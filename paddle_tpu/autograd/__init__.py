@@ -1,0 +1,36 @@
+"""paddle_tpu.autograd (reference: python/paddle/autograd/)."""
+
+from ..core.autograd import (  # noqa: F401
+    PyLayer, PyLayerContext, backward, enable_grad, is_grad_enabled, no_grad,
+    set_grad_enabled,
+)
+
+
+def hessian(func, xs, batch_axis=None):
+    import jax
+    from ..jit import tree_to_values, tree_to_tensors
+    from ..core import autograd as _ag
+
+    def f(*vals):
+        with _ag.functional_guard():
+            out = func(*tree_to_tensors(vals))
+        return tree_to_values(out)
+
+    vals = tree_to_values(xs if isinstance(xs, (list, tuple)) else (xs,))
+    h = jax.hessian(f, argnums=tuple(range(len(vals))))(*vals)
+    return tree_to_tensors(h)
+
+
+def jacobian(func, xs, batch_axis=None):
+    import jax
+    from ..jit import tree_to_values, tree_to_tensors
+    from ..core import autograd as _ag
+
+    def f(*vals):
+        with _ag.functional_guard():
+            out = func(*tree_to_tensors(vals))
+        return tree_to_values(out)
+
+    vals = tree_to_values(xs if isinstance(xs, (list, tuple)) else (xs,))
+    j = jax.jacobian(f, argnums=tuple(range(len(vals))))(*vals)
+    return tree_to_tensors(j)

@@ -1,0 +1,2 @@
+from . import autograd, dtype, enforce, place, tensor  # noqa: F401
+from .tensor import Parameter, Tensor, apply_op  # noqa: F401

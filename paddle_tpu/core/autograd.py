@@ -1,0 +1,359 @@
+"""Eager autograd engine.
+
+TPU-native redesign of the reference's dygraph engine
+(paddle/fluid/eager/backward.cc, grad_node_info.h): the reference code-generates
+a C++ GradNode class per op; here every op records ONE generic node whose
+backward is the ``jax.vjp`` of the op's jax implementation. ``backward()`` runs
+a reverse-topological sweep over the recorded DAG, exactly like
+``egr::Backward``'s ready-queue, accumulating into leaf ``Tensor.grad``.
+
+Eager mode is the debuggable path; the performance path wraps whole train
+steps in ``jax.jit`` via ``paddle_tpu.jit`` where this tape is bypassed and
+``jax.grad`` differentiates the traced program.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True          # paddle.no_grad toggles this
+        self.functional = 0          # >0 inside jit tracing: bypass the tape
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled and _state.functional == 0
+
+
+@contextlib.contextmanager
+def no_grad_guard():
+    prev = _state.enabled
+    _state.enabled = False
+    try:
+        yield
+    finally:
+        _state.enabled = prev
+
+
+class no_grad(contextlib.ContextDecorator):
+    """``paddle.no_grad``: context manager and decorator."""
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+
+def set_grad_enabled(mode: bool):
+    class _Ctx:
+        def __init__(self):
+            self._prev = _state.enabled
+            _state.enabled = bool(mode)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            _state.enabled = self._prev
+            return False
+
+    return _Ctx()
+
+
+@contextlib.contextmanager
+def functional_guard():
+    """Inside jit tracing: ops execute but the tape does not record."""
+    _state.functional += 1
+    try:
+        yield
+    finally:
+        _state.functional -= 1
+
+
+def in_functional_mode() -> bool:
+    return _state.functional > 0
+
+
+class GradNode:
+    """One recorded op. ``vjp_fn`` maps output cotangents -> input cotangents
+    for the float inputs that required grad (``inputs``)."""
+
+    __slots__ = (
+        "name",
+        "vjp_fn",
+        "inputs",
+        "out_avals",
+        "__weakref__",
+    )
+
+    def __init__(self, name, vjp_fn, inputs, out_avals):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs          # list[Tensor] (floats that require grad)
+        self.out_avals = out_avals    # list[(shape, dtype)] of op outputs
+
+    def parents(self):
+        return [t._grad_node for t in self.inputs if t._grad_node is not None]
+
+    def __repr__(self):
+        return f"GradNode({self.name})"
+
+
+def _is_float_array(x) -> bool:
+    try:
+        return jnp.issubdtype(jnp.result_type(x), jnp.floating)
+    except TypeError:
+        return False
+
+
+def record_op(
+    name: str,
+    fn: Callable,
+    tensor_args: Sequence[Any],
+    values: Tuple[Any, ...],
+    kwargs: Dict[str, Any],
+):
+    """Execute ``fn(*values, **kwargs)`` and, if recording, attach a GradNode.
+
+    Returns (raw_outputs, node_or_None, out_is_tuple).
+    ``tensor_args`` is parallel to ``values``: the Tensor object for args that
+    were Tensors, else None.
+    """
+    from .tensor import Tensor  # local to avoid import cycle
+
+    diff_idx = [
+        i
+        for i, t in enumerate(tensor_args)
+        if t is not None and not t.stop_gradient and _is_float_array(values[i])
+    ]
+    if not (is_grad_enabled() and diff_idx):
+        out = fn(*values, **kwargs)
+        return out, None
+
+    def closed(*dargs):
+        vals = list(values)
+        for i, v in zip(diff_idx, dargs):
+            vals[i] = v
+        return fn(*vals, **kwargs)
+
+    primals = tuple(values[i] for i in diff_idx)
+    out, vjp_fn = jax.vjp(closed, *primals)
+    leaves = out if isinstance(out, (tuple, list)) else (out,)
+    out_avals = [(np.shape(o), jnp.result_type(o)) for o in leaves]
+    node = GradNode(name, vjp_fn, [tensor_args[i] for i in diff_idx], out_avals)
+    return out, node
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
+    """``paddle.autograd.backward`` / ``Tensor.backward()``.
+
+    Reverse-topological ready-queue over the recorded GradNode DAG —
+    the same algorithm as the reference's egr::Backward
+    (paddle/fluid/eager/backward.cc), in Python over jax VJPs.
+    """
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # Seed cotangents.
+    node_cotangents: Dict[GradNode, List[Optional[jax.Array]]] = {}
+    roots: List[GradNode] = []
+
+    def _seed(node: GradNode, idx: int, g):
+        buf = node_cotangents.setdefault(node, [None] * len(node.out_avals))
+        buf[idx] = g if buf[idx] is None else buf[idx] + g
+
+    for t, g in zip(tensors, grad_tensors):
+        if t._grad_node is None:
+            # A leaf: backward() on it just sets its own grad.
+            if not t.stop_gradient:
+                seed = g.value if g is not None else jnp.ones_like(t.value)
+                t._accumulate_grad(seed)
+            continue
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}. Pass grad_tensors explicitly."
+                )
+            gval = jnp.ones_like(t.value)
+        else:
+            gval = g.value if isinstance(g, Tensor) else jnp.asarray(g)
+        _seed(t._grad_node, t._out_index, gval)
+        roots.append(t._grad_node)
+
+    if not roots:
+        return
+
+    # Collect reachable nodes and count consumers of each producer node.
+    reachable: set = set()
+    stack = list(roots)
+    while stack:
+        n = stack.pop()
+        if n in reachable:
+            continue
+        reachable.add(n)
+        stack.extend(n.parents())
+
+    consumer_count: Dict[GradNode, int] = {n: 0 for n in reachable}
+    for n in reachable:
+        for p in n.parents():
+            consumer_count[p] += 1
+
+    # Kahn init on the reversed DAG: start from nodes no reachable consumer
+    # still needs (the loss-side frontier).
+    pending = dict(consumer_count)
+    ready = [n for n, c in pending.items() if c == 0]
+
+    processed = set()
+    while ready:
+        node = ready.pop()
+        if node in processed:
+            continue
+        processed.add(node)
+        buf = node_cotangents.get(node)
+        if buf is None:
+            # No cotangent ever reached this node (dead branch): its inputs get
+            # zeros only if someone needs them; skip entirely.
+            cots = None
+        else:
+            cots = [
+                c if c is not None else jnp.zeros(shape, dtype)
+                for c, (shape, dtype) in zip(buf, node.out_avals)
+            ]
+        if cots is not None:
+            out_struct = cots[0] if len(cots) == 1 else tuple(cots)
+            # jax.vjp returns cotangent tuple for the diff inputs
+            try:
+                in_grads = node.vjp_fn(out_struct)
+            except TypeError:
+                in_grads = node.vjp_fn(tuple(cots))
+            for t, gval in zip(node.inputs, in_grads):
+                if gval is None:
+                    continue
+                if t._grad_node is not None:
+                    _seed(t._grad_node, t._out_index, gval)
+                if t._grad_node is None or t._retain_grads:
+                    t._accumulate_grad(gval)
+        if not retain_graph:
+            node.vjp_fn = None
+        node_cotangents.pop(node, None)
+        for p in node.parents():
+            pending[p] -= 1
+            if pending[p] == 0:
+                ready.append(p)
+
+
+class PyLayerContext:
+    """Context passed to PyLayer.forward/backward (paddle.autograd.PyLayer)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined differentiable function, mirroring ``paddle.autograd.PyLayer``.
+
+    Subclass with ``forward(ctx, *args)`` and ``backward(ctx, *grads)`` static
+    methods; call via ``MyLayer.apply(*args)``. Under the hood the backward is
+    registered on the tape as a custom vjp.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from .tensor import Tensor
+
+        ctx = PyLayerContext()
+        tensor_args = [a if isinstance(a, Tensor) else None for a in args]
+        with no_grad_guard():
+            out = cls.forward(ctx, *args, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        outs = [o for o in outs]
+
+        diff_inputs = [
+            t for t in tensor_args
+            if t is not None and not t.stop_gradient and _is_float_array(t.value)
+        ]
+        if not (is_grad_enabled() and diff_inputs):
+            return out
+
+        out_avals = [(tuple(o.shape), o.dtype.np_dtype) for o in outs]
+
+        diff_ids = {id(t) for t in diff_inputs}
+
+        def vjp_fn(cotangent):
+            cots = cotangent if isinstance(cotangent, tuple) else (cotangent,)
+            cot_tensors = [Tensor(c, stop_gradient=True) for c in cots]
+            with no_grad_guard():
+                gin = cls.backward(ctx, *cot_tensors)
+            gins = list(gin) if isinstance(gin, (tuple, list)) else [gin]
+            # paddle semantics: backward returns one grad per differentiable
+            # forward input, in order.
+            vals = []
+            gi = iter(gins)
+            for t in args:
+                if isinstance(t, Tensor) and id(t) in diff_ids:
+                    g = next(gi, None)
+                    vals.append(
+                        None if g is None else (g.value if isinstance(g, Tensor) else g)
+                    )
+            return tuple(vals)
+
+        node = GradNode(cls.__name__, vjp_fn, diff_inputs, out_avals)
+        for i, o in enumerate(outs):
+            o.stop_gradient = False
+            o._grad_node = node
+            o._out_index = i
+        return out if isinstance(out, (tuple, list)) else outs[0]

@@ -1,0 +1,115 @@
+"""Dtype system.
+
+Paddle-shaped dtype objects (``paddle.float32`` etc. — reference:
+paddle/phi/common/data_type.h) backed by numpy/jax dtypes. A ``DType``
+compares equal to its string name, to the numpy dtype, and to other DType
+instances, so user code written against either convention works.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+class DType:
+    __slots__ = ("name", "np_dtype")
+    _registry: dict = {}
+
+    def __new__(cls, name: str, np_dtype):
+        if name in cls._registry:
+            return cls._registry[name]
+        self = super().__new__(cls)
+        object.__setattr__(self, "name", name)
+        object.__setattr__(self, "np_dtype", np.dtype(np_dtype))
+        cls._registry[name] = self
+        return self
+
+    def __setattr__(self, *a):  # immutable
+        raise AttributeError("DType is immutable")
+
+    def __repr__(self) -> str:
+        return f"paddle_tpu.{self.name}"
+
+    def __str__(self) -> str:
+        return self.name
+
+    def __hash__(self) -> int:
+        return hash(self.name)
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == other or str(self.np_dtype) == other
+        try:
+            return np.dtype(other) == self.np_dtype
+        except TypeError:
+            return NotImplemented
+
+    @property
+    def is_floating_point(self) -> bool:
+        return jnp.issubdtype(self.np_dtype, np.floating)
+
+    @property
+    def is_integer(self) -> bool:
+        return jnp.issubdtype(self.np_dtype, np.integer)
+
+    @property
+    def is_complex(self) -> bool:
+        return jnp.issubdtype(self.np_dtype, np.complexfloating)
+
+    @property
+    def itemsize(self) -> int:
+        return self.np_dtype.itemsize
+
+
+bfloat16 = DType("bfloat16", jnp.bfloat16)
+float16 = DType("float16", np.float16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+int8 = DType("int8", np.int8)
+uint8 = DType("uint8", np.uint8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+bool_ = DType("bool", np.bool_)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+float8_e4m3fn = DType("float8_e4m3fn", jnp.float8_e4m3fn)
+float8_e5m2 = DType("float8_e5m2", jnp.float8_e5m2)
+
+_BY_NAME = dict(DType._registry)
+_BY_NAME["bool"] = bool_
+_BY_NAME["float"] = float32
+_BY_NAME["double"] = float64
+_BY_NAME["half"] = float16
+_BY_NAME["int"] = int32
+_BY_NAME["long"] = int64
+
+
+def to_paddle_dtype(dtype) -> DType:
+    """Coerce str / numpy dtype / jax dtype / DType to a DType."""
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        if dtype in _BY_NAME:
+            return _BY_NAME[dtype]
+        return DType(str(np.dtype(dtype)), np.dtype(dtype))
+    npd = np.dtype(dtype)
+    name = "bfloat16" if npd == jnp.bfloat16 else str(npd)
+    if name in _BY_NAME:
+        return _BY_NAME[name]
+    return DType(name, npd)
+
+
+def to_jax_dtype(dtype):
+    """Coerce any dtype spec to the numpy/jax dtype object jnp accepts."""
+    if dtype is None:
+        return None
+    return to_paddle_dtype(dtype).np_dtype
+
+
+def is_floating(dtype) -> bool:
+    return to_paddle_dtype(dtype).is_floating_point

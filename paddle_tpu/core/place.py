@@ -1,0 +1,149 @@
+"""Device placement facade.
+
+Reference: phi::Place / DeviceContext (paddle/phi/common/place.h,
+paddle/phi/core/device_context.h). On TPU, PJRT owns streams and memory, so
+a Place is a thin handle to a ``jax.Device`` and the DeviceContext reduces
+to device selection + default-dtype state. ``set_device('tpu')`` /
+``get_device()`` mirror ``paddle.set_device`` / ``paddle.get_device``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+from .enforce import InvalidArgumentError
+
+
+class Place:
+    """Base place: a handle to a jax device."""
+
+    device_type = "undefined"
+
+    def __init__(self, device_id: int = 0):
+        self._device_id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._device_id
+
+    def __repr__(self) -> str:
+        return f"Place({self.device_type}:{self._device_id})"
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self._device_id == other._device_id
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.device_type, self._device_id))
+
+    def jax_device(self) -> Optional[jax.Device]:
+        devs = [d for d in jax.devices() if _platform_of(d) == self.device_type]
+        if not devs:
+            devs = jax.devices()  # fall back to default platform
+        return devs[min(self._device_id, len(devs) - 1)]
+
+    def is_cpu_place(self) -> bool:
+        return self.device_type == "cpu"
+
+    def is_tpu_place(self) -> bool:
+        return self.device_type == "tpu"
+
+    # GPU never exists in this stack; kept for source compatibility.
+    def is_gpu_place(self) -> bool:
+        return False
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+# Source-compat aliases: code written against the reference's CUDA places
+# runs unchanged on the TPU build.
+CUDAPlace = TPUPlace
+XPUPlace = TPUPlace
+CustomPlace = TPUPlace
+
+
+def _platform_of(d: jax.Device) -> str:
+    p = d.platform.lower()
+    # the axon PJRT plugin reports platform 'axon' for a TPU chip
+    return "tpu" if p in ("tpu", "axon") else p
+
+
+class _DeviceState(threading.local):
+    def __init__(self):
+        self.place: Optional[Place] = None
+        self.default_dtype = "float32"
+
+
+_state = _DeviceState()
+
+
+def _default_place() -> Place:
+    plats = {_platform_of(d) for d in jax.devices()}
+    return TPUPlace(0) if "tpu" in plats else CPUPlace(0)
+
+
+def set_device(device: str) -> Place:
+    """``paddle.set_device`` analogue. Accepts 'cpu', 'tpu', 'tpu:N';
+    'gpu'/'xpu' map to tpu for source compatibility."""
+    dev = device.lower()
+    if ":" in dev:
+        kind, _, idx = dev.partition(":")
+        idx = int(idx)
+    else:
+        kind, idx = dev, 0
+    if kind in ("tpu", "gpu", "cuda", "xpu", "npu", "custom_device"):
+        place: Place = TPUPlace(idx)
+    elif kind == "cpu":
+        place = CPUPlace(idx)
+    else:
+        raise InvalidArgumentError(f"Unknown device {device!r}")
+    _state.place = place
+    return place
+
+
+def get_device() -> str:
+    p = current_place()
+    return f"{p.device_type}:{p.get_device_id()}"
+
+
+def current_place() -> Place:
+    if _state.place is None:
+        _state.place = _default_place()
+    return _state.place
+
+
+def set_default_dtype(dtype) -> None:
+    from .dtype import to_paddle_dtype
+
+    _state.default_dtype = to_paddle_dtype(dtype).name
+
+
+def get_default_dtype() -> str:
+    return _state.default_dtype
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def device_count() -> int:
+    return len(jax.devices())

@@ -1,0 +1,3 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed/)."""
+
+from . import fleet  # noqa: F401

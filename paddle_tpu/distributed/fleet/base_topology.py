@@ -1,0 +1,308 @@
+"""Hybrid-parallel topology over a TPU device mesh.
+
+Reference: python/paddle/distributed/fleet/base/topology.py
+(``CommunicateTopology``, ``HybridCommunicateGroup``). The reference lays
+processes on a rank grid ordered [dp, pp, sharding, sep, mp] — mp innermost so
+TP traffic rides NVLink. Here the grid IS a ``jax.sharding.Mesh``: mp maps to
+the innermost ICI axis, dp outermost (DCN when multi-host). A "process group"
+becomes a mesh axis name; collectives over it are XLA collectives inside
+jitted/shard_mapped programs.
+
+Axis name mapping (reference degree -> mesh axis):
+  dp_degree       -> "dp"     (data parallel)
+  pp_degree       -> "pp"     (pipeline stages)
+  sharding_degree -> "sharding" (ZeRO; usually fused with dp on TPU)
+  sep_degree      -> "sep"    (sequence/context parallel: Ulysses/ring)
+  mp_degree       -> "mp"     (tensor parallel, innermost)
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+_HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+_CURRENT_HCG: Optional["HybridCommunicateGroup"] = None
+
+
+class CommGroup:
+    """Facade for a communication group: a (mesh, axis) pair.
+
+    Stands in for the reference's ProcessGroup handle returned by
+    ``new_group``/HCG getters. ``axis_name`` is what collective ops use inside
+    shard_map; ``ranks`` reflect the logical rank grid.
+    """
+
+    _next_id = itertools.count()
+
+    def __init__(self, mesh: Optional[Mesh], axis_name: Optional[str],
+                 ranks: List[int], rank: int):
+        self.id = next(CommGroup._next_id)
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.ranks = list(ranks)
+        self.rank = rank          # this process's rank within the group, or -1
+        self.nranks = len(ranks)
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def name(self) -> str:
+        return f"comm_group_{self.id}_{self.axis_name or 'world'}"
+
+    def get_group_rank(self, global_rank: int) -> int:
+        return self.ranks.index(global_rank) if global_rank in self.ranks else -1
+
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"CommGroup(axis={self.axis_name}, ranks={self.ranks}, rank={self.rank})"
+
+
+class CommunicateTopology:
+    """The rank grid (reference class of the same name)."""
+
+    def __init__(
+        self,
+        hybrid_group_names: Sequence[str] = ("data", "pipe", "sharding", "sep", "model"),
+        dims: Sequence[int] = (1, 1, 1, 1, 1),
+    ):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(int(d) for d in dims)
+        self.coordinate = collections.namedtuple("Coordinate", self._parallel_names)
+        self._world_size = int(np.prod(self._dims))
+        ranks = range(self._world_size)
+        self._coord2rank = dict(zip(
+            (self.coordinate(*c) for c in itertools.product(*(range(d) for d in self._dims))),
+            ranks))
+        self._rank2coord = {v: k for k, v in self._coord2rank.items()}
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return self._world_size
+
+    def get_rank(self, **args) -> int:
+        return self._coord2rank[self.coordinate(**args)]
+
+    def get_coord(self, rank: int):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for c, r in self._coord2rank.items() if c[axis] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """All groups along ``axis_name``: one list of ranks per combination of
+        the other axes (the reference's group-building enumeration)."""
+        axis = self._parallel_names.index(axis_name)
+        other_dims = [range(d) for i, d in enumerate(self._dims) if i != axis]
+        out = []
+        for combo in itertools.product(*other_dims):
+            ranks = []
+            for i in range(self._dims[axis]):
+                coord = list(combo)
+                coord.insert(axis, i)
+                ranks.append(self._coord2rank[self.coordinate(*coord)])
+            out.append(ranks)
+        return out
+
+    def get_rank_from_stage(self, global_rank: int, **kwargs) -> int:
+        coord = self.get_coord(global_rank)
+        tf = coord._replace(**kwargs)._asdict()
+        return self.get_rank(**tf)
+
+
+class HybridCommunicateGroup:
+    """Reference-shaped facade over the device mesh.
+
+    Build from degrees; exposes the reference's getters plus ``get_mesh()``
+    for the jit/GSPMD path. On a single controller, the "current rank" is
+    process-based (multi-host: jax.process_index spans the dp/pp outer axes).
+    """
+
+    def __init__(self, topology: CommunicateTopology,
+                 mesh: Optional[Mesh] = None, global_rank: int = 0):
+        self._topo = topology
+        names = topology.get_hybrid_group_names()
+        deg = {n: topology.get_dim(n) for n in names}
+        self._dp_degree = deg.get("data", 1)
+        self._pp_degree = deg.get("pipe", 1)
+        self._sharding_degree = deg.get("sharding", 1)
+        self._sep_degree = deg.get("sep", 1)
+        self._mp_degree = deg.get("model", 1)
+        self.nranks = topology.world_size()
+        self.global_rank = global_rank
+        self._mesh = mesh if mesh is not None else self._build_mesh()
+
+        coord = self._topo.get_coord(global_rank)
+        self._dp_rank = coord.data if hasattr(coord, "data") else 0
+        self._pp_rank = coord.pipe if hasattr(coord, "pipe") else 0
+        self._sharding_rank = coord.sharding if hasattr(coord, "sharding") else 0
+        self._sep_rank = coord.sep if hasattr(coord, "sep") else 0
+        self._mp_rank = coord.model if hasattr(coord, "model") else 0
+
+        global _CURRENT_HCG
+        _CURRENT_HCG = self
+
+    def _build_mesh(self) -> Mesh:
+        devices = jax.devices()
+        need = self.nranks
+        if len(devices) < need:
+            raise RuntimeError(
+                f"hybrid topology needs {need} devices, found {len(devices)}. "
+                "For CPU simulation set XLA_FLAGS=--xla_force_host_platform_device_count=N.")
+        grid = np.array(devices[:need]).reshape(
+            self._dp_degree, self._pp_degree, self._sharding_degree,
+            self._sep_degree, self._mp_degree)
+        return Mesh(grid, axis_names=_HYBRID_AXES)
+
+    # ----------------------------------------------------------------- mesh
+    def get_mesh(self) -> Mesh:
+        return self._mesh
+
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def get_parallel_mode(self) -> str:
+        # mirrors reference ParallelMode decision
+        if self._mp_degree == 1 and self._pp_degree == 1 and self._sharding_degree == 1:
+            return "DATA_PARALLEL" if self._dp_degree > 1 else "SINGLE"
+        if self._mp_degree > 1 and self._pp_degree == 1:
+            return "TENSOR_PARALLEL"
+        if self._pp_degree > 1:
+            return "PIPELINE_PARALLEL"
+        return "SHARDING_PARALLEL"
+
+    def _axis_group(self, axis: str, rank_in_axis: int) -> CommGroup:
+        name_map = {"dp": "data", "pp": "pipe", "sharding": "sharding",
+                    "sep": "sep", "mp": "model"}
+        comm_lists = self._topo.get_comm_list(name_map[axis])
+        my = next((g for g in comm_lists if self.global_rank in g), comm_lists[0])
+        return CommGroup(self._mesh, axis, my, my.index(self.global_rank)
+                         if self.global_rank in my else 0)
+
+    # --------------------------------------------------------------- global
+    def get_global_rank(self) -> int:
+        return self.global_rank
+
+    # ------------------------------------------------------------------- dp
+    def get_data_parallel_rank(self) -> int:
+        return self._dp_rank
+
+    def get_data_parallel_world_size(self) -> int:
+        return self._dp_degree
+
+    def get_data_parallel_group(self) -> CommGroup:
+        return self._axis_group("dp", self._dp_rank)
+
+    def get_data_parallel_group_src_rank(self) -> int:
+        return self.get_data_parallel_group().ranks[0]
+
+    # ------------------------------------------------------------------- mp
+    def get_model_parallel_rank(self) -> int:
+        return self._mp_rank
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._mp_degree
+
+    def get_model_parallel_group(self) -> CommGroup:
+        return self._axis_group("mp", self._mp_rank)
+
+    def get_model_parallel_group_src_rank(self) -> int:
+        return self.get_model_parallel_group().ranks[0]
+
+    # ------------------------------------------------------------------- pp
+    def get_stage_id(self) -> int:
+        return self._pp_rank
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self) -> CommGroup:
+        return self._axis_group("pp", self._pp_rank)
+
+    def is_first_stage(self) -> bool:
+        return self._pp_rank == 0
+
+    def is_last_stage(self) -> bool:
+        return self._pp_rank == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        return None  # p2p rides ppermute inside the jitted pipeline schedule
+
+    # -------------------------------------------------------------- sharding
+    def get_sharding_parallel_rank(self) -> int:
+        return self._sharding_rank
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self) -> CommGroup:
+        return self._axis_group("sharding", self._sharding_rank)
+
+    def get_sharding_parallel_group_src_rank(self) -> int:
+        return self.get_sharding_parallel_group().ranks[0]
+
+    # ------------------------------------------------------------------ sep
+    def get_sep_parallel_rank(self) -> int:
+        return self._sep_rank
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._sep_degree
+
+    def get_sep_parallel_group(self) -> CommGroup:
+        return self._axis_group("sep", self._sep_rank)
+
+    # ------------------------------------------------------- combined groups
+    def get_check_parallel_group(self, sharding: bool = False) -> CommGroup:
+        return CommGroup(self._mesh, None, list(range(self.nranks)), self.global_rank)
+
+    def get_rank_from_stage(self, stage_id: int, **kwargs) -> int:
+        return self._topo.get_rank_from_stage(self.global_rank, pipe=stage_id, **kwargs)
+
+    def __repr__(self):
+        return (f"HybridCommunicateGroup(dp={self._dp_degree}, pp={self._pp_degree}, "
+                f"sharding={self._sharding_degree}, sep={self._sep_degree}, "
+                f"mp={self._mp_degree})")
+
+
+def create_hybrid_communicate_group(
+    dp_degree: int = 1, mp_degree: int = 1, pp_degree: int = 1,
+    sharding_degree: int = 1, sep_degree: int = 1,
+) -> HybridCommunicateGroup:
+    topo = CommunicateTopology(
+        ("data", "pipe", "sharding", "sep", "model"),
+        (dp_degree, pp_degree, sharding_degree, sep_degree, mp_degree))
+    return HybridCommunicateGroup(topo)
+
+
+def try_get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _CURRENT_HCG
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    if _CURRENT_HCG is None:
+        raise RuntimeError("fleet.init(...) has not been called")
+    return _CURRENT_HCG
+
+
+def _reset_hcg():
+    global _CURRENT_HCG
+    _CURRENT_HCG = None

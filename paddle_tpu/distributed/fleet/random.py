@@ -1,0 +1,110 @@
+"""TP-aware RNG state tracking.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py
+(``RNGStatesTracker``). Guarantees paddle's hybrid-parallel dropout semantics:
+dropout inside TP regions uses a *model-parallel* RNG state identical across
+TP ranks (so the mask agrees on replicated activations) or distinct across
+ranks (for sequence-parallel regions), while global dropout differs per dp
+rank. On TPU this is jax key folding: each named state is a base key; the
+local rank index is folded in only for per-rank states.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+LOCAL_RNG = "local_seed"
+GLOBAL_RNG = "global_seed"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+        self.seeds_ = set()
+        self.active_state: Optional[str] = None
+        self._lock = threading.Lock()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = jax.random.PRNGKey(int(seed))
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        prev = self.active_state
+        self.active_state = name
+        try:
+            yield
+        finally:
+            self.active_state = prev
+
+    def next_key(self) -> jax.Array:
+        """Split the active named state, persisting the new base key —
+        stateful-feeling RNG over jax's functional keys."""
+        with self._lock:
+            name = self.active_state
+            if name is None or name not in self.states_:
+                from ...framework.random import next_key as global_next
+                return global_next()
+            self.states_[name], sub = jax.random.split(self.states_[name])
+            return sub
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed: int = 1024):
+    """Initialize the tracker's named states from a base seed + topology,
+    mirroring the reference's model_parallel_random_seed: the model-parallel
+    state is identical across mp ranks; the local state differs per rank."""
+    from . import base_topology
+    hcg = base_topology.try_get_hybrid_communicate_group()
+    if hcg is not None:
+        mp_rank = hcg.get_model_parallel_rank()
+        dp_rank = hcg.get_data_parallel_rank()
+        pp_rank = hcg.get_stage_id()
+        global_rank = hcg.get_global_rank()
+    else:
+        mp_rank = dp_rank = pp_rank = global_rank = 0
+
+    local_seed = seed + 1024 + global_rank
+    global_seed = seed + 100 + dp_rank * 10 + pp_rank
+
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    tracker.add(GLOBAL_RNG, global_seed)
+    tracker.add(LOCAL_RNG, local_seed)
+    # model-parallel state: same seed for every mp rank in the same dp/pp slot
+    tracker.add(MODEL_PARALLEL_RNG, seed + 10 + dp_rank * 10 + pp_rank)
+    from ...framework.random import seed as set_global_seed
+    set_global_seed(global_seed)
+
+
+def determinate_seed(name: str) -> int:
+    import zlib
+    return zlib.adler32(name.encode())

@@ -1,0 +1,89 @@
+"""Parallel environment bootstrap.
+
+Reference: python/paddle/distributed/parallel.py (``init_parallel_env``,
+env-protocol driven ProcessGroup creation over TCPStore). Under JAX the
+runtime is single-controller per host: ``jax.distributed.initialize`` wires
+multi-host (DCN) coordination, and within a host all local devices are
+already visible. Rank/world_size are process-level (multi-host) notions.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+class ParallelEnv:
+    """Reference-shaped env view (python/paddle/distributed/parallel.py)."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def local_rank(self) -> int:
+        return int(os.environ.get("PADDLE_LOCAL_RANK", "0"))
+
+    @property
+    def dev_id(self) -> int:
+        return self.local_rank
+
+    @property
+    def nranks(self) -> int:
+        return get_world_size()
+
+    @property
+    def current_endpoint(self) -> str:
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+
+def init_parallel_env():
+    """``paddle.distributed.init_parallel_env``: on multi-host jobs, call
+    jax.distributed.initialize from the PADDLE_* env protocol set by the
+    launcher; single-host is a no-op (all chips already visible)."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    n_procs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if n_procs > 1 and jax.process_count() == 1:
+        coordinator = os.environ.get("PADDLE_MASTER",
+                                     os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")[0])
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=n_procs,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    _initialized = True
+    return ParallelEnv()
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def device_count() -> int:
+    return jax.local_device_count()

@@ -1,0 +1,135 @@
+"""Runtime flag registry.
+
+TPU-native equivalent of the reference's gflags-style registry
+(paddle/phi/core/flags.cc, paddle/utils/flags.h): typed, documented,
+env-overridable flags, settable at runtime via ``set_flags`` and readable
+via ``get_flags`` — same user API as ``paddle.set_flags``.
+
+Flags are read from the environment (``FLAGS_<name>=...``) at first access,
+so launchers can configure workers without code changes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: int,
+    float: float,
+    str: str,
+}
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    type: type
+    help: str
+    value: Any = None
+    is_set: bool = False  # explicitly set (env or set_flags)
+
+    def current(self) -> Any:
+        if self.is_set:
+            return self.value
+        env = os.environ.get("FLAGS_" + self.name)
+        if env is not None:
+            return _PARSERS[self.type](env)
+        return self.default
+
+
+class _Registry:
+    def __init__(self) -> None:
+        self._flags: Dict[str, _Flag] = {}
+        self._lock = threading.Lock()
+
+    def define(self, name: str, default: Any, help: str = "") -> None:
+        with self._lock:
+            if name in self._flags:
+                return
+            self._flags[name] = _Flag(name, default, type(default), help)
+
+    def get(self, name: str) -> Any:
+        f = self._flags.get(self._norm(name))
+        if f is None:
+            raise KeyError(f"Unknown flag: {name!r}. See paddle_tpu.flags.list_flags().")
+        return f.current()
+
+    def set(self, name: str, value: Any) -> None:
+        key = self._norm(name)
+        f = self._flags.get(key)
+        if f is None:
+            raise KeyError(f"Unknown flag: {name!r}. See paddle_tpu.flags.list_flags().")
+        if isinstance(value, str) and f.type is not str:
+            value = _PARSERS[f.type](value)
+        f.value = f.type(value)
+        f.is_set = True
+
+    @staticmethod
+    def _norm(name: str) -> str:
+        return name[6:] if name.startswith("FLAGS_") else name
+
+    def all(self) -> Dict[str, Any]:
+        return {n: f.current() for n, f in sorted(self._flags.items())}
+
+    def describe(self) -> List[str]:
+        return [
+            f"FLAGS_{n} (default={f.default!r}): {f.help}"
+            for n, f in sorted(self._flags.items())
+        ]
+
+
+_registry = _Registry()
+define_flag = _registry.define
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Set runtime flags. Mirrors ``paddle.set_flags``."""
+    for k, v in flags.items():
+        _registry.set(k, v)
+
+
+def get_flags(names) -> Dict[str, Any]:
+    """Read runtime flags. Mirrors ``paddle.get_flags``."""
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for n in names:
+        key = n if n.startswith("FLAGS_") else "FLAGS_" + n
+        out[key] = _registry.get(n)
+    return out
+
+
+def get_flag(name: str) -> Any:
+    return _registry.get(name)
+
+
+def list_flags() -> List[str]:
+    return _registry.describe()
+
+
+# ---------------------------------------------------------------------------
+# Core flag definitions (load-bearing set mirrored from the reference's
+# paddle/phi/core/flags.cc; TPU-specific ones added).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False, "Check every op output for NaN/Inf (debug).")
+define_flag("check_nan_inf_level", 0, "0: abort on nan/inf; >=1: report only.")
+define_flag("benchmark", False, "Synchronize after each op and log timings.")
+define_flag("deterministic", False, "Force deterministic kernels where possible.")
+define_flag("use_pallas", True, "Use Pallas fused kernels where available (vs pure-XLA fallbacks).")
+define_flag("allocator_strategy", "auto_growth", "Kept for API parity; PJRT owns memory on TPU.")
+define_flag("fraction_of_gpu_memory_to_use", 0.92, "API parity; PJRT owns memory on TPU.")
+define_flag("log_level", 1, "Framework log verbosity (GLOG_v analogue).")
+define_flag("eager_delete_tensor_gb", 0.0, "API parity; JAX GC owns tensor lifetime.")
+define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest.")
+define_flag("embedding_deterministic", 0, "API parity with reference embedding determinism flag.")
+define_flag("cudnn_deterministic", False, "API parity alias of FLAGS_deterministic.")

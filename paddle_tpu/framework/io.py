@@ -1,0 +1,82 @@
+"""Checkpoint save/load.
+
+Reference: python/paddle/framework/io.py (``paddle.save``/``paddle.load`` —
+pickled state dicts, .pdparams/.pdopt convention). Tensors round-trip
+through numpy; nested dicts/lists are preserved. Sharded / resharding
+checkpoints live in paddle_tpu.distributed.checkpoint (orbax-backed).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+
+
+class _TensorPayload:
+    """Pickle-stable wrapper (numpy + metadata)."""
+
+    def __init__(self, t: Tensor):
+        v = np.asarray(t._value)
+        # numpy can't represent bfloat16: store as uint16 view + marker
+        if str(t._value.dtype) == "bfloat16":
+            self.dtype = "bfloat16"
+            self.array = np.asarray(t._value.astype(jnp.float32))
+        else:
+            self.dtype = str(v.dtype)
+            self.array = v
+        self.stop_gradient = t.stop_gradient
+        self.name = t.name
+        self.is_parameter = isinstance(t, Parameter)
+
+    def to_tensor(self) -> Tensor:
+        arr = jnp.asarray(self.array)
+        if self.dtype == "bfloat16":
+            arr = arr.astype(jnp.bfloat16)
+        if self.is_parameter:
+            t = Parameter(arr, name=self.name)
+            t.stop_gradient = self.stop_gradient
+            return t
+        return Tensor(arr, stop_gradient=self.stop_gradient, name=self.name)
+
+
+def _pack(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj: Any, return_numpy=False) -> Any:
+    if isinstance(obj, _TensorPayload):
+        return obj.array if return_numpy else obj.to_tensor()
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs) -> None:
+    """``paddle.save``: pickle nested structures of Tensors to ``path``."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    """``paddle.load``: inverse of save."""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

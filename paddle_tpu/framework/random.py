@@ -1,0 +1,57 @@
+"""Global RNG state.
+
+Reference: paddle/phi/core/generator.cc + python/paddle/framework/random.py.
+JAX randomness is functional (explicit keys); this module owns a global key
+that eager random ops split from, giving paddle's stateful-RNG feel, while
+jitted code paths take explicit keys (see distributed/fleet/random.py for the
+TP-aware RNGStatesTracker).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+class _RNGState(threading.local):
+    def __init__(self):
+        self.key = jax.random.PRNGKey(0)
+        self.seed_value = 0
+
+
+_state = _RNGState()
+
+
+def seed(s: int):
+    """``paddle.seed``: reset the global generator."""
+    _state.key = jax.random.PRNGKey(int(s))
+    _state.seed_value = int(s)
+    return _state
+
+
+def get_rng_state():
+    return [_state.key]
+
+
+def set_rng_state(state):
+    _state.key = state[0] if isinstance(state, (list, tuple)) else state
+
+
+def get_cuda_rng_state():  # source compat
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    set_rng_state(state)
+
+
+def next_key() -> jax.Array:
+    """Split the global key and return a fresh subkey (eager random ops)."""
+    _state.key, sub = jax.random.split(_state.key)
+    return sub
+
+
+def default_seed() -> int:
+    return _state.seed_value

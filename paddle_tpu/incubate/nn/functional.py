@@ -1,0 +1,234 @@
+"""Fused-op surface (reference: python/paddle/incubate/nn/functional/).
+
+The reference exposes hand-fused CUDA kernels here; the TPU build maps each
+to either a Pallas kernel (paddle_tpu/kernels/) or a composition XLA fuses on
+its own. Names match the reference so user code ports directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ... import flags
+from ...core.tensor import Tensor, apply_op, _val
+from ...nn import functional as F
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, **kwargs):
+    """reference: paddle/phi/kernels/fusion/gpu rms_norm fused op. On TPU the
+    residual-add + rms_norm composition is one XLA fusion; a Pallas variant
+    exists for the long-row case (paddle_tpu/kernels/rms_norm.py)."""
+    if flags.get_flag("use_pallas"):
+        try:
+            from ...kernels.rms_norm import rms_norm_pallas
+            h = x
+            if bias is not None:
+                h = h + bias
+            if residual is not None:
+                h = h + residual
+            out = apply_op("fused_rms_norm",
+                           lambda a, w: rms_norm_pallas(a, w, epsilon),
+                           h, norm_weight)
+            return (out, h) if residual is not None else out
+        except Exception:
+            pass
+    h = x
+    if bias is not None:
+        h = h + bias
+    if residual is not None:
+        h = h + residual
+    out = F.rms_norm(h, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return (out, h) if residual is not None else out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, begin_norm_axis=-1,
+                     bias=None, residual=None, **kwargs):
+    h = x
+    if bias is not None:
+        h = h + bias
+    if residual is not None:
+        h = h + residual
+    out = F.layer_norm(h, h.shape[begin_norm_axis:] if begin_norm_axis >= 0
+                       else h.shape[-1], norm_weight, norm_bias, epsilon)
+    return (out, h) if residual is not None else out
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """reference: fused_rotary_position_embedding CUDA op. Layout [B, S, H, D]."""
+
+    def rope_one(t, sin_, cos_):
+        if t is None:
+            return None
+        d = t.shape[-1]
+        if use_neox_rotary_style:
+            t1, t2 = jnp.split(t, 2, axis=-1)
+            rot = jnp.concatenate([-t2, t1], axis=-1)
+            return t * cos_ + rot * sin_
+        t_even = t[..., 0::2]
+        t_odd = t[..., 1::2]
+        out_even = t_even * cos_[..., 0::2] - t_odd * sin_[..., 0::2]
+        out_odd = t_odd * cos_[..., 0::2] + t_even * sin_[..., 0::2]
+        return jnp.stack([out_even, out_odd], axis=-1).reshape(t.shape)
+
+    qv, kv, vv = _val(q), _val(k) if k is not None else None, _val(v) if v is not None else None
+    seq_axis = 0 if time_major else 1
+    s = qv.shape[seq_axis]
+    d = qv.shape[-1]
+    if sin is None or cos is None:
+        pos = jnp.arange(s, dtype=jnp.float32)
+        inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+        freqs = jnp.outer(pos, inv)
+        emb = jnp.concatenate([freqs, freqs], axis=-1)
+        sin_v, cos_v = jnp.sin(emb), jnp.cos(emb)
+    else:
+        sin_v, cos_v = _val(sin), _val(cos)
+        sin_v = sin_v.reshape(s, d) if sin_v.ndim > 2 else sin_v
+        cos_v = cos_v.reshape(s, d) if cos_v.ndim > 2 else cos_v
+    if position_ids is not None:
+        pid = _val(position_ids)
+        sin_v = jnp.take(sin_v, pid, axis=0)  # [B, S, D]
+        cos_v = jnp.take(cos_v, pid, axis=0)
+        sin_b = sin_v[:, :, None, :]
+        cos_b = cos_v[:, :, None, :]
+    else:
+        if time_major:
+            sin_b = sin_v[:, None, None, :]
+            cos_b = cos_v[:, None, None, :]
+        else:
+            sin_b = sin_v[None, :, None, :]
+            cos_b = cos_v[None, :, None, :]
+
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        outs.append(apply_op("fused_rope",
+                             lambda a: rope_one(a, sin_b.astype(a.dtype),
+                                                cos_b.astype(a.dtype)), t))
+    return tuple(outs)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train"):
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None, ln_scale=None,
+                                           ln_bias=None, dropout_rate=0.5,
+                                           ln_epsilon=1e-5, training=True):
+    """reference: paddle/phi/kernels/fusion/gpu/fused_bias_dropout_residual_
+    layer_norm — one XLA fusion here."""
+    h = x if bias is None else x + bias
+    h = F.dropout(h, p=dropout_rate, training=training)
+    h = h + residual
+    return F.layer_norm(h, h.shape[-1], ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    if transpose_weight:
+        from ... import ops
+        weight = ops.t(weight)
+    return F.linear(x, weight, bias)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    from ... import ops
+    out = ops.matmul(x, y, transpose_x=trans_x, transpose_y=trans_y)
+    if bias is not None:
+        out = out + bias
+    if activation == "gelu":
+        return F.gelu(out)
+    if activation == "relu":
+        return F.relu(out)
+    return out
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False):
+    from ... import ops
+    out = ops.matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    return out if bias is None else out + bias
+
+
+def swiglu(x, y=None):
+    return F.swiglu(x, y)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               transpose_qkv_wb=False):
+    """reference: paddle/fluid/operators/fused/fused_attention_op.cu.
+    Composed from XLA/Pallas pieces; numerics match the reference layout
+    (qkv_weight [3, H, D_head, D_model])."""
+    from ... import ops
+
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, h.shape[-1], pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    qw = _val(qkv_weight)
+    b, s, d = _val(h).shape
+    n_heads = qw.shape[1]
+    head_dim = qw.shape[2]
+
+    def qkv_fn(a, w, *bias_):
+        qkv = jnp.einsum("bsd,thed->bsthe", a, w)  # t in {q,k,v}
+        if bias_:
+            qkv = qkv + _val(qkv_bias).reshape(1, 1, 3, n_heads, head_dim)
+        return qkv
+
+    args = (h, qkv_weight) + ((qkv_bias,) if qkv_bias is not None else ())
+    qkv = apply_op("fused_qkv", qkv_fn, *args)
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    if cache_kv is not None:
+        k = ops.concat([cache_kv[0], k], axis=1)
+        v = ops.concat([cache_kv[1], v], axis=1)
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0, training=training)
+    out = out.reshape([b, s, n_heads * head_dim])
+    out = F.linear(out, linear_weight, linear_bias)
+    out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1], ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1):
+    """reference: paddle/fluid/operators/fused/fused_feedforward_op.cu."""
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, h.shape[-1], ln1_scale, ln1_bias, ln1_epsilon)
+    h = F.linear(h, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    h = F.dropout(h, p=dropout1_rate, training=training, mode=mode)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    h = F.dropout(h, p=dropout2_rate, training=training, mode=mode)
+    out = residual + h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1], ln2_scale, ln2_bias, ln2_epsilon)
+    return out

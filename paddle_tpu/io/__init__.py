@@ -1,0 +1,18 @@
+"""paddle_tpu.io — datasets and data loading
+(reference: python/paddle/io/ — dataloader, samplers).
+
+The reference's multiprocess loader exists to keep CUDA streams fed; on TPU
+the host is free during device steps, so a background-thread prefetcher
+(double buffering onto the device) achieves the same overlap with far less
+machinery. ``num_workers`` maps to a thread pool for ``__getitem__``.
+"""
+
+from .dataset import (  # noqa: F401
+    ChainDataset, ComposeDataset, ConcatDataset, Dataset, IterableDataset,
+    Subset, TensorDataset, random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler, DistributedBatchSampler, RandomSampler, Sampler,
+    SequenceSampler, SubsetRandomSampler, WeightedRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401

@@ -1,0 +1,134 @@
+"""DataLoader (reference: python/paddle/io/dataloader/dataloader_iter.py).
+
+Thread-pool ``__getitem__`` + a bounded background prefetch queue replaces
+the reference's multiprocess worker/shared-memory machinery: on TPU the host
+is idle while the device steps, so prefetch depth 2 suffices to hide input
+latency. Numpy collation feeds ``jnp.asarray`` once per batch (single H2D).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+class _WorkerInfo:
+    def __init__(self, id=0, num_workers=0, dataset=None):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info: Optional[_WorkerInfo] = None
+
+
+def get_worker_info():
+    return _worker_info
+
+
+def default_collate_fn(batch):
+    """Stack samples into batched numpy/Tensor structures."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s._value) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.number)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn([b[i] for b in batch])
+                            for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    return list(batch)
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 1)
+        self.use_buffer_reader = use_buffer_reader
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def _iter_batches(self):
+        if self._iterable_mode:
+            batch = []
+            for sample in self.dataset:
+                batch.append(sample)
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+            return
+
+        if self.num_workers > 0:
+            pool = ThreadPoolExecutor(max_workers=self.num_workers)
+            try:
+                for indices in self.batch_sampler:
+                    samples = list(pool.map(self.dataset.__getitem__, indices))
+                    yield self.collate_fn(samples)
+            finally:
+                pool.shutdown(wait=False)
+        else:
+            for indices in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        if not self.use_buffer_reader:
+            yield from self._iter_batches()
+            return
+        # background prefetch: keep `prefetch_factor` batches ready
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor)
+        _SENTINEL = object()
+        exc = []
+
+        def producer():
+            try:
+                for b in self._iter_batches():
+                    q.put(b)
+            except BaseException as e:  # surfaced on the consumer side
+                exc.append(e)
+            finally:
+                q.put(_SENTINEL)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                break
+            yield item
+        if exc:
+            raise exc[0]

@@ -1,0 +1,149 @@
+"""jit / to_static: the dynamic-to-static bridge.
+
+Reference: python/paddle/jit (dy2static AST transform + SOT bytecode capture
+feeding ProgramDesc/PIR + InterpreterCore). On TPU the entire IR + executor
+stack collapses into ``jax.jit``: tracing the eager API under a functional
+guard yields a jaxpr, XLA is the compiler and the executor. What remains of
+the reference's machinery is the param/buffer threading — done here with a
+torch.func-style ``functional_call`` that swaps Layer parameter values for
+traced arrays during tracing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.tensor import Tensor
+
+
+def _to_value(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _wrap_value(x):
+    if hasattr(x, "dtype") and hasattr(x, "shape"):
+        return Tensor(x, stop_gradient=True)
+    return x
+
+
+def tree_to_values(tree):
+    return jax.tree.map(_to_value, tree, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def tree_to_tensors(tree):
+    return jax.tree.map(_wrap_value, tree)
+
+
+def functional_call(
+    layer,
+    params: Dict[str, Any],
+    *args,
+    buffers: Optional[Dict[str, Any]] = None,
+    **kwargs,
+):
+    """Run ``layer.forward(*args)`` with parameter/buffer values taken from
+    ``params``/``buffers`` (flat name->array dicts), purely functionally.
+
+    Used to trace a Layer under jax.jit / jax.grad: the layer's Tensors get
+    their ``_value`` temporarily replaced by traced arrays. Returns raw jax
+    values (not Tensors). Forward must be functional w.r.t. params (true for
+    all in-tree layers).
+    """
+    named = dict(layer.named_parameters())
+    named_buf = dict(layer.named_buffers())
+    saved = {}
+    try:
+        for k, v in params.items():
+            t = named.get(k)
+            if t is None:
+                raise KeyError(f"Unknown parameter {k!r} for {type(layer).__name__}")
+            saved[id(t)] = (t, t._value)
+            t._value = _to_value(v)
+        for k, v in (buffers or {}).items():
+            t = named_buf.get(k)
+            if t is None:
+                continue
+            saved[id(t)] = (t, t._value)
+            t._value = _to_value(v)
+        with autograd.functional_guard():
+            out = layer(*tree_to_tensors(args), **tree_to_tensors(kwargs))
+        return tree_to_values(out)
+    finally:
+        for t, v in saved.values():
+            t._value = v
+
+
+class StaticFunction:
+    """Callable produced by ``to_static``: jax.jit over the eager function,
+    with Tensor<->jax.Array marshalling at the boundary."""
+
+    def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
+                 full_graph=True, backend=None, static_argnums=()):
+        self._fn = fn
+        self._static_argnums = static_argnums
+        self.input_spec = input_spec
+
+        @functools.partial(jax.jit, static_argnums=static_argnums)
+        def _jitted(*vals, **kvals):
+            with autograd.functional_guard():
+                out = fn(*tree_to_tensors(vals), **tree_to_tensors(kvals))
+            return tree_to_values(out)
+
+        self._jitted = _jitted
+
+    def __call__(self, *args, **kwargs):
+        out = self._jitted(*tree_to_values(args), **tree_to_values(kwargs))
+        return tree_to_tensors(out)
+
+    @property
+    def function(self):
+        return self._fn
+
+    def concrete_program(self, *args, **kwargs):
+        return self._jitted.lower(*tree_to_values(args), **tree_to_values(kwargs))
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """``paddle.jit.to_static``: compile an eager function/Layer with XLA."""
+
+    def decorate(fn):
+        if hasattr(fn, "forward") and not callable(getattr(fn, "__wrapped_layer__", None)):
+            layer = fn
+
+            class _StaticLayerCall:
+                def __init__(self):
+                    self._sf = StaticFunction(lambda *a, **k: layer.forward(*a, **k))
+
+                def __call__(self, *a, **k):
+                    return self._sf(*a, **k)
+
+            wrapped = _StaticLayerCall()
+            layer.forward = wrapped
+            return layer
+        return functools.wraps(fn)(StaticFunction(fn, input_spec=input_spec))
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn.__not_to_static__ = True
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+def jit_fn(fn=None, *, static_argnums=(), donate_argnums=()):
+    """Thin jax.jit wrapper usable on functions over Tensors."""
+    def deco(f):
+        return StaticFunction(f, static_argnums=static_argnums)
+    return deco(fn) if fn is not None else deco

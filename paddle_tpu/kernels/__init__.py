@@ -1,0 +1,3 @@
+"""Pallas TPU kernels (the reference's hand-written CUDA kernel tier:
+paddle/phi/kernels/fusion/gpu/ + flash_attn). Each kernel module exposes a
+jax-level function with a custom_vjp where training needs it."""

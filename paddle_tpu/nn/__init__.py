@@ -1,0 +1,22 @@
+"""paddle_tpu.nn — module system + layer zoo (reference: python/paddle/nn/)."""
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer import Layer, LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401
+from .layers.common import (  # noqa: F401
+    GELU, GLU, ELU, CELU, SELU, PReLU, ReLU, ReLU6, SiLU, Swish, Mish,
+    Sigmoid, Tanh, LeakyReLU, Hardswish, Hardsigmoid, Hardtanh,
+    Softplus, Softshrink, Hardshrink, Tanhshrink, Softsign, LogSigmoid,
+    Softmax, LogSoftmax,
+    AdaptiveAvgPool2D, AvgPool2D, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+    BCELoss, BCEWithLogitsLoss, Conv2D, Conv2DTranspose, CosineSimilarity,
+    CrossEntropyLoss, Dropout, Dropout2D, Embedding, Flatten, GroupNorm,
+    Identity, KLDivLoss, L1Loss, LayerNorm, Linear, MaxPool2D, MSELoss,
+    NLLLoss, Pad2D, PixelShuffle, RMSNorm, SmoothL1Loss, Upsample,
+)
+from .layers.transformer import (  # noqa: F401
+    MultiHeadAttention, Transformer, TransformerDecoder,
+    TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
+)
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401

@@ -1,0 +1,115 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py).
+
+ClipGradByGlobalNorm is hybrid-parallel aware through the
+HybridParallelOptimizer, which sums partial norms across mp/pp/sharding
+groups before scaling (see fleet/meta_optimizers/dygraph_optimizer).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max),
+                                  stop_gradient=True)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(g._value.astype(jnp.float32) ** 2))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor((g._value * scale).astype(g._value.dtype),
+                                  stop_gradient=True)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = clip_norm
+        self.group_name = group_name
+        self.auto_skip_clip = auto_skip_clip
+
+    def global_norm_sq(self, grads) -> jnp.ndarray:
+        """Sum of squared norms (before any cross-group reduction)."""
+        total = jnp.zeros((), jnp.float32)
+        for g in grads:
+            if g is None:
+                continue
+            v = g._value if isinstance(g, Tensor) else g
+            total = total + jnp.sum(v.astype(jnp.float32) ** 2)
+        return total
+
+    def __call__(self, params_grads):
+        grads = [g for _, g in params_grads]
+        total_sq = self.global_norm_sq(grads)
+        return self.apply_with_norm_sq(params_grads, total_sq)
+
+    def apply_with_norm_sq(self, params_grads, total_sq):
+        global_norm = jnp.sqrt(total_sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g._value * scale).astype(g._value.dtype),
+                                  stop_gradient=True)))
+        return out
+
+    # functional form for the jitted train step
+    def clip_tree(self, grads_tree):
+        import jax
+        leaves = jax.tree.leaves(grads_tree)
+        total = sum(jnp.sum(l.astype(jnp.float32) ** 2) for l in leaves)
+        global_norm = jnp.sqrt(total)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads_tree)
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    total = jnp.sum(jnp.stack([
+        jnp.sum(jnp.abs(p.grad._value.astype(jnp.float32)) ** norm_type)
+        for p in params]))
+    total_norm = total ** (1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total_norm, 1e-6), 1.0)
+    for p in params:
+        p.grad._value = (p.grad._value * scale).astype(p.grad._value.dtype)
+    return Tensor(total_norm)
+
+
+def clip_grad_value_(parameters, clip_value):
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = jnp.clip(p.grad._value, -clip_value, clip_value)

@@ -1,0 +1,793 @@
+"""nn functional ops (reference: python/paddle/nn/functional/).
+
+All implemented directly over jax/XLA; the fused hot ops (flash attention,
+fused rms_norm, …) live in paddle_tpu/incubate/nn/functional.py as Pallas
+kernels with these as reference fallbacks.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import to_jax_dtype
+from ..core.tensor import Tensor, apply_op, _val
+from ..framework.random import next_key
+
+# ------------------------------------------------------------- activations
+
+
+def _unary(op_name, jfn):
+    def op(x, name=None):
+        return apply_op(op_name, jfn, x)
+
+    op.__name__ = op_name
+    return op
+
+
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", jax.nn.relu6)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+tanh = _unary("tanh", jnp.tanh)
+silu = _unary("silu", jax.nn.silu)
+swish = silu
+mish = _unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+hardswish = _unary("hardswish", jax.nn.hard_swish)
+hardsigmoid = _unary("hardsigmoid", lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+tanhshrink = _unary("tanhshrink", lambda x: x - jnp.tanh(x))
+softsign = _unary("softsign", jax.nn.soft_sign)
+selu_ = _unary("selu", jax.nn.selu)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op("elu", lambda a: jax.nn.elu(a, alpha), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op("celu", lambda a: jax.nn.celu(a, alpha), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return apply_op("prelu", fn, x, weight)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op("hardtanh", lambda a: jnp.clip(a, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op("hardshrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        "softshrink",
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(
+        "softplus",
+        lambda a: jnp.where(beta * a > threshold, a, jax.nn.softplus(beta * a) / beta), x)
+
+
+def log_sigmoid(x, name=None):
+    return apply_op("log_sigmoid", jax.nn.log_sigmoid, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    jd = to_jax_dtype(dtype)
+    def fn(a):
+        if jd is not None:
+            a = a.astype(jd)
+        return jax.nn.softmax(a, axis=axis)
+    return apply_op("softmax", fn, x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    jd = to_jax_dtype(dtype)
+    def fn(a):
+        if jd is not None:
+            a = a.astype(jd)
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply_op("log_softmax", fn, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    g = jax.random.gumbel(next_key(), tuple(_val(x).shape), jnp.result_type(_val(x)))
+    def fn(a):
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y).at[
+                tuple(jnp.indices(y.shape)[i] if i != (axis % y.ndim) else idx
+                      for i in range(y.ndim))].set(1.0)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+    return apply_op("gumbel_softmax", fn, x)
+
+
+def glu(x, axis=-1, name=None):
+    def fn(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return apply_op("glu", fn, x)
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU: silu(x) * y (fused gate for Llama-style FFN).
+    Reference analogue: paddle.incubate.nn.functional.swiglu."""
+    if y is None:
+        def fn(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+        return apply_op("swiglu", fn, x)
+    return apply_op("swiglu", lambda a, b: jax.nn.silu(a) * b, x, y)
+
+
+# ------------------------------------------------------------------ linear
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Weight layout [in, out] (paddle convention —
+    reference: python/paddle/nn/functional/common.py::linear)."""
+    if bias is None:
+        return apply_op("linear", lambda a, w: a @ w, x, weight)
+    return apply_op("linear", lambda a, w, b: a @ w + b, x, weight, bias)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    idx = _val(x)
+    def fn(w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply_op("embedding", fn, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    return Tensor(jax.nn.one_hot(_val(x), num_classes))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *bi):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi:
+            out = out + bi[0]
+        return out
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return apply_op("bilinear", fn, *args)
+
+
+# -------------------------------------------------------------- normalization
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(tuple(normalized_shape))
+
+    def fn(a, *wb):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)
+        out = out.astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]; i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply_op("layer_norm", fn, *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (reference: paddle/phi/kernels/gpu/rms_norm_kernel.cu →
+    here a single XLA fusion; Pallas variant in incubate)."""
+    def fn(a, *w):
+        h = a.astype(jnp.float32)
+        var = jnp.mean(h * h, axis=-1, keepdims=True)
+        out = (h * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        if w:
+            out = out * w[0]
+        return out
+    args = (x,) + ((weight,) if weight is not None else ())
+    return apply_op("rms_norm", fn, *args)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    ch_axis = 1 if data_format.startswith("NC") else -1
+
+    def stats_shape(a):
+        s = [1] * a.ndim
+        s[ch_axis] = a.shape[ch_axis]
+        return s
+
+    rm, rv = _val(running_mean), _val(running_var)
+    if training and not use_global_stats:
+        v = _val(x)
+        axes = tuple(i for i in range(v.ndim) if i != (ch_axis % v.ndim))
+        batch_mean = jnp.mean(v.astype(jnp.float32), axis=axes)
+        batch_var = jnp.var(v.astype(jnp.float32), axis=axes)
+        # update running stats in place (paddle semantics)
+        running_mean._value = (momentum * rm + (1 - momentum) * batch_mean).astype(rm.dtype)
+        running_var._value = (momentum * rv + (1 - momentum) * batch_var).astype(rv.dtype)
+        mean_, var_ = batch_mean, batch_var
+    else:
+        mean_, var_ = rm, rv
+
+    def fn(a, *wb):
+        shape = stats_shape(a)
+        out = (a - mean_.reshape(shape)) * jax.lax.rsqrt(var_.reshape(shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out.astype(a.dtype)
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply_op("batch_norm", fn, *args)
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW", name=None):
+    def fn(a, *wb):
+        if not data_format.startswith("NC"):
+            a_t = jnp.moveaxis(a, -1, 1)
+        else:
+            a_t = a
+        n, c = a_t.shape[0], a_t.shape[1]
+        rest = a_t.shape[2:]
+        g = a_t.reshape(n, num_groups, c // num_groups, *rest).astype(jnp.float32)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a_t.shape).astype(a.dtype)
+        shape = [1, c] + [1] * len(rest)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        if not data_format.startswith("NC"):
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply_op("group_norm", fn, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    def fn(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + eps)).astype(a.dtype)
+        c = a.shape[1]
+        shape = [1, c] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply_op("instance_norm", fn, *args)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return apply_op(
+        "normalize",
+        lambda a: a / jnp.maximum(
+            jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p), epsilon), x)
+
+
+# ----------------------------------------------------------------- dropout
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training and p > 0.0:
+            return apply_op("dropout", lambda a: (a * (1.0 - p)).astype(a.dtype), x)
+        return x if isinstance(x, Tensor) else Tensor(x)
+    v = _val(x)
+    shape = list(v.shape)
+    if axis is not None:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    keep = jax.random.bernoulli(_dropout_key(), 1.0 - p, tuple(shape))
+
+    def fn(a):
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return apply_op("dropout", fn, x)
+
+
+def _dropout_key():
+    """Dropout keys respect the TP-aware RNGStatesTracker when one is active
+    (reference: fleet/meta_parallel/parallel_layers/random.py)."""
+    from ..distributed.fleet import random as fleet_random
+    tracker = fleet_random.get_rng_state_tracker()
+    if tracker.active_state is not None:
+        return tracker.next_key()
+    return next_key()
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale_ = 1.0507009873554805
+    alpha_p = -alpha * scale_
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, tuple(_val(x).shape))
+    a = (1.0 / math.sqrt((1.0 - p) * (1.0 + p * alpha_p ** 2)))
+    b = -a * alpha_p * p
+    return apply_op("alpha_dropout",
+                    lambda v: (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype), x)
+
+
+# ------------------------------------------------------------------- losses
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    lbl = _val(label)
+
+    def fn(logits, *w):
+        lg = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis) if use_softmax \
+            else jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-30, None))
+        if soft_label:
+            tgt = lbl.astype(jnp.float32)
+            loss = -jnp.sum(tgt * lg, axis=axis)
+        else:
+            l = lbl
+            if l.ndim == lg.ndim:
+                l = jnp.squeeze(l, axis=axis)
+            nclass = lg.shape[axis]
+            if label_smoothing > 0.0:
+                onehot = jax.nn.one_hot(l, nclass, axis=axis, dtype=jnp.float32)
+                tgt = onehot * (1 - label_smoothing) + label_smoothing / nclass
+                loss = -jnp.sum(tgt * lg, axis=axis)
+            else:
+                loss = -jnp.take_along_axis(
+                    lg, jnp.expand_dims(l, axis).astype(jnp.int32), axis=axis
+                ).squeeze(axis)
+            mask = (l != ignore_index)
+            loss = jnp.where(mask, loss, 0.0)
+            if w:
+                loss = loss * jnp.take(w[0], jnp.clip(l, 0, nclass - 1))
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+                if w:
+                    denom = jnp.maximum(jnp.sum(
+                        jnp.where(mask, jnp.take(w[0], jnp.clip(l, 0, nclass - 1)), 0.0)), 1e-12)
+                return jnp.sum(loss) / denom
+            if reduction == "sum":
+                return jnp.sum(loss)
+            return loss
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    args = (input,) + ((weight,) if weight is not None else ())
+    return apply_op("cross_entropy", fn, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = loss.unsqueeze(axis) if not soft_label else loss
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    lbl = _val(label)
+    def fn(lg, *w):
+        loss = -jnp.take_along_axis(lg, lbl[..., None].astype(jnp.int32), axis=-1).squeeze(-1)
+        mask = lbl != ignore_index
+        loss = jnp.where(mask, loss, 0.0)
+        if w:
+            loss = loss * jnp.take(w[0], jnp.clip(lbl, 0, lg.shape[-1] - 1))
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    args = (input,) + ((weight,) if weight is not None else ())
+    return apply_op("nll_loss", fn, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    def fn(a, b):
+        loss = (a - b) ** 2
+        return _reduce_loss(loss, reduction)
+    return apply_op("mse_loss", fn, input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    def fn(a, b):
+        return _reduce_loss(jnp.abs(a - b), reduction)
+    return apply_op("l1_loss", fn, input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce_loss(loss, reduction)
+    return apply_op("smooth_l1_loss", fn, input, label)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(a, b, *w):
+        a = jnp.clip(a, 1e-12, 1 - 1e-12)
+        loss = -(b * jnp.log(a) + (1 - b) * jnp.log1p(-a))
+        if w:
+            loss = loss * w[0]
+        return _reduce_loss(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply_op("bce", fn, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def fn(a, b, *rest):
+        mx = jnp.maximum(a, 0)
+        loss = mx - a * b + jnp.log1p(jnp.exp(-jnp.abs(a)))
+        i = 0
+        if pos_weight is not None:
+            pw = rest[i]; i += 1
+            loss = loss * (b * (pw - 1) + 1)
+        if weight is not None:
+            loss = loss * rest[i]
+        return _reduce_loss(loss, reduction)
+    args = [logit, label]
+    if pos_weight is not None:
+        args.append(pos_weight)
+    if weight is not None:
+        args.append(weight)
+    return apply_op("bce_logits", fn, *args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(a, b):
+        tgt = jnp.exp(b) if log_target else b
+        loss = tgt * ((b if log_target else jnp.log(jnp.clip(b, 1e-30, None))) - a)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / a.shape[0]
+        return _reduce_loss(loss, reduction)
+    return apply_op("kl_div", fn, input, label)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+    return apply_op("cosine_similarity", fn, x1, x2)
+
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+# ---------------------------------------------------------------- attention
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """SDPA with [batch, seq, heads, dim] layout (paddle convention —
+    reference: python/paddle/nn/functional/flash_attention.py).
+    Dispatches to the Pallas flash-attention kernel on TPU when enabled."""
+    from .. import flags
+    if flags.get_flag("use_pallas") and attn_mask is None and dropout_p == 0.0:
+        try:
+            from ..kernels.flash_attention import flash_attention_bshd
+            return apply_op("flash_attention",
+                            lambda q, k, v: flash_attention_bshd(q, k, v, causal=is_causal),
+                            query, key, value)
+        except Exception:
+            pass
+
+    mask_val = _val(attn_mask) if attn_mask is not None else None
+
+    def fn(q, k, v):
+        # [B, S, H, D] -> [B, H, S, D]
+        qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+        scores = jnp.einsum("bhsd,bhtd->bhst", qt, kt) / math.sqrt(q.shape[-1])
+        if is_causal:
+            s, t = scores.shape[-2], scores.shape[-1]
+            causal = jnp.tril(jnp.ones((s, t), bool))
+            scores = jnp.where(causal, scores, -1e30)
+        if mask_val is not None:
+            if mask_val.dtype == jnp.bool_:
+                scores = jnp.where(mask_val, scores, -1e30)
+            else:
+                scores = scores + mask_val
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+        if dropout_p > 0.0 and training:
+            keep = jax.random.bernoulli(next_key(), 1.0 - dropout_p, probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(q.dtype)
+        out = jnp.einsum("bhst,bhtd->bhsd", probs, vt)
+        return jnp.swapaxes(out, 1, 2)
+
+    return apply_op("sdpa", fn, query, key, value)
+
+
+# ---------------------------------------------------------------- conv/pool
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    elif isinstance(padding, int):
+        pad = [(padding, padding), (padding, padding)]
+    else:
+        p = list(padding)
+        pad = [(p[0], p[0]), (p[1], p[1])] if len(p) == 2 else [tuple(p[:2]), tuple(p[2:])]
+    dn = jax.lax.conv_dimension_numbers(
+        _val(x).shape, _val(weight).shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "OIHW", "NHWC"))
+
+    def fn(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+        if b:
+            shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
+            out = out + b[0].reshape(shape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply_op("conv2d", fn, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    x2 = apply_op("unsq", lambda a: a[..., None, :] if data_format == "NCL" else a[:, None], x)
+    w2 = apply_op("unsq", lambda a: a[..., None, :], weight)
+    out = conv2d(x2, w2, bias,
+                 stride=(1, stride if isinstance(stride, int) else stride[0]),
+                 padding=((0, 0), (padding, padding)) if isinstance(padding, int) else padding,
+                 dilation=(1, dilation if isinstance(dilation, int) else dilation[0]),
+                 groups=groups, data_format="NCHW" if data_format == "NCL" else "NHWC")
+    return apply_op("sq", lambda a: a.squeeze(-2 if data_format == "NCL" else 1), out)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1, data_format="NCHW", output_size=None, name=None):
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding_ = (padding, padding) if isinstance(padding, int) else tuple(padding)
+
+    def fn(a, w, *b):
+        # weight layout [in, out, kh, kw] for conv_transpose in paddle
+        out = jax.lax.conv_transpose(
+            a, jnp.swapaxes(w, 0, 1) if groups == 1 else w,
+            strides=stride,
+            padding=[(p, p) for p in padding_],
+            dimension_numbers=("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
+            else ("NHWC", "OIHW", "NHWC"),
+            transpose_kernel=True)
+        if b:
+            shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
+            out = out + b[0].reshape(shape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply_op("conv2d_transpose", fn, *args)
+
+
+def _pool(x, kernel, stride, padding, reducer, init, data_format="NCHW", count_include_pad=True):
+    kernel = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+    stride = tuple(stride) if not isinstance(stride, int) else (stride, stride)
+    padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    if data_format == "NCHW":
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = ((0, 0), (0, 0), (padding[0], padding[0]), (padding[1], padding[1]))
+    else:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pads = ((0, 0), (padding[0], padding[0]), (padding[1], padding[1]), (0, 0))
+
+    def fn(a):
+        return jax.lax.reduce_window(a, init, reducer, window, strides, pads)
+
+    return fn, window, strides, pads
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    stride = stride or kernel_size
+    fn, *_ = _pool(x, kernel_size, stride, padding, jax.lax.max, -jnp.inf, data_format)
+    out = apply_op("max_pool2d", fn, x)
+    if return_mask:
+        raise NotImplementedError(
+            "max_pool2d(return_mask=True) is not implemented on TPU; "
+            "use unfold + argmax if indices are required")
+    return out
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    stride = stride or kernel_size
+    fn, window, strides, pads = _pool(x, kernel_size, stride, padding, jax.lax.add, 0.0, data_format)
+    def avg(a):
+        s = fn(a)
+        if divisor_override:
+            return s / divisor_override
+        if exclusive:
+            ones = jnp.ones_like(a)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+            return s / cnt
+        k = np.prod([w for w in window if w > 1]) or 1
+        return s / k
+    return apply_op("avg_pool2d", avg, x)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    os = (output_size, output_size) if isinstance(output_size, int) else tuple(output_size)
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a_ = a.reshape(n, c, os[0], h // os[0], os[1], w // os[1])
+            return jnp.mean(a_, axis=(3, 5))
+        n, h, w, c = a.shape
+        a_ = a.reshape(n, os[0], h // os[0], os[1], w // os[1], c)
+        return jnp.mean(a_, axis=(2, 4))
+    return apply_op("adaptive_avg_pool2d", fn, x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW", name=None):
+    v = _val(x)
+    if data_format == "NCHW":
+        spatial = v.shape[2:]
+    else:
+        spatial = v.shape[1:-1]
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+        size = tuple(int(s * f) for s, f in zip(spatial, sf))
+    size = tuple(int(_val(s)) for s in size)
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+              "trilinear": "linear", "linear": "linear", "area": "linear"}[mode]
+
+    def fn(a):
+        if data_format == "NCHW":
+            tgt = a.shape[:2] + size
+        else:
+            tgt = (a.shape[0],) + size + (a.shape[-1],)
+        return jax.image.resize(a, tgt, method=method)
+
+    return apply_op("interpolate", fn, x)
+
+
+upsample = interpolate
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    def fn(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c // (r * r), r, r, h, w)
+        a = a.transpose(0, 1, 4, 2, 5, 3)
+        return a.reshape(n, c // (r * r), h * r, w * r)
+    return apply_op("pixel_shuffle", fn, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) else tuple(kernel_sizes)
+    st = (strides, strides) if isinstance(strides, int) else tuple(strides)
+    pd = (paddings, paddings) if isinstance(paddings, int) else tuple(paddings)
+    dl = (dilations, dilations) if isinstance(dilations, int) else tuple(dilations)
+    def fn(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
+        oh = (a.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (a.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        cols = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                patch = a[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                          j * dl[1]: j * dl[1] + ow * st[1]: st[1]]
+                cols.append(patch)
+        out = jnp.stack(cols, axis=2)  # [N, C, k*k, oh, ow]
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+    return apply_op("unfold", fn, x)
+
+
+# ---------------------------------------------------------------- sequence
+def pad_sequence(sequences, padding_value=0.0, batch_first=False):
+    vals = [_val(s) for s in sequences]
+    maxlen = max(v.shape[0] for v in vals)
+    padded = [jnp.pad(v, [(0, maxlen - v.shape[0])] + [(0, 0)] * (v.ndim - 1),
+                      constant_values=padding_value) for v in vals]
+    out = jnp.stack(padded, axis=0 if batch_first else 1)
+    return Tensor(out)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(l):
+        n = l.shape[-1]
+        if prior_dist is not None:
+            return (1 - epsilon) * l + epsilon * _val(prior_dist)
+        return (1 - epsilon) * l + epsilon / n
+    return apply_op("label_smooth", fn, label)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def fn(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([a[:, 1:, :fold], jnp.zeros_like(a[:, -1:, :fold])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(a[:, :1, fold:2 * fold]), a[:, :-1, fold:2 * fold]], axis=1)
+        rest = a[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+    return apply_op("temporal_shift", fn, x)

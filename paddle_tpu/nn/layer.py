@@ -1,0 +1,470 @@
+"""Layer: the module base class.
+
+Reference: python/paddle/nn/layer/layers.py (``paddle.nn.Layer``).
+Parameters/buffers/sublayers are held in ordered dicts with ``__setattr__``
+routing; ``state_dict`` returns Tensors by dotted name. The functional
+bridge for jit lives in paddle_tpu/jit (functional_call) — a Layer is also a
+pytree of parameter values via ``raw_state`` for direct use with jax.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import to_jax_dtype
+from ..core.place import get_default_dtype
+from ..core.tensor import Parameter, Tensor
+from . import initializer as I
+
+
+class HookRemoveHelper:
+    def __init__(self, container, key):
+        self._container, self._key = container, key
+
+    def remove(self):
+        self._container.pop(self._key, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype=None):
+        object.__setattr__(self, "_parameters", collections.OrderedDict())
+        object.__setattr__(self, "_buffers", collections.OrderedDict())
+        object.__setattr__(self, "_sub_layers", collections.OrderedDict())
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._hook_id = 0
+        self.training = True
+        self._dtype = dtype or get_default_dtype()
+        self._name_scope = name_scope or type(self).__name__.lower()
+
+    # ----------------------------------------------------------- attribute
+    def __setattr__(self, name: str, value: Any) -> None:
+        params = self.__dict__.get("_parameters")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            self.__dict__.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer):
+            subs = self.__dict__.get("_sub_layers")
+            if subs is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            self.__dict__.pop(name, None)
+            subs[name] = value
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    del params[name]
+                    object.__setattr__(self, name, value)
+                    return
+                raise TypeError(f"cannot assign non-Parameter to parameter {name!r}")
+            buffers = self.__dict__.get("_buffers")
+            if buffers is not None and name in buffers:
+                # reassigning a registered buffer must update the registry,
+                # or state_dict would keep serving the stale tensor
+                from ..core.tensor import Tensor as _T
+                if value is None or isinstance(value, _T):
+                    buffers[name] = value
+                    return
+                raise TypeError(f"cannot assign non-Tensor to buffer {name!r}")
+            if self.__dict__.get("_sub_layers") is not None and name in self._sub_layers:
+                del self._sub_layers[name]
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} has no attribute {name!r}")
+
+    def __delattr__(self, name: str) -> None:
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # ---------------------------------------------------------- construction
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias: bool = False,
+        default_initializer: Optional[I.Initializer] = None,
+    ) -> Parameter:
+        from .param_attr import ParamAttr
+
+        dtype = dtype or self._dtype
+        init = default_initializer
+        name = None
+        lr = 1.0
+        regularizer = None
+        trainable = True
+        if isinstance(attr, ParamAttr):
+            init = attr.initializer or init
+            name = attr.name
+            lr = attr.learning_rate
+            regularizer = attr.regularizer
+            trainable = attr.trainable
+        elif attr is False:
+            raise ValueError("attr=False: caller should skip creating this parameter")
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        value = init(tuple(int(s) for s in shape), dtype)
+        p = Parameter(value, name=name, trainable=trainable)
+        p.optimize_attr["learning_rate"] = lr
+        p.optimize_attr["regularizer"] = regularizer
+        return p
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]) -> Optional[Parameter]:
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor], persistable: bool = True) -> None:
+        self.__dict__.pop(name, None)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+
+    # ------------------------------------------------------------- traversal
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer, pfx in self._walk(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{pfx}.{pname}" if pfx else pname), p
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True
+                      ) -> Iterator[Tuple[str, Tensor]]:
+        seen = set()
+        for name, layer, pfx in self._walk(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{pfx}.{bname}" if pfx else bname), b
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def _walk(self, prefix: str, include_sublayers: bool):
+        yield "", self, prefix
+        if include_sublayers:
+            for name, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                sub_prefix = f"{prefix}.{name}" if prefix else name
+                yield from sub._walk(sub_prefix, True)
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        for name, sub in self._sub_layers.items():
+            if sub is not None:
+                yield name, sub
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        out = []
+        for _, layer, _pfx in self._walk("", True):
+            out.append(layer)
+        return out if include_self else out[1:]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False
+                        ) -> Iterator[Tuple[str, "Layer"]]:
+        for i, (name, layer, pfx) in enumerate(self._walk(prefix, True)):
+            if i == 0 and not include_self:
+                continue
+            yield pfx, layer
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # ------------------------------------------------------------ state dict
+    def state_dict(self, destination=None, include_sublayers: bool = True,
+                   structured_name_prefix: str = "", use_hook: bool = True) -> Dict[str, Tensor]:
+        out = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix,
+                                             include_sublayers=include_sublayers):
+            out[name] = p
+        for name, layer, pfx in self._walk(structured_name_prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                out[f"{pfx}.{bname}" if pfx else bname] = b
+        return out
+
+    def set_state_dict(self, state_dict: Dict[str, Any], use_structured_name: bool = True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, t in own.items():
+            if name in state_dict:
+                v = state_dict[name]
+                v = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                if tuple(v.shape) != tuple(t.shape):
+                    raise ValueError(
+                        f"shape mismatch for {name}: ckpt {tuple(v.shape)} vs model {tuple(t.shape)}")
+                t._value = v.astype(jnp.result_type(t._value))
+            else:
+                missing.append(name)
+        for k in state_dict:
+            if k not in own:
+                unexpected.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # ---------------------------------------------------------------- modes
+    def train(self) -> "Layer":
+        for l in self.sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self) -> "Layer":
+        for l in self.sublayers(include_self=True):
+            l.training = False
+        return self
+
+    # ---------------------------------------------------------------- hooks
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # ----------------------------------------------------------------- call
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------- dtype/dev
+    def to(self, device=None, dtype=None, blocking=None) -> "Layer":
+        if dtype is not None:
+            jd = to_jax_dtype(dtype)
+            for p in self.parameters():
+                if jnp.issubdtype(jnp.result_type(p._value), jnp.floating):
+                    p._value = p._value.astype(jd)
+            for b in self.buffers():
+                if b is not None and jnp.issubdtype(jnp.result_type(b._value), jnp.floating):
+                    b._value = b._value.astype(jd)
+        if device is not None:
+            devs = jax.devices("cpu") if str(device).startswith("cpu") else jax.devices()
+            for t in list(self.parameters()) + list(self.buffers()):
+                if t is not None and isinstance(t._value, jax.Array):
+                    t._value = jax.device_put(t._value, devs[0])
+        return self
+
+    def astype(self, dtype) -> "Layer":
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def full_name(self) -> str:
+        return self._name_scope
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self) -> str:
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = [sub_repr[0]] + ["  " + l for l in sub_repr[1:]]
+            lines.append(f"  ({name}): " + "\n".join(sub_repr))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    # ------------------------------------------------------- functional view
+    def raw_state(self) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """(params, buffers) as flat name->jax.Array dicts — the pytree the
+        jitted train step closes over."""
+        params = {k: p._value for k, p in self.named_parameters() if not p.stop_gradient}
+        frozen = {k: p._value for k, p in self.named_parameters() if p.stop_gradient}
+        buffers = {k: (b._value if b is not None else None) for k, b in self.named_buffers()}
+        buffers.update(frozen)
+        return params, buffers
+
+    def load_raw_state(self, params: Dict[str, Any], buffers: Optional[Dict[str, Any]] = None):
+        named = dict(self.named_parameters())
+        for k, v in params.items():
+            if k in named:
+                named[k]._value = v
+        if buffers:
+            named_b = dict(self.named_buffers())
+            for k, v in buffers.items():
+                if k in named_b and v is not None and named_b[k] is not None:
+                    named_b[k]._value = v
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and (
+            layers[0] and isinstance(layers[0][0], (list, tuple))
+        ):
+            for name, layer in layers[0]:
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                self.add_sublayer(str(i), layer)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Sequential(*list(self._sub_layers.values())[idx])
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        for i, l in enumerate(sublayers or []):
+            self.add_sublayer(str(i), l)
+
+    def append(self, sublayer: Layer) -> "LayerList":
+        self.add_sublayer(str(len(self._sub_layers)), sublayer)
+        return self
+
+    def extend(self, sublayers) -> "LayerList":
+        for l in sublayers:
+            self.append(l)
+        return self
+
+    def insert(self, index: int, sublayer: Layer) -> None:
+        layers = list(self._sub_layers.values())
+        layers.insert(index, sublayer)
+        self._sub_layers.clear()
+        for i, l in enumerate(layers):
+            self._sub_layers[str(i)] = l
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return LayerList(list(self._sub_layers.values())[idx])
+        return list(self._sub_layers.values())[idx]
+
+    def __setitem__(self, idx, layer):
+        self._sub_layers[str(idx)] = layer
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        for i, p in enumerate(parameters or []):
+            self.add_parameter(str(i), p)
+
+    def append(self, parameter: Parameter) -> "ParameterList":
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx)]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+
+class LayerDict(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            self.update(sublayers)
+
+    def update(self, sublayers) -> None:
+        items = sublayers.items() if isinstance(sublayers, dict) else sublayers
+        for k, v in items:
+            self.add_sublayer(k, v)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def values(self):
+        return self._sub_layers.values()

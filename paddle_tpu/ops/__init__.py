@@ -1,0 +1,95 @@
+"""Functional op surface + Tensor method binding.
+
+The reference monkey-patches generated pybind methods onto its eager Tensor
+(paddle/fluid/pybind/eager_method.cc); here we bind the Python functional ops
+onto ``Tensor`` so both ``paddle_tpu.add(x, y)`` and ``x.add(y)`` / ``x + y``
+work.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, _val
+from . import comparison, creation, linalg, manipulation, math, random
+from .comparison import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+
+_METHOD_SOURCES = (math, manipulation, comparison, linalg)
+
+# every public function in these modules whose first arg is a tensor becomes a
+# Tensor method
+_SKIP = {
+    "broadcast_tensors", "meshgrid", "is_tensor",
+}
+
+
+def _bind_methods() -> None:
+    for mod in _METHOD_SOURCES:
+        for name in dir(mod):
+            if name.startswith("_") or name in _SKIP:
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or isinstance(fn, type):
+                continue
+            if not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+    # creation-style helpers that are methods in paddle
+    Tensor.clone = creation.clone
+    Tensor.fill_diagonal_ = _fill_diagonal_
+
+
+def _fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    v = x._value
+    n = min(v.shape[-2:]) if v.ndim >= 2 else v.shape[0]
+    idx = jnp.arange(n - abs(offset))
+    if v.ndim == 2:
+        r = idx + (0 if offset >= 0 else -offset)
+        c = idx + (offset if offset >= 0 else 0)
+        x._value = v.at[r, c].set(value)
+    else:
+        x._value = v.at[..., idx, idx].set(value)
+    return x
+
+
+# ------------------------------------------------------------------ dunders
+def _coerce(y):
+    return y
+
+
+Tensor.__add__ = lambda s, o: math.add(s, _coerce(o))
+Tensor.__radd__ = lambda s, o: math.add(s, _coerce(o))
+Tensor.__sub__ = lambda s, o: math.subtract(s, _coerce(o))
+Tensor.__rsub__ = lambda s, o: apply_op("rsub", lambda a, b: b - a, s, o)
+Tensor.__mul__ = lambda s, o: math.multiply(s, _coerce(o))
+Tensor.__rmul__ = lambda s, o: math.multiply(s, _coerce(o))
+Tensor.__truediv__ = lambda s, o: math.divide(s, _coerce(o))
+Tensor.__rtruediv__ = lambda s, o: apply_op("rdiv", lambda a, b: b / a, s, o)
+Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, _coerce(o))
+Tensor.__mod__ = lambda s, o: math.mod(s, _coerce(o))
+Tensor.__pow__ = lambda s, o: math.pow(s, _coerce(o))
+Tensor.__rpow__ = lambda s, o: apply_op("rpow", lambda a, b: b ** a, s, o)
+Tensor.__neg__ = lambda s: math.neg(s)
+Tensor.__abs__ = lambda s: math.abs(s)
+Tensor.__matmul__ = lambda s, o: linalg.matmul(s, _coerce(o))
+Tensor.__rmatmul__ = lambda s, o: apply_op("rmatmul", lambda a, b: b @ a, s, o)
+Tensor.__eq__ = lambda s, o: comparison.equal(s, _coerce(o))
+Tensor.__ne__ = lambda s, o: comparison.not_equal(s, _coerce(o))
+Tensor.__lt__ = lambda s, o: comparison.less_than(s, _coerce(o))
+Tensor.__le__ = lambda s, o: comparison.less_equal(s, _coerce(o))
+Tensor.__gt__ = lambda s, o: comparison.greater_than(s, _coerce(o))
+Tensor.__ge__ = lambda s, o: comparison.greater_equal(s, _coerce(o))
+Tensor.__and__ = lambda s, o: math.logical_and(s, _coerce(o)) if s.dtype == "bool" else math.bitwise_and(s, o)
+Tensor.__or__ = lambda s, o: math.logical_or(s, _coerce(o)) if s.dtype == "bool" else math.bitwise_or(s, o)
+Tensor.__xor__ = lambda s, o: math.logical_xor(s, _coerce(o)) if s.dtype == "bool" else math.bitwise_xor(s, o)
+Tensor.__invert__ = lambda s: math.logical_not(s) if s.dtype == "bool" else math.bitwise_not(s)
+Tensor.__hash__ = lambda s: id(s)
+
+Tensor.T = property(lambda s: manipulation.transpose(s, list(range(s.ndim))[::-1]))
+Tensor.mT = property(lambda s: manipulation.swapaxes(s, -1, -2))
+
+_bind_methods()

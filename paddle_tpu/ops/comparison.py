@@ -1,0 +1,45 @@
+"""Comparison ops (reference: python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, _val
+
+
+def _cmp(op_name, jfn):
+    def op(x, y, name=None):
+        return apply_op(op_name, jfn, x, y)
+
+    op.__name__ = op_name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+
+
+def equal_all(x, y, name=None) -> Tensor:
+    return Tensor(jnp.array_equal(_val(x), _val(y)))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None) -> Tensor:
+    return Tensor(jnp.allclose(_val(x), _val(y), rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None) -> Tensor:
+    return Tensor(jnp.isclose(_val(x), _val(y), rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def is_empty(x, name=None) -> Tensor:
+    return Tensor(jnp.asarray(int(np.prod(_val(x).shape)) == 0))
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)

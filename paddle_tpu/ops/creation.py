@@ -1,0 +1,137 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import to_jax_dtype
+from ..core.place import get_default_dtype
+from ..core.tensor import Tensor, apply_op, _val
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        dtype = default or get_default_dtype()
+    return to_jax_dtype(dtype)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    if isinstance(data, Tensor):
+        t = Tensor(data._value, dtype=dtype, place=place, stop_gradient=stop_gradient)
+        return t
+    if dtype is None and not hasattr(data, "dtype"):
+        # python scalars / lists follow paddle defaults: float->default dtype,
+        # int->int64, bool->bool
+        probe = np.asarray(data)
+        if probe.dtype == np.float64:
+            dtype = get_default_dtype()
+    return Tensor(jnp.asarray(data, dtype=to_jax_dtype(dtype)), place=place,
+                  stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    fill_value = _val(fill_value)
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros_like(_val(x), dtype=to_jax_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones_like(_val(x), dtype=to_jax_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.full_like(_val(x), _val(fill_value), dtype=to_jax_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    start, end, step = _val(start), _val(end), _val(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        vals = [v for v in (start, end, step)]
+        dtype = "int64" if all(float(v).is_integer() if isinstance(v, float) else True
+                               and not isinstance(v, float) for v in vals) else get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype, "int64")))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.linspace(_val(start), _val(stop), int(_val(num)), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.logspace(_val(start), _val(stop), int(_val(num)), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    v = _val(x)
+    if v.ndim == 1 and padding_value != 0:
+        base = jnp.full((v.shape[0] + abs(offset),) * 2, padding_value, v.dtype)
+        return apply_op("diag", lambda a: base * (1 - (jnp.diag(jnp.ones_like(a), k=offset) != 0))
+                        + jnp.diag(a, k=offset), x)
+    return apply_op("diag", lambda a: jnp.diag(a, k=offset), x)
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    return apply_op("diagflat", lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    return apply_op("tril", lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    return apply_op("triu", lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def meshgrid(*args, **kwargs):
+    vals = [_val(a) for a in args]
+    outs = jnp.meshgrid(*vals, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None) -> Tensor:
+    v = jnp.asarray(_val(x))
+    if output is not None:
+        output.set_value(v)
+        return output
+    return Tensor(v)
+
+
+def clone(x) -> Tensor:
+    return apply_op("clone", lambda a: a + 0, x)
+
+
+def one_hot(x, num_classes, name=None) -> Tensor:
+    return Tensor(jax.nn.one_hot(_val(x), num_classes, dtype=_dt(None)))
+
+
+def _shape(shape):
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(_val(s)) for s in shape)

@@ -1,0 +1,216 @@
+"""Linear-algebra ops (reference: python/paddle/tensor/linalg.py).
+
+matmul maps straight onto the MXU; precision is governed by
+FLAGS_tpu_matmul_precision.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import flags
+from ..core.tensor import Tensor, apply_op, _val
+
+
+def _precision():
+    p = flags.get_flag("tpu_matmul_precision")
+    return None if p == "default" else p
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b, precision=_precision())
+    return apply_op("matmul", fn, x, y)
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    return apply_op("dot", lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def inner(x, y, name=None):
+    return apply_op("inner", jnp.inner, x, y)
+
+
+def outer(x, y, name=None):
+    return apply_op("outer", lambda a, b: jnp.outer(a.reshape(-1), b.reshape(-1)), x, y)
+
+
+def mv(x, vec, name=None):
+    return apply_op("mv", lambda a, v: a @ v, x, vec)
+
+
+def einsum(equation, *operands):
+    return apply_op("einsum", lambda *ops: jnp.einsum(equation, *ops, precision=_precision()),
+                    *operands)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def fn(a):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(a * a))
+            return jnp.linalg.norm(a, ord=None, axis=_ax(axis), keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=_ax(axis), keepdims=keepdim)
+        if p == float("inf") or p == "inf":
+            base = jnp.abs(a)
+            return (jnp.max(base) if axis is None
+                    else jnp.max(base, axis=_ax(axis), keepdims=keepdim))
+        if p == float("-inf"):
+            base = jnp.abs(a)
+            return (jnp.min(base) if axis is None
+                    else jnp.min(base, axis=_ax(axis), keepdims=keepdim))
+        if axis is None:
+            return jnp.sum(jnp.abs(a) ** p) ** (1.0 / p)
+        return jnp.sum(jnp.abs(a) ** p, axis=_ax(axis), keepdims=keepdim) ** (1.0 / p)
+    return apply_op("norm", fn, x)
+
+
+def _ax(axis):
+    return tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+
+def dist(x, y, p=2, name=None):
+    return norm(x - y if isinstance(x, Tensor) else Tensor(_val(x) - _val(y)), p=p)
+
+
+def cross(x, y, axis=9, name=None):
+    def fn(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply_op("cross", fn, x, y)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    v = _val(input)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (v.min(), v.max())
+    h, _ = jnp.histogram(v.reshape(-1), bins=bins, range=(lo, hi))
+    return Tensor(h.astype(jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    import numpy as np
+    v = np.asarray(_val(x))
+    w = None if weights is None else np.asarray(_val(weights))
+    return Tensor(jnp.asarray(np.bincount(v, weights=w, minlength=minlength)))
+
+
+def matrix_power(x, n, name=None):
+    return apply_op("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return apply_op("cholesky", fn, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, l):
+        lo = jnp.swapaxes(l, -1, -2) if upper else l
+        z = jax.scipy.linalg.solve_triangular(lo, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(lo, -1, -2), z, lower=False)
+    return apply_op("cholesky_solve", fn, x, y)
+
+
+def inverse(x, name=None):
+    return apply_op("inverse", jnp.linalg.inv, x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x)
+
+
+def solve(x, y, name=None):
+    return apply_op("solve", jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply_op("triangular_solve", fn, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(_val(x), _val(y), rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def qr(x, mode="reduced", name=None):
+    q, r = jnp.linalg.qr(_val(x), mode=mode)
+    return Tensor(q), Tensor(r)
+
+
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = jnp.linalg.svd(_val(x), full_matrices=full_matrices)
+    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2))
+
+
+def eig(x, name=None):
+    w, v = jnp.linalg.eig(_val(x))
+    return Tensor(w), Tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    w, v = jnp.linalg.eigh(_val(x), UPLO=UPLO)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    return Tensor(jnp.linalg.eigvals(_val(x)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return Tensor(jnp.linalg.eigvalsh(_val(x), UPLO=UPLO))
+
+
+def det(x, name=None):
+    return apply_op("det", jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    sgn, logdet = jnp.linalg.slogdet(_val(x))
+    return Tensor(jnp.stack([sgn, logdet]))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(_val(x), rtol=tol))
+
+
+def cond(x, p=None, name=None):
+    return Tensor(jnp.linalg.cond(_val(x), p=p))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = jax.scipy.linalg.lu_factor(_val(x))
+    if get_infos:
+        return Tensor(lu_), Tensor(piv + 1), Tensor(jnp.zeros((), jnp.int32))
+    return Tensor(lu_), Tensor(piv + 1)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return Tensor(jnp.corrcoef(_val(x), rowvar=rowvar))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return Tensor(jnp.cov(_val(x), rowvar=rowvar, ddof=1 if ddof else 0,
+                          fweights=None if fweights is None else _val(fweights),
+                          aweights=None if aweights is None else _val(aweights)))

@@ -1,0 +1,417 @@
+"""Shape/layout manipulation ops
+(reference: python/paddle/tensor/manipulation.py)."""
+
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enforce import InvalidArgumentError
+from ..core.tensor import Tensor, apply_op, _val
+
+
+def reshape(x, shape, name=None):
+    shape = tuple(int(_val(s)) for s in shape)
+    return apply_op("reshape", lambda a: jnp.reshape(a, shape), x)
+
+
+def reshape_(x, shape, name=None):
+    x._value = jnp.reshape(x._value, tuple(int(_val(s)) for s in shape))
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    v = _val(x)
+    nd = v.ndim
+    sa = start_axis % nd
+    ea = stop_axis % nd
+    new_shape = v.shape[:sa] + (-1,) + v.shape[ea + 1:]
+    return apply_op("flatten", lambda a: jnp.reshape(a, new_shape), x)
+
+
+def transpose(x, perm, name=None):
+    perm = tuple(perm)
+    return apply_op("transpose", lambda a: jnp.transpose(a, perm), x)
+
+
+def t(x, name=None):
+    return apply_op("t", lambda a: a.T, x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op("moveaxis", lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), x)
+
+
+def squeeze(x, axis=None, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    def fn(a):
+        if ax is None:
+            return jnp.squeeze(a)
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        axes = tuple(i for i in axes if a.shape[i] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return apply_op("squeeze", fn, x)
+
+
+def unsqueeze(x, axis, name=None):
+    axes = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    def fn(a):
+        out = a
+        for ax in sorted(axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply_op("unsqueeze", fn, x)
+
+
+def concat(x, axis=0, name=None):
+    tensors = list(x)
+    axis = int(_val(axis))
+    return apply_op("concat", lambda *vs: jnp.concatenate(vs, axis=axis), *tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply_op("stack", lambda *vs: jnp.stack(vs, axis=axis), *tensors)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(_val(axis))
+    v = _val(x)
+    dim = v.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise InvalidArgumentError(
+                f"split: dimension {axis} (size {dim}) is not divisible by "
+                f"num_or_sections={num_or_sections}; pass explicit section sizes")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(_val(s)) for s in num_or_sections]
+        n_neg = sizes.count(-1)
+        if n_neg:
+            known = sum(s for s in sizes if s >= 0)
+            sizes = [s if s >= 0 else dim - known for s in sizes]
+    offsets = np.cumsum([0] + sizes)
+
+    outs = []
+    for i in range(len(sizes)):
+        lo, hi = int(offsets[i]), int(offsets[i + 1])
+        outs.append(apply_op(
+            "split", lambda a, lo=lo, hi=hi: jax.lax.slice_in_dim(a, lo, hi, axis=axis), x))
+    return outs
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    v = _val(x)
+    return [apply_op("unbind", lambda a, i=i: jnp.take(a, i, axis=axis), x)
+            for i in range(v.shape[axis])]
+
+
+def tile(x, repeat_times, name=None):
+    reps = tuple(int(_val(r)) for r in repeat_times)
+    return apply_op("tile", lambda a: jnp.tile(a, reps), x)
+
+
+def expand(x, shape, name=None):
+    shape = tuple(int(_val(s)) for s in shape)
+    def fn(a):
+        tgt = tuple(a.shape[i - (len(shape) - a.ndim)] if s == -1 else s
+                    for i, s in enumerate(shape))
+        return jnp.broadcast_to(a, tgt)
+    return apply_op("expand", fn, x)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return apply_op("expand_as", lambda a, b: jnp.broadcast_to(a, b.shape), x, y)
+
+
+def broadcast_tensors(inputs, name=None):
+    vals = [_val(i) for i in inputs]
+    shape = jnp.broadcast_shapes(*[v.shape for v in vals])
+    return [apply_op("broadcast_tensors", lambda a: jnp.broadcast_to(a, shape), i)
+            for i in inputs]
+
+
+def flip(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply_op("flip", lambda a: jnp.flip(a, axis=ax), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op("roll", lambda a: jnp.roll(a, shifts, axis=axis), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+# ------------------------------------------------------------ gather/scatter
+def gather(x, index, axis=0, name=None):
+    idx = _val(index)
+    axis = int(_val(axis))
+    return apply_op("gather", lambda a: jnp.take(a, idx.reshape(-1) if idx.ndim > 1 else idx, axis=axis), x)
+
+
+def gather_nd(x, index, name=None):
+    idx = _val(index)
+    def fn(a):
+        return a[tuple(jnp.moveaxis(idx, -1, 0))]
+    return apply_op("gather_nd", fn, x)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    idx = _val(indices)
+    def fn(a):
+        i = idx
+        if broadcast:
+            tgt = list(a.shape)
+            tgt[axis] = i.shape[axis]
+            i = jnp.broadcast_to(i, tgt)
+        return jnp.take_along_axis(a, i, axis=axis)
+    return apply_op("take_along_axis", fn, arr)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    idx = _val(indices)
+    def fn(a, v):
+        v = jnp.broadcast_to(v, idx.shape) if np.ndim(v) == 0 else v
+        at = a.at[tuple(
+            idx if d == axis else jnp.arange(a.shape[d]).reshape(
+                [-1 if dd == d else 1 for dd in range(a.ndim)])
+            for d in range(a.ndim)
+        )]
+        if reduce == "assign":
+            return at.set(v)
+        if reduce in ("add", "sum"):
+            return at.add(v)
+        if reduce in ("mul", "multiply"):
+            return at.multiply(v)
+        raise InvalidArgumentError(f"Unknown reduce {reduce!r}")
+    return apply_op("put_along_axis", fn, arr, values)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = _val(index)
+    def fn(a, u):
+        if overwrite:
+            return a.at[idx].set(u)
+        return a.at[idx].add(u)
+    return apply_op("scatter", fn, x, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = _val(index)
+    def fn(a, u):
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+    return apply_op("scatter_nd_add", fn, x, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    z = zeros(shape, dtype=updates.dtype if isinstance(updates, Tensor) else None)
+    return scatter_nd_add(z, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    idx = _val(index)
+    return apply_op("index_select", lambda a: jnp.take(a, idx, axis=axis), x)
+
+
+def index_sample(x, index, name=None):
+    idx = _val(index)
+    return apply_op("index_sample", lambda a: jnp.take_along_axis(a, idx, axis=1), x)
+
+
+def index_add(x, index, axis, value, name=None):
+    idx = _val(index)
+    def fn(a, v):
+        return a.at[(slice(None),) * axis + (idx,)].add(v)
+    return apply_op("index_add", fn, x, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(_val(i) for i in indices)
+    def fn(a, v):
+        return a.at[idx].add(v) if accumulate else a.at[idx].set(v)
+    return apply_op("index_put", fn, x, value)
+
+
+def where(condition, x=None, y=None, name=None):
+    cond = _val(condition)
+    if x is None and y is None:
+        return nonzero(Tensor(cond), as_tuple=True)
+    return apply_op("where", lambda a, b: jnp.where(cond, a, b), x, y)
+
+
+def nonzero(x, as_tuple=False):
+    # Dynamic-shape op: forces host sync; fine in eager, rejected under jit.
+    v = np.asarray(_val(x))
+    nz = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def masked_select(x, mask, name=None):
+    v, m = np.asarray(_val(x)), np.asarray(_val(mask))
+    return Tensor(jnp.asarray(v[m]))
+
+
+def masked_fill(x, mask, value, name=None):
+    m = _val(mask)
+    return apply_op("masked_fill", lambda a, v: jnp.where(m, v, a), x, value)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    v = np.asarray(_val(x))
+    res = np.unique(v, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, name=None):
+    v = np.asarray(_val(x))
+    flat = v if axis is not None else v.reshape(-1)
+    keep = np.ones(flat.shape[0 if axis is None else axis], bool)
+    cmp = flat if axis is None else np.moveaxis(flat, axis, 0)
+    keep[1:] = np.any(cmp[1:] != cmp[:-1], axis=tuple(range(1, cmp.ndim)))
+    out = cmp[keep]
+    outs = [Tensor(jnp.asarray(out if axis is None else np.moveaxis(out, 0, axis)))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        cnt = np.diff(np.append(idx, cmp.shape[0]))
+        outs.append(Tensor(jnp.asarray(cnt)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+# ---------------------------------------------------------------- sort/topk
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    k = int(_val(k))
+    def fn(a):
+        src = a if largest else -a
+        vals, idx = jax.lax.top_k(jnp.moveaxis(src, axis, -1), k)
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+        return (vals if largest else -vals), idx.astype(jnp.int64)
+    out = apply_op("topk", fn, x)
+    return out[0], out[1]
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(a):
+        s = jnp.sort(a, axis=axis, stable=stable)
+        return jnp.flip(s, axis=axis) if descending else s
+    return apply_op("sort", fn, x)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    v = _val(x)
+    idx = jnp.argsort(v, axis=axis, stable=stable)
+    if descending:
+        idx = jnp.flip(idx, axis=axis)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(_val(sorted_sequence), _val(values), side=side)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+# --------------------------------------------------------------------- pad
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    v = _val(x)
+    pad = [int(_val(p)) for p in pad]
+    if len(pad) == 2 * v.ndim:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(v.ndim)]
+    else:
+        # paddle/torch convention: the FIRST pair pads the LAST dim,
+        # the second pair the second-to-last dim, and so on.
+        n = len(pad) // 2
+        trailing = [(pad[2 * i], pad[2 * i + 1]) for i in range(n)][::-1]
+        width = [(0, 0)] * (v.ndim - n) + trailing
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    kw = {"constant_values": value} if jmode == "constant" else {}
+    return apply_op("pad", lambda a: jnp.pad(a, width, mode=jmode, **kw), x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    v = _val(x)
+    shape = [int(_val(s)) for s in (shape or v.shape)]
+    offsets = [int(_val(o)) for o in (offsets or [0] * v.ndim)]
+    shape = [v.shape[i] if s == -1 else s for i, s in enumerate(shape)]
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return apply_op("crop", lambda a: a[idx], x)
+
+
+def slice(input, axes, starts, ends, name=None):
+    v = _val(input)
+    idx = [builtins.slice(None)] * v.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = builtins.slice(int(_val(st)), int(_val(en)))
+    idx = tuple(idx)
+    return apply_op("slice", lambda a: a[idx], input)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    v = _val(x)
+    idx = [builtins.slice(None)] * v.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(int(_val(st)), int(_val(en)), int(_val(sd)))
+    idx = tuple(idx)
+    return apply_op("strided_slice", lambda a: a[idx], x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = _val(repeats)
+    return apply_op("repeat_interleave", lambda a: jnp.repeat(a, r, axis=axis), x)
+
+
+def as_complex(x, name=None):
+    return apply_op("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return apply_op("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(_val(x).shape)), dtype=jnp.int64))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def fn(a):
+        size = index_num // nshards
+        shard = a // size
+        return jnp.where(shard == shard_id, a % size, ignore_value)
+    return apply_op("shard_index", fn, input)

@@ -1,0 +1,280 @@
+"""Math, reduction and activation ops
+(reference: python/paddle/tensor/math.py, ops.py, stat.py)."""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, _val
+
+
+def _unary(op_name, jfn):
+    def op(x, name=None):
+        return apply_op(op_name, jfn, x)
+
+    op.__name__ = op_name
+    return op
+
+
+def _binary(op_name, jfn):
+    def op(x, y, name=None):
+        return apply_op(op_name, jfn, x, y)
+
+    op.__name__ = op_name
+    return op
+
+
+# ----------------------------------------------------------------- unary
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+negative = neg
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+erf = _unary("erf", jax.lax.erf)
+erfinv = _unary("erfinv", jax.lax.erf_inv)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+sign = _unary("sign", jnp.sign)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+square = _unary("square", jnp.square)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+logsigmoid = _unary("logsigmoid", jax.nn.log_sigmoid)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+i0 = _unary("i0", jax.scipy.special.i0)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+isnan = _unary("isnan", jnp.isnan)
+isinf = _unary("isinf", jnp.isinf)
+isfinite = _unary("isfinite", jnp.isfinite)
+nan_to_num = _unary("nan_to_num", jnp.nan_to_num)
+
+# ---------------------------------------------------------------- binary
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+mod = _binary("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = _binary("pow", jnp.power)
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+hypot = _binary("hypot", jnp.hypot)
+heaviside = _binary("heaviside", jnp.heaviside)
+nextafter = _binary("nextafter", jnp.nextafter)
+copysign = _binary("copysign", jnp.copysign)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+
+# bitwise / logical
+bitwise_and = _binary("bitwise_and", jnp.bitwise_and)
+bitwise_or = _binary("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _binary("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = _unary("bitwise_not", jnp.bitwise_not)
+logical_and = _binary("logical_and", jnp.logical_and)
+logical_or = _binary("logical_or", jnp.logical_or)
+logical_xor = _binary("logical_xor", jnp.logical_xor)
+logical_not = _unary("logical_not", jnp.logical_not)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = _val(scale), _val(bias)
+    if bias_after_scale:
+        fn = lambda a: a * s + b
+    else:
+        fn = lambda a: (a + b) * s
+    return apply_op("scale", fn, x)
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = _val(min) if min is not None else None
+    hi = _val(max) if max is not None else None
+    return apply_op("clip", lambda a: jnp.clip(a, lo, hi), x)
+
+
+def lerp(x, y, weight, name=None):
+    return apply_op("lerp", lambda a, b, w: a + w * (b - a), x, y, weight)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op("addmm", lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+
+
+def multiplex(inputs, index, name=None):
+    idx = _val(index).reshape(-1)
+
+    def fn(*vals):
+        stacked = jnp.stack(vals, axis=0)          # [K, N, ...]
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx, rows]                   # row i from input idx[i]
+
+    return apply_op("multiplex", fn, *inputs)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+# ------------------------------------------------------------- reductions
+def _reduce(name, jfn):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        kw = {}
+        if dtype is not None:
+            from ..core.dtype import to_jax_dtype
+            kw["dtype"] = to_jax_dtype(dtype)
+        return apply_op(name, lambda a: jfn(a, axis=ax, keepdims=keepdim, **kw), x)
+
+    op.__name__ = name
+    return op
+
+
+sum = _reduce("sum", jnp.sum)
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod)
+max = _reduce("max", jnp.max)
+min = _reduce("min", jnp.min)
+amax = max
+amin = min
+nansum = _reduce("nansum", jnp.nansum)
+nanmean = _reduce("nanmean", jnp.nanmean)
+all = _reduce("all", jnp.all)
+any = _reduce("any", jnp.any)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    ddof = 1 if unbiased else 0
+    return apply_op("std", lambda a: jnp.std(a, axis=ax, ddof=ddof, keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    ddof = 1 if unbiased else 0
+    return apply_op("var", lambda a: jnp.var(a, axis=ax, ddof=ddof, keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply_op("median", lambda a: jnp.median(a, axis=axis, keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op("quantile", lambda a: jnp.quantile(a, jnp.asarray(_val(q)), axis=axis, keepdims=keepdim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply_op("logsumexp", lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim), x)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import to_jax_dtype
+    return apply_op("argmax", lambda a: jnp.argmax(a, axis=axis, keepdims=keepdim).astype(to_jax_dtype(dtype)), x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import to_jax_dtype
+    return apply_op("argmin", lambda a: jnp.argmin(a, axis=axis, keepdims=keepdim).astype(to_jax_dtype(dtype)), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        return apply_op("cumsum", lambda a: jnp.cumsum(a.reshape(-1)), x)
+    return apply_op("cumsum", lambda a: jnp.cumsum(a, axis=axis), x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    if dim is None:
+        return apply_op("cumprod", lambda a: jnp.cumprod(a.reshape(-1)), x)
+    return apply_op("cumprod", lambda a: jnp.cumprod(a, axis=dim), x)
+
+
+def _cum_extremum(v, ax, combine):
+    """Cumulative (value, first-index) scan along ax."""
+    idx0 = jnp.broadcast_to(
+        jnp.arange(v.shape[ax]).reshape(
+            [-1 if d == (ax % v.ndim) else 1 for d in range(v.ndim)]), v.shape)
+
+    def comb(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = combine(bv, av)
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    vals, idx = jax.lax.associative_scan(comb, (v, idx0), axis=ax)
+    return vals, idx
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    from ..core.dtype import to_jax_dtype
+    v = _val(x)
+    if axis is None:
+        v, ax = v.reshape(-1), 0
+    else:
+        ax = axis
+    vals, idx = _cum_extremum(v, ax, lambda b, a: b > a)
+    return Tensor(vals), Tensor(idx.astype(to_jax_dtype(dtype)))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    from ..core.dtype import to_jax_dtype
+    v = _val(x)
+    if axis is None:
+        v, ax = v.reshape(-1), 0
+    else:
+        ax = axis
+    vals, idx = _cum_extremum(v, ax, lambda b, a: b < a)
+    return Tensor(vals), Tensor(idx.astype(to_jax_dtype(dtype)))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.count_nonzero(_val(x), axis=axis, keepdims=keepdim))
+
+
+def kron(x, y, name=None):
+    return apply_op("kron", jnp.kron, x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = _val(prepend) if prepend is not None else None
+    app = _val(append) if append is not None else None
+    return apply_op("diff", lambda a: jnp.diff(a, n=n, axis=axis,
+                                               prepend=pre, append=app), x)

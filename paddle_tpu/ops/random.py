@@ -1,0 +1,111 @@
+"""Random sampling ops (reference: python/paddle/tensor/random.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import to_jax_dtype
+from ..core.place import get_default_dtype
+from ..core.tensor import Tensor, _val
+from ..framework.random import next_key
+
+
+def _dt(dtype):
+    return to_jax_dtype(dtype or get_default_dtype())
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.uniform(next_key(), tuple(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.normal(next_key(), tuple(shape), _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None) -> Tensor:
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = jnp.asarray(_val(mean)), jnp.asarray(_val(std))
+        shp = jnp.broadcast_shapes(m.shape, s.shape)
+        return Tensor(m + s * jax.random.normal(next_key(), shp, m.dtype if m.dtype != jnp.int32 else jnp.float32))
+    shp = tuple(shape) if shape is not None else ()
+    return Tensor(mean + std * jax.random.normal(next_key(), shp, _dt(None)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    return Tensor(jax.random.uniform(next_key(), tuple(shape), _dt(dtype),
+                                     minval=float(_val(min)), maxval=float(_val(max))))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    x._value = jax.random.uniform(next_key(), tuple(x.shape),
+                                  jnp.result_type(x._value), minval=min, maxval=max)
+    return x
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), tuple(shape), int(low), int(high),
+                                     dtype=to_jax_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    v = _val(x)
+    return randint(low, high, shape=v.shape, dtype=dtype or str(v.dtype))
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    return Tensor(jax.random.permutation(next_key(), int(n)).astype(to_jax_dtype(dtype)))
+
+
+def shuffle(x, name=None) -> Tensor:
+    return Tensor(jax.random.permutation(next_key(), _val(x), axis=0, independent=False))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    v = _val(x)
+    logits = jnp.log(jnp.clip(v, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1,
+                                     shape=(*v.shape[:-1], num_samples) if v.ndim > 1 else (num_samples,))
+        if v.ndim > 1:
+            out = jnp.moveaxis(out, -1, -1)
+    else:
+        g = jax.random.gumbel(next_key(), v.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None) -> Tensor:
+    v = _val(x)
+    return Tensor(jax.random.bernoulli(next_key(), v, v.shape).astype(v.dtype))
+
+
+def poisson(x, name=None) -> Tensor:
+    v = _val(x)
+    return Tensor(jax.random.poisson(next_key(), v, v.shape).astype(v.dtype))
+
+
+def exponential_(x, lam=1.0, name=None) -> Tensor:
+    x._value = jax.random.exponential(next_key(), tuple(x.shape),
+                                      jnp.result_type(x._value)) / lam
+    return x
+
+
+def binomial(count, prob, name=None) -> Tensor:
+    c, p = jnp.asarray(_val(count)), jnp.asarray(_val(prob))
+    return Tensor(jax.random.binomial(next_key(), c.astype(jnp.float32), p).astype(jnp.int64))
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None) -> Tensor:
+    return Tensor(mean + std * jax.random.normal(next_key(), tuple(shape), _dt(dtype)))
+
+
+def laplace(loc=0.0, scale=1.0, shape=None, dtype=None, name=None) -> Tensor:
+    shp = tuple(shape) if shape is not None else ()
+    return Tensor(loc + scale * jax.random.laplace(next_key(), shp, _dt(dtype)))

@@ -1,0 +1,431 @@
+"""Optimizers.
+
+Reference: python/paddle/optimizer/ (Optimizer base, adamw.py, momentum.py, …).
+Each optimizer is built around a PURE functional core — ``init_slot(p)`` and
+``apply_one(p, g, slots, lr, t)`` — so the exact same math runs:
+  * eagerly in ``step()`` (paddle-style: reads ``param.grad``), and
+  * inside a jitted train step via ``functional_update`` (a pytree-level
+    update the trainer/jit path calls with traced arrays).
+The slot layout intentionally matches the reference's accumulator names
+(moment1/moment2/beta1_pow/...) so sharded checkpoints map 1:1.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip: Optional[ClipGradBase] = None, name=None,
+                 multi_precision: bool = False):
+        self._lr = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._weight_decay = 0.0 if weight_decay is None else weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._slots: Dict[str, Any] = {}      # param.name -> slot pytree
+        self._master: Dict[str, jax.Array] = {}
+        self._step_count = 0
+        self._param_groups = None
+        if parameters and isinstance(parameters[0], dict):
+            self._param_groups = parameters
+            self._parameter_list = [p for g in parameters for p in g["params"]]
+
+    # ------------------------------------------------------- functional core
+    def init_slot(self, p_val: jax.Array) -> Any:
+        """Per-parameter optimizer state (override)."""
+        return ()
+
+    def apply_one(self, p, g, slots, lr, t, wd):
+        """Pure update: returns (new_p, new_slots). Override."""
+        raise NotImplementedError
+
+    # -------------------------------------------------------------- lr logic
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return self._lr()
+        return float(self._lr)
+
+    def set_lr(self, value: float):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler: LRScheduler):
+        self._lr = scheduler
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # ------------------------------------------------------------ eager path
+    def _params(self) -> List[Parameter]:
+        if self._parameter_list is None:
+            raise ValueError("optimizer created without a parameter list")
+        return [p for p in self._parameter_list if not p.stop_gradient]
+
+    def _decay_for(self, p: Parameter) -> float:
+        wd = self._weight_decay
+        if hasattr(wd, "__call__") and not isinstance(wd, (int, float)):
+            return float(wd())
+        if getattr(p, "no_weight_decay", False):
+            return 0.0
+        return float(wd)
+
+    def step(self):
+        params = self._params()
+        params_grads = [(p, p.grad) for p in params if p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        t = self._step_count
+        lr = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            if p.name not in self._slots:
+                self._slots[p.name] = self.init_slot(p._value)
+            g_val = g._value if isinstance(g, Tensor) else g
+            p_val = p._value
+            use_master = (self._multi_precision and
+                          p_val.dtype in (jnp.float16, jnp.bfloat16))
+            if use_master:
+                if p.name not in self._master:
+                    self._master[p.name] = p_val.astype(jnp.float32)
+                p_compute = self._master[p.name]
+            else:
+                p_compute = p_val
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            new_p, new_slots = self.apply_one(
+                p_compute, g_val.astype(p_compute.dtype), self._slots[p.name],
+                plr, t, self._decay_for(p))
+            self._slots[p.name] = new_slots
+            if use_master:
+                self._master[p.name] = new_p
+                p._value = new_p.astype(p_val.dtype)
+            else:
+                p._value = new_p
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._params():
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._params()]
+
+    # -------------------------------------------------------- jit/GSPMD path
+    def init_state_tree(self, params: Dict[str, jax.Array]):
+        """State pytree for ``functional_update`` (jitted train step)."""
+        return {
+            "slots": {k: self.init_slot(v) for k, v in params.items()},
+            "t": jnp.zeros((), jnp.int32),
+            "master": ({k: v.astype(jnp.float32) for k, v in params.items()}
+                       if self._multi_precision else None),
+        }
+
+    def functional_update(self, params: Dict[str, jax.Array],
+                          grads: Dict[str, jax.Array], state, lr=None):
+        """Pure: (params, grads, state) -> (new_params, new_state).
+        Safe to call inside jax.jit; lr may be a traced scalar."""
+        if lr is None:
+            lr = self.get_lr()
+        if self._grad_clip is not None and hasattr(self._grad_clip, "clip_tree"):
+            grads = self._grad_clip.clip_tree(grads)
+        t = state["t"] + 1
+        new_params, new_slots = {}, {}
+        new_master = {} if state.get("master") is not None else None
+        for k, p in params.items():
+            g = grads[k]
+            slots = state["slots"][k]
+            if new_master is not None:
+                pc = state["master"][k]
+            else:
+                pc = p
+            np_, ns = self.apply_one(pc, g.astype(pc.dtype), slots, lr, t, self._wd_value())
+            new_slots[k] = ns
+            if new_master is not None:
+                new_master[k] = np_
+                new_params[k] = np_.astype(p.dtype)
+            else:
+                new_params[k] = np_
+        return new_params, {"slots": new_slots, "t": t, "master": new_master}
+
+    def _wd_value(self) -> float:
+        wd = self._weight_decay
+        if hasattr(wd, "__call__") and not isinstance(wd, (int, float)):
+            return float(wd())
+        return float(wd)
+
+    # ------------------------------------------------------------ state dict
+    def state_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for pname, slots in self._slots.items():
+            # slot dicts are keyed by the reference accumulator names;
+            # serialize as <param>_<slot>_0 (the reference convention)
+            for key in sorted(slots) if isinstance(slots, dict) else []:
+                out[f"{pname}_{key}_0"] = Tensor(slots[key], stop_gradient=True)
+        for pname, m in self._master.items():
+            out[f"{pname}_fp32_master_0"] = Tensor(m, stop_gradient=True)
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        out["@step"] = self._step_count
+        return out
+
+    def set_state_dict(self, state: Dict[str, Any]):
+        self._step_count = int(state.get("@step", 0))
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+        if self._parameter_list is None:
+            return
+        for p in self._params():
+            template = self.init_slot(p._value)
+            if isinstance(template, dict):
+                slots = {}
+                for key in template:
+                    skey = f"{p.name}_{key}_0"
+                    if skey in state:
+                        v = state[skey]
+                        slots[key] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                if slots:
+                    template.update(slots)
+                    self._slots[p.name] = template
+            mkey = f"{p.name}_fp32_master_0"
+            if mkey in state:
+                v = state[mkey]
+                self._master[p.name] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def apply_one(self, p, g, slots, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        return p - lr * g, slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_slot(self, p_val):
+        return {"velocity": jnp.zeros_like(p_val, dtype=jnp.float32)}
+
+    def apply_one(self, p, g, slots, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        v = self._momentum * slots["velocity"] + g.astype(jnp.float32)
+        if self._nesterov:
+            upd = g.astype(jnp.float32) + self._momentum * v
+        else:
+            upd = v
+        return (p - lr * upd.astype(p.dtype)), {"velocity": v}
+
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, use_multi_tensor=False,
+                 name=None, amsgrad=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._decoupled_wd = False   # Adam: L2-reg style decay (coupled)
+
+    def init_slot(self, p_val):
+        return {
+            "moment1": jnp.zeros_like(p_val, dtype=jnp.float32),
+            "moment2": jnp.zeros_like(p_val, dtype=jnp.float32),
+        }
+
+    def apply_one(self, p, g, slots, lr, t, wd):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if wd and not self._decoupled_wd:
+            g32 = g32 + wd * p32
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * (g32 * g32)
+        tf = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        mhat = m / (1 - self._beta1 ** tf)
+        vhat = v / (1 - self._beta2 ** tf)
+        upd = mhat / (jnp.sqrt(vhat) + self._eps)
+        if wd and self._decoupled_wd:
+            upd = upd + wd * p32
+        new_p = (p32 - lr * upd).astype(p.dtype)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py);
+    supports ``apply_decay_param_fun`` to exempt bias/LN params."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None, amsgrad=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name=name)
+        self._decoupled_wd = True
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _decay_for(self, p):
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            return 0.0
+        return super()._decay_for(p)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_slot(self, p_val):
+        return {"moment": jnp.zeros_like(p_val, dtype=jnp.float32),
+                "inf_norm": jnp.zeros_like(p_val, dtype=jnp.float32)}
+
+    def apply_one(self, p, g, slots, lr, t, wd):
+        g32 = g.astype(jnp.float32)
+        if wd:
+            g32 = g32 + wd * p.astype(jnp.float32)
+        m = self._beta1 * slots["moment"] + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(g32))
+        tf = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        new_p = p - (lr / (1 - self._beta1 ** tf)) * (m / (u + self._eps)).astype(p.dtype)
+        return new_p, {"moment": m, "inf_norm": u}
+
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def init_slot(self, p_val):
+        s = {"mean_square": jnp.zeros_like(p_val, dtype=jnp.float32),
+             "momentum": jnp.zeros_like(p_val, dtype=jnp.float32)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(p_val, dtype=jnp.float32)
+        return s
+
+    def apply_one(self, p, g, slots, lr, t, wd):
+        g32 = g.astype(jnp.float32)
+        if wd:
+            g32 = g32 + wd * p.astype(jnp.float32)
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * g32 * g32
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * g32
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * slots["momentum"] + lr * g32 / denom
+        new = {"mean_square": ms, "momentum": mom}
+        if mg is not None:
+            new["mean_grad"] = mg
+        return (p - mom.astype(p.dtype)), new
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_slot(self, p_val):
+        return {"moment": jnp.full_like(p_val, self._init_acc, dtype=jnp.float32)}
+
+    def apply_one(self, p, g, slots, lr, t, wd):
+        g32 = g.astype(jnp.float32)
+        if wd:
+            g32 = g32 + wd * p.astype(jnp.float32)
+        acc = slots["moment"] + g32 * g32
+        new_p = p - (lr * g32 / (jnp.sqrt(acc) + self._eps)).astype(p.dtype)
+        return new_p, {"moment": acc}
+
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps, self._rho = epsilon, rho
+
+    def init_slot(self, p_val):
+        return {"avg_squared_grad": jnp.zeros_like(p_val, dtype=jnp.float32),
+                "avg_squared_update": jnp.zeros_like(p_val, dtype=jnp.float32)}
+
+    def apply_one(self, p, g, slots, lr, t, wd):
+        g32 = g.astype(jnp.float32)
+        if wd:
+            g32 = g32 + wd * p.astype(jnp.float32)
+        asg = self._rho * slots["avg_squared_grad"] + (1 - self._rho) * g32 * g32
+        upd = g32 * jnp.sqrt(slots["avg_squared_update"] + self._eps) / jnp.sqrt(asg + self._eps)
+        asu = self._rho * slots["avg_squared_update"] + (1 - self._rho) * upd * upd
+        return (p - lr * upd.astype(p.dtype)), {
+            "avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_slot(self, p_val):
+        return {"moment1": jnp.zeros_like(p_val, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(p_val, dtype=jnp.float32)}
+
+    def _decay_for(self, p):
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return 0.0
+        return super()._decay_for(p)
+
+    def apply_one(self, p, g, slots, lr, t, wd):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * g32 * g32
+        tf = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        mhat = m / (1 - self._beta1 ** tf)
+        vhat = v / (1 - self._beta2 ** tf)
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + wd * p32
+        w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (p32 - lr * trust * r).astype(p.dtype), {"moment1": m, "moment2": v}
+

@@ -1,0 +1,22 @@
+from . import install_check  # noqa: F401
+from .install_check import run_check  # noqa: F401
+
+
+def try_import(module_name: str):
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            f"{module_name} is required but not installed (offline image: "
+            "no pip installs available).") from e
+
+
+def unique_name_generator(prefix: str = "tmp"):
+    import itertools
+    counter = itertools.count()
+
+    def gen():
+        return f"{prefix}_{next(counter)}"
+
+    return gen

@@ -1,0 +1,46 @@
+"""Test config: force a CPU-simulated 8-device platform BEFORE jax import.
+
+Mirrors the reference CI trick (SURVEY.md §4): the reference spawns real
+2-GPU jobs; here an 8-device CPU mesh exercises every collective path on any
+machine.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env may pin a TPU platform
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+import jax
+
+# Drop any experimental TPU-tunnel PJRT plugin from the factory registry:
+# tests are CPU-only, and backend discovery would otherwise initialize the
+# tunnel (and hang if it is down).
+try:
+    from jax._src import xla_bridge as _xb
+    for _name in list(_xb._backend_factories):
+        if _name not in ("cpu",):
+            _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass
+
+# The ambient environment may have imported jax already (via sitecustomize)
+# with a TPU platform pinned — override the live config, not just the env.
+jax.config.update("jax_platforms", "cpu")
+
+# CPU XLA defaults to TPU-like reduced matmul precision; tests compare
+# against numpy so force exact fp32.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

@@ -1,0 +1,99 @@
+"""OpTest harness.
+
+Port of the reference's test/legacy_test/op_test.py strategy: run each op
+eagerly, check outputs against a numpy reference, and check analytic
+gradients (the eager tape) against (a) jax.grad of the same computation and
+(b) central finite differences.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def check_output(paddle_fn: Callable, numpy_fn: Callable, inputs: Sequence[np.ndarray],
+                 rtol=1e-5, atol=1e-6, kwargs=None):
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(i) for i in inputs]
+    out = paddle_fn(*tensors, **kwargs)
+    ref = numpy_fn(*inputs, **kwargs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(np.asarray(o.value, dtype=np.float64)
+                                   if o.dtype.is_floating_point else np.asarray(o.value),
+                                   np.asarray(r, dtype=np.float64)
+                                   if np.issubdtype(np.asarray(r).dtype, np.floating) else r,
+                                   rtol=rtol, atol=atol)
+    return out
+
+
+def check_grad(paddle_fn: Callable, inputs: Sequence[np.ndarray], rtol=1e-4,
+               atol=1e-5, eps=1e-3, kwargs=None, fd_check=True):
+    """Analytic (tape) grads vs jax.grad and finite differences of a scalar
+    reduction of the op output."""
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(i.astype(np.float64), stop_gradient=False)
+               for i in inputs]
+
+    def scalar(fn_out):
+        outs = fn_out if isinstance(fn_out, (tuple, list)) else [fn_out]
+        total = None
+        for o in outs:
+            s = (o.sum() if isinstance(o, Tensor) else jnp.sum(o))
+            total = s if total is None else total + s
+        return total
+
+    out = paddle_fn(*tensors, **kwargs)
+    loss = scalar(out)
+    loss.backward()
+    tape_grads = [t.grad.numpy() if t.grad is not None else None for t in tensors]
+
+    # jax.grad reference
+    def jf(*vals):
+        ts = [Tensor(v, stop_gradient=True) for v in vals]
+        from paddle_tpu.core.autograd import functional_guard
+        with functional_guard():
+            o = paddle_fn(*ts, **kwargs)
+        outs = o if isinstance(o, (tuple, list)) else [o]
+        return sum(jnp.sum(oo.value) for oo in outs)
+
+    jax_grads = jax.grad(jf, argnums=tuple(range(len(tensors))))(
+        *[t.value for t in tensors])
+    for tg, jg in zip(tape_grads, jax_grads):
+        if tg is None:
+            continue
+        np.testing.assert_allclose(tg, np.asarray(jg), rtol=rtol, atol=atol,
+                                   err_msg="tape grad != jax.grad")
+
+    if fd_check:
+        for i, x in enumerate(inputs):
+            if not np.issubdtype(np.asarray(x).dtype, np.floating):
+                continue
+            fd = _finite_difference(jf, [t.value for t in tensors], i, eps)
+            np.testing.assert_allclose(tape_grads[i], fd, rtol=5e-2, atol=5e-3,
+                                       err_msg=f"tape grad != finite diff (input {i})")
+
+
+def _finite_difference(f, vals, idx, eps):
+    x = np.asarray(vals[idx], dtype=np.float64)
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = g.reshape(-1)
+    for j in range(flat.size):
+        orig = flat[j]
+        flat[j] = orig + eps
+        vp = float(f(*[jnp.asarray(x) if k == idx else v for k, v in enumerate(vals)]))
+        flat[j] = orig - eps
+        vm = float(f(*[jnp.asarray(x) if k == idx else v for k, v in enumerate(vals)]))
+        flat[j] = orig
+        gflat[j] = (vp - vm) / (2 * eps)
+    return g

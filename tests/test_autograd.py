@@ -1,0 +1,129 @@
+"""Eager autograd engine tests (reference: eager-mode tests in
+test/legacy_test + dygraph tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def rand(*shape):
+    return np.random.randn(*shape).astype(np.float32)
+
+
+class TestBackward:
+    def test_chain(self):
+        x = paddle.to_tensor(rand(3, 3), stop_gradient=False)
+        y = (x * 2 + 1).tanh().sum()
+        y.backward()
+        import jax, jax.numpy as jnp
+        ref = jax.grad(lambda v: jnp.sum(jnp.tanh(v * 2 + 1)))(x.value)
+        np.testing.assert_allclose(x.grad.numpy(), np.asarray(ref), rtol=1e-5)
+
+    def test_diamond(self):
+        # shared subexpression: grads must accumulate once per consumer
+        x = paddle.to_tensor(rand(2, 2), stop_gradient=False)
+        h = x * 3
+        y = (h * h + h).sum()
+        y.backward()
+        import jax, jax.numpy as jnp
+        ref = jax.grad(lambda v: jnp.sum((v * 3) ** 2 + v * 3))(x.value)
+        np.testing.assert_allclose(x.grad.numpy(), np.asarray(ref), rtol=1e-5)
+
+    def test_accumulation_over_backwards(self):
+        x = paddle.to_tensor(rand(2,), stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full(2, 5.0), rtol=1e-6)
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor(rand(2, 2), stop_gradient=False)
+        y = paddle.to_tensor(rand(2, 2), stop_gradient=True)
+        (x * y).sum().backward()
+        assert x.grad is not None and y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor(rand(2, 2), stop_gradient=False)
+        d = (x * 2).detach()
+        assert d.stop_gradient
+        (d * 3).sum().backward()
+        assert x.grad is None
+
+    def test_no_grad(self):
+        x = paddle.to_tensor(rand(2, 2), stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y._grad_node is None
+
+    def test_non_scalar_needs_grad_tensors(self):
+        x = paddle.to_tensor(rand(2, 2), stop_gradient=False)
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            y.backward()
+        y.backward(paddle.ones_like(y))
+        np.testing.assert_allclose(x.grad.numpy(), np.full((2, 2), 2.0))
+
+    def test_retain_grads(self):
+        x = paddle.to_tensor(rand(2,), stop_gradient=False)
+        h = x * 2
+        h.retain_grads()
+        (h * 3).sum().backward()
+        np.testing.assert_allclose(h.grad.numpy(), np.full(2, 3.0))
+
+    def test_register_hook(self):
+        x = paddle.to_tensor(rand(2,), stop_gradient=False)
+        seen = []
+        x.register_hook(lambda g: seen.append(g.numpy()))
+        (x * 2).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], np.full(2, 2.0))
+
+    def test_paddle_grad(self):
+        x = paddle.to_tensor(rand(2, 2), stop_gradient=False)
+        y = (x ** 2).sum()
+        (gx,) = paddle.grad(y, [x])
+        np.testing.assert_allclose(gx.numpy(), 2 * x.numpy(), rtol=1e-6)
+        assert x.grad is None  # paddle.grad must not pollute .grad
+
+    def test_multi_output_op(self):
+        x = paddle.to_tensor(rand(3, 4), stop_gradient=False)
+        vals, idx = paddle.topk(x, k=2, axis=1)
+        vals.sum().backward()
+        assert x.grad is not None
+        assert np.isclose(x.grad.numpy().sum(), 6.0)
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                return grad * 2
+
+        x = paddle.to_tensor(rand(2, 2), stop_gradient=False)
+        y = Double.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full((2, 2), 2.0))
+
+    def test_pylayer_in_graph(self):
+        class Square(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor()
+                return grad * 2 * x
+
+        x = paddle.to_tensor(rand(2,), stop_gradient=False)
+        y = (Square.apply(x * 1.0) * 3).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 6 * x.numpy(), rtol=1e-5)

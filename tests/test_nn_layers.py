@@ -1,0 +1,197 @@
+"""Layer system + nn functional tests (reference: test/legacy_test
+test_layers.py-style behavioral asserts)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def rand(*shape):
+    return np.random.randn(*shape).astype(np.float32)
+
+
+class TestLayerBase:
+    def test_parameter_registration(self):
+        lin = nn.Linear(4, 3)
+        names = [n for n, _ in lin.named_parameters()]
+        assert set(names) == {"weight", "bias"}
+        assert lin.weight.shape == [4, 3]
+        assert not lin.weight.stop_gradient
+
+    def test_nested_state_dict(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        sd = net.state_dict()
+        assert set(sd) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+        sd2 = {k: paddle.zeros(v.shape) for k, v in sd.items()}
+        net.set_state_dict(sd2)
+        assert float(net.fc1.weight.numpy().sum()) == 0.0
+
+    def test_buffers(self):
+        bn = nn.BatchNorm1D(4)
+        buf_names = [n for n, _ in bn.named_buffers()]
+        assert "_mean" in buf_names and "_variance" in buf_names
+        assert "_mean" in bn.state_dict()
+
+    def test_train_eval_mode(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([100, 100])
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), x.numpy())
+        d.train()
+        out = d(x).numpy()
+        assert (out == 0).any() and out.max() > 1.0
+
+    def test_sequential_layerlist(self):
+        seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        out = seq(paddle.to_tensor(rand(3, 4)))
+        assert out.shape == [3, 2]
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(list(ll.parameters())) == 6
+
+    def test_forward_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h = lin.register_forward_post_hook(lambda l, i, o: calls.append(1))
+        lin(paddle.to_tensor(rand(1, 2)))
+        assert calls
+        h.remove()
+        lin(paddle.to_tensor(rand(1, 2)))
+        assert len(calls) == 1
+
+    def test_apply_and_to_dtype(self):
+        net = nn.Linear(2, 2)
+        net.to(dtype="bfloat16")
+        assert net.weight.dtype == "bfloat16"
+
+
+class TestFunctional:
+    def test_linear_vs_numpy(self):
+        x, w, b = rand(5, 4), rand(4, 3), rand(3)
+        out = F.linear(paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5)
+
+    def test_layer_norm(self):
+        x = rand(4, 8)
+        g, b = np.ones(8, np.float32), np.zeros(8, np.float32)
+        out = F.layer_norm(paddle.to_tensor(x), 8, paddle.to_tensor(g), paddle.to_tensor(b))
+        mu, var = x.mean(-1, keepdims=True), x.var(-1, keepdims=True)
+        np.testing.assert_allclose(out.numpy(), (x - mu) / np.sqrt(var + 1e-5),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_rms_norm(self):
+        x = rand(4, 8)
+        w = np.ones(8, np.float32)
+        out = F.rms_norm(paddle.to_tensor(x), paddle.to_tensor(w))
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_softmax_ce(self):
+        logits = rand(4, 10)
+        labels = np.random.randint(0, 10, (4,)).astype(np.int64)
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        # numpy reference
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = rand(4, 5)
+        labels = np.array([0, 1, -100, 2], np.int64)
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                               ignore_index=-100)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        mask = labels != -100
+        ref = -np.log(p[np.arange(4), np.clip(labels, 0, 4)])[mask].mean()
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+    def test_embedding(self):
+        w = rand(10, 4)
+        idx = np.array([[1, 2], [3, 4]], np.int64)
+        out = F.embedding(paddle.to_tensor(idx), paddle.to_tensor(w))
+        np.testing.assert_allclose(out.numpy(), w[idx])
+
+    def test_sdpa_causal_matches_naive(self):
+        b, s, h, d = 2, 8, 2, 4
+        q, k, v = rand(b, s, h, d), rand(b, s, h, d), rand(b, s, h, d)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            is_causal=True)
+        # naive numpy
+        qt = q.transpose(0, 2, 1, 3)
+        kt = k.transpose(0, 2, 1, 3)
+        vt = v.transpose(0, 2, 1, 3)
+        sc = qt @ kt.transpose(0, 1, 3, 2) / np.sqrt(d)
+        mask = np.tril(np.ones((s, s), bool))
+        sc = np.where(mask, sc, -1e30)
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = (p @ vt).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-3, atol=1e-4)
+
+    def test_conv2d(self):
+        x = rand(1, 3, 8, 8)
+        w = rand(4, 3, 3, 3)
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), padding=1)
+        assert out.shape == [1, 4, 8, 8]
+
+    def test_mha_layer(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.to_tensor(rand(2, 5, 16))
+        out = mha(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        out = enc(paddle.to_tensor(rand(2, 6, 16)))
+        assert out.shape == [2, 6, 16]
+
+    def test_activations(self):
+        x = rand(3, 3)
+        np.testing.assert_allclose(F.relu(paddle.to_tensor(x)).numpy(),
+                                   np.maximum(x, 0))
+        np.testing.assert_allclose(
+            F.silu(paddle.to_tensor(x)).numpy(), x / (1 + np.exp(-x)), rtol=1e-5)
+        g = F.gelu(paddle.to_tensor(x)).numpy()
+        assert g.shape == x.shape
+
+    def test_swiglu(self):
+        x, y = rand(2, 4), rand(2, 4)
+        out = F.swiglu(paddle.to_tensor(x), paddle.to_tensor(y))
+        ref = x / (1 + np.exp(-x)) * y
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+class TestEndToEndTraining:
+    def test_mlp_learns(self):
+        """Single-device eager training: loss must decrease (the reference's
+        most basic dygraph train test)."""
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+        opt = paddle.optimizer.Adam(0.03, parameters=net.parameters())
+        x = rand(64, 4)
+        y = (x.sum(-1, keepdims=True) > 0).astype(np.float32)
+        xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+        losses = []
+        for _ in range(30):
+            out = net(xt)
+            loss = F.mse_loss(out, yt)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]

@@ -1,0 +1,214 @@
+"""Op unit tests via the OpTest harness (reference: test/legacy_test/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+
+def rand(*shape):
+    return np.random.randn(*shape).astype(np.float32)
+
+
+class TestMath:
+    def test_add(self):
+        check_output(paddle.add, np.add, [rand(3, 4), rand(3, 4)])
+        check_grad(paddle.add, [rand(2, 3), rand(2, 3)])
+
+    def test_broadcast_add(self):
+        check_output(paddle.add, np.add, [rand(3, 4), rand(4)])
+        check_grad(paddle.add, [rand(3, 4), rand(4)])
+
+    def test_multiply(self):
+        check_output(paddle.multiply, np.multiply, [rand(3, 4), rand(3, 4)])
+        check_grad(paddle.multiply, [rand(2, 3), rand(2, 3)])
+
+    def test_divide(self):
+        a, b = rand(3, 3), rand(3, 3) + 2.0
+        check_output(paddle.divide, np.divide, [a, b])
+        check_grad(paddle.divide, [a, b])
+
+    def test_matmul(self):
+        check_output(paddle.matmul, np.matmul, [rand(3, 4), rand(4, 5)],
+                     rtol=1e-4, atol=1e-5)
+        check_grad(paddle.matmul, [rand(3, 4), rand(4, 5)])
+
+    def test_matmul_transpose(self):
+        a, b = rand(4, 3), rand(4, 5)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                            transpose_x=True)
+        np.testing.assert_allclose(out.numpy(), a.T @ b, rtol=1e-5)
+
+    def test_unary_suite(self):
+        for pfn, nfn, data in [
+            (paddle.exp, np.exp, rand(3, 3)),
+            (paddle.log, np.log, np.abs(rand(3, 3)) + 0.5),
+            (paddle.sqrt, np.sqrt, np.abs(rand(3, 3)) + 0.1),
+            (paddle.tanh, np.tanh, rand(3, 3)),
+            (paddle.abs, np.abs, rand(3, 3)),
+            (paddle.floor, np.floor, rand(3, 3)),
+            (paddle.square, np.square, rand(3, 3)),
+        ]:
+            # XLA's vectorized transcendentals differ from libm at ~1e-4
+            check_output(pfn, nfn, [data], rtol=2e-4, atol=2e-4)
+
+    def test_unary_grads(self):
+        check_grad(paddle.exp, [rand(2, 2)])
+        check_grad(paddle.tanh, [rand(2, 2)])
+        check_grad(paddle.sigmoid, [rand(2, 2)])
+
+    def test_reductions(self):
+        x = rand(3, 4, 5)
+        check_output(paddle.sum, np.sum, [x], kwargs={"axis": 1})
+        check_output(paddle.mean, np.mean, [x], kwargs={"axis": (0, 2)})
+        check_output(paddle.max, np.max, [x], kwargs={"axis": -1})
+        check_output(lambda t: paddle.sum(t, axis=1, keepdim=True),
+                     lambda a: np.sum(a, axis=1, keepdims=True), [x])
+        check_grad(lambda t: paddle.mean(t, axis=1), [rand(2, 3)])
+
+    def test_argmax_cumsum(self):
+        x = rand(4, 5)
+        assert np.array_equal(paddle.argmax(paddle.to_tensor(x), axis=1).numpy(),
+                              np.argmax(x, axis=1))
+        np.testing.assert_allclose(paddle.cumsum(paddle.to_tensor(x), axis=0).numpy(),
+                                   np.cumsum(x, axis=0), rtol=1e-5)
+
+    def test_clip_scale(self):
+        x = rand(3, 3)
+        np.testing.assert_allclose(
+            paddle.clip(paddle.to_tensor(x), -0.5, 0.5).numpy(),
+            np.clip(x, -0.5, 0.5))
+        np.testing.assert_allclose(
+            paddle.scale(paddle.to_tensor(x), scale=2.0, bias=1.0).numpy(),
+            x * 2 + 1, rtol=1e-6)
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp as np_lse  # noqa
+        x = rand(3, 4)
+        np.testing.assert_allclose(
+            paddle.logsumexp(paddle.to_tensor(x), axis=1).numpy(),
+            np.log(np.exp(x).sum(axis=1)), rtol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = rand(2, 3, 4)
+        t = paddle.to_tensor(x)
+        assert paddle.reshape(t, [6, 4]).shape == [6, 4]
+        assert paddle.transpose(t, [2, 0, 1]).shape == [4, 2, 3]
+        assert paddle.flatten(t, 1).shape == [2, 12]
+        check_grad(lambda a: paddle.reshape(a, [6, 4]), [x])
+
+    def test_concat_split_stack(self):
+        a, b = rand(2, 3), rand(2, 3)
+        c = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        np.testing.assert_allclose(c.numpy(), np.concatenate([a, b], axis=0))
+        parts = paddle.split(c, 2, axis=0)
+        np.testing.assert_allclose(parts[0].numpy(), a)
+        s = paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        assert s.shape == [2, 2, 3]
+        check_grad(lambda x, y: paddle.concat([x, y], axis=1), [a, b])
+
+    def test_squeeze_unsqueeze_tile(self):
+        x = rand(1, 3, 1)
+        assert paddle.squeeze(paddle.to_tensor(x)).shape == [3]
+        assert paddle.unsqueeze(paddle.to_tensor(x), 0).shape == [1, 1, 3, 1]
+        assert paddle.tile(paddle.to_tensor(rand(2, 2)), [2, 3]).shape == [4, 6]
+
+    def test_gather_scatter(self):
+        x = rand(5, 3)
+        idx = np.array([0, 2, 4])
+        np.testing.assert_allclose(
+            paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx)).numpy(),
+            x[idx])
+        upd = rand(3, 3)
+        out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                             paddle.to_tensor(upd))
+        ref = x.copy()
+        ref[idx] = upd
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_where_masked_fill(self):
+        x, y = rand(3, 3), rand(3, 3)
+        cond = x > 0
+        np.testing.assert_allclose(
+            paddle.where(paddle.to_tensor(cond), paddle.to_tensor(x),
+                         paddle.to_tensor(y)).numpy(),
+            np.where(cond, x, y))
+
+    def test_topk_sort(self):
+        x = rand(4, 6)
+        vals, idx = paddle.topk(paddle.to_tensor(x), k=3, axis=1)
+        ref = np.sort(x, axis=1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+        s = paddle.sort(paddle.to_tensor(x), axis=1)
+        np.testing.assert_allclose(s.numpy(), np.sort(x, axis=1))
+
+    def test_getitem_grad(self):
+        x = rand(4, 4)
+        t = paddle.to_tensor(x, stop_gradient=False)
+        y = t[1:3, :2].sum()
+        y.backward()
+        ref = np.zeros_like(x)
+        ref[1:3, :2] = 1.0
+        np.testing.assert_allclose(t.grad.numpy(), ref)
+
+    def test_pad(self):
+        x = rand(2, 3)
+        out = paddle.ops.pad(paddle.to_tensor(x), [1, 1, 2, 2])
+        assert out.shape == [4, 7]
+
+
+class TestComparison:
+    def test_cmp(self):
+        a, b = rand(3, 3), rand(3, 3)
+        assert np.array_equal((paddle.to_tensor(a) > paddle.to_tensor(b)).numpy(), a > b)
+        assert bool(paddle.allclose(paddle.to_tensor(a), paddle.to_tensor(a)))
+        assert not bool(paddle.equal_all(paddle.to_tensor(a), paddle.to_tensor(b)))
+
+
+class TestCreation:
+    def test_creation(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        # without jax x64 mode, int64 requests are served as int32
+        assert paddle.ones([2, 3], dtype="int64").dtype in ("int64", "int32")
+        assert paddle.full([2], 7.0).numpy()[0] == 7.0
+        assert paddle.arange(5).shape == [5]
+        assert np.allclose(paddle.eye(3).numpy(), np.eye(3))
+        assert paddle.one_hot(paddle.to_tensor(np.array([1, 2])), 4).shape == [2, 4]
+        tl = paddle.tril(paddle.to_tensor(rand(3, 3)))
+        assert np.allclose(np.triu(tl.numpy(), 1), 0)
+
+    def test_rng_determinism(self):
+        paddle.seed(7)
+        a = paddle.randn([3, 3]).numpy()
+        paddle.seed(7)
+        b = paddle.randn([3, 3]).numpy()
+        np.testing.assert_allclose(a, b)
+
+
+class TestLinalg:
+    def test_einsum(self):
+        a, b = rand(3, 4), rand(4, 5)
+        np.testing.assert_allclose(
+            paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            a @ b, rtol=1e-5)
+
+    def test_norm(self):
+        x = rand(3, 4)
+        np.testing.assert_allclose(paddle.norm(paddle.to_tensor(x)).numpy(),
+                                   np.linalg.norm(x), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.norm(paddle.to_tensor(x), p=1, axis=1).numpy(),
+            np.abs(x).sum(axis=1), rtol=1e-5)
+
+    def test_solve_inverse(self):
+        a = rand(3, 3) + 3 * np.eye(3, dtype=np.float32)
+        b = rand(3, 2)
+        np.testing.assert_allclose(
+            paddle.ops.solve(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            np.linalg.solve(a, b), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            paddle.ops.inverse(paddle.to_tensor(a)).numpy(),
+            np.linalg.inv(a), rtol=1e-4, atol=1e-5)

@@ -1,0 +1,215 @@
+"""Optimizer + LR scheduler + amp tests (reference:
+test/legacy_test/test_adamw_op.py etc.)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def rand(*shape):
+    return np.random.randn(*shape).astype(np.float32)
+
+
+def quad_problem(opt_factory, steps=50):
+    paddle.seed(1)
+    w = paddle.Parameter(np.array([5.0, -3.0], np.float32))
+    opt = opt_factory([w])
+    for _ in range(steps):
+        loss = (w * w).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return np.abs(w.numpy()).max()
+
+
+class TestOptimizers:
+    def test_sgd(self):
+        assert quad_problem(lambda p: paddle.optimizer.SGD(0.1, parameters=p)) < 0.1
+
+    def test_momentum(self):
+        assert quad_problem(lambda p: paddle.optimizer.Momentum(0.05, parameters=p),
+                            steps=120) < 0.2
+
+    def test_adam(self):
+        assert quad_problem(lambda p: paddle.optimizer.Adam(0.3, parameters=p)) < 0.2
+
+    def test_adamw(self):
+        assert quad_problem(lambda p: paddle.optimizer.AdamW(0.3, parameters=p)) < 0.2
+
+    def test_rmsprop(self):
+        assert quad_problem(lambda p: paddle.optimizer.RMSProp(0.05, parameters=p),
+                            steps=150) < 0.3
+
+    def test_adamw_matches_manual(self):
+        """AdamW decoupled decay semantics vs hand-rolled update."""
+        lr, b1, b2, eps, wd = 0.01, 0.9, 0.999, 1e-8, 0.1
+        w0 = np.array([1.0, 2.0], np.float32)
+        g = np.array([0.5, -0.5], np.float32)
+        w = paddle.Parameter(w0.copy())
+        opt = paddle.optimizer.AdamW(lr, beta1=b1, beta2=b2, epsilon=eps,
+                                     parameters=[w], weight_decay=wd)
+        (w * paddle.to_tensor(g)).sum().backward()
+        opt.step()
+        m = (1 - b1) * g
+        v = (1 - b2) * g * g
+        mh, vh = m / (1 - b1), v / (1 - b2)
+        ref = w0 - lr * (mh / (np.sqrt(vh) + eps) + wd * w0)
+        np.testing.assert_allclose(w.numpy(), ref, rtol=1e-5)
+
+    def test_grad_clip_global_norm(self):
+        w = paddle.Parameter(np.ones(4, np.float32))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        opt = paddle.optimizer.SGD(1.0, parameters=[w], grad_clip=clip)
+        (w * 100.0).sum().backward()   # grad = 100 each, norm = 200
+        opt.step()
+        # clipped grad norm == 1 -> step of magnitude 1/sqrt(4)=0.5 per element
+        np.testing.assert_allclose(w.numpy(), 1.0 - 0.5, rtol=1e-4)
+
+    def test_functional_update_matches_eager(self):
+        """The jit-path functional core must equal the eager step()."""
+        w_e = paddle.Parameter(np.array([1.0, -2.0, 3.0], np.float32))
+        opt_e = paddle.optimizer.AdamW(0.1, parameters=[w_e], weight_decay=0.01)
+        g = np.array([0.3, -0.1, 0.2], np.float32)
+        w_e.grad = paddle.to_tensor(g)
+        opt_e.step()
+
+        opt_f = paddle.optimizer.AdamW(0.1, weight_decay=0.01)
+        params = {"w": paddle.to_tensor(np.array([1.0, -2.0, 3.0], np.float32)).value}
+        state = opt_f.init_state_tree(params)
+        new_params, state = opt_f.functional_update(params, {"w": paddle.to_tensor(g).value}, state, lr=0.1)
+        np.testing.assert_allclose(w_e.numpy(), np.asarray(new_params["w"]), rtol=1e-6)
+
+    def test_multi_precision_master_weights(self):
+        w = paddle.Parameter(np.array([1.0, 2.0], np.float32))
+        w._value = w._value.astype("bfloat16")
+        opt = paddle.optimizer.AdamW(0.01, parameters=[w], multi_precision=True)
+        (w.astype("float32") * 1.0).sum().backward()
+        opt.step()
+        assert w.dtype == "bfloat16"
+        assert w.name in opt._master
+        assert str(opt._master[w.name].dtype) == "float32"
+
+    def test_state_dict_roundtrip(self):
+        w = paddle.Parameter(rand(3))
+        opt = paddle.optimizer.Adam(0.1, parameters=[w])
+        (w * 2).sum().backward()
+        opt.step()
+        sd = opt.state_dict()
+        opt2 = paddle.optimizer.Adam(0.1, parameters=[w])
+        opt2.set_state_dict(sd)
+        np.testing.assert_allclose(
+            np.asarray(opt2._slots[w.name]["moment1"]),
+            np.asarray(opt._slots[w.name]["moment1"]))
+
+
+class TestLRSchedulers:
+    def test_basic_schedulers(self):
+        lr = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        vals = []
+        for _ in range(5):
+            vals.append(lr())
+            lr.step()
+        np.testing.assert_allclose(vals, [0.1, 0.1, 0.05, 0.05, 0.025], rtol=1e-6)
+
+    def test_warmup(self):
+        lr = paddle.optimizer.lr.LinearWarmup(0.1, warmup_steps=5, start_lr=0.0,
+                                              end_lr=0.1)
+        first = lr()
+        for _ in range(6):
+            lr.step()
+        assert first < 0.05 and abs(lr() - 0.1) < 1e-6
+
+    def test_cosine(self):
+        lr = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        for _ in range(10):
+            lr.step()
+        assert lr() < 1e-6
+
+    def test_optimizer_uses_scheduler(self):
+        sched = paddle.optimizer.lr.StepDecay(1.0, step_size=1, gamma=0.1)
+        w = paddle.Parameter(np.array([1.0], np.float32))
+        opt = paddle.optimizer.SGD(sched, parameters=[w])
+        w.grad = paddle.to_tensor(np.array([1.0], np.float32))
+        opt.step()  # lr=1.0 at epoch 0
+        np.testing.assert_allclose(w.numpy(), [0.0], atol=1e-6)
+
+
+class TestAmp:
+    def test_autocast_o1(self):
+        x = paddle.to_tensor(rand(4, 4))
+        with paddle.amp.auto_cast(level="O1"):
+            y = paddle.matmul(x, x)
+            z = paddle.exp(x)          # blacklist: stays fp32
+        assert y.dtype == "bfloat16"
+        assert z.dtype == "float32"
+
+    def test_autocast_off(self):
+        x = paddle.to_tensor(rand(4, 4))
+        y = paddle.matmul(x, x)
+        assert y.dtype == "float32"
+
+    def test_grad_scaler_noop_path(self):
+        w = paddle.Parameter(np.array([1.0], np.float32))
+        opt = paddle.optimizer.SGD(0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(enable=False)
+        loss = (w * 2).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(w.numpy(), [0.8], rtol=1e-6)
+
+    def test_grad_scaler_fp16_skips_inf(self):
+        w = paddle.Parameter(np.array([1.0], np.float32))
+        opt = paddle.optimizer.SGD(0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+        loss = (w * 2).sum()
+        scaler.scale(loss).backward()
+        w.grad._value = w.grad._value * np.inf   # poison
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(w.numpy(), [1.0])  # step skipped
+        assert scaler._scale == 1.0  # decreased
+
+    def test_decorate_o2(self):
+        net = nn.Linear(4, 4)
+        opt = paddle.optimizer.AdamW(0.1, parameters=net.parameters())
+        net, opt = paddle.amp.decorate(net, opt, level="O2")
+        assert net.weight.dtype == "bfloat16"
+        assert opt._multi_precision
+
+
+class TestIO:
+    def test_save_load_state_dict(self, tmp_path):
+        net = nn.Linear(3, 2)
+        path = str(tmp_path / "model.pdparams")
+        paddle.save(net.state_dict(), path)
+        loaded = paddle.load(path)
+        np.testing.assert_allclose(loaded["weight"].numpy(), net.weight.numpy())
+        net2 = nn.Linear(3, 2)
+        net2.set_state_dict(loaded)
+        np.testing.assert_allclose(net2.weight.numpy(), net.weight.numpy())
+
+    def test_save_load_bf16(self, tmp_path):
+        t = paddle.to_tensor(rand(3, 3)).astype("bfloat16")
+        path = str(tmp_path / "t.pd")
+        paddle.save({"t": t}, path)
+        loaded = paddle.load(path)
+        assert loaded["t"].dtype == "bfloat16"
+
+    def test_dataloader(self):
+        ds = paddle.io.TensorDataset([rand(10, 4), np.arange(10)])
+        dl = paddle.io.DataLoader(ds, batch_size=3, shuffle=True, drop_last=False)
+        batches = list(dl)
+        assert len(batches) == 4
+        assert batches[0][0].shape == [3, 4]
+
+    def test_distributed_batch_sampler(self):
+        ds = paddle.io.TensorDataset([rand(10, 2)])
+        s0 = paddle.io.DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=0)
+        s1 = paddle.io.DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert len(i0) == len(i1) == 5
+        assert not (set(i0) & set(i1))
