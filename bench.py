@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Benchmark driver: trains the GPT-3 345M smoke config (BASELINE.json
+configs[0]) with the jitted train step on the available device and prints ONE
+JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline is MFU / 0.45 — the fraction of the 45%-MFU north-star target
+(BASELINE.md; no reference-published numbers exist to compare against).
+
+Env knobs: BENCH_MODEL (gpt345m|gpt_tiny|llama_tiny), BENCH_STEPS,
+BENCH_BATCH, BENCH_SEQ.
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.hapi import TrainStep
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM, LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.utils.metrics import SpeedMeter
+
+    import jax
+
+    model_name = os.environ.get("BENCH_MODEL", "gpt345m")
+    steps = int(os.environ.get("BENCH_STEPS", "12"))
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+
+    if model_name == "gpt345m":
+        cfg = GPTConfig.gpt3_345m()
+        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        seq = int(os.environ.get("BENCH_SEQ", "1024"))
+        model_cls = GPTForCausalLM
+    elif model_name == "gpt_tiny":
+        cfg = GPTConfig.tiny()
+        batch = int(os.environ.get("BENCH_BATCH", "4"))
+        seq = int(os.environ.get("BENCH_SEQ", "64"))
+        model_cls = GPTForCausalLM
+    else:
+        cfg = LlamaConfig.tiny()
+        batch = int(os.environ.get("BENCH_BATCH", "4"))
+        seq = int(os.environ.get("BENCH_SEQ", "64"))
+        model_cls = LlamaForCausalLM
+
+    paddle.seed(0)
+    model = model_cls(cfg)
+    n_params = sum(p.size for p in model.parameters())
+    if on_tpu:
+        # bf16 params + fp32 master weights: the TPU-native training recipe
+        model.to(dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(
+        1e-4, parameters=model.parameters(), weight_decay=0.01,
+        multi_precision=on_tpu)
+    step = TrainStep(model, opt)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1))
+    x = paddle.to_tensor(ids[:, :-1].astype(np.int32))
+    y = paddle.to_tensor(ids[:, 1:].astype(np.int32))
+
+    meter = SpeedMeter(
+        n_params=n_params, n_layers=cfg.num_hidden_layers,
+        hidden=cfg.hidden_size, seq_len=seq,
+        n_chips=jax.device_count(), warmup=2)
+
+    import jax.numpy as jnp
+    first_loss = last_loss = None
+    meter.start()
+    for i in range(steps):
+        with paddle.amp.auto_cast(enable=on_tpu, level="O1", dtype="bfloat16"):
+            loss = step(x, y)
+        jax.block_until_ready(loss.value)
+        meter.step(batch * seq)
+        if i == 0:
+            first_loss = float(loss)
+        last_loss = float(loss)
+
+    s = meter.summary()
+    result = {
+        "metric": f"{model_name}_mfu",
+        "value": round(s["mfu"], 4),
+        "unit": "mfu_fraction",
+        "vs_baseline": round(s["mfu"] / 0.45, 4),
+        "tokens_per_sec_per_chip": round(s["tokens_per_sec_per_chip"], 1),
+        "median_step_time_s": round(s["median_step_time_s"], 4),
+        "n_params": n_params,
+        "first_loss": round(first_loss, 4),
+        "last_loss": round(last_loss, 4),
+        "backend": jax.default_backend(),
+        "n_chips": jax.device_count(),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

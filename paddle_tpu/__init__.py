@@ -42,6 +42,8 @@ from . import metric  # noqa: F401
 from . import autograd  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 from . import distributed  # noqa: F401
+from . import hapi  # noqa: F401
+from . import models  # noqa: F401
 from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
 from .utils.install_check import run_check  # noqa: F401

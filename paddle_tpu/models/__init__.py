@@ -1,0 +1,9 @@
+"""Model exemplars.
+
+The reference keeps models out-of-tree (PaddleNLP/PaddleFleetX); this package
+ships the exemplars the north-star metric is measured on (BASELINE.json):
+GPT-3 345M, Llama-2 7B/70B, an ERNIE-style MoE, and an SD UNet.
+"""
+
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
+from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401

@@ -1,0 +1,166 @@
+"""GPT exemplar (the smoke-config model: GPT-3 345M).
+
+Built entirely from paddle_tpu.nn layers so that the same model definition
+runs eagerly, under jit, and — once wrapped by fleet — under hybrid
+parallelism. TP-aware variants swap Linear for Column/RowParallelLinear via
+``mesh_axes`` hints consumed by the fleet wrappers (meta_parallel).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import numpy as np
+
+from .. import ops
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer, LayerList
+from ..nn.layers.common import Dropout, Embedding, LayerNorm, Linear
+from ..nn.param_attr import ParamAttr
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    intermediate_size: Optional[int] = None
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.0
+    attention_probs_dropout_prob: float = 0.0
+    initializer_range: float = 0.02
+    layer_norm_epsilon: float = 1e-5
+    tie_word_embeddings: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @staticmethod
+    def gpt3_345m() -> "GPTConfig":
+        return GPTConfig(hidden_size=1024, num_hidden_layers=24,
+                         num_attention_heads=16)
+
+    @staticmethod
+    def tiny() -> "GPTConfig":
+        return GPTConfig(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                         num_attention_heads=4, max_position_embeddings=128)
+
+    def num_params(self) -> int:
+        h, l, v = self.hidden_size, self.num_hidden_layers, self.vocab_size
+        per_layer = 4 * h * h + 2 * h * self.intermediate_size  # attn + mlp
+        per_layer += 4 * h + 2 * self.intermediate_size         # biases
+        per_layer += 4 * h                                       # 2x LN
+        emb = v * h + self.max_position_embeddings * h
+        return l * per_layer + emb + 2 * h
+
+
+class GPTSelfAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        init = I.Normal(0.0, config.initializer_range)
+        self.qkv_proj = Linear(h, 3 * h, weight_attr=ParamAttr(initializer=init))
+        self.out_proj = Linear(
+            h, h, weight_attr=ParamAttr(
+                initializer=I.Normal(0.0, config.initializer_range /
+                                     math.sqrt(2 * config.num_hidden_layers))))
+        self.attn_drop_p = config.attention_probs_dropout_prob
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = ops.unbind(qkv, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
+            dropout_p=self.attn_drop_p if self.training else 0.0,
+            training=self.training)
+        return self.out_proj(out.reshape([b, s, h]))
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        init = I.Normal(0.0, config.initializer_range)
+        self.fc_in = Linear(config.hidden_size, config.intermediate_size,
+                            weight_attr=ParamAttr(initializer=init))
+        self.fc_out = Linear(
+            config.intermediate_size, config.hidden_size,
+            weight_attr=ParamAttr(initializer=I.Normal(
+                0.0, config.initializer_range / math.sqrt(2 * config.num_hidden_layers))))
+
+    def forward(self, x):
+        return self.fc_out(F.gelu(self.fc_in(x), approximate=True))
+
+
+class GPTBlock(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.attn = GPTSelfAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+        self.drop = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, attn_mask=None):
+        x = x + self.drop(self.attn(self.ln_1(x), attn_mask))
+        x = x + self.drop(self.mlp(self.ln_2(x)))
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        init = I.Normal(0.0, config.initializer_range)
+        self.wte = Embedding(config.vocab_size, config.hidden_size,
+                             weight_attr=ParamAttr(initializer=init))
+        self.wpe = Embedding(config.max_position_embeddings, config.hidden_size,
+                             weight_attr=ParamAttr(initializer=init))
+        self.drop = Dropout(config.hidden_dropout_prob)
+        self.h = LayerList([GPTBlock(config) for _ in range(config.num_hidden_layers)])
+        self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, attn_mask=None, position_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = ops.arange(s, dtype="int64").unsqueeze(0)
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = self.drop(x)
+        for block in self.h:
+            x = block(x, attn_mask)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None  # logits via wte.T
+        else:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False)
+
+    def logits(self, hidden):
+        if self.lm_head is None:
+            return ops.matmul(hidden, self.gpt.wte.weight, transpose_y=True)
+        return self.lm_head(hidden)
+
+    def forward(self, input_ids, labels=None, attn_mask=None, position_ids=None):
+        hidden = self.gpt(input_ids, attn_mask, position_ids)
+        logits = self.logits(hidden)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]),
+            labels.reshape([-1]), reduction="mean")
+        return loss

@@ -1,0 +1,171 @@
+"""Llama exemplar (the north-star model: Llama-2 7B / 70B).
+
+RMSNorm + rotary + GQA + SwiGLU, built from paddle_tpu.nn layers. Attention
+and norms dispatch to the Pallas kernels via the incubate fused surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+from .. import ops
+from ..incubate.nn import functional as FF
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer, LayerList
+from ..nn.layers.common import Embedding, Linear, RMSNorm
+from ..nn.param_attr import ParamAttr
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None
+    intermediate_size: int = 11008
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+
+    def __post_init__(self):
+        if self.num_key_value_heads is None:
+            self.num_key_value_heads = self.num_attention_heads
+
+    @staticmethod
+    def llama2_7b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def llama2_70b() -> "LlamaConfig":
+        return LlamaConfig(hidden_size=8192, num_hidden_layers=80,
+                           num_attention_heads=64, num_key_value_heads=8,
+                           intermediate_size=28672)
+
+    @staticmethod
+    def tiny() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           intermediate_size=128, max_position_embeddings=128)
+
+    def num_params(self) -> int:
+        h, l = self.hidden_size, self.num_hidden_layers
+        kv = self.num_key_value_heads * (h // self.num_attention_heads)
+        per_layer = h * h + 2 * h * kv + h * h          # q, k, v, o
+        per_layer += 3 * h * self.intermediate_size      # gate, up, down
+        per_layer += 2 * h                               # norms
+        emb = self.vocab_size * h
+        head = 0 if self.tie_word_embeddings else self.vocab_size * h
+        return l * per_layer + emb + head + h
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = h // self.num_heads
+        self.rope_theta = config.rope_theta
+        init = ParamAttr(initializer=I.Normal(0.0, config.initializer_range))
+        self.q_proj = Linear(h, self.num_heads * self.head_dim, weight_attr=init, bias_attr=False)
+        self.k_proj = Linear(h, self.num_kv_heads * self.head_dim, weight_attr=init, bias_attr=False)
+        self.v_proj = Linear(h, self.num_kv_heads * self.head_dim, weight_attr=init, bias_attr=False)
+        self.o_proj = Linear(self.num_heads * self.head_dim, h, weight_attr=init, bias_attr=False)
+
+    def forward(self, x, attn_mask=None, position_ids=None):
+        b, s, _ = x.shape
+        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        q, k, _ = FF.fused_rotary_position_embedding(
+            q, k, None, position_ids=position_ids, rotary_emb_base=self.rope_theta)
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            k = ops.repeat_interleave(k, rep, axis=2)
+            v = ops.repeat_interleave(v, rep, axis=2)
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             is_causal=attn_mask is None,
+                                             training=self.training)
+        return self.o_proj(out.reshape([b, s, self.num_heads * self.head_dim]))
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        init = ParamAttr(initializer=I.Normal(0.0, config.initializer_range))
+        self.gate_proj = Linear(config.hidden_size, config.intermediate_size,
+                                weight_attr=init, bias_attr=False)
+        self.up_proj = Linear(config.hidden_size, config.intermediate_size,
+                              weight_attr=init, bias_attr=False)
+        self.down_proj = Linear(config.intermediate_size, config.hidden_size,
+                                weight_attr=init, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, attn_mask=None, position_ids=None):
+        x = x + self.self_attn(self.input_layernorm(x), attn_mask, position_ids)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = Embedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=ParamAttr(initializer=I.Normal(0.0, config.initializer_range)))
+        self.layers = LayerList([LlamaDecoderLayer(config)
+                                 for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None, position_ids=None):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, attn_mask, position_ids)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  weight_attr=ParamAttr(
+                                      initializer=I.Normal(0.0, config.initializer_range)),
+                                  bias_attr=False)
+
+    def logits(self, hidden):
+        if self.lm_head is None:
+            return ops.matmul(hidden, self.llama.embed_tokens.weight, transpose_y=True)
+        return self.lm_head(hidden)
+
+    def forward(self, input_ids, labels=None, attn_mask=None, position_ids=None):
+        hidden = self.llama(input_ids, attn_mask, position_ids)
+        logits = self.logits(hidden)
+        if labels is None:
+            return logits
+        return F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]).astype("float32"),
+            labels.reshape([-1]), reduction="mean")

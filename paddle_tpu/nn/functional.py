@@ -543,13 +543,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     reference: python/paddle/nn/functional/flash_attention.py).
     Dispatches to the Pallas flash-attention kernel on TPU when enabled."""
     from .. import flags
-    if flags.get_flag("use_pallas") and attn_mask is None and dropout_p == 0.0:
+    if (flags.get_flag("use_pallas") and attn_mask is None and dropout_p == 0.0
+            and jax.default_backend() == "tpu"):
         try:
             from ..kernels.flash_attention import flash_attention_bshd
             return apply_op("flash_attention",
                             lambda q, k, v: flash_attention_bshd(q, k, v, causal=is_causal),
                             query, key, value)
-        except Exception:
+        except (ImportError, NotImplementedError):
             pass
 
     mask_val = _val(attn_mask) if attn_mask is not None else None

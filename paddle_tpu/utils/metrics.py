@@ -1,0 +1,116 @@
+"""Training throughput metrics — tokens/sec/chip and MFU.
+
+The north-star metric (BASELINE.md): first-class, not an afterthought.
+MFU = achieved_flops / peak_flops with achieved ≈ 6N per token (dense
+decoder fwd+bwd) plus the attention term 12·L·h·s per token.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax
+
+# peak bf16 FLOP/s per chip, from public TPU specs
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "cpu": 1e11,  # nominal, so MFU numbers exist in CPU sims
+}
+
+
+def detect_peak_flops() -> float:
+    try:
+        d = jax.devices()[0]
+        kind = (getattr(d, "device_kind", "") or "").lower().replace(" ", "")
+        for k, v in PEAK_FLOPS.items():
+            if k in kind:
+                return v
+        if d.platform.lower() in ("tpu", "axon"):
+            return PEAK_FLOPS["v5e"]
+    except Exception:
+        pass
+    return PEAK_FLOPS["cpu"]
+
+
+def train_flops_per_token(n_params: int, n_layers: int = 0, hidden: int = 0,
+                          seq_len: int = 0) -> float:
+    """6N + attention correction 12·L·h·s (fwd+bwd, dense decoder)."""
+    flops = 6.0 * n_params
+    if n_layers and hidden and seq_len:
+        flops += 12.0 * n_layers * hidden * seq_len
+    return flops
+
+
+@dataclass
+class SpeedMeter:
+    """Step-time tracker producing tokens/sec/chip + MFU.
+
+    Call ``start()`` then ``step(n_tokens)`` after each synchronized train
+    step. Warmup steps are excluded from the medians (compile time).
+    """
+
+    n_params: int
+    n_layers: int = 0
+    hidden: int = 0
+    seq_len: int = 0
+    n_chips: int = 1
+    warmup: int = 2
+    peak_flops: float = 0.0
+    times: List[float] = field(default_factory=list)
+    tokens: List[int] = field(default_factory=list)
+    _t0: Optional[float] = None
+
+    def __post_init__(self):
+        if not self.peak_flops:
+            self.peak_flops = detect_peak_flops()
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def step(self, n_tokens: int):
+        now = time.perf_counter()
+        if self._t0 is not None:
+            self.times.append(now - self._t0)
+            self.tokens.append(n_tokens)
+        self._t0 = now
+
+    def _steady(self):
+        return self.times[self.warmup:] if len(self.times) > self.warmup else self.times
+
+    def step_time(self) -> float:
+        import numpy as np
+        s = self._steady()
+        return float(np.median(s)) if s else float("nan")
+
+    def tokens_per_sec_per_chip(self) -> float:
+        s = self._steady()
+        tk = self.tokens[self.warmup:] if len(self.tokens) > self.warmup else self.tokens
+        if not s:
+            return 0.0
+        return (sum(tk) / sum(s)) / max(self.n_chips, 1)
+
+    def mfu(self) -> float:
+        tps = self.tokens_per_sec_per_chip()
+        fpt = train_flops_per_token(self.n_params, self.n_layers, self.hidden,
+                                    self.seq_len)
+        return tps * fpt / self.peak_flops
+
+    def summary(self) -> dict:
+        return {
+            "median_step_time_s": self.step_time(),
+            "tokens_per_sec_per_chip": self.tokens_per_sec_per_chip(),
+            "mfu": self.mfu(),
+            "n_chips": self.n_chips,
+            "n_params": self.n_params,
+            "peak_flops": self.peak_flops,
+        }
+
+    def log_line(self) -> str:
+        return json.dumps(self.summary())
