@@ -1,3 +1,14 @@
 """paddle_tpu.distributed (reference: python/paddle/distributed/)."""
 
-from . import fleet  # noqa: F401
+from . import communication, fleet  # noqa: F401
+from .communication import (  # noqa: F401
+    Group, P2POp, ReduceOp, Task, all_gather, all_gather_object, all_reduce,
+    alltoall, alltoall_single, barrier, batch_isend_irecv, broadcast,
+    destroy_process_group, get_group, irecv, isend, new_group, recv, reduce,
+    reduce_scatter, scatter, scatter_object_list, send, wait,
+)
+from .communication import in_jit, stream  # noqa: F401
+from .parallel import (  # noqa: F401
+    ParallelEnv, device_count, get_rank, get_world_size, init_parallel_env,
+    is_initialized,
+)

@@ -1,0 +1,14 @@
+"""paddle_tpu.distributed.communication
+(reference: python/paddle/distributed/communication/)."""
+
+from . import in_jit, stream  # noqa: F401
+from .collectives import (  # noqa: F401
+    Task, all_gather, all_gather_object, all_reduce, alltoall,
+    alltoall_single, barrier, broadcast, reduce, reduce_scatter, scatter,
+    scatter_object_list, wait,
+)
+from .group import (  # noqa: F401
+    Group, ReduceOp, destroy_process_group, get_group, is_initialized,
+    new_group,
+)
+from .p2p import P2POp, batch_isend_irecv, irecv, isend, recv, send  # noqa: F401

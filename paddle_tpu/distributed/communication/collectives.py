@@ -1,0 +1,277 @@
+"""Eager collective API.
+
+Reference: python/paddle/distributed/communication/{all_reduce,all_gather,
+reduce,broadcast,scatter,reduce_scatter,all_to_all,batch_isend_irecv}.py over
+ProcessGroupNCCL (paddle/fluid/distributed/collective/).
+
+Single-controller convention (documented here once, used everywhere): the
+reference runs one process per device, each holding its own per-rank tensor.
+Under JAX's single-controller runtime one process drives all devices, so a
+"per-rank tensor" is represented **stacked**: leading dimension of size
+``group.nranks``, slice ``i`` being rank ``i``'s value. Collectives keep that
+layout (an all-reduced result appears as ``nranks`` identical slices). The
+result is placed sharded over the group's mesh so slices genuinely live on
+their owning device.
+
+This facade is the debuggable path; hot loops use the in-jit primitives
+(``paddle_tpu.distributed.communication.in_jit``) folded into the compiled
+step function — on TPU an eager per-op collective round-trip is exactly what
+XLA exists to avoid.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from .group import Group, ReduceOp, _get_global_group
+
+
+class Task:
+    """Stand-in for the reference's async ProcessGroup::Task handle. XLA
+    dispatch is async by nature; ``wait`` blocks on the result buffer."""
+
+    def __init__(self, value=None):
+        self._value = value
+
+    def wait(self) -> bool:
+        if self._value is not None:
+            jax.block_until_ready(self._value)
+        return True
+
+    def is_completed(self) -> bool:
+        return True
+
+    def synchronize(self):
+        self.wait()
+
+
+def _val(t):
+    return t._value if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _check_rank_dim(x, group: Group, api: str):
+    if x.ndim == 0 or x.shape[0] != group.nranks:
+        raise ValueError(
+            f"{api}: expected a stacked per-rank tensor with leading dim "
+            f"{group.nranks} (= group size); got shape {tuple(x.shape)}. "
+            "Single-controller collectives represent each rank's tensor as "
+            "slice i of dim 0 — see collectives.py docstring.")
+
+
+def _distribute(x, group: Group):
+    """Place a stacked result sharded over the group mesh (dim 0 = rank)."""
+    try:
+        return jax.device_put(x, NamedSharding(group.mesh, P(group.axis_name)))
+    except Exception:
+        return x  # e.g. single real chip: keep undistributed
+
+
+def _reduce_stacked(x, op: int):
+    if op == ReduceOp.SUM:
+        return x.sum(axis=0)
+    if op == ReduceOp.MAX:
+        return x.max(axis=0)
+    if op == ReduceOp.MIN:
+        return x.min(axis=0)
+    if op == ReduceOp.PROD:
+        return x.prod(axis=0)
+    if op == ReduceOp.AVG:
+        return x.mean(axis=0)
+    raise ValueError(f"unknown ReduceOp {op}")
+
+
+def all_reduce(tensor, op: int = ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op: bool = True) -> Task:
+    """Every rank ends with the reduction of all ranks' values."""
+    group = _get_global_group(group)
+    x = _val(tensor)
+    _check_rank_dim(x, group, "all_reduce")
+    red = _reduce_stacked(x, op)
+    out = jnp.broadcast_to(red[None], x.shape)
+    out = _distribute(out, group)
+    if isinstance(tensor, Tensor):
+        tensor._inplace(out)
+    return Task(out)
+
+
+def reduce(tensor, dst: int = 0, op: int = ReduceOp.SUM,
+           group: Optional[Group] = None, sync_op: bool = True) -> Task:
+    """Only ``dst`` (a global rank) receives the reduction; other ranks keep
+    their input (the reference leaves their buffers untouched)."""
+    group = _get_global_group(group)
+    x = _val(tensor)
+    _check_rank_dim(x, group, "reduce")
+    dst_local = group.get_group_rank(dst)
+    if dst_local < 0:
+        raise ValueError(f"dst rank {dst} not in group {group.ranks}")
+    red = _reduce_stacked(x, op)
+    out = x.at[dst_local].set(red.astype(x.dtype))
+    out = _distribute(out, group)
+    if isinstance(tensor, Tensor):
+        tensor._inplace(out)
+    return Task(out)
+
+
+def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
+              sync_op: bool = True) -> Task:
+    group = _get_global_group(group)
+    x = _val(tensor)
+    _check_rank_dim(x, group, "broadcast")
+    src_local = group.get_group_rank(src)
+    if src_local < 0:
+        raise ValueError(f"src rank {src} not in group {group.ranks}")
+    out = jnp.broadcast_to(x[src_local][None], x.shape)
+    out = _distribute(out, group)
+    if isinstance(tensor, Tensor):
+        tensor._inplace(out)
+    return Task(out)
+
+
+def all_gather(tensor_list: List, tensor, group: Optional[Group] = None,
+               sync_op: bool = True) -> Task:
+    """Each rank contributes its slice; everyone receives every slice.
+    ``tensor_list`` is filled with ``nranks`` stacked tensors — element ``j``
+    holds rank ``j``'s contribution replicated across the rank dim."""
+    group = _get_global_group(group)
+    x = _val(tensor)
+    _check_rank_dim(x, group, "all_gather")
+    n = group.nranks
+    del tensor_list[:]
+    for j in range(n):
+        rep = jnp.broadcast_to(x[j][None], x.shape)
+        tensor_list.append(Tensor(_distribute(rep, group), stop_gradient=True))
+    return Task(x)
+
+
+def scatter(tensor, tensor_list: Optional[List] = None, src: int = 0,
+            group: Optional[Group] = None, sync_op: bool = True) -> Task:
+    """Rank ``src`` scatters ``tensor_list``; rank ``i`` receives element
+    ``i``. Stacked view: output slice i = tensor_list[i] (rank dim of each
+    list element indexed at src, so plain tensors also work)."""
+    group = _get_global_group(group)
+    if tensor_list is None:
+        raise ValueError("scatter requires tensor_list on the src rank")
+    n = group.nranks
+    if len(tensor_list) != n:
+        raise ValueError(f"scatter: len(tensor_list)={len(tensor_list)} != group size {n}")
+    src_local = group.get_group_rank(src)
+    if src_local < 0:
+        raise ValueError(f"src rank {src} not in group {group.ranks}")
+    chunks = []
+    for i, t in enumerate(tensor_list):
+        v = _val(t)
+        if v.ndim > 0 and v.shape[0] == n and isinstance(t, Tensor):
+            # stacked per-rank element: the value sent is src's copy
+            v = v[src_local]
+        chunks.append(v)
+    out = jnp.stack(chunks)
+    out = _distribute(out, group)
+    if isinstance(tensor, Tensor):
+        tensor._inplace(out)
+    return Task(out)
+
+
+def reduce_scatter(tensor, tensor_list: List, op: int = ReduceOp.SUM,
+                   group: Optional[Group] = None, sync_op: bool = True) -> Task:
+    """Element ``i`` of every rank's list is reduced onto rank ``i``.
+    ``tensor_list``: ``nranks`` stacked tensors (element j = what each rank
+    sends toward rank j). Output slice i = reduce over ranks of element i."""
+    group = _get_global_group(group)
+    n = group.nranks
+    if len(tensor_list) != n:
+        raise ValueError(f"reduce_scatter: len(tensor_list)={len(tensor_list)} != {n}")
+    outs = []
+    for j in range(n):
+        xj = _val(tensor_list[j])
+        _check_rank_dim(xj, group, "reduce_scatter")
+        outs.append(_reduce_stacked(xj, op))
+    out = jnp.stack(outs)
+    out = _distribute(out, group)
+    if isinstance(tensor, Tensor):
+        tensor._inplace(out)
+    return Task(out)
+
+
+def alltoall(out_tensor_list: List, in_tensor_list: List,
+             group: Optional[Group] = None, sync_op: bool = True) -> Task:
+    """Rank r sends in_list[j] to rank j. Stacked view: S[j][r] = rank r's
+    element j; output O[a][b] = S[b][a] — a transpose of (list idx, rank)."""
+    group = _get_global_group(group)
+    n = group.nranks
+    if len(in_tensor_list) != n:
+        raise ValueError(f"alltoall: len(in_tensor_list)={len(in_tensor_list)} != {n}")
+    stacked = []
+    for j in range(n):
+        xj = _val(in_tensor_list[j])
+        _check_rank_dim(xj, group, "alltoall")
+        stacked.append(xj)
+    S = jnp.stack(stacked)                # [list, rank, ...]
+    O = jnp.swapaxes(S, 0, 1)             # [rank→list, list→rank, ...]
+    del out_tensor_list[:]
+    for a in range(n):
+        out_tensor_list.append(Tensor(_distribute(O[a], group), stop_gradient=True))
+    return Task(O)
+
+
+def alltoall_single(out_tensor, in_tensor,
+                    in_split_sizes=None, out_split_sizes=None,
+                    group: Optional[Group] = None, sync_op: bool = True) -> Task:
+    """Single-tensor all-to-all: each rank's row [m, ...] is split into
+    ``nranks`` chunks along dim 1 of the stacked tensor; chunk j goes to rank
+    j. Equal splits only (the XLA all_to_all is static-shape)."""
+    group = _get_global_group(group)
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            "uneven alltoall_single splits are not supported on TPU: XLA "
+            "all_to_all is static-shape; pad to equal chunks instead")
+    x = _val(in_tensor)
+    _check_rank_dim(x, group, "alltoall_single")
+    if x.ndim < 2:
+        raise ValueError(
+            "alltoall_single: stacked input must be at least 2-D "
+            "([nranks, per_rank_len, ...])")
+    n = group.nranks
+    if x.shape[1] % n != 0:
+        raise ValueError(f"alltoall_single: dim1 {x.shape[1]} not divisible by {n}")
+    m = x.shape[1] // n
+    # [n_rank, n_chunk, m, ...] -> swap rank/chunk -> [n_rank, n_chunk*m, ...]
+    r = x.reshape(n, n, m, *x.shape[2:])
+    out = jnp.swapaxes(r, 0, 1).reshape(x.shape)
+    out = _distribute(out, group)
+    if isinstance(out_tensor, Tensor):
+        out_tensor._inplace(out)
+    return Task(out)
+
+
+def barrier(group: Optional[Group] = None) -> None:
+    group = _get_global_group(group)
+    jax.block_until_ready(jnp.zeros(()))
+
+
+def wait(tensor, group: Optional[Group] = None, use_calc_stream: bool = True) -> None:
+    jax.block_until_ready(_val(tensor))
+
+
+# ---------------------------------------------------------------- py objects
+def all_gather_object(object_list: List, obj, group: Optional[Group] = None) -> None:
+    """Single-controller: one process holds the object; every rank's copy is
+    identical (reference: pickle + uneven all_gather)."""
+    group = _get_global_group(group)
+    del object_list[:]
+    object_list.extend([obj] * group.nranks)
+
+
+def scatter_object_list(out_object_list: List, in_object_list: Optional[List] = None,
+                        src: int = 0, group: Optional[Group] = None) -> None:
+    group = _get_global_group(group)
+    if in_object_list is None:
+        raise ValueError("scatter_object_list requires in_object_list on src")
+    if len(in_object_list) != group.nranks:
+        raise ValueError("in_object_list must have group-size elements")
+    del out_object_list[:]
+    out_object_list.extend(in_object_list)

@@ -1,0 +1,151 @@
+"""Communication groups.
+
+Reference: python/paddle/distributed/communication/group.py (``Group``) and
+paddle/fluid/distributed/collective/process_group.h (``ProcessGroup``).
+
+On TPU a "process group" is a set of devices that collectives run over. Under
+JAX's single-controller runtime every group is realised as a 1-D
+``jax.sharding.Mesh`` over the member devices; collectives over the group are
+XLA collectives along that mesh's single axis (``axis_name = "g"``). Groups
+built from a hybrid topology axis (dp/mp/pp/...) additionally know their axis
+name on the global mesh so in-jit code can address them directly.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+class ReduceOp:
+    """Reduction ops (reference: paddle.distributed.ReduceOp)."""
+
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communication group: an ordered set of global ranks + a 1-D mesh.
+
+    ``rank`` is the calling process's rank within the group (always the
+    single-controller view here: the process sees every member, so ``rank``
+    is 0 unless the group excludes this process, then -1 — matching the
+    reference's convention for non-member ranks).
+    """
+
+    def __init__(self, gid: int, ranks: Sequence[int], axis_name: str = "g",
+                 global_mesh: Optional[Mesh] = None,
+                 global_axis: Optional[str] = None):
+        self.id = gid
+        self.ranks = list(int(r) for r in ranks)
+        self.nranks = len(self.ranks)
+        self.axis_name = axis_name
+        self.global_mesh = global_mesh  # full hybrid mesh, if axis-derived
+        self.global_axis = global_axis  # axis on the global mesh (dp/mp/...)
+        self._mesh: Optional[Mesh] = None
+
+    # ------------------------------------------------------------------ mesh
+    @property
+    def mesh(self) -> Mesh:
+        """Lazy 1-D mesh over this group's devices (logical rank == device
+        index in the single-controller simulation)."""
+        if self._mesh is None:
+            devices = jax.devices()
+            members = [devices[r % len(devices)] for r in self.ranks]
+            self._mesh = Mesh(np.array(members), (self.axis_name,))
+        return self._mesh
+
+    # ------------------------------------------------------------- reference
+    @property
+    def rank(self) -> int:
+        me = jax.process_index()
+        return self.get_group_rank(me) if self.is_member() else -1
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def name(self) -> str:
+        return f"_default_pg_{self.id}"
+
+    @property
+    def process_group(self):
+        return self
+
+    @property
+    def backend(self) -> str:
+        return "xla"
+
+    def is_member(self) -> bool:
+        # single-controller: the process drives every member device
+        return True
+
+    def get_group_rank(self, global_rank: int) -> int:
+        return self.ranks.index(global_rank) if global_rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks}, backend=xla)"
+
+
+_GROUP_MAP: Dict[int, Group] = {}
+_next_gid = [1]
+
+
+def _world_size_hint() -> int:
+    return len(jax.devices())
+
+
+def _get_or_create_world() -> Group:
+    if 0 not in _GROUP_MAP:
+        _GROUP_MAP[0] = Group(0, list(range(_world_size_hint())))
+    return _GROUP_MAP[0]
+
+
+def _get_global_group(group: Optional[Group] = None) -> Group:
+    return group if group is not None else _get_or_create_world()
+
+
+def new_group(ranks: Optional[Sequence[int]] = None, backend: str = "xla",
+              timeout: datetime.timedelta = datetime.timedelta(minutes=30)) -> Group:
+    """paddle.distributed.new_group: create a group over ``ranks``
+    (default: all). Each group owns a 1-D device mesh."""
+    if ranks is None:
+        ranks = list(range(_world_size_hint()))
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    g = Group(gid, sorted(ranks))
+    _GROUP_MAP[gid] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return _get_or_create_world()
+    if gid not in _GROUP_MAP:
+        raise ValueError(f"group {gid} does not exist")
+    return _GROUP_MAP[gid]
+
+
+def destroy_process_group(group: Optional[Group] = None) -> None:
+    if group is None:
+        _GROUP_MAP.clear()
+        _next_gid[0] = 1
+        from . import p2p
+        p2p._MAILBOX.clear()
+        from .. import parallel
+        parallel._initialized = False
+    else:
+        _GROUP_MAP.pop(group.id, None)
+
+
+def is_initialized() -> bool:
+    return 0 in _GROUP_MAP

@@ -1,0 +1,105 @@
+"""Collectives for use INSIDE jitted/shard_mapped programs — the hot path.
+
+Reference: the reference's hot-loop collectives are C++ ProcessGroupNCCL
+calls issued from layer code (paddle/fluid/distributed/collective/). On TPU
+the idiomatic equivalent is ``jax.lax`` collectives traced into the step
+function so XLA schedules them on ICI and overlaps them with compute. These
+wrappers exist so framework code (mp_ops, pipeline schedule, MoE dispatch,
+ring attention) speaks the reference's vocabulary while lowering to
+``psum``/``all_gather``/``psum_scatter``/``all_to_all``/``ppermute``.
+
+Every function takes an ``axis_name`` — a mesh axis (e.g. "mp") or a Group
+whose ``global_axis``/``axis_name`` is used.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .group import Group, ReduceOp
+
+
+def _axis(axis_or_group) -> str:
+    if isinstance(axis_or_group, Group):
+        return axis_or_group.global_axis or axis_or_group.axis_name
+    return axis_or_group
+
+
+def axis_rank(axis_or_group) -> jax.Array:
+    """This shard's index along the axis (reference: group rank)."""
+    return lax.axis_index(_axis(axis_or_group))
+
+
+def axis_size(axis_or_group) -> int:
+    return lax.axis_size(_axis(axis_or_group))
+
+
+def all_reduce(x, op: int = ReduceOp.SUM, axis_name="mp"):
+    a = _axis(axis_name)
+    if op == ReduceOp.SUM:
+        return lax.psum(x, a)
+    if op == ReduceOp.MAX:
+        return lax.pmax(x, a)
+    if op == ReduceOp.MIN:
+        return lax.pmin(x, a)
+    if op == ReduceOp.AVG:
+        return lax.pmean(x, a)
+    if op == ReduceOp.PROD:
+        # no pprod primitive: exp(psum(log|x|)) carries magnitude; sign and
+        # zero handled separately so negative/zero inputs stay exact
+        mag = jnp.exp(lax.psum(jnp.log(jnp.where(x == 0, 1.0, jnp.abs(x))), a))
+        n_neg = lax.psum((x < 0).astype(jnp.int32), a)
+        sign = jnp.where(n_neg % 2 == 0, 1.0, -1.0).astype(x.dtype)
+        any_zero = lax.pmax((x == 0).astype(jnp.int32), a)
+        return jnp.where(any_zero > 0, jnp.zeros_like(mag), mag * sign)
+    raise ValueError(f"unknown ReduceOp {op}")
+
+
+def all_gather(x, axis_name="mp", axis: int = 0, tiled: bool = True):
+    """Gather shards along ``axis`` (tiled: concatenate, matching the
+    reference's all_gather-into-one-tensor)."""
+    return lax.all_gather(x, _axis(axis_name), axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name="mp", axis: int = 0):
+    """Sum across the axis, keep this shard's slice of dim ``axis``."""
+    return lax.psum_scatter(x, _axis(axis_name), scatter_dimension=axis, tiled=True)
+
+
+def all_to_all(x, axis_name="sep", split_axis: int = 0, concat_axis: int = 0):
+    return lax.all_to_all(x, _axis(axis_name), split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ppermute(x, axis_name, perm: Sequence[Tuple[int, int]]):
+    """Point-to-point ring/permutation transfer (pipeline p2p, ring attn)."""
+    return lax.ppermute(x, _axis(axis_name), perm=list(perm))
+
+
+def shift_right(x, axis_name):
+    """Send to rank+1 (wrapping): the ring-attention / PP building block."""
+    n = axis_size(axis_name)
+    return ppermute(x, axis_name, [(i, (i + 1) % n) for i in range(n)])
+
+
+def shift_left(x, axis_name):
+    n = axis_size(axis_name)
+    return ppermute(x, axis_name, [(i, (i - 1) % n) for i in range(n)])
+
+
+def broadcast(x, src: int, axis_name):
+    """Every shard receives shard ``src``'s value (no native pbroadcast:
+    mask + psum, which XLA lowers to an efficient collective)."""
+    a = _axis(axis_name)
+    idx = lax.axis_index(a)
+    mask = (idx == src).astype(x.dtype)
+    return lax.psum(x * mask, a)
+
+
+def pgather(x, axis_name, axis: int = 0):
+    """all_gather with a fresh leading axis (untiled)."""
+    return lax.all_gather(x, _axis(axis_name), axis=axis, tiled=False)

@@ -1,0 +1,84 @@
+"""Point-to-point eager API: send/recv/isend/irecv/batch_isend_irecv.
+
+Reference: python/paddle/distributed/communication/{send,recv,
+batch_isend_irecv}.py over NCCL send_v2/recv_v2. Eager p2p between two ranks
+of a single-controller runtime is a mailbox: ``send`` deposits the value
+keyed by (src, dst, group); ``recv`` collects it. The performant path —
+pipeline-stage transfer — never uses this: it is ``lax.ppermute`` inside the
+jitted 1F1B schedule (see meta_parallel/pipeline_parallel.py).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import List, Optional
+
+import jax
+
+from ...core.tensor import Tensor
+from .collectives import Task, _val
+from .group import Group, _get_global_group
+
+# (src_rank, dst_rank, group_id) -> FIFO of values
+_MAILBOX = collections.defaultdict(collections.deque)
+
+
+def send(tensor, dst: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True, src: int = 0) -> Task:
+    """Deposit ``tensor`` for ``dst``. ``src`` identifies the logical sender
+    (the reference infers it from the calling process; single-controller
+    callers simulating a rank pass it explicitly — defaults to 0)."""
+    group = _get_global_group(group)
+    _MAILBOX[(src, dst, group.id)].append(_val(tensor))
+    return Task()
+
+
+def recv(tensor, src: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True, dst: int = 0) -> Task:
+    group = _get_global_group(group)
+    box = _MAILBOX[(src, dst, group.id)]
+    if not box:
+        raise RuntimeError(
+            f"recv: no message pending from rank {src} to rank {dst} "
+            f"in group {group.id} — send must be issued first in "
+            "single-controller simulation")
+    val = box.popleft()
+    if isinstance(tensor, Tensor):
+        tensor._inplace(val)
+    return Task(val)
+
+
+def isend(tensor, dst: int = 0, group: Optional[Group] = None, src: int = 0) -> Task:
+    return send(tensor, dst=dst, group=group, sync_op=False, src=src)
+
+
+def irecv(tensor, src: int = 0, group: Optional[Group] = None, dst: int = 0) -> Task:
+    return recv(tensor, src=src, group=group, sync_op=False, dst=dst)
+
+
+class P2POp:
+    """One op in a batched p2p exchange (reference: paddle.distributed.P2POp)."""
+
+    def __init__(self, op, tensor, peer: int, group: Optional[Group] = None,
+                 src: int = 0, dst: int = 0):
+        if op not in (send, recv, isend, irecv):
+            raise ValueError("P2POp op must be paddle.distributed.{send,recv,isend,irecv}")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+        self.src = src
+        self.dst = dst
+
+
+def batch_isend_irecv(p2p_op_list: List[P2POp]) -> List[Task]:
+    """Execute a batch: all sends first (filling mailboxes), then recvs —
+    mirroring NCCL group semantics where ordering inside the batch is free."""
+    tasks: List[Task] = []
+    sends = [o for o in p2p_op_list if o.op in (send, isend)]
+    recvs = [o for o in p2p_op_list if o.op in (recv, irecv)]
+    for o in sends:
+        tasks.append(send(o.tensor, dst=o.peer, group=o.group, src=o.src))
+    for o in recvs:
+        tasks.append(recv(o.tensor, src=o.peer, group=o.group, dst=o.dst))
+    return tasks

@@ -1,0 +1,50 @@
+"""paddle.distributed.communication.stream facade.
+
+Reference: python/paddle/distributed/communication/stream/ — collective
+variants taking ``use_calc_stream`` to skip the comm-stream hop. XLA owns
+stream scheduling on TPU, so these are the same collectives; the argument is
+accepted and ignored.
+"""
+
+from __future__ import annotations
+
+from .. import collectives as _c
+from ..group import ReduceOp  # noqa: F401
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, **kw):
+    return _c.all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def all_gather(tensor_or_list, tensor, group=None, sync_op=True, **kw):
+    return _c.all_gather(tensor_or_list, tensor, group=group, sync_op=sync_op)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True, **kw):
+    return _c.reduce(tensor, dst=dst, op=op, group=group, sync_op=sync_op)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True, **kw):
+    return _c.broadcast(tensor, src=src, group=group, sync_op=sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True, **kw):
+    return _c.scatter(tensor, tensor_list=tensor_list, src=src, group=group,
+                      sync_op=sync_op)
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True, **kw):
+    return _c.reduce_scatter(tensor, tensor_list, op=op, group=group,
+                             sync_op=sync_op)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True, **kw):
+    return _c.alltoall(out_tensor_list, in_tensor_list, group=group,
+                       sync_op=sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True, **kw):
+    return _c.alltoall_single(out_tensor, in_tensor, in_split_sizes,
+                              out_split_sizes, group=group, sync_op=sync_op)

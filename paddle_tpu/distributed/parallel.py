@@ -57,6 +57,8 @@ def init_parallel_env():
     global _initialized
     if _initialized:
         return ParallelEnv()
+    from .communication.group import _get_or_create_world
+    _get_or_create_world()
     n_procs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     if n_procs > 1 and jax.process_count() == 1:
         coordinator = os.environ.get("PADDLE_MASTER",
