@@ -297,7 +297,8 @@ class Parameter(Tensor):
     """Trainable tensor (paddle's EagerParamBase): stop_gradient=False,
     persistable, optionally ``trainable`` togglable."""
 
-    __slots__ = ("optimize_attr", "is_distributed", "split_axis")
+    __slots__ = ("optimize_attr", "is_distributed", "split_axis",
+                 "sequence_parallel")
 
     def __init__(self, value, dtype=None, name=None, trainable: bool = True):
         super().__init__(

@@ -5,9 +5,13 @@ Grows through the build: topology + RNG now; fleet.init/distributed_model/
 meta_parallel wrappers as milestones land.
 """
 
-from . import base_topology, random  # noqa: F401
+from . import base_topology, layers, meta_parallel, random, utils  # noqa: F401
 from .base_topology import (  # noqa: F401
     CommGroup, CommunicateTopology, HybridCommunicateGroup,
     create_hybrid_communicate_group, get_hybrid_communicate_group,
+)
+from .meta_parallel import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
 )
 from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed  # noqa: F401

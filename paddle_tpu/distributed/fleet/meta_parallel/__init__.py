@@ -1,0 +1,5 @@
+"""fleet.meta_parallel (reference: python/paddle/distributed/fleet/meta_parallel/)."""
+from .parallel_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)

@@ -1,0 +1,146 @@
+"""Megatron sequence parallelism (SP) utilities.
+
+Reference: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
+(``ScatterOp``/``GatherOp``/``AllGatherOp``/``ReduceScatterOp`` autograd
+functions; ``ColumnSequenceParallelLinear``/``RowSequenceParallelLinear``;
+``mark_as_sequence_parallel_parameter`` +
+``register_sequence_parallel_allreduce_hooks``).
+
+Two realisations:
+
+* **Explicit (shard_map)** — the ``*Op`` functions below are per-shard
+  collective pairs (fwd/bwd mirroring the reference exactly) for code that
+  runs inside ``jax.shard_map``. Convention: dim 0 is the sequence dim
+  (the reference uses [s, b, h] layout in SP regions).
+* **GSPMD** — the ``*SequenceParallelLinear`` layers annotate activations:
+  seq-sharded outside matmuls, hidden-sharded inside; XLA inserts the
+  all-gather/reduce-scatter transitions these ops hand-code. LayerNorm-param
+  grad sync (the reference's allreduce hooks) is automatic under GSPMD —
+  the partitioner sums replicated-param grads across the mesh — so
+  ``register_sequence_parallel_allreduce_hooks`` only needs to act on the
+  eager path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ....nn import functional as F
+from ..layers.mpu import mp_ops
+from ..meta_parallel.parallel_layers.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, shard_constraint,
+)
+
+# ------------------------------------------------------------- explicit ops
+# (inside shard_map over the mp axis; dim 0 = sequence)
+
+def scatter(x, axis_name="mp"):
+    """fwd: keep my seq slice / bwd: all-gather (reference ScatterOp)."""
+    return mp_ops._c_split(x, axis_name, 0)
+
+
+def all_gather(x, axis_name="mp"):
+    """fwd: all-gather seq / bwd: reduce-scatter (reference AllGatherOp)."""
+    return mp_ops._all_gather(x, axis_name, 0)
+
+
+def gather(x, axis_name="mp"):
+    """fwd: all-gather seq; bwd: jax's native adjoint (reduce-scatter).
+
+    The reference GatherOp declares a slice-backward — valid under its
+    per-rank autodiff convention where every rank holds the full output
+    cotangent. shard_map uses global-cotangent semantics (a replicated
+    output's seed is split 1/n per shard), under which the reduce-scatter
+    adjoint reproduces exactly the reference's composite numerics and a
+    hand-coded slice-bwd would shrink grads by the axis size (see
+    test_scatter_gather_roundtrip_and_grads)."""
+    return mp_ops._c_concat(x, axis_name, 0)
+
+
+def reduce_scatter(x, axis_name="mp"):
+    """fwd: reduce-scatter seq / bwd: all-gather (reference ReduceScatterOp)."""
+    return mp_ops._reduce_scatter(x, axis_name, 0)
+
+
+class ScatterOp:
+    apply = staticmethod(scatter)
+
+
+class GatherOp:
+    apply = staticmethod(gather)
+
+
+class AllGatherOp:
+    apply = staticmethod(all_gather)
+
+
+class ReduceScatterOp:
+    apply = staticmethod(reduce_scatter)
+
+
+# --------------------------------------------------------------- GSPMD path
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Column-parallel linear whose input arrives seq-sharded: the implicit
+    transition is all-gather(seq) in, out-dim-sharded result out."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias, gather_output=gather_output,
+                         fuse_matmul_bias=fuse_matmul_bias, mp_group=mp_group,
+                         name=name)
+
+    def forward(self, x):
+        # input: [s, b, h] sharded on s → constrain, then the matmul's GSPMD
+        # solution is allgather(s) + shard(out-dim)
+        spec = [self.axis] + [None] * (len(x.shape) - 1)
+        x = shard_constraint(x, P(*spec))
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Row-parallel linear whose output leaves seq-sharded: the implicit
+    transition is reduce-scatter(seq) instead of allreduce."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias, input_is_parallel=input_is_parallel,
+                         fuse_matmul_bias=fuse_matmul_bias, mp_group=mp_group,
+                         name=name)
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            spec = [None] * (len(x.shape) - 1) + [self.axis]
+            x = shard_constraint(x, P(*spec))
+        out = F.linear(x, self.weight, self.bias)
+        # output seq-sharded: GSPMD lowers the partial-sum + constraint to a
+        # reduce-scatter over mp (the SP win vs plain allreduce)
+        spec = [self.axis] + [None] * (len(out.shape) - 1)
+        return shard_constraint(out, P(*spec))
+
+
+# ------------------------------------------------------------------- hooks
+def mark_as_sequence_parallel_parameter(parameter) -> None:
+    """Tag params living in SP regions (LayerNorm scale/bias): the reference
+    allreduces their grads over mp because each rank sees only a seq shard."""
+    parameter.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter) -> bool:
+    return getattr(parameter, "sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(layer, accumulation_steps: int = 1,
+                                               fuse_allreduce: bool = False):
+    """API-parity no-op. The reference allreduces marked params' grads over
+    mp because each rank differentiates only its sequence shard. Here grads
+    are already global: the jitted GSPMD step's partitioner sums
+    replicated-param grads across the mesh, and the eager single-controller
+    tape differentiates the full (unsharded) arrays. ``accumulation_steps``/
+    ``fuse_allreduce`` are accepted for signature parity only."""
+    return None

@@ -58,7 +58,33 @@ class TrainStep:
         if mesh is not None:
             data_axes = tuple(a for a in data_axes if a in mesh.axis_names)
             self._data_sharding = NamedSharding(mesh, P(data_axes if data_axes else None))
-            spec_fn = param_spec_fn or (lambda name, v: P())
+            if param_spec_fn is None:
+                # parallel layers annotate params (mp_layers sets dist_attr);
+                # default spec_fn reads those annotations
+                declared = {
+                    name: getattr(p, "dist_attr", None)
+                    for name, p in model.named_parameters()
+                }
+
+                def spec_fn(name, v, _d=declared):
+                    spec = _d.get(name)
+                    if spec is None:
+                        return P()
+                    # drop axes absent from this mesh (e.g. layer built for
+                    # mp but trained on a dp-only mesh)
+                    entries = []
+                    for e in spec:
+                        if e is None:
+                            entries.append(None)
+                            continue
+                        names = tuple(n for n in
+                                      ((e,) if isinstance(e, str) else e)
+                                      if n in mesh.axis_names)
+                        entries.append(names[0] if len(names) == 1
+                                       else (names or None))
+                    return P(*entries)
+            else:
+                spec_fn = param_spec_fn
             self.param_shardings = {
                 k: NamedSharding(mesh, spec_fn(k, v)) for k, v in params.items()
             }
