@@ -5,13 +5,17 @@ Grows through the build: topology + RNG now; fleet.init/distributed_model/
 meta_parallel wrappers as milestones land.
 """
 
-from . import base_topology, layers, meta_parallel, random, utils  # noqa: F401
+from . import base_topology, layers, meta_optimizers, meta_parallel, random, utils  # noqa: F401
 from .base_topology import (  # noqa: F401
     CommGroup, CommunicateTopology, HybridCommunicateGroup,
     create_hybrid_communicate_group, get_hybrid_communicate_group,
 )
+from .meta_optimizers import (  # noqa: F401
+    DygraphShardingOptimizer, HybridParallelGradScaler, HybridParallelOptimizer,
+)
 from .meta_parallel import (  # noqa: F401
-    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    ColumnParallelLinear, GroupShardedOptimizerStage2, GroupShardedStage2,
+    GroupShardedStage3, ParallelCrossEntropy, RowParallelLinear,
     VocabParallelEmbedding,
 )
 from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed  # noqa: F401

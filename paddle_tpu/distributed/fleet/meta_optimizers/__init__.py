@@ -1,0 +1,7 @@
+"""Dygraph meta-optimizers (reference:
+python/paddle/distributed/fleet/meta_optimizers/dygraph_optimizer/)."""
+
+from .dygraph_sharding_optimizer import DygraphShardingOptimizer  # noqa: F401
+from .hybrid_parallel_optimizer import (  # noqa: F401
+    HybridParallelGradScaler, HybridParallelOptimizer,
+)

@@ -1,0 +1,67 @@
+"""HybridParallelOptimizer / HybridParallelGradScaler (reference:
+python/paddle/distributed/fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py).
+
+The reference's job is cross-group bookkeeping: allreduce the grad-norm
+across mp/pp/sharding groups before global clipping, sync mp-duplicated
+grads, scale by dp degree. Under the single-controller GSPMD model every
+gradient the optimizer sees is the LOGICAL full gradient (XLA already summed
+partials across groups), so global-norm clip over the grad tree is global by
+construction — the wrapper only preserves the reference API and routes
+stage-1 sharding declarations.
+"""
+
+from __future__ import annotations
+
+from ....amp.grad_scaler import GradScaler
+from ..base_topology import try_get_hybrid_communicate_group
+from .dygraph_sharding_optimizer import DygraphShardingOptimizer
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._hcg = hcg or try_get_hybrid_communicate_group()
+        self._strategy = strategy
+        sharding_degree = (
+            self._hcg.get_sharding_parallel_world_size()
+            if self._hcg is not None else 1)
+        if sharding_degree > 1 and not isinstance(
+                optimizer, DygraphShardingOptimizer):
+            optimizer = DygraphShardingOptimizer(optimizer, self._hcg)
+        self._inner_opt = optimizer
+
+    def __getattr__(self, item):
+        try:
+            return getattr(self.__dict__["_inner_opt"], item)
+        except KeyError:
+            raise AttributeError(item) from None
+
+    def step(self):
+        return self._inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self._inner_opt.minimize(loss, startup_program, parameters,
+                                        no_grad_set)
+
+    def clear_grad(self, *a, **k):
+        return self._inner_opt.clear_grad(*a, **k)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+
+class HybridParallelGradScaler(GradScaler):
+    """Reference: allreduces found_inf across the model-parallel group. The
+    single-controller scaler sees the global loss, so found_inf is already
+    global; this subclass exists for API parity."""
+
+    def __init__(self, scaler=None, hcg=None, **kw):
+        if isinstance(scaler, GradScaler):
+            self.__dict__.update(scaler.__dict__)
+        else:
+            super().__init__(**kw)
+        self._hcg = hcg

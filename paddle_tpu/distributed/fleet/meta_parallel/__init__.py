@@ -3,3 +3,6 @@ from .parallel_layers import (  # noqa: F401
     ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
     VocabParallelEmbedding,
 )
+from .sharding import (  # noqa: F401
+    GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
+)

@@ -207,6 +207,11 @@ class ParallelCrossEntropy(Layer):
             picked = jnp.take_along_axis(
                 logits, lab_clipped[..., None], axis=-1)[..., 0]
             loss = lse - picked
+            # out-of-range labels that aren't ignore_index surface as NaN
+            # (the reference CUDA op errors; under jit, NaN + the NaN
+            # checker is the observable equivalent)
+            invalid = (lab < 0) | (lab >= logits.shape[-1])
+            loss = jnp.where(invalid, jnp.nan, loss)
             mask = (lab != self.ignore_index)
             return jnp.where(mask, loss, 0.0)[..., None]
 

@@ -1,0 +1,9 @@
+"""Sharding (ZeRO) meta_parallel package (reference:
+python/paddle/distributed/fleet/meta_parallel/sharding/)."""
+
+from .group_sharded_stage import (  # noqa: F401
+    GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
+)
+from .group_sharded_utils import (  # noqa: F401
+    LEVEL_TO_STAGE, extend_spec_with_sharding, resolve_sharding_axis,
+)

@@ -1,0 +1,150 @@
+"""GroupSharded stage 2/3 wrappers (reference:
+python/paddle/distributed/fleet/meta_parallel/sharding/group_sharded_stage2.py
+and group_sharded_stage3.py — GroupShardedStage2/GroupShardedStage3 dygraph
+wrappers; GroupShardedOptimizerStage2 in group_sharded_optimizer_stage2.py).
+
+The wrappers keep the reference's API shape (a Layer wrapping the user model,
+an optimizer wrapper owning the shard) but their work is declarative: they
+stamp ``_group_sharded_level`` / ``_sharding_axis`` onto model + optimizer and
+(stage 3) extend each parameter's ``dist_attr`` so the jitted TrainStep stores
+params sharded and GSPMD gathers on use. Forward passes straight through —
+parallel==serial numerics hold by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jax.sharding import PartitionSpec as P
+
+from .....nn.layer import Layer
+from ...base_topology import try_get_hybrid_communicate_group
+from .group_sharded_utils import resolve_sharding_axis
+
+
+def _sharding_axis_for(group) -> str:
+    if group is not None and getattr(group, "axis_name", None):
+        return group.axis_name
+    hcg = try_get_hybrid_communicate_group()
+    if hcg is not None:
+        mesh = hcg.get_mesh()
+        ax = resolve_sharding_axis(mesh)
+        if ax is not None:
+            return ax
+    return "sharding"
+
+
+class GroupShardedOptimizerStage2:
+    """Optimizer wrapper owning the opt-state shard (reference:
+    GroupShardedOptimizerStage2 — rank-local slices + broadcast of updated
+    params). Here: marks the wrapped optimizer so TrainStep shards its slot
+    tree over the sharding axis; delegates everything else."""
+
+    def __init__(self, params, optim, group=None, offload=False, device="tpu",
+                 **kw):
+        self._optim = optim
+        self._group = group
+        self.offload = offload
+        optim._group_sharded_level = max(
+            getattr(optim, "_group_sharded_level", 0), 1)
+        optim._sharding_axis = _sharding_axis_for(group)
+
+    def __getattr__(self, item):
+        try:
+            return getattr(self.__dict__["_optim"], item)
+        except KeyError:
+            raise AttributeError(item) from None
+
+    # the reference exposes .step()/.clear_grad() on the wrapper
+    def step(self):
+        return self._optim.step()
+
+    def clear_grad(self, *a, **k):
+        return self._optim.clear_grad(*a, **k)
+
+    def state_dict(self):
+        return self._optim.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._optim.set_state_dict(sd)
+
+
+class GroupShardedStage2(Layer):
+    """Stage-2 model wrapper: grads + optimizer state sharded (reference:
+    GroupShardedStage2 — grad reduce-scatter hooks, GradStorage fusion).
+    GSPMD's reduce-scatter falls out of the sharded opt-state spec; the
+    wrapper passes forward through unchanged."""
+
+    def __init__(self, layer: Layer, sharding_optimizer=None, group=None,
+                 sync_buffers: bool = False, buffer_max_size: int = 2 ** 23,
+                 auto_refresh_trainable: bool = True, device: str = "tpu",
+                 dp_group=None):
+        super().__init__()
+        self._layer = layer
+        self._group = group
+        self._group_sharded_level = 2
+        self._sharding_axis = _sharding_axis_for(group)
+        opts = sharding_optimizer
+        if opts is not None:
+            for o in (opts if isinstance(opts, (list, tuple)) else [opts]):
+                tgt = getattr(o, "_optim", o)
+                tgt._group_sharded_level = 2
+                tgt._sharding_axis = self._sharding_axis
+
+    def forward(self, *args, **kwargs):
+        return self._layer(*args, **kwargs)
+
+    def __getattr__(self, item):
+        try:
+            return super().__getattr__(item)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layer"], item)
+
+
+class GroupShardedStage3(Layer):
+    """Stage-3 model wrapper: params, grads and optimizer state all sharded
+    (reference: GroupShardedStage3 — param segmentation, pre-forward/
+    pre-backward all-gather, release after use, optional CPU offload).
+    Here each param's dist_attr gains the sharding axis; TrainStep stores the
+    shard and GSPMD all-gathers at each use site — the same traffic pattern,
+    scheduled by XLA."""
+
+    def __init__(self, layer: Layer, optimizer=None, group=None,
+                 sync_buffers: bool = False, device: str = "tpu",
+                 segment_size: int = 2 ** 20, pertrain_sync_models: bool = True,
+                 offload: bool = False, sync_comm: bool = False,
+                 dp_group=None, exclude_layer=None):
+        super().__init__()
+        self._layer = layer
+        self._group = group
+        self.offload = offload
+        self._group_sharded_level = 3
+        self._sharding_axis = _sharding_axis_for(group)
+        if optimizer is not None:
+            for o in (optimizer if isinstance(optimizer, (list, tuple))
+                      else [optimizer]):
+                tgt = o.__dict__.get("_optim", o)
+                tgt._group_sharded_level = 3
+                tgt._sharding_axis = self._sharding_axis
+        # spec extension happens in ONE place (TrainStep, level>=3); the
+        # wrapper only records which params the user excluded
+        self._sharding_exclude_ids = set()
+        if exclude_layer:
+            for l in exclude_layer:
+                for _, p in getattr(l, "named_parameters", lambda: [])():
+                    self._sharding_exclude_ids.add(id(p))
+
+    def forward(self, *args, **kwargs):
+        return self._layer(*args, **kwargs)
+
+    def get_all_parameters(self, convert2cpu: bool = False):
+        """Reference API: materialize full params (all-gather). Under GSPMD
+        the logical value is already full; this is a no-op provided for
+        checkpoint tooling."""
+        return list(self._layer.parameters())
+
+    def __getattr__(self, item):
+        try:
+            return super().__getattr__(item)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layer"], item)

@@ -1,0 +1,90 @@
+"""ZeRO/GroupSharded spec machinery — GSPMD-first.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/sharding/
+(GroupShardedStage2/3, GradStorage/ParamStorage fusion, offload hooks).
+
+The reference implements ZeRO with runtime hooks: grads reduce-scattered to
+owner ranks, params broadcast/all-gathered on demand, fused grad storages.
+On TPU every one of those moves is a sharding DECLARATION: we extend each
+parameter's PartitionSpec with the ``sharding`` mesh axis on a free dim and
+let GSPMD insert the reduce-scatter (grads), the sharded update (optimizer),
+and the all-gather (stage-3 param use). The stages differ only in WHICH trees
+carry the extended spec:
+
+  stage 1 ("os")     : optimizer slots + master weights
+  stage 2 ("os_g")   : + gradients (reduce-scatter instead of all-reduce)
+  stage 3 ("p_g_os") : + the parameters themselves (gather-on-use)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+#: level string (reference group_sharded_parallel API) -> numeric stage
+LEVEL_TO_STAGE = {"os": 1, "os_g": 2, "p_g_os": 3}
+
+
+def _axis_sizes(mesh: Mesh, entry) -> int:
+    names = entry if isinstance(entry, tuple) else (entry,)
+    size = 1
+    for n in names:
+        size *= mesh.shape[n]
+    return size
+
+
+def extend_spec_with_sharding(
+    spec: Optional[P],
+    shape: Sequence[int],
+    mesh: Mesh,
+    axis: str = "sharding",
+) -> P:
+    """Add the ZeRO ``axis`` to a (possibly TP-sharded) PartitionSpec.
+
+    Picks the LARGEST dim the axis divides evenly, preferring free (None)
+    dims; a dim already sharded (e.g. by mp) can be co-sharded when its
+    per-shard extent still divides. Falls back to the original spec when
+    nothing divides — a replicated scalar/LN param costs nothing anyway.
+    """
+    if axis not in mesh.shape or mesh.shape[axis] <= 1:
+        return spec if spec is not None else P()
+    size = mesh.shape[axis]
+    entries = list(spec) if spec is not None else []
+    entries += [None] * (len(shape) - len(entries))
+
+    best_dim, best_extent, best_free = -1, 0, False
+    for d, (e, s) in enumerate(zip(entries, shape)):
+        if e is not None:
+            names = e if isinstance(e, tuple) else (e,)
+            if axis in names:
+                return P(*entries)  # already sharded over this axis
+            per_shard = s // _axis_sizes(mesh, e)
+            free = False
+        else:
+            per_shard = s
+            free = True
+        if per_shard % size != 0 or per_shard < size:
+            continue
+        # prefer free dims; among candidates take the largest extent
+        if (free, per_shard) > (best_free, best_extent) and (
+                free or not best_free):
+            best_dim, best_extent, best_free = d, per_shard, free
+    if best_dim < 0:
+        return P(*entries)
+    e = entries[best_dim]
+    if e is None:
+        entries[best_dim] = axis
+    else:
+        names = e if isinstance(e, tuple) else (e,)
+        entries[best_dim] = tuple(names) + (axis,)
+    return P(*entries)
+
+
+def resolve_sharding_axis(mesh: Mesh) -> Optional[str]:
+    """The mesh axis ZeRO shards over: ``sharding`` if present (>1), else
+    ``dp`` (the common TPU fusion of dp and sharding), else None."""
+    for a in ("sharding", "dp"):
+        if a in mesh.shape and mesh.shape[a] > 1:
+            return a
+    return None

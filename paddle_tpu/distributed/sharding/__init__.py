@@ -1,0 +1,5 @@
+"""User-facing group-sharded (ZeRO) API (reference:
+python/paddle/distributed/sharding/group_sharded.py —
+``group_sharded_parallel``/``save_group_sharded_model``)."""
+
+from .group_sharded import group_sharded_parallel, save_group_sharded_model  # noqa: F401

@@ -1,0 +1,83 @@
+"""group_sharded_parallel / save_group_sharded_model.
+
+Reference: python/paddle/distributed/sharding/group_sharded.py — dispatches
+level "os"/"os_g"/"p_g_os" to GroupShardedOptimizerStage2 + GroupShardedStage2
+or GroupShardedStage3 and returns (model, optimizer, scaler).
+
+TPU semantics: the returned wrappers carry sharding DECLARATIONS that the
+jitted TrainStep turns into GSPMD programs (reduce-scattered grads, sharded
+optimizer update, gather-on-use params). ``offload`` maps to host-offloaded
+optimizer state (jax memory kinds) — accepted, currently advisory.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..fleet.meta_parallel.sharding import (
+    LEVEL_TO_STAGE, GroupShardedOptimizerStage2, GroupShardedStage2,
+    GroupShardedStage3,
+)
+
+
+def group_sharded_parallel(
+    model,
+    optimizer,
+    level: str,
+    scaler=None,
+    group=None,
+    offload: bool = False,
+    sync_buffers: bool = False,
+    buffer_max_size: int = 2 ** 23,
+    segment_size: int = 2 ** 20,
+    sync_comm: bool = False,
+    dp_group=None,
+    exclude_layer=None,
+):
+    """Wrap model+optimizer for ZeRO level ``"os"`` (stage 1), ``"os_g"``
+    (stage 2) or ``"p_g_os"`` (stage 3)."""
+    if level not in LEVEL_TO_STAGE:
+        raise ValueError(
+            f"level must be one of {sorted(LEVEL_TO_STAGE)}, got {level!r}")
+    stage = LEVEL_TO_STAGE[level]
+
+    if stage == 1:
+        optimizer = GroupShardedOptimizerStage2(
+            params=list(model.parameters()), optim=optimizer, group=group,
+            offload=offload)
+        # stage 1 shards only optimizer state; model is untouched
+        return model, optimizer, scaler
+
+    if stage == 2:
+        optimizer = GroupShardedOptimizerStage2(
+            params=list(model.parameters()), optim=optimizer, group=group,
+            offload=offload)
+        model = GroupShardedStage2(
+            model, sharding_optimizer=optimizer, group=group,
+            sync_buffers=sync_buffers, buffer_max_size=buffer_max_size,
+            dp_group=dp_group)
+        return model, optimizer, scaler
+
+    model = GroupShardedStage3(
+        model, optimizer=optimizer, group=group, sync_buffers=sync_buffers,
+        segment_size=segment_size, offload=offload, sync_comm=sync_comm,
+        dp_group=dp_group, exclude_layer=exclude_layer)
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output: str, optimizer=None) -> None:
+    """Save a group-sharded model (reference: gathers stage-2/3 shards to a
+    full state_dict on rank 0). Single-controller JAX already holds the
+    logical full value; we save the assembled state_dict."""
+    from ... import save  # paddle_tpu.save
+
+    target = getattr(model, "_layer", model)
+    # ``output`` is always a directory (reference semantics)
+    os.makedirs(output, exist_ok=True)
+    model_path = os.path.join(output, "model.pdmodel")
+    opt_path = os.path.join(output, "model.pdopt")
+    save(target.state_dict(), model_path)
+    if optimizer is not None:
+        tgt = getattr(optimizer, "_optim", optimizer)
+        save(tgt.state_dict(), opt_path)

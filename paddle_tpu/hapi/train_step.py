@@ -10,6 +10,14 @@ path — both run the same Layer code.
 Sharding: pass a ``mesh`` and a ``param_spec_fn(name, value) -> PartitionSpec``
 and the step becomes a GSPMD program: batch sharded over ``dp``/``sharding``
 axes, params per the spec (fleet wrappers provide TP/ZeRO specs).
+
+ZeRO (group_sharded) integration: ``group_sharded_parallel`` /
+``DygraphShardingOptimizer`` stamp ``_group_sharded_level`` on the model /
+optimizer; stage>=1 stores optimizer slots + master weights sharded over the
+sharding axis, stage>=2 additionally constrains gradients to that sharding
+(XLA emits reduce-scatter instead of all-reduce), stage 3 stores the params
+themselves sharded (GSPMD all-gathers at use sites). Reference:
+python/paddle/distributed/fleet/meta_parallel/sharding/.
 """
 
 from __future__ import annotations
@@ -40,6 +48,8 @@ class TrainStep:
         donate: bool = True,
         grad_accum_steps: int = 1,
         remat: bool = False,
+        sharding_level: Optional[int] = None,
+        sharding_axis: Optional[str] = None,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -85,9 +95,45 @@ class TrainStep:
                     return P(*entries)
             else:
                 spec_fn = param_spec_fn
+
+            # ---- ZeRO / group_sharded: resolve stage + sharding axis from
+            # the wrappers' declarations (or explicit kwargs)
+            from ..distributed.fleet.meta_parallel.sharding import (
+                extend_spec_with_sharding, resolve_sharding_axis)
+            level = sharding_level
+            if level is None:
+                level = max(getattr(optimizer, "_group_sharded_level", 0),
+                            getattr(model, "_group_sharded_level", 0))
+            axis = (sharding_axis
+                    or getattr(optimizer, "_sharding_axis", None)
+                    or getattr(model, "_sharding_axis", None))
+            if level and (axis is None or axis not in mesh.shape
+                          or mesh.shape[axis] <= 1):
+                axis = resolve_sharding_axis(mesh)
+            if axis is None:
+                level = 0
+            self.sharding_level, self.sharding_axis = level, axis
+
+            param_specs = {k: spec_fn(k, v) for k, v in params.items()}
+            if level >= 3:
+                # honor GroupShardedStage3(exclude_layer=...) — the wrapper
+                # records excluded param ids, extension happens only here
+                excluded = getattr(model, "_sharding_exclude_ids", set())
+                named = dict(model.named_parameters())
+                param_specs = {
+                    k: (s if id(named.get(k)) in excluded else
+                        extend_spec_with_sharding(
+                            s, params[k].shape, mesh, axis))
+                    for k, s in param_specs.items()}
             self.param_shardings = {
-                k: NamedSharding(mesh, spec_fn(k, v)) for k, v in params.items()
-            }
+                k: NamedSharding(mesh, s) for k, s in param_specs.items()}
+            if level >= 1:
+                self.opt_shardings = {
+                    k: NamedSharding(mesh, extend_spec_with_sharding(
+                        param_specs[k], params[k].shape, mesh, axis))
+                    for k in params}
+            else:
+                self.opt_shardings = dict(self.param_shardings)
             params = {
                 k: jax.device_put(v, self.param_shardings[k])
                 for k, v in params.items()
@@ -95,22 +141,23 @@ class TrainStep:
         else:
             self._data_sharding = None
             self.param_shardings = None
+            self.opt_shardings = None
+            self.sharding_level, self.sharding_axis = 0, None
 
         self.params = params
         self.opt_state = optimizer.init_state_tree(params)
         if self.param_shardings is not None:
-            # optimizer slots inherit their parameter's sharding
-            def shard_like(path_params):
-                slots, master = path_params
-                return slots, master
+            # optimizer slots inherit their parameter's sharding, extended by
+            # the ZeRO axis at stage>=1 (optimizer-state sharding)
             new_slots = {}
             for k, slot in self.opt_state["slots"].items():
                 new_slots[k] = jax.tree.map(
-                    lambda s: jax.device_put(s, self.param_shardings[k]), slot)
+                    lambda s, _k=k: jax.device_put(s, self.opt_shardings[_k]),
+                    slot)
             self.opt_state["slots"] = new_slots
             if self.opt_state.get("master"):
                 self.opt_state["master"] = {
-                    k: jax.device_put(v, self.param_shardings[k])
+                    k: jax.device_put(v, self.opt_shardings[k])
                     for k, v in self.opt_state["master"].items()}
 
         def loss_of(p, batch):
@@ -143,8 +190,32 @@ class TrainStep:
                 grads = jax.tree.map(lambda g: g / self.grad_accum_steps, grads)
             else:
                 loss, grads = jax.value_and_grad(loss_of)(params, batch)
+            if self.sharding_level >= 2:
+                # ZeRO-2: pin grads to the opt-state sharding so XLA lowers
+                # the dp-sum to a reduce-scatter onto owner shards
+                grads = {
+                    k: jax.lax.with_sharding_constraint(
+                        g, self.opt_shardings[k])
+                    for k, g in grads.items()}
             new_params, new_state = optimizer.functional_update(
                 params, grads, opt_state, lr)
+            if self.param_shardings is not None:
+                # keep output layouts identical to inputs (donation + steady
+                # state across steps; ZeRO update stays on the shard)
+                new_params = {
+                    k: jax.lax.with_sharding_constraint(
+                        v, self.param_shardings[k])
+                    for k, v in new_params.items()}
+                new_state["slots"] = {
+                    k: jax.tree.map(
+                        lambda s, _k=k: jax.lax.with_sharding_constraint(
+                            s, self.opt_shardings[_k]), slot)
+                    for k, slot in new_state["slots"].items()}
+                if new_state.get("master"):
+                    new_state["master"] = {
+                        k: jax.lax.with_sharding_constraint(
+                            v, self.opt_shardings[k])
+                        for k, v in new_state["master"].items()}
             return loss, new_params, new_state
 
         donate_argnums = (0, 1) if donate else ()

@@ -1,0 +1,250 @@
+"""Group-sharded (ZeRO) tests.
+
+Mirrors the reference's test/collective/fleet/test_dygraph_sharding_stage2.py
+/ _stage3.py / test_dygraph_group_sharded_api.py (SURVEY.md §4): the core
+invariant is sharded == unsharded numerics, plus structural checks that the
+state the stage claims to shard actually lands sharded on the mesh.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import group_sharded_parallel, save_group_sharded_model
+from paddle_tpu.distributed.fleet import (
+    DygraphShardingOptimizer, HybridParallelOptimizer,
+    create_hybrid_communicate_group,
+)
+from paddle_tpu.distributed.fleet.base_topology import _reset_hcg
+from paddle_tpu.distributed.fleet.meta_parallel.sharding import (
+    extend_spec_with_sharding, resolve_sharding_axis,
+)
+from paddle_tpu.hapi import TrainStep
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 64)
+        self.fc2 = nn.Linear(64, 16)
+
+    def forward(self, x, y):
+        h = paddle.nn.functional.relu(self.fc1(x))
+        out = self.fc2(h)
+        return ((out - y) ** 2).mean()
+
+
+def _make_batches(n=3, bs=8):
+    rng = np.random.default_rng(0)
+    return [(rng.standard_normal((bs, 16)).astype(np.float32),
+             rng.standard_normal((bs, 16)).astype(np.float32))
+            for _ in range(n)]
+
+
+def _run(level, hcg=None, steps=3):
+    """Train an MLP a few steps; returns (losses, final_params)."""
+    paddle.seed(7)
+    model = MLP()
+    opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+    mesh = hcg.get_mesh() if hcg is not None else None
+    if level is not None:
+        model, opt, _ = group_sharded_parallel(model, opt, level)
+    step = TrainStep(model, opt, mesh=mesh, data_axes=("dp", "sharding"))
+    losses = []
+    for x, y in _make_batches(steps):
+        losses.append(float(step(paddle.to_tensor(x), paddle.to_tensor(y))))
+    step.sync_to_model()
+    # stage-2/3 wrappers nest the user model as ``_layer.`` (reference
+    # GroupShardedStage2/3 do the same); normalize for comparison
+    params = {k.removeprefix("_layer."): np.asarray(v)
+              for k, v in step.params.items()}
+    return losses, params, step
+
+
+@pytest.fixture
+def hcg_sharding8():
+    hcg = create_hybrid_communicate_group(sharding_degree=8)
+    yield hcg
+    _reset_hcg()
+
+
+@pytest.fixture
+def hcg_dp2_sharding2_mp2():
+    hcg = create_hybrid_communicate_group(
+        dp_degree=2, sharding_degree=2, mp_degree=2)
+    yield hcg
+    _reset_hcg()
+
+
+class TestExtendSpec:
+    def test_free_dim_picked(self, hcg_sharding8):
+        mesh = hcg_sharding8.get_mesh()
+        s = extend_spec_with_sharding(P(), (64, 16), mesh, "sharding")
+        assert s == P("sharding", None)
+
+    def test_prefers_largest_free_dim(self, hcg_sharding8):
+        mesh = hcg_sharding8.get_mesh()
+        s = extend_spec_with_sharding(P(), (16, 128), mesh, "sharding")
+        assert s == P(None, "sharding")
+
+    def test_respects_existing_tp_axis(self, hcg_dp2_sharding2_mp2):
+        mesh = hcg_dp2_sharding2_mp2.get_mesh()
+        s = extend_spec_with_sharding(P(None, "mp"), (64, 32), mesh, "sharding")
+        assert s == P("sharding", "mp")
+
+    def test_cosharding_when_no_free_dim(self, hcg_dp2_sharding2_mp2):
+        mesh = hcg_dp2_sharding2_mp2.get_mesh()
+        s = extend_spec_with_sharding(P("mp"), (64,), mesh, "sharding")
+        assert s == P(("mp", "sharding"))
+
+    def test_indivisible_replicates(self, hcg_sharding8):
+        mesh = hcg_sharding8.get_mesh()
+        s = extend_spec_with_sharding(P(), (3, 5), mesh, "sharding")
+        assert s == P(None, None)
+
+    def test_already_sharded_noop(self, hcg_sharding8):
+        mesh = hcg_sharding8.get_mesh()
+        s = extend_spec_with_sharding(P("sharding", None), (64, 16), mesh,
+                                      "sharding")
+        assert s == P("sharding", None)
+
+    def test_resolve_axis(self, hcg_sharding8):
+        assert resolve_sharding_axis(hcg_sharding8.get_mesh()) == "sharding"
+
+
+class TestGroupShardedParity:
+    """stage-N == serial numerics, step-by-step (the reference's invariant)."""
+
+    def test_stage1_matches_serial(self, hcg_sharding8):
+        base_losses, base_params, _ = _run(None)
+        _reset_hcg_after = hcg_sharding8  # keep fixture alive
+        losses, params, step = _run("os", hcg_sharding8)
+        np.testing.assert_allclose(losses, base_losses, rtol=2e-4, atol=1e-5)
+        for k in base_params:
+            # Adam's rsqrt amplifies reduction-order fp noise; params agree
+            # to ~1e-3 after 3 steps (losses, above, agree to 2e-4)
+            np.testing.assert_allclose(params[k], base_params[k],
+                                       rtol=1e-2, atol=1e-3)
+        # structural: optimizer moments are actually sharded
+        m1 = step.opt_state["slots"]["fc1.weight"]["moment1"]
+        assert "sharding" in jax.tree.leaves(
+            [m1.sharding.spec]) or m1.sharding.spec != P()
+        assert step.sharding_level == 1
+
+    def test_stage2_matches_serial(self, hcg_sharding8):
+        base_losses, base_params, _ = _run(None)
+        losses, params, step = _run("os_g", hcg_sharding8)
+        np.testing.assert_allclose(losses, base_losses, rtol=2e-4, atol=1e-5)
+        for k in base_params:
+            # Adam's rsqrt amplifies reduction-order fp noise; params agree
+            # to ~1e-3 after 3 steps (losses, above, agree to 2e-4)
+            np.testing.assert_allclose(params[k], base_params[k],
+                                       rtol=1e-2, atol=1e-3)
+        assert step.sharding_level == 2
+
+    def test_stage3_matches_serial(self, hcg_sharding8):
+        base_losses, base_params, _ = _run(None)
+        losses, params, step = _run("p_g_os", hcg_sharding8)
+        np.testing.assert_allclose(losses, base_losses, rtol=2e-4, atol=1e-5)
+        for k in base_params:
+            # Adam's rsqrt amplifies reduction-order fp noise; params agree
+            # to ~1e-3 after 3 steps (losses, above, agree to 2e-4)
+            np.testing.assert_allclose(params[k], base_params[k],
+                                       rtol=1e-2, atol=1e-3)
+        assert step.sharding_level == 3
+        # structural: params themselves are sharded on device
+        w = step.params.get("_layer.fc1.weight",
+                            step.params.get("fc1.weight"))
+        spec_entries = tuple(w.sharding.spec)
+        flat = []
+        for e in spec_entries:
+            if isinstance(e, tuple):
+                flat += list(e)
+            elif e is not None:
+                flat.append(e)
+        assert "sharding" in flat
+
+    def test_stage3_with_tp(self, hcg_dp2_sharding2_mp2):
+        """ZeRO-3 composes with tensor parallelism (sharded-DP × TP)."""
+        base_losses, base_params, _ = _run(None)
+        losses, params, step = _run("p_g_os", hcg_dp2_sharding2_mp2)
+        np.testing.assert_allclose(losses, base_losses, rtol=2e-4, atol=1e-5)
+        for k in base_params:
+            # Adam's rsqrt amplifies reduction-order fp noise; params agree
+            # to ~1e-3 after 3 steps (losses, above, agree to 2e-4)
+            np.testing.assert_allclose(params[k], base_params[k],
+                                       rtol=1e-2, atol=1e-3)
+
+
+class TestShardingOptimizers:
+    def test_dygraph_sharding_optimizer_stamps_level(self, hcg_sharding8):
+        model = MLP()
+        opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+        wrapped = DygraphShardingOptimizer(opt, hcg_sharding8)
+        assert opt._group_sharded_level == 1
+        assert opt._sharding_axis == "sharding"
+        assert wrapped.get_lr() == opt.get_lr()
+
+    def test_hybrid_parallel_optimizer_wraps_sharding(self, hcg_sharding8):
+        model = MLP()
+        opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+        hp = HybridParallelOptimizer(opt, hcg_sharding8)
+        assert isinstance(hp._inner_opt, DygraphShardingOptimizer)
+        assert opt._group_sharded_level == 1
+
+    def test_eager_step_still_works_with_wrapper(self, hcg_sharding8):
+        """The wrappers must not break the eager (non-jit) optimizer path."""
+        paddle.seed(0)
+        model = MLP()
+        opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+        model2, opt2, _ = group_sharded_parallel(model, opt, "os_g")
+        x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32))
+        y = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32))
+        loss = model2(x, y)
+        loss.backward()
+        before = model.fc1.weight.numpy().copy()
+        opt2.step()
+        opt2.clear_grad()
+        assert not np.allclose(model.fc1.weight.numpy(), before)
+
+
+class TestSaveGroupSharded:
+    def test_save_group_sharded_model(self, hcg_sharding8, tmp_path):
+        model = MLP()
+        opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+        model, opt, _ = group_sharded_parallel(model, opt, "p_g_os")
+        out = str(tmp_path / "ckpt")
+        save_group_sharded_model(model, out, optimizer=opt)
+        assert os.path.exists(os.path.join(out, "model.pdmodel"))
+        assert os.path.exists(os.path.join(out, "model.pdopt"))
+        sd = paddle.load(os.path.join(out, "model.pdmodel"))
+        assert any("fc1" in k for k in sd)
+
+    def test_stage3_exclude_layer(self, hcg_sharding8):
+        """exclude_layer params stay unsharded under stage 3."""
+        paddle.seed(0)
+        model = MLP()
+        opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+        wrapped, opt, _ = group_sharded_parallel(
+            model, opt, "p_g_os", exclude_layer=[model.fc2])
+        step = TrainStep(wrapped, opt, mesh=hcg_sharding8.get_mesh(),
+                         data_axes=("sharding",))
+        def flat_axes(spec):
+            out = []
+            for e in spec:
+                if isinstance(e, tuple):
+                    out += list(e)
+                elif e is not None:
+                    out.append(e)
+            return out
+        assert "sharding" in flat_axes(
+            step.param_shardings["_layer.fc1.weight"].spec)
+        assert "sharding" not in flat_axes(
+            step.param_shardings["_layer.fc2.weight"].spec)
