@@ -10,12 +10,17 @@ from .base_topology import (  # noqa: F401
     CommGroup, CommunicateTopology, HybridCommunicateGroup,
     create_hybrid_communicate_group, get_hybrid_communicate_group,
 )
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .fleet import (  # noqa: F401
+    Fleet, distributed_model, distributed_optimizer, init, is_initialized,
+)
 from .meta_optimizers import (  # noqa: F401
     DygraphShardingOptimizer, HybridParallelGradScaler, HybridParallelOptimizer,
 )
 from .meta_parallel import (  # noqa: F401
     ColumnParallelLinear, GroupShardedOptimizerStage2, GroupShardedStage2,
-    GroupShardedStage3, ParallelCrossEntropy, RowParallelLinear,
+    GroupShardedStage3, LayerDesc, ParallelCrossEntropy, PipelineLayer,
+    PipelineParallel, RowParallelLinear, SharedLayerDesc,
     VocabParallelEmbedding,
 )
 from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed  # noqa: F401

@@ -1,0 +1,126 @@
+"""The Fleet facade: ``fleet.init`` / ``distributed_model`` /
+``distributed_optimizer``.
+
+Reference: python/paddle/distributed/fleet/fleet.py — a singleton that (1)
+builds the HybridCommunicateGroup from ``strategy.hybrid_configs``, (2)
+wraps the user model with the per-strategy meta_parallel class, (3) wraps
+the optimizer with HybridParallelOptimizer (or DygraphShardingOptimizer when
+sharding is on). The TPU build keeps that exact surface; under the hood the
+"groups" are mesh axes and the wrappers mostly declare shardings for the
+jitted train step (see meta_parallel/*)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .base_topology import (
+    CommunicateTopology, HybridCommunicateGroup, try_get_hybrid_communicate_group,
+)
+from .distributed_strategy import DistributedStrategy
+from .meta_optimizers import DygraphShardingOptimizer, HybridParallelOptimizer
+from .meta_parallel import PipelineParallel
+from .meta_parallel.meta_parallel_base import (
+    DataParallel, ShardingParallel, TensorParallel,
+)
+from .meta_parallel.pp_layers import PipelineLayer
+
+
+class Fleet:
+    def __init__(self):
+        self._is_initialized = False
+        self._user_defined_strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+
+    # ------------------------------------------------------------------ init
+    def init(self, role_maker=None, is_collective: bool = True,
+             strategy: Optional[DistributedStrategy] = None):
+        if strategy is None:
+            strategy = DistributedStrategy()
+        self._user_defined_strategy = strategy
+        deg = strategy.degrees()
+        topo = CommunicateTopology(
+            ("data", "pipe", "sharding", "sep", "model"),
+            (deg["dp"], deg["pp"], deg["sharding"], deg["sep"], deg["mp"]))
+        self._hcg = HybridCommunicateGroup(topo)
+        self._is_initialized = True
+        return self
+
+    def is_initialized(self) -> bool:
+        return self._is_initialized
+
+    def get_hybrid_communicate_group(self) -> HybridCommunicateGroup:
+        if self._hcg is None:
+            raise RuntimeError("fleet.init() has not been called")
+        return self._hcg
+
+    def worker_index(self) -> int:
+        return (self._hcg.global_rank if self._hcg else 0)
+
+    def worker_num(self) -> int:
+        return self._hcg.nranks if self._hcg else 1
+
+    def barrier_worker(self):
+        pass  # single controller: nothing to synchronize
+
+    # ----------------------------------------------------------------- wrap
+    def distributed_model(self, model):
+        """Wrap per the strategy (reference fleet.py:distributed_model):
+        pp>1 → PipelineParallel (requires a PipelineLayer), else mp>1 →
+        TensorParallel, else sharding>1 → ShardingParallel, else DataParallel."""
+        hcg = self.get_hybrid_communicate_group()
+        strategy = self._user_defined_strategy
+        if hcg.get_pipe_parallel_world_size() > 1:
+            if not isinstance(model, PipelineLayer):
+                raise TypeError(
+                    "pp_degree > 1 requires the model to be a PipelineLayer")
+            return PipelineParallel(model, hcg, strategy)
+        if hcg.get_model_parallel_world_size() > 1:
+            return TensorParallel(model, hcg, strategy)
+        if hcg.get_sharding_parallel_world_size() > 1:
+            return ShardingParallel(model, hcg, strategy)
+        return DataParallel(model, hcg, strategy)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._user_defined_strategy = strategy
+        st = self._user_defined_strategy or DistributedStrategy()
+        hcg = self._hcg
+        if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
+            stage = int(st.sharding_configs.get("stage", 1))
+            if stage == 1:
+                optimizer = DygraphShardingOptimizer(optimizer, hcg)
+        return HybridParallelOptimizer(optimizer, hcg, st)
+
+    # ----------------------------------------------------- minimize (static)
+    def minimize(self, optimizer, loss, startup_program=None,
+                 parameter_list=None, no_grad_set=None):
+        raise NotImplementedError(
+            "static-graph fleet.minimize is out of scope; use "
+            "distributed_model + the jitted TrainStep")
+
+
+_fleet_singleton = Fleet()
+
+
+def _get_fleet() -> Fleet:
+    return _fleet_singleton
+
+
+def init(role_maker=None, is_collective: bool = True, strategy=None):
+    return _fleet_singleton.init(role_maker, is_collective, strategy)
+
+
+def is_initialized() -> bool:
+    return _fleet_singleton.is_initialized()
+
+
+def distributed_model(model):
+    return _fleet_singleton.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return _fleet_singleton.distributed_optimizer(optimizer, strategy)
+
+
+def get_hybrid_communicate_group_from_fleet():
+    return _fleet_singleton.get_hybrid_communicate_group()

@@ -3,6 +3,14 @@ from .parallel_layers import (  # noqa: F401
     ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
     VocabParallelEmbedding,
 )
+from . import pp_utils  # noqa: F401
+from .meta_parallel_base import (  # noqa: F401
+    DataParallel, MetaParallelBase, ShardingParallel, TensorParallel,
+)
+from .pipeline_parallel import PipelineParallel, PipelineTrainStep  # noqa: F401
+from .pp_layers import (  # noqa: F401
+    LayerDesc, PipelineLayer, SegmentLayers, SharedLayerDesc,
+)
 from .sharding import (  # noqa: F401
     GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
 )

@@ -1,0 +1,73 @@
+"""MetaParallelBase + TensorParallel / DataParallel / ShardingParallel
+wrappers (reference: .../meta_parallel/meta_parallel_base.py,
+tensor_parallel.py, sharding_parallel.py and base/dygraph/parallel.py's
+DataParallel over EagerReducer).
+
+On TPU these wrappers carry no runtime hooks of their own: TP layers already
+annotate their params with PartitionSpecs, DP/sharding gradient sync falls
+out of GSPMD when the jitted train step shards the batch over dp — XLA emits
+the bucketed all-reduce/reduce-scatter the reference implements by hand in
+reducer.cc. The classes exist so ``fleet.distributed_model`` returns the
+reference's types and so strategy metadata (broadcast of initial params
+across dp, sharded-model markers) has a place to live.
+"""
+
+from __future__ import annotations
+
+from ....nn.layer import Layer
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+
+class TensorParallel(MetaParallelBase):
+    """mp_degree > 1, pp_degree == 1. Param shardings come from the layer
+    annotations (mp_layers.py); nothing to do at wrap time beyond marking."""
+
+    def _prepare_for_model(self):
+        self._layers._is_tensor_parallel = True
+
+
+class ShardingParallel(MetaParallelBase):
+    def _prepare_for_model(self):
+        self._layers._is_sharding_parallel = True
+
+
+class DataParallel(MetaParallelBase):
+    """Plain DP (reference: paddle.DataParallel over EagerReducer buckets).
+    Gradient averaging over dp is a by-product of GSPMD batch sharding in
+    the train step; ``find_unused_parameters``/bucket knobs are accepted for
+    API compatibility and ignored."""
+
+    def __init__(self, layers, hcg=None, strategy=None,
+                 comm_buffer_size: int = 25, last_comm_buffer_size: int = 1,
+                 find_unused_parameters: bool = False, group=None):
+        super().__init__(layers, hcg, strategy)
+
+    def _prepare_for_model(self):
+        self._layers._is_data_parallel = True
+
+    def scale_loss(self, loss):
+        return loss  # GSPMD mean over the dp-sharded batch already averages
+
+    def no_sync(self):
+        import contextlib
+        return contextlib.nullcontext()

@@ -1,0 +1,265 @@
+"""Pipeline layer segmentation: ``LayerDesc`` / ``SharedLayerDesc`` /
+``PipelineLayer``.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/pp_layers (approx.
+path; see SURVEY.md §2.2 "meta_parallel: PP"). The reference builds ONLY the
+local stage's layers per rank and moves activations with NCCL p2p. On TPU we
+are single-controller/SPMD: the PipelineLayer materializes the FULL model
+(so the eager path, ``state_dict`` and parity tests work unchanged), and the
+pipelined schedule (pipeline_parallel.py) stacks the uniform middle region
+of identical blocks along a leading stage axis sharded over the ``pp`` mesh
+axis — stage-to-stage transfer lowers to an XLA collective-permute over ICI
+instead of send_v2/recv_v2.
+
+Segmentation semantics follow the reference:
+  - ``seg_method="uniform"``: split all layers into ``num_stages`` nearly
+    equal runs.
+  - ``seg_method="layer:Name"``: count only layers whose class name matches
+    ``Name``; distribute those evenly; unmatched prefix/suffix layers attach
+    to the first/last stage (how the reference keeps embedding on stage 0
+    and the head on the last stage).
+``SharedLayerDesc`` reproduces tied embeddings: descs with the same ``key``
+share ONE layer instance; later occurrences call ``forward_func`` on the
+shared instance, and because the parameter object is literally shared, the
+gradient contributions sum automatically under jax autodiff (the reference
+needs an explicit allreduce between the owning stages).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ....nn.layer import Layer
+
+
+class LayerDesc:
+    """Deferred layer construction: class + ctor args (reference class of
+    the same name)."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError(
+                f"LayerDesc expects a Layer subclass, got {layer_func!r}")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """A LayerDesc whose built instance is shared across all descs with the
+    same ``key`` (tied embeddings)."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class _SharedCall:
+    """Run-function entry for a non-owning SharedLayerDesc occurrence."""
+
+    def __init__(self, layer: Layer, forward_func: Optional[Callable],
+                 key: str):
+        self.layer = layer
+        self.forward_func = forward_func
+        self.key = key
+
+    def __call__(self, *args):
+        if self.forward_func is not None:
+            return self.forward_func(self.layer, *args)
+        return self.layer(*args)
+
+
+class SegmentLayers:
+    """Compute stage boundaries (reference class of the same name)."""
+
+    def __init__(self, layers_desc: Sequence, num_parts: int,
+                 method: str = "uniform"):
+        self._layers_desc = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+        if len(layers_desc) < num_parts:
+            raise ValueError(
+                f"cannot split {len(layers_desc)} layers into {num_parts} stages")
+
+    def do_segment(self) -> List[int]:
+        if self.method == "uniform":
+            return self.uniform(len(self._layers_desc), self.num_parts)
+        m = re.match(r"layer:(.+)", self.method)
+        if m:
+            name = m.group(1)
+            matched = [i for i, d in enumerate(self._layers_desc)
+                       if self._class_name(d) == name]
+            if len(matched) < self.num_parts:
+                raise ValueError(
+                    f"{len(matched)} layers match {name!r}, need >= "
+                    f"{self.num_parts} for {self.num_parts} stages")
+            # distribute matched layers evenly; boundary = first matched
+            # layer of each group (stage 0 additionally takes the prefix)
+            per = self.uniform(len(matched), self.num_parts)
+            parts = [0]
+            for g in range(1, self.num_parts):
+                parts.append(matched[per[g]])
+            parts.append(len(self._layers_desc))
+            return parts
+        raise ValueError(f"unknown seg_method {self.method!r}")
+
+    @staticmethod
+    def _class_name(desc) -> str:
+        if isinstance(desc, LayerDesc):
+            return desc.layer_func.__name__
+        return type(desc).__name__
+
+    @staticmethod
+    def uniform(num_items: int, num_parts: int) -> List[int]:
+        splits = np.array_split(np.arange(num_items), num_parts)
+        parts = [0]
+        for s in splits:
+            parts.append(parts[-1] + len(s))
+        return parts
+
+
+class PipelineLayer(Layer):
+    """The segmented model container.
+
+    ``layers`` is a list of Layer / LayerDesc / SharedLayerDesc / plain
+    callables (parameterless transforms). All entries are materialized (the
+    TPU build is single-controller); ``forward`` runs the full stack — the
+    serial/eager reference path. The pipelined fast path lives in
+    ``PipelineParallel``/``PipelineTrainStep``, which consume:
+
+      - ``stack_region()``: the maximal run [start, end) of entries with
+        identical parameter structure — the region that is stacked over the
+        ``pp`` mesh axis; and
+      - ``shared_groups``: tied-parameter aliases.
+    """
+
+    def __init__(self, layers, num_stages: Optional[int] = None,
+                 topology=None, loss_fn: Optional[Callable] = None,
+                 seg_method: str = "uniform", recompute_interval: int = 0,
+                 recompute_ctx: Optional[Dict] = None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self.recompute_interval = recompute_interval
+        if num_stages is None and topology is None:
+            raise ValueError("need num_stages or topology")
+        if num_stages is None:
+            num_stages = topology.get_pipe_parallel_world_size()
+        self._num_stages = int(num_stages)
+        self._layers_desc = list(layers)
+
+        # ---- build: materialize every desc; share instances by key
+        self.shared_layers: Dict[str, Layer] = {}
+        self.shared_weight_attrs: Dict[str, str] = {}
+        # maps run_function index -> shared key for non-owning occurrences
+        self._shared_uses: Dict[int, str] = {}
+        # maps shared key -> run_function index that REGISTERED the instance
+        # (where its params live in the flat param dict)
+        self._shared_owner_idx: Dict[str, int] = {}
+        self.run_function: List[Any] = []
+        for idx, d in enumerate(self._layers_desc):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self.shared_layers:
+                    layer = d.build_layer()
+                    self.shared_layers[d.layer_name] = layer
+                    self.shared_weight_attrs[d.layer_name] = d.shared_weight_attr
+                    self.add_sublayer(str(idx), layer)
+                    self._shared_owner_idx[d.layer_name] = idx
+                    if d.forward_func is None:
+                        self.run_function.append(layer)
+                    else:
+                        self.run_function.append(
+                            _SharedCall(layer, d.forward_func, d.layer_name))
+                        self._shared_uses[idx] = d.layer_name
+                else:
+                    layer = self.shared_layers[d.layer_name]
+                    self.run_function.append(
+                        _SharedCall(layer, d.forward_func, d.layer_name))
+                    self._shared_uses[idx] = d.layer_name
+            elif isinstance(d, LayerDesc):
+                layer = d.build_layer()
+                self.add_sublayer(str(idx), layer)
+                self.run_function.append(layer)
+            elif isinstance(d, Layer):
+                self.add_sublayer(str(idx), d)
+                self.run_function.append(d)
+            elif callable(d):
+                self.run_function.append(d)
+            else:
+                raise TypeError(f"unsupported pipeline entry {d!r}")
+
+        # ---- segment
+        self.segment_parts = SegmentLayers(
+            self._layers_desc, self._num_stages, seg_method).do_segment()
+
+    # ---------------------------------------------------------------- eager
+    def forward(self, *args):
+        out = args
+        for fn in self.run_function:
+            out = fn(*out) if isinstance(out, tuple) else fn(out)
+            if not isinstance(out, tuple):
+                out = (out,)
+        return out[0] if len(out) == 1 else out
+
+    # ------------------------------------------------------------- metadata
+    def get_num_stages(self) -> int:
+        return self._num_stages
+
+    def get_stage_range(self, stage: int):
+        return self.segment_parts[stage], self.segment_parts[stage + 1]
+
+    def get_stage_layers(self, stage: int):
+        a, b = self.get_stage_range(stage)
+        return self.run_function[a:b]
+
+    def _param_signature(self, entry) -> Optional[tuple]:
+        """Structure key for stackability: relative param names+shapes+dtypes.
+        None for non-Layer entries and shared uses (never stackable)."""
+        if not isinstance(entry, Layer) or isinstance(entry, _SharedCall):
+            return None
+        sig = tuple(sorted(
+            (name, tuple(p.shape), str(p.dtype))
+            for name, p in entry.named_parameters()))
+        return sig if sig else None
+
+    def stack_region(self):
+        """Maximal run [start, end) of identically-structured Layer entries —
+        the region the SPMD schedule shards over the pp axis. Entries outside
+        it (embedding, final norm, head, reshapes) run un-pipelined on every
+        device (replicated prefix/suffix compute)."""
+        sigs = [self._param_signature(e) for e in self.run_function]
+        best = (0, 0)
+        i = 0
+        n = len(sigs)
+        while i < n:
+            if sigs[i] is None:
+                i += 1
+                continue
+            j = i + 1
+            while j < n and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = j
+        return best
+
+    def describe(self) -> str:
+        lines = []
+        for s in range(self._num_stages):
+            a, b = self.get_stage_range(s)
+            names = [SegmentLayers._class_name(d)
+                     for d in self._layers_desc[a:b]]
+            lines.append(f"stage {s}: layers [{a}, {b}) = {names}")
+        return "\n".join(lines)

@@ -1,0 +1,1 @@
+from . import p2p_communication  # noqa: F401

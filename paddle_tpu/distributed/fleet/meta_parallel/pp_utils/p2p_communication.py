@@ -1,0 +1,87 @@
+"""p2p_communication — stage-to-stage transfer API parity.
+
+Reference: .../meta_parallel/pp_utils/p2p_communication.py: NCCL send/recv
+pairs with a ``SendRecvMeta`` shape/dtype handshake (the receiver must
+allocate before NCCL recv), batched isend/irecv.
+
+On TPU the production path does NOT use these: the pipelined train step is
+one SPMD program whose stage shift is an XLA collective-permute (see
+pipeline_parallel.py), so shapes are static and no handshake exists. This
+module keeps the reference surface for user code/tests that drive p2p
+manually — each call forwards to the eager collective facade
+(distributed.communication.p2p) over the pp group.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..... import distributed as dist
+
+
+class SendRecvMeta:
+    """Records activation shapes/dtypes exchanged between stages. The
+    reference sends this over the wire once (p2p_cache_shape); here shapes
+    are static under jit, so it is pure bookkeeping."""
+
+    def __init__(self):
+        self.send_shape_message: Optional[Tuple] = None
+        self.send_dtype_message: Optional[Tuple] = None
+        self.recv_shape_message: Optional[Tuple] = None
+        self.recv_dtype_message: Optional[Tuple] = None
+        self.has_send_meta = False
+        self.has_recv_meta = False
+
+    def set_send_message(self, tensor_or_tuple):
+        ts = (tensor_or_tuple if isinstance(tensor_or_tuple, (tuple, list))
+              else (tensor_or_tuple,))
+        self.send_shape_message = tuple(tuple(t.shape) for t in ts)
+        self.send_dtype_message = tuple(str(t.dtype) for t in ts)
+        self.has_send_meta = True
+
+    def recv_meta(self, group=None):
+        # static shapes: the handshake is a no-op; mirror send → recv
+        self.recv_shape_message = self.send_shape_message
+        self.recv_dtype_message = self.send_dtype_message
+        self.has_recv_meta = self.has_send_meta
+
+    def send_meta(self, tensor_or_tuple, group=None):
+        self.set_send_message(tensor_or_tuple)
+
+
+def _pp_group(hcg):
+    return hcg.get_pipe_parallel_group() if hcg is not None else None
+
+
+def send_forward(output_tensor, pp_last_stage: bool, hcg=None):
+    if pp_last_stage:
+        return None
+    g = _pp_group(hcg)
+    nxt = (g.rank + 1) % g.nranks if g else 1
+    return dist.send(output_tensor, dst=nxt, group=g)
+
+
+def recv_forward(pp_first_stage: bool, ref_tensor=None, hcg=None):
+    if pp_first_stage:
+        return None
+    g = _pp_group(hcg)
+    prev = (g.rank - 1) % g.nranks if g else 0
+    return dist.recv(ref_tensor, src=prev, group=g)
+
+
+def send_backward(input_tensor_grad, pp_first_stage: bool, hcg=None):
+    if pp_first_stage:
+        return None
+    g = _pp_group(hcg)
+    prev = (g.rank - 1) % g.nranks if g else 0
+    return dist.send(input_tensor_grad, dst=prev, group=g)
+
+
+def recv_backward(pp_last_stage: bool, ref_tensor=None, hcg=None):
+    if pp_last_stage:
+        return None
+    g = _pp_group(hcg)
+    nxt = (g.rank + 1) % g.nranks if g else 1
+    return dist.recv(ref_tensor, src=nxt, group=g)

@@ -5,5 +5,8 @@ ships the exemplars the north-star metric is measured on (BASELINE.json):
 GPT-3 345M, Llama-2 7B/70B, an ERNIE-style MoE, and an SD UNet.
 """
 
-from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTForCausalLM, GPTForCausalLMPipe, GPTModel,
+    GPTPretrainingCriterion,
+)
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401

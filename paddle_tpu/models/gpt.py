@@ -139,6 +139,73 @@ class GPTModel(Layer):
         return self.ln_f(x)
 
 
+class GPTEmbeddingPipe(Layer):
+    """First pipeline entry: token + position embedding (+ dropout).
+    Shared (tied) with the head via SharedLayerDesc key "embed"."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        init = I.Normal(0.0, config.initializer_range)
+        self.wte = Embedding(config.vocab_size, config.hidden_size,
+                             weight_attr=ParamAttr(initializer=init))
+        self.wpe = Embedding(config.max_position_embeddings, config.hidden_size,
+                             weight_attr=ParamAttr(initializer=init))
+        self.drop = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        position_ids = ops.arange(s, dtype="int64").unsqueeze(0)
+        return self.drop(self.wte(input_ids) + self.wpe(position_ids))
+
+
+def _embedding_as_head(layer: GPTEmbeddingPipe, hidden):
+    """forward_func for the tied head occurrence: logits via wte^T."""
+    return ops.matmul(hidden, layer.wte.weight, transpose_y=True)
+
+
+class GPTPretrainingCriterion(Layer):
+    """loss_fn for the pipe model: mean CE over all tokens."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.vocab_size = config.vocab_size
+
+    def forward(self, logits, labels):
+        return F.cross_entropy(logits.reshape([-1, self.vocab_size]),
+                               labels.reshape([-1]), reduction="mean")
+
+
+def GPTForCausalLMPipe(config: GPTConfig, num_stages: Optional[int] = None,
+                       topology=None, seg_method: str = "layer:GPTBlock",
+                       recompute_interval: int = 0):
+    """The pipeline-parallel GPT exemplar (reference: PaddleNLP's
+    GPTForCausalLMPipe(PipelineLayer); the PipelineLayer mechanics are
+    SURVEY.md §2.2 "meta_parallel: PP"). Returns a PipelineLayer whose
+    uniform GPTBlock region is stacked over the pp mesh axis by
+    PipelineTrainStep."""
+    from ..distributed.fleet.meta_parallel.pp_layers import (
+        LayerDesc, PipelineLayer, SharedLayerDesc)
+
+    descs = [
+        SharedLayerDesc("embed", GPTEmbeddingPipe, None, "wte.weight", config),
+    ]
+    descs += [LayerDesc(GPTBlock, config)
+              for _ in range(config.num_hidden_layers)]
+    descs.append(LayerDesc(LayerNorm, config.hidden_size,
+                           epsilon=config.layer_norm_epsilon))
+    if config.tie_word_embeddings:
+        descs.append(SharedLayerDesc(
+            "embed", GPTEmbeddingPipe, _embedding_as_head, "wte.weight",
+            config))
+    else:
+        descs.append(LayerDesc(Linear, config.hidden_size, config.vocab_size,
+                               bias_attr=False))
+    return PipelineLayer(
+        descs, num_stages=num_stages, topology=topology,
+        loss_fn=GPTPretrainingCriterion(config), seg_method=seg_method,
+        recompute_interval=recompute_interval)
+
+
 class GPTForCausalLM(Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
