@@ -1,0 +1,406 @@
+"""Flash attention for TPU in Pallas.
+
+Reference: paddle/phi/kernels/gpu/flash_attn_kernel.cu (vendored
+FlashAttention-2) + python/paddle/nn/functional/flash_attention.py. The TPU
+design is the standard online-softmax block algorithm laid out for the
+MXU/VMEM hierarchy:
+
+  - fwd: grid (batch*heads, q_blocks); K/V rows for the (batch, head) live
+    in VMEM; a fori_loop walks kv blocks keeping running max ``m``, running
+    denominator ``l`` and the f32 accumulator; causal blocks above the
+    diagonal are skipped entirely (not just masked).
+  - bwd: two kernels recomputing P from (q, k, saved logsumexp) — one
+    gridded over q blocks producing dq, one over kv blocks producing dk/dv.
+    This is the FlashAttention-2 backward with D_i = rowsum(dO * O)
+    precomputed outside.
+  - varlen (flash_attn_unpadded / segment masking): optional int32 segment
+    ids mask cross-segment attention, the TPU-idiomatic replacement for
+    ragged varlen batches (static shapes). Padding rows should carry a
+    dedicated segment id; they then only attend to other padding rows, and
+    their loss contribution is masked out by the caller. Rows whose segment
+    matches NO kv position emit zeros (fwd) and zero grads (bwd).
+
+All matmuls run with preferred_element_type=float32; inputs may be bf16.
+Layout at this level is (BH, S, D); the (B, S, H, D) paddle-convention
+wrapper is ``flash_attention_bshd``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu registers TPU lowerings — unavailable on CPU-only test envs
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover - CPU CI path (interpret mode)
+    pltpu = None
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ============================================================ forward kernel
+def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref,
+                o_ref, lse_ref, *, causal: bool, sm_scale: float,
+                block_k: int, kv_len: int):
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
+
+    m = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros((block_q, d), jnp.float32)
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    if causal:
+        # only kv blocks intersecting the causal triangle (qi is traced)
+        num_kv = jnp.minimum(
+            (qi * block_q + block_q + block_k - 1) // block_k,
+            kv_len // block_k)
+    else:
+        num_kv = kv_len // block_k
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bq, bk)
+
+        kv_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+        if seg_q_ref is not None:
+            sq = seg_q_ref[0]                               # (bq, 1)
+            sk = seg_kv_ref[0, pl.ds(ki * block_k, block_k), 0].reshape(
+                1, block_k)
+            s = jnp.where(sq == sk, s, _NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        # clamp for fully-masked rows: with m_new == -inf, exp(s - m_new)
+        # would be exp(0) = 1 for every masked score — clamping to 0 makes
+        # p = exp(-1e30) = 0 so masked rows emit zeros, and the saved
+        # lse = 0 + log(1) keeps the backward's p = exp(-1e30 - 0) = 0 too
+        m_new = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = alpha * acc + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m, l, acc))
+
+    # fully-masked rows (e.g. padding segments) have l == 0 — emit zeros
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)                        # (bq, 1)
+
+
+def _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k):
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    if sq % block_q or skv % block_k:
+        # NotImplementedError (not assert) so the sdpa dispatch falls back
+        # to the dense XLA path for odd sequence lengths
+        raise NotImplementedError(
+            f"flash_attention needs seq lens ({sq}, {skv}) divisible by "
+            f"blocks ({block_q}, {block_k}); pad or use the dense path")
+    grid = (bh, sq // block_q)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, skv, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, skv, d), lambda b, i: (b, 0, 0)),
+    ]
+    args = [q, k, v]
+    if seg_q is not None:
+        # segments ride with a trailing singleton so the (block, 1) layout
+        # satisfies mosaic's last-two-dims rule (1 == array dim)
+        in_specs += [
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, skv, 1), lambda b, i: (b, 0, 0)),
+        ]
+        args += [seg_q[..., None], seg_kv[..., None]]
+        kernel = functools.partial(
+            _fwd_kernel, causal=causal, sm_scale=sm_scale,
+            block_k=block_k, kv_len=skv)
+    else:
+        kernel = functools.partial(
+            lambda qr, kr, vr, o, s, **kw: _fwd_kernel(
+                qr, kr, vr, None, None, o, s, **kw),
+            causal=causal, sm_scale=sm_scale, block_k=block_k, kv_len=skv)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*args)
+    return out, lse
+
+
+# =========================================================== backward kernels
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   seg_q_ref, seg_kv_ref, dq_ref, *, causal, sm_scale,
+                   block_k, kv_len):
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]                                        # (bq, 1)
+    delta = delta_ref[0]                                    # (bq, 1)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    if causal:
+        num_kv = jnp.minimum(
+            (qi * block_q + block_q + block_k - 1) // block_k,
+            kv_len // block_k)
+    else:
+        num_kv = kv_len // block_k
+
+    def body(ki, dq):
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        kv_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+        if seg_q_ref is not None:
+            sq_ = seg_q_ref[0]                              # (bq, 1)
+            sk_ = seg_kv_ref[0, pl.ds(ki * block_k, block_k), 0].reshape(
+                1, block_k)
+            s = jnp.where(sq_ == sk_, s, _NEG_INF)
+        p = jnp.exp(s - lse)                               # (bq, bk)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_kv, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    seg_q_ref, seg_kv_ref, dk_ref, dv_ref, *, causal,
+                    sm_scale, block_q, q_len):
+    ki = pl.program_id(1)
+    block_k = k_ref.shape[1]
+    d = k_ref.shape[2]
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    kv_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    if causal:
+        # q blocks at/below the diagonal: first q row that can see this kv
+        start_q = (ki * block_k) // block_q
+    else:
+        start_q = 0
+    num_q = q_len // block_q
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32) * sm_scale
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q), :]   # (bq, 1)
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q), :]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        if causal:
+            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+        if seg_q_ref is not None:
+            sq_ = seg_q_ref[0, pl.ds(qi * block_q, block_q), :]  # (bq, 1)
+            sk_ = seg_kv_ref[0, :, 0].reshape(1, block_k)
+            s = jnp.where(sq_ == sk_, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk, dv = jax.lax.fori_loop(
+        start_q, num_q, body,
+        (jnp.zeros((block_k, d), jnp.float32),
+         jnp.zeros((block_k, d), jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)   # note: dk already has sm_scale via q
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(causal, sm_scale, block_q, block_k, res, g):
+    q, k, v, seg_q, seg_kv, out, lse = res
+    do = g[0] if isinstance(g, (tuple, list)) else g
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    bq = min(block_q, sq)
+    bk = min(block_k, skv)
+
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1, keepdims=True)                # (bh, sq, 1)
+
+    has_seg = seg_q is not None
+    seg3 = [seg_q[..., None], seg_kv[..., None]] if has_seg else []
+    common = [q, k, v, do, lse, delta] + seg3
+
+    in_specs_dq = [
+        pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),   # q
+        pl.BlockSpec((1, skv, d), lambda b, i: (b, 0, 0)),  # k
+        pl.BlockSpec((1, skv, d), lambda b, i: (b, 0, 0)),  # v
+        pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),   # do
+        pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),   # lse
+        pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),   # delta
+    ]
+    if has_seg:
+        in_specs_dq += [pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+                        pl.BlockSpec((1, skv, 1), lambda b, i: (b, 0, 0))]
+        dq_kernel = functools.partial(
+            _bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
+            block_k=bk, kv_len=skv)
+    else:
+        dq_kernel = functools.partial(
+            lambda qr, kr, vr, dor, lr, der, dqr, **kw: _bwd_dq_kernel(
+                qr, kr, vr, dor, lr, der, None, None, dqr, **kw),
+            causal=causal, sm_scale=sm_scale, block_k=bk, kv_len=skv)
+
+    dq = pl.pallas_call(
+        dq_kernel, grid=(bh, sq // bq),
+        in_specs=in_specs_dq,
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(*common)
+
+    in_specs_dkv = [
+        pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),   # q
+        pl.BlockSpec((1, bk, d), lambda b, i: (b, i, 0)),   # k
+        pl.BlockSpec((1, bk, d), lambda b, i: (b, i, 0)),   # v
+        pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),   # do
+        pl.BlockSpec((1, sq, 1), lambda b, i: (b, 0, 0)),   # lse
+        pl.BlockSpec((1, sq, 1), lambda b, i: (b, 0, 0)),   # delta
+    ]
+    if has_seg:
+        in_specs_dkv += [pl.BlockSpec((1, sq, 1), lambda b, i: (b, 0, 0)),
+                         pl.BlockSpec((1, bk, 1), lambda b, i: (b, i, 0))]
+        dkv_kernel = functools.partial(
+            _bwd_dkv_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=bq, q_len=sq)
+    else:
+        dkv_kernel = functools.partial(
+            lambda qr, kr, vr, dor, lr, der, dkr, dvr, **kw: _bwd_dkv_kernel(
+                qr, kr, vr, dor, lr, der, None, None, dkr, dvr, **kw),
+            causal=causal, sm_scale=sm_scale, block_q=bq, q_len=sq)
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel, grid=(bh, skv // bk),
+        in_specs=in_specs_dkv,
+        out_specs=[pl.BlockSpec((1, bk, d), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, bk, d), lambda b, i: (b, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        interpret=_interpret(),
+    )(*common)
+
+    return dq, dk, dv, None, None
+
+
+# ============================================================== public entry
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_attention(q, k, v, seg_q, seg_kv, causal, sm_scale,
+                     block_q, block_k):
+    out, _ = _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k):
+    out, lse = _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k)
+    return out, (q, k, v, seg_q, seg_kv, out, lse)
+
+
+_flash_attention.defvjp(_flash_fwd_rule, _bwd)
+
+
+def flash_attention(q, k, v, segment_ids: Optional[jax.Array] = None,
+                    kv_segment_ids: Optional[jax.Array] = None,
+                    causal: bool = True, sm_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K):
+    """(BH, S, D)-layout flash attention. segment_ids: (BH, S) int32 — rows
+    attend only within their segment (varlen batches packed statically)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if segment_ids is not None and kv_segment_ids is None:
+        kv_segment_ids = segment_ids
+    return _flash_attention(q, k, v, segment_ids, kv_segment_ids,
+                            causal, sm_scale, block_q, block_k)
+
+
+def flash_attention_bshd(q, k, v, segment_ids=None, kv_segment_ids=None,
+                         causal: bool = True,
+                         sm_scale: Optional[float] = None,
+                         block_q: int = DEFAULT_BLOCK_Q,
+                         block_k: int = DEFAULT_BLOCK_K):
+    """Paddle-convention (B, S, H, D) wrapper (reference:
+    python/paddle/nn/functional/flash_attention.py uses [batch, seq, heads,
+    dim]). ``segment_ids``: (B, S_q); ``kv_segment_ids``: (B, S_kv),
+    defaulting to ``segment_ids`` when the lengths match."""
+    b, s, h, d = q.shape
+    skv = k.shape[1]
+
+    def to_bhsd(t, sl):
+        return jnp.swapaxes(t, 1, 2).reshape(b * h, sl, d)
+
+    qf, kf, vf = to_bhsd(q, s), to_bhsd(k, skv), to_bhsd(v, skv)
+    seg_q = seg_kv = None
+    if segment_ids is not None:
+        if kv_segment_ids is None:
+            if s != skv:
+                raise ValueError(
+                    "kv_segment_ids required when q and kv lengths differ")
+            kv_segment_ids = segment_ids
+        seg_q = jnp.repeat(segment_ids, h, axis=0)
+        seg_kv = jnp.repeat(kv_segment_ids, h, axis=0)
+    out = flash_attention(qf, kf, vf, seg_q, seg_kv, causal, sm_scale,
+                          block_q, block_k)
+    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)

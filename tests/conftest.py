@@ -26,6 +26,10 @@ try:
     for _name in list(_xb._backend_factories):
         if _name not in ("cpu",):
             _xb._backend_factories.pop(_name, None)
+    # keep "tpu" a KNOWN platform (no factory): pallas/checkify register
+    # tpu lowering rules at import time and validate the name against
+    # xb.known_platforms()
+    _xb._platform_aliases.setdefault("tpu", "tpu")
 except Exception:
     pass
 

@@ -1,0 +1,152 @@
+"""Flash-attention Pallas kernel vs dense reference (OpTest pattern:
+numpy/jnp reference + gradient check — SURVEY.md §4 fixture 1).
+
+Runs in Pallas interpret mode on CPU; the same code compiles for TPU.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels.flash_attention import (
+    flash_attention, flash_attention_bshd,
+)
+
+
+def dense_ref(q, k, v, causal=True, seg_q=None, seg_kv=None):
+    """O(S^2) reference in f32."""
+    qf, kf, vf = (t.astype(jnp.float32) for t in (q, k, v))
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) / np.sqrt(q.shape[-1])
+    mask = jnp.ones(s.shape, bool)
+    if causal:
+        mask &= jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))[None]
+    if seg_q is not None:
+        mask &= seg_q[:, :, None] == seg_kv[:, None, :]
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    # rows with no visible kv: zero output (kernel contract)
+    any_visible = jnp.any(mask, axis=-1, keepdims=True)
+    p = jnp.where(any_visible, p, 0.0)
+    return jnp.einsum("bqk,bkd->bqd", p, vf).astype(q.dtype)
+
+
+def make_qkv(bh=2, s=256, d=64, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    shape = (bh, s, d)
+    return tuple(jnp.asarray(rng.standard_normal(shape) * 0.5, dtype)
+                 for _ in range(3))
+
+
+class TestFlashForward:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, causal):
+        q, k, v = make_qkv()
+        out = flash_attention(q, k, v, causal=causal)
+        ref = dense_ref(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_segment_ids(self):
+        q, k, v = make_qkv(bh=2, s=256)
+        # two packed sequences per row + a padding segment
+        seg = jnp.concatenate([
+            jnp.zeros((2, 96), jnp.int32),
+            jnp.ones((2, 96), jnp.int32),
+            jnp.full((2, 64), 7, jnp.int32),
+        ], axis=1)
+        out = flash_attention(q, k, v, segment_ids=seg, causal=True)
+        ref = dense_ref(q, k, v, causal=True, seg_q=seg, seg_kv=seg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_fully_masked_rows_emit_zeros(self):
+        q, k, v = make_qkv(bh=1, s=128)
+        seg_q = jnp.full((1, 128), 3, jnp.int32)
+        seg_kv = jnp.full((1, 128), 5, jnp.int32)   # never matches
+        out = flash_attention(q, k, v, segment_ids=seg_q,
+                              kv_segment_ids=seg_kv, causal=False)
+        np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-6)
+
+    def test_non_divisible_seq_raises_not_implemented(self):
+        q, k, v = make_qkv(s=300)
+        with pytest.raises(NotImplementedError):
+            flash_attention(q, k, v)
+
+    def test_bshd_layout(self):
+        rng = np.random.default_rng(3)
+        b, s, h, d = 2, 128, 4, 32
+        q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+                   for _ in range(3))
+        out = flash_attention_bshd(q, k, v, causal=True)
+        # reference on flattened heads
+        qf = jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
+        kf = jnp.swapaxes(k, 1, 2).reshape(b * h, s, d)
+        vf = jnp.swapaxes(v, 1, 2).reshape(b * h, s, d)
+        ref = dense_ref(qf, kf, vf, causal=True)
+        ref = jnp.swapaxes(ref.reshape(b, h, s, d), 1, 2)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+class TestFlashBackward:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_grads_match_dense(self, causal):
+        q, k, v = make_qkv(bh=2, s=256, d=64, seed=5)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(dense_ref(q, k, v, causal=causal) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gd, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4,
+                err_msg=f"d{name}")
+
+    def test_grads_with_segments(self):
+        q, k, v = make_qkv(bh=1, s=256, seed=9)
+        seg = jnp.concatenate([jnp.zeros((1, 128), jnp.int32),
+                               jnp.ones((1, 128), jnp.int32)], axis=1)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, segment_ids=seg) ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(dense_ref(q, k, v, True, seg, seg) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gd, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4,
+                err_msg=f"d{name}")
+
+    def test_fully_masked_rows_zero_grads(self):
+        q, k, v = make_qkv(bh=1, s=128, seed=2)
+        seg_q = jnp.full((1, 128), 3, jnp.int32)
+        seg_kv = jnp.full((1, 128), 5, jnp.int32)
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, segment_ids=seg_q, kv_segment_ids=seg_kv,
+                causal=False) ** 2)
+
+        gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(np.asarray(gq), 0.0, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(gk), 0.0, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(gv), 0.0, atol=1e-6)
+
+    def test_bf16_close(self):
+        q, k, v = make_qkv(bh=1, s=128, d=64, seed=4, dtype=jnp.bfloat16)
+        out = flash_attention(q, k, v, causal=True)
+        ref = dense_ref(q.astype(jnp.float32), k.astype(jnp.float32),
+                        v.astype(jnp.float32), causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref), atol=3e-2, rtol=3e-2)
