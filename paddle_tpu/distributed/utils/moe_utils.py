@@ -1,0 +1,60 @@
+"""global_scatter / global_gather — MoE all-to-all parity surface.
+
+Reference: paddle/fluid/operators/collective/global_scatter_op.cu /
+global_gather_op.cu + python/paddle/distributed/utils/moe_utils.py: dynamic
+all-to-all moving ragged per-expert token batches between ranks (grad of
+scatter = gather and vice versa).
+
+On TPU the production MoE path never calls these — the static-capacity
+einsum dispatch (incubate/.../moe/moe_layer.py) lets XLA emit the
+all-to-all from shardings. These functions reproduce the reference's
+single-controller semantics (host-visible counts, ragged repack) for user
+code that calls them directly; they run through ``apply_op`` so autodiff
+works (the tape's vjp of the repack is the inverse repack, matching the
+reference's scatter<->gather grad pairing).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op, _val
+
+
+def _host_counts(c):
+    return np.asarray(_val(c)).astype(np.int64).ravel()
+
+
+def _repack(xv, src_counts, dst_counts):
+    """Move run-length blocks of rows from src layout to dst layout."""
+    total = int(dst_counts.sum())
+    out = jnp.zeros((total,) + xv.shape[1:], xv.dtype)
+    src = dst = 0
+    for i in range(src_counts.shape[0]):
+        n = int(src_counts[i])
+        if n:
+            out = out.at[dst:dst + n].set(xv[src:src + n])
+        src += n
+        dst += int(dst_counts[i]) if i < dst_counts.shape[0] else n
+    return out
+
+
+def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Rows of ``x`` (grouped by [rank, expert] run-lengths in local_count)
+    repacked into the receiving layout sized by global_count."""
+    lc, gc = _host_counts(local_count), _host_counts(global_count)
+    if int(lc.sum()) != _val(x).shape[0]:
+        raise ValueError(
+            f"local_count sums to {int(lc.sum())}, x has {_val(x).shape[0]} rows")
+    return apply_op("global_scatter", lambda a: _repack(a, lc, gc), x)
+
+
+def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Inverse of global_scatter (expert layout -> original token order)."""
+    lc, gc = _host_counts(local_count), _host_counts(global_count)
+    if int(gc.sum()) != _val(x).shape[0]:
+        raise ValueError(
+            f"global_count sums to {int(gc.sum())}, x has {_val(x).shape[0]} rows")
+    return apply_op("global_gather", lambda a: _repack(a, gc, lc), x)

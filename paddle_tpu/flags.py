@@ -126,6 +126,11 @@ define_flag("check_nan_inf_level", 0, "0: abort on nan/inf; >=1: report only.")
 define_flag("benchmark", False, "Synchronize after each op and log timings.")
 define_flag("deterministic", False, "Force deterministic kernels where possible.")
 define_flag("use_pallas", True, "Use Pallas fused kernels where available (vs pure-XLA fallbacks).")
+define_flag("flash_attn_min_seqlen", 2048,
+            "Dispatch sdpa to the Pallas flash kernel only at seq >= this; "
+            "below it XLA's fused dense attention is faster on v5e (measured "
+            "GPT-345M @1024: 0.257 vs 0.236 MFU) while flash wins on memory "
+            "scaling at long seq. 0 = always use flash.")
 define_flag("allocator_strategy", "auto_growth", "Kept for API parity; PJRT owns memory on TPU.")
 define_flag("fraction_of_gpu_memory_to_use", 0.92, "API parity; PJRT owns memory on TPU.")
 define_flag("log_level", 1, "Framework log verbosity (GLOG_v analogue).")

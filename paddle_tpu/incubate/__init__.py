@@ -1,3 +1,3 @@
 """paddle_tpu.incubate (reference: python/paddle/incubate/)."""
 
-from . import nn  # noqa: F401
+from . import distributed, nn  # noqa: F401

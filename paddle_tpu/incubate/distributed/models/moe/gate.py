@@ -1,0 +1,86 @@
+"""MoE gates (reference: python/paddle/incubate/distributed/models/moe/gate/
+{naive_gate,gshard_gate,switch_gate}.py).
+
+A gate maps token activations (T, d) to routing decisions. All gates here
+return the raw logits; top-k selection / capacity / auxiliary losses are
+computed in the static-shape dispatch (moe_layer.top_k_dispatch) so every
+gate is jit-friendly. Aux losses are stashed on the layer (``get_loss``)
+mirroring the reference's ``gate.get_loss(clear=True)`` protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .....core.tensor import Tensor
+from .....nn import functional as F
+from .....nn import initializer as I
+from .....nn.layer import Layer
+from .....nn.param_attr import ParamAttr
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 top_k: int = 2):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert            # experts per rank (reference)
+        self.world_size = world_size
+        self.tot_expert = num_expert * world_size
+        self.top_k = top_k
+        self._loss: Optional[Tensor] = None
+
+    def set_loss(self, loss):
+        self._loss = loss
+
+    def get_loss(self, clear: bool = True):
+        l = self._loss
+        if clear:
+            self._loss = None
+        return l
+
+    @property
+    def has_loss(self) -> bool:
+        return self._loss is not None
+
+
+class NaiveGate(BaseGate):
+    """Plain linear gate, top-k softmax weights, no aux loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2):
+        super().__init__(d_model, num_expert, world_size, top_k)
+        self.gate = self.create_parameter(
+            (d_model, self.tot_expert),
+            attr=ParamAttr(initializer=I.XavierUniform()))
+
+    def forward(self, x):
+        return F.linear(x, self.gate)          # logits (T, E)
+
+    aux_loss_mode = None
+
+
+class GShardGate(NaiveGate):
+    """GShard top-2 gate: load-balance aux loss l_aux = E * sum(me * ce),
+    second expert kept with probability ~ its prob (random routing)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        super().__init__(d_model, num_expert, world_size, top_k=top_k)
+        self.capacity_factor = capacity
+        self.random_routing = random_routing
+
+    aux_loss_mode = "gshard"
+
+
+class SwitchGate(NaiveGate):
+    """Switch-transformer top-1 gate with its load-balance loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, top_k=top_k)
+        self.switch_eps = switch_eps
+        self.capacity_factor = capacity
+
+    aux_loss_mode = "switch"

@@ -34,6 +34,8 @@ def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
             out = apply_op("fused_rms_norm",
                            lambda a, w: rms_norm_pallas(a, w, epsilon),
                            h, norm_weight)
+            if norm_bias is not None:
+                out = out + norm_bias
             return (out, h) if residual is not None else out
         except Exception:
             pass

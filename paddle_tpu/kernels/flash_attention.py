@@ -5,14 +5,17 @@ FlashAttention-2) + python/paddle/nn/functional/flash_attention.py. The TPU
 design is the standard online-softmax block algorithm laid out for the
 MXU/VMEM hierarchy:
 
-  - fwd: grid (batch*heads, q_blocks); K/V rows for the (batch, head) live
-    in VMEM; a fori_loop walks kv blocks keeping running max ``m``, running
-    denominator ``l`` and the f32 accumulator; causal blocks above the
-    diagonal are skipped entirely (not just masked).
-  - bwd: two kernels recomputing P from (q, k, saved logsumexp) — one
-    gridded over q blocks producing dq, one over kv blocks producing dk/dv.
-    This is the FlashAttention-2 backward with D_i = rowsum(dO * O)
-    precomputed outside.
+  - Grids iterate (batch*heads, q_blocks, kv_blocks) with the kv dimension
+    innermost: TPU grid steps run sequentially per core, so the f32
+    accumulators (out-sum, running max m, denominator l) live in REVISITED
+    output blocks that stay VMEM-resident across the kv sweep — only one
+    (block_q, d) + (block_k, d) tile pair is resident at a time, so max
+    sequence length is bounded by HBM, not VMEM (long-context ready).
+  - Causal kv blocks strictly above the diagonal are predicated off with
+    pl.when (no MXU work issued).
+  - bwd: two kernels recomputing P from (q, k, saved logsumexp) — dq sweeps
+    kv blocks, dk/dv sweeps q blocks — FlashAttention-2's backward with
+    D_i = rowsum(dO * O) precomputed outside.
   - varlen (flash_attn_unpadded / segment masking): optional int32 segment
     ids mask cross-segment attention, the TPU-idiomatic replacement for
     ragged varlen batches (static shapes). Padding rows should carry a
@@ -49,69 +52,63 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _dims(ref_shape):
+    return ref_shape[1], ref_shape[2]
+
+
 # ============================================================ forward kernel
 def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref,
-                o_ref, lse_ref, *, causal: bool, sm_scale: float,
-                block_k: int, kv_len: int):
+                acc_ref, m_ref, l_ref, *, causal: bool, sm_scale: float):
     qi = pl.program_id(1)
-    block_q = q_ref.shape[1]
-    d = q_ref.shape[2]
+    kj = pl.program_id(2)
+    block_q, d = _dims(q_ref.shape)
+    block_k = k_ref.shape[1]
 
-    q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
-
-    m = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q, 1), jnp.float32)
-    acc = jnp.zeros((block_q, d), jnp.float32)
-
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[0] = jnp.zeros_like(acc_ref[0])
+        m_ref[0] = jnp.full_like(m_ref[0], _NEG_INF)
+        l_ref[0] = jnp.zeros_like(l_ref[0])
 
     if causal:
-        # only kv blocks intersecting the causal triangle (qi is traced)
-        num_kv = jnp.minimum(
-            (qi * block_q + block_q + block_k - 1) // block_k,
-            kv_len // block_k)
+        # skip kv blocks strictly above the causal diagonal
+        run = kj * block_k <= qi * block_q + block_q - 1
     else:
-        num_kv = kv_len // block_k
+        run = True
 
-    def body(ki, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)           # (bq, bk)
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
+        k = k_ref[0].astype(jnp.float32)                     # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
 
-        kv_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
         if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kv_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
         if seg_q_ref is not None:
-            sq = seg_q_ref[0]                               # (bq, 1)
-            sk = seg_kv_ref[0, pl.ds(ki * block_k, block_k), 0].reshape(
-                1, block_k)
+            sq = seg_q_ref[0]                                # (bq, 1)
+            sk = seg_kv_ref[0, :, 0].reshape(1, block_k)
             s = jnp.where(sq == sk, s, _NEG_INF)
 
-        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        m_prev, l_prev = m_ref[0], l_ref[0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         # clamp for fully-masked rows: with m_new == -inf, exp(s - m_new)
         # would be exp(0) = 1 for every masked score — clamping to 0 makes
         # p = exp(-1e30) = 0 so masked rows emit zeros, and the saved
         # lse = 0 + log(1) keeps the backward's p = exp(-1e30 - 0) = 0 too
         m_new = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
         p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
-        acc_new = alpha * acc + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[0] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[0] = m_new
+        acc_ref[0] = alpha * acc_ref[0] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
-
-    m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m, l, acc))
-
-    # fully-masked rows (e.g. padding segments) have l == 0 — emit zeros
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)                        # (bq, 1)
 
 
 def _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k):
@@ -125,148 +122,145 @@ def _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k):
         raise NotImplementedError(
             f"flash_attention needs seq lens ({sq}, {skv}) divisible by "
             f"blocks ({block_q}, {block_k}); pad or use the dense path")
-    grid = (bh, sq // block_q)
+    grid = (bh, sq // block_q, skv // block_k)
 
     in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        pl.BlockSpec((1, skv, d), lambda b, i: (b, 0, 0)),
-        pl.BlockSpec((1, skv, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
     ]
     args = [q, k, v]
     if seg_q is not None:
         # segments ride with a trailing singleton so the (block, 1) layout
         # satisfies mosaic's last-two-dims rule (1 == array dim)
         in_specs += [
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, skv, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, 1), lambda b, i, j: (b, j, 0)),
         ]
         args += [seg_q[..., None], seg_kv[..., None]]
-        kernel = functools.partial(
-            _fwd_kernel, causal=causal, sm_scale=sm_scale,
-            block_k=block_k, kv_len=skv)
+        kernel = functools.partial(_fwd_kernel, causal=causal,
+                                   sm_scale=sm_scale)
     else:
         kernel = functools.partial(
-            lambda qr, kr, vr, o, s, **kw: _fwd_kernel(
-                qr, kr, vr, None, None, o, s, **kw),
-            causal=causal, sm_scale=sm_scale, block_k=block_k, kv_len=skv)
+            lambda qr, kr, vr, a, m, l, **kw: _fwd_kernel(
+                qr, kr, vr, None, None, a, m, l, **kw),
+            causal=causal, sm_scale=sm_scale)
 
-    out, lse = pl.pallas_call(
+    # accumulators are revisited output blocks: index maps ignore the kv
+    # grid dim, so the block stays VMEM-resident across the kv sweep
+    acc, m, l = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
             jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ],
         interpret=_interpret(),
     )(*args)
+
+    # fully-masked rows (e.g. padding segments) have l == 0 — emit zeros
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l_safe).astype(q.dtype)
+    lse = m + jnp.log(l_safe)                                # (bh, sq, 1)
     return out, lse
 
 
 # =========================================================== backward kernels
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   seg_q_ref, seg_kv_ref, dq_ref, *, causal, sm_scale,
-                   block_k, kv_len):
+                   seg_q_ref, seg_kv_ref, dq_ref, *, causal, sm_scale):
     qi = pl.program_id(1)
-    block_q = q_ref.shape[1]
-    d = q_ref.shape[2]
-    q = q_ref[0].astype(jnp.float32) * sm_scale
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]                                        # (bq, 1)
-    delta = delta_ref[0]                                    # (bq, 1)
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
+    kj = pl.program_id(2)
+    block_q, d = _dims(q_ref.shape)
+    block_k = k_ref.shape[1]
 
-    if causal:
-        num_kv = jnp.minimum(
-            (qi * block_q + block_q + block_k - 1) // block_k,
-            kv_len // block_k)
-    else:
-        num_kv = kv_len // block_k
+    @pl.when(kj == 0)
+    def _init():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
 
-    def body(ki, dq):
-        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+    run = (kj * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                                     # (bq, 1)
+        delta = delta_ref[0]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        kv_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
         if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kv_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
         if seg_q_ref is not None:
-            sq_ = seg_q_ref[0]                              # (bq, 1)
-            sk_ = seg_kv_ref[0, pl.ds(ki * block_k, block_k), 0].reshape(
-                1, block_k)
+            sq_ = seg_q_ref[0]
+            sk_ = seg_kv_ref[0, :, 0].reshape(1, block_k)
             s = jnp.where(sq_ == sk_, s, _NEG_INF)
-        p = jnp.exp(s - lse)                               # (bq, bk)
-        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+        p = jnp.exp(s - lse)                                 # (bq, bk)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return dq + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+        dq_ref[0] = dq_ref[0] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-
-    dq = jax.lax.fori_loop(0, num_kv, body,
-                           jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     seg_q_ref, seg_kv_ref, dk_ref, dv_ref, *, causal,
-                    sm_scale, block_q, q_len):
+                    sm_scale):
     ki = pl.program_id(1)
+    qj = pl.program_id(2)
     block_k = k_ref.shape[1]
-    d = k_ref.shape[2]
-    k_blk = k_ref[0].astype(jnp.float32)
-    v_blk = v_ref[0].astype(jnp.float32)
-    kv_pos = ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
+    block_q, d = _dims(q_ref.shape)
 
-    if causal:
-        # q blocks at/below the diagonal: first q row that can see this kv
-        start_q = (ki * block_k) // block_q
-    else:
-        start_q = 0
-    num_q = q_len // block_q
+    @pl.when(qj == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
 
-    def body(qi, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32) * sm_scale
-        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q), :]   # (bq, 1)
-        delta = delta_ref[0, pl.ds(qi * block_q, block_q), :]
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+    # causal: q blocks whose END is before this kv block's start never see it
+    run = (qj * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(run)
+    def _step():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
         if causal:
+            q_pos = qj * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kv_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
         if seg_q_ref is not None:
-            sq_ = seg_q_ref[0, pl.ds(qi * block_q, block_q), :]  # (bq, 1)
+            sq_ = seg_q_ref[0]
             sk_ = seg_kv_ref[0, :, 0].reshape(1, block_k)
             s = jnp.where(sq_ == sk_, s, _NEG_INF)
         p = jnp.exp(s - lse)
-        dv_new = dv + jax.lax.dot_general(
+        dv_ref[0] = dv_ref[0] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        dk_new = dk + jax.lax.dot_general(
+        dk_ref[0] = dk_ref[0] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk_new, dv_new
-
-    dk, dv = jax.lax.fori_loop(
-        start_q, num_q, body,
-        (jnp.zeros((block_k, d), jnp.float32),
-         jnp.zeros((block_k, d), jnp.float32)))
-    dk_ref[0] = dk.astype(dk_ref.dtype)   # note: dk already has sm_scale via q
-    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _bwd(causal, sm_scale, block_q, block_k, res, g):
@@ -285,64 +279,64 @@ def _bwd(causal, sm_scale, block_q, block_k, res, g):
     common = [q, k, v, do, lse, delta] + seg3
 
     in_specs_dq = [
-        pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),   # q
-        pl.BlockSpec((1, skv, d), lambda b, i: (b, 0, 0)),  # k
-        pl.BlockSpec((1, skv, d), lambda b, i: (b, 0, 0)),  # v
-        pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),   # do
-        pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),   # lse
-        pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),   # delta
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # q
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),   # k
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),   # v
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # do
+        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),   # lse
+        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),   # delta
     ]
     if has_seg:
-        in_specs_dq += [pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
-                        pl.BlockSpec((1, skv, 1), lambda b, i: (b, 0, 0))]
-        dq_kernel = functools.partial(
-            _bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
-            block_k=bk, kv_len=skv)
+        in_specs_dq += [pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+                        pl.BlockSpec((1, bk, 1), lambda b, i, j: (b, j, 0))]
+        dq_kernel = functools.partial(_bwd_dq_kernel, causal=causal,
+                                      sm_scale=sm_scale)
     else:
         dq_kernel = functools.partial(
             lambda qr, kr, vr, dor, lr, der, dqr, **kw: _bwd_dq_kernel(
                 qr, kr, vr, dor, lr, der, None, None, dqr, **kw),
-            causal=causal, sm_scale=sm_scale, block_k=bk, kv_len=skv)
+            causal=causal, sm_scale=sm_scale)
 
     dq = pl.pallas_call(
-        dq_kernel, grid=(bh, sq // bq),
+        dq_kernel, grid=(bh, sq // bq, skv // bk),
         in_specs=in_specs_dq,
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
         interpret=_interpret(),
     )(*common)
+    dq = (dq * sm_scale).astype(q.dtype)
 
+    # dkv grid: kv blocks outer, q sweep innermost (revisited dk/dv blocks)
     in_specs_dkv = [
-        pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),   # q
-        pl.BlockSpec((1, bk, d), lambda b, i: (b, i, 0)),   # k
-        pl.BlockSpec((1, bk, d), lambda b, i: (b, i, 0)),   # v
-        pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),   # do
-        pl.BlockSpec((1, sq, 1), lambda b, i: (b, 0, 0)),   # lse
-        pl.BlockSpec((1, sq, 1), lambda b, i: (b, 0, 0)),   # delta
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0)),   # q
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),   # k
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),   # v
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0)),   # do
+        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0)),   # lse
+        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0)),   # delta
     ]
     if has_seg:
-        in_specs_dkv += [pl.BlockSpec((1, sq, 1), lambda b, i: (b, 0, 0)),
-                         pl.BlockSpec((1, bk, 1), lambda b, i: (b, i, 0))]
-        dkv_kernel = functools.partial(
-            _bwd_dkv_kernel, causal=causal, sm_scale=sm_scale,
-            block_q=bq, q_len=sq)
+        in_specs_dkv += [pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0)),
+                         pl.BlockSpec((1, bk, 1), lambda b, i, j: (b, i, 0))]
+        dkv_kernel = functools.partial(_bwd_dkv_kernel, causal=causal,
+                                       sm_scale=sm_scale)
     else:
         dkv_kernel = functools.partial(
             lambda qr, kr, vr, dor, lr, der, dkr, dvr, **kw: _bwd_dkv_kernel(
                 qr, kr, vr, dor, lr, der, None, None, dkr, dvr, **kw),
-            causal=causal, sm_scale=sm_scale, block_q=bq, q_len=sq)
+            causal=causal, sm_scale=sm_scale)
 
     dk, dv = pl.pallas_call(
-        dkv_kernel, grid=(bh, skv // bk),
+        dkv_kernel, grid=(bh, skv // bk, sq // bq),
         in_specs=in_specs_dkv,
-        out_specs=[pl.BlockSpec((1, bk, d), lambda b, i: (b, i, 0)),
-                   pl.BlockSpec((1, bk, d), lambda b, i: (b, i, 0))],
-        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
-                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        out_specs=[pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),
+                   pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bh, skv, d), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, skv, d), jnp.float32)],
         interpret=_interpret(),
     )(*common)
-
-    return dq, dk, dv, None, None
+    # dk already carries sm_scale via the scaled q used in ds
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype), None, None
 
 
 # ============================================================== public entry

@@ -1,0 +1,121 @@
+"""Fused RMSNorm in Pallas.
+
+Reference: paddle/phi/kernels/gpu/rms_norm_kernel.cu (fused residual-add +
+rms_norm used by the Llama path). XLA already fuses the jnp composition well;
+this kernel exists for the long-row case (hidden >= 8k) where keeping the
+row resident in VMEM for the two passes (moment + normalize) beats XLA's
+fusion, and as the pattern template for the kernel tier.
+
+fwd:  r = rsqrt(mean(x^2) + eps);  y = x * r * w        (saves r)
+bwd:  dx = r * g*w - x * r^3/H * sum(g*w*x)   (Pallas, row blocks)
+      dw = sum_rows(g * x * r)                (jnp — XLA reduces fine)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_BLOCK_ROWS = 256
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(x_ref, w_ref, y_ref, r_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    y_ref[:] = (x * r * w_ref[:].astype(jnp.float32)).astype(y_ref.dtype)
+    r_ref[:] = r
+
+
+def _bwd_kernel(x_ref, w_ref, g_ref, r_ref, dx_ref, *, h: int):
+    x = x_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    r = r_ref[:]
+    gw = g * w
+    dot = jnp.sum(gw * x, axis=-1, keepdims=True)
+    dx = r * gw - x * (r ** 3) * (dot / h)
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+
+
+def _row_call(kernel, n, h, block, n_out, out_shapes, args):
+    grid = (pl.cdiv(n, block),)
+    in_specs = []
+    for a in args:
+        if a.shape == (1, h):                 # weight: replicated per block
+            in_specs.append(pl.BlockSpec((1, h), lambda i: (0, 0)))
+        elif a.shape[-1] == 1:                # saved r: (N, 1)
+            in_specs.append(pl.BlockSpec((block, 1), lambda i: (i, 0)))
+        else:
+            in_specs.append(pl.BlockSpec((block, h), lambda i: (i, 0)))
+    out_specs = []
+    for s in out_shapes:
+        if s.shape[-1] == 1:
+            out_specs.append(pl.BlockSpec((block, 1), lambda i: (i, 0)))
+        else:
+            out_specs.append(pl.BlockSpec((block, h), lambda i: (i, 0)))
+    if n_out == 1:
+        out_specs, out_shapes = out_specs[0], out_shapes[0]
+    return pl.pallas_call(
+        kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shapes, interpret=_interpret())(*args)
+
+
+def _fwd(x2, w, eps, block):
+    n, h = x2.shape
+    return _row_call(
+        functools.partial(_fwd_kernel, eps=eps), n, h, block, 2,
+        [jax.ShapeDtypeStruct((n, h), x2.dtype),
+         jax.ShapeDtypeStruct((n, 1), jnp.float32)],
+        [x2, w])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms_norm(x2, w, eps, block):
+    y, _ = _fwd(x2, w, eps, block)
+    return y
+
+
+def _rms_fwd_rule(x2, w, eps, block):
+    y, r = _fwd(x2, w, eps, block)
+    return y, (x2, w, r)
+
+
+def _rms_bwd_rule(eps, block, res, g):
+    x2, w, r = res
+    n, h = x2.shape
+    dx = _row_call(
+        functools.partial(_bwd_kernel, h=h), n, h, block, 1,
+        [jax.ShapeDtypeStruct((n, h), x2.dtype)],
+        [x2, w, g, r])
+    dw = jnp.einsum("nh,nh->h", g.astype(jnp.float32),
+                    (x2.astype(jnp.float32) * r)).astype(w.dtype)
+    return dx, dw.reshape(w.shape)
+
+
+_rms_norm.defvjp(_rms_fwd_rule, _rms_bwd_rule)
+
+
+def rms_norm_pallas(x, weight, epsilon: float = 1e-6):
+    """Normalize over the last axis; any leading shape."""
+    orig = x.shape
+    h = orig[-1]
+    n = 1
+    for s in orig[:-1]:
+        n *= s
+    block = _BLOCK_ROWS if n >= _BLOCK_ROWS else max(8, n)
+    x2 = x.reshape(n, h)
+    pad = (-n) % block
+    if pad:
+        x2 = jnp.concatenate(
+            [x2, jnp.zeros((pad, h), x2.dtype)], axis=0)
+    y = _rms_norm(x2, weight.reshape(1, h), float(epsilon), block)
+    if pad:
+        y = y[:n]
+    return y.reshape(orig)

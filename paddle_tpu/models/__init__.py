@@ -10,3 +10,4 @@ from .gpt import (  # noqa: F401
     GPTPretrainingCriterion,
 )
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401
+from .moe_gpt import MoEGPTConfig, MoEGPTForCausalLM  # noqa: F401

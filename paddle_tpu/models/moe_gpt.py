@@ -1,0 +1,106 @@
+"""ERNIE-style MoE GPT exemplar: GPT blocks whose FFN is a mixture of
+experts on alternating layers (the reference measures MoE through
+ERNIE-3.0-style models trained with
+python/paddle/incubate/distributed/models/moe/MoELayer — SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .. import ops
+from ..incubate.distributed.models.moe import MoELayer
+from ..nn import functional as F
+from ..nn.layer import Layer, LayerList
+from ..nn.layers.common import Dropout, Embedding, LayerNorm
+from .gpt import GPTBlock, GPTConfig, GPTSelfAttention
+
+
+@dataclasses.dataclass
+class MoEGPTConfig(GPTConfig):
+    num_experts: int = 8
+    top_k: int = 2
+    moe_every: int = 2           # every Nth block uses MoE FFN
+    capacity_factor: float = 1.2
+    aux_loss_weight: float = 0.01
+    expert_axis: Optional[str] = None   # mesh axis for EP (e.g. "dp")
+
+    @staticmethod
+    def tiny(**kw):
+        d = dict(vocab_size=512, hidden_size=64, num_hidden_layers=4,
+                 num_attention_heads=4, max_position_embeddings=128,
+                 num_experts=4)
+        d.update(kw)
+        return MoEGPTConfig(**d)
+
+
+class MoEGPTBlock(Layer):
+    def __init__(self, config: MoEGPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.attn = GPTSelfAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.moe = MoELayer(
+            d_model=config.hidden_size, num_expert=config.num_experts,
+            d_hidden=config.intermediate_size, top_k=config.top_k,
+            gate="gshard", capacity_factor=config.capacity_factor,
+            expert_axis=config.expert_axis)
+        self.drop = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, attn_mask=None):
+        x = x + self.drop(self.attn(self.ln_1(x), attn_mask))
+        x = x + self.drop(self.moe(self.ln_2(x)))
+        return x
+
+
+class MoEGPTForCausalLM(Layer):
+    """GPT causal LM with MoE FFNs; ``total_aux_loss`` collects the gate
+    losses of every MoE block for the training loss."""
+
+    def __init__(self, config: MoEGPTConfig):
+        super().__init__()
+        self.config = config
+        from ..nn import initializer as I
+        from ..nn.param_attr import ParamAttr
+        init = I.Normal(0.0, config.initializer_range)
+        self.wte = Embedding(config.vocab_size, config.hidden_size,
+                             weight_attr=ParamAttr(initializer=init))
+        self.wpe = Embedding(config.max_position_embeddings, config.hidden_size,
+                             weight_attr=ParamAttr(initializer=init))
+        self.drop = Dropout(config.hidden_dropout_prob)
+        blocks = []
+        for i in range(config.num_hidden_layers):
+            if config.moe_every and (i + 1) % config.moe_every == 0:
+                blocks.append(MoEGPTBlock(config))
+            else:
+                blocks.append(GPTBlock(config))
+        self.h = LayerList(blocks)
+        self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+
+    def total_aux_loss(self):
+        total = None
+        for b in self.h:
+            gate = getattr(getattr(b, "moe", None), "gate", None)
+            if gate is not None and gate.has_loss:
+                l = gate.get_loss()
+                total = l if total is None else total + l
+        return total
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        b, s = input_ids.shape
+        pos = ops.arange(s, dtype="int64").unsqueeze(0)
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for block in self.h:
+            x = block(x, attn_mask)
+        hidden = self.ln_f(x)
+        logits = ops.matmul(hidden, self.wte.weight, transpose_y=True)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]),
+            labels.reshape([-1]), reduction="mean")
+        aux = self.total_aux_loss()
+        if aux is not None:
+            loss = loss + self.config.aux_loss_weight * aux
+        return loss

@@ -1,0 +1,63 @@
+"""Pallas fused rms_norm vs jnp reference (+ gradient check)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels.rms_norm import rms_norm_pallas
+
+
+def ref(x, w, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * r * w.astype(jnp.float32)).astype(x.dtype)
+
+
+@pytest.mark.parametrize("shape", [(4, 128, 256), (300, 512), (8, 64)])
+def test_forward(shape):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    w = jnp.asarray(rng.standard_normal(shape[-1]) * 0.1 + 1.0, jnp.float32)
+    np.testing.assert_allclose(np.asarray(rms_norm_pallas(x, w)),
+                               np.asarray(ref(x, w)), atol=1e-5, rtol=1e-5)
+
+
+def test_grads():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((64, 256)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal(256) * 0.1 + 1.0, jnp.float32)
+
+    gp = jax.grad(lambda x, w: jnp.sum(rms_norm_pallas(x, w) ** 2),
+                  argnums=(0, 1))(x, w)
+    gr = jax.grad(lambda x, w: jnp.sum(ref(x, w) ** 2),
+                  argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gp[0]), np.asarray(gr[0]),
+                               atol=2e-4, rtol=2e-4, err_msg="dx")
+    np.testing.assert_allclose(np.asarray(gp[1]), np.asarray(gr[1]),
+                               atol=2e-4, rtol=2e-4, err_msg="dw")
+
+
+def test_bf16():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((32, 128)), jnp.bfloat16)
+    w = jnp.ones(128, jnp.bfloat16)
+    out = rms_norm_pallas(x, w)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32),
+        np.asarray(ref(x.astype(jnp.float32), w.astype(jnp.float32))),
+        atol=3e-2, rtol=3e-2)
+
+
+def test_incubate_dispatch_matches():
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import functional as IF
+    rng = np.random.default_rng(3)
+    x = paddle.to_tensor(rng.standard_normal((4, 32, 128)).astype("float32"))
+    w = paddle.to_tensor((rng.standard_normal(128) * 0.1 + 1).astype("float32"))
+    out = IF.fused_rms_norm(x, w, epsilon=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(out.numpy()),
+        np.asarray(ref(jnp.asarray(x.numpy()), jnp.asarray(w.numpy()))),
+        atol=1e-5, rtol=1e-5)
