@@ -1,1 +1,5 @@
-from . import sequence_parallel_utils  # noqa: F401
+from . import ring_flash_attention, sequence_parallel_utils  # noqa: F401
+from .ring_flash_attention import (  # noqa: F401
+    ring_flash_attention as ring_flash_attention_fn,
+    sep_scaled_dot_product_attention, ulysses_attention,
+)

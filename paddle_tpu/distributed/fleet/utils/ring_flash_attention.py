@@ -1,0 +1,166 @@
+"""Long-context attention: ring attention + Ulysses (sep) attention.
+
+Reference: the reference ecosystem's balanced ring flash attention
+(paddlenlp/transformers/ring_flash_attention.py (approx., out-of-tree)) and
+the ``sep_degree`` Ulysses axis wired through
+python/paddle/distributed/fleet/base/topology.py — SURVEY.md §5.7.
+
+TPU-native design (this is where the rebuild can exceed the reference —
+SURVEY.md §5.7 "TPU equivalent"):
+
+  - **Ring attention** rides the ICI torus: each sep shard holds a Q/K/V
+    sequence chunk; ``axis_size`` scan steps each compute one block of the
+    online-softmax update and rotate the K/V chunk to the next neighbour
+    with ``lax.ppermute`` — XLA overlaps the permute with the block matmul,
+    so the sequence length per chip is bounded by HBM while communication
+    stays nearest-neighbour. Backward is jax autodiff: the transpose of
+    ppermute is the reverse-direction ppermute, giving the reverse ring
+    without hand-written comm.
+  - **Ulysses attention**: one ``lax.all_to_all`` turns seq-sharded
+    activations into head-sharded ones (each shard sees the FULL sequence
+    for H/P heads), runs ordinary attention, and the inverse all_to_all
+    restores seq sharding. Two collectives total, both on ICI.
+
+Both functions are PER-SHARD code (inside ``jax.shard_map`` over the sep
+axis); ``sep_scaled_dot_product_attention`` is the jit-level wrapper that
+builds the shard_map over the current mesh. Layout: (B, S, H, D) — the
+paddle sdpa convention; S is the GLOBAL length, S/P per shard.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+# ------------------------------------------------------------ ring attention
+def ring_flash_attention(q, k, v, axis_name: str = "sep",
+                         causal: bool = True,
+                         sm_scale: Optional[float] = None):
+    """Per-shard ring attention. q/k/v: (B, C, H, D) local chunks of the
+    (B, S, H, D) global arrays, C = S / axis_size. Returns (B, C, H, D)."""
+    p = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, c, h, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+
+    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * sm_scale   # (B,H,C,D)
+    q_pos = idx * c + lax.broadcasted_iota(jnp.int32, (c, c), 0)
+    kv_iota = lax.broadcasted_iota(jnp.int32, (c, c), 1)
+
+    perm = [(j, (j + 1) % p) for j in range(p)]
+
+    def step(carry, i):
+        m, l, acc, k_cur, v_cur = carry
+        src = (idx - i) % p                       # who produced this chunk
+        kf = jnp.swapaxes(k_cur, 1, 2).astype(jnp.float32)      # (B,H,C,D)
+        vf = jnp.swapaxes(v_cur, 1, 2).astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)
+        if causal:
+            kv_pos = src * c + kv_iota
+            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # clamp fully-masked rows (see kernels/flash_attention.py)
+        m_new = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        pexp = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(pexp, axis=-1, keepdims=True)
+        acc_new = alpha * acc + jnp.einsum("bhqk,bhkd->bhqd", pexp, vf)
+
+        # rotate the kv chunk around the ring (nearest-neighbour on ICI);
+        # XLA overlaps this permute with the next step's matmuls
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
+
+    m0 = jnp.full((b, h, c, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, c, 1), jnp.float32)
+    a0 = jnp.zeros((b, h, c, d), jnp.float32)
+    (m, l, acc, _, _), _ = lax.scan(step, (m0, l0, a0, k, v),
+                                    jnp.arange(p))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l_safe).astype(q.dtype)
+    return jnp.swapaxes(out, 1, 2)                # (B, C, H, D)
+
+
+# --------------------------------------------------------- ulysses attention
+def _dense_sdpa(q, k, v, causal, sm_scale):
+    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * sm_scale
+    kf = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vf = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = lax.broadcasted_iota(jnp.int32, (sq, sk), 0) >= \
+            lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(mask, s, _NEG_INF)
+    o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vf)
+    return jnp.swapaxes(o.astype(q.dtype), 1, 2)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sep", causal: bool = True,
+                      sm_scale: Optional[float] = None,
+                      attn_fn: Optional[Callable] = None):
+    """Per-shard Ulysses attention (reference: the sep_degree axis /
+    head-scatter seq-gather all-to-alls). q/k/v: (B, C, H, D) seq-sharded;
+    requires H % axis_size == 0. Each shard computes FULL-sequence attention
+    for H/P heads, so any single-device attention impl (the Pallas flash
+    kernel included) drops in via ``attn_fn``."""
+    p = lax.axis_size(axis_name)
+    b, c, h, d = q.shape
+    if h % p:
+        raise ValueError(f"num heads {h} not divisible by sep degree {p}")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+
+    def seq_gather(t):   # (B, C, H, D) -> (B, C*P, H/P, D)
+        return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def seq_scatter(t):  # (B, C*P, H/P, D) -> (B, C, H, D)
+        return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = seq_gather(q), seq_gather(k), seq_gather(v)
+    fn = attn_fn or functools.partial(_dense_sdpa, causal=causal,
+                                      sm_scale=sm_scale)
+    out = fn(qg, kg, vg)
+    return seq_scatter(out)
+
+
+# ------------------------------------------------------------- jit-level API
+def sep_scaled_dot_product_attention(
+        q, k, v, mesh: Optional[Mesh] = None, sep_axis: str = "sep",
+        method: str = "ring", causal: bool = True,
+        sm_scale: Optional[float] = None):
+    """Context-parallel sdpa at the jit level: shard_maps the per-shard
+    implementation over ``sep_axis`` (other mesh axes stay under GSPMD).
+    q/k/v: GLOBAL (B, S, H, D); S must divide by the sep degree."""
+    if mesh is None:
+        from ..base_topology import get_hybrid_communicate_group
+        mesh = get_hybrid_communicate_group().get_mesh()
+    if sep_axis not in mesh.shape or mesh.shape[sep_axis] <= 1:
+        return _dense_sdpa(q, k, v, causal,
+                           sm_scale or 1.0 / math.sqrt(q.shape[-1]))
+
+    impl = {"ring": ring_flash_attention, "ulysses": ulysses_attention}[method]
+    fn = functools.partial(impl, axis_name=sep_axis, causal=causal,
+                           sm_scale=sm_scale)
+    spec = P(None, sep_axis, None, None)
+    # manual over sep only; other axes stay GSPMD. check_vma must be True:
+    # this jax version's check_vma=False path re-enters shard_map with
+    # out_specs over ALL mesh axes, which partial-manual mode rejects
+    mapped = jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names=frozenset({sep_axis}))
+    return mapped(q, k, v)
