@@ -6,21 +6,91 @@ JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline is MFU / 0.45 — the fraction of the 45%-MFU north-star target
 (BASELINE.md; no reference-published numbers exist to compare against).
 
+Robustness contract (VERDICT.md round-1 item 1b): the ambient TPU backend can
+hang or fail at PJRT init. The parent process therefore never touches jax —
+it probes backend health in a subprocess with a timeout (retrying once), then
+re-execs itself as a child either on the ambient backend (healthy) or on
+forced CPU with a clearly labeled fallback marker. Whatever happens, exactly
+one JSON line is printed to stdout.
+
 Env knobs: BENCH_MODEL (gpt345m|gpt_tiny|llama_tiny), BENCH_STEPS,
 BENCH_BATCH, BENCH_SEQ.
 """
 
 import json
 import os
+import subprocess
 import sys
 
+_PROBE = "import jax; d = jax.devices(); print(len(d), jax.default_backend())"
 
-def main():
+
+def _probe_backend(env: dict, timeout: int = 150) -> bool:
+    try:
+        r = subprocess.run([sys.executable, "-c", _PROBE], env=env,
+                           capture_output=True, text=True, timeout=timeout)
+        return r.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def _parent() -> int:
+    """Probe backend health, then run the bench in a child process and
+    forward its one JSON line. Always prints one JSON line itself on any
+    failure mode."""
+    # Probe unless explicitly pinned to CPU: even with JAX_PLATFORMS unset,
+    # the axon sitecustomize registers a TPU backend whose init can hang.
+    healthy = True
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        healthy = _probe_backend(dict(os.environ))
+        if not healthy:  # retry once: transient tunnel flaps happen
+            healthy = _probe_backend(dict(os.environ))
+
+    env = dict(os.environ)
+    env["_PADDLE_TPU_BENCH_CHILD"] = "1"
+    if not healthy:
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["_PADDLE_TPU_BENCH_FALLBACK"] = "tpu_backend_unhealthy"
+        # CPU cannot train 345M in reasonable time; shrink unless pinned.
+        env.setdefault("BENCH_MODEL", "gpt_tiny")
+
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, capture_output=True, text=True,
+                           timeout=int(os.environ.get("BENCH_TIMEOUT", "1500")))
+    except subprocess.TimeoutExpired as e:
+        tail = ((e.stderr or b"")[-800:] if isinstance(e.stderr, bytes)
+                else (e.stderr or "")[-800:])
+        print(json.dumps({"metric": "bench_error", "value": 0.0,
+                          "unit": "error", "vs_baseline": 0.0,
+                          "error": f"bench child timed out: {tail}"}))
+        return 0
+
+    sys.stderr.write(r.stderr[-4000:])
+    # Forward the child's JSON line (last stdout line that parses as JSON).
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        print(line)
+        return 0
+    print(json.dumps({"metric": "bench_error", "value": 0.0,
+                      "unit": "error", "vs_baseline": 0.0,
+                      "error": f"child rc={r.returncode}: "
+                               f"{(r.stderr or r.stdout)[-800:]}"}))
+    return 0
+
+
+def _run_bench() -> dict:
     import numpy as np
 
     import paddle_tpu as paddle
     from paddle_tpu.hapi import TrainStep
-    from paddle_tpu.models import GPTConfig, GPTForCausalLM, LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM, LlamaConfig,
+                                   LlamaForCausalLM)
     from paddle_tpu.utils.metrics import SpeedMeter
 
     import jax
@@ -66,7 +136,6 @@ def main():
         hidden=cfg.hidden_size, seq_len=seq,
         n_chips=jax.device_count(), warmup=2)
 
-    import jax.numpy as jnp
     first_loss = last_loss = None
     meter.start()
     for i in range(steps):
@@ -92,6 +161,50 @@ def main():
         "backend": jax.default_backend(),
         "n_chips": jax.device_count(),
     }
+    fallback = os.environ.get("_PADDLE_TPU_BENCH_FALLBACK")
+    if fallback:
+        result["fallback"] = fallback
+        result["vs_baseline"] = 0.0  # CPU numbers don't count toward the target
+    try:
+        result.update(_decode_bench(model, cfg, paddle, jax))
+    except Exception as e:  # decode bench is best-effort extra signal
+        result["decode_error"] = repr(e)[:200]
+    return result
+
+
+def _decode_bench(model, cfg, paddle, jax) -> dict:
+    """Decode tokens/sec on the same model via the generate() path."""
+    import time
+
+    import numpy as np
+
+    if not hasattr(model, "generate"):
+        return {}
+    steps = int(os.environ.get("BENCH_DECODE_TOKENS", "64"))
+    rng = np.random.default_rng(0)
+    prompt = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (1, 16)).astype(np.int32))
+    model.eval()
+    # warmup (compile)
+    out = model.generate(prompt, max_new_tokens=8, do_sample=False)
+    jax.block_until_ready(out.value if hasattr(out, "value") else out)
+    t0 = time.perf_counter()
+    out = model.generate(prompt, max_new_tokens=steps, do_sample=False)
+    jax.block_until_ready(out.value if hasattr(out, "value") else out)
+    dt = time.perf_counter() - t0
+    return {"decode_tokens_per_sec": round(steps / dt, 1)}
+
+
+def main():
+    if os.environ.get("_PADDLE_TPU_BENCH_CHILD") != "1":
+        sys.exit(_parent())
+    try:
+        result = _run_bench()
+    except Exception as e:
+        import traceback
+        tail = traceback.format_exc()[-800:]
+        result = {"metric": "bench_error", "value": 0.0, "unit": "error",
+                  "vs_baseline": 0.0, "error": f"{e!r}: {tail}"}
     print(json.dumps(result))
 
 

@@ -404,10 +404,10 @@ class PipelineParallel(Layer):
     def _ensure_step(self, optimizer):
         if self._step is None:
             inner = getattr(optimizer, "_inner_opt", optimizer)
-            M = max(self.accumulate_steps,
-                    self._hcg.get_pipe_parallel_world_size())
+            # accumulate_steps < pp degree raises in PipelineTrainStep.__init__
             self._step = PipelineTrainStep(
-                self._layers, inner, self._hcg.get_mesh(), M, remat=True)
+                self._layers, inner, self._hcg.get_mesh(),
+                self.accumulate_steps, remat=True)
         return self._step
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):

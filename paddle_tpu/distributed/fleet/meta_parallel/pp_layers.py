@@ -201,8 +201,27 @@ class PipelineLayer(Layer):
                 raise TypeError(f"unsupported pipeline entry {d!r}")
 
         # ---- segment
+        # segment_parts drives describe()/get_stage_range() metadata. The
+        # SPMD schedule executes the even split of stack_region() over the
+        # pp axis (stacked identical blocks are what shard over the mesh);
+        # a seg_method that diverges from that split cannot change stage
+        # placement in this build, so we warn rather than silently diverge.
         self.segment_parts = SegmentLayers(
             self._layers_desc, self._num_stages, seg_method).do_segment()
+        start, end = self.stack_region()
+        L = (end - start) // self._num_stages if self._num_stages else 0
+        if L:
+            exec_parts = [0] + [start + L * (s + 1)
+                                for s in range(self._num_stages)]
+            exec_parts[-1] = len(self.run_function)
+            if list(self.segment_parts) != exec_parts:
+                import warnings
+                warnings.warn(
+                    f"seg_method={seg_method!r} yields stage boundaries "
+                    f"{list(self.segment_parts)}, but the SPMD pipeline "
+                    f"executes the even stacked split {exec_parts}; "
+                    "seg_method is descriptive-only in this build",
+                    stacklevel=2)
 
     # ---------------------------------------------------------------- eager
     def forward(self, *args):

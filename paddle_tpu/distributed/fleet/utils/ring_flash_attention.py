@@ -83,9 +83,13 @@ def ring_flash_attention(q, k, v, axis_name: str = "sep",
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return (m_new, l_new, acc_new, k_nxt, v_nxt), None
 
-    m0 = jnp.full((b, h, c, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, c, 1), jnp.float32)
-    a0 = jnp.zeros((b, h, c, d), jnp.float32)
+    # The step outputs depend on q/k/v and so are varying over the manual
+    # sep axis; freshly created carries start unvarying, which trips
+    # shard_map's check_vma (carry-in type != carry-out type). Tag them.
+    _vary = functools.partial(lax.pcast, axis_name=axis_name, to="varying")
+    m0 = _vary(jnp.full((b, h, c, 1), _NEG_INF, jnp.float32))
+    l0 = _vary(jnp.zeros((b, h, c, 1), jnp.float32))
+    a0 = _vary(jnp.zeros((b, h, c, d), jnp.float32))
     (m, l, acc, _, _), _ = lax.scan(step, (m0, l0, a0, k, v),
                                     jnp.arange(p))
     l_safe = jnp.where(l == 0.0, 1.0, l)

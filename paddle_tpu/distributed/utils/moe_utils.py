@@ -28,16 +28,25 @@ def _host_counts(c):
 
 
 def _repack(xv, src_counts, dst_counts):
-    """Move run-length blocks of rows from src layout to dst layout."""
+    """Move run-length blocks of rows from src layout to dst layout.
+
+    Each slot i copies min(src_counts[i], dst_counts[i]) rows — when a
+    destination block is smaller the excess source rows are dropped (capacity
+    truncation), and when it is larger the tail stays zero, matching the
+    reference op's recv-buffer semantics for mismatched count layouts."""
+    if src_counts.shape != dst_counts.shape:
+        raise ValueError(
+            f"count layouts differ in length: {src_counts.shape[0]} vs "
+            f"{dst_counts.shape[0]}")
     total = int(dst_counts.sum())
     out = jnp.zeros((total,) + xv.shape[1:], xv.dtype)
     src = dst = 0
     for i in range(src_counts.shape[0]):
-        n = int(src_counts[i])
+        n = min(int(src_counts[i]), int(dst_counts[i]))
         if n:
             out = out.at[dst:dst + n].set(xv[src:src + n])
-        src += n
-        dst += int(dst_counts[i]) if i < dst_counts.shape[0] else n
+        src += int(src_counts[i])
+        dst += int(dst_counts[i])
     return out
 
 

@@ -203,6 +203,29 @@ class TestGlobalScatterGather:
         (z * z).sum().backward()
         np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy())
 
+    def test_unequal_count_layouts(self):
+        """local_count != global_count per slot: each slot copies
+        min(src, dst) rows — excess source rows drop, short blocks
+        zero-pad (recv-buffer semantics)."""
+        from paddle_tpu.distributed.utils import global_scatter
+        x = paddle.to_tensor(
+            np.arange(10, dtype=np.float32).reshape(5, 2))
+        lc = paddle.to_tensor(np.asarray([3, 2], np.int64))
+        gc = paddle.to_tensor(np.asarray([2, 4], np.int64))
+        y = global_scatter(x, lc, gc)
+        expect = np.zeros((6, 2), np.float32)
+        expect[0:2] = x.numpy()[0:2]      # slot 0: min(3, 2) = 2 rows
+        expect[2:4] = x.numpy()[3:5]      # slot 1: min(2, 4) = 2 rows
+        np.testing.assert_allclose(y.numpy(), expect)
+
+    def test_count_layout_length_mismatch_raises(self):
+        from paddle_tpu.distributed.utils import global_scatter
+        x = paddle.to_tensor(np.zeros((3, 2), np.float32))
+        lc = paddle.to_tensor(np.asarray([1, 2], np.int64))
+        gc = paddle.to_tensor(np.asarray([1, 1, 1], np.int64))
+        with pytest.raises(ValueError):
+            global_scatter(x, lc, gc)
+
     def test_count_mismatch_raises(self):
         from paddle_tpu.distributed.utils import global_gather, global_scatter
         x = paddle.to_tensor(np.zeros((4, 2), np.float32))
