@@ -1,0 +1,172 @@
+"""Autoregressive generation: the inference/decode half of the framework.
+
+Reference parity targets (SURVEY.md §3.5):
+  - paddle/fluid/operators/fused/fused_multi_transformer_op.cu — the fused
+    decode step against a KV cache (here: ``cached_scaled_dot_product_
+    attention`` + the per-model ``forward_with_cache`` hooks);
+  - PaddleNLP's ``GenerationMixin.generate`` — the user-facing sampling loop.
+
+TPU-native design: the ENTIRE generation — prefill + every decode step +
+sampling — is one jitted function. The decode loop is a ``lax.scan`` with a
+static trip count over static-shape ring-buffer caches, so XLA compiles one
+program per (batch, prompt_len, max_new_tokens) signature and each decode
+step costs one device dispatch, not one per op. Eager per-token loops are
+exactly the pattern the tunnel-chip environment punishes (~ms per op);
+everything here stays on-device.
+
+Models opt in by inheriting ``GenerationMixin`` and providing:
+  - ``cache_spec() -> [(num_kv_heads, head_dim), ...]`` (one per layer)
+  - ``forward_with_cache(input_ids, caches, offset) -> (logits, caches)``
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["GenerationMixin"]
+
+_NEG_INF = -1e30
+
+
+def _top_k_filter(logits: jax.Array, k: int) -> jax.Array:
+    kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
+    return jnp.where(logits < kth, _NEG_INF, logits)
+
+
+def _top_p_filter(logits: jax.Array, top_p) -> jax.Array:
+    """Nucleus filtering with a traced threshold: keep the smallest prefix of
+    descending-prob tokens whose cumulative mass reaches top_p (the first
+    token is always kept since the exclusive cumsum starts at 0)."""
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum_excl = jnp.cumsum(probs, axis=-1) - probs
+    keep = cum_excl < top_p
+    cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf),
+                     axis=-1, keepdims=True)
+    return jnp.where(logits >= cutoff, logits, _NEG_INF)
+
+
+class GenerationMixin:
+    """Adds jit-compiled ``generate`` to a Layer with decode hooks."""
+
+    def init_cache(self, batch: int, max_len: int, dtype=None):
+        """Zero ring-buffer KV caches: one (k, v) pair per layer, each
+        (batch, max_len, num_kv_heads, head_dim)."""
+        if dtype is None:
+            dtype = next(iter(self.parameters())).dtype
+        return [(jnp.zeros((batch, max_len, hkv, d), dtype),
+                 jnp.zeros((batch, max_len, hkv, d), dtype))
+                for hkv, d in self.cache_spec()]
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None,
+                 pad_token_id: Optional[int] = None,
+                 return_full_sequence: bool = True):
+        """Greedy/sampled autoregressive decode. Returns the (B, P + N)
+        full sequence Tensor (or (B, N) generated tail when
+        ``return_full_sequence=False``). After an ``eos_token_id`` hit a row
+        emits ``pad_token_id`` for the remaining steps (shapes stay static)."""
+        from ..core.tensor import Tensor
+        from ..framework.random import next_key
+        from ..jit import functional_call
+
+        ids_val = (input_ids._value if isinstance(input_ids, Tensor)
+                   else jnp.asarray(input_ids))
+        if ids_val.ndim != 2:
+            raise ValueError(f"input_ids must be (batch, seq), got "
+                             f"{ids_val.shape}")
+        b, p = ids_val.shape
+        total = p + int(max_new_tokens)
+        maxpos = getattr(getattr(self, "config", None),
+                         "max_position_embeddings", None)
+        if maxpos is not None and total > maxpos:
+            raise ValueError(
+                f"prompt ({p}) + max_new_tokens ({max_new_tokens}) = {total} "
+                f"exceeds max_position_embeddings ({maxpos})")
+        if pad_token_id is None:
+            pad_token_id = eos_token_id if eos_token_id is not None else 0
+
+        was_training = self.training
+        self.eval()
+        try:
+            params, buffers = self.raw_state()
+            sig = (b, p, int(max_new_tokens), bool(do_sample), int(top_k),
+                   eos_token_id, pad_token_id)
+            cache = getattr(self, "_generate_jit_cache", None)
+            if cache is None:
+                cache = self._generate_jit_cache = {}
+            fn = cache.get(sig)
+            if fn is None:
+                fn = jax.jit(self._build_generate(
+                    b, p, int(max_new_tokens), bool(do_sample), int(top_k),
+                    eos_token_id, pad_token_id))
+                cache[sig] = fn
+            toks = fn(params, buffers, ids_val, next_key(),
+                      jnp.float32(temperature), jnp.float32(top_p))
+        finally:
+            if was_training:
+                self.train()
+        out = jnp.concatenate([ids_val, toks], axis=1) \
+            if return_full_sequence else toks
+        return Tensor(out, stop_gradient=True)
+
+    def _build_generate(self, b, p, n_new, do_sample, top_k,
+                        eos_token_id, pad_token_id):
+        from ..jit import functional_call
+
+        def select(logits, key, temperature, top_p):
+            lg = logits.astype(jnp.float32)
+            if not do_sample:
+                return jnp.argmax(lg, axis=-1)
+            lg = lg / jnp.maximum(temperature, 1e-6)
+            if top_k > 0:
+                lg = _top_k_filter(lg, top_k)
+            lg = _top_p_filter(lg, top_p)
+            return jax.random.categorical(key, lg, axis=-1)
+
+        def gen(params, buffers, ids, key, temperature, top_p):
+            total = p + n_new
+            dtype = jnp.result_type(next(iter(params.values())))
+            caches = [(jnp.zeros((b, total, hkv, d), dtype),
+                       jnp.zeros((b, total, hkv, d), dtype))
+                      for hkv, d in self.cache_spec()]
+
+            # prefill: writes cache positions [0, p), predicts token p
+            logits, caches = functional_call(
+                self, params, ids, caches, jnp.int32(0), buffers=buffers,
+                method="forward_with_cache")
+            key, sub = jax.random.split(key)
+            tok = select(logits[:, -1], sub, temperature, top_p).astype(
+                ids.dtype)
+            if eos_token_id is not None:
+                finished = tok == eos_token_id
+            else:
+                finished = jnp.zeros((b,), bool)
+
+            def body(carry, _):
+                tok, caches, off, key, finished = carry
+                logits, caches = functional_call(
+                    self, params, tok[:, None], caches, off, buffers=buffers,
+                    method="forward_with_cache")
+                key, sub = jax.random.split(key)
+                nxt = select(logits[:, -1], sub, temperature, top_p).astype(
+                    tok.dtype)
+                nxt = jnp.where(finished, jnp.asarray(pad_token_id, tok.dtype),
+                                nxt)
+                if eos_token_id is not None:
+                    finished = finished | (nxt == eos_token_id)
+                return (nxt, caches, off + 1, key, finished), nxt
+
+            (_, _, _, _, _), rest = lax.scan(
+                body, (tok, caches, jnp.int32(p), key, finished), None,
+                length=n_new - 1)
+            return jnp.concatenate([tok[:, None],
+                                    jnp.moveaxis(rest, 0, 1)], axis=1)
+
+        return gen

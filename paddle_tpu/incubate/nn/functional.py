@@ -85,23 +85,37 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     seq_axis = 0 if time_major else 1
     s = qv.shape[seq_axis]
     d = qv.shape[-1]
-    if sin is None or cos is None:
+    if (sin is None or cos is None) and position_ids is not None:
+        # Compute sin/cos straight from the positions (no table + gather):
+        # decode-time positions exceed the current chunk length, so a
+        # chunk-sized table would be out of range — and the direct compute
+        # is the better TPU program anyway (VPU math beats HBM gathers).
+        pid = _val(position_ids).astype(jnp.float32)       # [B, S]
+        inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+        freqs = pid[..., None] * inv                        # [B, S, D/2]
+        emb = jnp.concatenate([freqs, freqs], axis=-1)      # [B, S, D]
+        sin_b = jnp.sin(emb)[:, :, None, :]
+        cos_b = jnp.cos(emb)[:, :, None, :]
+    elif sin is None or cos is None:
         pos = jnp.arange(s, dtype=jnp.float32)
         inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
         freqs = jnp.outer(pos, inv)
         emb = jnp.concatenate([freqs, freqs], axis=-1)
         sin_v, cos_v = jnp.sin(emb), jnp.cos(emb)
+        sin_b = sin_v[None, :, None, :] if not time_major else sin_v[:, None, None, :]
+        cos_b = cos_v[None, :, None, :] if not time_major else cos_v[:, None, None, :]
     else:
         sin_v, cos_v = _val(sin), _val(cos)
         sin_v = sin_v.reshape(s, d) if sin_v.ndim > 2 else sin_v
         cos_v = cos_v.reshape(s, d) if cos_v.ndim > 2 else cos_v
-    if position_ids is not None:
+        sin_b = cos_b = None  # set below
+    if sin_b is None and position_ids is not None:
         pid = _val(position_ids)
         sin_v = jnp.take(sin_v, pid, axis=0)  # [B, S, D]
         cos_v = jnp.take(cos_v, pid, axis=0)
         sin_b = sin_v[:, :, None, :]
         cos_b = cos_v[:, :, None, :]
-    else:
+    elif sin_b is None:
         if time_major:
             sin_b = sin_v[:, None, None, :]
             cos_b = cos_v[:, None, None, :]
@@ -234,3 +248,151 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
     if not pre_layer_norm:
         out = F.layer_norm(out, out.shape[-1], ln2_scale, ln2_bias, ln2_epsilon)
     return out
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-5, cache_kvs=None, pre_caches=None,
+                            rotary_embs=None, time_step=None, attn_mask=None,
+                            dropout_rate=0.0, rotary_emb_dims=0,
+                            activation="gelu", training=False,
+                            mode="upscale_in_train", trans_qkvw=True,
+                            ring_id=-1, name=None):
+    """Whole-stack fused transformer with KV caches — reference:
+    paddle/fluid/operators/fused/fused_multi_transformer_op.cu (SURVEY.md
+    §3.5). One call runs all L layers: pre-LN -> qkv -> (rope) -> cache
+    attention -> out-proj -> residual -> ffn-LN -> ffn1 -> act -> ffn2 ->
+    residual. On TPU the per-layer "fusion" is XLA's job; what this function
+    contributes is the reference-shaped weight-list API and the decode cache
+    semantics (static ring-buffer caches + traced ``time_step``).
+
+    Weight shapes follow the reference: ``qkv_weights[i]`` is
+    (3, num_head, head_dim, embed_dim) when ``trans_qkvw`` else
+    (embed_dim, 3, num_head, head_dim); ``cache_kvs[i]`` is
+    (2, B, num_head, max_seq, head_dim). ``time_step`` (int scalar, decode
+    phase only) is the number of tokens already cached; when ``cache_kvs``
+    is given the call returns ``(out, cache_kvs)``.
+    """
+    from ...kernels.decode_attention import cached_attention, update_kv_cache
+
+    L = len(qkv_weights)
+    use_cache = cache_kvs is not None
+    xv = _val(x)
+    b, s, h = xv.shape
+
+    def layer_step(hid, i):
+        qkvw = _val(qkv_weights[i])
+        if trans_qkvw:          # (3, H, D, E) -> project E -> (3, H, D)
+            three, nh, hd, _ = qkvw.shape
+        else:
+            _, three, nh, hd = qkvw.shape
+            qkvw = jnp.transpose(qkvw, (1, 2, 3, 0))
+        residual = hid
+        ln_in = hid
+        if pre_layer_norm:
+            ln_in = _ln(hid, _val(ln_scales[i]),
+                        _val(ln_biases[i]) if ln_biases else None, epsilon)
+        qkv = jnp.einsum("bse,nhde->bsnhd", ln_in, qkvw)
+        if qkv_biases:
+            qkv = qkv + _val(qkv_biases[i])[None, None]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]   # (B,S,H,D)
+        if rotary_embs is not None and rotary_emb_dims > 0:
+            rot = _val(rotary_embs)                           # (2, B, 1, S, D)
+            cos_r, sin_r = rot[0], rot[1]
+            q = _apply_rot(q, cos_r, sin_r)
+            k = _apply_rot(k, cos_r, sin_r)
+        if use_cache:
+            ck = _val(cache_kvs[i])                           # (2,B,H,T,D)
+            k_cache = jnp.transpose(ck[0], (0, 2, 1, 3))      # (B,T,H,D)
+            v_cache = jnp.transpose(ck[1], (0, 2, 1, 3))
+            off = (jnp.asarray(_val(time_step), jnp.int32).reshape(())
+                   if time_step is not None else jnp.int32(0))
+            k_cache, v_cache = update_kv_cache(k_cache, v_cache, k, v, off)
+            attn = cached_attention(q, k_cache, v_cache, off + s)
+            new_ck = jnp.stack([jnp.transpose(k_cache, (0, 2, 1, 3)),
+                                jnp.transpose(v_cache, (0, 2, 1, 3))])
+            cache_out.append(new_ck)
+        else:
+            attn = _causal_sdpa(q, k, v, _val(attn_mask)
+                                if attn_mask is not None else None)
+        attn = attn.reshape(b, s, nh * hd)
+        lw = _val(linear_weights[i])
+        out = attn @ lw
+        if linear_biases:
+            out = out + _val(linear_biases[i])
+        hid = residual + out
+        if not pre_layer_norm:
+            hid = _ln(hid, _val(ln_scales[i]),
+                      _val(ln_biases[i]) if ln_biases else None, epsilon)
+
+        residual = hid
+        ffn_in = hid
+        if pre_layer_norm:
+            ffn_in = _ln(hid, _val(ffn_ln_scales[i]),
+                         _val(ffn_ln_biases[i]) if ffn_ln_biases else None,
+                         epsilon)
+        f1 = ffn_in @ _val(ffn1_weights[i])
+        if ffn1_biases:
+            f1 = f1 + _val(ffn1_biases[i])
+        f1 = jax.nn.gelu(f1, approximate=True) if activation == "gelu" \
+            else jax.nn.relu(f1)
+        f2 = f1 @ _val(ffn2_weights[i])
+        if ffn2_biases:
+            f2 = f2 + _val(ffn2_biases[i])
+        hid = residual + f2
+        if not pre_layer_norm:
+            hid = _ln(hid, _val(ffn_ln_scales[i]),
+                      _val(ffn_ln_biases[i]) if ffn_ln_biases else None,
+                      epsilon)
+        return hid
+
+    cache_out = []
+    hid = xv
+    for i in range(L):
+        hid = layer_step(hid, i)
+    out = Tensor(hid.astype(xv.dtype), stop_gradient=True)
+    if use_cache:
+        return out, [Tensor(c, stop_gradient=True) for c in cache_out]
+    return out
+
+
+def _ln(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out.astype(x.dtype)
+
+
+def _apply_rot(t, cos_r, sin_r):
+    # neox-style rotate-half; cos/sin (B, 1, S, D) -> (B, S, 1, D)
+    cos_b = jnp.transpose(cos_r, (0, 2, 1, 3)).astype(t.dtype)
+    sin_b = jnp.transpose(sin_r, (0, 2, 1, 3)).astype(t.dtype)
+    t1, t2 = jnp.split(t, 2, axis=-1)
+    rot = jnp.concatenate([-t2, t1], axis=-1)
+    return t * cos_b + rot * sin_b
+
+
+def _causal_sdpa(q, k, v, mask):
+    import math as _math
+    scale = 1.0 / _math.sqrt(q.shape[-1])
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
+    if mask is not None:
+        s = jnp.where(mask.astype(bool), s, -1e30) if mask.dtype != s.dtype \
+            else s + mask
+    else:
+        sq, sk = s.shape[-2], s.shape[-1]
+        tri = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) >= \
+            jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(tri, s, -1e30)
+    o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vt)
+    return jnp.swapaxes(o, 1, 2).astype(q.dtype)

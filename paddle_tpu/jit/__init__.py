@@ -44,9 +44,11 @@ def functional_call(
     params: Dict[str, Any],
     *args,
     buffers: Optional[Dict[str, Any]] = None,
+    method: Optional[str] = None,
     **kwargs,
 ):
-    """Run ``layer.forward(*args)`` with parameter/buffer values taken from
+    """Run ``layer.forward(*args)`` (or ``getattr(layer, method)`` when
+    ``method`` is given) with parameter/buffer values taken from
     ``params``/``buffers`` (flat name->array dicts), purely functionally.
 
     Used to trace a Layer under jax.jit / jax.grad: the layer's Tensors get
@@ -70,8 +72,9 @@ def functional_call(
                 continue
             saved[id(t)] = (t, t._value)
             t._value = _to_value(v)
+        fn = layer if method is None else getattr(layer, method)
         with autograd.functional_guard():
-            out = layer(*tree_to_tensors(args), **tree_to_tensors(kwargs))
+            out = fn(*tree_to_tensors(args), **tree_to_tensors(kwargs))
         return tree_to_values(out)
     finally:
         for t, v in saved.values():

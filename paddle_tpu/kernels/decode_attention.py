@@ -1,0 +1,78 @@
+"""KV-cache attention for autoregressive decode.
+
+Reference parity target: the decode phase of
+paddle/fluid/operators/fused/fused_multi_transformer_op.cu (masked
+multi-head attention against a growing cache) — SURVEY.md §3.5.
+
+TPU-native design: decode attention is HBM-bandwidth-bound (one query token
+streams the whole cache), so the right program is a pair of large batched
+einsums XLA maps straight onto the MXU/VPU with the cache resident in HBM —
+not a hand-scheduled kernel. Three choices that matter on TPU:
+
+  - **Static cache shape**: the cache is a preallocated ``(B, T, Hkv, D)``
+    ring buffer; the valid length is a traced scalar. No dynamic shapes, so
+    one compilation serves every decode step (jit caches by shape).
+  - **GQA without materialization**: grouped queries reshape to
+    ``(B, S, Hkv, rep, D)`` and attend against the *unexpanded* KV cache —
+    no ``repeat_interleave``, so cache reads stay at ``Hkv`` bandwidth.
+  - **f32 softmax accumulation** regardless of cache dtype (bf16-safe).
+
+``cached_attention`` covers both phases: prefill (S = prompt length,
+``cur_len`` = total written) and decode (S = 1).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def update_kv_cache(k_cache: jax.Array, v_cache: jax.Array,
+                    k_new: jax.Array, v_new: jax.Array,
+                    offset) -> Tuple[jax.Array, jax.Array]:
+    """Write ``k_new``/``v_new`` (B, S, Hkv, D) into the caches at sequence
+    position ``offset`` (traced scalar ok). Returns the updated caches."""
+    offset = jnp.asarray(offset, jnp.int32)
+    zero = jnp.zeros((), jnp.int32)
+    k_cache = lax.dynamic_update_slice(
+        k_cache, k_new.astype(k_cache.dtype), (zero, offset, zero, zero))
+    v_cache = lax.dynamic_update_slice(
+        v_cache, v_new.astype(v_cache.dtype), (zero, offset, zero, zero))
+    return k_cache, v_cache
+
+
+def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                     cur_len, sm_scale: Optional[float] = None) -> jax.Array:
+    """Attention of ``q`` (B, S, H, D) against caches (B, T, Hkv, D) whose
+    first ``cur_len`` positions are valid; the S query rows are the LAST S
+    written positions (absolute positions ``cur_len - S .. cur_len - 1``),
+    masked causally. Returns (B, S, H, D) in q's dtype."""
+    b, s, h, d = q.shape
+    t = k_cache.shape[1]
+    hkv = k_cache.shape[2]
+    if h % hkv:
+        raise ValueError(f"query heads {h} not divisible by kv heads {hkv}")
+    rep = h // hkv
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    cur_len = jnp.asarray(cur_len, jnp.int32)
+
+    qf = q.reshape(b, s, hkv, rep, d).astype(jnp.float32) * sm_scale
+    kf = k_cache.astype(jnp.float32)
+    scores = jnp.einsum("bsgrd,btgd->bgrst", qf, kf)        # (B,Hkv,rep,S,T)
+
+    q_pos = cur_len - s + lax.broadcasted_iota(jnp.int32, (s, t), 0)
+    k_pos = lax.broadcasted_iota(jnp.int32, (s, t), 1)
+    mask = k_pos <= q_pos                                   # causal + length
+    scores = jnp.where(mask, scores, _NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bgrst,btgd->bsgrd", probs,
+                     v_cache.astype(jnp.float32))
+    return out.reshape(b, s, h, d).astype(q.dtype)

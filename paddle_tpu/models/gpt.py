@@ -16,6 +16,7 @@ import numpy as np
 
 from .. import ops
 from ..core.tensor import Tensor
+from ..generation import GenerationMixin
 from ..nn import functional as F
 from ..nn import initializer as I
 from ..nn.layer import Layer, LayerList
@@ -74,10 +75,15 @@ class GPTSelfAttention(Layer):
                                      math.sqrt(2 * config.num_hidden_layers))))
         self.attn_drop_p = config.attention_probs_dropout_prob
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, cache=None):
         b, s, h = x.shape
         qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = ops.unbind(qkv, axis=2)
+        if cache is not None:
+            k_cache, v_cache, offset = cache
+            out, k_cache, v_cache = F.cached_scaled_dot_product_attention(
+                q, k, v, k_cache, v_cache, offset)
+            return self.out_proj(out.reshape([b, s, h])), (k_cache, v_cache)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
             dropout_p=self.attn_drop_p if self.training else 0.0,
@@ -109,7 +115,12 @@ class GPTBlock(Layer):
         self.mlp = GPTMLP(config)
         self.drop = Dropout(config.hidden_dropout_prob)
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, cache=None):
+        if cache is not None:
+            attn, new_cache = self.attn(self.ln_1(x), attn_mask, cache)
+            x = x + self.drop(attn)
+            x = x + self.drop(self.mlp(self.ln_2(x)))
+            return x, new_cache
         x = x + self.drop(self.attn(self.ln_1(x), attn_mask))
         x = x + self.drop(self.mlp(self.ln_2(x)))
         return x
@@ -128,12 +139,21 @@ class GPTModel(Layer):
         self.h = LayerList([GPTBlock(config) for _ in range(config.num_hidden_layers)])
         self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
 
-    def forward(self, input_ids, attn_mask=None, position_ids=None):
+    def forward(self, input_ids, attn_mask=None, position_ids=None,
+                caches=None, offset=None):
         b, s = input_ids.shape
         if position_ids is None:
             position_ids = ops.arange(s, dtype="int64").unsqueeze(0)
+            if offset is not None:
+                position_ids = position_ids + offset
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
+        if caches is not None:
+            new_caches = []
+            for block, (kc, vc) in zip(self.h, caches):
+                x, nc = block(x, attn_mask, cache=(kc, vc, offset))
+                new_caches.append(nc)
+            return self.ln_f(x), new_caches
         for block in self.h:
             x = block(x, attn_mask)
         return self.ln_f(x)
@@ -206,7 +226,7 @@ def GPTForCausalLMPipe(config: GPTConfig, num_stages: Optional[int] = None,
         recompute_interval=recompute_interval)
 
 
-class GPTForCausalLM(Layer):
+class GPTForCausalLM(GenerationMixin, Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.config = config
@@ -231,3 +251,14 @@ class GPTForCausalLM(Layer):
             logits.reshape([-1, self.config.vocab_size]),
             labels.reshape([-1]), reduction="mean")
         return loss
+
+    # ---- decode path (GenerationMixin hooks) -----------------------------
+    def cache_spec(self):
+        c = self.config
+        return [(c.num_attention_heads,
+                 c.hidden_size // c.num_attention_heads)
+                for _ in range(c.num_hidden_layers)]
+
+    def forward_with_cache(self, input_ids, caches, offset):
+        hidden, new_caches = self.gpt(input_ids, caches=caches, offset=offset)
+        return self.logits(hidden), new_caches

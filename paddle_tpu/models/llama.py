@@ -11,6 +11,7 @@ import math
 from typing import Optional
 
 from .. import ops
+from ..generation import GenerationMixin
 from ..incubate.nn import functional as FF
 from ..nn import functional as F
 from ..nn import initializer as I
@@ -78,13 +79,23 @@ class LlamaAttention(Layer):
         self.v_proj = Linear(h, self.num_kv_heads * self.head_dim, weight_attr=init, bias_attr=False)
         self.o_proj = Linear(self.num_heads * self.head_dim, h, weight_attr=init, bias_attr=False)
 
-    def forward(self, x, attn_mask=None, position_ids=None):
+    def forward(self, x, attn_mask=None, position_ids=None, cache=None):
         b, s, _ = x.shape
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        if cache is not None and position_ids is None:
+            _, _, offset = cache
+            position_ids = (ops.arange(s, dtype="int32") + offset).unsqueeze(0)
         q, k, _ = FF.fused_rotary_position_embedding(
             q, k, None, position_ids=position_ids, rotary_emb_base=self.rope_theta)
+        if cache is not None:
+            k_cache, v_cache, offset = cache
+            out, k_cache, v_cache = F.cached_scaled_dot_product_attention(
+                q, k, v, k_cache, v_cache, offset)
+            out = self.o_proj(
+                out.reshape([b, s, self.num_heads * self.head_dim]))
+            return out, (k_cache, v_cache)
         if self.num_kv_heads != self.num_heads:
             rep = self.num_heads // self.num_kv_heads
             k = ops.repeat_interleave(k, rep, axis=2)
@@ -119,7 +130,13 @@ class LlamaDecoderLayer(Layer):
                                                 epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x, attn_mask=None, position_ids=None):
+    def forward(self, x, attn_mask=None, position_ids=None, cache=None):
+        if cache is not None:
+            attn, new_cache = self.self_attn(
+                self.input_layernorm(x), attn_mask, position_ids, cache)
+            x = x + attn
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x, new_cache
         x = x + self.self_attn(self.input_layernorm(x), attn_mask, position_ids)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
@@ -136,14 +153,21 @@ class LlamaModel(Layer):
                                  for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
-    def forward(self, input_ids, attn_mask=None, position_ids=None):
+    def forward(self, input_ids, attn_mask=None, position_ids=None,
+                caches=None, offset=None):
         x = self.embed_tokens(input_ids)
+        if caches is not None:
+            new_caches = []
+            for layer, (kc, vc) in zip(self.layers, caches):
+                x, nc = layer(x, attn_mask, position_ids, cache=(kc, vc, offset))
+                new_caches.append(nc)
+            return self.norm(x), new_caches
         for layer in self.layers:
             x = layer(x, attn_mask, position_ids)
         return self.norm(x)
 
 
-class LlamaForCausalLM(Layer):
+class LlamaForCausalLM(GenerationMixin, Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -169,3 +193,14 @@ class LlamaForCausalLM(Layer):
         return F.cross_entropy(
             logits.reshape([-1, self.config.vocab_size]).astype("float32"),
             labels.reshape([-1]), reduction="mean")
+
+    # ---- decode path (GenerationMixin hooks) -----------------------------
+    def cache_spec(self):
+        c = self.config
+        return [(c.num_key_value_heads, c.hidden_size // c.num_attention_heads)
+                for _ in range(c.num_hidden_layers)]
+
+    def forward_with_cache(self, input_ids, caches, offset):
+        hidden, new_caches = self.llama(input_ids, caches=caches,
+                                        offset=offset)
+        return self.logits(hidden), new_caches

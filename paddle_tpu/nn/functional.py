@@ -536,6 +536,29 @@ def _reduce_loss(loss, reduction):
 
 
 # ---------------------------------------------------------------- attention
+def cached_scaled_dot_product_attention(query, key, value, k_cache, v_cache,
+                                        offset):
+    """Decode-phase attention (reference: the masked-MHA cache branch of
+    paddle/fluid/operators/fused/fused_multi_transformer_op.cu): write the
+    new key/value chunk (B, S, Hkv, D) into the static ring-buffer caches
+    (B, T, Hkv, D) at sequence position ``offset``, then attend ``query``
+    (B, S, H, D; GQA allowed) causally against the written prefix.
+
+    Returns ``(out, k_cache, v_cache)`` — out (B, S, H, D), caches updated.
+    ``offset`` may be a python int or a traced scalar; shapes stay static so
+    one compilation serves every decode step."""
+    from ..kernels.decode_attention import cached_attention, update_kv_cache
+
+    def fn(qv, knv, vnv, kcv, vcv, off):
+        kcv, vcv = update_kv_cache(kcv, vcv, knv, vnv, off)
+        out = cached_attention(qv, kcv, vcv,
+                               jnp.asarray(off, jnp.int32) + qv.shape[1])
+        return out, kcv, vcv
+
+    return apply_op("cached_sdpa", fn, query, key, value, k_cache, v_cache,
+                    offset)
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
                                  name=None):
