@@ -1,6 +1,6 @@
 """paddle_tpu.distributed (reference: python/paddle/distributed/)."""
 
-from . import communication, fleet, sharding, utils  # noqa: F401
+from . import checkpoint, communication, fleet, sharding, utils  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .communication import (  # noqa: F401
     Group, P2POp, ReduceOp, Task, all_gather, all_gather_object, all_reduce,

@@ -1,0 +1,152 @@
+"""Distributed (sharded) checkpointing with resharding on load.
+
+Reference: python/paddle/distributed/checkpoint/{save_state_dict,
+load_state_dict,metadata}.py — SURVEY.md §5.4. The reference writes
+per-rank shard files plus a metadata manifest describing each logical
+tensor's global shape and shard layout, then reshards at load time by
+intersecting saved shards with the target distribution.
+
+TPU-native design: all of that collapses onto orbax + GSPMD shardings.
+A ``jax.Array`` already knows its global shape and per-device layout, so
+orbax's TensorStore backend writes exactly the local shards each host owns
+(scaling to multi-host without a gather), and restoring with a different
+``NamedSharding`` IS the reshard — orbax reads whichever saved chunks the
+target layout needs. The manifest the reference hand-rolls is orbax's
+checkpoint metadata; we add a small ``paddle_meta.json`` for dtype/shape
+assertions and user metadata.
+
+API (reference-shaped):
+  - ``save_state_dict(state_dict, path)``
+  - ``load_state_dict(state_dict, path)`` — in-place into ``state_dict``'s
+    tensors, resharding onto each destination array's sharding
+  - ``get_checkpoint_metadata(path)``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["save_state_dict", "load_state_dict", "get_checkpoint_metadata"]
+
+_META_FILE = "paddle_meta.json"
+
+
+def _flatten(state_dict: Dict[str, Any], prefix: str = ""):
+    """Flatten nested dicts to dot-joined keys -> Tensor/array leaves."""
+    flat = {}
+    for k, v in state_dict.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            flat.update(_flatten(v, f"{key}."))
+        else:
+            flat[key] = v
+    return flat
+
+
+def _leaf_value(v):
+    from ...core.tensor import Tensor
+    return v._value if isinstance(v, Tensor) else jnp.asarray(v)
+
+
+def save_state_dict(state_dict: Dict[str, Any], path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    unique_id: Optional[int] = None,
+                    async_save: bool = False) -> None:
+    """Save a (possibly nested) state dict of Tensors / jax.Arrays. Sharded
+    arrays write only their local shards per host (orbax/TensorStore);
+    replicated arrays write once."""
+    import orbax.checkpoint as ocp
+
+    flat = {k: _leaf_value(v) for k, v in _flatten(state_dict).items()}
+    if not flat:
+        raise ValueError("empty state_dict")
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+
+    ckptr = (ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+             if async_save else ocp.PyTreeCheckpointer())
+    ckptr.save(os.path.join(path, "state"), flat, force=True)
+    if async_save:
+        ckptr.wait_until_finished()
+
+    meta = {
+        "format_version": 1,
+        "unique_id": unique_id,
+        "tensors": {
+            k: {"shape": list(np.shape(v)), "dtype": str(v.dtype)}
+            for k, v in flat.items()
+        },
+    }
+    with open(os.path.join(path, _META_FILE), "w") as f:
+        json.dump(meta, f, indent=1)
+
+
+def get_checkpoint_metadata(path: str) -> Dict[str, Any]:
+    with open(os.path.join(os.path.abspath(path), _META_FILE)) as f:
+        return json.load(f)
+
+
+def load_state_dict(state_dict: Dict[str, Any], path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    offload: bool = False) -> None:
+    """Load ``path`` into ``state_dict`` IN PLACE (reference semantics).
+    Each destination tensor's current sharding is the target layout: orbax
+    restores straight into that ``NamedSharding``, so a checkpoint saved on
+    one mesh (e.g. dp4×mp2) loads onto another (dp2×mp4) without a full
+    gather anywhere."""
+    import orbax.checkpoint as ocp
+    from ...core.tensor import Tensor
+
+    path = os.path.abspath(path)
+    meta = get_checkpoint_metadata(path)
+    flat = _flatten(state_dict)
+    missing = [k for k in flat if k not in meta["tensors"]]
+    if missing:
+        raise KeyError(f"keys not in checkpoint {path}: {missing[:5]}"
+                       f"{'...' if len(missing) > 5 else ''}")
+
+    restore_args = {}
+    for k, v in flat.items():
+        dst = _leaf_value(v)
+        saved = meta["tensors"][k]
+        if list(dst.shape) != saved["shape"]:
+            raise ValueError(
+                f"shape mismatch for {k!r}: checkpoint {saved['shape']} vs "
+                f"destination {list(dst.shape)}")
+        sharding = getattr(dst, "sharding", None)
+        restore_args[k] = ocp.ArrayRestoreArgs(
+            sharding=sharding, global_shape=tuple(dst.shape),
+            dtype=dst.dtype)
+
+    ckptr = ocp.PyTreeCheckpointer()
+    restored = ckptr.restore(
+        os.path.join(path, "state"),
+        args=ocp.args.PyTreeRestore(restore_args=restore_args))
+
+    for k, v in flat.items():
+        val = restored[k]
+        if isinstance(v, Tensor):
+            v._value = val
+        else:
+            # raw-array leaf: caller keeps the returned mapping
+            flat[k] = val
+    # push raw-array updates back into nested structure
+    _write_back(state_dict, restored)
+
+
+def _write_back(state_dict: Dict[str, Any], restored: Dict[str, Any],
+                prefix: str = "") -> None:
+    from ...core.tensor import Tensor
+    for k, v in state_dict.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            _write_back(v, restored, f"{key}.")
+        elif not isinstance(v, Tensor) and key in restored:
+            state_dict[k] = restored[key]

@@ -139,8 +139,8 @@ class GroupShardedStage3(Layer):
 
     def get_all_parameters(self, convert2cpu: bool = False):
         """Reference API: materialize full params (all-gather). Under GSPMD
-        the logical value is already full; this is a no-op provided for
-        checkpoint tooling."""
+        the logical value is already full; this returns the full logical
+        params; sharded save/reshard lives in paddle_tpu.distributed.checkpoint."""
         return list(self._layer.parameters())
 
     def __getattr__(self, item):

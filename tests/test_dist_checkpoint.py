@@ -1,0 +1,160 @@
+"""Distributed checkpoint tests: sharded save + resharding load.
+
+The VERDICT round-1 acceptance bar: save on dp4×mp2, restore on dp2×mp4,
+bitwise-equal logical params.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import checkpoint as dck
+
+
+def _mesh(dp, mp):
+    devs = np.array(jax.devices()[:dp * mp]).reshape(dp, mp)
+    return Mesh(devs, ("dp", "mp"))
+
+
+@pytest.fixture
+def tmp_ckpt(tmp_path):
+    return str(tmp_path / "ckpt")
+
+
+class TestSaveLoadReshard:
+    def test_reshard_dp4mp2_to_dp2mp4(self, tmp_ckpt):
+        mesh_a = _mesh(4, 2)
+        mesh_b = _mesh(2, 4)
+        rng = np.random.default_rng(0)
+
+        col = jnp.asarray(rng.standard_normal((16, 32)), jnp.float32)
+        row = jnp.asarray(rng.standard_normal((32, 16)), jnp.float32)
+        rep = jnp.asarray(rng.standard_normal((8,)), jnp.float32)
+
+        state = {
+            "w_col": paddle.to_tensor(
+                jax.device_put(col, NamedSharding(mesh_a, P(None, "mp")))),
+            "w_row": paddle.to_tensor(
+                jax.device_put(row, NamedSharding(mesh_a, P("mp", None)))),
+            "bias": paddle.to_tensor(
+                jax.device_put(rep, NamedSharding(mesh_a, P()))),
+        }
+        dck.save_state_dict(state, tmp_ckpt)
+
+        dst = {
+            "w_col": paddle.to_tensor(jax.device_put(
+                jnp.zeros_like(col), NamedSharding(mesh_b, P("mp", None)))),
+            "w_row": paddle.to_tensor(jax.device_put(
+                jnp.zeros_like(row), NamedSharding(mesh_b, P(None, "mp")))),
+            "bias": paddle.to_tensor(jax.device_put(
+                jnp.zeros_like(rep), NamedSharding(mesh_b, P("dp")))),
+        }
+        dck.load_state_dict(dst, tmp_ckpt)
+
+        np.testing.assert_array_equal(np.asarray(dst["w_col"]._value), col)
+        np.testing.assert_array_equal(np.asarray(dst["w_row"]._value), row)
+        np.testing.assert_array_equal(np.asarray(dst["bias"]._value), rep)
+        # the load must land ON the requested target sharding
+        assert dst["w_col"]._value.sharding.spec == P("mp", None)
+        assert dst["w_row"]._value.sharding.spec == P(None, "mp")
+
+    def test_model_state_roundtrip_bf16(self, tmp_ckpt):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        paddle.seed(7)
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+        model.to(dtype="bfloat16")
+        sd = model.state_dict()
+        ref = {k: np.asarray(v._value.astype(jnp.float32))
+               for k, v in sd.items()}
+        dck.save_state_dict(sd, tmp_ckpt)
+
+        paddle.seed(8)
+        model2 = LlamaForCausalLM(LlamaConfig.tiny())
+        model2.to(dtype="bfloat16")
+        sd2 = model2.state_dict()
+        dck.load_state_dict(sd2, tmp_ckpt)
+        for k, v in sd2.items():
+            assert str(v._value.dtype) == "bfloat16"
+            np.testing.assert_array_equal(
+                np.asarray(v._value.astype(jnp.float32)), ref[k],
+                err_msg=f"param {k} did not round-trip")
+
+    def test_nested_dict_and_metadata(self, tmp_ckpt):
+        state = {"model": {"w": paddle.to_tensor(np.ones((4, 4), np.float32))},
+                 "opt": {"step": paddle.to_tensor(np.asarray(3, np.int32))}}
+        dck.save_state_dict(state, tmp_ckpt)
+        meta = dck.get_checkpoint_metadata(tmp_ckpt)
+        assert meta["tensors"]["model.w"]["shape"] == [4, 4]
+        assert meta["tensors"]["opt.step"]["dtype"] == "int32"
+
+        dst = {"model": {"w": paddle.to_tensor(np.zeros((4, 4), np.float32))},
+               "opt": {"step": paddle.to_tensor(np.asarray(0, np.int32))}}
+        dck.load_state_dict(dst, tmp_ckpt)
+        np.testing.assert_array_equal(np.asarray(dst["model"]["w"]._value),
+                                      np.ones((4, 4)))
+        assert int(dst["opt"]["step"]._value) == 3
+
+    def test_shape_mismatch_raises(self, tmp_ckpt):
+        dck.save_state_dict(
+            {"w": paddle.to_tensor(np.ones((4, 4), np.float32))}, tmp_ckpt)
+        with pytest.raises(ValueError):
+            dck.load_state_dict(
+                {"w": paddle.to_tensor(np.zeros((2, 2), np.float32))},
+                tmp_ckpt)
+
+    def test_missing_key_raises(self, tmp_ckpt):
+        dck.save_state_dict(
+            {"w": paddle.to_tensor(np.ones((4, 4), np.float32))}, tmp_ckpt)
+        with pytest.raises(KeyError):
+            dck.load_state_dict(
+                {"nope": paddle.to_tensor(np.zeros((4, 4), np.float32))},
+                tmp_ckpt)
+
+
+class TestTrainResume:
+    def test_sharded_train_save_resume_on_new_mesh(self, tmp_ckpt):
+        """Train 2 steps on dp4×mp2, checkpoint params, restore onto dp2×mp4,
+        train 1 more step on each path — losses must match exactly."""
+        from paddle_tpu.distributed.fleet.base_topology import (
+            _reset_hcg, create_hybrid_communicate_group)
+        from paddle_tpu.hapi import TrainStep
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        rng = np.random.default_rng(0)
+        cfg = LlamaConfig.tiny()
+        ids = rng.integers(0, cfg.vocab_size, (8, 17))
+        x = paddle.to_tensor(ids[:, :-1].astype(np.int32))
+        y = paddle.to_tensor(ids[:, 1:].astype(np.int32))
+
+        def build(dp, mp):
+            _reset_hcg()
+            hcg = create_hybrid_communicate_group(dp_degree=dp, mp_degree=mp)
+            paddle.seed(0)
+            model = LlamaForCausalLM(cfg)
+            opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+            step = TrainStep(model, opt, mesh=hcg.get_mesh(),
+                             data_axes=("dp",))
+            return model, step
+
+        model_a, step_a = build(4, 2)
+        step_a(x, y)
+        step_a(x, y)
+        # save the live SHARDED training params (mesh A layouts) directly
+        dck.save_state_dict(dict(step_a.params), tmp_ckpt)
+        loss_a = float(step_a(x, y))
+
+        model_b, step_b = build(2, 4)
+        dst = {}
+        for k, v in step_b.params.items():
+            z = jnp.zeros(v.shape, v.dtype)
+            if step_b.param_shardings is not None:
+                z = jax.device_put(z, step_b.param_shardings[k])
+            dst[k] = z
+        dck.load_state_dict(dst, tmp_ckpt)   # reshard mesh A -> mesh B
+        step_b.params = dst
+        loss_b = float(step_b(x, y))
+        np.testing.assert_allclose(loss_a, loss_b, rtol=1e-5)
