@@ -45,7 +45,9 @@ class Tensor:
         "_out_index",
         "_retain_grads",
         "_backward_hooks",
-        "dist_attr",        # sharding annotation (auto_parallel)
+        "dist_attr",        # sharding annotation (auto_parallel): PartitionSpec
+        "process_mesh",     # auto_parallel ProcessMesh (shard_tensor output)
+        "placements",       # auto_parallel placements list (shard_tensor)
         "__weakref__",
     )
 

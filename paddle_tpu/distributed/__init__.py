@@ -1,6 +1,10 @@
 """paddle_tpu.distributed (reference: python/paddle/distributed/)."""
 
-from . import checkpoint, communication, fleet, sharding, utils  # noqa: F401
+from . import auto_parallel, checkpoint, communication, fleet, sharding, utils  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Engine, Partial, Placement, ProcessMesh, Replicate, Shard,
+    dtensor_from_fn, reshard, shard_optimizer, shard_tensor,
+)
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .communication import (  # noqa: F401
     Group, P2POp, ReduceOp, Task, all_gather, all_gather_object, all_reduce,

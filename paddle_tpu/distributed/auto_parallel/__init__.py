@@ -1,0 +1,316 @@
+"""auto_parallel: ProcessMesh + placement-annotated tensors + Engine.
+
+Reference: python/paddle/distributed/auto_parallel/ — ``ProcessMesh``,
+``shard_tensor``, ``Shard/Replicate/Partial`` placements, ``reshard``,
+``dtensor_from_fn``, and the static ``Engine`` (SURVEY.md §1 L5b).
+
+TPU-native design: this is the subsystem SURVEY §7.1 calls "nearly 1:1 with
+pjit/GSPMD". A ``ProcessMesh`` is a ``jax.sharding.Mesh``; a placements list
+(one entry per MESH dim saying which tensor dim it shards) converts to a
+``PartitionSpec`` (one entry per TENSOR dim listing mesh axes); and
+``shard_tensor``/``reshard`` are ``jax.device_put`` with the resulting
+``NamedSharding``. The reference's SPMD completion pass (filling in dist
+attrs on every intermediate op) is exactly what GSPMD does inside XLA, so
+annotating inputs + params is the whole user-facing job. ``Partial`` is an
+annotation-only state here (GSPMD materializes partial values only inside
+compiled programs; a user-held partial tensor is represented replicated with
+the pending-reduce recorded in ``dist_attr``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+    "shard_tensor", "dtensor_from_fn", "reshard", "shard_optimizer",
+    "Engine", "placements_to_spec", "spec_to_placements",
+]
+
+
+# ------------------------------------------------------------- placements
+class Placement:
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return False
+
+    def is_replicate(self) -> bool:
+        return False
+
+    def is_partial(self) -> bool:
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class Shard(Placement):
+    """This mesh dim shards tensor dim ``dim`` (reference: dist.Shard)."""
+    dim: int
+
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return dim is None or dim == self.dim
+
+
+@dataclasses.dataclass(frozen=True)
+class Replicate(Placement):
+    def is_replicate(self) -> bool:
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class Partial(Placement):
+    """Pending reduction over this mesh dim (reference: dist.Partial)."""
+    reduce_type: str = "sum"
+
+    def is_partial(self) -> bool:
+        return True
+
+
+# ------------------------------------------------------------ ProcessMesh
+class ProcessMesh:
+    """An N-D logical processor array (reference:
+    python/paddle/distributed/auto_parallel/process_mesh.py). ``mesh`` is a
+    (nested) list / ndarray of global process ids; ``dim_names`` label the
+    axes ("dp"/"mp"/"pp"/...)."""
+
+    def __init__(self, mesh: Union[Sequence, np.ndarray],
+                 dim_names: Optional[Sequence[str]] = None,
+                 shape=None, process_ids=None):
+        if mesh is None and shape is not None and process_ids is not None:
+            arr = np.asarray(process_ids).reshape(shape)
+        else:
+            arr = np.asarray(mesh)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        self._mesh = arr.astype(np.int64)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(self._mesh.ndim)]
+        if len(dim_names) != self._mesh.ndim:
+            raise ValueError(
+                f"{len(dim_names)} dim_names for a {self._mesh.ndim}-d mesh")
+        self._dim_names = list(dim_names)
+
+    # reference-shaped accessors
+    @property
+    def shape(self) -> List[int]:
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return self._mesh.ravel().tolist()
+
+    @property
+    def mesh(self) -> np.ndarray:
+        return self._mesh
+
+    def get_dim_size(self, name: str) -> int:
+        return self._mesh.shape[self._dim_names.index(name)]
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._mesh, other._mesh)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._mesh.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self._dim_names})")
+
+    # ---- jax bridge
+    def to_jax_mesh(self) -> Mesh:
+        """Device mesh with this topology: process id i -> jax.devices()[i]."""
+        devs = jax.devices()
+        n = self._mesh.size
+        if n > len(devs):
+            raise RuntimeError(
+                f"ProcessMesh needs {n} devices, have {len(devs)}")
+        arr = np.empty(self._mesh.shape, dtype=object)
+        flat_ids = self._mesh.ravel()
+        flat = [devs[int(i)] for i in flat_ids]
+        arr.ravel()[:] = flat
+        return Mesh(arr, tuple(self._dim_names))
+
+
+def placements_to_spec(placements: Sequence[Placement],
+                       mesh: ProcessMesh) -> P:
+    """Per-mesh-dim placements -> per-tensor-dim PartitionSpec."""
+    entries: dict = {}
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            entries.setdefault(pl.dim, []).append(
+                mesh.dim_names[mesh_dim])
+        elif not isinstance(pl, (Replicate, Partial)):
+            raise TypeError(f"unknown placement {pl!r}")
+    if not entries:
+        return P()
+    ndim = max(entries) + 1
+    out = []
+    for d in range(ndim):
+        names = entries.get(d)
+        if not names:
+            out.append(None)
+        elif len(names) == 1:
+            out.append(names[0])
+        else:
+            out.append(tuple(names))
+    return P(*out)
+
+
+def spec_to_placements(spec: P, mesh: ProcessMesh) -> List[Placement]:
+    """Inverse of placements_to_spec (Replicate for unused mesh dims)."""
+    out: List[Placement] = [Replicate() for _ in range(mesh.ndim)]
+    for tensor_dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        for name in (entry,) if isinstance(entry, str) else entry:
+            out[mesh.dim_names.index(name)] = Shard(tensor_dim)
+    return out
+
+
+# --------------------------------------------------------------- dist API
+def _ensure_tensor(x, dtype=None, stop_gradient=None):
+    from ...core.tensor import Tensor
+    if isinstance(x, Tensor):
+        return x
+    t = Tensor(jnp.asarray(x), stop_gradient=True if stop_gradient is None
+               else stop_gradient)
+    return t
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None):
+    """Distribute ``data`` over ``mesh`` per ``placements`` (reference:
+    dist.shard_tensor). The value lands sharded on the devices via GSPMD
+    layout; ``dist_attr``/``process_mesh``/``placements`` are recorded on
+    the Tensor so parallel wrappers and TrainStep pick the spec up."""
+    from ...core.tensor import Tensor
+
+    t = _ensure_tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+    spec = placements_to_spec(placements, mesh)
+    jmesh = mesh.to_jax_mesh()
+    val = t._value
+    if dtype is not None:
+        from ...core.dtype import to_jax_dtype
+        val = val.astype(to_jax_dtype(dtype))
+    sharded = jax.device_put(val, NamedSharding(jmesh, spec))
+    out = Tensor(sharded, stop_gradient=t.stop_gradient
+                 if stop_gradient is None else stop_gradient)
+    out.dist_attr = spec
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    return out
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements: Sequence[Placement],
+                    *args, **kwargs):
+    """Build via ``fn`` then distribute (reference: dist.dtensor_from_fn)."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(x, mesh: ProcessMesh, placements: Sequence[Placement]):
+    """Re-distribute an existing (dist) tensor (reference: dist.reshard)."""
+    return shard_tensor(x, mesh, placements)
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Reference: dist.shard_optimizer. Under GSPMD the optimizer states
+    inherit the param shardings inside the jitted step automatically, so
+    this is a pass-through marker kept for API parity."""
+    return optimizer
+
+
+# ------------------------------------------------------------------ Engine
+class Engine:
+    """Minimal auto-parallel Engine (reference:
+    python/paddle/distributed/auto_parallel/static/engine.py): wraps a
+    model + loss + optimizer into a jitted distributed TrainStep and drives
+    epochs over a data source."""
+
+    def __init__(self, model, loss=None, optimizer=None, metrics=None,
+                 strategy=None, mesh: Optional[ProcessMesh] = None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics or []
+        self._strategy = strategy
+        self._process_mesh = mesh
+        self._step = None
+        self.history: List[float] = []
+
+    def _jax_mesh(self) -> Optional[Mesh]:
+        if self._process_mesh is not None:
+            return self._process_mesh.to_jax_mesh()
+        try:
+            from ..fleet.base_topology import get_hybrid_communicate_group
+            return get_hybrid_communicate_group().get_mesh()
+        except Exception:
+            return None
+
+    def prepare(self, data_axes=("dp",)):
+        if self._step is None:
+            from ...hapi.train_step import TrainStep
+            self._step = TrainStep(
+                self._model, self._optimizer, loss_fn=self._loss,
+                mesh=self._jax_mesh(), data_axes=tuple(data_axes))
+        return self._step
+
+    def fit(self, train_data, epochs: int = 1, batch_size: Optional[int] = None,
+            steps_per_epoch: Optional[int] = None, verbose: int = 0,
+            log_freq: int = 10):
+        """train_data: an iterable of (inputs, labels) batches (DataLoader
+        or list). Returns the per-step loss history."""
+        step = self.prepare()
+        for _ in range(epochs):
+            for i, batch in enumerate(train_data):
+                if steps_per_epoch is not None and i >= steps_per_epoch:
+                    break
+                loss = step(*batch)
+                self.history.append(float(loss))
+        return self.history
+
+    def evaluate(self, eval_data, steps: Optional[int] = None):
+        from ...jit import functional_call
+        if self._step is not None:
+            # training donated the old param buffers; pull the live ones back
+            self._step.sync_to_model()
+        self._model.eval()
+        params, buffers = self._model.raw_state()
+        losses = []
+        for i, batch in enumerate(eval_data):
+            if steps is not None and i >= steps:
+                break
+            if self._loss is not None:
+                *xs, y = batch
+                out = functional_call(self._model, params, *xs,
+                                      buffers=buffers)
+                from ...jit import tree_to_tensors, tree_to_values
+                loss = tree_to_values(self._loss(tree_to_tensors(out), y))
+            else:
+                loss = functional_call(self._model, params, *batch,
+                                       buffers=buffers)
+            losses.append(float(np.asarray(loss)))
+        return {"loss": float(np.mean(losses)) if losses else float("nan")}
+
+    def state_dict(self):
+        if self._step is not None:
+            return self._step.state_dict()
+        return self._model.state_dict()
+
+    def save(self, path: str):
+        from ... import save
+        save(self.state_dict(), path)

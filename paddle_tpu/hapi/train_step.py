@@ -162,8 +162,15 @@ class TrainStep:
 
         def loss_of(p, batch):
             if self.loss_fn is not None:
+                from ..core import autograd
+                from ..jit import tree_to_tensors
                 out = functional_call(model, p, *batch[:-1], buffers=self.buffers)
-                return self.loss_fn(out, batch[-1])
+                # loss_fn is user code over Tensors (a paddle loss Layer or
+                # lambda); run it under the functional guard and unwrap
+                with autograd.functional_guard():
+                    loss = self.loss_fn(tree_to_tensors(out),
+                                        tree_to_tensors(batch[-1]))
+                return tree_to_values(loss)
             # default: the model returns the scalar loss itself
             return functional_call(model, p, *batch, buffers=self.buffers)
 

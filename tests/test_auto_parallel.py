@@ -1,0 +1,136 @@
+"""auto_parallel surface tests: ProcessMesh, placements<->specs,
+shard_tensor/reshard dist-attrs, Engine.fit. Topology-is-data (SURVEY §4):
+everything runs on the simulated 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+class TestProcessMesh:
+    def test_shape_names_ids(self):
+        m = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], ["dp", "mp"])
+        assert m.shape == [2, 4]
+        assert m.ndim == 2
+        assert m.dim_names == ["dp", "mp"]
+        assert m.process_ids == list(range(8))
+        assert m.get_dim_size("mp") == 4
+
+    def test_eq_hash(self):
+        a = dist.ProcessMesh([[0, 1], [2, 3]], ["x", "y"])
+        b = dist.ProcessMesh([[0, 1], [2, 3]], ["x", "y"])
+        c = dist.ProcessMesh([[0, 1], [2, 3]], ["x", "z"])
+        assert a == b and hash(a) == hash(b)
+        assert a != c
+
+    def test_to_jax_mesh(self):
+        m = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], ["dp", "mp"])
+        jm = m.to_jax_mesh()
+        assert jm.axis_names == ("dp", "mp")
+        assert dict(jm.shape) == {"dp": 2, "mp": 4}
+
+
+class TestPlacements:
+    def setup_method(self, _):
+        self.mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                                     ["dp", "mp"])
+
+    def test_placements_to_spec(self):
+        from paddle_tpu.distributed.auto_parallel import placements_to_spec
+        assert placements_to_spec(
+            [dist.Shard(0), dist.Replicate()], self.mesh) == P("dp")
+        assert placements_to_spec(
+            [dist.Replicate(), dist.Shard(1)], self.mesh) == P(None, "mp")
+        assert placements_to_spec(
+            [dist.Shard(1), dist.Shard(0)], self.mesh) == P("mp", "dp")
+        assert placements_to_spec(
+            [dist.Shard(0), dist.Shard(0)], self.mesh) == P(("dp", "mp"))
+        assert placements_to_spec(
+            [dist.Replicate(), dist.Replicate()], self.mesh) == P()
+
+    def test_spec_roundtrip(self):
+        from paddle_tpu.distributed.auto_parallel import (
+            placements_to_spec, spec_to_placements)
+        for pls in ([dist.Shard(0), dist.Replicate()],
+                    [dist.Replicate(), dist.Shard(1)],
+                    [dist.Shard(1), dist.Shard(0)]):
+            spec = placements_to_spec(pls, self.mesh)
+            assert spec_to_placements(spec, self.mesh) == pls
+
+    def test_placement_predicates(self):
+        assert dist.Shard(1).is_shard() and dist.Shard(1).is_shard(1)
+        assert not dist.Shard(1).is_shard(0)
+        assert dist.Replicate().is_replicate()
+        assert dist.Partial().is_partial()
+        assert dist.Partial().reduce_type == "sum"
+
+
+class TestShardTensor:
+    def setup_method(self, _):
+        self.mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                                     ["dp", "mp"])
+
+    def test_shard_tensor_attrs_and_layout(self):
+        x = np.arange(32, dtype=np.float32).reshape(8, 4)
+        t = dist.shard_tensor(x, self.mesh, [dist.Shard(0), dist.Replicate()])
+        assert t.dist_attr == P("dp")
+        assert t.process_mesh == self.mesh
+        assert t.placements == [dist.Shard(0), dist.Replicate()]
+        assert t._value.sharding.spec == P("dp")
+        np.testing.assert_array_equal(np.asarray(t._value), x)
+
+    def test_reshard_changes_layout(self):
+        x = np.arange(64, dtype=np.float32).reshape(8, 8)
+        t = dist.shard_tensor(x, self.mesh, [dist.Shard(0), dist.Replicate()])
+        r = dist.reshard(t, self.mesh, [dist.Replicate(), dist.Shard(1)])
+        assert r.dist_attr == P(None, "mp")
+        np.testing.assert_array_equal(np.asarray(r._value), x)
+
+    def test_dtensor_from_fn(self):
+        t = dist.dtensor_from_fn(
+            lambda: np.ones((4, 4), np.float32), self.mesh,
+            [dist.Replicate(), dist.Shard(1)])
+        assert t.dist_attr == P(None, "mp")
+
+    def test_trainstep_consumes_shard_tensor_annotation(self):
+        """A param annotated via shard_tensor dist_attr must surface in the
+        TrainStep's param shardings (dist-attr in -> GSPMD layout out)."""
+        from paddle_tpu.hapi import TrainStep
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        net = nn.Linear(8, 8)
+        spec = P(None, "mp")
+        net.weight.dist_attr = spec
+        hcg_mesh = self.mesh.to_jax_mesh()
+        opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+        step = TrainStep(net, opt, mesh=hcg_mesh,
+                         loss_fn=lambda out, y: (out - y).square().mean(),
+                         data_axes=("dp",))
+        assert step.param_shardings["weight"].spec == spec
+
+
+class TestEngine:
+    def test_fit_decreases_loss(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 8))
+        opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+        mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], ["dp", "mp"])
+        eng = dist.Engine(net, loss=lambda out, y: F.mse_loss(out, y),
+                          optimizer=opt, mesh=mesh)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32))
+        data = [(x, x)] * 10
+        hist = eng.fit(data, epochs=2)
+        assert len(hist) == 20
+        assert hist[-1] < hist[0] * 0.7
+        res = eng.evaluate([(x, x)])
+        assert np.isfinite(res["loss"])
