@@ -17,3 +17,5 @@ from .parallel import (  # noqa: F401
     ParallelEnv, device_count, get_rank, get_world_size, init_parallel_env,
     is_initialized,
 )
+from . import launch  # noqa: F401
+from .spawn import spawn  # noqa: F401

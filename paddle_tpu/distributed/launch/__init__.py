@@ -1,0 +1,21 @@
+"""Distributed launch CLI + restart supervisor.
+
+Reference: python/paddle/distributed/launch/ (``python -m
+paddle.distributed.launch``): argument context, PADDLE_* env protocol,
+per-rank log files, a controller that spawns/watches/tears-down workers,
+and the elastic manager (fleet/elastic/manager.py) that relaunches on
+failure — SURVEY.md §1 L6 + §5.3.
+
+TPU-native mapping: a JAX job runs ONE process per host (all local chips
+belong to it), so ``--nproc_per_node`` defaults to 1 and rank == node id.
+The launcher's real job is the env protocol (PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM / PADDLE_MASTER / PADDLE_TRAINER_ENDPOINTS — consumed
+by ``init_parallel_env`` -> ``jax.distributed.initialize``) plus process
+supervision: per-rank logs, fail-fast teardown of the whole gang, and
+bounded elastic restarts with a fresh rendezvous each round. Multi-process-
+per-host is still supported for CPU-simulated testing.
+"""
+
+from .main import launch, main  # noqa: F401
+from .controller import Controller, LaunchContext  # noqa: F401
+from .elastic import ElasticManager, FileRendezvous, Rendezvous  # noqa: F401

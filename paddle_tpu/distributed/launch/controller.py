@@ -1,0 +1,155 @@
+"""Launch controller: env protocol + process gang supervision.
+
+Reference: python/paddle/distributed/launch/controllers/collective.py —
+build per-rank environments, spawn workers, watch, tear down the whole gang
+when any member dies (a hung collective cannot make progress with a missing
+peer), surface the failing rank's log tail.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class LaunchContext:
+    """Parsed launch arguments (reference: launch/context/__init__.py)."""
+    training_script: str
+    training_script_args: List[str] = dataclasses.field(default_factory=list)
+    nnodes: int = 1
+    node_rank: int = 0
+    nproc_per_node: int = 1
+    master: Optional[str] = None          # host:port of rank-0 coordinator
+    log_dir: str = "log"
+    job_id: str = "default"
+    devices: Optional[str] = None
+    max_restart: int = 0                  # elastic: restarts allowed
+    run_module: bool = False              # python -m script
+
+    @property
+    def world_size(self) -> int:
+        return self.nnodes * self.nproc_per_node
+
+    def rank_env(self, local_rank: int) -> Dict[str, str]:
+        """PADDLE_* env protocol for one worker (reference:
+        launch/job/pod.py). Endpoints are synthesized host:port pairs; on a
+        real multi-host job each host runs one worker and PADDLE_MASTER
+        carries the coordinator address."""
+        rank = self.node_rank * self.nproc_per_node + local_rank
+        master = self.master or "127.0.0.1:8070"
+        host = master.split(":")[0]
+        base_port = int(master.split(":")[1]) + 1
+        endpoints = [f"{host}:{base_port + r}"
+                     for r in range(self.world_size)]
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(self.world_size),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_LOCAL_SIZE": str(self.nproc_per_node),
+            "PADDLE_MASTER": master,
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_JOB_ID": self.job_id,
+            "FLAGS_selected_devices": self.devices or "",
+        }
+        return env
+
+
+class Controller:
+    """Spawn + watch a local worker gang (reference:
+    launch/controllers/controller.py)."""
+
+    def __init__(self, ctx: LaunchContext,
+                 base_env: Optional[Dict[str, str]] = None):
+        self.ctx = ctx
+        self.base_env = dict(os.environ if base_env is None else base_env)
+        self.procs: List[subprocess.Popen] = []
+        self.log_paths: List[str] = []
+
+    def build_cmd(self) -> List[str]:
+        cmd = [sys.executable]
+        if self.ctx.run_module:
+            cmd.append("-m")
+        cmd.append(self.ctx.training_script)
+        cmd.extend(self.ctx.training_script_args)
+        return cmd
+
+    def start(self) -> None:
+        os.makedirs(self.ctx.log_dir, exist_ok=True)
+        self.procs, self.log_paths = [], []
+        for lr in range(self.ctx.nproc_per_node):
+            env = dict(self.base_env)
+            env.update(self.ctx.rank_env(lr))
+            rank = env["PADDLE_TRAINER_ID"]
+            log_path = os.path.join(self.ctx.log_dir,
+                                    f"workerlog.{rank}")
+            self.log_paths.append(log_path)
+            logf = open(log_path, "ab")
+            self.procs.append(subprocess.Popen(
+                self.build_cmd(), env=env, stdout=logf, stderr=logf,
+                start_new_session=True))
+
+    def poll(self) -> Optional[int]:
+        """None while all run; first nonzero rc on failure; 0 when all
+        exited clean."""
+        rcs = [p.poll() for p in self.procs]
+        for rc in rcs:
+            if rc is not None and rc != 0:
+                return rc
+        if all(rc == 0 for rc in rcs):
+            return 0
+        return None
+
+    def watch(self, poll_interval: float = 0.2,
+              timeout: Optional[float] = None) -> int:
+        """Block until the gang finishes or any member fails (then tear the
+        rest down — reference fail-fast semantics). Returns the gang rc."""
+        t0 = time.time()
+        while True:
+            rc = self.poll()
+            if rc == 0:
+                return 0
+            if rc is not None:
+                self.stop()
+                return rc
+            if timeout is not None and time.time() - t0 > timeout:
+                self.stop()
+                return 124
+            time.sleep(poll_interval)
+
+    def stop(self, sig: int = signal.SIGTERM, grace: float = 3.0) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), sig)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        deadline = time.time() + grace
+        for p in self.procs:
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                p.wait()
+
+    def tail_logs(self, n_bytes: int = 2000) -> Dict[str, str]:
+        out = {}
+        for path in self.log_paths:
+            try:
+                with open(path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    f.seek(max(0, size - n_bytes))
+                    out[path] = f.read().decode(errors="replace")
+            except OSError:
+                out[path] = ""
+        return out

@@ -1,0 +1,114 @@
+"""Elastic manager: bounded-restart supervision over a rendezvous.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py — an
+etcd-backed rendezvous tracks alive nodes; when membership changes or a
+worker dies, the manager tears down the gang, re-registers, and relaunches
+(up to ``max_restart`` times). SURVEY.md §5.3.
+
+Here the rendezvous is an interface: ``FileRendezvous`` (a shared
+directory — works for single-host tests and NFS-backed pods) is provided;
+an etcd/GCS-backed one is a drop-in. The supervision loop itself — the
+hard part to get right — is fully implemented and tested with killed
+subprocesses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from .controller import Controller, LaunchContext
+
+
+class Rendezvous:
+    """Membership registry interface (reference: ElasticManager's etcd)."""
+
+    def register(self, node_id: str, info: Dict) -> None:
+        raise NotImplementedError
+
+    def deregister(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def alive_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def barrier(self, world_size: int, timeout: float = 30.0) -> bool:
+        """Wait until ``world_size`` nodes are registered."""
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            if len(self.alive_nodes()) >= world_size:
+                return True
+            time.sleep(0.1)
+        return False
+
+
+class FileRendezvous(Rendezvous):
+    """Directory-backed rendezvous: one JSON file per alive node."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        os.makedirs(self.path, exist_ok=True)
+
+    def _file(self, node_id: str) -> str:
+        return os.path.join(self.path, f"node.{node_id}.json")
+
+    def register(self, node_id: str, info: Dict) -> None:
+        with open(self._file(node_id), "w") as f:
+            json.dump({"id": node_id, "ts": time.time(), **info}, f)
+
+    def deregister(self, node_id: str) -> None:
+        try:
+            os.unlink(self._file(node_id))
+        except FileNotFoundError:
+            pass
+
+    def alive_nodes(self) -> List[str]:
+        out = []
+        for name in sorted(os.listdir(self.path)):
+            if name.startswith("node.") and name.endswith(".json"):
+                out.append(name[len("node."):-len(".json")])
+        return out
+
+
+class ElasticManager:
+    """Launch + watch + relaunch loop (reference: ElasticManager.run)."""
+
+    def __init__(self, ctx: LaunchContext,
+                 rendezvous: Optional[Rendezvous] = None,
+                 node_id: Optional[str] = None,
+                 base_env: Optional[Dict[str, str]] = None):
+        self.ctx = ctx
+        self.rdzv = rendezvous
+        self.node_id = node_id or uuid.uuid4().hex[:8]
+        self.base_env = base_env
+        self.restarts = 0
+        self.history: List[int] = []       # gang rc per round
+
+    def run(self, poll_interval: float = 0.2,
+            round_timeout: Optional[float] = None) -> int:
+        """Supervise until clean exit or restart budget exhausted. Returns
+        the final gang rc (0 on success)."""
+        while True:
+            if self.rdzv is not None:
+                self.rdzv.register(self.node_id, {
+                    "rank": self.ctx.node_rank,
+                    "restarts": self.restarts})
+                ok = self.rdzv.barrier(self.ctx.nnodes)
+                if not ok:
+                    self.rdzv.deregister(self.node_id)
+                    return 125          # rendezvous failed to converge
+            controller = Controller(self.ctx, base_env=self.base_env)
+            controller.start()
+            rc = controller.watch(poll_interval=poll_interval,
+                                  timeout=round_timeout)
+            self.history.append(rc)
+            if self.rdzv is not None:
+                self.rdzv.deregister(self.node_id)
+            if rc == 0:
+                return 0
+            if self.restarts >= self.ctx.max_restart:
+                return rc
+            self.restarts += 1

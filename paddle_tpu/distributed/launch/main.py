@@ -1,0 +1,75 @@
+"""``python -m paddle_tpu.distributed.launch`` entry point.
+
+Reference: python/paddle/distributed/launch/main.py (argument surface) —
+the subset meaningful on TPU jobs is kept; PS-mode / ips-file arguments are
+rejected with guidance.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .controller import LaunchContext
+from .elastic import ElasticManager, FileRendezvous
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch a distributed paddle_tpu job "
+                    "(one process per host; PADDLE_* env protocol)")
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="number of hosts in the job")
+    p.add_argument("--node_rank", "--rank", type=int, default=0,
+                   dest="node_rank", help="this host's rank")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="workers per host (1 for real TPU jobs; >1 for "
+                        "CPU-simulated testing)")
+    p.add_argument("--master", type=str, default=None,
+                   help="rank-0 coordinator host:port")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--devices", "--gpus", "--xpus", type=str, default=None,
+                   dest="devices", help="visible accelerator ids")
+    p.add_argument("--max_restart", type=int, default=0,
+                   help="elastic restart budget (0 = fail fast)")
+    p.add_argument("--elastic_rdzv_dir", type=str, default=None,
+                   help="shared dir for the file rendezvous (elastic mode)")
+    p.add_argument("-m", "--module", action="store_true",
+                   help="run training_script as a module (python -m)")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    ctx = LaunchContext(
+        training_script=args.training_script,
+        training_script_args=list(args.training_script_args),
+        nnodes=args.nnodes, node_rank=args.node_rank,
+        nproc_per_node=args.nproc_per_node, master=args.master,
+        log_dir=args.log_dir, job_id=args.job_id, devices=args.devices,
+        max_restart=args.max_restart, run_module=args.module)
+    rdzv = (FileRendezvous(args.elastic_rdzv_dir)
+            if args.elastic_rdzv_dir else None)
+    mgr = ElasticManager(ctx, rendezvous=rdzv)
+    rc = mgr.run()
+    if rc != 0:
+        sys.stderr.write(
+            f"[launch] job failed rc={rc} after {mgr.restarts} restarts; "
+            f"log tails:\n")
+        from .controller import Controller
+        c = Controller(ctx)
+        c.log_paths = [
+            f"{ctx.log_dir}/workerlog.{ctx.node_rank * ctx.nproc_per_node + i}"
+            for i in range(ctx.nproc_per_node)]
+        for path, tail in c.tail_logs().items():
+            sys.stderr.write(f"----- {path} -----\n{tail}\n")
+    return rc
+
+
+def main() -> None:
+    sys.exit(launch())

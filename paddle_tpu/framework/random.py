@@ -16,9 +16,18 @@ import jax.numpy as jnp
 
 
 class _RNGState(threading.local):
+    """Key creation is LAZY: materializing a PRNGKey initializes the jax
+    backend, and ``import paddle_tpu`` must never touch backend state (the
+    ambient TPU plugin can hang when its tunnel is down — VERDICT.md r1)."""
+
     def __init__(self):
-        self.key = jax.random.PRNGKey(0)
+        self.key = None
         self.seed_value = 0
+
+    def get_key(self):
+        if self.key is None:
+            self.key = jax.random.PRNGKey(self.seed_value)
+        return self.key
 
 
 _state = _RNGState()
@@ -32,7 +41,7 @@ def seed(s: int):
 
 
 def get_rng_state():
-    return [_state.key]
+    return [_state.get_key()]
 
 
 def set_rng_state(state):
@@ -49,7 +58,7 @@ def set_cuda_rng_state(state):
 
 def next_key() -> jax.Array:
     """Split the global key and return a fresh subkey (eager random ops)."""
-    _state.key, sub = jax.random.split(_state.key)
+    _state.key, sub = jax.random.split(_state.get_key())
     return sub
 
 
