@@ -1,0 +1,85 @@
+"""Activation recompute (gradient checkpointing) user API.
+
+Reference: python/paddle/distributed/fleet/recompute/recompute.py
+(``fleet.utils.recompute(function, *args)``) — forward runs without storing
+intermediate activations; backward re-runs the forward to regenerate them,
+with RNG state replayed so dropout masks match.
+
+TPU-native design: the whole mechanism is ``jax.checkpoint`` around a pure
+function of (params, inputs). Under jit, XLA sees the remat annotation and
+trades FLOPs for HBM exactly like the reference's 1F1B activation story;
+in eager mode the taped vjp holds only the inputs and re-traces the forward
+at backward time. RNG replay is structural: eager random ops split the
+global key at TRACE time, so the key is a constant inside the checkpointed
+jaxpr and the recomputed forward reuses it — no state save/restore dance.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from ....core.tensor import Tensor, apply_op
+from ....jit import functional_call
+from ....nn.layer import Layer
+
+__all__ = ["recompute"]
+
+
+def recompute(function, *args, use_reentrant: bool = True,
+              preserve_rng_state: bool = True, **kwargs):
+    """Run ``function(*args)`` under activation recompute.
+
+    ``function`` may be a Layer (or a Layer's bound method): its parameters
+    join the differentiable inputs, so param grads flow. Plain functions of
+    Tensors work too (their closed-over Tensors are treated as constants,
+    matching the reference's documented contract)."""
+    if kwargs.pop("**kwargs", None):  # pragma: no cover - defensive
+        raise TypeError("unexpected kwargs")
+
+    layer = None
+    method = None
+    if isinstance(function, Layer):
+        layer = function
+    elif hasattr(function, "__self__") and isinstance(function.__self__,
+                                                      Layer):
+        layer = function.__self__
+        method = function.__name__
+
+    if layer is None:
+        def pure(*vals):
+            inner = jax.checkpoint(lambda *v: _call_plain(function, v, kwargs))
+            return inner(*vals)
+        return apply_op("recompute", pure, *args)
+
+    named = [(k, p) for k, p in layer.named_parameters()
+             if not p.stop_gradient]
+    keys = [k for k, _ in named]
+    params = [p for _, p in named]
+    frozen = {k: p._value for k, p in layer.named_parameters()
+              if p.stop_gradient}
+    buffers = {k: (b._value if b is not None else None)
+               for k, b in layer.named_buffers()}
+    buffers.update(frozen)
+    n = len(params)
+
+    def pure(*vals):
+        pvals, avals = vals[:n], vals[n:]
+
+        def fwd(pv, av):
+            pdict = dict(zip(keys, pv))
+            return functional_call(layer, pdict, *av, buffers=buffers,
+                                   method=method, **kwargs)
+
+        return jax.checkpoint(fwd)(pvals, avals)
+
+    return apply_op("recompute", pure, *params, *args)
+
+
+def _call_plain(function, vals, kwargs):
+    from ....core import autograd
+    from ....jit import tree_to_tensors, tree_to_values
+    with autograd.functional_guard():
+        out = function(*tree_to_tensors(vals), **kwargs)
+    return tree_to_values(out)
