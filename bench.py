@@ -49,12 +49,14 @@ def _parent() -> int:
     env = dict(os.environ)
     env["_PADDLE_TPU_BENCH_CHILD"] = "1"
     if not healthy:
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
         env["JAX_PLATFORMS"] = "cpu"
         env["_PADDLE_TPU_BENCH_FALLBACK"] = "tpu_backend_unhealthy"
         # CPU cannot train 345M in reasonable time; shrink unless pinned.
         env.setdefault("BENCH_MODEL", "gpt_tiny")
+    if env.get("JAX_PLATFORMS", "") == "cpu":
+        # the axon plugin can hang at import even when jax is pinned to cpu
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
 
     try:
         r = subprocess.run([sys.executable, os.path.abspath(__file__)],
@@ -166,6 +168,7 @@ def _run_bench() -> dict:
         result["fallback"] = fallback
         result["vs_baseline"] = 0.0  # CPU numbers don't count toward the target
     try:
+        step.sync_to_model()  # training donated the old param buffers
         result.update(_decode_bench(model, cfg, paddle, jax))
     except Exception as e:  # decode bench is best-effort extra signal
         result["decode_error"] = repr(e)[:200]

@@ -16,7 +16,7 @@ jaxpr and the recomputed forward reuses it — no state save/restore dance.
 
 from __future__ import annotations
 
-from typing import Any
+import numpy as np
 
 import jax
 
@@ -27,6 +27,27 @@ from ....nn.layer import Layer
 __all__ = ["recompute"]
 
 
+def _split_static(args):
+    """Partition positional args into traced data (Tensors/arrays) and
+    static Python values (bools, ints, None, ...). The reference passes
+    non-tensor args through unchanged — a bool flag must stay a Python bool
+    inside the checkpointed forward, not become a tracer."""
+    dyn_idx, dyn, template = [], [], list(args)
+    for i, a in enumerate(args):
+        if isinstance(a, (Tensor, jax.Array, np.ndarray)):
+            dyn_idx.append(i)
+            dyn.append(a)
+            template[i] = None
+    return dyn_idx, dyn, template
+
+
+def _merge(template, dyn_idx, vals):
+    full = list(template)
+    for i, v in zip(dyn_idx, vals):
+        full[i] = v
+    return full
+
+
 def recompute(function, *args, use_reentrant: bool = True,
               preserve_rng_state: bool = True, **kwargs):
     """Run ``function(*args)`` under activation recompute.
@@ -34,9 +55,15 @@ def recompute(function, *args, use_reentrant: bool = True,
     ``function`` may be a Layer (or a Layer's bound method): its parameters
     join the differentiable inputs, so param grads flow. Plain functions of
     Tensors work too (their closed-over Tensors are treated as constants,
-    matching the reference's documented contract)."""
-    if kwargs.pop("**kwargs", None):  # pragma: no cover - defensive
-        raise TypeError("unexpected kwargs")
+    matching the reference's documented contract). Non-Tensor positional
+    args (flags, masks-as-None, ...) pass through as static values."""
+    for k, v in kwargs.items():
+        if isinstance(v, Tensor) and not v.stop_gradient:
+            raise TypeError(
+                f"recompute() keyword argument {k!r} is a trainable Tensor; "
+                "kwargs are treated as constants (no grad flows). Pass it "
+                "positionally instead — matching the reference, which "
+                "rejects tensor kwargs in reentrant mode.")
 
     layer = None
     method = None
@@ -47,34 +74,49 @@ def recompute(function, *args, use_reentrant: bool = True,
         layer = function.__self__
         method = function.__name__
 
+    dyn_idx, dyn, template = _split_static(args)
+    # forward may return an arbitrary pytree (e.g. (hidden, cache) or a
+    # dict); apply_op only wraps flat outputs, so flatten inside the traced
+    # fn and unflatten the wrapped Tensors afterwards.
+    treedef_cell = []
+
+    def _flat(out):
+        leaves, treedef = jax.tree_util.tree_flatten(out)
+        treedef_cell.append(treedef)
+        return leaves[0] if len(leaves) == 1 else tuple(leaves)
+
+    def _unflat(result):
+        treedef = treedef_cell[-1]
+        leaves = [result] if treedef.num_leaves == 1 else list(result)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
     if layer is None:
         def pure(*vals):
-            inner = jax.checkpoint(lambda *v: _call_plain(function, v, kwargs))
-            return inner(*vals)
-        return apply_op("recompute", pure, *args)
+            def fwd(*v):
+                return _call_plain(function, _merge(template, dyn_idx, v),
+                                   kwargs)
+            return _flat(jax.checkpoint(fwd)(*vals))
+        return _unflat(apply_op("recompute", pure, *dyn))
 
     named = [(k, p) for k, p in layer.named_parameters()
              if not p.stop_gradient]
     keys = [k for k, _ in named]
-    params = [p for _, p in named]
-    frozen = {k: p._value for k, p in layer.named_parameters()
-              if p.stop_gradient}
-    buffers = {k: (b._value if b is not None else None)
-               for k, b in layer.named_buffers()}
-    buffers.update(frozen)
-    n = len(params)
+    ptensors = [p for _, p in named]  # Tensors: eager grads flow back
+    _, buffers = layer.raw_state()  # frozen params merged into buffers
+    n = len(ptensors)
 
     def pure(*vals):
         pvals, avals = vals[:n], vals[n:]
 
         def fwd(pv, av):
             pdict = dict(zip(keys, pv))
-            return functional_call(layer, pdict, *av, buffers=buffers,
-                                   method=method, **kwargs)
+            return functional_call(
+                layer, pdict, *_merge(template, dyn_idx, av),
+                buffers=buffers, method=method, **kwargs)
 
-        return jax.checkpoint(fwd)(pvals, avals)
+        return _flat(jax.checkpoint(fwd)(pvals, avals))
 
-    return apply_op("recompute", pure, *params, *args)
+    return _unflat(apply_op("recompute", pure, *ptensors, *dyn))
 
 
 def _call_plain(function, vals, kwargs):

@@ -95,7 +95,9 @@ class GenerationMixin:
         was_training = self.training
         self.eval()
         try:
+            from ..jit import ensure_live
             params, buffers = self.raw_state()
+            ensure_live(params, "call step.sync_to_model() before generate().")
             sig = (b, p, int(max_new_tokens), bool(do_sample), int(top_k),
                    eos_token_id, pad_token_id)
             cache = getattr(self, "_generate_jit_cache", None)

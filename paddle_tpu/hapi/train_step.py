@@ -57,12 +57,9 @@ class TrainStep:
         self.mesh = mesh
         self.grad_accum_steps = grad_accum_steps
         params, buffers = model.raw_state()
-        for k, v in params.items():
-            if hasattr(v, "is_deleted") and v.is_deleted():
-                raise RuntimeError(
-                    f"parameter {k!r} was donated to a previous TrainStep's "
-                    "compiled program; call prev_step.sync_to_model() before "
-                    "building a new TrainStep (or pass donate=False).")
+        from ..jit import ensure_live
+        ensure_live(params, "call prev_step.sync_to_model() before building "
+                            "a new TrainStep (or pass donate=False).")
         self.buffers = buffers
 
         if mesh is not None:

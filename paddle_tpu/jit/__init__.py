@@ -39,6 +39,16 @@ def tree_to_tensors(tree):
     return jax.tree.map(_wrap_value, tree)
 
 
+def ensure_live(params: Dict[str, Any], hint: str) -> None:
+    """Raise a helpful error when any param value was donated to a compiled
+    program (jax deletes donated buffers). ``hint`` names the remedy."""
+    for k, v in params.items():
+        if hasattr(v, "is_deleted") and v.is_deleted():
+            raise RuntimeError(
+                f"parameter {k!r} was donated to a TrainStep's compiled "
+                f"program; {hint}")
+
+
 def functional_call(
     layer,
     params: Dict[str, Any],

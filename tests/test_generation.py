@@ -85,6 +85,29 @@ class TestGenerate:
         with pytest.raises(ValueError):
             model.generate(prompt, max_new_tokens=64)
 
+    def test_generate_after_donated_train_step(self):
+        """TrainStep donates param buffers; generate() must either see the
+        live params (after sync_to_model) or raise a helpful error — never
+        the raw 'Array has been deleted' crash (bench.py regression)."""
+        from paddle_tpu.hapi import TrainStep
+
+        cfg = GPTConfig.tiny()
+        model = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+        step = TrainStep(model, opt)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (2, 17))
+        x = paddle.to_tensor(ids[:, :-1].astype(np.int32))
+        y = paddle.to_tensor(ids[:, 1:].astype(np.int32))
+        step(x, y)
+
+        prompt = paddle.to_tensor(ids[:, :8].astype(np.int32))
+        with pytest.raises(RuntimeError, match="sync_to_model"):
+            model.generate(prompt, max_new_tokens=2)
+        step.sync_to_model()
+        out = model.generate(prompt, max_new_tokens=2, do_sample=False)
+        assert out.numpy().shape == (2, 10)
+
 
 class TestCachedAttention:
     def test_prefill_matches_dense(self):

@@ -62,6 +62,76 @@ class TestRecompute:
         for _, p in net.named_parameters():
             assert p.grad is not None
 
+    def test_non_tensor_args_stay_static(self):
+        """Reference contract: non-tensor positional args (bool flags, None
+        masks) pass through unchanged — Python control flow on them must
+        work inside the recomputed forward."""
+
+        class Flagged(nn.Layer):
+            def __init__(self, h):
+                super().__init__()
+                self.fc = nn.Linear(h, h)
+
+            def forward(self, x, double, mask=None):
+                h = self.fc(x)
+                if double:  # crashes if `double` became a tracer
+                    h = h * 2
+                if mask is not None:
+                    h = h + mask
+                return h
+
+        paddle.seed(3)
+        net = Flagged(8)
+        x = paddle.to_tensor(
+            np.random.default_rng(2).standard_normal((4, 8)).astype(
+                np.float32), stop_gradient=False)
+        out = recompute(net, x, True)
+        ref = net(x, True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+        out.mean().backward()
+        for _, p in net.named_parameters():
+            assert p.grad is not None
+
+    def test_trainable_tensor_kwarg_rejected(self):
+        import pytest
+
+        net = Block(8)
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        bias = paddle.to_tensor(np.ones((2, 8), np.float32),
+                                stop_gradient=False)
+        with pytest.raises(TypeError, match="positionally"):
+            recompute(lambda t, mask=None: t + mask, x, mask=bias)
+
+    def test_pytree_return(self):
+        """Layer forwards returning (hidden, cache)-style nested pytrees
+        must come back as Tensors with grads flowing."""
+
+        class Pair(nn.Layer):
+            def __init__(self, h):
+                super().__init__()
+                self.fc = nn.Linear(h, h)
+
+            def forward(self, x):
+                h = self.fc(x)
+                return {"hidden": h, "aux": (h * 2, h.sum())}
+
+        paddle.seed(5)
+        net = Pair(8)
+        x = paddle.to_tensor(
+            np.random.default_rng(4).standard_normal((4, 8)).astype(
+                np.float32), stop_gradient=False)
+        out = recompute(net, x)
+        assert set(out) == {"hidden", "aux"}
+        ref = net(x)
+        np.testing.assert_allclose(out["hidden"].numpy(),
+                                   ref["hidden"].numpy(), rtol=1e-6)
+        np.testing.assert_allclose(out["aux"][0].numpy(),
+                                   ref["aux"][0].numpy(), rtol=1e-6)
+        (out["hidden"].mean() + out["aux"][1]).backward()
+        for _, p in net.named_parameters():
+            assert p.grad is not None
+        assert x.grad is not None
+
     def test_plain_function(self):
         x = paddle.to_tensor(np.ones((3, 3), np.float32), stop_gradient=False)
         out = recompute(lambda t: (t * 3).sum(), x)
