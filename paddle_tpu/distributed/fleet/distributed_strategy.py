@@ -31,6 +31,7 @@ _DEFAULTS: Dict[str, Dict[str, Any]] = {
 
         "schedule_mode": "1F1B",     # FThenB | 1F1B (remat off/on — see
                                      # pipeline_parallel.py module docstring)
+        "virtual_pp_degree": 1,      # interleaved chunks per device (VPP)
         "p2p_cache_shape": True,
     },
     "amp_configs": {

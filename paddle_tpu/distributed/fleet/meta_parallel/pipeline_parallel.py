@@ -105,7 +105,8 @@ class PipelineTrainStep:
                  loss_fn: Optional[Callable] = None,
                  remat: bool = True, donate: bool = True,
                  sharding_level: Optional[int] = None,
-                 sharding_axis: Optional[str] = None):
+                 sharding_axis: Optional[str] = None,
+                 virtual_pp_degree: int = 1):
         if "pp" not in mesh.shape:
             raise ValueError("mesh has no 'pp' axis")
         self.pipe_layer = pipe_layer
@@ -113,24 +114,34 @@ class PipelineTrainStep:
         self.mesh = mesh
         self.S = mesh.shape["pp"]
         self.M = int(num_microbatches)
+        self.V = int(virtual_pp_degree)
         if self.M < self.S:
             raise ValueError(
                 f"accumulate_steps ({self.M}) must be >= pp degree ({self.S}) "
                 "or the pipeline is mostly bubble")
+        if self.V < 1:
+            raise ValueError(f"virtual_pp_degree must be >= 1, got {self.V}")
+        if self.V > 1 and self.M % self.S != 0:
+            # interleaved schedule circulates microbatch groups of S around
+            # the ring V times; ragged groups would leave permanent holes
+            raise ValueError(
+                f"interleaved schedule needs accumulate_steps ({self.M}) "
+                f"divisible by pp degree ({self.S})")
         self.loss_fn = loss_fn or pipe_layer._loss_fn
         if self.loss_fn is None:
             raise ValueError("PipelineLayer needs a loss_fn for train_batch")
 
         start, end = pipe_layer.stack_region()
         n_blocks = end - start
-        if n_blocks < self.S:
+        if n_blocks < self.S * self.V:
             raise ValueError(
-                f"stackable block region has {n_blocks} layers < {self.S} stages")
+                f"stackable block region has {n_blocks} layers < "
+                f"{self.S} stages x {self.V} virtual chunks")
         # blocks must split evenly over stages; leftovers join the suffix
         # (they run replicated — correct, slightly wasteful, and only happens
         # for unusual layer counts)
-        self.L = n_blocks // self.S
-        end = start + self.L * self.S
+        self.L = n_blocks // (self.S * self.V)
+        end = start + self.L * self.S * self.V
         self._start, self._end = start, end
         self.template: Layer = pipe_layer.run_function[start]
         rf = pipe_layer.run_function
@@ -171,13 +182,22 @@ class PipelineTrainStep:
             leaves = []
             for j in range(start, end):
                 leaves.append(dict(rf[j].named_parameters())[rel]._value)
-            stacked = jnp.stack(leaves).reshape(
-                (self.S, self.L) + leaves[0].shape)
-            params[_STACK_PREFIX + rel] = stacked
             base = _mesh_filter_spec(
                 getattr(dict(self.template.named_parameters())[rel],
                         "dist_attr", None), mesh)
-            specs[_STACK_PREFIX + rel] = P("pp", None, *base)
+            if self.V == 1:
+                stacked = jnp.stack(leaves).reshape(
+                    (self.S, self.L) + leaves[0].shape)
+                specs[_STACK_PREFIX + rel] = P("pp", None, *base)
+            else:
+                # interleaved: depth chunk c = v*S + s lives on device s as
+                # virtual chunk v (Megatron VPP assignment: device s holds
+                # chunks {s, s+S, ...}) -> layout (S, V, L, *shape)
+                stacked = jnp.stack(leaves).reshape(
+                    (self.V, self.S, self.L) + leaves[0].shape)
+                stacked = jnp.swapaxes(stacked, 0, 1)
+                specs[_STACK_PREFIX + rel] = P("pp", None, None, *base)
+            params[_STACK_PREFIX + rel] = stacked
 
         # ---- ZeRO composition (same resolution as hapi.TrainStep) --------
         level = sharding_level
@@ -230,7 +250,7 @@ class PipelineTrainStep:
 
         # ---- the jitted step ---------------------------------------------
         template = self.template
-        S, L, M = self.S, self.L, self.M
+        S, L, M, V = self.S, self.L, self.M, self.V
         loss_fn = self.loss_fn
         act_spec = self._act_sharding
         run_entries = self._run_entries
@@ -252,7 +272,7 @@ class PipelineTrainStep:
             y, _ = jax.lax.scan(body, x, stage_params)
             return y
 
-        def pipeline(stacked, h):
+        def pipeline_plain(stacked, h):
             # h: (M, mb, ...) microbatch activations entering stage 0
             stage_params = tuple(stacked[_STACK_PREFIX + rel]
                                  for rel in self._block_rels)
@@ -274,6 +294,73 @@ class PipelineTrainStep:
 
             _, ys = jax.lax.scan(tick, buf, feed)
             return ys[S - 1:]          # (M, mb, ...) in microbatch order
+
+        def stage_fn_v(stage_chunks, v, x):
+            # stage_chunks: tuple of (V, L, ...) leaves for this stage;
+            # select the active virtual chunk by (traced) phase index v
+            chunk = tuple(
+                jax.lax.dynamic_index_in_dim(a, v, 0, keepdims=False)
+                for a in stage_chunks)
+            return stage_fn(chunk, x)
+
+        def pipeline_interleaved(stacked, h):
+            """Interleaved (VPP) schedule, reference 'virtual pipeline' /
+            interleaved 1F1B (Megatron fig. 4; reference pass:
+            pipeline_scheduler_pass VPP mode). Microbatch groups of S
+            circulate the S-device ring V times; each tick every device
+            applies ONE chunk of L blocks (1/V of its layers), so the
+            fill/drain bubble is (S-1) ticks of L blocks instead of (S-1)
+            ticks of V*L blocks: bubble fraction (S-1)/(M*V + S - 1)."""
+            stage_params = tuple(stacked[_STACK_PREFIX + rel]
+                                 for rel in self._block_rels)
+            T = M * V + S - 1
+            feed_idx = np.zeros((T,), np.int32)
+            feed_mask = np.zeros((T,), bool)
+            phases = np.zeros((T, S), np.int32)
+            coll_idx = np.zeros((T,), np.int32)
+            coll_mask = np.zeros((T,), bool)
+            for t in range(T):
+                g, r = divmod(t, V * S)
+                if r < S and g * S + r < M:
+                    feed_mask[t] = True
+                    feed_idx[t] = g * S + r
+                for s in range(S):
+                    phases[t, s] = ((t - s) // S) % V if t >= s else 0
+            for g in range(M // S):
+                for i in range(S):
+                    t = g * V * S + (V - 1) * S + i + (S - 1)
+                    coll_mask[t] = True
+                    coll_idx[t] = g * S + i
+            buf = jnp.zeros((S,) + h.shape[1:], h.dtype)
+            buf = jax.lax.with_sharding_constraint(buf, act_spec)
+            acc = jnp.zeros((M,) + h.shape[1:], h.dtype)
+
+            def tick(carry, xs):
+                buf, acc = carry
+                fi, fm, vs, ci, cm = xs
+                x_t = jax.lax.dynamic_index_in_dim(h, fi, 0, keepdims=False)
+                slot0 = jnp.where(fm, x_t, buf[0])
+                buf = jax.lax.dynamic_update_index_in_dim(buf, slot0, 0, 0)
+                out = jax.vmap(stage_fn_v)(stage_params, vs, buf)
+                out = jax.lax.with_sharding_constraint(out, act_spec)
+                y_t = out[-1]
+                prev = jax.lax.dynamic_index_in_dim(acc, ci, 0, keepdims=False)
+                acc = jax.lax.dynamic_update_index_in_dim(
+                    acc, jnp.where(cm, y_t, prev), ci, 0)
+                # ring shift incl. wrap S-1 -> 0 (chunk v done on the last
+                # device continues as chunk v+1 on device 0)
+                nxt = jnp.roll(out, 1, axis=0)
+                nxt = jax.lax.with_sharding_constraint(nxt, act_spec)
+                return (nxt, acc), None
+
+            (_, acc), _ = jax.lax.scan(
+                tick, (buf, acc),
+                (jnp.asarray(feed_idx), jnp.asarray(feed_mask),
+                 jnp.asarray(phases), jnp.asarray(coll_idx),
+                 jnp.asarray(coll_mask)))
+            return acc                 # (M, mb, ...) in microbatch order
+
+        pipeline = pipeline_plain if V == 1 else pipeline_interleaved
 
         def loss_of(params, inputs, labels):
             # prefix on the full flattened batch (standard 3D shapes), then
@@ -366,7 +453,11 @@ class PipelineTrainStep:
         for k, v in self.params.items():
             if k.startswith(_STACK_PREFIX):
                 rel = k[len(_STACK_PREFIX):]
-                flat = v.reshape((self.S * self.L,) + v.shape[2:])
+                if self.V > 1:   # (S, V, L, ...) -> depth order (V*S*L, ...)
+                    v = jnp.swapaxes(v, 0, 1)
+                    flat = v.reshape((self.V * self.S * self.L,) + v.shape[3:])
+                else:
+                    flat = v.reshape((self.S * self.L,) + v.shape[2:])
                 for j in range(self._start, self._end):
                     p = dict(rf[j].named_parameters())[rel]
                     p._value = flat[j - self._start]
@@ -396,6 +487,7 @@ class PipelineParallel(Layer):
         pc = (strategy.pipeline_configs if strategy is not None else {})
         self.accumulate_steps = int(pc.get("accumulate_steps", 1))
         self.micro_batch_size = pc.get("micro_batch_size", None)
+        self.virtual_pp_degree = int(pc.get("virtual_pp_degree", 1))
         self._step: Optional[PipelineTrainStep] = None
 
     def forward(self, *args):
@@ -405,9 +497,17 @@ class PipelineParallel(Layer):
         if self._step is None:
             inner = getattr(optimizer, "_inner_opt", optimizer)
             # accumulate_steps < pp degree raises in PipelineTrainStep.__init__
+            layer_v = getattr(self._layers, "num_virtual_pipeline_stages", 1)
+            strat_v = self.virtual_pp_degree
+            if layer_v > 1 and strat_v > 1 and layer_v != strat_v:
+                raise ValueError(
+                    f"conflicting virtual pipeline settings: PipelineLayer("
+                    f"num_virtual_pipeline_stages={layer_v}) vs strategy "
+                    f"pipeline_configs virtual_pp_degree={strat_v}")
+            v = max(layer_v, strat_v)
             self._step = PipelineTrainStep(
                 self._layers, inner, self._hcg.get_mesh(),
-                self.accumulate_steps, remat=True)
+                self.accumulate_steps, remat=True, virtual_pp_degree=v)
         return self._step
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):

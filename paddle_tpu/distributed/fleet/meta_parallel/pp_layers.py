@@ -153,6 +153,11 @@ class PipelineLayer(Layer):
         self._loss_fn = loss_fn
         self._topo = topology
         self.recompute_interval = recompute_interval
+        # reference arg of the same name: chunks per device for the
+        # interleaved schedule (consumed by PipelineTrainStep as
+        # virtual_pp_degree)
+        self.num_virtual_pipeline_stages = int(num_virtual_pipeline_stages
+                                               or 1)
         if num_stages is None and topology is None:
             raise ValueError("need num_stages or topology")
         if num_stages is None:
@@ -210,7 +215,7 @@ class PipelineLayer(Layer):
             self._layers_desc, self._num_stages, seg_method).do_segment()
         start, end = self.stack_region()
         L = (end - start) // self._num_stages if self._num_stages else 0
-        if L:
+        if L and self.num_virtual_pipeline_stages == 1:
             exec_parts = [0] + [start + L * (s + 1)
                                 for s in range(self._num_stages)]
             exec_parts[-1] = len(self.run_function)
@@ -222,6 +227,10 @@ class PipelineLayer(Layer):
                     f"executes the even stacked split {exec_parts}; "
                     "seg_method is descriptive-only in this build",
                     stacklevel=2)
+        elif self.num_virtual_pipeline_stages > 1:
+            # interleaved placement: contiguous segment_parts don't apply —
+            # get_stage_layer_indices() is the placement source of truth
+            pass
 
     # ---------------------------------------------------------------- eager
     def forward(self, *args):
@@ -237,11 +246,36 @@ class PipelineLayer(Layer):
         return self._num_stages
 
     def get_stage_range(self, stage: int):
+        if self.num_virtual_pipeline_stages > 1:
+            raise ValueError(
+                "get_stage_range() assumes one contiguous range per stage; "
+                "with num_virtual_pipeline_stages > 1 device placement is "
+                "interleaved — use get_stage_layer_indices(stage) instead")
         return self.segment_parts[stage], self.segment_parts[stage + 1]
 
+    def get_stage_layer_indices(self, stage: int):
+        """run_function indices held by ``stage``. Under the interleaved
+        schedule (num_virtual_pipeline_stages = V > 1) device s holds depth
+        chunks {s, s+S, ...} of the stacked block region, plus the
+        replicated prefix/suffix entries."""
+        V, S = self.num_virtual_pipeline_stages, self._num_stages
+        if V == 1:
+            a, b = self.get_stage_range(stage)
+            return list(range(a, b))
+        start, end = self.stack_region()
+        n = end - start
+        L = n // (S * V)
+        idxs = list(range(0, start)) if stage == 0 else []
+        for v in range(V):
+            c0 = start + (v * S + stage) * L
+            idxs.extend(range(c0, c0 + L))
+        if stage == S - 1:
+            idxs.extend(range(start + S * V * L, len(self.run_function)))
+        return idxs
+
     def get_stage_layers(self, stage: int):
-        a, b = self.get_stage_range(stage)
-        return self.run_function[a:b]
+        return [self.run_function[i]
+                for i in self.get_stage_layer_indices(stage)]
 
     def _param_signature(self, entry) -> Optional[tuple]:
         """Structure key for stackability: relative param names+shapes+dtypes.

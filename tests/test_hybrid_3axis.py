@@ -1,0 +1,212 @@
+"""3-axis hybrid composition: dp x mp x pp (x ZeRO sharding) on the 8-device
+CPU mesh, plus the Llama-7B-shaped lowering check.
+
+VERDICT round-1 item 8: 2-axis combos each pass, but axis-ordering bugs love
+the 3-axis case and spec bugs only show at scale. Parity model (SURVEY.md
+§4): hybrid parallel == serial numerics, step by step.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.fleet import (
+    ColumnParallelLinear, RowParallelLinear, create_hybrid_communicate_group,
+)
+from paddle_tpu.distributed.fleet.base_topology import _reset_hcg
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc, PipelineLayer, PipelineTrainStep,
+)
+from paddle_tpu.hapi import TrainStep
+from paddle_tpu.optimizer import AdamW
+
+H, VOCAB, SEQ = 32, 64, 16
+
+
+class TPBlock(nn.Layer):
+    """Megatron-style block: column-parallel up, row-parallel down."""
+
+    def __init__(self, h=H):
+        super().__init__()
+        self.up = ColumnParallelLinear(h, 4 * h, gather_output=False)
+        self.down = RowParallelLinear(4 * h, h, input_is_parallel=True)
+        self.ln = nn.LayerNorm(h)
+
+    def forward(self, x):
+        return x + self.down(F.gelu(self.up(self.ln(x))))
+
+
+class Head(nn.Layer):
+    def __init__(self, h=H, vocab=VOCAB):
+        super().__init__()
+        self.ln = nn.LayerNorm(h)
+        self.proj = nn.Linear(h, vocab)
+
+    def forward(self, x):
+        return self.proj(self.ln(x))
+
+
+def _ce(out, y):
+    return F.cross_entropy(
+        Tensor(out).reshape([-1, VOCAB]), Tensor(y).reshape([-1]),
+        reduction="mean")._value
+
+
+def build_pipe(n_blocks=4, seed=21):
+    paddle.seed(seed)
+    descs = [LayerDesc(nn.Embedding, VOCAB, H)]
+    descs += [LayerDesc(TPBlock) for _ in range(n_blocks)]
+    descs.append(LayerDesc(Head))
+    return PipelineLayer(descs, num_stages=2, loss_fn=None)
+
+
+def batch(b=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, VOCAB, (b, SEQ)).astype(np.int32)
+    y = rng.integers(0, VOCAB, (b, SEQ)).astype(np.int32)
+    return paddle.to_tensor(x), paddle.to_tensor(y)
+
+
+@pytest.fixture
+def hcg_3axis():
+    _reset_hcg()
+    hcg = create_hybrid_communicate_group(dp_degree=2, mp_degree=2,
+                                          pp_degree=2)
+    yield hcg
+    _reset_hcg()
+
+
+class TestThreeAxisParity:
+    def _parity(self, hcg, steps=3, **step_kw):
+        serial_pipe = build_pipe()
+        hybrid_pipe = build_pipe()
+        serial = TrainStep(serial_pipe, AdamW(learning_rate=1e-3),
+                           loss_fn=lambda o, y: _ce(o, y))
+        hybrid = PipelineTrainStep(
+            hybrid_pipe, AdamW(learning_rate=1e-3), hcg.get_mesh(),
+            num_microbatches=2, loss_fn=lambda o, y: _ce(o, y), **step_kw)
+        x, y = batch()
+        for i in range(steps):
+            ls, lh = serial(x, y), hybrid(x, y)
+            np.testing.assert_allclose(
+                float(ls), float(lh), rtol=3e-4,
+                err_msg=f"step {i} ({step_kw or 'plain'})")
+
+    def test_dp_mp_pp_matches_serial(self, hcg_3axis):
+        """The v5e-8-shaped config (dp=2 x mp=2 x pp=2) trains identically
+        to serial — the composition VERDICT flagged as never exercised."""
+        self._parity(hcg_3axis)
+
+    def test_dp_mp_pp_zero1_matches_serial(self, hcg_3axis):
+        """4th axis: ZeRO-1 optimizer-state sharding over dp on top of the
+        3-axis mesh."""
+        self._parity(hcg_3axis, sharding_level=1, sharding_axis="dp")
+
+    def test_dp_mp_pp_vpp_matches_serial(self, hcg_3axis):
+        """3 axes + interleaved virtual pipeline chunks."""
+        self._parity(hcg_3axis, virtual_pp_degree=2)
+
+    def test_stacked_specs_carry_mp_axis(self, hcg_3axis):
+        """The stacked block params must keep their TP dist_attr: the
+        column weight stacks to (S, L, in, out) sharded P('pp',None,None,'mp')."""
+        pipe = build_pipe()
+        step = PipelineTrainStep(pipe, AdamW(learning_rate=1e-3),
+                                 hcg_3axis.get_mesh(), num_microbatches=2,
+                                 loss_fn=lambda o, y: _ce(o, y))
+        spec = step.param_shardings["@stacked.up.weight"].spec
+        assert spec == P("pp", None, None, "mp"), spec
+        spec = step.param_shardings["@stacked.down.weight"].spec
+        assert spec == P("pp", None, "mp", None), spec
+
+
+class TestLlama7BShapedLowering:
+    """Spec check at scale without hardware or memory: instantiate the
+    Llama-2-7B config with zero-cost virtual parameters, lower the full
+    hybrid train step (dp=2 x mp=4, Megatron layout + ZeRO-1), and assert
+    the lowering carries the expected shardings. Reference: VERDICT item 8
+    ('catch spec bugs at scale')."""
+
+    def test_7b_train_step_lowers_with_shardings(self, monkeypatch):
+        import paddle_tpu.nn.initializer as I
+        from paddle_tpu.jit import functional_call
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        # np.zeros is calloc-backed: 7B fp32 params cost virtual pages only
+        def cheap(self, shape, dtype):
+            return np.zeros(tuple(shape), "float32")
+
+        for cls in (I.Constant, I.Normal, I.TruncatedNormal, I.Uniform,
+                    I.XavierNormal, I.XavierUniform, I.KaimingNormal,
+                    I.KaimingUniform):
+            monkeypatch.setattr(cls, "__call__", cheap, raising=True)
+
+        cfg = LlamaConfig.llama2_7b()
+        model = LlamaForCausalLM(cfg)
+        n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+        assert n_params > 6.5e9, n_params
+
+        _reset_hcg()
+        hcg = create_hybrid_communicate_group(dp_degree=2, mp_degree=4)
+        mesh = hcg.get_mesh()
+
+        def spec_of(name):
+            if any(s in name for s in ("q_proj.weight", "k_proj.weight",
+                                       "v_proj.weight", "gate_proj.weight",
+                                       "up_proj.weight", "lm_head.weight")):
+                return P(None, "mp")
+            if any(s in name for s in ("o_proj.weight", "down_proj.weight")):
+                return P("mp", None)
+            if "embed_tokens.weight" in name:
+                return P("mp", None)
+            return P()
+
+        raw, buffers = model.raw_state()
+        params = {k: jax.ShapeDtypeStruct(v.shape, jnp.float32)
+                  for k, v in raw.items()}
+        param_sh = {k: NamedSharding(mesh, spec_of(k)) for k in params}
+        # ZeRO-1: optimizer slots sharded over dp on top of the TP axis
+        from paddle_tpu.distributed.fleet.meta_parallel.sharding import (
+            extend_spec_with_sharding)
+        opt_sh = {k: NamedSharding(mesh, extend_spec_with_sharding(
+            spec_of(k), params[k].shape, mesh, "dp")) for k in params}
+
+        opt = AdamW(learning_rate=1e-4, parameters=model.parameters())
+        opt_state = jax.eval_shape(opt.init_state_tree, params)
+        opt_state_sh = jax.tree.map(
+            lambda _: None, opt_state)
+        opt_state_sh["slots"] = {
+            k: jax.tree.map(lambda _, s=opt_sh[k]: s, slot)
+            for k, slot in opt_state["slots"].items()}
+
+        def loss_of(p, x, y):
+            return functional_call(model, p, Tensor(x), Tensor(y),
+                                   buffers=buffers)
+
+        def step(p, opt_state, lr, x, y):
+            loss, grads = jax.value_and_grad(loss_of)(p, x, y)
+            new_p, new_s = opt.functional_update(p, grads, opt_state, lr)
+            new_p = {k: jax.lax.with_sharding_constraint(v, param_sh[k])
+                     for k, v in new_p.items()}
+            return loss, new_p, new_s
+
+        b, s = 8, 512
+        data_sh = NamedSharding(mesh, P("dp"))
+        lowered = jax.jit(step, in_shardings=(
+            param_sh, opt_state_sh, None, data_sh, data_sh)).lower(
+            params, opt_state,
+            jax.ShapeDtypeStruct((), jnp.float32),
+            jax.ShapeDtypeStruct((b, s), jnp.int32),
+            jax.ShapeDtypeStruct((b, s), jnp.int32))
+        text = lowered.as_text()
+        # sharding annotations present (shardy or GSPMD) and mesh as declared
+        assert "sdy.sharding" in text or "mhlo.sharding" in text
+        assert ('"dp"=2' in text and '"mp"=4' in text) \
+            or "devices=[2,4]" in text, (
+            "expected a dp=2 x mp=4 device assignment in the lowering")

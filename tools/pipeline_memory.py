@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Measure compiled peak/temp memory of the pipeline schedule vs
+num_microbatches, remat on/off, and virtual_pp_degree — the evidence for the
+"remat == 1F1B activation-memory behavior" claim (pipeline_parallel.py
+module docstring): 1F1B's defining property is activation memory bounded by
+the number of stages S, not the number of microbatches M. Under XLA autodiff
+the scan saves per-tick carries unless the block body is rematerialized, so
+remat=True is what bounds the saved-activation footprint.
+
+Writes PIPELINE_MEMORY.md at the repo root. Runs on the CPU-simulated
+8-device mesh by default (set JAX_PLATFORMS=tpu to measure on hardware);
+XLA's memory accounting (CompiledMemoryStats.temp_size_in_bytes) is the
+same machinery either way.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+# drop non-cpu PJRT factories (the ambient TPU-tunnel plugin can hang) —
+# same trick as tests/conftest.py
+try:
+    from jax._src import xla_bridge as _xb
+    for _name in list(_xb._backend_factories):
+        if _name != "cpu":
+            _xb._backend_factories.pop(_name, None)
+    _xb._platform_aliases.setdefault("tpu", "tpu")
+except Exception:
+    pass
+jax.config.update("jax_platforms", "cpu")
+
+
+def measure(M, remat, V=1, n_layers=8, hidden=128, seq=128, vocab=128):
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.base_topology import (
+        create_hybrid_communicate_group)
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineTrainStep
+    from paddle_tpu.models import GPTConfig, GPTForCausalLMPipe
+    from paddle_tpu.optimizer import AdamW
+
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                    num_hidden_layers=n_layers, num_attention_heads=4,
+                    max_position_embeddings=seq)
+    paddle.seed(0)
+    pipe = GPTForCausalLMPipe(cfg, num_stages=4)
+    hcg = create_hybrid_communicate_group(pp_degree=4)
+    step = PipelineTrainStep(pipe, AdamW(learning_rate=1e-3), hcg.get_mesh(),
+                             num_microbatches=M, remat=remat,
+                             virtual_pp_degree=V, donate=False)
+    b = M  # one sample per microbatch keeps compile fast
+    x = jnp.zeros((b, seq), jnp.int32)
+    y = jnp.zeros((b, seq), jnp.int32)
+    lr = jnp.asarray(1e-3, jnp.float32)
+    compiled = step._jit_step.lower(
+        step.params, step.opt_state, lr, x, y).compile()
+    ma = compiled.memory_analysis()
+    return ma.temp_size_in_bytes
+
+
+def main():
+    rows = []
+    for remat in (False, True):
+        for M in (4, 8, 16):
+            t = measure(M, remat)
+            rows.append(("FThenB" if not remat else "remat(1F1B-mem)",
+                         M, 1, t))
+            print(f"remat={remat} M={M} V=1 temp={t/1e6:.2f} MB",
+                  file=sys.stderr)
+    for M in (4, 8):
+        t = measure(M, True, V=2)
+        rows.append(("remat + interleaved", M, 2, t))
+        print(f"remat=True M={M} V=2 temp={t/1e6:.2f} MB", file=sys.stderr)
+
+    base = {(s, m): t for s, m, v, t in rows if v == 1}
+    lines = [
+        "# Pipeline schedule: compiled activation (temp) memory",
+        "",
+        "Evidence for the remat==1F1B memory claim "
+        "(`pipeline_parallel.py` docstring): XLA `CompiledMemoryStats."
+        "temp_size_in_bytes` of the full fwd+bwd+update pipeline program, "
+        "GPT(h=128, L=8, seq=128) on the 8-device CPU mesh, pp=4, "
+        "microbatch size 1 (batch scales with M so per-microbatch work is "
+        "constant).",
+        "",
+        "| schedule | M=4 | M=8 | M=16 | growth M4->M16 |",
+        "|---|---|---|---|---|",
+    ]
+    for sched in ("FThenB", "remat(1F1B-mem)"):
+        t4, t8, t16 = (base[(sched, m)] for m in (4, 8, 16))
+        lines.append(
+            f"| {sched} | {t4/1e6:.2f} MB | {t8/1e6:.2f} MB | "
+            f"{t16/1e6:.2f} MB | {t16/t4:.2f}x |")
+    vpp = {m: t for s, m, v, t in rows if v == 2}
+    lines += [
+        "",
+        "Interleaved (V=2 virtual chunks/device, remat on): "
+        + ", ".join(f"M={m}: {t/1e6:.2f} MB" for m, t in sorted(vpp.items()))
+        + ".",
+        "",
+        "Reading: without remat the saved per-tick scan activations grow "
+        "with M (the FThenB failure mode the reference's 1F1B schedule "
+        "exists to fix); with remat the growth is the microbatch data "
+        "itself, activation residency stays bounded by the S in-flight "
+        "stage inputs — the 1F1B memory behavior. Regenerate with "
+        "`python tools/pipeline_memory.py`.",
+        "",
+    ]
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "PIPELINE_MEMORY.md")
+    with open(out, "w") as f:
+        f.write("\n".join(lines))
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
