@@ -172,7 +172,68 @@ def _run_bench() -> dict:
         result.update(_decode_bench(model, cfg, paddle, jax))
     except Exception as e:  # decode bench is best-effort extra signal
         result["decode_error"] = repr(e)[:200]
+    if os.environ.get("BENCH_SD", "1" if on_tpu else "0") == "1":
+        # free the GPT training state first: SD15 + AdamW master weights
+        # plus the 345M train state would overrun one chip's HBM
+        del step, opt, model
+        try:
+            result.update(_sd_unet_bench(paddle, jax, on_tpu))
+        except Exception as e:  # best-effort extra signal
+            result["sd_error"] = repr(e)[:200]
     return result
+
+
+def _sd_unet_bench(paddle, jax, on_tpu) -> dict:
+    """SD-1.x UNet denoising train step: imgs/sec/chip (BASELINE configs[4],
+    'to measure' — this sets the number)."""
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.hapi import TrainStep
+    from paddle_tpu.models import (UNet2DConditionModel, UNetConfig,
+                                   UNetDenoiseLoss)
+
+    paddle.seed(0)
+    cfg = (UNetConfig.sd15() if on_tpu else UNetConfig.tiny())
+    batch = int(os.environ.get("BENCH_SD_BATCH", "4" if on_tpu else "2"))
+    steps = int(os.environ.get("BENCH_SD_STEPS", "8"))
+    model = UNet2DConditionModel(cfg)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(),
+                                 multi_precision=on_tpu)
+    # remat: SD15 + AdamW master weights is HBM-tight on one v5e chip
+    step = TrainStep(UNetDenoiseLoss(model), opt, remat=on_tpu)
+    rng = np.random.default_rng(0)
+    dt = "bfloat16" if on_tpu else "float32"
+    lat = paddle.to_tensor(rng.standard_normal(
+        (batch, cfg.in_channels, cfg.sample_size, cfg.sample_size)
+    ).astype(np.float32)).astype(dt)
+    t = paddle.to_tensor(rng.integers(0, 1000, (batch,)).astype(np.int32))
+    ctx = paddle.to_tensor(rng.standard_normal(
+        (batch, 77, cfg.cross_attention_dim)).astype(np.float32)).astype(dt)
+    noise = paddle.to_tensor(rng.standard_normal(
+        lat.shape).astype(np.float32)).astype(dt)
+
+    loss = step(lat, t, ctx, noise)  # compile
+    jax.block_until_ready(loss.value)
+    times = []
+    last = None
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        last = step(lat, t, ctx, noise)
+        jax.block_until_ready(last.value)
+        times.append(time.perf_counter() - t0)
+    med = sorted(times)[len(times) // 2]
+    # unsharded step: runs on ONE device regardless of slice size
+    return {
+        "sd_unet_imgs_per_sec_per_chip": round(batch / med, 2),
+        "sd_unet_step_time_s": round(med, 4),
+        "sd_unet_n_params": n_params,
+        "sd_unet_loss": round(float(last), 4),
+    }
 
 
 def _decode_bench(model, cfg, paddle, jax) -> dict:

@@ -11,3 +11,6 @@ from .gpt import (  # noqa: F401
 )
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401
 from .moe_gpt import MoEGPTConfig, MoEGPTForCausalLM  # noqa: F401
+from .unet import (  # noqa: F401
+    UNet2DConditionModel, UNetConfig, UNetDenoiseLoss,
+)

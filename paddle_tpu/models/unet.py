@@ -1,0 +1,338 @@
+"""Stable-Diffusion-style conditional UNet exemplar (BASELINE configs[4]).
+
+Reference parity target: PaddleMIX's ppdiffusers ``UNet2DConditionModel``
+(itself mirroring diffusers), which the reference framework trains through
+its PHI conv/groupnorm kernels (SURVEY.md §1 note). Here the model is built
+entirely from paddle_tpu.nn layers: Conv2D lowers to
+``lax.conv_general_dilated`` (XLA tiles it onto the MXU), GroupNorm/SiLU
+fuse into the surrounding convs under jit, and attention uses the shared
+``scaled_dot_product_attention`` (Pallas flash kernel at long sequence).
+
+Architecture (SD 1.x shape): conv_in -> down blocks (ResNet x N
+[+ cross/self attention] + stride-2 downsample) -> mid (ResNet, attention,
+ResNet) -> up blocks mirroring down with skip concats + nearest-neighbor
+upsample -> GroupNorm/SiLU/conv_out. Timesteps enter via sinusoidal
+embedding + MLP, added inside every ResNet block.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import ops
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer import Layer, LayerList
+from ..nn.layers.common import Conv2D, GroupNorm, LayerNorm, Linear
+
+__all__ = ["UNetConfig", "UNet2DConditionModel", "UNetDenoiseLoss"]
+
+
+@dataclasses.dataclass
+class UNetConfig:
+    sample_size: int = 64              # latent H=W
+    in_channels: int = 4
+    out_channels: int = 4
+    block_out_channels: Tuple[int, ...] = (320, 640, 1280, 1280)
+    layers_per_block: int = 2
+    # which down blocks carry cross-attention (mirrored for up blocks);
+    # SD 1.x: all but the last (lowest-resolution) down block
+    cross_attention_blocks: Optional[Sequence[bool]] = None
+    cross_attention_dim: int = 768
+    num_attention_heads: int = 8       # SD 1.x: 8 heads, head_dim = C // 8
+    norm_num_groups: int = 32
+    freq_shift: float = 0.0
+
+    def __post_init__(self):
+        if self.cross_attention_blocks is None:
+            n = len(self.block_out_channels)
+            self.cross_attention_blocks = tuple(
+                [True] * (n - 1) + [False])
+
+    @staticmethod
+    def sd15() -> "UNetConfig":
+        return UNetConfig()
+
+    @staticmethod
+    def tiny() -> "UNetConfig":
+        return UNetConfig(sample_size=16, block_out_channels=(32, 64),
+                          layers_per_block=1, cross_attention_dim=32,
+                          num_attention_heads=4, norm_num_groups=8)
+
+
+def _timestep_embedding(t, dim: int, freq_shift: float = 0.0,
+                        max_period: float = 10000.0):
+    """Sinusoidal embedding (reference: ppdiffusers get_timestep_embedding)."""
+    half = dim // 2
+    freqs = ops.exp(
+        ops.arange(half, dtype="float32") *
+        (-math.log(max_period) / (half - freq_shift)))
+    args = t.astype("float32").unsqueeze(-1) * freqs.unsqueeze(0)
+    return ops.concat([ops.cos(args), ops.sin(args)], axis=-1)
+
+
+class ResnetBlock2D(Layer):
+    def __init__(self, in_c: int, out_c: int, temb_c: int, groups: int):
+        super().__init__()
+        self.norm1 = GroupNorm(min(groups, in_c), in_c)
+        self.conv1 = Conv2D(in_c, out_c, 3, padding=1)
+        self.time_emb_proj = Linear(temb_c, out_c)
+        self.norm2 = GroupNorm(min(groups, out_c), out_c)
+        self.conv2 = Conv2D(out_c, out_c, 3, padding=1)
+        self.shortcut = (Conv2D(in_c, out_c, 1) if in_c != out_c else None)
+
+    def forward(self, x, temb):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = h + self.time_emb_proj(F.silu(temb)).unsqueeze(-1).unsqueeze(-1)
+        h = self.conv2(F.silu(self.norm2(h)))
+        skip = x if self.shortcut is None else self.shortcut(x)
+        return skip + h
+
+
+class Attention(Layer):
+    """Multi-head attention over flattened spatial tokens; optional
+    cross-attention context."""
+
+    def __init__(self, query_dim: int, context_dim: Optional[int],
+                 num_heads: int):
+        super().__init__()
+        self.heads = num_heads
+        self.head_dim = query_dim // self.heads
+        kv_dim = context_dim if context_dim is not None else query_dim
+        self.to_q = Linear(query_dim, query_dim, bias_attr=False)
+        self.to_k = Linear(kv_dim, query_dim, bias_attr=False)
+        self.to_v = Linear(kv_dim, query_dim, bias_attr=False)
+        self.to_out = Linear(query_dim, query_dim)
+
+    def forward(self, x, context=None):
+        ctx = x if context is None else context
+        b, s, _ = x.shape
+        t = ctx.shape[1]
+        q = self.to_q(x).reshape([b, s, self.heads, self.head_dim])
+        k = self.to_k(ctx).reshape([b, t, self.heads, self.head_dim])
+        v = self.to_v(ctx).reshape([b, t, self.heads, self.head_dim])
+        out = F.scaled_dot_product_attention(q, k, v)
+        return self.to_out(out.reshape([b, s, self.heads * self.head_dim]))
+
+
+class FeedForward(Layer):
+    """GEGLU feed-forward (reference: ppdiffusers FeedForward/GEGLU)."""
+
+    def __init__(self, dim: int, mult: int = 4):
+        super().__init__()
+        self.proj_in = Linear(dim, dim * mult * 2)
+        self.proj_out = Linear(dim * mult, dim)
+
+    def forward(self, x):
+        h, gate = ops.chunk(self.proj_in(x), 2, axis=-1)
+        return self.proj_out(h * F.gelu(gate))
+
+
+class BasicTransformerBlock(Layer):
+    def __init__(self, dim: int, context_dim: int, num_heads: int):
+        super().__init__()
+        self.norm1 = LayerNorm(dim)
+        self.attn1 = Attention(dim, None, num_heads)
+        self.norm2 = LayerNorm(dim)
+        self.attn2 = Attention(dim, context_dim, num_heads)
+        self.norm3 = LayerNorm(dim)
+        self.ff = FeedForward(dim)
+
+    def forward(self, x, context):
+        x = x + self.attn1(self.norm1(x))
+        x = x + self.attn2(self.norm2(x), context)
+        x = x + self.ff(self.norm3(x))
+        return x
+
+
+class Transformer2D(Layer):
+    """GroupNorm + 1x1 proj in, one BasicTransformerBlock over flattened
+    spatial tokens, 1x1 proj out with residual."""
+
+    def __init__(self, channels: int, context_dim: int, num_heads: int,
+                 groups: int):
+        super().__init__()
+        self.norm = GroupNorm(min(groups, channels), channels)
+        self.proj_in = Conv2D(channels, channels, 1)
+        self.block = BasicTransformerBlock(channels, context_dim, num_heads)
+        self.proj_out = Conv2D(channels, channels, 1)
+
+    def forward(self, x, context):
+        b, c, h, w = x.shape
+        res = x
+        y = self.proj_in(self.norm(x))
+        y = y.reshape([b, c, h * w]).transpose([0, 2, 1])
+        y = self.block(y, context)
+        y = y.transpose([0, 2, 1]).reshape([b, c, h, w])
+        return res + self.proj_out(y)
+
+
+class Downsample2D(Layer):
+    def __init__(self, channels: int):
+        super().__init__()
+        self.conv = Conv2D(channels, channels, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class Upsample2D(Layer):
+    def __init__(self, channels: int):
+        super().__init__()
+        self.conv = Conv2D(channels, channels, 3, padding=1)
+
+    def forward(self, x):
+        return self.conv(F.interpolate(x, scale_factor=2, mode="nearest"))
+
+
+class DownBlock(Layer):
+    def __init__(self, in_c, out_c, temb_c, cfg: UNetConfig, attn: bool,
+                 downsample: bool):
+        super().__init__()
+        self.resnets = LayerList([
+            ResnetBlock2D(in_c if i == 0 else out_c, out_c, temb_c,
+                          cfg.norm_num_groups)
+            for i in range(cfg.layers_per_block)])
+        self.attentions = (LayerList([
+            Transformer2D(out_c, cfg.cross_attention_dim,
+                          cfg.num_attention_heads, cfg.norm_num_groups)
+            for _ in range(cfg.layers_per_block)]) if attn else None)
+        self.downsample = Downsample2D(out_c) if downsample else None
+
+    def forward(self, x, temb, context):
+        skips = []
+        for i, res in enumerate(self.resnets):
+            x = res(x, temb)
+            if self.attentions is not None:
+                x = self.attentions[i](x, context)
+            skips.append(x)
+        if self.downsample is not None:
+            x = self.downsample(x)
+            skips.append(x)
+        return x, skips
+
+
+class UpBlock(Layer):
+    def __init__(self, in_c, skip_c_list, out_c, temb_c, cfg: UNetConfig,
+                 attn: bool, upsample: bool):
+        super().__init__()
+        self.resnets = LayerList([
+            ResnetBlock2D((in_c if i == 0 else out_c) + skip_c_list[i],
+                          out_c, temb_c, cfg.norm_num_groups)
+            for i in range(len(skip_c_list))])
+        self.attentions = (LayerList([
+            Transformer2D(out_c, cfg.cross_attention_dim,
+                          cfg.num_attention_heads, cfg.norm_num_groups)
+            for _ in range(len(skip_c_list))]) if attn else None)
+        self.upsample = Upsample2D(out_c) if upsample else None
+
+    def forward(self, x, skips, temb, context):
+        for i, res in enumerate(self.resnets):
+            x = ops.concat([x, skips.pop()], axis=1)
+            x = res(x, temb)
+            if self.attentions is not None:
+                x = self.attentions[i](x, context)
+        if self.upsample is not None:
+            x = self.upsample(x)
+        return x
+
+
+class MidBlock(Layer):
+    def __init__(self, channels, temb_c, cfg: UNetConfig):
+        super().__init__()
+        self.resnet1 = ResnetBlock2D(channels, channels, temb_c,
+                                     cfg.norm_num_groups)
+        self.attention = Transformer2D(channels, cfg.cross_attention_dim,
+                                       cfg.num_attention_heads,
+                                       cfg.norm_num_groups)
+        self.resnet2 = ResnetBlock2D(channels, channels, temb_c,
+                                     cfg.norm_num_groups)
+
+    def forward(self, x, temb, context):
+        x = self.resnet1(x, temb)
+        x = self.attention(x, context)
+        return self.resnet2(x, temb)
+
+
+class UNet2DConditionModel(Layer):
+    """The conditional denoiser: ``forward(sample, timestep,
+    encoder_hidden_states) -> noise prediction`` (NCHW latents)."""
+
+    def __init__(self, config: UNetConfig):
+        super().__init__()
+        self.config = config
+        ch = config.block_out_channels
+        temb_c = ch[0] * 4
+        self.time_proj_dim = ch[0]
+        self.time_embedding = LayerList(
+            [Linear(ch[0], temb_c), Linear(temb_c, temb_c)])
+        self.conv_in = Conv2D(config.in_channels, ch[0], 3, padding=1)
+
+        self.down_blocks = LayerList()
+        in_c = ch[0]
+        for i, out_c in enumerate(ch):
+            last = i == len(ch) - 1
+            self.down_blocks.append(DownBlock(
+                in_c, out_c, temb_c, config,
+                attn=config.cross_attention_blocks[i], downsample=not last))
+            in_c = out_c
+
+        self.mid_block = MidBlock(ch[-1], temb_c, config)
+
+        # mirror the down path: skip channels in reverse order
+        skip_channels = [ch[0]]  # conv_in output
+        for i, out_c in enumerate(ch):
+            skip_channels += [out_c] * config.layers_per_block
+            if i != len(ch) - 1:
+                skip_channels.append(out_c)
+        self.up_blocks = LayerList()
+        in_c = ch[-1]
+        for i in reversed(range(len(ch))):
+            out_c = ch[i]
+            n_res = config.layers_per_block + 1
+            skips = [skip_channels.pop() for _ in range(n_res)]
+            self.up_blocks.append(UpBlock(
+                in_c, skips, out_c, temb_c, config,
+                attn=config.cross_attention_blocks[i], upsample=i != 0))
+            in_c = out_c
+
+        self.conv_norm_out = GroupNorm(min(config.norm_num_groups, ch[0]),
+                                       ch[0])
+        self.conv_out = Conv2D(ch[0], config.out_channels, 3, padding=1)
+
+    def forward(self, sample, timestep, encoder_hidden_states):
+        cfg = self.config
+        temb = _timestep_embedding(timestep, self.time_proj_dim,
+                                   cfg.freq_shift)
+        temb = temb.astype(sample.dtype)
+        temb = self.time_embedding[1](F.silu(self.time_embedding[0](temb)))
+
+        x = self.conv_in(sample)
+        skips = [x]
+        for blk in self.down_blocks:
+            x, s = blk(x, temb, encoder_hidden_states)
+            skips.extend(s)
+        x = self.mid_block(x, temb, encoder_hidden_states)
+        for blk in self.up_blocks:
+            n = len(blk.resnets)
+            take, skips = skips[-n:], skips[:-n]
+            x = blk(x, list(take), temb, encoder_hidden_states)
+        return self.conv_out(F.silu(self.conv_norm_out(x)))
+
+
+class UNetDenoiseLoss(Layer):
+    """Epsilon-prediction MSE training objective (the standard SD denoising
+    loss) — shared by bench.py and the tests so the objective is defined
+    once."""
+
+    def __init__(self, unet: UNet2DConditionModel):
+        super().__init__()
+        self.unet = unet
+
+    def forward(self, latents, timesteps, encoder_hidden_states, noise):
+        pred = self.unet(latents, timesteps, encoder_hidden_states)
+        return F.mse_loss(pred, noise)
