@@ -36,6 +36,7 @@ from .framework.random import get_cuda_rng_state, get_rng_state, seed, set_cuda_
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import jit  # noqa: F401
+from . import static  # noqa: F401
 from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import metric  # noqa: F401

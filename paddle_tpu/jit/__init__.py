@@ -160,3 +160,6 @@ def jit_fn(fn=None, *, static_argnums=(), donate_argnums=()):
     def deco(f):
         return StaticFunction(f, static_argnums=static_argnums)
     return deco(fn) if fn is not None else deco
+
+
+from .save_load import TranslatedLayer, load, save  # noqa: E402,F401

@@ -1,0 +1,179 @@
+"""``paddle.jit.save`` / ``paddle.jit.load`` — the deployment export path.
+
+Reference: python/paddle/jit/api.py ``jit.save`` serializes a traced
+Program (``.pdmodel``) + params (``.pdiparams``) that AnalysisPredictor
+loads for inference. The TPU-native artifact is a *serialized StableHLO
+module* via ``jax.export`` — portable across processes and jaxlib minor
+versions, reloadable without the model's Python class — plus an ``.npz``
+of parameters and a JSON manifest:
+
+  {path}.pdmodel        jax.export blob (StableHLO + calling convention)
+  {path}.pdiparams.npz  npz: trainable params (flat name -> array); buffers
+                        and frozen params are baked into the module as
+                        constants at trace time
+  {path}.json           manifest: input specs, param names, version
+
+``jit.load`` returns a ``TranslatedLayer`` whose ``forward`` invokes the
+deserialized module — no Python source needed, matching the reference's
+TranslatedLayer contract. Dynamic dims in InputSpec become jax.export
+symbolic dimensions, so one artifact serves any batch size.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import export as jax_export
+
+from ..core.dtype import to_jax_dtype
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..static import InputSpec
+
+__all__ = ["save", "load", "TranslatedLayer"]
+
+_FORMAT_VERSION = 1
+
+
+def _spec_to_aval(spec: InputSpec, scope, idx: int):
+    """``scope`` is ONE jax_export.SymbolicScope shared by the whole
+    signature — per-dim scopes would fail export with 'invalid mixing of
+    symbolic scopes'."""
+    dims = []
+    for j, d in enumerate(spec.shape):
+        if d is None or (isinstance(d, int) and d < 0):
+            dims.append(jax_export.symbolic_shape(
+                f"d{idx}_{j}", scope=scope)[0])
+        else:
+            dims.append(int(d))
+    return jax.ShapeDtypeStruct(tuple(dims), to_jax_dtype(spec.dtype))
+
+
+def _infer_specs(layer, input_spec) -> List[InputSpec]:
+    if input_spec is None:
+        raise ValueError(
+            "jit.save needs input_spec=[InputSpec(...), ...] (or Tensors) "
+            "to know the exported signature")
+    specs = []
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            specs.append(s)
+        elif isinstance(s, Tensor):
+            specs.append(InputSpec.from_tensor(s))
+        else:
+            raise TypeError(f"input_spec entries must be InputSpec or "
+                            f"Tensor, got {type(s)}")
+    return specs
+
+
+def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
+    """Export ``layer``'s forward at the given signature for deployment.
+
+    ``layer`` may be a Layer or a ``to_static``-wrapped StaticFunction
+    (its underlying function is exported). Creates ``{path}.pdmodel``,
+    ``{path}.pdiparams.npz`` and ``{path}.json``.
+    """
+    from . import StaticFunction, functional_call
+
+    modes = []
+    if isinstance(layer, StaticFunction):
+        if input_spec is None:
+            input_spec = layer.input_spec
+        fn = layer.function
+        params: Dict[str, Any] = {}
+
+        def pure(params, *inputs):
+            from ..core import autograd
+            from . import tree_to_tensors, tree_to_values
+            with autograd.functional_guard():
+                out = fn(*tree_to_tensors(inputs))
+            return tree_to_values(out)
+    elif isinstance(layer, Layer):
+        # trace in eval mode, then restore each sublayer's training flag
+        modes = [(l, l.training) for l in layer.sublayers(include_self=True)]
+        layer.eval()
+        params, buffers = layer.raw_state()
+
+        def pure(params, *inputs):
+            return functional_call(layer, params, *inputs, buffers=buffers)
+    else:
+        raise TypeError(f"jit.save expects a Layer or to_static function, "
+                        f"got {type(layer)}")
+
+    try:
+        specs = _infer_specs(layer, input_spec)
+        scope = jax_export.SymbolicScope()
+        in_avals = [_spec_to_aval(s, scope, i) for i, s in enumerate(specs)]
+        param_avals = {
+            k: jax.ShapeDtypeStruct(np.shape(v), jnp.asarray(v).dtype)
+            for k, v in params.items()}
+        exported = jax_export.export(jax.jit(pure))(param_avals, *in_avals)
+    finally:
+        for l, was_training in modes:
+            l.training = was_training
+
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    # buffers/frozen params are constants inside the exported module —
+    # storing them again in the npz would double the artifact
+    arrays = {f"param::{k}": np.asarray(v) for k, v in params.items()}
+    np.savez(path + ".pdiparams", **arrays)
+    manifest = {
+        "format_version": _FORMAT_VERSION,
+        "input_specs": [{"shape": [None if d is None else int(d)
+                                   for d in s.shape],
+                         "dtype": str(s.dtype), "name": s.name}
+                        for s in specs],
+        "param_names": sorted(params),
+    }
+    with open(path + ".json", "w") as f:
+        json.dump(manifest, f, indent=1)
+
+
+class TranslatedLayer(Layer):
+    """The loaded artifact: a Layer whose forward calls the deserialized
+    StableHLO module (reference: TranslatedLayer from jit.load)."""
+
+    def __init__(self, exported, params: Dict[str, Any],
+                 manifest: Dict[str, Any]):
+        super().__init__()
+        self._exported = exported
+        self._params = {k: jnp.asarray(v) for k, v in params.items()}
+        self._manifest = manifest
+        self.eval()
+
+    def forward(self, *inputs):
+        vals = tuple(x._value if isinstance(x, Tensor) else jnp.asarray(x)
+                     for x in inputs)
+        out = self._exported.call(self._params, *vals)
+        return jax.tree.map(lambda v: Tensor(v, stop_gradient=True), out)
+
+    @property
+    def input_specs(self):
+        return [InputSpec(tuple(s["shape"]), s["dtype"], s.get("name"))
+                for s in self._manifest["input_specs"]]
+
+
+def load(path: str) -> TranslatedLayer:
+    """Load a ``jit.save`` artifact; returns a callable TranslatedLayer."""
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path + ".json") as f:
+        manifest = json.load(f)
+    if manifest.get("format_version", 0) > _FORMAT_VERSION:
+        raise ValueError(
+            f"artifact {path!r} has format_version "
+            f"{manifest['format_version']} > supported {_FORMAT_VERSION}")
+    npz = np.load(path + ".pdiparams.npz")
+    params = {k[len("param::"):]: npz[k] for k in npz.files
+              if k.startswith("param::")}
+    return TranslatedLayer(exported, params, manifest)

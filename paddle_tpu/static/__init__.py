@@ -1,0 +1,33 @@
+"""Static-graph compatibility surface.
+
+The reference's static Program/Executor stack collapses into jax.jit
+(SURVEY.md §7.1); what survives here is the part user code actually
+touches: ``InputSpec`` — the shape/dtype signature fed to ``jit.save`` /
+``to_static`` (reference: python/paddle/static/input.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+__all__ = ["InputSpec"]
+
+
+class InputSpec:
+    """Shape/dtype spec for one traced input. ``None`` dims are symbolic
+    (dynamic) — the exported artifact accepts any size there."""
+
+    def __init__(self, shape: Sequence[Optional[int]], dtype="float32",
+                 name: Optional[str] = None, stop_gradient: bool = True):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype!r}, "
+                f"name={self.name!r})")
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tuple(tensor.shape), str(tensor.dtype), name)
