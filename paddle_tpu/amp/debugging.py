@@ -1,21 +1,46 @@
 """Numerics debugging (reference: python/paddle/amp/debugging.py).
 
-The practically important sanitizer from the reference's FLAGS_check_nan_inf
-stack: per-op NaN/Inf checking with op-level skip lists, plus jax_debug_nans
-integration for jitted code.
+Three sanitizer layers, mirroring the reference's FLAGS_check_nan_inf stack:
+
+1. **Eager per-op checking** — ``enable_tensor_checker`` flips
+   ``FLAGS_check_nan_inf``; every ``apply_op`` output is checked (abort or
+   warn per ``check_nan_inf_level``). With ``TensorCheckerConfig(
+   output_dir=...)`` each checked op also appends a JSONL line of output
+   stats (nan/inf counts, min/max/mean) — the dump the offline comparator
+   consumes.
+2. **Jit-safe checking** — ``checked_jit`` wraps a function with
+   ``jax.experimental.checkify`` so NaN/Inf/div-by-zero/OOB raise
+   ``FloatingPointError`` host-side even from compiled TPU code, and
+   ``check_numerics`` inserts a functionalized check when called on traced
+   values (reference: CheckNumericsKernel under the static executor).
+3. **Offline comparator** — ``compare_accuracy(dump_a, dump_b, out)``
+   aligns two stats dumps op-by-op (e.g. a bf16 run vs an fp32 run, the
+   reference's excel-report workflow) and writes a JSON report of ops whose
+   outputs diverge.
 """
 
 from __future__ import annotations
 
 import contextlib
+import json
+import os
+import threading
 from typing import List, Optional
 
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from .. import flags
 from ..core.tensor import Tensor
+
+__all__ = [
+    "DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
+    "disable_tensor_checker", "check_numerics", "checked_jit",
+    "compare_accuracy", "enable_operator_stats_collection",
+    "disable_operator_stats_collection", "collect_operator_stats",
+]
 
 
 class DebugMode:
@@ -25,6 +50,7 @@ class DebugMode:
 
 
 _skip_ops: set = set()
+_dump = threading.local()  # .file handle, .seq counter — per-thread dump
 
 
 def enable_operator_stats_collection():
@@ -36,16 +62,59 @@ def disable_operator_stats_collection():
 
 
 def enable_tensor_checker(checker_config=None):
-    """Turn on per-op output checking (eager) and jax debug_nans (jit)."""
-    flags.set_flags({"check_nan_inf": True})
-    if checker_config is not None and getattr(checker_config, "debug_mode", 0) != 0:
-        flags.set_flags({"check_nan_inf_level": 1})
-    jax.config.update("jax_debug_nans", True)
+    """Turn on per-op output checking (eager); in abort mode also flip jax
+    debug_nans so jitted code aborts too. Warn/dump mode must NOT abort —
+    the comparator workflow needs the run to continue past bad ops."""
+    mode = getattr(checker_config, "debug_mode",
+                   DebugMode.CHECK_NAN_INF_AND_ABORT)
+    abort = mode == DebugMode.CHECK_NAN_INF_AND_ABORT
+    flags.set_flags({"check_nan_inf": True,
+                     "check_nan_inf_level": 0 if abort else 1})
+    if checker_config is not None:
+        out_dir = getattr(checker_config, "output_dir", None)
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            _dump.file = open(os.path.join(out_dir, "op_stats.jsonl"), "w")
+            _dump.seq = 0
+    jax.config.update("jax_debug_nans", abort)
 
 
 def disable_tensor_checker():
-    flags.set_flags({"check_nan_inf": False})
+    flags.set_flags({"check_nan_inf": False, "check_nan_inf_level": 0})
     jax.config.update("jax_debug_nans", False)
+    f = getattr(_dump, "file", None)
+    if f is not None:
+        f.close()
+        _dump.file = None
+
+
+def record_op_stats(op_name: str, out) -> None:
+    """Append one JSONL stats line per floating output of ``op_name`` —
+    called from the apply_op check hook when a dump dir is configured."""
+    f = getattr(_dump, "file", None)
+    if f is None:
+        return
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    for i, o in enumerate(outs):
+        if o is None or not hasattr(o, "dtype"):
+            continue
+        if not jnp.issubdtype(jnp.result_type(o), jnp.floating):
+            continue
+        if isinstance(o, jax.core.Tracer):
+            continue
+        arr = np.asarray(o, dtype=np.float32)
+        finite = arr[np.isfinite(arr)]
+        _dump.seq += 1
+        f.write(json.dumps({
+            "seq": _dump.seq, "op": op_name, "out": i,
+            "shape": list(np.shape(arr)), "dtype": str(o.dtype),
+            "num_nan": int(np.isnan(arr).sum()),
+            "num_inf": int(np.isinf(arr).sum()),
+            "min": float(finite.min()) if finite.size else None,
+            "max": float(finite.max()) if finite.size else None,
+            "mean": float(finite.mean()) if finite.size else None,
+            "abs_mean": float(np.abs(finite).mean()) if finite.size else None,
+        }) + "\n")
 
 
 class TensorCheckerConfig:
@@ -61,7 +130,21 @@ class TensorCheckerConfig:
 
 def check_numerics(tensor, op_type: str = "", var_name: str = "",
                    debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
-    arr = np.asarray(tensor._value if isinstance(tensor, Tensor) else tensor)
+    """NaN/Inf check on one tensor. Eager: returns ``(n_nan, n_inf)`` ints
+    and aborts per ``debug_mode``. Under tracing: inserts a functionalized
+    ``checkify.check`` (the enclosing jit must be built with
+    ``checked_jit``) and returns traced counts."""
+    val = tensor._value if isinstance(tensor, Tensor) else tensor
+    if isinstance(val, jax.core.Tracer):
+        from jax.experimental import checkify as ck
+        n_nan = jnp.isnan(val).sum()
+        n_inf = jnp.isinf(val).sum()
+        if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            ck.check(jnp.isfinite(val).all(),
+                     f"check_numerics: {op_type or '?'}:{var_name or '?'} "
+                     "has {nan} NaN, {inf} Inf", nan=n_nan, inf=n_inf)
+        return n_nan, n_inf
+    arr = np.asarray(val)
     n_nan = int(np.isnan(arr).sum())
     n_inf = int(np.isinf(arr).sum())
     if (n_nan or n_inf) and debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
@@ -70,11 +153,88 @@ def check_numerics(tensor, op_type: str = "", var_name: str = "",
     return n_nan, n_inf
 
 
+def checked_jit(fn, errors=None):
+    """jit-compile ``fn`` (a function over Tensors) under
+    ``jax.experimental.checkify``: float errors (NaN/Inf), div-by-zero and
+    OOB indexing raise host-side ``FloatingPointError``/``checkify``
+    errors after the step, and explicit ``check_numerics`` calls inside
+    ``fn`` are honored. The TPU-native equivalent of running the
+    reference's CheckNumerics pass inside the compiled program."""
+    from jax.experimental import checkify as ck
+
+    from ..core import autograd
+    from ..jit import tree_to_tensors, tree_to_values
+
+    if errors is None:
+        errors = (ck.float_checks | ck.user_checks | ck.div_checks
+                  | ck.index_checks)
+
+    def raw(*vals):
+        with autograd.functional_guard():
+            out = fn(*tree_to_tensors(vals))
+        return tree_to_values(out)
+
+    jitted = jax.jit(ck.checkify(raw, errors=errors))
+
+    def call(*args):
+        err, out = jitted(*tree_to_values(args))
+        err.throw()
+        return tree_to_tensors(out)
+
+    return call
+
+
 @contextlib.contextmanager
 def collect_operator_stats():
     yield
 
 
 def compare_accuracy(dump_path, another_dump_path, output_filename,
-                     loss_scale=1, dump_all_tensors=False):
-    raise NotImplementedError("offline accuracy comparison is not implemented yet")
+                     loss_scale=1, dump_all_tensors=False,
+                     atol=1e-3, rtol=1e-3):
+    """Offline comparator (reference: paddle.amp.debugging.compare_accuracy
+    excel workflow): align two ``op_stats.jsonl`` dumps — e.g. a bf16 run
+    vs an fp32 run of the same model — op by op, and write a JSON report
+    listing every op whose output stats diverge beyond tolerance or that
+    produced NaN/Inf in one run but not the other. Returns the list of
+    divergent entries."""
+
+    def load(p):
+        path = p if p.endswith(".jsonl") else os.path.join(
+            p, "op_stats.jsonl")
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    a, b = load(dump_path), load(another_dump_path)
+    report: List[dict] = []
+    n = min(len(a), len(b))
+    for i in range(n):
+        ra, rb = a[i], b[i]
+        if ra["op"] != rb["op"] or ra["out"] != rb["out"]:
+            report.append({"seq": ra["seq"], "issue": "op_mismatch",
+                           "a": ra["op"], "b": rb["op"]})
+            continue
+        entry = {"seq": ra["seq"], "op": ra["op"], "out": ra["out"]}
+        issues = []
+        if (ra["num_nan"] > 0) != (rb["num_nan"] > 0) or \
+           (ra["num_inf"] > 0) != (rb["num_inf"] > 0):
+            issues.append("nan_inf_mismatch")
+        for stat in ("mean", "abs_mean", "min", "max"):
+            va, vb = ra.get(stat), rb.get(stat)
+            if va is None or vb is None:
+                continue
+            if abs(va - vb) > atol + rtol * max(abs(va), abs(vb)):
+                issues.append(f"{stat}_diverged")
+        if issues:
+            entry["issues"] = issues
+            entry["a"] = {k: ra[k] for k in
+                          ("num_nan", "num_inf", "mean", "min", "max")}
+            entry["b"] = {k: rb[k] for k in
+                          ("num_nan", "num_inf", "mean", "min", "max")}
+            report.append(entry)
+    if len(a) != len(b):
+        report.append({"issue": "length_mismatch", "a_ops": len(a),
+                       "b_ops": len(b)})
+    with open(output_filename, "w") as f:
+        json.dump({"compared_ops": n, "divergent": report}, f, indent=1)
+    return report

@@ -400,6 +400,9 @@ def _check_nan_inf(op_name: str, out) -> None:
     """FLAGS_check_nan_inf analogue (reference: nan_inf_utils_detail)."""
     import numpy as _np
 
+    from ..amp.debugging import record_op_stats
+    record_op_stats(op_name, out)  # no-op unless a dump dir is configured
+
     outs = out if isinstance(out, (tuple, list)) else (out,)
     for o in outs:
         if o is None or not hasattr(o, "dtype"):

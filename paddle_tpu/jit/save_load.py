@@ -129,8 +129,8 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
     np.savez(path + ".pdiparams", **arrays)
     manifest = {
         "format_version": _FORMAT_VERSION,
-        "input_specs": [{"shape": [None if d is None else int(d)
-                                   for d in s.shape],
+        "input_specs": [{"shape": [None if d is None or int(d) < 0
+                                   else int(d) for d in s.shape],
                          "dtype": str(s.dtype), "name": s.name}
                         for s in specs],
         "param_names": sorted(params),

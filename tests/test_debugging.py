@@ -1,0 +1,129 @@
+"""Numerics sanitizer tests: eager nan/inf checking, jit-safe checkify
+path, stats dumping + offline comparator."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.amp import debugging as dbg
+
+
+class TestEagerChecker:
+    def test_check_numerics_eager(self):
+        t = paddle.to_tensor(np.array([1.0, np.nan, np.inf], np.float32))
+        with pytest.raises(FloatingPointError, match="1 NaN, 1 Inf"):
+            dbg.check_numerics(t, "myop", "x")
+        n_nan, n_inf = dbg.check_numerics(
+            t, "myop", "x", debug_mode=dbg.DebugMode.CHECK_NAN_INF)
+        assert (n_nan, n_inf) == (1, 1)
+
+    def test_flag_aborts_on_bad_op_output(self):
+        dbg.enable_tensor_checker()
+        try:
+            x = paddle.to_tensor(np.zeros((2,), np.float32))
+            with pytest.raises(FloatingPointError):
+                x / paddle.to_tensor(np.zeros((2,), np.float32))
+        finally:
+            dbg.disable_tensor_checker()
+
+
+class TestModeHygiene:
+    def test_warn_mode_keeps_running_and_dumping(self, tmp_path):
+        """Warn/dump mode must survive NaN-producing ops (the comparator
+        workflow) — no abort, and the bad op is recorded."""
+        out_dir = str(tmp_path / "d")
+        dbg.enable_tensor_checker(dbg.TensorCheckerConfig(
+            output_dir=out_dir, debug_mode=dbg.DebugMode.CHECK_NAN_INF))
+        try:
+            x = paddle.to_tensor(np.zeros((2,), np.float32))
+            bad = x / x  # NaN — must warn, not raise
+            _ = bad + 1.0
+        finally:
+            dbg.disable_tensor_checker()
+        lines = [json.loads(l) for l in
+                 open(os.path.join(out_dir, "op_stats.jsonl"))]
+        assert any(r["num_nan"] > 0 for r in lines)
+
+    def test_abort_mode_restored_after_warn_session(self):
+        """A warn session must not leave a stale level that downgrades a
+        later default (abort) session."""
+        dbg.enable_tensor_checker(dbg.TensorCheckerConfig(
+            debug_mode=dbg.DebugMode.CHECK_NAN_INF))
+        dbg.disable_tensor_checker()
+        dbg.enable_tensor_checker()
+        try:
+            x = paddle.to_tensor(np.zeros((2,), np.float32))
+            with pytest.raises(FloatingPointError):
+                x / x
+        finally:
+            dbg.disable_tensor_checker()
+
+
+class TestCheckedJit:
+    def test_nan_raises_from_compiled_code(self):
+        def f(x):
+            return paddle.log(x)  # log(-1) -> nan inside jit
+
+        call = dbg.checked_jit(f)
+        ok = call(paddle.to_tensor(np.ones((3,), np.float32)))
+        assert np.isfinite(ok.numpy()).all()
+        with pytest.raises(Exception, match="nan"):
+            call(paddle.to_tensor(-np.ones((3,), np.float32)))
+
+    def test_explicit_check_numerics_inside_jit(self):
+        def f(x):
+            y = x * 2
+            dbg.check_numerics(y, "double", "y")
+            return y
+
+        call = dbg.checked_jit(f)
+        out = call(paddle.to_tensor(np.ones((2,), np.float32)))
+        np.testing.assert_allclose(out.numpy(), 2 * np.ones((2,)))
+        with pytest.raises(Exception, match="check_numerics"):
+            call(paddle.to_tensor(np.array([1.0, np.inf], np.float32)))
+
+
+class TestComparator:
+    def _dump_run(self, tmp_path, name, scale, poison=False):
+        out_dir = str(tmp_path / name)
+        cfg = dbg.TensorCheckerConfig(
+            output_dir=out_dir, debug_mode=dbg.DebugMode.CHECK_NAN_INF)
+        dbg.enable_tensor_checker(cfg)
+        try:
+            paddle.seed(0)
+            net = nn.Linear(4, 4)
+            x = paddle.to_tensor(
+                (scale * np.ones((2, 4))).astype(np.float32))
+            y = net(x)
+            if poison:
+                y = y / paddle.to_tensor(np.zeros((), np.float32))
+            (y * y).mean()
+        finally:
+            dbg.disable_tensor_checker()
+        return out_dir
+
+    def test_identical_runs_report_clean(self, tmp_path):
+        a = self._dump_run(tmp_path, "a", 1.0)
+        b = self._dump_run(tmp_path, "b", 1.0)
+        out = str(tmp_path / "report.json")
+        report = dbg.compare_accuracy(a, b, out)
+        assert report == []
+        assert json.load(open(out))["compared_ops"] > 0
+
+    def test_divergent_runs_flagged(self, tmp_path):
+        a = self._dump_run(tmp_path, "a", 1.0)
+        b = self._dump_run(tmp_path, "b", 100.0)
+        report = dbg.compare_accuracy(a, b, str(tmp_path / "r.json"))
+        assert any("diverged" in i for e in report
+                   for i in e.get("issues", []))
+
+    def test_nan_inf_mismatch_flagged(self, tmp_path):
+        a = self._dump_run(tmp_path, "a", 1.0)
+        b = self._dump_run(tmp_path, "b", 1.0, poison=True)
+        report = dbg.compare_accuracy(a, b, str(tmp_path / "r.json"))
+        assert any("nan_inf_mismatch" in e.get("issues", [])
+                   or e.get("issue") == "length_mismatch" for e in report)

@@ -91,6 +91,21 @@ class TestJitSaveLoad:
         assert (tmp_path / "m.pdiparams.npz").exists()
         assert (tmp_path / "m.json").exists()
 
+    def test_minus_one_dim_is_dynamic_and_manifested_as_none(self, tmp_path):
+        """-1 (the paddle dynamic-dim spelling) must behave like None and
+        be normalized to null in the manifest."""
+        import json
+
+        paddle.seed(5)
+        net = nn.Linear(6, 3)
+        p = str(tmp_path / "neg")
+        paddle.jit.save(net, p, input_spec=[InputSpec([-1, 6], "float32")])
+        manifest = json.load(open(p + ".json"))
+        assert manifest["input_specs"][0]["shape"] == [None, 6]
+        loaded = paddle.jit.load(p)
+        assert tuple(loaded(paddle.to_tensor(
+            np.ones((7, 6), np.float32))).shape) == (7, 3)
+
     def test_missing_input_spec_raises(self, tmp_path):
         net = nn.Linear(4, 2)
         with pytest.raises(ValueError, match="input_spec"):
