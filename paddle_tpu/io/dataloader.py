@@ -16,7 +16,8 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from ..core.tensor import Tensor
-from .dataset import Dataset, IterableDataset
+from ..framework.random import default_seed
+from .dataset import Dataset, IterableDataset, TensorDataset
 from .sampler import BatchSampler
 
 
@@ -66,6 +67,8 @@ class DataLoader:
         self.prefetch_factor = max(prefetch_factor, 1)
         self.use_buffer_reader = use_buffer_reader
         self._iterable_mode = isinstance(dataset, IterableDataset)
+        self._native = None   # lazily-built native fast path
+        self._epoch = 0
         if self._iterable_mode:
             self.batch_sampler = None
             self.batch_size = batch_size
@@ -75,11 +78,59 @@ class DataLoader:
         else:
             self.batch_sampler = BatchSampler(
                 dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last)
+            # plain sampling over a TensorDataset with default collation is
+            # the hot path — serve it from the native (C++) prefetcher:
+            # shuffle + gather + queueing run off the GIL
+            # (paddle_tpu/native, reference: DataLoader C workers)
+            # exact-type check: a subclass may override __getitem__ (per-
+            # sample transforms), which this path bypasses
+            self._native_eligible = (
+                use_shared_memory
+                and self.collate_fn is default_collate_fn
+                and type(dataset) is TensorDataset)
+            self._native_cfg = (batch_size, shuffle, drop_last)
 
     def __len__(self):
         if self._iterable_mode:
             raise TypeError("IterableDataset has no len()")
         return len(self.batch_sampler)
+
+    def _native_batches(self):
+        """C++ prefetcher path (see __init__); None when ineligible.
+
+        Each call returns a generator with its OWN prefetcher handle, so
+        concurrent or abandoned iterations can't steal each other's
+        batches; the handle is destroyed when the generator closes."""
+        if not getattr(self, "_native_eligible", False):
+            return None
+        from .. import native
+        if not native.available():
+            self._native_eligible = False
+            return None
+        if self._native is None:  # cache the contiguous views only
+            try:
+                self._native = [np.ascontiguousarray(
+                    t._value if isinstance(t, Tensor) else t)
+                    for t in self.dataset.tensors]
+            except Exception:
+                self._native_eligible = False
+                return None
+        batch_size, shuffle, drop_last = self._native_cfg
+
+        def gen():
+            pf = native.BatchPrefetcher(
+                self._native, batch_size=batch_size, shuffle=shuffle,
+                drop_last=drop_last, capacity=self.prefetch_factor,
+                n_threads=max(self.num_workers, 1))
+            try:
+                self._epoch += 1
+                # same seed recipe as the fallback RandomSampler, so
+                # paddle.seed() steers the data order on both paths
+                for bufs in pf.epoch(seed=default_seed() + self._epoch):
+                    yield tuple(Tensor(b) for b in bufs)
+            finally:
+                pf.close()
+        return gen()
 
     def _iter_batches(self):
         if self._iterable_mode:
@@ -106,6 +157,12 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
     def __iter__(self):
+        native_gen = self._native_batches()
+        if native_gen is not None:
+            # the C++ prefetcher already double-buffers off the GIL; the
+            # Python buffer-reader thread would only add a second queue
+            yield from native_gen
+            return
         if not self.use_buffer_reader:
             yield from self._iter_batches()
             return

@@ -1,0 +1,206 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The reference implements its data path (DataFeed, DataLoader workers,
+shared-memory queues) in C++; this package is the TPU-native equivalent:
+``src/io_core.cpp`` compiles lazily with the system g++ into
+``_io_core.so`` (cached next to the source, rebuilt when the source
+changes). Everything degrades gracefully: if no compiler is available or
+``PADDLE_TPU_DISABLE_NATIVE=1`` is set, callers fall back to the pure
+NumPy path — same semantics, same RNG order is NOT guaranteed between the
+two paths (document at call sites), but each path is deterministic.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["available", "shuffled_indices", "gather", "BatchPrefetcher"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "io_core.cpp")
+_SO = os.path.join(_HERE, "_io_core.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_load_failed = False
+
+
+def _build() -> bool:
+    # per-pid temp + atomic rename: concurrent builders (pytest workers,
+    # spawned trainers) must not corrupt each other's output
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load():
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get("PADDLE_TPU_DISABLE_NATIVE") == "1":
+            _load_failed = True
+            return None
+        stale = (not os.path.exists(_SO)
+                 or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+        if stale and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.ptio_shuffle.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_uint64]
+        lib.ptio_gather.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32]
+        lib.ptio_prefetcher_create.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32]
+        lib.ptio_prefetcher_create.restype = ctypes.c_void_p
+        lib.ptio_prefetcher_start_epoch.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64]
+        lib.ptio_prefetcher_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)]
+        lib.ptio_prefetcher_next.restype = ctypes.c_int64
+        lib.ptio_prefetcher_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True when the native core is built and loadable."""
+    return _load() is not None
+
+
+def shuffled_indices(n: int, seed: int) -> np.ndarray:
+    """Deterministic permutation of [0, n). Native Fisher-Yates when
+    available; NumPy fallback (different but equally deterministic order)."""
+    lib = _load()
+    if lib is None:
+        return np.random.default_rng(seed).permutation(n).astype(np.int64)
+    idx = np.arange(n, dtype=np.int64)
+    lib.ptio_shuffle(idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                     n, ctypes.c_uint64(seed & (2**64 - 1)))
+    return idx
+
+
+def gather(src: np.ndarray, indices: np.ndarray,
+           n_threads: int = 4) -> np.ndarray:
+    """dst[i] = src[indices[i]] over the leading dim — multithreaded
+    memcpy when native, ``src[indices]`` otherwise."""
+    lib = _load()
+    src = np.ascontiguousarray(src)
+    if lib is None:
+        return src[indices]
+    indices = np.ascontiguousarray(indices, dtype=np.int64)
+    out = np.empty((len(indices),) + src.shape[1:], src.dtype)
+    rec = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    lib.ptio_gather(
+        src.ctypes.data_as(ctypes.c_void_p),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(indices), rec, out.ctypes.data_as(ctypes.c_void_p),
+        n_threads)
+    return out
+
+
+class BatchPrefetcher:
+    """Background batch producer over parallel arrays sharing dim 0.
+
+    The C++ producer thread shuffles (per epoch), gathers records with a
+    small thread pool, and keeps up to ``capacity`` batches queued while
+    Python/the chip consume — the reference DataLoader's C-worker role.
+    Iterate via ``epoch(seed)``; falls back to NumPy when native is
+    unavailable.
+    """
+
+    def __init__(self, arrays: Sequence[np.ndarray], batch_size: int,
+                 shuffle: bool = False, drop_last: bool = False,
+                 capacity: int = 2, n_threads: int = 4):
+        self.arrays = [np.ascontiguousarray(a) for a in arrays]
+        n = len(self.arrays[0])
+        if any(len(a) != n for a in self.arrays):
+            raise ValueError("arrays must share dim 0")
+        self.n = n
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._lib = _load()
+        self._handle = None
+        if self._lib is not None:
+            ptrs = (ctypes.c_void_p * len(self.arrays))(
+                *[a.ctypes.data_as(ctypes.c_void_p).value
+                  for a in self.arrays])
+            recs = (ctypes.c_int64 * len(self.arrays))(
+                *[a.dtype.itemsize *
+                  int(np.prod(a.shape[1:], dtype=np.int64))
+                  for a in self.arrays])
+            self._handle = self._lib.ptio_prefetcher_create(
+                ptrs, recs, len(self.arrays), n, self.batch_size,
+                int(drop_last), int(shuffle), capacity, n_threads)
+
+    def __len__(self):
+        if self.drop_last:
+            return self.n // self.batch_size
+        return (self.n + self.batch_size - 1) // self.batch_size
+
+    def epoch(self, seed: int = 0):
+        """Yield batches (tuple of np arrays, one per input array)."""
+        if self._handle is None:
+            yield from self._numpy_epoch(seed)
+            return
+        self._lib.ptio_prefetcher_start_epoch(
+            self._handle, ctypes.c_uint64(seed & (2**64 - 1)))
+        while True:
+            outs = [np.empty((self.batch_size,) + a.shape[1:], a.dtype)
+                    for a in self.arrays]
+            ptrs = (ctypes.c_void_p * len(outs))(
+                *[o.ctypes.data_as(ctypes.c_void_p).value for o in outs])
+            got = self._lib.ptio_prefetcher_next(self._handle, ptrs)
+            if got <= 0:
+                return
+            if got < self.batch_size:
+                outs = [o[:got] for o in outs]
+            yield tuple(outs)
+
+    def _numpy_epoch(self, seed: int):
+        order = (np.random.default_rng(seed).permutation(self.n)
+                 if self.shuffle else np.arange(self.n))
+        for lo in range(0, self.n, self.batch_size):
+            idx = order[lo:lo + self.batch_size]
+            if len(idx) < self.batch_size and self.drop_last:
+                return
+            yield tuple(a[idx] for a in self.arrays)
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.ptio_prefetcher_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

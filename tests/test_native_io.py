@@ -1,0 +1,157 @@
+"""Native (C++) IO core: builds via g++, binds via ctypes, degrades to
+NumPy. Reference role: the DataLoader C workers / DataFeed data path."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import native
+from paddle_tpu.io import DataLoader, TensorDataset
+
+
+class TestNativeCore:
+    def test_builds_and_loads(self):
+        assert native.available(), (
+            "native core failed to build — g++ is in the image, so this "
+            "should never fall back here")
+
+    def test_shuffle_is_deterministic_permutation(self):
+        a = native.shuffled_indices(1000, seed=7)
+        b = native.shuffled_indices(1000, seed=7)
+        c = native.shuffled_indices(1000, seed=8)
+        assert np.array_equal(a, b)
+        assert not np.array_equal(a, c)
+        assert np.array_equal(np.sort(a), np.arange(1000))
+
+    def test_gather_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        src = rng.standard_normal((64, 3, 5)).astype(np.float32)
+        idx = rng.integers(0, 64, (17,)).astype(np.int64)
+        np.testing.assert_array_equal(native.gather(src, idx), src[idx])
+
+    def test_gather_multithreaded(self):
+        src = np.arange(10000 * 8, dtype=np.int32).reshape(10000, 8)
+        idx = native.shuffled_indices(10000, seed=3)
+        np.testing.assert_array_equal(
+            native.gather(src, idx, n_threads=8), src[idx])
+
+
+class TestBatchPrefetcher:
+    def test_epoch_covers_dataset_in_order_when_not_shuffled(self):
+        x = np.arange(50, dtype=np.float32).reshape(25, 2)
+        pf = native.BatchPrefetcher([x], batch_size=4)
+        got = np.concatenate([b[0] for b in pf.epoch(0)])
+        np.testing.assert_array_equal(got, x)
+        pf.close()
+
+    def test_shuffled_epochs_cover_and_differ(self):
+        x = np.arange(30, dtype=np.int64)[:, None]
+        y = np.arange(30, dtype=np.int64)
+        pf = native.BatchPrefetcher([x, y], batch_size=7, shuffle=True)
+        e1 = [b for b in pf.epoch(seed=1)]
+        e2 = [b for b in pf.epoch(seed=2)]
+        for ep in (e1, e2):
+            ys = np.concatenate([by for _, by in ep])
+            np.testing.assert_array_equal(np.sort(ys), np.arange(30))
+            for bx, by in ep:  # rows stay aligned across arrays
+                np.testing.assert_array_equal(bx[:, 0], by)
+        assert not np.array_equal(
+            np.concatenate([by for _, by in e1]),
+            np.concatenate([by for _, by in e2]))
+        pf.close()
+
+    def test_drop_last(self):
+        x = np.arange(10, dtype=np.float32)[:, None]
+        pf = native.BatchPrefetcher([x], batch_size=4, drop_last=True)
+        sizes = [len(b[0]) for b in pf.epoch(0)]
+        assert sizes == [4, 4]
+        pf.close()
+
+
+class TestDataLoaderFastPath:
+    def _loader(self, n=20, batch=6, **kw):
+        x = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+        y = np.arange(n, dtype=np.int64)
+        ds = TensorDataset([x, y])
+        return DataLoader(ds, batch_size=batch, **kw), x, y
+
+    def test_fast_path_active_and_correct(self):
+        loader, x, y = self._loader()
+        assert loader._native_batches() is not None
+        xs, ys = [], []
+        for bx, by in loader:
+            assert isinstance(bx, paddle.Tensor)
+            xs.append(bx.numpy())
+            ys.append(by.numpy())
+        np.testing.assert_array_equal(np.concatenate(xs), x)
+        np.testing.assert_array_equal(np.concatenate(ys), y)
+
+    def test_matches_fallback_when_unshuffled(self, monkeypatch):
+        loader, x, y = self._loader()
+        fast = [(bx.numpy(), by.numpy()) for bx, by in loader]
+        loader2, _, _ = self._loader()
+        monkeypatch.setattr(loader2, "_native_eligible", False)
+        slow = [(bx.numpy(), by.numpy()) for bx, by in loader2]
+        assert len(fast) == len(slow)
+        for (fx, fy), (sx, sy) in zip(fast, slow):
+            np.testing.assert_array_equal(fx, sx)
+            np.testing.assert_array_equal(fy, sy)
+
+    def test_shuffle_epochs_differ_but_stay_aligned(self):
+        loader, x, y = self._loader(shuffle=True)
+        e1 = [(bx.numpy(), by.numpy()) for bx, by in loader]
+        e2 = [(bx.numpy(), by.numpy()) for bx, by in loader]
+        ys1 = np.concatenate([by for _, by in e1])
+        ys2 = np.concatenate([by for _, by in e2])
+        np.testing.assert_array_equal(np.sort(ys1), y)
+        assert not np.array_equal(ys1, ys2)
+        for bx, by in e1 + e2:
+            np.testing.assert_array_equal(bx[:, 0], x[by][:, 0])
+
+    def test_abandoned_iteration_does_not_steal_batches(self):
+        """Breaking out of one loop must not corrupt the next epoch —
+        each iterator owns its prefetcher handle."""
+        loader, x, y = self._loader()
+        for _ in loader:
+            break  # abandon mid-epoch
+        ys = np.concatenate([by.numpy() for _, by in loader])
+        np.testing.assert_array_equal(ys, y)
+
+    def test_two_live_iterators_are_independent(self):
+        loader, x, y = self._loader()
+        pairs = list(zip(iter(loader), iter(loader)))
+        assert len(pairs) == len(loader)
+        for (ax, ay), (bx, by) in pairs:
+            np.testing.assert_array_equal(ay.numpy(), by.numpy())
+
+    def test_paddle_seed_steers_native_shuffle(self):
+        import paddle_tpu as pd
+        pd.seed(123)
+        loader, _, _ = self._loader(shuffle=True)
+        o1 = np.concatenate([by.numpy() for _, by in loader])
+        pd.seed(456)
+        loader, _, _ = self._loader(shuffle=True)
+        o2 = np.concatenate([by.numpy() for _, by in loader])
+        assert not np.array_equal(o1, o2)
+
+    def test_tensordataset_subclass_uses_fallback(self):
+        from paddle_tpu.io import TensorDataset as TD
+
+        class Augmented(TD):
+            def __getitem__(self, idx):
+                x, y = super().__getitem__(idx)
+                return x * 2, y
+
+        n = 8
+        x = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+        y = np.arange(n, dtype=np.int64)
+        loader = DataLoader(Augmented([x, y]), batch_size=4)
+        assert loader._native_batches() is None
+        bx, by = next(iter(loader))
+        np.testing.assert_array_equal(bx.numpy(), x[:4] * 2)
+
+    def test_custom_collate_uses_fallback(self):
+        loader, x, y = self._loader(
+            collate_fn=lambda batch: len(batch))
+        assert loader._native_batches() is None
+        assert list(loader) == [6, 6, 6, 2]
