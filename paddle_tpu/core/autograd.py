@@ -127,8 +127,12 @@ class GradNode:
 
 
 def _is_float_array(x) -> bool:
+    """Differentiable dtypes: floating or complex (fft ops chain complex
+    intermediates through the tape)."""
     try:
-        return jnp.issubdtype(jnp.result_type(x), jnp.floating)
+        dt = jnp.result_type(x)
+        return (jnp.issubdtype(dt, jnp.floating)
+                or jnp.issubdtype(dt, jnp.complexfloating))
     except TypeError:
         return False
 

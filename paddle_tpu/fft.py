@@ -1,0 +1,106 @@
+"""paddle.fft — discrete Fourier transforms over jnp.fft.
+
+Reference: python/paddle/fft.py (which wraps the PHI fft kernels /
+cuFFT). XLA lowers these to its native FFT HLO on TPU. Norm semantics
+follow the reference: "backward" (default), "ortho", "forward".
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, _val, apply_op
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    if norm is None:
+        return "backward"
+    if norm not in ("backward", "ortho", "forward"):
+        raise ValueError(f"invalid norm {norm!r}")
+    return norm
+
+
+def _wrap1(jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        nm = _norm(norm)
+        return apply_op(jfn.__name__,
+                        lambda a: jfn(a, n=n, axis=axis, norm=nm), x)
+    return op
+
+
+def _wrap2(jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        nm = _norm(norm)
+        return apply_op(jfn.__name__,
+                        lambda a: jfn(a, s=s, axes=axes, norm=nm), x)
+    return op
+
+
+fft = _wrap1(jnp.fft.fft)
+ifft = _wrap1(jnp.fft.ifft)
+rfft = _wrap1(jnp.fft.rfft)
+irfft = _wrap1(jnp.fft.irfft)
+hfft = _wrap1(jnp.fft.hfft)
+ihfft = _wrap1(jnp.fft.ihfft)
+
+fft2 = _wrap2(jnp.fft.fft2)
+ifft2 = _wrap2(jnp.fft.ifft2)
+rfft2 = _wrap2(jnp.fft.rfft2)
+irfft2 = _wrap2(jnp.fft.irfft2)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    nm = _norm(norm)
+    return apply_op("fftn", lambda a: jnp.fft.fftn(a, s=s, axes=axes,
+                                                   norm=nm), x)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    nm = _norm(norm)
+    return apply_op("ifftn", lambda a: jnp.fft.ifftn(a, s=s, axes=axes,
+                                                     norm=nm), x)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    nm = _norm(norm)
+    return apply_op("rfftn", lambda a: jnp.fft.rfftn(a, s=s, axes=axes,
+                                                     norm=nm), x)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    nm = _norm(norm)
+    return apply_op("irfftn", lambda a: jnp.fft.irfftn(a, s=s, axes=axes,
+                                                       norm=nm), x)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(n, d=d)
+    if dtype is not None:
+        from .core.dtype import to_jax_dtype
+        out = out.astype(to_jax_dtype(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(n, d=d)
+    if dtype is not None:
+        from .core.dtype import to_jax_dtype
+        out = out.astype(to_jax_dtype(dtype))
+    return Tensor(out)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op("fftshift",
+                    lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op("ifftshift",
+                    lambda a: jnp.fft.ifftshift(a, axes=axes), x)

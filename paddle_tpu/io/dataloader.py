@@ -109,12 +109,18 @@ class DataLoader:
             return None
         if self._native is None:  # cache the contiguous views only
             try:
-                self._native = [np.ascontiguousarray(
+                arrays = [np.ascontiguousarray(
                     t._value if isinstance(t, Tensor) else t)
                     for t in self.dataset.tensors]
             except Exception:
                 self._native_eligible = False
                 return None
+            if any(a.dtype.hasobject for a in arrays):
+                # the C++ gather memcpys raw bytes — object arrays would
+                # smuggle PyObject* without refcounts
+                self._native_eligible = False
+                return None
+            self._native = arrays
         batch_size, shuffle, drop_last = self._native_cfg
 
         def gen():

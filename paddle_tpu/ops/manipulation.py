@@ -415,3 +415,175 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
         shard = a // size
         return jnp.where(shard == shard_id, a % size, ignore_value)
     return apply_op("shard_index", fn, input)
+
+
+# ------------------------------------------- extended manipulation surface
+# (reference: python/paddle/tensor/manipulation.py, round-2 additions)
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("diagonal", lambda a: jnp.diagonal(
+        a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    def fn(a):
+        n = a.shape[-1] + abs(offset)
+        ndim = a.ndim + 1
+        d1, d2 = dim1 % ndim, dim2 % ndim
+        # build in the last two dims, then move into place
+        eye = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        rows = jnp.arange(a.shape[-1]) + max(-offset, 0)
+        cols = jnp.arange(a.shape[-1]) + max(offset, 0)
+        eye = eye.at[..., rows, cols].set(a)
+        order = [i for i in range(ndim) if i not in (d1, d2)]
+        inv = [0] * ndim
+        for pos, i in enumerate(order + [d1, d2]):
+            inv[i] = pos
+        return jnp.transpose(eye, inv)
+    return apply_op("diag_embed", fn, input)
+
+
+def unflatten(x, axis, shape, name=None):
+    def fn(a):
+        ax = axis % a.ndim
+        tgt = list(a.shape[:ax]) + list(shape) + list(a.shape[ax + 1:])
+        return a.reshape(tgt)
+    return apply_op("unflatten", fn, x)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along ``axis`` (Tensor.unfold): result gains a
+    trailing dim of length ``size``."""
+    def fn(a):
+        ax = axis % a.ndim
+        n = (a.shape[ax] - size) // step + 1
+        starts = jnp.arange(n) * step
+        windows = jax.vmap(
+            lambda s: jax.lax.dynamic_slice_in_dim(a, s, size, axis=ax))(
+            starts)
+        # windows: (n, ..., size at ax ...) -> move n to ax, window to last
+        w = jnp.moveaxis(windows, 0, ax)       # (..., n, size, ...)
+        return jnp.moveaxis(w, ax + 1, a.ndim)
+    return apply_op("unfold", fn, x)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    dim = _val(x).shape[axis]
+    if isinstance(num_or_indices, int):
+        parts = np.array_split(np.arange(dim), num_or_indices)
+        bounds = [0] + list(np.cumsum([len(p) for p in parts]))
+    else:
+        bounds = [0] + [int(i) for i in num_or_indices] + [dim]
+    out = apply_op(
+        "tensor_split",
+        lambda a: tuple(jax.lax.slice_in_dim(a, lo, hi, axis=axis)
+                        for lo, hi in zip(bounds[:-1], bounds[1:])), x)
+    return list(out)
+
+
+def hsplit(x, num_or_indices, name=None):
+    ax = 0 if _val(x).ndim == 1 else 1
+    return tensor_split(x, num_or_indices, axis=ax)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def hstack(x, name=None):
+    return apply_op("hstack", lambda *vs: jnp.hstack(vs), *list(x))
+
+
+def vstack(x, name=None):
+    return apply_op("vstack", lambda *vs: jnp.vstack(vs), *list(x))
+
+
+def dstack(x, name=None):
+    return apply_op("dstack", lambda *vs: jnp.dstack(vs), *list(x))
+
+
+def column_stack(x, name=None):
+    return apply_op("column_stack",
+                    lambda *vs: jnp.column_stack(vs), *list(x))
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply_op("atleast_1d", jnp.atleast_1d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply_op("atleast_2d", jnp.atleast_2d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply_op("atleast_3d", jnp.atleast_3d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def block_diag(inputs, name=None):
+    return apply_op(
+        "block_diag",
+        lambda *vs: jax.scipy.linalg.block_diag(
+            *[jnp.atleast_2d(v) for v in vs]), *list(inputs))
+
+
+def take(x, index, mode="raise", name=None):
+    """Flattened gather (paddle.take): 'raise' clamps under jit (XLA has
+    no trap), 'wrap' wraps negatives/overflow, 'clip' clamps."""
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError(f"unknown take mode {mode!r}")
+    idxv = _val(index)
+
+    def fn(a):
+        flat = a.reshape(-1)
+        i = idxv
+        if mode == "wrap":
+            i = jnp.mod(i, flat.shape[0])
+        else:
+            i = jnp.clip(i, -flat.shape[0], flat.shape[0] - 1)
+        return flat[i]
+    return apply_op("take", fn, x)
+
+
+def msort(x, name=None):
+    return apply_op("msort", lambda a: jnp.sort(a, axis=0), x)
+
+
+def cartesian_prod(x, name=None):
+    def fn(*vs):
+        grids = jnp.meshgrid(*vs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return apply_op("cartesian_prod", fn, *list(x))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Limited as_strided: materializes via explicit index arithmetic
+    (XLA has no aliasing views across arbitrary strides)."""
+    def fn(a):
+        flat = a.reshape(-1)
+        idx = jnp.asarray(offset)
+        for dim, st in zip(shape, stride):
+            idx = idx[..., None] + jnp.arange(dim) * st
+        return flat[idx]
+    return apply_op("as_strided", fn, x)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    from ..core.dtype import to_jax_dtype as _tjd
+    return apply_op("view_dtype",
+                    lambda a: a.view(_tjd(shape_or_dtype)), x)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, list(_val(other).shape))

@@ -278,3 +278,191 @@ def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
     app = _val(append) if append is not None else None
     return apply_op("diff", lambda a: jnp.diff(a, n=n, axis=axis,
                                                prepend=pre, append=app), x)
+
+
+# ------------------------------------------------- extended math surface
+# (reference: python/paddle/tensor/math.py + stat.py, round-2 additions)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+signbit = _unary("signbit", jnp.signbit)
+isneginf = _unary("isneginf", jnp.isneginf)
+isposinf = _unary("isposinf", jnp.isposinf)
+isreal = _unary("isreal", jnp.isreal)
+gammaln = _unary("gammaln", jax.scipy.special.gammaln)
+i0e = _unary("i0e", jax.scipy.special.i0e)
+i1 = _unary("i1", jax.scipy.special.i1)
+i1e = _unary("i1e", jax.scipy.special.i1e)
+polygamma_fn = jax.scipy.special.polygamma
+
+
+def polygamma(x, n, name=None):
+    return apply_op("polygamma", lambda a: polygamma_fn(n, a), x)
+
+
+def ldexp(x, y, name=None):
+    return apply_op("ldexp", lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)),
+                    x, y)
+
+
+def frexp(x, name=None):
+    return apply_op("frexp", jnp.frexp, x)
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return apply_op("vecdot", lambda a, b: jnp.sum(a * b, axis=axis), x, y)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        return jax.lax.cumlogsumexp(a, axis=ax)
+    return apply_op("logcumsumexp", fn, x)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    xv = _val(x) if x is not None else None
+    step = 1.0 if dx is None and xv is None else dx
+
+    def fn(a):
+        if xv is not None:
+            return jnp.trapezoid(a, x=xv, axis=axis)
+        return jnp.trapezoid(a, dx=step, axis=axis)
+    return apply_op("trapezoid", fn, y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    xv = _val(x) if x is not None else None
+    step = 1.0 if dx is None and xv is None else dx
+
+    def fn(a):
+        n = a.shape[axis]
+        lo = jax.lax.slice_in_dim(a, 0, n - 1, axis=axis)
+        hi = jax.lax.slice_in_dim(a, 1, n, axis=axis)
+        avg = (lo + hi) / 2.0
+        if xv is not None:
+            d = jnp.diff(xv, axis=axis if xv.ndim > 1 else 0)
+            if xv.ndim == 1:
+                shape = [1] * a.ndim
+                shape[axis] = d.shape[0]
+                d = d.reshape(shape)
+            avg = avg * d
+        else:
+            avg = avg * step
+        return jnp.cumsum(avg, axis=axis)
+    return apply_op("cumulative_trapezoid", fn, y)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply_op("vander", lambda a: jnp.vander(
+        a, N=n, increasing=increasing), x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_op("nanmedian", lambda a: jnp.nanmedian(
+        a, axis=axis, keepdims=keepdim), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op("nanquantile", lambda a: jnp.nanquantile(
+        a, q, axis=axis, keepdims=keepdim), x)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    """k-th SMALLEST (1-based) along axis -> (values, indices)."""
+    n = _val(x).shape[axis]
+    if not 1 <= k <= n:
+        raise ValueError(
+            f"kthvalue: k={k} out of range for axis of size {n} "
+            "(k is 1-based)")
+
+    def fn(v):
+        sorted_i = jnp.argsort(v, axis=axis)
+        idx = jnp.take(sorted_i, k - 1, axis=axis)
+        vals = jnp.take_along_axis(
+            v, jnp.expand_dims(idx, axis % v.ndim), axis=axis)
+        if keepdim:
+            return vals, jnp.expand_dims(idx, axis % v.ndim)
+        return jnp.squeeze(vals, axis % v.ndim), idx
+    return apply_op("kthvalue", fn, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    """Most frequent value along axis -> (values, indices). Ties break
+    toward the LARGEST value (matching the reference kernel, which scans
+    sorted runs and keeps >=)."""
+    ax = axis % (_val(x).ndim)
+
+    def fn(v):
+        sv = jnp.sort(v, axis=ax)
+        # run length at each sorted position: positions since the run start
+        is_new = jnp.concatenate(
+            [jnp.ones_like(jnp.take(sv, jnp.asarray([0]), ax), dtype=bool),
+             jnp.diff(sv, axis=ax) != 0], axis=ax)
+        pos = jnp.cumsum(jnp.ones_like(sv, dtype=jnp.int32), axis=ax) - 1
+        run_start = jnp.where(is_new, pos, 0)
+        run_start = jax.lax.associative_scan(jnp.maximum, run_start, axis=ax)
+        run_len = pos - run_start + 1
+        best = jnp.argmax(jnp.flip(run_len, axis=ax), axis=ax, keepdims=True)
+        best = sv.shape[ax] - 1 - best  # last max -> largest value on ties
+        vals = jnp.take_along_axis(sv, best, axis=ax)
+        # index in the ORIGINAL array whose value equals the mode (first hit)
+        idx = jnp.argmax(v == vals, axis=ax, keepdims=True)
+        if not keepdim:
+            return jnp.squeeze(vals, ax), jnp.squeeze(idx, ax)
+        return vals, idx
+    return apply_op("mode", fn, x)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def fn(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.linalg.norm(flat, ord=p, axis=1)
+        scale_f = jnp.where(norms > max_norm,
+                            max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale_f[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+    return apply_op("renorm", fn, x)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    use_mm = compute_mode in ("use_mm_for_euclid_dist_if_necessary",
+                              "use_mm_for_euclid_dist")
+
+    def fn(a, b):
+        if p == 2.0 and use_mm:
+            # |x-y|^2 = |x|^2 + |y|^2 - 2 x.y — (n, m) memory instead of
+            # materializing the (n, m, d) difference tensor
+            sq = (jnp.sum(a * a, -1)[..., :, None]
+                  + jnp.sum(b * b, -1)[..., None, :]
+                  - 2.0 * jnp.einsum("...nd,...md->...nm", a, b))
+            return jnp.sqrt(jnp.maximum(sq, 0.0))
+        diffs = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diffs * diffs, axis=-1))
+        if p == float("inf"):
+            return jnp.max(diffs, axis=-1)
+        return jnp.sum(diffs ** p, axis=-1) ** (1.0 / p)
+    return apply_op("cdist", fn, x, y)
+
+
+def complex(real, imag, name=None):
+    return apply_op("complex", jax.lax.complex, real, imag)
+
+
+def polar(abs, angle, name=None):
+    return apply_op("polar", lambda r, t: jax.lax.complex(
+        r * jnp.cos(t), r * jnp.sin(t)), abs, angle)
+
+
+def bitwise_left_shift(x, y, name=None):
+    return apply_op("bitwise_left_shift", jnp.left_shift, x, y)
+
+
+def bitwise_right_shift(x, y, name=None):
+    return apply_op("bitwise_right_shift", jnp.right_shift, x, y)

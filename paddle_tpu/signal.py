@@ -1,0 +1,148 @@
+"""paddle.signal — STFT / ISTFT.
+
+Reference: python/paddle/signal.py (frame + fft kernels). Framing is a
+gather, windows multiply elementwise, the FFT lowers to XLA's native FFT —
+everything jit-safe with static shapes, dispatched through ``apply_op`` so
+eager autograd flows (window included).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, _val, apply_op
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length: int, hop_length: int, axis=-1, name=None):
+    """Slice into overlapping frames. axis=-1: (..., T) -> (..., L, N);
+    axis=0: (T, ...) -> (L, N, ...) (reference layouts)."""
+    ndim = _val(x).ndim
+    if axis not in (-1, ndim - 1, 0):
+        raise ValueError("frame: axis must be first or last")
+    last = axis in (-1, ndim - 1)  # for 1-D both spellings coincide
+
+    def fn(v):
+        w = v if last else jnp.moveaxis(v, 0, -1)
+        n = (w.shape[-1] - frame_length) // hop_length + 1
+        idx = (jnp.arange(n) * hop_length)[:, None] + \
+            jnp.arange(frame_length)[None, :]
+        out = jnp.swapaxes(w[..., idx], -1, -2)   # (..., L, N)
+        if not last:
+            out = jnp.moveaxis(out, -2, 0)        # L first
+            out = jnp.moveaxis(out, -1, 1)        # then N
+        return out
+    return apply_op("frame", fn, x)
+
+
+def overlap_add(x, hop_length: int, axis=-1, name=None):
+    """Inverse of frame: sum overlapping frames. axis=-1 expects
+    (..., L, N); axis=0 expects (L, N, ...)."""
+    ndim = _val(x).ndim
+    if axis not in (-1, ndim - 1, 0):
+        raise ValueError("overlap_add: axis must be first or last")
+    first = axis == 0  # (L, N) == (..., L, N) when ndim == 2: no move
+
+    def fn(v):
+        w = v
+        if first and v.ndim > 2:
+            w = jnp.moveaxis(w, 0, -1)            # (N, ..., L)
+            w = jnp.moveaxis(w, 0, -1)            # (..., L, N)
+        frame_length, n = w.shape[-2], w.shape[-1]
+        out_len = (n - 1) * hop_length + frame_length
+        idx = (jnp.arange(n) * hop_length)[:, None] + \
+            jnp.arange(frame_length)[None, :]     # (N, L)
+        out = jnp.zeros(w.shape[:-2] + (out_len,), w.dtype)
+        out = out.at[..., idx].add(jnp.swapaxes(w, -1, -2))
+        if first and v.ndim > 2:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+    return apply_op("overlap_add", fn, x)
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """Short-time Fourier transform -> complex (..., n_fft//2+1 | n_fft,
+    num_frames), matching the reference layout (freq before frames)."""
+    hop = hop_length if hop_length is not None else n_fft // 4
+    wl = win_length if win_length is not None else n_fft
+    is_complex_in = jnp.iscomplexobj(_val(x))
+
+    def fn(v, *maybe_w):
+        if maybe_w:
+            w = maybe_w[0].astype(
+                v.real.dtype if jnp.iscomplexobj(v) else v.dtype)
+        else:
+            w = jnp.ones((wl,), v.dtype)
+        if wl < n_fft:  # center-pad the window to n_fft
+            lp = (n_fft - wl) // 2
+            w = jnp.pad(w, (lp, n_fft - wl - lp))
+        if center:
+            pad = n_fft // 2
+            cfg = [(0, 0)] * (v.ndim - 1) + [(pad, pad)]
+            v = jnp.pad(v, cfg, mode=pad_mode)
+        n = (v.shape[-1] - n_fft) // hop + 1
+        idx = (jnp.arange(n) * hop)[:, None] + jnp.arange(n_fft)[None, :]
+        frames = v[..., idx] * w              # (..., N, n_fft)
+        if onesided and not is_complex_in:
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)     # (..., freq, N)
+
+    args = (x,) if window is None else (x, window)
+    return apply_op("stft", fn, *args)
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None):
+    """Inverse STFT via windowed overlap-add with window-envelope
+    normalization (reference: paddle.signal.istft)."""
+    hop = hop_length if hop_length is not None else n_fft // 4
+    wl = win_length if win_length is not None else n_fft
+
+    def fn(v, *maybe_w):
+        if maybe_w:
+            w = maybe_w[0].astype(jnp.float32)
+        else:
+            w = jnp.ones((wl,), jnp.float32)
+        if wl < n_fft:
+            lp = (n_fft - wl) // 2
+            w = jnp.pad(w, (lp, n_fft - wl - lp))
+        spec = jnp.swapaxes(v, -1, -2)        # (..., N, freq)
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w
+        n = frames.shape[-2]
+        out_len = (n - 1) * hop + n_fft
+        idx = (jnp.arange(n) * hop)[:, None] + jnp.arange(n_fft)[None, :]
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+        out = out.at[..., idx].add(frames)
+        env = jnp.zeros((out_len,), jnp.float32)
+        env = env.at[idx].add(w * w)
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            pad = n_fft // 2
+            out = out[..., pad:out_len - pad]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    args = (x,) if window is None else (x, window)
+    return apply_op("istft", fn, *args)

@@ -150,6 +150,12 @@ class TestDataLoaderFastPath:
         bx, by = next(iter(loader))
         np.testing.assert_array_equal(bx.numpy(), x[:4] * 2)
 
+    def test_object_dtype_uses_fallback(self):
+        objs = np.array([{"a": i} for i in range(8)], dtype=object)
+        ds = TensorDataset([objs, np.arange(8)])
+        loader = DataLoader(ds, batch_size=4)
+        assert loader._native_batches() is None
+
     def test_custom_collate_uses_fallback(self):
         loader, x, y = self._loader(
             collate_fn=lambda batch: len(batch))

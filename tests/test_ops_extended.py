@@ -1,0 +1,388 @@
+"""Round-2 op-zoo additions: extended math/manipulation, paddle.fft,
+paddle.signal. Parity oracle is numpy/scipy semantics (the reference's own
+test strategy — SURVEY.md §4 OpTest compares against numpy)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+
+rng = np.random.default_rng(7)
+
+
+def T(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestExtendedMath:
+    def test_angles_and_flags(self):
+        x = np.array([-2.0, 0.0, 180.0], np.float32)
+        np.testing.assert_allclose(ops.deg2rad(T(x)).numpy(),
+                                   np.deg2rad(x), rtol=1e-6)
+        np.testing.assert_allclose(ops.rad2deg(T(x)).numpy(),
+                                   np.rad2deg(x), rtol=1e-6)
+        y = np.array([-1.0, 0.0, np.inf, -np.inf, np.nan], np.float32)
+        np.testing.assert_array_equal(ops.signbit(T(y)).numpy(),
+                                      np.signbit(y))
+        np.testing.assert_array_equal(ops.isposinf(T(y)).numpy(),
+                                      np.isposinf(y))
+        np.testing.assert_array_equal(ops.isneginf(T(y)).numpy(),
+                                      np.isneginf(y))
+
+    def test_ldexp_frexp_roundtrip(self):
+        x = np.array([1.5, -3.25, 1000.0], np.float32)
+        m, e = ops.frexp(T(x))
+        np.testing.assert_allclose(
+            ops.ldexp(m, T(e.numpy().astype(np.float32))).numpy(), x,
+            rtol=1e-6)
+
+    def test_gammaln(self):
+        import math
+        x = np.array([1.0, 2.0, 5.0, 0.5], np.float32)
+        want = [math.lgamma(v) for v in x]
+        np.testing.assert_allclose(ops.gammaln(T(x)).numpy(), want,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_logcumsumexp(self):
+        x = rng.standard_normal((4, 6)).astype(np.float32)
+        got = ops.logcumsumexp(T(x), axis=1).numpy()
+        want = np.logaddexp.accumulate(x, axis=1)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_trapezoid(self):
+        y = rng.standard_normal((5, 8)).astype(np.float32)
+        np.testing.assert_allclose(ops.trapezoid(T(y), dx=0.5).numpy(),
+                                   np.trapezoid(y, dx=0.5, axis=-1),
+                                   rtol=1e-5)
+        x = np.sort(rng.standard_normal(8)).astype(np.float32)
+        np.testing.assert_allclose(ops.trapezoid(T(y), x=T(x)).numpy(),
+                                   np.trapezoid(y, x=x, axis=-1), rtol=1e-5)
+
+    def test_cumulative_trapezoid(self):
+        y = rng.standard_normal((3, 7)).astype(np.float32)
+        from scipy.integrate import cumulative_trapezoid as ct
+        np.testing.assert_allclose(
+            ops.cumulative_trapezoid(T(y), dx=2.0).numpy(),
+            ct(y, dx=2.0, axis=-1), rtol=1e-5)
+
+    def test_vander(self):
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(ops.vander(T(x), n=4).numpy(),
+                                   np.vander(x, 4), rtol=1e-6)
+
+    def test_nan_stats(self):
+        x = rng.standard_normal((4, 5)).astype(np.float32)
+        x[1, 2] = np.nan
+        np.testing.assert_allclose(ops.nanmedian(T(x), axis=1).numpy(),
+                                   np.nanmedian(x, axis=1), rtol=1e-6)
+        np.testing.assert_allclose(
+            ops.nanquantile(T(x), 0.25, axis=0).numpy(),
+            np.nanquantile(x, 0.25, axis=0), rtol=1e-5)
+
+    def test_kthvalue(self):
+        x = rng.standard_normal((3, 9)).astype(np.float32)
+        vals, idx = ops.kthvalue(T(x), 3, axis=1)
+        want = np.sort(x, axis=1)[:, 2]
+        np.testing.assert_allclose(vals.numpy(), want, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.take_along_axis(x, idx.numpy()[:, None], 1)[:, 0], want,
+            rtol=1e-6)
+
+    def test_mode(self):
+        x = np.array([[1, 2, 2, 3], [5, 5, 5, 1]], np.float32)
+        vals, idx = ops.mode(T(x), axis=1)
+        np.testing.assert_array_equal(vals.numpy(), [2.0, 5.0])
+        assert x[0, int(idx.numpy()[0])] == 2.0
+        assert x[1, int(idx.numpy()[1])] == 5.0
+
+    def test_renorm(self):
+        x = rng.standard_normal((4, 6)).astype(np.float32) * 5
+        out = ops.renorm(T(x), p=2.0, axis=0, max_norm=1.0).numpy()
+        norms = np.linalg.norm(out.reshape(4, -1), axis=1)
+        assert (norms <= 1.0 + 1e-5).all()
+        small = x / np.abs(x).max() * 0.01
+        np.testing.assert_allclose(
+            ops.renorm(T(small), 2.0, 0, 1.0).numpy(), small, rtol=1e-6)
+
+    def test_cdist(self):
+        a = rng.standard_normal((5, 3)).astype(np.float32)
+        b = rng.standard_normal((7, 3)).astype(np.float32)
+        from scipy.spatial.distance import cdist as sp_cdist
+        np.testing.assert_allclose(ops.cdist(T(a), T(b)).numpy(),
+                                   sp_cdist(a, b), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            ops.cdist(T(a), T(b), p=1.0).numpy(),
+            sp_cdist(a, b, metric="minkowski", p=1), rtol=1e-4, atol=1e-5)
+
+    def test_complex_polar(self):
+        re = rng.standard_normal(5).astype(np.float32)
+        im = rng.standard_normal(5).astype(np.float32)
+        z = ops.complex(T(re), T(im)).numpy()
+        np.testing.assert_allclose(z, re + 1j * im, rtol=1e-6)
+        r = np.abs(z).astype(np.float32)
+        th = np.angle(z).astype(np.float32)
+        np.testing.assert_allclose(ops.polar(T(r), T(th)).numpy(), z,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_shifts(self):
+        x = np.array([1, 2, 8], np.int32)
+        np.testing.assert_array_equal(
+            ops.bitwise_left_shift(T(x), T(np.array([1, 2, 1], np.int32))
+                                   ).numpy(), [2, 8, 16])
+        np.testing.assert_array_equal(
+            ops.bitwise_right_shift(T(x), T(np.ones(3, np.int32))).numpy(),
+            [0, 1, 4])
+
+    def test_vecdot(self):
+        a = rng.standard_normal((4, 6)).astype(np.float32)
+        b = rng.standard_normal((4, 6)).astype(np.float32)
+        np.testing.assert_allclose(ops.vecdot(T(a), T(b)).numpy(),
+                                   (a * b).sum(-1), rtol=1e-5)
+
+
+class TestExtendedManipulation:
+    def test_diagonal_and_embed_roundtrip(self):
+        x = rng.standard_normal((3, 5, 5)).astype(np.float32)
+        d = ops.diagonal(T(x), axis1=1, axis2=2)
+        np.testing.assert_allclose(d.numpy(),
+                                   np.diagonal(x, axis1=1, axis2=2))
+        emb = ops.diag_embed(d).numpy()
+        assert emb.shape == (3, 5, 5)
+        np.testing.assert_allclose(np.diagonal(emb, axis1=1, axis2=2),
+                                   d.numpy())
+
+    def test_diag_embed_offset(self):
+        v = np.array([1.0, 2.0, 3.0], np.float32)
+        out = ops.diag_embed(T(v), offset=1).numpy()
+        np.testing.assert_allclose(out, np.diag(v, k=1))
+
+    def test_unflatten_unfold(self):
+        x = rng.standard_normal((2, 12)).astype(np.float32)
+        out = ops.unflatten(T(x), 1, [3, 4]).numpy()
+        np.testing.assert_array_equal(out, x.reshape(2, 3, 4))
+        y = np.arange(10, dtype=np.float32)
+        w = ops.unfold(T(y), 0, 4, 3).numpy()
+        np.testing.assert_array_equal(w, [[0, 1, 2, 3], [3, 4, 5, 6],
+                                          [6, 7, 8, 9]])
+
+    def test_splits(self):
+        x = rng.standard_normal((6, 4)).astype(np.float32)
+        parts = ops.tensor_split(T(x), 4, axis=0)
+        np.testing.assert_array_equal(
+            np.concatenate([p.numpy() for p in parts]), x)
+        assert [len(p) for p in parts] == [2, 2, 1, 1]
+        parts = ops.tensor_split(T(x), [2, 5], axis=0)
+        assert [p.shape[0] for p in parts] == [2, 3, 1]
+        np.testing.assert_array_equal(ops.vsplit(T(x), 2)[1].numpy(), x[3:])
+        np.testing.assert_array_equal(ops.hsplit(T(x), 2)[0].numpy(),
+                                      x[:, :2])
+
+    def test_stacks(self):
+        a = rng.standard_normal((2, 3)).astype(np.float32)
+        b = rng.standard_normal((2, 3)).astype(np.float32)
+        np.testing.assert_array_equal(ops.hstack([T(a), T(b)]).numpy(),
+                                      np.hstack([a, b]))
+        np.testing.assert_array_equal(ops.vstack([T(a), T(b)]).numpy(),
+                                      np.vstack([a, b]))
+        np.testing.assert_array_equal(ops.dstack([T(a), T(b)]).numpy(),
+                                      np.dstack([a, b]))
+        v = np.arange(3, dtype=np.float32)
+        np.testing.assert_array_equal(
+            ops.column_stack([T(v), T(v * 2)]).numpy(),
+            np.column_stack([v, v * 2]))
+
+    def test_atleast(self):
+        s = T(np.float32(3.0))
+        assert ops.atleast_1d(s).shape == [1]
+        assert ops.atleast_2d(s).shape == [1, 1]
+        assert ops.atleast_3d(s).shape == [1, 1, 1]
+        a, b = ops.atleast_2d(s, T(np.ones(4, np.float32)))
+        assert a.shape == [1, 1] and b.shape == [1, 4]
+
+    def test_block_diag(self):
+        from scipy.linalg import block_diag as sp_bd
+        a = rng.standard_normal((2, 2)).astype(np.float32)
+        b = rng.standard_normal((3, 1)).astype(np.float32)
+        np.testing.assert_array_equal(ops.block_diag([T(a), T(b)]).numpy(),
+                                      sp_bd(a, b))
+
+    def test_take_modes(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        idx = np.array([0, 5, 11], np.int64)
+        np.testing.assert_array_equal(ops.take(T(x), T(idx)).numpy(),
+                                      [0, 5, 11])
+        wrap = ops.take(T(x), T(np.array([13, -1], np.int64)), mode="wrap")
+        np.testing.assert_array_equal(wrap.numpy(), [1, 11])
+        clip = ops.take(T(x), T(np.array([99], np.int64)), mode="clip")
+        np.testing.assert_array_equal(clip.numpy(), [11])
+
+    def test_msort_cartesian(self):
+        x = rng.standard_normal((4, 3)).astype(np.float32)
+        np.testing.assert_array_equal(ops.msort(T(x)).numpy(),
+                                      np.sort(x, axis=0))
+        a = np.array([1, 2], np.float32)
+        b = np.array([3, 4, 5], np.float32)
+        prod = ops.cartesian_prod([T(a), T(b)]).numpy()
+        assert prod.shape == (6, 2)
+        np.testing.assert_array_equal(prod[0], [1, 3])
+        np.testing.assert_array_equal(prod[-1], [2, 5])
+
+    def test_view_and_as_strided(self):
+        x = np.arange(8, dtype=np.float32)
+        np.testing.assert_array_equal(ops.view(T(x), [2, 4]).numpy(),
+                                      x.reshape(2, 4))
+        np.testing.assert_array_equal(
+            ops.view_as(T(x), T(np.zeros((4, 2)))).numpy(), x.reshape(4, 2))
+        s = ops.as_strided(T(x), [3, 2], [2, 1]).numpy()
+        np.testing.assert_array_equal(
+            s, np.lib.stride_tricks.as_strided(
+                x, (3, 2), (8, 4)))
+
+
+class TestFFT:
+    def test_fft_ifft_roundtrip(self):
+        x = rng.standard_normal(16).astype(np.float32)
+        spec = paddle.fft.fft(T(x))
+        np.testing.assert_allclose(spec.numpy(), np.fft.fft(x), rtol=1e-4,
+                                   atol=1e-5)
+        back = paddle.fft.ifft(spec)
+        np.testing.assert_allclose(back.numpy().real, x, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_rfft_matches_numpy_and_norms(self):
+        x = rng.standard_normal((3, 32)).astype(np.float32)
+        for norm in ("backward", "ortho", "forward"):
+            np.testing.assert_allclose(
+                paddle.fft.rfft(T(x), norm=norm).numpy(),
+                np.fft.rfft(x, norm=norm), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            paddle.fft.irfft(paddle.fft.rfft(T(x))).numpy(), x,
+            rtol=1e-4, atol=1e-5)
+
+    def test_2d_and_nd(self):
+        x = rng.standard_normal((4, 8, 8)).astype(np.float32)
+        np.testing.assert_allclose(paddle.fft.fft2(T(x)).numpy(),
+                                   np.fft.fft2(x), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(paddle.fft.rfftn(T(x)).numpy(),
+                                   np.fft.rfftn(x), rtol=1e-4, atol=1e-4)
+
+    def test_freq_and_shift(self):
+        np.testing.assert_allclose(paddle.fft.fftfreq(8, d=0.5).numpy(),
+                                   np.fft.fftfreq(8, d=0.5), rtol=1e-6)
+        np.testing.assert_allclose(paddle.fft.rfftfreq(8).numpy(),
+                                   np.fft.rfftfreq(8), rtol=1e-6)
+        x = np.arange(8, dtype=np.float32)
+        np.testing.assert_array_equal(paddle.fft.fftshift(T(x)).numpy(),
+                                      np.fft.fftshift(x))
+        np.testing.assert_array_equal(
+            paddle.fft.ifftshift(paddle.fft.fftshift(T(x))).numpy(), x)
+
+    def test_invalid_norm_raises(self):
+        with pytest.raises(ValueError):
+            paddle.fft.fft(T(np.ones(4, np.float32)), norm="bogus")
+
+
+class TestSignal:
+    def test_frame_overlap_add_roundtrip_shapes(self):
+        x = rng.standard_normal((2, 64)).astype(np.float32)
+        f = paddle.signal.frame(T(x), 16, 8)
+        assert tuple(f.shape) == (2, 16, 7)
+        back = paddle.signal.overlap_add(f, 8)
+        assert tuple(back.shape) == (2, 64)
+
+    def test_stft_matches_scipy(self):
+        from scipy.signal import stft as sp_stft
+        x = rng.standard_normal(256).astype(np.float32)
+        n_fft, hop = 32, 16
+        win = np.hanning(n_fft).astype(np.float32)
+        got = paddle.signal.stft(T(x), n_fft, hop_length=hop,
+                                 window=T(win), center=False).numpy()
+        _, _, want = sp_stft(x, window=win, nperseg=n_fft,
+                             noverlap=n_fft - hop, boundary=None,
+                             padded=False)
+        # scipy normalizes by window.sum(); undo for raw comparison
+        want = want * win.sum()
+        np.testing.assert_allclose(got, want[:, :got.shape[-1]],
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_stft_istft_roundtrip(self):
+        x = rng.standard_normal((2, 400)).astype(np.float32)
+        n_fft, hop = 64, 16
+        win = np.hanning(n_fft).astype(np.float32)
+        spec = paddle.signal.stft(T(x), n_fft, hop_length=hop,
+                                  window=T(win))
+        back = paddle.signal.istft(spec, n_fft, hop_length=hop,
+                                   window=T(win), length=400)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-3, atol=1e-3)
+
+
+class TestAutogradThroughNewOps:
+    def test_multi_input_stacks_carry_grads(self):
+        a = paddle.to_tensor(np.ones((2, 3), np.float32),
+                             stop_gradient=False)
+        b = paddle.to_tensor(np.ones((2, 3), np.float32),
+                             stop_gradient=False)
+        out = ops.hstack([a, b])
+        assert not out.stop_gradient
+        out.sum().backward()
+        np.testing.assert_array_equal(a.grad.numpy(), np.ones((2, 3)))
+        np.testing.assert_array_equal(b.grad.numpy(), np.ones((2, 3)))
+
+    def test_tensor_split_carries_grads(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32),
+                             stop_gradient=False)
+        parts = ops.tensor_split(x, 3)
+        (parts[0].sum() * 2 + parts[2].sum()).backward()
+        np.testing.assert_array_equal(x.grad.numpy(), [2, 2, 0, 0, 1, 1])
+
+    def test_fft_roundtrip_grad(self):
+        x = paddle.to_tensor(rng.standard_normal(8).astype(np.float32),
+                             stop_gradient=False)
+        y = paddle.fft.irfft(paddle.fft.rfft(x))
+        assert not y.stop_gradient
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(8), rtol=1e-5)
+
+    def test_stft_grad_flows_to_signal_and_window(self):
+        x = paddle.to_tensor(rng.standard_normal(64).astype(np.float32),
+                             stop_gradient=False)
+        w = paddle.to_tensor(np.hanning(16).astype(np.float32),
+                             stop_gradient=False)
+        spec = paddle.signal.stft(x, 16, hop_length=8, window=w)
+        ops.abs(spec).sum().backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+        assert w.grad is not None
+
+    def test_kthvalue_validates_k(self):
+        x = T(np.array([3.0, 1.0, 2.0], np.float32))
+        with pytest.raises(ValueError):
+            ops.kthvalue(x, 0)
+        with pytest.raises(ValueError):
+            ops.kthvalue(x, 4)
+
+
+class TestSignalAxis0:
+    def test_frame_axis0_layout_and_roundtrip(self):
+        x = rng.standard_normal((16, 2)).astype(np.float32)
+        f = paddle.signal.frame(T(x), 4, 4, axis=0)
+        assert tuple(f.shape) == (4, 4, 2)  # (L, N, ...)
+        np.testing.assert_array_equal(f.numpy()[:, 0, :], x[:4])
+        np.testing.assert_array_equal(f.numpy()[:, 1, :], x[4:8])
+        back = paddle.signal.overlap_add(f, 4, axis=0)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+
+    def test_frame_1d(self):
+        x = np.arange(10, dtype=np.float32)
+        f = paddle.signal.frame(T(x), 4, 2)
+        assert tuple(f.shape) == (4, 4)
+        np.testing.assert_array_equal(f.numpy()[:, 0], x[:4])
+
+
+class TestTensorMethodBinding:
+    def test_new_ops_bound_as_methods(self):
+        x = T(np.arange(6, dtype=np.float32).reshape(2, 3))
+        assert hasattr(x, "diagonal")
+        np.testing.assert_allclose(x.diagonal().numpy(),
+                                   np.diagonal(x.numpy()))
+        assert hasattr(x, "deg2rad") and hasattr(x, "cdist")
