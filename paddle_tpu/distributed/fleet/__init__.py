@@ -24,3 +24,6 @@ from .meta_parallel import (  # noqa: F401
     VocabParallelEmbedding,
 )
 from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed  # noqa: F401
+from .role_maker import (  # noqa: F401
+    PaddleCloudRoleMaker, Role, UserDefinedRoleMaker,
+)

@@ -34,6 +34,10 @@ class Fleet:
     # ------------------------------------------------------------------ init
     def init(self, role_maker=None, is_collective: bool = True,
              strategy: Optional[DistributedStrategy] = None):
+        if role_maker is None:
+            from .role_maker import PaddleCloudRoleMaker
+            role_maker = PaddleCloudRoleMaker(is_collective=is_collective)
+        self._role_maker = role_maker
         if strategy is None:
             strategy = DistributedStrategy()
         self._user_defined_strategy = strategy

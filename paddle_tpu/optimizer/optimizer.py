@@ -155,7 +155,7 @@ class Optimizer:
                 pc = state["master"][k]
             else:
                 pc = p
-            np_, ns = self.apply_one(pc, g.astype(pc.dtype), slots, lr, t, self._wd_value())
+            np_, ns = self.apply_one(pc, g.astype(pc.dtype), slots, lr, t, self._wd_for_key(k))
             new_slots[k] = ns
             if new_master is not None:
                 new_master[k] = np_
@@ -169,6 +169,11 @@ class Optimizer:
         if hasattr(wd, "__call__") and not isinstance(wd, (int, float)):
             return float(wd())
         return float(wd)
+
+    def _wd_for_key(self, key: str) -> float:
+        """Per-parameter weight decay in the functional path (override for
+        name-based exclusion, e.g. LARS exclude_from_weight_decay)."""
+        return self._wd_value()
 
     # ------------------------------------------------------------ state dict
     def state_dict(self) -> Dict[str, Any]:
@@ -429,3 +434,48 @@ class Lamb(Optimizer):
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         return (p32 - lr * trust * r).astype(p.dtype), {"moment1": m, "moment2": v}
 
+
+
+class LarsMomentum(Optimizer):
+    """LARS (reference: paddle.incubate.optimizer.LarsMomentumOptimizer /
+    lars_momentum op): layer-wise trust ratio scales the LR by
+    ||w|| / (||g|| + lars_weight_decay * ||w|| + epsilon)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, epsilon=1e-9,
+                 exclude_from_weight_decay=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, lars_weight_decay,
+                         grad_clip, name, multi_precision)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._eps = epsilon
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def init_slot(self, p_val):
+        return {"velocity": jnp.zeros_like(p_val, dtype=jnp.float32)}
+
+    def _decay_for(self, p) -> float:
+        if any(s in (p.name or "") for s in self._exclude):
+            return 0.0
+        return super()._decay_for(p)
+
+    def _wd_for_key(self, key: str) -> float:
+        if any(s in key for s in self._exclude):
+            return 0.0
+        return self._wd_value()
+
+    def apply_one(self, p, g, slots, lr, t, wd):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        g_norm = jnp.sqrt(jnp.sum(g32 * g32))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm /
+            (g_norm + wd * w_norm + self._eps),
+            1.0)
+        upd = g32 + wd * p32
+        v = self._momentum * slots["velocity"] + lr * local_lr * upd
+        return (p32 - v).astype(p.dtype), {"velocity": v}

@@ -110,6 +110,11 @@ def istft(x, n_fft: int, hop_length: Optional[int] = None,
     normalization (reference: paddle.signal.istft)."""
     hop = hop_length if hop_length is not None else n_fft // 4
     wl = win_length if win_length is not None else n_fft
+    if return_complex and onesided:
+        raise ValueError(
+            "istft: return_complex=True requires onesided=False (a "
+            "onesided spectrum reconstructs a real signal) — reference "
+            "raises the same way")
 
     def fn(v, *maybe_w):
         if maybe_w:

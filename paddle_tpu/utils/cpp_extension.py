@@ -1,0 +1,219 @@
+"""Custom C++ op runtime (reference: python/paddle/utils/cpp_extension/ +
+paddle/phi/api/ext/ OpMetaInfo).
+
+``load(name, sources)`` JIT-compiles user C++ into a shared library and
+returns a module of Python ops. The TPU-native twist: custom C++ runs on
+the HOST, so inside ``jit`` the op executes via ``jax.pure_callback`` —
+XLA calls back to the host mid-program, the same role the reference's
+custom-op registry plays for CPU kernels. Eagerly it's a direct ctypes
+call. Autograd: pass ``backward_for(...)`` to register a VJP.
+
+User C ABI (one function per op):
+
+    extern "C" void my_op(const float* x, float* out, int64_t n);
+
+declared to ``load`` via ``functions={"my_op": spec}`` where spec lists
+the argument roles — see ``FunctionSpec``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, _val
+
+__all__ = ["load", "CppExtension", "FunctionSpec", "get_build_directory"]
+
+_DEFAULT_BUILD_DIR = os.path.join(
+    tempfile.gettempdir(), "paddle_tpu_extensions")
+_build_lock = threading.Lock()
+
+
+def get_build_directory() -> str:
+    d = os.environ.get("PADDLE_EXTENSION_DIR", _DEFAULT_BUILD_DIR)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+@dataclass
+class FunctionSpec:
+    """Describes one exported C function.
+
+    The C function receives, in order: one ``const T*`` per input, one
+    ``T*`` per output, then one ``int64_t`` per dimension of each input's
+    shape (flattened, inputs in order). Outputs are allocated by the
+    caller with shapes from ``out_shapes(*input_shapes)`` (defaults to
+    the first input's shape) and dtypes from ``out_dtypes``.
+    """
+
+    n_inputs: int = 1
+    n_outputs: int = 1
+    dtype: str = "float32"
+    out_dtypes: Optional[Sequence[str]] = None
+    out_shapes: Optional[Callable] = None  # (*in_shapes) -> [shape, ...]
+
+    def resolve_out(self, in_shapes):
+        shapes = (self.out_shapes(*in_shapes) if self.out_shapes
+                  else [in_shapes[0]] * self.n_outputs)
+        dtypes = list(self.out_dtypes or [self.dtype] * self.n_outputs)
+        return [tuple(int(d) for d in s) for s in shapes], dtypes
+
+
+_C_DTYPES = {
+    "float32": ctypes.c_float, "float64": ctypes.c_double,
+    "int32": ctypes.c_int32, "int64": ctypes.c_int64,
+}
+
+
+class _NativeFunction:
+    def __init__(self, cfunc, name: str, spec: FunctionSpec):
+        self._cfunc = cfunc
+        self._name = name
+        self._spec = spec
+        self._vjp: Optional[Callable] = None
+
+    def _host_call(self, *arrays):
+        spec = self._spec
+        want = np.dtype(spec.dtype)
+        arrays = [np.ascontiguousarray(a, dtype=want) for a in arrays]
+        in_shapes = [a.shape for a in arrays]
+        out_shapes, out_dtypes = spec.resolve_out(in_shapes)
+        outs = [np.zeros(s, d) for s, d in zip(out_shapes, out_dtypes)]
+        args = []
+        for a in arrays:
+            args.append(a.ctypes.data_as(
+                ctypes.POINTER(_C_DTYPES[str(a.dtype)])))
+        for o in outs:
+            args.append(o.ctypes.data_as(
+                ctypes.POINTER(_C_DTYPES[str(o.dtype)])))
+        for a in arrays:
+            args.extend(ctypes.c_int64(d) for d in a.shape)
+        self._cfunc(*args)
+        return tuple(outs) if len(outs) != 1 else outs[0]
+
+    def __call__(self, *tensors):
+        spec = self._spec
+
+        if self._spec.dtype not in _C_DTYPES or any(
+                d not in _C_DTYPES for d in (self._spec.out_dtypes or [])):
+            raise TypeError(
+                f"custom op {self._name!r}: supported dtypes are "
+                f"{sorted(_C_DTYPES)}")
+
+        def fn(*vals):
+            in_shapes = [np.shape(v) for v in vals]
+            out_shapes, out_dtypes = spec.resolve_out(in_shapes)
+            result_shape = [
+                jax.ShapeDtypeStruct(s, jnp.dtype(d))
+                for s, d in zip(out_shapes, out_dtypes)]
+            if len(result_shape) == 1:
+                result_shape = result_shape[0]
+            # host callback: works eagerly AND inside jit-compiled
+            # programs (XLA inserts a host transfer + callback)
+            out = jax.pure_callback(self._host_call, result_shape, *vals,
+                                    vmap_method="sequential")
+            return out
+
+        if self._vjp is not None:
+            vjp = self._vjp
+            inner = fn
+
+            @jax.custom_vjp
+            def fn_vjp(*vals):
+                return inner(*vals)
+
+            def fwd(*vals):
+                return inner(*vals), vals
+
+            def bwd(res, g):
+                grads = vjp(res, g)
+                return tuple(grads)
+            fn_vjp.defvjp(fwd, bwd)
+            fn = fn_vjp
+        return apply_op(f"custom_op::{self._name}", fn, *tensors)
+
+    def backward_for(self, grad_fn: Callable):
+        """Register the VJP: ``grad_fn(saved_inputs, out_cotangent) ->
+        tuple of input cotangents`` (jax-traceable)."""
+        self._vjp = grad_fn
+        return self
+
+
+class CppExtension:
+    """The loaded module: exported functions become attributes."""
+
+    def __init__(self, name: str, lib, functions: Dict[str, FunctionSpec]):
+        self.name = name
+        self._lib = lib
+        for fname, spec in functions.items():
+            cfunc = getattr(lib, fname)
+            cfunc.restype = None
+            setattr(self, fname, _NativeFunction(cfunc, fname, spec))
+
+
+def _compile(name: str, sources: List[str], extra_cxx_flags,
+             build_dir: str) -> str:
+    srcs = []
+    for s in sources:
+        if os.path.exists(s):
+            with open(s) as f:
+                srcs.append(f.read())
+        else:  # inline source string
+            srcs.append(s)
+    blob = "\n".join(srcs)
+    tag = hashlib.sha256(
+        (blob + " ".join(extra_cxx_flags)).encode()).hexdigest()[:16]
+    so = os.path.join(build_dir, f"{name}_{tag}.so")
+    if os.path.exists(so):
+        return so
+    src_path = os.path.join(build_dir, f"{name}_{tag}.cpp")
+    with open(src_path, "w") as f:
+        f.write(blob)
+    tmp = f"{so}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+           *extra_cxx_flags, src_path, "-o", tmp]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"cpp_extension build failed:\n{r.stderr[-2000:]}")
+        os.replace(tmp, so)
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    return so
+
+
+def load(name: str, sources: List[str],
+         functions: Dict[str, FunctionSpec] = None,
+         extra_cxx_flags: Sequence[str] = (),
+         build_directory: Optional[str] = None,
+         verbose: bool = False) -> CppExtension:
+    """Compile + load a custom C++ op library (reference:
+    paddle.utils.cpp_extension.load). ``sources`` are file paths or
+    inline source strings; ``functions`` maps exported symbol ->
+    FunctionSpec."""
+    if not functions:
+        raise ValueError(
+            "functions={'symbol': FunctionSpec(...)} is required — the "
+            "TPU build binds C symbols via ctypes, not op registration "
+            "macros")
+    build_dir = build_directory or get_build_directory()
+    with _build_lock:
+        so = _compile(name, list(sources), list(extra_cxx_flags), build_dir)
+    lib = ctypes.CDLL(so)
+    return CppExtension(name, lib, functions)

@@ -1,0 +1,13 @@
+"""paddle.vision — transforms, CNN model zoo, datasets.
+
+Reference: python/paddle/vision/. The ops submodule's detection helpers
+(roi_align, nms, deform_conv) are out of scope this round — the model
+zoo, transforms, and dataset surfaces are what the exemplar/benchmark
+paths consume.
+"""
+
+from . import datasets, models, transforms  # noqa: F401
+from .models import (  # noqa: F401
+    LeNet, MobileNetV2, ResNet, VGG, mobilenet_v2, resnet18, resnet34,
+    resnet50, resnet101, resnet152, vgg11, vgg13, vgg16, vgg19,
+)

@@ -1,0 +1,191 @@
+"""paddle.vision.datasets — dataset classes.
+
+Reference: python/paddle/vision/datasets/{mnist.py,cifar.py,...}. This
+environment has zero network egress, so ``download=True`` (the reference
+default) raises with guidance; the classes load from local files with the
+standard formats. ``FakeData`` provides deterministic synthetic images
+for tests/benchmarks (reference has the same concept in its test utils).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+def _no_download(download, what):
+    if download:
+        raise ValueError(
+            f"download=True is unsupported (no network egress); place the "
+            f"{what} files locally and pass their paths")
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data."""
+
+    def __init__(self, size: int = 256, image_shape=(3, 32, 32),
+                 num_classes: int = 10, transform: Optional[Callable] = None,
+                 seed: int = 0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        rng = np.random.default_rng(seed)
+        self._images = rng.integers(
+            0, 256, (size,) + self.image_shape).astype(np.uint8)
+        self._labels = rng.integers(0, num_classes, (size,)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self._images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32) / 255.0
+        return img, self._labels[idx]
+
+    def __len__(self):
+        return self.size
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST (reference: paddle.vision.datasets.MNIST).
+    ``image_path``/``label_path`` point at the (optionally gzipped)
+    idx3/idx1 files."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path: Optional[str] = None,
+                 label_path: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = False,
+                 backend: str = "cv2"):
+        _no_download(download, self.NAME)
+        if image_path is None or label_path is None:
+            raise ValueError(
+                f"{type(self).__name__} needs image_path and label_path "
+                "(local idx files; download is unavailable)")
+        self.mode = mode
+        self.transform = transform
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+        if len(self.images) != len(self.labels):
+            raise ValueError("image/label count mismatch")
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") \
+            else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(f"bad idx3 magic {magic} in {path}")
+            data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+            return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(f"bad idx1 magic {magic} in {path}")
+            return np.frombuffer(f.read(n), dtype=np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from the python-pickle tar (reference:
+    paddle.vision.datasets.Cifar10). ``data_file`` is the local
+    cifar-10-python.tar.gz."""
+
+    _PREFIX = "cifar-10-batches-py"
+    _META_LABEL = b"labels"
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None,
+                 download: bool = False, backend: str = "cv2"):
+        _no_download(download, "cifar")
+        if data_file is None:
+            raise ValueError(
+                f"{type(self).__name__} needs data_file (local tar.gz; "
+                "download is unavailable)")
+        if mode not in ("train", "test"):
+            raise ValueError(f"mode must be train|test, got {mode!r}")
+        self.mode = mode
+        self.transform = transform
+        images, labels = [], []
+        with tarfile.open(data_file, "r:*") as tar:
+            for member in tar.getmembers():
+                base = os.path.basename(member.name)
+                take = (base.startswith("data_batch") if mode == "train"
+                        else base == "test_batch")
+                if not (take and member.name.startswith(self._PREFIX)):
+                    continue
+                batch = pickle.load(tar.extractfile(member),
+                                    encoding="bytes")
+                images.append(np.asarray(batch[b"data"], np.uint8))
+                labels.extend(batch[self._META_LABEL])
+        if not images:
+            raise ValueError(f"no {mode} batches found in {data_file}")
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(np.transpose(img, (1, 2, 0)))
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    _PREFIX = "cifar-100-python"
+    _META_LABEL = b"fine_labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="cv2"):
+        # cifar-100 stores one 'train'/'test' file instead of data_batch_*
+        _no_download(download, "cifar")
+        if data_file is None:
+            raise ValueError("Cifar100 needs data_file (local tar.gz)")
+        if mode not in ("train", "test"):
+            raise ValueError(f"mode must be train|test, got {mode!r}")
+        self.mode = mode
+        self.transform = transform
+        images, labels = [], []
+        with tarfile.open(data_file, "r:*") as tar:
+            for member in tar.getmembers():
+                base = os.path.basename(member.name)
+                if base != mode or not member.name.startswith(self._PREFIX):
+                    continue
+                batch = pickle.load(tar.extractfile(member),
+                                    encoding="bytes")
+                images.append(np.asarray(batch[b"data"], np.uint8))
+                labels.extend(batch[self._META_LABEL])
+        if not images:
+            raise ValueError(f"no {mode} file found in {data_file}")
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)

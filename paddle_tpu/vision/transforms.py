@@ -1,0 +1,264 @@
+"""paddle.vision.transforms — preprocessing transforms.
+
+Reference: python/paddle/vision/transforms/{transforms.py,functional.py}.
+Transforms operate on numpy HWC uint8/float images (the loader side of
+the pipeline — host CPU work), ending with ToTensor/Normalize producing
+CHW float arrays ready for a single H2D transfer per batch.
+"""
+
+from __future__ import annotations
+
+import numbers
+import random as pyrandom
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+    "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+    "Transpose", "Pad", "BrightnessTransform", "ContrastTransform",
+    "to_tensor", "normalize", "resize", "center_crop", "crop", "hflip",
+    "vflip", "pad", "adjust_brightness", "adjust_contrast",
+]
+
+
+def _as_hwc(img) -> np.ndarray:
+    a = np.asarray(img._value if isinstance(img, Tensor) else img)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    return a
+
+
+# ------------------------------------------------------------- functional
+def to_tensor(img, data_format: str = "CHW"):
+    a = _as_hwc(img)
+    if a.dtype == np.uint8:
+        a = a.astype(np.float32) / 255.0
+    else:
+        a = a.astype(np.float32)
+    if data_format.upper() == "CHW":
+        a = np.transpose(a, (2, 0, 1))
+    return Tensor(a)
+
+
+def normalize(img, mean, std, data_format: str = "CHW", to_rgb=False):
+    is_tensor = isinstance(img, Tensor)
+    a = np.asarray(img._value if is_tensor else img, dtype=np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format.upper() == "CHW":
+        shape = (-1, 1, 1)
+    else:
+        shape = (1, 1, -1)
+    out = (a - mean.reshape(shape)) / std.reshape(shape)
+    return Tensor(out) if is_tensor else out
+
+
+def resize(img, size, interpolation: str = "bilinear"):
+    a = _as_hwc(img)
+    if isinstance(size, int):
+        h, w = a.shape[:2]
+        if h <= w:
+            oh, ow = size, max(int(round(w * size / h)), 1)
+        else:
+            oh, ow = max(int(round(h * size / w)), 1), size
+    else:
+        oh, ow = size
+    import jax
+    import jax.numpy as jnp
+    method = {"nearest": "nearest", "bilinear": "linear",
+              "bicubic": "cubic"}[interpolation]
+    out = np.asarray(jax.image.resize(
+        jnp.asarray(a, jnp.float32), (oh, ow, a.shape[2]), method=method))
+    if a.dtype == np.uint8:  # preserve dtype: ToTensor's /255 depends on it
+        return np.clip(np.round(out), 0, 255).astype(np.uint8)
+    return out.astype(a.dtype)
+
+
+def crop(img, top: int, left: int, height: int, width: int):
+    a = _as_hwc(img)
+    return a[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    a = _as_hwc(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    th, tw = output_size
+    h, w = a.shape[:2]
+    return crop(a, (h - th) // 2, (w - tw) // 2, th, tw)
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode: str = "constant"):
+    a = _as_hwc(img)
+    if isinstance(padding, int):
+        pl = pr = pt = pb = padding
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    cfg = [(pt, pb), (pl, pr), (0, 0)]
+    if padding_mode == "constant":
+        return np.pad(a, cfg, mode="constant", constant_values=fill)
+    return np.pad(a, cfg, mode=padding_mode)
+
+
+def adjust_brightness(img, brightness_factor: float):
+    a = _as_hwc(img)
+    is_u8 = a.dtype == np.uint8
+    out = np.clip(a.astype(np.float32) * brightness_factor, 0,
+                  255.0 if is_u8 else 1.0)
+    return out.astype(np.uint8) if is_u8 else out
+
+
+def adjust_contrast(img, contrast_factor: float):
+    a = _as_hwc(img)
+    is_u8 = a.dtype == np.uint8
+    f = a.astype(np.float32)
+    mean = f.mean()
+    out = np.clip((f - mean) * contrast_factor + mean, 0,
+                  255.0 if is_u8 else 1.0)
+    return out.astype(np.uint8) if is_u8 else out
+
+
+# ----------------------------------------------------------------- classes
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format: str = "CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format: str = "CHW",
+                 to_rgb=False, keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation: str = "bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        a = _as_hwc(img)
+        if self.padding is not None:
+            a = pad(a, self.padding, self.fill, self.padding_mode)
+        th, tw = self.size
+        h, w = a.shape[:2]
+        if self.pad_if_needed and (h < th or w < tw):
+            # pad() unpacks 4-tuples as (left, top, right, bottom)
+            a = pad(a, (0, 0, max(tw - w, 0), max(th - h, 0)), self.fill,
+                    self.padding_mode)
+            h, w = a.shape[:2]
+        top = pyrandom.randint(0, h - th)
+        left = pyrandom.randint(0, w - tw)
+        return crop(a, top, left, th, tw)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob: float = 0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if pyrandom.random() < self.prob else _as_hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob: float = 0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if pyrandom.random() < self.prob else _as_hwc(img)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = tuple(order)
+
+    def _apply_image(self, img):
+        return np.transpose(_as_hwc(img), self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding, self.fill = padding, fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value: float, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        f = 1 + pyrandom.uniform(-self.value, self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value: float, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        f = 1 + pyrandom.uniform(-self.value, self.value)
+        return adjust_contrast(img, f)

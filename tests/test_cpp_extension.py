@@ -1,0 +1,79 @@
+"""Custom C++ op runtime (paddle.utils.cpp_extension equivalent): JIT
+build, eager call, call under jax.jit via pure_callback, custom VJP."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.cpp_extension import FunctionSpec, load
+
+RELU_SRC = r"""
+#include <cstdint>
+extern "C" void my_relu(const float* x, float* out, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) out[i] = x[i] > 0 ? x[i] : 0.0f;
+}
+extern "C" void my_axpy(const float* x, const float* y, float* out,
+                        int64_t nx, int64_t ny) {
+  for (int64_t i = 0; i < nx; ++i) out[i] = 2.0f * x[i] + y[i];
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    return load(
+        "testops", [RELU_SRC],
+        functions={
+            "my_relu": FunctionSpec(n_inputs=1, n_outputs=1),
+            "my_axpy": FunctionSpec(n_inputs=2, n_outputs=1),
+        },
+        build_directory=str(tmp_path_factory.mktemp("ext")))
+
+
+class TestCppExtension:
+    def test_eager_call(self, ext):
+        x = paddle.to_tensor(np.array([-1.0, 2.0, -3.0, 4.0], np.float32))
+        out = ext.my_relu(x)
+        np.testing.assert_array_equal(out.numpy(), [0, 2, 0, 4])
+
+    def test_two_input_op(self, ext):
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        y = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        np.testing.assert_array_equal(ext.my_axpy(x, y).numpy(),
+                                      2.0 + np.arange(4))
+
+    def test_runs_inside_jit(self, ext):
+        def f(v):
+            r = ext.my_relu(paddle.Tensor(v))
+            return r._value * 3
+
+        out = jax.jit(f)(jnp.asarray([-2.0, 5.0], jnp.float32))
+        np.testing.assert_array_equal(np.asarray(out), [0.0, 15.0])
+
+    def test_custom_vjp(self, ext):
+        ext.my_relu.backward_for(
+            lambda saved, g: (g * (saved[0] > 0).astype(g.dtype),))
+        x = paddle.to_tensor(np.array([-1.0, 2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        out = ext.my_relu(x)
+        out.sum().backward()
+        np.testing.assert_array_equal(x.grad.numpy(), [0, 1, 1])
+
+    def test_build_error_surfaces(self, tmp_path):
+        with pytest.raises(RuntimeError, match="build failed"):
+            load("broken", ["this is not C++"],
+                 functions={"f": FunctionSpec()},
+                 build_directory=str(tmp_path))
+
+    def test_cache_reuses_artifact(self, ext, tmp_path):
+        import os
+        d = str(tmp_path)
+        load("cached", [RELU_SRC],
+             functions={"my_relu": FunctionSpec()}, build_directory=d)
+        before = set(os.listdir(d))
+        load("cached", [RELU_SRC],
+             functions={"my_relu": FunctionSpec()}, build_directory=d)
+        assert set(os.listdir(d)) == before

@@ -306,6 +306,13 @@ class TestSignal:
         np.testing.assert_allclose(got, want[:, :got.shape[-1]],
                                    rtol=1e-3, atol=1e-3)
 
+    def test_istft_return_complex_needs_twosided(self):
+        spec = paddle.fft.rfft(T(rng.standard_normal((1, 64)).astype(
+            np.float32)))
+        with pytest.raises(ValueError, match="onesided"):
+            paddle.signal.istft(T(np.zeros((1, 17, 5), np.complex64)),
+                                32, return_complex=True)
+
     def test_stft_istft_roundtrip(self):
         x = rng.standard_normal((2, 400)).astype(np.float32)
         n_fft, hop = 64, 16

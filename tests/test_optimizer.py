@@ -213,3 +213,98 @@ class TestIO:
         i1 = [i for b in s1 for i in b]
         assert len(i0) == len(i1) == 5
         assert not (set(i0) & set(i1))
+
+
+class TestLarsMomentum:
+    def test_trust_ratio_scales_update(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.optimizer import LarsMomentum
+
+        paddle.seed(0)
+        p = paddle.Parameter(np.full((4,), 2.0, np.float32))
+        p.stop_gradient = False
+        opt = LarsMomentum(learning_rate=0.1, momentum=0.0,
+                           lars_coeff=0.001, lars_weight_decay=0.0,
+                           parameters=[p])
+        p.grad = paddle.to_tensor(np.full((4,), 1.0, np.float32))
+        w_norm = np.linalg.norm(p.numpy())
+        g_norm = np.linalg.norm(p.grad.numpy())
+        expect = p.numpy() - 0.1 * (0.001 * w_norm / (g_norm + 1e-9)) \
+            * p.grad.numpy()
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), expect, rtol=1e-5)
+
+    def test_trains_under_jit(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.hapi import TrainStep
+        from paddle_tpu.optimizer import LarsMomentum
+
+        paddle.seed(1)
+        net = nn.Linear(4, 4)
+        step = TrainStep(net, LarsMomentum(
+            learning_rate=0.5, parameters=net.parameters()),
+            loss_fn=lambda o, y: F.mse_loss(
+                paddle.Tensor(o), paddle.Tensor(y))._value)
+        x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+        losses = [float(step(x, x)) for _ in range(5)]
+        assert losses[-1] < losses[0]
+
+
+class TestRoleMaker:
+    def test_paddle_cloud_reads_env(self, monkeypatch):
+        from paddle_tpu.distributed.fleet import PaddleCloudRoleMaker
+
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+        monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                           "h0:1,h1:1,h2:1,h3:1")
+        monkeypatch.setenv("PADDLE_CURRENT_ENDPOINT", "h2:1")
+        rm = PaddleCloudRoleMaker()
+        assert rm.worker_index() == 2
+        assert rm.worker_num() == 4
+        assert not rm.is_first_worker()
+        assert rm.get_trainer_endpoints()[2] == "h2:1"
+
+    def test_validation(self, monkeypatch):
+        from paddle_tpu.distributed.fleet import PaddleCloudRoleMaker
+
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "9")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        with pytest.raises(ValueError):
+            PaddleCloudRoleMaker()
+
+    def test_user_defined(self):
+        from paddle_tpu.distributed.fleet import UserDefinedRoleMaker
+
+        rm = UserDefinedRoleMaker(current_id=1, worker_num=3)
+        assert rm.worker_index() == 1 and rm.worker_num() == 3
+
+
+class TestLarsExclude:
+    def test_exclude_from_weight_decay(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.optimizer import LarsMomentum
+
+        def run(exclude):
+            # grad NOT proportional to p, else the trust ratio cancels
+            # the decay exactly
+            p = paddle.Parameter(np.array([1.0, 2.0, 3.0, 4.0], np.float32),
+                                 name="bn_scale")
+            p.stop_gradient = False
+            opt = LarsMomentum(learning_rate=0.1, momentum=0.0,
+                               lars_weight_decay=0.5, parameters=[p],
+                               exclude_from_weight_decay=exclude)
+            p.grad = paddle.to_tensor(np.full((4,), 1.0, np.float32))
+            opt.step()
+            return p.numpy()
+
+        with_decay = run([])
+        without = run(["bn_"])
+        assert not np.allclose(with_decay, without)
+        # the functional path must honor the same exclusion
+        opt = LarsMomentum(exclude_from_weight_decay=["bn_"],
+                           lars_weight_decay=0.5)
+        assert opt._wd_for_key("bn_scale") == 0.0
+        assert opt._wd_for_key("fc.weight") == 0.5

@@ -1,0 +1,179 @@
+"""paddle.vision: transforms, model zoo forwards + training smoke,
+datasets (FakeData + local-format readers)."""
+
+import gzip
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms as T
+
+
+class TestTransforms:
+    def test_to_tensor_and_normalize(self):
+        img = (np.arange(2 * 3 * 3) % 255).astype(np.uint8).reshape(3, 3, 2)
+        t = T.ToTensor()(img)
+        assert tuple(t.shape) == (2, 3, 3)
+        assert float(t.numpy().max()) <= 1.0
+        n = T.Normalize(mean=[0.5, 0.5], std=[0.5, 0.5])(t)
+        np.testing.assert_allclose(n.numpy(), (t.numpy() - 0.5) / 0.5,
+                                   rtol=1e-6)
+
+    def test_resize_and_crops(self):
+        img = np.zeros((10, 20, 3), np.uint8)
+        assert T.resize(img, (5, 8)).shape == (5, 8, 3)
+        assert T.resize(img, 5).shape == (5, 10, 3)  # short side to 5
+        assert T.center_crop(img, 6).shape == (6, 6, 3)
+        assert T.crop(img, 1, 2, 3, 4).shape == (3, 4, 3)
+        rc = T.RandomCrop(8)(img)
+        assert rc.shape == (8, 8, 3)
+
+    def test_flips_and_pad(self):
+        img = np.arange(12, dtype=np.float32).reshape(2, 3, 2)
+        np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+        np.testing.assert_array_equal(T.vflip(img), img[::-1])
+        assert T.pad(img, 2).shape == (6, 7, 2)
+
+    def test_compose_pipeline(self):
+        pipe = T.Compose([
+            T.Resize((8, 8)), T.RandomHorizontalFlip(0.0),
+            T.ToTensor(), T.Normalize([0.5] * 3, [0.5] * 3)])
+        out = pipe(np.zeros((16, 16, 3), np.uint8))
+        assert tuple(out.shape) == (3, 8, 8)
+
+
+class TestModels:
+    def test_lenet_forward(self):
+        paddle.seed(0)
+        m = paddle.vision.LeNet()
+        x = paddle.to_tensor(np.random.randn(2, 1, 28, 28).astype("float32"))
+        assert tuple(m(x).shape) == (2, 10)
+
+    def test_resnet18_forward_and_param_count(self):
+        paddle.seed(0)
+        m = paddle.vision.resnet18(num_classes=10)
+        n = sum(int(np.prod(p.shape)) for p in m.parameters())
+        assert 11.1e6 < n < 11.3e6, n  # torchvision resnet18(10cls) ~11.18M
+        m.eval()
+        x = paddle.to_tensor(np.random.randn(2, 3, 32, 32).astype("float32"))
+        assert tuple(m(x).shape) == (2, 10)
+
+    def test_resnet50_param_count(self):
+        paddle.seed(0)
+        m = paddle.vision.resnet50(num_classes=1000)
+        n = sum(int(np.prod(p.shape)) for p in m.parameters())
+        assert 25.0e6 < n < 26.0e6, n  # ~25.56M
+
+    def test_vgg16_structure(self):
+        paddle.seed(0)
+        m = paddle.vision.vgg16(num_classes=10)
+        convs = [l for l in m.features.sublayers()
+                 if type(l).__name__ == "Conv2D"]
+        assert len(convs) == 13
+
+    def test_mobilenetv2_forward(self):
+        paddle.seed(0)
+        m = paddle.vision.mobilenet_v2(num_classes=7)
+        m.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 32, 32).astype("float32"))
+        assert tuple(m(x).shape) == (1, 7)
+
+    def test_pretrained_rejected(self):
+        with pytest.raises(ValueError, match="egress"):
+            paddle.vision.resnet18(pretrained=True)
+
+    def test_resnet_trains(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.hapi import TrainStep
+
+        paddle.seed(3)
+        m = paddle.vision.ResNet(
+            paddle.vision.models.BasicBlock, [1, 1, 1, 1], num_classes=4)
+        opt = paddle.optimizer.Momentum(0.01, parameters=m.parameters())
+
+        def loss_fn(logits, y):
+            return F.cross_entropy(paddle.Tensor(logits),
+                                   paddle.Tensor(y))._value
+
+        step = TrainStep(m, opt, loss_fn=loss_fn)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((8, 3, 32, 32)).astype(
+            np.float32))
+        y = paddle.to_tensor(rng.integers(0, 4, (8,)).astype(np.int32))
+        losses = [float(step(x, y)) for _ in range(6)]
+        assert losses[-1] < losses[0], losses
+
+
+class TestDatasets:
+    def test_fake_data_with_transform(self):
+        ds = paddle.vision.datasets.FakeData(
+            size=10, image_shape=(3, 8, 8), num_classes=4)
+        img, label = ds[3]
+        assert img.shape == (3, 8, 8) and 0 <= label < 4
+        assert len(ds) == 10
+        a1, _ = paddle.vision.datasets.FakeData(size=10)[0]
+        a2, _ = paddle.vision.datasets.FakeData(size=10)[0]
+        np.testing.assert_array_equal(a1, a2)  # deterministic
+
+    def test_mnist_reads_idx(self, tmp_path):
+        imgs = np.arange(4 * 28 * 28, dtype=np.uint8).reshape(4, 28, 28)
+        labels = np.array([1, 2, 3, 4], np.uint8)
+        ip = str(tmp_path / "img.idx3.gz")
+        lp = str(tmp_path / "lab.idx1.gz")
+        with gzip.open(ip, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 4, 28, 28))
+            f.write(imgs.tobytes())
+        with gzip.open(lp, "wb") as f:
+            f.write(struct.pack(">II", 2049, 4))
+            f.write(labels.tobytes())
+        ds = paddle.vision.datasets.MNIST(image_path=ip, label_path=lp)
+        assert len(ds) == 4
+        img, lab = ds[2]
+        np.testing.assert_array_equal(img, imgs[2])
+        assert lab == 3
+
+    def test_cifar10_reads_tar(self, tmp_path):
+        import io
+        import pickle
+
+        rng = np.random.default_rng(0)
+        batch = {b"data": rng.integers(0, 256, (5, 3072)).astype(np.uint8),
+                 b"labels": [0, 1, 2, 3, 4]}
+        tar_path = str(tmp_path / "cifar-10-python.tar.gz")
+        with tarfile.open(tar_path, "w:gz") as tar:
+            blob = pickle.dumps(batch)
+            info = tarfile.TarInfo("cifar-10-batches-py/data_batch_1")
+            info.size = len(blob)
+            tar.addfile(info, io.BytesIO(blob))
+        ds = paddle.vision.datasets.Cifar10(data_file=tar_path, mode="train")
+        assert len(ds) == 5
+        img, lab = ds[1]
+        assert img.shape == (3, 32, 32) and lab == 1
+
+    def test_download_rejected(self):
+        with pytest.raises(ValueError, match="egress|download"):
+            paddle.vision.datasets.MNIST(download=True)
+
+
+class TestTransformDtypeHygiene:
+    def test_resize_preserves_uint8(self):
+        img = np.full((16, 16, 3), 200, np.uint8)
+        out = T.resize(img, (8, 8))
+        assert out.dtype == np.uint8
+        t = T.Compose([T.Resize((8, 8)), T.ToTensor()])(img)
+        assert float(t.numpy().max()) <= 1.0  # /255 still applied
+
+    def test_brightness_preserves_uint8(self):
+        img = np.full((4, 4, 3), 100, np.uint8)
+        out = T.adjust_brightness(img, 1.5)
+        assert out.dtype == np.uint8
+        t = T.Compose([T.BrightnessTransform(0.0), T.ToTensor()])(img)
+        np.testing.assert_allclose(t.numpy(), 100 / 255.0, rtol=1e-5)
+
+    def test_random_crop_pad_if_needed_widens(self):
+        img = np.zeros((20, 10, 3), np.uint8)
+        out = T.RandomCrop((20, 20), pad_if_needed=True)(img)
+        assert out.shape == (20, 20, 3)
