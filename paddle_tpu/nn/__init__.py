@@ -19,4 +19,8 @@ from .layers.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder,
     TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
 )
+from .layers.rnn import (  # noqa: F401
+    RNN, BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNNCellBase, SimpleRNN,
+    SimpleRNNCell,
+)
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401

@@ -1,0 +1,409 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native design: the reference dispatches to a cuDNN fused RNN kernel
+(paddle/phi/kernels/gpu/rnn_kernel.cu) or a per-step dygraph loop. Here every
+(layer, direction) is ONE ``lax.scan`` over time — a single XLA while-loop
+whose body is two MXU matmuls — recorded on the eager tape as one op
+(``core/tensor.py::apply_op``), so it is differentiable eagerly and traces to
+one fused loop under ``jit``. Variable-length batches use masked carries
+instead of packed sequences (static shapes for XLA): steps at ``t >=
+sequence_length`` keep the previous state and emit zero output, which
+reproduces the reference's padded-sequence semantics for both directions.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op
+from .. import functional as F
+from .. import initializer as I
+from ..layer import Layer
+from ..param_attr import ParamAttr
+from ...ops import manipulation as _manip
+
+
+def _scan_rnn(name, step, n_state, x, states, params, sequence_length,
+              is_reverse, time_major):
+    """Run one scan over time for one (layer, direction).
+
+    ``step(ps, x_t, states) -> (out_t, new_states)`` is a pure jax function;
+    carries update only where ``t < sequence_length`` and masked steps emit
+    zeros, so a reverse-direction scan walking t = T-1..0 consumes exactly the
+    valid suffix-reversed sequence (reference semantics for padded batches).
+    """
+    n_par = len(params)
+    has_len = sequence_length is not None
+
+    def fn(xv, *rest, is_reverse=False, time_major=False):
+        st = tuple(rest[:n_state])
+        ps = tuple(rest[n_state:n_state + n_par])
+        sl = rest[n_state + n_par] if has_len else None
+        xs = xv if time_major else jnp.swapaxes(xv, 0, 1)  # [T, B, I]
+        ts = jnp.arange(xs.shape[0])
+        if is_reverse:
+            xs, ts = xs[::-1], ts[::-1]
+
+        def body(carry, xt_t):
+            xt, t = xt_t
+            out, new = step(ps, xt, carry)
+            if sl is not None:
+                m = (t < sl)[:, None]
+                new = tuple(jnp.where(m, n, c) for n, c in zip(new, carry))
+                out = jnp.where(m, out, jnp.zeros_like(out))
+            return new, out
+
+        final, outs = jax.lax.scan(body, st, (xs, ts))
+        if is_reverse:
+            outs = outs[::-1]
+        if not time_major:
+            outs = jnp.swapaxes(outs, 0, 1)
+        return (outs,) + tuple(final)
+
+    res = apply_op(name, fn, x, *states, *params,
+                   *((sequence_length,) if has_len else ()),
+                   is_reverse=is_reverse, time_major=time_major)
+    return res[0], tuple(res[1:])
+
+
+class RNNCellBase(Layer):
+    """Base for single-step recurrent cells
+    (reference: python/paddle/nn/layer/rnn.py::RNNCellBase)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        shapes = self.state_shape
+        if isinstance(shapes[0], (tuple, list)):
+            return tuple(
+                Tensor(jnp.full((batch,) + tuple(s), init_value,
+                                dtype or jnp.float32), stop_gradient=True)
+                for s in shapes)
+        return Tensor(jnp.full((batch,) + tuple(shapes), init_value,
+                               dtype or jnp.float32), stop_gradient=True)
+
+    def _make_params(self, input_size, hidden_size, n_gates,
+                     weight_ih_attr=None, weight_hh_attr=None,
+                     bias_ih_attr=None, bias_hh_attr=None):
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        g = n_gates * hidden_size
+        self.weight_ih = self.create_parameter(
+            (g, input_size), attr=ParamAttr._to_attr(weight_ih_attr),
+            default_initializer=None if weight_ih_attr else init)
+        self.weight_hh = self.create_parameter(
+            (g, hidden_size), attr=ParamAttr._to_attr(weight_hh_attr),
+            default_initializer=None if weight_hh_attr else init)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter(
+            (g,), attr=ParamAttr._to_attr(bias_ih_attr), is_bias=True,
+            default_initializer=None if bias_ih_attr else init)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter(
+            (g,), attr=ParamAttr._to_attr(bias_hh_attr), is_bias=True,
+            default_initializer=None if bias_hh_attr else init)
+
+    def _param_tuple(self):
+        return (self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+
+    def _forward_one_step(self, inputs, states):
+        st = states if isinstance(states, (tuple, list)) else (states,)
+        n_state = len(st)
+        step = self._step_fn
+
+        def fn(xv, *rest):
+            out, new = step(tuple(rest[n_state:]), xv, tuple(rest[:n_state]))
+            return (out,) + tuple(new)
+
+        res = apply_op(self._op_name, fn, inputs, *st, *self._param_tuple())
+        new = tuple(res[1:])
+        return res[0], (new if len(new) > 1 else new[0])
+
+
+def _gates(ps, xt, h):
+    w_ih, w_hh, b_ih, b_hh = ps
+    g = xt @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        g = g + b_ih
+    if b_hh is not None:
+        g = g + b_hh
+    return g
+
+
+class SimpleRNNCell(RNNCellBase):
+    """h' = act(W_ih x + b_ih + W_hh h + b_hh)."""
+
+    _op_name = "simple_rnn_cell"
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if activation not in ("tanh", "relu"):
+            raise ValueError(
+                f"activation must be tanh or relu, got {activation}")
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        self._make_params(input_size, hidden_size, 1, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+        # static activation choice baked into the jax step
+        self._step_fn = (SimpleRNNCell._step_tanh if activation == "tanh"
+                         else SimpleRNNCell._step_relu)
+
+    @staticmethod
+    def _step_tanh(ps, xt, states):
+        h = jnp.tanh(_gates(ps, xt, states[0]))
+        return h, (h,)
+
+    @staticmethod
+    def _step_relu(ps, xt, states):
+        h = jax.nn.relu(_gates(ps, xt, states[0]))
+        return h, (h,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out, new = self._forward_one_step(inputs, states)
+        return out, new
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def extra_repr(self):
+        return (f"{self.input_size}, {self.hidden_size}"
+                + (f", activation={self.activation}"
+                   if self.activation != "tanh" else ""))
+
+
+class LSTMCell(RNNCellBase):
+    """Gate order [i, f, g, o] matching the reference (and cuDNN/torch)."""
+
+    _op_name = "lstm_cell"
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self._make_params(input_size, hidden_size, 4, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+        self._step_fn = LSTMCell._jax_step
+
+    @staticmethod
+    def _jax_step(ps, xt, states):
+        h, c = states
+        i, f, g, o = jnp.split(_gates(ps, xt, h), 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c2 = f * c + i * jnp.tanh(g)
+        h2 = o * jnp.tanh(c2)
+        return h2, (h2, c2)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        return self._forward_one_step(inputs, states)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class GRUCell(RNNCellBase):
+    """Gate order [r, z, c]; h' = z * h + (1 - z) * c (reference formula)."""
+
+    _op_name = "gru_cell"
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self._make_params(input_size, hidden_size, 3, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+        self._step_fn = GRUCell._jax_step
+
+    @staticmethod
+    def _jax_step(ps, xt, states):
+        w_ih, w_hh, b_ih, b_hh = ps
+        h = states[0]
+        xg = xt @ w_ih.T + (b_ih if b_ih is not None else 0.0)
+        hg = h @ w_hh.T + (b_hh if b_hh is not None else 0.0)
+        xr, xz, xc = jnp.split(xg, 3, axis=-1)
+        hr, hz, hc = jnp.split(hg, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        c = jnp.tanh(xc + r * hc)
+        h2 = (h - c) * z + c
+        return h2, (h2,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out, new = self._forward_one_step(inputs, states)
+        return out, new
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class RNN(Layer):
+    """Wrap a cell into a scanner over time
+    (reference: python/paddle/nn/layer/rnn.py::RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            initial_states = self.cell.get_initial_states(
+                inputs, batch_dim_idx=1 if self.time_major else 0)
+        st = (initial_states if isinstance(initial_states, (tuple, list))
+              else (initial_states,))
+        outs, final = _scan_rnn(
+            f"{self.cell._op_name}_scan", self.cell._step_fn, len(st),
+            inputs, st, self.cell._param_tuple(), sequence_length,
+            self.is_reverse, self.time_major)
+        return outs, (final if len(final) > 1 else final[0])
+
+
+class BiRNN(Layer):
+    """Run two cells over opposite directions, concat outputs
+    (reference: python/paddle/nn/layer/rnn.py::BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw, self.cell_bw = cell_fw, cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            initial_states = (None, None)
+        rnn_fw = RNN(self.cell_fw, False, self.time_major)
+        rnn_bw = RNN(self.cell_bw, True, self.time_major)
+        out_fw, st_fw = rnn_fw(inputs, initial_states[0], sequence_length)
+        out_bw, st_bw = rnn_bw(inputs, initial_states[1], sequence_length)
+        return _manip.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer, optionally bidirectional stack; one scan per
+    (layer, direction). Final states stack as [L * D, B, H] in layer-major,
+    direction-minor order (reference layout)."""
+
+    _CELL = None
+    _N_STATE = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None,
+                 **cell_kwargs):
+        super().__init__()
+        if direction in ("bidirectional", "bidirect"):
+            self.num_directions = 2
+        elif direction == "forward":
+            self.num_directions = 1
+        else:
+            raise ValueError(
+                f"direction must be forward or bidirect, got {direction!r}")
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        attrs = dict(weight_ih_attr=weight_ih_attr,
+                     weight_hh_attr=weight_hh_attr,
+                     bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+        from ..layer import LayerList
+        self._cells = LayerList()
+        for layer in range(num_layers):
+            in_size = (input_size if layer == 0
+                       else hidden_size * self.num_directions)
+            for _ in range(self.num_directions):
+                self._cells.append(
+                    type(self)._CELL(in_size, hidden_size, **cell_kwargs,
+                                     **attrs))
+
+    def _zeros_state(self, batch, dtype):
+        n = self.num_layers * self.num_directions
+        z = Tensor(jnp.zeros((n, batch, self.hidden_size), dtype),
+                   stop_gradient=True)
+        return z
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        batch = inputs.shape[1 if self.time_major else 0]
+        dtype = inputs._value.dtype
+        if initial_states is None:
+            if self._N_STATE == 2:
+                initial_states = (self._zeros_state(batch, dtype),
+                                  self._zeros_state(batch, dtype))
+            else:
+                initial_states = self._zeros_state(batch, dtype)
+        init = (initial_states if isinstance(initial_states, (tuple, list))
+                else (initial_states,))
+
+        x = inputs
+        finals = []  # one tuple of states per (layer, direction)
+        for layer in range(self.num_layers):
+            outs_dir = []
+            for d in range(self.num_directions):
+                idx = layer * self.num_directions + d
+                cell = self._cells[idx]
+                st = tuple(s[idx] for s in init)
+                outs, final = _scan_rnn(
+                    f"{cell._op_name}_scan", cell._step_fn, self._N_STATE,
+                    x, st,
+                    cell._param_tuple(), sequence_length, d == 1,
+                    self.time_major)
+                outs_dir.append(outs)
+                finals.append(final)
+            x = (outs_dir[0] if self.num_directions == 1
+                 else _manip.concat(outs_dir, axis=-1))
+            if self.dropout > 0.0 and layer < self.num_layers - 1:
+                x = F.dropout(x, p=self.dropout, training=self.training)
+
+        stacked = tuple(
+            _manip.stack([f[k] for f in finals], axis=0)
+            for k in range(self._N_STATE))
+        return x, (stacked if self._N_STATE > 1 else stacked[0])
+
+    def extra_repr(self):
+        s = f"{self.input_size}, {self.hidden_size}"
+        if self.num_layers != 1:
+            s += f", num_layers={self.num_layers}"
+        if self.num_directions == 2:
+            s += ", direction=bidirect"
+        return s
+
+
+class SimpleRNN(_RNNBase):
+    _CELL = SimpleRNNCell
+    _N_STATE = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation=activation, **kwargs)
+
+
+class LSTM(_RNNBase):
+    _CELL = LSTMCell
+    _N_STATE = 2
+
+
+class GRU(_RNNBase):
+    _CELL = GRUCell
+    _N_STATE = 1

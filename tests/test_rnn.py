@@ -1,0 +1,202 @@
+"""RNN/LSTM/GRU layers: parity vs torch's cuDNN-convention RNNs, masking,
+autograd, and jit tracing (reference test model: test/legacy_test/test_rnn_op.py
+and test/rnn/test_rnn_nets.py — numpy/torch reference + grad checks)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+torch = pytest.importorskip("torch")
+
+
+def _copy_from_torch(cells, t_rnn, num_layers, bidirectional):
+    n_dir = 2 if bidirectional else 1
+    for layer in range(num_layers):
+        for d in range(n_dir):
+            cell = cells[layer * n_dir + d]
+            sfx = f"_l{layer}" + ("_reverse" if d == 1 else "")
+            for ours, theirs in (("weight_ih", f"weight_ih{sfx}"),
+                                 ("weight_hh", f"weight_hh{sfx}"),
+                                 ("bias_ih", f"bias_ih{sfx}"),
+                                 ("bias_hh", f"bias_hh{sfx}")):
+                val = getattr(t_rnn, theirs).detach().numpy()
+                getattr(cell, ours).set_value(val)
+
+
+@pytest.mark.parametrize("kind", ["rnn", "lstm", "gru"])
+@pytest.mark.parametrize("bidirectional", [False, True])
+def test_matches_torch(kind, bidirectional):
+    B, T, I, H, L = 3, 7, 5, 8, 2
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((B, T, I)).astype(np.float32)
+
+    direction = "bidirect" if bidirectional else "forward"
+    if kind == "rnn":
+        ours = nn.SimpleRNN(I, H, num_layers=L, direction=direction)
+        theirs = torch.nn.RNN(I, H, L, batch_first=True,
+                              bidirectional=bidirectional)
+    elif kind == "lstm":
+        ours = nn.LSTM(I, H, num_layers=L, direction=direction)
+        theirs = torch.nn.LSTM(I, H, L, batch_first=True,
+                               bidirectional=bidirectional)
+    else:
+        ours = nn.GRU(I, H, num_layers=L, direction=direction)
+        theirs = torch.nn.GRU(I, H, L, batch_first=True,
+                              bidirectional=bidirectional)
+    _copy_from_torch(ours._cells, theirs, L, bidirectional)
+
+    out, state = ours(paddle.to_tensor(x))
+    t_out, t_state = theirs(torch.from_numpy(x))
+
+    np.testing.assert_allclose(out.numpy(), t_out.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
+    if kind == "lstm":
+        h, c = state
+        np.testing.assert_allclose(h.numpy(), t_state[0].detach().numpy(),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(c.numpy(), t_state[1].detach().numpy(),
+                                   rtol=1e-5, atol=1e-5)
+    else:
+        np.testing.assert_allclose(state.numpy(),
+                                   t_state.detach().numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_lstm_grads_match_torch():
+    B, T, I, H = 2, 5, 4, 6
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((B, T, I)).astype(np.float32)
+
+    ours = nn.LSTM(I, H)
+    theirs = torch.nn.LSTM(I, H, batch_first=True)
+    _copy_from_torch(ours._cells, theirs, 1, False)
+
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    out, _ = ours(xt)
+    out.sum().backward()
+
+    tx = torch.from_numpy(x).requires_grad_(True)
+    t_out, _ = theirs(tx)
+    t_out.sum().backward()
+
+    np.testing.assert_allclose(xt.grad.numpy(), tx.grad.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    cell = ours._cells[0]
+    np.testing.assert_allclose(
+        cell.weight_ih.grad.numpy(),
+        theirs.weight_ih_l0.grad.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        cell.bias_hh.grad.numpy(),
+        theirs.bias_hh_l0.grad.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_length_masking():
+    """Steps past each row's length keep state and emit zeros."""
+    B, T, I, H = 3, 6, 4, 5
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((B, T, I)).astype(np.float32)
+    lens = np.array([6, 3, 1], dtype=np.int32)
+
+    m = nn.GRU(I, H)
+    out, h = m(paddle.to_tensor(x),
+               sequence_length=paddle.to_tensor(lens))
+    o = out.numpy()
+    # masked tail is exactly zero
+    assert np.all(o[1, 3:] == 0.0) and np.all(o[2, 1:] == 0.0)
+    # final state equals the last valid step's output
+    np.testing.assert_allclose(h.numpy()[0, 1], o[1, 2], rtol=1e-6)
+    np.testing.assert_allclose(h.numpy()[0, 2], o[2, 0], rtol=1e-6)
+    # and the valid prefix matches an unmasked run on the truncated input
+    out_trunc, _ = m(paddle.to_tensor(x[1:2, :3]))
+    np.testing.assert_allclose(o[1, :3], out_trunc.numpy()[0],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_reverse_respects_sequence_length():
+    """Reverse direction consumes only the valid suffix, reversed — i.e.
+    out[t=0] of the bw direction has seen the whole valid sequence."""
+    B, T, I, H = 2, 5, 3, 4
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((B, T, I)).astype(np.float32)
+    lens = np.array([5, 3], dtype=np.int32)
+
+    cell = nn.GRUCell(I, H)
+    r = nn.RNN(cell, is_reverse=True)
+    out, h = r(paddle.to_tensor(x), sequence_length=paddle.to_tensor(lens))
+
+    # row 1: same as reversing its 3 valid steps only
+    out1, h1 = r(paddle.to_tensor(x[1:2, :3]))
+    np.testing.assert_allclose(out.numpy()[1, :3], out1.numpy()[0],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(h.numpy()[1], h1.numpy()[0],
+                               rtol=1e-5, atol=1e-6)
+    assert np.all(out.numpy()[1, 3:] == 0.0)
+
+
+def test_cells_single_step_and_initial_states():
+    B, I, H = 4, 3, 6
+    rng = np.random.default_rng(4)
+    x = paddle.to_tensor(rng.standard_normal((B, I)).astype(np.float32))
+
+    lstm = nn.LSTMCell(I, H)
+    out, (h, c) = lstm(x)
+    assert out.shape == [B, H] and h.shape == [B, H] and c.shape == [B, H]
+    np.testing.assert_allclose(out.numpy(), h.numpy())
+
+    gru = nn.GRUCell(I, H)
+    out2, h2 = gru(x)
+    assert out2.shape == [B, H]
+    np.testing.assert_allclose(out2.numpy(), h2.numpy())
+
+    srn = nn.SimpleRNNCell(I, H, activation="relu")
+    out3, h3 = srn(x)
+    assert np.all(out3.numpy() >= 0)
+
+
+def test_birnn_wrapper():
+    B, T, I, H = 2, 4, 3, 5
+    rng = np.random.default_rng(5)
+    x = paddle.to_tensor(rng.standard_normal((B, T, I)).astype(np.float32))
+    bi = nn.BiRNN(nn.LSTMCell(I, H), nn.LSTMCell(I, H))
+    out, (st_fw, st_bw) = bi(x)
+    assert out.shape == [B, T, 2 * H]
+    assert st_fw[0].shape == [B, H] and st_bw[1].shape == [B, H]
+
+
+def test_time_major_and_dropout_paths():
+    T, B, I, H = 5, 3, 4, 6
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((T, B, I)).astype(np.float32)
+    m = nn.LSTM(I, H, num_layers=2, time_major=True, dropout=0.5)
+    m.eval()  # dropout off: result must equal the no-dropout stack
+    out, (h, c) = m(paddle.to_tensor(x))
+    assert out.shape == [T, B, H] and h.shape == [2, B, H]
+    m2 = nn.LSTM(I, H, num_layers=2, time_major=True, dropout=0.0)
+    for c2, c1 in zip(m2._cells, m._cells):
+        for n in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+            getattr(c2, n).set_value(getattr(c1, n).numpy())
+    out2, _ = m2(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), out2.numpy(), rtol=1e-6)
+
+
+def test_lstm_traces_under_jit():
+    import jax
+
+    from paddle_tpu.jit import functional_call
+
+    B, T, I, H = 2, 6, 4, 8
+    m = nn.LSTM(I, H)
+    params, buffers = m.raw_state()
+    x = np.random.default_rng(7).standard_normal((B, T, I)).astype(np.float32)
+
+    def fwd(params, xv):
+        out, _ = functional_call(
+            m, params, paddle.to_tensor(xv), buffers=buffers)
+        return out.value if hasattr(out, "value") else out
+
+    eager_out, _ = m(paddle.to_tensor(x))
+    jit_out = jax.jit(fwd)(params, x)
+    np.testing.assert_allclose(np.asarray(jit_out), eager_out.numpy(),
+                               rtol=1e-5, atol=1e-6)
