@@ -36,7 +36,15 @@ class Fleet:
              strategy: Optional[DistributedStrategy] = None):
         if role_maker is None:
             from .role_maker import PaddleCloudRoleMaker
-            role_maker = PaddleCloudRoleMaker(is_collective=is_collective)
+            try:
+                role_maker = PaddleCloudRoleMaker(is_collective=is_collective)
+            except ValueError as e:
+                # stale/inconsistent PADDLE_* env outside a launch-CLI job
+                # must not break single-process init (reference behavior)
+                import warnings
+                warnings.warn(f"ignoring inconsistent PADDLE_* env: {e}")
+                from .role_maker import UserDefinedRoleMaker
+                role_maker = UserDefinedRoleMaker(current_id=0, worker_num=1)
         self._role_maker = role_maker
         if strategy is None:
             strategy = DistributedStrategy()
@@ -58,10 +66,21 @@ class Fleet:
         return self._hcg
 
     def worker_index(self) -> int:
+        # the role maker carries the job-level identity (multi-host rank);
+        # the hcg is mesh-local and single-controller
+        rm = getattr(self, "_role_maker", None)
+        if rm is not None:
+            return rm.worker_index()
         return (self._hcg.global_rank if self._hcg else 0)
 
     def worker_num(self) -> int:
+        rm = getattr(self, "_role_maker", None)
+        if rm is not None and rm.worker_num() > 1:
+            return rm.worker_num()
         return self._hcg.nranks if self._hcg else 1
+
+    def is_first_worker(self) -> bool:
+        return self.worker_index() == 0
 
     def barrier_worker(self):
         pass  # single controller: nothing to synchronize

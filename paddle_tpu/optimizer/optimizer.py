@@ -304,6 +304,12 @@ class AdamW(Adam):
             return 0.0
         return super()._decay_for(p)
 
+    def _wd_for_key(self, key):
+        # functional/jit path sees pytree keys (dotted state-dict paths)
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(key):
+            return 0.0
+        return super()._wd_for_key(key)
+
 
 class Adamax(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
@@ -419,6 +425,13 @@ class Lamb(Optimizer):
         if self._exclude_fn is not None and self._exclude_fn(p):
             return 0.0
         return super()._decay_for(p)
+
+    def _wd_for_key(self, key):
+        # functional/jit path has only the pytree key, not the Parameter;
+        # the exclude fn receives the key string there
+        if self._exclude_fn is not None and self._exclude_fn(key):
+            return 0.0
+        return super()._wd_for_key(key)
 
     def apply_one(self, p, g, slots, lr, t, wd):
         g32 = g.astype(jnp.float32)

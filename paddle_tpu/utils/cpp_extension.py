@@ -170,6 +170,10 @@ def _compile(name: str, sources: List[str], extra_cxx_flags,
         if os.path.exists(s):
             with open(s) as f:
                 srcs.append(f.read())
+        elif "\n" not in s and "{" not in s and not any(
+                c.isspace() for c in s):
+            # a single path-like token that doesn't exist: typo'd filename
+            raise FileNotFoundError(f"cpp_extension source not found: {s!r}")
         else:  # inline source string
             srcs.append(s)
     blob = "\n".join(srcs)

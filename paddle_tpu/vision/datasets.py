@@ -138,9 +138,8 @@ class Cifar10(Dataset):
         with tarfile.open(data_file, "r:*") as tar:
             for member in tar.getmembers():
                 base = os.path.basename(member.name)
-                take = (base.startswith("data_batch") if mode == "train"
-                        else base == "test_batch")
-                if not (take and member.name.startswith(self._PREFIX)):
+                if not (self._take(base, mode)
+                        and member.name.startswith(self._PREFIX)):
                     continue
                 batch = pickle.load(tar.extractfile(member),
                                     encoding="bytes")
@@ -150,6 +149,11 @@ class Cifar10(Dataset):
             raise ValueError(f"no {mode} batches found in {data_file}")
         self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
         self.labels = np.asarray(labels, np.int64)
+
+    @staticmethod
+    def _take(base: str, mode: str) -> bool:
+        return (base.startswith("data_batch") if mode == "train"
+                else base == "test_batch")
 
     def __getitem__(self, idx):
         img = self.images[idx]
@@ -162,30 +166,10 @@ class Cifar10(Dataset):
 
 
 class Cifar100(Cifar10):
+    # cifar-100 stores one 'train'/'test' file instead of data_batch_*
     _PREFIX = "cifar-100-python"
     _META_LABEL = b"fine_labels"
 
-    def __init__(self, data_file=None, mode="train", transform=None,
-                 download=False, backend="cv2"):
-        # cifar-100 stores one 'train'/'test' file instead of data_batch_*
-        _no_download(download, "cifar")
-        if data_file is None:
-            raise ValueError("Cifar100 needs data_file (local tar.gz)")
-        if mode not in ("train", "test"):
-            raise ValueError(f"mode must be train|test, got {mode!r}")
-        self.mode = mode
-        self.transform = transform
-        images, labels = [], []
-        with tarfile.open(data_file, "r:*") as tar:
-            for member in tar.getmembers():
-                base = os.path.basename(member.name)
-                if base != mode or not member.name.startswith(self._PREFIX):
-                    continue
-                batch = pickle.load(tar.extractfile(member),
-                                    encoding="bytes")
-                images.append(np.asarray(batch[b"data"], np.uint8))
-                labels.extend(batch[self._META_LABEL])
-        if not images:
-            raise ValueError(f"no {mode} file found in {data_file}")
-        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
-        self.labels = np.asarray(labels, np.int64)
+    @staticmethod
+    def _take(base: str, mode: str) -> bool:
+        return base == mode

@@ -80,6 +80,11 @@ def resize(img, size, interpolation: str = "bilinear"):
 
 def crop(img, top: int, left: int, height: int, width: int):
     a = _as_hwc(img)
+    h, w = a.shape[:2]
+    if top < 0 or left < 0 or top + height > h or left + width > w:
+        raise ValueError(
+            f"crop region ({top},{left})+({height},{width}) exceeds image "
+            f"size ({h},{w})")
     return a[top:top + height, left:left + width]
 
 
@@ -89,6 +94,9 @@ def center_crop(img, output_size):
         output_size = (output_size, output_size)
     th, tw = output_size
     h, w = a.shape[:2]
+    if th > h or tw > w:
+        raise ValueError(
+            f"center_crop size {(th, tw)} larger than image {(h, w)}")
     return crop(a, (h - th) // 2, (w - tw) // 2, th, tw)
 
 
