@@ -126,7 +126,8 @@ def _run_bench() -> dict:
     opt = paddle.optimizer.AdamW(
         1e-4, parameters=model.parameters(), weight_decay=0.01,
         multi_precision=on_tpu)
-    step = TrainStep(model, opt)
+    step = TrainStep(model, opt,
+                     remat=os.environ.get("BENCH_REMAT", "0") == "1")
 
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1))
@@ -249,8 +250,10 @@ def _decode_bench(model, cfg, paddle, jax) -> dict:
     prompt = paddle.to_tensor(
         rng.integers(0, cfg.vocab_size, (1, 16)).astype(np.int32))
     model.eval()
-    # warmup (compile)
-    out = model.generate(prompt, max_new_tokens=8, do_sample=False)
+    # warmup MUST use the same max_new_tokens: the jit signature includes
+    # the scan length, so a different value compiles a different program
+    # and the timed run would measure XLA compilation
+    out = model.generate(prompt, max_new_tokens=steps, do_sample=False)
     jax.block_until_ready(out.value if hasattr(out, "value") else out)
     t0 = time.perf_counter()
     out = model.generate(prompt, max_new_tokens=steps, do_sample=False)

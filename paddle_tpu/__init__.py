@@ -40,6 +40,7 @@ from . import static  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import vision  # noqa: F401
+from . import distribution  # noqa: F401
 from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import metric  # noqa: F401

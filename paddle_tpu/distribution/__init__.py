@@ -1,0 +1,1038 @@
+"""paddle.distribution — probability distributions
+(reference: python/paddle/distribution/).
+
+TPU-native design: every density/entropy/KL is a pure jax function recorded
+on the eager tape through ``apply_op`` (differentiable w.r.t. distribution
+parameters, traces under jit); sampling draws keys from the framework RNG
+(``framework/random.py``) and uses jax.random's native samplers — including
+the implicitly-reparameterized gamma/beta/dirichlet samplers, so ``rsample``
+gradients flow where the reference only offers score-function estimates.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..core.tensor import Tensor, apply_op, _val
+from ..framework.random import next_key
+
+__all__ = [
+    "Distribution", "Normal", "LogNormal", "Uniform", "Bernoulli",
+    "Binomial", "Categorical", "Multinomial", "Beta", "Dirichlet",
+    "Exponential", "Gamma", "Geometric", "Gumbel", "Laplace", "Poisson",
+    "StudentT", "Cauchy", "Independent", "TransformedDistribution",
+    "kl_divergence", "register_kl",
+]
+
+
+def _param(x, dtype=jnp.float32):
+    """Accept Tensor / array / python scalar; keep Tensors on the tape."""
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, dtype), stop_gradient=True)
+
+
+def _shape(s) -> Tuple[int, ...]:
+    if s is None:
+        return ()
+    if isinstance(s, int):
+        return (s,)
+    return tuple(int(v) for v in s)
+
+
+class Distribution:
+    """Base class (reference: python/paddle/distribution/distribution.py)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        t = self.rsample(shape)
+        return Tensor(_val(t), stop_gradient=True)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no reparameterized sampler")
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply_op(f"{type(self).__name__}_prob".lower(),
+                        lambda lp: jnp.exp(lp), self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other) -> Tensor:
+        return kl_divergence(self, other)
+
+    def _extend(self, shape):
+        return _shape(shape) + self._batch_shape + self._event_shape
+
+
+# --------------------------------------------------------------------- KL
+_KL_REGISTRY: Dict[Tuple[Type, Type], callable] = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Decorator registering a pairwise KL implementation
+    (reference: python/paddle/distribution/kl.py::register_kl)."""
+
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+# ----------------------------------------------------------------- Normal
+class Normal(Distribution):
+    """N(loc, scale) (reference: python/paddle/distribution/normal.py)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        shape = np.broadcast_shapes(tuple(self.loc.shape),
+                                    tuple(self.scale.shape))
+        super().__init__(shape, ())
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return apply_op("normal_var", lambda s: s * s, self.scale)
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(next_key(), self._extend(shape))
+        return apply_op("normal_rsample",
+                        lambda l, s: l + s * eps, self.loc, self.scale)
+
+    def log_prob(self, value):
+        return apply_op(
+            "normal_log_prob",
+            lambda v, l, s: (-((v - l) ** 2) / (2 * s * s)
+                             - jnp.log(s) - 0.5 * math.log(2 * math.pi)),
+            _param(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply_op(
+            "normal_entropy",
+            lambda l, s: jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                jnp.broadcast_shapes(l.shape, s.shape)),
+            self.loc, self.scale)
+
+    def cdf(self, value):
+        return apply_op(
+            "normal_cdf",
+            lambda v, l, s: 0.5 * (1 + jsp.erf((v - l) / (s * math.sqrt(2)))),
+            _param(value), self.loc, self.scale)
+
+    def icdf(self, value):
+        return apply_op(
+            "normal_icdf",
+            lambda v, l, s: l + s * math.sqrt(2) * jsp.erfinv(2 * v - 1),
+            _param(value), self.loc, self.scale)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    return apply_op(
+        "kl_normal_normal",
+        lambda pl, ps, ql, qs: (jnp.log(qs / ps)
+                                + (ps * ps + (pl - ql) ** 2) / (2 * qs * qs)
+                                - 0.5),
+        p.loc, p.scale, q.loc, q.scale)
+
+
+class LogNormal(Distribution):
+    """exp(N(loc, scale))
+    (reference: python/paddle/distribution/lognormal.py)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(self._base.batch_shape, ())
+
+    @property
+    def mean(self):
+        return apply_op("lognormal_mean",
+                        lambda l, s: jnp.exp(l + s * s / 2),
+                        self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return apply_op(
+            "lognormal_var",
+            lambda l, s: (jnp.exp(s * s) - 1) * jnp.exp(2 * l + s * s),
+            self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        z = self._base.rsample(shape)
+        return apply_op("lognormal_rsample", jnp.exp, z)
+
+    def log_prob(self, value):
+        v = _param(value)
+        return apply_op(
+            "lognormal_log_prob",
+            lambda v, l, s: (-((jnp.log(v) - l) ** 2) / (2 * s * s)
+                             - jnp.log(v * s) - 0.5 * math.log(2 * math.pi)),
+            v, self.loc, self.scale)
+
+    def entropy(self):
+        return apply_op(
+            "lognormal_entropy",
+            lambda l, s: jnp.broadcast_to(
+                l + 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                jnp.broadcast_shapes(l.shape, s.shape)),
+            self.loc, self.scale)
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    return _kl_normal_normal(p._base, q._base)
+
+
+# ---------------------------------------------------------------- Uniform
+class Uniform(Distribution):
+    """U[low, high) (reference: python/paddle/distribution/uniform.py)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _param(low)
+        self.high = _param(high)
+        shape = np.broadcast_shapes(tuple(self.low.shape),
+                                    tuple(self.high.shape))
+        super().__init__(shape, ())
+
+    @property
+    def mean(self):
+        return apply_op("uniform_mean", lambda a, b: (a + b) / 2,
+                        self.low, self.high)
+
+    @property
+    def variance(self):
+        return apply_op("uniform_var", lambda a, b: (b - a) ** 2 / 12,
+                        self.low, self.high)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(next_key(), self._extend(shape))
+        return apply_op("uniform_rsample",
+                        lambda a, b: a + (b - a) * u, self.low, self.high)
+
+    def log_prob(self, value):
+        return apply_op(
+            "uniform_log_prob",
+            lambda v, a, b: jnp.where((v >= a) & (v < b), -jnp.log(b - a),
+                                      -jnp.inf),
+            _param(value), self.low, self.high)
+
+    def entropy(self):
+        return apply_op("uniform_entropy", lambda a, b: jnp.log(b - a),
+                        self.low, self.high)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return apply_op(
+        "kl_uniform_uniform",
+        lambda pa, pb, qa, qb: jnp.where(
+            (qa <= pa) & (pb <= qb),
+            jnp.log((qb - qa) / (pb - pa)), jnp.inf),
+        p.low, p.high, q.low, q.high)
+
+
+# -------------------------------------------------------------- Bernoulli
+class Bernoulli(Distribution):
+    """(reference: python/paddle/distribution/bernoulli.py)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _param(probs)
+        super().__init__(tuple(self.probs.shape), ())
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return apply_op("bernoulli_var", lambda p: p * (1 - p), self.probs)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(next_key(), self._extend(shape))
+        out = (u < _val(self.probs)).astype(jnp.float32)
+        return Tensor(out, stop_gradient=True)
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax relaxation (the reference's rsample contract)."""
+        u = jax.random.uniform(next_key(), self._extend(shape),
+                               minval=1e-6, maxval=1 - 1e-6)
+        return apply_op(
+            "bernoulli_rsample",
+            lambda p: jax.nn.sigmoid(
+                (jnp.log(p) - jnp.log1p(-p)
+                 + jnp.log(u) - jnp.log1p(-u)) / temperature),
+            self.probs)
+
+    def log_prob(self, value):
+        return apply_op(
+            "bernoulli_log_prob",
+            lambda v, p: v * jnp.log(p) + (1 - v) * jnp.log1p(-p),
+            _param(value), self.probs)
+
+    def entropy(self):
+        return apply_op(
+            "bernoulli_entropy",
+            lambda p: -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)),
+            self.probs)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    return apply_op(
+        "kl_bernoulli_bernoulli",
+        lambda pp, qp: (pp * (jnp.log(pp) - jnp.log(qp))
+                        + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp))),
+        p.probs, q.probs)
+
+
+# ------------------------------------------------------------- Categorical
+class Categorical(Distribution):
+    """Takes unnormalized ``logits`` whose softmax are the class probs
+    (reference: python/paddle/distribution/categorical.py)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _param(logits)
+        shape = tuple(self.logits.shape)
+        super().__init__(shape[:-1], ())
+        self._n = shape[-1]
+
+    @property
+    def probs_tensor(self):
+        return apply_op("categorical_probs",
+                        lambda lg: jax.nn.softmax(lg, -1), self.logits)
+
+    def sample(self, shape=()):
+        idx = jax.random.categorical(
+            next_key(), _val(self.logits),
+            shape=_shape(shape) + self._batch_shape)
+        return Tensor(idx, stop_gradient=True)
+
+    def log_prob(self, value):
+        return apply_op(
+            "categorical_log_prob",
+            lambda v, lg: jnp.take_along_axis(
+                jax.nn.log_softmax(lg, -1),
+                v.astype(jnp.int32)[..., None], axis=-1)[..., 0],
+            _param(value, jnp.int32), self.logits)
+
+    def probs(self, value):
+        return apply_op("categorical_probs_of",
+                        lambda lp: jnp.exp(lp), self.log_prob(value))
+
+    def entropy(self):
+        return apply_op(
+            "categorical_entropy",
+            lambda lg: -jnp.sum(jax.nn.softmax(lg, -1)
+                                * jax.nn.log_softmax(lg, -1), -1),
+            self.logits)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    return apply_op(
+        "kl_categorical_categorical",
+        lambda pl, ql: jnp.sum(
+            jax.nn.softmax(pl, -1)
+            * (jax.nn.log_softmax(pl, -1) - jax.nn.log_softmax(ql, -1)), -1),
+        p.logits, q.logits)
+
+
+# ------------------------------------------------------------- Multinomial
+class Multinomial(Distribution):
+    """(reference: python/paddle/distribution/multinomial.py)."""
+
+    def __init__(self, total_count: int, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _param(probs)
+        shape = tuple(self.probs.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return apply_op("multinomial_mean",
+                        lambda p: self.total_count * p, self.probs)
+
+    @property
+    def variance(self):
+        return apply_op("multinomial_var",
+                        lambda p: self.total_count * p * (1 - p), self.probs)
+
+    def sample(self, shape=()):
+        logits = jnp.log(_val(self.probs))
+        draws = jax.random.categorical(
+            next_key(), logits,
+            shape=(self.total_count,) + _shape(shape) + self._batch_shape)
+        counts = jax.nn.one_hot(draws, self._event_shape[0]).sum(0)
+        return Tensor(counts, stop_gradient=True)
+
+    def log_prob(self, value):
+        return apply_op(
+            "multinomial_log_prob",
+            lambda v, p: (jsp.gammaln(jnp.asarray(self.total_count + 1.0))
+                          - jnp.sum(jsp.gammaln(v + 1.0), -1)
+                          + jnp.sum(v * jnp.log(p), -1)),
+            _param(value), self.probs)
+
+    def entropy(self):
+        # no closed form; Monte-Carlo estimate matches reference docs
+        samples = self.sample((64,))
+        lp = self.log_prob(samples)
+        return apply_op("multinomial_entropy",
+                        lambda l: -jnp.mean(l, axis=0), lp)
+
+
+# ------------------------------------------------------- Beta / Dirichlet
+class Beta(Distribution):
+    """(reference: python/paddle/distribution/beta.py). ``rsample`` uses
+    jax's implicitly-differentiated gamma sampler."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _param(alpha)
+        self.beta = _param(beta)
+        shape = np.broadcast_shapes(tuple(self.alpha.shape),
+                                    tuple(self.beta.shape))
+        super().__init__(shape, ())
+
+    @property
+    def mean(self):
+        return apply_op("beta_mean", lambda a, b: a / (a + b),
+                        self.alpha, self.beta)
+
+    @property
+    def variance(self):
+        return apply_op(
+            "beta_var",
+            lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+            self.alpha, self.beta)
+
+    def rsample(self, shape=()):
+        k1, k2 = jax.random.split(next_key())
+        ext = self._extend(shape)
+
+        def fn(a, b):
+            ga = jax.random.gamma(k1, jnp.broadcast_to(a, ext))
+            gb = jax.random.gamma(k2, jnp.broadcast_to(b, ext))
+            return ga / (ga + gb)
+
+        return apply_op("beta_rsample", fn, self.alpha, self.beta)
+
+    def log_prob(self, value):
+        return apply_op(
+            "beta_log_prob",
+            lambda v, a, b: ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                             - (jsp.gammaln(a) + jsp.gammaln(b)
+                                - jsp.gammaln(a + b))),
+            _param(value), self.alpha, self.beta)
+
+    def entropy(self):
+        def fn(a, b):
+            lbeta = jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+            return (lbeta - (a - 1) * jsp.digamma(a)
+                    - (b - 1) * jsp.digamma(b)
+                    + (a + b - 2) * jsp.digamma(a + b))
+
+        return apply_op("beta_entropy", fn, self.alpha, self.beta)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def fn(pa, pb, qa, qb):
+        lbeta_p = jsp.gammaln(pa) + jsp.gammaln(pb) - jsp.gammaln(pa + pb)
+        lbeta_q = jsp.gammaln(qa) + jsp.gammaln(qb) - jsp.gammaln(qa + qb)
+        return (lbeta_q - lbeta_p
+                + (pa - qa) * jsp.digamma(pa)
+                + (pb - qb) * jsp.digamma(pb)
+                + (qa - pa + qb - pb) * jsp.digamma(pa + pb))
+
+    return apply_op("kl_beta_beta", fn, p.alpha, p.beta, q.alpha, q.beta)
+
+
+class Dirichlet(Distribution):
+    """(reference: python/paddle/distribution/dirichlet.py)."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _param(concentration)
+        shape = tuple(self.concentration.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return apply_op("dirichlet_mean",
+                        lambda c: c / jnp.sum(c, -1, keepdims=True),
+                        self.concentration)
+
+    @property
+    def variance(self):
+        def fn(c):
+            c0 = jnp.sum(c, -1, keepdims=True)
+            m = c / c0
+            return m * (1 - m) / (c0 + 1)
+
+        return apply_op("dirichlet_var", fn, self.concentration)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        ext = _shape(shape) + self._batch_shape + self._event_shape
+
+        def fn(c):
+            g = jax.random.gamma(key, jnp.broadcast_to(c, ext))
+            return g / jnp.sum(g, -1, keepdims=True)
+
+        return apply_op("dirichlet_rsample", fn, self.concentration)
+
+    def log_prob(self, value):
+        return apply_op(
+            "dirichlet_log_prob",
+            lambda v, c: (jnp.sum((c - 1) * jnp.log(v), -1)
+                          + jsp.gammaln(jnp.sum(c, -1))
+                          - jnp.sum(jsp.gammaln(c), -1)),
+            _param(value), self.concentration)
+
+    def entropy(self):
+        def fn(c):
+            c0 = jnp.sum(c, -1)
+            k = c.shape[-1]
+            return (jnp.sum(jsp.gammaln(c), -1) - jsp.gammaln(c0)
+                    + (c0 - k) * jsp.digamma(c0)
+                    - jnp.sum((c - 1) * jsp.digamma(c), -1))
+
+        return apply_op("dirichlet_entropy", fn, self.concentration)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    def fn(pc, qc):
+        p0 = jnp.sum(pc, -1)
+        return (jsp.gammaln(p0) - jnp.sum(jsp.gammaln(pc), -1)
+                - jsp.gammaln(jnp.sum(qc, -1))
+                + jnp.sum(jsp.gammaln(qc), -1)
+                + jnp.sum((pc - qc) * (jsp.digamma(pc)
+                                       - jsp.digamma(p0)[..., None]), -1))
+
+    return apply_op("kl_dirichlet_dirichlet", fn,
+                    p.concentration, q.concentration)
+
+
+# ------------------------------------------------- Exponential-family rest
+class Exponential(Distribution):
+    """rate-parameterized (reference:
+    python/paddle/distribution/exponential.py)."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _param(rate)
+        super().__init__(tuple(self.rate.shape), ())
+
+    @property
+    def mean(self):
+        return apply_op("exponential_mean", lambda r: 1.0 / r, self.rate)
+
+    @property
+    def variance(self):
+        return apply_op("exponential_var", lambda r: 1.0 / (r * r), self.rate)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(next_key(), self._extend(shape),
+                               minval=1e-12, maxval=1.0)
+        return apply_op("exponential_rsample",
+                        lambda r: -jnp.log(u) / r, self.rate)
+
+    def log_prob(self, value):
+        return apply_op("exponential_log_prob",
+                        lambda v, r: jnp.log(r) - r * v,
+                        _param(value), self.rate)
+
+    def entropy(self):
+        return apply_op("exponential_entropy",
+                        lambda r: 1.0 - jnp.log(r), self.rate)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    return apply_op(
+        "kl_exp_exp",
+        lambda pr, qr: jnp.log(pr) - jnp.log(qr) + qr / pr - 1.0,
+        p.rate, q.rate)
+
+
+class Gamma(Distribution):
+    """concentration/rate (reference: python/paddle/distribution/gamma.py)."""
+
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _param(concentration)
+        self.rate = _param(rate)
+        shape = np.broadcast_shapes(tuple(self.concentration.shape),
+                                    tuple(self.rate.shape))
+        super().__init__(shape, ())
+
+    @property
+    def mean(self):
+        return apply_op("gamma_mean", lambda c, r: c / r,
+                        self.concentration, self.rate)
+
+    @property
+    def variance(self):
+        return apply_op("gamma_var", lambda c, r: c / (r * r),
+                        self.concentration, self.rate)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        ext = self._extend(shape)
+
+        def fn(c, r):
+            return jax.random.gamma(key, jnp.broadcast_to(c, ext)) / r
+
+        return apply_op("gamma_rsample", fn, self.concentration, self.rate)
+
+    def log_prob(self, value):
+        return apply_op(
+            "gamma_log_prob",
+            lambda v, c, r: (c * jnp.log(r) + (c - 1) * jnp.log(v) - r * v
+                             - jsp.gammaln(c)),
+            _param(value), self.concentration, self.rate)
+
+    def entropy(self):
+        return apply_op(
+            "gamma_entropy",
+            lambda c, r: (c - jnp.log(r) + jsp.gammaln(c)
+                          + (1 - c) * jsp.digamma(c)),
+            self.concentration, self.rate)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    def fn(pc, pr, qc, qr):
+        return ((pc - qc) * jsp.digamma(pc) - jsp.gammaln(pc)
+                + jsp.gammaln(qc) + qc * (jnp.log(pr) - jnp.log(qr))
+                + pc * (qr - pr) / pr)
+
+    return apply_op("kl_gamma_gamma", fn, p.concentration, p.rate,
+                    q.concentration, q.rate)
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k >= 0
+    (reference: python/paddle/distribution/geometric.py)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _param(probs)
+        super().__init__(tuple(self.probs.shape), ())
+
+    @property
+    def mean(self):
+        return apply_op("geometric_mean", lambda p: (1 - p) / p, self.probs)
+
+    @property
+    def variance(self):
+        return apply_op("geometric_var", lambda p: (1 - p) / (p * p),
+                        self.probs)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(next_key(), self._extend(shape),
+                               minval=1e-12, maxval=1.0)
+        out = jnp.floor(jnp.log(u) / jnp.log1p(-_val(self.probs)))
+        return Tensor(out, stop_gradient=True)
+
+    def log_prob(self, value):
+        return apply_op(
+            "geometric_log_prob",
+            lambda v, p: v * jnp.log1p(-p) + jnp.log(p),
+            _param(value), self.probs)
+
+    def entropy(self):
+        return apply_op(
+            "geometric_entropy",
+            lambda p: -((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p,
+            self.probs)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    return apply_op(
+        "kl_geo_geo",
+        lambda pp, qp: (jnp.log(pp) - jnp.log(qp)
+                        + (1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qp))),
+        p.probs, q.probs)
+
+
+class Gumbel(Distribution):
+    """(reference: python/paddle/distribution/gumbel.py)."""
+
+    _EULER = 0.57721566490153286
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        shape = np.broadcast_shapes(tuple(self.loc.shape),
+                                    tuple(self.scale.shape))
+        super().__init__(shape, ())
+
+    @property
+    def mean(self):
+        return apply_op("gumbel_mean",
+                        lambda l, s: l + s * self._EULER,
+                        self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return apply_op("gumbel_var",
+                        lambda l, s: (math.pi ** 2 / 6) * s * s
+                        + jnp.zeros_like(l),
+                        self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        g = jax.random.gumbel(next_key(), self._extend(shape))
+        return apply_op("gumbel_rsample", lambda l, s: l + s * g,
+                        self.loc, self.scale)
+
+    def log_prob(self, value):
+        def fn(v, l, s):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+
+        return apply_op("gumbel_log_prob", fn, _param(value),
+                        self.loc, self.scale)
+
+    def entropy(self):
+        return apply_op(
+            "gumbel_entropy",
+            lambda l, s: jnp.broadcast_to(jnp.log(s) + 1 + self._EULER,
+                                          jnp.broadcast_shapes(l.shape,
+                                                               s.shape)),
+            self.loc, self.scale)
+
+
+class Laplace(Distribution):
+    """(reference: python/paddle/distribution/laplace.py)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        shape = np.broadcast_shapes(tuple(self.loc.shape),
+                                    tuple(self.scale.shape))
+        super().__init__(shape, ())
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return apply_op("laplace_var", lambda s: 2 * s * s, self.scale)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(next_key(), self._extend(shape),
+                               minval=-0.5 + 1e-7, maxval=0.5)
+        return apply_op(
+            "laplace_rsample",
+            lambda l, s: l - s * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u)),
+            self.loc, self.scale)
+
+    def log_prob(self, value):
+        return apply_op(
+            "laplace_log_prob",
+            lambda v, l, s: -jnp.abs(v - l) / s - jnp.log(2 * s),
+            _param(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply_op(
+            "laplace_entropy",
+            lambda l, s: jnp.broadcast_to(
+                1 + jnp.log(2 * s), jnp.broadcast_shapes(l.shape, s.shape)),
+            self.loc, self.scale)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    def fn(pl, ps, ql, qs):
+        d = jnp.abs(pl - ql)
+        return (jnp.log(qs) - jnp.log(ps)
+                + d / qs + ps / qs * jnp.exp(-d / ps) - 1)
+
+    return apply_op("kl_laplace_laplace", fn, p.loc, p.scale, q.loc, q.scale)
+
+
+class Poisson(Distribution):
+    """(reference: python/paddle/distribution/poisson.py)."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _param(rate)
+        super().__init__(tuple(self.rate.shape), ())
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        out = jax.random.poisson(next_key(), _val(self.rate),
+                                 self._extend(shape))
+        return Tensor(out.astype(jnp.float32), stop_gradient=True)
+
+    def log_prob(self, value):
+        return apply_op(
+            "poisson_log_prob",
+            lambda v, r: v * jnp.log(r) - r - jsp.gammaln(v + 1),
+            _param(value), self.rate)
+
+    def entropy(self):
+        # series approximation matching the reference implementation style:
+        # exact for the Monte-Carlo tail via log_prob on sampled support
+        ks = jnp.arange(0, 64, dtype=jnp.float32)
+
+        def fn(r):
+            lp = (ks[..., None] * jnp.log(r) - r
+                  - jsp.gammaln(ks[..., None] + 1))
+            return -jnp.sum(jnp.exp(lp) * lp, axis=0)
+
+        return apply_op("poisson_entropy", fn, self.rate)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    return apply_op(
+        "kl_poisson_poisson",
+        lambda pr, qr: pr * (jnp.log(pr) - jnp.log(qr)) - pr + qr,
+        p.rate, q.rate)
+
+
+class Binomial(Distribution):
+    """(reference: python/paddle/distribution/binomial.py)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _param(total_count)
+        self.probs = _param(probs)
+        shape = np.broadcast_shapes(tuple(self.total_count.shape),
+                                    tuple(self.probs.shape))
+        super().__init__(shape, ())
+
+    @property
+    def mean(self):
+        return apply_op("binomial_mean", lambda n, p: n * p,
+                        self.total_count, self.probs)
+
+    @property
+    def variance(self):
+        return apply_op("binomial_var", lambda n, p: n * p * (1 - p),
+                        self.total_count, self.probs)
+
+    def sample(self, shape=()):
+        out = jax.random.binomial(
+            next_key(), _val(self.total_count).astype(jnp.float32),
+            _val(self.probs), shape=self._extend(shape))
+        return Tensor(out, stop_gradient=True)
+
+    def log_prob(self, value):
+        def fn(v, n, p):
+            return (jsp.gammaln(n + 1) - jsp.gammaln(v + 1)
+                    - jsp.gammaln(n - v + 1)
+                    + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+        return apply_op("binomial_log_prob", fn, _param(value),
+                        self.total_count, self.probs)
+
+
+class StudentT(Distribution):
+    """(reference: python/paddle/distribution/student_t.py)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _param(df)
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        shape = np.broadcast_shapes(tuple(self.df.shape),
+                                    tuple(self.loc.shape),
+                                    tuple(self.scale.shape))
+        super().__init__(shape, ())
+
+    @property
+    def mean(self):
+        return apply_op(
+            "studentt_mean",
+            lambda d, l: jnp.where(d > 1, l, jnp.nan), self.df, self.loc)
+
+    @property
+    def variance(self):
+        def fn(d, s):
+            v = jnp.where(d > 2, s * s * d / (d - 2), jnp.inf)
+            return jnp.where(d > 1, v, jnp.nan)
+
+        return apply_op("studentt_var", fn, self.df, self.scale)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        ext = self._extend(shape)
+
+        def fn(d, l, s):
+            t = jax.random.t(key, jnp.broadcast_to(d, ext))
+            return l + s * t
+
+        return apply_op("studentt_rsample", fn, self.df, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def fn(v, d, l, s):
+            z = (v - l) / s
+            return (jsp.gammaln((d + 1) / 2) - jsp.gammaln(d / 2)
+                    - 0.5 * jnp.log(d * math.pi) - jnp.log(s)
+                    - (d + 1) / 2 * jnp.log1p(z * z / d))
+
+        return apply_op("studentt_log_prob", fn, _param(value),
+                        self.df, self.loc, self.scale)
+
+    def entropy(self):
+        def fn(d, s):
+            return ((d + 1) / 2 * (jsp.digamma((d + 1) / 2)
+                                   - jsp.digamma(d / 2))
+                    + 0.5 * jnp.log(d)
+                    + jsp.betaln(d / 2, jnp.asarray(0.5)) + jnp.log(s))
+
+        return apply_op("studentt_entropy", fn, self.df, self.scale)
+
+
+class Cauchy(Distribution):
+    """(reference: python/paddle/distribution/cauchy.py)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        shape = np.broadcast_shapes(tuple(self.loc.shape),
+                                    tuple(self.scale.shape))
+        super().__init__(shape, ())
+
+    def rsample(self, shape=()):
+        c = jax.random.cauchy(next_key(), self._extend(shape))
+        return apply_op("cauchy_rsample", lambda l, s: l + s * c,
+                        self.loc, self.scale)
+
+    def log_prob(self, value):
+        return apply_op(
+            "cauchy_log_prob",
+            lambda v, l, s: (-math.log(math.pi) - jnp.log(s)
+                             - jnp.log1p(((v - l) / s) ** 2)),
+            _param(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply_op(
+            "cauchy_entropy",
+            lambda l, s: jnp.broadcast_to(
+                jnp.log(4 * math.pi * s),
+                jnp.broadcast_shapes(l.shape, s.shape)),
+            self.loc, self.scale)
+
+    def cdf(self, value):
+        return apply_op(
+            "cauchy_cdf",
+            lambda v, l, s: jnp.arctan((v - l) / s) / math.pi + 0.5,
+            _param(value), self.loc, self.scale)
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy_cauchy(p, q):
+    def fn(pl, ps, ql, qs):
+        return (jnp.log(((ps + qs) ** 2 + (pl - ql) ** 2)
+                        / (4 * ps * qs)))
+
+    return apply_op("kl_cauchy_cauchy", fn, p.loc, p.scale, q.loc, q.scale)
+
+
+# ----------------------------------------------------------- combinators
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims
+    (reference: python/paddle/distribution/independent.py)."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_rank: int):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        super().__init__(bs[:len(bs) - self._rank],
+                         bs[len(bs) - self._rank:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        axes = tuple(range(-self._rank, 0)) if self._rank else ()
+        if not axes:
+            return lp
+        return apply_op("independent_log_prob",
+                        lambda l: jnp.sum(l, axis=axes), lp)
+
+    def entropy(self):
+        ent = self.base.entropy()
+        axes = tuple(range(-self._rank, 0)) if self._rank else ()
+        if not axes:
+            return ent
+        return apply_op("independent_entropy",
+                        lambda e: jnp.sum(e, axis=axes), ent)
+
+
+from .transform import (  # noqa: E402,F401
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    PowerTransform, SigmoidTransform, SoftmaxTransform, StickBreakingTransform,
+    TanhTransform, Transform, TransformedDistribution,
+)
+
+__all__ += [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "PowerTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StickBreakingTransform", "TanhTransform",
+]

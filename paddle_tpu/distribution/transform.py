@@ -1,0 +1,253 @@
+"""Bijective transforms + TransformedDistribution
+(reference: python/paddle/distribution/transform.py,
+transformed_distribution.py).
+
+Each transform supplies forward / inverse / forward_log_det_jacobian as pure
+jax functions; ``TransformedDistribution.log_prob`` composes them through the
+eager tape so parameter gradients flow.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, _val
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "PowerTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StickBreakingTransform", "TanhTransform", "TransformedDistribution",
+]
+
+
+class Transform:
+    """Base transform: y = f(x), with log|det J_f(x)|."""
+
+    #: how many trailing event dims the jacobian couples (0 = elementwise)
+    _event_rank = 0
+
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        """forward log det jacobian at x (elementwise, pre-reduction)."""
+        raise NotImplementedError
+
+    # public API mirrors the reference naming
+    def forward(self, x):
+        return apply_op(f"{type(self).__name__}_fwd".lower(),
+                        self._forward, x)
+
+    def inverse(self, y):
+        return apply_op(f"{type(self).__name__}_inv".lower(),
+                        self._inverse, y)
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(f"{type(self).__name__}_fldj".lower(), self._fldj, x)
+
+    def inverse_log_det_jacobian(self, y):
+        return apply_op(
+            f"{type(self).__name__}_ildj".lower(),
+            lambda yv: -self._fldj(self._inverse(yv)), y)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # a right-inverse, matching the reference
+
+    def _fldj(self, x):
+        raise NotImplementedError("AbsTransform is not injective")
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(_val(loc), jnp.float32)
+        self.scale = jnp.asarray(_val(scale), jnp.float32)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = jnp.asarray(_val(power), jnp.float32)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        # log(1 - tanh^2 x) = 2 (log 2 - x - softplus(-2x)), numerically safe
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """Not bijective on R^k; operates on the last axis like the reference."""
+
+    _event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        return jnp.log(y)  # a right-inverse up to additive constant
+
+    def _fldj(self, x):
+        raise NotImplementedError("SoftmaxTransform has no square jacobian")
+
+
+class StickBreakingTransform(Transform):
+    """R^{k} -> simplex^{k+1} via stick breaking (last axis)."""
+
+    _event_rank = 1
+
+    def _forward(self, x):
+        offset = jnp.arange(x.shape[-1], 0, -1, dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zp = jnp.concatenate(
+            [jnp.ones_like(z[..., :1]),
+             jnp.cumprod(1 - z, axis=-1)], axis=-1)
+        return jnp.concatenate(
+            [z, jnp.ones_like(z[..., :1])], axis=-1) * zp
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        offset = jnp.arange(k, 0, -1, dtype=y.dtype)
+        rem = 1.0 - jnp.cumsum(y[..., :-1], axis=-1)
+        rem = jnp.concatenate([jnp.ones_like(y[..., :1]), rem[..., :-1]],
+                              axis=-1)
+        z = y[..., :-1] / rem
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _fldj(self, x):
+        # jacobian is triangular: log|det| = sum_i log(z_i (1-z_i) rem_i)
+        offset = jnp.arange(x.shape[-1], 0, -1, dtype=x.dtype)
+        t = x - jnp.log(offset)
+        z = jax.nn.sigmoid(t)
+        rem = jnp.concatenate(
+            [jnp.ones_like(z[..., :1]),
+             jnp.cumprod(1 - z, axis=-1)[..., :-1]], axis=-1)
+        return jnp.sum(-jax.nn.softplus(-t) - jax.nn.softplus(t)
+                       + jnp.log(rem), axis=-1)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._event_rank = max((t._event_rank for t in self.transforms),
+                               default=0)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class TransformedDistribution:
+    """base distribution pushed through a chain of transforms
+    (reference: python/paddle/distribution/transformed_distribution.py)."""
+
+    def __init__(self, base, transforms):
+        from . import Distribution
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transform = ChainTransform(transforms)
+        self._batch_shape = base.batch_shape
+        self._event_shape = base.event_shape
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return Tensor(self.transform._forward(_val(x)), stop_gradient=True)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return apply_op("transformed_rsample", self.transform._forward, x)
+
+    def log_prob(self, value):
+        def fn(yv):
+            xv = self.transform._inverse(yv)
+            base_lp = _val(self.base.log_prob(Tensor(xv,
+                                                     stop_gradient=True)))
+            ldj = self.transform._fldj(xv)
+            if self.transform._event_rank and ldj.ndim > base_lp.ndim:
+                ldj = jnp.sum(
+                    ldj, axis=tuple(range(-self.transform._event_rank, 0)))
+            return base_lp - ldj
+
+        # differentiate w.r.t. value through the tape; base-parameter grads
+        # flow through the inner log_prob's own tape ops
+        return apply_op("transformed_log_prob", fn,
+                        value if isinstance(value, Tensor)
+                        else Tensor(jnp.asarray(value, jnp.float32),
+                                    stop_gradient=True))

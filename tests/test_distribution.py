@@ -1,0 +1,238 @@
+"""paddle.distribution: log_prob/entropy/KL parity vs torch.distributions,
+sample-moment checks, gradient flow, transforms
+(reference test model: test/distribution/test_distribution_*.py — numpy and
+scipy reference implementations)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+torch = pytest.importorskip("torch")
+td = torch.distributions
+
+RTOL, ATOL = 1e-4, 1e-5
+
+
+def _t(x):
+    return torch.from_numpy(np.asarray(x, np.float32))
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(1234)
+
+
+PAIRS = [
+    ("normal", lambda: D.Normal([0.5, -1.0], [1.0, 2.5]),
+     lambda: td.Normal(_t([0.5, -1.0]), _t([1.0, 2.5])),
+     np.array([0.3, 2.0], np.float32)),
+    ("lognormal", lambda: D.LogNormal([0.2, -0.3], [0.8, 1.1]),
+     lambda: td.LogNormal(_t([0.2, -0.3]), _t([0.8, 1.1])),
+     np.array([0.5, 2.3], np.float32)),
+    ("uniform", lambda: D.Uniform([-1.0, 0.0], [2.0, 5.0]),
+     lambda: td.Uniform(_t([-1.0, 0.0]), _t([2.0, 5.0])),
+     np.array([0.5, 4.5], np.float32)),
+    ("bernoulli", lambda: D.Bernoulli([0.3, 0.8]),
+     lambda: td.Bernoulli(_t([0.3, 0.8])),
+     np.array([1.0, 0.0], np.float32)),
+    ("beta", lambda: D.Beta([0.5, 3.0], [0.5, 2.0]),
+     lambda: td.Beta(_t([0.5, 3.0]), _t([0.5, 2.0])),
+     np.array([0.3, 0.7], np.float32)),
+    ("exponential", lambda: D.Exponential([0.5, 2.0]),
+     lambda: td.Exponential(_t([0.5, 2.0])),
+     np.array([1.5, 0.2], np.float32)),
+    ("gamma", lambda: D.Gamma([0.5, 3.0], [1.0, 2.0]),
+     lambda: td.Gamma(_t([0.5, 3.0]), _t([1.0, 2.0])),
+     np.array([0.7, 1.9], np.float32)),
+    ("geometric", lambda: D.Geometric([0.2, 0.7]),
+     lambda: td.Geometric(_t([0.2, 0.7])),
+     np.array([3.0, 0.0], np.float32)),
+    ("gumbel", lambda: D.Gumbel([0.0, 1.0], [1.0, 2.0]),
+     lambda: td.Gumbel(_t([0.0, 1.0]), _t([1.0, 2.0])),
+     np.array([0.5, -0.5], np.float32)),
+    ("laplace", lambda: D.Laplace([0.0, 1.0], [1.0, 0.5]),
+     lambda: td.Laplace(_t([0.0, 1.0]), _t([1.0, 0.5])),
+     np.array([0.4, 2.2], np.float32)),
+    ("poisson", lambda: D.Poisson([1.5, 4.0]),
+     lambda: td.Poisson(_t([1.5, 4.0])),
+     np.array([2.0, 5.0], np.float32)),
+    ("studentt", lambda: D.StudentT([3.0, 7.0], [0.0, 1.0], [1.0, 2.0]),
+     lambda: td.StudentT(_t([3.0, 7.0]), _t([0.0, 1.0]), _t([1.0, 2.0])),
+     np.array([0.8, -1.0], np.float32)),
+    ("cauchy", lambda: D.Cauchy([0.0, 1.0], [1.0, 2.0]),
+     lambda: td.Cauchy(_t([0.0, 1.0]), _t([1.0, 2.0])),
+     np.array([0.5, 3.0], np.float32)),
+]
+
+
+@pytest.mark.parametrize("name,ours,theirs,val",
+                         PAIRS, ids=[p[0] for p in PAIRS])
+def test_log_prob_matches_torch(name, ours, theirs, val):
+    lp = ours().log_prob(paddle.to_tensor(val)).numpy()
+    tlp = theirs().log_prob(_t(val)).numpy()
+    np.testing.assert_allclose(lp, tlp, rtol=RTOL, atol=ATOL)
+
+
+@pytest.mark.parametrize(
+    "name,ours,theirs,val",
+    [p for p in PAIRS if p[0] not in ("poisson", "cauchy")],
+    ids=[p[0] for p in PAIRS if p[0] not in ("poisson", "cauchy")])
+def test_entropy_matches_torch(name, ours, theirs, val):
+    e = ours().entropy().numpy()
+    te = theirs().entropy().numpy()
+    np.testing.assert_allclose(e, te, rtol=RTOL, atol=1e-4)
+
+
+KL_CASES = [
+    ("normal", lambda: (D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)),
+     lambda: (td.Normal(_t(0.0), _t(1.0)), td.Normal(_t(1.0), _t(2.0)))),
+    ("bernoulli", lambda: (D.Bernoulli(0.3), D.Bernoulli(0.6)),
+     lambda: (td.Bernoulli(_t(0.3)), td.Bernoulli(_t(0.6)))),
+    ("beta", lambda: (D.Beta(2.0, 3.0), D.Beta(4.0, 1.5)),
+     lambda: (td.Beta(_t(2.0), _t(3.0)), td.Beta(_t(4.0), _t(1.5)))),
+    ("gamma", lambda: (D.Gamma(2.0, 1.0), D.Gamma(3.0, 2.0)),
+     lambda: (td.Gamma(_t(2.0), _t(1.0)), td.Gamma(_t(3.0), _t(2.0)))),
+    ("exponential", lambda: (D.Exponential(0.5), D.Exponential(2.0)),
+     lambda: (td.Exponential(_t(0.5)), td.Exponential(_t(2.0)))),
+    ("laplace", lambda: (D.Laplace(0.0, 1.0), D.Laplace(0.5, 2.0)),
+     lambda: (td.Laplace(_t(0.0), _t(1.0)), td.Laplace(_t(0.5), _t(2.0)))),
+    ("poisson", lambda: (D.Poisson(2.0), D.Poisson(5.0)),
+     lambda: (td.Poisson(_t(2.0)), td.Poisson(_t(5.0)))),
+    ("geometric", lambda: (D.Geometric(0.3), D.Geometric(0.6)),
+     lambda: (td.Geometric(_t(0.3)), td.Geometric(_t(0.6)))),
+    ("dirichlet",
+     lambda: (D.Dirichlet([1.0, 2.0, 3.0]), D.Dirichlet([2.0, 1.0, 1.5])),
+     lambda: (td.Dirichlet(_t([1.0, 2.0, 3.0])),
+              td.Dirichlet(_t([2.0, 1.0, 1.5])))),
+    ("categorical",
+     lambda: (D.Categorical([0.1, 0.7, 0.2]), D.Categorical([1.0, 0.0, -1.0])),
+     lambda: (td.Categorical(logits=_t([0.1, 0.7, 0.2])),
+              td.Categorical(logits=_t([1.0, 0.0, -1.0])))),
+]
+
+
+@pytest.mark.parametrize("name,ours,theirs", KL_CASES,
+                         ids=[c[0] for c in KL_CASES])
+def test_kl_matches_torch(name, ours, theirs):
+    p, q = ours()
+    tp, tq = theirs()
+    kl = D.kl_divergence(p, q).numpy()
+    tkl = td.kl_divergence(tp, tq).numpy()
+    np.testing.assert_allclose(kl, tkl, rtol=RTOL, atol=1e-4)
+
+
+def test_sample_moments():
+    n = 20000
+    for dist, mean, std in [
+        (D.Normal(2.0, 3.0), 2.0, 3.0),
+        (D.Uniform(0.0, 4.0), 2.0, 4.0 / np.sqrt(12)),
+        (D.Exponential(2.0), 0.5, 0.5),
+        (D.Gamma(4.0, 2.0), 2.0, 1.0),
+        (D.Laplace(1.0, 2.0), 1.0, np.sqrt(8)),
+        (D.Gumbel(0.0, 1.0), 0.5772, np.pi / np.sqrt(6)),
+    ]:
+        s = dist.sample((n,)).numpy()
+        assert abs(s.mean() - mean) < 5 * std / np.sqrt(n) + 0.02, type(dist)
+        assert abs(s.std() - std) < 0.1 * std + 0.02, type(dist)
+
+
+def test_discrete_samples():
+    s = D.Bernoulli(0.25).sample((10000,)).numpy()
+    assert set(np.unique(s)) <= {0.0, 1.0} and abs(s.mean() - 0.25) < 0.02
+    c = D.Categorical([0.0, 0.0, 10.0]).sample((100,)).numpy()
+    assert np.all(c == 2)
+    m = D.Multinomial(10, [0.2, 0.3, 0.5]).sample((500,)).numpy()
+    assert m.shape == (500, 3) and np.all(m.sum(-1) == 10)
+    np.testing.assert_allclose(m.mean(0), [2, 3, 5], atol=0.3)
+    p = D.Poisson(3.0).sample((10000,)).numpy()
+    assert abs(p.mean() - 3.0) < 0.1
+    b = D.Binomial(np.float32(12), 0.4).sample((5000,)).numpy()
+    assert abs(b.mean() - 4.8) < 0.15 and b.max() <= 12
+
+
+def test_rsample_gradients_flow():
+    # pathwise gradient d E[x]/d loc == 1 for Normal
+    loc = paddle.to_tensor(np.float32(0.7), stop_gradient=False)
+    scale = paddle.to_tensor(np.float32(1.3), stop_gradient=False)
+    d = D.Normal(loc, scale)
+    s = d.rsample((256,))
+    s.mean().backward()
+    np.testing.assert_allclose(loc.grad.numpy(), 1.0, rtol=1e-5)
+
+    # implicit-reparam gamma: grads exist and are finite
+    a = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+    g = D.Gamma(a, 1.0).rsample((64,))
+    g.mean().backward()
+    assert np.isfinite(a.grad.numpy())
+
+
+def test_log_prob_gradients_flow():
+    p = paddle.to_tensor(np.float32(0.4), stop_gradient=False)
+    d = D.Bernoulli(p)
+    lp = d.log_prob(paddle.to_tensor(np.float32(1.0)))
+    lp.backward()
+    np.testing.assert_allclose(p.grad.numpy(), 1 / 0.4, rtol=1e-5)
+
+
+def test_independent_reinterprets_batch():
+    base = D.Normal(np.zeros((3, 4), np.float32), np.ones((3, 4), np.float32))
+    ind = D.Independent(base, 1)
+    assert ind.batch_shape == (3,) and ind.event_shape == (4,)
+    v = np.random.default_rng(0).standard_normal((3, 4)).astype(np.float32)
+    lp = ind.log_prob(paddle.to_tensor(v)).numpy()
+    tlp = td.Independent(td.Normal(torch.zeros(3, 4), torch.ones(3, 4)),
+                         1).log_prob(_t(v)).numpy()
+    np.testing.assert_allclose(lp, tlp, rtol=RTOL, atol=ATOL)
+
+
+def test_transforms_roundtrip_and_ldj():
+    x = np.linspace(-2, 2, 7).astype(np.float32)
+    cases = [
+        (D.ExpTransform(), td.ExpTransform()),
+        (D.SigmoidTransform(), td.SigmoidTransform()),
+        (D.TanhTransform(), td.TanhTransform()),
+        (D.AffineTransform(1.5, -2.0), td.AffineTransform(_t(1.5), _t(-2.0))),
+    ]
+    for ours, theirs in cases:
+        y = ours.forward(paddle.to_tensor(x)).numpy()
+        ty = theirs(_t(x)).numpy()
+        np.testing.assert_allclose(y, ty, rtol=1e-5, atol=1e-6)
+        xr = ours.inverse(paddle.to_tensor(y)).numpy()
+        np.testing.assert_allclose(xr, x, rtol=1e-4, atol=1e-5)
+        ldj = ours.forward_log_det_jacobian(paddle.to_tensor(x)).numpy()
+        tldj = theirs.log_abs_det_jacobian(_t(x), _t(ty)).numpy()
+        np.testing.assert_allclose(ldj, tldj, rtol=1e-4, atol=1e-5)
+
+
+def test_transformed_distribution_log_prob():
+    # LogNormal as TransformedDistribution(Normal, Exp) — closed form check
+    base = D.Normal(0.3, 0.9)
+    tdist = D.TransformedDistribution(base, [D.ExpTransform()])
+    v = np.array([0.5, 1.5, 3.0], np.float32)
+    lp = tdist.log_prob(paddle.to_tensor(v)).numpy()
+    ref = D.LogNormal(0.3, 0.9).log_prob(paddle.to_tensor(v)).numpy()
+    np.testing.assert_allclose(lp, ref, rtol=1e-5, atol=1e-6)
+    s = tdist.sample((1000,)).numpy()
+    assert np.all(s > 0)
+
+
+def test_stick_breaking_transform():
+    x = np.random.default_rng(1).standard_normal((5, 3)).astype(np.float32)
+    t = D.StickBreakingTransform()
+    tt = td.StickBreakingTransform()
+    y = t.forward(paddle.to_tensor(x)).numpy()
+    ty = tt(_t(x)).numpy()
+    np.testing.assert_allclose(y, ty, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-5)
+    xr = t.inverse(paddle.to_tensor(y)).numpy()
+    np.testing.assert_allclose(xr, x, rtol=1e-3, atol=1e-4)
+    ldj = t.forward_log_det_jacobian(paddle.to_tensor(x)).numpy()
+    tldj = tt.log_abs_det_jacobian(_t(x), _t(ty)).numpy()
+    np.testing.assert_allclose(ldj, tldj, rtol=1e-4, atol=1e-5)
+
+
+def test_kl_unregistered_raises():
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(D.Normal(0.0, 1.0), D.Gamma(1.0, 1.0))
