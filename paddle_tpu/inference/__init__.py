@@ -1,0 +1,190 @@
+"""paddle.inference — the deployment predictor facade
+(reference: paddle/fluid/inference/api/analysis_predictor.cc and
+paddle/fluid/inference/api/paddle_inference_api.h, surfaced in Python as
+``paddle.inference.Config`` / ``create_predictor``; SURVEY.md §3.5).
+
+TPU-native design: the reference's AnalysisPredictor loads a serialized
+Program, runs IR fusion/memory passes, and executes on a C++ executor. Here
+the artifact is a ``jit.save`` StableHLO export — XLA *is* the analysis/
+fusion pipeline — and the predictor is a thin named-handle wrapper around
+the deserialized module, jit-cached per input signature. The handle API
+(``get_input_handle().copy_from_cpu(...)``, ``run()``,
+``get_output_handle().copy_to_cpu()``) matches the reference so serving
+code ports unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "PredictorHandle", "create_predictor"]
+
+
+class Config:
+    """(reference: paddle_infer.Config). Accepts the ``jit.save`` artifact
+    prefix — ``Config(prefix)`` or ``Config(model_file, params_file)`` where
+    the reference's two-file form maps onto ``{prefix}.pdmodel`` /
+    ``{prefix}.pdiparams.npz``."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file is None:
+            raise ValueError("Config needs the exported model path prefix")
+        prefix = prog_file
+        for suffix in (".pdmodel", ".json"):
+            if prefix.endswith(suffix):
+                prefix = prefix[: -len(suffix)]
+        self._prefix = prefix
+        self._params_file = params_file
+        self._device = "tpu"
+        self._memory_optim = True
+        self._ir_optim = True
+        self._threads = 1
+
+    def model_path(self) -> str:
+        return self._prefix
+
+    # --- device selection (reference: enable_use_gpu/disable_gpu) ---------
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0):
+        self._device = "tpu"  # accelerator on this build IS the TPU
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self) -> bool:
+        return self._device == "tpu"
+
+    def enable_xpu(self, *a, **k):
+        self._device = "tpu"
+
+    # --- pass toggles: XLA owns fusion; keep the knobs for API parity -----
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = bool(flag)
+
+    def ir_optim(self) -> bool:
+        return self._ir_optim
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = bool(flag)
+
+    def enable_tensorrt_engine(self, *a, **k):
+        raise NotImplementedError(
+            "TensorRT is a CUDA-only subsystem; on TPU the exported module "
+            "is already XLA-compiled (SURVEY.md §7.2 non-goal)")
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._threads = int(n)
+
+    def summary(self) -> str:
+        return (f"Config(prefix={self._prefix!r}, device={self._device}, "
+                f"ir_optim={self._ir_optim})")
+
+
+class PredictorHandle:
+    """Named input/output tensor handle
+    (reference: paddle_infer.Tensor / ZeroCopyTensor)."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._data: Optional[np.ndarray] = None
+
+    def name(self) -> str:
+        return self._name
+
+    def reshape(self, shape):
+        if self._data is None:
+            self._data = np.zeros(tuple(int(s) for s in shape), np.float32)
+        else:
+            self._data = np.resize(self._data,
+                                   tuple(int(s) for s in shape))
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._data = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._data is None:
+            raise RuntimeError(f"handle {self._name!r} has no data; "
+                               f"call run() first")
+        return np.asarray(self._data)
+
+    def shape(self) -> List[int]:
+        return list(self._data.shape) if self._data is not None else []
+
+
+class Predictor:
+    """(reference: paddle_infer.Predictor over AnalysisPredictor)."""
+
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+
+        self._config = config
+        self._layer = jit_load(config.model_path())
+        specs = self._layer.input_specs
+        self._input_names = [
+            s.name or f"input_{i}" for i, s in enumerate(specs)]
+        self._inputs: Dict[str, PredictorHandle] = {
+            n: PredictorHandle(n) for n in self._input_names}
+        self._output_names: List[str] = []
+        self._outputs: Dict[str, PredictorHandle] = {}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> PredictorHandle:
+        if name not in self._inputs:
+            raise KeyError(f"unknown input {name!r}; "
+                           f"inputs are {self._input_names}")
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute the module. Either pre-fill handles and call ``run()``,
+        or pass arrays positionally (they fill the handles first)."""
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(a)
+        args = []
+        for n in self._input_names:
+            h = self._inputs[n]
+            if h._data is None:
+                raise RuntimeError(f"input {n!r} not set; call "
+                                   f"get_input_handle({n!r}).copy_from_cpu()")
+            args.append(h._data)
+        out = self._layer(*args)
+        leaves = out if isinstance(out, (tuple, list)) else [out]
+        self._output_names = [f"output_{i}" for i in range(len(leaves))]
+        self._outputs = {}
+        for name, leaf in zip(self._output_names, leaves):
+            h = PredictorHandle(name)
+            h.copy_from_cpu(np.asarray(
+                leaf.numpy() if hasattr(leaf, "numpy") else leaf))
+            self._outputs[name] = h
+        if inputs is not None:
+            return [self._outputs[n].copy_to_cpu()
+                    for n in self._output_names]
+        return True
+
+    def get_output_names(self) -> List[str]:
+        if not self._output_names:
+            # pre-run: the export doesn't name outputs; run() fills them
+            return []
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> PredictorHandle:
+        if name not in self._outputs:
+            raise KeyError(f"unknown output {name!r} (did run() happen?); "
+                           f"outputs are {self._output_names}")
+        return self._outputs[name]
+
+    def clear_intermediate_tensor(self):
+        pass  # XLA owns buffers; nothing to clear
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

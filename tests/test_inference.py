@@ -1,0 +1,76 @@
+"""paddle.inference predictor facade over jit.save artifacts
+(reference test model: test/ir/inference/ predictor API tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference as paddle_infer
+from paddle_tpu import nn
+from paddle_tpu.static import InputSpec
+
+
+@pytest.fixture
+def artifact(tmp_path):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([None, 8], "float32", name="x")])
+    return net, prefix
+
+
+def test_predictor_handle_api(artifact):
+    net, prefix = artifact
+    config = paddle_infer.Config(prefix)
+    predictor = paddle_infer.create_predictor(config)
+
+    assert predictor.get_input_names() == ["x"]
+    x = np.random.default_rng(0).standard_normal((3, 8)).astype(np.float32)
+    h = predictor.get_input_handle("x")
+    h.reshape([3, 8])
+    h.copy_from_cpu(x)
+    assert predictor.run() is True
+
+    names = predictor.get_output_names()
+    assert len(names) == 1
+    out = predictor.get_output_handle(names[0]).copy_to_cpu()
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_positional_run_and_dynamic_batch(artifact):
+    net, prefix = artifact
+    predictor = paddle_infer.create_predictor(paddle_infer.Config(prefix))
+    for b in (1, 5):
+        x = np.random.default_rng(b).standard_normal((b, 8)).astype(
+            np.float32)
+        outs = predictor.run([x])
+        np.testing.assert_allclose(outs[0], net(paddle.to_tensor(x)).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_config_api_parity(artifact):
+    _, prefix = artifact
+    c = paddle_infer.Config(prefix + ".pdmodel")  # reference two-file form
+    assert c.model_path() == prefix
+    c.disable_gpu()
+    assert not c.use_gpu()
+    c.enable_use_gpu(100, 0)
+    assert c.use_gpu()
+    c.switch_ir_optim(False)
+    assert not c.ir_optim()
+    with pytest.raises(NotImplementedError):
+        c.enable_tensorrt_engine()
+    assert "Config(" in c.summary()
+
+
+def test_errors(artifact):
+    _, prefix = artifact
+    predictor = paddle_infer.create_predictor(paddle_infer.Config(prefix))
+    with pytest.raises(KeyError):
+        predictor.get_input_handle("nope")
+    with pytest.raises(RuntimeError):
+        predictor.run()  # input never set
+    with pytest.raises(ValueError):
+        paddle_infer.Config()
