@@ -42,6 +42,7 @@ from . import signal  # noqa: F401
 from . import vision  # noqa: F401
 from . import distribution  # noqa: F401
 from . import inference  # noqa: F401
+from . import sparse  # noqa: F401
 from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import metric  # noqa: F401

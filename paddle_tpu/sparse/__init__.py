@@ -1,0 +1,330 @@
+"""paddle.sparse — COO/CSR sparse tensors
+(reference: python/paddle/sparse/ — creation.py, binary.py, unary.py,
+nn/functional; the C++ kernels live in paddle/phi/kernels/sparse/).
+
+TPU-native design: sparse storage rides ``jax.experimental.sparse``
+(BCOO/BCSR), whose ops lower to XLA gather/scatter/segment-sum — the TPU has
+no sparse MXU path, so (like the reference's cuSPARSE fallbacks) sparse
+compute is worthwhile for memory, not FLOPs. The facade keeps the reference
+API: ``sparse_coo_tensor(indices, values, shape)`` with ``indices`` of shape
+``[ndim, nnz]``, ``.to_dense()``, ``.indices()/.values()/.crows()/.cols()``,
+elementwise add/subtract/multiply/divide on matching sparsity, ``matmul``
+(sparse @ dense), ``masked_matmul``, and unary math that preserves zeros.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor, _val
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_same_shape", "add", "subtract", "multiply",
+    "divide", "matmul", "masked_matmul", "transpose", "coalesce",
+    "relu", "abs", "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh",
+    "atanh", "sqrt", "square", "log1p", "expm1", "neg", "pow", "cast",
+]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (reference: phi::SparseCooTensor surfaced via
+    paddle.sparse.sparse_coo_tensor)."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._m = bcoo
+
+    # -------------------------------------------------------- inspection
+    @property
+    def shape(self):
+        return list(self._m.shape)
+
+    @property
+    def dtype(self):
+        return self._m.data.dtype
+
+    @property
+    def nnz(self):
+        return int(self._m.nse)
+
+    def indices(self) -> Tensor:
+        # paddle layout: [ndim, nnz]; BCOO stores [nnz, ndim]
+        return Tensor(self._m.indices.T, stop_gradient=True)
+
+    def values(self) -> Tensor:
+        return Tensor(self._m.data, stop_gradient=True)
+
+    def is_sparse(self) -> bool:
+        return True
+
+    def is_sparse_coo(self) -> bool:
+        return True
+
+    def is_sparse_csr(self) -> bool:
+        return False
+
+    # ------------------------------------------------------- conversion
+    def to_dense(self) -> Tensor:
+        return Tensor(self._m.todense(), stop_gradient=True)
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if len(self._m.shape) != 2:
+            raise ValueError("to_sparse_csr needs a 2-D tensor")
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(self._m))
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._m.sum_duplicates())
+
+    # ------------------------------------------------------------- math
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __truediv__(self, other):
+        return divide(self, other)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+    def numpy(self):
+        return np.asarray(self._m.todense())
+
+    def T(self):
+        return transpose(self, list(range(len(self.shape)))[::-1])
+
+    def astype(self, dtype):
+        return cast(self, dtype)
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (2-D) (reference: phi::SparseCsrTensor)."""
+
+    def __init__(self, bcsr: jsparse.BCSR):
+        self._m = bcsr
+
+    @property
+    def shape(self):
+        return list(self._m.shape)
+
+    @property
+    def dtype(self):
+        return self._m.data.dtype
+
+    @property
+    def nnz(self):
+        return int(self._m.nse)
+
+    def crows(self) -> Tensor:
+        return Tensor(self._m.indptr, stop_gradient=True)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._m.indices, stop_gradient=True)
+
+    def values(self) -> Tensor:
+        return Tensor(self._m.data, stop_gradient=True)
+
+    def is_sparse(self) -> bool:
+        return True
+
+    def is_sparse_coo(self) -> bool:
+        return False
+
+    def is_sparse_csr(self) -> bool:
+        return True
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._m.todense(), stop_gradient=True)
+
+    def to_sparse_coo(self, sparse_dim: Optional[int] = None):
+        return SparseCooTensor(self._m.to_bcoo())
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+    def numpy(self):
+        return np.asarray(self._m.todense())
+
+
+# ------------------------------------------------------------- creation
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCooTensor:
+    """(reference: python/paddle/sparse/creation.py::sparse_coo_tensor).
+    ``indices``: [ndim, nnz]; ``values``: [nnz, ...dense dims]."""
+    idx = jnp.asarray(_val(indices), jnp.int32)
+    val = jnp.asarray(_val(values))
+    if dtype is not None:
+        from ..core.dtype import to_jax_dtype
+        val = val.astype(to_jax_dtype(dtype))
+    if idx.ndim != 2:
+        raise ValueError(f"indices must be [ndim, nnz], got {idx.shape}")
+    if shape is None:
+        shape = tuple(int(i) for i in (idx.max(axis=1) + 1))
+    m = jsparse.BCOO((val, idx.T), shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(m)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCsrTensor:
+    """(reference: python/paddle/sparse/creation.py::sparse_csr_tensor)."""
+    indptr = jnp.asarray(_val(crows), jnp.int32)
+    indices = jnp.asarray(_val(cols), jnp.int32)
+    val = jnp.asarray(_val(values))
+    if dtype is not None:
+        from ..core.dtype import to_jax_dtype
+        val = val.astype(to_jax_dtype(dtype))
+    m = jsparse.BCSR((val, indices, indptr),
+                     shape=tuple(int(s) for s in shape))
+    return SparseCsrTensor(m)
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+def _coo(x) -> jsparse.BCOO:
+    if isinstance(x, SparseCooTensor):
+        return x._m
+    if isinstance(x, SparseCsrTensor):
+        return x._m.to_bcoo()
+    raise TypeError(f"expected a sparse tensor, got {type(x)}")
+
+
+def _wrap_like(x, m: jsparse.BCOO):
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(m))
+    return SparseCooTensor(m)
+
+
+# ---------------------------------------------------------------- binary
+def _ew(name, fn, x, y):
+    """Elementwise op on two same-shape sparse tensors (union support) or
+    sparse ⊕ dense scalar."""
+    if isinstance(y, (int, float)):
+        m = _coo(x)
+        return _wrap_like(x, jsparse.BCOO((fn(m.data, y), m.indices),
+                                          shape=m.shape))
+    mx, my = _coo(x), _coo(y)
+    if tuple(mx.shape) != tuple(my.shape):
+        raise ValueError(f"{name}: shape mismatch {mx.shape} vs {my.shape}")
+    # union of supports via concatenation + sum_duplicates keeps COO form
+    if name in ("add", "subtract"):
+        data_y = my.data if name == "add" else -my.data
+        m = jsparse.BCOO(
+            (jnp.concatenate([mx.data, data_y]),
+             jnp.concatenate([mx.indices, my.indices])),
+            shape=mx.shape).sum_duplicates()
+        return _wrap_like(x, m)
+    # multiply/divide need aligned supports: densify the rhs (documented
+    # scope: the reference's sparse*sparse also requires same sparsity)
+    dy = my.todense()
+    vals = fn(mx.data, dy[tuple(mx.indices.T)])
+    return _wrap_like(x, jsparse.BCOO((vals, mx.indices), shape=mx.shape))
+
+
+def add(x, y):
+    return _ew("add", jnp.add, x, y)
+
+
+def subtract(x, y):
+    return _ew("subtract", jnp.subtract, x, y)
+
+
+def multiply(x, y):
+    return _ew("multiply", jnp.multiply, x, y)
+
+
+def divide(x, y):
+    return _ew("divide", jnp.divide, x, y)
+
+
+def matmul(x, y):
+    """sparse @ dense -> dense Tensor
+    (reference: python/paddle/sparse/matmul — spmm)."""
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        # sparse @ sparse: densify the smaller side (XLA has no spgemm)
+        y = y.to_dense()
+    yv = _val(y)
+    m = x._m if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else None
+    if m is None:
+        raise TypeError("matmul lhs must be sparse")
+    return Tensor(m @ jnp.asarray(yv), stop_gradient=True)
+
+
+def masked_matmul(x, y, mask):
+    """dense @ dense, sampled at ``mask``'s sparsity (SDDMM)
+    (reference: paddle.sparse.masked_matmul)."""
+    xv, yv = jnp.asarray(_val(x)), jnp.asarray(_val(y))
+    mm = _coo(mask)
+    rows, cols = mm.indices[:, 0], mm.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+    return _wrap_like(mask, jsparse.BCOO((vals, mm.indices), shape=mm.shape))
+
+
+def transpose(x, perm: Sequence[int]):
+    m = _coo(x)
+    return _wrap_like(x, m.transpose(tuple(perm)))
+
+
+def coalesce(x):
+    return SparseCooTensor(_coo(x).sum_duplicates())
+
+
+# ----------------------------------------------------------------- unary
+def _unary(name, fn):
+    def op(x, name_=None):
+        m = _coo(x)
+        return _wrap_like(x, jsparse.BCOO((fn(m.data), m.indices),
+                                          shape=m.shape))
+
+    op.__name__ = name
+    return op
+
+
+# zero-preserving unaries only (the reference restricts to the same set)
+relu = _unary("relu", jax.nn.relu)
+abs = _unary("abs", jnp.abs)
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+expm1 = _unary("expm1", jnp.expm1)
+neg = _unary("neg", jnp.negative)
+
+
+def pow(x, factor):
+    m = _coo(x)
+    return _wrap_like(x, jsparse.BCOO((jnp.power(m.data, factor), m.indices),
+                                      shape=m.shape))
+
+
+def cast(x, dtype):
+    from ..core.dtype import to_jax_dtype
+    m = _coo(x)
+    return _wrap_like(x, jsparse.BCOO((m.data.astype(to_jax_dtype(dtype)),
+                                       m.indices), shape=m.shape))

@@ -1,0 +1,130 @@
+"""paddle.sparse COO/CSR facade
+(reference test model: test/legacy_test/test_sparse_*_op.py — dense-reference
+comparisons)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _rand_coo(shape=(4, 5), nnz=6, seed=0):
+    rng = np.random.default_rng(seed)
+    dense = np.zeros(shape, np.float32)
+    flat = rng.choice(dense.size, nnz, replace=False)
+    dense.flat[flat] = rng.standard_normal(nnz).astype(np.float32)
+    idx = np.stack(np.nonzero(dense))
+    vals = dense[tuple(idx)]
+    return dense, sparse.sparse_coo_tensor(idx, vals, shape)
+
+
+def test_coo_roundtrip():
+    dense, s = _rand_coo()
+    assert s.is_sparse() and s.is_sparse_coo() and not s.is_sparse_csr()
+    assert s.shape == [4, 5] and s.nnz == 6
+    np.testing.assert_allclose(s.to_dense().numpy(), dense)
+    assert s.indices().shape == [2, 6]
+    np.testing.assert_allclose(
+        s.values().numpy(),
+        dense[tuple(np.asarray(s.indices().numpy()))])
+
+
+def test_csr_roundtrip_and_convert():
+    dense, s = _rand_coo()
+    csr = s.to_sparse_csr()
+    assert csr.is_sparse_csr() and csr.nnz == 6
+    np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+    assert csr.crows().shape == [5]  # rows + 1
+    back = csr.to_sparse_coo()
+    np.testing.assert_allclose(back.to_dense().numpy(), dense)
+
+    # direct csr construction
+    crows = np.asarray(csr.crows().numpy())
+    cols = np.asarray(csr.cols().numpy())
+    vals = np.asarray(csr.values().numpy())
+    again = sparse.sparse_csr_tensor(crows, cols, vals, dense.shape)
+    np.testing.assert_allclose(again.to_dense().numpy(), dense)
+
+
+def test_add_subtract_union_support():
+    da, a = _rand_coo(seed=1)
+    db, b = _rand_coo(seed=2)
+    np.testing.assert_allclose(sparse.add(a, b).to_dense().numpy(), da + db,
+                               rtol=1e-6)
+    np.testing.assert_allclose(sparse.subtract(a, b).to_dense().numpy(),
+                               da - db, rtol=1e-6)
+
+
+def test_multiply_and_scalar():
+    da, a = _rand_coo(seed=3)
+    db, b = _rand_coo(seed=3)  # same support
+    np.testing.assert_allclose(sparse.multiply(a, b).to_dense().numpy(),
+                               da * db, rtol=1e-6)
+    np.testing.assert_allclose(sparse.multiply(a, 2.5).to_dense().numpy(),
+                               da * 2.5, rtol=1e-6)
+
+
+def test_matmul_spmm():
+    dense, s = _rand_coo((4, 5), 7, seed=4)
+    rhs = np.random.default_rng(5).standard_normal((5, 3)).astype(np.float32)
+    out = sparse.matmul(s, paddle.to_tensor(rhs))
+    np.testing.assert_allclose(out.numpy(), dense @ rhs, rtol=1e-5,
+                               atol=1e-6)
+    # csr path
+    out2 = sparse.matmul(s.to_sparse_csr(), paddle.to_tensor(rhs))
+    np.testing.assert_allclose(out2.numpy(), dense @ rhs, rtol=1e-5,
+                               atol=1e-6)
+    # operator form
+    np.testing.assert_allclose((s @ paddle.to_tensor(rhs)).numpy(),
+                               dense @ rhs, rtol=1e-5, atol=1e-6)
+
+
+def test_masked_matmul_sddmm():
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((4, 6)).astype(np.float32)
+    y = rng.standard_normal((6, 5)).astype(np.float32)
+    mask_dense, mask = _rand_coo((4, 5), 8, seed=7)
+    out = sparse.masked_matmul(x, y, mask)
+    ref = (x @ y) * (mask_dense != 0)
+    np.testing.assert_allclose(out.to_dense().numpy(), ref, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_unary_zero_preserving():
+    dense, s = _rand_coo(seed=8)
+    np.testing.assert_allclose(sparse.relu(s).to_dense().numpy(),
+                               np.maximum(dense, 0), rtol=1e-6)
+    np.testing.assert_allclose(sparse.sin(s).to_dense().numpy(),
+                               np.sin(dense), rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(sparse.square(s).to_dense().numpy(),
+                               dense ** 2, rtol=1e-6)
+    np.testing.assert_allclose(sparse.neg(s).to_dense().numpy(), -dense)
+    c = sparse.cast(s, "float64" if False else "float32")
+    assert c.to_dense().numpy().dtype == np.float32
+
+
+def test_transpose_and_coalesce():
+    dense, s = _rand_coo((3, 7), 5, seed=9)
+    t = sparse.transpose(s, [1, 0])
+    np.testing.assert_allclose(t.to_dense().numpy(), dense.T)
+
+    # duplicate indices sum on coalesce (reference semantics)
+    idx = np.array([[0, 0, 1], [2, 2, 3]])
+    vals = np.array([1.0, 2.0, 5.0], np.float32)
+    dup = sparse.sparse_coo_tensor(idx, vals, (2, 4))
+    co = sparse.coalesce(dup)
+    want = np.zeros((2, 4), np.float32)
+    want[0, 2] = 3.0
+    want[1, 3] = 5.0
+    np.testing.assert_allclose(co.to_dense().numpy(), want)
+
+
+def test_shape_validation():
+    with pytest.raises(ValueError):
+        sparse.sparse_coo_tensor(np.zeros((3,)), np.zeros((3,)), (2, 2))
+    _, a = _rand_coo((4, 5))
+    _, b = _rand_coo((5, 4))
+    assert not sparse.is_same_shape(a, b)
+    with pytest.raises(ValueError):
+        sparse.add(a, b)
