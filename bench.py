@@ -219,13 +219,13 @@ def _sd_unet_bench(paddle, jax, on_tpu) -> dict:
         lat.shape).astype(np.float32)).astype(dt)
 
     loss = step(lat, t, ctx, noise)  # compile
-    jax.block_until_ready(loss.value)
+    float(loss)  # host sync (block_until_ready is unreliable on the tunnel)
     times = []
     last = None
     for _ in range(steps):
         t0 = time.perf_counter()
         last = step(lat, t, ctx, noise)
-        jax.block_until_ready(last.value)
+        float(last)
         times.append(time.perf_counter() - t0)
     med = sorted(times)[len(times) // 2]
     # unsharded step: runs on ONE device regardless of slice size
@@ -254,10 +254,11 @@ def _decode_bench(model, cfg, paddle, jax) -> dict:
     # the scan length, so a different value compiles a different program
     # and the timed run would measure XLA compilation
     out = model.generate(prompt, max_new_tokens=steps, do_sample=False)
-    jax.block_until_ready(out.value if hasattr(out, "value") else out)
+    np.asarray(out.value if hasattr(out, "value") else out)  # host sync:
+    # block_until_ready does not reliably block through the axon tunnel
     t0 = time.perf_counter()
     out = model.generate(prompt, max_new_tokens=steps, do_sample=False)
-    jax.block_until_ready(out.value if hasattr(out, "value") else out)
+    np.asarray(out.value if hasattr(out, "value") else out)
     dt = time.perf_counter() - t0
     return {"decode_tokens_per_sec": round(steps / dt, 1)}
 

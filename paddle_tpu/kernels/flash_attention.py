@@ -46,6 +46,16 @@ except Exception:  # pragma: no cover - CPU CI path (interpret mode)
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
+_LANES = 128  # stat rows replicate across one lane tile inside kernels
+
+
+def _rep(x):
+    """(BH, S) -> (BH, S, 128) lane-replicated: Mosaic needs the last two
+    block dims (8, 128)-aligned, and a trailing singleton would PAD to 128
+    lanes in HBM anyway — replicating transiently at the kernel boundary
+    keeps the persistent arrays compact (the residuals saved across layers
+    are the 2-D forms)."""
+    return jnp.broadcast_to(x[..., None], (*x.shape, _LANES))
 
 
 def _interpret() -> bool:
@@ -91,11 +101,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
         if seg_q_ref is not None:
-            sq = seg_q_ref[0]                                # (bq, 1)
-            sk = seg_kv_ref[0, :, 0].reshape(1, block_k)
+            sq = seg_q_ref[0][:, :1]                         # (bq, 1)
+            sk = seg_kv_ref[0]                               # (1, bk)
             s = jnp.where(sq == sk, s, _NEG_INF)
 
-        m_prev, l_prev = m_ref[0], l_ref[0]
+        # stat refs are (block_q, 128) lane-replicated; compute on column 0
+        m_prev = m_ref[0][:, :1]                             # (bq, 1)
+        l_prev = l_ref[0][:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         # clamp for fully-masked rows: with m_new == -inf, exp(s - m_new)
         # would be exp(0) = 1 for every masked score — clamping to 0 makes
@@ -104,8 +116,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref,
         m_new = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
-        l_ref[0] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        m_ref[0] = m_new
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        l_ref[0] = jnp.broadcast_to(l_new, l_ref[0].shape)
+        m_ref[0] = jnp.broadcast_to(m_new, m_ref[0].shape)
         acc_ref[0] = alpha * acc_ref[0] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -131,13 +144,13 @@ def _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k):
     ]
     args = [q, k, v]
     if seg_q is not None:
-        # segments ride with a trailing singleton so the (block, 1) layout
-        # satisfies mosaic's last-two-dims rule (1 == array dim)
+        # q-side ids lane-replicated (column orientation, no transpose);
+        # kv-side ids compact (BH, 1, S) row vectors
         in_specs += [
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, 1), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
         ]
-        args += [seg_q[..., None], seg_kv[..., None]]
+        args += [_rep(seg_q), seg_kv[:, None, :]]
         kernel = functools.partial(_fwd_kernel, causal=causal,
                                    sm_scale=sm_scale)
     else:
@@ -154,21 +167,24 @@ def _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k):
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
-            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
-            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
         ],
         interpret=_interpret(),
     )(*args)
 
+    # reduce the lane-replicated stats to compact (BH, S) residuals —
+    # these persist per layer until the backward, so layout matters
+    m, l = m[..., 0], l[..., 0]
     # fully-masked rows (e.g. padding segments) have l == 0 — emit zeros
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    out = (acc / l_safe).astype(q.dtype)
-    lse = m + jnp.log(l_safe)                                # (bh, sq, 1)
+    out = (acc / l_safe[..., None]).astype(q.dtype)
+    lse = m + jnp.log(l_safe)                                # (bh, sq)
     return out, lse
 
 
@@ -190,8 +206,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _step():
         q = q_ref[0].astype(jnp.float32) * sm_scale
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]                                     # (bq, 1)
-        delta = delta_ref[0]
+        lse = lse_ref[0][:, :1]                              # (bq, 1)
+        delta = delta_ref[0][:, :1]                          # (bq, 1)
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -203,8 +219,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
         if seg_q_ref is not None:
-            sq_ = seg_q_ref[0]
-            sk_ = seg_kv_ref[0, :, 0].reshape(1, block_k)
+            sq_ = seg_q_ref[0][:, :1]
+            sk_ = seg_kv_ref[0]
             s = jnp.where(sq_ == sk_, s, _NEG_INF)
         p = jnp.exp(s - lse)                                 # (bq, bk)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -237,8 +253,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0].astype(jnp.float32)
         q = q_ref[0].astype(jnp.float32) * sm_scale
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0][:, :1]                              # (bq, 1)
+        delta = delta_ref[0][:, :1]                          # (bq, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
@@ -248,8 +264,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
         if seg_q_ref is not None:
-            sq_ = seg_q_ref[0]
-            sk_ = seg_kv_ref[0, :, 0].reshape(1, block_k)
+            sq_ = seg_q_ref[0][:, :1]
+            sk_ = seg_kv_ref[0]
             s = jnp.where(sq_ == sk_, s, _NEG_INF)
         p = jnp.exp(s - lse)
         dv_ref[0] = dv_ref[0] + jax.lax.dot_general(
@@ -272,23 +288,26 @@ def _bwd(causal, sm_scale, block_q, block_k, res, g):
     bk = min(block_k, skv)
 
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
-                    axis=-1, keepdims=True)                # (bh, sq, 1)
+                    axis=-1)                               # (bh, sq)
 
     has_seg = seg_q is not None
-    seg3 = [seg_q[..., None], seg_kv[..., None]] if has_seg else []
-    common = [q, k, v, do, lse, delta] + seg3
+    # q-side rows lane-replicated transiently for the kernel boundary;
+    # kv-side ids ride compact as (BH, 1, S) row vectors
+    seg2 = [_rep(seg_q), seg_kv[:, None, :]] if has_seg else []
+    common = [q, k, v, do, _rep(lse), _rep(delta)] + seg2
 
     in_specs_dq = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # q
         pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),   # k
         pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),   # v
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # do
-        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),   # lse
-        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),   # delta
+        pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),  # lse
+        pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),  # delta
     ]
     if has_seg:
-        in_specs_dq += [pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
-                        pl.BlockSpec((1, bk, 1), lambda b, i, j: (b, j, 0))]
+        in_specs_dq += [
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, bk), lambda b, i, j: (b, 0, j))]
         dq_kernel = functools.partial(_bwd_dq_kernel, causal=causal,
                                       sm_scale=sm_scale)
     else:
@@ -312,12 +331,13 @@ def _bwd(causal, sm_scale, block_q, block_k, res, g):
         pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),   # k
         pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),   # v
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0)),   # do
-        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0)),   # lse
-        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0)),   # delta
+        pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, j, 0)),  # lse
+        pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, j, 0)),  # delta
     ]
     if has_seg:
-        in_specs_dkv += [pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0)),
-                         pl.BlockSpec((1, bk, 1), lambda b, i, j: (b, i, 0))]
+        in_specs_dkv += [
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, bk), lambda b, i, j: (b, 0, i))]
         dkv_kernel = functools.partial(_bwd_dkv_kernel, causal=causal,
                                        sm_scale=sm_scale)
     else:

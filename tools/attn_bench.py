@@ -1,0 +1,39 @@
+"""On-chip flash-vs-dense attention microbench (fwd+bwd)."""
+import time, functools, json, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+from paddle_tpu.kernels.flash_attention import flash_attention_bshd
+
+def dense_bshd(q, k, v):
+    qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    s = jnp.einsum("bhsd,bhtd->bhst", qt, kt) / np.sqrt(q.shape[-1])
+    causal = jnp.tril(jnp.ones(s.shape[-2:], bool))
+    s = jnp.where(causal, s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), -1).astype(q.dtype)
+    return jnp.swapaxes(jnp.einsum("bhst,bhtd->bhsd", p, vt), 1, 2)
+
+def bench(fn, *args):
+    # NB: jax.block_until_ready does not reliably block through the axon
+    # tunnel — time a jitted scalar and float() it (host transfer syncs)
+    loss = lambda *a: fn(*a).astype(jnp.float32).sum()
+    g = jax.jit(lambda *a: jax.grad(loss, argnums=(0, 1, 2))(*a)[0].sum())
+    float(g(*args))
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(g(*args))
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[2]
+
+rng = np.random.default_rng(0)
+for s in (1024, 2048, 4096, 8192):
+    b = max(1, 8192 // s)
+    h, d = 16, 64
+    q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+               for _ in range(3))
+    tf = bench(functools.partial(flash_attention_bshd, causal=True), q, k, v)
+    td = bench(dense_bshd, q, k, v)
+    print(json.dumps({"seq": s, "batch": b, "flash_ms": round(tf*1e3, 2),
+                      "dense_ms": round(td*1e3, 2),
+                      "speedup": round(td/tf, 2)}), flush=True)
