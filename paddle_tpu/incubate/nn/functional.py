@@ -396,3 +396,57 @@ def _causal_sdpa(q, k, v, mask):
         s = jnp.where(tri, s, -1e30)
     o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vt)
     return jnp.swapaxes(o, 1, 2).astype(q.dtype)
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, transpose_y=False,
+                               ignore_index=-100, chunk_tokens=1024):
+    """LM-head matmul + softmax cross-entropy without materializing the full
+    (tokens, vocab) f32 logits — the single largest activation in causal-LM
+    training (2 x 3GB for GPT-345M at batch 8 x 2048 on one v5e chip).
+
+    TPU-native design: ``lax.map`` over token chunks; each chunk's logits
+    come out of the MXU already f32 (preferred_element_type), the per-chunk
+    loss reduces immediately, and ``jax.checkpoint`` drops the chunk logits
+    so the backward recomputes them chunk-by-chunk. Peak vocab-activation
+    memory falls from O(tokens) to O(chunk_tokens). Reference analogue:
+    c_softmax_with_cross_entropy_op.cu fuses the same chain for the TP path
+    (paddle/fluid/operators/collective/c_softmax_with_cross_entropy_op.cu).
+
+    ``weight``: (H, V), or (V, H) with ``transpose_y=True`` (tied
+    embeddings). ``labels`` < 0 or == ignore_index are masked out; returns
+    the mean loss over unmasked tokens.
+    """
+    from ...core.tensor import apply_op
+
+    def fn(hv, wv, lv):
+        h_dim = hv.shape[-1]
+        h2 = hv.reshape(-1, h_dim)
+        l2 = lv.reshape(-1).astype(jnp.int32)
+        l2 = jnp.where(l2 == ignore_index, -1, l2)
+        n = h2.shape[0]
+        k = max(1, -(-n // chunk_tokens))
+        pad = k * chunk_tokens - n if n > chunk_tokens else 0
+        if n <= chunk_tokens:
+            k = 1
+        if pad:
+            h2 = jnp.concatenate([h2, jnp.zeros((pad, h_dim), h2.dtype)])
+            l2 = jnp.concatenate([l2, jnp.full((pad,), -1, l2.dtype)])
+        hs = h2.reshape(k, -1, h_dim)
+        ls = l2.reshape(k, -1)
+        contract = ((1,), (1,)) if transpose_y else ((1,), (0,))
+
+        def chunk_fn(args):
+            h_c, l_c = args
+            logits = jax.lax.dot_general(
+                h_c, wv, (contract, ((), ())),
+                preferred_element_type=jnp.float32)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            safe = jnp.clip(l_c, 0, logits.shape[-1] - 1)
+            gold = jnp.take_along_axis(logits, safe[:, None], -1)[..., 0]
+            return jnp.where(l_c >= 0, lse - gold, 0.0)
+
+        per = jax.lax.map(jax.checkpoint(chunk_fn), (hs, ls))
+        count = jnp.maximum(jnp.sum(ls >= 0), 1)
+        return jnp.sum(per) / count.astype(jnp.float32)
+
+    return apply_op("fused_linear_cross_entropy", fn, hidden, weight, labels)

@@ -244,13 +244,15 @@ class GPTForCausalLM(GenerationMixin, Layer):
 
     def forward(self, input_ids, labels=None, attn_mask=None, position_ids=None):
         hidden = self.gpt(input_ids, attn_mask, position_ids)
-        logits = self.logits(hidden)
         if labels is None:
-            return logits
-        loss = F.cross_entropy(
-            logits.reshape([-1, self.config.vocab_size]),
-            labels.reshape([-1]), reduction="mean")
-        return loss
+            return self.logits(hidden)
+        # chunked fused LM loss: never materializes (tokens, vocab) f32
+        from ..incubate.nn import functional as IF
+        if self.lm_head is None:
+            return IF.fused_linear_cross_entropy(
+                hidden, self.gpt.wte.weight, labels, transpose_y=True)
+        return IF.fused_linear_cross_entropy(
+            hidden, self.lm_head.weight, labels, transpose_y=False)
 
     # ---- decode path (GenerationMixin hooks) -----------------------------
     def cache_spec(self):

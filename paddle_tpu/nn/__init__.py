@@ -23,4 +23,14 @@ from .layers.rnn import (  # noqa: F401
     RNN, BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNNCellBase, SimpleRNN,
     SimpleRNNCell,
 )
+from .layers.extra import (  # noqa: F401
+    AdaptiveAvgPool1D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+    AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D, AvgPool3D, Bilinear,
+    ChannelShuffle, Conv1D, Conv1DTranspose, Conv3D, Conv3DTranspose,
+    CosineEmbeddingLoss, CTCLoss, Fold, GaussianNLLLoss, HingeEmbeddingLoss,
+    InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, LocalResponseNorm,
+    MarginRankingLoss, MaxPool1D, MaxPool3D, MultiLabelSoftMarginLoss,
+    PairwiseDistance, PoissonNLLLoss, SoftMarginLoss, TripletMarginLoss,
+    Unfold, ZeroPad2D,
+)
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401

@@ -613,7 +613,12 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
         pad = [(padding, padding), (padding, padding)]
     else:
         p = list(padding)
-        pad = [(p[0], p[0]), (p[1], p[1])] if len(p) == 2 else [tuple(p[:2]), tuple(p[2:])]
+        if len(p) == 2 and all(isinstance(pi, (tuple, list)) for pi in p):
+            pad = [tuple(p[0]), tuple(p[1])]  # already (lo, hi) pairs
+        elif len(p) == 2:
+            pad = [(p[0], p[0]), (p[1], p[1])]
+        else:
+            pad = [tuple(p[:2]), tuple(p[2:])]
     dn = jax.lax.conv_dimension_numbers(
         _val(x).shape, _val(weight).shape,
         ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "OIHW", "NHWC"))
@@ -646,21 +651,39 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
                      dilation=1, groups=1, data_format="NCHW", output_size=None, name=None):
+    """Gradient-of-conv formulation: lhs-dilated conv with the spatially
+    flipped kernel; weight layout [in, out // groups, kh, kw] (the
+    reference's conv2d_transpose convention).
+    out = (L - 1) * stride - 2 * padding + dilation * (k - 1) + 1 + output_padding
+    """
     stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
     padding_ = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    op_ = ((output_padding, output_padding) if isinstance(output_padding, int)
+           else tuple(output_padding))
+    if data_format != "NCHW":
+        raise NotImplementedError("conv2d_transpose: NCHW only")
+    kh, kw = _val(weight).shape[2], _val(weight).shape[3]
+    pads = tuple(
+        (dilation[i] * (k - 1) - padding_[i],
+         dilation[i] * (k - 1) - padding_[i] + op_[i])
+        for i, k in enumerate((kh, kw)))
+    dn = ("NCHW", "IOHW", "NCHW")
 
     def fn(a, w, *b):
-        # weight layout [in, out, kh, kw] for conv_transpose in paddle
-        out = jax.lax.conv_transpose(
-            a, jnp.swapaxes(w, 0, 1) if groups == 1 else w,
-            strides=stride,
-            padding=[(p, p) for p in padding_],
-            dimension_numbers=("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
-            else ("NHWC", "OIHW", "NHWC"),
-            transpose_kernel=True)
+        wf = jnp.flip(w, (2, 3))
+        if groups > 1:
+            cin = wf.shape[0]
+            # regroup [in, out/g, kh, kw] -> [in/g, out, kh, kw] group-major
+            wf = wf.reshape(groups, cin // groups, *wf.shape[1:]) \
+                .transpose(1, 0, 2, 3, 4) \
+                .reshape(cin // groups, -1, *wf.shape[2:])
+        out = jax.lax.conv_general_dilated(
+            a, wf, window_strides=(1, 1), padding=pads,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups)
         if b:
-            shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
-            out = out + b[0].reshape(shape)
+            out = out + b[0].reshape(1, -1, 1, 1)
         return out
 
     args = (x, weight) + ((bias,) if bias is not None else ())
@@ -816,3 +839,7 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
         rest = a[:, :, 2 * fold:]
         return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
     return apply_op("temporal_shift", fn, x)
+
+
+# extended surface: 3-D conv/pool family, grid sampling, CTC, loss zoo
+from .functional_extra import *  # noqa: F401,F403,E402

@@ -1,0 +1,605 @@
+"""Functional surface extensions: 3-D conv/pool family, grid sampling, CTC
+loss, and the margin/embedding loss zoo
+(reference: python/paddle/nn/functional/{conv,pooling,vision,loss}.py).
+
+CTC is the one nontrivial kernel here: the reference binds warp-ctc
+(paddle/fluid/operators/warpctc_op.*); the TPU-native version is a
+log-semiring forward DP as one ``lax.scan`` over time, vmapped over the
+batch — static shapes, masked tails for variable input/label lengths.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, _val
+
+_NEG_INF = -1e30
+
+
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+# ------------------------------------------------------------------ conv3d
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    """(reference: python/paddle/nn/functional/conv.py::conv3d)."""
+    stride, dilation = _triple(stride), _triple(dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    elif isinstance(padding, int):
+        pad = [(padding,) * 2] * 3
+    else:
+        p = list(padding)
+        pad = [(pi, pi) for pi in p] if len(p) == 3 else \
+            [tuple(p[0:2]), tuple(p[2:4]), tuple(p[4:6])]
+    dn = jax.lax.conv_dimension_numbers(
+        _val(x).shape, _val(weight).shape,
+        ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW"
+        else ("NDHWC", "OIDHW", "NDHWC"))
+
+    def fn(a, w, b):
+        out = jax.lax.conv_general_dilated(
+            a, w, stride, pad, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups)
+        if b is not None:
+            shape = ((1, -1, 1, 1, 1) if data_format == "NCDHW"
+                     else (1, 1, 1, 1, -1))
+            out = out + b.reshape(shape)
+        return out
+
+    return apply_op("conv3d", fn, x, weight, bias)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCL", name=None):
+    from .functional import conv2d_transpose
+
+    st = stride if isinstance(stride, int) else stride[0]
+    pd = padding if isinstance(padding, int) else padding[0]
+    dl = dilation if isinstance(dilation, int) else dilation[0]
+    op = output_padding if isinstance(output_padding, int) \
+        else output_padding[0]
+    x2 = apply_op("unsq", lambda a: a[..., None, :], x)
+    w2 = apply_op("unsq", lambda a: a[..., None, :], weight)
+    out = conv2d_transpose(x2, w2, bias, stride=(1, st), padding=(0, pd),
+                           output_padding=(0, op), groups=groups,
+                           dilation=(1, dl), data_format="NCHW")
+    return apply_op("sq", lambda a: a.squeeze(-2), out)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", name=None):
+    """Gradient-of-conv3d formulation (reference conv3d_transpose)."""
+    stride, dilation = _triple(stride), _triple(dilation)
+    padding = _triple(padding) if isinstance(padding, int) else tuple(padding)
+    output_padding = _triple(output_padding) \
+        if isinstance(output_padding, int) else tuple(output_padding)
+    dn = jax.lax.conv_dimension_numbers(
+        _val(x).shape, _val(weight).shape,
+        ("NCDHW", "IODHW", "NCDHW"))
+    # transpose conv == lhs-dilated conv with flipped kernel padding
+    pads = tuple(
+        (dilation[i] * (_val(weight).shape[2 + i] - 1) - padding[i],
+         dilation[i] * (_val(weight).shape[2 + i] - 1) - padding[i]
+         + output_padding[i])
+        for i in range(3))
+
+    def fn(a, w, b):
+        out = jax.lax.conv_general_dilated(
+            a, jnp.flip(w, (2, 3, 4)), (1, 1, 1), pads,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1, 1)
+        return out
+
+    return apply_op("conv3d_transpose", fn, x, weight, bias)
+
+
+# ------------------------------------------------------------------- pools
+def _pool_nd(x, nd, kernel, stride, padding, reducer, init, fmt):
+    kernel = (kernel,) * nd if isinstance(kernel, int) else tuple(kernel)
+    stride = (stride,) * nd if isinstance(stride, int) else tuple(stride)
+    padding = (padding,) * nd if isinstance(padding, int) else tuple(padding)
+    channels_last = fmt.endswith("C")
+    if channels_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pads = ((0, 0),) + tuple((p, p) for p in padding) + ((0, 0),)
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding)
+
+    def fn(a):
+        return jax.lax.reduce_window(a, init, reducer, window, strides, pads)
+
+    return fn
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        raise NotImplementedError("max_pool1d(return_mask=True): use "
+                                  "unfold + argmax on TPU")
+    fn = _pool_nd(x, 1, kernel_size, stride or kernel_size, padding,
+                  jax.lax.max, -jnp.inf, data_format)
+    return apply_op("max_pool1d", fn, x)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    fn = _pool_nd(x, 1, kernel_size, stride or kernel_size, padding,
+                  jax.lax.add, 0.0, data_format)
+    return apply_op("avg_pool1d", lambda a: fn(a) / k, x)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        raise NotImplementedError("max_pool3d(return_mask=True)")
+    fn = _pool_nd(x, 3, kernel_size, stride or kernel_size, padding,
+                  jax.lax.max, -jnp.inf, data_format)
+    return apply_op("max_pool3d", fn, x)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, data_format="NCDHW", name=None):
+    ks = _triple(kernel_size)
+    denom = float(np.prod(ks))
+    fn = _pool_nd(x, 3, kernel_size, stride or kernel_size, padding,
+                  jax.lax.add, 0.0, data_format)
+    return apply_op("avg_pool3d", lambda a: fn(a) / denom, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return apply_op("adaptive_avg_pool1d",
+                    lambda a: _adaptive_reduce(a, (output_size,), jnp.mean),
+                    x)
+
+
+def _adaptive_reduce(a, out_sizes, reduce_fn):
+    """Adaptive pooling over the trailing len(out_sizes) spatial dims via
+    per-window slicing (paddle's start/end index formula)."""
+    nd = len(out_sizes)
+    spatial = a.shape[-nd:]
+
+    def pool_axis(arr, axis, in_size, out_size):
+        pieces = []
+        for i in range(out_size):
+            s = (i * in_size) // out_size
+            e = -(-((i + 1) * in_size) // out_size)
+            sl = [slice(None)] * arr.ndim
+            sl[axis] = slice(s, e)
+            pieces.append(reduce_fn(arr[tuple(sl)], axis=axis,
+                                    keepdims=True))
+        return jnp.concatenate(pieces, axis=axis)
+
+    for d in range(nd):
+        axis = a.ndim - nd + d
+        a = pool_axis(a, axis, spatial[d], out_sizes[d])
+    return a
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool1d(return_mask=True)")
+    return apply_op("adaptive_max_pool1d",
+                    lambda a: _adaptive_reduce(a, (output_size,), jnp.max), x)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool2d(return_mask=True)")
+    out = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    return apply_op("adaptive_max_pool2d",
+                    lambda a: _adaptive_reduce(a, out, jnp.max), x)
+
+
+def adaptive_avg_pool3d(x, output_size, name=None):
+    out = _triple(output_size)
+    return apply_op("adaptive_avg_pool3d",
+                    lambda a: _adaptive_reduce(a, out, jnp.mean), x)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool3d(return_mask=True)")
+    out = _triple(output_size)
+    return apply_op("adaptive_max_pool3d",
+                    lambda a: _adaptive_reduce(a, out, jnp.max), x)
+
+
+# ---------------------------------------------------------- grid sampling
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """(reference: python/paddle/nn/functional/vision.py::affine_grid).
+    ``theta``: (N, 2, 3); ``out_shape``: [N, C, H, W] -> grid (N, H, W, 2)."""
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def fn(th):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], -1)     # (H, W, 3)
+        return jnp.einsum("hwk,njk->nhwj", base, th)          # (N, H, W, 2)
+
+    return apply_op("affine_grid", fn, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """(reference: python/paddle/nn/functional/vision.py::grid_sample).
+    x: (N, C, H, W); grid: (N, Hg, Wg, 2) in [-1, 1] (x, y) order."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"mode must be bilinear|nearest, got {mode!r}")
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(
+            f"padding_mode={padding_mode!r}; zeros|border supported")
+
+    def fn(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def gather(ix, iy):
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+            vals = a[jnp.arange(n)[:, None, None], :, iyc, ixc]  # (N,Hg,Wg,C)
+            if padding_mode == "zeros":
+                ok = ((ix >= 0) & (ix <= w - 1)
+                      & (iy >= 0) & (iy <= h - 1))
+                vals = jnp.where(ok[..., None], vals, 0.0)
+            return vals
+
+        if mode == "nearest":
+            out = gather(jnp.round(fx).astype(jnp.int32),
+                         jnp.round(fy).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            wx = (fx - x0)[..., None]
+            wy = (fy - y0)[..., None]
+            out = (gather(x0, y0) * (1 - wx) * (1 - wy)
+                   + gather(x0 + 1, y0) * wx * (1 - wy)
+                   + gather(x0, y0 + 1) * (1 - wx) * wy
+                   + gather(x0 + 1, y0 + 1) * wx * wy)
+        return jnp.moveaxis(out, -1, 1)                       # (N, C, Hg, Wg)
+
+    return apply_op("grid_sample", fn, x, grid)
+
+
+# -------------------------------------------------------------------- CTC
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC (reference: python/paddle/nn/functional/loss.py::ctc_loss over
+    the warp-ctc op). Follows the reference convention: ``log_probs`` are
+    unnormalized logits of shape (T, B, C) — log_softmax is applied
+    internally (warp-ctc semantics); labels (B, L) padded; lengths (B,)."""
+
+    def fn(logits, lab, in_len, lab_len):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)   # (T, B, C)
+        lp = jnp.moveaxis(lp, 1, 0)                               # (B, T, C)
+
+        def one(lp_b, lab_b, T_b, L_b):
+            T, C = lp_b.shape
+            L = lab_b.shape[0]
+            S = 2 * L + 1
+            ext = jnp.full((S,), blank, lab_b.dtype)
+            ext = ext.at[1::2].set(lab_b)
+            # skip transition allowed where ext[s] != blank and != ext[s-2]
+            prev2 = jnp.concatenate([jnp.full((2,), -1, ext.dtype),
+                                     ext[:-2]])
+            can_skip = (ext != blank) & (ext != prev2)
+
+            alpha0 = jnp.full((S,), _NEG_INF)
+            alpha0 = alpha0.at[0].set(lp_b[0, blank])
+            alpha0 = alpha0.at[1].set(
+                jnp.where(L_b > 0, lp_b[0, ext[1]], _NEG_INF))
+
+            def step(alpha, t):
+                shift1 = jnp.concatenate([jnp.full((1,), _NEG_INF),
+                                          alpha[:-1]])
+                shift2 = jnp.concatenate([jnp.full((2,), _NEG_INF),
+                                          alpha[:-2]])
+                shift2 = jnp.where(can_skip, shift2, _NEG_INF)
+                merged = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2)
+                new = merged + lp_b[t, ext]
+                # freeze past this sequence's end
+                alpha = jnp.where(t < T_b, new, alpha)
+                return alpha, None
+
+            alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+            idx_last = jnp.clip(2 * L_b, 0, S - 1)
+            idx_prev = jnp.clip(2 * L_b - 1, 0, S - 1)
+            total = jnp.logaddexp(alpha[idx_last],
+                                  jnp.where(L_b > 0, alpha[idx_prev],
+                                            _NEG_INF))
+            return -total
+
+        losses = jax.vmap(one)(lp, lab, in_len, lab_len)          # (B,)
+        if norm_by_times:
+            losses = losses / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            # reference divides by label length before averaging
+            return jnp.mean(
+                losses / jnp.maximum(lab_len.astype(jnp.float32), 1.0))
+        if reduction == "sum":
+            return jnp.sum(losses)
+        return losses
+
+    return apply_op("ctc_loss", fn, log_probs, labels, input_lengths,
+                    label_lengths)
+
+
+# ------------------------------------------------------------ loss family
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return apply_op(
+        "margin_ranking_loss",
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin),
+                                reduction),
+        input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    return apply_op(
+        "hinge_embedding_loss",
+        lambda a, y: _reduce(jnp.where(y == 1.0, a,
+                                       jnp.maximum(0.0, margin - a)),
+                             reduction),
+        input, label)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return apply_op(
+        "soft_margin_loss",
+        lambda a, y: _reduce(jnp.log1p(jnp.exp(-y * a)), reduction),
+        input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    def fn(a, y, w):
+        per = -(y * jax.nn.log_sigmoid(a)
+                + (1 - y) * jax.nn.log_sigmoid(-a))
+        if w is not None:
+            per = per * w
+        return _reduce(jnp.mean(per, -1), reduction)
+
+    return apply_op("multi_label_soft_margin_loss", fn, input, label, weight)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        per = jnp.where(y == 1.0, 1.0 - cos,
+                        jnp.maximum(0.0, cos - margin))
+        return _reduce(per, reduction)
+
+    return apply_op("cosine_embedding_loss", fn, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def fn(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(
+                jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p), -1), 1 / p)
+
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_an = jnp.minimum(d_an, dist(pos, neg))
+        return _reduce(jnp.maximum(0.0, d_ap - d_an + margin), reduction)
+
+    return apply_op("triplet_margin_loss", fn, input, positive, negative)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    return apply_op(
+        "pairwise_distance",
+        lambda a, b: jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p), -1,
+                    keepdims=keepdim), 1 / p),
+        x, y)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def fn(a, y):
+        if log_input:
+            per = jnp.exp(a) - y * a
+        else:
+            per = a - y * jnp.log(a + epsilon)
+        if full:
+            stirling = (y * jnp.log(y) - y
+                        + 0.5 * jnp.log(2 * math.pi * y))
+            per = per + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(per, reduction)
+
+    return apply_op("poisson_nll_loss", fn, input, label)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def fn(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        per = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            per = per + 0.5 * math.log(2 * math.pi)
+        return _reduce(per, reduction)
+
+    return apply_op("gaussian_nll_loss", fn, input, label, variance)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(lg, y, norm):
+        p = jax.nn.sigmoid(lg)
+        ce = -(y * jax.nn.log_sigmoid(lg)
+               + (1 - y) * jax.nn.log_sigmoid(-lg))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        per = a_t * jnp.power(1 - p_t, gamma) * ce
+        if norm is not None:
+            per = per / norm
+        return _reduce(per, reduction)
+
+    return apply_op("sigmoid_focal_loss", fn, logit, label, normalizer)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """(reference: python/paddle/nn/functional/loss.py::dice_loss);
+    input (N, ..., C) probabilities, label (N, ..., 1) int class ids."""
+
+    def fn(a, y):
+        c = a.shape[-1]
+        oh = jax.nn.one_hot(y[..., 0], c, dtype=a.dtype)
+        flat_a = a.reshape(a.shape[0], -1)
+        flat_y = oh.reshape(a.shape[0], -1)
+        inter = jnp.sum(flat_a * flat_y, -1)
+        union = jnp.sum(flat_a, -1) + jnp.sum(flat_y, -1)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return apply_op("dice_loss", fn, input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply_op(
+        "log_loss",
+        lambda a, y: -(y * jnp.log(a + epsilon)
+                       + (1 - y) * jnp.log(1 - a + epsilon)),
+        input, label)
+
+
+def square_error_cost(input, label, name=None):
+    return apply_op("square_error_cost", lambda a, y: (a - y) ** 2,
+                    input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """(reference: python/paddle/nn/functional/loss.py::npair_loss)."""
+
+    def fn(a, p, y):
+        y = y.reshape(-1, 1)
+        same = (y == y.T).astype(a.dtype)
+        same = same / jnp.sum(same, -1, keepdims=True)
+        sim = a @ p.T
+        xent = jnp.mean(
+            jnp.sum(-same * jax.nn.log_softmax(sim, -1), -1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, -1))
+                        + jnp.mean(jnp.sum(p * p, -1))) / 2
+        return xent + reg
+
+    return apply_op("npair_loss", fn, anchor, positive, labels)
+
+
+# ------------------------------------------------------------------- misc
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def fn(a):
+        sq = a * a
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        pad = [(0, 0)] * a.ndim
+        pad[ch_axis] = (size // 2, (size - 1) // 2)
+        window = [1] * a.ndim
+        window[ch_axis] = size
+        s = jax.lax.reduce_window(jnp.pad(sq, pad), 0.0, jax.lax.add,
+                                  tuple(window), (1,) * a.ndim,
+                                  [(0, 0)] * a.ndim)
+        return a / jnp.power(k + alpha * s / size, beta)
+
+    return apply_op("local_response_norm", fn, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, groups, c // groups, h, w) \
+                .swapaxes(1, 2).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, groups, c // groups) \
+            .swapaxes(3, 4).reshape(n, h, w, c)
+
+    return apply_op("channel_shuffle", fn, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im (reference: python/paddle/nn/functional/common.py::fold);
+    x: (N, C*kh*kw, L) -> (N, C, H, W). Scatter-add of unfold patches."""
+    oh, ow = ((output_sizes, output_sizes)
+              if isinstance(output_sizes, int) else tuple(output_sizes))
+    kh, kw = ((kernel_sizes, kernel_sizes)
+              if isinstance(kernel_sizes, int) else tuple(kernel_sizes))
+    sh, sw = (strides, strides) if isinstance(strides, int) \
+        else tuple(strides)
+    ph, pw = (paddings, paddings) if isinstance(paddings, int) \
+        else tuple(paddings)
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) \
+        else tuple(dilations)
+
+    def fn(a):
+        n, ckk, L = a.shape
+        c = ckk // (kh * kw)
+        nh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        nw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        a = a.reshape(n, c, kh, kw, nh, nw)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), a.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                patch = a[:, :, i, j]                       # (n, c, nh, nw)
+                out = jax.lax.dynamic_update_slice(
+                    out,
+                    jax.lax.dynamic_slice(
+                        out, (0, 0, i * dh, j * dw),
+                        (n, c, (nh - 1) * sh + 1, (nw - 1) * sw + 1))
+                    .at[:, :, ::sh, ::sw].add(patch),
+                    (0, 0, i * dh, j * dw))
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+
+    return apply_op("fold", fn, x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    p = (padding,) * 4 if isinstance(padding, int) else tuple(padding)
+
+    def fn(a):
+        if data_format == "NCHW":
+            return jnp.pad(a, ((0, 0), (0, 0), (p[2], p[3]), (p[0], p[1])))
+        return jnp.pad(a, ((0, 0), (p[2], p[3]), (p[0], p[1]), (0, 0)))
+
+    return apply_op("zeropad2d", fn, x)

@@ -1,0 +1,355 @@
+"""Layer wrappers for the extended functional surface
+(reference: python/paddle/nn/layer/{conv,pooling,norm,loss,distance}.py)."""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from .. import functional as F
+from .. import initializer as I
+from ..layer import Layer
+from ..param_attr import ParamAttr
+
+
+class _ConvNd(Layer):
+    _NDIM = 2
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None,
+                 transpose=False, output_padding=0):
+        super().__init__()
+        nd = self._NDIM
+        ks = (kernel_size,) * nd if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._in, self._out = in_channels, out_channels
+        self._stride, self._padding = stride, padding
+        self._dilation, self._groups = dilation, groups
+        self._output_padding = output_padding
+        if transpose:
+            wshape = (in_channels, out_channels // groups) + ks
+        else:
+            wshape = (out_channels, in_channels // groups) + ks
+        fan_in = in_channels // groups * int(math.prod(ks))
+        std = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            wshape, attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=None if weight_attr else I.Uniform(-std, std))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                (out_channels,), attr=ParamAttr._to_attr(bias_attr),
+                is_bias=True,
+                default_initializer=None if bias_attr else
+                I.Uniform(-std, std))
+
+    def extra_repr(self):
+        return f"{self._in}, {self._out}, stride={self._stride}"
+
+
+class Conv1D(_ConvNd):
+    _NDIM = 1
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups)
+
+
+class Conv3D(_ConvNd):
+    _NDIM = 3
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups)
+
+
+class Conv1DTranspose(_ConvNd):
+    _NDIM = 1
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, transpose=True, **kwargs)
+
+    def forward(self, x, output_size=None):
+        return F.conv1d_transpose(x, self.weight, self.bias, self._stride,
+                                  self._padding, self._output_padding,
+                                  self._groups, self._dilation)
+
+
+class Conv3DTranspose(_ConvNd):
+    _NDIM = 3
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, transpose=True, **kwargs)
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight, self.bias, self._stride,
+                                  self._padding, self._output_padding,
+                                  self._groups, self._dilation)
+
+
+class _Pool(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, **kw):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride, padding
+
+    def extra_repr(self):
+        return f"kernel_size={self._k}, stride={self._s}, padding={self._p}"
+
+
+class MaxPool1D(_Pool):
+    def forward(self, x):
+        return F.max_pool1d(x, self._k, self._s, self._p)
+
+
+class AvgPool1D(_Pool):
+    def forward(self, x):
+        return F.avg_pool1d(x, self._k, self._s, self._p)
+
+
+class MaxPool3D(_Pool):
+    def forward(self, x):
+        return F.max_pool3d(x, self._k, self._s, self._p)
+
+
+class AvgPool3D(_Pool):
+    def forward(self, x):
+        return F.avg_pool3d(x, self._k, self._s, self._p)
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__()
+        self._o = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self._o)
+
+
+class AdaptiveAvgPool3D(AdaptiveAvgPool1D):
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self._o)
+
+
+class AdaptiveMaxPool1D(AdaptiveAvgPool1D):
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self._o)
+
+
+class AdaptiveMaxPool2D(AdaptiveAvgPool1D):
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self._o)
+
+
+class AdaptiveMaxPool3D(AdaptiveAvgPool1D):
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self._o)
+
+
+class _InstanceNorm(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 name=None):
+        super().__init__()
+        self._eps = epsilon
+        if weight_attr is False:
+            self.scale = None
+        else:
+            self.scale = self.create_parameter(
+                (num_features,), attr=ParamAttr._to_attr(weight_attr),
+                default_initializer=I.Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                (num_features,), attr=ParamAttr._to_attr(bias_attr),
+                is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.scale, bias=self.bias,
+                               eps=self._eps)
+
+
+class InstanceNorm1D(_InstanceNorm):
+    pass
+
+
+class InstanceNorm2D(_InstanceNorm):
+    pass
+
+
+class InstanceNorm3D(_InstanceNorm):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        return F.local_response_norm(x, *self._args)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self._groups, self._fmt = groups, data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self._groups, self._fmt)
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self._padding, self._fmt = padding, data_format
+
+    def forward(self, x):
+        return F.zeropad2d(x, self._padding, self._fmt)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._args = (output_sizes, kernel_sizes, strides, paddings,
+                      dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self._args)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self._args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.unfold(x, *self._args)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._args = (p, epsilon, keepdim)
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, *self._args)
+
+
+class Bilinear(Layer):
+    """(reference: python/paddle/nn/layer/common.py::Bilinear)."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(in1_features)
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features),
+            attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=None if weight_attr else I.Uniform(-std, std))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                (out_features,), attr=ParamAttr._to_attr(bias_attr),
+                is_bias=True)
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+# ------------------------------------------------------------ loss layers
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self._blank, self._reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          self._blank, self._reduction, norm_by_times)
+
+
+class MarginRankingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__()
+        self._margin, self._reduction = margin, reduction
+
+    def forward(self, input, other, label):
+        return F.margin_ranking_loss(input, other, label, self._margin,
+                                     self._reduction)
+
+
+class HingeEmbeddingLoss(Layer):
+    def __init__(self, margin=1.0, reduction="mean", name=None):
+        super().__init__()
+        self._margin, self._reduction = margin, reduction
+
+    def forward(self, input, label):
+        return F.hinge_embedding_loss(input, label, self._margin,
+                                      self._reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self._reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self._weight, self._reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self._weight,
+                                              self._reduction)
+
+
+class CosineEmbeddingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__()
+        self._margin, self._reduction = margin, reduction
+
+    def forward(self, input1, input2, label):
+        return F.cosine_embedding_loss(input1, input2, label, self._margin,
+                                       self._reduction)
+
+
+class TripletMarginLoss(Layer):
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._args = (margin, p, epsilon, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_loss(input, positive, negative, *self._args)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._args = (log_input, full, epsilon, reduction)
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(input, label, *self._args)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", name=None):
+        super().__init__()
+        self._args = (full, epsilon, reduction)
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, *self._args)

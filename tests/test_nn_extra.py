@@ -1,0 +1,325 @@
+"""Extended nn surface: 3-D conv/pool, grid sampling, CTC, loss zoo —
+torch-reference parity (reference test model: test/legacy_test/
+test_conv3d_op.py, test_warpctc_op.py, test_*_loss.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+torch = pytest.importorskip("torch")
+TF = torch.nn.functional
+
+RT, AT = 1e-4, 1e-4
+
+
+def _t(x):
+    return torch.from_numpy(np.asarray(x))
+
+
+def test_conv3d_matches_torch():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 3, 6, 7, 8)).astype(np.float32)
+    w = rng.standard_normal((5, 3, 3, 3, 3)).astype(np.float32)
+    b = rng.standard_normal((5,)).astype(np.float32)
+    out = F.conv3d(paddle.to_tensor(x), paddle.to_tensor(w),
+                   paddle.to_tensor(b), stride=2, padding=1)
+    ref = TF.conv3d(_t(x), _t(w), _t(b), stride=2, padding=1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=RT, atol=AT)
+
+
+def test_conv_transpose_1d_3d_match_torch():
+    rng = np.random.default_rng(1)
+    x1 = rng.standard_normal((2, 4, 9)).astype(np.float32)
+    w1 = rng.standard_normal((4, 3, 3)).astype(np.float32)
+    out1 = F.conv1d_transpose(paddle.to_tensor(x1), paddle.to_tensor(w1),
+                              stride=2, padding=1)
+    ref1 = TF.conv_transpose1d(_t(x1), _t(w1), stride=2, padding=1)
+    np.testing.assert_allclose(out1.numpy(), ref1.numpy(), rtol=RT, atol=AT)
+
+    x3 = rng.standard_normal((1, 4, 4, 5, 6)).astype(np.float32)
+    w3 = rng.standard_normal((4, 2, 3, 3, 3)).astype(np.float32)
+    out3 = F.conv3d_transpose(paddle.to_tensor(x3), paddle.to_tensor(w3),
+                              stride=2, padding=1, output_padding=1)
+    ref3 = TF.conv_transpose3d(_t(x3), _t(w3), stride=2, padding=1,
+                               output_padding=1)
+    np.testing.assert_allclose(out3.numpy(), ref3.numpy(), rtol=RT, atol=AT)
+
+
+def test_pools_match_torch():
+    rng = np.random.default_rng(2)
+    x1 = rng.standard_normal((2, 3, 12)).astype(np.float32)
+    np.testing.assert_allclose(
+        F.max_pool1d(paddle.to_tensor(x1), 3, 2, 1).numpy(),
+        TF.max_pool1d(_t(x1), 3, 2, 1).numpy(), rtol=RT)
+    np.testing.assert_allclose(
+        F.avg_pool1d(paddle.to_tensor(x1), 2, 2).numpy(),
+        TF.avg_pool1d(_t(x1), 2, 2).numpy(), rtol=RT)
+
+    x3 = rng.standard_normal((2, 3, 8, 8, 8)).astype(np.float32)
+    np.testing.assert_allclose(
+        F.max_pool3d(paddle.to_tensor(x3), 2, 2).numpy(),
+        TF.max_pool3d(_t(x3), 2, 2).numpy(), rtol=RT)
+    np.testing.assert_allclose(
+        F.avg_pool3d(paddle.to_tensor(x3), 2, 2).numpy(),
+        TF.avg_pool3d(_t(x3), 2, 2).numpy(), rtol=RT)
+
+
+def test_adaptive_pools_match_torch():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2, 4, 10)).astype(np.float32)
+    np.testing.assert_allclose(
+        F.adaptive_avg_pool1d(paddle.to_tensor(x), 3).numpy(),
+        TF.adaptive_avg_pool1d(_t(x), 3).numpy(), rtol=RT, atol=AT)
+    np.testing.assert_allclose(
+        F.adaptive_max_pool1d(paddle.to_tensor(x), 4).numpy(),
+        TF.adaptive_max_pool1d(_t(x), 4).numpy(), rtol=RT)
+    x2 = rng.standard_normal((2, 3, 9, 11)).astype(np.float32)
+    np.testing.assert_allclose(
+        F.adaptive_max_pool2d(paddle.to_tensor(x2), (4, 5)).numpy(),
+        TF.adaptive_max_pool2d(_t(x2), (4, 5)).numpy(), rtol=RT)
+    x3 = rng.standard_normal((1, 2, 6, 7, 8)).astype(np.float32)
+    np.testing.assert_allclose(
+        F.adaptive_avg_pool3d(paddle.to_tensor(x3), 3).numpy(),
+        TF.adaptive_avg_pool3d(_t(x3), 3).numpy(), rtol=RT, atol=AT)
+
+
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+@pytest.mark.parametrize("pad", ["zeros", "border"])
+@pytest.mark.parametrize("align", [True, False])
+def test_grid_sample_matches_torch(mode, pad, align):
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((2, 3, 5, 6)).astype(np.float32)
+    grid = (rng.uniform(-1.3, 1.3, (2, 4, 7, 2))).astype(np.float32)
+    out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                        mode=mode, padding_mode=pad, align_corners=align)
+    ref = TF.grid_sample(_t(x), _t(grid), mode=mode, padding_mode=pad,
+                         align_corners=align)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=RT, atol=1e-4)
+
+
+def test_affine_grid_matches_torch():
+    theta = np.array([[[1.2, 0.1, 0.2], [-0.1, 0.9, -0.3]],
+                      [[0.8, 0.0, 0.0], [0.0, 1.1, 0.5]]], np.float32)
+    for align in (True, False):
+        out = F.affine_grid(paddle.to_tensor(theta), [2, 3, 4, 5],
+                            align_corners=align)
+        ref = TF.affine_grid(_t(theta), [2, 3, 4, 5], align_corners=align)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=RT,
+                                   atol=1e-5)
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_ctc_loss_matches_torch(reduction):
+    rng = np.random.default_rng(5)
+    T, B, C, L = 12, 3, 6, 4
+    logits = rng.standard_normal((T, B, C)).astype(np.float32)
+    labels = rng.integers(1, C, (B, L)).astype(np.int32)
+    in_lens = np.array([12, 9, 7], np.int32)
+    lab_lens = np.array([4, 3, 2], np.int32)
+
+    out = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                     paddle.to_tensor(in_lens), paddle.to_tensor(lab_lens),
+                     blank=0, reduction=reduction)
+    ref = TF.ctc_loss(
+        torch.log_softmax(_t(logits), -1), _t(labels.astype(np.int64)),
+        _t(in_lens.astype(np.int64)), _t(lab_lens.astype(np.int64)),
+        blank=0, reduction=reduction)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_ctc_loss_gradient_flows():
+    rng = np.random.default_rng(6)
+    logits = paddle.to_tensor(
+        rng.standard_normal((8, 2, 5)).astype(np.float32),
+        stop_gradient=False)
+    labels = paddle.to_tensor(np.array([[1, 2], [3, 4]], np.int32))
+    loss = F.ctc_loss(logits, labels,
+                      paddle.to_tensor(np.array([8, 8], np.int32)),
+                      paddle.to_tensor(np.array([2, 2], np.int32)))
+    loss.backward()
+    g = logits.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+LOSSES = [
+    ("margin_ranking",
+     lambda a, b, y: F.margin_ranking_loss(a, b, y, margin=0.5),
+     lambda a, b, y: TF.margin_ranking_loss(a, b, y, margin=0.5), 3),
+    ("hinge_embedding",
+     lambda a, y: F.hinge_embedding_loss(a, y, margin=1.0),
+     lambda a, y: TF.hinge_embedding_loss(a, y, margin=1.0), "pm1"),
+    ("soft_margin",
+     lambda a, y: F.soft_margin_loss(a, y),
+     lambda a, y: TF.soft_margin_loss(a, y), "pm1"),
+    ("cosine_embedding",
+     lambda a, b, y: F.cosine_embedding_loss(a, b, y, margin=0.2),
+     lambda a, b, y: TF.cosine_embedding_loss(a, b, y, margin=0.2), "cos"),
+    ("triplet",
+     lambda a, p, n: F.triplet_margin_loss(a, p, n, margin=1.0),
+     lambda a, p, n: TF.triplet_margin_loss(a, p, n, margin=1.0), 3),
+]
+
+
+def test_loss_zoo_matches_torch():
+    rng = np.random.default_rng(7)
+    a = rng.standard_normal((6, 8)).astype(np.float32)
+    b = rng.standard_normal((6, 8)).astype(np.float32)
+    c = rng.standard_normal((6, 8)).astype(np.float32)
+    y_pm1 = rng.choice([-1.0, 1.0], (6, 8)).astype(np.float32)
+    y_vec = rng.choice([-1.0, 1.0], (6,)).astype(np.float32)
+
+    np.testing.assert_allclose(
+        F.margin_ranking_loss(paddle.to_tensor(a), paddle.to_tensor(b),
+                              paddle.to_tensor(y_pm1), margin=0.5).numpy(),
+        TF.margin_ranking_loss(_t(a), _t(b), _t(y_pm1),
+                               margin=0.5).numpy(), rtol=RT, atol=AT)
+    np.testing.assert_allclose(
+        F.hinge_embedding_loss(paddle.to_tensor(a),
+                               paddle.to_tensor(y_pm1)).numpy(),
+        TF.hinge_embedding_loss(_t(a), _t(y_pm1)).numpy(), rtol=RT, atol=AT)
+    np.testing.assert_allclose(
+        F.soft_margin_loss(paddle.to_tensor(a),
+                           paddle.to_tensor(y_pm1)).numpy(),
+        TF.soft_margin_loss(_t(a), _t(y_pm1)).numpy(), rtol=RT, atol=AT)
+    np.testing.assert_allclose(
+        F.cosine_embedding_loss(paddle.to_tensor(a), paddle.to_tensor(b),
+                                paddle.to_tensor(y_vec),
+                                margin=0.2).numpy(),
+        TF.cosine_embedding_loss(_t(a), _t(b), _t(y_vec),
+                                 margin=0.2).numpy(), rtol=RT, atol=AT)
+    np.testing.assert_allclose(
+        F.triplet_margin_loss(paddle.to_tensor(a), paddle.to_tensor(b),
+                              paddle.to_tensor(c)).numpy(),
+        TF.triplet_margin_loss(_t(a), _t(b), _t(c)).numpy(),
+        rtol=RT, atol=AT)
+    y01 = (y_pm1 > 0).astype(np.float32)
+    np.testing.assert_allclose(
+        F.multi_label_soft_margin_loss(paddle.to_tensor(a),
+                                       paddle.to_tensor(y01)).numpy(),
+        TF.multilabel_soft_margin_loss(_t(a), _t(y01)).numpy(),
+        rtol=RT, atol=AT)
+    np.testing.assert_allclose(
+        F.poisson_nll_loss(paddle.to_tensor(a),
+                           paddle.to_tensor(np.abs(b))).numpy(),
+        TF.poisson_nll_loss(_t(a), _t(np.abs(b))).numpy(), rtol=RT, atol=AT)
+    var = np.abs(c) + 0.1
+    np.testing.assert_allclose(
+        F.gaussian_nll_loss(paddle.to_tensor(a), paddle.to_tensor(b),
+                            paddle.to_tensor(var)).numpy(),
+        TF.gaussian_nll_loss(_t(a), _t(b), _t(var)).numpy(),
+        rtol=RT, atol=AT)
+    np.testing.assert_allclose(
+        F.pairwise_distance(paddle.to_tensor(a),
+                            paddle.to_tensor(b)).numpy(),
+        TF.pairwise_distance(_t(a), _t(b)).numpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_misc_ops():
+    rng = np.random.default_rng(8)
+    x = rng.standard_normal((2, 8, 6, 6)).astype(np.float32)
+    np.testing.assert_allclose(
+        F.local_response_norm(paddle.to_tensor(x), 5).numpy(),
+        TF.local_response_norm(_t(x), 5).numpy(), rtol=RT, atol=AT)
+    np.testing.assert_allclose(
+        F.channel_shuffle(paddle.to_tensor(x), 4).numpy(),
+        TF.channel_shuffle(_t(x), 4).numpy(), rtol=RT)
+    np.testing.assert_allclose(
+        F.zeropad2d(paddle.to_tensor(x), [1, 2, 3, 4]).numpy(),
+        TF.pad(_t(x), [1, 2, 3, 4]).numpy(), rtol=RT)
+
+    # fold inverts unfold (overlap-add identity vs torch)
+    cols = F.unfold(paddle.to_tensor(x), 3, strides=2, paddings=1)
+    out = F.fold(cols, (6, 6), 3, strides=2, paddings=1)
+    ref = TF.fold(TF.unfold(_t(x), 3, stride=2, padding=1), (6, 6), 3,
+                  stride=2, padding=1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=RT, atol=AT)
+
+
+def test_layer_wrappers_smoke():
+    rng = np.random.default_rng(9)
+    x3 = paddle.to_tensor(
+        rng.standard_normal((2, 3, 6, 6, 6)).astype(np.float32))
+    assert nn.Conv3D(3, 4, 3, padding=1)(x3).shape == [2, 4, 6, 6, 6]
+    assert nn.MaxPool3D(2, 2)(x3).shape == [2, 3, 3, 3, 3]
+    x1 = paddle.to_tensor(rng.standard_normal((2, 3, 10)).astype(np.float32))
+    assert nn.Conv1D(3, 5, 3, padding=1)(x1).shape == [2, 5, 10]
+    assert nn.Conv1DTranspose(3, 5, 4, stride=2, padding=1)(x1).shape \
+        == [2, 5, 20]
+    assert nn.InstanceNorm1D(3)(x1).shape == [2, 3, 10]
+    assert nn.Bilinear(4, 5, 6)(
+        paddle.to_tensor(rng.standard_normal((7, 4)).astype(np.float32)),
+        paddle.to_tensor(rng.standard_normal((7, 5)).astype(np.float32))
+    ).shape == [7, 6]
+    loss = nn.CTCLoss()(  # layer form smoke
+        paddle.to_tensor(rng.standard_normal((6, 2, 5)).astype(np.float32)),
+        paddle.to_tensor(np.array([[1, 2], [3, 4]], np.int32)),
+        paddle.to_tensor(np.array([6, 6], np.int32)),
+        paddle.to_tensor(np.array([2, 2], np.int32)))
+    assert np.isfinite(float(loss))
+
+
+def test_fused_linear_cross_entropy_matches_plain():
+    from paddle_tpu.incubate.nn import functional as IF
+    rng = np.random.default_rng(10)
+    B, T, H, V = 2, 70, 16, 37  # T chosen so chunking pads (chunk 32)
+    h = rng.standard_normal((B, T, H)).astype(np.float32)
+    w = rng.standard_normal((H, V)).astype(np.float32)
+    y = rng.integers(0, V, (B, T)).astype(np.int32)
+
+    ht = paddle.to_tensor(h, stop_gradient=False)
+    wt = paddle.to_tensor(w, stop_gradient=False)
+    loss = IF.fused_linear_cross_entropy(ht, wt, paddle.to_tensor(y),
+                                         chunk_tokens=32)
+    loss.backward()
+
+    h2 = paddle.to_tensor(h, stop_gradient=False)
+    w2 = paddle.to_tensor(w, stop_gradient=False)
+    import paddle_tpu.ops as ops
+    logits = ops.matmul(h2.reshape([-1, H]), w2)
+    ref = F.cross_entropy(logits, paddle.to_tensor(y.reshape(-1)))
+    ref.backward()
+
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+    np.testing.assert_allclose(ht.grad.numpy(), h2.grad.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(wt.grad.numpy(), w2.grad.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_linear_cross_entropy_tied_and_masked():
+    from paddle_tpu.incubate.nn import functional as IF
+    rng = np.random.default_rng(11)
+    H, V = 8, 11
+    h = rng.standard_normal((3, 5, H)).astype(np.float32)
+    w_vh = rng.standard_normal((V, H)).astype(np.float32)  # tied layout
+    y = rng.integers(0, V, (3, 5)).astype(np.int32)
+    y[0, :2] = -100  # ignore_index masked out
+
+    loss = IF.fused_linear_cross_entropy(
+        paddle.to_tensor(h), paddle.to_tensor(w_vh), paddle.to_tensor(y),
+        transpose_y=True, chunk_tokens=4)
+    # plain reference with masking
+    logits = h.reshape(-1, H) @ w_vh.T
+    lp = logits - np.log(np.exp(logits - logits.max(-1, keepdims=True))
+                         .sum(-1, keepdims=True)) - logits.max(-1,
+                                                              keepdims=True)
+    yy = y.reshape(-1)
+    keep = yy != -100
+    ref = -lp[np.arange(len(yy))[keep], yy[keep]].mean()
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+
+def test_conv2d_transpose_grouped_dilated_matches_torch():
+    rng = np.random.default_rng(12)
+    x = rng.standard_normal((2, 6, 7, 8)).astype(np.float32)
+    w = rng.standard_normal((6, 2, 3, 3)).astype(np.float32)  # groups=2
+    out = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                             stride=2, padding=1, dilation=2, groups=2)
+    ref = TF.conv_transpose2d(_t(x), _t(w), stride=2, padding=1,
+                              dilation=2, groups=2)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
