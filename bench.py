@@ -25,13 +25,58 @@ import sys
 _PROBE = "import jax; d = jax.devices(); print(len(d), jax.default_backend())"
 
 
-def _probe_backend(env: dict, timeout: int = 150) -> bool:
+def cache_env(env: dict) -> dict:
+    """Persistent XLA compilation cache: one healthy window amortizes
+    compiles across bench runs and the tpu_watch harness."""
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".cache", "xla"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    return env
+
+
+def _tpu_expected(env: dict) -> bool:
+    """Whether this machine should have a TPU (the axon tunnel plugin is
+    configured). Decides if a clean CPU-backend probe means 'no chip here'
+    (definitive) or 'plugin failed init during a flap' (retry)."""
+    return ("PALLAS_AXON_POOL_IPS" in env
+            or env.get("BENCH_EXPECT_TPU", "") == "1")
+
+
+def _probe_backend(env: dict, timeout: int = 150) -> str:
+    """Returns 'tpu' (healthy chip), 'cpu' (clean exit on a CPU backend —
+    jax silently fell back), or 'dead' (hang or crash — the tunnel-flap
+    failure mode)."""
     try:
         r = subprocess.run([sys.executable, "-c", _PROBE], env=env,
                            capture_output=True, text=True, timeout=timeout)
-        return r.returncode == 0
     except (subprocess.TimeoutExpired, OSError):
-        return False
+        return "dead"
+    if r.returncode != 0:
+        return "dead"
+    return "tpu" if ("tpu" in r.stdout or "axon" in r.stdout) else "cpu"
+
+
+def _probe_with_backoff(env: dict) -> str:
+    """Retry the health probe across a budget (default 10 min) before
+    giving up — tunnel flaps are often minutes-long, and a healthy window
+    is the only chance at real perf numbers (VERDICT r2 item 1b). Returns
+    the final state: 'tpu', 'cpu' (no TPU on this machine — definitive,
+    no retry), or 'dead' (budget exhausted on an expected-but-unhealthy
+    chip). A clean CPU probe on a machine WITH an axon plugin configured
+    counts as a flap (the plugin can fail init cleanly) and is retried."""
+    import time
+    budget = float(os.environ.get("BENCH_PROBE_BUDGET", "600"))
+    deadline = time.time() + budget
+    expected = _tpu_expected(env)
+    while True:
+        state = _probe_backend(env)
+        if state == "tpu" or (state == "cpu" and not expected):
+            return state
+        if time.time() + 30 >= deadline:
+            return state
+        sys.stderr.write("bench: TPU probe unhealthy, retrying...\n")
+        time.sleep(30)
 
 
 def _parent() -> int:
@@ -40,17 +85,17 @@ def _parent() -> int:
     failure mode."""
     # Probe unless explicitly pinned to CPU: even with JAX_PLATFORMS unset,
     # the axon sitecustomize registers a TPU backend whose init can hang.
-    healthy = True
+    state = "tpu"
     if os.environ.get("JAX_PLATFORMS", "") != "cpu":
-        healthy = _probe_backend(dict(os.environ))
-        if not healthy:  # retry once: transient tunnel flaps happen
-            healthy = _probe_backend(dict(os.environ))
+        state = _probe_with_backoff(dict(os.environ))
 
-    env = dict(os.environ)
+    env = cache_env(dict(os.environ))
     env["_PADDLE_TPU_BENCH_CHILD"] = "1"
-    if not healthy:
+    if state != "tpu":
         env["JAX_PLATFORMS"] = "cpu"
-        env["_PADDLE_TPU_BENCH_FALLBACK"] = "tpu_backend_unhealthy"
+        # distinct labels: flaky chip vs a machine with no chip at all
+        env["_PADDLE_TPU_BENCH_FALLBACK"] = (
+            "tpu_backend_unhealthy" if state == "dead" else "no_tpu_backend")
         # CPU cannot train 345M in reasonable time; shrink unless pinned.
         env.setdefault("BENCH_MODEL", "gpt_tiny")
     if env.get("JAX_PLATFORMS", "") == "cpu":
@@ -99,7 +144,8 @@ def _run_bench() -> dict:
 
     model_name = os.environ.get("BENCH_MODEL", "gpt345m")
     steps = int(os.environ.get("BENCH_STEPS", "12"))
-    on_tpu = jax.default_backend() in ("tpu", "axon")
+    from paddle_tpu.flags import is_tpu_backend
+    on_tpu = is_tpu_backend()
 
     if model_name == "gpt345m":
         cfg = GPTConfig.gpt3_345m()
@@ -166,8 +212,14 @@ def _run_bench() -> dict:
     }
     fallback = os.environ.get("_PADDLE_TPU_BENCH_FALLBACK")
     if fallback:
+        # MFU against a nominal CPU peak is meaningless (VERDICT r2 weak
+        # #4): report throughput as the headline and null out the MFU.
         result["fallback"] = fallback
-        result["vs_baseline"] = 0.0  # CPU numbers don't count toward the target
+        result["vs_baseline"] = 0.0
+        result["mfu"] = None
+        result["metric"] = f"{model_name}_tokens_per_sec_cpu_fallback"
+        result["value"] = result["tokens_per_sec_per_chip"]
+        result["unit"] = "tokens_per_sec_per_chip"
     try:
         step.sync_to_model()  # training donated the old param buffers
         result.update(_decode_bench(model, cfg, paddle, jax))

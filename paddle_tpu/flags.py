@@ -138,3 +138,10 @@ define_flag("eager_delete_tensor_gb", 0.0, "API parity; JAX GC owns tensor lifet
 define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest.")
 define_flag("embedding_deterministic", 0, "API parity with reference embedding determinism flag.")
 define_flag("cudnn_deterministic", False, "API parity alias of FLAGS_deterministic.")
+
+
+def is_tpu_backend() -> bool:
+    """True when running on a real TPU — either the native "tpu" PJRT
+    backend or the axon tunnel plugin. Gates Pallas-kernel dispatch."""
+    import jax
+    return jax.default_backend() in ("tpu", "axon")

@@ -59,7 +59,8 @@ def _rep(x):
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from ..flags import is_tpu_backend
+    return not is_tpu_backend()
 
 
 def _dims(ref_shape):

@@ -23,7 +23,8 @@ _BLOCK_ROWS = 256
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from ..flags import is_tpu_backend
+    return not is_tpu_backend()
 
 
 def _fwd_kernel(x_ref, w_ref, y_ref, r_ref, *, eps: float):

@@ -567,7 +567,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     Dispatches to the Pallas flash-attention kernel on TPU when enabled."""
     from .. import flags
     if (flags.get_flag("use_pallas") and attn_mask is None and dropout_p == 0.0
-            and jax.default_backend() == "tpu"
+            and flags.is_tpu_backend()
             and query.shape[1] >= flags.get_flag("flash_attn_min_seqlen")):
         try:
             from ..kernels.flash_attention import flash_attention_bshd

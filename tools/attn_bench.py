@@ -15,9 +15,12 @@ def dense_bshd(q, k, v):
 
 def bench(fn, *args):
     # NB: jax.block_until_ready does not reliably block through the axon
-    # tunnel — time a jitted scalar and float() it (host transfer syncs)
+    # tunnel — time a jitted scalar and float() it (host transfer syncs).
+    # Sum ALL of dq/dk/dv: summing only dq lets XLA DCE prune the dk/dv
+    # backward kernels and understate the backward cost.
     loss = lambda *a: fn(*a).astype(jnp.float32).sum()
-    g = jax.jit(lambda *a: jax.grad(loss, argnums=(0, 1, 2))(*a)[0].sum())
+    g = jax.jit(lambda *a: sum(t.astype(jnp.float32).sum()
+                               for t in jax.grad(loss, argnums=(0, 1, 2))(*a)))
     float(g(*args))
     ts = []
     for _ in range(5):
@@ -36,4 +39,5 @@ for s in (1024, 2048, 4096, 8192):
     td = bench(dense_bshd, q, k, v)
     print(json.dumps({"seq": s, "batch": b, "flash_ms": round(tf*1e3, 2),
                       "dense_ms": round(td*1e3, 2),
-                      "speedup": round(td/tf, 2)}), flush=True)
+                      "speedup": round(td/tf, 2),
+                      "backend": jax.default_backend()}), flush=True)
