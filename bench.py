@@ -93,9 +93,13 @@ def _parent() -> int:
     env["_PADDLE_TPU_BENCH_CHILD"] = "1"
     if state != "tpu":
         env["JAX_PLATFORMS"] = "cpu"
-        # distinct labels: flaky chip vs a machine with no chip at all
+        # distinct labels: flaky chip vs a machine with no chip at all.
+        # On an expected-TPU machine even a clean 'cpu' probe is a flap
+        # (the plugin can fail init cleanly), never "no chip here".
         env["_PADDLE_TPU_BENCH_FALLBACK"] = (
-            "tpu_backend_unhealthy" if state == "dead" else "no_tpu_backend")
+            "tpu_backend_unhealthy"
+            if (state == "dead" or _tpu_expected(dict(os.environ)))
+            else "no_tpu_backend")
         # CPU cannot train 345M in reasonable time; shrink unless pinned.
         env.setdefault("BENCH_MODEL", "gpt_tiny")
     if env.get("JAX_PLATFORMS", "") == "cpu":

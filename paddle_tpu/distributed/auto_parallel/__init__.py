@@ -223,7 +223,17 @@ def dtensor_from_fn(fn, mesh: ProcessMesh, placements: Sequence[Placement],
 
 
 def reshard(x, mesh: ProcessMesh, placements: Sequence[Placement]):
-    """Re-distribute an existing (dist) tensor (reference: dist.reshard)."""
+    """Re-distribute an existing (dist) tensor (reference: dist.reshard).
+
+    Partial semantics (global view): a Partial tensor stores the GLOBAL
+    total — per-device partial contributions never exist at the eager
+    user level (XLA inserts the actual psum/reduce-scatter when the
+    pending-reduce annotation is consumed inside a jitted program). So
+    ``reshard(Partial -> Replicate)`` is value-preserving: the reduction
+    the reference performs across ranks is the identity on the stored
+    total, and only the placement metadata changes. Likewise
+    ``Partial -> Shard(d)`` re-lays-out the total (the reference's
+    reduce-scatter) without changing its value."""
     return shard_tensor(x, mesh, placements)
 
 

@@ -156,10 +156,12 @@ class PipelineTrainStep:
         # ---- flat params + shardings -------------------------------------
         params: Dict[str, Any] = {}
         specs: Dict[str, P] = {}
+        named_for_masks: Dict[str, Any] = {}  # key -> Parameter (wd masks)
 
         def add_layer_params(idx, layer):
             for rel, p in layer.named_parameters():
                 params[f"{idx}.{rel}"] = p._value
+                named_for_masks[f"{idx}.{rel}"] = p
                 specs[f"{idx}.{rel}"] = _mesh_filter_spec(
                     getattr(p, "dist_attr", None), mesh)
 
@@ -178,13 +180,13 @@ class PipelineTrainStep:
             add_layer_params(idx, pipe_layer.shared_layers[key])
 
         self._block_rels = [rel for rel, _ in self.template.named_parameters()]
+        tmpl_params = dict(self.template.named_parameters())
+        block_params = [dict(rf[j].named_parameters())
+                        for j in range(start, end)]
         for rel in self._block_rels:
-            leaves = []
-            for j in range(start, end):
-                leaves.append(dict(rf[j].named_parameters())[rel]._value)
+            leaves = [bp[rel]._value for bp in block_params]
             base = _mesh_filter_spec(
-                getattr(dict(self.template.named_parameters())[rel],
-                        "dist_attr", None), mesh)
+                getattr(tmpl_params[rel], "dist_attr", None), mesh)
             if self.V == 1:
                 stacked = jnp.stack(leaves).reshape(
                     (self.S, self.L) + leaves[0].shape)
@@ -198,6 +200,15 @@ class PipelineTrainStep:
                 stacked = jnp.swapaxes(stacked, 0, 1)
                 specs[_STACK_PREFIX + rel] = P("pp", None, None, *base)
             params[_STACK_PREFIX + rel] = stacked
+            # one wd scalar covers the whole stacked array, so the decay
+            # decision must be uniform across the stacked layers; the
+            # uniformity is CHECKED below in _check_stack_decay_uniform
+            # (a per-layer-divergent callback would otherwise be applied
+            # template-wide silently)
+            named_for_masks[_STACK_PREFIX + rel] = tmpl_params[rel]
+        self._stack_mask_params = {
+            _STACK_PREFIX + rel: [bp[rel] for bp in block_params]
+            for rel in self._block_rels}
 
         # ---- ZeRO composition (same resolution as hapi.TrainStep) --------
         level = sharding_level
@@ -229,6 +240,9 @@ class PipelineTrainStep:
         params = {k: jax.device_put(v, self.param_shardings[k])
                   for k, v in params.items()}
         self.params = params
+        if hasattr(optimizer, "resolve_decay_masks"):
+            optimizer.resolve_decay_masks(named_for_masks)
+            self._check_stack_decay_uniform(optimizer)
         self.opt_state = optimizer.init_state_tree(params)
         self.opt_state["slots"] = {
             k: jax.tree.map(
@@ -400,6 +414,27 @@ class PipelineTrainStep:
         self._step_count = 0
 
     # ------------------------------------------------------------ internals
+    def _check_stack_decay_uniform(self, optimizer) -> None:
+        """A stacked parameter gets ONE weight-decay scalar, so the
+        optimizer's exclusion decision must agree across every layer in
+        the stack. Divergence (e.g. a callback targeting one layer's
+        autogenerated name) would otherwise silently apply the template
+        layer's decision stack-wide."""
+        excl = getattr(optimizer, "_wd_exclusion", None)
+        if excl is None:
+            return
+        for key, plist in self._stack_mask_params.items():
+            decisions = {bool(optimizer._wd_excluded_for_param(p))
+                         for p in plist}
+            if len(decisions) > 1:
+                raise ValueError(
+                    f"weight-decay exclusion differs across the layers "
+                    f"stacked into {key!r}; pipeline stacking applies one "
+                    f"decay scalar per stacked tensor. Make the exclusion "
+                    f"structural (e.g. by parameter role/suffix) so it is "
+                    f"uniform across identical blocks.")
+            excl[key] = decisions.pop()
+
     def _run_entries(self, entries: List[Tuple[int, Any]], flat, x):
         """Apply prefix/suffix run_function entries functionally: parameter
         values come from ``flat``; shared (tied) entries read the OWNER's

@@ -142,6 +142,11 @@ class TrainStep:
             self.sharding_level, self.sharding_axis = 0, None
 
         self.params = params
+        if hasattr(optimizer, "resolve_decay_masks"):
+            # evaluate weight-decay exclusion callbacks against Parameters
+            # (eager contract) once, keyed by pytree key, so the jitted
+            # path applies the identical mask
+            optimizer.resolve_decay_masks(dict(model.named_parameters()))
         self.opt_state = optimizer.init_state_tree(params)
         if self.param_shardings is not None:
             # optimizer slots inherit their parameter's sharding, extended by

@@ -661,8 +661,17 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
     padding_ = (padding, padding) if isinstance(padding, int) else tuple(padding)
     op_ = ((output_padding, output_padding) if isinstance(output_padding, int)
            else tuple(output_padding))
-    if data_format != "NCHW":
-        raise NotImplementedError("conv2d_transpose: NCHW only")
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"conv2d_transpose: bad data_format {data_format}")
+    if data_format == "NHWC":
+        # transpose around the NCHW core: weights are layout-independent
+        # ([in, out/g, kh, kw]) and XLA folds the transposes into the conv
+        x_nchw = apply_op("nhwc_to_nchw", lambda a: a.transpose(0, 3, 1, 2), x)
+        out = conv2d_transpose(
+            x_nchw, weight, bias, stride=stride, padding=padding,
+            output_padding=output_padding, dilation=dilation, groups=groups,
+            data_format="NCHW", output_size=output_size)
+        return apply_op("nchw_to_nhwc", lambda a: a.transpose(0, 2, 3, 1), out)
     kh, kw = _val(weight).shape[2], _val(weight).shape[3]
     pads = tuple(
         (dilation[i] * (k - 1) - padding_[i],
@@ -690,18 +699,23 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
     return apply_op("conv2d_transpose", fn, *args)
 
 
-def _pool(x, kernel, stride, padding, reducer, init, data_format="NCHW", count_include_pad=True):
+def _pool(x, kernel, stride, padding, reducer, init, data_format="NCHW",
+          ceil_mode=False):
+    from .functional_extra import _ceil_extra
     kernel = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
     stride = tuple(stride) if not isinstance(stride, int) else (stride, stride)
     padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    spatial = x.shape[2:4] if data_format == "NCHW" else x.shape[1:3]
+    sp = tuple((p, p + _ceil_extra(L, k, s, p, ceil_mode))
+               for L, k, s, p in zip(spatial, kernel, stride, padding))
     if data_format == "NCHW":
         window = (1, 1) + kernel
         strides = (1, 1) + stride
-        pads = ((0, 0), (0, 0), (padding[0], padding[0]), (padding[1], padding[1]))
+        pads = ((0, 0), (0, 0)) + sp
     else:
         window = (1,) + kernel + (1,)
         strides = (1,) + stride + (1,)
-        pads = ((0, 0), (padding[0], padding[0]), (padding[1], padding[1]), (0, 0))
+        pads = ((0, 0),) + sp + ((0, 0),)
 
     def fn(a):
         return jax.lax.reduce_window(a, init, reducer, window, strides, pads)
@@ -712,7 +726,8 @@ def _pool(x, kernel, stride, padding, reducer, init, data_format="NCHW", count_i
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
     stride = stride or kernel_size
-    fn, *_ = _pool(x, kernel_size, stride, padding, jax.lax.max, -jnp.inf, data_format)
+    fn, *_ = _pool(x, kernel_size, stride, padding, jax.lax.max, -jnp.inf,
+                   data_format, ceil_mode)
     out = apply_op("max_pool2d", fn, x)
     if return_mask:
         raise NotImplementedError(
@@ -724,7 +739,8 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW", name=None):
     stride = stride or kernel_size
-    fn, window, strides, pads = _pool(x, kernel_size, stride, padding, jax.lax.add, 0.0, data_format)
+    fn, window, strides, pads = _pool(x, kernel_size, stride, padding,
+                                      jax.lax.add, 0.0, data_format, ceil_mode)
     def avg(a):
         s = fn(a)
         if divisor_override:

@@ -106,24 +106,63 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
 
 
 # ------------------------------------------------------------------- pools
-def _pool_nd(x, nd, kernel, stride, padding, reducer, init, fmt):
+def _ceil_extra(L, k, s, p, ceil_mode):
+    """Extra right-padding so reduce_window emits ceil((L+2p-k)/s)+1
+    positions instead of floor (paddle ceil_mode=True semantics). A window
+    that would START in the right padding is dropped (torch/paddle clamp) —
+    without it the final position is all-padding: -inf for max pool, 0/0
+    for exclusive avg."""
+    if not ceil_mode:
+        return 0
+    out_ceil = -(-(L + 2 * p - k) // s) + 1
+    if (out_ceil - 1) * s >= L + p:
+        out_ceil -= 1
+    return max(0, (out_ceil - 1) * s + k - (L + 2 * p))
+
+
+def _pool_nd(x, nd, kernel, stride, padding, reducer, init, fmt,
+             ceil_mode=False):
     kernel = (kernel,) * nd if isinstance(kernel, int) else tuple(kernel)
     stride = (stride,) * nd if isinstance(stride, int) else tuple(stride)
     padding = (padding,) * nd if isinstance(padding, int) else tuple(padding)
     channels_last = fmt.endswith("C")
+    spatial = x.shape[-nd - 1:-1] if channels_last else x.shape[-nd:]
+    sp = tuple((p, p + _ceil_extra(L, k, s, p, ceil_mode))
+               for L, k, s, p in zip(spatial, kernel, stride, padding))
     if channels_last:
         window = (1,) + kernel + (1,)
         strides = (1,) + stride + (1,)
-        pads = ((0, 0),) + tuple((p, p) for p in padding) + ((0, 0),)
+        pads = ((0, 0),) + sp + ((0, 0),)
     else:
         window = (1, 1) + kernel
         strides = (1, 1) + stride
-        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding)
+        pads = ((0, 0), (0, 0)) + sp
 
     def fn(a):
         return jax.lax.reduce_window(a, init, reducer, window, strides, pads)
 
-    return fn
+    return fn, window, strides, pads
+
+
+def _avg_pool_nd(x, nd, op_name, kernel_size, stride, padding, exclusive,
+                 ceil_mode, data_format):
+    """exclusive=True (reference default) divides each window by the count
+    of REAL elements in it — padding (incl. ceil_mode extra) never enters
+    the denominator. exclusive=False divides by the full kernel size."""
+    fn, window, strides, pads = _pool_nd(
+        x, nd, kernel_size, stride or kernel_size, padding,
+        jax.lax.add, 0.0, data_format, ceil_mode)
+
+    def avg(a):
+        s = fn(a)
+        if exclusive:
+            cnt = jax.lax.reduce_window(jnp.ones_like(a), 0.0, jax.lax.add,
+                                        window, strides, pads)
+            return s / cnt
+        k = np.prod([w for w in window if w > 1]) or 1
+        return s / k
+
+    return apply_op(op_name, avg, x)
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -131,35 +170,30 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
     if return_mask:
         raise NotImplementedError("max_pool1d(return_mask=True): use "
                                   "unfold + argmax on TPU")
-    fn = _pool_nd(x, 1, kernel_size, stride or kernel_size, padding,
-                  jax.lax.max, -jnp.inf, data_format)
+    fn, *_ = _pool_nd(x, 1, kernel_size, stride or kernel_size, padding,
+                      jax.lax.max, -jnp.inf, data_format, ceil_mode)
     return apply_op("max_pool1d", fn, x)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
                ceil_mode=False, data_format="NCL", name=None):
-    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
-    fn = _pool_nd(x, 1, kernel_size, stride or kernel_size, padding,
-                  jax.lax.add, 0.0, data_format)
-    return apply_op("avg_pool1d", lambda a: fn(a) / k, x)
+    return _avg_pool_nd(x, 1, "avg_pool1d", kernel_size, stride, padding,
+                        exclusive, ceil_mode, data_format)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     if return_mask:
         raise NotImplementedError("max_pool3d(return_mask=True)")
-    fn = _pool_nd(x, 3, kernel_size, stride or kernel_size, padding,
-                  jax.lax.max, -jnp.inf, data_format)
+    fn, *_ = _pool_nd(x, 3, kernel_size, stride or kernel_size, padding,
+                      jax.lax.max, -jnp.inf, data_format, ceil_mode)
     return apply_op("max_pool3d", fn, x)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, data_format="NCDHW", name=None):
-    ks = _triple(kernel_size)
-    denom = float(np.prod(ks))
-    fn = _pool_nd(x, 3, kernel_size, stride or kernel_size, padding,
-                  jax.lax.add, 0.0, data_format)
-    return apply_op("avg_pool3d", lambda a: fn(a) / denom, x)
+    return _avg_pool_nd(x, 3, "avg_pool3d", kernel_size, stride, padding,
+                        exclusive, ceil_mode, data_format)
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):

@@ -356,7 +356,8 @@ class AvgPool2D(Layer):
                  exclusive=True, divisor_override=None, data_format="NCHW", name=None):
         super().__init__()
         self._kw = dict(kernel_size=kernel_size, stride=stride, padding=padding,
-                        exclusive=exclusive, divisor_override=divisor_override,
+                        ceil_mode=ceil_mode, exclusive=exclusive,
+                        divisor_override=divisor_override,
                         data_format=data_format)
 
     def forward(self, x):

@@ -95,6 +95,9 @@ class _Pool(Layer):
     def __init__(self, kernel_size, stride=None, padding=0, **kw):
         super().__init__()
         self._k, self._s, self._p = kernel_size, stride, padding
+        # ceil_mode / exclusive / data_format ride through to the functional
+        kw.pop("name", None)
+        self._kw = kw
 
     def extra_repr(self):
         return f"kernel_size={self._k}, stride={self._s}, padding={self._p}"
@@ -102,22 +105,22 @@ class _Pool(Layer):
 
 class MaxPool1D(_Pool):
     def forward(self, x):
-        return F.max_pool1d(x, self._k, self._s, self._p)
+        return F.max_pool1d(x, self._k, self._s, self._p, **self._kw)
 
 
 class AvgPool1D(_Pool):
     def forward(self, x):
-        return F.avg_pool1d(x, self._k, self._s, self._p)
+        return F.avg_pool1d(x, self._k, self._s, self._p, **self._kw)
 
 
 class MaxPool3D(_Pool):
     def forward(self, x):
-        return F.max_pool3d(x, self._k, self._s, self._p)
+        return F.max_pool3d(x, self._k, self._s, self._p, **self._kw)
 
 
 class AvgPool3D(_Pool):
     def forward(self, x):
-        return F.avg_pool3d(x, self._k, self._s, self._p)
+        return F.avg_pool3d(x, self._k, self._s, self._p, **self._kw)
 
 
 class AdaptiveAvgPool1D(Layer):

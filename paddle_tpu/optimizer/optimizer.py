@@ -74,13 +74,19 @@ class Optimizer:
             raise ValueError("optimizer created without a parameter list")
         return [p for p in self._parameter_list if not p.stop_gradient]
 
+    def _wd_excluded_for_param(self, p: Parameter) -> bool:
+        """Whether this Parameter is exempt from weight decay. Single source
+        of truth for BOTH the eager step() path and (via
+        ``resolve_decay_masks``) the jitted functional path — subclasses
+        override this, not the two paths separately, so user exclusion
+        callbacks always see the eager-contract argument (Parameter or
+        p.name), never a pytree key (advisor r2 finding)."""
+        return bool(getattr(p, "no_weight_decay", False))
+
     def _decay_for(self, p: Parameter) -> float:
-        wd = self._weight_decay
-        if hasattr(wd, "__call__") and not isinstance(wd, (int, float)):
-            return float(wd())
-        if getattr(p, "no_weight_decay", False):
+        if self._wd_excluded_for_param(p):
             return 0.0
-        return float(wd)
+        return self._wd_value()
 
     def step(self):
         params = self._params()
@@ -170,9 +176,27 @@ class Optimizer:
             return float(wd())
         return float(wd)
 
+    def resolve_decay_masks(self, named_params: Dict[str, Parameter]):
+        """Pre-resolve the per-parameter decay-exclusion mask keyed by
+        pytree key, evaluating user callbacks with their eager-contract
+        argument (the Parameter). Called by TrainStep before
+        ``init_state_tree``; after this, ``_wd_for_key`` is an exact mirror
+        of the eager ``_decay_for``."""
+        self._wd_exclusion = {
+            k: self._wd_excluded_for_param(p) for k, p in named_params.items()}
+
     def _wd_for_key(self, key: str) -> float:
-        """Per-parameter weight decay in the functional path (override for
-        name-based exclusion, e.g. LARS exclude_from_weight_decay)."""
+        """Per-parameter weight decay in the functional path. Uses the
+        mask pre-resolved from Parameters when available; subclasses
+        provide a key-string fallback for standalone functional use
+        (functional_update without a TrainStep/model)."""
+        excl = getattr(self, "_wd_exclusion", None)
+        if excl is not None:
+            return 0.0 if excl.get(key, False) else self._wd_value()
+        return self._wd_fallback_for_key(key)
+
+    def _wd_fallback_for_key(self, key: str) -> float:
+        """Key-string exclusion fallback (no Parameter available)."""
         return self._wd_value()
 
     # ------------------------------------------------------------ state dict
@@ -299,16 +323,19 @@ class AdamW(Adam):
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio
 
-    def _decay_for(self, p):
-        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
-            return 0.0
-        return super()._decay_for(p)
+    def _wd_excluded_for_param(self, p):
+        # reference contract: apply_decay_param_fun receives p.name
+        if (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(p.name)):
+            return True
+        return super()._wd_excluded_for_param(p)
 
-    def _wd_for_key(self, key):
-        # functional/jit path sees pytree keys (dotted state-dict paths)
-        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(key):
+    def _wd_fallback_for_key(self, key):
+        # standalone functional use only: the callback sees the pytree key
+        if (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(key)):
             return 0.0
-        return super()._wd_for_key(key)
+        return super()._wd_fallback_for_key(key)
 
 
 class Adamax(Optimizer):
@@ -421,17 +448,23 @@ class Lamb(Optimizer):
         return {"moment1": jnp.zeros_like(p_val, dtype=jnp.float32),
                 "moment2": jnp.zeros_like(p_val, dtype=jnp.float32)}
 
-    def _decay_for(self, p):
+    def _wd_excluded_for_param(self, p):
+        # reference contract: exclude_from_weight_decay_fn receives the
+        # Parameter itself (python/paddle/optimizer/lamb.py)
         if self._exclude_fn is not None and self._exclude_fn(p):
-            return 0.0
-        return super()._decay_for(p)
+            return True
+        return super()._wd_excluded_for_param(p)
 
-    def _wd_for_key(self, key):
-        # functional/jit path has only the pytree key, not the Parameter;
-        # the exclude fn receives the key string there
-        if self._exclude_fn is not None and self._exclude_fn(key):
-            return 0.0
-        return super()._wd_for_key(key)
+    def _wd_fallback_for_key(self, key):
+        if self._exclude_fn is not None:
+            # the callback takes a Parameter; silently applying full decay
+            # (or passing it a str) would corrupt numerics without warning
+            raise RuntimeError(
+                "Lamb.exclude_from_weight_decay_fn takes a Parameter, which "
+                "the standalone functional path does not have — call "
+                "resolve_decay_masks(named_params) before functional_update "
+                "(TrainStep does this automatically)")
+        return super()._wd_fallback_for_key(key)
 
     def apply_one(self, p, g, slots, lr, t, wd):
         g32 = g.astype(jnp.float32)
@@ -469,12 +502,12 @@ class LarsMomentum(Optimizer):
     def init_slot(self, p_val):
         return {"velocity": jnp.zeros_like(p_val, dtype=jnp.float32)}
 
-    def _decay_for(self, p) -> float:
+    def _wd_excluded_for_param(self, p) -> bool:
         if any(s in (p.name or "") for s in self._exclude):
-            return 0.0
-        return super()._decay_for(p)
+            return True
+        return super()._wd_excluded_for_param(p)
 
-    def _wd_for_key(self, key: str) -> float:
+    def _wd_fallback_for_key(self, key: str) -> float:
         if any(s in key for s in self._exclude):
             return 0.0
         return self._wd_value()

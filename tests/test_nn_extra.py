@@ -323,3 +323,112 @@ def test_conv2d_transpose_grouped_dilated_matches_torch():
                               dilation=2, groups=2)
     np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
                                atol=1e-4)
+
+
+def test_avg_pool_exclusive_and_ceil_match_torch():
+    """exclusive=True (default) must exclude padded zeros from the window
+    denominator (torch count_include_pad=False); ceil_mode adds the final
+    partial window — advisor r2 finding."""
+    rng = np.random.default_rng(7)
+    x1 = rng.standard_normal((2, 3, 11)).astype(np.float32)
+    np.testing.assert_allclose(
+        F.avg_pool1d(paddle.to_tensor(x1), 4, 2, 1).numpy(),
+        TF.avg_pool1d(_t(x1), 4, 2, 1, count_include_pad=False).numpy(),
+        rtol=RT, atol=AT)
+    np.testing.assert_allclose(
+        F.avg_pool1d(paddle.to_tensor(x1), 4, 2, 1, exclusive=False).numpy(),
+        TF.avg_pool1d(_t(x1), 4, 2, 1, count_include_pad=True).numpy(),
+        rtol=RT, atol=AT)
+    np.testing.assert_allclose(
+        F.avg_pool1d(paddle.to_tensor(x1), 3, 2, 1, ceil_mode=True).numpy(),
+        TF.avg_pool1d(_t(x1), 3, 2, 1, ceil_mode=True,
+                      count_include_pad=False).numpy(),
+        rtol=RT, atol=AT)
+
+    x2 = rng.standard_normal((2, 3, 9, 11)).astype(np.float32)
+    np.testing.assert_allclose(
+        F.avg_pool2d(paddle.to_tensor(x2), 3, 2, 1, ceil_mode=True).numpy(),
+        TF.avg_pool2d(_t(x2), 3, 2, 1, ceil_mode=True,
+                      count_include_pad=False).numpy(),
+        rtol=RT, atol=AT)
+    np.testing.assert_allclose(
+        F.max_pool2d(paddle.to_tensor(x2), 3, 2, 1, ceil_mode=True).numpy(),
+        TF.max_pool2d(_t(x2), 3, 2, 1, ceil_mode=True).numpy(),
+        rtol=RT, atol=AT)
+
+    x3 = rng.standard_normal((2, 3, 7, 8, 9)).astype(np.float32)
+    np.testing.assert_allclose(
+        F.avg_pool3d(paddle.to_tensor(x3), 3, 2, 1).numpy(),
+        TF.avg_pool3d(_t(x3), 3, 2, 1, count_include_pad=False).numpy(),
+        rtol=RT, atol=AT)
+    # exclusive=False + ceil_mode: paddle divides by the FULL kernel size
+    # even in the ceil-added partial window (torch clips the divisor there,
+    # so compare against a manual sum/k^3 instead)
+    out = F.avg_pool3d(paddle.to_tensor(x3), 2, 2, 0, ceil_mode=True,
+                       exclusive=False).numpy()
+    pad = np.zeros((2, 3, 8, 8, 10), np.float32)
+    pad[:, :, :7, :8, :9] = x3
+    man = np.zeros_like(out)
+    for i in range(out.shape[2]):
+        for j in range(out.shape[3]):
+            for l in range(out.shape[4]):
+                man[:, :, i, j, l] = pad[:, :, 2*i:2*i+2, 2*j:2*j+2,
+                                         2*l:2*l+2].sum(axis=(2, 3, 4)) / 8
+    np.testing.assert_allclose(out, man, rtol=RT, atol=AT)
+    np.testing.assert_allclose(
+        F.max_pool3d(paddle.to_tensor(x3), 3, 2, 1, ceil_mode=True).numpy(),
+        TF.max_pool3d(_t(x3), 3, 2, 1, ceil_mode=True).numpy(),
+        rtol=RT, atol=AT)
+
+
+def test_conv2d_transpose_nhwc():
+    """NHWC accepted again (advisor r2: regressed to hard error) — must
+    equal the NCHW result transposed."""
+    rng = np.random.default_rng(13)
+    x = rng.standard_normal((2, 6, 7, 8)).astype(np.float32)
+    w = rng.standard_normal((6, 4, 3, 3)).astype(np.float32)
+    b = rng.standard_normal((4,)).astype(np.float32)
+    ref = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                             paddle.to_tensor(b), stride=2, padding=1)
+    out = F.conv2d_transpose(
+        paddle.to_tensor(x.transpose(0, 2, 3, 1)), paddle.to_tensor(w),
+        paddle.to_tensor(b), stride=2, padding=1, data_format="NHWC")
+    np.testing.assert_allclose(out.numpy().transpose(0, 3, 1, 2),
+                               ref.numpy(), rtol=RT, atol=AT)
+
+
+def test_pool_layers_pass_ceil_and_exclusive_through():
+    """Pool LAYERS must forward ceil_mode/exclusive to the functionals
+    (they silently dropped them before)."""
+    import paddle_tpu.nn as pnn
+    rng = np.random.default_rng(3)
+    x2 = paddle.to_tensor(rng.standard_normal((1, 2, 5, 5)).astype(np.float32))
+    assert pnn.AvgPool2D(2, 2, ceil_mode=True)(x2).shape[-2:] == [3, 3]
+    assert pnn.MaxPool2D(2, 2, ceil_mode=True)(x2).shape[-2:] == [3, 3]
+    x1 = paddle.to_tensor(rng.standard_normal((1, 2, 5)).astype(np.float32))
+    assert pnn.AvgPool1D(2, 2, ceil_mode=True)(x1).shape[-1] == 3
+    assert pnn.MaxPool1D(2, 2, ceil_mode=True)(x1).shape[-1] == 3
+    x3 = paddle.to_tensor(rng.standard_normal((1, 2, 5, 5, 5)).astype(np.float32))
+    assert pnn.AvgPool3D(2, 2, ceil_mode=True)(x3).shape[-3:] == [3, 3, 3]
+    assert pnn.MaxPool3D(2, 2, ceil_mode=True)(x3).shape[-3:] == [3, 3, 3]
+    # exclusive riding through: padded edge window divided by real count
+    xp = paddle.to_tensor(np.ones((1, 1, 4), np.float32))
+    out = pnn.AvgPool1D(3, 2, 1)(xp)  # exclusive=True default
+    np.testing.assert_allclose(out.numpy().ravel(), [1.0, 1.0], rtol=1e-6)
+
+
+def test_ceil_mode_drops_window_starting_in_right_pad():
+    """torch/paddle clamp: a ceil-mode window starting entirely in right
+    padding is dropped (else max pool emits -inf / exclusive avg 0/0)."""
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((1, 1, 5, 5)).astype(np.float32)
+    out = F.max_pool2d(paddle.to_tensor(x), 2, 2, 1, ceil_mode=True)
+    ref = TF.max_pool2d(_t(x), 2, 2, 1, ceil_mode=True)
+    assert tuple(out.shape) == tuple(ref.shape) == (1, 1, 3, 3)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=RT)
+    av = F.avg_pool2d(paddle.to_tensor(x), 2, 2, 1, ceil_mode=True)
+    assert np.isfinite(av.numpy()).all()
+    np.testing.assert_allclose(
+        av.numpy(),
+        TF.avg_pool2d(_t(x), 2, 2, 1, ceil_mode=True,
+                      count_include_pad=False).numpy(), rtol=RT, atol=AT)
