@@ -699,59 +699,24 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
     return apply_op("conv2d_transpose", fn, *args)
 
 
-def _pool(x, kernel, stride, padding, reducer, init, data_format="NCHW",
-          ceil_mode=False):
-    from .functional_extra import _ceil_extra
-    kernel = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
-    stride = tuple(stride) if not isinstance(stride, int) else (stride, stride)
-    padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
-    spatial = x.shape[2:4] if data_format == "NCHW" else x.shape[1:3]
-    sp = tuple((p, p + _ceil_extra(L, k, s, p, ceil_mode))
-               for L, k, s, p in zip(spatial, kernel, stride, padding))
-    if data_format == "NCHW":
-        window = (1, 1) + kernel
-        strides = (1, 1) + stride
-        pads = ((0, 0), (0, 0)) + sp
-    else:
-        window = (1,) + kernel + (1,)
-        strides = (1,) + stride + (1,)
-        pads = ((0, 0),) + sp + ((0, 0),)
-
-    def fn(a):
-        return jax.lax.reduce_window(a, init, reducer, window, strides, pads)
-
-    return fn, window, strides, pads
-
-
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
-    stride = stride or kernel_size
-    fn, *_ = _pool(x, kernel_size, stride, padding, jax.lax.max, -jnp.inf,
-                   data_format, ceil_mode)
-    out = apply_op("max_pool2d", fn, x)
+    # single source for pool padding/ceil semantics: functional_extra
+    from .functional_extra import _pool_nd
     if return_mask:
         raise NotImplementedError(
             "max_pool2d(return_mask=True) is not implemented on TPU; "
             "use unfold + argmax if indices are required")
-    return out
+    fn, *_ = _pool_nd(_val(x), 2, kernel_size, stride or kernel_size,
+                      padding, jax.lax.max, -jnp.inf, data_format, ceil_mode)
+    return apply_op("max_pool2d", fn, x)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW", name=None):
-    stride = stride or kernel_size
-    fn, window, strides, pads = _pool(x, kernel_size, stride, padding,
-                                      jax.lax.add, 0.0, data_format, ceil_mode)
-    def avg(a):
-        s = fn(a)
-        if divisor_override:
-            return s / divisor_override
-        if exclusive:
-            ones = jnp.ones_like(a)
-            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
-            return s / cnt
-        k = np.prod([w for w in window if w > 1]) or 1
-        return s / k
-    return apply_op("avg_pool2d", avg, x)
+    from .functional_extra import _avg_pool_nd
+    return _avg_pool_nd(x, 2, "avg_pool2d", kernel_size, stride, padding,
+                        exclusive, ceil_mode, data_format, divisor_override)
 
 
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):

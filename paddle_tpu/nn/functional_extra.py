@@ -145,7 +145,7 @@ def _pool_nd(x, nd, kernel, stride, padding, reducer, init, fmt,
 
 
 def _avg_pool_nd(x, nd, op_name, kernel_size, stride, padding, exclusive,
-                 ceil_mode, data_format):
+                 ceil_mode, data_format, divisor_override=None):
     """exclusive=True (reference default) divides each window by the count
     of REAL elements in it — padding (incl. ceil_mode extra) never enters
     the denominator. exclusive=False divides by the full kernel size."""
@@ -155,12 +155,13 @@ def _avg_pool_nd(x, nd, op_name, kernel_size, stride, padding, exclusive,
 
     def avg(a):
         s = fn(a)
+        if divisor_override:
+            return s / divisor_override
         if exclusive:
             cnt = jax.lax.reduce_window(jnp.ones_like(a), 0.0, jax.lax.add,
                                         window, strides, pads)
             return s / cnt
-        k = np.prod([w for w in window if w > 1]) or 1
-        return s / k
+        return s / float(np.prod(window))
 
     return apply_op(op_name, avg, x)
 
