@@ -48,6 +48,7 @@ from . import io  # noqa: F401
 from . import metric  # noqa: F401
 from . import autograd  # noqa: F401
 from .framework.io import load, save  # noqa: F401
+from .framework.lazy import LazyGuard  # noqa: F401
 from . import distributed  # noqa: F401
 from . import hapi  # noqa: F401
 from . import models  # noqa: F401

@@ -62,7 +62,10 @@ class Tensor:
     ):
         if isinstance(value, Tensor):
             value = value._value
-        if not isinstance(value, jax.Array) and not isinstance(value, jax.core.Tracer):
+        if isinstance(value, jax.ShapeDtypeStruct):
+            # meta tensor (LazyGuard): shape+dtype metadata, no storage
+            pass
+        elif not isinstance(value, jax.Array) and not isinstance(value, jax.core.Tracer):
             value = jnp.asarray(value, dtype=to_jax_dtype(dtype))
         elif dtype is not None and jnp.result_type(value) != to_jax_dtype(dtype):
             value = value.astype(to_jax_dtype(dtype))

@@ -106,12 +106,29 @@ class PipelineTrainStep:
                  remat: bool = True, donate: bool = True,
                  sharding_level: Optional[int] = None,
                  sharding_axis: Optional[str] = None,
-                 virtual_pp_degree: int = 1):
+                 virtual_pp_degree: int = 1,
+                 abstract: bool = False, param_dtype=None,
+                 lowering_platform: str = "tpu"):
+        """``abstract=True`` builds the FULL sharded program over
+        ``jax.ShapeDtypeStruct`` parameters (no arrays are ever
+        materialized or placed): ``mesh`` may then be a
+        ``jax.sharding.AbstractMesh`` of any size — e.g. a simulated
+        v5p-128 — and ``lower()`` produces the StableHLO for
+        ``lowering_platform``. ``param_dtype`` overrides the parameter
+        dtype (bf16 params + f32 master weights is the TPU recipe)
+        without touching data. Abstract steps cannot run — only lower."""
         if "pp" not in mesh.shape:
             raise ValueError("mesh has no 'pp' axis")
         self.pipe_layer = pipe_layer
         self.optimizer = optimizer
         self.mesh = mesh
+        self._abstract = bool(abstract)
+        self._lowering_platform = lowering_platform
+        donate = donate and not abstract
+        if param_dtype is not None and not abstract:
+            raise ValueError(
+                "param_dtype is only applied in abstract mode; for a live "
+                "step cast the model first (model.to(dtype=...))")
         self.S = mesh.shape["pp"]
         self.M = int(num_microbatches)
         self.V = int(virtual_pp_degree)
@@ -183,22 +200,34 @@ class PipelineTrainStep:
         tmpl_params = dict(self.template.named_parameters())
         block_params = [dict(rf[j].named_parameters())
                         for j in range(start, end)]
+
+        def _pdt(dtype):
+            return jnp.dtype(param_dtype) if param_dtype else jnp.dtype(dtype)
+
         for rel in self._block_rels:
-            leaves = [bp[rel]._value for bp in block_params]
             base = _mesh_filter_spec(
                 getattr(tmpl_params[rel], "dist_attr", None), mesh)
+            leaf_shape = tuple(tmpl_params[rel].shape)
             if self.V == 1:
-                stacked = jnp.stack(leaves).reshape(
-                    (self.S, self.L) + leaves[0].shape)
+                shp = (self.S, self.L) + leaf_shape
                 specs[_STACK_PREFIX + rel] = P("pp", None, *base)
             else:
                 # interleaved: depth chunk c = v*S + s lives on device s as
                 # virtual chunk v (Megatron VPP assignment: device s holds
                 # chunks {s, s+S, ...}) -> layout (S, V, L, *shape)
-                stacked = jnp.stack(leaves).reshape(
-                    (self.V, self.S, self.L) + leaves[0].shape)
-                stacked = jnp.swapaxes(stacked, 0, 1)
+                shp = (self.S, self.V, self.L) + leaf_shape
                 specs[_STACK_PREFIX + rel] = P("pp", None, None, *base)
+            if abstract:
+                stacked = jax.ShapeDtypeStruct(
+                    shp, _pdt(tmpl_params[rel]._value.dtype))
+            else:
+                leaves = [bp[rel]._value for bp in block_params]
+                if self.V == 1:
+                    stacked = jnp.stack(leaves).reshape(shp)
+                else:
+                    stacked = jnp.stack(leaves).reshape(
+                        (self.V, self.S) + shp[2:])
+                    stacked = jnp.swapaxes(stacked, 0, 1)
             params[_STACK_PREFIX + rel] = stacked
             # one wd scalar covers the whole stacked array, so the decay
             # decision must be uniform across the stacked layers; the
@@ -237,22 +266,31 @@ class PipelineTrainStep:
         else:
             self.opt_shardings = dict(self.param_shardings)
 
-        params = {k: jax.device_put(v, self.param_shardings[k])
-                  for k, v in params.items()}
+        if abstract:
+            # re-struct every leaf so param_dtype applies uniformly (lazy
+            # meta params arrive as f32 ShapeDtypeStructs)
+            params = {k: jax.ShapeDtypeStruct(tuple(v.shape), _pdt(v.dtype))
+                      for k, v in params.items()}
+        else:
+            params = {k: jax.device_put(v, self.param_shardings[k])
+                      for k, v in params.items()}
         self.params = params
         if hasattr(optimizer, "resolve_decay_masks"):
             optimizer.resolve_decay_masks(named_for_masks)
             self._check_stack_decay_uniform(optimizer)
-        self.opt_state = optimizer.init_state_tree(params)
-        self.opt_state["slots"] = {
-            k: jax.tree.map(
-                lambda s, _k=k: jax.device_put(s, self.opt_shardings[_k]),
-                slot)
-            for k, slot in self.opt_state["slots"].items()}
-        if self.opt_state.get("master"):
-            self.opt_state["master"] = {
-                k: jax.device_put(v, self.opt_shardings[k])
-                for k, v in self.opt_state["master"].items()}
+        if abstract:
+            self.opt_state = jax.eval_shape(optimizer.init_state_tree, params)
+        else:
+            self.opt_state = optimizer.init_state_tree(params)
+            self.opt_state["slots"] = {
+                k: jax.tree.map(
+                    lambda s, _k=k: jax.device_put(s, self.opt_shardings[_k]),
+                    slot)
+                for k, slot in self.opt_state["slots"].items()}
+            if self.opt_state.get("master"):
+                self.opt_state["master"] = {
+                    k: jax.device_put(v, self.opt_shardings[k])
+                    for k, v in self.opt_state["master"].items()}
 
         # data + activation shardings
         data_axes = tuple(a for a in ("dp", "sharding")
@@ -409,9 +447,74 @@ class PipelineTrainStep:
                     for k, v in new_state["master"].items()}
             return loss, new_params, new_state
 
-        self._jit_step = jax.jit(
-            step, donate_argnums=(0, 1) if donate else ())
+        if abstract:
+            # ShapeDtypeStruct args carry no placement — pin every input's
+            # sharding explicitly so the lowering is the real SPMD program
+            rep = NamedSharding(mesh, P())
+            opt_sh_tree = {
+                "slots": {
+                    k: jax.tree.map(lambda _, s=self.opt_shardings[k]: s,
+                                    slot)
+                    for k, slot in self.opt_state["slots"].items()},
+                "t": rep,
+                "master": (
+                    {k: self.opt_shardings[k]
+                     for k in self.opt_state["master"]}
+                    if self.opt_state.get("master") is not None else None),
+            }
+            self._jit_step = jax.jit(
+                step,
+                in_shardings=(self.param_shardings, opt_sh_tree, rep,
+                              self._data_sharding, self._data_sharding))
+        else:
+            self._jit_step = jax.jit(
+                step, donate_argnums=(0, 1) if donate else ())
         self._step_count = 0
+
+    # ------------------------------------------------------- abstract mode
+    def lower(self, inputs: jax.ShapeDtypeStruct,
+              labels: jax.ShapeDtypeStruct):
+        """Trace + lower the full sharded train step for the target
+        platform (abstract mode). Works from any host — no devices of the
+        target platform are needed."""
+        lr = jax.ShapeDtypeStruct((), jnp.float32)
+        traced = self._jit_step.trace(self.params, self.opt_state, lr,
+                                      inputs, labels)
+        return traced.lower(
+            lowering_platforms=(self._lowering_platform,))
+
+    def per_device_state_bytes(self) -> Dict[str, int]:
+        """Analytic per-device bytes of the resident training state
+        (params + optimizer slots + master weights), from the sharding
+        table — the HBM-fit check for a target topology."""
+
+        def shard_bytes(sds, sharding):
+            # per-dim ceil division: a dim not divisible by its mesh axes
+            # pads up on device, so flat total//prod would UNDERcount and
+            # let a topology pass the fit check yet OOM on hardware
+            n = 1
+            spec = sharding.spec
+            for i, dim in enumerate(sds.shape):
+                denom = 1
+                if i < len(spec) and spec[i] is not None:
+                    entry = spec[i]
+                    for name in ((entry,) if isinstance(entry, str)
+                                 else entry):
+                        denom *= self.mesh.shape[name]
+                n *= -(-dim // denom)
+            return n * jnp.dtype(sds.dtype).itemsize
+
+        out = {"params": 0, "slots": 0, "master": 0}
+        for k, v in self.params.items():
+            out["params"] += shard_bytes(v, self.param_shardings[k])
+        for k, slot in self.opt_state["slots"].items():
+            for leaf in jax.tree.leaves(slot):
+                out["slots"] += shard_bytes(leaf, self.opt_shardings[k])
+        if self.opt_state.get("master") is not None:
+            for k, v in self.opt_state["master"].items():
+                out["master"] += shard_bytes(v, self.opt_shardings[k])
+        out["total"] = out["params"] + out["slots"] + out["master"]
+        return out
 
     # ------------------------------------------------------------ internals
     def _check_stack_decay_uniform(self, optimizer) -> None:
@@ -461,6 +564,9 @@ class PipelineTrainStep:
 
     # -------------------------------------------------------------- running
     def __call__(self, inputs, labels) -> Tensor:
+        if self._abstract:
+            raise RuntimeError("abstract PipelineTrainStep holds no arrays; "
+                               "use lower() / per_device_state_bytes()")
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         iv, lv = tree_to_values(inputs), tree_to_values(labels)
         iv = jax.device_put(iv, self._data_sharding)

@@ -1,0 +1,35 @@
+"""Lazy (meta) parameter initialization.
+
+Reference: paddle.LazyGuard (python/paddle/nn/initializer/lazy_init.py) —
+construct arbitrarily large models without allocating parameter memory.
+Inside the guard, ``Layer.create_parameter`` skips the initializer and
+stores a ``jax.ShapeDtypeStruct`` as the Parameter value (a meta tensor:
+shape + dtype, zero bytes). Consumers that only need structure — abstract
+program lowering (``PipelineTrainStep(abstract=True)``), sharding planners,
+``jit.save`` input specs — work unchanged; running compute on a lazy model
+raises naturally until the values are materialized (e.g. by a checkpoint
+load or ``Layer.load_raw_state``).
+"""
+
+from __future__ import annotations
+
+_LAZY = False
+
+
+class LazyGuard:
+    """Context manager: parameters created inside are meta tensors."""
+
+    def __enter__(self):
+        global _LAZY
+        self._prev = _LAZY
+        _LAZY = True
+        return self
+
+    def __exit__(self, *exc):
+        global _LAZY
+        _LAZY = self._prev
+        return False
+
+
+def in_lazy_init() -> bool:
+    return _LAZY

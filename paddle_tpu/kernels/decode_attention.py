@@ -106,12 +106,19 @@ def cached_attention_dense(q, k_cache, v_cache, cur_len,
 
 
 # ===================================================== flash prefill kernel
-def _prefill_kernel(off_ref, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
-                    sm_scale: float):
+def _prefill_kernel(off_ref, q_ref, k_ref, v_ref, out_ref,
+                    acc_ref, m_ref, l_ref, *, sm_scale: float, n_k: int):
     """Online-softmax prefill block step. ``off_ref`` (scalar prefetch)
     holds the absolute position of q row 0 (= cur_len - S): the causal
     mask ``kv_pos <= q_pos + offset`` also subsumes the valid-length mask,
-    since every q row's absolute position is < cur_len <= T."""
+    since every q row's absolute position is < cur_len <= T.
+
+    The softmax stats and the f32 accumulator live in VMEM scratch (they
+    persist across the sequential kv sweep); only the normalized output —
+    written on the LAST kv block this row runs — ever reaches HBM. An
+    earlier revision emitted lane-replicated (BH, S, 128) f32 stats as
+    outputs: 128x the bytes actually needed, the exact transient f6d4e2a
+    removed from flash_attention."""
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     block_q, d = q_ref.shape[1], q_ref.shape[2]
@@ -120,12 +127,13 @@ def _prefill_kernel(off_ref, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(kj == 0)
     def _init():
-        acc_ref[0] = jnp.zeros_like(acc_ref[0])
-        m_ref[0] = jnp.full_like(m_ref[0], _NEG_INF)
-        l_ref[0] = jnp.zeros_like(l_ref[0])
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
     # skip kv blocks strictly above the (offset-shifted) causal diagonal
-    run = kj * block_k <= qi * block_q + block_q - 1 + offset
+    last_valid = qi * block_q + block_q - 1 + offset
+    run = kj * block_k <= last_valid
 
     @pl.when(run)
     def _step():
@@ -140,18 +148,27 @@ def _prefill_kernel(off_ref, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
             jnp.int32, (block_q, block_k), 1)
         s = jnp.where(kv_pos <= q_pos, s, _NEG_INF)
 
-        m_prev = m_ref[0][:, :1]
-        l_prev = l_ref[0][:, :1]
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         m_new = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        l_ref[0] = jnp.broadcast_to(l_new, l_ref[0].shape)
-        m_ref[0] = jnp.broadcast_to(m_new, m_ref[0].shape)
-        acc_ref[0] = alpha * acc_ref[0] + jax.lax.dot_general(
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        acc_ref[...] = alpha * acc_ref[...] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    # normalize + emit on the last kv block this q row-block runs
+    final_kj = jnp.minimum(last_valid // block_k, n_k - 1)
+
+    @pl.when(kj == final_kj)
+    def _emit():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out_ref[0] = (acc_ref[...] / l_safe).astype(out_ref.dtype)
 
 
 def flash_prefill(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
@@ -197,9 +214,11 @@ def flash_prefill(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     def q_index(bh, i, j, off_ref):
         return (bh, i, 0)
 
-    grid = (b * h, sq // block_q, t // block_k)
-    acc, m, l = pl.pallas_call(
-        functools.partial(_prefill_kernel, sm_scale=float(sm_scale)),
+    n_k = t // block_k
+    grid = (b * h, sq // block_q, n_k)
+    out = pl.pallas_call(
+        functools.partial(_prefill_kernel, sm_scale=float(sm_scale),
+                          n_k=n_k),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
@@ -208,23 +227,17 @@ def flash_prefill(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                 pl.BlockSpec((1, block_k, d), kv_index),
                 pl.BlockSpec((1, block_k, d), kv_index),
             ],
-            out_specs=[
-                pl.BlockSpec((1, block_q, d), q_index),
-                pl.BlockSpec((1, block_q, _LANES), q_index),
-                pl.BlockSpec((1, block_q, _LANES), q_index),
+            out_specs=pl.BlockSpec((1, block_q, d), q_index),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, d), jnp.float32),       # acc
+                pltpu.VMEM((block_q, _LANES), jnp.float32),  # m
+                pltpu.VMEM((block_q, _LANES), jnp.float32),  # l
             ],
         ),
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, sq, d), jnp.float32),
-            jax.ShapeDtypeStruct((b * h, sq, _LANES), jnp.float32),
-            jax.ShapeDtypeStruct((b * h, sq, _LANES), jnp.float32),
-        ],
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         interpret=_prefill_interpret(),
     )(offset, qf, kf, vf)
 
-    l0 = l[..., 0]
-    l_safe = jnp.where(l0 == 0.0, 1.0, l0)
-    out = (acc / l_safe[..., None]).astype(q.dtype)
     if pad_q:
         out = out[:, :s]
     return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)

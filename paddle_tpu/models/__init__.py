@@ -9,7 +9,8 @@ from .gpt import (  # noqa: F401
     GPTConfig, GPTForCausalLM, GPTForCausalLMPipe, GPTModel,
     GPTPretrainingCriterion,
 )
-from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401
+from .llama import (LlamaConfig, LlamaForCausalLM,  # noqa: F401
+                    LlamaForCausalLMPipe, LlamaModel, annotate_llama_tp)
 from .moe_gpt import MoEGPTConfig, MoEGPTForCausalLM  # noqa: F401
 from .unet import (  # noqa: F401
     UNet2DConditionModel, UNetConfig, UNetDenoiseLoss,

@@ -208,3 +208,94 @@ class LlamaForCausalLM(GenerationMixin, Layer):
         hidden, new_caches = self.llama(input_ids, caches=caches,
                                         offset=offset)
         return self.logits(hidden), new_caches
+
+
+# ===================================================== pipeline-parallel pipe
+class LlamaEmbeddingPipe(Layer):
+    """First pipeline entry: token embedding (rotary needs no position
+    table). Reference: PaddleNLP LlamaForCausalLMPipe's embedding stage."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.embed_tokens = Embedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=ParamAttr(
+                initializer=I.Normal(0.0, config.initializer_range)))
+
+    def forward(self, input_ids):
+        return self.embed_tokens(input_ids)
+
+
+class LlamaPretrainingCriterion(Layer):
+    """loss_fn for the pipe model: mean CE over all tokens."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.vocab_size = config.vocab_size
+
+    def forward(self, logits, labels):
+        return F.cross_entropy(logits.reshape([-1, self.vocab_size]),
+                               labels.reshape([-1]), reduction="mean")
+
+
+# Megatron TP layout for the Llama weights (Linear weights are (in, out)):
+# column-parallel splits the output dim, row-parallel the input dim.
+_LLAMA_TP_COLUMN = ("q_proj.weight", "k_proj.weight", "v_proj.weight",
+                    "gate_proj.weight", "up_proj.weight")
+_LLAMA_TP_ROW = ("o_proj.weight", "down_proj.weight")
+
+
+def annotate_llama_tp(layer: Layer, axis: str = "mp") -> None:
+    """Attach Megatron TP ``dist_attr`` PartitionSpecs to a Llama(-pipe)
+    layer tree by parameter role. PipelineTrainStep / hapi.TrainStep read
+    ``dist_attr`` when building param shardings (reference: the
+    Column/RowParallelLinear layout of
+    python/paddle/distributed/fleet/layers/mpu/mp_layers.py, applied as
+    GSPMD annotations instead of explicit collectives)."""
+    from jax.sharding import PartitionSpec as P
+    for name, p in layer.named_parameters():
+        if any(name.endswith(s) for s in _LLAMA_TP_COLUMN):
+            p.dist_attr = P(None, axis)
+        elif any(name.endswith(s) for s in _LLAMA_TP_ROW):
+            p.dist_attr = P(axis, None)
+        elif name.endswith("embed_tokens.weight"):
+            p.dist_attr = P(axis, None)       # vocab-sharded embedding
+        elif name.endswith("lm_head.weight"):
+            p.dist_attr = P(None, axis)       # vocab-sharded head
+
+
+def LlamaForCausalLMPipe(config: LlamaConfig,
+                         num_stages: Optional[int] = None,
+                         topology=None, seg_method: str = "layer:LlamaDecoderLayer",
+                         recompute_interval: int = 0,
+                         tensor_parallel: bool = False,
+                         tensor_parallel_axis: str = "mp"):
+    """The pipeline-parallel Llama exemplar (reference: PaddleNLP
+    LlamaForCausalLMPipe over the reference's PipelineLayer machinery,
+    SURVEY.md §2.2 meta_parallel PP). The uniform LlamaDecoderLayer region
+    is stacked over the pp mesh axis by PipelineTrainStep;
+    ``tensor_parallel=True`` additionally attaches the Megatron TP layout
+    as dist_attr annotations."""
+    from ..distributed.fleet.meta_parallel.pp_layers import (
+        LayerDesc, PipelineLayer)
+    from ..nn.layers.common import RMSNorm as _RMSNorm
+
+    descs = [LayerDesc(LlamaEmbeddingPipe, config)]
+    descs += [LayerDesc(LlamaDecoderLayer, config)
+              for _ in range(config.num_hidden_layers)]
+    descs.append(LayerDesc(_RMSNorm, config.hidden_size,
+                           epsilon=config.rms_norm_eps))
+    descs.append(LayerDesc(Linear, config.hidden_size, config.vocab_size,
+                           bias_attr=False))
+    pipe = PipelineLayer(
+        descs, num_stages=num_stages, topology=topology,
+        loss_fn=LlamaPretrainingCriterion(config), seg_method=seg_method,
+        recompute_interval=recompute_interval)
+    if tensor_parallel:
+        from jax.sharding import PartitionSpec as P
+        annotate_llama_tp(pipe, tensor_parallel_axis)
+        # the head is the Linear we appended last: column-parallel vocab
+        head = pipe.run_function[-1]
+        assert isinstance(head, Linear), head
+        head.weight.dist_attr = P(None, tensor_parallel_axis)
+    return pipe

@@ -120,7 +120,15 @@ class Layer:
             raise ValueError("attr=False: caller should skip creating this parameter")
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
-        value = init(tuple(int(s) for s in shape), dtype)
+        from ..framework.lazy import in_lazy_init
+        if in_lazy_init():
+            # meta tensor: shape+dtype only, zero bytes (paddle.LazyGuard)
+            import jax
+            from ..core.dtype import to_jax_dtype
+            value = jax.ShapeDtypeStruct(
+                tuple(int(s) for s in shape), to_jax_dtype(dtype))
+        else:
+            value = init(tuple(int(s) for s in shape), dtype)
         p = Parameter(value, name=name, trainable=trainable)
         p.optimize_attr["learning_rate"] = lr
         p.optimize_attr["regularizer"] = regularizer
