@@ -75,6 +75,30 @@ def _bind_params(layer: Layer, rel2val: Dict[str, Any]):
             t._value = v
 
 
+def make_stage_fn(template: Layer, block_rels: List[str], remat: bool):
+    """The per-stage compute shared by every schedule: scan the stage's L
+    stacked blocks through the template layer, functionally bound.
+    stage_params: tuple of (L, ...) leaves ordered like block_rels."""
+
+    def block_apply(lparams, x):
+        rel2val = dict(zip(block_rels, lparams))
+        with _bind_params(template, rel2val), autograd.functional_guard():
+            out = template(Tensor(x, stop_gradient=True))
+        return tree_to_values(out)
+
+    if remat:
+        block_apply = jax.checkpoint(block_apply)
+
+    def stage_fn(stage_params, x):
+        def body(carry, lp):
+            return block_apply(lp, carry), None
+
+        y, _ = jax.lax.scan(body, x, stage_params)
+        return y
+
+    return stage_fn
+
+
 def _mesh_filter_spec(spec: Optional[P], mesh: Mesh) -> P:
     """Drop axes absent from this mesh from a declared PartitionSpec."""
     if spec is None:
@@ -108,7 +132,8 @@ class PipelineTrainStep:
                  sharding_axis: Optional[str] = None,
                  virtual_pp_degree: int = 1,
                  abstract: bool = False, param_dtype=None,
-                 lowering_platform: str = "tpu"):
+                 lowering_platform: str = "tpu",
+                 schedule: str = "auto"):
         """``abstract=True`` builds the FULL sharded program over
         ``jax.ShapeDtypeStruct`` parameters (no arrays are ever
         materialized or placed): ``mesh`` may then be a
@@ -129,6 +154,23 @@ class PipelineTrainStep:
             raise ValueError(
                 "param_dtype is only applied in abstract mode; for a live "
                 "step cast the model first (model.to(dtype=...))")
+        if schedule not in ("auto", "zbh1"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}; "
+                             "'auto' (lockstep FThenB/remat/VPP) or 'zbh1'")
+        self._schedule = schedule
+        if schedule == "zbh1":
+            # v1 scope of the zero-bubble engine (pipeline_zbh1.py)
+            if virtual_pp_degree != 1:
+                raise NotImplementedError("zbh1 + interleaved VPP")
+            if tuple(mesh.axis_names) != ("pp",):
+                raise NotImplementedError(
+                    "zbh1 v1 runs on a pp-only mesh (per-stage divergent "
+                    "execution via shard_map); compose dp/mp outside or "
+                    "use schedule='auto'")
+            if pipe_layer.shared_layers:
+                raise NotImplementedError(
+                    "zbh1 v1 does not support tied (shared) layers — the "
+                    "tied weight would need cross-phase gradient routing")
         self.S = mesh.shape["pp"]
         self.M = int(num_microbatches)
         self.V = int(virtual_pp_degree)
@@ -307,22 +349,7 @@ class PipelineTrainStep:
         act_spec = self._act_sharding
         run_entries = self._run_entries
 
-        def block_apply(lparams, x):
-            rel2val = dict(zip(self._block_rels, lparams))
-            with _bind_params(template, rel2val), autograd.functional_guard():
-                out = template(Tensor(x, stop_gradient=True))
-            return tree_to_values(out)
-
-        if remat:
-            block_apply = jax.checkpoint(block_apply)
-
-        def stage_fn(stage_params, x):
-            # stage_params: tuple of (L, ...) leaves; scan applies the L
-            # blocks of this stage in order
-            def body(carry, lp):
-                return block_apply(lp, carry), None
-            y, _ = jax.lax.scan(body, x, stage_params)
-            return y
+        stage_fn = make_stage_fn(template, self._block_rels, remat)
 
         def pipeline_plain(stacked, h):
             # h: (M, mb, ...) microbatch activations entering stage 0
@@ -414,6 +441,10 @@ class PipelineTrainStep:
 
         pipeline = pipeline_plain if V == 1 else pipeline_interleaved
 
+        if self._schedule == "zbh1":
+            self._build_zbh1_step(optimizer, remat, donate)
+            return
+
         def loss_of(params, inputs, labels):
             # prefix on the full flattened batch (standard 3D shapes), then
             # pipeline over microbatches, then suffix + loss on the full batch
@@ -469,6 +500,60 @@ class PipelineTrainStep:
         else:
             self._jit_step = jax.jit(
                 step, donate_argnums=(0, 1) if donate else ())
+        self._step_count = 0
+
+    # ---------------------------------------------------- zbh1 (zero bubble)
+    def _build_zbh1_step(self, optimizer, remat, donate):
+        from .pipeline_zbh1 import build_zbh1_loss_and_grads
+
+        S, M = self.S, self.M
+        mesh = self.mesh
+        run_entries = self._run_entries
+        loss_fn = self.loss_fn
+        block_rels = self._block_rels
+        template = self.template
+        prefix_keys = [k for k in self.params if not k.startswith(
+            _STACK_PREFIX) and int(k.split(".", 1)[0]) < self._start]
+        suffix_keys = [k for k in self.params if not k.startswith(
+            _STACK_PREFIX) and int(k.split(".", 1)[0]) >= self._end]
+        prefix_entries, suffix_entries = self._prefix, self._suffix
+
+        def prefix_apply(prefix_params, ids_mb):
+            return run_entries(prefix_entries, prefix_params, ids_mb)
+
+        def suffix_loss(suffix_params, y_mb, labels_mb):
+            out = run_entries(suffix_entries, suffix_params, y_mb)
+            with autograd.functional_guard():
+                loss = loss_fn(*tree_to_tensors((out, labels_mb)))
+            return tree_to_values(loss)
+
+        def step(params, opt_state, lr, inputs, labels):
+            x = inputs.reshape((M, inputs.shape[0] // M) + inputs.shape[1:])
+            lab = labels.reshape(
+                (M, labels.shape[0] // M) + labels.shape[1:])
+            pre = {k: params[k] for k in prefix_keys}
+            suf = {k: params[k] for k in suffix_keys}
+            stacked = tuple(params[_STACK_PREFIX + rel]
+                            for rel in block_rels)
+            act_sds = jax.eval_shape(
+                prefix_apply, pre,
+                jax.ShapeDtypeStruct(x.shape[1:], x.dtype))
+            zfn = build_zbh1_loss_and_grads(
+                mesh, S, M, block_rels, template,
+                prefix_apply, suffix_loss, act_sds, remat=remat)
+            loss, dWt, dPre, dSuf = zfn(stacked, pre, suf, x, lab)
+            grads = {_STACK_PREFIX + rel: dWt[i]
+                     for i, rel in enumerate(block_rels)}
+            grads.update(dPre)
+            grads.update(dSuf)
+            new_params, new_state = optimizer.functional_update(
+                params, grads, opt_state, lr)
+            new_params = {k: jax.lax.with_sharding_constraint(
+                v, self.param_shardings[k]) for k, v in new_params.items()}
+            return loss, new_params, new_state
+
+        self._jit_step = jax.jit(
+            step, donate_argnums=(0, 1) if donate else ())
         self._step_count = 0
 
     # ------------------------------------------------------- abstract mode

@@ -1,0 +1,299 @@
+"""Zero-bubble (ZBH1) pipeline schedule.
+
+Reference: the ZBH1 mode of
+python/paddle/distributed/passes/pipeline_scheduler_pass (zero-bubble
+pipeline: split each backward into B = dx, the critical path, and
+W = dW, deferrable, and fill pipeline bubbles with W work).
+
+TPU-native formulation. The other schedules here (pipeline_parallel.py)
+are LOCKSTEP: a vmap over the pp-sharded stage axis runs the SAME program
+on every stage each tick, with fill/drain ticks masked — masked work still
+executes, so the bubble burns real compute and no schedule permutation can
+recover it. Zero bubble therefore needs per-stage DIVERGENT execution,
+which on TPU is ``shard_map`` over the pp axis with ``lax.cond``-gated
+work units: cond executes only the taken branch at runtime, so a tick
+costs max-over-stages of the unit each stage actually runs, and ticks
+where a stage has no unit cost it ~nothing.
+
+Units per (stage, microbatch):
+  F  forward through the stage's L blocks (stage 0 prepends the prefix /
+     embedding; stage S-1 stores y for its B unit)
+  B  dx-only backward (stage S-1 first runs suffix+loss and seeds the
+     gradient; stage 0 stores its dx for the deferred prefix backward);
+     sends dx down the ring
+  W  the deferred parameter gradient (stage 0's W also runs the prefix
+     backward) — the ZBH1 split
+A greedy static scheduler (numpy, trace time) assigns at most one unit
+per stage per tick with priority B > F > W — W fills what would be bubble
+ticks. Ring messages (activations up, dx down) move via ppermute every
+tick and are stashed into per-microbatch buffers on arrival, driven by
+static stash tables (a message's slot is known from the schedule), so a
+busy receiver can consume it any later tick.
+
+Exactness: loss is computed per microbatch at stage S-1 and averaged —
+mean of equal-size microbatch means == the full-batch mean for token-mean
+criteria (suffixes must be per-token, which final-norm + head are).
+Parity vs the serial model is pinned by tests/test_zbh1.py.
+
+Cost model (per microbatch per stage, F = one forward): F + (Fr + Bdx)
++ (Fr + Bdw) ~ 5F vs the lockstep schedules' 4F — the extra forward
+recompute is the price of decoupling W from B in a pure functional
+program. The payoff is scheduling freedom: steady-state ticks cost
+~max(2F) and fill/drain ticks shrink toward zero instead of burning
+masked slots, so wall-clock beats lockstep once the bubble fraction
+(S-1)/(M+S-1) outweighs the extra recompute.
+
+v1 scope: mesh with only a "pp" axis, V == 1, no ZeRO composition.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+
+def zbh1_schedule(S: int, M: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Greedy ZBH1 tables: (F, B, W), each (T, S), holding the microbatch
+    index a stage processes at that tick, or -1. Priority B > F > W."""
+    f_time = np.full((S, M), -1)
+    b_time = np.full((S, M), -1)
+    next_f = [0] * S
+    next_b = [0] * S
+    next_w = [0] * S
+    rows_f, rows_b, rows_w = [], [], []
+    t = 0
+    cap = 6 * (M + S) + 8
+    while any(n < M for n in next_w) and t < cap:
+        rf, rb, rw = [-1] * S, [-1] * S, [-1] * S
+        for s in range(S):
+            m = next_b[s]
+            b_ready = m < M and (
+                (s == S - 1 and 0 <= f_time[s][m] < t)
+                or (s < S - 1 and 0 <= b_time[s + 1][m] < t))
+            mf = next_f[s]
+            f_ready = mf < M and (s == 0 or 0 <= f_time[s - 1][mf] < t)
+            if b_ready:
+                rb[s] = m
+                b_time[s][m] = t
+                next_b[s] += 1
+            elif f_ready:
+                rf[s] = mf
+                f_time[s][mf] = t
+                next_f[s] += 1
+            elif next_w[s] < next_b[s]:
+                rw[s] = next_w[s]
+                next_w[s] += 1
+        rows_f.append(rf)
+        rows_b.append(rb)
+        rows_w.append(rw)
+        t += 1
+    if any(n < M for n in next_w):
+        raise RuntimeError(f"zbh1 schedule did not complete in {cap} ticks")
+    return (np.asarray(rows_f, np.int32), np.asarray(rows_b, np.int32),
+            np.asarray(rows_w, np.int32))
+
+
+def _stash_tables(Ft, Bt, S):
+    """stash_f[t][s]: slot where the activation arriving at stage s at the
+    START of tick t belongs (= what s-1 forwarded at t-1); stash_b the
+    same for dx arriving from s+1. -1 = nothing arrived."""
+    T = Ft.shape[0]
+    sf = np.full((T, S), -1, np.int32)
+    sb = np.full((T, S), -1, np.int32)
+    for t in range(1, T):
+        for s in range(S):
+            if s > 0:
+                sf[t][s] = Ft[t - 1][s - 1]
+            if s < S - 1:
+                sb[t][s] = Bt[t - 1][s + 1]
+    return sf, sb
+
+
+def _masked_store(buf, idx, val, pred):
+    """buf[idx] = val where pred (idx may be -1 => no-op via pred)."""
+    slot = jnp.maximum(idx, 0)
+    prev = jax.lax.dynamic_index_in_dim(buf, slot, 0, keepdims=False)
+    new = jnp.where(jnp.logical_and(pred, idx >= 0), val, prev)
+    return jax.lax.dynamic_update_index_in_dim(buf, new, slot, 0)
+
+
+def build_zbh1_loss_and_grads(
+        mesh: Mesh, S: int, M: int,
+        block_rels: List[str],
+        template,
+        prefix_apply: Callable,      # (prefix_params, ids_mb) -> x
+        suffix_loss: Callable,       # (suffix_params, y_mb, labels_mb) -> loss
+        act_sds: jax.ShapeDtypeStruct,
+        remat: bool = True):
+    """Returns f(stacked_tuple, prefix_params, suffix_params, ids, labels)
+    -> (loss, stacked_grads_tuple, prefix_grads, suffix_grads). ids/labels
+    are (M, mb, ...) replicated; stacked leaves are (S, L, ...)
+    pp-sharded."""
+
+    Ft, Bt, Wt = zbh1_schedule(S, M)
+    sf_tab, sb_tab = _stash_tables(Ft, Bt, S)
+    ring_up = [(i, (i + 1) % S) for i in range(S)]
+    ring_dn = [(i, (i - 1) % S) for i in range(S)]
+
+    from .pipeline_parallel import make_stage_fn
+    stage_fn = make_stage_fn(template, block_rels, remat)
+
+    def kernel(stacked, prefix_params, suffix_params, ids, labels):
+        local = tuple(a[0] for a in stacked)     # drop the stage dim
+        s_idx = jax.lax.axis_index("pp")
+        is_first = s_idx == 0
+        is_last = s_idx == S - 1
+
+        zbuf = jnp.zeros((M,) + tuple(act_sds.shape), act_sds.dtype)
+        X = zbuf                                  # stage inputs, M slots
+        Y = zbuf                                  # last-stage outputs
+        G = zbuf                                  # stage-output grads
+        DX0 = zbuf                                # stage-0 dx (prefix bwd)
+        up = jnp.zeros(tuple(act_sds.shape), act_sds.dtype)
+        dn = jnp.zeros(tuple(act_sds.shape), act_sds.dtype)
+        loss_acc = jnp.zeros((), jnp.float32)
+        f32z = lambda tree: jax.tree.map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), tree)
+        dW, dPre, dSuf = f32z(local), f32z(prefix_params), f32z(suffix_params)
+
+        def f_unit(op):
+            m, X, Y, up = op
+
+            def from_prefix(m):
+                return prefix_apply(
+                    prefix_params, jax.lax.dynamic_index_in_dim(
+                        ids, m, 0, keepdims=False)).astype(up.dtype)
+
+            def from_stash(m):
+                return jax.lax.dynamic_index_in_dim(X, m, 0, keepdims=False)
+
+            x = jax.lax.cond(is_first, from_prefix, from_stash, m)
+            X = jax.lax.dynamic_update_index_in_dim(X, x, m, 0)
+            y = stage_fn(local, x)
+            Y = _masked_store(Y, m, y, is_last)
+            return X, Y, y
+
+        def b_unit(op):
+            m, X, Y, G, loss_acc, dSuf, DX0 = op
+            x = jax.lax.dynamic_index_in_dim(X, m, 0, keepdims=False)
+
+            def seed_from_loss(op2):
+                y, lab, dSuf = op2
+                # seed 1/M scales both dSuf and g so the sum is the mean
+                lval, both_vjp = jax.vjp(
+                    lambda sp, yy: suffix_loss(sp, yy, lab),
+                    suffix_params, y)
+                dsuf_m, g = both_vjp(jnp.ones((), lval.dtype) / M)
+                dSuf = jax.tree.map(lambda a, d: a + d.astype(a.dtype),
+                                    dSuf, dsuf_m)
+                return g.astype(x.dtype), lval.astype(jnp.float32), dSuf
+
+            def seed_from_ring(op2):
+                y, lab, dSuf = op2
+                g = jax.lax.dynamic_index_in_dim(G, m, 0, keepdims=False)
+                return g, jnp.zeros((), jnp.float32), dSuf
+
+            y_m = jax.lax.dynamic_index_in_dim(Y, m, 0, keepdims=False)
+            lab_m = jax.lax.dynamic_index_in_dim(labels, m, 0,
+                                                 keepdims=False)
+            g, lval, dSuf = jax.lax.cond(
+                is_last, seed_from_loss, seed_from_ring, (y_m, lab_m, dSuf))
+            loss_acc = loss_acc + lval / M
+            G = jax.lax.dynamic_update_index_in_dim(G, g, m, 0)
+            _, x_vjp = jax.vjp(lambda xx: stage_fn(local, xx), x)
+            (dx,) = x_vjp(g)
+            DX0 = _masked_store(DX0, m, dx, is_first)
+            return G, loss_acc, dSuf, DX0, dx
+
+        def w_unit(op):
+            m, X, G, DX0, dW, dPre = op
+            x = jax.lax.dynamic_index_in_dim(X, m, 0, keepdims=False)
+            g = jax.lax.dynamic_index_in_dim(G, m, 0, keepdims=False)
+            _, p_vjp = jax.vjp(lambda lp: stage_fn(lp, x), local)
+            (dw_m,) = p_vjp(g)
+            dW = jax.tree.map(lambda a, d: a + d.astype(a.dtype), dW, dw_m)
+
+            def prefix_bwd(op2):
+                dPre, = op2
+                dxin = jax.lax.dynamic_index_in_dim(DX0, m, 0,
+                                                    keepdims=False)
+                _, pre_vjp = jax.vjp(
+                    lambda pp: prefix_apply(
+                        pp, jax.lax.dynamic_index_in_dim(
+                            ids, m, 0, keepdims=False)).astype(dxin.dtype),
+                    prefix_params)
+                (dpre_m,) = pre_vjp(dxin)
+                return (jax.tree.map(lambda a, d: a + d.astype(a.dtype),
+                                     dPre, dpre_m),)
+
+            (dPre,) = jax.lax.cond(is_first, prefix_bwd,
+                                   lambda op2: op2, (dPre,))
+            return dW, dPre
+
+        def tick(carry, xs):
+            (X, Y, G, DX0, up, dn, loss_acc, dW, dPre, dSuf) = carry
+            rf, rb, rw, sf, sb = xs
+            pick = lambda row: row[s_idx]
+            mf, mb_, mw = pick(rf), pick(rb), pick(rw)
+            # stash last tick's ring arrivals into their static slots
+            X = _masked_store(X, pick(sf), up, True)
+            G = _masked_store(G, pick(sb), dn, True)
+
+            X, Y, y_out = jax.lax.cond(
+                mf >= 0, f_unit,
+                lambda op: (op[1], op[2], jnp.zeros_like(op[3])),
+                (jnp.maximum(mf, 0), X, Y, up))
+
+            G, loss_acc, dSuf, DX0, dx_out = jax.lax.cond(
+                mb_ >= 0, b_unit,
+                lambda op: (op[3], op[4], op[5], op[6],
+                            jnp.zeros_like(up)),
+                (jnp.maximum(mb_, 0), X, Y, G, loss_acc, dSuf, DX0))
+
+            dW, dPre = jax.lax.cond(
+                mw >= 0, w_unit, lambda op: (op[4], op[5]),
+                (jnp.maximum(mw, 0), X, G, DX0, dW, dPre))
+
+            up = jax.lax.ppermute(y_out, "pp", ring_up)
+            dn = jax.lax.ppermute(dx_out, "pp", ring_dn)
+            return (X, Y, G, DX0, up, dn, loss_acc, dW, dPre, dSuf), None
+
+        carry = (X, Y, G, DX0, up, dn, loss_acc, dW, dPre, dSuf)
+        carry = jax.tree.map(
+            lambda a: jax.lax.pcast(a, ("pp",), to="varying"), carry)
+        carry, _ = jax.lax.scan(
+            tick, carry,
+            tuple(jnp.asarray(t) for t in (Ft, Bt, Wt, sf_tab, sb_tab)))
+        (X, Y, G, DX0, up, dn, loss_acc, dW, dPre, dSuf) = carry
+
+        loss = jax.lax.psum(jnp.where(is_last, loss_acc, 0.0), "pp")
+        dPre = jax.tree.map(lambda a: jax.lax.psum(
+            jnp.where(is_first, a, jnp.zeros_like(a)), "pp"), dPre)
+        dSuf = jax.tree.map(lambda a: jax.lax.psum(
+            jnp.where(is_last, a, jnp.zeros_like(a)), "pp"), dSuf)
+        dW = jax.tree.map(lambda a: a[None], dW)   # re-add the stage dim
+        return loss, dW, dPre, dSuf
+
+    def loss_and_grads(stacked_tuple, prefix_params, suffix_params,
+                       ids, labels):
+        in_specs = (
+            tuple(P("pp") for _ in stacked_tuple),
+            jax.tree.map(lambda _: P(), prefix_params),
+            jax.tree.map(lambda _: P(), suffix_params),
+            P(), P())
+        out_specs = (
+            P(),
+            tuple(P("pp") for _ in stacked_tuple),
+            jax.tree.map(lambda _: P(), prefix_params),
+            jax.tree.map(lambda _: P(), suffix_params))
+        return jax.shard_map(kernel, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)(
+            stacked_tuple, prefix_params, suffix_params, ids, labels)
+
+    return loss_and_grads

@@ -67,6 +67,53 @@ def measure(M, remat, V=1, n_layers=8, hidden=128, seq=128, vocab=128):
     return ma.temp_size_in_bytes
 
 
+def measure_zbh1(M, n_layers=8, hidden=128, seq=128, vocab=128):
+    """Same model on a pp-only 4-stage mesh under the zero-bubble engine
+    (Llama pipe: zbh1 v1 needs untied weights)."""
+    import paddle_tpu as paddle
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineTrainStep
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLMPipe
+    from paddle_tpu.optimizer import AdamW
+
+    cfg = LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                      num_hidden_layers=n_layers, num_attention_heads=4,
+                      num_key_value_heads=4, intermediate_size=4 * hidden,
+                      max_position_embeddings=seq)
+    paddle.seed(0)
+    pipe = LlamaForCausalLMPipe(cfg, num_stages=4)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("pp",))
+    step = PipelineTrainStep(pipe, AdamW(learning_rate=1e-3), mesh,
+                             num_microbatches=M, schedule="zbh1",
+                             donate=False)
+    x = jnp.zeros((M, seq), jnp.int32)
+    y = jnp.zeros((M, seq), jnp.int32)
+    lr = jnp.asarray(1e-3, jnp.float32)
+    compiled = step._jit_step.lower(
+        step.params, step.opt_state, lr, x, y).compile()
+    return compiled.memory_analysis().temp_size_in_bytes
+
+
+def zbh1_tick_table():
+    """Static-schedule accounting: lockstep executes EVERY stage every
+    tick (masked fill/drain work still burns compute), the cond-gated
+    zbh1 engine executes only scheduled units. Units per microbatch per
+    stage: lockstep 2 (F; B=dx+dw fused by autodiff), zbh1 3 (F; B=dx;
+    W=dw)."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_zbh1 import (
+        zbh1_schedule)
+    rows = []
+    for S, M in ((4, 4), (4, 8), (4, 16), (8, 8)):
+        Ft, Bt, Wt = zbh1_schedule(S, M)
+        T = Ft.shape[0]
+        busy = int(((Ft >= 0) | (Bt >= 0) | (Wt >= 0)).sum())
+        util = busy / (T * S)
+        lock_T = 2 * (M + S - 1)        # F wave + autodiff B wave
+        lock_util = M / (M + S - 1)     # active fraction per wave
+        rows.append((S, M, T, f"{util:.0%}", lock_T, f"{lock_util:.0%}"))
+    return rows
+
+
 def main():
     rows = []
     for remat in (False, True):
@@ -80,6 +127,10 @@ def main():
         t = measure(M, True, V=2)
         rows.append(("remat + interleaved", M, 2, t))
         print(f"remat=True M={M} V=2 temp={t/1e6:.2f} MB", file=sys.stderr)
+    zb = {}
+    for M in (4, 8):
+        zb[M] = measure_zbh1(M)
+        print(f"zbh1 M={M} temp={zb[M]/1e6:.2f} MB", file=sys.stderr)
 
     base = {(s, m): t for s, m, v, t in rows if v == 1}
     lines = [
@@ -113,6 +164,35 @@ def main():
         "itself, activation residency stays bounded by the S in-flight "
         "stage inputs — the 1F1B memory behavior. Regenerate with "
         "`python tools/pipeline_memory.py`.",
+        "",
+        "## Zero-bubble (ZBH1) vs lockstep",
+        "",
+        "The lockstep schedules above vmap ONE program over all stages — "
+        "fill/drain ticks are masked but still execute, so the bubble "
+        "burns real compute. `schedule='zbh1'` "
+        "(`pipeline_zbh1.py`) runs per-stage divergent units "
+        "(shard_map + cond): F, dx-only B, deferred W — W fills would-be "
+        "bubble ticks. Static-schedule accounting (a 'tick' = one unit; "
+        "lockstep units are F and the fused autodiff B=dx+dw, so lockstep "
+        "does 2 units/microbatch/stage vs zbh1's 3 — zbh1 pays one extra "
+        "forward recompute for the split):",
+        "",
+        "| S | M | zbh1 ticks | zbh1 stage-utilization | lockstep ticks "
+        "(2 waves) | lockstep useful fraction |",
+        "|---|---|---|---|---|---|",
+    ]
+    for S, M, T, util, lock_T, lock_util in zbh1_tick_table():
+        lines.append(f"| {S} | {M} | {T} | {util} | {lock_T} | "
+                     f"{lock_util} |")
+    lines += [
+        "",
+        "Lockstep wastes `(S-1)/(M+S-1)` of every wave in masked compute "
+        "(the bubble); zbh1's idle stage-ticks cost ~nothing (cond skips "
+        "the unit) and W units absorb the drain. Compiled temp memory of "
+        "the zbh1 engine (Llama h=128 L=8, pp-only 4-stage mesh): "
+        + ", ".join(f"M={m}: {t/1e6:.2f} MB" for m, t in sorted(zb.items()))
+        + " — the M-slot stash buffers (X/Y/G/DX0) trade the lockstep "
+        "schedules' scan carries for explicit per-microbatch slots.",
         "",
     ]
     out = os.path.join(os.path.dirname(os.path.dirname(
