@@ -160,6 +160,11 @@ class PipelineTrainStep:
         self._schedule = schedule
         if schedule == "zbh1":
             # v1 scope of the zero-bubble engine (pipeline_zbh1.py)
+            if abstract:
+                raise NotImplementedError(
+                    "zbh1 + abstract lowering: the zbh1 builder does not "
+                    "pin abstract in_shardings yet; lower the lockstep "
+                    "schedule instead")
             if virtual_pp_degree != 1:
                 raise NotImplementedError("zbh1 + interleaved VPP")
             if tuple(mesh.axis_names) != ("pp",):
@@ -342,6 +347,10 @@ class PipelineTrainStep:
         self._act_sharding = NamedSharding(
             mesh, P("pp", data_axes if data_axes else None))
 
+        if self._schedule == "zbh1":
+            self._build_zbh1_step(optimizer, remat, donate)
+            return
+
         # ---- the jitted step ---------------------------------------------
         template = self.template
         S, L, M, V = self.S, self.L, self.M, self.V
@@ -441,10 +450,6 @@ class PipelineTrainStep:
 
         pipeline = pipeline_plain if V == 1 else pipeline_interleaved
 
-        if self._schedule == "zbh1":
-            self._build_zbh1_step(optimizer, remat, donate)
-            return
-
         def loss_of(params, inputs, labels):
             # prefix on the full flattened batch (standard 3D shapes), then
             # pipeline over microbatches, then suffix + loss on the full batch
@@ -512,11 +517,15 @@ class PipelineTrainStep:
         loss_fn = self.loss_fn
         block_rels = self._block_rels
         template = self.template
-        prefix_keys = [k for k in self.params if not k.startswith(
-            _STACK_PREFIX) and int(k.split(".", 1)[0]) < self._start]
-        suffix_keys = [k for k in self.params if not k.startswith(
-            _STACK_PREFIX) and int(k.split(".", 1)[0]) >= self._end]
         prefix_entries, suffix_entries = self._prefix, self._suffix
+
+        def entry_keys(entries):
+            return [f"{idx}.{rel}" for idx, e in entries
+                    if isinstance(e, Layer)
+                    for rel, _ in e.named_parameters()]
+
+        prefix_keys = entry_keys(prefix_entries)
+        suffix_keys = entry_keys(suffix_entries)
 
         def prefix_apply(prefix_params, ids_mb):
             return run_entries(prefix_entries, prefix_params, ids_mb)
@@ -548,8 +557,20 @@ class PipelineTrainStep:
             grads.update(dSuf)
             new_params, new_state = optimizer.functional_update(
                 params, grads, opt_state, lr)
+            # keep output layouts identical to inputs (donation + steady
+            # state), exactly like the lockstep step: params AND slots
             new_params = {k: jax.lax.with_sharding_constraint(
                 v, self.param_shardings[k]) for k, v in new_params.items()}
+            new_state["slots"] = {
+                k: jax.tree.map(
+                    lambda s, _k=k: jax.lax.with_sharding_constraint(
+                        s, self.opt_shardings[_k]), slot)
+                for k, slot in new_state["slots"].items()}
+            if new_state.get("master"):
+                new_state["master"] = {
+                    k: jax.lax.with_sharding_constraint(
+                        v, self.opt_shardings[k])
+                    for k, v in new_state["master"].items()}
             return loss, new_params, new_state
 
         self._jit_step = jax.jit(

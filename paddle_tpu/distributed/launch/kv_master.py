@@ -1,0 +1,200 @@
+"""Rank-0 HTTP KV master + TCP rendezvous.
+
+Reference: python/paddle/distributed/launch/controllers/master.py
+(``HTTPMaster``: rank 0 serves a tiny KV store over HTTP; every node
+registers itself and polls the peer list — launch barrier and elastic
+membership without etcd or a shared filesystem).
+
+Stdlib-only: ``ThreadingHTTPServer`` on the master, ``urllib`` clients on
+the workers — multi-node launch needs nothing but plain TCP to rank 0.
+
+Routes:
+  PUT    /kv/<key>        body = value (bytes, stored verbatim)
+  GET    /kv/<key>        -> 200 value | 404
+  DELETE /kv/<key>
+  GET    /prefix/<p>      -> JSON {key: value-as-str} for keys with prefix
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from .elastic import Rendezvous
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: Dict[str, bytes]
+    lock: threading.Lock
+
+    def log_message(self, *a):            # silence per-request stderr spam
+        pass
+
+    def _key(self) -> Optional[str]:
+        if self.path.startswith("/kv/"):
+            return self.path[len("/kv/"):]
+        return None
+
+    def do_PUT(self):
+        key = self._key()
+        if key is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        n = int(self.headers.get("Content-Length", 0))
+        val = self.rfile.read(n)
+        with self.lock:
+            self.store[key] = val
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self):
+        if self.path.startswith("/prefix/"):
+            prefix = self.path[len("/prefix/"):]
+            with self.lock:
+                out = {k: v.decode("utf-8", "replace")
+                       for k, v in self.store.items()
+                       if k.startswith(prefix)}
+            body = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        key = self._key()
+        with self.lock:
+            val = self.store.get(key) if key else None
+        if val is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(val)))
+        self.end_headers()
+        self.wfile.write(val)
+
+    def do_DELETE(self):
+        key = self._key()
+        with self.lock:
+            existed = key is not None and self.store.pop(key, None) is not None
+        self.send_response(200 if existed else 404)
+        self.end_headers()
+
+
+class KVServer:
+    """The rank-0 master: a threaded HTTP KV store."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        handler = type("BoundHandler", (_Handler,), {
+            "store": {}, "lock": threading.Lock()})
+        self._handler = handler
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+
+    def start(self) -> "KVServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class KVClient:
+    """urllib client for the master (retries cover master startup races)."""
+
+    def __init__(self, endpoint: str, timeout: float = 5.0,
+                 retries: int = 20, retry_interval: float = 0.25):
+        if "://" not in endpoint:
+            endpoint = "http://" + endpoint
+        self.base = endpoint.rstrip("/")
+        self.timeout = timeout
+        self.retries = retries
+        self.retry_interval = retry_interval
+
+    def _req(self, method: str, path: str, data: Optional[bytes] = None,
+             want_body: bool = False):
+        last = None
+        for _ in range(self.retries):
+            req = urllib.request.Request(self.base + path, data=data,
+                                         method=method)
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    return r.read() if want_body else True
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    return None if want_body else False
+                last = e
+            except (urllib.error.URLError, OSError) as e:
+                last = e                   # master not up yet / net blip
+            time.sleep(self.retry_interval)
+        raise ConnectionError(f"KV master unreachable at {self.base}: {last}")
+
+    def put(self, key: str, value: bytes) -> None:
+        self._req("PUT", f"/kv/{key}", data=value)
+
+    def get(self, key: str) -> Optional[bytes]:
+        return self._req("GET", f"/kv/{key}", want_body=True)
+
+    def delete(self, key: str) -> None:
+        self._req("DELETE", f"/kv/{key}")
+
+    def prefix(self, p: str) -> Dict[str, str]:
+        body = self._req("GET", f"/prefix/{p}", want_body=True)
+        return json.loads(body) if body else {}
+
+
+class HTTPRendezvous(Rendezvous):
+    """Rendezvous over the rank-0 KV master — the FileRendezvous drop-in
+    that works across hosts with no shared filesystem. ``is_master=True``
+    (node rank 0) starts the server in-process; every node (including the
+    master) talks to it through the client.
+
+    ``ttl``: when set, a registration older than ttl seconds is considered
+    dead unless refreshed via ``heartbeat()`` — the reference master's
+    etcd-lease behavior for elastic membership."""
+
+    def __init__(self, endpoint: str, is_master: bool = False,
+                 ttl: Optional[float] = None):
+        self.server: Optional[KVServer] = None
+        if is_master:
+            host, _, port = endpoint.partition(":")
+            self.server = KVServer("0.0.0.0", int(port or 0)).start()
+            endpoint = f"{host or '127.0.0.1'}:{self.server.port}"
+        self.endpoint = endpoint
+        self.client = KVClient(endpoint)
+        self.ttl = ttl
+
+    def register(self, node_id: str, info: Dict) -> None:
+        self.client.put(f"nodes/{node_id}", json.dumps(
+            {"id": node_id, "ts": time.time(), **info}).encode())
+
+    heartbeat = register
+
+    def deregister(self, node_id: str) -> None:
+        self.client.delete(f"nodes/{node_id}")
+
+    def alive_nodes(self) -> List[str]:
+        now = time.time()
+        out = []
+        for key, val in sorted(self.client.prefix("nodes/").items()):
+            if self.ttl is not None:
+                try:
+                    if now - json.loads(val)["ts"] > self.ttl:
+                        continue
+                except (ValueError, KeyError):
+                    continue
+            out.append(key[len("nodes/"):])
+        return out
+
+    def shutdown(self) -> None:
+        if self.server is not None:
+            self.server.stop()
+            self.server = None

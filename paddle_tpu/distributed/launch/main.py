@@ -53,8 +53,16 @@ def launch(argv: Optional[List[str]] = None) -> int:
         nproc_per_node=args.nproc_per_node, master=args.master,
         log_dir=args.log_dir, job_id=args.job_id, devices=args.devices,
         max_restart=args.max_restart, run_module=args.module)
-    rdzv = (FileRendezvous(args.elastic_rdzv_dir)
-            if args.elastic_rdzv_dir else None)
+    if args.elastic_rdzv_dir:
+        rdzv = FileRendezvous(args.elastic_rdzv_dir)
+    elif args.master and args.nnodes > 1:
+        # multi-node without a shared FS: rank 0 serves the HTTP KV master
+        # (reference: launch/controllers/master.py), everyone rendezvous
+        # against it over plain TCP
+        from .kv_master import HTTPRendezvous
+        rdzv = HTTPRendezvous(args.master, is_master=args.node_rank == 0)
+    else:
+        rdzv = None
     mgr = ElasticManager(ctx, rendezvous=rdzv)
     rc = mgr.run()
     if rc != 0:

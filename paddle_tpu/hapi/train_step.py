@@ -47,6 +47,7 @@ class TrainStep:
         data_axes: Tuple[str, ...] = ("dp",),
         donate: bool = True,
         grad_accum_steps: int = 1,
+        fused_grad_accum: bool = True,
         remat: bool = False,
         sharding_level: Optional[int] = None,
         sharding_axis: Optional[str] = None,
@@ -56,6 +57,7 @@ class TrainStep:
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.grad_accum_steps = grad_accum_steps
+        self.fused_grad_accum = fused_grad_accum
         params, buffers = model.raw_state()
         from ..jit import ensure_live
         ensure_live(params, "call prev_step.sync_to_model() before building "
@@ -186,17 +188,42 @@ class TrainStep:
                                         b.shape[0] // self.grad_accum_steps,
                                         *b.shape[1:]), b) for b in batch]
 
-                def acc_fn(carry, mb):
-                    loss, g = jax.value_and_grad(loss_of)(params, mb)
-                    return (carry[0] + loss,
-                            jax.tree.map(jnp.add, carry[1], g)), None
+                if self.fused_grad_accum:
+                    # fused dW accumulation (reference:
+                    # fused_linear_param_grad_add_kernel.cu): put the
+                    # microbatch loop INSIDE the differentiated function,
+                    # so the scan TRANSPOSE owns the single gradient
+                    # accumulator (an aliased loop carry) and each dW
+                    # matmul can fuse into its += epilogue. Measured
+                    # compiled temp size equals the unfused path (XLA
+                    # aliases that path's carries too) — the difference
+                    # is the guaranteed in-loop accumulate (HBM traffic),
+                    # not capacity. checkpoint bounds forward-activation
+                    # residency to one microbatch (the eager behavior).
+                    inner = loss_of if remat else jax.checkpoint(loss_of)
 
-                zero = (jnp.zeros(()),
-                        jax.tree.map(jnp.zeros_like, params))
-                (loss_sum, grads), _ = jax.lax.scan(
-                    acc_fn, zero, tuple(micro))
-                loss = loss_sum / self.grad_accum_steps
-                grads = jax.tree.map(lambda g: g / self.grad_accum_steps, grads)
+                    def total_loss(params):
+                        def body(acc, mb):
+                            return acc + inner(params, mb), None
+
+                        s, _ = jax.lax.scan(body, jnp.zeros(()),
+                                            tuple(micro))
+                        return s / self.grad_accum_steps
+
+                    loss, grads = jax.value_and_grad(total_loss)(params)
+                else:
+                    def acc_fn(carry, mb):
+                        loss, g = jax.value_and_grad(loss_of)(params, mb)
+                        return (carry[0] + loss,
+                                jax.tree.map(jnp.add, carry[1], g)), None
+
+                    zero = (jnp.zeros(()),
+                            jax.tree.map(jnp.zeros_like, params))
+                    (loss_sum, grads), _ = jax.lax.scan(
+                        acc_fn, zero, tuple(micro))
+                    loss = loss_sum / self.grad_accum_steps
+                    grads = jax.tree.map(
+                        lambda g: g / self.grad_accum_steps, grads)
             else:
                 loss, grads = jax.value_and_grad(loss_of)(params, batch)
             if self.sharding_level >= 2:

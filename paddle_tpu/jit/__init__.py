@@ -91,14 +91,33 @@ def functional_call(
             t._value = v
 
 
+_DY2STATIC_HINT = (
+    "to_static traces the function ONCE with abstract values, so Python "
+    "`if`/`while` on tensor DATA cannot be evaluated (shapes are fine — "
+    "they are static). Fixes, in order of preference: (1) rewrite with "
+    "paddle.static.nn.cond / while_loop / switch_case (structured control "
+    "flow that compiles); (2) paddle.where for elementwise selects; "
+    "(3) to_static(..., full_graph=False) to fall back to EAGER execution "
+    "for calls that hit data-dependent control flow (correct but "
+    "uncompiled). See tests/test_dy2static.py for the semantics table.")
+
+
 class StaticFunction:
     """Callable produced by ``to_static``: jax.jit over the eager function,
-    with Tensor<->jax.Array marshalling at the boundary."""
+    with Tensor<->jax.Array marshalling at the boundary.
+
+    Divergence guard (reference: test/dygraph_to_static discipline): the
+    reference REWRITES Python control flow into graph ops; here tracing
+    would silently take one branch — so data-dependent Python control flow
+    raises with guidance instead (or falls back to eager when
+    ``full_graph=False``)."""
 
     def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
                  full_graph=True, backend=None, static_argnums=()):
         self._fn = fn
         self._static_argnums = static_argnums
+        self._full_graph = full_graph
+        self._fell_back = False
         self.input_spec = input_spec
 
         @functools.partial(jax.jit, static_argnums=static_argnums)
@@ -110,7 +129,27 @@ class StaticFunction:
         self._jitted = _jitted
 
     def __call__(self, *args, **kwargs):
-        out = self._jitted(*tree_to_values(args), **tree_to_values(kwargs))
+        try:
+            out = self._jitted(*tree_to_values(args),
+                               **tree_to_values(kwargs))
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.TracerArrayConversionError) as e:
+            if self._full_graph:
+                raise RuntimeError(
+                    f"to_static: data-dependent Python control flow in "
+                    f"{getattr(self._fn, '__name__', self._fn)!r}. "
+                    + _DY2STATIC_HINT) from e
+            if not self._fell_back:
+                import warnings
+                warnings.warn(
+                    "to_static(full_graph=False): falling back to eager "
+                    "for data-dependent control flow — correct, but this "
+                    "call is NOT compiled. " + _DY2STATIC_HINT,
+                    stacklevel=2)
+                self._fell_back = True
+            return self._fn(*args, **kwargs)
         return tree_to_tensors(out)
 
     @property
@@ -128,10 +167,15 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     def decorate(fn):
         if hasattr(fn, "forward") and not callable(getattr(fn, "__wrapped_layer__", None)):
             layer = fn
+            orig_forward = layer.forward   # bind BEFORE rebinding: the
+            # traced lambda must call the real forward, not the wrapper
+            # (late binding would recurse infinitely)
 
             class _StaticLayerCall:
                 def __init__(self):
-                    self._sf = StaticFunction(lambda *a, **k: layer.forward(*a, **k))
+                    self._sf = StaticFunction(
+                        lambda *a, **k: orig_forward(*a, **k),
+                        full_graph=full_graph)
 
                 def __call__(self, *a, **k):
                     return self._sf(*a, **k)
@@ -139,7 +183,8 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             wrapped = _StaticLayerCall()
             layer.forward = wrapped
             return layer
-        return functools.wraps(fn)(StaticFunction(fn, input_spec=input_spec))
+        return functools.wraps(fn)(StaticFunction(
+            fn, input_spec=input_spec, full_graph=full_graph))
 
     if function is not None:
         return decorate(function)

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
-__all__ = ["InputSpec"]
+__all__ = ["InputSpec", "nn"]
 
 
 class InputSpec:
@@ -31,3 +31,6 @@ class InputSpec:
     @classmethod
     def from_tensor(cls, tensor, name=None):
         return cls(tuple(tensor.shape), str(tensor.dtype), name)
+
+
+from . import nn  # noqa: E402,F401

@@ -1,0 +1,100 @@
+"""Structured control flow usable under ``to_static`` tracing.
+
+Reference: python/paddle/static/nn/control_flow.py (``cond``,
+``while_loop``, ``case``, ``switch_case`` build ConditionalBlock/While
+ops into the static Program). Under XLA the same constructs map to
+``lax.cond`` / ``lax.while_loop`` / ``lax.switch`` — these are the
+supported replacements for data-dependent Python ``if``/``while``, which
+cannot be traced (see jit.to_static's semantics table).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..jit import tree_to_tensors, tree_to_values
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, name=None,
+         return_names=None):
+    """``paddle.static.nn.cond``: only the taken branch executes at
+    runtime; both branches must return the same structure/shapes."""
+    out = lax.cond(_val(pred).astype(bool).reshape(()),
+                   lambda: tree_to_values(true_fn()),
+                   lambda: tree_to_values(false_fn()))
+    return tree_to_tensors(out)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
+               is_test: bool = False, name=None) -> List:
+    """``paddle.static.nn.while_loop``: loop_vars must keep their
+    shapes/dtypes across iterations (XLA compiles one body)."""
+    init = tuple(tree_to_values(tuple(loop_vars)))
+
+    def c(vals):
+        return _val(cond_fn(*tree_to_tensors(vals))).astype(bool).reshape(())
+
+    def b(vals):
+        out = body_fn(*tree_to_tensors(vals))
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        return tuple(tree_to_values(tuple(out)))
+
+    out = lax.while_loop(c, b, init)
+    return list(tree_to_tensors(out))
+
+
+def case(pred_fn_pairs: Sequence[Tuple], default: Callable = None,
+         name=None):
+    """``paddle.static.nn.case``: first matching predicate wins (lowered
+    as a chain of lax.cond)."""
+    if default is None:
+        *pred_fn_pairs, last = pred_fn_pairs
+        default = last[1] if isinstance(last, (tuple, list)) else last
+
+    def build(pairs):
+        if not pairs:
+            return tree_to_values(default())
+        (p, fn), *rest = pairs
+        return lax.cond(_val(p).astype(bool).reshape(()),
+                        lambda: tree_to_values(fn()),
+                        lambda: build(rest))
+
+    return tree_to_tensors(build(list(pred_fn_pairs)))
+
+
+def switch_case(branch_index, branch_fns, default: Callable = None,
+                name=None):
+    """``paddle.static.nn.switch_case`` over ``lax.switch``."""
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+        index_map = {k: i for i, k in enumerate(keys)}
+        idx = _val(branch_index).reshape(())
+        # map sparse indices onto dense switch slots
+        import jax.numpy as jnp
+        dense = jnp.full((), len(fns), jnp.int32)
+        for k, i in index_map.items():
+            dense = jnp.where(idx == k, i, dense)
+        idx = dense
+    else:
+        fns = list(branch_fns)
+        idx = _val(branch_index).astype("int32").reshape(())
+    if default is not None:
+        fns = fns + [default]
+        # any out-of-range index (negative included) runs default —
+        # reference switch_case semantics
+        import jax.numpy as jnp
+        idx = jnp.where((idx < 0) | (idx >= len(fns) - 1),
+                        len(fns) - 1, idx)
+    idx = lax.clamp(0, idx, len(fns) - 1)
+    out = lax.switch(idx, [lambda f=f: tree_to_values(f()) for f in fns])
+    return tree_to_tensors(out)

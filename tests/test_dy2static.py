@@ -1,0 +1,202 @@
+"""dy2static equivalence suite (reference: test/dygraph_to_static/,
+SURVEY.md §4): eager vs to_static over Python control flow, with every
+divergence class either EXACT, GUARDED (clear error + working
+alternative), or DOCUMENTED.
+
+Semantics table
+===============
+
+| construct                         | eager      | to_static                |
+|-----------------------------------|------------|--------------------------|
+| if on SHAPES / python values      | works      | EXACT (static at trace)  |
+| for over range(static n)          | works      | EXACT (unrolled)         |
+| if/while on tensor DATA           | works      | GUARDED: RuntimeError    |
+|                                   |            | with guidance (default   |
+|                                   |            | full_graph=True)         |
+| ... with full_graph=False         | works      | eager fallback + warning |
+| static.nn.cond / while_loop /     | works      | EXACT (lax control flow, |
+|   switch_case / case              |            | compiled)                |
+| paddle.where elementwise select   | works      | EXACT                    |
+| Python side effects (print,       | every call | ONCE at trace time       |
+|   append, global mutation)        |            | (DOCUMENTED, pinned)     |
+| float()/int()/bool() on tensors   | works      | GUARDED (same error)     |
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.jit import to_static
+from paddle_tpu.static import nn as snn
+
+
+def t(x, dtype=np.float32):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+class TestExactClasses:
+    def test_shape_dependent_branch_exact(self):
+        def fn(x):
+            if x.shape[0] > 2:          # shape: static at trace time
+                return x * 2
+            return x + 1
+
+        st = to_static(fn)
+        big, small = t(np.ones((4, 2))), t(np.ones((2, 2)))
+        np.testing.assert_allclose(st(big).numpy(), fn(big).numpy())
+        np.testing.assert_allclose(st(small).numpy(), fn(small).numpy())
+
+    def test_static_python_loop_unrolled_exact(self):
+        def fn(x):
+            acc = x
+            for i in range(3):          # static trip count: unrolled
+                acc = acc * 2 + i
+            return acc
+
+        st = to_static(fn)
+        x = t(np.arange(6).reshape(2, 3))
+        np.testing.assert_allclose(st(x).numpy(), fn(x).numpy())
+
+    def test_where_select_exact(self):
+        def fn(x):
+            return paddle.where(x > 0, x, -x)
+
+        st = to_static(fn)
+        x = t(np.linspace(-2, 2, 8))
+        np.testing.assert_allclose(st(x).numpy(), fn(x).numpy())
+
+
+class TestGuardedClasses:
+    def test_data_dependent_if_raises_with_guidance(self):
+        @to_static
+        def fn(x):
+            if x.sum() > 0:             # DATA-dependent: cannot trace
+                return x * 2
+            return x + 1
+
+        with pytest.raises(RuntimeError, match="static.nn.cond"):
+            fn(t(np.ones(3)))
+
+    def test_data_dependent_while_raises(self):
+        @to_static
+        def fn(x):
+            while x.sum() < 10:
+                x = x * 2
+            return x
+
+        with pytest.raises(RuntimeError, match="control flow"):
+            fn(t(np.ones(3)))
+
+    def test_float_conversion_raises(self):
+        @to_static
+        def fn(x):
+            return float(x.sum()) * x   # host pull mid-trace
+
+        with pytest.raises(RuntimeError, match="control flow"):
+            fn(t(np.ones(3)))
+
+    def test_full_graph_false_falls_back_to_eager(self):
+        def fn(x):
+            if x.sum() > 0:
+                return x * 2
+            return x + 1
+
+        st = to_static(fn, full_graph=False)
+        pos, neg = t(np.ones(3)), t(-np.ones(3))
+        with pytest.warns(UserWarning, match="NOT compiled"):
+            np.testing.assert_allclose(st(pos).numpy(), fn(pos).numpy())
+        # both branches reachable: truly eager, not a frozen trace
+        np.testing.assert_allclose(st(neg).numpy(), fn(neg).numpy())
+
+
+class TestStructuredControlFlow:
+    """The compiled replacements: eager == to_static on BOTH branches."""
+
+    def test_cond(self):
+        def fn(x):
+            return snn.cond(x.sum() > 0, lambda: x * 2, lambda: x + 1)
+
+        st = to_static(fn)
+        for val in (np.ones(3), -np.ones(3)):
+            x = t(val)
+            np.testing.assert_allclose(st(x).numpy(), fn(x).numpy())
+
+    def test_while_loop(self):
+        def fn(x):
+            def cond_fn(i, acc):
+                return i < 4
+
+            def body(i, acc):
+                return i + 1, acc * 2
+
+            _, out = snn.while_loop(cond_fn, body,
+                                    [t(0, np.int32), x])
+            return out
+
+        st = to_static(fn)
+        x = t(np.arange(3))
+        np.testing.assert_allclose(st(x).numpy(), fn(x).numpy())
+        np.testing.assert_allclose(st(x).numpy(), x.numpy() * 16)
+
+    def test_data_dependent_while_loop(self):
+        """The while_loop trip count may depend on tensor DATA — the case
+        plain Python `while` cannot compile."""
+        def fn(x):
+            def cond_fn(v):
+                return v.sum() < 100
+
+            def body(v):
+                return v * 2
+
+            (out,) = snn.while_loop(cond_fn, body, [x])
+            return out
+
+        st = to_static(fn)
+        for seed in (1.0, 30.0):
+            x = t(np.full(3, seed))
+            np.testing.assert_allclose(st(x).numpy(), fn(x).numpy())
+
+    def test_case_and_switch_case(self):
+        x = t(np.ones(4))
+
+        def fn(ix):
+            return snn.switch_case(ix, [lambda: x * 1, lambda: x * 2,
+                                        lambda: x * 3],
+                                   default=lambda: x * 0)
+
+        st = to_static(fn)
+        for i in (0, 1, 2, 7):
+            np.testing.assert_allclose(st(t(i, np.int32)).numpy(),
+                                       fn(t(i, np.int32)).numpy())
+
+        out = snn.case([(x.sum() > 10, lambda: x * 10),
+                        (x.sum() > 2, lambda: x * 2)],
+                       default=lambda: x)
+        np.testing.assert_allclose(out.numpy(), x.numpy() * 2)
+
+
+class TestDocumentedDivergence:
+    def test_side_effects_run_once_at_trace(self):
+        """Python side effects are trace-time-only under to_static — the
+        documented (reference-divergent: SOT would re-trace) semantics."""
+        calls = []
+
+        def fn(x):
+            calls.append(1)             # side effect
+            return x * 2
+
+        st = to_static(fn)
+        x = t(np.ones(3))
+        for _ in range(3):
+            st(x)
+        assert len(calls) == 1          # traced once, cached after
+        eager_calls = []
+
+        def fn2(x):
+            eager_calls.append(1)
+            return x * 2
+
+        for _ in range(3):
+            fn2(x)
+        assert len(eager_calls) == 3

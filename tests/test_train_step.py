@@ -240,3 +240,42 @@ class TestHapiModel:
         model.fit(ds, batch_size=8, epochs=2, verbose=0)
         res = model.evaluate(ds, batch_size=8, verbose=0)
         assert res["loss"] is not None and np.isfinite(res["loss"])
+
+
+class TestFusedGradAccum:
+    """fused_grad_accum puts the microbatch loop inside the differentiated
+    scan (the fused_linear_param_grad_add equivalent) — must match the
+    materialize-then-add path step for step, and both must match a
+    full-batch step (linear loss => averaging microbatch grads is exact).
+    """
+
+    def _run(self, fused, accum, steps=3):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.hapi import TrainStep
+
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 8))
+        opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+        step = TrainStep(net, opt, grad_accum_steps=accum,
+                         fused_grad_accum=fused,
+                         loss_fn=lambda o, y: F.mse_loss(
+                             paddle.Tensor(o), paddle.Tensor(y))._value)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32))
+        losses = [float(step(x, x)) for _ in range(steps)]
+        step.sync_to_model()
+        return losses, {k: np.asarray(v._value)
+                        for k, v in net.named_parameters()}
+
+    def test_fused_matches_unfused_and_full_batch(self):
+        lf, pf = self._run(True, 4)
+        lu, pu = self._run(False, 4)
+        l1, p1 = self._run(True, 1)
+        np.testing.assert_allclose(lf, lu, rtol=1e-5)
+        np.testing.assert_allclose(lf, l1, rtol=1e-5)
+        for k in pf:
+            np.testing.assert_allclose(pf[k], pu[k], rtol=1e-5, atol=1e-6,
+                                       err_msg=k)
+            np.testing.assert_allclose(pf[k], p1[k], rtol=1e-4, atol=1e-5,
+                                       err_msg=k)
