@@ -16,13 +16,22 @@ from .core.dtype import (  # noqa: F401
     float16, float32, float64, float8_e4m3fn, float8_e5m2,
     int8, int16, int32, int64, uint8,
 )
-from .core.dtype import bool_  # noqa: F401
+from .core.dtype import bool_, finfo, iinfo  # noqa: F401
+
+
+def __getattr__(name):
+    # paddle.bool without shadowing the builtin inside this module's own
+    # function bodies (PEP 562)
+    if name == "bool":
+        return bool_
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 from .core.place import (  # noqa: F401
     CPUPlace, CUDAPlace, Place, TPUPlace, XPUPlace, device_count,
     get_default_dtype, get_device, is_compiled_with_cuda,
     is_compiled_with_tpu, is_compiled_with_xpu, set_default_dtype, set_device,
 )
 from .core.tensor import Parameter, Tensor  # noqa: F401
+from .nn.param_attr import ParamAttr  # noqa: F401
 from .core.autograd import enable_grad, no_grad, set_grad_enabled  # noqa: F401
 from .core import autograd as _autograd_mod
 
@@ -51,6 +60,8 @@ from .framework.io import load, save  # noqa: F401
 from .framework.lazy import LazyGuard  # noqa: F401
 from . import distributed  # noqa: F401
 from . import hapi  # noqa: F401
+from .hapi.summary import flops, summary  # noqa: F401
+from . import linalg  # noqa: F401
 from . import models  # noqa: F401
 from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401

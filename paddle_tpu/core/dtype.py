@@ -113,3 +113,34 @@ def to_jax_dtype(dtype):
 
 def is_floating(dtype) -> bool:
     return to_paddle_dtype(dtype).is_floating_point
+
+
+class finfo:
+    """reference: paddle.finfo — float dtype limits."""
+
+    def __init__(self, dtype):
+        d = (dtype.np_dtype if isinstance(dtype, DType)
+             else to_jax_dtype(dtype))
+        import ml_dtypes
+        info = ml_dtypes.finfo(d)
+        self.dtype = str(np.dtype(d))
+        self.bits = info.bits
+        self.eps = float(info.eps)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+
+
+class iinfo:
+    """reference: paddle.iinfo — integer dtype limits."""
+
+    def __init__(self, dtype):
+        d = (dtype.np_dtype if isinstance(dtype, DType)
+             else to_jax_dtype(dtype))
+        info = np.iinfo(np.dtype(d))
+        self.dtype = str(np.dtype(d))
+        self.bits = info.bits
+        self.min = int(info.min)
+        self.max = int(info.max)

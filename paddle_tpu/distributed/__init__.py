@@ -19,3 +19,12 @@ from .parallel import (  # noqa: F401
 )
 from . import launch  # noqa: F401
 from .spawn import spawn  # noqa: F401
+
+# reference-name aliases + late surface (paddle.distributed.*)
+from .communication import alltoall as all_to_all  # noqa: F401
+from .communication import alltoall_single as all_to_all_single  # noqa: F401
+from .communication import gather  # noqa: F401
+from .fleet.meta_parallel.meta_parallel_base import DataParallel  # noqa: F401
+from .fleet import DistributedStrategy as Strategy  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from .auto_parallel import shard_layer, to_static  # noqa: F401

@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = [
     "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
     "shard_tensor", "dtensor_from_fn", "reshard", "shard_optimizer",
+    "shard_layer", "to_static",
     "Engine", "placements_to_spec", "spec_to_placements",
 ]
 
@@ -324,3 +325,33 @@ class Engine:
     def save(self, path: str):
         from ... import save
         save(self.state_dict(), path)
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """reference: dist.shard_layer — distribute a Layer's parameters over
+    ``process_mesh``. ``shard_fn(name, layer, mesh)`` may annotate
+    sublayers; the default leaves params replicated (annotations come
+    from the parallel layers or dist_attr)."""
+    if shard_fn is not None:
+        for name, sub in layer.named_sublayers():
+            shard_fn(name, sub, process_mesh)
+    else:
+        jmesh = process_mesh.to_jax_mesh()
+        for _, p in layer.named_parameters():
+            spec = getattr(p, "dist_attr", None) or P()
+            p._value = jax.device_put(p._value, NamedSharding(jmesh, spec))
+            p.process_mesh = process_mesh
+    return layer
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """reference: dist.to_static — wrap a (sharded) Layer + loss +
+    optimizer into an executable distributed program. Returns an Engine
+    (prepare() builds the jitted TrainStep)."""
+    mesh = getattr(layer, "process_mesh", None)
+    for _, p in layer.named_parameters():
+        mesh = mesh or getattr(p, "process_mesh", None)
+    eng = Engine(layer, loss=loss, optimizer=optimizer, strategy=strategy,
+                 mesh=mesh)
+    return eng

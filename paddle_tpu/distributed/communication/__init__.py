@@ -4,6 +4,7 @@
 from . import in_jit, stream  # noqa: F401
 from .collectives import (  # noqa: F401
     Task, all_gather, all_gather_object, all_reduce, alltoall,
+    gather,
     alltoall_single, barrier, broadcast, reduce, reduce_scatter, scatter,
     scatter_object_list, wait,
 )

@@ -275,3 +275,18 @@ def scatter_object_list(out_object_list: List, in_object_list: Optional[List] = 
         raise ValueError("in_object_list must have group-size elements")
     del out_object_list[:]
     out_object_list.extend(in_object_list)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True) -> Task:
+    """reference: communication/gather.py — collect every rank's slice at
+    ``dst``. Single-controller note: the gathered list is globally
+    available (the rank-distinction is a layout property), so every
+    caller sees the full list; dst is accepted for API parity."""
+    group = _get_global_group(group)
+    x = _val(tensor)
+    _check_rank_dim(x, group, "gather")
+    if gather_list is not None:
+        del gather_list[:]
+        for j in range(group.nranks):
+            gather_list.append(Tensor(x[j], stop_gradient=True))
+    return Task()

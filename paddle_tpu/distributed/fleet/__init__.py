@@ -27,3 +27,14 @@ from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_rand
 from .role_maker import (  # noqa: F401
     PaddleCloudRoleMaker, Role, UserDefinedRoleMaker,
 )
+
+
+def worker_num() -> int:
+    """reference: fleet.worker_num (module-level convenience)."""
+    from .fleet import fleet as _fleet
+    return _fleet.worker_num()
+
+
+def worker_index() -> int:
+    from .fleet import fleet as _fleet
+    return _fleet.worker_index()

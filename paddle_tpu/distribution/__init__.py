@@ -24,6 +24,8 @@ from ..core.tensor import Tensor, apply_op, _val
 from ..framework.random import next_key
 
 __all__ = [
+    "ContinuousBernoulli", "ExponentialFamily", "MultivariateNormal",
+    "IndependentTransform", "ReshapeTransform", "StackTransform",
     "Distribution", "Normal", "LogNormal", "Uniform", "Bernoulli",
     "Binomial", "Categorical", "Multinomial", "Beta", "Dirichlet",
     "Exponential", "Gamma", "Geometric", "Gumbel", "Laplace", "Poisson",
@@ -1027,8 +1029,10 @@ class Independent(Distribution):
 
 from .transform import (  # noqa: E402,F401
     AbsTransform, AffineTransform, ChainTransform, ExpTransform,
-    PowerTransform, SigmoidTransform, SoftmaxTransform, StickBreakingTransform,
-    TanhTransform, Transform, TransformedDistribution,
+    IndependentTransform, PowerTransform, ReshapeTransform,
+    SigmoidTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform, TanhTransform, Transform,
+    TransformedDistribution,
 )
 
 __all__ += [
@@ -1036,3 +1040,149 @@ __all__ += [
     "ExpTransform", "PowerTransform", "SigmoidTransform", "SoftmaxTransform",
     "StickBreakingTransform", "TanhTransform",
 ]
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference:
+    distribution/exponential_family.py): subclasses expose natural
+    parameters + log-normalizer; entropy falls out via the Bregman
+    identity (autodiff of the log-normalizer)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    def entropy(self):
+        nat = [jnp.asarray(_val(p), jnp.float32)
+               for p in self._natural_parameters]
+        lg, grads = jax.value_and_grad(
+            lambda *ps: jnp.sum(self._log_normalizer(*ps)),
+            argnums=tuple(range(len(nat))))(*nat)
+        ent = lg
+        for n, g in zip(nat, grads):
+            ent = ent - jnp.sum(n * g)
+        # mean-reduce over batch happens in subclasses when needed
+        return Tensor(ent)
+
+
+class ContinuousBernoulli(Distribution):
+    """reference: distribution/continuous_bernoulli.py."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = probs if isinstance(probs, Tensor) else Tensor(
+            jnp.asarray(probs, jnp.float32))
+        self._lims = lims
+        shape = tuple(self.probs.shape)
+        super().__init__(shape, ())
+
+    def _c(self):
+        """log normalizing constant C(p)."""
+        p = _val(self.probs)
+        lo, hi = self._lims
+        safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+        cut = (safe < lo) | (safe > hi)
+        num = jnp.log(jnp.abs(jnp.arctanh(1 - 2 * jnp.where(cut, safe, lo))))
+        c = jnp.where(
+            cut,
+            jnp.log(2.0) + num - jnp.log(jnp.abs(1 - 2 * jnp.where(
+                cut, safe, lo))),
+            jnp.log(2.0))
+        return c
+
+    @property
+    def mean(self):
+        p = _val(self.probs)
+        safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+        near = jnp.abs(safe - 0.5) < 1e-3
+        m = jnp.where(near, 0.5,
+                      safe / (2 * safe - 1)
+                      + 1 / (2 * jnp.arctanh(1 - 2 * jnp.where(
+                          near, 0.25, safe))))
+        return Tensor(m)
+
+    def log_prob(self, value):
+        def fn(v, p):
+            pl = jnp.clip(p, 1e-6, 1 - 1e-6)
+            return (v * jnp.log(pl) + (1 - v) * jnp.log1p(-pl) + self._c())
+        return apply_op("cb_log_prob", fn, value, self.probs)
+
+    def sample(self, shape=()):
+        from ..framework.random import next_key
+        u = jax.random.uniform(
+            next_key(), tuple(shape) + tuple(self.probs.shape))
+        p = jnp.clip(_val(self.probs), 1e-6, 1 - 1e-6)
+        near = jnp.abs(p - 0.5) < 1e-3
+        ps = jnp.where(near, 0.25, p)
+        x = (jnp.log1p(u * (2 * ps - 1) / (1 - ps))
+             / (jnp.log(ps) - jnp.log1p(-ps)))
+        return Tensor(jnp.where(near, u, x))
+
+    rsample = sample
+
+
+class MultivariateNormal(Distribution):
+    """reference: distribution/multivariate_normal.py (loc + covariance,
+    Cholesky-parameterized sampling + log_prob)."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None):
+        self.loc = loc if isinstance(loc, Tensor) else Tensor(
+            jnp.asarray(loc, jnp.float32))
+        lv = _val(self.loc)
+        if scale_tril is not None:
+            self._tril = jnp.asarray(_val(scale_tril), jnp.float32)
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(
+                jnp.asarray(_val(covariance_matrix), jnp.float32))
+        elif precision_matrix is not None:
+            prec = jnp.asarray(_val(precision_matrix), jnp.float32)
+            self._tril = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        else:
+            raise ValueError("need covariance_matrix, precision_matrix or "
+                             "scale_tril")
+        super().__init__(tuple(lv.shape[:-1]), (lv.shape[-1],))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def covariance_matrix(self):
+        return Tensor(self._tril @ jnp.swapaxes(self._tril, -1, -2))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.sum(self._tril ** 2, axis=-1))
+
+    def rsample(self, shape=()):
+        from ..framework.random import next_key
+        lv = _val(self.loc)
+        eps = jax.random.normal(next_key(), tuple(shape) + lv.shape)
+        return Tensor(lv + jnp.einsum("...ij,...j->...i", self._tril, eps))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def fn(v, loc):
+            d = v - loc
+            # solve L z = d  ->  z = L^-1 d; logdet = sum log diag L
+            z = jax.scipy.linalg.solve_triangular(
+                self._tril, d[..., None], lower=True)[..., 0]
+            k = loc.shape[-1]
+            logdet = jnp.sum(jnp.log(jnp.diagonal(
+                self._tril, axis1=-2, axis2=-1)), axis=-1)
+            return (-0.5 * jnp.sum(z * z, axis=-1) - logdet
+                    - 0.5 * k * jnp.log(2 * jnp.pi))
+        return apply_op("mvn_log_prob", fn, value, self.loc)
+
+    def entropy(self):
+        k = self.event_shape[0]
+        logdet = jnp.sum(jnp.log(jnp.diagonal(
+            self._tril, axis1=-2, axis2=-1)), axis=-1)
+        return Tensor(0.5 * k * (1 + jnp.log(2 * jnp.pi)) + logdet)
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import math
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -251,3 +253,81 @@ class TransformedDistribution:
                         value if isinstance(value, Tensor)
                         else Tensor(jnp.asarray(value, jnp.float32),
                                     stop_gradient=True))
+
+
+class IndependentTransform(Transform):
+    """Reinterpret trailing batch dims of a base transform as event dims
+    (reference: distribution/transform.py IndependentTransform) — forward
+    /inverse delegate; the log-det sums over the reinterpreted dims."""
+
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        self._base = base
+        self._rank = int(reinterpreted_batch_rank)
+        self._event_rank = base._event_rank + self._rank
+
+    def _forward(self, x):
+        return self._base._forward(x)
+
+    def _inverse(self, y):
+        return self._base._inverse(y)
+
+    def _fldj(self, x):
+        ld = self._base._fldj(x)
+        axes = tuple(range(ld.ndim - self._rank, ld.ndim))
+        return ld.sum(axis=axes) if axes else ld
+
+
+class ReshapeTransform(Transform):
+    """Shape-only bijection (reference: ReshapeTransform): log-det 0."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self._in = tuple(in_event_shape)
+        self._out = tuple(out_event_shape)
+        if int(np.prod(self._in)) != int(np.prod(self._out)):
+            raise ValueError(f"element counts differ: {self._in} vs "
+                             f"{self._out}")
+        self._event_rank = len(self._in)
+
+    @property
+    def in_event_shape(self):
+        return self._in
+
+    @property
+    def out_event_shape(self):
+        return self._out
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self._in)]
+        return x.reshape(batch + self._out)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self._out)]
+        return y.reshape(batch + self._in)
+
+    def _fldj(self, x):
+        batch = x.shape[:x.ndim - len(self._in)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class StackTransform(Transform):
+    """Apply a list of transforms to slices along ``axis`` (reference:
+    StackTransform)."""
+
+    def __init__(self, transforms, axis: int = 0):
+        self._transforms = list(transforms)
+        self._axis = int(axis)
+
+    def _map(self, method, x):
+        parts = jnp.split(x, len(self._transforms), axis=self._axis)
+        outs = [getattr(t, method)(p.squeeze(self._axis))
+                for t, p in zip(self._transforms, parts)]
+        return jnp.stack(outs, axis=self._axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _fldj(self, x):
+        return self._map("_fldj", x)

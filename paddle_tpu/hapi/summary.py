@@ -1,0 +1,115 @@
+"""paddle.summary / paddle.flops (reference: python/paddle/hapi/
+model_summary.py and python/paddle/hapi/dynamic_flops.py): layer table
+with parameter counts + a per-layer FLOPs estimate, collected with
+forward post-hooks over one shape-driven forward pass."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def _num_params(layer, include_sub=False):
+    ps = layer.parameters(include_sublayers=include_sub)
+    return sum(int(np.prod(p.shape)) for p in ps)
+
+
+def _layer_flops(layer, inp, out):
+    """Matmul-dominated estimate per layer type (mults only, like the
+    reference's dynamic_flops handlers)."""
+    name = type(layer).__name__
+    o = int(np.prod(out.shape)) if hasattr(out, "shape") else 0
+    if name == "Linear":
+        return o * layer.weight.shape[0]
+    if name.startswith("Conv"):
+        w = layer.weight
+        per_out = int(np.prod(w.shape[1:]))       # cin/groups * prod(k)
+        return o * per_out
+    if "Norm" in name:
+        return 2 * o
+    if name in ("ReLU", "GELU", "Sigmoid", "Tanh", "Softmax"):
+        return o
+    return 0
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print + return a {'total_params', 'trainable_params'} dict
+    (reference: paddle.summary)."""
+    import paddle_tpu as paddle
+
+    rows = []
+    hooks = []
+
+    def mk_hook(name):
+        def hook(layer, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (tuple, list)) else outputs
+            rows.append((name, type(layer).__name__,
+                         tuple(getattr(out, "shape", ())),
+                         _num_params(layer, include_sub=False)))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        hooks.append(sub.register_forward_post_hook(mk_hook(name)))
+    try:
+        if input is None:
+            if input_size is None:
+                raise ValueError("summary needs input_size or input")
+            shapes = (input_size if isinstance(input_size, list)
+                      else [input_size])
+            dts = dtypes or ["float32"] * len(shapes)
+            input = [paddle.zeros(list(s), dtype=d)
+                     for s, d in zip(shapes, dts)]
+            out = net(*input)
+        else:
+            out = net(input)
+    finally:
+        for h in hooks:
+            h.remove()
+
+    total = _num_params(net, include_sub=True)
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+    width = max([len(r[0]) for r in rows], default=10) + 2
+    lines = [f"{'Layer':<{width}}{'Type':<24}{'Output Shape':<20}{'Params':>12}"]
+    lines.append("-" * (width + 56))
+    for name, tname, shape, n in rows:
+        lines.append(f"{name:<{width}}{tname:<24}{str(shape):<20}{n:>12,}")
+    lines.append("-" * (width + 56))
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops: Optional[dict] = None,
+          print_detail: bool = False):
+    """Total forward FLOPs estimate (reference: paddle.flops)."""
+    import paddle_tpu as paddle
+
+    acc = []
+    hooks = []
+
+    def mk_hook():
+        def hook(layer, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (tuple, list)) else outputs
+            if custom_ops and type(layer).__name__ in custom_ops:
+                acc.append(custom_ops[type(layer).__name__](
+                    layer, inputs, out))
+            else:
+                acc.append(_layer_flops(
+                    layer, inputs[0] if inputs else None, out))
+        return hook
+
+    for _, sub in net.named_sublayers():
+        hooks.append(sub.register_forward_post_hook(mk_hook()))
+    try:
+        x = paddle.zeros(list(input_size))
+        net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+    total = int(sum(acc))
+    if print_detail:
+        print(f"FLOPs: {total:,}")
+    return total

@@ -1,0 +1,9 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py re-exports
+the tensor.linalg surface)."""
+from .ops.linalg import *  # noqa: F401,F403
+from .ops.linalg import (cond, cov, corrcoef, eig, eigh, eigvals,  # noqa: F401
+                         eigvalsh, det, slogdet, inv, inverse, pinv, solve,
+                         lstsq, lu, lu_unpack, qr, svd, svdvals,
+                         matrix_power, matrix_rank, cholesky,
+                         cholesky_solve, triangular_solve, multi_dot,
+                         matrix_exp, householder_product, norm)

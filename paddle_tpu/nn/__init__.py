@@ -14,6 +14,7 @@ from .layers.common import (  # noqa: F401
     CrossEntropyLoss, Dropout, Dropout2D, Embedding, Flatten, GroupNorm,
     Identity, KLDivLoss, L1Loss, LayerNorm, Linear, MaxPool2D, MSELoss,
     NLLLoss, Pad2D, PixelShuffle, RMSNorm, SmoothL1Loss, Upsample,
+    BatchNorm, SyncBatchNorm,
 )
 from .layers.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder,
@@ -32,5 +33,10 @@ from .layers.extra import (  # noqa: F401
     MarginRankingLoss, MaxPool1D, MaxPool3D, MultiLabelSoftMarginLoss,
     PairwiseDistance, PoissonNLLLoss, SoftMarginLoss, TripletMarginLoss,
     Unfold, ZeroPad2D,
+    AlphaDropout, Dropout3D, HuberLoss, MaxUnPool1D, MaxUnPool2D,
+    MaxUnPool3D, Maxout, MultiMarginLoss, Pad1D, Pad3D, PixelUnshuffle,
+    RNNTLoss, RReLU, SpectralNorm, ThresholdedReLU, UpsamplingBilinear2D,
+    UpsamplingNearest2D,
 )
+from . import utils  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401

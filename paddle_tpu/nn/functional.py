@@ -702,11 +702,11 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
     # single source for pool padding/ceil semantics: functional_extra
-    from .functional_extra import _pool_nd
+    from .functional_extra import _max_pool_mask_nd, _pool_nd
     if return_mask:
-        raise NotImplementedError(
-            "max_pool2d(return_mask=True) is not implemented on TPU; "
-            "use unfold + argmax if indices are required")
+        return _max_pool_mask_nd(x, 2, kernel_size,
+                                 stride or kernel_size, padding,
+                                 ceil_mode, "max_pool2d", data_format)
     fn, *_ = _pool_nd(_val(x), 2, kernel_size, stride or kernel_size,
                       padding, jax.lax.max, -jnp.inf, data_format, ceil_mode)
     return apply_op("max_pool2d", fn, x)

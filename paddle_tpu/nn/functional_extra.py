@@ -169,8 +169,9 @@ def _avg_pool_nd(x, nd, op_name, kernel_size, stride, padding, exclusive,
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     if return_mask:
-        raise NotImplementedError("max_pool1d(return_mask=True): use "
-                                  "unfold + argmax on TPU")
+        return _max_pool_mask_nd(x, 1, kernel_size,
+                                 stride or kernel_size, padding,
+                                 ceil_mode, "max_pool1d", data_format)
     fn, *_ = _pool_nd(x, 1, kernel_size, stride or kernel_size, padding,
                       jax.lax.max, -jnp.inf, data_format, ceil_mode)
     return apply_op("max_pool1d", fn, x)
@@ -185,7 +186,9 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     if return_mask:
-        raise NotImplementedError("max_pool3d(return_mask=True)")
+        return _max_pool_mask_nd(x, 3, kernel_size,
+                                 stride or kernel_size, padding,
+                                 ceil_mode, "max_pool3d", data_format)
     fn, *_ = _pool_nd(x, 3, kernel_size, stride or kernel_size, padding,
                       jax.lax.max, -jnp.inf, data_format, ceil_mode)
     return apply_op("max_pool3d", fn, x)
@@ -638,3 +641,287 @@ def zeropad2d(x, padding, data_format="NCHW", name=None):
         return jnp.pad(a, ((0, 0), (p[2], p[3]), (p[0], p[1]), (0, 0)))
 
     return apply_op("zeropad2d", fn, x)
+
+
+# ----------------------------------------------- coverage-manifest additions
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """nn.functional.pad — same op as paddle.pad (reference exposes both)."""
+    from ..ops.manipulation import pad as _pad
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    """reference: python/paddle/nn/functional/loss.py huber_loss."""
+    def fn(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        out = jnp.where(ad <= delta, 0.5 * d * d,
+                        delta * (ad - 0.5 * delta))
+        if reduction == "mean":
+            return out.mean()
+        if reduction == "sum":
+            return out.sum()
+        return out
+    return apply_op("huber_loss", fn, input, label)
+
+
+def maxout(x, groups, axis=1, name=None):
+    """reference: nn/functional/activation.py maxout — max over channel
+    groups: C -> C/groups."""
+    def fn(a):
+        ax = axis if axis >= 0 else a.ndim + axis
+        c = a.shape[ax]
+        if c % groups:
+            raise ValueError(f"channels {c} not divisible by groups {groups}")
+        shp = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return a.reshape(shp).max(axis=ax + 1)
+    return apply_op("maxout", fn, x)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """reference: loss.py multi_margin_loss (multi-class hinge)."""
+    def fn(a, lab, *w):
+        n, c = a.shape
+        correct = jnp.take_along_axis(a, lab[:, None], axis=1)
+        m = jnp.maximum(0.0, margin - correct + a) ** p
+        if w:
+            m = m * w[0][lab][:, None]
+        mask = jax.nn.one_hot(lab, c, dtype=a.dtype)
+        out = (m * (1 - mask)).sum(axis=1) / c
+        if reduction == "mean":
+            return out.mean()
+        if reduction == "sum":
+            return out.sum()
+        return out
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply_op("multi_margin_loss", fn, *args)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    """reference: vision.py pixel_unshuffle — inverse of pixel_shuffle."""
+    r = downscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            return a.transpose(0, 1, 3, 5, 2, 4).reshape(
+                n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        return a.transpose(0, 1, 3, 5, 2, 4).reshape(
+            n, h // r, w // r, c * r * r)
+    return apply_op("pixel_unshuffle", fn, x)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    """reference: activation.py rrelu — randomized leaky slope in train,
+    mean slope in eval."""
+    if not training:
+        slope = (lower + upper) / 2.0
+        return apply_op("rrelu", lambda a: jnp.where(a >= 0, a, a * slope), x)
+    from ..framework.random import next_key
+
+    key = next_key()
+
+    def fn(a):
+        slopes = jax.random.uniform(key, a.shape, jnp.float32,
+                                    lower, upper).astype(a.dtype)
+        return jnp.where(a >= 0, a, a * slopes)
+    return apply_op("rrelu", fn, x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op("thresholded_relu",
+                    lambda a: jnp.where(a > threshold, a, value), x)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """reference: nn/functional/extension.py sequence_mask:
+    out[..., j] = j < x[...]."""
+    from ..core.dtype import to_jax_dtype
+
+    def fn(lens):
+        m = maxlen if maxlen is not None else int(jnp.max(lens))
+        iota = jnp.arange(m)
+        return (iota < lens[..., None]).astype(to_jax_dtype(dtype))
+    if maxlen is None:
+        import numpy as _np
+        lens = _val(x)
+        m = int(_np.asarray(lens).max())
+        return apply_op("sequence_mask",
+                        lambda l: (jnp.arange(m) < l[..., None]).astype(
+                            to_jax_dtype(dtype)), x)
+    return apply_op("sequence_mask", fn, x)
+
+
+# ------------------------------------------- max pool with indices + unpool
+def _max_pool_mask_nd(x, nd, kernel, stride, padding, ceil_mode, op_name,
+                      data_format="NCX"):
+    """return_mask=True path: manual -inf padding + patch extraction +
+    argmax. Indices are flat positions in the UNPADDED per-channel spatial
+    map (the reference convention, feeding max_unpool). Channels-last
+    formats transpose around the NC* core (the spatial flat index is
+    layout-independent)."""
+    if data_format.endswith("C") and len(data_format) > 2:
+        perm_in = (0, len(data_format) - 1) + tuple(
+            range(1, len(data_format) - 1))
+        from ..ops.manipulation import transpose as _tp
+        vals, idx = _max_pool_mask_nd(
+            _tp(x, list(perm_in)), nd, kernel, stride, padding, ceil_mode,
+            op_name)
+        perm_out = (0,) + tuple(range(2, nd + 2)) + (1,)
+        return _tp(vals, list(perm_out)), _tp(idx, list(perm_out))
+    kernel = (kernel,) * nd if isinstance(kernel, int) else tuple(kernel)
+    stride = (stride,) * nd if isinstance(stride, int) else tuple(stride)
+    padding = (padding,) * nd if isinstance(padding, int) else tuple(padding)
+
+    def fn(a):
+        spatial = a.shape[2:]
+        sp = tuple((p, p + _ceil_extra(L, k, s, p, ceil_mode))
+                   for L, k, s, p in zip(spatial, kernel, stride, padding))
+        ap = jnp.pad(a, ((0, 0), (0, 0)) + sp, constant_values=_NEG_INF)
+        patches = jax.lax.conv_general_dilated_patches(
+            ap, kernel, stride, [(0, 0)] * nd)
+        n, ck, *out_sp = patches.shape
+        c = a.shape[1]
+        # patch channel layout: (C, *kernel) row-major
+        patches = patches.reshape((n, c, int(np.prod(kernel))) + tuple(out_sp))
+        vals = patches.max(axis=2)
+        loc = patches.argmax(axis=2)                       # local kernel idx
+        # local -> absolute (unpadded) coordinates, then flatten
+        flat = jnp.zeros_like(loc)
+        rem = loc
+        mult = 1
+        coords = []
+        for d in range(nd - 1, -1, -1):
+            kd = rem % kernel[d]
+            rem = rem // kernel[d]
+            coords.append((d, kd))
+        idx = jnp.zeros_like(loc)
+        for d, kd in coords:
+            out_idx = jax.lax.broadcasted_iota(
+                loc.dtype, loc.shape, 2 + d)
+            abs_d = out_idx * stride[d] - padding[d] + kd
+            m = 1
+            for dd in range(d + 1, nd):
+                m *= spatial[dd]
+            idx = idx + abs_d * m
+        return vals, idx.astype(jnp.int32)
+
+    # through apply_op so gradients flow into the pooled values (the
+    # int index output gets a float0 cotangent and stays grad-free)
+    return apply_op(op_name, fn, x)
+
+
+def _max_unpool_nd(x, indices, nd, kernel, stride, padding, output_size,
+                   op_name):
+    kernel = (kernel,) * nd if isinstance(kernel, int) else tuple(kernel)
+    stride_ = stride or kernel
+    stride_ = ((stride_,) * nd if isinstance(stride_, int)
+               else tuple(stride_))
+    padding = (padding,) * nd if isinstance(padding, int) else tuple(padding)
+
+    def fn(a, idx):
+        n, c, *out_sp = a.shape
+        if output_size is not None:
+            target = tuple(output_size[-nd:])
+        else:
+            target = tuple((o - 1) * s - 2 * p + k for o, s, p, k in
+                           zip(out_sp, stride_, padding, kernel))
+        flat_sz = int(np.prod(target))
+        af = a.reshape(n * c, -1)
+        ix = idx.reshape(n * c, -1)
+
+        def scatter_one(vals, ii):
+            return jnp.zeros((flat_sz,), a.dtype).at[ii].set(vals)
+
+        out = jax.vmap(scatter_one)(af, ix)
+        return out.reshape((n, c) + target)
+
+    return apply_op(op_name, fn, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """reference: nn/functional/pooling.py max_unpool1d."""
+    return _max_unpool_nd(x, indices, 1, kernel_size, stride, padding,
+                          output_size, "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool_nd(x, indices, 2, kernel_size, stride, padding,
+                          output_size, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool_nd(x, indices, 3, kernel_size, stride, padding,
+                          output_size, "max_unpool3d")
+
+
+def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-Transducer loss (reference: python/paddle/nn/functional/loss.py
+    rnnt_loss over warprnnt). TPU-native: log-semiring forward DP
+    alpha[t, u] = logaddexp(alpha[t-1, u] + blank(t-1, u),
+                            alpha[t, u-1] + label(t, u-1))
+    as a lax.scan over T with an inner scan over U, vmapped over the
+    batch. Static (T, U) grid, variable lengths via masked gather."""
+    def fn(lg, lab, tl, ul):
+        b, t_max, u1, v = lg.shape
+        u_max = u1 - 1
+        lp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        blank_lp = lp[..., blank]                          # (B, T, U+1)
+        lab_idx = jnp.minimum(lab, v - 1)
+        y_lp = jnp.take_along_axis(
+            lp[:, :, :u_max, :], lab_idx[:, None, :, None],
+            axis=-1)[..., 0]                               # (B, T, U)
+        # mask label positions beyond each sample's label length
+        u_iota = jnp.arange(u_max)[None, None, :]
+        y_lp = jnp.where(u_iota < ul[:, None, None], y_lp, _NEG_INF)
+
+        def one(blank_b, y_b, tl_b, ul_b):
+            # alpha row for t=0: alpha[0, u] = sum of label steps
+            first = jnp.concatenate(
+                [jnp.zeros((1,)), jnp.cumsum(y_b[0])])     # (U+1,)
+
+            def t_step(prev, xs):
+                blank_t_1, y_t = xs                        # rows t-1, t
+                base = prev + blank_t_1                    # vertical move
+
+                def u_step(carry, bu):
+                    b_u, y_u_1 = bu
+                    val = jnp.logaddexp(b_u, carry + y_u_1)
+                    return val, val
+
+                first_v = base[0]
+                _, rest = jax.lax.scan(
+                    u_step, first_v,
+                    (base[1:], y_t))
+                row = jnp.concatenate([first_v[None], rest])
+                return row, None
+
+            def t_step_collect(prev, xs):
+                row, _ = t_step(prev, xs)
+                return row, row
+
+            _, rows = jax.lax.scan(t_step_collect, first,
+                                   (blank_b[:-1], y_b[1:]))
+            all_rows = jnp.concatenate([first[None], rows], axis=0)
+            final_row = all_rows[jnp.maximum(tl_b - 1, 0)]
+            final_alpha = final_row[ul_b]
+            final_blank = blank_b[jnp.maximum(tl_b - 1, 0), ul_b]
+            return -(final_alpha + final_blank)
+
+        losses = jax.vmap(one)(blank_lp, y_lp, tl, ul)
+        if reduction == "mean":
+            return losses.mean()
+        if reduction == "sum":
+            return losses.sum()
+        return losses
+
+    return apply_op("rnnt_loss", fn, logits, labels, logit_lengths,
+                    label_lengths)

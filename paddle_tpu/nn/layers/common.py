@@ -467,3 +467,54 @@ class CosineSimilarity(Layer):
 
     def forward(self, x1, x2):
         return F.cosine_similarity(x1, x2, self.axis, self.eps)
+
+
+class BatchNorm(BatchNorm1D):
+    """Rank-agnostic BatchNorm (reference: nn/layer/norm.py BatchNorm —
+    the pre-2.0 API kept for compatibility; acts on dim 1)."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, data_layout="NCHW",
+                 use_global_stats=False, name=None, **kwargs):
+        super().__init__(num_channels, momentum, epsilon, param_attr,
+                         bias_attr, data_layout,
+                         use_global_stats or None)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act == "relu":
+            return F.relu(out)
+        return out
+
+
+class SyncBatchNorm(BatchNorm1D):
+    """reference: nn/layer/norm.py SyncBatchNorm (cross-device stats via
+    NCCL). TPU-native: under a jitted GSPMD step the batch axis is sharded
+    over dp, so the plain mean/var reductions ALREADY lower to global
+    collectives — synchronized stats fall out of the programming model
+    rather than a special kernel. This subclass exists for API parity and
+    for convert_sync_batchnorm."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format)
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """Recursively swap BatchNorm*D for SyncBatchNorm (reference
+        classmethod of the same name)."""
+        if isinstance(layer, BatchNorm1D) and not isinstance(
+                layer, SyncBatchNorm):
+            new = cls(layer._mean.shape[0], layer._momentum,
+                      layer._epsilon)
+            new.weight = layer.weight
+            new.bias = layer.bias
+            new._buffers["_mean"] = layer._mean
+            new._buffers["_variance"] = layer._variance
+            return new
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer

@@ -356,3 +356,226 @@ class GaussianNLLLoss(Layer):
 
     def forward(self, input, label, variance):
         return F.gaussian_nll_loss(input, label, variance, *self._args)
+
+
+# ------------------------------------------- coverage-manifest layer batch
+class AlphaDropout(Layer):
+    """reference: nn/layer/common.py AlphaDropout (SELU-preserving)."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, p=self.p, training=self.training)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p, self.data_format = p, data_format
+
+    def forward(self, x):
+        return F.dropout3d(x, p=self.p, training=self.training,
+                           data_format=self.data_format)
+
+
+class HuberLoss(Layer):
+    def __init__(self, reduction="mean", delta=1.0, name=None):
+        super().__init__()
+        self._args = (delta, reduction)
+
+    def forward(self, input, label):
+        return F.huber_loss(input, label, *self._args)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._p, self._margin, self._weight = p, margin, weight
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, p=self._p,
+                                   margin=self._margin, weight=self._weight,
+                                   reduction=self._reduction)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self._groups, self._axis = groups, axis
+
+    def forward(self, x):
+        return F.maxout(x, self._groups, self._axis)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self._lower, self._upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self._lower, self._upper, training=self.training)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0, value=0.0, name=None):
+        super().__init__()
+        self._threshold, self._value = threshold, value
+
+    def forward(self, x):
+        return F.thresholded_relu(x, self._threshold, self._value)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._r, self._fmt = downscale_factor, data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self._r, self._fmt)
+
+
+class _PadND(Layer):
+    _fmt = "NCHW"
+
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format=None, name=None):
+        super().__init__()
+        self.padding, self.mode, self.value = padding, mode, value
+        self.data_format = data_format or self._fmt
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode=self.mode, value=self.value,
+                     data_format=self.data_format)
+
+
+class Pad1D(_PadND):
+    _fmt = "NCL"
+
+
+class Pad3D(_PadND):
+    _fmt = "NCDHW"
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, data_format)
+        self._output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, self._args[0], self._args[1],
+                              self._args[2], self._args[3],
+                              self._output_size)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, data_format)
+        self._output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self._args[0], self._args[1],
+                              self._args[2], self._args[3],
+                              self._output_size)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, data_format)
+        self._output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, self._args[0], self._args[1],
+                              self._args[2], self._args[3],
+                              self._output_size)
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._kw = dict(size=size, scale_factor=scale_factor,
+                        mode="nearest", data_format=data_format)
+
+    def forward(self, x):
+        return F.interpolate(x, **self._kw)
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._kw = dict(size=size, scale_factor=scale_factor,
+                        mode="bilinear", align_corners=True,
+                        data_format=data_format)
+
+    def forward(self, x):
+        return F.interpolate(x, **self._kw)
+
+
+class SpectralNorm(Layer):
+    """reference: nn/layer/norm.py SpectralNorm — normalizes an input
+    WEIGHT tensor by its largest singular value via power iteration.
+    The u/v estimates are buffers updated eagerly per forward (inside a
+    jitted program the update is functional: same math, no persistence —
+    the reference trains eagerly here too)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 name=None):
+        super().__init__()
+        import numpy as _np
+        self._dim, self._iters, self._eps = dim, power_iters, epsilon
+        h = weight_shape[dim]
+        w = int(_np.prod(weight_shape)) // h
+        from ...core.tensor import Tensor as _T
+        rng = _np.random.default_rng(0)
+        self.register_buffer("weight_u", _T(
+            rng.standard_normal(h).astype("float32"), stop_gradient=True))
+        self.register_buffer("weight_v", _T(
+            rng.standard_normal(w).astype("float32"), stop_gradient=True))
+
+    def forward(self, weight):
+        import jax as _jax
+        import jax.numpy as jnp
+        from ... import ops as _ops
+        from ...core.tensor import Tensor as _T, _val as _v
+        w = _v(weight)
+        perm = [self._dim] + [i for i in range(w.ndim) if i != self._dim]
+        wm = jnp.transpose(w, perm).reshape(w.shape[self._dim], -1)
+        u, v = _v(self.weight_u), _v(self.weight_v)
+        for _ in range(self._iters):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + self._eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + self._eps)
+        if not isinstance(u, _jax.core.Tracer):
+            self.weight_u._value = u
+            self.weight_v._value = v
+        # sigma via TAPE-RECORDED ops on the input weight so grads flow
+        w_mat = _ops.reshape(_ops.transpose(weight, perm),
+                             [w.shape[self._dim], -1])
+        u_t = _T(u, stop_gradient=True)
+        v_t = _T(v, stop_gradient=True)
+        sigma = _ops.matmul(_ops.matmul(_ops.unsqueeze(u_t, 0), w_mat),
+                            _ops.unsqueeze(v_t, -1)).reshape([])
+        return weight / sigma
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._blank, self._reduction = blank, reduction
+
+    def forward(self, logits, labels, logit_lengths, label_lengths):
+        return F.rnnt_loss(logits, labels, logit_lengths, label_lengths,
+                           blank=self._blank, reduction=self._reduction)

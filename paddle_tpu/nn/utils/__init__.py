@@ -1,0 +1,153 @@
+"""paddle.nn.utils (reference: python/paddle/nn/utils/): weight
+reparameterizations + parameter/vector conversions + grad clipping."""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, _val
+from ..layer import Layer
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    """Concatenate flattened parameters (reference util of same name)."""
+    vals = [_val(p).reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(vals), stop_gradient=True)
+
+
+def vector_to_parameters(vec, parameters, name=None) -> None:
+    v = _val(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p._value = v[off:off + n].reshape(tuple(p.shape)).astype(
+            _val(p).dtype)
+        off += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False) -> Tensor:
+    """In-place global-norm clip over .grad (reference:
+    nn/utils/clip_grad_norm_)."""
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(_val(p.grad))) for p in params]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(_val(p.grad)) ** norm_type)
+             for p in params])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("non-finite grad norm")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        g = p.grad
+        g._value = _val(g) * scale
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value) -> None:
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = jnp.clip(_val(p.grad), -clip_value, clip_value)
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0) -> Layer:
+    """Reparameterize ``layer.<name>`` as g * v/||v|| (reference:
+    nn/utils/weight_norm_hook.py). The derived weight is recomputed from
+    the TRAINABLE v/g parameters in a forward pre-hook using tape-recorded
+    tensor ops, so gradients flow to v and g."""
+    import paddle_tpu as _paddle
+
+    w = getattr(layer, name)
+    wv = _val(w)
+    axes = tuple(i for i in range(wv.ndim) if i != dim)
+    g0 = jnp.sqrt(jnp.sum(wv * wv, axis=axes, keepdims=True))
+    from ...core.tensor import Parameter
+    v = Parameter(wv, name=f"{w.name}_v")
+    g = Parameter(g0, name=f"{w.name}_g")
+    layer.add_parameter(f"{name}_v", v)
+    layer.add_parameter(f"{name}_g", g)
+    # the original becomes derived — drop it from the parameter dict
+    layer._parameters.pop(name, None)
+
+    def recompute(lyr, inputs):
+        # tensor ops (not raw jnp) so the tape links weight -> (v, g)
+        norm = _paddle.sqrt(_paddle.sum(v * v, axis=list(axes),
+                                        keepdim=True))
+        object.__setattr__(lyr, name, g * v / norm)
+        return None
+
+    recompute(layer, None)
+    helper = layer.register_forward_pre_hook(recompute)
+    layer.__dict__[f"_{name}_weight_norm_hook"] = helper
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight") -> Layer:
+    helper = layer.__dict__.pop(f"_{name}_weight_norm_hook", None)
+    if helper is not None:
+        helper.remove()
+    v = layer._parameters.pop(f"{name}_v", None)
+    g = layer._parameters.pop(f"{name}_g", None)
+    if v is not None and g is not None:
+        from ...core.tensor import Parameter
+        vv, gg = _val(v), _val(g)
+        axes = tuple(i for i in range(vv.ndim) if gg.shape[i] == 1)
+        norm = jnp.sqrt(jnp.sum(vv * vv, axis=axes, keepdims=True))
+        w = Parameter(gg * vv / jnp.maximum(norm, 1e-12),
+                      name=v.name.replace("_v", ""))
+        layer.__dict__.pop(name, None)
+        layer.add_parameter(name, w)
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations=1,
+                  eps: float = 1e-12, dim: int = 0) -> Layer:
+    """Hook-based spectral normalization of ``layer.<name>``
+    (reference: nn/utils/spectral_norm_hook.py). The ORIGINAL weight
+    stays the live trainable parameter (as ``<name>_orig``); every
+    forward recomputes the normalized weight from its CURRENT value with
+    tape-recorded ops, so the optimizer trains it and gradients flow
+    through sigma (torch/paddle semantics)."""
+    import paddle_tpu as _paddle
+
+    w = layer._parameters.pop(name)
+    layer.add_parameter(f"{name}_orig", w)
+    wv = _val(w)
+    h = wv.shape[dim]
+    rng = np.random.default_rng(0)
+    u0 = jnp.asarray(rng.standard_normal(h), jnp.float32)
+    state = {"u": u0 / jnp.linalg.norm(u0)}
+    perm = [dim] + [i for i in range(wv.ndim) if i != dim]
+
+    def recompute(lyr, inputs):
+        wv = _val(w)                         # CURRENT trained value
+        wm = jnp.transpose(wv, perm).reshape(wv.shape[dim], -1)
+        uu = state["u"]
+        for _ in range(n_power_iterations):
+            vv = wm.T @ uu
+            vv = vv / (jnp.linalg.norm(vv) + eps)
+            uu = wm @ vv
+            uu = uu / (jnp.linalg.norm(uu) + eps)
+        if not isinstance(wv, jax.core.Tracer):
+            state["u"] = uu
+        # sigma via tensor ops on the Parameter so grads flow through it
+        w_mat = w.transpose(perm).reshape([wv.shape[dim], -1])
+        u_t = Tensor(uu, stop_gradient=True)
+        v_t = Tensor(vv, stop_gradient=True)
+        sigma = _paddle.matmul(_paddle.matmul(u_t.unsqueeze(0), w_mat),
+                               v_t.unsqueeze(-1)).reshape([])
+        object.__setattr__(lyr, name, w / sigma)
+        return None
+
+    recompute(layer, None)
+    layer.register_forward_pre_hook(recompute)
+    return layer

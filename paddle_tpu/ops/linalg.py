@@ -214,3 +214,81 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
     return Tensor(jnp.cov(_val(x), rowvar=rowvar, ddof=1 if ddof else 0,
                           fweights=None if fweights is None else _val(fweights),
                           aweights=None if aweights is None else _val(aweights)))
+
+
+# paddle.linalg aliases / additions
+inv = inverse
+
+
+def multi_dot(x, name=None):
+    """reference: paddle.linalg.multi_dot — optimal-order chain matmul
+    (jnp.linalg.multi_dot picks the association order)."""
+    return Tensor(jnp.linalg.multi_dot([_val(t) for t in x]))
+
+
+def matrix_exp(x, name=None):
+    from jax.scipy.linalg import expm
+    return apply_op("matrix_exp", expm, x)
+
+
+def svdvals(x, name=None):
+    return apply_op("svdvals",
+                    lambda a: jnp.linalg.svd(a, compute_uv=False), x)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """reference: paddle.linalg.lu_unpack — split packed LU into P, L, U."""
+    a = _val(lu_data)
+    piv = _val(lu_pivots)
+    m, n = a.shape[-2], a.shape[-1]
+    k = min(m, n)
+    L = U = P = None
+    if unpack_ludata:
+        L = jnp.tril(a[..., :, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
+        U = jnp.triu(a[..., :k, :])
+    if unpack_pivots:
+        # pivots are 1-based successive row swaps (LAPACK convention)
+        def perm_of(pv):
+            perm = jnp.arange(m)
+
+            def body(i, perm):
+                j = pv[i] - 1
+                pi, pj = perm[i], perm[j]
+                perm = perm.at[i].set(pj).at[j].set(pi)
+                return perm
+
+            perm = jax.lax.fori_loop(0, pv.shape[0], body, perm)
+            return jnp.eye(m, dtype=a.dtype)[perm]
+
+        if piv.ndim == 1:
+            P = perm_of(piv)
+        else:
+            P = jax.vmap(perm_of)(piv.reshape(-1, piv.shape[-1])).reshape(
+                piv.shape[:-1] + (m, m))
+    return (Tensor(P) if P is not None else None,
+            Tensor(L) if L is not None else None,
+            Tensor(U) if U is not None else None)
+
+
+def householder_product(x, tau, name=None):
+    """reference: paddle.linalg.householder_product (orgqr): accumulate
+    the Q of a QR from Householder reflectors."""
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) < i, 0.0,
+                          jnp.where(jnp.arange(m) == i, 1.0, a[:, i]))
+            h = jnp.eye(m, dtype=a.dtype) - t[i] * jnp.outer(v, v)
+            return q @ h
+
+        q = jax.lax.fori_loop(0, n, body, q)
+        return q[:, :n]
+    if _val(x).ndim == 2:
+        return apply_op("householder_product", fn, x, tau)
+    return Tensor(jax.vmap(lambda a, t: fn(a, t))(
+        _val(x).reshape((-1,) + _val(x).shape[-2:]),
+        _val(tau).reshape(-1, _val(tau).shape[-1])).reshape(
+        _val(x).shape[:-2] + (_val(x).shape[-2], _val(x).shape[-1])))

@@ -587,3 +587,53 @@ def view(x, shape_or_dtype, name=None):
 
 def view_as(x, other, name=None):
     return reshape(x, list(_val(other).shape))
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill True positions of ``mask`` with consecutive elements of
+    ``value`` (reference: python/paddle/tensor/manipulation.py).
+    TPU note: needs a cumsum gather (data-dependent placement), static
+    shapes preserved."""
+    def fn(a, m, v):
+        m = m.astype(bool)
+        mb = jnp.broadcast_to(m, a.shape)
+        # index of each True position within the flat mask order
+        flat_m = mb.reshape(-1)
+        idx = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+        src = v.reshape(-1)
+        take = jnp.clip(idx, 0, src.shape[0] - 1)
+        repl = src[take].reshape(a.shape)
+        return jnp.where(mb, repl, a)
+    return apply_op("masked_scatter", fn, x, mask, value)
+
+
+def cast(x, dtype):
+    """reference: paddle.cast — dtype conversion as a free function."""
+    from ..core.dtype import to_jax_dtype
+    return apply_op("cast", lambda a: a.astype(to_jax_dtype(dtype)), x)
+
+
+def tolist(x, name=None):
+    import numpy as _np
+    from ..core.tensor import _val
+    return _np.asarray(_val(x)).tolist()
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    """Inplace-flavored flatten (reference trailing-underscore API): the
+    Tensor's value is replaced; returns x."""
+    out = flatten(x, start_axis, stop_axis)
+    x._value = out._value
+    return x
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._value = out._value
+    return x
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._value = out._value
+    return x

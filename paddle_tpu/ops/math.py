@@ -466,3 +466,24 @@ def bitwise_left_shift(x, y, name=None):
 
 def bitwise_right_shift(x, y, name=None):
     return apply_op("bitwise_right_shift", jnp.right_shift, x, y)
+
+
+def sgn(x, name=None):
+    """Complex-aware sign: x/|x| for complex, jnp.sign for real
+    (reference: python/paddle/tensor/math.py sgn)."""
+    def fn(a):
+        if jnp.iscomplexobj(a):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(a)
+    return apply_op("sgn", fn, x)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """reference: python/paddle/tensor/linalg.py histogramdd."""
+    from ..core.tensor import Tensor, _val
+    h, edges = jnp.histogramdd(
+        _val(x), bins=bins, range=ranges, density=density,
+        weights=None if weights is None else _val(weights))
+    return Tensor(h), [Tensor(e) for e in edges]

@@ -2,6 +2,6 @@
 
 from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
-    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, LarsMomentum, Momentum, Optimizer,
+    SGD, ASGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, LarsMomentum, Momentum, NAdam, Optimizer, RAdam, Rprop,
     RMSProp,
 )

@@ -525,3 +525,145 @@ class LarsMomentum(Optimizer):
         upd = g32 + wd * p32
         v = self._momentum * slots["velocity"] + lr * local_lr * upd
         return (p32 - v).astype(p.dtype), {"velocity": v}
+
+
+class NAdam(Optimizer):
+    """reference: python/paddle/optimizer/nadam.py (Nesterov Adam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def init_slot(self, p_val):
+        return {"moment1": jnp.zeros_like(p_val, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(p_val, dtype=jnp.float32),
+                "mu_product": jnp.ones((), jnp.float32)}
+
+    def apply_one(self, p, g, slots, lr, t, wd):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if wd:
+            g32 = g32 + wd * p32
+        tf = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (tf * self._psi))
+        mu_t1 = self._beta1 * (1 - 0.5 * 0.96 ** ((tf + 1) * self._psi))
+        mu_prod = slots["mu_product"] * mu_t
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * g32 * g32
+        mhat = (mu_t1 * m / (1 - mu_prod * mu_t1)
+                + (1 - mu_t) * g32 / (1 - mu_prod))
+        vhat = v / (1 - self._beta2 ** tf)
+        new_p = (p32 - lr * mhat / (jnp.sqrt(vhat) + self._eps)).astype(
+            p.dtype)
+        return new_p, {"moment1": m, "moment2": v, "mu_product": mu_prod}
+
+
+class RAdam(Optimizer):
+    """reference: python/paddle/optimizer/radam.py (rectified Adam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_slot(self, p_val):
+        return {"moment1": jnp.zeros_like(p_val, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(p_val, dtype=jnp.float32)}
+
+    def apply_one(self, p, g, slots, lr, t, wd):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if wd:
+            g32 = g32 + wd * p32
+        tf = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * g32 * g32
+        mhat = m / (1 - self._beta1 ** tf)
+        rho_inf = 2.0 / (1 - self._beta2) - 1
+        b2t = self._beta2 ** tf
+        rho_t = rho_inf - 2 * tf * b2t / (1 - b2t)
+        r = jnp.sqrt(jnp.maximum(
+            (rho_t - 4) * (rho_t - 2) * rho_inf
+            / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-12),
+            0.0))
+        vhat = jnp.sqrt(v / (1 - b2t)) + self._eps
+        upd = jnp.where(rho_t > 5.0, r * mhat / vhat, mhat)
+        return (p32 - lr * upd).astype(p.dtype), {"moment1": m, "moment2": v}
+
+
+class ASGD(Optimizer):
+    """reference: python/paddle/optimizer/asgd.py — step with the MEAN of
+    the last ``batch_num`` gradients (circular gradient buffer per param;
+    costs batch_num x param memory, like the reference's d/ys buffers).
+    The live parameter is the iterate (not an averaged copy)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._n = int(batch_num)
+
+    def init_slot(self, p_val):
+        return {"grad_buf": jnp.zeros((self._n,) + tuple(p_val.shape),
+                                      jnp.float32),
+                "grad_sum": jnp.zeros_like(p_val, dtype=jnp.float32)}
+
+    def apply_one(self, p, g, slots, lr, t, wd):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if wd:
+            g32 = g32 + wd * p32
+        tf = t if hasattr(t, "astype") else jnp.asarray(t)
+        pos = (tf - 1) % self._n
+        old = jax.lax.dynamic_index_in_dim(slots["grad_buf"], pos, 0,
+                                           keepdims=False)
+        gsum = slots["grad_sum"] - old + g32
+        buf = jax.lax.dynamic_update_index_in_dim(
+            slots["grad_buf"], g32, pos, 0)
+        denom = jnp.minimum(tf.astype(jnp.float32)
+                            if hasattr(tf, "astype") else float(tf),
+                            float(self._n))
+        new_p = (p32 - lr * gsum / denom).astype(p.dtype)
+        return new_p, {"grad_buf": buf, "grad_sum": gsum}
+
+
+class Rprop(Optimizer):
+    """reference: python/paddle/optimizer/rprop.py (resilient backprop:
+    sign-based per-weight step adaptation)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         name, multi_precision)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_minus, self._eta_plus = etas
+
+    def init_slot(self, p_val):
+        return {"prev_grad": jnp.zeros_like(p_val, dtype=jnp.float32),
+                "step_size": jnp.full(p_val.shape, float(self._lr),
+                                      jnp.float32)
+                if not callable(self._lr) else
+                jnp.full(p_val.shape, 1e-3, jnp.float32)}
+
+    def apply_one(self, p, g, slots, lr, t, wd):
+        g32 = g.astype(jnp.float32)
+        sign = jnp.sign(g32 * slots["prev_grad"])
+        step = jnp.clip(
+            jnp.where(sign > 0, slots["step_size"] * self._eta_plus,
+                      jnp.where(sign < 0,
+                                slots["step_size"] * self._eta_minus,
+                                slots["step_size"])),
+            self._lr_min, self._lr_max)
+        g_eff = jnp.where(sign < 0, 0.0, g32)   # no step on sign flip
+        new_p = (p.astype(jnp.float32)
+                 - jnp.sign(g_eff) * step).astype(p.dtype)
+        return new_p, {"prev_grad": g_eff, "step_size": step}

@@ -328,3 +328,16 @@ def cast(x, dtype):
     m = _coo(x)
     return _wrap_like(x, jsparse.BCOO((m.data.astype(to_jax_dtype(dtype)),
                                        m.indices), shape=m.shape))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    """reference: paddle.sparse.sum — reduce over the dense value."""
+    from ..core.dtype import to_jax_dtype
+    m = _coo(x)
+    dense = m.todense()
+    out = jnp.sum(dense, axis=axis, keepdims=keepdim,
+                  dtype=to_jax_dtype(dtype))
+    return Tensor(out)
+
+
+from . import nn  # noqa: E402,F401

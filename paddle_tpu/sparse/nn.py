@@ -1,0 +1,57 @@
+"""paddle.sparse.nn (reference: python/paddle/sparse/nn/): activations and
+layers over sparse tensors — applied to the nonzero values, preserving
+structure."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..nn.layer import Layer
+from . import _coo, _wrap_like
+
+
+def _value_map(x, fn):
+    m = _coo(x)
+    return _wrap_like(x, jsparse.BCOO((fn(m.data), m.indices),
+                                      shape=m.shape))
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return _value_map(x, jax.nn.relu)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return _value_map(x, jax.nn.relu6)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return _value_map(x, lambda v: jax.nn.leaky_relu(v, self._slope))
+
+
+class Softmax(Layer):
+    """Row-wise softmax over the sparse pattern (reference:
+    sparse/nn/layer/activation.py Softmax): densifies masked rows —
+    zeros outside the pattern stay zero."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        m = _coo(x)
+        dense = m.todense()
+        mask = jsparse.BCOO((jnp.ones_like(m.data, bool), m.indices),
+                            shape=m.shape).todense()
+        s = jnp.where(mask, dense, -jnp.inf)
+        out = jax.nn.softmax(s, axis=self._axis)
+        out = jnp.where(mask, out, 0.0)
+        return _wrap_like(x, jsparse.bcoo_fromdense(out))

@@ -11,3 +11,5 @@ from .models import (  # noqa: F401
     LeNet, MobileNetV2, ResNet, VGG, mobilenet_v2, resnet18, resnet34,
     resnet50, resnet101, resnet152, vgg11, vgg13, vgg16, vgg19,
 )
+
+from . import ops  # noqa: E402,F401

@@ -1,0 +1,118 @@
+"""paddle.vision.ops (reference: python/paddle/vision/ops.py): detection
+primitives. TPU-native notes: nms's sequential suppression runs as a
+lax.while_loop over a fixed box budget (static shapes); roi_align is a
+gather + bilinear kernel over XLA ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, _val
+
+
+def box_area(boxes):
+    b = _val(boxes)
+    return Tensor((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]))
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU (reference helper used by nms/matchers)."""
+    a, b = _val(boxes1), _val(boxes2)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return Tensor(inter / (area_a[:, None] + area_b[None] - inter + 1e-10))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS (reference: vision/ops.py nms). Returns kept indices by
+    descending score. Static-shape friendly: the suppression loop is a
+    fori_loop over the fixed box count; the kept set is a boolean mask
+    materialized to indices on the host at the end."""
+    b = _val(boxes)
+    n = b.shape[0]
+    sc = _val(scores) if scores is not None else jnp.arange(
+        n, 0, -1, dtype=jnp.float32)
+    if category_idxs is not None:
+        # per-category NMS: offset boxes per category so they never overlap
+        cidx = _val(category_idxs).astype(jnp.float32)
+        span = (jnp.max(b) - jnp.min(b)) + 1.0
+        b = b + (cidx * span)[:, None]
+    order = jnp.argsort(-sc)
+    bs = b[order]
+    iou = _val(box_iou(Tensor(bs), Tensor(bs)))
+
+    def body(i, keep):
+        # drop i if any higher-scored KEPT box overlaps it
+        sup = jnp.any(jnp.where(jnp.arange(n) < i,
+                                keep & (iou[:, i] > iou_threshold), False))
+        return keep.at[i].set(~sup)
+
+    keep = jax.lax.fori_loop(1, n, body, jnp.ones((n,), bool))
+    import numpy as np
+    kept_np = np.asarray(order)[np.asarray(keep)]   # score-descending
+    if top_k is not None:
+        kept_np = kept_np[:top_k]
+    return Tensor(jnp.asarray(kept_np))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference: vision/ops.py roi_align): bilinear-sampled
+    pooling over box grids. x: (N, C, H, W); boxes: (R, 4) in image
+    coords; boxes_num: (N,) boxes per image."""
+    xv, bv = _val(x), _val(boxes)
+    n, c, h, w = xv.shape
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+    bn = _val(boxes_num)
+    import numpy as np
+    img_of_box = jnp.repeat(jnp.arange(n), np.asarray(bn),
+                            total_repeat_length=bv.shape[0])
+    offset = 0.5 if aligned else 0.0
+    x1 = bv[:, 0] * spatial_scale - offset
+    y1 = bv[:, 1] * spatial_scale - offset
+    x2 = bv[:, 2] * spatial_scale - offset
+    y2 = bv[:, 3] * spatial_scale - offset
+    bw = jnp.maximum(x2 - x1, 1e-4)
+    bh = jnp.maximum(y2 - y1, 1e-4)
+    ratio = sampling_ratio if sampling_ratio > 0 else 2
+
+    def sample_box(img_idx, xx1, yy1, wdt, hgt):
+        img = xv[img_idx]                      # (C, H, W)
+        ys = yy1 + (jnp.arange(oh * ratio) + 0.5) * hgt / (oh * ratio)
+        xs = xx1 + (jnp.arange(ow * ratio) + 0.5) * wdt / (ow * ratio)
+
+        def bilinear(yc, xc):
+            y0 = jnp.clip(jnp.floor(yc).astype(jnp.int32), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xc).astype(jnp.int32), 0, w - 1)
+            y1_ = jnp.clip(y0 + 1, 0, h - 1)
+            x1_ = jnp.clip(x0 + 1, 0, w - 1)
+            wy = jnp.clip(yc - y0, 0.0, 1.0)
+            wx = jnp.clip(xc - x0, 0.0, 1.0)
+            v00 = img[:, y0, x0]
+            v01 = img[:, y0, x1_]
+            v10 = img[:, y1_, x0]
+            v11 = img[:, y1_, x1_]
+            return ((1 - wy) * (1 - wx) * v00 + (1 - wy) * wx * v01
+                    + wy * (1 - wx) * v10 + wy * wx * v11)
+
+        grid = jax.vmap(lambda yc: jax.vmap(
+            lambda xc: bilinear(yc, xc))(xs))(ys)   # (OHr, OWr, C)
+        grid = grid.reshape(oh, ratio, ow, ratio, c).mean(axis=(1, 3))
+        return jnp.transpose(grid, (2, 0, 1))       # (C, oh, ow)
+
+    out = jax.vmap(sample_box)(img_of_box, x1, y1, bw, bh)
+    return Tensor(out)
+
+
+def generate_proposals(*args, **kwargs):
+    raise NotImplementedError(
+        "generate_proposals: RPN proposal generation is out of scope for "
+        "the TPU build; compose box_iou/nms/roi_align instead")
