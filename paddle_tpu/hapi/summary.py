@@ -62,7 +62,8 @@ def summary(net, input_size=None, dtypes=None, input=None):
                      for s, d in zip(shapes, dts)]
             out = net(*input)
         else:
-            out = net(input)
+            out = (net(*input) if isinstance(input, (list, tuple))
+                   else net(input))
     finally:
         for h in hooks:
             h.remove()

@@ -54,7 +54,14 @@ def _rep(x):
     block dims (8, 128)-aligned, and a trailing singleton would PAD to 128
     lanes in HBM anyway — replicating transiently at the kernel boundary
     keeps the persistent arrays compact (the residuals saved across layers
-    are the 2-D forms)."""
+    are the 2-D forms).
+
+    Known cost (advisor r2): four such transients coexist across the two
+    bwd pallas_calls (~128 MB each at BH=256, S=4096). The fix — loading
+    compact (BH, S) stats as (1, block_q) lane-major rows and transposing
+    in-kernel — changes Mosaic layouts and needs on-chip compile
+    validation, which the tunnel outage blocks; revisit when a healthy
+    window allows running tools/attn_bench.py against both variants."""
     return jnp.broadcast_to(x[..., None], (*x.shape, _LANES))
 
 
@@ -125,7 +132,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref,
             preferred_element_type=jnp.float32)
 
 
-def _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k):
+def _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k,
+         h=1, hkv=1):
     bh, sq, d = q.shape
     skv = k.shape[1]
     block_q = min(block_q, sq)
@@ -137,11 +145,17 @@ def _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k):
             f"flash_attention needs seq lens ({sq}, {skv}) divisible by "
             f"blocks ({block_q}, {block_k}); pad or use the dense path")
     grid = (bh, sq // block_q, skv // block_k)
+    rep = h // hkv
+
+    def kv_index(b, i, j):
+        # GQA: query head -> its kv head (identity when hkv == h), so the
+        # UNEXPANDED kv is read at Hkv bandwidth
+        return ((b // h) * hkv + (b % h) // rep, j, 0)
 
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
     ]
     args = [q, k, v]
     if seg_q is not None:
@@ -149,7 +163,9 @@ def _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k):
         # kv-side ids compact (BH, 1, S) row vectors
         in_specs += [
             pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, i, j: ((b // h) * hkv + (b % h) // rep,
+                                          0, j)),
         ]
         args += [_rep(seg_q), seg_kv[:, None, :]]
         kernel = functools.partial(_fwd_kernel, causal=causal,
@@ -235,12 +251,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     seg_q_ref, seg_kv_ref, dk_ref, dv_ref, *, causal,
                     sm_scale):
+    # grid: (b_kv, ki, rep, qj) — dk/dv blocks are revisited across the
+    # (rep, qj) sweep (GQA: every query head in the group accumulates
+    # into its kv head's gradient)
     ki = pl.program_id(1)
-    qj = pl.program_id(2)
+    r = pl.program_id(2)
+    qj = pl.program_id(3)
     block_k = k_ref.shape[1]
     block_q, d = _dims(q_ref.shape)
 
-    @pl.when(qj == 0)
+    @pl.when((qj == 0) & (r == 0))
     def _init():
         dk_ref[0] = jnp.zeros_like(dk_ref[0])
         dv_ref[0] = jnp.zeros_like(dv_ref[0])
@@ -280,8 +300,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
 
 
-def _bwd(causal, sm_scale, block_q, block_k, res, g):
+def _bwd(causal, sm_scale, block_q, block_k, h, hkv, res, g):
     q, k, v, seg_q, seg_kv, out, lse = res
+    rep = h // hkv
+
+    def kv_index(b, i, j):
+        return ((b // h) * hkv + (b % h) // rep, j, 0)
     do = g[0] if isinstance(g, (tuple, list)) else g
     bh, sq, d = q.shape
     skv = k.shape[1]
@@ -299,8 +323,8 @@ def _bwd(causal, sm_scale, block_q, block_k, res, g):
 
     in_specs_dq = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # q
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),   # k
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),   # v
+        pl.BlockSpec((1, bk, d), kv_index),                    # k
+        pl.BlockSpec((1, bk, d), kv_index),                    # v
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # do
         pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),  # lse
         pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),  # delta
@@ -308,7 +332,9 @@ def _bwd(causal, sm_scale, block_q, block_k, res, g):
     if has_seg:
         in_specs_dq += [
             pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, bk), lambda b, i, j: (b, 0, j))]
+            pl.BlockSpec((1, 1, bk),
+                         lambda b, i, j: ((b // h) * hkv + (b % h) // rep,
+                                          0, j))]
         dq_kernel = functools.partial(_bwd_dq_kernel, causal=causal,
                                       sm_scale=sm_scale)
     else:
@@ -326,19 +352,27 @@ def _bwd(causal, sm_scale, block_q, block_k, res, g):
     )(*common)
     dq = (dq * sm_scale).astype(q.dtype)
 
-    # dkv grid: kv blocks outer, q sweep innermost (revisited dk/dv blocks)
+    # dkv grid: (b_kv, kv block, group member, q sweep) — dk/dv blocks are
+    # revisited across BOTH trailing dims; every query head of the GQA
+    # group accumulates into its kv head's gradient
+    def q_index(b, i, r, j):
+        return ((b // hkv) * h + (b % hkv) * rep + r, j, 0)
+
     in_specs_dkv = [
-        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0)),   # q
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),   # k
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),   # v
-        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0)),   # do
-        pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, j, 0)),  # lse
-        pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, j, 0)),  # delta
+        pl.BlockSpec((1, bq, d), q_index),                     # q
+        pl.BlockSpec((1, bk, d), lambda b, i, r, j: (b, i, 0)),  # k
+        pl.BlockSpec((1, bk, d), lambda b, i, r, j: (b, i, 0)),  # v
+        pl.BlockSpec((1, bq, d), q_index),                     # do
+        pl.BlockSpec((1, bq, _LANES),
+                     lambda b, i, r, j: q_index(b, i, r, j)),  # lse
+        pl.BlockSpec((1, bq, _LANES),
+                     lambda b, i, r, j: q_index(b, i, r, j)),  # delta
     ]
     if has_seg:
         in_specs_dkv += [
-            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, 1, bk), lambda b, i, j: (b, 0, i))]
+            pl.BlockSpec((1, bq, _LANES),
+                         lambda b, i, r, j: q_index(b, i, r, j)),
+            pl.BlockSpec((1, 1, bk), lambda b, i, r, j: (b, 0, i))]
         dkv_kernel = functools.partial(_bwd_dkv_kernel, causal=causal,
                                        sm_scale=sm_scale)
     else:
@@ -347,13 +381,15 @@ def _bwd(causal, sm_scale, block_q, block_k, res, g):
                 qr, kr, vr, dor, lr, der, None, None, dkr, dvr, **kw),
             causal=causal, sm_scale=sm_scale)
 
+    bh_kv = k.shape[0]
     dk, dv = pl.pallas_call(
-        dkv_kernel, grid=(bh, skv // bk, sq // bq),
+        dkv_kernel, grid=(bh_kv, skv // bk, rep, sq // bq),
         in_specs=in_specs_dkv,
-        out_specs=[pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),
-                   pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0))],
-        out_shape=[jax.ShapeDtypeStruct((bh, skv, d), jnp.float32),
-                   jax.ShapeDtypeStruct((bh, skv, d), jnp.float32)],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, i, r, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, r, j: (b, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bh_kv, skv, d), jnp.float32),
+                   jax.ShapeDtypeStruct((bh_kv, skv, d), jnp.float32)],
         interpret=_interpret(),
     )(*common)
     # dk already carries sm_scale via the scaled q used in ds
@@ -361,15 +397,18 @@ def _bwd(causal, sm_scale, block_q, block_k, res, g):
 
 
 # ============================================================== public entry
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _flash_attention(q, k, v, seg_q, seg_kv, causal, sm_scale,
-                     block_q, block_k):
-    out, _ = _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k)
+                     block_q, block_k, h, hkv):
+    out, _ = _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
+                  block_k, h, hkv)
     return out
 
 
-def _flash_fwd_rule(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k):
-    out, lse = _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k)
+def _flash_fwd_rule(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
+                    block_k, h, hkv):
+    out, lse = _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
+                    block_k, h, hkv)
     return out, (q, k, v, seg_q, seg_kv, out, lse)
 
 
@@ -380,15 +419,25 @@ def flash_attention(q, k, v, segment_ids: Optional[jax.Array] = None,
                     kv_segment_ids: Optional[jax.Array] = None,
                     causal: bool = True, sm_scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K):
+                    block_k: int = DEFAULT_BLOCK_K,
+                    n_heads: int = 1, n_kv_heads: Optional[int] = None):
     """(BH, S, D)-layout flash attention. segment_ids: (BH, S) int32 — rows
-    attend only within their segment (varlen batches packed statically)."""
+    attend only within their segment (varlen batches packed statically).
+    GQA: pass q as (B*n_heads, S, D) and k/v as (B*n_kv_heads, Skv, D) —
+    the kernels read the UNEXPANDED kv via index maps (Hkv bandwidth) and
+    accumulate dk/dv over each group's query heads."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if n_kv_heads is None:
+        n_kv_heads = n_heads
+    if n_heads % n_kv_heads:
+        raise ValueError(f"n_heads {n_heads} not divisible by n_kv_heads "
+                         f"{n_kv_heads}")
     if segment_ids is not None and kv_segment_ids is None:
         kv_segment_ids = segment_ids
     return _flash_attention(q, k, v, segment_ids, kv_segment_ids,
-                            causal, sm_scale, block_q, block_k)
+                            causal, sm_scale, block_q, block_k,
+                            n_heads, n_kv_heads)
 
 
 def flash_attention_bshd(q, k, v, segment_ids=None, kv_segment_ids=None,
@@ -399,14 +448,17 @@ def flash_attention_bshd(q, k, v, segment_ids=None, kv_segment_ids=None,
     """Paddle-convention (B, S, H, D) wrapper (reference:
     python/paddle/nn/functional/flash_attention.py uses [batch, seq, heads,
     dim]). ``segment_ids``: (B, S_q); ``kv_segment_ids``: (B, S_kv),
-    defaulting to ``segment_ids`` when the lengths match."""
+    defaulting to ``segment_ids`` when the lengths match. GQA: k/v may
+    carry fewer heads (Hkv | H) — never expanded in HBM."""
     b, s, h, d = q.shape
     skv = k.shape[1]
+    hkv = k.shape[2]
 
-    def to_bhsd(t, sl):
-        return jnp.swapaxes(t, 1, 2).reshape(b * h, sl, d)
+    def to_bhsd(t, sl, nh):
+        return jnp.swapaxes(t, 1, 2).reshape(b * nh, sl, d)
 
-    qf, kf, vf = to_bhsd(q, s), to_bhsd(k, skv), to_bhsd(v, skv)
+    qf = to_bhsd(q, s, h)
+    kf, vf = to_bhsd(k, skv, hkv), to_bhsd(v, skv, hkv)
     seg_q = seg_kv = None
     if segment_ids is not None:
         if kv_segment_ids is None:
@@ -415,7 +467,7 @@ def flash_attention_bshd(q, k, v, segment_ids=None, kv_segment_ids=None,
                     "kv_segment_ids required when q and kv lengths differ")
             kv_segment_ids = segment_ids
         seg_q = jnp.repeat(segment_ids, h, axis=0)
-        seg_kv = jnp.repeat(kv_segment_ids, h, axis=0)
+        seg_kv = jnp.repeat(kv_segment_ids, hkv, axis=0)
     out = flash_attention(qf, kf, vf, seg_q, seg_kv, causal, sm_scale,
-                          block_q, block_k)
+                          block_q, block_k, n_heads=h, n_kv_heads=hkv)
     return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)

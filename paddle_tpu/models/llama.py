@@ -96,10 +96,10 @@ class LlamaAttention(Layer):
             out = self.o_proj(
                 out.reshape([b, s, self.num_heads * self.head_dim]))
             return out, (k_cache, v_cache)
-        if self.num_kv_heads != self.num_heads:
-            rep = self.num_heads // self.num_kv_heads
-            k = ops.repeat_interleave(k, rep, axis=2)
-            v = ops.repeat_interleave(v, rep, axis=2)
+        # GQA: kv stays UNEXPANDED — sdpa's flash path reads it at Hkv
+        # bandwidth via GQA index maps; only the dense fallback expands.
+        # NB the group layout differs: sdpa groups q heads contiguously
+        # (head h -> kv head h // rep), matching repeat_interleave.
         out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                              is_causal=attn_mask is None,
                                              training=self.training)

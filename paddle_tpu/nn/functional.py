@@ -580,6 +580,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     mask_val = _val(attn_mask) if attn_mask is not None else None
 
     def fn(q, k, v):
+        # GQA: unexpanded kv accepted everywhere; the dense path expands
+        # here (the flash kernel above never does — Hkv bandwidth)
+        if k.shape[2] != q.shape[2]:
+            rep = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         # [B, S, H, D] -> [B, H, S, D]
         qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
         scores = jnp.einsum("bhsd,bhtd->bhst", qt, kt) / math.sqrt(q.shape[-1])

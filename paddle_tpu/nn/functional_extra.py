@@ -790,19 +790,13 @@ def _max_pool_mask_nd(x, nd, kernel, stride, padding, ceil_mode, op_name,
         patches = patches.reshape((n, c, int(np.prod(kernel))) + tuple(out_sp))
         vals = patches.max(axis=2)
         loc = patches.argmax(axis=2)                       # local kernel idx
-        # local -> absolute (unpadded) coordinates, then flatten
-        flat = jnp.zeros_like(loc)
+        # local kernel index -> absolute (unpadded) flat spatial index
         rem = loc
-        mult = 1
-        coords = []
+        idx = jnp.zeros_like(loc)
         for d in range(nd - 1, -1, -1):
             kd = rem % kernel[d]
             rem = rem // kernel[d]
-            coords.append((d, kd))
-        idx = jnp.zeros_like(loc)
-        for d, kd in coords:
-            out_idx = jax.lax.broadcasted_iota(
-                loc.dtype, loc.shape, 2 + d)
+            out_idx = jax.lax.broadcasted_iota(loc.dtype, loc.shape, 2 + d)
             abs_d = out_idx * stride[d] - padding[d] + kd
             m = 1
             for dd in range(d + 1, nd):
@@ -870,6 +864,10 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
                             alpha[t, u-1] + label(t, u-1))
     as a lax.scan over T with an inner scan over U, vmapped over the
     batch. Static (T, U) grid, variable lengths via masked gather."""
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "rnnt_loss: FastEmit regularization is not implemented; pass "
+            "fastemit_lambda=0")
     def fn(lg, lab, tl, ul):
         b, t_max, u1, v = lg.shape
         u_max = u1 - 1

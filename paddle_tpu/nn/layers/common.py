@@ -509,7 +509,9 @@ class SyncBatchNorm(BatchNorm1D):
         if isinstance(layer, BatchNorm1D) and not isinstance(
                 layer, SyncBatchNorm):
             new = cls(layer._mean.shape[0], layer._momentum,
-                      layer._epsilon)
+                      layer._epsilon,
+                      data_format=layer._data_format)
+            new._use_global_stats = layer._use_global_stats
             new.weight = layer.weight
             new.bias = layer.bias
             new._buffers["_mean"] = layer._mean

@@ -132,11 +132,15 @@ def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations=1,
         wv = _val(w)                         # CURRENT trained value
         wm = jnp.transpose(wv, perm).reshape(wv.shape[dim], -1)
         uu = state["u"]
+        # n_power_iterations=0 is valid (use stored estimates): vv must
+        # exist regardless
+        vv = wm.T @ uu
+        vv = vv / (jnp.linalg.norm(vv) + eps)
         for _ in range(n_power_iterations):
-            vv = wm.T @ uu
-            vv = vv / (jnp.linalg.norm(vv) + eps)
             uu = wm @ vv
             uu = uu / (jnp.linalg.norm(uu) + eps)
+            vv = wm.T @ uu
+            vv = vv / (jnp.linalg.norm(vv) + eps)
         if not isinstance(wv, jax.core.Tracer):
             state["u"] = uu
         # sigma via tensor ops on the Parameter so grads flow through it

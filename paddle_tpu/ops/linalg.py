@@ -288,7 +288,11 @@ def householder_product(x, tau, name=None):
         return q[:, :n]
     if _val(x).ndim == 2:
         return apply_op("householder_product", fn, x, tau)
-    return Tensor(jax.vmap(lambda a, t: fn(a, t))(
-        _val(x).reshape((-1,) + _val(x).shape[-2:]),
-        _val(tau).reshape(-1, _val(tau).shape[-1])).reshape(
-        _val(x).shape[:-2] + (_val(x).shape[-2], _val(x).shape[-1])))
+
+    def batched(a, t):
+        lead = a.shape[:-2]
+        out = jax.vmap(fn)(a.reshape((-1,) + a.shape[-2:]),
+                           t.reshape(-1, t.shape[-1]))
+        return out.reshape(lead + out.shape[-2:])
+
+    return apply_op("householder_product", batched, x, tau)

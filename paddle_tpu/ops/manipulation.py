@@ -601,6 +601,12 @@ def masked_scatter(x, mask, value, name=None):
         flat_m = mb.reshape(-1)
         idx = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
         src = v.reshape(-1)
+        if not isinstance(flat_m, jax.core.Tracer):
+            n_true = int(flat_m.sum())
+            if n_true > src.shape[0]:
+                raise ValueError(
+                    f"masked_scatter: mask has {n_true} True positions but "
+                    f"value has only {src.shape[0]} elements")
         take = jnp.clip(idx, 0, src.shape[0] - 1)
         repl = src[take].reshape(a.shape)
         return jnp.where(mb, repl, a)

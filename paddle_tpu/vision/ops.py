@@ -66,7 +66,13 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True, name=None):
     """RoIAlign (reference: vision/ops.py roi_align): bilinear-sampled
     pooling over box grids. x: (N, C, H, W); boxes: (R, 4) in image
-    coords; boxes_num: (N,) boxes per image."""
+    coords; boxes_num: (N,) boxes per image.
+
+    Divergence: the reference's sampling_ratio=-1 adapts the per-bin
+    sample count to each ROI's size (ceil(roi/out)), which needs
+    data-dependent shapes XLA cannot compile; here -1 means a fixed 2
+    samples/bin. Pass an explicit sampling_ratio for numerical parity
+    with reference models."""
     xv, bv = _val(x), _val(boxes)
     n, c, h, w = xv.shape
     oh, ow = ((output_size, output_size) if isinstance(output_size, int)
