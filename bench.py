@@ -302,9 +302,17 @@ def _decode_bench(model, cfg, paddle, jax) -> dict:
     if not hasattr(model, "generate"):
         return {}
     steps = int(os.environ.get("BENCH_DECODE_TOKENS", "64"))
+    # prompt + new tokens sized so the KV cache length is a multiple of
+    # 128 on TPU: the flash_prefill kernel then serves the prefill phase
+    # (odd cache lengths fall back to the dense einsum path)
+    default_prompt = ((-steps) % 128) or 128
+    if default_prompt < 16:
+        default_prompt += 128          # keep prompt+steps on a 128 multiple
+    prompt_len = int(os.environ.get("BENCH_PROMPT_LEN",
+                                    str(default_prompt)))
     rng = np.random.default_rng(0)
     prompt = paddle.to_tensor(
-        rng.integers(0, cfg.vocab_size, (1, 16)).astype(np.int32))
+        rng.integers(0, cfg.vocab_size, (1, prompt_len)).astype(np.int32))
     model.eval()
     # warmup MUST use the same max_new_tokens: the jit signature includes
     # the scan length, so a different value compiles a different program

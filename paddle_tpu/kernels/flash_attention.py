@@ -433,7 +433,18 @@ def flash_attention(q, k, v, segment_ids: Optional[jax.Array] = None,
     if n_heads % n_kv_heads:
         raise ValueError(f"n_heads {n_heads} not divisible by n_kv_heads "
                          f"{n_kv_heads}")
+    if q.shape[0] * n_kv_heads != k.shape[0] * n_heads:
+        raise ValueError(
+            f"q rows {q.shape[0]} / k rows {k.shape[0]} inconsistent with "
+            f"n_heads={n_heads}, n_kv_heads={n_kv_heads} — pass the head "
+            f"counts for GQA inputs")
     if segment_ids is not None and kv_segment_ids is None:
+        if n_kv_heads != n_heads:
+            # a (B*H, Skv) default would be read with (B*Hkv)-space rows
+            raise ValueError(
+                "GQA flash_attention needs an explicit (B*n_kv_heads, Skv) "
+                "kv_segment_ids (the q-side ids have a different leading "
+                "dim)")
         kv_segment_ids = segment_ids
     return _flash_attention(q, k, v, segment_ids, kv_segment_ids,
                             causal, sm_scale, block_q, block_k,

@@ -29,6 +29,11 @@ def bench(fn, *args):
         ts.append(time.perf_counter() - t0)
     return sorted(ts)[2]
 
+def dense_gqa_bshd(q, k, v):
+    rep = q.shape[2] // k.shape[2]
+    return dense_bshd(q, jnp.repeat(k, rep, axis=2),
+                      jnp.repeat(v, rep, axis=2))
+
 rng = np.random.default_rng(0)
 for s in (1024, 2048, 4096, 8192):
     b = max(1, 8192 // s)
@@ -39,5 +44,20 @@ for s in (1024, 2048, 4096, 8192):
     td = bench(dense_bshd, q, k, v)
     print(json.dumps({"seq": s, "batch": b, "flash_ms": round(tf*1e3, 2),
                       "dense_ms": round(td*1e3, 2),
+                      "speedup": round(td/tf, 2),
+                      "backend": jax.default_backend()}), flush=True)
+
+# GQA (the 70B north-star layout: rep=8): unexpanded-kv kernel vs
+# repeat_interleave + dense
+for s in (2048, 4096):
+    b, h, hkv, d = max(1, 8192 // s), 16, 2, 64
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+    k, v = (jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.bfloat16)
+            for _ in range(2))
+    tf = bench(functools.partial(flash_attention_bshd, causal=True), q, k, v)
+    td = bench(dense_gqa_bshd, q, k, v)
+    print(json.dumps({"seq": s, "batch": b, "gqa_rep": h // hkv,
+                      "flash_gqa_ms": round(tf*1e3, 2),
+                      "dense_expand_ms": round(td*1e3, 2),
                       "speedup": round(td/tf, 2),
                       "backend": jax.default_backend()}), flush=True)
