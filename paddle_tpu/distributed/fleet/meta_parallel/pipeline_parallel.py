@@ -167,15 +167,22 @@ class PipelineTrainStep:
                     "schedule instead")
             if virtual_pp_degree != 1:
                 raise NotImplementedError("zbh1 + interleaved VPP")
-            if tuple(mesh.axis_names) != ("pp",):
+            if set(mesh.axis_names) - {"pp", "dp"}:
                 raise NotImplementedError(
-                    "zbh1 v1 runs on a pp-only mesh (per-stage divergent "
-                    "execution via shard_map); compose dp/mp outside or "
-                    "use schedule='auto'")
+                    "zbh1 runs on a pp or pp x dp mesh (per-stage "
+                    "divergent execution via shard_map); mp/sharding "
+                    "composition uses schedule='auto'")
             if pipe_layer.shared_layers:
                 raise NotImplementedError(
                     "zbh1 v1 does not support tied (shared) layers — the "
                     "tied weight would need cross-phase gradient routing")
+            if (sharding_level
+                    or getattr(optimizer, "_group_sharded_level", 0)
+                    or getattr(pipe_layer, "_group_sharded_level", 0)):
+                raise NotImplementedError(
+                    "zbh1 + ZeRO sharding: the manual shard_map region "
+                    "would all-gather the dp-sharded state every step; "
+                    "use schedule='auto' for sharding compositions")
         self.S = mesh.shape["pp"]
         self.M = int(num_microbatches)
         self.V = int(virtual_pp_degree)
@@ -536,20 +543,31 @@ class PipelineTrainStep:
                 loss = loss_fn(*tree_to_tensors((out, labels_mb)))
             return tree_to_values(loss)
 
+        dp_axis = "dp" if ("dp" in mesh.shape
+                           and mesh.shape["dp"] > 1) else None
+        dp_size = mesh.shape.get("dp", 1) if dp_axis else 1
+
         def step(params, opt_state, lr, inputs, labels):
             x = inputs.reshape((M, inputs.shape[0] // M) + inputs.shape[1:])
             lab = labels.reshape(
                 (M, labels.shape[0] // M) + labels.shape[1:])
+            if x.shape[1] % dp_size:
+                raise ValueError(
+                    f"microbatch size {x.shape[1]} not divisible by dp "
+                    f"degree {dp_size}")
             pre = {k: params[k] for k in prefix_keys}
             suf = {k: params[k] for k in suffix_keys}
             stacked = tuple(params[_STACK_PREFIX + rel]
                             for rel in block_rels)
+            # act shape is per-dp-shard inside the manual region
+            local_in = (x.shape[1] // dp_size,) + x.shape[2:]
             act_sds = jax.eval_shape(
                 prefix_apply, pre,
-                jax.ShapeDtypeStruct(x.shape[1:], x.dtype))
+                jax.ShapeDtypeStruct(local_in, x.dtype))
             zfn = build_zbh1_loss_and_grads(
                 mesh, S, M, block_rels, template,
-                prefix_apply, suffix_loss, act_sds, remat=remat)
+                prefix_apply, suffix_loss, act_sds, remat=remat,
+                dp_axis=dp_axis)
             loss, dWt, dPre, dSuf = zfn(stacked, pre, suf, x, lab)
             grads = {_STACK_PREFIX + rel: dWt[i]
                      for i, rel in enumerate(block_rels)}

@@ -130,11 +130,16 @@ def build_zbh1_loss_and_grads(
         prefix_apply: Callable,      # (prefix_params, ids_mb) -> x
         suffix_loss: Callable,       # (suffix_params, y_mb, labels_mb) -> loss
         act_sds: jax.ShapeDtypeStruct,
-        remat: bool = True):
+        remat: bool = True,
+        dp_axis: str = None):
     """Returns f(stacked_tuple, prefix_params, suffix_params, ids, labels)
     -> (loss, stacked_grads_tuple, prefix_grads, suffix_grads). ids/labels
-    are (M, mb, ...) replicated; stacked leaves are (S, L, ...)
-    pp-sharded."""
+    are (M, mb, ...); stacked leaves are (S, L, ...) pp-sharded. With
+    ``dp_axis`` the microbatch dim is additionally dp-sharded (params
+    replicated over dp): loss and grads are pmean'd over dp — standard
+    data parallelism composed INSIDE the manual region, so the pp ring
+    stays per-dp-slice and the dp reduction is one collective at the
+    end. ``act_sds`` must describe the LOCAL (per-dp-shard) activation."""
 
     Ft, Bt, Wt = zbh1_schedule(S, M)
     sf_tab, sb_tab = _stash_tables(Ft, Bt, S)
@@ -277,16 +282,24 @@ def build_zbh1_loss_and_grads(
             jnp.where(is_first, a, jnp.zeros_like(a)), "pp"), dPre)
         dSuf = jax.tree.map(lambda a: jax.lax.psum(
             jnp.where(is_last, a, jnp.zeros_like(a)), "pp"), dSuf)
+        if dp_axis is not None:
+            # each dp shard computed the mean loss over ITS tokens; the
+            # global mean (and its gradient) is the dp-mean of those
+            loss = jax.lax.pmean(loss, dp_axis)
+            dW = jax.tree.map(lambda a: jax.lax.pmean(a, dp_axis), dW)
+            dPre = jax.tree.map(lambda a: jax.lax.pmean(a, dp_axis), dPre)
+            dSuf = jax.tree.map(lambda a: jax.lax.pmean(a, dp_axis), dSuf)
         dW = jax.tree.map(lambda a: a[None], dW)   # re-add the stage dim
         return loss, dW, dPre, dSuf
 
     def loss_and_grads(stacked_tuple, prefix_params, suffix_params,
                        ids, labels):
+        data_spec = P(None, dp_axis) if dp_axis else P()
         in_specs = (
             tuple(P("pp") for _ in stacked_tuple),
             jax.tree.map(lambda _: P(), prefix_params),
             jax.tree.map(lambda _: P(), suffix_params),
-            P(), P())
+            data_spec, data_spec)
         out_specs = (
             P(),
             tuple(P("pp") for _ in stacked_tuple),

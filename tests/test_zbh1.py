@@ -99,15 +99,69 @@ class TestZBH1Parity:
             np.testing.assert_allclose(float(ls), float(lz), rtol=2e-4,
                                        err_msg=f"step {i}")
 
-    def test_v1_scope_validation(self):
+    def test_scope_validation(self):
+        from jax.sharding import Mesh
+
         cfg = self._cfg()
         pipe = self._build(cfg, seed=1)
-        from paddle_tpu.distributed.fleet.base_topology import (
-            _reset_hcg, create_hybrid_communicate_group)
-        _reset_hcg()
-        hcg = create_hybrid_communicate_group(dp_degree=2, pp_degree=4)
-        with pytest.raises(NotImplementedError, match="pp-only"):
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                    ("mp", "pp"))
+        with pytest.raises(NotImplementedError, match="pp x dp"):
             PipelineTrainStep(pipe, AdamW(learning_rate=1e-3),
-                              hcg.get_mesh(), num_microbatches=4,
+                              mesh, num_microbatches=4,
                               schedule="zbh1")
-        _reset_hcg()
+
+
+class TestZBH1WithDP:
+    def test_pp_dp_matches_serial(self):
+        """zbh1 over a pp2 x dp2 mesh: data-parallel shards run the
+        divergent pipeline independently; grads pmean over dp — must
+        still match the serial model exactly."""
+        from jax.sharding import Mesh
+
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          num_key_value_heads=2, intermediate_size=64,
+                          max_position_embeddings=32)
+        crit = LlamaPretrainingCriterion(cfg)
+        paddle.seed(8)
+        m_serial = LlamaForCausalLMPipe(cfg, num_stages=2)
+        paddle.seed(8)
+        m_zb = LlamaForCausalLMPipe(cfg, num_stages=2)
+        from paddle_tpu.core.tensor import Tensor
+
+        def loss_fn(out, y):
+            return crit(Tensor(out), Tensor(y))._value
+
+        serial = TrainStep(m_serial, AdamW(learning_rate=1e-3),
+                           loss_fn=loss_fn)
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("dp", "pp"))
+        zb = PipelineTrainStep(m_zb, AdamW(learning_rate=1e-3),
+                               mesh, num_microbatches=2,
+                               schedule="zbh1")
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        y = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+        for i in range(3):
+            ls = serial(xt, yt)
+            lz = zb(xt, yt)
+            np.testing.assert_allclose(float(ls), float(lz), rtol=2e-4,
+                                       err_msg=f"step {i}")
+
+    def test_zbh1_rejects_zero_sharding(self):
+        from jax.sharding import Mesh
+
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          num_key_value_heads=2, intermediate_size=64,
+                          max_position_embeddings=32)
+        paddle.seed(9)
+        pipe = LlamaForCausalLMPipe(cfg, num_stages=2)
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("dp", "pp"))
+        with pytest.raises(NotImplementedError, match="ZeRO"):
+            PipelineTrainStep(pipe, AdamW(learning_rate=1e-3), mesh,
+                              num_microbatches=2, schedule="zbh1",
+                              sharding_level=2)
