@@ -1,0 +1,17 @@
+"""Shared example bootstrap: on machines with the axon TPU tunnel plugin,
+a CPU-pinned run must drop the plugin env BEFORE python imports jax (the
+sitecustomize registers a backend whose init can hang when the tunnel is
+down). Call first thing; re-execs the script once with a clean env."""
+import os
+import sys
+
+
+def ensure_backend():
+    if (os.environ.get("JAX_PLATFORMS", "") == "cpu"
+            and "PALLAS_AXON_POOL_IPS" in os.environ
+            and os.environ.get("_EXAMPLE_ENV_CLEAN") != "1"):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+        env["_EXAMPLE_ENV_CLEAN"] = "1"
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)

@@ -33,6 +33,7 @@ LOG = os.path.join(REPO, ".cache", "tpu_watch.log")
 def log(msg: str) -> None:
     line = f"[{time.strftime('%H:%M:%S')}] {msg}"
     print(line, flush=True)
+    os.makedirs(os.path.dirname(LOG), exist_ok=True)
     with open(LOG, "a") as f:
         f.write(line + "\n")
 
