@@ -119,15 +119,26 @@ def ulysses_attention(q, k, v, axis_name: str = "sep", causal: bool = True,
     head-scatter seq-gather all-to-alls). q/k/v: (B, C, H, D) seq-sharded;
     requires H % axis_size == 0. Each shard computes FULL-sequence attention
     for H/P heads, so any single-device attention impl (the Pallas flash
-    kernel included) drops in via ``attn_fn``."""
+    kernel included) drops in via ``attn_fn``.
+
+    GQA (k/v with Hkv < H heads): when Hkv is divisible by the sep degree
+    the kv all-to-alls split kv heads like q heads. When it is NOT
+    (Hkv < P, the 70B-style layout), plain Ulysses cannot shard kv by
+    head — instead the (few) kv heads are ALL-GATHERED in sequence and
+    each shard selects the kv heads its q-head slice attends to
+    (comm: 2 q all-to-alls + one kv all-gather of B*S*Hkv*D — cheaper
+    than ring's (P-1) kv rotations whenever Hkv <= 2H/P)."""
     p = lax.axis_size(axis_name)
     b, c, h, d = q.shape
+    hkv = k.shape[2]
     if h % p:
         raise ValueError(f"num heads {h} not divisible by sep degree {p}")
+    if h % hkv:
+        raise ValueError(f"q heads {h} not divisible by kv heads {hkv}")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
 
-    def seq_gather(t):   # (B, C, H, D) -> (B, C*P, H/P, D)
+    def seq_gather(t):   # (B, C, Hx, D) -> (B, C*P, Hx/P, D)
         return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)
 
@@ -135,10 +146,29 @@ def ulysses_attention(q, k, v, axis_name: str = "sep", causal: bool = True,
         return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
-    qg, kg, vg = seq_gather(q), seq_gather(k), seq_gather(v)
+    qg = seq_gather(q)
     fn = attn_fn or functools.partial(_dense_sdpa, causal=causal,
                                       sm_scale=sm_scale)
-    out = fn(qg, kg, vg)
+    if hkv == h or hkv % p == 0:
+        kg, vg = seq_gather(k), seq_gather(v)
+        if hkv != h:
+            # per-shard GQA: expand the local kv head slice to match
+            rep = (h // p) // (hkv // p)
+            kg = jnp.repeat(kg, rep, axis=2)
+            vg = jnp.repeat(vg, rep, axis=2)
+        out = fn(qg, kg, vg)
+    else:
+        # GQA-Ulysses: kv heads are too few to split — gather full-seq kv
+        # and select this shard's group heads (q head g = r*(H/P)+j maps
+        # to kv head g // (H/Hkv))
+        kg = lax.all_gather(k, axis_name, axis=1, tiled=True)
+        vg = lax.all_gather(v, axis_name, axis=1, tiled=True)
+        r = lax.axis_index(axis_name)
+        rep = h // hkv
+        heads = r * (h // p) + jnp.arange(h // p)
+        k_sel = jnp.take(kg, heads // rep, axis=2)
+        v_sel = jnp.take(vg, heads // rep, axis=2)
+        out = fn(qg, k_sel, v_sel)
     return seq_scatter(out)
 
 
@@ -154,6 +184,10 @@ def sep_scaled_dot_product_attention(
         from ..base_topology import get_hybrid_communicate_group
         mesh = get_hybrid_communicate_group().get_mesh()
     if sep_axis not in mesh.shape or mesh.shape[sep_axis] <= 1:
+        if k.shape[2] != q.shape[2]:      # GQA: the dense path expands
+            rep = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         return _dense_sdpa(q, k, v, causal,
                            sm_scale or 1.0 / math.sqrt(q.shape[-1]))
 

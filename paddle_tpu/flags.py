@@ -128,9 +128,11 @@ define_flag("deterministic", False, "Force deterministic kernels where possible.
 define_flag("use_pallas", True, "Use Pallas fused kernels where available (vs pure-XLA fallbacks).")
 define_flag("flash_attn_min_seqlen", 2048,
             "Dispatch sdpa to the Pallas flash kernel only at seq >= this; "
-            "below it XLA's fused dense attention is faster on v5e (measured "
-            "GPT-345M @1024: 0.257 vs 0.236 MFU) while flash wins on memory "
-            "scaling at long seq. 0 = always use flash.")
+            "below it XLA's fused dense attention is faster on v5e (r2 "
+            "measurement, artifact NOT committed — tools/tpu_watch.py "
+            "re-measures and banks ATTN_BENCH_r*.json to validate or "
+            "correct this default the next healthy chip window) while "
+            "flash wins on memory scaling at long seq. 0 = always flash.")
 define_flag("allocator_strategy", "auto_growth", "Kept for API parity; PJRT owns memory on TPU.")
 define_flag("fraction_of_gpu_memory_to_use", 0.92, "API parity; PJRT owns memory on TPU.")
 define_flag("log_level", 1, "Framework log verbosity (GLOG_v analogue).")

@@ -41,6 +41,23 @@ def _bind_methods() -> None:
     # creation-style helpers that are methods in paddle
     Tensor.clone = creation.clone
     Tensor.fill_diagonal_ = _fill_diagonal_
+    Tensor.dim = lambda self: self._value.ndim
+    Tensor.ndimension = Tensor.dim
+    Tensor.rank = Tensor.dim
+    Tensor.cuda = lambda self, *a, **k: self       # device no-ops on TPU:
+    Tensor.pin_memory = lambda self, *a, **k: self  # arrays live in HBM
+    Tensor.normal_ = _normal_
+    Tensor.uniform_ = random.uniform_    # same in-place fill as ops.random
+
+
+def _normal_(x, mean=0.0, std=1.0, name=None):
+    """In-place refill from N(mean, std) (reference Tensor.normal_)."""
+    from ..framework.random import next_key
+    import jax as _jax
+    dt = jnp.result_type(x._value)
+    x._value = mean + std * _jax.random.normal(
+        next_key(), tuple(x._value.shape), dt)
+    return x
 
 
 def _fill_diagonal_(x, value, offset=0, wrap=False, name=None):

@@ -283,3 +283,15 @@ class TestGradientFlowThroughNewSurface:
         out = sn(w)
         out.sum().backward()
         assert w.grad is not None
+
+
+def test_tensor_method_aliases():
+    t = paddle.to_tensor(np.ones((2, 3), np.float32))
+    assert t.dim() == t.ndimension() == t.rank() == 2
+    assert t.cuda() is t and t.pin_memory() is t   # device no-ops on TPU
+    t.normal_(0.0, 1.0)
+    assert float(np.asarray(t.numpy()).std()) > 0
+    u = paddle.to_tensor(np.zeros((100,), np.float32))
+    u.uniform_(0.0, 1.0)
+    un = np.asarray(u.numpy())
+    assert un.min() >= 0 and un.max() <= 1 and un.std() > 0

@@ -132,16 +132,18 @@ def main():
         "",
         "## Decision rule",
         "",
-        "- **Ulysses first** when the sep degree divides the head count "
-        "(P <= Hkv for GQA: the all_to_all must split KV heads too): "
-        "fewest bytes, one hop, and the inner attention is a plain "
+        "- **Ulysses first** when P divides the Q head count: fewest "
+        "bytes, one hop, and the inner attention is a plain "
         "single-device kernel (the Pallas flash kernel drops in via "
-        "`attn_fn`).",
-        "- **Ring** when P > Hkv (head-divisibility broken), when scaling "
-        "sep beyond the head count, or when nearest-neighbour-only "
-        "comm matters (ICI torus without all-to-all bandwidth): its "
-        "per-step ppermute overlaps with the block matmuls, and its "
-        "causal load-balancing favors very long S.",
+        "`attn_fn`). GQA with Hkv < P is handled too: kv heads are "
+        "all-gathered in sequence instead of head-split (comm 2 q "
+        "all-to-alls + one kv all-gather — cheaper than ring whenever "
+        "Hkv <= 2H/P).",
+        "- **Ring** when P exceeds the q head count, or when "
+        "nearest-neighbour-only comm matters (ICI torus without "
+        "all-to-all bandwidth): its per-step ppermute overlaps with the "
+        "block matmuls, and its causal load-balancing favors very "
+        "long S.",
         "- Both compose with dp/mp/pp on the same mesh "
         "(`sep_scaled_dot_product_attention` shard_maps only the sep "
         "axis; everything else stays GSPMD).",
