@@ -12,12 +12,11 @@ Runs as a long-lived background process (tmux). Loop:
      the window may close mid-sequence);
   4. sleep and re-probe.
 
-Exits when every artifact is banked. Artifacts (repo root):
-  ATTN_BENCH_r03.json     flash-vs-dense fwd+bwd at 1k/2k/4k/8k
-  RMSNORM_BENCH_r03.json  pallas-vs-XLA rms_norm
-  BENCH_tpu_r03.json      real gpt345m MFU via bench.py on the chip
+Exits when every artifact is banked. Round 4 on: the banking sequence
+lives in tools/chip_sprint.py (strict leverage order — kernel compile
+checks, attn/rmsnorm microbenches, 345M MFU + decode); the watcher just
+probes and arms the sprint, which banks + commits per step itself.
 """
-import json
 import os
 import subprocess
 import sys
@@ -51,96 +50,20 @@ def probe() -> bool:
     return state == "tpu"
 
 
-def run_json_lines(argv, timeout: int, env=None) -> list:
-    """Run a bench subprocess; return its stdout JSON lines. Raises on
-    nonzero rc (a partial run must NOT be banked as a complete artifact)
-    or when no line parses."""
-    r = subprocess.run(argv, env=env or base_env(), capture_output=True,
-                       text=True, timeout=timeout, cwd=REPO)
-    lines = []
-    for ln in r.stdout.splitlines():
-        try:
-            lines.append(json.loads(ln))
-        except (json.JSONDecodeError, ValueError):
-            continue
-    if r.returncode != 0 or not lines:
-        raise RuntimeError(f"rc={r.returncode} lines={len(lines)} "
-                           f"stderr={r.stderr[-2000:]}")
-    return lines
+ROUND = os.environ.get("CHIP_SPRINT_ROUND", "r04")
+ARTIFACTS = [f"KERNEL_COMPILE_{ROUND}.json", f"ATTN_BENCH_{ROUND}.json",
+             f"RMSNORM_BENCH_{ROUND}.json", f"BENCH_tpu_{ROUND}.json"]
 
 
-def require_tpu(lines: list) -> None:
-    """Every bench line self-reports its backend; refuse to bank anything
-    that silently fell back to CPU between probe and run."""
-    bad = [l.get("backend") for l in lines
-           if l.get("backend") not in ("tpu", "axon")]
-    if bad:
-        raise RuntimeError(f"bench ran on {bad[0]!r}, not TPU — not banking")
-
-
-def commit(path: str, msg: str) -> None:
-    for attempt in range(5):  # index.lock races with the main session
-        r = subprocess.run(["git", "add", path], cwd=REPO,
-                           capture_output=True, text=True)
-        if r.returncode == 0:
-            r = subprocess.run(["git", "commit", "-m", msg, "--", path],
-                               cwd=REPO, capture_output=True, text=True)
-            if r.returncode == 0:
-                log(f"committed {path}")
-                return
-        log(f"commit attempt {attempt}: {r.stderr.strip()[:200]}")
-        time.sleep(10)
-    log(f"GAVE UP committing {path} — left in working tree")
-
-
-def bank_attn() -> None:
-    lines = run_json_lines(
-        [sys.executable, os.path.join(REPO, "tools", "attn_bench.py")],
-        timeout=3600)
-    require_tpu(lines)
-    out = {"backend": lines[-1]["backend"],
-           "ts": time.strftime("%Y-%m-%dT%H:%M:%S"), "results": lines}
-    p = os.path.join(REPO, "ATTN_BENCH_r03.json")
-    with open(p, "w") as f:
-        json.dump(out, f, indent=1)
-    commit(p, "Bank on-chip flash-vs-dense attention bench (r3)")
-
-
-def bank_rmsnorm() -> None:
-    lines = run_json_lines(
-        [sys.executable, os.path.join(REPO, "tools", "rmsnorm_bench.py")],
-        timeout=1800)
-    require_tpu(lines)
-    out = {"backend": lines[-1]["backend"],
-           "ts": time.strftime("%Y-%m-%dT%H:%M:%S"), "results": lines}
-    p = os.path.join(REPO, "RMSNORM_BENCH_r03.json")
-    with open(p, "w") as f:
-        json.dump(out, f, indent=1)
-    commit(p, "Bank on-chip rms_norm pallas-vs-XLA bench (r3)")
-
-
-def bank_gpt345m() -> None:
-    env = base_env()
-    env["BENCH_TIMEOUT"] = "3000"
-    # The watcher just probed: cap bench.py's own probe backoff so the
-    # outer timeout (3300) > probe budget (60) + child budget (3000).
-    env["BENCH_PROBE_BUDGET"] = "60"
-    lines = run_json_lines([sys.executable, os.path.join(REPO, "bench.py")],
-                           timeout=3300, env=env)
-    res = lines[-1]
-    if res.get("backend") not in ("tpu", "axon") or "fallback" in res:
-        raise RuntimeError(f"bench fell back to {res.get('backend')}")
-    p = os.path.join(REPO, "BENCH_tpu_r03.json")
-    with open(p, "w") as f:
-        json.dump(res, f, indent=1)
-    commit(p, "Bank on-chip gpt345m MFU bench (r3)")
-
-
-ARTIFACTS = [
-    ("ATTN_BENCH_r03.json", bank_attn),
-    ("RMSNORM_BENCH_r03.json", bank_rmsnorm),
-    ("BENCH_tpu_r03.json", bank_gpt345m),
-]
+def run_sprint() -> None:
+    """Arm tools/chip_sprint.py: it banks + commits each step itself and
+    skips already-banked artifacts, so re-arming after a flap is safe."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chip_sprint.py")],
+        env=base_env(), capture_output=True, text=True, timeout=4 * 3600,
+        cwd=REPO)
+    log(f"chip_sprint rc={r.returncode} tail={r.stdout[-400:]} "
+        f"stderr={r.stderr[-400:]}")
 
 
 def main() -> None:
@@ -148,20 +71,17 @@ def main() -> None:
     deadline = time.time() + float(os.environ.get("TPU_WATCH_HOURS", "11")) * 3600
     interval = 120.0
     while time.time() < deadline:
-        todo = [(p, fn) for p, fn in ARTIFACTS
+        todo = [p for p in ARTIFACTS
                 if not os.path.exists(os.path.join(REPO, p))]
         if not todo:
             log("all artifacts banked — exiting")
             return
         if probe():
             interval = 120.0
-            for p, fn in todo:
-                try:
-                    log(f"running {p} ...")
-                    fn()
-                except Exception as e:
-                    log(f"{p} FAILED: {e!r}"[:500])
-                    break  # window may have closed; re-probe
+            try:
+                run_sprint()
+            except Exception as e:
+                log(f"sprint FAILED: {e!r}"[:500])
         else:
             interval = min(interval * 1.5, 600.0)
         time.sleep(interval)
