@@ -114,7 +114,8 @@ def _dense_sdpa(q, k, v, causal, sm_scale):
 
 def ulysses_attention(q, k, v, axis_name: str = "sep", causal: bool = True,
                       sm_scale: Optional[float] = None,
-                      attn_fn: Optional[Callable] = None):
+                      attn_fn: Optional[Callable] = None,
+                      attn_fn_gqa: bool = False):
     """Per-shard Ulysses attention (reference: the sep_degree axis /
     head-scatter seq-gather all-to-alls). q/k/v: (B, C, H, D) seq-sharded;
     requires H % axis_size == 0. Each shard computes FULL-sequence attention
@@ -127,7 +128,12 @@ def ulysses_attention(q, k, v, axis_name: str = "sep", causal: bool = True,
     head — instead the (few) kv heads are ALL-GATHERED in sequence and
     each shard selects the kv heads its q-head slice attends to
     (comm: 2 q all-to-alls + one kv all-gather of B*S*Hkv*D — cheaper
-    than ring's (P-1) kv rotations whenever Hkv <= 2H/P)."""
+    than ring's (P-1) kv rotations whenever Hkv <= 2H/P).
+
+    ``attn_fn_gqa``: declare that ``attn_fn`` handles grouped-query inputs
+    natively (fewer kv heads than q heads, e.g. the Pallas flash kernel) —
+    the unexpanded kv then reaches it at Hkv bandwidth instead of being
+    jnp.repeat-expanded first (advisor r3)."""
     p = lax.axis_size(axis_name)
     b, c, h, d = q.shape
     hkv = k.shape[2]
@@ -149,10 +155,13 @@ def ulysses_attention(q, k, v, axis_name: str = "sep", causal: bool = True,
     qg = seq_gather(q)
     fn = attn_fn or functools.partial(_dense_sdpa, causal=causal,
                                       sm_scale=sm_scale)
+    gqa_fn = attn_fn is not None and attn_fn_gqa
     if hkv == h or hkv % p == 0:
         kg, vg = seq_gather(k), seq_gather(v)
-        if hkv != h:
+        if hkv != h and not gqa_fn:
             # per-shard GQA: expand the local kv head slice to match
+            # (dense fallback only — a GQA-aware attn_fn reads the
+            # unexpanded slice at Hkv bandwidth)
             rep = (h // p) // (hkv // p)
             kg = jnp.repeat(kg, rep, axis=2)
             vg = jnp.repeat(vg, rep, axis=2)
@@ -165,10 +174,22 @@ def ulysses_attention(q, k, v, axis_name: str = "sep", causal: bool = True,
         vg = lax.all_gather(v, axis_name, axis=1, tiled=True)
         r = lax.axis_index(axis_name)
         rep = h // hkv
-        heads = r * (h // p) + jnp.arange(h // p)
-        k_sel = jnp.take(kg, heads // rep, axis=2)
-        v_sel = jnp.take(vg, heads // rep, axis=2)
-        out = fn(qg, k_sel, v_sel)
+        hq_l = h // p
+        # here hkv % p != 0 (else-branch), which rules out hq_l % rep == 0
+        # (they are equivalent) — the only unexpanded-kv case left is the
+        # whole local q slice sharing ONE kv group:
+        if gqa_fn and rep % hq_l == 0:
+            # the whole local q slice lives inside ONE kv group (slice
+            # start r*hq_l is a multiple of hq_l and rep % hq_l == 0, so
+            # the slice never crosses a group boundary): one kv head
+            kv_heads = jnp.reshape(r * hq_l // rep, (1,))
+            out = fn(qg, jnp.take(kg, kv_heads, axis=2),
+                     jnp.take(vg, kv_heads, axis=2))
+        else:
+            heads = r * (h // p) + jnp.arange(h // p)
+            k_sel = jnp.take(kg, heads // rep, axis=2)
+            v_sel = jnp.take(vg, heads // rep, axis=2)
+            out = fn(qg, k_sel, v_sel)
     return seq_scatter(out)
 
 

@@ -8,6 +8,12 @@ membership without etcd or a shared filesystem).
 Stdlib-only: ``ThreadingHTTPServer`` on the master, ``urllib`` clients on
 the workers — multi-node launch needs nothing but plain TCP to rank 0.
 
+Hardening (advisor r3): the server binds the master endpoint's interface
+(not 0.0.0.0) when one is given, and when a job token is set (explicitly
+or via ``PADDLE_JOB_TOKEN``) every request must carry it in an
+``X-Job-Token`` header — any host that can reach the port can no longer
+read or rewrite the rendezvous state.
+
 Routes:
   PUT    /kv/<key>        body = value (bytes, stored verbatim)
   GET    /kv/<key>        -> 200 value | 404
@@ -17,6 +23,7 @@ Routes:
 
 from __future__ import annotations
 
+import hmac
 import json
 import threading
 import time
@@ -31,9 +38,18 @@ from .elastic import Rendezvous
 class _Handler(BaseHTTPRequestHandler):
     store: Dict[str, bytes]
     lock: threading.Lock
+    token: Optional[str]
 
     def log_message(self, *a):            # silence per-request stderr spam
         pass
+
+    def _authorized(self) -> bool:
+        if self.token and not hmac.compare_digest(
+                self.headers.get("X-Job-Token", ""), self.token):
+            self.send_response(403)
+            self.end_headers()
+            return False
+        return True
 
     def _key(self) -> Optional[str]:
         if self.path.startswith("/kv/"):
@@ -41,6 +57,8 @@ class _Handler(BaseHTTPRequestHandler):
         return None
 
     def do_PUT(self):
+        if not self._authorized():
+            return
         key = self._key()
         if key is None:
             self.send_response(404)
@@ -54,6 +72,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_GET(self):
+        if not self._authorized():
+            return
         if self.path.startswith("/prefix/"):
             prefix = self.path[len("/prefix/"):]
             with self.lock:
@@ -79,6 +99,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(val)
 
     def do_DELETE(self):
+        if not self._authorized():
+            return
         key = self._key()
         with self.lock:
             existed = key is not None and self.store.pop(key, None) is not None
@@ -89,9 +111,10 @@ class _Handler(BaseHTTPRequestHandler):
 class KVServer:
     """The rank-0 master: a threaded HTTP KV store."""
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 token: Optional[str] = None):
         handler = type("BoundHandler", (_Handler,), {
-            "store": {}, "lock": threading.Lock()})
+            "store": {}, "lock": threading.Lock(), "token": token})
         self._handler = handler
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
@@ -111,10 +134,12 @@ class KVClient:
     """urllib client for the master (retries cover master startup races)."""
 
     def __init__(self, endpoint: str, timeout: float = 5.0,
-                 retries: int = 20, retry_interval: float = 0.25):
+                 retries: int = 20, retry_interval: float = 0.25,
+                 token: Optional[str] = None):
         if "://" not in endpoint:
             endpoint = "http://" + endpoint
         self.base = endpoint.rstrip("/")
+        self.token = token
         self.timeout = timeout
         self.retries = retries
         self.retry_interval = retry_interval
@@ -125,12 +150,18 @@ class KVClient:
         for _ in range(self.retries):
             req = urllib.request.Request(self.base + path, data=data,
                                          method=method)
+            if self.token:
+                req.add_header("X-Job-Token", self.token)
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout) as r:
                     return r.read() if want_body else True
             except urllib.error.HTTPError as e:
                 if e.code == 404:
                     return None if want_body else False
+                if e.code == 403:   # deterministic: wrong/missing job token
+                    raise PermissionError(
+                        f"KV master at {self.base} rejected the job token "
+                        "(set PADDLE_JOB_TOKEN to match the master)") from e
                 last = e
             except (urllib.error.URLError, OSError) as e:
                 last = e                   # master not up yet / net blip
@@ -162,14 +193,35 @@ class HTTPRendezvous(Rendezvous):
     etcd-lease behavior for elastic membership."""
 
     def __init__(self, endpoint: str, is_master: bool = False,
-                 ttl: Optional[float] = None):
+                 ttl: Optional[float] = None,
+                 token: Optional[str] = None):
+        import os
+        if token is None:
+            token = os.environ.get("PADDLE_JOB_TOKEN") or None
         self.server: Optional[KVServer] = None
         if is_master:
             host, _, port = endpoint.partition(":")
-            self.server = KVServer("0.0.0.0", int(port or 0)).start()
+            # bind the advertised interface when it is a literal IP;
+            # hostnames may resolve to loopback locally (Debian-style
+            # /etc/hosts) where binding would succeed yet be unreachable
+            # from peers, so they get 0.0.0.0 + token auth instead
+            bind_host = "0.0.0.0"
+            if host:
+                try:
+                    import ipaddress
+                    ipaddress.ip_address(host)
+                    bind_host = host
+                except ValueError:
+                    pass
+            try:
+                self.server = KVServer(bind_host, int(port or 0),
+                                       token=token).start()
+            except OSError:
+                self.server = KVServer("0.0.0.0", int(port or 0),
+                                       token=token).start()
             endpoint = f"{host or '127.0.0.1'}:{self.server.port}"
         self.endpoint = endpoint
-        self.client = KVClient(endpoint)
+        self.client = KVClient(endpoint, token=token)
         self.ttl = ttl
 
     def register(self, node_id: str, info: Dict) -> None:

@@ -69,8 +69,9 @@ def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         from ..flags import get_flag, is_tpu_backend
         if get_flag("use_pallas") and is_tpu_backend():
             try:
-                return flash_prefill(q, k_cache, v_cache, cur_len,
-                                     sm_scale=sm_scale)
+                return _prefill_diff(q, k_cache, v_cache,
+                                     jnp.asarray(cur_len, jnp.int32),
+                                     sm_scale)
             except NotImplementedError:
                 pass
     return cached_attention_dense(q, k_cache, v_cache, cur_len,
@@ -103,6 +104,36 @@ def cached_attention_dense(q, k_cache, v_cache, cur_len,
     out = jnp.einsum("bgrst,btgd->bsgrd", probs,
                      v_cache.astype(jnp.float32))
     return out.reshape(b, s, h, d).astype(q.dtype)
+
+
+# ------------------------------------------------------------------------
+# Differentiable wrapper over the fwd-only flash_prefill kernel (advisor
+# r3): without it, any caller differentiating through a prefill (e.g. a
+# future training-with-cache path) would die at trace time with an opaque
+# missing-vjp Pallas error. The backward recomputes the DENSE vjp — the
+# (S, T) score matrix is materialized there, so training through a long
+# prefill pays dense memory; the fwd inference path keeps flash behavior.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _prefill_diff(q, k_cache, v_cache, cur_len, sm_scale):
+    return flash_prefill(q, k_cache, v_cache, cur_len, sm_scale=sm_scale)
+
+
+def _prefill_diff_fwd(q, k_cache, v_cache, cur_len, sm_scale):
+    out = flash_prefill(q, k_cache, v_cache, cur_len, sm_scale=sm_scale)
+    return out, (q, k_cache, v_cache, cur_len)
+
+
+def _prefill_diff_bwd(sm_scale, res, g):
+    q, k_cache, v_cache, cur_len = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: cached_attention_dense(q_, k_, v_, cur_len,
+                                                  sm_scale=sm_scale),
+        q, k_cache, v_cache)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_prefill_diff.defvjp(_prefill_diff_fwd, _prefill_diff_bwd)
 
 
 # ===================================================== flash prefill kernel

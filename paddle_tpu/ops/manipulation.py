@@ -607,6 +607,16 @@ def masked_scatter(x, mask, value, name=None):
                 raise ValueError(
                     f"masked_scatter: mask has {n_true} True positions but "
                     f"value has only {src.shape[0]} elements")
+        else:
+            # under jit the size check can't raise at trace time; fail
+            # loudly for callers running under checkify (the repo's
+            # debugging contract, amp/debugging.py) instead of silently
+            # reusing the last source element
+            from jax.experimental import checkify as ck
+            ck.debug_check(
+                flat_m.sum() <= src.shape[0],
+                "masked_scatter: mask has more True positions than value "
+                "elements")
         take = jnp.clip(idx, 0, src.shape[0] - 1)
         repl = src[take].reshape(a.shape)
         return jnp.where(mb, repl, a)

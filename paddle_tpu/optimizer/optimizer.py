@@ -648,11 +648,12 @@ class Rprop(Optimizer):
         self._eta_minus, self._eta_plus = etas
 
     def init_slot(self, p_val):
+        # scheduler or constant: the initial per-weight step is the
+        # CURRENT learning rate (reference rprop.py seeds from the initial
+        # lr, not a hardcoded constant — advisor r3)
         return {"prev_grad": jnp.zeros_like(p_val, dtype=jnp.float32),
-                "step_size": jnp.full(p_val.shape, float(self._lr),
-                                      jnp.float32)
-                if not callable(self._lr) else
-                jnp.full(p_val.shape, 1e-3, jnp.float32)}
+                "step_size": jnp.full(p_val.shape, float(self.get_lr()),
+                                      jnp.float32)}
 
     def apply_one(self, p, g, slots, lr, t, wd):
         g32 = g.astype(jnp.float32)

@@ -307,3 +307,34 @@ def test_flash_prefill_no_quadratic_scores_temp():
     assert dn_temp >= scores_bytes                       # dense really has it
     assert fl_temp < 100 * 2**20, f"flash temp {fl_temp/2**20:.0f} MB"
     assert fl_temp * 10 < dn_temp
+
+
+class TestPrefillDifferentiable:
+    """Advisor r3: differentiating through the prefill dispatch must work
+    (dense-backward fallback), not die in a missing-vjp Pallas error."""
+
+    def test_prefill_grad_matches_dense(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.kernels.decode_attention import (
+            _prefill_diff, cached_attention_dense)
+
+        rng = np.random.default_rng(0)
+        b, s, h, d, t = 1, 8, 2, 16, 128   # t: block_k multiple
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        kc = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+        vc = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+        cur = jnp.asarray(100, jnp.int32)
+
+        def loss_flash(q, kc, vc):
+            return (_prefill_diff(q, kc, vc, cur, None) ** 2).sum()
+
+        def loss_dense(q, kc, vc):
+            return (cached_attention_dense(q, kc, vc, cur) ** 2).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, kc, vc)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, kc, vc)
+        for a, b_, name in zip(gf, gd, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-4, atol=1e-4,
+                                       err_msg=f"d{name}")

@@ -307,3 +307,45 @@ class TestHTTPKVRendezvous:
             assert rdzv.alive_nodes() == []   # deregistered after the run
         finally:
             rdzv.shutdown()
+
+
+class TestKVMasterAuth:
+    """Advisor r3: a job token gates every route; wrong/missing tokens are
+    rejected before touching the store."""
+
+    def test_token_required_when_set(self):
+        from paddle_tpu.distributed.launch.kv_master import KVClient, KVServer
+
+        srv = KVServer("127.0.0.1", 0, token="s3cret").start()
+        try:
+            good = KVClient(f"127.0.0.1:{srv.port}", retries=2,
+                            retry_interval=0.05, token="s3cret")
+            good.put("k", b"v")
+            assert good.get("k") == b"v"
+
+            bad = KVClient(f"127.0.0.1:{srv.port}", retries=2,
+                           retry_interval=0.05)
+            # 403 is deterministic: fail fast with the auth error, no
+            # retry storm masquerading as "master unreachable"
+            with pytest.raises(PermissionError, match="job token"):
+                bad.put("k", b"evil")
+            with pytest.raises(PermissionError, match="job token"):
+                bad.get("k")
+            assert good.get("k") == b"v"  # store untouched by bad client
+        finally:
+            srv.stop()
+
+    def test_rendezvous_token_from_env(self, monkeypatch):
+        from paddle_tpu.distributed.launch.kv_master import (HTTPRendezvous,
+                                                             KVClient)
+
+        monkeypatch.setenv("PADDLE_JOB_TOKEN", "jobtok")
+        rdzv = HTTPRendezvous("127.0.0.1:0", is_master=True)
+        try:
+            rdzv.register("n0", {"rank": 0})
+            assert rdzv.alive_nodes() == ["n0"]
+            anon = KVClient(rdzv.endpoint, retries=2, retry_interval=0.05)
+            with pytest.raises(PermissionError, match="job token"):
+                anon.get("nodes/n0")
+        finally:
+            rdzv.shutdown()

@@ -166,3 +166,59 @@ class TestUlyssesGQA:
         assert np.isfinite(np.asarray(gq)).all()
         assert float(jnp.abs(gk).sum()) > 0
         assert float(jnp.abs(gv).sum()) > 0
+
+
+class TestUlyssesGQAAttnFn:
+    """Advisor r3: a GQA-aware attn_fn must receive the UNEXPANDED kv
+    (Hkv-bandwidth contract) on both GQA branches, and the result must
+    still match dense."""
+
+    @pytest.mark.parametrize("h,hkv", [(16, 8), (8, 2)])  # split / gather
+    def test_attn_fn_sees_unexpanded_kv(self, h, hkv):
+        from jax.sharding import Mesh, NamedSharding
+        from paddle_tpu.distributed.fleet.utils.ring_flash_attention import (
+            ulysses_attention)
+
+        p = 4
+        mesh = Mesh(np.array(jax.devices()[:p]), ("sep",))
+        b, s, d = 1, 32, 8
+        rng = np.random.default_rng(21)
+        sh = NamedSharding(mesh, P(None, "sep", None, None))
+        q = jax.device_put(jnp.asarray(
+            rng.standard_normal((b, s, h, d)), jnp.float32), sh)
+        k = jax.device_put(jnp.asarray(
+            rng.standard_normal((b, s, hkv, d)), jnp.float32), sh)
+        v = jax.device_put(jnp.asarray(
+            rng.standard_normal((b, s, hkv, d)), jnp.float32), sh)
+
+        seen_heads = []
+
+        def gqa_fn(qq, kk, vv):
+            # GQA-aware dense: expand inside (stand-in for flash kernel)
+            seen_heads.append((qq.shape[2], kk.shape[2]))
+            rep = qq.shape[2] // kk.shape[2]
+            return _dense_sdpa(qq, jnp.repeat(kk, rep, axis=2),
+                               jnp.repeat(vv, rep, axis=2), True,
+                               1.0 / np.sqrt(d))
+
+        spec = P(None, "sep", None, None)
+        mapped = jax.shard_map(
+            lambda a, b_, c: ulysses_attention(
+                a, b_, c, axis_name="sep", causal=True,
+                attn_fn=gqa_fn, attn_fn_gqa=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            axis_names=frozenset({"sep"}))
+        out = mapped(q, k, v)
+
+        rep = h // hkv
+        ref = _dense_sdpa(q, jnp.repeat(k, rep, axis=2),
+                          jnp.repeat(v, rep, axis=2), True,
+                          1.0 / np.sqrt(d))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        # the contract: attn_fn got the UNEXPANDED kv head count —
+        # max(1, local_q_heads // rep) heads, never q-many
+        assert seen_heads and all(kk < qq for qq, kk in seen_heads), \
+            seen_heads
+        assert all(kk == max(1, qq // rep) for qq, kk in seen_heads), \
+            seen_heads

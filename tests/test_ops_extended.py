@@ -393,3 +393,50 @@ class TestTensorMethodBinding:
         np.testing.assert_allclose(x.diagonal().numpy(),
                                    np.diagonal(x.numpy()))
         assert hasattr(x, "deg2rad") and hasattr(x, "cdist")
+
+
+class TestMaskedScatterGuards:
+    """Advisor r3: the too-few-values error must fire eagerly, and fail
+    loudly under jit for checkify callers (instead of silently reusing
+    the last source element)."""
+
+    def test_eager_raises_on_short_value(self):
+        x = T(np.zeros((2, 3), np.float32))
+        mask = T(np.ones((2, 3), bool))
+        vals = T(np.arange(4, dtype=np.float32))
+        with pytest.raises(ValueError, match="True positions"):
+            ops.masked_scatter(x, mask, vals)
+
+    def test_jit_checkify_raises(self):
+        import jax
+        from jax.experimental import checkify as ck
+        from paddle_tpu.core.autograd import functional_guard
+
+        def f(x, m, v):
+            with functional_guard():
+                return ops.masked_scatter(
+                    paddle.to_tensor(x), paddle.to_tensor(m),
+                    paddle.to_tensor(v)).value
+
+        cf = jax.jit(ck.checkify(f, errors=ck.user_checks))
+        err, _ = cf(np.zeros((2, 3), np.float32), np.ones((2, 3), bool),
+                    np.arange(4, dtype=np.float32))
+        with pytest.raises(Exception, match="True positions"):
+            err.throw()
+
+    def test_jit_correct_when_enough_values(self):
+        import jax
+        from paddle_tpu.core.autograd import functional_guard
+
+        def f(x, m, v):
+            with functional_guard():
+                return ops.masked_scatter(
+                    paddle.to_tensor(x), paddle.to_tensor(m),
+                    paddle.to_tensor(v)).value
+
+        x = np.zeros((2, 2), np.float32)
+        m = np.array([[True, False], [True, True]])
+        v = np.array([1.0, 2.0, 3.0], np.float32)
+        out = jax.jit(f)(x, m, v)
+        np.testing.assert_allclose(
+            np.asarray(out), [[1.0, 0.0], [2.0, 3.0]])

@@ -401,3 +401,19 @@ class TestDecayMaskEagerJitParity:
             np.testing.assert_allclose(np.asarray(eager[k]),
                                        np.asarray(jit[k]),
                                        rtol=2e-5, atol=2e-6, err_msg=k)
+
+
+class TestRpropSchedulerInit:
+    """Advisor r3: with an LRScheduler, Rprop's initial per-weight step
+    must seed from the scheduler's current lr, not a hardcoded 1e-3."""
+
+    def test_initial_step_uses_scheduler_lr(self):
+        import numpy as _np
+        import paddle_tpu as paddle
+        from paddle_tpu.optimizer import Rprop
+        from paddle_tpu.optimizer.lr import StepDecay
+
+        sched = StepDecay(learning_rate=0.25, step_size=10)
+        opt = Rprop(learning_rate=sched)
+        slot = opt.init_slot(_np.zeros((3, 2), _np.float32))
+        _np.testing.assert_allclose(_np.asarray(slot["step_size"]), 0.25)
