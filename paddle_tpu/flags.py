@@ -133,6 +133,15 @@ define_flag("flash_attn_min_seqlen", 2048,
             "re-measures and banks ATTN_BENCH_r*.json to validate or "
             "correct this default the next healthy chip window) while "
             "flash wins on memory scaling at long seq. 0 = always flash.")
+define_flag("flash_compact_stats", False,
+            "Flash-attention stats stay compact (BH, S) at the kernel "
+            "boundary: fwd keeps softmax stats in VMEM scratch and emits "
+            "lse via an in-kernel (1, bq) write; bwd loads lse/delta/seg "
+            "as (1, bq) lane rows transposed in-kernel — kills the "
+            "128x-replicated HBM transients (advisor r2). Default off "
+            "until tools/chip_sprint.py validates the Mosaic layouts "
+            "compile on a real chip; numerics are parity-tested in "
+            "interpret mode either way.")
 define_flag("allocator_strategy", "auto_growth", "Kept for API parity; PJRT owns memory on TPU.")
 define_flag("fraction_of_gpu_memory_to_use", 0.92, "API parity; PJRT owns memory on TPU.")
 define_flag("log_level", 1, "Framework log verbosity (GLOG_v analogue).")

@@ -57,11 +57,12 @@ def _rep(x):
     are the 2-D forms).
 
     Known cost (advisor r2): four such transients coexist across the two
-    bwd pallas_calls (~128 MB each at BH=256, S=4096). The fix — loading
-    compact (BH, S) stats as (1, block_q) lane-major rows and transposing
-    in-kernel — changes Mosaic layouts and needs on-chip compile
-    validation, which the tunnel outage blocks; revisit when a healthy
-    window allows running tools/attn_bench.py against both variants."""
+    bwd pallas_calls (~128 MB each at BH=256, S=4096). The fix — compact
+    (BH, S) stats loaded as (1, block_q) lane rows and transposed
+    in-kernel, plus a scratch-stat forward — is implemented behind
+    FLAGS_flash_compact_stats (parity-tested in interpret mode); it stays
+    off by default until tools/chip_sprint.py validates the changed
+    Mosaic layouts compile on a real chip."""
     return jnp.broadcast_to(x[..., None], (*x.shape, _LANES))
 
 
@@ -70,16 +71,63 @@ def _interpret() -> bool:
     return not is_tpu_backend()
 
 
+def _compact() -> bool:
+    """FLAGS_flash_compact_stats: keep softmax stats compact (BH, S) at
+    the kernel boundary — no 128x lane-replicated HBM transients. Numerics
+    are identical (parity-tested); only Mosaic layouts differ, so the
+    default stays off until tools/chip_sprint.py validates on-chip
+    compilation."""
+    from ..flags import get_flag
+    return bool(get_flag("flash_compact_stats"))
+
+
 def _dims(ref_shape):
     return ref_shape[1], ref_shape[2]
 
 
 # ============================================================ forward kernel
+def _masked_scores(q_ref, k_ref, seg_col, seg_kv_ref, q_blk, kv_blk,
+                   causal, sm_scale):
+    """Scaled (bq, bk) score block with causal + segment masking — the
+    shared core of all four kernels. ``seg_col``: the q-side segment ids
+    as a (bq, 1) column (None when unsegmented)."""
+    block_q, d = _dims(q_ref.shape)
+    block_k = k_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32) * sm_scale              # (bq, d)
+    k = k_ref[0].astype(jnp.float32)                         # (bk, d)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if causal:
+        q_pos = q_blk * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kv_pos = kv_blk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+    if seg_col is not None:
+        s = jnp.where(seg_col == seg_kv_ref[0], s, _NEG_INF)
+    return s
+
+
+def _softmax_update(s, m_prev, l_prev):
+    """One online-softmax step: returns (m_new, l_new, p, alpha) for a
+    score block against the running (bq, 1) stats."""
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    # clamp for fully-masked rows: with m_new == -inf, exp(s - m_new)
+    # would be exp(0) = 1 for every masked score — clamping to 0 makes
+    # p = exp(-1e30) = 0 so masked rows emit zeros, and the saved
+    # lse = 0 + log(1) keeps the backward's p = exp(-1e30 - 0) = 0 too
+    m_new = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    return m_new, l_new, p, alpha
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref,
                 acc_ref, m_ref, l_ref, *, causal: bool, sm_scale: float):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
-    block_q, d = _dims(q_ref.shape)
+    block_q, _ = _dims(q_ref.shape)
     block_k = k_ref.shape[1]
 
     @pl.when(kj == 0)
@@ -96,44 +144,138 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
-        k = k_ref[0].astype(jnp.float32)                     # (bk, d)
-        v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kv_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
-        if seg_q_ref is not None:
-            sq = seg_q_ref[0][:, :1]                         # (bq, 1)
-            sk = seg_kv_ref[0]                               # (1, bk)
-            s = jnp.where(sq == sk, s, _NEG_INF)
-
+        seg_col = seg_q_ref[0][:, :1] if seg_q_ref is not None else None
+        s = _masked_scores(q_ref, k_ref, seg_col, seg_kv_ref, qi, kj,
+                           causal, sm_scale)
         # stat refs are (block_q, 128) lane-replicated; compute on column 0
-        m_prev = m_ref[0][:, :1]                             # (bq, 1)
-        l_prev = l_ref[0][:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        # clamp for fully-masked rows: with m_new == -inf, exp(s - m_new)
-        # would be exp(0) = 1 for every masked score — clamping to 0 makes
-        # p = exp(-1e30) = 0 so masked rows emit zeros, and the saved
-        # lse = 0 + log(1) keeps the backward's p = exp(-1e30 - 0) = 0 too
-        m_new = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        m_new, l_new, p, alpha = _softmax_update(
+            s, m_ref[0][:, :1], l_ref[0][:, :1])
         l_ref[0] = jnp.broadcast_to(l_new, l_ref[0].shape)
         m_ref[0] = jnp.broadcast_to(m_new, m_ref[0].shape)
         acc_ref[0] = alpha * acc_ref[0] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
 
+def _fwd_kernel_compact(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref,
+                        out_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                        causal: bool, sm_scale: float, n_k: int):
+    """Compact-stat forward: acc/m/l live in VMEM scratch across the
+    sequential kv sweep (same structure as decode_attention._prefill_kernel);
+    the normalized output and the compact (1, block_q) lse row are emitted
+    on the LAST kv block each q row-block runs — no lane-replicated stat
+    arrays ever reach HBM."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    block_q, d = _dims(q_ref.shape)
+    block_k = k_ref.shape[1]
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    run = (kj * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _step():
+        seg_col = (jnp.transpose(seg_q_ref[...])             # (bq, 1)
+                   if seg_q_ref is not None else None)
+        s = _masked_scores(q_ref, k_ref, seg_col, seg_kv_ref, qi, kj,
+                           causal, sm_scale)
+        m_new, l_new, p, alpha = _softmax_update(
+            s, m_ref[:, :1], l_ref[:, :1])
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        acc_ref[...] = alpha * acc_ref[...] + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        final_kj = jnp.minimum((qi * block_q + block_q - 1) // block_k,
+                               n_k - 1)
+    else:
+        final_kj = n_k - 1
+
+    @pl.when(kj == final_kj)
+    def _emit():
+        m = m_ref[:, :1]
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out_ref[0] = (acc_ref[...] / l_safe).astype(out_ref.dtype)
+        lse_ref[...] = jnp.transpose(m + jnp.log(l_safe))    # (1, bq)
+
+
+def _fwd_compact(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
+                 block_k, h, hkv):
+    if pltpu is None:
+        raise NotImplementedError(
+            "FLAGS_flash_compact_stats needs pallas TPU scratch support")
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    if sq % block_q or skv % block_k:
+        raise NotImplementedError(
+            f"flash_attention needs seq lens ({sq}, {skv}) divisible by "
+            f"blocks ({block_q}, {block_k}); pad or use the dense path")
+    n_k = skv // block_k
+    grid = (bh, sq // block_q, n_k)
+    rep = h // hkv
+
+    def kv_index(b, i, j):
+        return ((b // h) * hkv + (b % h) // rep, j, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
+    ]
+    args = [q, k, v]
+    if seg_q is not None:
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, i, j: ((b // h) * hkv + (b % h) // rep,
+                                          0, j)),
+        ]
+        args += [seg_q, seg_kv[:, None, :]]
+        kernel = functools.partial(_fwd_kernel_compact, causal=causal,
+                                   sm_scale=sm_scale, n_k=n_k)
+    else:
+        kernel = functools.partial(
+            lambda qr, kr, vr, o, ls, a, m, l, **kw: _fwd_kernel_compact(
+                qr, kr, vr, None, None, o, ls, a, m, l, **kw),
+            causal=causal, sm_scale=sm_scale, n_k=n_k)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*args)
+    return out, lse
+
+
 def _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k,
-         h=1, hkv=1):
+         h=1, hkv=1, compact=False):
+    if compact:
+        return _fwd_compact(q, k, v, seg_q, seg_kv, causal, sm_scale,
+                            block_q, block_k, h, hkv)
     bh, sq, d = q.shape
     skv = k.shape[1]
     block_q = min(block_q, sq)
@@ -206,8 +348,18 @@ def _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k,
 
 
 # =========================================================== backward kernels
+def _col(ref, compact):
+    """Read a per-q-row stat as a (block_q, 1) column. Replicated layout:
+    ref block (1, bq, 128), column 0. Compact layout: ref block (1, bq)
+    lane row, transposed in-kernel (the Mosaic relayout the flag gates)."""
+    if compact:
+        return jnp.transpose(ref[...])
+    return ref[0][:, :1]
+
+
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   seg_q_ref, seg_kv_ref, dq_ref, *, causal, sm_scale):
+                   seg_q_ref, seg_kv_ref, dq_ref, *, causal, sm_scale,
+                   compact=False):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     block_q, d = _dims(q_ref.shape)
@@ -221,36 +373,26 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32) * sm_scale
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, :1]                              # (bq, 1)
-        delta = delta_ref[0][:, :1]                          # (bq, 1)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kv_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
-        if seg_q_ref is not None:
-            sq_ = seg_q_ref[0][:, :1]
-            sk_ = seg_kv_ref[0]
-            s = jnp.where(sq_ == sk_, s, _NEG_INF)
+        lse = _col(lse_ref, compact)                         # (bq, 1)
+        delta = _col(delta_ref, compact)                     # (bq, 1)
+        seg_col = (_col(seg_q_ref, compact)
+                   if seg_q_ref is not None else None)
+        s = _masked_scores(q_ref, k_ref, seg_col, seg_kv_ref, qi, kj,
+                           causal, sm_scale)
         p = jnp.exp(s - lse)                                 # (bq, bk)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         dq_ref[0] = dq_ref[0] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     seg_q_ref, seg_kv_ref, dk_ref, dv_ref, *, causal,
-                    sm_scale):
+                    sm_scale, compact=False):
     # grid: (b_kv, ki, rep, qj) — dk/dv blocks are revisited across the
     # (rep, qj) sweep (GQA: every query head in the group accumulates
     # into its kv head's gradient)
@@ -270,37 +412,28 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _step():
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32) * sm_scale
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, :1]                              # (bq, 1)
-        delta = delta_ref[0][:, :1]                          # (bq, 1)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = qj * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kv_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
-        if seg_q_ref is not None:
-            sq_ = seg_q_ref[0][:, :1]
-            sk_ = seg_kv_ref[0]
-            s = jnp.where(sq_ == sk_, s, _NEG_INF)
+        lse = _col(lse_ref, compact)                         # (bq, 1)
+        delta = _col(delta_ref, compact)                     # (bq, 1)
+        seg_col = (_col(seg_q_ref, compact)
+                   if seg_q_ref is not None else None)
+        s = _masked_scores(q_ref, k_ref, seg_col, seg_kv_ref, qj, ki,
+                           causal, sm_scale)
         p = jnp.exp(s - lse)
         dv_ref[0] = dv_ref[0] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         dk_ref[0] = dk_ref[0] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds, q_ref[0].astype(jnp.float32) * sm_scale,
+            (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
 
-def _bwd(causal, sm_scale, block_q, block_k, h, hkv, res, g):
+def _bwd(causal, sm_scale, block_q, block_k, h, hkv, compact, res, g):
     q, k, v, seg_q, seg_kv, out, lse = res
     rep = h // hkv
 
@@ -316,32 +449,41 @@ def _bwd(causal, sm_scale, block_q, block_k, h, hkv, res, g):
                     axis=-1)                               # (bh, sq)
 
     has_seg = seg_q is not None
-    # q-side rows lane-replicated transiently for the kernel boundary;
-    # kv-side ids ride compact as (BH, 1, S) row vectors
-    seg2 = [_rep(seg_q), seg_kv[:, None, :]] if has_seg else []
-    common = [q, k, v, do, _rep(lse), _rep(delta)] + seg2
+    if compact:
+        # stats + q-side ids ride compact (BH, S): (1, bq) lane rows,
+        # transposed in-kernel (no replicated HBM transients at all)
+        stat_spec_dq = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+        seg2 = [seg_q, seg_kv[:, None, :]] if has_seg else []
+        common = [q, k, v, do, lse, delta] + seg2
+    else:
+        # q-side rows lane-replicated transiently for the kernel boundary;
+        # kv-side ids ride compact as (BH, 1, S) row vectors
+        stat_spec_dq = pl.BlockSpec((1, bq, _LANES),
+                                    lambda b, i, j: (b, i, 0))
+        seg2 = [_rep(seg_q), seg_kv[:, None, :]] if has_seg else []
+        common = [q, k, v, do, _rep(lse), _rep(delta)] + seg2
 
     in_specs_dq = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # q
         pl.BlockSpec((1, bk, d), kv_index),                    # k
         pl.BlockSpec((1, bk, d), kv_index),                    # v
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # do
-        pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),  # lse
-        pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),  # delta
+        stat_spec_dq,                                          # lse
+        stat_spec_dq,                                          # delta
     ]
     if has_seg:
         in_specs_dq += [
-            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+            stat_spec_dq,
             pl.BlockSpec((1, 1, bk),
                          lambda b, i, j: ((b // h) * hkv + (b % h) // rep,
                                           0, j))]
         dq_kernel = functools.partial(_bwd_dq_kernel, causal=causal,
-                                      sm_scale=sm_scale)
+                                      sm_scale=sm_scale, compact=compact)
     else:
         dq_kernel = functools.partial(
             lambda qr, kr, vr, dor, lr, der, dqr, **kw: _bwd_dq_kernel(
                 qr, kr, vr, dor, lr, der, None, None, dqr, **kw),
-            causal=causal, sm_scale=sm_scale)
+            causal=causal, sm_scale=sm_scale, compact=compact)
 
     dq = pl.pallas_call(
         dq_kernel, grid=(bh, sq // bq, skv // bk),
@@ -358,28 +500,32 @@ def _bwd(causal, sm_scale, block_q, block_k, h, hkv, res, g):
     def q_index(b, i, r, j):
         return ((b // hkv) * h + (b % hkv) * rep + r, j, 0)
 
+    if compact:
+        stat_spec_dkv = pl.BlockSpec(
+            (1, bq), lambda b, i, r, j: q_index(b, i, r, j)[:2])
+    else:
+        stat_spec_dkv = pl.BlockSpec(
+            (1, bq, _LANES), lambda b, i, r, j: q_index(b, i, r, j))
+
     in_specs_dkv = [
         pl.BlockSpec((1, bq, d), q_index),                     # q
         pl.BlockSpec((1, bk, d), lambda b, i, r, j: (b, i, 0)),  # k
         pl.BlockSpec((1, bk, d), lambda b, i, r, j: (b, i, 0)),  # v
         pl.BlockSpec((1, bq, d), q_index),                     # do
-        pl.BlockSpec((1, bq, _LANES),
-                     lambda b, i, r, j: q_index(b, i, r, j)),  # lse
-        pl.BlockSpec((1, bq, _LANES),
-                     lambda b, i, r, j: q_index(b, i, r, j)),  # delta
+        stat_spec_dkv,                                         # lse
+        stat_spec_dkv,                                         # delta
     ]
     if has_seg:
         in_specs_dkv += [
-            pl.BlockSpec((1, bq, _LANES),
-                         lambda b, i, r, j: q_index(b, i, r, j)),
+            stat_spec_dkv,
             pl.BlockSpec((1, 1, bk), lambda b, i, r, j: (b, 0, i))]
         dkv_kernel = functools.partial(_bwd_dkv_kernel, causal=causal,
-                                       sm_scale=sm_scale)
+                                       sm_scale=sm_scale, compact=compact)
     else:
         dkv_kernel = functools.partial(
             lambda qr, kr, vr, dor, lr, der, dkr, dvr, **kw: _bwd_dkv_kernel(
                 qr, kr, vr, dor, lr, der, None, None, dkr, dvr, **kw),
-            causal=causal, sm_scale=sm_scale)
+            causal=causal, sm_scale=sm_scale, compact=compact)
 
     bh_kv = k.shape[0]
     dk, dv = pl.pallas_call(
@@ -397,18 +543,22 @@ def _bwd(causal, sm_scale, block_q, block_k, h, hkv, res, g):
 
 
 # ============================================================== public entry
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+# ``compact`` is a STATIC custom_vjp argument, not read from the flag
+# inside _fwd/_bwd: jax caches custom_vjp traces process-wide keyed on the
+# static args, so a trace-time flag read would make whichever layout
+# traced first sticky for every later call with the same shapes.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
 def _flash_attention(q, k, v, seg_q, seg_kv, causal, sm_scale,
-                     block_q, block_k, h, hkv):
+                     block_q, block_k, h, hkv, compact):
     out, _ = _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
-                  block_k, h, hkv)
+                  block_k, h, hkv, compact)
     return out
 
 
 def _flash_fwd_rule(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
-                    block_k, h, hkv):
+                    block_k, h, hkv, compact):
     out, lse = _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
-                    block_k, h, hkv)
+                    block_k, h, hkv, compact)
     return out, (q, k, v, seg_q, seg_kv, out, lse)
 
 
@@ -448,7 +598,7 @@ def flash_attention(q, k, v, segment_ids: Optional[jax.Array] = None,
         kv_segment_ids = segment_ids
     return _flash_attention(q, k, v, segment_ids, kv_segment_ids,
                             causal, sm_scale, block_q, block_k,
-                            n_heads, n_kv_heads)
+                            n_heads, n_kv_heads, _compact())
 
 
 def flash_attention_bshd(q, k, v, segment_ids=None, kv_segment_ids=None,
