@@ -47,6 +47,45 @@ def _active_mesh():
     return hcg.get_mesh() if hcg is not None else None
 
 
+def _manual_axis(axis: str) -> bool:
+    """True when ``axis`` is a MANUAL axis of the current trace context
+    (inside a shard_map manual over it, e.g. the zbh1 engine). GSPMD
+    constraints don't apply there — the TP layers switch to explicit
+    collectives, the shard_map idiom."""
+    cur = jax.sharding.get_abstract_mesh()
+    return axis in set(getattr(cur, "manual_axes", ()) or ())
+
+
+def _mp_copy(x, axis: str):
+    """Megatron's ``f``: identity forward, psum backward — marks the point
+    where a replicated activation fans out into column-sharded compute, so
+    the partial input-grads of the local matmuls sum to the true dx. Only
+    meaningful under MANUAL mp (check_vma=False shard_map: no automatic
+    transpose collectives)."""
+
+    @jax.custom_vjp
+    def f(v):
+        return v
+
+    f.defvjp(lambda v: (v, None),
+             lambda _, g: (jax.lax.psum(g, axis),))
+    return apply_op("mp_copy", f, x)
+
+
+def _mp_reduce(x, axis: str):
+    """Megatron's ``g``: psum forward, identity backward — the row-parallel
+    output reduction; the replicated cotangent flows straight to each
+    member's partial product."""
+
+    @jax.custom_vjp
+    def f(v):
+        return jax.lax.psum(v, axis)
+
+    f.defvjp(lambda v: (jax.lax.psum(v, axis), None),
+             lambda _, g: (g,))
+    return apply_op("mp_reduce", f, x)
+
+
 def shard_constraint(x, spec: P):
     """Annotate an activation's layout (jax.lax.with_sharding_constraint),
     recorded on the autograd tape; no-op without an active mesh or when the
@@ -55,6 +94,22 @@ def shard_constraint(x, spec: P):
     if mesh is None:
         return x
     sharding = NamedSharding(mesh, spec)
+    cur = jax.sharding.get_abstract_mesh()
+    manual = set(getattr(cur, "manual_axes", ()) or ())
+    if manual:
+        # inside a (partial-)manual shard_map region (e.g. the zbh1 pp
+        # engine): constraints must be built on the trace's abstract mesh,
+        # whose axis types mark the manual axes — the stored concrete mesh
+        # is all-Auto and jax rejects it. Specs touching a manual axis
+        # cannot be constrained from inside; skip those.
+        flat = set()
+        for entry in spec:
+            if entry is None:
+                continue
+            flat.update(entry if isinstance(entry, tuple) else (entry,))
+        if flat & manual:
+            return x
+        sharding = NamedSharding(cur, spec)
     val = x._value if isinstance(x, Tensor) else x
     for dim, entry in enumerate(spec):
         if entry is None:
@@ -94,6 +149,22 @@ class VocabParallelEmbedding(Layer):
         self.weight.dist_attr = P(self.axis, None)
 
     def forward(self, x):
+        if self.world_size > 1 and _manual_axis(self.axis):
+            # manual mp: the bound weight is the LOCAL vocab shard —
+            # masked local lookup, then the g-reduction (psum fwd,
+            # identity bwd: each member's local dW comes from its own
+            # shard's rows only)
+            def fn(ids, w):
+                local_v = w.shape[0]
+                r = jax.lax.axis_index(self.axis)
+                loc = ids - r * local_v
+                valid = (loc >= 0) & (loc < local_v)
+                out = jnp.take(w, jnp.clip(loc, 0, local_v - 1), axis=0)
+                return out * valid[..., None].astype(out.dtype)
+
+            out = apply_op("vocab_parallel_embedding_manual", fn,
+                           x, self.weight)
+            return _mp_reduce(out, self.axis)
         out = F.embedding(x, self.weight)
         return out
 
@@ -132,6 +203,18 @@ class ColumnParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        if self.world_size > 1 and _manual_axis(self.axis):
+            # manual mp: weight/bias are LOCAL out-dim shards; the f-copy
+            # makes the local matmuls' partial dx sum to the true dx;
+            # gather_output all-gathers the out dim
+            x = _mp_copy(x, self.axis)
+            out = F.linear(x, self.weight, self.bias)
+            if self.gather_output:
+                out = apply_op(
+                    "mp_allgather",
+                    lambda v: jax.lax.all_gather(
+                        v, self.axis, axis=v.ndim - 1, tiled=True), out)
+            return out
         out = F.linear(x, self.weight, self.bias)
         if not self.gather_output:
             # leave the out dim sharded: the consumer (RowParallelLinear)
@@ -174,6 +257,24 @@ class RowParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        if self.world_size > 1 and _manual_axis(self.axis):
+            # manual mp: local partial product, g-reduction (psum fwd,
+            # identity bwd), then the replicated bias exactly once. A
+            # replicated (non-parallel) input is sliced to this member's
+            # in-dim shard first — the GSPMD path's split constraint,
+            # done explicitly.
+            if not self.input_is_parallel:
+                def split_in(v):
+                    local_in = self._in_features // self.world_size
+                    r = jax.lax.axis_index(self.axis)
+                    return jax.lax.dynamic_slice_in_dim(
+                        v, r * local_in, local_in, axis=v.ndim - 1)
+                x = apply_op("mp_split_in", split_in, x)
+            out = F.linear(x, self.weight)
+            out = _mp_reduce(out, self.axis)
+            if self.bias is not None:
+                out = out + self.bias
+            return out
         if self.input_is_parallel:
             spec = [None] * (len(x.shape) - 1) + [self.axis]
             x = shard_constraint(x, P(*spec))
@@ -200,6 +301,9 @@ class ParallelCrossEntropy(Layer):
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
+        if self.world_size > 1 and _manual_axis(self.axis):
+            return self._forward_manual(input, label)
+
         def ce(logits, lab):
             logits = logits.astype(jnp.float32)
             lse = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=False)
@@ -216,3 +320,62 @@ class ParallelCrossEntropy(Layer):
             return jnp.where(mask, loss, 0.0)[..., None]
 
         return apply_op("parallel_cross_entropy", ce, input, label)
+
+    def _forward_manual(self, input, label):
+        """Manual mp: the reference's c_softmax_with_cross_entropy,
+        explicitly — local max / pmax, shifted local sum(exp) / psum,
+        masked local label pick / psum. The backward is the analytic
+        (softmax_local - onehot_local) * ct, a custom_vjp: the builtin
+        collective transposes (psum^T = psum) would double-count under
+        the engine's local-grad check_vma=False contract."""
+        axis = self.axis
+        ignore = self.ignore_index
+        world = self.world_size
+
+        def stats(logits, lab):
+            local_v = logits.shape[-1]
+            off = jax.lax.axis_index(axis) * local_v
+            m = jax.lax.pmax(jnp.max(logits, axis=-1), axis)     # global max
+            sumexp = jax.lax.psum(
+                jnp.sum(jnp.exp(logits - m[..., None]), axis=-1), axis)
+            lse = m + jnp.log(sumexp)
+            loc = lab - off
+            mine = (loc >= 0) & (loc < local_v)
+            loc_c = jnp.clip(loc, 0, local_v - 1)
+            return lse, loc_c, mine
+
+        def loss_of(logits, lab, lse, loc_c, mine):
+            picked_local = jnp.take_along_axis(
+                logits, loc_c[..., None], axis=-1)[..., 0]
+            picked = jax.lax.psum(
+                jnp.where(mine, picked_local, 0.0), axis)
+            loss = lse - picked
+            invalid = (lab < 0) | (lab >= logits.shape[-1] * world)
+            loss = jnp.where(invalid, jnp.nan, loss)
+            return jnp.where(lab != ignore, loss, 0.0)[..., None]
+
+        @jax.custom_vjp
+        def ce(logits, lab):
+            logits = logits.astype(jnp.float32)
+            lse, loc_c, mine = stats(logits, lab)
+            return loss_of(logits, lab, lse, loc_c, mine)
+
+        def ce_fwd(logits, lab):
+            logits = logits.astype(jnp.float32)
+            lse, loc_c, mine = stats(logits, lab)
+            return (loss_of(logits, lab, lse, loc_c, mine),
+                    (logits, lab, lse, loc_c, mine))
+
+        def ce_bwd(res, g):
+            logits, lab, lse, loc_c, mine = res
+            softmax = jnp.exp(logits - lse[..., None])
+            onehot = (jax.nn.one_hot(loc_c, logits.shape[-1],
+                                     dtype=logits.dtype)
+                      * mine[..., None].astype(logits.dtype))
+            active = ((lab != ignore) & (lab >= 0)
+                      & (lab < logits.shape[-1] * world))
+            ct = g[..., 0] * active.astype(logits.dtype)
+            return ((softmax - onehot) * ct[..., None], None)
+
+        ce.defvjp(ce_fwd, ce_bwd)
+        return apply_op("parallel_cross_entropy_manual", ce, input, label)

@@ -167,22 +167,17 @@ class PipelineTrainStep:
                     "schedule instead")
             if virtual_pp_degree != 1:
                 raise NotImplementedError("zbh1 + interleaved VPP")
-            if set(mesh.axis_names) - {"pp", "dp"}:
+            eff_level = (sharding_level
+                         or getattr(optimizer, "_group_sharded_level", 0)
+                         or getattr(pipe_layer, "_group_sharded_level", 0)
+                         or 0)
+            if eff_level and int(eff_level) >= 3:
                 raise NotImplementedError(
-                    "zbh1 runs on a pp or pp x dp mesh (per-stage "
-                    "divergent execution via shard_map); mp/sharding "
-                    "composition uses schedule='auto'")
-            if pipe_layer.shared_layers:
-                raise NotImplementedError(
-                    "zbh1 v1 does not support tied (shared) layers — the "
-                    "tied weight would need cross-phase gradient routing")
-            if (sharding_level
-                    or getattr(optimizer, "_group_sharded_level", 0)
-                    or getattr(pipe_layer, "_group_sharded_level", 0)):
-                raise NotImplementedError(
-                    "zbh1 + ZeRO sharding: the manual shard_map region "
-                    "would all-gather the dp-sharded state every step; "
-                    "use schedule='auto' for sharding compositions")
+                    "zbh1 + ZeRO stage 3: dp-sharded PARAMS would be "
+                    "all-gathered at shard_map entry with no GSPMD "
+                    "control over placement; levels 1/2 compose (the "
+                    "optimizer update and grad resharding run outside "
+                    "the manual region), or use schedule='auto'")
         self.S = mesh.shape["pp"]
         self.M = int(num_microbatches)
         self.V = int(virtual_pp_degree)
@@ -307,6 +302,14 @@ class PipelineTrainStep:
         if axis is None:
             level = 0
         self.sharding_level, self.sharding_axis = level, axis
+        if schedule == "zbh1" and level and axis != "dp":
+            # the zero-bubble engine composes ZeRO only over the dp axis
+            # (the manual data axis its pmean runs on); fail here, not at
+            # first-step trace with an opaque mesh-axis error
+            raise NotImplementedError(
+                f"zbh1 + ZeRO over axis {axis!r}: the zero-bubble engine "
+                "shards optimizer state over 'dp' only — use a dp axis "
+                "for sharding or schedule='auto'")
 
         if level >= 3:
             specs = {k: extend_spec_with_sharding(
@@ -526,19 +529,30 @@ class PipelineTrainStep:
         template = self.template
         prefix_entries, suffix_entries = self._prefix, self._suffix
 
+        # tied/shared layers: their params live at the OWNER index; both
+        # phases read them, so they ride as a third replicated group with
+        # cross-phase gradient routing inside the zbh1 kernel
+        shared_keys = [
+            f"{self._shared_owner[key]}.{rel}"
+            for key, layer in self.pipe_layer.shared_layers.items()
+            for rel, _ in layer.named_parameters()]
+
         def entry_keys(entries):
             return [f"{idx}.{rel}" for idx, e in entries
                     if isinstance(e, Layer)
-                    for rel, _ in e.named_parameters()]
+                    for rel, _ in e.named_parameters()
+                    if f"{idx}.{rel}" not in shared_keys]
 
         prefix_keys = entry_keys(prefix_entries)
         suffix_keys = entry_keys(suffix_entries)
 
-        def prefix_apply(prefix_params, ids_mb):
-            return run_entries(prefix_entries, prefix_params, ids_mb)
+        def prefix_apply(prefix_params, shared_params, ids_mb):
+            return run_entries(prefix_entries,
+                               {**prefix_params, **shared_params}, ids_mb)
 
-        def suffix_loss(suffix_params, y_mb, labels_mb):
-            out = run_entries(suffix_entries, suffix_params, y_mb)
+        def suffix_loss(suffix_params, shared_params, y_mb, labels_mb):
+            out = run_entries(suffix_entries,
+                              {**suffix_params, **shared_params}, y_mb)
             with autograd.functional_guard():
                 loss = loss_fn(*tree_to_tensors((out, labels_mb)))
             return tree_to_values(loss)
@@ -557,22 +571,39 @@ class PipelineTrainStep:
                     f"degree {dp_size}")
             pre = {k: params[k] for k in prefix_keys}
             suf = {k: params[k] for k in suffix_keys}
+            shr = {k: params[k] for k in shared_keys}
             stacked = tuple(params[_STACK_PREFIX + rel]
                             for rel in block_rels)
             # act shape is per-dp-shard inside the manual region
             local_in = (x.shape[1] // dp_size,) + x.shape[2:]
             act_sds = jax.eval_shape(
-                prefix_apply, pre,
+                prefix_apply, pre, shr,
                 jax.ShapeDtypeStruct(local_in, x.dtype))
             zfn = build_zbh1_loss_and_grads(
                 mesh, S, M, block_rels, template,
                 prefix_apply, suffix_loss, act_sds, remat=remat,
-                dp_axis=dp_axis)
-            loss, dWt, dPre, dSuf = zfn(stacked, pre, suf, x, lab)
+                dp_axis=dp_axis,
+                stacked_specs=[
+                    self.param_shardings[_STACK_PREFIX + rel].spec
+                    for rel in block_rels],
+                pre_specs={k: self.param_shardings[k].spec
+                           for k in prefix_keys},
+                suf_specs={k: self.param_shardings[k].spec
+                           for k in suffix_keys},
+                shr_specs={k: self.param_shardings[k].spec
+                           for k in shared_keys})
+            loss, dWt, dPre, dSuf, dShr = zfn(stacked, pre, suf, shr,
+                                              x, lab)
             grads = {_STACK_PREFIX + rel: dWt[i]
                      for i, rel in enumerate(block_rels)}
             grads.update(dPre)
             grads.update(dSuf)
+            grads.update(dShr)
+            if self.sharding_level and self.sharding_level >= 2:
+                # ZeRO-2: grads live dp-sharded from here on (the reshard
+                # happens OUTSIDE the manual region, like the auto path)
+                grads = {k: jax.lax.with_sharding_constraint(
+                    g, self.opt_shardings[k]) for k, g in grads.items()}
             new_params, new_state = optimizer.functional_update(
                 params, grads, opt_state, lr)
             # keep output layouts identical to inputs (donation + steady

@@ -43,7 +43,30 @@ program. The payoff is scheduling freedom: steady-state ticks cost
 masked slots, so wall-clock beats lockstep once the bubble fraction
 (S-1)/(M+S-1) outweighs the extra recompute.
 
-v1 scope: mesh with only a "pp" axis, V == 1, no ZeRO composition.
+Composition (round 4 lifts the v1 scope):
+  - tied/shared layers: the tied weights ride as a third replicated param
+    group ``shared_params`` visible to BOTH phases; stage 0 accumulates
+    the prefix-side contribution (in W's deferred prefix backward) and
+    stage S-1 the suffix-side one (in B's loss vjp), summed by the final
+    masked psum — the cross-phase gradient routing the reference's shared
+    comm group performs with an allreduce.
+  - mp (tensor parallel): the shard_map is manual over the WHOLE mesh
+    (check_vma=False) — GSPMD-auto collectives inside the divergent
+    lax.cond units are unsound (stages take different branches,
+    desynchronizing compiler-inserted collectives; observed as an XLA
+    rendezvous deadlock). The TP layers detect manual mp via
+    ``_manual_axis()`` and switch to explicit Megatron f/g collectives
+    (mp_layers._mp_copy/_mp_reduce), which ARE sound inside units:
+    every member of an mp group shares its pp stage and hence its
+    branch. A NEW TP layer must get the same treatment — GSPMD will
+    not handle it here.
+  - ZeRO: levels 1/2 (optimizer-state / gradient sharding) compose — the
+    functional optimizer update and the grad resharding happen OUTSIDE
+    the manual region. Level 3 (param sharding) stays rejected: P()
+    in_specs would all-gather the full parameter state at shard_map
+    entry every step with no GSPMD control over the gather's placement.
+
+Remaining v1 scope: V == 1 (no interleaved VPP), no abstract lowering.
 """
 
 from __future__ import annotations
@@ -127,19 +150,53 @@ def build_zbh1_loss_and_grads(
         mesh: Mesh, S: int, M: int,
         block_rels: List[str],
         template,
-        prefix_apply: Callable,      # (prefix_params, ids_mb) -> x
-        suffix_loss: Callable,       # (suffix_params, y_mb, labels_mb) -> loss
+        prefix_apply: Callable,   # (prefix_params, shared_params, ids) -> x
+        suffix_loss: Callable,    # (suffix_params, shared_params, y, lab) -> l
         act_sds: jax.ShapeDtypeStruct,
         remat: bool = True,
-        dp_axis: str = None):
-    """Returns f(stacked_tuple, prefix_params, suffix_params, ids, labels)
-    -> (loss, stacked_grads_tuple, prefix_grads, suffix_grads). ids/labels
+        dp_axis: str = None,
+        stacked_specs=None,          # per-block_rel P, e.g. P('pp',None,'mp')
+        pre_specs=None, suf_specs=None, shr_specs=None):
+    """Returns f(stacked_tuple, prefix_params, suffix_params, shared_params,
+    ids, labels) -> (loss, stacked_grads_tuple, prefix_grads, suffix_grads,
+    shared_grads). ``shared_params``: tied weights read by both phases
+    (empty dict when none) — their gradient sums the stage-0 prefix-side
+    and stage-(S-1) suffix-side contributions. ids/labels
     are (M, mb, ...); stacked leaves are (S, L, ...) pp-sharded. With
     ``dp_axis`` the microbatch dim is additionally dp-sharded (params
     replicated over dp): loss and grads are pmean'd over dp — standard
     data parallelism composed INSIDE the manual region, so the pp ring
     stays per-dp-slice and the dp reduction is one collective at the
     end. ``act_sds`` must describe the LOCAL (per-dp-shard) activation."""
+
+    if stacked_specs is None:
+        stacked_specs = [P("pp") for _ in block_rels]
+    pre_specs = pre_specs or {}
+    suf_specs = suf_specs or {}
+    shr_specs = shr_specs or {}
+
+    def spec_axes(spec):
+        out = set()
+        for entry in spec:
+            if entry is None:
+                continue
+            out.update(entry if isinstance(entry, tuple) else (entry,))
+        return out
+
+    # tensor-parallel (and any other) axes named by param specs become
+    # MANUAL axes of the engine: GSPMD-auto collectives inside divergent
+    # lax.cond units are unsound (different pp stages take different
+    # branches, desynchronizing the compiler-inserted collective schedule
+    # — observed as an XLA rendezvous deadlock), while explicit TP
+    # collectives are sound because every member of an mp group shares
+    # its stage and therefore its branch. The TP layers switch to their
+    # explicit-collective path via _manual_axis().
+    tp_axes = set()
+    for sp in list(stacked_specs) + list(pre_specs.values()) \
+            + list(suf_specs.values()) + list(shr_specs.values()):
+        tp_axes |= spec_axes(sp)
+    tp_axes -= {"pp", dp_axis}
+    tp_axes = tuple(sorted(tp_axes))
 
     Ft, Bt, Wt = zbh1_schedule(S, M)
     sf_tab, sb_tab = _stash_tables(Ft, Bt, S)
@@ -149,7 +206,19 @@ def build_zbh1_loss_and_grads(
     from .pipeline_parallel import make_stage_fn
     stage_fn = make_stage_fn(template, block_rels, remat)
 
-    def kernel(stacked, prefix_params, suffix_params, ids, labels):
+    # axes the kernel is manual over — every per-stage value varies on
+    # them (vma); cond branches and the scan carry must agree on this
+    vary_axes = ("pp",) + ((dp_axis,) if dp_axis else ()) + tp_axes
+
+    def _vary(x):
+        """Promote x to varying over the engine's manual axes (idempotent
+        per axis) — cond branches and the scan carry must agree on vma."""
+        missing = tuple(a for a in vary_axes
+                        if a not in jax.typeof(x).vma)
+        return jax.lax.pcast(x, missing, to="varying") if missing else x
+
+    def kernel(stacked, prefix_params, suffix_params, shared_params,
+               ids, labels):
         local = tuple(a[0] for a in stacked)     # drop the stage dim
         s_idx = jax.lax.axis_index("pp")
         is_first = s_idx == 0
@@ -166,14 +235,17 @@ def build_zbh1_loss_and_grads(
         f32z = lambda tree: jax.tree.map(
             lambda a: jnp.zeros(a.shape, jnp.float32), tree)
         dW, dPre, dSuf = f32z(local), f32z(prefix_params), f32z(suffix_params)
+        # tied-weight grads, accumulated on different stages per phase
+        dShrP, dShrS = f32z(shared_params), f32z(shared_params)
 
         def f_unit(op):
             m, X, Y, up = op
 
             def from_prefix(m):
-                return prefix_apply(
-                    prefix_params, jax.lax.dynamic_index_in_dim(
-                        ids, m, 0, keepdims=False)).astype(up.dtype)
+                return _vary(prefix_apply(
+                    prefix_params, shared_params,
+                    jax.lax.dynamic_index_in_dim(
+                        ids, m, 0, keepdims=False)).astype(up.dtype))
 
             def from_stash(m):
                 return jax.lax.dynamic_index_in_dim(X, m, 0, keepdims=False)
@@ -185,39 +257,47 @@ def build_zbh1_loss_and_grads(
             return X, Y, y
 
         def b_unit(op):
-            m, X, Y, G, loss_acc, dSuf, DX0 = op
+            m, X, Y, G, loss_acc, dSuf, dShrS, DX0 = op
             x = jax.lax.dynamic_index_in_dim(X, m, 0, keepdims=False)
 
             def seed_from_loss(op2):
-                y, lab, dSuf = op2
-                # seed 1/M scales both dSuf and g so the sum is the mean
+                y, lab, dSuf, dShrS = op2
+                # seed 1/M scales dSuf/dShrS and g so the sum is the mean
                 lval, both_vjp = jax.vjp(
-                    lambda sp, yy: suffix_loss(sp, yy, lab),
-                    suffix_params, y)
-                dsuf_m, g = both_vjp(jnp.ones((), lval.dtype) / M)
+                    lambda sp, sh, yy: suffix_loss(sp, sh, yy, lab),
+                    suffix_params, shared_params, y)
+                # the cotangent must carry lval's vma (varying over the
+                # manual axes when check_vma=True) — derive it from lval;
+                # the value is exactly 1/M: seed scales dSuf/dShrS and g
+                # so the sum over microbatches is the mean
+                dsuf_m, dshr_m, g = both_vjp((lval * 0 + 1) / M)
                 dSuf = jax.tree.map(lambda a, d: a + d.astype(a.dtype),
                                     dSuf, dsuf_m)
-                return g.astype(x.dtype), lval.astype(jnp.float32), dSuf
+                dShrS = jax.tree.map(lambda a, d: a + d.astype(a.dtype),
+                                     dShrS, dshr_m)
+                return (g.astype(x.dtype), lval.astype(jnp.float32), dSuf,
+                        dShrS)
 
             def seed_from_ring(op2):
-                y, lab, dSuf = op2
+                y, lab, dSuf, dShrS = op2
                 g = jax.lax.dynamic_index_in_dim(G, m, 0, keepdims=False)
-                return g, jnp.zeros((), jnp.float32), dSuf
+                return g, _vary(jnp.zeros((), jnp.float32)), dSuf, dShrS
 
             y_m = jax.lax.dynamic_index_in_dim(Y, m, 0, keepdims=False)
             lab_m = jax.lax.dynamic_index_in_dim(labels, m, 0,
                                                  keepdims=False)
-            g, lval, dSuf = jax.lax.cond(
-                is_last, seed_from_loss, seed_from_ring, (y_m, lab_m, dSuf))
+            g, lval, dSuf, dShrS = jax.lax.cond(
+                is_last, seed_from_loss, seed_from_ring,
+                (y_m, lab_m, dSuf, dShrS))
             loss_acc = loss_acc + lval / M
             G = jax.lax.dynamic_update_index_in_dim(G, g, m, 0)
             _, x_vjp = jax.vjp(lambda xx: stage_fn(local, xx), x)
             (dx,) = x_vjp(g)
             DX0 = _masked_store(DX0, m, dx, is_first)
-            return G, loss_acc, dSuf, DX0, dx
+            return G, loss_acc, dSuf, dShrS, DX0, dx
 
         def w_unit(op):
-            m, X, G, DX0, dW, dPre = op
+            m, X, G, DX0, dW, dPre, dShrP = op
             x = jax.lax.dynamic_index_in_dim(X, m, 0, keepdims=False)
             g = jax.lax.dynamic_index_in_dim(G, m, 0, keepdims=False)
             _, p_vjp = jax.vjp(lambda lp: stage_fn(lp, x), local)
@@ -225,24 +305,27 @@ def build_zbh1_loss_and_grads(
             dW = jax.tree.map(lambda a, d: a + d.astype(a.dtype), dW, dw_m)
 
             def prefix_bwd(op2):
-                dPre, = op2
+                dPre, dShrP = op2
                 dxin = jax.lax.dynamic_index_in_dim(DX0, m, 0,
                                                     keepdims=False)
                 _, pre_vjp = jax.vjp(
-                    lambda pp: prefix_apply(
-                        pp, jax.lax.dynamic_index_in_dim(
+                    lambda pp, sh: prefix_apply(
+                        pp, sh, jax.lax.dynamic_index_in_dim(
                             ids, m, 0, keepdims=False)).astype(dxin.dtype),
-                    prefix_params)
-                (dpre_m,) = pre_vjp(dxin)
+                    prefix_params, shared_params)
+                dpre_m, dshr_m = pre_vjp(dxin)
                 return (jax.tree.map(lambda a, d: a + d.astype(a.dtype),
-                                     dPre, dpre_m),)
+                                     dPre, dpre_m),
+                        jax.tree.map(lambda a, d: a + d.astype(a.dtype),
+                                     dShrP, dshr_m))
 
-            (dPre,) = jax.lax.cond(is_first, prefix_bwd,
-                                   lambda op2: op2, (dPre,))
-            return dW, dPre
+            dPre, dShrP = jax.lax.cond(is_first, prefix_bwd,
+                                       lambda op2: op2, (dPre, dShrP))
+            return dW, dPre, dShrP
 
         def tick(carry, xs):
-            (X, Y, G, DX0, up, dn, loss_acc, dW, dPre, dSuf) = carry
+            (X, Y, G, DX0, up, dn, loss_acc,
+             dW, dPre, dSuf, dShrP, dShrS) = carry
             rf, rb, rw, sf, sb = xs
             pick = lambda row: row[s_idx]
             mf, mb_, mw = pick(rf), pick(rb), pick(rw)
@@ -255,33 +338,43 @@ def build_zbh1_loss_and_grads(
                 lambda op: (op[1], op[2], jnp.zeros_like(op[3])),
                 (jnp.maximum(mf, 0), X, Y, up))
 
-            G, loss_acc, dSuf, DX0, dx_out = jax.lax.cond(
+            G, loss_acc, dSuf, dShrS, DX0, dx_out = jax.lax.cond(
                 mb_ >= 0, b_unit,
-                lambda op: (op[3], op[4], op[5], op[6],
+                lambda op: (op[3], op[4], op[5], op[6], op[7],
                             jnp.zeros_like(up)),
-                (jnp.maximum(mb_, 0), X, Y, G, loss_acc, dSuf, DX0))
+                (jnp.maximum(mb_, 0), X, Y, G, loss_acc, dSuf, dShrS, DX0))
 
-            dW, dPre = jax.lax.cond(
-                mw >= 0, w_unit, lambda op: (op[4], op[5]),
-                (jnp.maximum(mw, 0), X, G, DX0, dW, dPre))
+            dW, dPre, dShrP = jax.lax.cond(
+                mw >= 0, w_unit, lambda op: (op[4], op[5], op[6]),
+                (jnp.maximum(mw, 0), X, G, DX0, dW, dPre, dShrP))
 
             up = jax.lax.ppermute(y_out, "pp", ring_up)
             dn = jax.lax.ppermute(dx_out, "pp", ring_dn)
-            return (X, Y, G, DX0, up, dn, loss_acc, dW, dPre, dSuf), None
+            return (X, Y, G, DX0, up, dn, loss_acc,
+                    dW, dPre, dSuf, dShrP, dShrS), None
 
-        carry = (X, Y, G, DX0, up, dn, loss_acc, dW, dPre, dSuf)
-        carry = jax.tree.map(
-            lambda a: jax.lax.pcast(a, ("pp",), to="varying"), carry)
+        carry = (X, Y, G, DX0, up, dn, loss_acc,
+                 dW, dPre, dSuf, dShrP, dShrS)
+        carry = jax.tree.map(_vary, carry)
         carry, _ = jax.lax.scan(
             tick, carry,
             tuple(jnp.asarray(t) for t in (Ft, Bt, Wt, sf_tab, sb_tab)))
-        (X, Y, G, DX0, up, dn, loss_acc, dW, dPre, dSuf) = carry
+        (X, Y, G, DX0, up, dn, loss_acc,
+         dW, dPre, dSuf, dShrP, dShrS) = carry
 
         loss = jax.lax.psum(jnp.where(is_last, loss_acc, 0.0), "pp")
         dPre = jax.tree.map(lambda a: jax.lax.psum(
             jnp.where(is_first, a, jnp.zeros_like(a)), "pp"), dPre)
         dSuf = jax.tree.map(lambda a: jax.lax.psum(
             jnp.where(is_last, a, jnp.zeros_like(a)), "pp"), dSuf)
+        # tied weights: prefix-side contribution lives on stage 0, the
+        # suffix-side one on stage S-1 — one masked psum sums both (and
+        # both land on the same device when S == 1)
+        dShr = jax.tree.map(
+            lambda ap, as_: jax.lax.psum(
+                jnp.where(is_first, ap, jnp.zeros_like(ap))
+                + jnp.where(is_last, as_, jnp.zeros_like(as_)), "pp"),
+            dShrP, dShrS)
         if dp_axis is not None:
             # each dp shard computed the mean loss over ITS tokens; the
             # global mean (and its gradient) is the dp-mean of those
@@ -289,24 +382,68 @@ def build_zbh1_loss_and_grads(
             dW = jax.tree.map(lambda a: jax.lax.pmean(a, dp_axis), dW)
             dPre = jax.tree.map(lambda a: jax.lax.pmean(a, dp_axis), dPre)
             dSuf = jax.tree.map(lambda a: jax.lax.pmean(a, dp_axis), dSuf)
+            dShr = jax.tree.map(lambda a: jax.lax.pmean(a, dp_axis), dShr)
+        if tp_axes:
+            # grads of params NOT sharded over a tp axis are numerically
+            # replicated across it (activations re-replicate at each row
+            # psum); the pmean is an identity that discharges the
+            # varying-axis bookkeeping so P()-style out_specs hold
+            def drop_tp(a, spec):
+                for ax in tp_axes:
+                    if ax not in spec_axes(spec):
+                        a = jax.lax.pmean(a, ax)
+                return a
+            loss = drop_tp(loss, P())
+            dW = tuple(drop_tp(a, sp)
+                       for a, sp in zip(dW, [P(*sp[1:]) for sp in
+                                             stacked_specs]))
+            dPre = {k: drop_tp(a, pre_specs.get(k, P()))
+                    for k, a in dPre.items()}
+            dSuf = {k: drop_tp(a, suf_specs.get(k, P()))
+                    for k, a in dSuf.items()}
+            dShr = {k: drop_tp(a, shr_specs.get(k, P()))
+                    for k, a in dShr.items()}
         dW = jax.tree.map(lambda a: a[None], dW)   # re-add the stage dim
-        return loss, dW, dPre, dSuf
+        return loss, dW, dPre, dSuf, dShr
 
     def loss_and_grads(stacked_tuple, prefix_params, suffix_params,
-                       ids, labels):
+                       shared_params, ids, labels):
         data_spec = P(None, dp_axis) if dp_axis else P()
+
+        def dict_specs(specs, tree):
+            return {k: specs.get(k, P()) for k in tree}
+
         in_specs = (
-            tuple(P("pp") for _ in stacked_tuple),
-            jax.tree.map(lambda _: P(), prefix_params),
-            jax.tree.map(lambda _: P(), suffix_params),
+            tuple(stacked_specs),
+            dict_specs(pre_specs, prefix_params),
+            dict_specs(suf_specs, suffix_params),
+            dict_specs(shr_specs, shared_params),
             data_spec, data_spec)
         out_specs = (
             P(),
-            tuple(P("pp") for _ in stacked_tuple),
-            jax.tree.map(lambda _: P(), prefix_params),
-            jax.tree.map(lambda _: P(), suffix_params))
+            tuple(stacked_specs),
+            dict_specs(pre_specs, prefix_params),
+            dict_specs(suf_specs, suffix_params),
+            dict_specs(shr_specs, shared_params))
+        # manual over the WHOLE mesh with check_vma=False: the engine's
+        # vjp structure computes LOCAL grads inside divergent cond
+        # branches and reduces them with the explicit masked psums at the
+        # end. check_vma=True would auto-insert transpose collectives
+        # INSIDE the divergent branches (unsound — different pp stages
+        # take different branches, observed as an XLA rendezvous
+        # deadlock). The TP layers' manual f/g ops carry the only
+        # collectives that belong inside units, and they are sound
+        # because an mp group shares its stage and hence its branch. Any
+        # extra mesh axes (sharding/sep) must be size 1 here.
+        for ax in set(mesh.axis_names) - {"pp", dp_axis} - set(tp_axes):
+            if mesh.shape[ax] > 1:
+                raise NotImplementedError(
+                    f"zbh1: mesh axis {ax!r} (size {mesh.shape[ax]}) is "
+                    "neither pp/dp nor named by any param spec — the "
+                    "manual engine cannot leave it to GSPMD")
         return jax.shard_map(kernel, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_vma=False)(
-            stacked_tuple, prefix_params, suffix_params, ids, labels)
+            stacked_tuple, prefix_params, suffix_params, shared_params,
+            ids, labels)
 
     return loss_and_grads

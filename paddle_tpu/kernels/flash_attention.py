@@ -207,11 +207,11 @@ def _fwd_kernel_compact(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref,
         lse_ref[...] = jnp.transpose(m + jnp.log(l_safe))    # (1, bq)
 
 
-def _fwd_compact(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
-                 block_k, h, hkv):
-    if pltpu is None:
-        raise NotImplementedError(
-            "FLAGS_flash_compact_stats needs pallas TPU scratch support")
+def _fwd_setup(q, k, block_q, block_k, h, hkv):
+    """Shared fwd-path setup for both stat layouts: block clamping, the
+    divisibility contract (NotImplementedError so the sdpa dispatch can
+    fall back to dense), grid, and the GQA kv index map reading the
+    UNEXPANDED kv at Hkv bandwidth."""
     bh, sq, d = q.shape
     skv = k.shape[1]
     block_q = min(block_q, sq)
@@ -225,20 +225,35 @@ def _fwd_compact(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
     rep = h // hkv
 
     def kv_index(b, i, j):
+        # GQA: query head -> its kv head (identity when hkv == h)
         return ((b // h) * hkv + (b % h) // rep, j, 0)
 
-    in_specs = [
+    def kv_seg_index(b, i, j):
+        return ((b // h) * hkv + (b % h) // rep, 0, j)
+
+    qkv_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_k, d), kv_index),
         pl.BlockSpec((1, block_k, d), kv_index),
     ]
+    return (bh, sq, d, block_q, block_k, n_k, grid, qkv_specs,
+            kv_seg_index)
+
+
+def _fwd_compact(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
+                 block_k, h, hkv):
+    if pltpu is None:
+        raise NotImplementedError(
+            "FLAGS_flash_compact_stats needs pallas TPU scratch support")
+    (bh, sq, d, block_q, block_k, n_k, grid, qkv_specs,
+     kv_seg_index) = _fwd_setup(q, k, block_q, block_k, h, hkv)
+
+    in_specs = list(qkv_specs)
     args = [q, k, v]
     if seg_q is not None:
         in_specs += [
             pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, 1, block_k),
-                         lambda b, i, j: ((b // h) * hkv + (b % h) // rep,
-                                          0, j)),
+            pl.BlockSpec((1, 1, block_k), kv_seg_index),
         ]
         args += [seg_q, seg_kv[:, None, :]]
         kernel = functools.partial(_fwd_kernel_compact, causal=causal,
@@ -276,38 +291,17 @@ def _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k,
     if compact:
         return _fwd_compact(q, k, v, seg_q, seg_kv, causal, sm_scale,
                             block_q, block_k, h, hkv)
-    bh, sq, d = q.shape
-    skv = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, skv)
-    if sq % block_q or skv % block_k:
-        # NotImplementedError (not assert) so the sdpa dispatch falls back
-        # to the dense XLA path for odd sequence lengths
-        raise NotImplementedError(
-            f"flash_attention needs seq lens ({sq}, {skv}) divisible by "
-            f"blocks ({block_q}, {block_k}); pad or use the dense path")
-    grid = (bh, sq // block_q, skv // block_k)
-    rep = h // hkv
+    (bh, sq, d, block_q, block_k, n_k, grid, qkv_specs,
+     kv_seg_index) = _fwd_setup(q, k, block_q, block_k, h, hkv)
 
-    def kv_index(b, i, j):
-        # GQA: query head -> its kv head (identity when hkv == h), so the
-        # UNEXPANDED kv is read at Hkv bandwidth
-        return ((b // h) * hkv + (b % h) // rep, j, 0)
-
-    in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), kv_index),
-        pl.BlockSpec((1, block_k, d), kv_index),
-    ]
+    in_specs = list(qkv_specs)
     args = [q, k, v]
     if seg_q is not None:
         # q-side ids lane-replicated (column orientation, no transpose);
         # kv-side ids compact (BH, 1, S) row vectors
         in_specs += [
             pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_k),
-                         lambda b, i, j: ((b // h) * hkv + (b % h) // rep,
-                                          0, j)),
+            pl.BlockSpec((1, 1, block_k), kv_seg_index),
         ]
         args += [_rep(seg_q), seg_kv[:, None, :]]
         kernel = functools.partial(_fwd_kernel, causal=causal,

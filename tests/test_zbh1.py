@@ -100,16 +100,14 @@ class TestZBH1Parity:
                                        err_msg=f"step {i}")
 
     def test_scope_validation(self):
-        from jax.sharding import Mesh
-
+        """Remaining v1 scope: interleaved VPP and ZeRO stage 3 stay
+        rejected (tied layers, mp meshes and ZeRO 1/2 now compose)."""
         cfg = self._cfg()
         pipe = self._build(cfg, seed=1)
-        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
-                    ("mp", "pp"))
-        with pytest.raises(NotImplementedError, match="pp x dp"):
+        with pytest.raises(NotImplementedError, match="VPP"):
             PipelineTrainStep(pipe, AdamW(learning_rate=1e-3),
-                              mesh, num_microbatches=4,
-                              schedule="zbh1")
+                              pp_mesh(4), num_microbatches=4,
+                              schedule="zbh1", virtual_pp_degree=2)
 
 
 class TestZBH1WithDP:
@@ -150,7 +148,7 @@ class TestZBH1WithDP:
             np.testing.assert_allclose(float(ls), float(lz), rtol=2e-4,
                                        err_msg=f"step {i}")
 
-    def test_zbh1_rejects_zero_sharding(self):
+    def test_zbh1_rejects_zero3_only(self):
         from jax.sharding import Mesh
 
         cfg = LlamaConfig(vocab_size=64, hidden_size=32,
@@ -161,7 +159,249 @@ class TestZBH1WithDP:
         pipe = LlamaForCausalLMPipe(cfg, num_stages=2)
         mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
                     ("dp", "pp"))
-        with pytest.raises(NotImplementedError, match="ZeRO"):
+        with pytest.raises(NotImplementedError, match="stage 3"):
             PipelineTrainStep(pipe, AdamW(learning_rate=1e-3), mesh,
                               num_microbatches=2, schedule="zbh1",
-                              sharding_level=2)
+                              sharding_level=3)
+
+    def test_pp_dp_zero1_matches_serial(self):
+        """zbh1 + ZeRO-1: optimizer slots dp-sharded, update outside the
+        manual region — numerics unchanged vs serial."""
+        from jax.sharding import Mesh
+
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          num_key_value_heads=2, intermediate_size=64,
+                          max_position_embeddings=32)
+        crit = LlamaPretrainingCriterion(cfg)
+        paddle.seed(12)
+        m_serial = LlamaForCausalLMPipe(cfg, num_stages=2)
+        paddle.seed(12)
+        m_zb = LlamaForCausalLMPipe(cfg, num_stages=2)
+        from paddle_tpu.core.tensor import Tensor
+
+        def loss_fn(out, y):
+            return crit(Tensor(out), Tensor(y))._value
+
+        serial = TrainStep(m_serial, AdamW(learning_rate=1e-3),
+                           loss_fn=loss_fn)
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("dp", "pp"))
+        zb = PipelineTrainStep(m_zb, AdamW(learning_rate=1e-3),
+                               mesh, num_microbatches=2,
+                               schedule="zbh1", sharding_level=1,
+                               sharding_axis="dp")
+        rng = np.random.default_rng(3)
+        x = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        y = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+        for i in range(3):
+            ls = serial(xt, yt)
+            lz = zb(xt, yt)
+            np.testing.assert_allclose(float(ls), float(lz), rtol=2e-4,
+                                       err_msg=f"step {i}")
+
+
+class TestZBH1Tied:
+    """Tied embeddings (GPT: wte shared between embedding and head) under
+    the zero-bubble schedule — the cross-phase gradient routing VERDICT r3
+    item 2 asks for. Parity vs the same pipe run serially."""
+
+    def _cfg(self):
+        from paddle_tpu.models import GPTConfig
+        return GPTConfig(vocab_size=64, hidden_size=32,
+                         num_hidden_layers=4, num_attention_heads=2,
+                         intermediate_size=64,
+                         max_position_embeddings=32,
+                         hidden_dropout_prob=0.0,
+                         tie_word_embeddings=True)
+
+    def _parity(self, mesh, M, steps=3, **kw):
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.models import GPTForCausalLMPipe
+        from paddle_tpu.models.gpt import GPTPretrainingCriterion
+
+        cfg = self._cfg()
+        crit = GPTPretrainingCriterion(cfg)
+
+        def loss_fn(out, y):
+            return crit(Tensor(out), Tensor(y))._value
+
+        paddle.seed(31)
+        m_serial = GPTForCausalLMPipe(cfg, num_stages=2)
+        paddle.seed(31)
+        m_zb = GPTForCausalLMPipe(cfg, num_stages=2)
+        assert m_zb.shared_layers, "config must produce tied layers"
+        serial = TrainStep(m_serial, AdamW(learning_rate=1e-3),
+                           loss_fn=loss_fn)
+        zb = PipelineTrainStep(m_zb, AdamW(learning_rate=1e-3), mesh,
+                               num_microbatches=M, schedule="zbh1",
+                               loss_fn=loss_fn, **kw)
+        rng = np.random.default_rng(2)
+        x = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        y = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+        for i in range(steps):
+            ls = serial(xt, yt)
+            lz = zb(xt, yt)
+            np.testing.assert_allclose(float(ls), float(lz), rtol=3e-4,
+                                       err_msg=f"step {i}")
+
+    def test_tied_pp2_matches_serial(self):
+        self._parity(pp_mesh(2), M=4)
+
+    def test_tied_pp2_dp2_matches_serial(self):
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("dp", "pp"))
+        self._parity(mesh, M=2)
+
+    def test_tied_grads_route_cross_phase(self):
+        """The tied wte grad must include BOTH uses: equal inputs through
+        embedding-only (untied head) vs tied must give different wte
+        updates — i.e. the head contribution is actually routed."""
+        from paddle_tpu.models import GPTForCausalLMPipe
+
+        cfg = self._cfg()
+        paddle.seed(33)
+        m_zb = GPTForCausalLMPipe(cfg, num_stages=2)
+        zb = PipelineTrainStep(m_zb, AdamW(learning_rate=1e-1),
+                               pp_mesh(2), num_microbatches=2,
+                               schedule="zbh1")
+        rng = np.random.default_rng(4)
+        x = rng.integers(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+        y = rng.integers(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+        before = np.asarray(zb.params["0.wte.weight"]).copy()
+        zb(paddle.to_tensor(x), paddle.to_tensor(y))
+        after = np.asarray(zb.params["0.wte.weight"])
+        # rows of wte NOT in the input can only move via the head (tied)
+        unused = sorted(set(range(cfg.vocab_size)) - set(x.reshape(-1)))
+        assert unused, "need unused vocab rows for this check"
+        moved = np.abs(after[unused] - before[unused]).max()
+        assert moved > 0, "head-side tied gradient was dropped"
+
+
+class TestZBH1WithMP:
+    """zbh1 on a pp x mp (x dp) mesh: mp stays GSPMD inside the
+    partial-manual region (VERDICT r3 item 2 composition)."""
+
+    def _parity(self, mesh, M, steps=3):
+        import paddle_tpu.nn as nn
+        from test_hybrid_3axis import TPBlock, Head, _ce
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc, PipelineLayer)
+
+        def build():
+            paddle.seed(41)
+            descs = [LayerDesc(nn.Embedding, 64, 32)]
+            descs += [LayerDesc(TPBlock, 32) for _ in range(4)]
+            descs.append(LayerDesc(Head, 32, 64))
+            return PipelineLayer(descs, num_stages=2, loss_fn=None)
+
+        serial = TrainStep(build(), AdamW(learning_rate=1e-3),
+                           loss_fn=lambda o, y: _ce(o, y))
+        zb = PipelineTrainStep(build(), AdamW(learning_rate=1e-3), mesh,
+                               num_microbatches=M,
+                               loss_fn=lambda o, y: _ce(o, y),
+                               schedule="zbh1")
+        rng = np.random.default_rng(5)
+        x = rng.integers(0, 64, (8, 16)).astype(np.int32)
+        y = rng.integers(0, 64, (8, 16)).astype(np.int32)
+        xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+        for i in range(steps):
+            ls = serial(xt, yt)
+            lz = zb(xt, yt)
+            np.testing.assert_allclose(float(ls), float(lz), rtol=3e-4,
+                                       err_msg=f"step {i}")
+
+    def test_pp2_mp2_matches_serial(self, hcg_pp_mp):
+        self._parity(hcg_pp_mp.get_mesh(), M=2)
+
+    def test_dp2_mp2_pp2_matches_serial(self, hcg_3axis_zb):
+        self._parity(hcg_3axis_zb.get_mesh(), M=2)
+
+
+import pytest as _pytest
+
+
+@_pytest.fixture
+def hcg_pp_mp():
+    from paddle_tpu.distributed.fleet.base_topology import (
+        _reset_hcg, create_hybrid_communicate_group)
+    _reset_hcg()
+    hcg = create_hybrid_communicate_group(mp_degree=2, pp_degree=2)
+    yield hcg
+    _reset_hcg()
+
+
+@_pytest.fixture
+def hcg_3axis_zb():
+    from paddle_tpu.distributed.fleet.base_topology import (
+        _reset_hcg, create_hybrid_communicate_group)
+    _reset_hcg()
+    hcg = create_hybrid_communicate_group(dp_degree=2, mp_degree=2,
+                                          pp_degree=2)
+    yield hcg
+    _reset_hcg()
+
+
+class TestZBH1ManualTPLayers:
+    """The manual-mp paths of VocabParallelEmbedding / ParallelCrossEntropy
+    (plus Column/Row f/g ops) under the zero-bubble engine: full
+    Megatron-style pipe must match its serial (GSPMD-path) run."""
+
+    def test_vocab_embedding_and_pce_head(self, hcg_pp_mp):
+        import paddle_tpu.nn as nn
+        from test_hybrid_3axis import TPBlock
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.distributed.fleet import (ColumnParallelLinear,
+                                                  ParallelCrossEntropy,
+                                                  VocabParallelEmbedding)
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc, PipelineLayer)
+
+        VOCAB, H = 64, 32
+        pce = ParallelCrossEntropy()
+
+        def loss_fn(out, y):
+            return pce(Tensor(out), Tensor(y)).mean()._value
+
+        def build():
+            paddle.seed(51)
+            descs = [LayerDesc(VocabParallelEmbedding, VOCAB, H)]
+            descs += [LayerDesc(TPBlock, H) for _ in range(2)]
+            descs.append(LayerDesc(nn.LayerNorm, H))
+            descs.append(LayerDesc(ColumnParallelLinear, H, VOCAB,
+                                   gather_output=False, has_bias=False))
+            return PipelineLayer(descs, num_stages=2, loss_fn=None)
+
+        serial = TrainStep(build(), AdamW(learning_rate=1e-3),
+                           loss_fn=loss_fn)
+        zb = PipelineTrainStep(build(), AdamW(learning_rate=1e-3),
+                               hcg_pp_mp.get_mesh(), num_microbatches=2,
+                               loss_fn=loss_fn, schedule="zbh1")
+        rng = np.random.default_rng(7)
+        x = rng.integers(0, VOCAB, (8, 16)).astype(np.int32)
+        y = rng.integers(0, VOCAB, (8, 16)).astype(np.int32)
+        xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+        for i in range(3):
+            ls = serial(xt, yt)
+            lz = zb(xt, yt)
+            np.testing.assert_allclose(float(ls), float(lz), rtol=3e-4,
+                                       err_msg=f"step {i}")
+
+    def test_zbh1_sharding_axis_must_be_dp(self):
+        from jax.sharding import Mesh
+
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          num_key_value_heads=2, intermediate_size=64,
+                          max_position_embeddings=32)
+        paddle.seed(15)
+        pipe = LlamaForCausalLMPipe(cfg, num_stages=2)
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("sharding", "pp"))
+        with pytest.raises(NotImplementedError, match="'dp' only"):
+            PipelineTrainStep(pipe, AdamW(learning_rate=1e-3), mesh,
+                              num_microbatches=2, schedule="zbh1",
+                              sharding_level=1, sharding_axis="sharding")
