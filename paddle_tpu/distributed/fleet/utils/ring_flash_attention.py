@@ -33,6 +33,8 @@ import functools
 import math
 from typing import Callable, Optional
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -42,59 +44,209 @@ _NEG_INF = -1e30
 
 
 # ------------------------------------------------------------ ring attention
+def _chunk_attn(q, k, v, causal, sm_scale, h, hkv):
+    """One ring step's inner attention: (B, Cq, H, D) x (B, Ck, Hkv, D)
+    -> (out (B, Cq, H, D), lse (B, H, Cq)), the mergeable pair. Runs the
+    Pallas flash kernel (O(block) temps, unexpanded GQA kv) whenever the
+    chunk shapes fit its tiling on the current backend; falls back to a
+    dense-with-lse computation otherwise (small test chunks)."""
+    from ....flags import get_flag, is_tpu_backend
+    b, cq, _, d = q.shape
+    ck = k.shape[1]
+    if is_tpu_backend():
+        # Mosaic tiling wants full lane-aligned chunks
+        ok = cq % 128 == 0 and ck % 128 == 0
+    else:
+        # pallas INTERPRET mode cannot run inside a check_vma=True
+        # shard_map (jax hlo_interpreter limitation) — only use it when
+        # the values carry no vma (sep-only meshes run check_vma=False)
+        ok = not jax.typeof(q).vma
+    if get_flag("use_pallas") and ok:
+        from ....kernels.flash_attention import flash_attention_with_lse
+        try:
+            qf = jnp.swapaxes(q, 1, 2).reshape(b * h, cq, d)
+            kf = jnp.swapaxes(k, 1, 2).reshape(b * hkv, ck, d)
+            vf = jnp.swapaxes(v, 1, 2).reshape(b * hkv, ck, d)
+            out, lse = flash_attention_with_lse(
+                qf, kf, vf, causal=causal, sm_scale=sm_scale,
+                n_heads=h, n_kv_heads=hkv)
+            return (jnp.swapaxes(out.reshape(b, h, cq, d), 1, 2),
+                    lse.reshape(b, h, cq))
+        except NotImplementedError:
+            pass
+    rep = h // hkv
+    kx = jnp.repeat(k, rep, axis=2) if rep > 1 else k
+    vx = jnp.repeat(v, rep, axis=2) if rep > 1 else v
+    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * sm_scale
+    kf = jnp.swapaxes(kx, 1, 2).astype(jnp.float32)
+    vf = jnp.swapaxes(vx, 1, 2).astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)
+    if causal:
+        mask = lax.broadcasted_iota(jnp.int32, (cq, ck), 0) >= \
+            lax.broadcasted_iota(jnp.int32, (cq, ck), 1)
+        s = jnp.where(mask, s, _NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)            # (B, H, Cq)
+    out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), vf)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype), lse
+
+
+def _merge(o1, lse1, o2, lse2):
+    """Combine two partial softmax results in log-space: out (B, C, H, D)
+    returned in FLOAT32 (the ring accumulator dtype — per-step casts back
+    to bf16 would compound rounding across the P merges; callers cast
+    once after the scan), lse (B, H, C). Empty partials carry
+    lse = -1e30 and contribute 0."""
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(m <= _NEG_INF / 2, 0.0, m)
+    w1 = jnp.exp(lse1 - m_safe)
+    w2 = jnp.exp(lse2 - m_safe)
+    den = w1 + w2
+    den_safe = jnp.where(den == 0.0, 1.0, den)
+    lse = jnp.where(den == 0.0, _NEG_INF, m_safe + jnp.log(den_safe))
+    wt = lambda w: jnp.swapaxes(w / den_safe, 1, 2)[..., None]
+    return (o1.astype(jnp.float32) * wt(w1)
+            + o2.astype(jnp.float32) * wt(w2)), lse
+
+
+def _empty_partial(b, c, h, d):
+    return (jnp.zeros((b, c, h, d), jnp.float32),
+            jnp.full((b, h, c), _NEG_INF, jnp.float32))
+
+
 def ring_flash_attention(q, k, v, axis_name: str = "sep",
                          causal: bool = True,
-                         sm_scale: Optional[float] = None):
-    """Per-shard ring attention. q/k/v: (B, C, H, D) local chunks of the
-    (B, S, H, D) global arrays, C = S / axis_size. Returns (B, C, H, D)."""
+                         sm_scale: Optional[float] = None,
+                         zigzag: bool = False):
+    """Per-shard ring attention. q/k/v: (B, C, H(kv), D) local chunks of
+    the (B, S, H, D) global arrays, C = S / axis_size; GQA kv (Hkv < H)
+    rides the ring UNEXPANDED. Returns (B, C, H, D).
+
+    Each of the ``axis_size`` ring steps computes one chunk-vs-chunk
+    attention through the Pallas flash kernel (mergeable (out, lse) form
+    — per-shard temps O(C*D + block^2), never the (C, C) score matrix)
+    and rotates the kv chunk to the neighbour with ``lax.ppermute``; XLA
+    overlaps the permute with the step's matmuls, and the backward ring
+    is the transposed ppermute via autodiff.
+
+    ``zigzag`` (opt-in — the data must actually BE in zigzag order; the
+    function cannot reorder it): the caller feeds chunks where rank r
+    holds sequence pieces r and 2P-1-r (half a chunk each;
+    ``sep_scaled_dot_product_attention`` does the reorder and sets this).
+    Causal work then balances EXACTLY: per rank over a full rotation,
+    qa-vs-ka runs r full blocks, qb-vs-ka runs P-1, qb-vs-kb runs
+    P-1-r — a constant 2(P-1) halves plus the diagonal step, vs the
+    contiguous layout's r-proportional skew (rank P-1 does P times rank
+    0's work). Work units are gated by ``lax.switch`` on the piece
+    comparison, so skipped blocks cost nothing; the branches are pure
+    local compute (no collectives), so per-rank divergence is sound."""
     p = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, c, h, d = q.shape
+    hkv = k.shape[2]
+    if h % hkv:
+        raise ValueError(f"q heads {h} not divisible by kv heads {hkv}")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-
-    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * sm_scale   # (B,H,C,D)
-    q_pos = idx * c + lax.broadcasted_iota(jnp.int32, (c, c), 0)
-    kv_iota = lax.broadcasted_iota(jnp.int32, (c, c), 1)
-
     perm = [(j, (j + 1) % p) for j in range(p)]
 
+    def rotate(t):
+        return lax.ppermute(t, axis_name, perm)
+
+    def _vary(x):
+        # fresh accumulators start unvarying and need the varying tag for
+        # the scan carry; never applied to k/v (already varying — under
+        # check_vma=False their typeof may not even report it)
+        if axis_name in jax.typeof(x).vma:
+            return x
+        return lax.pcast(x, axis_name, to="varying")
+
+    def unit(mode, qx, kx, vx):
+        """mode 0: skip, 1: full, 2: causal (same-piece, aligned). The o
+        partial comes back f32 (switch branches must agree with the skip
+        branch's f32 accumulator dtype)."""
+
+        def attn(causal_):
+            def run(a, b_, c_):
+                o, lse = _chunk_attn(a, b_, c_, causal_, sm_scale, h, hkv)
+                return o.astype(jnp.float32), lse
+            return run
+
+        return lax.switch(
+            mode,
+            [lambda a, b_, c_: jax.tree.map(_vary, _empty_partial(
+                b, a.shape[1], h, d)),
+             attn(False), attn(True)],
+            qx, kx, vx)
+
+    if not zigzag:
+        # one accumulator over the whole chunk. Non-causal: every chunk
+        # pair runs full. Causal contiguous: rank r's chunk attends
+        # chunks src < r fully, its own causally, later ones not at all
+        # (work skewed by r — the zigzag layout fixes that).
+        def step(carry, i):
+            o, lse, k_cur, v_cur = carry
+            src = (idx - i) % p
+            if causal:
+                mode = jnp.where(src == idx, 2,
+                                 jnp.where(src < idx, 1, 0))
+            else:
+                mode = jnp.ones((), jnp.int32)
+            oi, lsei = unit(mode.astype(jnp.int32), q, k_cur, v_cur)
+            o, lse = _merge(o, lse, oi, lsei)
+            return (o, lse, rotate(k_cur), rotate(v_cur)), None
+
+        o0, l0 = _empty_partial(b, c, h, d)
+        carry = (_vary(o0), _vary(l0), k, v)
+        (o, _, _, _), _ = lax.scan(step, carry, jnp.arange(p))
+        return o.astype(q.dtype)
+
+    # zigzag: local chunk = [piece idx, piece 2P-1-idx], half each
+    if not causal:
+        raise ValueError("zigzag layout only applies to causal attention")
+    if c % 2:
+        raise ValueError(f"zigzag ring needs an even local chunk, got {c}")
+    half = c // 2
+    qa, qb = q[:, :half], q[:, half:]
+
     def step(carry, i):
-        m, l, acc, k_cur, v_cur = carry
-        src = (idx - i) % p                       # who produced this chunk
-        kf = jnp.swapaxes(k_cur, 1, 2).astype(jnp.float32)      # (B,H,C,D)
-        vf = jnp.swapaxes(v_cur, 1, 2).astype(jnp.float32)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)
-        if causal:
-            kv_pos = src * c + kv_iota
-            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+        oa, la, ob, lb, k_cur, v_cur = carry
+        src = (idx - i) % p
+        ka, kb = k_cur[:, :half], k_cur[:, half:]
+        va, vb = v_cur[:, :half], v_cur[:, half:]
+        # piece indices: qa=idx, qb=2P-1-idx, ka=src, kb=2P-1-src
+        mode_aa = jnp.where(src == idx, 2,
+                            jnp.where(src < idx, 1, 0)).astype(jnp.int32)
+        # piece(ka)=src <= P-1 < P <= 2P-1-idx = piece(qb): always full
+        o1, l1 = unit(mode_aa, qa, ka, va)
+        o2, l2 = _chunk_attn(qb, ka, va, False, sm_scale, h, hkv)
+        mode_bb = jnp.where(src == idx, 2,
+                            jnp.where(src > idx, 1, 0)).astype(jnp.int32)
+        o3, l3 = unit(mode_bb, qb, kb, vb)
+        oa, la = _merge(oa, la, o1, l1)
+        ob, lb = _merge(ob, lb, o2, l2)
+        ob, lb = _merge(ob, lb, o3, l3)
+        return (oa, la, ob, lb, rotate(k_cur), rotate(v_cur)), None
 
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        # clamp fully-masked rows (see kernels/flash_attention.py)
-        m_new = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
-        pexp = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(pexp, axis=-1, keepdims=True)
-        acc_new = alpha * acc + jnp.einsum("bhqk,bhkd->bhqd", pexp, vf)
+    oa0, la0 = _empty_partial(b, half, h, d)
+    ob0, lb0 = _empty_partial(b, half, h, d)
+    carry = (_vary(oa0), _vary(la0), _vary(ob0), _vary(lb0), k, v)
+    (oa, _, ob, _, _, _), _ = lax.scan(step, carry, jnp.arange(p))
+    return jnp.concatenate([oa, ob], axis=1).astype(q.dtype)
 
-        # rotate the kv chunk around the ring (nearest-neighbour on ICI);
-        # XLA overlaps this permute with the next step's matmuls
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
 
-    # The step outputs depend on q/k/v and so are varying over the manual
-    # sep axis; freshly created carries start unvarying, which trips
-    # shard_map's check_vma (carry-in type != carry-out type). Tag them.
-    _vary = functools.partial(lax.pcast, axis_name=axis_name, to="varying")
-    m0 = _vary(jnp.full((b, h, c, 1), _NEG_INF, jnp.float32))
-    l0 = _vary(jnp.zeros((b, h, c, 1), jnp.float32))
-    a0 = _vary(jnp.zeros((b, h, c, d), jnp.float32))
-    (m, l, acc, _, _), _ = lax.scan(step, (m0, l0, a0, k, v),
-                                    jnp.arange(p))
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    out = (acc / l_safe).astype(q.dtype)
-    return jnp.swapaxes(out, 1, 2)                # (B, C, H, D)
+def zigzag_order(S: int, p: int):
+    """Global sequence permutation for the balanced ring: rank r's chunk
+    is [piece r, piece 2P-1-r] of 2P equal pieces. Returns (order,
+    inverse) index arrays, or None when S doesn't split into 2P pieces."""
+    if S % (2 * p):
+        return None
+    piece = S // (2 * p)
+    order = np.concatenate([
+        np.r_[r * piece:(r + 1) * piece,
+              (2 * p - 1 - r) * piece:(2 * p - r) * piece]
+        for r in range(p)])
+    inv = np.argsort(order)
+    return order, inv
 
 
 # --------------------------------------------------------- ulysses attention
@@ -212,14 +364,39 @@ def sep_scaled_dot_product_attention(
         return _dense_sdpa(q, k, v, causal,
                            sm_scale or 1.0 / math.sqrt(q.shape[-1]))
 
+    p = mesh.shape[sep_axis]
     impl = {"ring": ring_flash_attention, "ulysses": ulysses_attention}[method]
+    kw = {}
+    zig = None
+    if method == "ring" and causal:
+        # balanced causal ring: permute the sequence into zigzag order
+        # (rank r holds pieces r and 2P-1-r) so per-rank causal work is
+        # uniform; the inverse permute restores order on the way out.
+        # GSPMD turns the takes on the seq-sharded operands into the
+        # half-chunk exchange.
+        zig = zigzag_order(q.shape[1], p)
+        kw["zigzag"] = zig is not None
     fn = functools.partial(impl, axis_name=sep_axis, causal=causal,
-                           sm_scale=sm_scale)
+                           sm_scale=sm_scale, **kw)
     spec = P(None, sep_axis, None, None)
-    # manual over sep only; other axes stay GSPMD. check_vma must be True:
-    # this jax version's check_vma=False path re-enters shard_map with
-    # out_specs over ALL mesh axes, which partial-manual mode rejects
-    mapped = jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        axis_names=frozenset({sep_axis}))
+    if set(mesh.axis_names) == {sep_axis}:
+        # full-manual mesh: check_vma=False — pallas interpret mode can
+        # then serve the inner flash kernel on CPU test meshes
+        mapped = jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+    else:
+        # manual over sep only; other axes stay GSPMD. check_vma must be
+        # True: this jax version's check_vma=False path re-enters
+        # shard_map with out_specs over ALL mesh axes, which
+        # partial-manual mode rejects
+        mapped = jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            axis_names=frozenset({sep_axis}))
+    if zig is not None:
+        order, inv = zig
+        out = mapped(jnp.take(q, order, axis=1),
+                     jnp.take(k, order, axis=1),
+                     jnp.take(v, order, axis=1))
+        return jnp.take(out, inv, axis=1)
     return mapped(q, k, v)

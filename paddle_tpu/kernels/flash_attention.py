@@ -71,6 +71,16 @@ def _interpret() -> bool:
     return not is_tpu_backend()
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying ``like``'s varying-manual-axes: inside a
+    check_vma=True shard_map (e.g. the ring-attention sep region) pallas
+    outputs must declare their vma explicitly."""
+    vma = jax.typeof(like).vma
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _compact() -> bool:
     """FLAGS_flash_compact_stats: keep softmax stats compact (BH, S) at
     the kernel boundary — no 128x lane-replicated HBM transients. Numerics
@@ -273,8 +283,8 @@ def _fwd_compact(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
             pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            _sds((bh, sq, d), q.dtype, q),
+            _sds((bh, sq), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -324,9 +334,9 @@ def _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k,
             pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
-            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
-            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
+            _sds((bh, sq, d), jnp.float32, q),
+            _sds((bh, sq, _LANES), jnp.float32, q),
+            _sds((bh, sq, _LANES), jnp.float32, q),
         ],
         interpret=_interpret(),
     )(*args)
@@ -428,12 +438,26 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(causal, sm_scale, block_q, block_k, h, hkv, compact, res, g):
+    do = g[0] if isinstance(g, (tuple, list)) else g
+    return _bwd_impl(causal, sm_scale, block_q, block_k, h, hkv, compact,
+                     res, do, None)
+
+
+def _bwd_with_lse(causal, sm_scale, block_q, block_k, h, hkv, compact,
+                  res, g):
+    do, dlse = g
+    dq, dk, dv, _, _ = _bwd_impl(causal, sm_scale, block_q, block_k, h,
+                                 hkv, compact, res, do, dlse)
+    return dq, dk, dv, None, None
+
+
+def _bwd_impl(causal, sm_scale, block_q, block_k, h, hkv, compact, res,
+              do, dlse):
     q, k, v, seg_q, seg_kv, out, lse = res
     rep = h // hkv
 
     def kv_index(b, i, j):
         return ((b // h) * hkv + (b % h) // rep, j, 0)
-    do = g[0] if isinstance(g, (tuple, list)) else g
     bh, sq, d = q.shape
     skv = k.shape[1]
     bq = min(block_q, sq)
@@ -441,6 +465,11 @@ def _bwd(causal, sm_scale, block_q, block_k, h, hkv, compact, res, g):
 
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1)                               # (bh, sq)
+    if dlse is not None:
+        # lse cotangent folds into the kernels for free: ds = p*(dp -
+        # delta) becomes p*(dp - delta + dlse) since d lse/d s = p —
+        # i.e. the SAME kernels with delta := delta - dlse
+        delta = delta - dlse.astype(jnp.float32)
 
     has_seg = seg_q is not None
     if compact:
@@ -483,7 +512,7 @@ def _bwd(causal, sm_scale, block_q, block_k, h, hkv, compact, res, g):
         dq_kernel, grid=(bh, sq // bq, skv // bk),
         in_specs=in_specs_dq,
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
+        out_shape=_sds((bh, sq, d), jnp.float32, q),
         interpret=_interpret(),
     )(*common)
     dq = (dq * sm_scale).astype(q.dtype)
@@ -528,8 +557,8 @@ def _bwd(causal, sm_scale, block_q, block_k, h, hkv, compact, res, g):
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, i, r, j: (b, i, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, r, j: (b, i, 0))],
-        out_shape=[jax.ShapeDtypeStruct((bh_kv, skv, d), jnp.float32),
-                   jax.ShapeDtypeStruct((bh_kv, skv, d), jnp.float32)],
+        out_shape=[_sds((bh_kv, skv, d), jnp.float32, q),
+                   _sds((bh_kv, skv, d), jnp.float32, q)],
         interpret=_interpret(),
     )(*common)
     # dk already carries sm_scale via the scaled q used in ds
@@ -557,6 +586,51 @@ def _flash_fwd_rule(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
 
 
 _flash_attention.defvjp(_flash_fwd_rule, _bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash_attention_lse(q, k, v, seg_q, seg_kv, causal, sm_scale,
+                         block_q, block_k, h, hkv, compact):
+    return _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
+                block_k, h, hkv, compact)
+
+
+def _flash_lse_fwd_rule(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
+                        block_k, h, hkv, compact):
+    out, lse = _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
+                    block_k, h, hkv, compact)
+    return (out, lse), (q, k, v, seg_q, seg_kv, out, lse)
+
+
+_flash_attention_lse.defvjp(_flash_lse_fwd_rule, _bwd_with_lse)
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = True,
+                             sm_scale: Optional[float] = None,
+                             block_q: int = DEFAULT_BLOCK_Q,
+                             block_k: int = DEFAULT_BLOCK_K,
+                             n_heads: int = 1,
+                             n_kv_heads: Optional[int] = None):
+    """(BH, S, D) flash attention returning ``(out, lse)`` — the mergeable
+    form ring attention needs (two partial results combine in log-space).
+    Differentiable in BOTH outputs: the lse cotangent folds into the
+    standard FA2 backward as ``delta - dlse`` (d lse/d s = p). GQA as in
+    ``flash_attention``."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if n_kv_heads is None:
+        n_kv_heads = n_heads
+    if n_heads % n_kv_heads:
+        raise ValueError(f"n_heads {n_heads} not divisible by n_kv_heads "
+                         f"{n_kv_heads}")
+    if q.shape[0] * n_kv_heads != k.shape[0] * n_heads:
+        raise ValueError(
+            f"q rows {q.shape[0]} / k rows {k.shape[0]} inconsistent with "
+            f"n_heads={n_heads}, n_kv_heads={n_kv_heads} — pass the head "
+            f"counts for GQA inputs")
+    return _flash_attention_lse(q, k, v, None, None, causal, sm_scale,
+                                block_q, block_k, n_heads, n_kv_heads,
+                                _compact())
 
 
 def flash_attention(q, k, v, segment_ids: Optional[jax.Array] = None,

@@ -222,3 +222,114 @@ class TestUlyssesGQAAttnFn:
             seen_heads
         assert all(kk == max(1, qq // rep) for qq, kk in seen_heads), \
             seen_heads
+
+
+class TestRingRebuilt:
+    """Round-4 ring rebuild (VERDICT r3 item 3): flash inner kernel, GQA
+    on the ring path, zigzag balance."""
+
+    def test_ring_gqa_matches_dense(self):
+        from jax.sharding import Mesh, NamedSharding
+        mesh = Mesh(np.array(jax.devices()[:4]), ("sep",))
+        b, s, h, hkv, d = 1, 64, 8, 2, 16
+        rng = np.random.default_rng(31)
+        sh = NamedSharding(mesh, P(None, "sep", None, None))
+        q = jax.device_put(jnp.asarray(
+            rng.standard_normal((b, s, h, d)), jnp.float32), sh)
+        k = jax.device_put(jnp.asarray(
+            rng.standard_normal((b, s, hkv, d)), jnp.float32), sh)
+        v = jax.device_put(jnp.asarray(
+            rng.standard_normal((b, s, hkv, d)), jnp.float32), sh)
+        out = sep_scaled_dot_product_attention(
+            q, k, v, mesh=mesh, method="ring", causal=True)
+        rep = h // hkv
+        ref = _dense_sdpa(q, jnp.repeat(k, rep, axis=2),
+                          jnp.repeat(v, rep, axis=2), True,
+                          1.0 / np.sqrt(d))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_ring_gqa_grads_match_dense(self):
+        from jax.sharding import Mesh, NamedSharding
+        mesh = Mesh(np.array(jax.devices()[:4]), ("sep",))
+        b, s, h, hkv, d = 1, 32, 4, 2, 8
+        rng = np.random.default_rng(32)
+        sh = NamedSharding(mesh, P(None, "sep", None, None))
+        q = jax.device_put(jnp.asarray(
+            rng.standard_normal((b, s, h, d)), jnp.float32), sh)
+        k = jax.device_put(jnp.asarray(
+            rng.standard_normal((b, s, hkv, d)), jnp.float32), sh)
+        v = jax.device_put(jnp.asarray(
+            rng.standard_normal((b, s, hkv, d)), jnp.float32), sh)
+
+        def loss_ring(q, k, v):
+            return (sep_scaled_dot_product_attention(
+                q, k, v, mesh=mesh, method="ring", causal=True) ** 2).sum()
+
+        def loss_dense(q, k, v):
+            rep = q.shape[2] // k.shape[2]
+            return (_dense_sdpa(q, jnp.repeat(k, rep, axis=2),
+                                jnp.repeat(v, rep, axis=2), True,
+                                1.0 / np.sqrt(q.shape[-1])) ** 2).sum()
+
+        gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b_, n in zip(gr, gd, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=5e-4, atol=5e-4,
+                                       err_msg=f"d{n}")
+
+    def test_no_quadratic_score_temps_on_flash_path(self):
+        """With the pallas inner kernel serving the ring steps (sep-only
+        mesh, interpret mode), the lowered program must not materialize
+        any (C, C) or (half, half) f32 score block — only the kernel's
+        (128, 128) tiles."""
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(jax.devices()[:4]), ("sep",))
+        b, s, h, d = 1, 2048, 2, 64          # C = 512, half = 256
+        q = jax.ShapeDtypeStruct((b, s, h, d), jnp.bfloat16)
+
+        def f(q, k, v):
+            return sep_scaled_dot_product_attention(
+                q, k, v, mesh=mesh, method="ring", causal=True)
+
+        txt = jax.jit(f).lower(q, q, q).as_text()
+        assert "512x512" not in txt
+        assert "256x256" not in txt
+        assert "128x128" in txt              # kernel tiles present
+
+    def test_zigzag_balance_table(self):
+        """Static schedule property: with zigzag assignment every rank
+        runs the same number of full half-blocks per rotation (2(P-1))
+        plus the two diagonal causal halves — vs the contiguous layout's
+        r-proportional skew."""
+        for p in (2, 4, 8):
+            for r in range(p):
+                fulls = 0
+                causals = 0
+                for i in range(p):
+                    src = (r - i) % p
+                    # qa=r vs ka=src
+                    if src == r:
+                        causals += 1
+                    elif src < r:
+                        fulls += 1
+                    # qb vs ka: always full
+                    fulls += 1
+                    # qb=2P-1-r vs kb=2P-1-src
+                    if src == r:
+                        causals += 1
+                    elif src > r:
+                        fulls += 1
+                assert causals == 2, (p, r, causals)
+                assert fulls == 2 * (p - 1) + 1, (p, r, fulls)
+
+    def test_zigzag_order_roundtrip(self):
+        from paddle_tpu.distributed.fleet.utils.ring_flash_attention import (
+            zigzag_order)
+        order, inv = zigzag_order(32, 4)
+        x = np.arange(32)
+        np.testing.assert_array_equal(x[order][inv], x)
+        # rank 0's chunk = pieces 0 and 7
+        np.testing.assert_array_equal(order[:8],
+                                      np.r_[0:4, 28:32])
