@@ -19,7 +19,7 @@ from .meta_optimizers import (  # noqa: F401
 )
 from .meta_parallel import (  # noqa: F401
     ColumnParallelLinear, GroupShardedOptimizerStage2, GroupShardedStage2,
-    GroupShardedStage3, LayerDesc, ParallelCrossEntropy, PipelineLayer,
+    GroupShardedStage3, LayerDesc, ParallelCrossEntropy, parallel_matmul, PipelineLayer,
     PipelineParallel, RowParallelLinear, SharedLayerDesc,
     VocabParallelEmbedding,
 )

@@ -1,6 +1,6 @@
 """fleet.meta_parallel (reference: python/paddle/distributed/fleet/meta_parallel/)."""
 from .parallel_layers import (  # noqa: F401
-    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    ColumnParallelLinear, ParallelCrossEntropy, parallel_matmul, RowParallelLinear,
     VocabParallelEmbedding,
 )
 from . import pp_utils  # noqa: F401

@@ -1,5 +1,5 @@
 from ...random import RNGStatesTracker, get_rng_state_tracker  # noqa: F401
 from .mp_layers import (  # noqa: F401
-    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    ColumnParallelLinear, ParallelCrossEntropy, parallel_matmul, RowParallelLinear,
     VocabParallelEmbedding, shard_constraint,
 )

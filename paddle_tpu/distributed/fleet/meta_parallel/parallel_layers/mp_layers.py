@@ -86,6 +86,22 @@ def _mp_reduce(x, axis: str):
     return apply_op("mp_reduce", f, x)
 
 
+def parallel_matmul(x, weight, transpose_y: bool = True,
+                    mp_group=None):
+    """The tied-head matmul over a vocab-parallel table (reference:
+    parallel_matmul in the fleet model zoo: logits = x @ W^T with W
+    vocab-sharded, parallel_output=True). GSPMD path: plain matmul, the
+    table's dist_attr shards the output. Manual-mp path: f-copy the
+    replicated activation first (identity fwd, psum bwd — dx from the
+    local-shard contraction is partial), then the local matmul; the
+    vocab-sharded logits feed ParallelCrossEntropy."""
+    from .....ops import matmul
+    world, axis = _mp_degree_and_axis(mp_group)
+    if world > 1 and _manual_axis(axis):
+        x = _mp_copy(x, axis)
+    return matmul(x, weight, transpose_y=transpose_y)
+
+
 def shard_constraint(x, spec: P):
     """Annotate an activation's layout (jax.lax.with_sharding_constraint),
     recorded on the autograd tape; no-op without an active mesh or when the

@@ -323,6 +323,26 @@ class PipelineTrainStep:
         else:
             self.opt_shardings = dict(self.param_shardings)
 
+        if schedule == "zbh1":
+            # the manual engine uses exactly pp, dp, and the axes named
+            # by param specs (TP); any OTHER size>1 axis (sep, sharding)
+            # would silently replicate all work — the user configured a
+            # parallelism the engine would not deliver. Fail loudly.
+            named = set()
+            for s in specs.values():
+                for entry in s:
+                    if entry is None:
+                        continue
+                    named.update(entry if isinstance(entry, tuple)
+                                 else (entry,))
+            for ax, size in mesh.shape.items():
+                if size > 1 and ax not in {"pp", "dp"} | named:
+                    raise NotImplementedError(
+                        f"zbh1: mesh axis {ax!r} (size {size}) is neither "
+                        "pp/dp nor named by any param spec — the manual "
+                        "engine would replicate its work, not parallelize "
+                        "it; use schedule='auto' or drop the axis")
+
         if abstract:
             # re-struct every leaf so param_dtype applies uniformly (lazy
             # meta params arrive as f32 ShapeDtypeStructs)

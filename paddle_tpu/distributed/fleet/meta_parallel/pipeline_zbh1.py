@@ -433,14 +433,10 @@ def build_zbh1_loss_and_grads(
         # take different branches, observed as an XLA rendezvous
         # deadlock). The TP layers' manual f/g ops carry the only
         # collectives that belong inside units, and they are sound
-        # because an mp group shares its stage and hence its branch. Any
-        # extra mesh axes (sharding/sep) must be size 1 here.
-        for ax in set(mesh.axis_names) - {"pp", dp_axis} - set(tp_axes):
-            if mesh.shape[ax] > 1:
-                raise NotImplementedError(
-                    f"zbh1: mesh axis {ax!r} (size {mesh.shape[ax]}) is "
-                    "neither pp/dp nor named by any param spec — the "
-                    "manual engine cannot leave it to GSPMD")
+        # because an mp group shares its stage and hence its branch.
+        # Mesh axes named by NO spec (e.g. mp with a non-TP model, or
+        # size-1 sharding/sep axes) replicate the work — sound, since
+        # full-manual means no GSPMD could use them anyway.
         return jax.shard_map(kernel, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_vma=False)(
             stacked_tuple, prefix_params, suffix_params, shared_params,

@@ -405,3 +405,85 @@ class TestZBH1ManualTPLayers:
             PipelineTrainStep(pipe, AdamW(learning_rate=1e-3), mesh,
                               num_microbatches=2, schedule="zbh1",
                               sharding_level=1, sharding_axis="sharding")
+
+
+def _vocab_head(layer, hidden):
+    """Tied head over the (possibly locally-sharded) vocab-parallel
+    embedding table via parallel_matmul — vocab-sharded logits under
+    manual mp (with the f-copy so dx is complete), full under
+    GSPMD/serial."""
+    from paddle_tpu.distributed.fleet import parallel_matmul
+    return parallel_matmul(hidden, layer.weight, transpose_y=True)
+
+
+class TestZBH1TiedTensorParallel:
+    """The full Megatron tied pipe under zero bubble: vocab-parallel
+    embedding SHARED with the vocab-parallel head, TP blocks, manual
+    ParallelCrossEntropy — tied routing x TP collectives in ONE zbh1
+    program on pp2 x mp2 (VERDICT r3 item 2's end state)."""
+
+    def _build(self, vocab, h):
+        from test_hybrid_3axis import TPBlock
+        from paddle_tpu.distributed.fleet import VocabParallelEmbedding
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc, PipelineLayer, SharedLayerDesc)
+        import paddle_tpu.nn as nn
+
+        paddle.seed(61)
+        descs = [SharedLayerDesc("embed", VocabParallelEmbedding, None,
+                                 "weight", vocab, h)]
+        descs += [LayerDesc(TPBlock, h) for _ in range(2)]
+        descs.append(LayerDesc(nn.LayerNorm, h))
+        descs.append(SharedLayerDesc("embed", VocabParallelEmbedding,
+                                     _vocab_head, "weight", vocab, h))
+        return PipelineLayer(descs, num_stages=2, loss_fn=None)
+
+    def test_tied_tp_pp2_mp2_matches_serial(self, hcg_pp_mp):
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.distributed.fleet import ParallelCrossEntropy
+
+        VOCAB, H = 64, 32
+        pce = ParallelCrossEntropy()
+
+        def loss_fn(out, y):
+            return pce(Tensor(out), Tensor(y)).mean()._value
+
+        serial = TrainStep(self._build(VOCAB, H),
+                           AdamW(learning_rate=1e-3), loss_fn=loss_fn)
+        zb = PipelineTrainStep(self._build(VOCAB, H),
+                               AdamW(learning_rate=1e-3),
+                               hcg_pp_mp.get_mesh(), num_microbatches=2,
+                               loss_fn=loss_fn, schedule="zbh1")
+        assert zb.pipe_layer.shared_layers
+        rng = np.random.default_rng(9)
+        x = rng.integers(0, VOCAB, (8, 16)).astype(np.int32)
+        y = rng.integers(0, VOCAB, (8, 16)).astype(np.int32)
+        xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+        for i in range(3):
+            ls = serial(xt, yt)
+            lz = zb(xt, yt)
+            np.testing.assert_allclose(float(ls), float(lz), rtol=3e-4,
+                                       err_msg=f"step {i}")
+
+    def test_zbh1_rejects_unnamed_size_axis(self):
+        """A size>1 mesh axis no param spec names (sep here) must fail at
+        construction, not silently replicate."""
+        from paddle_tpu.distributed.fleet.base_topology import (
+            _reset_hcg, create_hybrid_communicate_group)
+        from paddle_tpu.models import GPTConfig, GPTForCausalLMPipe
+
+        _reset_hcg()
+        try:
+            hcg = create_hybrid_communicate_group(sep_degree=2,
+                                                  pp_degree=2)
+            cfg = GPTConfig(vocab_size=64, hidden_size=32,
+                            num_hidden_layers=2, num_attention_heads=2,
+                            max_position_embeddings=32)
+            paddle.seed(1)
+            pipe = GPTForCausalLMPipe(cfg, num_stages=2)
+            with pytest.raises(NotImplementedError, match="'sep'"):
+                PipelineTrainStep(pipe, AdamW(learning_rate=1e-3),
+                                  hcg.get_mesh(), num_microbatches=2,
+                                  schedule="zbh1")
+        finally:
+            _reset_hcg()
