@@ -100,18 +100,27 @@ def rank(x) -> int:
     return x.ndim
 
 
+_static_mode = False
+
+
 def in_dynamic_mode() -> bool:
-    return True
+    return not _static_mode
 
 
 def disable_static(place=None):
+    global _static_mode
+    _static_mode = False
     return None
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu has no legacy static-graph Program mode; use "
-        "paddle_tpu.jit.to_static (jax.jit) for compiled execution.")
+    """Static-graph compatibility mode: build under
+    ``paddle.static.program_guard`` (ops are recorded by execution) and
+    run with ``paddle.static.Executor``. The mode flag only flips
+    ``in_dynamic_mode()`` — recording is scoped by program_guard."""
+    global _static_mode
+    _static_mode = True
+    return None
 
 
 def disable_signal_handler():

@@ -45,6 +45,7 @@ class Tensor:
         "_out_index",
         "_retain_grads",
         "_backward_hooks",
+        "_static_var_id",   # static Program variable id (static/program.py)
         "dist_attr",        # sharding annotation (auto_parallel): PartitionSpec
         "process_mesh",     # auto_parallel ProcessMesh (shard_tensor output)
         "placements",       # auto_parallel placements list (shard_tensor)
@@ -336,12 +337,19 @@ def _val_index(idx):
     return _val(idx)
 
 
+# set by static/program.py while a program_guard is active: every op
+# through this dispatch point is then also recorded into the Program
+_static_recorder = None
+
+
 def apply_op(name: str, fn: Callable, *args, **kwargs) -> Any:
     """Single dispatch point for every eager op.
 
     ``args`` may mix Tensors and raw values; ``kwargs`` are static (shapes,
     axes). Executes via jax, records a GradNode when grads are required
-    (see core/autograd.py), and wraps outputs as Tensors.
+    (see core/autograd.py), and wraps outputs as Tensors. Under an active
+    ``paddle.static.program_guard`` the op is additionally recorded for
+    Executor replay.
     """
     from .. import flags
 
@@ -365,6 +373,8 @@ def apply_op(name: str, fn: Callable, *args, **kwargs) -> Any:
             t._grad_node = node
             t._out_index = i
         wrapped.append(t)
+    if _static_recorder is not None:
+        _static_recorder.record(name, fn, args, kwargs, wrapped)
     return tuple(wrapped) if multi else wrapped[0]
 
 

@@ -129,6 +129,19 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..core import tensor as _core
+        rec = _core._static_recorder
+        if rec is not None:
+            # static build: record the train marker — Executor.run does
+            # backward + step per run (the reference appends backward +
+            # optimizer ops to the Program here)
+            tag = getattr(loss, "_static_var_id", None)
+            if tag is None or tag[0] is not rec.program._family:
+                raise ValueError(
+                    "minimize(loss): loss is not a variable of the "
+                    "program under construction")
+            rec.program.train_specs.append((tag[1], self))
+            return None, []
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in self._params()]

@@ -10,7 +10,8 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
-__all__ = ["InputSpec", "nn"]
+__all__ = ["InputSpec", "nn", "Program", "program_guard", "data",
+           "Executor", "default_main_program", "default_startup_program"]
 
 
 class InputSpec:
@@ -34,3 +35,6 @@ class InputSpec:
 
 
 from . import nn  # noqa: E402,F401
+from .program import (Executor, Program, data,  # noqa: E402,F401
+                      default_main_program, default_startup_program,
+                      program_guard)

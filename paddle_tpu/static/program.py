@@ -1,0 +1,274 @@
+"""``paddle.static`` Program/Executor facade (VERDICT r3 item 6).
+
+Reference: python/paddle/static/ (``Program``, ``program_guard``,
+``data``, ``Executor``) over paddle/fluid/framework/new_executor/
+interpreter_core.cc. The reference builds a ProgramDesc of OpDescs and
+interprets it; the TPU-native collapse is TRACE-BY-EXECUTION:
+
+  - Inside ``program_guard`` user code runs EAGERLY on placeholder
+    values, and every op that passes through the ``apply_op`` dispatch
+    point is recorded into the active ``Program`` as (fn, arg-slots,
+    static kwargs, output-slots). ``static.data`` creates the
+    placeholders; ``Parameter`` arguments are recorded BY REFERENCE so
+    every ``Executor.run`` reads their current values (training state
+    lives in the parameters, exactly like the reference's scope vars).
+  - ``Executor.run(program, feed, fetch_list)`` replays the recorded
+    ops on the fed values — through ``apply_op`` again, so a fresh
+    autograd tape is built and an ``optimizer.minimize(loss)`` recorded
+    at build time executes backward + update per run. All actual math
+    is jax → XLA either way.
+  - ``opt.minimize(loss)`` under an active guard records a train marker
+    instead of executing (the reference appends backward + optimizer
+    ops to the program; the marker is our equivalent).
+
+Scope (documented collapse, SURVEY.md §7.1): no ProgramDesc
+serialization, no pass pipeline (XLA owns optimization), and control
+flow uses ``paddle.static.nn`` cond/while_loop which trace as single
+recorded ops.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core import tensor as _core
+from ..core.autograd import no_grad
+from ..core.dtype import to_jax_dtype
+from ..core.tensor import Parameter, Tensor
+
+__all__ = [
+    "Program", "program_guard", "data", "Executor",
+    "default_main_program", "default_startup_program",
+]
+
+
+class _OpRecord:
+    __slots__ = ("name", "fn", "arg_specs", "kwargs", "out_ids")
+
+    def __init__(self, name, fn, arg_specs, kwargs, out_ids):
+        self.name = name
+        self.fn = fn
+        self.arg_specs = arg_specs
+        self.kwargs = kwargs
+        self.out_ids = out_ids
+
+
+class Program:
+    """A recorded op sequence + data placeholders + train markers."""
+
+    def __init__(self):
+        self.ops: List[_OpRecord] = []
+        # name -> (var_id, shape, dtype)
+        self.datas: Dict[str, Tuple[int, tuple, Any]] = {}
+        # (loss var_id, optimizer) markers appended by minimize()
+        self.train_specs: List[Tuple[int, Any]] = []
+        self.random_seed = 0
+        self._next_id = 0
+        # identity shared by clones: a tensor's _static_var_id is only
+        # meaningful inside its own program family — a tensor captured
+        # from ANOTHER program must be frozen as a constant, never
+        # resolved against this program's variable table
+        self._family = object()
+
+    def _new_id(self) -> int:
+        self._next_id += 1
+        return self._next_id
+
+    def clone(self, for_test: bool = False) -> "Program":
+        """Reference ``Program.clone(for_test=True)``: the same forward
+        ops without the backward/update markers (eval program)."""
+        p = Program()
+        p.ops = list(self.ops)
+        p.datas = dict(self.datas)
+        p.random_seed = self.random_seed
+        p._next_id = self._next_id
+        p._family = self._family
+        if not for_test:
+            p.train_specs = list(self.train_specs)
+        return p
+
+    def global_block(self):
+        return self
+
+    def __repr__(self):
+        return (f"Program(ops={len(self.ops)}, datas={list(self.datas)}, "
+                f"train={len(self.train_specs)})")
+
+
+class _Recorder:
+    def __init__(self, program: Program):
+        self.program = program
+
+    def record(self, name, fn, args, kwargs, outs) -> None:
+        specs = []
+        for a in args:
+            if isinstance(a, Parameter):
+                specs.append(("param", a))
+            elif isinstance(a, Tensor):
+                tag = getattr(a, "_static_var_id", None)
+                if tag is None or tag[0] is not self.program._family:
+                    # created OUTSIDE this program (plain constant or a
+                    # variable of some OTHER program) — freeze its
+                    # build-time value
+                    specs.append(("const", a._value))
+                else:
+                    specs.append(("var", tag[1]))
+            else:
+                specs.append(("const", a))
+        out_ids = []
+        for o in outs:
+            if isinstance(o, Tensor):
+                oid = self.program._new_id()
+                o._static_var_id = (self.program._family, oid)
+                out_ids.append(oid)
+            else:
+                out_ids.append(None)
+        self.program.ops.append(
+            _OpRecord(name, fn, specs, dict(kwargs), out_ids))
+
+
+_default_main: Optional[Program] = None
+_default_startup: Optional[Program] = None
+
+
+def default_main_program() -> Program:
+    global _default_main
+    if _default_main is None:
+        _default_main = Program()
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    global _default_startup
+    if _default_startup is None:
+        _default_startup = Program()
+    return _default_startup
+
+
+def _active_recorder():
+    return _core._static_recorder
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program,
+                  startup_program: Optional[Program] = None):
+    """Record ops executed in the body into ``main_program`` (the
+    reference context manager of the same name). ``startup_program`` is
+    accepted for API parity; parameter initialization happens eagerly at
+    layer construction here, so it records nothing."""
+    if not isinstance(main_program, Program):
+        raise TypeError(f"program_guard needs a Program, got "
+                        f"{type(main_program).__name__}")
+    prev = _core._static_recorder
+    _core._static_recorder = _Recorder(main_program)
+    try:
+        yield
+    finally:
+        _core._static_recorder = prev
+
+
+def data(name: str, shape: Sequence[Optional[int]], dtype="float32",
+         lod_level: int = 0):
+    """Declare a feedable placeholder (reference: paddle.static.data).
+    ``None``/-1 dims are symbolic; the placeholder carries size 1 there
+    during the build trace and the fed value's real size at run time."""
+    rec = _active_recorder()
+    if rec is None:
+        raise RuntimeError(
+            "paddle.static.data must be called under program_guard")
+    if name in rec.program.datas:
+        raise ValueError(f"duplicate static.data name {name!r}")
+    concrete = tuple(1 if (s is None or (isinstance(s, int) and s < 0))
+                     else int(s) for s in shape)
+    t = Tensor(jnp.zeros(concrete, to_jax_dtype(dtype)),
+               stop_gradient=True, name=name)
+    vid = rec.program._new_id()
+    t._static_var_id = (rec.program._family, vid)
+    rec.program.datas[name] = (vid, tuple(shape), dtype)
+    return t
+
+
+class Executor:
+    """Replays a recorded Program (reference: paddle.static.Executor over
+    InterpreterCore). ``place`` is accepted and ignored — jax owns
+    placement."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence] = None,
+            return_numpy: bool = True):
+        prog = program if program is not None else default_main_program()
+        feed = feed or {}
+        table: Dict[int, Tensor] = {}
+        for name, (vid, shape, dtype) in prog.datas.items():
+            if name not in feed:
+                raise KeyError(
+                    f"static.data {name!r} was not fed (feed keys: "
+                    f"{sorted(feed)})")
+            val = np.asarray(feed[name])
+            if len(val.shape) != len(shape) or any(
+                    s is not None and s >= 0 and s != v
+                    for s, v in zip(shape, val.shape)):
+                raise ValueError(
+                    f"feed {name!r} has shape {val.shape}, declared "
+                    f"{tuple(shape)} (None/-1 dims are free; the rest "
+                    "must match — the reference Executor rejects this "
+                    "too, silently broadcasting instead would corrupt "
+                    "the program)")
+            table[vid] = Tensor(jnp.asarray(val, to_jax_dtype(dtype)),
+                                stop_gradient=True, name=name)
+
+        def resolve(spec):
+            kind, payload = spec
+            if kind == "param":
+                return payload                   # live Parameter
+            if kind == "var":
+                return table[payload]
+            return payload
+
+        prev = _core._static_recorder
+        _core._static_recorder = None            # replay must not re-record
+        # eval programs (no train markers) replay under no_grad: no tape,
+        # no retained activations on the inference path
+        grad_ctx = (contextlib.nullcontext() if prog.train_specs
+                    else no_grad())
+        try:
+            with grad_ctx:
+                for op in prog.ops:
+                    args = [resolve(s) for s in op.arg_specs]
+                    out = _core.apply_op(op.name, op.fn, *args,
+                                         **op.kwargs)
+                    outs = (list(out) if isinstance(out, (tuple, list))
+                            else [out])
+                    for oid, o in zip(op.out_ids, outs):
+                        if oid is not None:
+                            table[oid] = o
+            for loss_vid, optimizer in prog.train_specs:
+                loss_t = table[loss_vid]
+                loss_t.backward()
+                optimizer.step()
+                optimizer.clear_grad()
+        finally:
+            _core._static_recorder = prev
+
+        results = []
+        for f in fetch_list or []:
+            tag = getattr(f, "_static_var_id", None)
+            if (tag is None or tag[0] is not prog._family
+                    or tag[1] not in table):
+                raise ValueError(
+                    f"fetch target {f!r} is not a variable of this program")
+            v = table[tag[1]]
+            results.append(np.asarray(v._value) if return_numpy else v)
+        return results
+
+    def close(self):
+        return None
