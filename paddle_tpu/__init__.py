@@ -55,13 +55,42 @@ from . import sparse  # noqa: F401
 from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import metric  # noqa: F401
+from . import device  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import version  # noqa: F401
+from . import hub  # noqa: F401
+from . import geometric  # noqa: F401
+from .hapi import Model  # noqa: F401
+from .hapi import callbacks as callbacks  # noqa: F401
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference: paddle.set_printoptions — numpy-backed display options
+    (tensors print via numpy here)."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
 from . import autograd  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 from .framework.lazy import LazyGuard  # noqa: F401
 from . import distributed  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi.summary import flops, summary  # noqa: F401
-from . import linalg  # noqa: F401
+import importlib as _importlib
+# NB: `from . import linalg` would return the ops.linalg SUBMODULE already
+# bound on the package by `from .ops import *`; force the rich module
+linalg = _importlib.import_module(".linalg", __name__)
 from . import models  # noqa: F401
 from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
@@ -137,15 +166,19 @@ def synchronize():
     (jax.device_put(0) + 0).block_until_ready()
 
 
-class device:  # namespace facade: paddle.device.*
-    from .core.place import set_device, get_device, device_count  # type: ignore
-    set_device = staticmethod(set_device)
-    get_device = staticmethod(get_device)
+# paddle.device is the real submodule (imported above); the former class
+# facade is gone — everything it offered lives in device/__init__.py
 
-    @staticmethod
-    def cuda_device_count():
-        return 0
 
-    @staticmethod
-    def is_compiled_with_cuda():
-        return False
+def batch(reader, batch_size, drop_last=False):
+    """reference: paddle.batch (deprecated reader decorator)."""
+    def gen():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return gen

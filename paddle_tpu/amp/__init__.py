@@ -13,3 +13,13 @@ from .auto_cast import (  # noqa: F401
 )
 from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
 from . import debugging  # noqa: F401
+
+
+def is_float16_supported(device=None) -> bool:
+    """fp16 compute support on the current backend (TPU prefers bf16;
+    XLA lowers f16 on all backends)."""
+    return True
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    return True

@@ -34,3 +34,67 @@ def jacobian(func, xs, batch_axis=None):
     vals = tree_to_values(xs if isinstance(xs, (list, tuple)) else (xs,))
     j = jax.jacobian(f, argnums=tuple(range(len(vals))))(*vals)
     return tree_to_tensors(j)
+
+
+def vjp(func, xs, v=None):
+    """reference: paddle.autograd.vjp (functional jax.vjp under the
+    tensor API)."""
+    import jax as _jax
+    from ..core.tensor import Tensor, _val
+    single = not isinstance(xs, (tuple, list))
+    vals = (_val(xs),) if single else tuple(_val(x) for x in xs)
+
+    def f(*a):
+        out = func(*[Tensor(t, stop_gradient=False) for t in a])
+        return _val(out)
+
+    out, pull = _jax.vjp(f, *vals)
+    if v is None:
+        import jax.numpy as _jnp
+        v = _jnp.ones_like(out)
+    else:
+        v = _val(v)
+    grads = pull(v)
+    outs = Tensor(out, stop_gradient=True)
+    gs = [Tensor(g, stop_gradient=True) for g in grads]
+    return outs, (gs[0] if single else gs)
+
+
+def jvp(func, xs, v=None):
+    """reference: paddle.autograd.jvp (jax.jvp)."""
+    import jax as _jax
+    import jax.numpy as _jnp
+    from ..core.tensor import Tensor, _val
+    single = not isinstance(xs, (tuple, list))
+    vals = (_val(xs),) if single else tuple(_val(x) for x in xs)
+    if v is None:
+        tangents = tuple(_jnp.ones_like(a) for a in vals)
+    else:
+        vs = (v,) if single else v
+        tangents = tuple(_val(t) for t in vs)
+
+    def f(*a):
+        out = func(*[Tensor(t, stop_gradient=False) for t in a])
+        return _val(out)
+
+    out, tangent_out = _jax.jvp(f, vals, tangents)
+    return (Tensor(out, stop_gradient=True),
+            Tensor(tangent_out, stop_gradient=True))
+
+
+import contextlib as _ctx
+
+
+@_ctx.contextmanager
+def saved_tensors_hooks(pack_hook, unpack_hook):
+    """reference: paddle.autograd.saved_tensors_hooks. The eager tape
+    saves residuals inside jax vjp closures, not as user-visible
+    tensors; the hooks context is accepted and the hooks are invoked
+    around explicitly-saved PyLayer tensors only."""
+    from ..core import autograd as _aut
+    prev = getattr(_aut, "_saved_tensor_hooks", None)
+    _aut._saved_tensor_hooks = (pack_hook, unpack_hook)
+    try:
+        yield
+    finally:
+        _aut._saved_tensor_hooks = prev

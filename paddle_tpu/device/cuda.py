@@ -1,0 +1,105 @@
+"""reference: python/paddle/device/cuda/ — CUDA stream/memory APIs. On
+TPU there is no CUDA; these are API-parity shims with honest semantics:
+counts are 0, streams/events are ordering no-ops (XLA owns scheduling),
+memory queries read the jax device stats where available."""
+
+from __future__ import annotations
+
+import contextlib
+
+
+def device_count() -> int:
+    return 0
+
+
+class Stream:
+    def __init__(self, device=None, priority=None):
+        self.device = device
+
+    def synchronize(self):
+        import jax
+        jax.effects_barrier()
+
+    def wait_event(self, event):
+        return None
+
+    def wait_stream(self, stream):
+        return None
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False,
+                 interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        return None
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self):
+        return None
+
+
+def current_stream(device=None) -> Stream:
+    return Stream(device)
+
+
+@contextlib.contextmanager
+def stream_guard(stream):
+    yield
+
+
+def synchronize(device=None):
+    import jax
+    jax.effects_barrier()
+
+
+def _mem_stat(key: str) -> int:
+    import jax
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        return int(stats.get(key, 0))
+    except Exception:
+        return 0
+
+
+def memory_allocated(device=None) -> int:
+    return _mem_stat("bytes_in_use")
+
+
+def max_memory_allocated(device=None) -> int:
+    return _mem_stat("peak_bytes_in_use")
+
+
+def memory_reserved(device=None) -> int:
+    return _mem_stat("bytes_reserved") or _mem_stat("bytes_in_use")
+
+
+def max_memory_reserved(device=None) -> int:
+    return _mem_stat("peak_bytes_in_use")
+
+
+def empty_cache():
+    return None
+
+
+def get_device_properties(device=None):
+    import jax
+    d = jax.devices()[0]
+    return {"name": getattr(d, "device_kind", d.platform),
+            "platform": d.platform}
+
+
+def get_device_name(device=None) -> str:
+    import jax
+    d = jax.devices()[0]
+    return getattr(d, "device_kind", d.platform)
+
+
+def get_device_capability(device=None):
+    return (0, 0)

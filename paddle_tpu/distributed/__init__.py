@@ -28,3 +28,35 @@ from .fleet.meta_parallel.meta_parallel_base import DataParallel  # noqa: F401
 from .fleet import DistributedStrategy as Strategy  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .auto_parallel import shard_layer, to_static  # noqa: F401
+
+
+def get_backend() -> str:
+    """reference: paddle.distributed.get_backend — the communication
+    backend name; collectives here ride XLA (ICI/DCN)."""
+    return "XLA"
+
+
+def is_available() -> bool:
+    return True
+
+
+def is_initialized() -> bool:
+    from .parallel import parallel_env_initialized
+    try:
+        return bool(parallel_env_initialized())
+    except Exception:
+        from .fleet.base_topology import try_get_hybrid_communicate_group
+        return try_get_hybrid_communicate_group() is not None
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """reference: gloo CPU-barrier init; the HTTP-KV rendezvous is this
+    build's cross-host barrier (launch/kv_master.py)."""
+    from .launch.kv_master import HTTPRendezvous
+    rdzv = HTTPRendezvous(server_endpoint, is_master=rank_id == 0)
+    rdzv.register(str(rank_id), {"rank": rank_id})
+    return rdzv
+
+from . import rpc  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .communication import stream  # noqa: F401

@@ -355,3 +355,49 @@ def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
     eng = Engine(layer, loss=loss, optimizer=optimizer, strategy=strategy,
                  mesh=mesh)
     return eng
+
+
+class Strategy:
+    """reference: paddle.distributed.Strategy (auto_parallel strategy
+    config: sharding/fused_passes/pipeline knobs). Configuration carrier;
+    the Engine reads the fields it understands."""
+
+    def __init__(self, config=None):
+        class _NS:
+            def __init__(self, **kw):
+                self.__dict__.update(kw)
+        self.sharding = _NS(enable=False, degree=1, stage=1)
+        self.fused_passes = _NS(enable=False, fused_passes_list=[])
+        self.pipeline = _NS(enable=False, schedule_mode="1F1B",
+                            micro_batch_size=1, accumulate_steps=1)
+        self.amp = _NS(enable=False, dtype="float16", level="O1")
+        self.gradient_merge = _NS(enable=False, k_steps=1)
+        if config:
+            for k, v in dict(config).items():
+                setattr(self, k, v)
+
+
+def shard_op(op_fn, process_mesh=None, in_shardings=None,
+             out_shardings=None):
+    """reference: paddle.distributed.shard_op — annotate one op call with
+    input/output shardings. GSPMD formulation: constrain inputs, call,
+    constrain outputs."""
+    _st = shard_tensor
+
+    def wrapped(*args, **kwargs):
+        if in_shardings is not None and process_mesh is not None:
+            args = tuple(
+                _st(a, process_mesh, s) if s is not None else a
+                for a, s in zip(args, in_shardings))
+        out = op_fn(*args, **kwargs)
+        if out_shardings is not None and process_mesh is not None:
+            if isinstance(out, (tuple, list)):
+                out = type(out)(
+                    _st(o, process_mesh, s) if s is not None else o
+                    for o, s in zip(out, out_shardings))
+            else:
+                out = _st(out, process_mesh, out_shardings[0]
+                          if isinstance(out_shardings, (list, tuple))
+                          else out_shardings)
+        return out
+    return wrapped

@@ -48,3 +48,21 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True, **kw):
     return _c.alltoall_single(out_tensor, in_tensor, in_split_sizes,
                               out_split_sizes, group=group, sync_op=sync_op)
+
+
+def send(tensor, dst=0, group=None, sync_op=True, **kw):
+    from ..p2p import send as _send
+    return _send(tensor, dst=dst, group=group)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, **kw):
+    from ..p2p import recv as _recv
+    return _recv(tensor, src=src, group=group)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True, **kw):
+    from .. import collectives as _cc
+    return _cc.alltoall_single(out_tensor, in_tensor,
+                               in_split_sizes, out_split_sizes,
+                               group=group)

@@ -5,6 +5,7 @@ Grows through the build: topology + RNG now; fleet.init/distributed_model/
 meta_parallel wrappers as milestones land.
 """
 
+from .utils.fs import HDFSClient, LocalFS, UtilBase  # noqa: F401
 from . import base_topology, layers, meta_optimizers, meta_parallel, random, utils  # noqa: F401
 from .base_topology import (  # noqa: F401
     CommGroup, CommunicateTopology, HybridCommunicateGroup,
@@ -38,3 +39,17 @@ def worker_num() -> int:
 def worker_index() -> int:
     from .fleet import fleet as _fleet
     return _fleet.worker_index()
+
+
+def _bind_fleet_method(name):
+    def call(*a, **k):
+        return getattr(_fleet, name)(*a, **k)
+    call.__name__ = name
+    return call
+
+
+for _n in ("is_worker", "is_server", "is_first_worker", "worker_endpoints",
+           "server_num", "server_index", "server_endpoints", "init_worker",
+           "init_server", "run_server", "stop_worker", "barrier_worker"):
+    globals()[_n] = _bind_fleet_method(_n)
+del _n

@@ -85,6 +85,48 @@ class Fleet:
     def barrier_worker(self):
         pass  # single controller: nothing to synchronize
 
+    # -- collective-mode facade of the PS-era worker/server API (the PS
+    # runtime itself is a recorded non-goal — SURVEY §7.2): workers are
+    # ranks, there are no servers.
+    def is_worker(self) -> bool:
+        return True
+
+    def is_server(self) -> bool:
+        return False
+
+    def worker_endpoints(self, to_string=False):
+        rm = getattr(self, "_role_maker", None)
+        eps = rm.worker_endpoints() if rm is not None and hasattr(
+            rm, "worker_endpoints") else ["127.0.0.1:0"]
+        return ",".join(eps) if to_string else eps
+
+    def server_num(self) -> int:
+        return 0
+
+    def server_index(self) -> int:
+        return -1
+
+    def server_endpoints(self, to_string=False):
+        return "" if to_string else []
+
+    def init_worker(self, scopes=None):
+        pass
+
+    def init_server(self, *args, **kwargs):
+        raise RuntimeError(
+            "parameter-server mode is a recorded non-goal of the TPU "
+            "rebuild (SURVEY §7.2); collective mode has no servers")
+
+    run_server = init_server
+
+    def stop_worker(self):
+        pass
+
+    @property
+    def util(self):
+        from .utils.fs import UtilBase
+        return UtilBase()
+
     # ----------------------------------------------------------------- wrap
     def distributed_model(self, model):
         """Wrap per the strategy (reference fleet.py:distributed_model):

@@ -14,3 +14,5 @@ from .pp_layers import (  # noqa: F401
 from .sharding import (  # noqa: F401
     GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
 )
+
+from .parallel_layers import RNGStatesTracker, get_rng_state_tracker  # noqa: F401

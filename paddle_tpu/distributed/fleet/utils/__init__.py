@@ -5,3 +5,5 @@ from .ring_flash_attention import (  # noqa: F401
     ring_flash_attention as ring_flash_attention_fn,
     sep_scaled_dot_product_attention, ulysses_attention,
 )
+
+from .fs import HDFSClient, LocalFS, UtilBase  # noqa: F401

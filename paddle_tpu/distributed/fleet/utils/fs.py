@@ -1,0 +1,96 @@
+"""reference: python/paddle/distributed/fleet/utils/fs.py — LocalFS /
+HDFSClient + UtilBase. LocalFS is fully functional; HDFS needs a
+cluster client binary this image doesn't ship, so HDFSClient raises
+with guidance at USE (construction is allowed for config-parity)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+
+class LocalFS:
+    def ls_dir(self, path):
+        dirs, files = [], []
+        for n in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, n))
+             else files).append(n)
+        return dirs, files
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def rename(self, src, dst):
+        os.rename(src, dst)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def need_upload_download(self) -> bool:
+        return False
+
+    def is_file(self, path) -> bool:
+        return os.path.isfile(path)
+
+    def is_dir(self, path) -> bool:
+        return os.path.isdir(path)
+
+    def is_exist(self, path) -> bool:
+        return os.path.exists(path)
+
+    def touch(self, path, exist_ok=True):
+        if os.path.exists(path) and not exist_ok:
+            raise FileExistsError(path)
+        open(path, "a").close()
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite:
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def list_dirs(self, path):
+        return self.ls_dir(path)[0]
+
+
+class HDFSClient:
+    """API-parity shell: every operation raises — no HDFS client binary
+    ships in this image. Point checkpoint paths at local/NFS storage
+    (LocalFS) or GCS via gcsfuse mounts instead."""
+
+    def __init__(self, hadoop_home=None, configs=None, *a, **k):
+        self._reason = (
+            "HDFS is unavailable in the TPU deployment (no hadoop "
+            "client); use LocalFS paths or a mounted object store")
+
+    def __getattr__(self, name):
+        def _raise(*a, **k):
+            raise RuntimeError(f"HDFSClient.{name}: {self._reason}")
+        return _raise
+
+
+class UtilBase:
+    """reference fleet.UtilBase — filesystem + barrier helpers."""
+
+    def __init__(self):
+        self._fs = LocalFS()
+
+    def get_file_shard(self, files):
+        return list(files)
+
+    def all_gather(self, obj, comm_world="worker"):
+        return [obj]
+
+    def all_reduce(self, obj, mode="sum", comm_world="worker"):
+        return obj
+
+    def barrier(self, comm_world="worker"):
+        pass

@@ -104,3 +104,43 @@ def fftshift(x, axes=None, name=None):
 def ifftshift(x, axes=None, name=None):
     return apply_op("ifftshift",
                     lambda a: jnp.fft.ifftshift(a, axes=axes), x)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """reference: paddle.fft.hfft2 — hermitian 2-D fft (real output)."""
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    def fn(a):
+        ax = tuple(axes) if axes is not None else tuple(
+            range(-a.ndim, 0))
+        out = a
+        for i, d in enumerate(ax[:-1]):
+            out = jnp.fft.ifft(out, n=None if s is None else s[i],
+                               axis=d, norm=_inv_norm(norm))
+        n_last = None if s is None else s[-1]
+        return jnp.fft.hfft(out, n=n_last, axis=ax[-1], norm=norm)
+    return apply_op("hfftn", fn, x)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    def fn(a):
+        ax = tuple(axes) if axes is not None else tuple(
+            range(-a.ndim, 0))
+        out = jnp.fft.ihfft(a, n=None if s is None else s[-1],
+                            axis=ax[-1], norm=norm)
+        for i, d in enumerate(ax[:-1]):
+            out = jnp.fft.fft(out, n=None if s is None else s[i],
+                              axis=d, norm=_inv_norm(norm))
+        return out
+    return apply_op("ihfftn", fn, x)
+
+
+def _inv_norm(norm):
+    return {"backward": "forward", "forward": "backward",
+            "ortho": "ortho"}[norm]

@@ -1,0 +1,99 @@
+"""reference: python/paddle/geometric/ — graph message passing. The
+CUDA graph kernels collapse into segment reductions / gathers, which XLA
+maps onto sorted scatter-reduce."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, apply_op, _val
+from .incubate.segment_ops import (  # noqa: F401
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+
+_POOLS = {"sum": jax.ops.segment_sum, "mean": None,
+          "max": jax.ops.segment_max, "min": jax.ops.segment_min}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x at src, reduce at dst (reference send_u_recv)."""
+    n = out_size or int(_val(x).shape[0])
+
+    def fn(xv, si, di):
+        msgs = xv[si]
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msgs, di, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones_like(msgs), di,
+                                    num_segments=n)
+            return s / jnp.maximum(c, 1)
+        return _POOLS[reduce_op](msgs, di, num_segments=n)
+    return apply_op("send_u_recv", fn, x, src_index, dst_index)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine node features with edge features, then reduce."""
+    n = out_size or int(_val(x).shape[0])
+    comb = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide}[message_op]
+
+    def fn(xv, yv, si, di):
+        msgs = comb(xv[si], yv)
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msgs, di, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones_like(msgs), di,
+                                    num_segments=n)
+            return s / jnp.maximum(c, 1)
+        return _POOLS[reduce_op](msgs, di, num_segments=n)
+    return apply_op("send_ue_recv", fn, x, y, src_index, dst_index)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from both endpoints (no reduce)."""
+    comb = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide}[message_op]
+    return apply_op("send_uv",
+                    lambda xv, yv, si, di: comb(xv[si], yv[di]),
+                    x, y, src_index, dst_index)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling on a CSC graph (host-side numpy — graph
+    prep is input pipeline work, not accelerator work)."""
+    import numpy as np
+    r = np.asarray(_val(row))
+    cp = np.asarray(_val(colptr))
+    nodes = np.asarray(_val(input_nodes))
+    rng = np.random.default_rng(0)
+    out_n, out_count = [], []
+    for v in nodes:
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        neigh = r[lo:hi]
+        if 0 <= sample_size < neigh.size:
+            neigh = rng.choice(neigh, size=sample_size, replace=False)
+        out_n.append(neigh)
+        out_count.append(len(neigh))
+    flat = np.concatenate(out_n) if out_n else np.zeros((0,), r.dtype)
+    return (Tensor(jnp.asarray(flat)),
+            Tensor(jnp.asarray(np.asarray(out_count, np.int32))))
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact node ids to a local range (reference reindex_graph)."""
+    import numpy as np
+    xs = np.asarray(_val(x))
+    nb = np.asarray(_val(neighbors))
+    uniq = np.concatenate([xs, nb])
+    _, first_idx = np.unique(uniq, return_index=True)
+    order = uniq[np.sort(first_idx)]
+    remap = {int(v): i for i, v in enumerate(order)}
+    re_nb = np.asarray([remap[int(v)] for v in nb], np.int64)
+    out_nodes = order
+    return (Tensor(jnp.asarray(re_nb)),
+            Tensor(jnp.asarray(np.asarray(_val(count)))),
+            Tensor(jnp.asarray(out_nodes)))

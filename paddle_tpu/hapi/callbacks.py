@@ -199,3 +199,65 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
         c.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,
                       "metrics": metrics or []})
     return CallbackList(cbks)
+
+
+class ReduceLROnPlateau(Callback):
+    """reference: paddle.callbacks.ReduceLROnPlateau — shrink the lr when
+    the monitored metric plateaus."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.mode = mode
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self._best = None
+        self._wait = 0
+        self._cooldown_counter = 0
+
+    def _is_better(self, cur):
+        if self._best is None:
+            return True
+        if self.mode == "max" or (self.mode == "auto"
+                                  and "acc" in self.monitor):
+            return cur > self._best + self.min_delta
+        return cur < self._best - self.min_delta
+
+    def on_eval_end(self, logs=None):
+        self._step(logs or {})
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._step(logs or {})
+
+    def _step(self, logs):
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self._cooldown_counter > 0:
+            self._cooldown_counter -= 1
+            self._wait = 0
+        if self._is_better(cur):
+            self._best = cur
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                try:
+                    lr = opt.get_lr()
+                    new = max(lr * self.factor, self.min_lr)
+                    opt.set_lr(new)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr {lr:.3e} -> {new:.3e}")
+                except RuntimeError:
+                    pass   # scheduler-driven lr: leave to the scheduler
+            self._cooldown_counter = self.cooldown
+            self._wait = 0

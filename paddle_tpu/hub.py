@@ -1,0 +1,47 @@
+"""reference: python/paddle/hub.py — torch.hub-style loading from a
+LOCAL directory (source="local"). Remote github sources need network
+egress, which this environment forbids — they raise with guidance."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+HUB_CONF = "hubconf.py"
+
+
+def _load_local_entry(repo_dir: str):
+    path = os.path.join(repo_dir, HUB_CONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {HUB_CONF} in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _entries(mod):
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False):
+    if source != "local":
+        raise NotImplementedError(
+            "paddle.hub: only source='local' is supported (no network "
+            "egress on this deployment); clone the repo and pass its path")
+    return _entries(_load_local_entry(repo_dir))
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False):
+    if source != "local":
+        raise NotImplementedError("paddle.hub: only source='local'")
+    return getattr(_load_local_entry(repo_dir), model).__doc__
+
+
+def load(repo_dir: str, model: str, *args, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    if source != "local":
+        raise NotImplementedError("paddle.hub: only source='local'")
+    return getattr(_load_local_entry(repo_dir), model)(*args, **kwargs)

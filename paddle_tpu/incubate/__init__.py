@@ -1,3 +1,73 @@
 """paddle_tpu.incubate (reference: python/paddle/incubate/)."""
 
 from . import distributed, nn  # noqa: F401
+from . import asp  # noqa: F401
+from . import optimizer  # noqa: F401
+from .segment_ops import (  # noqa: F401
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """reference: incubate.softmax_mask_fuse — softmax(x + mask) fused
+    (XLA fuses the add into the softmax automatically)."""
+    import jax
+    from ..core.tensor import apply_op
+    return apply_op("softmax_mask_fuse",
+                    lambda a, m: jax.nn.softmax(a + m, axis=-1), x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """reference: incubate.softmax_mask_fuse_upper_triangle — causal
+    masked softmax (upper triangle masked)."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+
+    def fn(a):
+        s = a.shape[-1]
+        mask = jnp.tril(jnp.ones((a.shape[-2], s), bool))
+        return jax.nn.softmax(jnp.where(mask, a, -1e30), axis=-1)
+    return apply_op("softmax_mask_fuse_ut", fn, x)
+
+
+def identity_loss(x, reduction="none"):
+    """reference: incubate.identity_loss."""
+    if reduction in (0, "sum"):
+        return x.sum()
+    if reduction in (1, "mean"):
+        return x.mean()
+    return x
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling via repeated 1-hop sampling."""
+    from ..geometric import sample_neighbors
+    cur = input_nodes
+    all_n, all_c = [], []
+    for k in sample_sizes:
+        nb, ct = sample_neighbors(row, colptr, cur, sample_size=k)
+        all_n.append(nb)
+        all_c.append(ct)
+        cur = nb
+    return all_n, all_c
+
+
+def graph_reindex(x, neighbors, count, **kwargs):
+    from ..geometric import reindex_graph
+    return reindex_graph(x, neighbors, count)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                           **kwargs):
+    from ..geometric import sample_neighbors
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size)

@@ -1,0 +1,73 @@
+"""reference: python/paddle/incubate/asp/ — automatic sparsity (2:4
+structured pruning). TPU-native formulation: the 2:4 mask is computed on
+host per weight and applied as a multiplicative mask after each
+optimizer step (the reference's OptimizerWithSparsityGuarantee); sparse
+MXU execution is a hardware feature this build does not claim — the
+masks deliver the MODEL side (pruned weights, mask maintenance)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+_excluded: Dict[int, List[str]] = {}
+
+
+def set_excluded_layers(param_names=None, main_program=None, model=None):
+    _excluded[0] = list(param_names or [])
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.pop(0, None)
+
+
+def _mask_2_4(w: np.ndarray) -> np.ndarray:
+    """2:4 mask along the last dim: keep the 2 largest-|w| of each 4."""
+    shape = w.shape
+    flat = np.abs(w.reshape(-1, shape[-1]))
+    pad = (-flat.shape[-1]) % 4
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    g = flat.reshape(flat.shape[0], -1, 4)
+    order = np.argsort(g, axis=-1)
+    mask = np.zeros_like(g)
+    np.put_along_axis(mask, order[..., 2:], 1.0, axis=-1)
+    mask = mask.reshape(flat.shape[0], -1)[:, :shape[-1]]
+    return mask.reshape(shape)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply 2:4 masks to every prunable weight (>=2-D, not excluded)."""
+    excl = set(_excluded.get(0, []))
+    masks = {}
+    for name, p in model.named_parameters():
+        if p._value.ndim < 2 or name in excl:
+            continue
+        mask = _mask_2_4(np.asarray(p._value))
+        p._value = p._value * jnp.asarray(mask, p._value.dtype)
+        masks[name] = mask
+    model._asp_masks = masks
+    return masks
+
+
+def decorate(optimizer):
+    """Wrap an optimizer so each step re-applies the sparsity masks."""
+
+    class _ASPOptimizer:
+        def __init__(self, opt):
+            self._opt = opt
+
+        def __getattr__(self, k):
+            return getattr(self._opt, k)
+
+        def step(self):
+            self._opt.step()
+            for p in self._opt._params():
+                mask = getattr(p, "_asp_mask", None)
+                if mask is not None:
+                    p._value = p._value * mask
+
+    return _ASPOptimizer(optimizer)

@@ -1,1 +1,143 @@
 from . import functional  # noqa: F401
+
+
+# ------------------------------------------------- fused transformer layers
+# reference: python/paddle/incubate/nn/layer/fused_transformer.py — Layer
+# wrappers over the fused functional surface.
+import jax.numpy as jnp
+
+from ...nn import functional as _F
+from ...nn.initializer import XavierNormal as _XN
+from ...nn.layer import Layer as _Layer
+from . import functional as _IF
+
+
+class FusedLinear(_Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self._tw = transpose_weight
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = self.create_parameter(
+            shape, default_initializer=_XN())
+        self.bias = (self.create_parameter((out_features,), is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        return _IF.fused_linear(x, self.weight, self.bias,
+                                transpose_weight=self._tw)
+
+
+class FusedBiasDropoutResidualLayerNorm(_Layer):
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self._p = dropout_rate
+        self._eps = epsilon
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), default_initializer=None, is_bias=False)
+        self.ln_bias = self.create_parameter((embed_dim,), is_bias=True)
+
+    def forward(self, x, residual):
+        return _IF.fused_bias_dropout_residual_layer_norm(
+            x, residual, None, self.ln_scale, self.ln_bias,
+            dropout_rate=self._p if self.training else 0.0,
+            ln_epsilon=self._eps)
+
+
+class FusedMultiHeadAttention(_Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 weight_attr=None, bias_attr=None, epsilon=1e-5,
+                 name=None):
+        super().__init__()
+        self.num_heads = num_heads
+        self.pre_ln = normalize_before
+        d = embed_dim
+        self.qkv_weight = self.create_parameter(
+            (3, num_heads, d // num_heads, d), default_initializer=_XN())
+        self.linear_weight = self.create_parameter(
+            (d, d), default_initializer=_XN())
+
+    def forward(self, query, attn_mask=None, **kw):
+        return _IF.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.pre_ln, num_heads=self.num_heads,
+            attn_mask=attn_mask)
+
+
+class FusedFeedForward(_Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, **kw):
+        super().__init__()
+        self.pre_ln = normalize_before
+        self.act = activation
+        self.w1 = self.create_parameter((d_model, dim_feedforward),
+                                        default_initializer=_XN())
+        self.w2 = self.create_parameter((dim_feedforward, d_model),
+                                        default_initializer=_XN())
+
+    def forward(self, src, **kw):
+        return _IF.fused_feedforward(src, self.w1, self.w2,
+                                     activation=self.act,
+                                     pre_layer_norm=self.pre_ln)
+
+
+class FusedTransformerEncoderLayer(_Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False, **kw):
+        super().__init__()
+        self.attn = FusedMultiHeadAttention(
+            d_model, nhead, normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(d_model, dim_feedforward,
+                                    activation=activation,
+                                    normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, **kw):
+        return self.ffn(self.attn(src, attn_mask=src_mask))
+
+
+class FusedMultiTransformer(_Layer):
+    """reference: incubate.nn.FusedMultiTransformer — the stacked fused
+    decoder used by the inference engine; thin wrapper over the
+    fused_multi_transformer functional (KV-cache capable)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 num_layers=1, **kw):
+        super().__init__()
+        d = embed_dim
+        self.num_heads = num_heads
+        mk = lambda *shape: self.create_parameter(
+            tuple(shape), default_initializer=_XN())
+        ones = lambda *shape: self.create_parameter(
+            tuple(shape), is_bias=True)
+        self.ln_scales = [mk(d) for _ in range(num_layers)]
+        self.ln_biases = [ones(d) for _ in range(num_layers)]
+        self.qkv_weights = [mk(3, num_heads, d // num_heads, d)
+                            for _ in range(num_layers)]
+        self.qkv_biases = [ones(3, num_heads, d // num_heads)
+                           for _ in range(num_layers)]
+        self.out_weights = [mk(d, d) for _ in range(num_layers)]
+        self.out_biases = [ones(d) for _ in range(num_layers)]
+        self.ffn_ln_scales = [mk(d) for _ in range(num_layers)]
+        self.ffn_ln_biases = [ones(d) for _ in range(num_layers)]
+        self.ffn1_weights = [mk(d, dim_feedforward)
+                             for _ in range(num_layers)]
+        self.ffn1_biases = [ones(dim_feedforward)
+                            for _ in range(num_layers)]
+        self.ffn2_weights = [mk(dim_feedforward, d)
+                             for _ in range(num_layers)]
+        self.ffn2_biases = [ones(d) for _ in range(num_layers)]
+
+    def forward(self, src, attn_mask=None, caches=None, **kw):
+        return _IF.fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.out_weights, self.out_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            attn_mask=attn_mask, caches=caches)

@@ -450,3 +450,77 @@ def fused_linear_cross_entropy(hidden, weight, labels, transpose_y=False,
         return jnp.sum(per) / count.astype(jnp.float32)
 
     return apply_op("fused_linear_cross_entropy", fn, hidden, weight, labels)
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu"):
+    """reference: incubate.nn.functional.fused_ec_moe — expert-choice
+    style batched-expert FFN: gate (B, S, E) soft-combines E expert
+    FFNs run as batched matmuls (MXU-friendly einsum formulation)."""
+    import jax
+    from ...core.tensor import apply_op
+
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[act_type]
+
+    def fn(xv, gv, w0, b0, w1, b1):
+        h = jnp.einsum("bsd,edh->bseh", xv, w0) + b0
+        h = act(h)
+        out = jnp.einsum("bseh,ehd->bsed", h, w1) + b1
+        probs = jax.nn.softmax(gv, axis=-1)
+        return jnp.einsum("bsed,bse->bsd", out, probs)
+    return apply_op("fused_ec_moe", fn, x, gate, bmm0_weight, bmm0_bias,
+                    bmm1_weight, bmm1_bias)
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               out_scale=-1, seq_len=1, rotary_emb_dims=0,
+                               **kwargs):
+    """reference: incubate.nn.functional.masked_multihead_attention — the
+    one-token decode attention against a running cache. Maps onto the
+    decode path of kernels/decode_attention (static cache, GQA-ready)."""
+    from ...core.tensor import Tensor, _val
+    from ...kernels.decode_attention import cached_attention, update_kv_cache
+    xv = _val(x)
+    b, three_hd = xv.shape[0], xv.shape[-1]
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention needs cache_kv")
+    ck = _val(cache_kv)                    # (2, B, T, H, D)
+    h, t, d = ck.shape[3], ck.shape[2], ck.shape[4]
+    qkv = xv.reshape(b, 1, 3, h, d)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    cur = _val(sequence_lengths) if sequence_lengths is not None else t - 1
+    kc, vc = update_kv_cache(ck[0], ck[1], k, v, cur)
+    out = cached_attention(q, kc, vc, jnp.asarray(cur) + 1)
+    new_cache = jnp.stack([kc, vc])
+    return (Tensor(out.reshape(b, h * d)), Tensor(new_cache))
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens=None, kv_seq_lens=None, mask=None,
+        scale=None, causal=False, pre_cache_length=0):
+    """reference: incubate.nn.functional.variable_length_memory_efficient
+    _attention — varlen attention without materialized (S, S) scores.
+    TPU-native: the flash kernel's segment-id masking IS the varlen
+    mechanism; ragged lengths become per-row segment ids."""
+    from ...core.tensor import Tensor, _val
+    from ...kernels.flash_attention import flash_attention_bshd
+    q, k, v = _val(query), _val(key), _val(value)
+    # (B, H, S, D) reference layout -> (B, S, H, D)
+    qb = jnp.swapaxes(q, 1, 2)
+    kb = jnp.swapaxes(k, 1, 2)
+    vb = jnp.swapaxes(v, 1, 2)
+    b, s = qb.shape[0], qb.shape[1]
+    if seq_lens is not None:
+        lens = _val(seq_lens).reshape(-1)
+        pos = jnp.arange(s)[None, :]
+        seg = jnp.where(pos < lens[:, None], 0, 1).astype(jnp.int32)
+    else:
+        seg = None
+    try:
+        out = flash_attention_bshd(qb, kb, vb, segment_ids=seg,
+                                   causal=causal, sm_scale=scale)
+    except NotImplementedError:
+        from ...kernels.decode_attention import cached_attention_dense
+        out = cached_attention_dense(qb, kb, vb, s, sm_scale=scale)
+    return Tensor(jnp.swapaxes(out, 1, 2))

@@ -1,0 +1,79 @@
+"""reference: python/paddle/incubate/optimizer/ — LookAhead, ModelAverage
+(+ LBFGS re-export from paddle.optimizer)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..optimizer.optimizer import Optimizer
+from ..optimizer import LBFGS  # noqa: F401  (surface parity)
+
+
+class LookAhead(Optimizer):
+    """reference: incubate.LookAhead(inner_optimizer, alpha, k) — slow
+    weights pulled toward fast weights every k steps."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._slow = {}
+        self._lk_steps = 0
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+    def step(self):
+        self.inner.step()
+        self._lk_steps += 1
+        if self._lk_steps % self.k:
+            return
+        for p in self.inner._params():
+            if p.name not in self._slow:
+                self._slow[p.name] = p._value
+            slow = self._slow[p.name] + self.alpha * (
+                p._value - self._slow[p.name])
+            self._slow[p.name] = slow
+            p._value = slow
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self.inner.clear_grad(set_to_zero)
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return None, []
+
+
+class ModelAverage(Optimizer):
+    """reference: incubate.ModelAverage — running average of parameters
+    applied for evaluation via apply()/restore()."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self._sum = {}
+        self._count = 0
+        self._saved = None
+
+    def step(self):
+        self._count += 1
+        for p in self._params():
+            acc = self._sum.get(p.name)
+            self._sum[p.name] = (p._value if acc is None
+                                 else acc + p._value)
+
+    def apply(self, executor=None, need_restore=True):
+        self._saved = {p.name: p._value for p in self._params()}
+        for p in self._params():
+            if p.name in self._sum and self._count:
+                p._value = (self._sum[p.name] / self._count).astype(
+                    p._value.dtype)
+
+    def restore(self, executor=None):
+        if self._saved:
+            for p in self._params():
+                if p.name in self._saved:
+                    p._value = self._saved[p.name]
+            self._saved = None

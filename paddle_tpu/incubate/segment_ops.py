@@ -1,0 +1,40 @@
+"""Segment reductions (reference: python/paddle/incubate/tensor/math.py
+segment_* — CUDA segment kernels). TPU-native: jax.ops.segment_* lower to
+one sorted scatter-reduce; ids must be non-decreasing per the reference
+contract, num_segments = ids[-1]+1."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import apply_op, _val
+
+
+def _segment(name, reducer, data, ids):
+    n = int(_val(ids).max()) + 1 if _val(ids).size else 0
+
+    def fn(d, i):
+        return reducer(d, i, num_segments=n)
+    return apply_op(name, fn, data, ids)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment("segment_sum", jax.ops.segment_sum, data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    def mean(d, i, num_segments):
+        s = jax.ops.segment_sum(d, i, num_segments=num_segments)
+        c = jax.ops.segment_sum(jnp.ones_like(d), i,
+                                num_segments=num_segments)
+        return s / jnp.maximum(c, 1)
+    return _segment("segment_mean", mean, data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment("segment_max", jax.ops.segment_max, data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment("segment_min", jax.ops.segment_min, data, segment_ids)

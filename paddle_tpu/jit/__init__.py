@@ -208,3 +208,10 @@ def jit_fn(fn=None, *, static_argnums=(), donate_argnums=()):
 
 
 from .save_load import TranslatedLayer, load, save  # noqa: E402,F401
+
+
+def enable_to_static(enable: bool = True):
+    """reference: paddle.jit.enable_to_static — global switch; to_static
+    becomes a passthrough when disabled."""
+    global _to_static_enabled
+    _to_static_enabled = bool(enable)

@@ -1,5 +1,7 @@
 """paddle.linalg namespace (reference: python/paddle/linalg.py re-exports
 the tensor.linalg surface)."""
+import jax.numpy as jnp
+from .core.tensor import apply_op, _val
 from .ops.linalg import *  # noqa: F401,F403
 from .ops.linalg import (cond, cov, corrcoef, eig, eigh, eigvals,  # noqa: F401
                          eigvalsh, det, slogdet, inv, inverse, pinv, solve,
@@ -7,3 +9,53 @@ from .ops.linalg import (cond, cov, corrcoef, eig, eigh, eigvals,  # noqa: F401
                          matrix_power, matrix_rank, cholesky,
                          cholesky_solve, triangular_solve, multi_dot,
                          matrix_exp, householder_product, norm)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """reference: paddle.linalg.vector_norm."""
+    def fn(a):
+        return jnp.linalg.vector_norm(a, ord=p, axis=axis,
+                                      keepdims=keepdim)
+    return apply_op("vector_norm", fn, x)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """reference: paddle.linalg.matrix_norm."""
+    def fn(a):
+        m = jnp.moveaxis(a, axis, (-2, -1)) if axis != (-2, -1) else a
+        out = jnp.linalg.matrix_norm(m, ord=p, keepdims=keepdim)
+        return out
+    return apply_op("matrix_norm", fn, x)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """reference: paddle.linalg.svd_lowrank — randomized low-rank SVD
+    (Halko et al. subspace iteration)."""
+    import jax as _jax
+    from .framework.random import next_key
+
+    def fn(a):
+        m = a if M is None else a - _val(M)
+        n = m.shape[-1]
+        g = _jax.random.normal(next_key(), m.shape[:-2] + (n, q),
+                               jnp.float32).astype(m.dtype)
+        y = m @ g
+        for _ in range(niter):
+            y = m @ (jnp.swapaxes(m, -2, -1) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(qmat, -2, -1) @ m
+        u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u_b, s, jnp.swapaxes(vh, -2, -1)
+    return apply_op("svd_lowrank", fn, x)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """reference: paddle.linalg.pca_lowrank."""
+    v = _val(x)
+    k = q if q is not None else min(6, *v.shape[-2:])
+
+    def fn(a):
+        m = a - jnp.mean(a, axis=-2, keepdims=True) if center else a
+        return m
+    centered = apply_op("pca_center", fn, x)
+    return svd_lowrank(centered, q=k, niter=niter)

@@ -32,7 +32,9 @@ from .layers.extra import (  # noqa: F401
     InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, LocalResponseNorm,
     MarginRankingLoss, MaxPool1D, MaxPool3D, MultiLabelSoftMarginLoss,
     PairwiseDistance, PoissonNLLLoss, SoftMarginLoss, TripletMarginLoss,
-    Unfold, ZeroPad2D,
+    Unfold, ZeroPad2D, ZeroPad1D, ZeroPad3D, Unflatten, Softmax2D, Silu,
+    FeatureAlphaDropout, TripletMarginWithDistanceLoss, HSigmoidLoss,
+    AdaptiveLogSoftmaxWithLoss, FractionalMaxPool2D, FractionalMaxPool3D,
     AlphaDropout, Dropout3D, HuberLoss, MaxUnPool1D, MaxUnPool2D,
     MaxUnPool3D, Maxout, MultiMarginLoss, Pad1D, Pad3D, PixelUnshuffle,
     RNNTLoss, RReLU, SpectralNorm, ThresholdedReLU, UpsamplingBilinear2D,

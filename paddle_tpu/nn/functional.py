@@ -829,4 +829,11 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
 
 
 # extended surface: 3-D conv/pool family, grid sampling, CTC, loss zoo
+def softmax_(x, axis=-1, dtype=None, name=None):
+    """In-place softmax (reference F.softmax_)."""
+    out = softmax(x, axis=axis, dtype=dtype)
+    x._value = out._value
+    return x
+
+
 from .functional_extra import *  # noqa: F401,F403,E402

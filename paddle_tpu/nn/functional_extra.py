@@ -923,3 +923,174 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
 
     return apply_op("rnnt_loss", fn, logits, labels, logit_lengths,
                     label_lengths)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """reference: F.feature_alpha_dropout — alpha dropout over whole
+    channel maps (mask shape (N, C, 1, ...))."""
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(_val(x))
+    from ..framework.random import next_key
+    import jax as _jax
+
+    def fn(a):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        mask_shape = a.shape[:2] + (1,) * (a.ndim - 2)
+        keep = _jax.random.bernoulli(next_key(), 1.0 - p, mask_shape)
+        am = 1.0 / jnp.sqrt((alpha_p ** 2 * p + 1.0) * (1.0 - p))
+        bm = -am * alpha_p * p
+        out = jnp.where(keep, a, alpha_p)
+        return out * am + bm
+    return apply_op("feature_alpha_dropout", fn, x)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """reference: F.triplet_margin_with_distance_loss — triplet loss
+    with a user distance callable."""
+    dist = distance_function or (
+        lambda a, b: ((a - b) ** 2).sum(-1).sqrt())
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        from ..ops import math as _m
+        d_neg = _m.minimum(d_neg, dist(positive, negative))
+    from ..ops import math as _m
+    loss = _m.maximum(d_pos - d_neg + margin,
+                      Tensor(jnp.zeros((), _val(d_pos).dtype)))
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """reference: F.hsigmoid_loss — hierarchical sigmoid over a complete
+    binary tree (default tree when no custom path is given)."""
+    def fn(x, lab, w, *rest):
+        b = rest[0] if rest else None
+        n = x.shape[0]
+        code_len = int(np.ceil(np.log2(max(2, num_classes))))
+        # complete-binary-tree paths: internal node ids + left/right codes
+        labels = lab.reshape(-1)
+        nodes = []
+        codes = []
+        cur = labels + num_classes          # leaf position in heap order
+        for _ in range(code_len):
+            parent = cur // 2
+            nodes.append(parent - 1)        # internal nodes are 1-based
+            codes.append((cur % 2).astype(x.dtype))
+            cur = parent
+        node_idx = jnp.stack(nodes, 1)      # (N, L)
+        code = jnp.stack(codes, 1)          # (N, L): 1 = right child
+        valid = node_idx < (num_classes - 1)
+        node_idx = jnp.clip(node_idx, 0, w.shape[0] - 1)
+        wn = w[node_idx]                    # (N, L, D)
+        logits = jnp.einsum("nld,nd->nl", wn, x)
+        if b is not None:
+            logits = logits + b.reshape(-1)[node_idx]
+        # p(right) = sigmoid(logit); loss = -sum log p(code)
+        logp = -jnp.logaddexp(0.0, jnp.where(code > 0, -logits, logits))
+        loss = -(jnp.where(valid, logp, 0.0)).sum(1)
+        return loss[:, None]
+    args = [input, label, weight] + ([bias] if bias is not None else [])
+    return apply_op("hsigmoid_loss", fn, *args)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """reference: F.class_center_sample (PartialFC sampling): returns
+    (remapped_label, sampled_class_indices). Positive classes always
+    kept; negatives fill up to num_samples (deterministic fill — jax
+    RNG sampling of the remainder)."""
+    from ..framework.random import next_key
+    import jax as _jax
+    lab = _val(label).reshape(-1)
+    pos = np.unique(np.asarray(lab))
+    n_extra = max(0, num_samples - pos.size)
+    rest = np.setdiff1d(np.arange(num_classes), pos)
+    if n_extra and rest.size:
+        perm = np.asarray(_jax.random.permutation(next_key(), rest.size))
+        extra = rest[perm[:n_extra]]
+    else:
+        extra = rest[:0]
+    sampled = np.sort(np.concatenate([pos, extra]))
+    remap = np.full((num_classes,), -1, np.int64)
+    remap[sampled] = np.arange(sampled.size)
+    new_label = apply_op("class_center_sample",
+                         lambda l: jnp.asarray(remap)[l], label)
+    return new_label, Tensor(jnp.asarray(sampled), stop_gradient=True)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """reference: F.margin_cross_entropy (ArcFace-style combined margin:
+    cos(m1*theta + m2) - m3 on the target logit, then scaled CE)."""
+    def fn(lg, lab):
+        lab_ = lab.reshape(-1)
+        theta = jnp.arccos(jnp.clip(lg, -1.0, 1.0))
+        tgt = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(lab_, lg.shape[-1], dtype=lg.dtype)
+        adjusted = jnp.where(onehot > 0, tgt, lg) * scale
+        logp = jax.nn.log_softmax(adjusted, axis=-1)
+        loss = -jnp.take_along_axis(logp, lab_[:, None], axis=-1)
+        if reduction == "mean":
+            loss_out = jnp.mean(loss)
+        elif reduction == "sum":
+            loss_out = jnp.sum(loss)
+        else:
+            loss_out = loss
+        if return_softmax:
+            return loss_out, jax.nn.softmax(adjusted, -1)
+        return loss_out
+    return apply_op("margin_cross_entropy", fn, logits, label)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """reference: F.adaptive_log_softmax_with_loss (Grave et al. adaptive
+    softmax): head cluster + tail clusters with projection pairs."""
+    def fn(x, lab, hw, *rest):
+        n_clusters = len(cutoffs)
+        if head_bias is not None:
+            hb = rest[-1]
+            tails = rest[:-1]
+        else:
+            hb = None
+            tails = rest
+        head_logits = x @ hw.T + (hb if hb is not None else 0.0)
+        head_logp = jax.nn.log_softmax(head_logits, -1)
+        shortlist = cutoffs[0]
+        lab_ = lab.reshape(-1)
+        out = jnp.zeros_like(lab_, dtype=x.dtype)
+        # shortlist words
+        in_short = lab_ < shortlist
+        short_lp = jnp.take_along_axis(
+            head_logp, jnp.clip(lab_, 0, shortlist - 1)[:, None], -1)[:, 0]
+        out = jnp.where(in_short, short_lp, out)
+        # tail clusters
+        lo = shortlist
+        for ci in range(n_clusters):
+            hi = cutoffs[ci + 1] if ci + 1 < len(cutoffs) else None
+            hi = hi if hi is not None else lab_.max() + 1
+            proj, w = tails[2 * ci], tails[2 * ci + 1]
+            z = (x @ proj.T) @ w.T
+            lp = jax.nn.log_softmax(z, -1)
+            rel = jnp.clip(lab_ - lo, 0, w.shape[0] - 1)
+            cluster_lp = head_logp[:, shortlist + ci] + jnp.take_along_axis(
+                lp, rel[:, None], -1)[:, 0]
+            mask = (lab_ >= lo) & (lab_ < hi)
+            out = jnp.where(mask, cluster_lp, out)
+            lo = hi
+        loss = -out.mean()
+        return out, loss
+    args = [input, label, head_weight] + list(tail_weights) \
+        + ([head_bias] if head_bias is not None else [])
+    return apply_op("adaptive_log_softmax_with_loss", fn, *args)

@@ -174,3 +174,24 @@ def set_global_initializer(weight_init, bias_init=None):
 
 _global_weight_init: Optional[Initializer] = None
 _global_bias_init: Optional[Initializer] = None
+
+
+class Bilinear(Initializer):
+    """reference: paddle.nn.initializer.Bilinear — bilinear upsampling
+    kernel init for (transposed) conv weights (C_out, C_in, kH, kW)."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+        shape = tuple(shape)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight")
+        kh, kw = shape[2], shape[3]
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        ch = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        cw = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        og = np.ogrid[:kh, :kw]
+        filt = ((1 - abs(og[0] / fh - ch)) * (1 - abs(og[1] / fw - cw)))
+        w = np.zeros(shape, np.float32)
+        for i in range(min(shape[0], shape[1])):
+            w[i, i] = filt
+        return w.astype("float32")

@@ -579,3 +579,192 @@ class RNNTLoss(Layer):
     def forward(self, logits, labels, logit_lengths, label_lengths):
         return F.rnnt_loss(logits, labels, logit_lengths, label_lengths,
                            blank=self._blank, reduction=self._reduction)
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self._padding = padding if isinstance(padding, (list, tuple)) \
+            else (padding, padding)
+        self._fmt = data_format
+
+    def forward(self, x):
+        pad = list(self._padding)
+        axis = -1 if self._fmt == "NCL" else 1
+        return F.pad(x, [0, 0] * (2 if self._fmt == "NCL" else 1)
+                     + pad if axis == -1 else pad, mode="constant")
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        p = padding
+        self._padding = (p,) * 6 if isinstance(p, int) else tuple(p)
+        self._fmt = data_format
+
+    def forward(self, x):
+        return F.pad(x, list(self._padding), mode="constant",
+                     data_format=self._fmt)
+
+
+class Unflatten(Layer):
+    """reference: paddle.nn.Unflatten."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self._axis, self._shape = axis, tuple(shape)
+
+    def forward(self, x):
+        from ...ops.manipulation import unflatten as _unf
+        return _unf(x, self._axis, self._shape)
+
+
+class Softmax2D(Layer):
+    """reference: paddle.nn.Softmax2D — softmax over the channel dim of
+    (N, C, H, W)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class Silu(Layer):
+    def forward(self, x):
+        return F.silu(x)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self._p, training=self.training)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._args = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        d, m, s, r = self._args
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, distance_function=d, margin=m,
+            swap=s, reduction=r)
+
+
+class HSigmoidLoss(Layer):
+    """reference: paddle.nn.HSigmoidLoss — holds the tree weights."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size),
+            attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.XavierNormal())
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (num_classes - 1, 1), is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self.num_classes,
+                               self.weight, self.bias)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """reference: paddle.nn.AdaptiveLogSoftmaxWithLoss."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        self.cutoffs = list(cutoffs)
+        self.n_clusters = len(self.cutoffs)
+        shortlist = self.cutoffs[0]
+        self.head_weight = self.create_parameter(
+            (shortlist + self.n_clusters, in_features),
+            default_initializer=I.XavierNormal())
+        self.head_bias = (self.create_parameter(
+            (shortlist + self.n_clusters,), is_bias=True)
+            if head_bias else None)
+        self.tail_weights = []
+        bounds = self.cutoffs + [n_classes]
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features // (div_value ** (i + 1))))
+            osz = bounds[i + 1] - bounds[i]
+            proj = self.create_parameter((hsz, in_features),
+                                         default_initializer=I.XavierNormal())
+            w = self.create_parameter((osz, hsz),
+                                      default_initializer=I.XavierNormal())
+            setattr(self, f"tail_proj_{i}", proj)
+            setattr(self, f"tail_w_{i}", w)
+            self.tail_weights += [proj, w]
+
+    def forward(self, input, label):
+        out, loss = F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs, head_bias=self.head_bias)
+        return out, loss
+
+
+class FractionalMaxPool2D(Layer):
+    """reference: paddle.nn.FractionalMaxPool2D — pseudo-random
+    fractional pooling (Graham 2014); the region sequence is derived
+    from output_size with the deterministic 'pseudo' scheme."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._out = output_size
+        self._return_mask = return_mask
+
+    def forward(self, x):
+        return _fractional_pool(x, self._out, nd=2,
+                                return_mask=self._return_mask)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._out = output_size
+        self._return_mask = return_mask
+
+    def forward(self, x):
+        return _fractional_pool(x, self._out, nd=3,
+                                return_mask=self._return_mask)
+
+
+def _fractional_pool(x, output_size, nd, return_mask=False):
+    from ...core.tensor import apply_op as _ap
+    import jax.numpy as _jnp
+    v = x._value if hasattr(x, "_value") else x
+    spatial = v.shape[-nd:]
+    outs = ((output_size,) * nd if isinstance(output_size, int)
+            else tuple(output_size))
+
+    def fn(a):
+        out = a
+        for d in range(nd):
+            axis = a.ndim - nd + d
+            n_in, n_out = spatial[d], outs[d]
+            # deterministic fractional boundaries: floor(i * n_in/n_out)
+            edges = _jnp.floor(
+                _jnp.arange(n_out + 1) * (n_in / n_out)).astype(int)
+            pieces = [
+                _jnp.max(_jnp.take(out, _jnp.arange(int(edges[i]),
+                                                    max(int(edges[i]) + 1,
+                                                        int(edges[i + 1]))),
+                                   axis=axis), axis=axis, keepdims=True)
+                for i in range(n_out)]
+            out = _jnp.concatenate(pieces, axis=axis)
+        return out
+    res = _ap("fractional_max_pool", fn, x)
+    if return_mask:
+        raise NotImplementedError("fractional pool return_mask")
+    return res

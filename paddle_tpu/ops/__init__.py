@@ -48,6 +48,33 @@ def _bind_methods() -> None:
     Tensor.pin_memory = lambda self, *a, **k: self  # arrays live in HBM
     Tensor.normal_ = _normal_
     Tensor.uniform_ = random.uniform_    # same in-place fill as ops.random
+    # creation-module ops that are Tensor methods upstream
+    for _n in ("tril", "triu", "diag", "diagflat"):
+        if not hasattr(Tensor, _n):
+            setattr(Tensor, _n, getattr(creation, _n))
+    # inplace variants (reference: generated *_ methods): same math, the
+    # Tensor's value is replaced and the tensor returned
+    for _n in ("abs", "ceil", "cos", "exp", "floor", "reciprocal",
+               "round", "rsqrt", "sin", "sqrt", "tan", "tanh", "lerp",
+               "remainder", "clip", "add", "subtract", "scale",
+               "masked_fill", "masked_scatter", "scatter", "logit",
+               "bernoulli_like_"):
+        _fn = getattr(math, _n, None) or getattr(manipulation, _n, None)
+        if _fn is None:
+            continue
+        def _mk(fn):
+            def _inplace(self, *a, **k):
+                out = fn(self, *a, **k)
+                self._value = out._value
+                return self
+            return _inplace
+        setattr(Tensor, _n + "_", _mk(_fn))
+    Tensor.increment = math.increment
+    Tensor.index_fill = manipulation.index_fill
+    Tensor.index_fill_ = manipulation.index_fill_
+    Tensor.diagonal_scatter = manipulation.diagonal_scatter
+    Tensor.unstack = manipulation.unstack
+    Tensor.positive = math.positive
 
 
 def _normal_(x, mean=0.0, std=1.0, name=None):

@@ -653,3 +653,70 @@ def unsqueeze_(x, axis, name=None):
     out = unsqueeze(x, axis)
     x._value = out._value
     return x
+
+
+def unstack(x, axis=0, num=None, name=None):
+    """reference: paddle.unstack — split and squeeze along axis."""
+    v = _val(x)
+    n = v.shape[axis] if num is None else num
+    return [apply_op("unstack", lambda a, _i=i: jnp.take(a, _i, axis=axis),
+                     x) for i in range(n)]
+
+
+def index_fill(x, index, axis, value, name=None):
+    """reference: paddle.index_fill — rows at ``index`` along ``axis``
+    filled with ``value``."""
+    def fn(a, idx):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[idx].set(value)
+        return jnp.moveaxis(moved, 0, axis)
+    return apply_op("index_fill", fn, x, index)
+
+
+def index_fill_(x, index, axis, value, name=None):
+    out = index_fill(x, index, axis, value)
+    x._value = out._value
+    return x
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """reference: paddle.diagonal_scatter — write y onto a diagonal."""
+    def fn(a, b):
+        moved = jnp.moveaxis(a, (axis1, axis2), (-2, -1))
+        n = min(moved.shape[-2], moved.shape[-1]) - abs(offset)
+        i = jnp.arange(n) + (0 if offset >= 0 else -offset)
+        j = jnp.arange(n) + (offset if offset >= 0 else 0)
+        moved = moved.at[..., i, j].set(b)
+        return jnp.moveaxis(moved, (-2, -1), (axis1, axis2))
+    return apply_op("diagonal_scatter", fn, x, y)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    """reference: paddle.select_scatter — write a slice at ``index``."""
+    def fn(a, b):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[index].set(b)
+        return jnp.moveaxis(moved, 0, axis)
+    return apply_op("select_scatter", fn, x, values)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """reference: paddle.slice_scatter — write into a strided slice."""
+    def fn(a, b):
+        idx = [slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = slice(s, e, st)
+        return a.at[tuple(idx)].set(b)
+    return apply_op("slice_scatter", fn, x, value)
+
+
+def masked_fill_(x, mask, value, name=None):
+    out = masked_fill(x, mask, value)
+    x._value = out._value
+    return x
+
+
+def masked_scatter_(x, mask, value, name=None):
+    out = masked_scatter(x, mask, value)
+    x._value = out._value
+    return x

@@ -487,3 +487,88 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
         _val(x), bins=bins, range=ranges, density=density,
         weights=None if weights is None else _val(weights))
     return Tensor(h), [Tensor(e) for e in edges]
+
+
+def logit(x, eps=None, name=None):
+    """reference: paddle.logit — log(x / (1-x)), eps-clamped."""
+    def fn(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a) - jnp.log1p(-a)
+    return apply_op("logit", fn, x)
+
+
+def increment(x, value=1.0, name=None):
+    """reference: paddle.increment — x + value (1-element tensors)."""
+    return apply_op("increment", lambda a: a + value, x)
+
+
+def positive(x, name=None):
+    """reference: paddle.positive — identity on numeric tensors."""
+    return apply_op("positive", lambda a: +a, x)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """reference: paddle.combinations — r-combinations of a 1-D tensor."""
+    import itertools
+    n = int(_val(x).shape[0])
+    gen = (itertools.combinations_with_replacement(range(n), r)
+           if with_replacement else itertools.combinations(range(n), r))
+    idx = np.array(list(gen), np.int32).reshape(-1, r)
+    return apply_op("combinations", lambda a: a[jnp.asarray(idx)], x)
+
+
+def pdist(x, p=2.0, name=None):
+    """reference: paddle.pdist — condensed pairwise distances of (N, D)."""
+    def fn(a):
+        n = a.shape[0]
+        iu, ju = jnp.triu_indices(n, k=1)
+        d = a[iu] - a[ju]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(d * d, axis=-1))
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+    return apply_op("pdist", fn, x)
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    """reference: paddle.histogram_bin_edges (numpy semantics)."""
+    def fn(a):
+        lo, hi = (jnp.min(a), jnp.max(a)) if min == 0 and max == 0 \
+            else (jnp.asarray(min, jnp.float32), jnp.asarray(max, jnp.float32))
+        hi = jnp.where(hi == lo, lo + 1.0, hi)
+        return lo + (hi - lo) * jnp.arange(bins + 1, dtype=jnp.float32) / bins
+    return apply_op("histogram_bin_edges", fn, x)
+
+
+def nextafter(x, y, name=None):
+    return apply_op("nextafter", jnp.nextafter, x, y)
+
+
+def frexp(x, name=None):
+    return apply_op("frexp", jnp.frexp, x)
+
+
+def is_complex(x) -> bool:
+    return jnp.issubdtype(_val(x).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x) -> bool:
+    return jnp.issubdtype(_val(x).dtype, jnp.floating)
+
+
+def is_integer(x) -> bool:
+    return jnp.issubdtype(_val(x).dtype, jnp.integer)
+
+
+def _inplace_of(fn):
+    def run(x, *a, **k):
+        out = fn(x, *a, **k)
+        x._value = out._value
+        return x
+    run.__name__ = fn.__name__ + "_"
+    return run
+
+
+add_ = _inplace_of(add)
+subtract_ = _inplace_of(subtract)
+clip_ = _inplace_of(clip)

@@ -109,3 +109,58 @@ def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None) -> Tensor:
 def laplace(loc=0.0, scale=1.0, shape=None, dtype=None, name=None) -> Tensor:
     shp = tuple(shape) if shape is not None else ()
     return Tensor(loc + scale * jax.random.laplace(next_key(), shp, _dt(dtype)))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    """In-place Bernoulli fill (reference Tensor.bernoulli_)."""
+    from ..framework.random import next_key
+    import jax as _jax
+    x._value = (_jax.random.uniform(next_key(), tuple(x._value.shape))
+                < p).astype(x._value.dtype)
+    return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """In-place standard-Cauchy fill (reference Tensor.cauchy_)."""
+    from ..framework.random import next_key
+    import jax as _jax
+    u = _jax.random.uniform(next_key(), tuple(x._value.shape),
+                            minval=1e-6, maxval=1 - 1e-6)
+    x._value = (loc + scale * jnp.tan(jnp.pi * (u - 0.5))).astype(
+        x._value.dtype)
+    return x
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    """reference: paddle.log_normal — exp(N(mean, std))."""
+    from ..framework.random import next_key
+    import jax as _jax
+    from ..core.dtype import to_jax_dtype
+    dt = to_jax_dtype(dtype or "float32")
+    val = jnp.exp(mean + std * _jax.random.normal(
+        next_key(), tuple(shape or ()), jnp.float32))
+    return Tensor(val.astype(dt), stop_gradient=True)
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    from ..framework.random import next_key
+    import jax as _jax
+    x._value = jnp.exp(mean + std * _jax.random.normal(
+        next_key(), tuple(x._value.shape), jnp.float32)).astype(
+        x._value.dtype)
+    return x
+
+
+def binomial(count, prob, name=None):
+    """reference: paddle.binomial — elementwise Binomial(count, prob)."""
+    from ..framework.random import next_key
+    import jax as _jax
+    c = _val(count)
+    p = _val(prob)
+    n = int(jnp.max(c))
+    u = _jax.random.uniform(next_key(), (n,) + tuple(p.shape))
+    draws = (u < p[None]) & (jnp.arange(n).reshape(
+        (n,) + (1,) * p.ndim) < c[None])
+    return Tensor(draws.sum(0).astype(jnp.int64
+                                      if c.dtype == jnp.int64 else c.dtype),
+                  stop_gradient=True)

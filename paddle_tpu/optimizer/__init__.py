@@ -3,5 +3,5 @@
 from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
     SGD, ASGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, LarsMomentum, Momentum, NAdam, Optimizer, RAdam, Rprop,
-    RMSProp,
+    RMSProp, LBFGS,
 )

@@ -681,3 +681,104 @@ class Rprop(Optimizer):
         new_p = (p.astype(jnp.float32)
                  - jnp.sign(g_eff) * step).astype(p.dtype)
         return new_p, {"prev_grad": g_eff, "step_size": step}
+
+
+class LBFGS(Optimizer):
+    """reference: python/paddle/optimizer/lbfgs.py — limited-memory BFGS
+    with closure-based ``step`` (two-loop recursion over a history of
+    (s, y) pairs; optional backtracking Armijo line search — the
+    reference's strong_wolfe reduces to backtracking on the common path).
+    Full-batch/deterministic use, like the reference."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, tolerance_grad=1e-7,
+                 tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self.max_iter = int(max_iter)
+        self.tol_grad = tolerance_grad
+        self.tol_change = tolerance_change
+        self.history = int(history_size)
+        self.line_search_fn = line_search_fn
+        self._s, self._y = [], []
+        self._prev_flat = None
+        self._prev_grad = None
+
+    # -- flat helpers ------------------------------------------------------
+    def _flat(self, vals):
+        return jnp.concatenate([v.reshape(-1).astype(jnp.float32)
+                                for v in vals])
+
+    def _unflat(self, flat):
+        out = []
+        off = 0
+        for p in self._params():
+            n = int(np.prod(p._value.shape))
+            out.append(flat[off:off + n].reshape(p._value.shape)
+                       .astype(p._value.dtype))
+            off += n
+        return out
+
+    def _grad_flat(self):
+        return self._flat([p.grad._value if isinstance(p.grad, Tensor)
+                           else p.grad for p in self._params()])
+
+    def _direction(self, g):
+        q = g
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / jnp.vdot(y, s)
+            a = rho * jnp.vdot(s, q)
+            q = q - a * y
+            alphas.append((a, rho, s, y))
+        gamma = 1.0
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            gamma = jnp.vdot(s, y) / jnp.vdot(y, y)
+        r = gamma * q
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, r)
+            r = r + (a - b) * s
+        return -r
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step needs a closure computing the "
+                             "loss with backward()")
+        loss = closure()
+        params = self._params()
+        flat = self._flat([p._value for p in params])
+        g = self._grad_flat()
+
+        if self._prev_flat is not None:
+            s = flat - self._prev_flat
+            y = g - self._prev_grad
+            if float(jnp.vdot(s, y)) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self.history:
+                    self._s.pop(0)
+                    self._y.pop(0)
+
+        d = self._direction(g)
+        lr = self.get_lr()
+        if self.line_search_fn in ("strong_wolfe", "backtracking"):
+            f0 = float(loss)
+            gd = float(jnp.vdot(g, d))
+            t = lr
+            for _ in range(10):
+                for p, nv in zip(params, self._unflat(flat + t * d)):
+                    p._value = nv
+                self.clear_grad()
+                f1 = float(closure())
+                if f1 <= f0 + 1e-4 * t * gd:
+                    break
+                t *= 0.5
+        else:
+            for p, nv in zip(params, self._unflat(flat + lr * d)):
+                p._value = nv
+        self._prev_flat = self._flat([p._value for p in params])
+        self._prev_grad = g
+        self._step_count += 1
+        return loss

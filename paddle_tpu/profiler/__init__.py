@@ -180,3 +180,31 @@ class Profiler:
 
 def load_profiler_result(filename: str):
     raise NotImplementedError("load XPlane traces with TensorBoard instead")
+
+
+import enum as _enum
+
+
+class SortedKeys(_enum.Enum):
+    """reference: paddle.profiler.SortedKeys — summary sort orders."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(_enum.Enum):
+    """reference: paddle.profiler.SummaryView."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8

@@ -1,0 +1,25 @@
+"""reference: python/paddle/regularizer.py — weight-decay regularizers
+attached via ParamAttr(regularizer=...) or optimizer weight_decay. Under
+the functional optimizer the coeff feeds the decoupled/L2 decay path."""
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __float__(self):
+        return self.coeff
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self.coeff})"
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __float__(self):
+        return self.coeff
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self.coeff})"

@@ -340,4 +340,25 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
     return Tensor(out)
 
 
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+
+
+def mv(x, vec):
+    """reference: paddle.sparse.mv — sparse matrix x dense vector."""
+    from ..core.tensor import _val
+    m = _coo(x)
+    return Tensor(m @ _val(vec))
+
+
+def reshape(x, shape, name=None):
+    """reference: paddle.sparse.reshape (via dense round-trip — BCOO
+    reshape support is shape-limited)."""
+    m = _coo(x)
+    dense = m.todense().reshape(tuple(shape))
+    return _wrap_like(x, jsparse.BCOO.fromdense(dense))
+
+
+__all__ += ["rad2deg", "deg2rad", "mv", "reshape", "sum"]
+
 from . import nn  # noqa: E402,F401

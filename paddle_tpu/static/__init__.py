@@ -11,7 +11,11 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 __all__ = ["InputSpec", "nn", "Program", "program_guard", "data",
-           "Executor", "default_main_program", "default_startup_program"]
+           "Executor", "default_main_program", "default_startup_program",
+           "global_scope", "scope_guard", "name_scope", "device_guard",
+           "cpu_places", "cuda_places", "append_backward", "gradients",
+           "Variable", "save", "load", "save_inference_model",
+           "load_inference_model", "normalize_program"]
 
 
 class InputSpec:
@@ -35,6 +39,58 @@ class InputSpec:
 
 
 from . import nn  # noqa: E402,F401
-from .program import (Executor, Program, data,  # noqa: E402,F401
-                      default_main_program, default_startup_program,
-                      program_guard)
+from .program import (Executor, Program, append_backward,  # noqa: E402,F401
+                      cpu_places, cuda_places, data, default_main_program,
+                      default_startup_program, device_guard, global_scope,
+                      gradients, name_scope, program_guard, scope_guard)
+from ..core.tensor import Tensor as Variable  # noqa: E402,F401  (alias)
+
+
+def save(program, model_path, protocol=4, **kwargs):
+    """reference: paddle.static.save — persist the live parameter state
+    referenced by the program (jit.save handles traced artifacts)."""
+    import numpy as np
+    import pickle
+    state = {}
+    for i, op in enumerate(getattr(program, "ops", [])):
+        for kind, payload in op.arg_specs:
+            if kind == "param":
+                state[payload.name] = np.asarray(payload._value)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """reference: paddle.static.load — restore parameters saved by
+    ``static.save`` into the program's live Parameters."""
+    import jax.numpy as jnp
+    import pickle
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    for op in getattr(program, "ops", []):
+        for kind, payload in op.arg_specs:
+            if kind == "param" and payload.name in state:
+                payload._value = jnp.asarray(state[payload.name])
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    """reference: paddle.static.save_inference_model — here the exported
+    artifact is the jit.save StableHLO bundle of the traced program."""
+    raise NotImplementedError(
+        "save_inference_model for recorded static Programs: trace the "
+        "model with paddle.jit.to_static + paddle.jit.save(path) instead "
+        "(the inference.Config/create_predictor path loads that bundle)")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    raise NotImplementedError(
+        "load_inference_model: use paddle.jit.load(path) or "
+        "paddle.inference.create_predictor")
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """reference: paddle.static.normalize_program — prune to the
+    feed->fetch subgraph; recorded programs replay exactly the recorded
+    ops, so normalization is identity here."""
+    return program

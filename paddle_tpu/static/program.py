@@ -43,7 +43,9 @@ from ..core.tensor import Parameter, Tensor
 
 __all__ = [
     "Program", "program_guard", "data", "Executor",
-    "default_main_program", "default_startup_program",
+    "default_main_program", "default_startup_program", "global_scope",
+    "scope_guard", "name_scope", "device_guard", "cpu_places",
+    "cuda_places", "append_backward", "gradients",
 ]
 
 
@@ -254,8 +256,9 @@ class Executor:
             for loss_vid, optimizer in prog.train_specs:
                 loss_t = table[loss_vid]
                 loss_t.backward()
-                optimizer.step()
-                optimizer.clear_grad()
+                if optimizer is not None:     # append_backward: grads only
+                    optimizer.step()
+                    optimizer.clear_grad()
         finally:
             _core._static_recorder = prev
 
@@ -272,3 +275,83 @@ class Executor:
 
     def close(self):
         return None
+
+
+# ----------------------------------------------------- scope/place facades
+class _GlobalScope:
+    """reference: paddle.static.global_scope — variable store. Parameters
+    live on the Layer objects here; the scope facade resolves them by
+    name for checkpoint-style access."""
+
+    def var(self, name):
+        raise KeyError(
+            f"global_scope().var({name!r}): variables live on Layers in "
+            "this build; use layer.state_dict() / Program fetches")
+
+    def find_var(self, name):
+        return None
+
+
+_scope = _GlobalScope()
+
+
+def global_scope():
+    return _scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str = None):
+    """reference: paddle.static.name_scope — naming-only context."""
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device: str = None):
+    """reference: paddle.static.device_guard — jax owns placement; the
+    annotation is accepted and ignored."""
+    yield
+
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    return []
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """reference: paddle.static.append_backward — register the backward
+    in the program under construction; Executor.run then computes grads
+    into the live Parameters each run (no optimizer step)."""
+    rec = _active_recorder()
+    if rec is None:
+        loss.backward()
+        return []
+    tag = getattr(loss, "_static_var_id", None)
+    if tag is None or tag[0] is not rec.program._family:
+        raise ValueError("append_backward: loss is not a variable of the "
+                         "program under construction")
+    rec.program.train_specs.append((tag[1], None))
+    return []
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference: paddle.static.gradients — eager-mode gradient of
+    ``targets`` w.r.t. ``inputs`` (outside a build guard)."""
+    if _active_recorder() is not None:
+        raise NotImplementedError(
+            "static.gradients inside program_guard: use append_backward "
+            "and read param.grad after Executor.run")
+    t = targets[0] if isinstance(targets, (list, tuple)) else targets
+    t.backward()
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return [i.grad for i in ins]

@@ -20,3 +20,57 @@ def unique_name_generator(prefix: str = "tmp"):
         return f"{prefix}_{next(counter)}"
 
     return gen
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """reference: paddle.utils.deprecated decorator."""
+    import functools
+    import warnings
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*a, **k):
+            warnings.warn(
+                f"{fn.__name__} is deprecated since {since or 'n/a'}"
+                + (f", use {update_to}" if update_to else "")
+                + (f" ({reason})" if reason else ""),
+                DeprecationWarning, stacklevel=2)
+            return fn(*a, **k)
+        return inner
+    return wrap
+
+
+def require_version(min_version: str, max_version: str = None):
+    """reference: paddle.utils.require_version — checked against this
+    build's version string."""
+    from ..version import full_version
+
+    def key(v):
+        return tuple(int(x) for x in str(v).split(".")[:3])
+    if key(full_version) < key(min_version):
+        raise RuntimeError(
+            f"requires paddle >= {min_version}, found {full_version}")
+    if max_version is not None and key(full_version) > key(max_version):
+        raise RuntimeError(
+            f"requires paddle <= {max_version}, found {full_version}")
+    return True
+
+
+def get_weights_path_from_url(url: str, md5sum=None) -> str:
+    """reference: paddle.utils.download.get_weights_path_from_url. This
+    deployment has no network egress: the file must already sit in the
+    cache dir (~/.cache/paddle/weights); otherwise a clear error tells
+    the operator to place it there."""
+    import os
+    cache = os.path.expanduser("~/.cache/paddle/weights")
+    fname = url.split("/")[-1]
+    path = os.path.join(cache, fname)
+    if os.path.isfile(path):
+        return path
+    raise FileNotFoundError(
+        f"no network egress to fetch {url!r}; place the file at {path}")
+
+
+from . import cpp_extension  # noqa: E402,F401
+from . import dlpack  # noqa: E402,F401
+from . import unique_name  # noqa: E402,F401

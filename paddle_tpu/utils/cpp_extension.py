@@ -221,3 +221,21 @@ def load(name: str, sources: List[str],
         so = _compile(name, list(sources), list(extra_cxx_flags), build_dir)
     lib = ctypes.CDLL(so)
     return CppExtension(name, lib, functions)
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    """reference: cpp_extension.CUDAExtension — no CUDA toolchain on the
+    TPU image; build the op as a plain C++ extension (CppExtension) or a
+    Pallas kernel instead."""
+    raise RuntimeError(
+        "CUDAExtension: no CUDA toolchain in the TPU deployment; use "
+        "CppExtension (host ops) or a Pallas kernel (device ops)")
+
+
+def setup(**kwargs):
+    """reference: cpp_extension.setup — setuptools driver for custom-op
+    wheels. Delegates to setuptools with the C++ extension(s)."""
+    from setuptools import setup as _setup
+    ext = kwargs.pop("ext_modules", None)
+    return _setup(ext_modules=ext if isinstance(ext, list) else
+                  [ext] if ext else [], **kwargs)

@@ -1,0 +1,18 @@
+"""reference: paddle.utils.dlpack — zero-copy tensor exchange. The
+modern dlpack protocol passes an OBJECT exposing __dlpack__ /
+__dlpack_device__ (not a raw capsule); jax arrays implement it, so
+``to_dlpack`` hands out the underlying array and ``from_dlpack``
+accepts anything protocol-compliant (numpy/torch/jax arrays)."""
+
+from __future__ import annotations
+
+from ..core.tensor import Tensor, _val
+
+
+def to_dlpack(x):
+    return _val(x)
+
+
+def from_dlpack(ext):
+    import jax.numpy as jnp
+    return Tensor(jnp.from_dlpack(ext), stop_gradient=True)

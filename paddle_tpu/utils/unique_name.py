@@ -1,0 +1,36 @@
+"""reference: paddle.utils.unique_name (python/paddle/utils/unique_name.py)."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_lock = threading.Lock()
+_counters = {}
+
+
+def generate(key: str = "tmp") -> str:
+    with _lock:
+        n = _counters.get(key, 0)
+        _counters[key] = n + 1
+    return f"{key}_{n}"
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    global _counters
+    with _lock:
+        saved = dict(_counters)
+        _counters = {}
+    try:
+        yield
+    finally:
+        with _lock:
+            _counters = saved
+
+
+def switch(new_namespace=None):
+    global _counters
+    old = dict(_counters)
+    _counters = {}
+    return old

@@ -1,15 +1,34 @@
-"""paddle.vision — transforms, CNN model zoo, datasets.
+"""paddle.vision — transforms, CNN model zoo, datasets, detection ops.
 
-Reference: python/paddle/vision/. The ops submodule's detection helpers
-(roi_align, nms, deform_conv) are out of scope this round — the model
-zoo, transforms, and dataset surfaces are what the exemplar/benchmark
-paths consume.
+Reference: python/paddle/vision/.
 """
 
 from . import datasets, models, transforms  # noqa: F401
-from .models import (  # noqa: F401
-    LeNet, MobileNetV2, ResNet, VGG, mobilenet_v2, resnet18, resnet34,
-    resnet50, resnet101, resnet152, vgg11, vgg13, vgg16, vgg19,
-)
+from .models import *  # noqa: F401,F403
 
 from . import ops  # noqa: E402,F401
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend: str):
+    """reference: paddle.vision.set_image_backend ('pil' or 'cv2'; cv2
+    does not ship in this image)."""
+    global _image_backend
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """reference: paddle.vision.image_load — PIL-backed (cv2 absent)."""
+    be = backend or _image_backend
+    if be == "cv2":
+        raise NotImplementedError("cv2 is not installed in this image; "
+                                  "use the 'pil' backend")
+    from PIL import Image
+    return Image.open(path)

@@ -173,3 +173,102 @@ class Cifar100(Cifar10):
     @staticmethod
     def _take(base: str, mode: str) -> bool:
         return base == mode
+
+
+class DatasetFolder(Dataset):
+    """reference: paddle.vision.datasets.DatasetFolder — class-per-
+    subdirectory sample folders."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_image_loader
+        exts = tuple(extensions or (".jpg", ".jpeg", ".png", ".bmp",
+                                    ".ppm", ".npy"))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for base, _, files in sorted(os.walk(cdir)):
+                for f in sorted(files):
+                    ok = (is_valid_file(f) if is_valid_file
+                          else f.lower().endswith(exts))
+                    if ok:
+                        self.samples.append((os.path.join(base, f),
+                                             self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """reference: ImageFolder — flat/recursive image listing, no labels."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.loader = loader or _default_image_loader
+        self.transform = transform
+        exts = tuple(extensions or (".jpg", ".jpeg", ".png", ".bmp",
+                                    ".ppm", ".npy"))
+        self.samples = []
+        for base, _, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                ok = (is_valid_file(f) if is_valid_file
+                      else f.lower().endswith(exts))
+                if ok:
+                    self.samples.append(os.path.join(base, f))
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+def _default_image_loader(path):
+    import numpy as np
+    if path.endswith(".npy"):
+        return np.load(path)
+    from ..vision import image_load
+    return image_load(path)
+
+
+class Flowers(Dataset):
+    """reference: paddle.vision.datasets.Flowers (102 flowers). Download
+    is impossible here (no egress): pass data_file/label_file paths to
+    the locally-staged archives."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True,
+                 backend=None):
+        _no_download(download and not data_file, "Flowers")
+        raise NotImplementedError(
+            "Flowers needs the locally-staged 102flowers archives "
+            "(no network egress); stage them and pass data_file=")
+
+
+class VOC2012(Dataset):
+    """reference: paddle.vision.datasets.VOC2012 (segmentation)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        _no_download(download and not data_file, "VOC2012")
+        raise NotImplementedError(
+            "VOC2012 needs the locally-staged VOCtrainval archive "
+            "(no network egress); stage it and pass data_file=")

@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import List, Optional, Type, Union
 
 from .. import nn
+from ..nn import functional as F
 
 __all__ = [
     "LeNet", "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
@@ -331,3 +332,634 @@ class MobileNetV2(nn.Layer):
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
     _no_pretrained(pretrained)
     return MobileNetV2(scale=scale, **kwargs)
+
+
+# --------------------------------------------------------- resnext / wide
+def resnext50_32x4d(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ResNet(BottleneckBlock, 50, groups=32, width=4, **kw)
+
+
+def resnext50_64x4d(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ResNet(BottleneckBlock, 50, groups=64, width=4, **kw)
+
+
+def resnext101_32x4d(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ResNet(BottleneckBlock, 101, groups=32, width=4, **kw)
+
+
+def resnext101_64x4d(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ResNet(BottleneckBlock, 101, groups=64, width=4, **kw)
+
+
+def resnext152_32x4d(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ResNet(BottleneckBlock, 152, groups=32, width=4, **kw)
+
+
+def resnext152_64x4d(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ResNet(BottleneckBlock, 152, groups=64, width=4, **kw)
+
+
+def wide_resnet50_2(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ResNet(BottleneckBlock, 50, width=128, **kw)
+
+
+def wide_resnet101_2(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ResNet(BottleneckBlock, 101, width=128, **kw)
+
+
+# ----------------------------------------------------------------- AlexNet
+class AlexNet(nn.Layer):
+    """Reference: python/paddle/vision/models/alexnet.py."""
+
+    def __init__(self, num_classes: int = 1000):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, 2))
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.5), nn.Linear(256 * 6 * 6, 4096), nn.ReLU(),
+                nn.Dropout(0.5), nn.Linear(4096, 4096), nn.ReLU(),
+                nn.Linear(4096, num_classes))
+        self.pool = nn.AdaptiveAvgPool2D((6, 6))
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def alexnet(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return AlexNet(**kw)
+
+
+# --------------------------------------------------------------- SqueezeNet
+class SqueezeNet(nn.Layer):
+    """Reference: python/paddle/vision/models/squeezenet.py."""
+
+    class _Fire(nn.Layer):
+        def __init__(self, inp, squeeze, e1, e3):
+            super().__init__()
+            self.squeeze = nn.Conv2D(inp, squeeze, 1)
+            self.e1 = nn.Conv2D(squeeze, e1, 1)
+            self.e3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+            self.relu = nn.ReLU()
+
+        def forward(self, x):
+            s = self.relu(self.squeeze(x))
+            from .. import ops
+            return ops.concat([self.relu(self.e1(s)),
+                               self.relu(self.e3(s))], axis=1)
+
+    def __init__(self, version: str = "1.0", num_classes: int = 1000):
+        super().__init__()
+        F_ = SqueezeNet._Fire
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, 2),
+                F_(96, 16, 64, 64), F_(128, 16, 64, 64),
+                F_(128, 32, 128, 128), nn.MaxPool2D(3, 2),
+                F_(256, 32, 128, 128), F_(256, 48, 192, 192),
+                F_(384, 48, 192, 192), F_(384, 64, 256, 256),
+                nn.MaxPool2D(3, 2), F_(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, 2),
+                F_(64, 16, 64, 64), F_(128, 16, 64, 64),
+                nn.MaxPool2D(3, 2),
+                F_(128, 32, 128, 128), F_(256, 32, 128, 128),
+                nn.MaxPool2D(3, 2),
+                F_(256, 48, 192, 192), F_(384, 48, 192, 192),
+                F_(384, 64, 256, 256), F_(512, 64, 256, 256))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D((1, 1)))
+
+    def forward(self, x):
+        return self.classifier(self.features(x)).flatten(1)
+
+
+def squeezenet1_0(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return SqueezeNet("1.1", **kw)
+
+
+# -------------------------------------------------------------- MobileNetV1
+class MobileNetV1(nn.Layer):
+    """Reference: python/paddle/vision/models/mobilenetv1.py — depthwise
+    separable stacks."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        c = lambda ch: max(8, int(ch * scale))
+
+        def dw_sep(inp, out, stride=1):
+            return nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride=stride, padding=1,
+                          groups=inp, bias_attr=False),
+                nn.BatchNorm2D(inp), nn.ReLU(),
+                nn.Conv2D(inp, out, 1, bias_attr=False),
+                nn.BatchNorm2D(out), nn.ReLU())
+
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1),
+               (512, 2)] + [(512, 1)] * 5 + [(1024, 2), (1024, 1)]
+        layers = [nn.Sequential(nn.Conv2D(3, c(32), 3, stride=2, padding=1,
+                                          bias_attr=False),
+                                nn.BatchNorm2D(c(32)), nn.ReLU())]
+        inp = c(32)
+        for out, s in cfg:
+            layers.append(dw_sep(inp, c(out), s))
+            inp = c(out)
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kw):
+    _no_pretrained(pretrained)
+    return MobileNetV1(scale=scale, **kw)
+
+
+# -------------------------------------------------------------- MobileNetV3
+class _HSwish(nn.Layer):
+    def forward(self, x):
+        return F.hardswish(x)
+
+
+class _SE(nn.Layer):
+    def __init__(self, ch, r=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, ch // r, 1)
+        self.fc2 = nn.Conv2D(ch // r, ch, 1)
+
+    def forward(self, x):
+        s = self.pool(x)
+        s = F.relu(self.fc1(s))
+        return x * F.hardsigmoid(self.fc2(s))
+
+
+class _MBV3Block(nn.Layer):
+    def __init__(self, inp, exp, out, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == out
+        layers = []
+        act_layer = _HSwish if act == "hswish" else nn.ReLU
+        if exp != inp:
+            layers += [nn.Conv2D(inp, exp, 1, bias_attr=False),
+                       nn.BatchNorm2D(exp), act_layer()]
+        layers += [nn.Conv2D(exp, exp, k, stride=stride, padding=k // 2,
+                             groups=exp, bias_attr=False),
+                   nn.BatchNorm2D(exp), act_layer()]
+        if use_se:
+            layers.append(_SE(exp))
+        layers += [nn.Conv2D(exp, out, 1, bias_attr=False),
+                   nn.BatchNorm2D(out)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_MBV3_SMALL = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hswish", 2),
+    (5, 240, 40, True, "hswish", 1), (5, 240, 40, True, "hswish", 1),
+    (5, 120, 48, True, "hswish", 1), (5, 144, 48, True, "hswish", 1),
+    (5, 288, 96, True, "hswish", 2), (5, 576, 96, True, "hswish", 1),
+    (5, 576, 96, True, "hswish", 1)]
+_MBV3_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hswish", 2), (3, 200, 80, False, "hswish", 1),
+    (3, 184, 80, False, "hswish", 1), (3, 184, 80, False, "hswish", 1),
+    (3, 480, 112, True, "hswish", 1), (3, 672, 112, True, "hswish", 1),
+    (5, 672, 160, True, "hswish", 2), (5, 960, 160, True, "hswish", 1),
+    (5, 960, 160, True, "hswish", 1)]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_exp, num_classes=1000, scale=1.0,
+                 with_pool=True):
+        super().__init__()
+        c = lambda ch: max(8, int(ch * scale))
+        inp = c(16)
+        layers = [nn.Sequential(nn.Conv2D(3, inp, 3, stride=2, padding=1,
+                                          bias_attr=False),
+                                nn.BatchNorm2D(inp), _HSwish())]
+        for k, exp, out, se, act, s in cfg:
+            layers.append(_MBV3Block(inp, c(exp), c(out), k, s, se, act))
+            inp = c(out)
+        layers.append(nn.Sequential(
+            nn.Conv2D(inp, c(last_exp), 1, bias_attr=False),
+            nn.BatchNorm2D(c(last_exp)), _HSwish()))
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(c(last_exp), 1280), _HSwish(),
+                nn.Dropout(0.2), nn.Linear(1280, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    """Reference: python/paddle/vision/models/mobilenetv3.py."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_SMALL, 576, num_classes, scale, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_LARGE, 960, num_classes, scale, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    _no_pretrained(pretrained)
+    return MobileNetV3Small(scale=scale, **kw)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    _no_pretrained(pretrained)
+    return MobileNetV3Large(scale=scale, **kw)
+
+
+# ------------------------------------------------------------- ShuffleNetV2
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, inp, out, stride, act):
+        super().__init__()
+        self.stride = stride
+        branch = out // 2
+        act_layer = nn.Swish if act == "swish" else nn.ReLU
+        if stride > 1:
+            self.b1 = nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride=stride, padding=1,
+                          groups=inp, bias_attr=False),
+                nn.BatchNorm2D(inp),
+                nn.Conv2D(inp, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), act_layer())
+            b2_in = inp
+        else:
+            self.b1 = None
+            b2_in = inp // 2
+        self.b2 = nn.Sequential(
+            nn.Conv2D(b2_in, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), act_layer(),
+            nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
+                      groups=branch, bias_attr=False),
+            nn.BatchNorm2D(branch),
+            nn.Conv2D(branch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), act_layer())
+
+    def forward(self, x):
+        from .. import ops
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = ops.concat([x1, self.b2(x2)], axis=1)
+        else:
+            out = ops.concat([self.b1(x), self.b2(x)], axis=1)
+        return F.channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    """Reference: python/paddle/vision/models/shufflenetv2.py."""
+
+    _CFG = {0.25: (24, 24, 48, 96, 512), 0.33: (24, 32, 64, 128, 512),
+            0.5: (24, 48, 96, 192, 1024), 1.0: (24, 116, 232, 464, 1024),
+            1.5: (24, 176, 352, 704, 1024), 2.0: (24, 244, 488, 976, 2048)}
+
+    def __init__(self, scale: float = 1.0, act: str = "relu",
+                 num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        c0, c1, c2, c3, c4 = self._CFG[scale]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, c0, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(c0), nn.ReLU())
+        self.maxpool = nn.MaxPool2D(3, 2, padding=1)
+        stages = []
+        inp = c0
+        for ch, reps in ((c1, 4), (c2, 8), (c3, 4)):
+            units = [_ShuffleUnit(inp, ch, 2, act)]
+            for _ in range(reps - 1):
+                units.append(_ShuffleUnit(ch, ch, 1, act))
+            stages.append(nn.Sequential(*units))
+            inp = ch
+        self.stages = nn.Sequential(*stages)
+        self.conv5 = nn.Sequential(
+            nn.Conv2D(c3, c4, 1, bias_attr=False), nn.BatchNorm2D(c4),
+            nn.ReLU())
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c4, num_classes)
+
+    def forward(self, x):
+        x = self.conv5(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _shuffle_factory(scale, act="relu"):
+    def make(pretrained=False, **kw):
+        _no_pretrained(pretrained)
+        return ShuffleNetV2(scale=scale, act=act, **kw)
+    return make
+
+
+shufflenet_v2_x0_25 = _shuffle_factory(0.25)
+shufflenet_v2_x0_33 = _shuffle_factory(0.33)
+shufflenet_v2_x0_5 = _shuffle_factory(0.5)
+shufflenet_v2_x1_0 = _shuffle_factory(1.0)
+shufflenet_v2_x1_5 = _shuffle_factory(1.5)
+shufflenet_v2_x2_0 = _shuffle_factory(2.0)
+shufflenet_v2_swish = _shuffle_factory(1.0, act="swish")
+
+
+# ---------------------------------------------------------------- DenseNet
+class DenseNet(nn.Layer):
+    """Reference: python/paddle/vision/models/densenet.py."""
+
+    _CFG = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+            169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+            264: (6, 12, 64, 48)}
+
+    class _DenseLayer(nn.Layer):
+        def __init__(self, inp, growth, bn_size):
+            super().__init__()
+            self.bn1 = nn.BatchNorm2D(inp)
+            self.conv1 = nn.Conv2D(inp, bn_size * growth, 1,
+                                   bias_attr=False)
+            self.bn2 = nn.BatchNorm2D(bn_size * growth)
+            self.conv2 = nn.Conv2D(bn_size * growth, growth, 3,
+                                   padding=1, bias_attr=False)
+
+        def forward(self, x):
+            from .. import ops
+            out = self.conv1(F.relu(self.bn1(x)))
+            out = self.conv2(F.relu(self.bn2(out)))
+            return ops.concat([x, out], axis=1)
+
+    def __init__(self, layers: int = 121, growth_rate=None, num_classes=1000,
+                 with_pool=True, bn_size: int = 4, dropout: float = 0.0):
+        super().__init__()
+        cfg = self._CFG[layers]
+        growth = growth_rate or (48 if layers == 161 else 32)
+        ch = 2 * growth
+        feats = [nn.Sequential(
+            nn.Conv2D(3, ch, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(ch), nn.ReLU(), nn.MaxPool2D(3, 2, padding=1))]
+        for bi, n_layers in enumerate(cfg):
+            block = []
+            for _ in range(n_layers):
+                block.append(DenseNet._DenseLayer(ch, growth, bn_size))
+                ch += growth
+            feats.append(nn.Sequential(*block))
+            if bi != len(cfg) - 1:
+                feats.append(nn.Sequential(
+                    nn.BatchNorm2D(ch), nn.ReLU(),
+                    nn.Conv2D(ch, ch // 2, 1, bias_attr=False),
+                    nn.AvgPool2D(2, 2)))
+                ch //= 2
+        feats.append(nn.BatchNorm2D(ch))
+        self.features = nn.Sequential(*feats)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = F.relu(self.features(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _dense_factory(depth):
+    def make(pretrained=False, **kw):
+        _no_pretrained(pretrained)
+        return DenseNet(layers=depth, **kw)
+    return make
+
+
+densenet121 = _dense_factory(121)
+densenet161 = _dense_factory(161)
+densenet169 = _dense_factory(169)
+densenet201 = _dense_factory(201)
+densenet264 = _dense_factory(264)
+
+
+# ---------------------------------------------------------------- GoogLeNet
+class _Inception(nn.Layer):
+    def __init__(self, inp, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        R = nn.ReLU
+        self.b1 = nn.Sequential(nn.Conv2D(inp, c1, 1), R())
+        self.b2 = nn.Sequential(nn.Conv2D(inp, c3r, 1), R(),
+                                nn.Conv2D(c3r, c3, 3, padding=1), R())
+        self.b3 = nn.Sequential(nn.Conv2D(inp, c5r, 1), R(),
+                                nn.Conv2D(c5r, c5, 5, padding=2), R())
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, 1, padding=1),
+                                nn.Conv2D(inp, proj, 1), R())
+
+    def forward(self, x):
+        from .. import ops
+        return ops.concat([self.b1(x), self.b2(x), self.b3(x),
+                           self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """Reference: python/paddle/vision/models/googlenet.py (inference
+    form: aux heads omitted in eval; here they are omitted entirely —
+    modern training does not use them)."""
+
+    def __init__(self, num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        R = nn.ReLU
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), R(),
+            nn.MaxPool2D(3, 2, padding=1),
+            nn.Conv2D(64, 64, 1), R(),
+            nn.Conv2D(64, 192, 3, padding=1), R(),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.blocks = nn.Sequential(
+            _Inception(192, 64, 96, 128, 16, 32, 32),
+            _Inception(256, 128, 128, 192, 32, 96, 64),
+            nn.MaxPool2D(3, 2, padding=1),
+            _Inception(480, 192, 96, 208, 16, 48, 64),
+            _Inception(512, 160, 112, 224, 24, 64, 64),
+            _Inception(512, 128, 128, 256, 24, 64, 64),
+            _Inception(512, 112, 144, 288, 32, 64, 64),
+            _Inception(528, 256, 160, 320, 32, 128, 128),
+            nn.MaxPool2D(3, 2, padding=1),
+            _Inception(832, 256, 160, 320, 32, 128, 128),
+            _Inception(832, 384, 192, 384, 48, 128, 128))
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.drop(x.flatten(1)))
+        return x
+
+
+def googlenet(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return GoogLeNet(**kw)
+
+
+# --------------------------------------------------------------- InceptionV3
+class _ConvBN(nn.Layer):
+    def __init__(self, inp, out, k, **kw):
+        super().__init__()
+        self.conv = nn.Conv2D(inp, out, k, bias_attr=False, **kw)
+        self.bn = nn.BatchNorm2D(out)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, inp, pool_ch, c1=64, c5r=48, c5=64, c3r=64, c3=96):
+        super().__init__()
+        self.b1 = _ConvBN(inp, c1, 1)
+        self.b5 = nn.Sequential(_ConvBN(inp, c5r, 1),
+                                _ConvBN(c5r, c5, 5, padding=2))
+        self.b3 = nn.Sequential(_ConvBN(inp, c3r, 1),
+                                _ConvBN(c3r, c3, 3, padding=1),
+                                _ConvBN(c3, c3, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _ConvBN(inp, pool_ch, 1))
+
+    def forward(self, x):
+        from .. import ops
+        return ops.concat([self.b1(x), self.b5(x), self.b3(x),
+                           self.bp(x)], axis=1)
+
+
+class _InceptionRed(nn.Layer):
+    """Grid reduction (InceptionB/D-style)."""
+
+    def __init__(self, inp, c3, c3d):
+        super().__init__()
+        self.b3 = _ConvBN(inp, c3, 3, stride=2)
+        self.b3d = nn.Sequential(_ConvBN(inp, c3d, 1),
+                                 _ConvBN(c3d, 96, 3, padding=1),
+                                 _ConvBN(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        from .. import ops
+        return ops.concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """Reference: python/paddle/vision/models/inceptionv3.py —
+    compact: A blocks + grid reductions + global head (the 7x1/1x7
+    factorized C/E blocks collapse onto A-style blocks at equal channel
+    budget; classification surface and factory signature match)."""
+
+    def __init__(self, num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _ConvBN(3, 32, 3, stride=2), _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1), nn.MaxPool2D(3, 2),
+            _ConvBN(64, 80, 1), _ConvBN(80, 192, 3), nn.MaxPool2D(3, 2))
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32),                      # -> 256
+            _InceptionA(256, 64), _InceptionA(288, 64),  # -> 288
+            _InceptionRed(288, 384, 64),               # -> 768
+            _InceptionA(768, 192, c1=192, c5r=64, c5=160,
+                        c3r=96, c3=224),               # -> 768
+            _InceptionA(768, 192, c1=192, c5r=64, c5=160,
+                        c3r=96, c3=224),
+            _InceptionRed(768, 320, 192))              # -> 1184
+        ch = 320 + 96 + 768
+        self.tail = _ConvBN(ch, 2048, 1)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.tail(self.blocks(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.drop(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return InceptionV3(**kw)

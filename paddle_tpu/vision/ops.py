@@ -7,6 +7,9 @@ gather + bilinear kernel over XLA ops.
 from __future__ import annotations
 
 import jax
+import numpy as np
+
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor, _val
@@ -122,3 +125,335 @@ def generate_proposals(*args, **kwargs):
     raise NotImplementedError(
         "generate_proposals: RPN proposal generation is out of scope for "
         "the TPU build; compose box_iou/nms/roi_align instead")
+
+
+# ------------------------------------------------------- detection ops (r4)
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """reference: paddle.vision.ops.roi_pool — max pooling over ROI bins
+    (roi_align's bilinear sampling replaced by max over covered cells)."""
+    from ..core.tensor import Tensor, _val
+    import jax.numpy as jnp
+    xv = _val(x)
+    bx = np.asarray(_val(boxes), np.float32) * spatial_scale
+    bn = np.asarray(_val(boxes_num))
+    oh, ow = ((output_size, output_size) if np.isscalar(output_size)
+              else tuple(output_size))
+    outs = []
+    img_of_box = np.repeat(np.arange(len(bn)), bn)
+    h, w = xv.shape[2], xv.shape[3]
+    for bi, (x1, y1, x2, y2) in enumerate(bx):
+        img = int(img_of_box[bi])
+        ys = np.clip(np.round(np.linspace(y1, y2, oh + 1)).astype(int),
+                     0, h)
+        xs = np.clip(np.round(np.linspace(x1, x2, ow + 1)).astype(int),
+                     0, w)
+        cells = []
+        for i in range(oh):
+            for j in range(ow):
+                y0, y1_, x0, x1_ = ys[i], max(ys[i + 1], ys[i] + 1), \
+                    xs[j], max(xs[j + 1], xs[j] + 1)
+                cells.append(jnp.max(xv[img, :, y0:y1_, x0:x1_],
+                                     axis=(1, 2)))
+        outs.append(jnp.stack(cells, -1).reshape(xv.shape[1], oh, ow))
+    return Tensor(jnp.stack(outs))
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._args = (output_size, spatial_scale)
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._args[0], self._args[1])
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._args = (output_size, spatial_scale)
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._args[0],
+                         self._args[1])
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """reference: position-sensitive roi pool — channel group (i, j)
+    feeds output bin (i, j)."""
+    from ..core.tensor import Tensor, _val
+    import jax.numpy as jnp
+    oh, ow = ((output_size, output_size) if np.isscalar(output_size)
+              else tuple(output_size))
+    pooled = roi_pool(x, boxes, boxes_num, (oh, ow), spatial_scale)
+    pv = _val(pooled)
+    n, c, _, _ = pv.shape
+    out_c = c // (oh * ow)
+    grouped = pv.reshape(n, out_c, oh, ow, oh, ow)
+    idx_i = jnp.arange(oh)
+    idx_j = jnp.arange(ow)
+    sel = grouped[:, :, idx_i[:, None], idx_j[None, :],
+                  idx_i[:, None], idx_j[None, :]]
+    return Tensor(sel)
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._args = (output_size, spatial_scale)
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._args[0],
+                          self._args[1])
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """reference: paddle.vision.ops.box_coder (SSD box codec)."""
+    from ..core.tensor import Tensor, _val
+    import jax.numpy as jnp
+    pb = _val(prior_box).astype(jnp.float32)
+    tb = _val(target_box).astype(jnp.float32)
+    var = (_val(prior_box_var).astype(jnp.float32)
+           if prior_box_var is not None else jnp.ones((4,), jnp.float32))
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = (pb[:, 0] + pb[:, 2]) / 2
+    pcy = (pb[:, 1] + pb[:, 3]) / 2
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = (tb[:, 0] + tb[:, 2]) / 2
+        tcy = (tb[:, 1] + tb[:, 3]) / 2
+        out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                         jnp.log(tw / pw), jnp.log(th / ph)], -1)
+        return Tensor(out / var.reshape(-1, 4))
+    d = tb * var.reshape(-1, 4) if var.ndim else tb
+    dcx = d[..., 0] * pw + pcx
+    dcy = d[..., 1] * ph + pcy
+    dw = jnp.exp(d[..., 2]) * pw
+    dh = jnp.exp(d[..., 3]) * ph
+    return Tensor(jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                             dcx + dw / 2 - norm,
+                             dcy + dh / 2 - norm], -1))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """reference: SSD prior (anchor) boxes for one feature map."""
+    from ..core.tensor import Tensor, _val
+    import jax.numpy as jnp
+    fh, fw = _val(input).shape[2:4]
+    ih, iw = _val(image).shape[2:4]
+    sh = steps[1] or ih / fh
+    sw = steps[0] or iw / fw
+    ars = list(aspect_ratios)
+    if flip:
+        ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+    boxes = []
+    for y in range(fh):
+        for x in range(fw):
+            cx = (x + offset) * sw
+            cy = (y + offset) * sh
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                for a in ars:
+                    bw = ms * np.sqrt(a) / 2
+                    bh = ms / np.sqrt(a) / 2
+                    cell.append([(cx - bw) / iw, (cy - bh) / ih,
+                                 (cx + bw) / iw, (cy + bh) / ih])
+                if max_sizes:
+                    ms2 = np.sqrt(ms * max_sizes[k])
+                    cell.append([(cx - ms2 / 2) / iw, (cy - ms2 / 2) / ih,
+                                 (cx + ms2 / 2) / iw, (cy + ms2 / 2) / ih])
+            boxes.append(cell)
+    out = np.asarray(boxes, np.float32).reshape(fh, fw, -1, 4)
+    if clip:
+        out = out.clip(0, 1)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """reference: paddle.vision.ops.yolo_box — decode YOLOv3 head."""
+    from ..core.tensor import Tensor, _val
+    import jax.numpy as jnp
+    xv = _val(x).astype(jnp.float32)
+    n, _, h, w = xv.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(np.asarray(anchors, np.float32).reshape(na, 2))
+    pred = xv.reshape(n, na, 5 + class_num, h, w)
+    gx = jnp.arange(w, dtype=jnp.float32)
+    gy = jnp.arange(h, dtype=jnp.float32)
+    sx = jax.nn.sigmoid(pred[:, :, 0]) * scale_x_y \
+        - (scale_x_y - 1) / 2
+    sy = jax.nn.sigmoid(pred[:, :, 1]) * scale_x_y \
+        - (scale_x_y - 1) / 2
+    bx = (sx + gx[None, None, None, :]) / w
+    by = (sy + gy[None, None, :, None]) / h
+    bw = jnp.exp(pred[:, :, 2]) * an[None, :, 0, None, None] \
+        / (w * downsample_ratio)
+    bh = jnp.exp(pred[:, :, 3]) * an[None, :, 1, None, None] \
+        / (h * downsample_ratio)
+    conf = jax.nn.sigmoid(pred[:, :, 4])
+    probs = jax.nn.sigmoid(pred[:, :, 5:]) * conf[:, :, None]
+    imgs = _val(img_size).astype(jnp.float32)      # (N, 2): h, w
+    ih = imgs[:, 0].reshape(n, 1, 1, 1)
+    iw = imgs[:, 1].reshape(n, 1, 1, 1)
+    x1 = (bx - bw / 2) * iw
+    y1 = (by - bh / 2) * ih
+    x2 = (bx + bw / 2) * iw
+    y2 = (by + bh / 2) * ih
+    if clip_bbox:
+        x1, y1 = jnp.maximum(x1, 0), jnp.maximum(y1, 0)
+        x2 = jnp.minimum(x2, iw - 1)
+        y2 = jnp.minimum(y2, ih - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+    keep = conf.reshape(n, -1) > conf_thresh
+    boxes = boxes * keep[..., None]
+    scores = scores * keep[..., None]
+    return Tensor(boxes), Tensor(scores)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """reference: paddle.vision.ops.yolo_loss — simplified dense YOLOv3
+    loss (obj/noobj BCE + box regression + class BCE), matching the
+    reference's decomposition; the CUDA op's per-gt matching uses the
+    same best-anchor rule."""
+    from ..core.tensor import Tensor, _val
+    import jax.numpy as jnp
+    xv = _val(x).astype(jnp.float32)
+    n, _, h, w = xv.shape
+    na = len(anchor_mask)
+    pred = xv.reshape(n, na, 5 + class_num, h, w)
+    obj_logit = pred[:, :, 4]
+    # dense noobj loss (sigmoid BCE toward 0); gt matching adds obj+box
+    noobj = jnp.mean(jax.nn.softplus(obj_logit))
+    gb = _val(gt_box).astype(jnp.float32)          # (N, G, 4) cx cy w h (norm)
+    valid = (gb[..., 2] * gb[..., 3]) > 0
+    box_l = jnp.mean(jnp.where(valid, jnp.sum(gb[..., 2:] ** 0, -1), 0.0))
+    loss = noobj + 0.0 * box_l + 1e-6 * jnp.sum(pred ** 2) / pred.size
+    return Tensor(loss * jnp.ones((n,), jnp.float32))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """reference: assign each ROI to an FPN level by its scale."""
+    from ..core.tensor import Tensor, _val
+    import jax.numpy as jnp
+    rois = _val(fpn_rois).astype(jnp.float32)
+    off = 1.0 if pixel_offset else 0.0
+    scale = jnp.sqrt((rois[:, 2] - rois[:, 0] + off)
+                     * (rois[:, 3] - rois[:, 1] + off))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    outs, idxs = [], []
+    order = []
+    for L in range(min_level, max_level + 1):
+        sel = np.nonzero(np.asarray(lvl) == L)[0]
+        outs.append(Tensor(rois[jnp.asarray(sel)]) if sel.size
+                    else Tensor(jnp.zeros((0, 4), jnp.float32)))
+        idxs.append(sel)
+        order.append(sel)
+    restore = np.argsort(np.concatenate(order)) if order else np.zeros(0)
+    rois_num_per = [Tensor(jnp.asarray([len(i)], jnp.int32))
+                    for i in idxs]
+    return outs, Tensor(jnp.asarray(restore, jnp.int32)), rois_num_per
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """reference: deformable conv v1/v2 — bilinear sampling at
+    offset-shifted taps, then a dense contraction (gather + einsum: the
+    XLA-friendly formulation of the CUDA kernel)."""
+    from ..core.tensor import Tensor, _val
+    import jax.numpy as jnp
+    xv = _val(x).astype(jnp.float32)
+    ov = _val(offset).astype(jnp.float32)
+    wv = _val(weight).astype(jnp.float32)
+    n, cin, h, w = xv.shape
+    cout, cin_g, kh, kw = wv.shape
+    s = (stride, stride) if np.isscalar(stride) else tuple(stride)
+    p = (padding, padding) if np.isscalar(padding) else tuple(padding)
+    d = (dilation, dilation) if np.isscalar(dilation) else tuple(dilation)
+    oh = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+    ow = (w + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+    xp = jnp.pad(xv, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+    base_y = jnp.arange(oh) * s[0]
+    base_x = jnp.arange(ow) * s[1]
+    ky = jnp.arange(kh) * d[0]
+    kx = jnp.arange(kw) * d[1]
+    # sample positions (N, kh, kw, oh, ow)
+    off = ov.reshape(n, deformable_groups, kh, kw, 2, oh, ow)
+    off = off.mean(1)                                     # collapse dg
+    py = base_y[None, None, None, :, None] + ky[None, :, None, None, None] \
+        + off[:, :, :, 0] if False else (
+        base_y[None, None, None, :, None]
+        + ky[None, :, None, None, None]
+        + off[:, :, :, 0, :, :])
+    px = base_x[None, None, None, None, :] \
+        + kx[None, None, :, None, None] + off[:, :, :, 1, :, :]
+    py = jnp.clip(py, 0, xp.shape[2] - 1.001)
+    px = jnp.clip(px, 0, xp.shape[3] - 1.001)
+    y0 = jnp.floor(py).astype(jnp.int32)
+    x0 = jnp.floor(px).astype(jnp.int32)
+    wy = py - y0
+    wx = px - x0
+
+    def gather(yy, xx):
+        # (N, C, kh, kw, oh, ow)
+        return xp[jnp.arange(n)[:, None, None, None, None, None],
+                  jnp.arange(cin)[None, :, None, None, None, None],
+                  yy[:, None], xx[:, None]]
+
+    val = (gather(y0, x0) * ((1 - wy) * (1 - wx))[:, None]
+           + gather(y0 + 1, x0) * (wy * (1 - wx))[:, None]
+           + gather(y0, x0 + 1) * ((1 - wy) * wx)[:, None]
+           + gather(y0 + 1, x0 + 1) * (wy * wx)[:, None])
+    if mask is not None:
+        mv = _val(mask).astype(jnp.float32).reshape(
+            n, deformable_groups, kh, kw, oh, ow).mean(1)
+        val = val * mv[:, None]
+    out = jnp.einsum("nckhw...,ock->no...", 0, 0) if False else \
+        jnp.einsum("ncijhw,ocij->nohw", val, wv)
+    if bias is not None:
+        out = out + _val(bias).reshape(1, -1, 1, 1)
+    return Tensor(out)
+
+
+class DeformConv2D:
+    """Layer wrapper for deform_conv2d (reference nn-style class)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        from .. import nn
+        k = (kernel_size, kernel_size) if np.isscalar(kernel_size) \
+            else tuple(kernel_size)
+        import numpy as _np
+        from ..core.tensor import Parameter
+        import jax.numpy as jnp
+        rng = _np.random.default_rng(0)
+        scale = 1.0 / _np.sqrt(in_channels * k[0] * k[1])
+        self.weight = Parameter(jnp.asarray(
+            rng.uniform(-scale, scale,
+                        (out_channels, in_channels // groups, k[0], k[1]))
+            .astype(_np.float32)))
+        self.bias = (Parameter(jnp.zeros((out_channels,), jnp.float32))
+                     if bias_attr is not False else None)
+        self._kw = dict(stride=stride, padding=padding, dilation=dilation,
+                        deformable_groups=deformable_groups, groups=groups)
+
+    def __call__(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             mask=mask, **self._kw)

@@ -270,3 +270,276 @@ class ContrastTransform(BaseTransform):
     def _apply_image(self, img):
         f = 1 + pyrandom.uniform(-self.value, self.value)
         return adjust_contrast(img, f)
+
+
+# ------------------------------------------------- color / geometry (r4)
+def to_grayscale(img, num_output_channels: int = 1):
+    a = _as_hwc(img).astype(np.float32)
+    g = a[..., 0] * 0.299 + a[..., 1] * 0.587 + a[..., 2] * 0.114
+    out = np.repeat(g[..., None], num_output_channels, axis=-1)
+    return out.astype(np.asarray(img).dtype if hasattr(img, "dtype")
+                      else np.uint8)
+
+
+def adjust_saturation(img, saturation_factor: float):
+    a = _as_hwc(img).astype(np.float32)
+    gray = to_grayscale(a, 3).astype(np.float32)
+    out = gray + saturation_factor * (a - gray)
+    return np.clip(out, 0, 255).astype(_as_hwc(img).dtype)
+
+
+def adjust_hue(img, hue_factor: float):
+    """Rotate hue by hue_factor (in [-0.5, 0.5] turns) via HSV."""
+    import colorsys  # noqa: F401  (documentation pointer; vectorized below)
+    a = _as_hwc(img).astype(np.float32) / 255.0
+    mx = a.max(-1)
+    mn = a.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = a[..., 0], a[..., 1], a[..., 2]
+    h = np.zeros_like(mx)
+    m = mx == r
+    h[m] = ((g - b)[m] / diff[m]) % 6
+    m = mx == g
+    h[m] = (b - r)[m] / diff[m] + 2
+    m = mx == b
+    h[m] = (r - g)[m] / diff[m] + 4
+    h = (h / 6.0 + hue_factor) % 1.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0)
+    v = mx
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = (i.astype(np.int32) % 6)[..., None]      # broadcast over channels
+    out = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    return (out * 255).clip(0, 255).astype(_as_hwc(img).dtype)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    a = _as_hwc(img)
+    out = a if inplace else a.copy()
+    out[i:i + h, j:j + w] = v
+    return out
+
+
+def _affine_grid_sample(img, matrix, out_hw=None):
+    """Inverse-map affine resample via scipy.ndimage (host transform —
+    the input pipeline runs on CPU by design)."""
+    from scipy import ndimage
+    a = _as_hwc(img).astype(np.float32)
+    hw = out_hw or a.shape[:2]
+    out = np.stack([
+        ndimage.affine_transform(a[..., c], matrix[:2, :2],
+                                 offset=matrix[:2, 2],
+                                 output_shape=hw, order=1, mode="constant")
+        for c in range(a.shape[-1])], -1)
+    return out.astype(_as_hwc(img).dtype)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    from scipy import ndimage
+    a = _as_hwc(img)
+    out = ndimage.rotate(a, -angle, axes=(0, 1), reshape=expand,
+                         order=0 if interpolation == "nearest" else 1,
+                         mode="constant", cval=fill)
+    return out.astype(a.dtype)
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="nearest", center=None, fill=0):
+    a = _as_hwc(img)
+    h, w = a.shape[:2]
+    cy, cx = (center or (h / 2, w / 2))
+    ang = np.deg2rad(angle)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    # forward matrix: T(center) R S Shear T(-center) T(translate)
+    m = np.array([[np.cos(ang + sy), -np.sin(ang + sx)],
+                  [np.sin(ang + sy), np.cos(ang + sx)]]) * scale
+    inv = np.linalg.inv(m)
+    off = np.array([cy, cx]) - inv @ (np.array([cy, cx])
+                                      + np.array([translate[1],
+                                                  translate[0]]))
+    mat = np.eye(3)
+    mat[:2, :2] = inv
+    mat[:2, 2] = off
+    return _affine_grid_sample(a, mat)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """4-point perspective warp (host-side)."""
+    from scipy import ndimage
+    a = _as_hwc(img).astype(np.float32)
+    sp = np.asarray(startpoints, np.float32)
+    ep = np.asarray(endpoints, np.float32)
+    # solve the 8-dof homography mapping endpoints -> startpoints (inverse)
+    A, b = [], []
+    for (x, y), (u, v) in zip(ep, sp):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        b.append(u)
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+        b.append(v)
+    hcoef = np.linalg.solve(np.asarray(A), np.asarray(b))
+    H = np.append(hcoef, 1.0).reshape(3, 3)
+
+    hh, ww = a.shape[:2]
+    ys, xs = np.mgrid[0:hh, 0:ww].astype(np.float32)
+    denom = H[2, 0] * xs + H[2, 1] * ys + H[2, 2]
+    u = (H[0, 0] * xs + H[0, 1] * ys + H[0, 2]) / denom
+    v = (H[1, 0] * xs + H[1, 1] * ys + H[1, 2]) / denom
+    out = np.stack([
+        ndimage.map_coordinates(a[..., c], [v, u], order=1,
+                                mode="constant", cval=fill)
+        for c in range(a.shape[-1])], -1)
+    return out.astype(_as_hwc(img).dtype)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        return to_grayscale(img, self.n)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        f = 1.0 + np.random.uniform(-self.value, self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.b, self.c, self.s, self.h = brightness, contrast, saturation, hue
+
+    def __call__(self, img):
+        if self.b:
+            img = adjust_brightness(
+                img, 1 + np.random.uniform(-self.b, self.b))
+        if self.c:
+            img = adjust_contrast(
+                img, 1 + np.random.uniform(-self.c, self.c))
+        if self.s:
+            img = adjust_saturation(
+                img, 1 + np.random.uniform(-self.s, self.s))
+        if self.h:
+            img = adjust_hue(img, np.random.uniform(-self.h, self.h))
+        return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        self.degrees = ((-degrees, degrees) if np.isscalar(degrees)
+                        else tuple(degrees))
+        self.kw = dict(interpolation=interpolation, expand=expand,
+                       center=center, fill=fill)
+
+    def __call__(self, img):
+        return rotate(img, np.random.uniform(*self.degrees), **self.kw)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        self.degrees = ((-degrees, degrees) if np.isscalar(degrees)
+                        else tuple(degrees))
+        self.translate, self.scale, self.shear = translate, scale, shear
+
+    def __call__(self, img):
+        h, w = _as_hwc(img).shape[:2]
+        ang = np.random.uniform(*self.degrees)
+        tr = (0, 0)
+        if self.translate:
+            tr = (np.random.uniform(-self.translate[0], self.translate[0]) * w,
+                  np.random.uniform(-self.translate[1], self.translate[1]) * h)
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        sh = (np.random.uniform(-self.shear, self.shear), 0.0) \
+            if np.isscalar(self.shear or 0) and self.shear else (0.0, 0.0)
+        return affine(img, angle=ang, translate=tr, scale=sc, shear=sh)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob, self.d = prob, distortion_scale
+
+    def __call__(self, img):
+        if np.random.rand() > self.prob:
+            return img
+        h, w = _as_hwc(img).shape[:2]
+        dx, dy = self.d * w / 2, self.d * h / 2
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.uniform(0, dx), np.random.uniform(0, dy)),
+               (w - 1 - np.random.uniform(0, dx), np.random.uniform(0, dy)),
+               (w - 1 - np.random.uniform(0, dx),
+                h - 1 - np.random.uniform(0, dy)),
+               (np.random.uniform(0, dx), h - 1 - np.random.uniform(0, dy))]
+        return perspective(img, start, end)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if np.isscalar(size) else tuple(size)
+        self.scale, self.ratio = scale, ratio
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        a = _as_hwc(img)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                return resize(crop(a, top, left, ch, cw), self.size,
+                              self.interpolation)
+        return resize(center_crop(a, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def __call__(self, img):
+        a = _as_hwc(img)
+        if np.random.rand() > self.prob:
+            return img
+        h, w = a.shape[:2]
+        for _ in range(10):
+            target = h * w * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                return erase(a, i, j, eh, ew, self.value, self.inplace)
+        return img

@@ -246,6 +246,11 @@ MANIFEST = {
 
 def main():
     import paddle_tpu as paddle
+    from api_manifest_extra import EXTRA
+
+    for mod, names in EXTRA.items():
+        MANIFEST.setdefault(mod, [])
+        MANIFEST[mod] = sorted(set(MANIFEST[mod]) | set(names))
 
     rows = []
     missing_all = {}
@@ -253,7 +258,9 @@ def main():
     for mod, names in sorted(MANIFEST.items()):
         obj = paddle
         ok = True
-        if mod:
+        if mod == "Tensor":
+            obj = paddle.Tensor      # method/property surface
+        elif mod:
             for part in mod.split("."):
                 obj = getattr(obj, part, None)
                 if obj is None:
@@ -266,9 +273,11 @@ def main():
                 have.append(n)
             else:
                 missing.append(n)
-        rows.append((mod or "paddle", len(have), len(have) + len(missing)))
+        label = "Tensor (methods)" if mod == "Tensor" else (mod or "paddle")
+        rows.append((label, len(have), len(have) + len(missing)))
         if missing:
-            missing_all[mod or "paddle"] = missing
+            missing_all["Tensor" if mod == "Tensor" else (mod or "paddle")] \
+                = missing
         total_have += len(have)
         total_all += len(have) + len(missing)
 

@@ -1,0 +1,392 @@
+"""Adversarial expansion of the API-coverage manifest (VERDICT r3 item 7).
+
+Provenance: the reference mount is empty every round, so these names are
+curated from the upstream PaddlePaddle 2.6 public API documentation
+(api/paddle/Overview + per-module Overview pages) and the upstream
+``python/paddle/__init__.py`` ``__all__`` structure as described in
+SURVEY.md §2.2 — deliberately INCLUDING areas this rebuild has not
+covered (vision model zoo, audio/text datasets, onnx export) so the
+reported percentage is honest rather than self-confirming. The special
+module key ``"Tensor"`` is resolved against ``paddle_tpu.Tensor``
+attributes (upstream: python/paddle/tensor/tensor.prototype.pyi — the
+method surface of ``paddle.Tensor``).
+"""
+
+# ~340 paddle.Tensor methods/properties (upstream Tensor docs: every
+# tensor op surfaces as a method; _ suffix = inplace).
+TENSOR_METHODS = """
+abs acos acosh add add_ addmm all allclose amax amin angle any argmax
+argmin argsort asin asinh astype atan atan2 atanh backward bincount
+bitwise_and bitwise_not bitwise_or bitwise_xor bmm broadcast_to
+bucketize cast ceil ceil_ cholesky chunk clip clip_ clone concat conj
+cos cosh count_nonzero cpu cross cumprod cumsum cummax cummin detach
+diag diagonal diff digamma dim dist divide dot dsplit eig eigvals
+equal equal_all erf erfinv exp exp_ expand expand_as expm1 fill_
+fill_diagonal_ flatten flatten_ flip floor floor_ floor_divide floor_mod
+fmax fmin frac gather gather_nd gcd greater_equal greater_than
+heaviside histogram hsplit imag increment index_add index_put
+index_sample index_select inner inverse isclose isfinite isinf isnan
+item kron kthvalue lcm lerp lerp_ less_equal less_than lgamma log
+log10 log1p log2 logcumsumexp logical_and logical_not logical_or
+logical_xor logit logsumexp lstsq lu masked_fill masked_fill_
+masked_select masked_scatter matmul max maximum mean median min minimum
+mm mod mode moveaxis multiply multiplex mv nan_to_num nanmean nanmedian
+nansum neg nonzero norm normal_ not_equal numel numpy outer pow prod
+put_along_axis quantile rad2deg real reciprocal reciprocal_ register_hook
+remainder remainder_ repeat_interleave reshape reshape_ roll rot90
+round round_ rsqrt rsqrt_ scale scale_ scatter scatter_ scatter_nd
+scatter_nd_add searchsorted set_value sgn shard_index sign sin sinh
+slice sort split sqrt sqrt_ square squeeze squeeze_ stack
+stanh std strided_slice subtract subtract_ sum t take take_along_axis
+tanh tanh_ tensor_split tile to tolist topk trace transpose tril triu
+trunc unbind uniform_ unique unique_consecutive unsqueeze unsqueeze_
+unstack var vsplit where zero_
+logaddexp copysign signbit isposinf isneginf polygamma i0 i0e i1 i1e
+nanquantile renorm trapezoid unflatten as_strided positive block_diag
+vander cumulative_trapezoid ldexp hypot element_size diag_embed
+diagonal_scatter index_fill index_fill_ abs_ sin_ cos_ tan_
+""".split()
+
+TENSOR_PROPERTIES = """
+T dtype grad is_leaf name ndim persistable place shape size
+stop_gradient
+""".split()
+
+EXTRA = {
+    "Tensor": TENSOR_METHODS + TENSOR_PROPERTIES,
+    "": [
+        # framework / device / dtype infra (upstream top level)
+        "Tensor", "dtype", "finfo", "iinfo", "get_default_dtype",
+        "set_default_dtype", "set_grad_enabled", "is_grad_enabled",
+        "no_grad", "enable_grad", "grad", "disable_static",
+        "enable_static", "in_dynamic_mode", "get_flags", "set_flags",
+        "save", "load", "summary", "flops", "Model", "LazyGuard",
+        "set_printoptions", "einsum", "is_complex", "is_floating_point",
+        "is_integer", "crop", "increment", "multiplex", "shard_index",
+        "standard_normal", "poisson", "log_normal", "cauchy_",
+        "unflatten", "as_strided", "positive", "negative",
+        "combinations", "polar", "vander", "trapezoid", "cumulative_trapezoid",
+        "logaddexp", "logit", "i0", "i0e", "i1", "i1e", "polygamma",
+        "copysign", "signbit", "isposinf", "isneginf", "isreal",
+        "index_fill", "index_fill_", "diagonal_scatter", "select_scatter",
+        "slice_scatter", "masked_scatter_", "block_diag", "stanh",
+        "renorm", "quantile", "nanquantile", "pdist", "cdist",
+        "batch", "scale", "clip_", "subtract_", "add_", "numel",
+        "nextafter", "frexp", "masked_fill", "masked_fill_",
+        "histogram_bin_edges", "bernoulli_", "binomial",
+    ],
+    "device": [
+        "set_device", "get_device", "get_all_device_type",
+        "get_all_custom_device_type", "get_available_device",
+        "get_available_custom_device", "is_compiled_with_cuda",
+        "is_compiled_with_rocm", "is_compiled_with_xpu",
+        "is_compiled_with_custom_device", "cuda",
+    ],
+    "regularizer": ["L1Decay", "L2Decay"],
+    "callbacks": [
+        "Callback", "EarlyStopping", "LRScheduler", "ModelCheckpoint",
+        "ProgBarLogger", "ReduceLROnPlateau", "VisualDL",
+    ],
+    "nn": [
+        # layer-zoo long tail (upstream paddle.nn Overview)
+        "Identity", "Flatten", "Unflatten", "UpsamplingBilinear2D",
+        "UpsamplingNearest2D", "Upsample", "AlphaDropout", "Dropout2D",
+        "Dropout3D", "FeatureAlphaDropout",
+        "CELU", "GLU", "Hardshrink", "Hardsigmoid", "Hardswish",
+        "Hardtanh", "LeakyReLU", "LogSigmoid", "LogSoftmax", "Maxout",
+        "Mish", "PReLU", "RReLU", "ReLU6", "SELU", "Silu", "Softmax2D",
+        "Softplus", "Softshrink", "Softsign", "Swish", "Tanhshrink",
+        "ThresholdedReLU",
+        "Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+        "Conv3DTranspose",
+        "AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D",
+        "MaxPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
+        "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
+        "AdaptiveMaxPool3D", "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+        "FractionalMaxPool2D", "FractionalMaxPool3D",
+        "BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+        "SyncBatchNorm", "GroupNorm", "InstanceNorm1D", "InstanceNorm2D",
+        "InstanceNorm3D", "LayerNorm", "LocalResponseNorm", "RMSNorm",
+        "SpectralNorm",
+        "Pad1D", "Pad2D", "Pad3D", "ZeroPad1D", "ZeroPad2D", "ZeroPad3D",
+        "CosineSimilarity", "PairwiseDistance",
+        "Embedding", "Linear", "Bilinear", "Dropout",
+        "SimpleRNN", "LSTM", "GRU", "SimpleRNNCell", "LSTMCell", "GRUCell",
+        "RNN", "BiRNN", "RNNCellBase",
+        "AdaptiveLogSoftmaxWithLoss",
+        "MultiHeadAttention", "Transformer", "TransformerDecoder",
+        "TransformerDecoderLayer", "TransformerEncoder",
+        "TransformerEncoderLayer",
+        "BCELoss", "BCEWithLogitsLoss", "CrossEntropyLoss", "CTCLoss",
+        "CosineEmbeddingLoss", "GaussianNLLLoss", "HSigmoidLoss",
+        "HingeEmbeddingLoss", "KLDivLoss", "L1Loss", "MarginRankingLoss",
+        "MSELoss", "MultiLabelSoftMarginLoss", "MultiMarginLoss",
+        "NLLLoss", "PoissonNLLLoss", "RNNTLoss", "SmoothL1Loss",
+        "SoftMarginLoss", "TripletMarginLoss",
+        "TripletMarginWithDistanceLoss",
+        "PixelShuffle", "PixelUnshuffle", "ChannelShuffle", "Fold",
+        "Unfold",
+        "Layer", "LayerList", "LayerDict", "Sequential", "ParameterList",
+        "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue",
+        "initializer", "utils",
+    ],
+    "nn.initializer": [
+        "Assign", "Bilinear", "Constant", "Dirac", "KaimingNormal",
+        "KaimingUniform", "Normal", "Orthogonal", "TruncatedNormal",
+        "Uniform", "XavierNormal", "XavierUniform", "calculate_gain",
+        "set_global_initializer",
+    ],
+    "nn.utils": [
+        "clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+        "remove_weight_norm", "spectral_norm", "vector_to_parameters",
+        "weight_norm",
+    ],
+    "nn.functional": [
+        # functional long tail
+        "adaptive_log_softmax_with_loss", 
+        "celu", "glu", "gumbel_softmax", "hardshrink", "hardsigmoid",
+        "hardswish", "hardtanh", "leaky_relu", "log_sigmoid",
+        "log_softmax", "maxout", "mish", "prelu", "rrelu", "relu6",
+        "selu", "silu", "softmax_", "softplus", "softshrink", "softsign",
+        "swish", "tanhshrink", "thresholded_relu",
+        "alpha_dropout", "dropout2d", "dropout3d", "feature_alpha_dropout",
+        "fold", "unfold", "pixel_shuffle", "pixel_unshuffle",
+        "channel_shuffle", "interpolate", "upsample", "grid_sample",
+        "affine_grid", "pad", "zeropad2d",
+        "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
+        "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
+        "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+        "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+        "max_unpool1d", "max_unpool2d", "max_unpool3d",
+        "binary_cross_entropy", "binary_cross_entropy_with_logits",
+        "cosine_embedding_loss", "cross_entropy", "ctc_loss",
+        "gaussian_nll_loss", "hinge_embedding_loss", "hsigmoid_loss",
+        "kl_div", "l1_loss", "log_loss", "margin_cross_entropy",
+        "margin_ranking_loss", "mse_loss", "multi_label_soft_margin_loss",
+        "multi_margin_loss", "nll_loss", "npair_loss", "poisson_nll_loss",
+        "rnnt_loss", "sigmoid_focal_loss", "smooth_l1_loss",
+        "soft_margin_loss", "softmax_with_cross_entropy", "square_error_cost",
+        "triplet_margin_loss", "triplet_margin_with_distance_loss",
+        "cosine_similarity", "linear", "bilinear", "embedding",
+        "one_hot", "label_smooth", "class_center_sample",
+        "scaled_dot_product_attention", "sequence_mask", "normalize",
+        "local_response_norm", "batch_norm", "group_norm", "instance_norm",
+        "layer_norm", "rms_norm", "temporal_shift",
+    ],
+    "linalg": [
+        "cholesky", "cholesky_solve", "cond", "corrcoef", "cov", "det",
+        "eig", "eigh", "eigvals", "eigvalsh", "householder_product",
+        "inv", "lstsq", "lu", "lu_unpack", "matrix_exp", "matrix_norm",
+        "matrix_power", "matrix_rank", "multi_dot", "norm", "pca_lowrank",
+        "pinv", "qr", "slogdet", "solve", "svd", "svd_lowrank",
+        "triangular_solve", "vector_norm",
+    ],
+    "io": [
+        "BatchSampler", "ChainDataset", "ComposeDataset", "DataLoader",
+        "Dataset", "DistributedBatchSampler", "IterableDataset",
+        "RandomSampler", "Sampler", "SequenceSampler", "Subset",
+        "SubsetRandomSampler", "TensorDataset", "WeightedRandomSampler",
+        "get_worker_info", "random_split",
+    ],
+    "distributed": [
+        "rpc", "get_backend", "is_available",
+        "destroy_process_group", "get_group", "gloo_init_parallel_env",
+        "stream", "save_state_dict", "load_state_dict",
+        "alltoall_single", "reduce_scatter", "is_initialized",
+        "launch", "checkpoint",
+    ],
+    "distributed.communication.stream": [
+        "all_gather", "all_reduce", "alltoall", "alltoall_single",
+        "broadcast", "reduce", "reduce_scatter", "recv", "scatter",
+        "send",
+    ],
+    "distributed.rpc": [
+        "init_rpc", "rpc_sync", "rpc_async", "shutdown",
+        "get_worker_info", "get_all_worker_infos", "get_current_worker_info",
+    ],
+    "static": [
+        "Program", "program_guard", "data", "Executor",
+        "default_main_program", "default_startup_program", "InputSpec",
+        "name_scope", "device_guard", "cpu_places", "cuda_places",
+        "global_scope", "scope_guard", "append_backward", "gradients",
+        "save", "load", "save_inference_model", "load_inference_model",
+        "normalize_program", "Variable",
+    ],
+    "jit": [
+        "to_static", "not_to_static", "save", "load", "ignore_module",
+        "enable_to_static", "TranslatedLayer",
+    ],
+    "amp": [
+        "GradScaler", "auto_cast", "decorate", "is_bfloat16_supported",
+        "is_float16_supported", "debugging",
+    ],
+    "incubate": [
+        "segment_max", "segment_mean", "segment_min", "segment_sum",
+        "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+        "identity_loss", "graph_khop_sampler", "graph_reindex",
+        "graph_sample_neighbors",
+    ],
+    "vision": ["get_image_backend", "set_image_backend", "image_load",
+               "transforms", "models", "datasets", "ops"],
+    "vision.transforms": [
+        "BaseTransform", "BrightnessTransform", "CenterCrop",
+        "ColorJitter", "Compose", "ContrastTransform", "Grayscale",
+        "HueTransform", "Normalize", "Pad", "RandomCrop",
+        "RandomErasing", "RandomHorizontalFlip", "RandomResizedCrop",
+        "RandomRotation", "RandomVerticalFlip", "Resize",
+        "SaturationTransform", "ToTensor", "Transpose", "RandomAffine",
+        "RandomPerspective", "affine", "perspective", "erase",
+        "adjust_brightness",
+        "adjust_contrast", "adjust_hue", "center_crop", "crop", "hflip",
+        "normalize", "pad", "resize", "rotate", "to_grayscale",
+        "to_tensor", "vflip",
+    ],
+    "vision.models": [
+        "AlexNet", "alexnet", "DenseNet", "densenet121", "densenet161",
+        "densenet169", "densenet201", "densenet264", "GoogLeNet",
+        "googlenet", "InceptionV3", "inception_v3", "LeNet", "MobileNetV1",
+        "mobilenet_v1", "MobileNetV2", "mobilenet_v2", "MobileNetV3Large",
+        "MobileNetV3Small", "mobilenet_v3_large", "mobilenet_v3_small",
+        "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+        "resnet152", "resnext50_32x4d", "resnext50_64x4d",
+        "resnext101_32x4d", "resnext101_64x4d", "resnext152_32x4d",
+        "resnext152_64x4d", "ShuffleNetV2", "shufflenet_v2_x0_25",
+        "shufflenet_v2_x0_33", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+        "shufflenet_v2_x1_5", "shufflenet_v2_x2_0", "shufflenet_v2_swish",
+        "SqueezeNet", "squeezenet1_0", "squeezenet1_1", "VGG", "vgg11",
+        "vgg13", "vgg16", "vgg19", "wide_resnet50_2", "wide_resnet101_2",
+    ],
+    "vision.datasets": ["Cifar10", "Cifar100", "FashionMNIST", "Flowers",
+                        "MNIST", "VOC2012", "DatasetFolder", "ImageFolder"],
+    "vision.ops": ["DeformConv2D", "PSRoIPool", "RoIAlign", "RoIPool",
+                   "box_coder", "deform_conv2d", "distribute_fpn_proposals",
+                   "generate_proposals", "nms", "prior_box", "psroi_pool",
+                   "roi_align", "roi_pool", "yolo_box", "yolo_loss"],
+    "onnx": ["export"],
+    "audio": ["backends", "datasets", "features", "functional"],
+    "text": ["Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14",
+             "WMT16", "viterbi_decode", "ViterbiDecoder"],
+    "utils": ["deprecated", "get_weights_path_from_url", "require_version",
+              "run_check", "try_import", "unique_name", "cpp_extension",
+              "dlpack"],
+    "version": ["cuda", "cudnn", "full_version", "major", "minor"],
+    "distributed.fleet": [
+        "init", "is_first_worker", "worker_index", "worker_num",
+        "is_worker", "worker_endpoints", "server_num", "server_index",
+        "server_endpoints", "is_server", "barrier_worker", "init_worker",
+        "init_server", "run_server", "stop_worker", "distributed_model",
+        "distributed_optimizer", "DistributedStrategy",
+        "UserDefinedRoleMaker", "PaddleCloudRoleMaker", "UtilBase",
+        "get_hybrid_communicate_group", "HybridCommunicateGroup",
+        "meta_parallel", "utils",
+    ],
+    "distributed.fleet.meta_parallel": [
+        "ColumnParallelLinear", "RowParallelLinear",
+        "VocabParallelEmbedding", "ParallelCrossEntropy", "PipelineLayer",
+        "LayerDesc", "SharedLayerDesc", "TensorParallel",
+        "PipelineParallel", "ShardingParallel", "get_rng_state_tracker",
+    ],
+    "distributed.fleet.utils": [
+        "recompute", "LocalFS", "HDFSClient",
+    ],
+    "distributed.auto_parallel": [
+        "ProcessMesh", "shard_tensor", "shard_op", "Engine", "Strategy",
+    ],
+    "distributed.sharding": [
+        "group_sharded_parallel", "save_group_sharded_model",
+    ],
+    "distributed.utils": [
+        "global_scatter", "global_gather",
+    ],
+    "incubate.nn": [
+        "FusedBiasDropoutResidualLayerNorm", "FusedFeedForward",
+        "FusedLinear", "FusedMultiHeadAttention", "FusedMultiTransformer",
+        "FusedTransformerEncoderLayer",
+    ],
+    "incubate.nn.functional": [
+        "fused_bias_dropout_residual_layer_norm", "fused_dropout_add",
+        "fused_ec_moe", "fused_feedforward", "fused_layer_norm",
+        "fused_linear", "fused_linear_activation", "fused_matmul_bias",
+        "fused_multi_head_attention", "fused_multi_transformer",
+        "fused_rms_norm", "fused_rotary_position_embedding",
+        "masked_multihead_attention", "swiglu", "variable_length_memory_efficient_attention",
+    ],
+    "incubate.optimizer": ["LookAhead", "ModelAverage", "LBFGS"],
+    "geometric": [
+        "send_u_recv", "send_ue_recv", "send_uv", "segment_max",
+        "segment_mean", "segment_min", "segment_sum", "sample_neighbors",
+        "reindex_graph",
+    ],
+    "hub": ["help", "list", "load"],
+    "device.cuda": [
+        "Event", "Stream", "current_stream", "device_count",
+        "empty_cache", "get_device_capability", "get_device_name",
+        "get_device_properties", "max_memory_allocated",
+        "max_memory_reserved", "memory_allocated", "memory_reserved",
+        "stream_guard", "synchronize",
+    ],
+    "profiler": ["RecordEvent", "SortedKeys", "SummaryView",
+                 "load_profiler_result"],
+    "amp.debugging": [
+        "TensorCheckerConfig", "check_numerics",
+        "collect_operator_stats", "disable_operator_stats_collection",
+        "disable_tensor_checker", "enable_operator_stats_collection",
+        "enable_tensor_checker", "compare_accuracy",
+    ],
+    "utils.cpp_extension": ["CppExtension", "CUDAExtension", "load",
+                            "setup", "get_build_directory"],
+    "utils.dlpack": ["from_dlpack", "to_dlpack"],
+    "utils.unique_name": ["generate", "guard", "switch"],
+    "incubate.asp": ["decorate", "prune_model", "set_excluded_layers",
+                     "reset_excluded_layers"],
+    "incubate.distributed.models.moe": ["MoELayer", "GShardGate",
+                                        "SwitchGate", "BaseGate"],
+    "distributed.fleet.meta_optimizers": [
+        "DygraphShardingOptimizer", "HybridParallelOptimizer",
+        "HybridParallelGradScaler",
+    ],
+    "autograd": [
+        "backward", "hessian", "jacobian", "jvp", "vjp", "PyLayer",
+        "PyLayerContext", "saved_tensors_hooks", "no_grad", "is_grad_enabled",
+        "set_grad_enabled",
+    ],
+    "fft": [
+        "fft", "fft2", "fftn", "ifft", "ifft2", "ifftn", "rfft", "rfft2",
+        "rfftn", "irfft", "irfft2", "irfftn", "hfft", "hfft2", "hfftn",
+        "ihfft", "ihfft2", "ihfftn", "fftfreq", "rfftfreq", "fftshift",
+        "ifftshift",
+    ],
+    "signal": ["stft", "istft"],
+    "optimizer": [
+        "Adadelta", "Adagrad", "Adam", "AdamW", "Adamax", "ASGD",
+        "LBFGS", "Lamb", "Momentum", "NAdam", "Optimizer", "RAdam",
+        "RMSProp", "Rprop", "SGD", "lr",
+    ],
+    "sparse": [
+        "sparse_coo_tensor", "sparse_csr_tensor", "is_same_shape", "nn",
+        "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+        "mv", "transpose", "reshape", "sum", "abs", "sin", "sinh", "tan",
+        "tanh", "asin", "asinh", "atan", "atanh", "sqrt", "square",
+        "log1p", "expm1", "pow", "neg", "cast", "coalesce", "rad2deg",
+        "deg2rad",     ],
+    "static.nn": [
+        "fc", "batch_norm", "embedding", "conv2d", "conv3d", "cond",
+        "while_loop", "case", "switch_case", "py_func", "sequence_expand",
+        "prelu", "spectral_norm", "layer_norm", "group_norm", "nce",
+    ],
+    "metric": ["Accuracy", "Auc", "Metric", "Precision", "Recall",
+               "accuracy"],
+    "distribution": [
+        "AbsTransform", "AffineTransform", "Bernoulli", "Beta",
+        "Binomial", "Categorical", "Cauchy", "ChainTransform",
+        "ContinuousBernoulli", "Dirichlet", "Distribution",
+        "ExpTransform", "Exponential", "ExponentialFamily", "Gamma",
+        "Geometric", "Gumbel", "Independent", "IndependentTransform",
+        "Laplace", "LogNormal", "Multinomial", "MultivariateNormal",
+        "Normal", "Poisson", "PowerTransform", "ReshapeTransform",
+        "SigmoidTransform", "SoftmaxTransform", "StackTransform",
+        "StickBreakingTransform", "StudentT", "TanhTransform",
+        "Transform", "TransformedDistribution", "Uniform",
+        "kl_divergence", "register_kl",
+    ],
+}
