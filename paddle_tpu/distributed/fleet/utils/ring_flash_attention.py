@@ -133,8 +133,10 @@ def ring_flash_attention(q, k, v, axis_name: str = "sep",
     holds sequence pieces r and 2P-1-r (half a chunk each;
     ``sep_scaled_dot_product_attention`` does the reorder and sets this).
     Causal work then balances EXACTLY: per rank over a full rotation,
-    qa-vs-ka runs r full blocks, qb-vs-ka runs P-1, qb-vs-kb runs
-    P-1-r — a constant 2(P-1) halves plus the diagonal step, vs the
+    qa-vs-ka runs r full half-blocks, qb-vs-ka runs P (piece(qb) =
+    2P-1-r exceeds every ka piece, so it is a full half-block on all P
+    steps), qb-vs-kb runs P-1-r — a constant 2P-1 halves plus the
+    diagonal contributions (qa-vs-ka and qb-vs-kb at src == r), vs the
     contiguous layout's r-proportional skew (rank P-1 does P times rank
     0's work). Work units are gated by ``lax.switch`` on the piece
     comparison, so skipped blocks cost nothing; the branches are pure

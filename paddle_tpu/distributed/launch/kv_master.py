@@ -35,6 +35,32 @@ from typing import Dict, List, Optional
 from .elastic import Rendezvous
 
 
+def check_job_token(handler: BaseHTTPRequestHandler,
+                    token: Optional[str]) -> bool:
+    """Shared X-Job-Token gate (used by the KV master AND distributed.rpc
+    so a hardening change lands in both): constant-time compare, 403 +
+    False on mismatch. Call BEFORE reading or unpickling the body."""
+    if token and not hmac.compare_digest(
+            handler.headers.get("X-Job-Token", ""), token):
+        try:   # drain the body so the client sees 403, not a RST reset;
+            # attacker-controlled headers: a junk Content-Length must not
+            # crash the rejection path, and an inflated one must not pin
+            # this thread on a blocking read
+            handler.connection.settimeout(5.0)
+            n = int(handler.headers.get("Content-Length", 0) or 0)
+            while n > 0:
+                chunk = handler.rfile.read(min(n, 1 << 16))
+                if not chunk:
+                    break
+                n -= len(chunk)
+        except (OSError, ValueError):
+            pass
+        handler.send_response(403)
+        handler.end_headers()
+        return False
+    return True
+
+
 class _Handler(BaseHTTPRequestHandler):
     store: Dict[str, bytes]
     lock: threading.Lock
@@ -44,12 +70,7 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _authorized(self) -> bool:
-        if self.token and not hmac.compare_digest(
-                self.headers.get("X-Job-Token", ""), self.token):
-            self.send_response(403)
-            self.end_headers()
-            return False
-        return True
+        return check_job_token(self, self.token)
 
     def _key(self) -> Optional[str]:
         if self.path.startswith("/kv/"):

@@ -23,7 +23,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 from urllib import request as _urlreq
 
-from .launch.kv_master import HTTPRendezvous, KVClient
+from .launch.kv_master import HTTPRendezvous, KVClient, check_job_token
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_worker_info", "get_all_worker_infos",
@@ -46,6 +46,11 @@ class _CallHandler(BaseHTTPRequestHandler):
         pass
 
     def do_POST(self):
+        # Same threat model as kv_master: any host that can reach the port.
+        # Authenticate BEFORE unpickling — pickle.loads of attacker bytes
+        # is arbitrary code execution.
+        if not check_job_token(self, _state.get("token")):
+            return
         n = int(self.headers.get("Content-Length", 0))
         fn, args, kwargs = pickle.loads(self.rfile.read(n))
         try:
@@ -68,16 +73,47 @@ def init_rpc(name: str, rank: int = -1, world_size: Optional[int] = None,
     rank = rank if rank >= 0 else int(os.environ.get("PADDLE_TRAINER_ID", 0))
     master = master_endpoint or os.environ.get("PADDLE_MASTER_ENDPOINT",
                                                "127.0.0.1:0")
-    httpd = ThreadingHTTPServer(("0.0.0.0", 0), _CallHandler)
+    # Advertise the IP the launcher assigned this trainer
+    # (PADDLE_CURRENT_ENDPOINT=ip:port) so remote workers dial the right
+    # machine; loopback only for single-host runs. Bind that same
+    # interface rather than 0.0.0.0.
+    cur = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+    ip = cur.rsplit(":", 1)[0] if ":" in cur else (cur or "127.0.0.1")
+    # install the token BEFORE the server socket starts accepting — a
+    # request racing init_rpc must not see an unauthenticated window
+    token = os.environ.get("PADDLE_JOB_TOKEN") or None
+    _state["token"] = token
+    # Same bind policy as the KV master (kv_master.py HTTPRendezvous):
+    # bind the advertised interface only when it is a literal IP —
+    # hostnames may resolve to loopback locally (Debian-style /etc/hosts)
+    # where the bind would *succeed* yet be unreachable from peers, so
+    # they get 0.0.0.0 + token auth instead.
+    bind_host = "0.0.0.0"
+    try:
+        import ipaddress
+        ipaddress.ip_address(ip)
+        bind_host = ip
+    except ValueError:
+        pass
+    try:
+        httpd = ThreadingHTTPServer((bind_host, 0), _CallHandler)
+    except OSError as e:   # endpoint names a NATed/external IP; fall back
+        # loud, not silent: if ip was simply wrong (stale endpoint) the
+        # rdzv still advertises it and calls to this worker will time out
+        import warnings
+        warnings.warn(
+            f"rpc: cannot bind {ip!r} ({e}); listening on 0.0.0.0 but "
+            f"advertising {ip!r} — if that address is wrong, calls to "
+            f"{name!r} will time out. Check PADDLE_CURRENT_ENDPOINT.")
+        httpd = ThreadingHTTPServer(("0.0.0.0", 0), _CallHandler)
     port = httpd.server_address[1]
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
     rdzv = HTTPRendezvous(master, is_master=rank == 0)
-    ip = "127.0.0.1"
     info = {"name": name, "rank": rank, "ip": ip, "port": port}
     rdzv.client.put(f"rpc/{name}", json.dumps(info).encode())
     _state.update(server=httpd, thread=t, rdzv=rdzv, name=name,
-                  rank=rank, world_size=world_size)
+                  rank=rank, world_size=world_size, token=token)
     if world_size:
         deadline = time.time() + 60
         while len(_workers()) < world_size and time.time() < deadline:
@@ -121,6 +157,8 @@ def rpc_async(to: str, fn, args=None, kwargs=None,
         try:
             req = _urlreq.Request(f"http://{w.ip}:{w.port}/", data=payload,
                                   method="POST")
+            if _state.get("token"):
+                req.add_header("X-Job-Token", _state["token"])
             with _urlreq.urlopen(req, timeout=timeout) as r:
                 ok, val = pickle.loads(r.read())
             if ok:

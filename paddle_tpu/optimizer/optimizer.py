@@ -778,7 +778,10 @@ class LBFGS(Optimizer):
         else:
             for p, nv in zip(params, self._unflat(flat + lr * d)):
                 p._value = nv
-        self._prev_flat = self._flat([p._value for p in params])
+        # Pair with the *evaluation* point: next step forms
+        # s = x_{k+1} - x_k and y = g_{k+1} - g_k. Saving the post-update
+        # params here would make s identically zero.
+        self._prev_flat = flat
         self._prev_grad = g
         self._step_count += 1
         return loss

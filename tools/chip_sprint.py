@@ -29,7 +29,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 import bench as bench_mod
 
-ROUND = os.environ.get("CHIP_SPRINT_ROUND", "r04")
+ROUND = os.environ.get("CHIP_SPRINT_ROUND", "r05")
 
 
 def base_env(test_mode: bool) -> dict:

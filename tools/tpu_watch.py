@@ -44,13 +44,37 @@ def base_env() -> dict:
     return bench_mod.cache_env(env)
 
 
-def probe() -> bool:
+def relay_listening(timeout: float = 3.0) -> bool:
+    """Cheap pre-check (TUNNEL_DIAGNOSIS.md): under the loopback relay
+    (``AXON_LOOPBACK_RELAY=1``), ``jax.devices()`` goes via the relay's
+    :8083 stateless endpoint. Connection refused means no relay process
+    exists — the 150 s PJRT probe would only hang in the claim loop, so
+    skip it and poll again soon. Environments NOT behind the relay (or
+    with a non-default port — set ``AXON_RELAY_PORT``) always fall
+    through to the real probe."""
+    if os.environ.get("AXON_LOOPBACK_RELAY") != "1":
+        return True   # no relay in the path; only the PJRT probe can tell
+    port = int(os.environ.get("AXON_RELAY_PORT", "8083"))
+    import socket
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+def probe() -> str:
+    """'tpu' | 'cpu' | 'dead' | 'no-relay' — one check per loop iteration
+    so the backoff branch can't disagree with a re-check."""
+    if not relay_listening():
+        log("probe -> no-relay (:8083 refused — skipped 150 s PJRT probe)")
+        return "no-relay"
     state = bench_mod._probe_backend(base_env())
     log(f"probe -> {state}")
-    return state == "tpu"
+    return state
 
 
-ROUND = os.environ.get("CHIP_SPRINT_ROUND", "r04")
+ROUND = os.environ.get("CHIP_SPRINT_ROUND", "r05")
 ARTIFACTS = [f"KERNEL_COMPILE_{ROUND}.json", f"ATTN_BENCH_{ROUND}.json",
              f"RMSNORM_BENCH_{ROUND}.json", f"BENCH_tpu_{ROUND}.json"]
 
@@ -58,9 +82,11 @@ ARTIFACTS = [f"KERNEL_COMPILE_{ROUND}.json", f"ATTN_BENCH_{ROUND}.json",
 def run_sprint() -> None:
     """Arm tools/chip_sprint.py: it banks + commits each step itself and
     skips already-banked artifacts, so re-arming after a flap is safe."""
-    r = subprocess.run(
+    env = base_env()
+    env["CHIP_SPRINT_ROUND"] = ROUND   # single source: sprint banks the
+    r = subprocess.run(                # same artifact names we wait for
         [sys.executable, os.path.join(REPO, "tools", "chip_sprint.py")],
-        env=base_env(), capture_output=True, text=True, timeout=4 * 3600,
+        env=env, capture_output=True, text=True, timeout=4 * 3600,
         cwd=REPO)
     log(f"chip_sprint rc={r.returncode} tail={r.stdout[-400:]} "
         f"stderr={r.stderr[-400:]}")
@@ -76,12 +102,15 @@ def main() -> None:
         if not todo:
             log("all artifacts banked — exiting")
             return
-        if probe():
+        state = probe()
+        if state == "tpu":
             interval = 120.0
             try:
                 run_sprint()
             except Exception as e:
                 log(f"sprint FAILED: {e!r}"[:500])
+        elif state == "no-relay":
+            interval = 60.0   # socket pre-check is ~free; poll often
         else:
             interval = min(interval * 1.5, 600.0)
         time.sleep(interval)
