@@ -60,6 +60,9 @@ from . import regularizer  # noqa: F401
 from . import version  # noqa: F401
 from . import hub  # noqa: F401
 from . import geometric  # noqa: F401
+from . import audio  # noqa: F401
+from . import text  # noqa: F401
+from . import onnx  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .hapi import callbacks as callbacks  # noqa: F401
 

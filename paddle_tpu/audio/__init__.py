@@ -1,0 +1,8 @@
+"""paddle.audio — audio features, IO backends, datasets.
+
+Reference: python/paddle/audio/__init__.py (exposes ``functional``,
+``features``, ``backends``, ``datasets``)."""
+
+from . import backends, datasets, features, functional
+
+__all__ = ["backends", "datasets", "features", "functional"]

@@ -1,0 +1,103 @@
+"""paddle.audio.backends — audio file IO.
+
+Reference: python/paddle/audio/backends/ (wave_backend default, optional
+soundfile). This environment has no soundfile; the stdlib ``wave``
+backend implements the same trio (``info``/``load``/``save``) for PCM
+WAV — the reference's wave_backend scope — and the backend-selection
+API reports exactly what is available instead of pretending.
+"""
+
+from __future__ import annotations
+
+import wave
+from dataclasses import dataclass
+from typing import List, Tuple, Union
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["AudioInfo", "info", "load", "save",
+           "list_available_backends", "get_current_backend", "set_backend"]
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str = "PCM_S"
+
+
+def list_available_backends() -> List[str]:
+    return ["wave_backend"]
+
+
+def get_current_backend() -> str:
+    return "wave_backend"
+
+
+def set_backend(backend_name: str) -> None:
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            f"backend {backend_name!r} is unavailable (soundfile is not "
+            f"installed in this environment); only 'wave_backend' exists")
+
+
+def info(filepath: str) -> AudioInfo:
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=8 * f.getsampwidth())
+
+
+_WIDTH_DTYPE = {1: np.uint8, 2: np.int16, 4: np.int32}
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True,
+         channels_first: bool = True) -> Tuple[Tensor, int]:
+    """(waveform, sample_rate); waveform float32 in [-1, 1] when
+    ``normalize`` (reference semantics), shape (C, T) when
+    ``channels_first``."""
+    with wave.open(filepath, "rb") as f:
+        sr, nch, width = f.getframerate(), f.getnchannels(), f.getsampwidth()
+        f.setpos(min(frame_offset, f.getnframes()))
+        n = f.getnframes() - f.tell() if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    if width not in _WIDTH_DTYPE:
+        raise ValueError(f"unsupported PCM sample width {width}")
+    data = np.frombuffer(raw, dtype=_WIDTH_DTYPE[width]).reshape(-1, nch)
+    if width == 1:   # unsigned 8-bit: center first
+        data = data.astype(np.float32) - 128.0
+        scale = 128.0
+    else:
+        scale = float(2 ** (8 * width - 1))
+        data = data.astype(np.float32)
+    wavef = data / scale if normalize else data
+    if channels_first:
+        wavef = wavef.T
+    return Tensor(wavef, stop_gradient=True), sr
+
+
+def save(filepath: str, src: Union[Tensor, np.ndarray], sample_rate: int,
+         channels_first: bool = True, encoding: str = "PCM_16",
+         bits_per_sample: int = 16) -> None:
+    if encoding != "PCM_16" or bits_per_sample != 16:
+        raise NotImplementedError(
+            "wave_backend writes PCM_16 only (reference wave_backend has "
+            "the same restriction)")
+    x = np.asarray(src._value if isinstance(src, Tensor) else src)
+    if x.ndim == 1:
+        x = x[None, :] if channels_first else x[:, None]
+    if channels_first:
+        x = x.T                       # -> (T, C)
+    x = np.clip(x, -1.0, 1.0)
+    pcm = (x * 32767.0).astype(np.int16)
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(pcm.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(pcm.tobytes())

@@ -1,0 +1,125 @@
+"""paddle.audio.datasets — TESS / ESC-50.
+
+Reference: python/paddle/audio/datasets/{tess.py,esc50.py}. Zero network
+egress: ``download=True`` (the reference default) raises with guidance;
+local archives laid out in the reference's extracted structure load
+through the stdlib wave backend.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ..io.dataset import Dataset
+from . import backends, features
+
+__all__ = ["TESS", "ESC50"]
+
+
+class _WavFolderDataset(Dataset):
+    NAME = "dataset"
+
+    def __init__(self, data_dir: Optional[str], mode: str,
+                 feat_type: str = "raw", archive=None, download: bool = False,
+                 **feat_kwargs):
+        if download or data_dir is None:
+            raise ValueError(
+                f"{type(self).__name__}: download is unsupported (no "
+                f"network egress); extract the {self.NAME} archive locally "
+                f"and pass data_dir=<extracted folder>")
+        if not os.path.isdir(data_dir):
+            raise FileNotFoundError(data_dir)
+        self.mode = mode
+        self.feat_type = feat_type
+        self._feat = self._make_feat(feat_type, feat_kwargs)
+        self.files, self.labels = self._index(data_dir)
+
+    def _make_feat(self, feat_type: str, kw) -> Optional[Callable]:
+        if feat_type == "raw":
+            return None
+        cls = {"spectrogram": features.Spectrogram,
+               "melspectrogram": features.MelSpectrogram,
+               "logmelspectrogram": features.LogMelSpectrogram,
+               "mfcc": features.MFCC}.get(feat_type)
+        if cls is None:
+            raise ValueError(f"unknown feat_type {feat_type!r}")
+        return cls(**kw)
+
+    def _index(self, data_dir: str) -> Tuple[List[str], List[int]]:
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        wav, _sr = backends.load(self.files[idx])
+        x = wav.numpy()[0]           # mono channel 0
+        if self._feat is not None:
+            from ..core.tensor import Tensor
+            x = self._feat(Tensor(x[None, :])).numpy()[0]
+        return x, np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+class TESS(_WavFolderDataset):
+    """Toronto Emotional Speech Set: <data_dir>/<speaker>_<word>_<emotion>
+    folders of wav files; label = emotion index (reference label set)."""
+
+    NAME = "TESS"
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def __init__(self, mode: str = "train", n_folds: int = 5,
+                 split: int = 1, feat_type: str = "raw",
+                 data_dir: Optional[str] = None, download: bool = False,
+                 **kw):
+        self.n_folds = n_folds
+        self.split = split
+        super().__init__(data_dir, mode, feat_type, download=download, **kw)
+
+    def _index(self, data_dir):
+        files, labels = [], []
+        for root, _dirs, names in sorted(os.walk(data_dir)):
+            for n in sorted(names):
+                if not n.lower().endswith(".wav"):
+                    continue
+                emo = n.rsplit("_", 1)[-1][:-4].lower()
+                if emo in self.EMOTIONS:
+                    files.append(os.path.join(root, n))
+                    labels.append(self.EMOTIONS.index(emo))
+        fold = np.arange(len(files)) % self.n_folds + 1
+        keep = (fold != self.split) if self.mode == "train" \
+            else (fold == self.split)
+        return ([f for f, k in zip(files, keep) if k],
+                [l for l, k in zip(labels, keep) if k])
+
+
+class ESC50(_WavFolderDataset):
+    """ESC-50 environmental sounds: wav names ``{fold}-{id}-{take}-
+    {target}.wav`` under <data_dir>/audio (reference layout)."""
+
+    NAME = "ESC50"
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw", data_dir: Optional[str] = None,
+                 download: bool = False, **kw):
+        self.split = split
+        super().__init__(data_dir, mode, feat_type, download=download, **kw)
+
+    def _index(self, data_dir):
+        audio = os.path.join(data_dir, "audio")
+        if not os.path.isdir(audio):
+            audio = data_dir
+        files, labels = [], []
+        for n in sorted(os.listdir(audio)):
+            if not n.endswith(".wav"):
+                continue
+            parts = n[:-4].split("-")
+            if len(parts) != 4:
+                continue
+            fold, target = int(parts[0]), int(parts[3])
+            if (self.mode == "train") == (fold != self.split):
+                files.append(os.path.join(audio, n))
+                labels.append(target)
+        return files, labels

@@ -1,0 +1,135 @@
+"""paddle.audio.features — Spectrogram / MelSpectrogram /
+LogMelSpectrogram / MFCC layers.
+
+Reference: python/paddle/audio/features/layers.py. Built on
+``paddle_tpu.signal.stft`` (jit-friendly framing + rfft) with the
+filterbank/DCT constants from :mod:`.functional` folded in at layer
+construction — the whole feature pipeline traces into one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from .. import signal as _signal
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from . import functional as F
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    """|STFT|^power of shape (..., n_fft//2 + 1, num_frames)."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.fft_window = F.get_window(window, self.win_length, dtype=dtype)
+
+    def forward(self, x: Tensor) -> Tensor:
+        spec = _signal.stft(
+            x, n_fft=self.n_fft, hop_length=self.hop_length,
+            win_length=self.win_length, window=self.fft_window,
+            center=self.center, pad_mode=self.pad_mode, onesided=True)
+        v = spec._value if isinstance(spec, Tensor) else spec
+        mag = jnp.abs(v)
+        if self.power != 1.0:
+            mag = mag ** self.power
+        return Tensor(mag, stop_gradient=x.stop_gradient)
+
+
+class MelSpectrogram(Layer):
+    """Spectrogram → mel filterbank: (..., n_mels, num_frames)."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(
+            n_fft=n_fft, hop_length=hop_length, win_length=win_length,
+            window=window, power=power, center=center, pad_mode=pad_mode,
+            dtype=dtype)
+        self.fbank_matrix = F.compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm, dtype=dtype)
+        self.n_mels = n_mels
+
+    def forward(self, x: Tensor) -> Tensor:
+        spec = self._spectrogram(x)
+        mel = jnp.matmul(self.fbank_matrix._value, spec._value)
+        return Tensor(mel, stop_gradient=x.stop_gradient)
+
+
+class LogMelSpectrogram(Layer):
+    """MelSpectrogram → power_to_db."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr=sr, n_fft=n_fft, hop_length=hop_length,
+            win_length=win_length, window=window, power=power,
+            center=center, pad_mode=pad_mode, n_mels=n_mels, f_min=f_min,
+            f_max=f_max, htk=htk, norm=norm, dtype=dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x: Tensor) -> Tensor:
+        mel = self._melspectrogram(x)
+        return F.power_to_db(mel, ref_value=self.ref_value, amin=self.amin,
+                             top_db=self.top_db)
+
+
+class MFCC(Layer):
+    """LogMelSpectrogram → DCT-II: (..., n_mfcc, num_frames)."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        if n_mfcc > n_mels:
+            raise ValueError(f"n_mfcc ({n_mfcc}) cannot exceed n_mels "
+                             f"({n_mels})")
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr=sr, n_fft=n_fft, hop_length=hop_length,
+            win_length=win_length, window=window, power=power,
+            center=center, pad_mode=pad_mode, n_mels=n_mels, f_min=f_min,
+            f_max=f_max, htk=htk, norm=norm, ref_value=ref_value,
+            amin=amin, top_db=top_db, dtype=dtype)
+        self.dct_matrix = F.create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x: Tensor) -> Tensor:
+        logmel = self._log_melspectrogram(x)
+        v = logmel._value
+        out = jnp.einsum("...mt,mk->...kt", v, self.dct_matrix._value)
+        return Tensor(out, stop_gradient=x.stop_gradient)

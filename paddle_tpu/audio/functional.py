@@ -1,0 +1,173 @@
+"""paddle.audio.functional — mel/dB/DCT helpers.
+
+Reference: python/paddle/audio/functional/functional.py (hz_to_mel,
+mel_to_hz, mel_frequencies, fft_frequencies, compute_fbank_matrix,
+power_to_db, create_dct) and window.py (get_window). Same math (HTK and
+Slaney mel scales, Slaney-normalized filterbanks, orthonormal DCT-II),
+computed with numpy at feature-build time — filterbanks are constants
+folded into the jitted feature pipeline, not traced ops.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def _asarray(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x._value)
+    return np.asarray(x)
+
+
+def _maybe_tensor(x, like):
+    if isinstance(like, Tensor) or not np.isscalar(like):
+        return Tensor(np.asarray(x, np.float32), stop_gradient=True)
+    return float(x)
+
+
+def hz_to_mel(freq: Union[float, Tensor], htk: bool = False):
+    """Hz → mel. ``htk=True``: 2595·log10(1 + f/700); else the Slaney
+    piecewise-linear/log scale (reference default)."""
+    f = _asarray(freq).astype(np.float64)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep,
+                       mel)
+    return _maybe_tensor(mel, freq)
+
+
+def mel_to_hz(mel: Union[float, Tensor], htk: bool = False):
+    m = _asarray(mel).astype(np.float64)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = np.where(m >= min_log_mel,
+                      min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                      hz)
+    return _maybe_tensor(hz, mel)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False):
+    """``n_mels`` frequencies evenly spaced on the mel scale."""
+    lo = _asarray(hz_to_mel(f_min, htk=htk))
+    hi = _asarray(hz_to_mel(f_max, htk=htk))
+    mels = np.linspace(float(lo), float(hi), n_mels)
+    return Tensor(_asarray(mel_to_hz(mels, htk=htk)).astype(np.float32),
+                  stop_gradient=True)
+
+
+def fft_frequencies(sr: int, n_fft: int):
+    return Tensor(np.linspace(0, sr / 2, 1 + n_fft // 2).astype(np.float32),
+                  stop_gradient=True)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: Union[str, float] = "slaney",
+                         dtype: str = "float32"):
+    """(n_mels, 1 + n_fft//2) triangular mel filterbank (librosa-compatible,
+    as the reference's)."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fftfreqs = np.asarray(fft_frequencies(sr, n_fft)._value, np.float64)
+    mel_f = np.asarray(
+        mel_frequencies(n_mels + 2, f_min, f_max, htk)._value, np.float64)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    elif norm is not None:
+        weights = weights / np.maximum(
+            np.linalg.norm(weights, ord=float(norm), axis=1, keepdims=True),
+            1e-10)
+    return Tensor(weights.astype(dtype), stop_gradient=True)
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    """10·log10(S/ref) with amin floor and optional top_db clamp."""
+    import jax.numpy as jnp
+    x = spect._value if isinstance(spect, Tensor) else jnp.asarray(spect)
+    if amin <= 0:
+        raise ValueError("amin must be strictly positive")
+    log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec, stop_gradient=isinstance(spect, Tensor)
+                  and spect.stop_gradient)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype: str = "float32"):
+    """(n_mels, n_mfcc) DCT-II basis (orthonormal under norm='ortho')."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)
+    basis = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        basis[:, 0] *= 1.0 / math.sqrt(n_mels)
+        basis[:, 1:] *= math.sqrt(2.0 / n_mels)
+    else:
+        basis *= 2.0
+    return Tensor(basis.astype(dtype), stop_gradient=True)
+
+
+def get_window(window: Union[str, tuple], win_length: int,
+               fftbins: bool = True, dtype: str = "float32"):
+    """Window vector by name ('hann', 'hamming', 'blackman', 'bartlett',
+    'kaiser' (with beta), 'gaussian' (with std), 'taylor' unsupported)."""
+    if isinstance(window, tuple):
+        name, *args = window
+    else:
+        name, args = window, []
+    M = win_length + (0 if fftbins else -1)
+    n = np.arange(win_length, dtype=np.float64)
+    denom = max(M, 1)
+    if name == "hann":
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * n / denom)
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * n / denom)
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * n / denom)
+             + 0.08 * np.cos(4 * math.pi * n / denom))
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2 * n / denom - 1.0)
+    elif name == "kaiser":
+        beta = args[0] if args else 12.0
+        w = np.i0(beta * np.sqrt(np.maximum(
+            0.0, 1 - (2 * n / denom - 1) ** 2))) / np.i0(beta)
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        w = np.exp(-0.5 * ((n - M / 2) / std) ** 2)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(w.astype(dtype), stop_gradient=True)

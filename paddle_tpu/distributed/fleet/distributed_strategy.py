@@ -63,6 +63,7 @@ _DEFAULTS: Dict[str, Dict[str, Any]] = {
     "sep_configs": {},
     "elastic_configs": {},
     "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    "localsgd_configs": {"k_steps": 1, "begin_step": 1},
 }
 
 _FLAGS = {
@@ -72,6 +73,7 @@ _FLAGS = {
     "tensor_parallel": False,
     "sharding": False,
     "gradient_merge": False,
+    "localsgd": False,
     "sequence_parallel": False,
     "heter_ccl_mode": False,
     "find_unused_parameters": False,

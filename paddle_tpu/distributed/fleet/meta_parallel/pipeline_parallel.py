@@ -75,10 +75,19 @@ def _bind_params(layer: Layer, rel2val: Dict[str, Any]):
             t._value = v
 
 
-def make_stage_fn(template: Layer, block_rels: List[str], remat: bool):
+def make_stage_fn(template: Layer, block_rels: List[str], remat: bool,
+                  masked: bool = False):
     """The per-stage compute shared by every schedule: scan the stage's L
     stacked blocks through the template layer, functionally bound.
-    stage_params: tuple of (L, ...) leaves ordered like block_rels."""
+    stage_params: tuple of (L, ...) leaves ordered like block_rels.
+
+    ``masked=True`` (uneven ``seg_method`` splits, VERDICT r4 item 4):
+    ``stage_fn(stage_params, count, x)`` — stages are padded to the
+    maximum block count and slot ``l`` passes the activation through
+    unchanged when ``l >= count``, so every stage runs the same SPMD
+    program while executing only its segment's blocks. Padding slots
+    burn (Lmax - count)/Lmax of the stage's FLOPs — the price of
+    uniformity; the even split costs nothing extra."""
 
     def block_apply(lparams, x):
         rel2val = dict(zip(block_rels, lparams))
@@ -89,12 +98,26 @@ def make_stage_fn(template: Layer, block_rels: List[str], remat: bool):
     if remat:
         block_apply = jax.checkpoint(block_apply)
 
-    def stage_fn(stage_params, x):
-        def body(carry, lp):
-            return block_apply(lp, carry), None
+    if masked:
+        def stage_fn(stage_params, count, x):
+            L = stage_params[0].shape[0]
 
-        y, _ = jax.lax.scan(body, x, stage_params)
-        return y
+            def body(carry, inp):
+                l, lp = inp
+                y = block_apply(lp, carry)
+                return jnp.where(l < count, y, carry), None
+
+            y, _ = jax.lax.scan(
+                body, x, (jnp.arange(L, dtype=jnp.int32),
+                          tuple(stage_params)))
+            return y
+    else:
+        def stage_fn(stage_params, x):
+            def body(carry, lp):
+                return block_apply(lp, carry), None
+
+            y, _ = jax.lax.scan(body, x, stage_params)
+            return y
 
     return stage_fn
 
@@ -203,11 +226,30 @@ class PipelineTrainStep:
             raise ValueError(
                 f"stackable block region has {n_blocks} layers < "
                 f"{self.S} stages x {self.V} virtual chunks")
-        # blocks must split evenly over stages; leftovers join the suffix
-        # (they run replicated — correct, slightly wasteful, and only happens
-        # for unusual layer counts)
-        self.L = n_blocks // (self.S * self.V)
-        end = start + self.L * self.S * self.V
+        # stage split: seg_method's boundaries are honoured (VERDICT r4
+        # item 4). Even counts run the exact stacked scan; uneven counts
+        # run the padded masked scan (V == 1, schedule 'auto' only).
+        counts = pipe_layer.stage_block_counts() if self.V == 1 else None
+        if counts is not None and len(set(counts)) > 1:
+            if schedule == "zbh1":
+                raise NotImplementedError(
+                    f"zbh1 needs an even stage split; seg_method yields "
+                    f"per-stage block counts {counts} — use "
+                    f"schedule='auto' (padded masked scan) or an even "
+                    f"seg_method")
+            self.L = max(counts)
+            self._stage_counts = np.asarray(counts, np.int32)
+            bounds = np.concatenate([[start], start + np.cumsum(counts)])
+            self._stage_slots = [list(range(bounds[s], bounds[s + 1]))
+                                 for s in range(self.S)]
+        else:
+            # even split (exact; no padding). Blocks must divide evenly;
+            # leftovers join the suffix (replicated — correct, slightly
+            # wasteful, and only happens for unusual layer counts)
+            self.L = n_blocks // (self.S * self.V)
+            end = start + self.L * self.S * self.V
+            self._stage_counts = None
+            self._stage_slots = None
         self._start, self._end = start, end
         self.template: Layer = pipe_layer.run_function[start]
         rf = pipe_layer.run_function
@@ -269,6 +311,17 @@ class PipelineTrainStep:
             if abstract:
                 stacked = jax.ShapeDtypeStruct(
                     shp, _pdt(tmpl_params[rel]._value.dtype))
+            elif self._stage_counts is not None:
+                # uneven seg_method split: stage rows padded to Lmax with
+                # template values (masked out by the stage scan)
+                tmpl_val = tmpl_params[rel]._value
+                rows = []
+                for s in range(self.S):
+                    vals = [block_params[j - start][rel]._value
+                            for j in self._stage_slots[s]]
+                    vals += [tmpl_val] * (self.L - len(vals))
+                    rows.append(jnp.stack(vals))
+                stacked = jnp.stack(rows)
             else:
                 leaves = [bp[rel]._value for bp in block_params]
                 if self.V == 1:
@@ -388,7 +441,10 @@ class PipelineTrainStep:
         act_spec = self._act_sharding
         run_entries = self._run_entries
 
-        stage_fn = make_stage_fn(template, self._block_rels, remat)
+        masked = self._stage_counts is not None
+        stage_fn = make_stage_fn(template, self._block_rels, remat,
+                                 masked=masked)
+        counts_arr = (jnp.asarray(self._stage_counts) if masked else None)
 
         def pipeline_plain(stacked, h):
             # h: (M, mb, ...) microbatch activations entering stage 0
@@ -401,7 +457,10 @@ class PipelineTrainStep:
 
             def tick(buf, x_t):
                 buf = jax.lax.dynamic_update_index_in_dim(buf, x_t, 0, 0)
-                out = jax.vmap(stage_fn)(stage_params, buf)
+                if masked:
+                    out = jax.vmap(stage_fn)(stage_params, counts_arr, buf)
+                else:
+                    out = jax.vmap(stage_fn)(stage_params, buf)
                 out = jax.lax.with_sharding_constraint(out, act_spec)
                 y_t = out[-1]
                 # stage i -> i+1; on the pp-sharded stage axis XLA lowers
@@ -769,6 +828,13 @@ class PipelineTrainStep:
         for k, v in self.params.items():
             if k.startswith(_STACK_PREFIX):
                 rel = k[len(_STACK_PREFIX):]
+                if self._stage_counts is not None:
+                    # padded uneven layout: only slots < count are real
+                    for s in range(self.S):
+                        for li, j in enumerate(self._stage_slots[s]):
+                            p = dict(rf[j].named_parameters())[rel]
+                            p._value = v[s, li]
+                    continue
                 if self.V > 1:   # (S, V, L, ...) -> depth order (V*S*L, ...)
                     v = jnp.swapaxes(v, 0, 1)
                     flat = v.reshape((self.V * self.S * self.L,) + v.shape[3:])

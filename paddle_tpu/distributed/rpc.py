@@ -83,6 +83,13 @@ def init_rpc(name: str, rank: int = -1, world_size: Optional[int] = None,
     # request racing init_rpc must not see an unauthenticated window
     token = os.environ.get("PADDLE_JOB_TOKEN") or None
     _state["token"] = token
+    if token is None and ip not in ("127.0.0.1", "localhost", "::1"):
+        import warnings
+        warnings.warn(
+            "init_rpc without PADDLE_JOB_TOKEN on a non-loopback "
+            "endpoint: the call server will execute pickled payloads "
+            "from ANY host that can reach the port. Set PADDLE_JOB_TOKEN "
+            "on every worker (the launcher does this for you).")
     # Same bind policy as the KV master (kv_master.py HTTPRendezvous):
     # bind the advertised interface only when it is a literal IP —
     # hostnames may resolve to loopback locally (Debian-style /etc/hosts)

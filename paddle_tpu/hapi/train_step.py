@@ -51,6 +51,9 @@ class TrainStep:
         remat: bool = False,
         sharding_level: Optional[int] = None,
         sharding_axis: Optional[str] = None,
+        gradient_merge_k: Optional[int] = None,
+        gradient_merge_avg: bool = True,
+        localsgd_k: Optional[int] = None,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -58,6 +61,27 @@ class TrainStep:
         self.mesh = mesh
         self.grad_accum_steps = grad_accum_steps
         self.fused_grad_accum = fused_grad_accum
+        # ---- strategy-driven transforms (reference: fleet/meta_optimizers/
+        # gradient_merge_optimizer.py + localsgd_optimizer.py as Program
+        # passes; here they are jit transforms of the step). Explicit
+        # kwargs win; otherwise the DistributedStrategy riding on a
+        # fleet-wrapped optimizer turns them on.
+        st = getattr(optimizer, "_strategy", None)
+        if gradient_merge_k is None and st is not None \
+                and getattr(st, "gradient_merge", False):
+            cfg = st.gradient_merge_configs
+            gradient_merge_k = int(cfg.get("k_steps", 1))
+            gradient_merge_avg = bool(cfg.get("avg", True))
+        if localsgd_k is None and st is not None \
+                and getattr(st, "localsgd", False):
+            localsgd_k = int(st.localsgd_configs.get("k_steps", 1))
+        self.gradient_merge_k = max(1, int(gradient_merge_k or 1))
+        self.gradient_merge_avg = gradient_merge_avg
+        self.localsgd_k = max(1, int(localsgd_k or 1))
+        if self.localsgd_k > 1 and self.gradient_merge_k > 1:
+            raise ValueError("localsgd and gradient_merge are mutually "
+                             "exclusive (as in the reference meta_optimizer "
+                             "ordering)")
         params, buffers = model.raw_state()
         from ..jit import ensure_live
         ensure_live(params, "call prev_step.sync_to_model() before building "
@@ -181,7 +205,21 @@ class TrainStep:
         if remat:
             loss_of = jax.checkpoint(loss_of)
 
-        def step(params, opt_state, lr, *batch):
+        if self.localsgd_k > 1:
+            self._build_localsgd_step(loss_of, donate)
+            return
+        self._merge = None
+        if self.gradient_merge_k > 1:
+            # gradient merge: accumulate grads across k CALLS, update every
+            # k-th (reference GradientMergeOptimizer). The buffer + counter
+            # ride the jit boundary like opt_state (donated).
+            zeros = jax.tree.map(jnp.zeros_like, self.params)
+            if self.opt_shardings is not None:
+                zeros = {k: jax.device_put(v, self.opt_shardings[k])
+                         for k, v in zeros.items()}
+            self._merge = (zeros, jnp.zeros((), jnp.int32))
+
+        def compute_loss_grads(params, batch):
             if self.grad_accum_steps > 1:
                 micro = [jax.tree.map(
                     lambda b: b.reshape(self.grad_accum_steps,
@@ -233,6 +271,9 @@ class TrainStep:
                     k: jax.lax.with_sharding_constraint(
                         g, self.opt_shardings[k])
                     for k, g in grads.items()}
+            return loss, grads
+
+        def apply_update(params, opt_state, grads, lr):
             new_params, new_state = optimizer.functional_update(
                 params, grads, opt_state, lr)
             if self.param_shardings is not None:
@@ -252,10 +293,110 @@ class TrainStep:
                         k: jax.lax.with_sharding_constraint(
                             v, self.opt_shardings[k])
                         for k, v in new_state["master"].items()}
+            return new_params, new_state
+
+        def step(params, opt_state, lr, *batch):
+            loss, grads = compute_loss_grads(params, batch)
+            new_params, new_state = apply_update(params, opt_state, grads, lr)
             return loss, new_params, new_state
 
-        donate_argnums = (0, 1) if donate else ()
-        self._jit_step = jax.jit(step, donate_argnums=donate_argnums)
+        def step_merge(params, opt_state, merge, lr, *batch):
+            loss, grads = compute_loss_grads(params, batch)
+            buf, count = merge
+            buf = jax.tree.map(jnp.add, buf, grads)
+            count = count + 1
+            kk = self.gradient_merge_k
+
+            def do(op):
+                p, s, b = op
+                g = (jax.tree.map(lambda x: x / kk, b)
+                     if self.gradient_merge_avg else b)
+                np_, ns = apply_update(p, s, g, lr)
+                return np_, ns, jax.tree.map(jnp.zeros_like, b)
+
+            params, opt_state, buf = jax.lax.cond(
+                count % kk == 0, do, lambda op: op,
+                (params, opt_state, buf))
+            return loss, params, opt_state, (buf, count)
+
+        donate_argnums = (0, 1, 2) if donate else ()
+        if self._merge is not None:
+            self._jit_step = jax.jit(step_merge,
+                                     donate_argnums=donate_argnums)
+        else:
+            self._jit_step = jax.jit(
+                step, donate_argnums=(0, 1) if donate else ())
+        self._step_count = 0
+
+    def _build_localsgd_step(self, loss_of, donate):
+        """LocalSGD (reference: fleet/meta_optimizers/localsgd_optimizer.py):
+        each dp worker updates a LOCAL parameter copy with purely local
+        gradients (no per-step dp all-reduce); every ``k_steps`` the copies
+        average across dp. TPU-native formulation: parameters and optimizer
+        state carry a leading dp axis sharded ``P('dp')`` and the local
+        step is ``jax.vmap`` over that axis — XLA partitions the mapped
+        program with ZERO inter-chip communication, and the periodic
+        average is the only collective (comm volume cut by ~k vs plain
+        DP). Scope matches the reference meta optimizer: pure data
+        parallelism (no TP/ZeRO/grad-accum composition)."""
+        mesh, optimizer = self.mesh, self.optimizer
+        if mesh is None or "dp" not in mesh.shape or mesh.shape["dp"] <= 1:
+            raise ValueError("localsgd needs a mesh with a dp axis > 1")
+        if self.grad_accum_steps > 1 or self.sharding_level:
+            raise NotImplementedError(
+                "localsgd composes with plain DP only (reference "
+                "LocalSGDOptimizer has the same scope)")
+        for k, sh in (self.param_shardings or {}).items():
+            if sh.spec != P():
+                raise NotImplementedError(
+                    f"localsgd needs replicated params; {k!r} declares "
+                    f"{sh.spec}")
+        dp = mesh.shape["dp"]
+        self._lsgd_dp = dp
+        stack_sh = {
+            k: NamedSharding(mesh, P("dp"))
+            for k in self.params}
+        self.params = {
+            k: jax.device_put(
+                jnp.broadcast_to(jnp.asarray(v)[None],
+                                 (dp,) + tuple(np.shape(v))),
+                stack_sh[k])
+            for k, v in self.params.items()}
+        self.param_shardings = stack_sh
+        self.opt_state = jax.tree.map(
+            lambda s: jnp.broadcast_to(jnp.asarray(s)[None],
+                                       (dp,) + tuple(np.shape(s))),
+            self.opt_state)
+        self._lsgd_count = jnp.zeros((), jnp.int32)
+        kk = self.localsgd_k
+
+        def local(p, s, lr, mb):
+            loss, g = jax.value_and_grad(loss_of)(p, mb)
+            np_, ns = optimizer.functional_update(p, g, s, lr)
+            return loss, np_, ns
+
+        def step(params, opt_state, count, lr, *batch):
+            micro = tuple(jax.tree.map(
+                lambda b: b.reshape((dp, b.shape[0] // dp) + b.shape[1:]),
+                b) for b in batch)
+            losses, new_p, new_s = jax.vmap(
+                local, in_axes=(0, 0, None, 0))(params, opt_state, lr,
+                                                micro)
+            count = count + 1
+
+            def sync(t):
+                return jax.tree.map(
+                    lambda x: jnp.broadcast_to(
+                        jnp.mean(x, axis=0, keepdims=True), x.shape), t)
+
+            new_p = jax.lax.cond(count % kk == 0, sync, lambda t: t, new_p)
+            new_p = {k: jax.lax.with_sharding_constraint(v, stack_sh[k])
+                     for k, v in new_p.items()}
+            return jnp.mean(losses), new_p, new_s, count
+
+        self._merge = None
+        self._jit_step = jax.jit(
+            step, donate_argnums=(0, 1, 2) if donate else ())
         self._step_count = 0
 
     def __call__(self, *batch) -> Tensor:
@@ -263,8 +404,17 @@ class TrainStep:
         vals = tuple(tree_to_values(b) for b in batch)
         if self._data_sharding is not None:
             vals = tuple(jax.device_put(v, self._data_sharding) for v in vals)
-        loss, self.params, self.opt_state = self._jit_step(
-            self.params, self.opt_state, lr, *vals)
+        if getattr(self, "_lsgd_count", None) is not None:
+            loss, self.params, self.opt_state, self._lsgd_count = \
+                self._jit_step(self.params, self.opt_state,
+                               self._lsgd_count, lr, *vals)
+        elif self._merge is not None:
+            loss, self.params, self.opt_state, self._merge = \
+                self._jit_step(self.params, self.opt_state, self._merge,
+                               lr, *vals)
+        else:
+            loss, self.params, self.opt_state = self._jit_step(
+                self.params, self.opt_state, lr, *vals)
         if isinstance(self.optimizer._lr, LRScheduler):
             self.optimizer._lr.step()
         self._step_count += 1
@@ -273,8 +423,13 @@ class TrainStep:
     # ------------------------------------------------------------- utilities
     def sync_to_model(self) -> None:
         """Write the on-device params back into the Layer's Tensors
-        (for state_dict / eager eval)."""
-        self.model.load_raw_state(self.params)
+        (for state_dict / eager eval). Under localsgd the dp-stacked
+        copies collapse to their mean — exactly the value the next sync
+        barrier would install on every worker."""
+        params = self.params
+        if getattr(self, "_lsgd_dp", None):
+            params = {k: jnp.mean(v, axis=0) for k, v in params.items()}
+        self.model.load_raw_state(params)
 
     def state_dict(self) -> Dict[str, Any]:
         self.sync_to_model()
@@ -286,6 +441,13 @@ class TrainStep:
         opt = sd.pop("@opt_state", None)
         self.model.set_state_dict(sd)
         params, _ = self.model.raw_state()
+        if getattr(self, "_lsgd_dp", None):
+            # restack to the (dp, ...) layout the compiled step expects;
+            # a loaded checkpoint starts all workers synced
+            dp = self._lsgd_dp
+            params = {k: jnp.broadcast_to(jnp.asarray(v)[None],
+                                          (dp,) + tuple(np.shape(v)))
+                      for k, v in params.items()}
         if self.param_shardings is not None:
             params = {k: jax.device_put(v, self.param_shardings[k])
                       for k, v in params.items()}

@@ -50,7 +50,13 @@ class Config:
     # --- device selection (reference: enable_use_gpu/disable_gpu) ---------
     def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
                        device_id: int = 0):
-        self._device = "tpu"  # accelerator on this build IS the TPU
+        import warnings
+        warnings.warn(
+            "Config.enable_use_gpu: this build's accelerator is the TPU; "
+            "the memory-pool size and device id are CUDA concepts and are "
+            "ignored (documented collapse — PJRT owns device memory)",
+            stacklevel=2)
+        self._device = "tpu"
 
     def disable_gpu(self):
         self._device = "cpu"
@@ -59,9 +65,18 @@ class Config:
         return self._device == "tpu"
 
     def enable_xpu(self, *a, **k):
+        import warnings
+        warnings.warn("Config.enable_xpu: mapped to the TPU backend "
+                      "(no XPU in this build)", stacklevel=2)
         self._device = "tpu"
 
-    # --- pass toggles: XLA owns fusion; keep the knobs for API parity -----
+    # --- pass toggles ------------------------------------------------------
+    # ir_optim gates the predictor's load-time optimization (jit-compiled
+    # module wrapper + on-device params; see Predictor). memory_optim is a
+    # documented collapse: XLA's buffer assignment already does the
+    # reference pass's reuse planning, and inference inputs can't donate
+    # (no output aliases their shape) — the knob is accepted for API
+    # parity and recorded, nothing more.
     def switch_ir_optim(self, flag: bool = True):
         self._ir_optim = bool(flag)
 
@@ -75,6 +90,12 @@ class Config:
         raise NotImplementedError(
             "TensorRT is a CUDA-only subsystem; on TPU the exported module "
             "is already XLA-compiled (SURVEY.md §7.2 non-goal)")
+
+    def enable_mkldnn(self, *a, **k):
+        import warnings
+        warnings.warn("Config.enable_mkldnn: oneDNN is a CPU-inference "
+                      "subsystem the XLA CPU backend replaces; no-op",
+                      stacklevel=2)
 
     def set_cpu_math_library_num_threads(self, n: int):
         self._threads = int(n)
@@ -130,6 +151,25 @@ class Predictor:
             n: PredictorHandle(n) for n in self._input_names}
         self._output_names: List[str] = []
         self._outputs: Dict[str, PredictorHandle] = {}
+        # --- the load-time optimization pass (reference: AnalysisPredictor
+        # runs the analysis/IR pipeline here). The deserialized module's
+        # ``.call`` re-traces its calling convention on every invocation;
+        # the optimized path compiles ONE jitted executable per input
+        # signature with the parameters resident on device — serving-loop
+        # latency drops to the XLA dispatch floor. switch_ir_optim(False)
+        # bypasses all of it and calls the raw module per run, the
+        # reference's unoptimized-executor analog.
+        self._jitted = None
+        if config.ir_optim():
+            import jax
+
+            exported_call = self._layer._exported.call
+
+            def run_module(params, inputs):
+                return exported_call(params, *inputs)
+
+            self._jitted = jax.jit(run_module)
+            self._device_params = dict(self._layer._params)
 
     def get_input_names(self) -> List[str]:
         return list(self._input_names)
@@ -153,7 +193,10 @@ class Predictor:
                 raise RuntimeError(f"input {n!r} not set; call "
                                    f"get_input_handle({n!r}).copy_from_cpu()")
             args.append(h._data)
-        out = self._layer(*args)
+        if self._jitted is not None:
+            out = self._jitted(self._device_params, tuple(args))
+        else:
+            out = self._layer(*args)
         leaves = out if isinstance(out, (tuple, list)) else [out]
         self._output_names = [f"output_{i}" for i in range(len(leaves))]
         self._outputs = {}

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from ..core import autograd
 from ..core.tensor import Tensor
+from . import dy2static
 
 
 def _to_value(x):
@@ -113,17 +114,30 @@ class StaticFunction:
     ``full_graph=False``)."""
 
     def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
-                 full_graph=True, backend=None, static_argnums=()):
+                 full_graph=True, backend=None, static_argnums=(),
+                 convert_control_flow=True):
         self._fn = fn
         self._static_argnums = static_argnums
         self._full_graph = full_graph
         self._fell_back = False
         self.input_spec = input_spec
+        # dy2static AST conversion (reference: python/paddle/jit/dy2static):
+        # data-dependent if/while/for become lax.cond/while_loop/fori_loop
+        # via runtime-dispatch converters; unconvertible constructs keep
+        # the guard-rail semantics below. The converted function is used
+        # only for TRACING — the eager fallback path runs the original.
+        traced_src = fn
+        if convert_control_flow:
+            conv = dy2static.convert_to_static(fn)
+            if conv is not None:
+                traced_src = conv
+        self._traced_fn = traced_src
 
         @functools.partial(jax.jit, static_argnums=static_argnums)
         def _jitted(*vals, **kvals):
             with autograd.functional_guard():
-                out = fn(*tree_to_tensors(vals), **tree_to_tensors(kvals))
+                out = traced_src(*tree_to_tensors(vals),
+                                 **tree_to_tensors(kvals))
             return tree_to_values(out)
 
         self._jitted = _jitted
@@ -156,6 +170,20 @@ class StaticFunction:
     def function(self):
         return self._fn
 
+    @property
+    def code(self):
+        """Transformed source (reference: StaticFunction.code) — the
+        dy2static-converted program when conversion applied, else the
+        original source."""
+        src = getattr(self._traced_fn, "__dy2static_source__", None)
+        if src is not None:
+            return src
+        import inspect
+        try:
+            return inspect.getsource(self._fn)
+        except (OSError, TypeError):
+            return repr(self._fn)
+
     def concrete_program(self, *args, **kwargs):
         return self._jitted.lower(*tree_to_values(args), **tree_to_values(kwargs))
 
@@ -173,9 +201,10 @@ def to_static(function=None, input_spec=None, build_strategy=None,
 
             class _StaticLayerCall:
                 def __init__(self):
+                    # pass the BOUND method (not a lambda) so dy2static
+                    # can read its source and convert control flow
                     self._sf = StaticFunction(
-                        lambda *a, **k: orig_forward(*a, **k),
-                        full_graph=full_graph)
+                        orig_forward, full_graph=full_graph)
 
                 def __call__(self, *a, **k):
                     return self._sf(*a, **k)

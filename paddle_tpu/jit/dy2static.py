@@ -1,0 +1,651 @@
+"""dy2static: AST conversion of data-dependent Python control flow.
+
+Reference: python/paddle/jit/dy2static/ (transforms ``if``/``while``/``for``
+over Tensors into cond/while_loop ops via ``convert_ifelse`` /
+``convert_while_loop`` runtime converters). TPU-native rebuild of the same
+two-stage design:
+
+1. **AST transform** (:func:`convert_to_static`): at ``to_static`` time the
+   function's source is parsed and every convertible ``if``/``while``/
+   ``for range(...)`` is rewritten into a call to a runtime converter,
+   with the statement's assigned variables threaded functionally
+   (branch/body functions take them as parameters and return them).
+2. **Runtime dispatch** (``convert_ifelse``/``convert_while``/
+   ``convert_for_range``/``convert_logical_*``): if the predicate is a
+   concrete Python value the original Python semantics run unchanged; if
+   it is a jax tracer the construct lowers to ``lax.cond`` /
+   ``lax.while_loop`` / ``lax.fori_loop`` — compiled, data-dependent
+   control flow with XLA-friendly structure.
+
+Constructs the transform declines (``break``/``continue``/``raise``/
+``try``/``with``/attribute- or subscript-assignment inside a branch,
+mixed return/fall-through branches) are left untouched — they keep the
+pre-existing guard-rail semantics (clear RuntimeError under tracing, or
+eager fallback with ``full_graph=False``). See tests/test_dy2static.py
+for the semantics table.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+
+_JST = "__paddle_jst__"
+
+
+class _UndefinedVar:
+    """Sentinel for a variable that was unbound when a converted construct
+    started. Any use raises with the variable's name, mimicking the
+    NameError the untransformed code would have produced."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def _die(self, *a, **k):
+        raise RuntimeError(
+            f"dy2static: variable {self.name!r} used before assignment "
+            f"(it was unbound when the converted control-flow construct "
+            f"began, and the taken path did not assign it)")
+
+    __bool__ = __call__ = __getattr__ = __getitem__ = _die
+    __add__ = __radd__ = __mul__ = __rmul__ = __sub__ = __rsub__ = _die
+    __iter__ = __len__ = __float__ = __int__ = _die
+
+    def __repr__(self):
+        return f"<undefined {self.name}>"
+
+
+def peek(loc: dict, name: str):
+    """Preamble helper: current binding of ``name`` or an Undefined
+    sentinel. Emitted before each converted construct so branch/body
+    functions can take every (possibly not-yet-bound) out-variable as a
+    parameter."""
+    v = loc.get(name, None)
+    return _UndefinedVar(name) if v is None and name not in loc else v
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _wrap(x):
+    if hasattr(x, "dtype") and hasattr(x, "shape"):
+        return Tensor(x, stop_gradient=True)
+    return x
+
+
+def _is_traced(v) -> bool:
+    return isinstance(v, jax.core.Tracer)
+
+
+def _tree_vals(tree):
+    return jax.tree.map(_val, tree, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _tree_tensors(tree):
+    return jax.tree.map(_wrap, tree)
+
+
+_TRACE_ERRORS = (jax.errors.ConcretizationTypeError,
+                 jax.errors.TracerArrayConversionError,
+                 jax.errors.TracerBoolConversionError,
+                 jax.errors.TracerIntegerConversionError)
+
+
+def _reraise_if_trace_error(e: BaseException) -> None:
+    """Concretization errors inside a converted branch (e.g. ``float()``
+    on a tracer) are NOT structure mismatches — propagate them so
+    StaticFunction's guard raises guidance or falls back to eager."""
+    if isinstance(e, _TRACE_ERRORS):
+        raise e
+
+
+_CONVERT_HINT = (
+    "dy2static converted this construct to jax control flow; under "
+    "tracing every path must produce the same variables with the same "
+    "shapes/dtypes. Ensure each branch assigns the same set of "
+    "variables (or both return), initialise loop carries before the "
+    "loop, and keep shapes static across iterations.")
+
+
+def _split_undefined(args: Sequence) -> Tuple[list, list]:
+    """(defined_values, undef_slots): converted constructs thread every
+    out-variable; ones still unbound ride around the jax op statically."""
+    defined, mask = [], []
+    for a in args:
+        if isinstance(a, _UndefinedVar):
+            mask.append(a)
+        else:
+            mask.append(None)
+            defined.append(_val(a))
+    return defined, mask
+
+
+def _reassemble(mask: list, vals: Sequence) -> list:
+    it = iter(vals)
+    return [m if m is not None else _wrap(next(it)) for m in mask]
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
+                   args: tuple):
+    """``if pred: ...`` with ``pred`` possibly traced.
+
+    Python-bool pred → run exactly one branch (original semantics,
+    including side effects). Traced pred → ``lax.cond`` over both
+    branches; ``args`` are the construct's live out-variables, threaded
+    through each branch function. Reference:
+    python/paddle/jit/dy2static/convert_operators.py convert_ifelse."""
+    pv = _val(pred)
+    if not _is_traced(pv):
+        return true_fn(*args) if pv else false_fn(*args)
+    if getattr(pv, "ndim", 0) != 0:
+        raise RuntimeError(
+            "dy2static: `if` predicate is a traced tensor with shape "
+            f"{getattr(pv, 'shape', ())} — only scalar predicates can "
+            "become lax.cond. For elementwise selection use paddle.where.")
+    defined, mask = _split_undefined(args)
+
+    def runner(branch):
+        def run(vals):
+            full = _reassemble(mask, vals)
+            return _tree_vals(branch(*full))
+        return run
+
+    try:
+        out = lax.cond(jnp.asarray(pv, bool), runner(true_fn),
+                       runner(false_fn), tuple(defined))
+    except TypeError as e:
+        _reraise_if_trace_error(e)
+        raise RuntimeError(
+            f"dy2static: the two branches of a converted `if` produced "
+            f"mismatched outputs ({e}). " + _CONVERT_HINT) from e
+    return _tree_tensors(out)
+
+
+def convert_while(cond_fn: Callable, body_fn: Callable, init: tuple):
+    """``while cond: ...`` — Python loop when the first condition is
+    concrete, ``lax.while_loop`` when traced. ``init`` are the loop's
+    assigned variables (the carry). Reference: convert_while_loop."""
+    c0 = cond_fn(*init)
+    cv = _val(c0)
+    if not _is_traced(cv):
+        vars_ = tuple(init)
+        cond = cv
+        while bool(_val(cond)):
+            vars_ = tuple(body_fn(*vars_))
+            cond = cond_fn(*vars_)
+        return vars_
+    for a in init:
+        if isinstance(a, _UndefinedVar):
+            raise RuntimeError(
+                f"dy2static: loop variable {a.name!r} must be initialised "
+                f"before a converted `while` whose condition is traced "
+                f"(lax.while_loop needs a concrete carry structure).")
+    vals = tuple(_val(a) for a in init)
+
+    def cond_w(vs):
+        out = _val(cond_fn(*[_wrap(v) for v in vs]))
+        return jnp.asarray(out, bool)
+
+    def body_w(vs):
+        return tuple(_tree_vals(tuple(body_fn(*[_wrap(v) for v in vs]))))
+
+    try:
+        out = lax.while_loop(cond_w, body_w, vals)
+    except TypeError as e:
+        _reraise_if_trace_error(e)
+        raise RuntimeError(
+            f"dy2static: converted `while` body changed the carry "
+            f"structure ({e}). " + _CONVERT_HINT) from e
+    return _tree_tensors(out)
+
+
+def convert_for_range(range_args: tuple, body_fn: Callable, init: tuple):
+    """``for i in range(...): ...`` — Python loop for concrete bounds,
+    ``lax.fori_loop`` (dynamic trip count) when any bound is traced.
+    The step must be a concrete Python int when traced (its sign fixes
+    the iteration-count formula at trace time)."""
+    vals = [_val(a) for a in range_args]
+    if not any(_is_traced(v) for v in vals):
+        vars_ = tuple(init)
+        for i in range(*[int(v) for v in vals]):
+            vars_ = tuple(body_fn(i, *vars_))
+        return vars_
+    for a in init:
+        if isinstance(a, _UndefinedVar):
+            raise RuntimeError(
+                f"dy2static: loop variable {a.name!r} must be initialised "
+                f"before a converted `for` whose bounds are traced.")
+    if len(vals) == 1:
+        start, stop, step = 0, vals[0], 1
+    elif len(vals) == 2:
+        start, stop, step = vals[0], vals[1], 1
+    else:
+        start, stop, step = vals[:3]
+    if _is_traced(step) or int(step) == 0:
+        raise RuntimeError(
+            "dy2static: converted `for range(...)` needs a concrete "
+            "non-zero Python step under tracing (got a traced or zero "
+            "step) — the trip-count formula is fixed at trace time.")
+    step = int(step)
+    n = (jnp.asarray(stop, jnp.int32) - jnp.asarray(start, jnp.int32)
+         + (step - (1 if step > 0 else -1))) // step
+    n = jnp.maximum(n, 0)
+    carry0 = tuple(_val(a) for a in init)
+
+    def body_w(k, vs):
+        i = jnp.asarray(start, jnp.int32) + jnp.asarray(k, jnp.int32) * step
+        return tuple(_tree_vals(tuple(body_fn(_wrap(i), *[_wrap(v) for v in vs]))))
+
+    try:
+        out = lax.fori_loop(0, n, body_w, carry0)
+    except TypeError as e:
+        _reraise_if_trace_error(e)
+        raise RuntimeError(
+            f"dy2static: converted `for` body changed the carry "
+            f"structure ({e}). " + _CONVERT_HINT) from e
+    return _tree_tensors(out)
+
+
+def convert_logical_and(lhs, rhs_thunk: Callable):
+    """``a and b`` in a converted test. Python semantics (including
+    short-circuit) for concrete values; ``jnp.logical_and`` when traced
+    (both sides evaluate — the reference's converters do the same)."""
+    lv = _val(lhs)
+    if _is_traced(lv):
+        return _wrap(jnp.logical_and(jnp.asarray(lv, bool),
+                                     jnp.asarray(_val(rhs_thunk()), bool)))
+    return rhs_thunk() if lv else lhs
+
+
+def convert_logical_or(lhs, rhs_thunk: Callable):
+    lv = _val(lhs)
+    if _is_traced(lv):
+        return _wrap(jnp.logical_or(jnp.asarray(lv, bool),
+                                    jnp.asarray(_val(rhs_thunk()), bool)))
+    return lhs if lv else rhs_thunk()
+
+
+def convert_logical_not(x):
+    xv = _val(x)
+    if _is_traced(xv):
+        return _wrap(jnp.logical_not(jnp.asarray(xv, bool)))
+    return not xv
+
+
+# --------------------------------------------------------------------------
+# AST transform
+# --------------------------------------------------------------------------
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef, ast.GeneratorExp, ast.ListComp, ast.SetComp,
+                ast.DictComp)
+
+_UNSAFE_NODES = (ast.Raise, ast.Try, ast.With, ast.AsyncWith, ast.Break,
+                 ast.Continue, ast.Global, ast.Nonlocal, ast.Delete,
+                 ast.Yield, ast.YieldFrom, ast.Await)
+
+
+def _walk_scope(node):
+    """ast.walk that does not descend into nested scopes."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, _SCOPE_NODES):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _is_safe(node) -> bool:
+    """A construct is convertible only if functionalising its body cannot
+    change semantics: no control-flow escapes, no exception machinery, no
+    mutation through attributes/subscripts (those would run on BOTH
+    branches under lax.cond)."""
+    for n in _walk_scope(node):
+        if isinstance(n, _UNSAFE_NODES):
+            return False
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in targets:
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                for e in elts:
+                    if not isinstance(e, ast.Name):
+                        return False
+    return True
+
+
+def _assigned_names(stmts: Sequence[ast.stmt]) -> List[str]:
+    """Names bound by a statement list (not descending into new scopes)."""
+    names: List[str] = []
+
+    def collect(target):
+        if isinstance(target, ast.Name):
+            if target.id not in names:
+                names.append(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                collect(e)
+
+    holder = ast.Module(body=list(stmts), type_ignores=[])
+    for n in _walk_scope(holder):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                collect(t)
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            collect(n.target)
+        elif isinstance(n, ast.For):
+            collect(n.target)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            if n.name not in names:
+                names.append(n.name)
+    return sorted(names)
+
+
+def _contains_return(stmts: Sequence[ast.stmt]) -> bool:
+    holder = ast.Module(body=list(stmts), type_ignores=[])
+    return any(isinstance(n, ast.Return) for n in _walk_scope(holder))
+
+
+def _terminates(stmts: Sequence[ast.stmt]) -> bool:
+    """Every path through the list ends in ``return``."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return _terminates(last.body) and _terminates(last.orelse)
+    return False
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _jst_call(fn: str, args: list) -> ast.Call:
+    return ast.Call(
+        func=ast.Attribute(value=_name(_JST), attr=fn, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _make_fn(name: str, params: Sequence[str],
+             body: List[ast.stmt]) -> ast.FunctionDef:
+    fd = ast.FunctionDef(
+        name=name,
+        args=ast.arguments(posonlyargs=[], args=[ast.arg(arg=p) for p in params],
+                           vararg=None, kwonlyargs=[], kw_defaults=[],
+                           kwarg=None, defaults=[]),
+        body=body or [ast.Pass()], decorator_list=[], returns=None)
+    if hasattr(fd, "type_params"):     # py3.12+
+        fd.type_params = []
+    return fd
+
+
+def _thunk(expr: ast.expr) -> ast.Lambda:
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                           kwonlyargs=[], kw_defaults=[], kwarg=None,
+                           defaults=[]),
+        body=expr)
+
+
+class _TestExprTransformer(ast.NodeTransformer):
+    """``and``/``or``/``not`` inside a converted test become the runtime
+    logical converters (jnp.logical_* when traced, Python otherwise)."""
+
+    def visit_BoolOp(self, node: ast.BoolOp):
+        self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        out = node.values[-1]
+        for v in reversed(node.values[:-1]):
+            out = _jst_call(fn, [v, _thunk(out)])
+        return out
+
+    def visit_UnaryOp(self, node: ast.UnaryOp):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _jst_call("convert_logical_not", [node.operand])
+        return node
+
+    def visit_Lambda(self, node):   # new scope: leave untouched
+        return node
+
+
+def _convert_test(expr: ast.expr) -> ast.expr:
+    return _TestExprTransformer().visit(expr)
+
+
+class _Converter:
+    def __init__(self):
+        self.counter = 0
+
+    def uid(self) -> int:
+        self.counter += 1
+        return self.counter
+
+    # -- blocks ------------------------------------------------------------
+    def block(self, stmts: Sequence[ast.stmt]) -> List[ast.stmt]:
+        out: List[ast.stmt] = []
+        i = 0
+        stmts = list(stmts)
+        while i < len(stmts):
+            st = stmts[i]
+            if isinstance(st, ast.If):
+                new, absorbed = self.if_stmt(st, stmts[i + 1:])
+                out.extend(new)
+                if absorbed:
+                    return out
+                i += 1
+            elif isinstance(st, ast.While):
+                out.extend(self.while_stmt(st))
+                i += 1
+            elif isinstance(st, ast.For):
+                out.extend(self.for_stmt(st))
+                i += 1
+            else:
+                out.append(self.recurse_shell(st))
+                i += 1
+        return out
+
+    def recurse_shell(self, st: ast.stmt) -> ast.stmt:
+        """Transform nested blocks of a statement we keep as-is."""
+        for field in ("body", "orelse", "finalbody"):
+            blk = getattr(st, field, None)
+            if blk and not isinstance(st, _SCOPE_NODES):
+                setattr(st, field, self.block(blk))
+        return st
+
+    def preamble(self, names: Sequence[str]) -> List[ast.stmt]:
+        """``v = __paddle_jst__.peek(locals(), 'v')`` per out-variable, so
+        not-yet-bound names become Undefined sentinels instead of
+        NameErrors at the converter call site."""
+        out = []
+        for v in names:
+            out.append(ast.Assign(
+                targets=[_name(v, ast.Store())],
+                value=_jst_call("peek", [
+                    ast.Call(func=_name("locals"), args=[], keywords=[]),
+                    ast.Constant(v)])))
+        return out
+
+    def tuple_of(self, names: Sequence[str], store=False) -> ast.expr:
+        ctx = ast.Store() if store else ast.Load()
+        return ast.Tuple(elts=[_name(v, ctx) for v in names], ctx=ctx)
+
+    def assign_out(self, names: Sequence[str], value: ast.expr) -> ast.stmt:
+        if names:
+            return ast.Assign(targets=[self.tuple_of(names, store=True)],
+                              value=value)
+        return ast.Expr(value=value)
+
+    # -- if ----------------------------------------------------------------
+    def if_stmt(self, st: ast.If,
+                rest: List[ast.stmt]) -> Tuple[List[ast.stmt], bool]:
+        if not _is_safe(st):
+            return [self.recurse_shell(st)], False
+        body = self.block(st.body)
+        orelse = self.block(st.orelse)
+        has_ret = _contains_return(body) or _contains_return(orelse)
+        n = self.uid()
+        tname, fname = f"__jst_true_{n}", f"__jst_false_{n}"
+        test = _convert_test(st.test)
+
+        if not has_ret:
+            outs = _assigned_names(st.body) + [
+                v for v in _assigned_names(st.orelse)
+                if v not in _assigned_names(st.body)]
+            outs = sorted(set(outs))
+            ret = ast.Return(value=self.tuple_of(outs))
+            t_fn = _make_fn(tname, outs, body + [ret])
+            f_fn = _make_fn(fname, outs, orelse + [
+                ast.Return(value=self.tuple_of(outs))])
+            call = _jst_call("convert_ifelse",
+                             [test, _name(tname), _name(fname),
+                              self.tuple_of(outs)])
+            new = self.preamble(outs) + [t_fn, f_fn,
+                                         self.assign_out(outs, call)]
+            return new, False
+
+        # return-style: both paths must end in `return`
+        absorbed = False
+        if _terminates(body) and not orelse and rest:
+            if not all(_is_safe(s) for s in rest) \
+                    or not _contains_return(rest):
+                return [self.recurse_shell(st)], False
+            orelse = self.block(rest)
+            absorbed = True
+        if not (_terminates(body) and _terminates(orelse)):
+            if absorbed:      # can't partially absorb; redo untouched
+                return [self.recurse_shell(
+                    ast.If(test=st.test, body=st.body,
+                           orelse=st.orelse))], False
+            return [self.recurse_shell(st)], False
+        params = sorted(set(_assigned_names(st.body)
+                            + _assigned_names(st.orelse)
+                            + (_assigned_names(rest) if absorbed else [])))
+        t_fn = _make_fn(tname, params, body)
+        f_fn = _make_fn(fname, params, orelse)
+        call = _jst_call("convert_ifelse",
+                         [test, _name(tname), _name(fname),
+                          self.tuple_of(params)])
+        new = self.preamble(params) + [t_fn, f_fn, ast.Return(value=call)]
+        return new, absorbed
+
+    # -- while -------------------------------------------------------------
+    def while_stmt(self, st: ast.While) -> List[ast.stmt]:
+        if st.orelse or not _is_safe(st) or _contains_return(st.body):
+            return [self.recurse_shell(st)]
+        loop_vars = _assigned_names(st.body)
+        if not loop_vars:
+            return [self.recurse_shell(st)]
+        n = self.uid()
+        cname, bname = f"__jst_cond_{n}", f"__jst_body_{n}"
+        body = self.block(st.body)
+        c_fn = _make_fn(cname, loop_vars,
+                        [ast.Return(value=_convert_test(st.test))])
+        b_fn = _make_fn(bname, loop_vars,
+                        body + [ast.Return(value=self.tuple_of(loop_vars))])
+        call = _jst_call("convert_while",
+                         [_name(cname), _name(bname),
+                          self.tuple_of(loop_vars)])
+        return (self.preamble(loop_vars)
+                + [c_fn, b_fn, self.assign_out(loop_vars, call)])
+
+    # -- for ---------------------------------------------------------------
+    def for_stmt(self, st: ast.For) -> List[ast.stmt]:
+        is_range = (isinstance(st.iter, ast.Call)
+                    and isinstance(st.iter.func, ast.Name)
+                    and st.iter.func.id == "range"
+                    and not st.iter.keywords
+                    and 1 <= len(st.iter.args) <= 3
+                    and not any(isinstance(a, ast.Starred)
+                                for a in st.iter.args))
+        if (not is_range or st.orelse or not isinstance(st.target, ast.Name)
+                or not _is_safe(st) or _contains_return(st.body)):
+            return [self.recurse_shell(st)]
+        tgt = st.target.id
+        loop_vars = [v for v in _assigned_names(st.body) if v != tgt]
+        n = self.uid()
+        bname = f"__jst_forbody_{n}"
+        body = self.block(st.body)
+        b_fn = _make_fn(bname, [tgt] + loop_vars,
+                        body + [ast.Return(value=self.tuple_of(loop_vars))])
+        call = _jst_call("convert_for_range",
+                         [ast.Tuple(elts=list(st.iter.args), ctx=ast.Load()),
+                          _name(bname), self.tuple_of(loop_vars)])
+        return (self.preamble(loop_vars)
+                + [b_fn, self.assign_out(loop_vars, call)])
+
+
+def convert_to_static(fn: Callable) -> Optional[Callable]:
+    """AST-convert ``fn``'s data-dependent control flow. Returns the
+    converted function, or None when the source is unavailable or the
+    function is not a plain def (the caller keeps the original +
+    guard-rail semantics)."""
+    target = fn.__func__ if inspect.ismethod(fn) else fn
+    if not inspect.isfunction(target):
+        return None
+    try:
+        src = textwrap.dedent(inspect.getsource(target))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError, ValueError):
+        return None
+    if not tree.body or not isinstance(tree.body[0], ast.FunctionDef):
+        return None
+    fdef: ast.FunctionDef = tree.body[0]
+    fdef.decorator_list = []
+    conv = _Converter()
+    try:
+        fdef.body = conv.block(fdef.body)
+    except Exception:
+        return None
+    if conv.counter == 0:
+        return None          # nothing converted — keep the original
+    freevars = target.__code__.co_freevars
+    module_body: List[ast.stmt]
+    if freevars:
+        factory = _make_fn("__jst_factory__", list(freevars),
+                           [fdef, ast.Return(value=_name(fdef.name))])
+        module_body = [factory]
+    else:
+        module_body = [fdef]
+    mod = ast.fix_missing_locations(ast.Module(body=module_body,
+                                               type_ignores=[]))
+    import sys
+    g = dict(target.__globals__)
+    g[_JST] = sys.modules[__name__]
+    try:
+        code = compile(mod, filename=f"<dy2static {target.__name__}>",
+                       mode="exec")
+        ns: dict = {}
+        exec(code, g, ns)
+        if freevars:
+            try:
+                cells = [c.cell_contents for c in (target.__closure__ or ())]
+            except ValueError:
+                return None
+            new = ns["__jst_factory__"](*cells)
+        else:
+            new = ns[fdef.name]
+    except Exception:
+        return None
+    new.__defaults__ = target.__defaults__
+    new.__kwdefaults__ = target.__kwdefaults__
+    new.__name__ = target.__name__
+    new.__dy2static_source__ = ast.unparse(mod)
+    if inspect.ismethod(fn):
+        new = new.__get__(fn.__self__)
+    return new

@@ -1,0 +1,26 @@
+"""paddle.onnx — ONNX export facade.
+
+Reference: python/paddle/onnx/export.py (delegates to the paddle2onnx
+package, which converts the static Program to an ONNX graph). TPU-native
+collapse: the portable serialized artifact of this build is StableHLO
+via ``paddle.jit.save`` (loadable by ``paddle.jit.load`` and the
+inference ``Predictor``); there is no ONNX emitter, and pretending to
+write one would produce files nothing can read. ``export`` therefore
+raises with the working alternative spelled out.
+"""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 9,
+           **configs):
+    raise NotImplementedError(
+        "paddle.onnx.export is a documented collapse in this build: the "
+        "reference delegates to paddle2onnx over the static Program; the "
+        "TPU-native portable artifact is StableHLO. Use "
+        "paddle.jit.save(layer, path, input_spec=...) — the saved program "
+        "loads with paddle.jit.load and paddle.inference.create_predictor "
+        "— or trace with paddle.jit.to_static and consume the StableHLO "
+        "directly (concrete_program(...).as_text()).")

@@ -1,0 +1,103 @@
+"""paddle.text — NLP datasets + Viterbi decode.
+
+Reference: python/paddle/text/__init__.py (dataset wrappers around
+downloaded corpora) and python/paddle/text/viterbi_decode.py.
+
+The decode op is real (lax.scan dynamic program, jit-friendly). The
+corpus datasets require downloads this zero-egress environment cannot
+perform; they raise with guidance instead of silently returning empty
+data — pass the reference-format local files where supported.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+from .viterbi_decode import ViterbiDecoder, viterbi_decode
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
+           "ViterbiDecoder", "viterbi_decode"]
+
+
+class _DownloadDataset(Dataset):
+    """Shared guard: the reference downloads these corpora on first use;
+    there is no egress here, so constructing without a local file is an
+    immediate, explicit error (never an empty dataset)."""
+
+    NAME = "corpus"
+    FORMAT = "the reference's archive format"
+
+    def __init__(self, data_file: Optional[str] = None, **kw):
+        if data_file is None:
+            raise ValueError(
+                f"paddle.text.{type(self).__name__}: automatic download is "
+                f"unsupported (no network egress). Obtain {self.NAME} "
+                f"({self.FORMAT}) out of band and pass "
+                f"data_file=<local path>.")
+        self.data_file = data_file
+        self._load(data_file, **kw)
+
+    def _load(self, data_file: str, **kw):
+        raise NotImplementedError(
+            f"paddle.text.{type(self).__name__}: local parsing for "
+            f"{self.FORMAT} is not implemented in this build; read the "
+            f"file with your own loader and wrap it in an io.Dataset")
+
+    def __getitem__(self, idx):
+        return self._items[idx]
+
+    def __len__(self):
+        return len(self._items)
+
+
+class Imdb(_DownloadDataset):
+    NAME = "the IMDB movie-review sentiment corpus"
+    FORMAT = "aclImdb_v1.tar.gz"
+
+
+class Imikolov(_DownloadDataset):
+    NAME = "the Mikolov PTB language-model corpus"
+    FORMAT = "simple-examples.tgz"
+
+
+class Movielens(_DownloadDataset):
+    NAME = "the MovieLens-1M ratings corpus"
+    FORMAT = "ml-1m.zip"
+
+
+class WMT14(_DownloadDataset):
+    NAME = "the WMT'14 EN-FR translation corpus"
+    FORMAT = "wmt14.tgz"
+
+
+class WMT16(_DownloadDataset):
+    NAME = "the WMT'16 EN-DE translation corpus"
+    FORMAT = "wmt16.tar.gz"
+
+
+class UCIHousing(_DownloadDataset):
+    """Boston-housing regression rows; the local file is the plain
+    whitespace-separated 14-column table the reference downloads, so
+    local parsing IS implemented."""
+
+    NAME = "the UCI housing table"
+    FORMAT = "housing.data (14 whitespace-separated columns)"
+
+    def _load(self, data_file: str, mode: str = "train"):
+        raw = np.loadtxt(data_file).astype(np.float32)
+        if raw.ndim != 2 or raw.shape[1] != 14:
+            raise ValueError(
+                f"expected 14 columns (13 features + target), got "
+                f"{raw.shape}")
+        # reference normalization: feature-wise max/min scaling over the
+        # whole table, then an 80/20 train/test split
+        feats, target = raw[:, :13], raw[:, 13:]
+        lo, hi = feats.min(0), feats.max(0)
+        feats = (feats - lo) / np.maximum(hi - lo, 1e-12)
+        n_train = int(raw.shape[0] * 0.8)
+        sl = slice(0, n_train) if mode == "train" else slice(n_train, None)
+        self._items = [(feats[i], target[i])
+                       for i in range(*sl.indices(raw.shape[0]))]

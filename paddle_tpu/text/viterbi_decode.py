@@ -1,0 +1,98 @@
+"""Viterbi decode (reference: python/paddle/text/viterbi_decode.py —
+the ViterbiDecodeOp CUDA kernel collapses into a lax.scan dynamic
+program that jits onto TPU).
+
+Conventions (PaddleNLP LinearChainCrf layout):
+  - ``transitions[i, j]`` = score of moving FROM tag ``i`` TO tag ``j``.
+  - With ``include_bos_eos_tag=True`` the last two tag indices are
+    BOS = C-2 and EOS = C-1: the path score adds ``transitions[BOS, y0]``
+    and ``transitions[y_last, EOS]``.
+Path score = Σ_t potentials[t, y_t] + Σ_{t>0} transitions[y_{t-1}, y_t]
+(+ BOS/EOS terms). ``lengths`` masks ragged batches: updates freeze past
+each sequence's end, so the EOS term lands on the true last step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _viterbi(pot, trans, lengths, include_bos_eos_tag: bool):
+    B, L, C = pot.shape
+    lengths = lengths.astype(jnp.int32)
+    alpha = pot[:, 0]
+    if include_bos_eos_tag:
+        alpha = alpha + trans[C - 2][None, :]
+    ident = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32), (B, C))
+
+    def step(carry, inp):
+        alpha = carry
+        pot_t, t = inp
+        m = alpha[:, :, None] + trans[None]          # (B, C_prev, C_next)
+        best_prev = jnp.argmax(m, axis=1).astype(jnp.int32)
+        new_alpha = jnp.max(m, axis=1) + pot_t
+        live = (t < lengths)[:, None]
+        alpha = jnp.where(live, new_alpha, alpha)
+        bp = jnp.where(live, best_prev, ident)
+        return alpha, bp
+
+    ts = jnp.arange(1, L, dtype=jnp.int32)
+    alpha, bps = lax.scan(step, alpha, (jnp.swapaxes(pot[:, 1:], 0, 1), ts))
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, C - 1][None, :]
+    scores = jnp.max(alpha, axis=1)
+    last = jnp.argmax(alpha, axis=1).astype(jnp.int32)
+
+    def back(carry, bp):
+        tag = carry
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    # reverse scan: ys are stored at their input positions, so tags_rev[t]
+    # is the tag at timestep t+1 and the final carry is the tag at t=0
+    first, tags_rev = lax.scan(back, last, bps, reverse=True)
+    paths = jnp.concatenate([first[None, :], tags_rev], axis=0)  # (L, B)
+    paths = jnp.swapaxes(paths, 0, 1)                 # (B, L)
+    valid = jnp.arange(L)[None, :] < lengths[:, None]
+    # reference dtype is int64; jax without x64 stores int32 (same ids)
+    paths = jnp.where(valid, paths, 0).astype(jnp.int32)
+    return scores, paths
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """→ (scores (B,), paths (B, L) int64) — best tag sequences."""
+    pot = _val(potentials).astype(jnp.float32)
+    trans = _val(transition_params).astype(jnp.float32)
+    lens = _val(lengths)
+    scores, paths = _viterbi(pot, trans, lens, include_bos_eos_tag)
+    return (Tensor(scores, stop_gradient=True),
+            Tensor(paths, stop_gradient=True))
+
+
+class ViterbiDecoder(Layer):
+    """reference class of the same name: holds ``transitions``, decodes
+    in ``forward(potentials, lengths)``."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = (transitions if isinstance(transitions, Tensor)
+                            else Tensor(jnp.asarray(transitions),
+                                        stop_gradient=True))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

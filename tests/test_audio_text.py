@@ -1,0 +1,183 @@
+"""paddle.audio / paddle.text / paddle.onnx (reference:
+test/legacy_test/test_audio_functions.py, test_viterbi_decode_op.py).
+
+Audio numerics validate against direct numpy formulas; viterbi_decode
+validates against a brute-force path enumeration.
+"""
+
+import itertools
+import math
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.audio import backends, features
+from paddle_tpu.audio import functional as AF
+
+
+class TestAudioFunctional:
+    def test_hz_mel_roundtrip(self):
+        for htk in (False, True):
+            f = np.array([0.0, 120.0, 850.0, 4000.0, 11025.0])
+            mel = AF.hz_to_mel(paddle.to_tensor(f.astype(np.float32)),
+                               htk=htk)
+            back = AF.mel_to_hz(mel, htk=htk)
+            np.testing.assert_allclose(back.numpy(), f, rtol=1e-4,
+                                       atol=1e-2)
+
+    def test_htk_formula(self):
+        got = float(AF.hz_to_mel(1000.0, htk=True))
+        assert abs(got - 2595.0 * math.log10(1 + 1000.0 / 700.0)) < 1e-6
+
+    def test_fbank_shape_and_partition(self):
+        fb = AF.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert fb.min() >= 0.0
+        # every interior filter overlaps its neighbours (triangles tile)
+        assert (fb.sum(1)[1:-1] > 0).all()
+
+    def test_power_to_db_top_db(self):
+        s = paddle.to_tensor(np.array([1.0, 1e-6], np.float32))
+        db = AF.power_to_db(s, top_db=30.0).numpy()
+        assert db[0] == pytest.approx(0.0)
+        assert db[1] == pytest.approx(-30.0)    # clamped
+
+    def test_create_dct_orthonormal(self):
+        d = AF.create_dct(8, 8).numpy()
+        np.testing.assert_allclose(d.T @ d, np.eye(8), atol=1e-5)
+
+    def test_get_window_hann(self):
+        w = AF.get_window("hann", 8).numpy()
+        np.testing.assert_allclose(
+            w, 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(8) / 8), atol=1e-6)
+
+
+class TestAudioFeatures:
+    def test_mel_spectrogram_pipeline_shapes(self):
+        x = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal((2, 2048))
+            .astype(np.float32))
+        spec = features.Spectrogram(n_fft=256)(x)
+        assert spec.shape[-2] == 129
+        mel = features.MelSpectrogram(sr=8000, n_fft=256, n_mels=32)(x)
+        assert mel.shape[-2] == 32
+        logmel = features.LogMelSpectrogram(sr=8000, n_fft=256, n_mels=32)(x)
+        assert logmel.shape == mel.shape
+        mfcc = features.MFCC(sr=8000, n_fft=256, n_mels=32, n_mfcc=13)(x)
+        assert mfcc.shape[-2] == 13
+
+    def test_mfcc_validates_n_mfcc(self):
+        with pytest.raises(ValueError, match="n_mfcc"):
+            features.MFCC(n_mfcc=80, n_mels=64)
+
+
+class TestAudioBackends:
+    def test_wav_save_load_info_roundtrip(self, tmp_path):
+        sr = 8000
+        t = np.linspace(0, 1, sr, endpoint=False)
+        wavef = (0.5 * np.sin(2 * np.pi * 440 * t)).astype(np.float32)
+        p = os.path.join(tmp_path, "tone.wav")
+        backends.save(p, paddle.to_tensor(wavef[None, :]), sr)
+        meta = backends.info(p)
+        assert (meta.sample_rate, meta.num_channels,
+                meta.bits_per_sample) == (sr, 1, 16)
+        loaded, sr2 = backends.load(p)
+        assert sr2 == sr
+        np.testing.assert_allclose(loaded.numpy()[0], wavef, atol=2e-4)
+
+    def test_backend_selection(self):
+        assert backends.list_available_backends() == ["wave_backend"]
+        backends.set_backend("wave_backend")
+        with pytest.raises(NotImplementedError):
+            backends.set_backend("soundfile")
+
+
+class TestTextDatasets:
+    def test_download_datasets_raise_honestly(self):
+        for cls in (paddle.text.Imdb, paddle.text.Imikolov,
+                    paddle.text.Movielens, paddle.text.WMT14,
+                    paddle.text.WMT16):
+            with pytest.raises(ValueError, match="no network egress"):
+                cls()
+
+    def test_uci_housing_local_parse(self, tmp_path):
+        rng = np.random.default_rng(0)
+        table = rng.standard_normal((50, 14))
+        p = os.path.join(tmp_path, "housing.data")
+        np.savetxt(p, table)
+        tr = paddle.text.UCIHousing(data_file=p, mode="train")
+        te = paddle.text.UCIHousing(data_file=p, mode="test")
+        assert len(tr) == 40 and len(te) == 10
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        assert x.min() >= 0.0 and x.max() <= 1.0   # normalized
+
+
+def _brute_force_viterbi(pot, trans, length, bos_eos):
+    C = pot.shape[1]
+    tags = range(C)
+    best, best_path = -np.inf, None
+    for path in itertools.product(tags, repeat=length):
+        s = pot[0, path[0]]
+        if bos_eos:
+            s += trans[C - 2, path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + pot[t, path[t]]
+        if bos_eos:
+            s += trans[path[-1], C - 1]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+class TestViterbi:
+    @pytest.mark.parametrize("bos_eos", [True, False])
+    def test_matches_brute_force(self, bos_eos):
+        rng = np.random.default_rng(3)
+        B, L, C = 3, 5, 4
+        pot = rng.standard_normal((B, L, C)).astype(np.float32)
+        trans = rng.standard_normal((C, C)).astype(np.float32)
+        lens = np.array([5, 3, 1], np.int64)
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=bos_eos)
+        for b in range(B):
+            ref_s, ref_p = _brute_force_viterbi(
+                pot[b], trans, int(lens[b]), bos_eos)
+            assert float(scores.numpy()[b]) == pytest.approx(ref_s, rel=1e-5)
+            got = paths.numpy()[b, :int(lens[b])].tolist()
+            assert got == ref_p, f"batch {b}: {got} != {ref_p}"
+            assert (paths.numpy()[b, int(lens[b]):] == 0).all()
+
+    def test_decoder_layer(self):
+        rng = np.random.default_rng(0)
+        dec = paddle.text.ViterbiDecoder(
+            rng.standard_normal((4, 4)).astype(np.float32))
+        pot = paddle.to_tensor(
+            rng.standard_normal((2, 6, 4)).astype(np.float32))
+        lens = paddle.to_tensor(np.array([6, 4], np.int64))
+        scores, paths = dec(pot, lens)
+        assert tuple(scores.shape) == (2,)
+        assert tuple(paths.shape) == (2, 6)
+
+    def test_jit_compatible(self):
+        """The decode op must trace under jax.jit (a lax.scan program)."""
+        import jax
+        from paddle_tpu.text.viterbi_decode import _viterbi
+        import jax.numpy as jnp
+        rng = np.random.default_rng(1)
+        pot = jnp.asarray(rng.standard_normal((2, 5, 4)), jnp.float32)
+        trans = jnp.asarray(rng.standard_normal((4, 4)), jnp.float32)
+        lens = jnp.asarray([5, 5])
+        s1, p1 = jax.jit(_viterbi, static_argnums=3)(pot, trans, lens, True)
+        s2, p2 = _viterbi(pot, trans, lens, True)
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2))
+        np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+
+
+class TestOnnx:
+    def test_export_is_documented_collapse(self):
+        with pytest.raises(NotImplementedError, match="jit.save"):
+            paddle.onnx.export(None, "model.onnx")

@@ -1,6 +1,7 @@
 """dy2static equivalence suite (reference: test/dygraph_to_static/,
 SURVEY.md §4): eager vs to_static over Python control flow, with every
-divergence class either EXACT, GUARDED (clear error + working
+divergence class either EXACT, CONVERTED (AST-rewritten to lax control
+flow — see paddle_tpu/jit/dy2static.py), GUARDED (clear error + working
 alternative), or DOCUMENTED.
 
 Semantics table
@@ -10,16 +11,24 @@ Semantics table
 |-----------------------------------|------------|--------------------------|
 | if on SHAPES / python values      | works      | EXACT (static at trace)  |
 | for over range(static n)          | works      | EXACT (unrolled)         |
-| if/while on tensor DATA           | works      | GUARDED: RuntimeError    |
-|                                   |            | with guidance (default   |
-|                                   |            | full_graph=True)         |
+| if on tensor DATA                 | works      | CONVERTED → lax.cond     |
+|   (return-style, assignment-style,|            | (parity tests below)     |
+|    elif chains, and/or/not tests) |            |                          |
+| while on tensor DATA              | works      | CONVERTED →              |
+|                                   |            | lax.while_loop           |
+| for over range(tensor n)          | works      | CONVERTED → lax.fori_loop|
+| unconvertible control flow        | works      | GUARDED: RuntimeError    |
+|   (break/raise/attr-mutation in   |            | with guidance (default   |
+|    branch; mixed return/assign)   |            | full_graph=True)         |
 | ... with full_graph=False         | works      | eager fallback + warning |
 | static.nn.cond / while_loop /     | works      | EXACT (lax control flow, |
 |   switch_case / case              |            | compiled)                |
 | paddle.where elementwise select   | works      | EXACT                    |
-| Python side effects (print,       | every call | ONCE at trace time       |
-|   append, global mutation)        |            | (DOCUMENTED, pinned)     |
-| float()/int()/bool() on tensors   | works      | GUARDED (same error)     |
+| Python side effects (print,       | every call | ONCE at trace time; BOTH |
+|   append, global mutation)        |            | branches of a converted  |
+|                                   |            | `if` trace (DOCUMENTED)  |
+| float()/int()/bool() on tensors   | works      | GUARDED (host pull —     |
+|                                   |            | inherently untraceable)  |
 """
 
 import numpy as np
@@ -67,26 +76,133 @@ class TestExactClasses:
         np.testing.assert_allclose(st(x).numpy(), fn(x).numpy())
 
 
-class TestGuardedClasses:
-    def test_data_dependent_if_raises_with_guidance(self):
-        @to_static
+class TestConverted:
+    """Data-dependent Python control flow now CONVERTS (VERDICT r4 #3):
+    the AST transform rewrites it onto lax.cond/while_loop/fori_loop,
+    with eager↔static parity on every reachable path."""
+
+    def test_data_dependent_if_return_style(self):
         def fn(x):
-            if x.sum() > 0:             # DATA-dependent: cannot trace
+            if x.sum() > 0:
                 return x * 2
             return x + 1
 
-        with pytest.raises(RuntimeError, match="static.nn.cond"):
-            fn(t(np.ones(3)))
+        st = to_static(fn)
+        assert "convert_ifelse" in st.code     # proof it converted
+        for v in (np.ones(3), -np.ones(3)):
+            np.testing.assert_allclose(st(t(v)).numpy(), fn(t(v)).numpy())
 
-    def test_data_dependent_while_raises(self):
-        @to_static
+    def test_data_dependent_if_assignment_style(self):
         def fn(x):
-            while x.sum() < 10:
+            y = x
+            if x.sum() > 0:
+                y = y * 3
+            else:
+                y = y - 1
+            return y + 1
+
+        st = to_static(fn)
+        for v in (np.ones(3), -np.ones(3)):
+            np.testing.assert_allclose(st(t(v)).numpy(), fn(t(v)).numpy())
+
+    def test_elif_chain(self):
+        def fn(x):
+            if x.sum() > 10:
+                y = x * 10
+            elif x.sum() > 0:
+                y = x * 2
+            else:
+                y = -x
+            return y
+
+        st = to_static(fn)
+        for v in (np.full(3, 5.0), np.full(3, 0.5), -np.ones(3)):
+            np.testing.assert_allclose(st(t(v)).numpy(), fn(t(v)).numpy())
+
+    def test_bool_ops_in_test(self):
+        def fn(x):
+            if x.sum() > 0 and x.max() < 10:
+                return x * 2
+            if not (x.sum() > 0):
+                return -x
+            return x
+
+        st = to_static(fn)
+        for v in (np.ones(3), np.full(3, 20.0), -np.ones(3)):
+            np.testing.assert_allclose(st(t(v)).numpy(), fn(t(v)).numpy())
+
+    def test_data_dependent_while(self):
+        def fn(x):
+            while x.sum() < 100:
                 x = x * 2
             return x
 
-        with pytest.raises(RuntimeError, match="control flow"):
-            fn(t(np.ones(3)))
+        st = to_static(fn)
+        assert "convert_while" in st.code
+        for s in (1.0, 30.0, 200.0):
+            v = np.full(3, s)
+            np.testing.assert_allclose(st(t(v)).numpy(), fn(t(v)).numpy())
+
+    def test_for_over_tensor_range(self):
+        def fn(x, n):
+            acc = x
+            for i in range(n):
+                acc = acc + i
+            return acc
+
+        st = to_static(fn)
+        assert "convert_for_range" in st.code
+        np.testing.assert_allclose(
+            st(t(np.zeros(2)), t(4, np.int32)).numpy(),
+            fn(t(np.zeros(2)), 4).numpy())
+        # zero-trip loop
+        np.testing.assert_allclose(
+            st(t(np.zeros(2)), t(0, np.int32)).numpy(), np.zeros(2))
+
+    def test_nested_if_in_while(self):
+        def fn(x):
+            while x.sum() < 50:
+                if x.max() > 4:
+                    x = x + 10
+                else:
+                    x = x * 2
+            return x
+
+        st = to_static(fn)
+        for s in (1.0, 5.0, 100.0):
+            v = np.full(3, s)
+            np.testing.assert_allclose(st(t(v)).numpy(), fn(t(v)).numpy())
+
+    def test_grad_through_converted_if(self):
+        import paddle_tpu.nn.functional as F  # noqa: F401
+        import jax
+
+        def loss(x):
+            if x.sum() > 0:
+                return (x * 2).sum()
+            return (x * 3).sum()
+
+        st = to_static(loss)
+        # lax.cond is differentiable: jax.grad through the jitted callable
+        from paddle_tpu.jit import dy2static as d2s
+        conv = d2s.convert_to_static(loss)
+        g = jax.grad(lambda a: _val_of(conv(_wrap_t(a))))(np.ones(3, np.float32))
+        np.testing.assert_allclose(np.asarray(g), np.full(3, 2.0))
+        g2 = jax.grad(lambda a: _val_of(conv(_wrap_t(a))))(-np.ones(3, np.float32))
+        np.testing.assert_allclose(np.asarray(g2), np.full(3, 3.0))
+
+
+def _wrap_t(a):
+    import paddle_tpu as _p
+    return _p.to_tensor(a)
+
+
+def _val_of(x):
+    return x._value if hasattr(x, "_value") else x
+
+
+class TestGuardedClasses:
+    """Constructs the transform declines keep the guard-rail semantics."""
 
     def test_float_conversion_raises(self):
         @to_static
@@ -96,10 +212,20 @@ class TestGuardedClasses:
         with pytest.raises(RuntimeError, match="control flow"):
             fn(t(np.ones(3)))
 
+    def test_unconvertible_branch_raises_with_guidance(self):
+        @to_static
+        def fn(x):
+            if x.sum() > 0:             # raise in branch: not converted
+                raise ValueError("positive")
+            return x + 1
+
+        with pytest.raises(RuntimeError, match="static.nn.cond"):
+            fn(t(np.ones(3)))
+
     def test_full_graph_false_falls_back_to_eager(self):
         def fn(x):
             if x.sum() > 0:
-                return x * 2
+                return float(x.sum()) * x    # unconvertible: host pull
             return x + 1
 
         st = to_static(fn, full_graph=False)

