@@ -190,19 +190,27 @@ def _run_bench() -> dict:
     if not remat:
         # HBM insurance for the rare healthy chip window (VERDICT r4 #2):
         # if the no-remat step OOMs, fall back to remat instead of losing
-        # the round's only real-MFU shot. Probe with the first step.
+        # the round's only real-MFU shot. Snapshot state first so the
+        # measured run restarts from step 0 WITHOUT a second compile of
+        # the big program (set_state_dict reuses the jitted step), and
+        # sync via a host pull — block_until_ready does not reliably
+        # block (or raise) through the axon tunnel.
+        # deep-copy to host: state_dict's Tensors alias the on-device
+        # buffers the probe step is about to donate
+        snap = {k: (np.array(v.numpy(), copy=True)
+                    if hasattr(v, "numpy") else v)
+                for k, v in step.state_dict().items()}
         try:
-            jax.block_until_ready(step(x, y).value)
+            float(step(x, y))
         except Exception as e:
             if "RESOURCE_EXHAUSTED" not in repr(e).upper():
                 raise
             sys.stderr.write("bench: no-remat step OOMed; retrying with "
                              "remat\n")
             remat = True
-        # rebuild either way so the measured run starts from step 0 with
-        # untouched weights (the probe consumed one update); the compile
-        # is a cache hit in the no-OOM case
-        model, step = build(remat)
+            model, step = build(remat)
+        else:
+            step.set_state_dict(snap)
 
     meter = SpeedMeter(
         n_params=n_params, n_layers=cfg.num_hidden_layers,
@@ -335,17 +343,34 @@ def _decode_bench(model, cfg, paddle, jax) -> dict:
     prompt = paddle.to_tensor(
         rng.integers(0, cfg.vocab_size, (1, prompt_len)).astype(np.int32))
     model.eval()
-    # warmup MUST use the same max_new_tokens: the jit signature includes
-    # the scan length, so a different value compiles a different program
-    # and the timed run would measure XLA compilation
-    out = model.generate(prompt, max_new_tokens=steps, do_sample=False)
-    np.asarray(out.value if hasattr(out, "value") else out)  # host sync:
-    # block_until_ready does not reliably block through the axon tunnel
-    t0 = time.perf_counter()
-    out = model.generate(prompt, max_new_tokens=steps, do_sample=False)
-    np.asarray(out.value if hasattr(out, "value") else out)
-    dt = time.perf_counter() - t0
-    return {"decode_tokens_per_sec": round(steps / dt, 1)}
+
+    def timed(n_tokens, repeats=3):
+        # warmup MUST use the same max_new_tokens: the jit signature
+        # includes the scan length, so a different value compiles a
+        # different program and the timed run would measure compilation
+        out = model.generate(prompt, max_new_tokens=n_tokens,
+                             do_sample=False)
+        np.asarray(out.value if hasattr(out, "value") else out)  # host
+        # sync: block_until_ready is unreliable through the axon tunnel
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = model.generate(prompt, max_new_tokens=n_tokens,
+                                 do_sample=False)
+            np.asarray(out.value if hasattr(out, "value") else out)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # steady-state decode rate: subtract the prefill(+1 token) time so
+    # the metric is not a function of the prompt length (the r2->r3
+    # redefinition artifact VERDICT r4 weak #1 flagged); keep the
+    # end-to-end number too for continuity
+    t_full = timed(steps)
+    t_one = timed(1)
+    dt = max(t_full - t_one, 1e-9)
+    return {"decode_tokens_per_sec": round((steps - 1) / dt, 1),
+            "decode_e2e_tokens_per_sec": round(steps / t_full, 1),
+            "prefill_plus_1_s": round(t_one, 4)}
 
 
 def main():

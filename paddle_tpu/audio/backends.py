@@ -70,13 +70,15 @@ def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
     if width not in _WIDTH_DTYPE:
         raise ValueError(f"unsupported PCM sample width {width}")
     data = np.frombuffer(raw, dtype=_WIDTH_DTYPE[width]).reshape(-1, nch)
-    if width == 1:   # unsigned 8-bit: center first
-        data = data.astype(np.float32) - 128.0
-        scale = 128.0
+    if normalize:
+        if width == 1:    # unsigned 8-bit: center, then scale
+            wavef = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            wavef = data.astype(np.float32) / float(2 ** (8 * width - 1))
     else:
-        scale = float(2 ** (8 * width - 1))
-        data = data.astype(np.float32)
-    wavef = data / scale if normalize else data
+        # reference wave_backend: raw PCM values in the file's own dtype
+        # (uint8 stays [0, 255] uncentered, int16/int32 stay integer)
+        wavef = data.copy()
     if channels_first:
         wavef = wavef.T
     return Tensor(wavef, stop_gradient=True), sr

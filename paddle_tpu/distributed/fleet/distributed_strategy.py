@@ -64,6 +64,8 @@ _DEFAULTS: Dict[str, Dict[str, Any]] = {
     "elastic_configs": {},
     "gradient_merge_configs": {"k_steps": 1, "avg": True},
     "localsgd_configs": {"k_steps": 1, "begin_step": 1},
+    "dgc_configs": {"rampup_begin_step": 0, "rampup_step": 1,
+                    "sparsity": [0.999]},
 }
 
 _FLAGS = {
@@ -74,6 +76,7 @@ _FLAGS = {
     "sharding": False,
     "gradient_merge": False,
     "localsgd": False,
+    "dgc": False,
     "sequence_parallel": False,
     "heter_ccl_mode": False,
     "find_unused_parameters": False,

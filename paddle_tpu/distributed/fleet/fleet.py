@@ -150,6 +150,27 @@ class Fleet:
             self._user_defined_strategy = strategy
         st = self._user_defined_strategy or DistributedStrategy()
         hcg = self._hcg
+        if getattr(st, "dgc", False):
+            # reference dgc_optimizer.py: DGC applies to Momentum only,
+            # silently skipping others — here we fail loudly instead
+            from ...optimizer.optimizer import Momentum
+            from .meta_optimizers.dgc_optimizer import DGCMomentum
+            if type(optimizer) is not Momentum:
+                raise TypeError(
+                    "strategy.dgc requires a Momentum optimizer "
+                    f"(got {type(optimizer).__name__})")
+            cfg = st.dgc_configs
+            optimizer = DGCMomentum(
+                learning_rate=optimizer._lr,
+                momentum=optimizer._momentum,
+                rampup_begin_step=cfg.get("rampup_begin_step", 0),
+                rampup_step=cfg.get("rampup_step", 1),
+                sparsity=cfg.get("sparsity", [0.999]),
+                parameters=optimizer._parameter_list,
+                use_nesterov=optimizer._nesterov,
+                weight_decay=optimizer._weight_decay,
+                grad_clip=optimizer._grad_clip,
+                multi_precision=optimizer._multi_precision)
         if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
             stage = int(st.sharding_configs.get("stage", 1))
             if stage == 1:

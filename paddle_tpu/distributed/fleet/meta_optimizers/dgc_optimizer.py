@@ -1,0 +1,96 @@
+"""DGC — Deep Gradient Compression momentum (reference:
+python/paddle/distributed/fleet/meta_optimizers/dgc_optimizer.py wrapping
+the DGCMomentumOptimizer + paddle/fluid/operators/dgc_op; algorithm from
+Lin et al., "Deep Gradient Compression", ICLR 2018).
+
+TPU-native collapse: the reference's point is sending the top-k gradient
+entries over NCCL. Under GSPMD the partitioner owns the collectives and
+the all-reduce stays dense, so what survives — and what this class
+implements exactly — is DGC's *algorithmic* core as one jit transform of
+the update rule:
+
+  - momentum correction:   u_t = m·u_{t-1} + g_t
+  - error accumulation:    v_t = v_{t-1} + u_t
+  - top-k sparsification:  mask = |v_t| ≥ τ(s),  update = v_t·mask
+  - error feedback:        v_{t+1} = v_t·(1-mask)
+  - momentum factor masking: u_{t+1} = u_t·(1-mask)
+  - sparsity rampup:       s steps through ``sparsity`` every
+                           ``rampup_step`` steps after
+                           ``rampup_begin_step`` (plain momentum before)
+
+τ is estimated from a strided sample of |v| (the paper's own 0.1%
+sampling trick — an exact top-k on a 100M-param tensor would dominate
+the step).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from ....optimizer.optimizer import Momentum
+
+__all__ = ["DGCMomentum"]
+
+_SAMPLE = 4096
+
+
+class DGCMomentum(Momentum):
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 rampup_begin_step: int = 0, rampup_step: int = 1,
+                 sparsity: Sequence[float] = (0.999,), parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 num_trainers: Optional[int] = None,
+                 multi_precision: bool = False, name=None):
+        super().__init__(learning_rate, momentum, parameters, use_nesterov,
+                         weight_decay, grad_clip, multi_precision, name)
+        self._rampup_begin = int(rampup_begin_step)
+        self._rampup_step = max(1, int(rampup_step))
+        self._sparsity = tuple(float(s) for s in sparsity) or (0.999,)
+
+    def init_slot(self, p_val):
+        return {"velocity": jnp.zeros_like(p_val, dtype=jnp.float32),
+                "error": jnp.zeros_like(p_val, dtype=jnp.float32)}
+
+    def _current_sparsity(self, t):
+        """Rampup: chunk i of ``rampup_step``/len(sparsity) steps uses
+        sparsity[i] (the reference's schedule shape)."""
+        levels = jnp.asarray(self._sparsity, jnp.float32)
+        per = max(1, self._rampup_step // len(self._sparsity))
+        idx = jnp.clip((t - self._rampup_begin) // per,
+                       0, len(self._sparsity) - 1)
+        return levels[idx.astype(jnp.int32)]
+
+    def apply_one(self, p, g, slots, lr, t, wd):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if wd:
+            g32 = g32 + wd * p32
+
+        u, v = slots["velocity"], slots["error"]
+
+        # dense momentum branch (pre-rampup) — matches Momentum exactly
+        dense_u = self._momentum * u + g32
+        dense_upd = (g32 + self._momentum * dense_u if self._nesterov
+                     else dense_u)
+
+        # DGC branch
+        u2 = self._momentum * u + g32
+        acc = v + u2
+        flat = jnp.abs(acc).reshape(-1)
+        stride = max(1, flat.shape[0] // _SAMPLE)
+        sample = flat[::stride][:_SAMPLE]
+        s = self._current_sparsity(t)
+        tau = jnp.quantile(sample, jnp.clip(s, 0.0, 1.0))
+        mask = (jnp.abs(acc) >= tau).astype(jnp.float32)
+        sparse_upd = acc * mask
+        dgc_u = u2 * (1.0 - mask)
+        dgc_v = acc * (1.0 - mask)
+
+        use_dgc = t >= self._rampup_begin
+        upd = jnp.where(use_dgc, sparse_upd, dense_upd)
+        new_u = jnp.where(use_dgc, dgc_u, dense_u)
+        new_v = jnp.where(use_dgc, dgc_v, v)
+        new_p = (p32 - lr * upd).astype(p.dtype)
+        return new_p, {"velocity": new_u, "error": new_v}

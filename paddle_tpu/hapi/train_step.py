@@ -72,9 +72,11 @@ class TrainStep:
             cfg = st.gradient_merge_configs
             gradient_merge_k = int(cfg.get("k_steps", 1))
             gradient_merge_avg = bool(cfg.get("avg", True))
+        self._lsgd_begin = 1
         if localsgd_k is None and st is not None \
                 and getattr(st, "localsgd", False):
             localsgd_k = int(st.localsgd_configs.get("k_steps", 1))
+            self._lsgd_begin = int(st.localsgd_configs.get("begin_step", 1))
         self.gradient_merge_k = max(1, int(gradient_merge_k or 1))
         self.gradient_merge_avg = gradient_merge_avg
         self.localsgd_k = max(1, int(localsgd_k or 1))
@@ -369,6 +371,7 @@ class TrainStep:
             self.opt_state)
         self._lsgd_count = jnp.zeros((), jnp.int32)
         kk = self.localsgd_k
+        begin = int(getattr(self, "_lsgd_begin", 1))
 
         def local(p, s, lr, mb):
             loss, g = jax.value_and_grad(loss_of)(p, mb)
@@ -389,7 +392,11 @@ class TrainStep:
                     lambda x: jnp.broadcast_to(
                         jnp.mean(x, axis=0, keepdims=True), x.shape), t)
 
-            new_p = jax.lax.cond(count % kk == 0, sync, lambda t: t, new_p)
+            # reference localsgd warmup (begin_step): dense DP — i.e. a
+            # sync every step — until step ``begin_step``, from which
+            # local updates are allowed to drift (default 1 = no warmup)
+            do_sync = jnp.logical_or(count < begin, count % kk == 0)
+            new_p = jax.lax.cond(do_sync, sync, lambda t: t, new_p)
             new_p = {k: jax.lax.with_sharding_constraint(v, stack_sh[k])
                      for k, v in new_p.items()}
             return jnp.mean(losses), new_p, new_s, count

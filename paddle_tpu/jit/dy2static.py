@@ -211,17 +211,28 @@ def convert_while(cond_fn: Callable, body_fn: Callable, init: tuple):
     return _tree_tensors(out)
 
 
-def convert_for_range(range_args: tuple, body_fn: Callable, init: tuple):
+def convert_for_range(range_args: tuple, body_fn: Callable, init: tuple,
+                      prior_target=None):
     """``for i in range(...): ...`` — Python loop for concrete bounds,
     ``lax.fori_loop`` (dynamic trip count) when any bound is traced.
     The step must be a concrete Python int when traced (its sign fixes
-    the iteration-count formula at trace time)."""
+    the iteration-count formula at trace time).
+
+    Returns ``(target, *loop_vars)`` — Python leaves the loop variable
+    bound to its final value after the loop, so the transform rebinds it
+    (``prior_target`` is its pre-loop binding, kept when the range is
+    empty). Traced-bounds caveat: with a traced-empty range the target
+    reads ``prior_target`` when that is a value, but an UNBOUND target
+    cannot ride lax.fori_loop — it reads ``start - step`` instead of
+    raising NameError (documented divergence)."""
     vals = [_val(a) for a in range_args]
     if not any(_is_traced(v) for v in vals):
         vars_ = tuple(init)
+        tgt = prior_target
         for i in range(*[int(v) for v in vals]):
+            tgt = i
             vars_ = tuple(body_fn(i, *vars_))
-        return vars_
+        return (tgt,) + vars_
     for a in init:
         if isinstance(a, _UndefinedVar):
             raise RuntimeError(
@@ -255,7 +266,15 @@ def convert_for_range(range_args: tuple, body_fn: Callable, init: tuple):
         raise RuntimeError(
             f"dy2static: converted `for` body changed the carry "
             f"structure ({e}). " + _CONVERT_HINT) from e
-    return _tree_tensors(out)
+    last = (jnp.asarray(start, jnp.int32)
+            + (jnp.asarray(n, jnp.int32) - 1) * step)
+    pv = _val(prior_target)
+    if isinstance(prior_target, _UndefinedVar) or pv is None:
+        tgt = _wrap(last)
+    else:
+        tgt = _wrap(jnp.where(n > 0, last,
+                              jnp.asarray(pv, jnp.int32)))
+    return (tgt,) + tuple(_tree_tensors(out))
 
 
 def convert_logical_and(lhs, rhs_thunk: Callable):
@@ -585,9 +604,11 @@ class _Converter:
                         body + [ast.Return(value=self.tuple_of(loop_vars))])
         call = _jst_call("convert_for_range",
                          [ast.Tuple(elts=list(st.iter.args), ctx=ast.Load()),
-                          _name(bname), self.tuple_of(loop_vars)])
-        return (self.preamble(loop_vars)
-                + [b_fn, self.assign_out(loop_vars, call)])
+                          _name(bname), self.tuple_of(loop_vars),
+                          _name(tgt)])
+        # Python binds the loop variable past the loop — rebind it too
+        return (self.preamble(loop_vars + [tgt])
+                + [b_fn, self.assign_out([tgt] + loop_vars, call)])
 
 
 def convert_to_static(fn: Callable) -> Optional[Callable]:

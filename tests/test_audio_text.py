@@ -87,6 +87,23 @@ class TestAudioBackends:
         assert sr2 == sr
         np.testing.assert_allclose(loaded.numpy()[0], wavef, atol=2e-4)
 
+    def test_load_raw_pcm_when_not_normalized(self):
+        """Review r5: normalize=False returns the file's raw PCM values
+        in its own dtype (reference wave_backend semantics)."""
+        import tempfile
+        sr = 8000
+        x = (0.25 * np.sin(2 * np.pi * 100 *
+                           np.linspace(0, 0.1, 800))).astype(np.float32)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "x.wav")
+            backends.save(p, paddle.to_tensor(x[None]), sr)
+            raw, _ = backends.load(p, normalize=False)
+            vals = raw.numpy()
+            assert vals.dtype == np.int16
+            norm, _ = backends.load(p, normalize=True)
+            np.testing.assert_allclose(norm.numpy(),
+                                       vals.astype(np.float32) / 32768.0)
+
     def test_backend_selection(self):
         assert backends.list_available_backends() == ["wave_backend"]
         backends.set_backend("wave_backend")

@@ -326,3 +326,43 @@ class TestDocumentedDivergence:
         for _ in range(3):
             fn2(x)
         assert len(eager_calls) == 3
+
+
+class TestForTargetBinding:
+    """Review r5: Python leaves the loop variable bound after the loop —
+    the conversion must rebind it (post-loop reads regressed to
+    NameError before this fix)."""
+
+    def test_concrete_range_post_loop_read(self):
+        def fn(x):
+            for i in range(3):
+                x = x + 1
+            return x * i
+
+        st = to_static(fn)
+        assert "convert_for_range" in st.code
+        x = t(np.ones(2))
+        np.testing.assert_allclose(st(x).numpy(), fn(x).numpy())
+
+    def test_traced_range_post_loop_read(self):
+        def fn(x, n):
+            acc = x
+            for i in range(n):
+                acc = acc + 1
+            return acc + i
+
+        st = to_static(fn)
+        np.testing.assert_allclose(
+            st(t(np.zeros(2)), t(4, np.int32)).numpy(),
+            fn(t(np.zeros(2)), 4).numpy())
+
+    def test_empty_range_keeps_prior_binding(self):
+        def fn(x):
+            i = 7
+            for i in range(0):
+                x = x + 1
+            return x * i
+
+        st = to_static(fn)
+        x = t(np.ones(2))
+        np.testing.assert_allclose(st(x).numpy(), fn(x).numpy())

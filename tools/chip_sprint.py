@@ -306,6 +306,41 @@ def run_step(step: str, test_mode: bool) -> bool:
         return False
 
 
+def maybe_flip_compact_stats() -> None:
+    """Execute the banked decision tree (KERNEL_DECISIONS.md): if the
+    kernels artifact proves both compact-stat bwd layouts compile on a
+    real chip, flip FLAGS_flash_compact_stats default to True and commit
+    — the window converts straight into the decision."""
+    path = os.path.join(REPO, f"KERNEL_COMPILE_{ROUND}.json")
+    if not os.path.exists(path):
+        return
+    with open(path) as f:
+        payload = json.load(f)
+    recs = {r.get("name"): r for r in payload.get("results", [])}
+    need = ("flash_bwd_compact_stats", "flash_bwd_compact_stats_gqa")
+    # the chip presents as backend "axon" through the tunnel plugin and
+    # "tpu" when native — both are the real Mosaic compile path
+    if not all(recs.get(n, {}).get("ok") is True
+               and recs.get(n, {}).get("backend") in ("tpu", "axon")
+               for n in need):
+        log("compact-stats flip: gate not met (see KERNEL_DECISIONS.md)")
+        return
+    flags_py = os.path.join(REPO, "paddle_tpu", "flags.py")
+    with open(flags_py) as f:
+        src = f.read()
+    old = 'define_flag("flash_compact_stats", False,'
+    if old not in src:
+        log("compact-stats flip: default already flipped or moved")
+        return
+    with open(flags_py, "w") as f:
+        f.write(src.replace(old,
+                            'define_flag("flash_compact_stats", True,'))
+    commit(flags_py,
+           "Flip flash_compact_stats default on: Mosaic layouts validated "
+           f"on chip ({ROUND} kernels artifact; KERNEL_DECISIONS.md)")
+    log("compact-stats flip: APPLIED and committed")
+
+
 def main() -> int:
     if "--step" in sys.argv:
         run_worker(sys.argv[sys.argv.index("--step") + 1])
@@ -319,6 +354,11 @@ def main() -> int:
         if not run_step(step, test_mode):
             ok = False
             break  # strict order: a dead window fails everything after
+        if step == "kernels" and not test_mode:
+            try:
+                maybe_flip_compact_stats()
+            except Exception as e:   # the flip must never kill the sprint
+                log(f"compact-stats flip FAILED: {e!r}"[:400])
     return 0 if ok else 1
 
 
