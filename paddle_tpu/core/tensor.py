@@ -293,6 +293,12 @@ class Tensor:
     def __float__(self) -> float:
         return float(np.asarray(self._value))
 
+    def __index__(self) -> int:
+        # lets a scalar int Tensor drive range()/slicing; under tracing
+        # jax raises its concretization error, which to_static's guard
+        # turns into guidance (instead of range()'s bare TypeError)
+        return self._value.__index__()
+
     def __hash__(self):
         return id(self)
 

@@ -43,6 +43,14 @@ class DGCMomentum(Momentum):
                  use_nesterov=False, weight_decay=None, grad_clip=None,
                  num_trainers: Optional[int] = None,
                  multi_precision: bool = False, name=None):
+        if use_nesterov:
+            # DGC's momentum correction is defined for plain momentum
+            # (Lin et al. §3); silently switching Nesterov off at rampup
+            # would be a hidden optimizer change — reject up front
+            raise NotImplementedError(
+                "DGCMomentum does not support use_nesterov=True (the "
+                "sparsified momentum-correction update is plain "
+                "momentum); use Momentum without strategy.dgc")
         super().__init__(learning_rate, momentum, parameters, use_nesterov,
                          weight_decay, grad_clip, multi_precision, name)
         self._rampup_begin = int(rampup_begin_step)

@@ -410,7 +410,19 @@ class TrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         vals = tuple(tree_to_values(b) for b in batch)
         if self._data_sharding is not None:
-            vals = tuple(jax.device_put(v, self._data_sharding) for v in vals)
+            if jax.process_count() > 1:
+                # multi-host: each process feeds its LOCAL batch shard
+                # (what its DataLoader/DistributedBatchSampler yields);
+                # the global array spans the mesh (reference analogue:
+                # per-trainer readers + NCCL data parallel). Per-leaf so
+                # pytree batch elements work like the single-process path
+                vals = tuple(jax.tree.map(
+                    lambda leaf: jax.make_array_from_process_local_data(
+                        self._data_sharding, np.asarray(leaf)), v)
+                    for v in vals)
+            else:
+                vals = tuple(jax.device_put(v, self._data_sharding)
+                             for v in vals)
         if getattr(self, "_lsgd_count", None) is not None:
             loss, self.params, self.opt_state, self._lsgd_count = \
                 self._jit_step(self.params, self.opt_state,

@@ -269,11 +269,68 @@ def convert_for_range(range_args: tuple, body_fn: Callable, init: tuple,
     last = (jnp.asarray(start, jnp.int32)
             + (jnp.asarray(n, jnp.int32) - 1) * step)
     pv = _val(prior_target)
-    if isinstance(prior_target, _UndefinedVar) or pv is None:
+    if (isinstance(prior_target, _UndefinedVar) or pv is None
+            or np.shape(pv) != ()
+            or not jnp.issubdtype(jnp.asarray(pv).dtype, jnp.integer)):
+        # blending needs a scalar-int prior; otherwise the target reads
+        # the last index even for a traced-empty range (documented
+        # divergence — never a silently-truncated prior value)
         tgt = _wrap(last)
     else:
         tgt = _wrap(jnp.where(n > 0, last,
                               jnp.asarray(pv, jnp.int32)))
+    return (tgt,) + tuple(_tree_tensors(out))
+
+
+def convert_for_iter(seq, body_fn: Callable, init: tuple,
+                     prior_target=None):
+    """``for x in seq: ...`` over a general iterable. Plain Python
+    iteration for non-array sequences; for a Tensor/array (the case
+    Python iteration cannot trace) the loop lowers to ``lax.fori_loop``
+    over the static leading dimension with ``x = seq[i]``. Returns
+    ``(target, *loop_vars)`` like :func:`convert_for_range`."""
+    sv = _val(seq)
+    is_array = hasattr(sv, "ndim") and hasattr(sv, "shape") \
+        and not isinstance(sv, (list, tuple, range, str, bytes, dict))
+    if not is_array:
+        vars_ = tuple(init)
+        tgt = prior_target
+        for x in seq:
+            tgt = x
+            vars_ = tuple(body_fn(x, *vars_))
+        return (tgt,) + vars_
+    if getattr(sv, "ndim", 0) == 0:
+        raise TypeError("iteration over a 0-d tensor")
+    n = int(sv.shape[0])          # leading dim is static under tracing
+    if n == 0:
+        return (prior_target,) + tuple(init)
+    if not _is_traced(sv) and not any(_is_traced(_val(a)) for a in init):
+        vars_ = tuple(init)
+        x = None
+        for i in range(n):
+            x = _wrap(sv[i])
+            vars_ = tuple(body_fn(x, *vars_))
+        return (x,) + vars_
+    for a in init:
+        if isinstance(a, _UndefinedVar):
+            raise RuntimeError(
+                f"dy2static: loop variable {a.name!r} must be initialised "
+                f"before a converted `for` over a traced tensor.")
+    carry0 = tuple(_val(a) for a in init)
+
+    def body_w(k, vs):
+        x = jax.lax.dynamic_index_in_dim(sv, k, 0, keepdims=False)
+        return tuple(_tree_vals(tuple(
+            body_fn(_wrap(x), *[_wrap(v) for v in vs]))))
+
+    try:
+        out = lax.fori_loop(0, n, body_w, carry0)
+    except TypeError as e:
+        _reraise_if_trace_error(e)
+        raise RuntimeError(
+            f"dy2static: converted `for` body changed the carry "
+            f"structure ({e}). " + _CONVERT_HINT) from e
+    tgt = _wrap(sv[n - 1])
     return (tgt,) + tuple(_tree_tensors(out))
 
 
@@ -311,11 +368,6 @@ _SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
                 ast.ClassDef, ast.GeneratorExp, ast.ListComp, ast.SetComp,
                 ast.DictComp)
 
-_UNSAFE_NODES = (ast.Raise, ast.Try, ast.With, ast.AsyncWith, ast.Break,
-                 ast.Continue, ast.Global, ast.Nonlocal, ast.Delete,
-                 ast.Yield, ast.YieldFrom, ast.Await)
-
-
 def _walk_scope(node):
     """ast.walk that does not descend into nested scopes."""
     stack = list(ast.iter_child_nodes(node))
@@ -326,22 +378,103 @@ def _walk_scope(node):
             stack.extend(ast.iter_child_nodes(n))
 
 
+_HARD_UNSAFE = (ast.Raise, ast.Try, ast.With, ast.AsyncWith, ast.Global,
+                ast.Nonlocal, ast.Delete, ast.Yield, ast.YieldFrom,
+                ast.Await)
+
+
+def _analyze(stmts: Sequence[ast.stmt]):
+    """(hard_unsafe, unbound_break, unbound_continue) for a statement
+    list: ``unbound`` = a break/continue NOT enclosed by a loop inside
+    the list itself (i.e. one that targets the construct being
+    converted). Hard-unsafe constructs (exceptions, scope statements,
+    attribute/subscript mutation) can never be functionalised."""
+    unsafe = ub_break = ub_cont = False
+
+    def walk(node, depth):
+        nonlocal unsafe, ub_break, ub_cont
+        for n in ast.iter_child_nodes(node):
+            if isinstance(n, _SCOPE_NODES):
+                continue
+            if isinstance(n, _HARD_UNSAFE):
+                unsafe = True
+                continue
+            if isinstance(n, ast.Break):
+                ub_break = ub_break or depth == 0
+                continue
+            if isinstance(n, ast.Continue):
+                ub_cont = ub_cont or depth == 0
+                continue
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (n.targets if isinstance(n, ast.Assign)
+                           else [n.target])
+                for t in targets:
+                    elts = (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                            else [t])
+                    for e in elts:
+                        if not isinstance(e, ast.Name):
+                            unsafe = True
+            walk(n, depth + 1 if isinstance(n, (ast.While, ast.For))
+                 else depth)
+
+    holder = ast.Module(body=list(stmts), type_ignores=[])
+    walk(holder, 0)
+    return unsafe, ub_break, ub_cont
+
+
 def _is_safe(node) -> bool:
     """A construct is convertible only if functionalising its body cannot
-    change semantics: no control-flow escapes, no exception machinery, no
-    mutation through attributes/subscripts (those would run on BOTH
-    branches under lax.cond)."""
-    for n in _walk_scope(node):
-        if isinstance(n, _UNSAFE_NODES):
-            return False
-        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
-            for t in targets:
-                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
-                for e in elts:
-                    if not isinstance(e, ast.Name):
-                        return False
-    return True
+    change semantics: no control-flow escapes targeting an ENCLOSING
+    construct, no exception machinery, no mutation through attributes/
+    subscripts (those would run on BOTH branches under lax.cond).
+    break/continue bound to a loop nested inside the construct are fine —
+    that loop handles (or shells) them itself."""
+    body = node.body if isinstance(node, (ast.If, ast.While, ast.For)) \
+        else [node]
+    orelse = getattr(node, "orelse", [])
+    unsafe, ub_break, ub_cont = _analyze(list(body) + list(orelse))
+    return not (unsafe or ub_break or ub_cont)
+
+
+def _assign_const(name: str, value: bool) -> ast.stmt:
+    return ast.Assign(targets=[_name(name, ast.Store())],
+                      value=ast.Constant(value))
+
+
+def _lower_bc(stmts: Sequence[ast.stmt], brk: Optional[str],
+              cont: str) -> Tuple[List[ast.stmt], bool]:
+    """The reference BreakContinueTransformer's guard lowering:
+    ``break``/``continue`` become flag assignments and every statement
+    that could follow one runs under ``if not flag``. Nested loops keep
+    their own break/continue. Returns (new_stmts, any_flag_set)."""
+    out: List[ast.stmt] = []
+    for i, st in enumerate(stmts):
+        if isinstance(st, ast.Break):
+            if brk is None:      # callers exclude this case up front
+                raise ValueError("break not lowerable here")
+            out.append(_assign_const(brk, True))
+            return out, True                      # rest is dead code
+        if isinstance(st, ast.Continue):
+            out.append(_assign_const(cont, True))
+            return out, True
+        if isinstance(st, ast.If):
+            nb, fb = _lower_bc(st.body, brk, cont)
+            no, fo = _lower_bc(st.orelse, brk, cont)
+            out.append(ast.If(test=st.test, body=nb or [ast.Pass()],
+                              orelse=no))
+            if fb or fo:
+                rest, _ = _lower_bc(stmts[i + 1:], brk, cont)
+                if rest:
+                    flags: ast.expr = _name(cont)
+                    if brk is not None:
+                        flags = ast.BoolOp(op=ast.Or(),
+                                           values=[_name(brk), _name(cont)])
+                    guard = ast.UnaryOp(op=ast.Not(), operand=flags)
+                    out.append(ast.If(test=guard, body=rest, orelse=[]))
+                return out, True
+            continue
+        out.append(st)           # incl. nested loops: their b/c is theirs
+    return out, False
 
 
 def _assigned_names(stmts: Sequence[ast.stmt]) -> List[str]:
@@ -565,8 +698,26 @@ class _Converter:
 
     # -- while -------------------------------------------------------------
     def while_stmt(self, st: ast.While) -> List[ast.stmt]:
-        if st.orelse or not _is_safe(st) or _contains_return(st.body):
+        if st.orelse or _contains_return(st.body):
             return [self.recurse_shell(st)]
+        unsafe, ub_break, ub_cont = _analyze(st.body)
+        if unsafe:
+            return [self.recurse_shell(st)]
+        if ub_break or ub_cont:
+            # lower break/continue into flag guards, then convert the
+            # flag-free loop (reference BreakContinueTransformer)
+            n = self.uid()
+            brk, cont = f"__jst_brk_{n}", f"__jst_cont_{n}"
+            body2, _ = _lower_bc(st.body, brk, cont)
+            body2 = [_assign_const(cont, False)] + body2
+            test2: ast.expr = ast.BoolOp(
+                op=ast.And(),
+                values=[ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
+                        st.test])
+            inner = ast.While(test=test2, body=body2, orelse=[])
+            # both flags need pre-loop bindings: they are loop carries
+            return ([_assign_const(brk, False), _assign_const(cont, False)]
+                    + self.while_stmt(inner))
         loop_vars = _assigned_names(st.body)
         if not loop_vars:
             return [self.recurse_shell(st)]
@@ -592,22 +743,43 @@ class _Converter:
                     and 1 <= len(st.iter.args) <= 3
                     and not any(isinstance(a, ast.Starred)
                                 for a in st.iter.args))
-        if (not is_range or st.orelse or not isinstance(st.target, ast.Name)
-                or not _is_safe(st) or _contains_return(st.body)):
+        if (st.orelse or not isinstance(st.target, ast.Name)
+                or _contains_return(st.body)):
             return [self.recurse_shell(st)]
+        unsafe, ub_break, ub_cont = _analyze(st.body)
+        if unsafe or ub_break:
+            # break in a converted for cannot shorten the fori trip count
+            # AND changes the target's final binding — keep the guard
+            return [self.recurse_shell(st)]
+        body_stmts = list(st.body)
+        cont_pre: List[ast.stmt] = []
+        if ub_cont:
+            # continue lowers to a flag guard; every iteration still runs
+            # (correct for `for` — the trip count is unchanged)
+            n = self.uid()
+            cont = f"__jst_cont_{n}"
+            body_stmts, _ = _lower_bc(body_stmts, None, cont)
+            body_stmts = [_assign_const(cont, False)] + body_stmts
+            cont_pre = [_assign_const(cont, False)]   # pre-loop carry init
         tgt = st.target.id
-        loop_vars = [v for v in _assigned_names(st.body) if v != tgt]
+        loop_vars = [v for v in _assigned_names(body_stmts) if v != tgt]
         n = self.uid()
         bname = f"__jst_forbody_{n}"
-        body = self.block(st.body)
+        body = self.block(body_stmts)
         b_fn = _make_fn(bname, [tgt] + loop_vars,
                         body + [ast.Return(value=self.tuple_of(loop_vars))])
-        call = _jst_call("convert_for_range",
-                         [ast.Tuple(elts=list(st.iter.args), ctx=ast.Load()),
-                          _name(bname), self.tuple_of(loop_vars),
-                          _name(tgt)])
+        if is_range:
+            call = _jst_call(
+                "convert_for_range",
+                [ast.Tuple(elts=list(st.iter.args), ctx=ast.Load()),
+                 _name(bname), self.tuple_of(loop_vars), _name(tgt)])
+        else:
+            call = _jst_call(
+                "convert_for_iter",
+                [st.iter, _name(bname), self.tuple_of(loop_vars),
+                 _name(tgt)])
         # Python binds the loop variable past the loop — rebind it too
-        return (self.preamble(loop_vars + [tgt])
+        return (cont_pre + self.preamble(loop_vars + [tgt])
                 + [b_fn, self.assign_out([tgt] + loop_vars, call)])
 
 

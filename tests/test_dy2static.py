@@ -15,11 +15,17 @@ Semantics table
 |   (return-style, assignment-style,|            | (parity tests below)     |
 |    elif chains, and/or/not tests) |            |                          |
 | while on tensor DATA              | works      | CONVERTED →              |
-|                                   |            | lax.while_loop           |
+|   (incl. break / continue, via    |            | lax.while_loop           |
+|    flag-guard lowering)           |            |                          |
 | for over range(tensor n)          | works      | CONVERTED → lax.fori_loop|
+|   (continue OK; break stays       |            |                          |
+|    GUARDED: trip count + target   |            |                          |
+|    binding can't shorten)         |            |                          |
+| for over a Tensor (row iteration) | works      | CONVERTED → fori_loop    |
+|                                   |            | over the leading dim     |
 | unconvertible control flow        | works      | GUARDED: RuntimeError    |
-|   (break/raise/attr-mutation in   |            | with guidance (default   |
-|    branch; mixed return/assign)   |            | full_graph=True)         |
+|   (raise/attr-mutation in branch; |            | with guidance (default   |
+|    mixed return/assign; for-break)|            | full_graph=True)         |
 | ... with full_graph=False         | works      | eager fallback + warning |
 | static.nn.cond / while_loop /     | works      | EXACT (lax control flow, |
 |   switch_case / case              |            | compiled)                |
@@ -366,3 +372,89 @@ class TestForTargetBinding:
         st = to_static(fn)
         x = t(np.ones(2))
         np.testing.assert_allclose(st(x).numpy(), fn(x).numpy())
+
+
+class TestBreakContinueLowering:
+    """break/continue lower to flag guards (reference
+    BreakContinueTransformer), then the flag-free loop converts."""
+
+    def test_break_in_while(self):
+        def fn(x):
+            while x.sum() < 1000:
+                x = x * 2
+                if x.max() > 40:
+                    break
+            return x
+
+        st = to_static(fn)
+        assert "convert_while" in st.code
+        for s in (1.0, 25.0, 2000.0):
+            v = np.full(3, s)
+            np.testing.assert_allclose(st(t(v)).numpy(), fn(t(v)).numpy())
+
+    def test_continue_in_while(self):
+        def fn(x):
+            i = paddle.to_tensor(np.int32(0))
+            acc = x * 0
+            while i < 6:
+                i = i + 1
+                if i % 2 == 0:
+                    continue
+                acc = acc + i.astype("float32")
+            return acc
+
+        st = to_static(fn)
+        np.testing.assert_allclose(st(t(np.zeros(2))).numpy(),
+                                   fn(t(np.zeros(2))).numpy())
+
+    def test_continue_in_for_range(self):
+        def fn(x, n):
+            acc = x
+            for i in range(n):
+                if i == 2:
+                    continue
+                acc = acc + i
+            return acc
+
+        st = to_static(fn)
+        np.testing.assert_allclose(
+            st(t(np.zeros(2)), t(5, np.int32)).numpy(),
+            fn(t(np.zeros(2)), 5).numpy())
+
+    def test_break_in_for_stays_guarded(self):
+        def fn(x, n):
+            acc = x
+            for i in range(n):
+                if acc.sum() > 10:
+                    break
+                acc = acc + 1
+            return acc
+
+        st = to_static(fn)
+        with pytest.raises(RuntimeError, match="control flow"):
+            st(t(np.zeros(2)), t(5, np.int32))
+
+
+class TestForOverTensor:
+    def test_row_iteration_converts(self):
+        def fn(xs, acc):
+            for row in xs:
+                acc = acc + row * 2
+            return acc
+
+        st = to_static(fn)
+        assert "convert_for_iter" in st.code
+        xs = np.arange(12, dtype=np.float32).reshape(4, 3)
+        np.testing.assert_allclose(
+            st(t(xs), t(np.zeros(3))).numpy(),
+            fn(t(xs), t(np.zeros(3))).numpy())
+
+    def test_python_list_iteration_still_exact(self):
+        def fn(x):
+            for c in [1.0, 2.0, 3.0]:
+                x = x * c
+            return x
+
+        st = to_static(fn)
+        np.testing.assert_allclose(st(t(np.ones(2))).numpy(),
+                                   fn(t(np.ones(2))).numpy())

@@ -29,6 +29,50 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+def launch(n_procs: int = 2, devices_per_proc: int = 4,
+           timeout: float = 420.0):
+    """Shared launcher (used by __graft_entry__.dryrun_multichip AND
+    tests/test_multiprocess_mesh.py — one env protocol, one cleanup
+    path): start the KV master, spawn ``n_procs`` workers with the
+    launcher env protocol, and return their parsed JSON results. Any
+    failure kills EVERY worker before raising — a dead rank otherwise
+    leaves its peer orphaned inside jax.distributed.initialize."""
+    import subprocess
+
+    from paddle_tpu.distributed.launch.kv_master import KVServer
+
+    srv = KVServer(host="127.0.0.1").start()
+    procs = []
+    try:
+        for r in range(n_procs):
+            env = dict(os.environ)
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                                f"{devices_per_proc}")
+            env["PADDLE_TRAINER_ID"] = str(r)
+            env["PADDLE_TRAINERS_NUM"] = str(n_procs)
+            env["PADDLE_MASTER_ENDPOINT"] = f"127.0.0.1:{srv.port}"
+            env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+        outs = []
+        for r, p in enumerate(procs):
+            so, se = p.communicate(timeout=timeout)
+            if p.returncode != 0:
+                raise RuntimeError(f"mp worker {r} rc={p.returncode}: "
+                                   f"{se[-1500:]}")
+            outs.append(json.loads(so.strip().splitlines()[-1]))
+        return outs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        srv.stop()
+
+
 def main() -> None:
     rank = int(os.environ["PADDLE_TRAINER_ID"])
     nprocs = int(os.environ["PADDLE_TRAINERS_NUM"])
@@ -96,10 +140,41 @@ def main() -> None:
     hcg = create_hybrid_communicate_group(dp_degree=n_global)
     assert hcg.get_data_parallel_world_size() == n_global
 
+    # ---- FULL train step across both processes ---------------------------
+    # dp=8 over the 2-process mesh: params replicated globally (identical
+    # seed per process), each process feeds its local half of the global
+    # batch (per-rank data, like a DistributedBatchSampler shard); the
+    # jitted fwd+bwd+AdamW step runs ONE SPMD program over both
+    # processes, with the dp grad-sum riding the cross-process
+    # collectives verified above. Losses must agree bit-for-bit across
+    # ranks (replicated output).
+    import paddle_tpu as paddle
+    from paddle_tpu.hapi import TrainStep
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=2, max_position_embeddings=32,
+                    hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    ts = TrainStep(model, opt, mesh=mesh, data_axes=("dp",))
+    lrng = np.random.default_rng(100 + rank)      # per-rank data
+    local_b = n_global // nprocs                  # rows this process feeds
+    losses = []
+    for _ in range(3):
+        ids = lrng.integers(0, cfg.vocab_size, (local_b, 17))
+        x = paddle.to_tensor(ids[:, :-1].astype(np.int32))
+        yb = paddle.to_tensor(ids[:, 1:].astype(np.int32))
+        losses.append(float(ts(x, yb)))
+    assert all(np.isfinite(l) for l in losses), losses
+
     print(json.dumps({
         "rank": rank, "processes": jax.process_count(),
         "global_devices": n_global, "local_devices": local,
-        "collective_mean": got, "expected": want, "ok": True,
+        "collective_mean": got, "expected": want,
+        "train_losses": [round(l, 6) for l in losses], "ok": True,
     }), flush=True)
 
 
