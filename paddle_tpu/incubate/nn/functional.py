@@ -524,3 +524,81 @@ def variable_length_memory_efficient_attention(
         from ...kernels.decode_attention import cached_attention_dense
         out = cached_attention_dense(qb, kb, vb, s, sm_scale=scale)
     return Tensor(jnp.swapaxes(out, 1, 2))
+
+
+def weight_quantize(x, algo="weight_only_int8", group_size=-1):
+    """reference: paddle.nn.quant.weight_quantize (surfaced through
+    incubate for the LLM serving path) — per-channel (or grouped)
+    abs-max int8/int4 weight quantization.
+
+    Returns (quantized_weight int8, scales float32). ``x`` is the f32/
+    bf16 weight (in_features, out_features); scales are per output
+    channel, or per (group, out) block when ``group_size`` > 0.
+    int4 packs two nibbles per int8 byte along the in dimension
+    (reference packing); on TPU the win is HBM bandwidth — the matmul
+    dequantizes into bf16 registers (weight_only_linear)."""
+    from ...core.tensor import Tensor, _val
+    w = _val(x).astype(jnp.float32)
+    if algo not in ("weight_only_int8", "weight_only_int4"):
+        raise ValueError(f"unsupported weight_quantize algo {algo!r}")
+    k, n = w.shape
+    if group_size > 0:
+        if k % group_size:
+            raise ValueError(f"in_features {k} not divisible by "
+                             f"group_size {group_size}")
+        wg = w.reshape(k // group_size, group_size, n)
+        amax = jnp.max(jnp.abs(wg), axis=1)              # (G, N)
+    else:
+        amax = jnp.max(jnp.abs(w), axis=0, keepdims=True)  # (1, N)
+    qmax = 127.0 if algo == "weight_only_int8" else 7.0
+    scale = jnp.maximum(amax, 1e-8) / qmax
+    if group_size > 0:
+        q = jnp.clip(jnp.round(wg / scale[:, None, :]), -qmax, qmax)
+        q = q.reshape(k, n)
+    else:
+        q = jnp.clip(jnp.round(w / scale), -qmax, qmax)
+    q = q.astype(jnp.int8)
+    if algo == "weight_only_int4":
+        # pack two int4 values (rows 2i, 2i+1) into one int8 byte
+        if k % 2:
+            raise ValueError("int4 packing needs an even in_features")
+        lo = q[0::2] & 0x0F
+        hi = (q[1::2] & 0x0F) << 4
+        q = (lo | hi).astype(jnp.int8)
+    return (Tensor(q, stop_gradient=True),
+            Tensor(scale.reshape(-1, n) if group_size > 0
+                   else scale.reshape(n), stop_gradient=True))
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", group_size=-1):
+    """reference: paddle.nn.quant.weight_only_linear (the
+    weight_only_gemm CUDA kernel). TPU-native: dequantize into the
+    matmul — XLA fuses the int8→f32 convert and per-channel scale into
+    the MXU feed, so the weight lives in HBM at 1/2 (int8) or 1/4
+    (int4) the bytes and the FLOPs stay bf16/f32."""
+    from ...core.tensor import Tensor, _val
+    xv = _val(x)
+    q = _val(weight)
+    scale = _val(weight_scale)
+    if weight_dtype == "int4":
+        lo = (q << 4).astype(jnp.int8) >> 4        # sign-extend low nibble
+        hi = q >> 4                                # arithmetic shift: high
+        kp = q.shape[0]
+        w = jnp.zeros((kp * 2, q.shape[1]), jnp.int8)
+        w = w.at[0::2].set(lo).at[1::2].set(hi)
+    elif weight_dtype == "int8":
+        w = q
+    else:
+        raise ValueError(f"unsupported weight_dtype {weight_dtype!r}")
+    wf = w.astype(jnp.float32)
+    if group_size > 0:
+        g = wf.shape[0] // group_size
+        wf = (wf.reshape(g, group_size, -1) * scale[:, None, :]).reshape(
+            wf.shape)
+    else:
+        wf = wf * scale.reshape(1, -1)
+    out = jnp.matmul(xv.astype(jnp.float32), wf)
+    if bias is not None:
+        out = out + _val(bias)
+    return Tensor(out.astype(xv.dtype))

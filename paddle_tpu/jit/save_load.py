@@ -108,15 +108,23 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
 
     try:
         specs = _infer_specs(layer, input_spec)
-        scope = jax_export.SymbolicScope()
-        in_avals = [_spec_to_aval(s, scope, i) for i, s in enumerate(specs)]
-        param_avals = {
-            k: jax.ShapeDtypeStruct(np.shape(v), jnp.asarray(v).dtype)
-            for k, v in params.items()}
-        exported = jax_export.export(jax.jit(pure))(param_avals, *in_avals)
+        export_pure(pure, params, specs, path)
     finally:
         for l, was_training in modes:
             l.training = was_training
+
+
+def export_pure(pure, params: Dict[str, Any], specs: List[InputSpec],
+                path: str) -> None:
+    """Export a pure function ``pure(params, *inputs)`` at the given
+    signature into the jit.save artifact triplet (shared by ``jit.save``
+    and ``static.save_inference_model``)."""
+    scope = jax_export.SymbolicScope()
+    in_avals = [_spec_to_aval(s, scope, i) for i, s in enumerate(specs)]
+    param_avals = {
+        k: jax.ShapeDtypeStruct(np.shape(v), jnp.asarray(v).dtype)
+        for k, v in params.items()}
+    exported = jax_export.export(jax.jit(pure))(param_avals, *in_avals)
 
     d = os.path.dirname(os.path.abspath(path))
     if d:
@@ -134,6 +142,7 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
                          "dtype": str(s.dtype), "name": s.name}
                         for s in specs],
         "param_names": sorted(params),
+        "n_outputs": len(exported.out_avals),
     }
     with open(path + ".json", "w") as f:
         json.dump(manifest, f, indent=1)
@@ -161,6 +170,18 @@ class TranslatedLayer(Layer):
     def input_specs(self):
         return [InputSpec(tuple(s["shape"]), s["dtype"], s.get("name"))
                 for s in self._manifest["input_specs"]]
+
+    @property
+    def n_outputs(self) -> int:
+        return int(self._manifest.get("n_outputs", 1))
+
+    @property
+    def feed_names(self):
+        """Input names with the positional fallback — the single
+        definition load_inference_model returns and Executor.run keys
+        feeds by."""
+        return [s.name or f"input_{i}"
+                for i, s in enumerate(self.input_specs)]
 
 
 def load(path: str) -> TranslatedLayer:

@@ -74,19 +74,121 @@ def load(program, model_path, executor=None, var_list=None):
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
-                         **kwargs):
-    """reference: paddle.static.save_inference_model — here the exported
-    artifact is the jit.save StableHLO bundle of the traced program."""
-    raise NotImplementedError(
-        "save_inference_model for recorded static Programs: trace the "
-        "model with paddle.jit.to_static + paddle.jit.save(path) instead "
-        "(the inference.Config/create_predictor path loads that bundle)")
+                         program=None, **kwargs):
+    """reference: paddle.static.save_inference_model (prunes the Program
+    to the feed→fetch subgraph and serializes it for AnalysisPredictor).
+    TPU-native: the recorded Program replays as ONE pure function of
+    (params, feeds) → fetches, exported through the same jax.export
+    StableHLO bundle ``jit.save`` writes — so the classic static deploy
+    loop (``load_inference_model`` + ``Executor.run``) AND the
+    ``inference.create_predictor`` path both load it unchanged."""
+    from ..core import tensor as _core
+    from ..core.autograd import no_grad
+    from ..core.tensor import Tensor
+    from ..jit.save_load import export_pure
+    from .program import default_main_program
+
+    feed_vars = list(feed_vars) if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = list(fetch_vars) if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    prog = program if program is not None else default_main_program()
+    if prog.train_specs:
+        prog = prog.clone(for_test=True)
+
+    in_specs = []
+    feed_ids = []
+    for fv in feed_vars:
+        name = getattr(fv, "name", None)
+        if name not in prog.datas:
+            raise ValueError(
+                f"feed var {fv!r} is not a static.data of this program "
+                f"(known: {sorted(prog.datas)})")
+        vid, shape, dtype = prog.datas[name]
+        in_specs.append(InputSpec(shape, dtype, name))
+        feed_ids.append(vid)
+    fetch_ids = []
+    for f in fetch_vars:
+        tag = getattr(f, "_static_var_id", None)
+        if tag is None or tag[0] is not prog._family:
+            raise ValueError(
+                f"fetch var {f!r} is not a variable of this program")
+        fetch_ids.append(tag[1])
+
+    # prune to the feed->fetch subgraph (the reference's
+    # normalize_program step): walk backward from the fetches so ops
+    # feeding unrelated datas/vars neither export nor demand feeds
+    needed = set(fetch_ids)
+    ops = []
+    for op in reversed(prog.ops):
+        if any(o in needed for o in op.out_ids if o is not None):
+            ops.append(op)
+            for kind, payload in op.arg_specs:
+                if kind == "var":
+                    needed.add(payload)
+    ops.reverse()
+    missing = [name for name, (vid, _s, _d) in prog.datas.items()
+               if vid in needed and vid not in feed_ids]
+    if missing:
+        raise ValueError(
+            f"fetch vars depend on static.data {missing} which are not "
+            f"in feed_vars — add them (reference save_inference_model "
+            f"rejects under-fed subgraphs the same way)")
+
+    # live Parameters referenced by the pruned subgraph
+    param_objs = {}
+    for op in ops:
+        for kind, payload in op.arg_specs:
+            if kind == "param":
+                param_objs[payload.name] = payload
+    params = {k: p._value for k, p in param_objs.items()}
+
+    def pure(pvals, *feeds):
+        table = {vid: Tensor(v, stop_gradient=True)
+                 for vid, v in zip(feed_ids, feeds)}
+
+        def resolve(spec):
+            kind, payload = spec
+            if kind == "param":
+                return payload
+            if kind == "var":
+                return table[payload]
+            return payload
+
+        saved = [(p, p._value) for p in param_objs.values()]
+        prev = _core._static_recorder
+        _core._static_recorder = None
+        try:
+            for k, p in param_objs.items():
+                p._value = pvals[k]
+            with no_grad():
+                for op in ops:
+                    args = [resolve(s) for s in op.arg_specs]
+                    out = _core.apply_op(op.name, op.fn, *args, **op.kwargs)
+                    outs = (list(out) if isinstance(out, (tuple, list))
+                            else [out])
+                    for oid, o in zip(op.out_ids, outs):
+                        if oid is not None:
+                            table[oid] = o
+        finally:
+            _core._static_recorder = prev
+            for p, v in saved:
+                p._value = v
+        return tuple(table[i]._value for i in fetch_ids)
+
+    export_pure(pure, params, in_specs, path_prefix)
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
-    raise NotImplementedError(
-        "load_inference_model: use paddle.jit.load(path) or "
-        "paddle.inference.create_predictor")
+    """reference returns ``[inference_program, feed_target_names,
+    fetch_targets]`` consumed as ``exe.run(program, feed={name: value},
+    fetch_list=fetch_targets)``. Here the "program" is the loaded
+    TranslatedLayer (Executor.run accepts it directly) and fetch targets
+    are output indices — ported serving loops run unchanged."""
+    from ..jit import load as jit_load
+
+    layer = jit_load(path_prefix)
+    return [layer, layer.feed_names, list(range(layer.n_outputs))]
 
 
 def normalize_program(program, feed_vars, fetch_vars, **kwargs):

@@ -207,6 +207,23 @@ class Executor:
             feed: Optional[Dict[str, Any]] = None,
             fetch_list: Optional[Sequence] = None,
             return_numpy: bool = True):
+        from ..jit.save_load import TranslatedLayer
+        if isinstance(program, TranslatedLayer):
+            # load_inference_model hands back the deserialized module as
+            # the "inference program"; run it with the reference calling
+            # convention (feed dict keyed by feed names, fetch targets =
+            # output indices)
+            names = program.feed_names
+            missing = [n for n in names if n not in (feed or {})]
+            if missing:
+                raise KeyError(f"inference program inputs not fed: "
+                               f"{missing}")
+            out = program(*[feed[n] for n in names])
+            leaves = out if isinstance(out, (tuple, list)) else [out]
+            sel = (fetch_list if fetch_list is not None
+                   else range(len(leaves)))
+            return [np.asarray(leaves[int(i)]._value) if return_numpy
+                    else leaves[int(i)] for i in sel]
         prog = program if program is not None else default_main_program()
         feed = feed or {}
         table: Dict[int, Tensor] = {}

@@ -432,3 +432,69 @@ def test_ceil_mode_drops_window_starting_in_right_pad():
         av.numpy(),
         TF.avg_pool2d(_t(x), 2, 2, 1, ceil_mode=True,
                       count_include_pad=False).numpy(), rtol=RT, atol=AT)
+
+
+class TestWeightOnlyQuant:
+    """reference: paddle.nn.quant weight_quantize/weight_only_linear
+    (the LLM weight-only-int8/int4 serving path); parity vs the f32
+    linear within quantization error."""
+
+    def _wx(self, k=64, n=32, seed=0):
+        rng = np.random.default_rng(seed)
+        w = rng.standard_normal((k, n)).astype(np.float32) * 0.1
+        x = rng.standard_normal((4, k)).astype(np.float32)
+        return w, x
+
+    def test_int8_roundtrip_close(self):
+        from paddle_tpu.incubate.nn import functional as IF
+        w, x = self._wx()
+        qw, scale = IF.weight_quantize(paddle.to_tensor(w))
+        assert qw.numpy().dtype == np.int8
+        out = IF.weight_only_linear(paddle.to_tensor(x), qw,
+                                    weight_scale=scale)
+        ref = x @ w
+        err = np.abs(out.numpy() - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.02, err      # 8-bit abs-max: ~1/127 per channel
+
+    def test_int8_grouped(self):
+        from paddle_tpu.incubate.nn import functional as IF
+        w, x = self._wx()
+        qw, scale = IF.weight_quantize(paddle.to_tensor(w), group_size=16)
+        assert tuple(scale.shape) == (4, 32)
+        out = IF.weight_only_linear(paddle.to_tensor(x), qw,
+                                    weight_scale=scale, group_size=16)
+        ref = x @ w
+        err = np.abs(out.numpy() - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.02, err
+
+    def test_int4_pack_unpack(self):
+        from paddle_tpu.incubate.nn import functional as IF
+        w, x = self._wx()
+        qw, scale = IF.weight_quantize(paddle.to_tensor(w),
+                                       algo="weight_only_int4")
+        assert qw.numpy().shape == (32, 32)    # two nibbles per byte
+        out = IF.weight_only_linear(paddle.to_tensor(x), qw,
+                                    weight_scale=scale,
+                                    weight_dtype="int4")
+        ref = x @ w
+        err = np.abs(out.numpy() - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.2, err       # 4-bit: coarse but structured
+        # exact nibble round-trip: quantize an int4-representable weight
+        w4 = (np.round(w / np.abs(w).max(0) * 7) *
+              (np.abs(w).max(0) / 7)).astype(np.float32)
+        qw2, s2 = IF.weight_quantize(paddle.to_tensor(w4),
+                                     algo="weight_only_int4")
+        out2 = IF.weight_only_linear(paddle.to_tensor(x), qw2,
+                                     weight_scale=s2, weight_dtype="int4")
+        np.testing.assert_allclose(out2.numpy(), x @ w4, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_bias_and_bf16_activation(self):
+        from paddle_tpu.incubate.nn import functional as IF
+        w, x = self._wx()
+        b = np.random.default_rng(1).standard_normal(32).astype(np.float32)
+        qw, scale = IF.weight_quantize(paddle.to_tensor(w))
+        out = IF.weight_only_linear(
+            paddle.to_tensor(x).astype("bfloat16"), qw,
+            bias=paddle.to_tensor(b), weight_scale=scale)
+        assert str(out.dtype).endswith("bfloat16")
