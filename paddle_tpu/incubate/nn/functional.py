@@ -570,27 +570,22 @@ def weight_quantize(x, algo="weight_only_int8", group_size=-1):
                    else scale.reshape(n), stop_gradient=True))
 
 
-def weight_only_linear(x, weight, bias=None, weight_scale=None,
-                       weight_dtype="int8", group_size=-1):
-    """reference: paddle.nn.quant.weight_only_linear (the
-    weight_only_gemm CUDA kernel). TPU-native: dequantize into the
-    matmul — XLA fuses the int8→f32 convert and per-channel scale into
-    the MXU feed, so the weight lives in HBM at 1/2 (int8) or 1/4
-    (int4) the bytes and the FLOPs stay bf16/f32."""
-    from ...core.tensor import Tensor, _val
-    xv = _val(x)
-    q = _val(weight)
-    scale = _val(weight_scale)
-    if weight_dtype == "int4":
+def _dequantize_weight(q, scale, weight_dtype: str, group_size: int,
+                       out_dtype):
+    """Shared unpack + scale for weight_only_linear / nn.quant
+    weight_dequantize — ONE packing convention (int4: low nibble = even
+    row, arithmetic-shift sign extension)."""
+    if weight_dtype in ("int4", "weight_only_int4"):
         lo = (q << 4).astype(jnp.int8) >> 4        # sign-extend low nibble
         hi = q >> 4                                # arithmetic shift: high
-        kp = q.shape[0]
-        w = jnp.zeros((kp * 2, q.shape[1]), jnp.int8)
+        w = jnp.zeros((q.shape[0] * 2, q.shape[1]), jnp.int8)
         w = w.at[0::2].set(lo).at[1::2].set(hi)
-    elif weight_dtype == "int8":
+    elif weight_dtype in ("int8", "weight_only_int8"):
         w = q
     else:
-        raise ValueError(f"unsupported weight_dtype {weight_dtype!r}")
+        raise ValueError(f"unsupported weight dtype {weight_dtype!r}")
+    # scale in f32, then cast once: bf16 weights keep the matmul on the
+    # fast MXU path while the scales stay accurate
     wf = w.astype(jnp.float32)
     if group_size > 0:
         g = wf.shape[0] // group_size
@@ -598,7 +593,23 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
             wf.shape)
     else:
         wf = wf * scale.reshape(1, -1)
-    out = jnp.matmul(xv.astype(jnp.float32), wf)
+    return wf.astype(out_dtype)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", group_size=-1):
+    """reference: paddle.nn.quant.weight_only_linear (the
+    weight_only_gemm CUDA kernel). TPU-native: dequantize into the
+    matmul — XLA fuses the int8→bf16 convert and per-channel scale into
+    the MXU feed, so the weight lives in HBM at 1/2 (int8) or 1/4
+    (int4) the bytes, the GEMM runs in the ACTIVATION dtype (bf16 on
+    the serving path) and accumulates in f32."""
+    from ...core.tensor import Tensor, _val
+    xv = _val(x)
+    scale = _val(weight_scale)
+    wf = _dequantize_weight(_val(weight), scale, weight_dtype, group_size,
+                            xv.dtype)
+    out = jnp.matmul(xv, wf, preferred_element_type=jnp.float32)
     if bias is not None:
         out = out + _val(bias)
     return Tensor(out.astype(xv.dtype))

@@ -197,7 +197,12 @@ class Predictor:
             out = self._jitted(self._device_params, tuple(args))
         else:
             out = self._layer(*args)
-        leaves = out if isinstance(out, (tuple, list)) else [out]
+        # flatten like the manifest's n_outputs: dict/nested outputs
+        # serve as ordered leaves
+        import jax
+        from ..core.tensor import Tensor as _T
+        leaves = jax.tree.leaves(out,
+                                 is_leaf=lambda v: isinstance(v, _T))
         self._output_names = [f"output_{i}" for i in range(len(leaves))]
         self._outputs = {}
         for name, leaf in zip(self._output_names, leaves):

@@ -41,4 +41,5 @@ from .layers.extra import (  # noqa: F401
     UpsamplingNearest2D,
 )
 from . import utils  # noqa: F401
+from . import quant  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401

@@ -1,0 +1,44 @@
+"""paddle.nn.quant — weight-only quantization ops (reference:
+python/paddle/nn/quant/quantized_linear.py: weight_quantize,
+weight_dequantize, weight_only_linear, llm_int8_linear over the
+weight_only_gemm / llm.int8 CUDA kernels).
+
+TPU-native: quantized weights live in HBM at 1/2 (int8) or 1/4 (int4)
+the bytes; the dequantize folds into the MXU feed (XLA fuses convert +
+per-channel scale into the matmul). Core implementations are shared
+with :mod:`paddle_tpu.incubate.nn.functional` — one math, two namespaces
+(the reference ships both)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..incubate.nn.functional import weight_only_linear, weight_quantize
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear"]
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8",
+                      out_dtype="float32", group_size=-1):
+    """Inverse of :func:`weight_quantize` — back to the dense weight
+    (same unpack/scale helper as the serving matmul, so the packing
+    convention cannot drift between them)."""
+    from ..core.tensor import Tensor, _val
+    from ..incubate.nn.functional import _dequantize_weight
+    wf = _dequantize_weight(_val(x), _val(scale), algo, group_size,
+                            jnp.dtype(out_dtype))
+    return Tensor(wf, stop_gradient=True)
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold: float = 6.0):
+    """reference: llm.int8 (Dettmers et al.) — activation outliers above
+    ``threshold`` compute in full precision, the rest through the int8
+    weight. On TPU the weight already dequantizes into the matmul, so
+    the mixed decomposition reduces to the same dequantized GEMM — kept
+    for API parity; ``threshold`` only gates which rows WOULD take the
+    outlier path in the reference kernel."""
+    return weight_only_linear(x, weight, bias=bias,
+                              weight_scale=weight_scale,
+                              weight_dtype="int8")

@@ -219,7 +219,12 @@ class Executor:
                 raise KeyError(f"inference program inputs not fed: "
                                f"{missing}")
             out = program(*[feed[n] for n in names])
-            leaves = out if isinstance(out, (tuple, list)) else [out]
+            # manifest n_outputs counts FLATTENED leaves — match it, so
+            # artifacts whose forward returns a dict/nested tree serve
+            # correctly (fetch targets index the flattened order)
+            import jax
+            leaves = jax.tree.leaves(
+                out, is_leaf=lambda v: isinstance(v, Tensor))
             sel = (fetch_list if fetch_list is not None
                    else range(len(leaves)))
             return [np.asarray(leaves[int(i)]._value) if return_numpy

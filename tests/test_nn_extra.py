@@ -498,3 +498,20 @@ class TestWeightOnlyQuant:
             paddle.to_tensor(x).astype("bfloat16"), qw,
             bias=paddle.to_tensor(b), weight_scale=scale)
         assert str(out.dtype).endswith("bfloat16")
+
+    def test_nn_quant_namespace_and_dequantize(self):
+        """reference: paddle.nn.quant.{weight_quantize, weight_dequantize,
+        weight_only_linear, llm_int8_linear}."""
+        from paddle_tpu.nn import quant
+        w, x = self._wx()
+        for algo, tol in (("weight_only_int8", 0.02),
+                          ("weight_only_int4", 0.2)):
+            qw, sc = quant.weight_quantize(paddle.to_tensor(w), algo=algo)
+            back = quant.weight_dequantize(qw, sc, algo=algo)
+            err = np.abs(back.numpy() - w).max() / np.abs(w).max()
+            assert err < tol, (algo, err)
+        qw, sc = quant.weight_quantize(paddle.to_tensor(w))
+        out = quant.llm_int8_linear(paddle.to_tensor(x), qw,
+                                    weight_scale=sc)
+        ref = x @ w
+        assert np.abs(out.numpy() - ref).max() / np.abs(ref).max() < 0.02

@@ -268,3 +268,37 @@ class TestSaveInferenceModel:
                 paddle.static.save_inference_model(
                     os.path.join(d, "m2"), [x], [_unrelated],
                     program=main)
+
+    def test_dict_output_artifact_serves(self, tmp_path):
+        """Review r5: an artifact whose forward returns a pytree serves
+        through Executor.run as ordered flattened leaves."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.static import InputSpec
+
+        class TwoHead(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.lin(x)
+                return {"a": h, "b": h + 1.0}
+
+        paddle.seed(0)
+        net = TwoHead()
+        prefix = str(tmp_path / "dicty")
+        paddle.jit.save(net, prefix,
+                        input_spec=[InputSpec([None, 4], "float32",
+                                              name="x")])
+        exe = paddle.static.Executor()
+        prog, feeds, fts = paddle.static.load_inference_model(prefix, exe)
+        assert len(fts) == 2
+        xv = np.ones((2, 4), np.float32)
+        a, b = exe.run(prog, feed={"x": xv}, fetch_list=fts)
+        np.testing.assert_allclose(b, a + 1.0, rtol=1e-6)
+        # and the Predictor facade serves the same artifact
+        from paddle_tpu import inference as paddle_infer
+        pred = paddle_infer.create_predictor(paddle_infer.Config(prefix))
+        outs = pred.run([xv])
+        assert len(outs) == 2
+        np.testing.assert_allclose(outs[1], outs[0] + 1.0, rtol=1e-6)
