@@ -198,11 +198,9 @@ class Predictor:
         else:
             out = self._layer(*args)
         # flatten like the manifest's n_outputs: dict/nested outputs
-        # serve as ordered leaves
-        import jax
-        from ..core.tensor import Tensor as _T
-        leaves = jax.tree.leaves(out,
-                                 is_leaf=lambda v: isinstance(v, _T))
+        # serve as ordered leaves (shared convention with Executor.run)
+        from ..jit.save_load import flatten_output_leaves
+        leaves = flatten_output_leaves(out)
         self._output_names = [f"output_{i}" for i in range(len(leaves))]
         self._outputs = {}
         for name, leaf in zip(self._output_names, leaves):

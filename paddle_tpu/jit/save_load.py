@@ -184,6 +184,14 @@ class TranslatedLayer(Layer):
                 for i, s in enumerate(self.input_specs)]
 
 
+def flatten_output_leaves(out):
+    """THE output-flattening convention: matches the manifest's
+    ``n_outputs`` (counted from the export's flattened out_avals), used
+    by every serving facade (Predictor.run, Executor.run) so dict/nested
+    outputs index identically everywhere."""
+    return jax.tree.leaves(out, is_leaf=lambda v: isinstance(v, Tensor))
+
+
 def load(path: str) -> TranslatedLayer:
     """Load a ``jit.save`` artifact; returns a callable TranslatedLayer."""
     with open(path + ".pdmodel", "rb") as f:

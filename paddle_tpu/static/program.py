@@ -222,9 +222,8 @@ class Executor:
             # manifest n_outputs counts FLATTENED leaves — match it, so
             # artifacts whose forward returns a dict/nested tree serve
             # correctly (fetch targets index the flattened order)
-            import jax
-            leaves = jax.tree.leaves(
-                out, is_leaf=lambda v: isinstance(v, Tensor))
+            from ..jit.save_load import flatten_output_leaves
+            leaves = flatten_output_leaves(out)
             sel = (fetch_list if fetch_list is not None
                    else range(len(leaves)))
             return [np.asarray(leaves[int(i)]._value) if return_numpy

@@ -96,6 +96,10 @@ def measure_zbh1(M, n_layers=8, hidden=128, seq=128, vocab=128,
     compiled = step._jit_step.lower(
         step.params, step.opt_state, lr, x, y).compile()
     temp = compiled.memory_analysis().temp_size_in_bytes
+    try:
+        flops = float(compiled.cost_analysis().get("flops", 0.0))
+    except Exception:
+        flops = 0.0
     med = None
     if time_steps:
         # reuse the AOT executable: the jit dispatch cache is separate,
@@ -109,7 +113,7 @@ def measure_zbh1(M, n_layers=8, hidden=128, seq=128, vocab=128,
             jax.block_until_ready(out)
             ts.append(_time.perf_counter() - t0)
         med = sorted(ts)[len(ts) // 2]
-    return temp, med
+    return temp, med, flops
 
 
 def zbh1_tick_table():
@@ -148,11 +152,11 @@ def main():
     zb = {}
     zt = {}
     for M in (4, 8):
-        zb[M], zt[M] = measure_zbh1(M, time_steps=3)
-        _, lt = measure_zbh1(M, schedule="auto", time_steps=3)
-        zt[M] = (zt[M], lt)
+        zb[M], zm, _zfl = measure_zbh1(M, time_steps=3)
+        _, lt, _lfl = measure_zbh1(M, schedule="auto", time_steps=3)
+        zt[M] = (zm, lt)
         print(f"zbh1 M={M} temp={zb[M]/1e6:.2f} MB "
-              f"step={zt[M][0]*1e3:.0f} ms vs lockstep {lt*1e3:.0f} ms",
+              f"step={zm*1e3:.0f} ms vs lockstep {lt*1e3:.0f} ms",
               file=sys.stderr)
 
     base = {(s, m): t for s, m, v, t in rows if v == 1}
@@ -220,14 +224,55 @@ def main():
         "remat): "
         + ", ".join(f"M={m}: {a*1e3:.0f} ms vs {b*1e3:.0f} ms"
                     for m, (a, b) in sorted(zt.items()))
-        + ". zbh1 is ~25% slower HERE and that is the expected CPU "
-        "artifact, not a verdict: host 'devices' are threads sharing "
-        "cores, so wall clock prices TOTAL work — and the B/W split "
-        "costs one extra forward recompute per microbatch (~5F vs 4F). "
-        "On real chips each stage owns its compute and the metric is the "
-        "per-device critical path, where cond-gating turns fill/drain "
-        "ticks from full masked slots into ~free skips. Re-measure on a "
-        "TPU slice before picking a default.",
+        + f". zbh1 is slower HERE "
+        f"({', '.join(f'M={m}: {a/b - 1:+.0%}' for m, (a, b) in sorted(zt.items()))}) "
+        "and the CPU wall clock is load-sensitive (host 'devices' are "
+        "threads sharing cores, so it prices TOTAL work under whatever "
+        "else the box runs) — use the analytic accounting below, not "
+        "these milliseconds, for the schedule decision.",
+        "",
+        "**Total work, counted from the unit schedule** (XLA "
+        "`cost_analysis()` is NOT usable here: it counts a `lax.scan` "
+        "body once, not x trip-count — measured zbh1 flops were "
+        "identical for M=4 and M=8, the giveaway). Per microbatch per "
+        "stage, with F ~ f forward-flops and the backward ~ 2f split "
+        "as dx ~ f + dw ~ f: lockstep-remat executes F + recompute-F + "
+        "(dx+dw) = 4f; the v1 zbh1 engine executes F + (F+dx) + (F+dw) "
+        "= 5f — each of B and W re-runs the stage forward inside its "
+        "vjp (`pipeline_zbh1.py` b_unit/w_unit). Ratio 5/4 = 1.25.",
+        "",
+        "**Projected per-chip time ratio on compute-bound hardware** "
+        "(critical path ~ total_work / utilization, utilizations from "
+        "the tick table; <1 means zbh1 wins):",
+        "",
+    ]
+    tick = {(S, M): (u, lu) for S, M, _T, u, _lT, lu
+            in zbh1_tick_table()}
+    for m in sorted(zt):
+        zu = float(tick[(4, m)][0].rstrip("%")) / 100
+        lu = float(tick[(4, m)][1].rstrip("%")) / 100
+        proj = 1.25 * (lu / zu)
+        stash = 1.0 * (lu / zu)
+        lines.append(
+            f"- S=4, M={m}: work ratio 1.25 -> projected {proj:.2f} "
+            f"{'(v1 wins)' if proj < 1 else '(v1 loses)'}; a "
+            f"stash-residuals W unit (work ratio -> 1.0) projects "
+            f"{stash:.2f} ({1 - stash:.0%} win).")
+    lines += [
+        "",
+        "Reading: the v1 recompute-based engine wins only where the "
+        "bubble dominates (M close to S); at practical M/S the extra "
+        "forward cancels the gain — so `schedule='auto'` stays the "
+        "default (refines VERDICT r4 weak #5 from 'plausible but "
+        "unproven' to a quantified call). The change that makes zbh1 "
+        "win across the table is the one production ZBH1 "
+        "implementations use: don't recompute in B/W — stash the "
+        "forward's vjp residuals (extractable as arrays with "
+        "jax.closure_convert) in per-slot buffers whose depth is the "
+        "B/W lag (~S slots of per-stage activation residuals, the 1F1B "
+        "in-flight bound, NOT M; the temp budget exists — zbh1's "
+        "footprint is 2-4x below lockstep's above). Round-6 engine "
+        "change, final validation on-chip (TUNNEL_DIAGNOSIS.md).",
         "",
     ]
     out = os.path.join(os.path.dirname(os.path.dirname(
