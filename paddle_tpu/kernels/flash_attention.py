@@ -190,7 +190,10 @@ def _fwd_kernel_compact(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref,
 
     @pl.when(run)
     def _step():
-        seg_col = (jnp.transpose(seg_q_ref[...])             # (bq, 1)
+        # seg block is (1, 1, bq) — Mosaic needs the sublane dim of every
+        # compact stat block to equal the (size-1) array dim, so compact
+        # stats ride (BH, 1, S) through every pallas boundary
+        seg_col = (jnp.transpose(seg_q_ref[0])               # (bq, 1)
                    if seg_q_ref is not None else None)
         s = _masked_scores(q_ref, k_ref, seg_col, seg_kv_ref, qi, kj,
                            causal, sm_scale)
@@ -214,7 +217,7 @@ def _fwd_kernel_compact(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref,
         l = l_ref[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         out_ref[0] = (acc_ref[...] / l_safe).astype(out_ref.dtype)
-        lse_ref[...] = jnp.transpose(m + jnp.log(l_safe))    # (1, bq)
+        lse_ref[0] = jnp.transpose(m + jnp.log(l_safe))      # (1, bq)
 
 
 def _fwd_setup(q, k, block_q, block_k, h, hkv):
@@ -262,10 +265,10 @@ def _fwd_compact(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
     args = [q, k, v]
     if seg_q is not None:
         in_specs += [
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
             pl.BlockSpec((1, 1, block_k), kv_seg_index),
         ]
-        args += [seg_q, seg_kv[:, None, :]]
+        args += [seg_q[:, None, :], seg_kv[:, None, :]]
         kernel = functools.partial(_fwd_kernel_compact, causal=causal,
                                    sm_scale=sm_scale, n_k=n_k)
     else:
@@ -280,11 +283,11 @@ def _fwd_compact(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             _sds((bh, sq, d), q.dtype, q),
-            _sds((bh, sq), jnp.float32, q),
+            _sds((bh, 1, sq), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -293,7 +296,7 @@ def _fwd_compact(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
         ],
         interpret=_interpret(),
     )(*args)
-    return out, lse
+    return out, lse[:, 0, :]
 
 
 def _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k,
@@ -354,10 +357,12 @@ def _fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k,
 # =========================================================== backward kernels
 def _col(ref, compact):
     """Read a per-q-row stat as a (block_q, 1) column. Replicated layout:
-    ref block (1, bq, 128), column 0. Compact layout: ref block (1, bq)
-    lane row, transposed in-kernel (the Mosaic relayout the flag gates)."""
+    ref block (1, bq, 128), column 0. Compact layout: ref block (1, 1, bq)
+    lane row (stats ride (BH, 1, S) — the size-1 sublane dim satisfies
+    Mosaic's block-shape rule), transposed in-kernel (the relayout the
+    flag gates)."""
     if compact:
-        return jnp.transpose(ref[...])
+        return jnp.transpose(ref[0])
     return ref[0][:, :1]
 
 
@@ -473,11 +478,13 @@ def _bwd_impl(causal, sm_scale, block_q, block_k, h, hkv, compact, res,
 
     has_seg = seg_q is not None
     if compact:
-        # stats + q-side ids ride compact (BH, S): (1, bq) lane rows,
-        # transposed in-kernel (no replicated HBM transients at all)
-        stat_spec_dq = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
-        seg2 = [seg_q, seg_kv[:, None, :]] if has_seg else []
-        common = [q, k, v, do, lse, delta] + seg2
+        # stats + q-side ids ride compact (BH, 1, S): (1, 1, bq) lane
+        # rows, transposed in-kernel (no replicated HBM transients at all)
+        stat_spec_dq = pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i))
+        seg2 = ([seg_q[:, None, :], seg_kv[:, None, :]]
+                if has_seg else [])
+        common = ([q, k, v, do, lse[:, None, :], delta[:, None, :]]
+                  + seg2)
     else:
         # q-side rows lane-replicated transiently for the kernel boundary;
         # kv-side ids ride compact as (BH, 1, S) row vectors
@@ -525,7 +532,8 @@ def _bwd_impl(causal, sm_scale, block_q, block_k, h, hkv, compact, res,
 
     if compact:
         stat_spec_dkv = pl.BlockSpec(
-            (1, bq), lambda b, i, r, j: q_index(b, i, r, j)[:2])
+            (1, 1, bq),
+            lambda b, i, r, j: (q_index(b, i, r, j)[0], 0, j))
     else:
         stat_spec_dkv = pl.BlockSpec(
             (1, bq, _LANES), lambda b, i, r, j: q_index(b, i, r, j))

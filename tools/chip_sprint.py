@@ -276,18 +276,29 @@ def run_step(step: str, test_mode: bool) -> bool:
         argv = [sys.executable, os.path.abspath(__file__), "--step", step]
     log(f"step {step} -> {artifact} ...")
     env = base_env(test_mode)
+    # stream the step's output to files so a wedged step is diagnosable
+    # while it runs (capture_output showed nothing until completion)
+    cache_dir = os.path.join(REPO, ".cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    out_log = os.path.join(cache_dir, f"sprint_{step}.out")
+    err_log = os.path.join(cache_dir, f"sprint_{step}.err")
     try:
-        r = subprocess.run(argv, env=env, capture_output=True, text=True,
-                           timeout=timeout, cwd=REPO)
+        with open(out_log, "w") as of, open(err_log, "w") as ef:
+            r = subprocess.run(argv, env=env, stdout=of, stderr=ef,
+                               text=True, timeout=timeout, cwd=REPO)
+        with open(out_log) as f:
+            stdout = f.read()
+        with open(err_log) as f:
+            stderr = f.read()
         lines = []
-        for ln in r.stdout.splitlines():
+        for ln in stdout.splitlines():
             try:
                 lines.append(json.loads(ln))
             except (json.JSONDecodeError, ValueError):
                 continue
         if r.returncode != 0 or not lines:
             raise RuntimeError(f"rc={r.returncode} lines={len(lines)} "
-                               f"stderr={r.stderr[-2000:]}")
+                               f"stderr={stderr[-2000:]}")
         require_tpu(lines, test_mode)
         bad = [l for l in lines if l.get("ok") is False]
         payload = {"step": step, "backend": lines[-1].get("backend"),
@@ -346,14 +357,28 @@ def main() -> int:
         run_worker(sys.argv[sys.argv.index("--step") + 1])
         return 0
     test_mode = "--test" in sys.argv
-    order = ["kernels", "attn", "rmsnorm", "train"]
+    # train (real MFU, the north star) immediately after the kernel
+    # existence proof: windows are perishable and the microbenches are
+    # the cheapest thing to lose (r05: the attn step wedged a live
+    # window for its full timeout with train still unbanked behind it)
+    order = ["kernels", "train", "attn", "rmsnorm"]
     if test_mode:
         order = ["kernels"]  # plumbing validation; benches are TPU-priced
     ok = True
     for step in order:
         if not run_step(step, test_mode):
             ok = False
-            break  # strict order: a dead window fails everything after
+            if test_mode:
+                break
+            # one step can wedge (stuck claim/RPC) while the window is
+            # fine — probe cheaply; only a dead window ends the sprint
+            state = bench_mod._probe_with_backoff(base_env(False))
+            if state not in ("tpu", "axon"):
+                log(f"window dead after {step} failure (probe={state}) — "
+                    "ending sprint")
+                break
+            log(f"window still healthy after {step} failure — continuing")
+            continue
         if step == "kernels" and not test_mode:
             try:
                 maybe_flip_compact_stats()
