@@ -30,26 +30,17 @@ from .role_maker import (  # noqa: F401
 )
 
 
-def worker_num() -> int:
-    """reference: fleet.worker_num (module-level convenience)."""
-    from .fleet import fleet as _fleet
-    return _fleet.worker_num()
-
-
-def worker_index() -> int:
-    from .fleet import fleet as _fleet
-    return _fleet.worker_index()
-
-
 def _bind_fleet_method(name):
     def call(*a, **k):
-        return getattr(_fleet, name)(*a, **k)
+        from .fleet import _fleet_singleton   # late-bound singleton
+        return getattr(_fleet_singleton, name)(*a, **k)
     call.__name__ = name
     return call
 
 
-for _n in ("is_worker", "is_server", "is_first_worker", "worker_endpoints",
-           "server_num", "server_index", "server_endpoints", "init_worker",
+for _n in ("worker_num", "worker_index", "is_worker", "is_server",
+           "is_first_worker", "worker_endpoints", "server_num",
+           "server_index", "server_endpoints", "init_worker",
            "init_server", "run_server", "stop_worker", "barrier_worker"):
     globals()[_n] = _bind_fleet_method(_n)
 del _n

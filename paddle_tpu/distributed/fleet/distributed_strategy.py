@@ -66,9 +66,14 @@ _DEFAULTS: Dict[str, Dict[str, Any]] = {
     "localsgd_configs": {"k_steps": 1, "begin_step": 1},
     "dgc_configs": {"rampup_begin_step": 0, "rampup_step": 1,
                     "sparsity": [0.999]},
+    # PS mode (proto a_sync_configs): async push/pull against the host
+    # table runtime (distributed/ps). k_steps<=0 = fully async (the only
+    # mode the TPU PS implements — geo/half-async collapse into it)
+    "a_sync_configs": {"k_steps": -1, "launch_barrier": True},
 }
 
 _FLAGS = {
+    "a_sync": False,
     "amp": False,
     "recompute": False,
     "pipeline": False,

@@ -39,6 +39,12 @@ class Fleet:
             try:
                 role_maker = PaddleCloudRoleMaker(is_collective=is_collective)
             except ValueError as e:
+                if not is_collective:
+                    # PS mode was explicitly requested: a bad TRAINING_ROLE
+                    # or server-endpoint env is a real config error, not
+                    # stale launcher residue — downgrading it to a warning
+                    # would silently turn a PSERVER into a worker
+                    raise
                 # stale/inconsistent PADDLE_* env outside a launch-CLI job
                 # must not break single-process init (reference behavior)
                 import warnings
@@ -49,6 +55,14 @@ class Fleet:
         if strategy is None:
             strategy = DistributedStrategy()
         self._user_defined_strategy = strategy
+        if role_maker is not None and getattr(role_maker, "is_server",
+                                              lambda: False)():
+            # a PSERVER process hosts tables only — building the device
+            # mesh would touch accelerators the server has no use for
+            # (and, through a flaky tunnel, can hang the whole server)
+            self._hcg = None
+            self._is_initialized = True
+            return self
         deg = strategy.degrees()
         topo = CommunicateTopology(
             ("data", "pipe", "sharding", "sep", "model"),
@@ -85,14 +99,18 @@ class Fleet:
     def barrier_worker(self):
         pass  # single controller: nothing to synchronize
 
-    # -- collective-mode facade of the PS-era worker/server API (the PS
-    # runtime itself is a recorded non-goal — SURVEY §7.2): workers are
-    # ranks, there are no servers.
+    # -- PS-era worker/server API. Collective mode: workers are ranks and
+    # there are no servers. PS mode (fleet.init(is_collective=False) with
+    # the TRAINING_ROLE env protocol): backed by the host-side table
+    # runtime in distributed/ps (reference fleet.py init_server/
+    # run_server/init_worker/stop_worker over the brpc PS).
     def is_worker(self) -> bool:
-        return True
+        rm = getattr(self, "_role_maker", None)
+        return rm.is_worker() if rm is not None else True
 
     def is_server(self) -> bool:
-        return False
+        rm = getattr(self, "_role_maker", None)
+        return rm.is_server() if rm is not None else False
 
     def worker_endpoints(self, to_string=False):
         rm = getattr(self, "_role_maker", None)
@@ -101,26 +119,58 @@ class Fleet:
         return ",".join(eps) if to_string else eps
 
     def server_num(self) -> int:
-        return 0
+        rm = getattr(self, "_role_maker", None)
+        return rm.server_num() if rm is not None and hasattr(
+            rm, "server_num") else 0
 
     def server_index(self) -> int:
-        return -1
+        rm = getattr(self, "_role_maker", None)
+        return rm.server_index() if rm is not None and hasattr(
+            rm, "server_index") else -1
 
     def server_endpoints(self, to_string=False):
-        return "" if to_string else []
+        rm = getattr(self, "_role_maker", None)
+        eps = (rm.server_endpoints() if rm is not None and hasattr(
+            rm, "server_endpoints") else [])
+        return ",".join(eps) if to_string else eps
 
     def init_worker(self, scopes=None):
-        pass
+        """PS mode: connect this trainer to the table servers."""
+        eps = self.server_endpoints()
+        if not eps:
+            return                       # collective mode: nothing to do
+        from ..ps import PSClient, set_client
+        set_client(PSClient(eps))
 
-    def init_server(self, *args, **kwargs):
-        raise RuntimeError(
-            "parameter-server mode is a recorded non-goal of the TPU "
-            "rebuild (SURVEY §7.2); collective mode has no servers")
+    def init_server(self, dirname=None, **kwargs):
+        """PS mode: build this process's table-shard server (reference
+        semantics: init_server(dirname) preloads saved tables; actual
+        serving starts in run_server)."""
+        if not self.is_server():
+            raise RuntimeError(
+                "init_server: this process is not a PSERVER (set "
+                "TRAINING_ROLE/PADDLE_PORT per the PS env protocol and "
+                "call fleet.init(is_collective=False))")
+        from ..ps import PSServer
+        ep = self._role_maker.get_current_endpoint()
+        port = int(ep.rsplit(":", 1)[1])
+        self._ps_server = PSServer(port=port, load_dir=dirname,
+                                   server_index=self.server_index())
 
-    run_server = init_server
+    def run_server(self):
+        """Blocking serve loop; returns after a worker sends shutdown."""
+        srv = getattr(self, "_ps_server", None)
+        if srv is None:
+            raise RuntimeError("call fleet.init_server() first")
+        srv.run()
 
     def stop_worker(self):
-        pass
+        """PS mode, reference semantics: the FIRST worker's stop_worker
+        shuts the servers down; everyone drops their client."""
+        from .. import ps
+        if ps._client is not None and self.is_first_worker():
+            ps._client.shutdown_servers()
+        ps.set_client(None)
 
     @property
     def util(self):

@@ -2,8 +2,9 @@
 role_maker.py): resolve this process's identity in the job from the
 PADDLE_* env protocol the launch CLI exports (see distributed/launch).
 
-The TPU build keeps only the collective roles — the parameter-server
-worker/server split is out of scope (SURVEY.md §7.2 non-goal).
+Collective roles are the default; ``is_collective=False`` resolves the
+parameter-server TRAINER/PSERVER split for the host-side PS runtime
+(paddle_tpu/distributed/ps).
 """
 
 from __future__ import annotations
@@ -56,6 +57,13 @@ class PaddleCloudRoleMaker(RoleMakerBase):
       PADDLE_TRAINERS_NUM        world size
       PADDLE_TRAINER_ENDPOINTS   comma-separated host:port of every rank
       PADDLE_CURRENT_ENDPOINT    this rank's endpoint
+
+    Parameter-server mode (``is_collective=False``; reference
+    role_maker._ps_env) adds:
+
+      TRAINING_ROLE                  TRAINER | PSERVER
+      PADDLE_PSERVERS_IP_PORT_LIST   comma-separated server host:port
+      POD_IP / PADDLE_PORT           this server's bind address
     """
 
     def __init__(self, is_collective: bool = True, **kwargs):
@@ -65,6 +73,34 @@ class PaddleCloudRoleMaker(RoleMakerBase):
         eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
         self._endpoints: List[str] = [e for e in eps.split(",") if e]
         self._current = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        self._role = Role.WORKER
+        self._server_endpoints: List[str] = []
+        if not is_collective:
+            # PS env is parsed ONLY in PS mode (reference _ps_env): a
+            # stale PADDLE_PSERVERS_IP_PORT_LIST must not give a
+            # collective job phantom servers
+            srv = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+            self._server_endpoints = [e for e in srv.split(",") if e]
+            training_role = os.environ.get("TRAINING_ROLE", "TRAINER")
+            if training_role not in ("TRAINER", "PSERVER"):
+                raise ValueError(
+                    f"TRAINING_ROLE={training_role!r}: expected TRAINER "
+                    "or PSERVER")
+            if training_role == "PSERVER":
+                if "PADDLE_PORT" not in os.environ:
+                    raise ValueError(
+                        "TRAINING_ROLE=PSERVER needs PADDLE_PORT (and "
+                        "POD_IP) to locate this server in "
+                        "PADDLE_PSERVERS_IP_PORT_LIST")
+                self._role = Role.SERVER
+                self._current = (
+                    os.environ.get("POD_IP", "127.0.0.1") + ":"
+                    + os.environ["PADDLE_PORT"])
+                if self._current not in self._server_endpoints:
+                    raise ValueError(
+                        f"this server {self._current} is not in "
+                        f"PADDLE_PSERVERS_IP_PORT_LIST="
+                        f"{self._server_endpoints}")
         if self._endpoints and len(self._endpoints) != self._size:
             raise ValueError(
                 f"PADDLE_TRAINER_ENDPOINTS has {len(self._endpoints)} "
@@ -85,6 +121,27 @@ class PaddleCloudRoleMaker(RoleMakerBase):
 
     def get_current_endpoint(self) -> str:
         return self._current
+
+    # ------------------------------------------------- PS-mode identity
+    def role(self):
+        return self._role
+
+    def is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def is_server(self) -> bool:
+        return self._role == Role.SERVER
+
+    def server_endpoints(self) -> List[str]:
+        return list(self._server_endpoints)
+
+    def server_num(self) -> int:
+        return len(self._server_endpoints)
+
+    def server_index(self) -> int:
+        if self._role != Role.SERVER:
+            return -1
+        return self._server_endpoints.index(self._current)
 
 
 class UserDefinedRoleMaker(RoleMakerBase):

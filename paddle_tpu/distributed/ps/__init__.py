@@ -1,0 +1,515 @@
+"""Parameter-server runtime — the async/sparse path for embedding-heavy
+(recommendation/search) workloads.
+
+Reference: paddle/fluid/distributed/ps/{service,table}/ (the brpc-based
+C++ PS: ``BrpcPsServer``, ``MemorySparseTable``, accessors) plus the
+Python surface ``fleet.init_server/run_server/init_worker/stop_worker``
+and the ``TRAINING_ROLE=PSERVER|TRAINER`` env protocol
+(python/paddle/distributed/fleet/base/role_maker.py).
+
+TPU-first redesign, not a port: the defining PS workload is embedding
+tables far larger than accelerator memory, touched sparsely and updated
+asynchronously. On a TPU pod the dense math belongs on chip under jit;
+the tables belong in HOST memory next to the input pipeline. So:
+
+* tables live in server processes as hash-sharded numpy rows
+  (``id % n_servers`` picks the shard, exactly the reference's default
+  sparse-table partitioner);
+* workers pull rows / push grads over the job's authenticated HTTP
+  control plane — the same ``X-Job-Token`` + endpoints protocol the
+  launcher's KV master and ``distributed.rpc`` already use (brpc has no
+  TPU-side value; the payloads here are numpy buffers, not protos);
+* the optimizer runs SERVER-side per row (async-SGD ``a_sync=True``
+  semantics: push applies immediately, no global barrier per step);
+* pulled rows enter the jitted dense path as ordinary arrays;
+  :class:`DistributedEmbedding` pushes row grads at backward time via
+  PyLayer, outside jit — host lookup stays off the compiled hot path.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "SparseTable", "DenseTable", "PSServer", "PSClient",
+    "DistributedEmbedding", "the_client", "set_client",
+]
+
+
+# ================================================================= tables
+def _make_rows(ids: np.ndarray, dim: int, init: str, scale: float,
+               seed: int) -> np.ndarray:
+    """Deterministic per-id init: every server (and any re-created shard)
+    materializes the same row for the same id — the reference gets this
+    from its accessor's per-feature init; here a per-id seeded RNG."""
+    out = np.empty((len(ids), dim), np.float32)
+    if init == "zeros":
+        out[:] = 0.0
+        return out
+    for j, i in enumerate(ids):
+        rng = np.random.default_rng([seed, int(i)])
+        out[j] = rng.uniform(-scale, scale, dim).astype(np.float32)
+    return out
+
+
+class SparseTable:
+    """Hash-map id -> f32 row, with the optimizer applied server-side on
+    push (reference: MemorySparseTable + sparse accessors; SGD/Adagrad/
+    Adam mirror the reference's naive/adagrad/adam sparse value names)."""
+
+    def __init__(self, dim: int, optimizer: str = "sgd", lr: float = 0.05,
+                 initializer: str = "uniform", init_scale: float = 0.01,
+                 seed: int = 0, beta1: float = 0.9, beta2: float = 0.999,
+                 eps: float = 1e-8):
+        if optimizer not in ("sgd", "adagrad", "adam"):
+            raise ValueError(f"unsupported sparse optimizer {optimizer!r}")
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.initializer = initializer
+        self.init_scale = float(init_scale)
+        self.seed = int(seed)
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        self._rows: Dict[int, np.ndarray] = {}
+        self._slots: Dict[int, list] = {}      # per-id optimizer state
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- access
+    def _ensure(self, ids: np.ndarray) -> None:
+        missing = np.array([i for i in ids if int(i) not in self._rows],
+                           np.int64)
+        if len(missing):
+            rows = _make_rows(missing, self.dim, self.initializer,
+                              self.init_scale, self.seed)
+            for j, i in enumerate(missing):
+                self._rows[int(i)] = rows[j]
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        with self._lock:
+            self._ensure(ids)
+            return np.stack([self._rows[int(i)] for i in ids]) \
+                if len(ids) else np.zeros((0, self.dim), np.float32)
+
+    def push(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        """Apply the per-row update. ids may repeat — duplicates are
+        summed first (one optimizer step per touched row, like the
+        reference's push_sparse merge)."""
+        if grads.shape != (len(ids), self.dim):
+            raise ValueError(f"push grads {grads.shape} != "
+                             f"({len(ids)}, {self.dim})")
+        uniq, inv = np.unique(np.asarray(ids, np.int64),
+                              return_inverse=True)
+        acc = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(acc, inv, grads.astype(np.float32))
+        with self._lock:
+            self._ensure(uniq)
+            for j, i in enumerate(uniq):
+                self._apply(int(i), acc[j])
+
+    def _apply(self, i: int, g: np.ndarray) -> None:
+        w = self._rows[i]
+        if self.optimizer == "sgd":
+            w -= self.lr * g
+        elif self.optimizer == "adagrad":
+            g2 = self._slots.setdefault(i, [np.zeros(self.dim,
+                                                     np.float32)])[0]
+            g2 += g * g
+            w -= self.lr * g / (np.sqrt(g2) + self.eps)
+        else:                                   # adam
+            m, v, t = self._slots.setdefault(
+                i, [np.zeros(self.dim, np.float32),
+                    np.zeros(self.dim, np.float32), 0])
+            t += 1
+            self._slots[i][2] = t
+            m *= self.beta1
+            m += (1 - self.beta1) * g
+            v *= self.beta2
+            v += (1 - self.beta2) * g * g
+            mh = m / (1 - self.beta1 ** t)
+            vh = v / (1 - self.beta2 ** t)
+            w -= self.lr * mh / (np.sqrt(vh) + self.eps)
+
+    # --------------------------------------------------------- save/load
+    def state(self) -> dict:
+        with self._lock:
+            ids = np.array(sorted(self._rows), np.int64)
+            rows = (np.stack([self._rows[int(i)] for i in ids])
+                    if len(ids) else np.zeros((0, self.dim), np.float32))
+            return {"ids": ids, "rows": rows}
+
+    def load_state(self, state: dict) -> None:
+        with self._lock:
+            self._rows = {int(i): np.array(r, np.float32)
+                          for i, r in zip(state["ids"], state["rows"])}
+            self._slots.clear()                 # slots restart (reference
+                                                # save formats drop them
+                                                # at base save level too)
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+
+class DenseTable:
+    """A replicated dense parameter hosted by one server (the reference
+    round-robins dense vars over servers; the client does the same)."""
+
+    def __init__(self, shape, lr: float = 0.05, init: str = "zeros",
+                 seed: int = 0):
+        self.lr = float(lr)
+        if init == "zeros":
+            self._w = np.zeros(shape, np.float32)
+        else:
+            rng = np.random.default_rng(seed)
+            self._w = rng.uniform(-0.01, 0.01, shape).astype(np.float32)
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self._w.copy()
+
+    def push(self, grad: np.ndarray) -> None:
+        with self._lock:
+            self._w -= self.lr * grad.astype(np.float32)
+
+    def state(self) -> dict:
+        with self._lock:
+            return {"w": self._w.copy()}
+
+    def load_state(self, state: dict) -> None:
+        with self._lock:
+            self._w = np.array(state["w"], np.float32)
+
+
+# ================================================================= server
+def _check_token(handler: BaseHTTPRequestHandler,
+                 token: Optional[str]) -> bool:
+    from ..launch.kv_master import check_job_token
+    return check_job_token(handler, token)
+
+
+class _PSHandler(BaseHTTPRequestHandler):
+    server_obj: "PSServer"
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        srv = self.server_obj
+        if not _check_token(self, srv.token):
+            return
+        n = int(self.headers.get("Content-Length", 0))
+        op, payload = pickle.loads(self.rfile.read(n))
+        try:
+            result = (True, srv.handle(op, payload))
+        except Exception as e:              # marshal to the caller
+            result = (False, e)
+        body = pickle.dumps(result)
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class PSServer:
+    """One table-shard server (reference BrpcPsServer). Tables are
+    created lazily and idempotently from client specs so servers need no
+    model code at all."""
+
+    def __init__(self, bind_ip: str = "0.0.0.0", port: int = 0,
+                 token: Optional[str] = None,
+                 load_dir: Optional[str] = None,
+                 server_index: int = 0):
+        self.token = (token if token is not None
+                      else os.environ.get("PADDLE_JOB_TOKEN"))
+        self.tables: Dict[int, Any] = {}
+        self.load_dir = load_dir            # lazy: applied per-table on
+        self.server_index = server_index    # create_table (tables exist
+        self._lock = threading.Lock()       # only once a client specs them)
+        handler = type("_H", (_PSHandler,), {})
+        self._httpd = ThreadingHTTPServer((bind_ip, port), handler)
+        handler.server_obj = self
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+        self._done = threading.Event()
+
+    # --------------------------------------------------------------- ops
+    def handle(self, op: str, p: dict):
+        if op == "create_table":
+            with self._lock:
+                if p["table_id"] not in self.tables:
+                    kind = p["kind"]
+                    kw = dict(p["spec"])
+                    t = (SparseTable(**kw) if kind == "sparse"
+                         else DenseTable(**kw))
+                    if self.load_dir:       # init_server(dirname) resume
+                        path = os.path.join(
+                            self.load_dir,
+                            f"shard_{self.server_index}.pkl")
+                        if os.path.exists(path):
+                            with open(path, "rb") as f:
+                                blob = pickle.load(f)
+                            state = blob.get(str(p["table_id"]))
+                            if state is not None:
+                                t.load_state(state)
+                    self.tables[p["table_id"]] = t
+            return None
+        if op == "shutdown":
+            self._done.set()
+            threading.Thread(target=self._httpd.shutdown,
+                             daemon=True).start()
+            return None
+        if op == "stats":
+            return {tid: (len(t) if isinstance(t, SparseTable) else 1)
+                    for tid, t in self.tables.items()}
+        if op == "save":
+            self._save(p["dirname"], p["server_index"])
+            return None
+        if op == "load":
+            self._load(p["dirname"], p["server_index"])
+            return None
+        t = self.tables[p["table_id"]]
+        if op == "pull_sparse":
+            return t.pull(p["ids"])
+        if op == "push_sparse":
+            return t.push(p["ids"], p["grads"])
+        if op == "pull_dense":
+            return t.pull()
+        if op == "push_dense":
+            return t.push(p["grad"])
+        raise ValueError(f"unknown PS op {op!r}")
+
+    def _save(self, dirname: str, idx: int) -> None:
+        os.makedirs(dirname, exist_ok=True)
+        blob = {str(tid): t.state() for tid, t in self.tables.items()}
+        with open(os.path.join(dirname, f"shard_{idx}.pkl"), "wb") as f:
+            pickle.dump(blob, f)
+
+    def _load(self, dirname: str, idx: int) -> None:
+        with open(os.path.join(dirname, f"shard_{idx}.pkl"), "rb") as f:
+            blob = pickle.load(f)
+        for tid, state in blob.items():
+            self.tables[int(tid)].load_state(state)
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def run(self) -> None:
+        """Blocking serve (fleet.run_server): returns after a client
+        sends ``shutdown``."""
+        self.start()
+        self._done.wait()
+        self._thread.join(timeout=10)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+
+# ================================================================= client
+class PSClient:
+    """Worker-side stub (reference BrpcPsClient): partitions sparse ids
+    by ``id % n_servers``, merges duplicate ids before the wire, fans
+    requests out over a thread pool, reassembles in input order."""
+
+    def __init__(self, server_endpoints: List[str],
+                 token: Optional[str] = None, timeout: float = 60.0):
+        if not server_endpoints:
+            raise ValueError("PSClient needs at least one server endpoint")
+        self.endpoints = list(server_endpoints)
+        self.token = (token if token is not None
+                      else os.environ.get("PADDLE_JOB_TOKEN"))
+        self.timeout = timeout
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(4, len(self.endpoints)))
+
+    # --------------------------------------------------------------- rpc
+    def _call(self, server: int, op: str, payload: dict):
+        req = urllib.request.Request(
+            f"http://{self.endpoints[server]}/", method="POST",
+            data=pickle.dumps((op, payload)))
+        if self.token:
+            req.add_header("X-Job-Token", self.token)
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            ok, result = pickle.loads(r.read())
+        if not ok:
+            raise result
+        return result
+
+    def _all(self, op: str, payload_fn) -> list:
+        futs = [self._pool.submit(self._call, s, op, payload_fn(s))
+                for s in range(len(self.endpoints))]
+        return [f.result() for f in futs]
+
+    # ------------------------------------------------------------- tables
+    def create_sparse_table(self, table_id: int, dim: int, **spec) -> None:
+        spec["dim"] = dim
+        self._all("create_table", lambda s: {
+            "table_id": table_id, "kind": "sparse", "spec": spec})
+
+    def create_dense_table(self, table_id: int, shape, **spec) -> None:
+        spec["shape"] = shape
+        self._call(table_id % len(self.endpoints), "create_table", {
+            "table_id": table_id, "kind": "dense", "spec": spec})
+
+    def pull_sparse(self, table_id: int, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).ravel()
+        uniq, inv = np.unique(ids, return_inverse=True)
+        n = len(self.endpoints)
+        shard = uniq % n
+        parts: Dict[int, np.ndarray] = {
+            s: uniq[shard == s] for s in range(n) if np.any(shard == s)}
+        futs = {s: self._pool.submit(self._call, s, "pull_sparse",
+                                     {"table_id": table_id, "ids": part})
+                for s, part in parts.items()}
+        dim = None
+        rows_by_id: Dict[int, np.ndarray] = {}
+        for s, part in parts.items():
+            rows = futs[s].result()
+            dim = rows.shape[1]
+            for j, i in enumerate(part):
+                rows_by_id[int(i)] = rows[j]
+        if dim is None:                        # empty pull
+            return np.zeros((0, 0), np.float32)
+        uniq_rows = np.stack([rows_by_id[int(i)] for i in uniq])
+        return uniq_rows[inv]
+
+    def push_sparse(self, table_id: int, ids: np.ndarray,
+                    grads: np.ndarray) -> None:
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        acc = np.zeros((len(uniq), grads.shape[1]), np.float32)
+        np.add.at(acc, inv, grads)
+        n = len(self.endpoints)
+        shard = uniq % n
+        futs = []
+        for s in range(n):
+            m = shard == s
+            if np.any(m):
+                futs.append(self._pool.submit(
+                    self._call, s, "push_sparse",
+                    {"table_id": table_id, "ids": uniq[m],
+                     "grads": acc[m]}))
+        for f in futs:
+            f.result()
+
+    def pull_dense(self, table_id: int) -> np.ndarray:
+        return self._call(table_id % len(self.endpoints), "pull_dense",
+                          {"table_id": table_id})
+
+    def push_dense(self, table_id: int, grad: np.ndarray) -> None:
+        self._call(table_id % len(self.endpoints), "push_dense",
+                   {"table_id": table_id, "grad": np.asarray(grad)})
+
+    # ---------------------------------------------------------- lifecycle
+    def save(self, dirname: str) -> None:
+        self._all("save", lambda s: {"dirname": dirname,
+                                     "server_index": s})
+
+    def load(self, dirname: str) -> None:
+        self._all("load", lambda s: {"dirname": dirname,
+                                     "server_index": s})
+
+    def stats(self) -> list:
+        return self._all("stats", lambda s: {})
+
+    def shutdown_servers(self) -> None:
+        for s in range(len(self.endpoints)):
+            try:
+                self._call(s, "shutdown", {})
+            except OSError:
+                pass                           # already gone
+
+
+# ===================================================== module-level client
+_client: Optional[PSClient] = None
+_next_table_id = [0]
+
+
+def set_client(client: Optional[PSClient]) -> None:
+    global _client
+    _client = client
+
+
+def the_client() -> PSClient:
+    if _client is None:
+        raise RuntimeError(
+            "no PS client: call fleet.init with TRAINING_ROLE=TRAINER + "
+            "PADDLE_PSERVERS_IP_PORT_LIST set, then fleet.init_worker()")
+    return _client
+
+
+def _auto_table_id() -> int:
+    _next_table_id[0] += 1
+    return 1000 + _next_table_id[0]
+
+
+# ========================================================== user surface
+class DistributedEmbedding:
+    """Embedding whose table lives on the parameter servers (reference:
+    ``paddle.static.nn.sparse_embedding`` over a distributed lookup
+    table). Forward pulls rows on host and enters the (possibly jitted
+    downstream) dense path; backward pushes row grads — the server
+    applies its own optimizer, so the worker optimizer never sees the
+    table. Instantiate AFTER fleet.init_worker().
+    """
+
+    def __new__(cls, *args, **kwargs):          # defer heavy imports
+        import paddle_tpu  # noqa: F401
+        return super().__new__(cls)
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 table_id: Optional[int] = None, client: Optional[PSClient]
+                 = None, optimizer: str = "sgd", lr: float = 0.05,
+                 initializer: str = "uniform", init_scale: float = 0.01,
+                 seed: int = 0):
+        from paddle_tpu.autograd import PyLayer
+        import paddle_tpu as paddle
+
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.table_id = _auto_table_id() if table_id is None else table_id
+        self._client = client or the_client()
+        self._client.create_sparse_table(
+            self.table_id, embedding_dim, optimizer=optimizer, lr=lr,
+            initializer=initializer, init_scale=init_scale, seed=seed)
+        # PyLayer only records a node when a differentiable input flows
+        # in; ids are ints, so a zero anchor rides along (and backward
+        # returns a zero grad for it)
+        self._anchor = paddle.to_tensor(
+            np.zeros((1,), np.float32), stop_gradient=False)
+        client_ref, table_id_ref, dim = (self._client, self.table_id,
+                                         embedding_dim)
+
+        class _Lookup(PyLayer):
+            @staticmethod
+            def forward(ctx, anchor, ids_np):
+                rows = client_ref.pull_sparse(table_id_ref, ids_np)
+                ctx.ids_np = ids_np
+                out = rows.reshape(ids_np.shape + (dim,))
+                return paddle.to_tensor(out) + anchor * 0.0
+
+            @staticmethod
+            def backward(ctx, grad_out):
+                g = grad_out.numpy().reshape(-1, dim)
+                client_ref.push_sparse(table_id_ref, ctx.ids_np, g)
+                return paddle.to_tensor(np.zeros((1,), np.float32))
+
+        self._lookup = _Lookup
+
+    def __call__(self, ids):
+        ids_np = np.asarray(
+            ids.numpy() if hasattr(ids, "numpy") else ids, np.int64)
+        return self._lookup.apply(self._anchor, ids_np)
