@@ -197,6 +197,10 @@ def step_train_decode() -> list:
     env = dict(os.environ)
     env["BENCH_TIMEOUT"] = env.get("BENCH_TIMEOUT", "3000")
     env["BENCH_PROBE_BUDGET"] = "60"
+    # windows flap: bank the 345M MFU + decode number first and leave
+    # the SD UNet to its own later step (r05: a wedge cost ~50 min of a
+    # live window; never put two compiles between us and an artifact)
+    env["BENCH_SD"] = "0"
     r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
                        env=env, capture_output=True, text=True, timeout=3300)
     lines = []
@@ -212,11 +216,26 @@ def step_train_decode() -> list:
     return [lines[-1]]
 
 
+def step_sd() -> list:
+    """SD-1.5 UNet train-step bench (BASELINE configs[4]) on the ambient
+    backend, split out of the train step so the flagship MFU artifact
+    never waits behind a second large compile."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.flags import is_tpu_backend
+
+    rec = bench_mod._sd_unet_bench(paddle, jax, is_tpu_backend())
+    rec["backend"] = jax.default_backend()
+    return [rec]
+
+
 STEPS = {
     "kernels": (f"KERNEL_COMPILE_{ROUND}.json", step_kernels, 2400),
-    "attn": (f"ATTN_BENCH_{ROUND}.json", None, 3600),      # tools/attn_bench
+    "attn": (f"ATTN_BENCH_{ROUND}.json", None, 1800),      # tools/attn_bench
     "rmsnorm": (f"RMSNORM_BENCH_{ROUND}.json", None, 1800),
     "train": (f"BENCH_tpu_{ROUND}.json", step_train_decode, 3600),
+    "sd": (f"SD_BENCH_{ROUND}.json", step_sd, 2400),
 }
 _TOOL_SCRIPTS = {"attn": "attn_bench.py", "rmsnorm": "rmsnorm_bench.py"}
 
@@ -361,7 +380,7 @@ def main() -> int:
     # existence proof: windows are perishable and the microbenches are
     # the cheapest thing to lose (r05: the attn step wedged a live
     # window for its full timeout with train still unbanked behind it)
-    order = ["kernels", "train", "attn", "rmsnorm"]
+    order = ["kernels", "train", "attn", "rmsnorm", "sd"]
     if test_mode:
         order = ["kernels"]  # plumbing validation; benches are TPU-priced
     ok = True

@@ -76,7 +76,8 @@ def probe() -> str:
 
 ROUND = os.environ.get("CHIP_SPRINT_ROUND", "r05")
 ARTIFACTS = [f"KERNEL_COMPILE_{ROUND}.json", f"ATTN_BENCH_{ROUND}.json",
-             f"RMSNORM_BENCH_{ROUND}.json", f"BENCH_tpu_{ROUND}.json"]
+             f"RMSNORM_BENCH_{ROUND}.json", f"BENCH_tpu_{ROUND}.json",
+             f"SD_BENCH_{ROUND}.json"]
 
 
 def run_sprint() -> None:
@@ -97,8 +98,19 @@ def main() -> None:
     deadline = time.time() + float(os.environ.get("TPU_WATCH_HOURS", "11")) * 3600
     interval = 120.0
     while time.time() < deadline:
-        todo = [p for p in ARTIFACTS
-                if not os.path.exists(os.path.join(REPO, p))]
+        todo = []
+        for p in ARTIFACTS:
+            path = os.path.join(REPO, p)
+            if not os.path.exists(path):
+                todo.append(p)
+                continue
+            try:                      # an artifact with failed checks is
+                import json           # not banked — the sprint re-runs it
+                with open(path) as f:
+                    if json.load(f).get("n_failed_checks", 0):
+                        todo.append(p)
+            except (OSError, ValueError):
+                todo.append(p)
         if not todo:
             log("all artifacts banked — exiting")
             return
