@@ -35,6 +35,19 @@ def cache_env(env: dict) -> dict:
     return env
 
 
+def artifact_banked(path: str) -> bool:
+    """Single definition of 'banked' shared by chip_sprint (skip/re-run
+    decision) and tpu_watch (exit decision) so they can't diverge: the
+    artifact exists, parses, and recorded zero failed checks."""
+    if not os.path.exists(path):
+        return False
+    try:
+        with open(path) as f:
+            return json.load(f).get("n_failed_checks", 0) == 0
+    except (OSError, ValueError):
+        return False
+
+
 def _tpu_expected(env: dict) -> bool:
     """Whether this machine should have a TPU (the axon tunnel plugin is
     configured). Decides if a clean CPU-backend probe means 'no chip here'

@@ -142,6 +142,15 @@ define_flag("flash_compact_stats", False,
             "until tools/chip_sprint.py validates the Mosaic layouts "
             "compile on a real chip; numerics are parity-tested in "
             "interpret mode either way.")
+define_flag("flash_block_q", 128,
+            "Flash-attention q rows per pallas grid step. 128 matches "
+            "the v5e MXU/VPU tile; tools/attn_bench.py sweeps a (bq, bk) "
+            "grid on-chip and banks the winner in ATTN_BENCH_r*.json — "
+            "set FLAGS_flash_block_q/_k (or pass block_q/block_k) to "
+            "apply a banked tuning without a code change.")
+define_flag("flash_block_k", 128,
+            "Flash-attention kv columns per pallas grid step (see "
+            "flash_block_q).")
 define_flag("allocator_strategy", "auto_growth", "Kept for API parity; PJRT owns memory on TPU.")
 define_flag("fraction_of_gpu_memory_to_use", 0.92, "API parity; PJRT owns memory on TPU.")
 define_flag("log_level", 1, "Framework log verbosity (GLOG_v analogue).")

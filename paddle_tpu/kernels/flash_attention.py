@@ -43,8 +43,32 @@ try:  # pltpu registers TPU lowerings — unavailable on CPU-only test envs
 except Exception:  # pragma: no cover - CPU CI path (interpret mode)
     pltpu = None
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+def _blocks(block_q, block_k):
+    """None -> the FLAGS_flash_block_{q,k} tuning (env-overridable, so a
+    banked on-chip sweep from tools/attn_bench.py applies without a code
+    change). The single source of the 128 default is the flag registry."""
+    from ..flags import get_flag
+    if block_q is None:
+        block_q = int(get_flag("flash_block_q"))
+    if block_k is None:
+        block_k = int(get_flag("flash_block_k"))
+    return block_q, block_k
+
+
+def _snap(block: int, n: int) -> int:
+    """Largest usable block for a length-n axis: block itself when it
+    divides n, else the largest multiple-of-128 divisor of n that is
+    < block. Returns 0 when none exists (caller raises). Keeps a
+    flag-tuned block (swept at one shape) from silently demoting other
+    shapes to the dense path: seq 1664 with FLAGS_flash_block_k=512
+    snaps to 128 instead of losing the kernel."""
+    block = min(block, n)
+    if n % block == 0:
+        return block
+    for cand in range(block - block % 128, 0, -128):
+        if n % cand == 0:
+            return cand
+    return 0
 _NEG_INF = -1e30
 _LANES = 128  # stat rows replicate across one lane tile inside kernels
 
@@ -227,12 +251,13 @@ def _fwd_setup(q, k, block_q, block_k, h, hkv):
     UNEXPANDED kv at Hkv bandwidth."""
     bh, sq, d = q.shape
     skv = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, skv)
-    if sq % block_q or skv % block_k:
+    block_q = _snap(block_q, sq)
+    block_k = _snap(block_k, skv)
+    if not block_q or not block_k:
         raise NotImplementedError(
-            f"flash_attention needs seq lens ({sq}, {skv}) divisible by "
-            f"blocks ({block_q}, {block_k}); pad or use the dense path")
+            f"flash_attention needs seq lens ({sq}, {skv}) with a "
+            f"multiple-of-128 divisor <= the block sizes; pad or use "
+            f"the dense path")
     n_k = skv // block_k
     grid = (bh, sq // block_q, n_k)
     rep = h // hkv
@@ -465,8 +490,10 @@ def _bwd_impl(causal, sm_scale, block_q, block_k, h, hkv, compact, res,
         return ((b // h) * hkv + (b % h) // rep, j, 0)
     bh, sq, d = q.shape
     skv = k.shape[1]
-    bq = min(block_q, sq)
-    bk = min(block_k, skv)
+    # same snap as the forward (whose guard already rejected impossible
+    # shapes) so fwd and bwd tile identically under flag-tuned blocks
+    bq = _snap(block_q, sq)
+    bk = _snap(block_k, skv)
 
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1)                               # (bh, sq)
@@ -615,8 +642,8 @@ _flash_attention_lse.defvjp(_flash_lse_fwd_rule, _bwd_with_lse)
 
 def flash_attention_with_lse(q, k, v, causal: bool = True,
                              sm_scale: Optional[float] = None,
-                             block_q: int = DEFAULT_BLOCK_Q,
-                             block_k: int = DEFAULT_BLOCK_K,
+                             block_q: Optional[int] = None,
+                             block_k: Optional[int] = None,
                              n_heads: int = 1,
                              n_kv_heads: Optional[int] = None):
     """(BH, S, D) flash attention returning ``(out, lse)`` — the mergeable
@@ -626,6 +653,7 @@ def flash_attention_with_lse(q, k, v, causal: bool = True,
     ``flash_attention``."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    block_q, block_k = _blocks(block_q, block_k)
     if n_kv_heads is None:
         n_kv_heads = n_heads
     if n_heads % n_kv_heads:
@@ -644,8 +672,8 @@ def flash_attention_with_lse(q, k, v, causal: bool = True,
 def flash_attention(q, k, v, segment_ids: Optional[jax.Array] = None,
                     kv_segment_ids: Optional[jax.Array] = None,
                     causal: bool = True, sm_scale: Optional[float] = None,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     n_heads: int = 1, n_kv_heads: Optional[int] = None):
     """(BH, S, D)-layout flash attention. segment_ids: (BH, S) int32 — rows
     attend only within their segment (varlen batches packed statically).
@@ -654,6 +682,7 @@ def flash_attention(q, k, v, segment_ids: Optional[jax.Array] = None,
     accumulate dk/dv over each group's query heads."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    block_q, block_k = _blocks(block_q, block_k)
     if n_kv_heads is None:
         n_kv_heads = n_heads
     if n_heads % n_kv_heads:
@@ -680,8 +709,8 @@ def flash_attention(q, k, v, segment_ids: Optional[jax.Array] = None,
 def flash_attention_bshd(q, k, v, segment_ids=None, kv_segment_ids=None,
                          causal: bool = True,
                          sm_scale: Optional[float] = None,
-                         block_q: int = DEFAULT_BLOCK_Q,
-                         block_k: int = DEFAULT_BLOCK_K):
+                         block_q: Optional[int] = None,
+                         block_k: Optional[int] = None):
     """Paddle-convention (B, S, H, D) wrapper (reference:
     python/paddle/nn/functional/flash_attention.py uses [batch, seq, heads,
     dim]). ``segment_ids``: (B, S_q); ``kv_segment_ids``: (B, S_kv),

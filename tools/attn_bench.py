@@ -35,12 +35,15 @@ def dense_gqa_bshd(q, k, v):
                       jnp.repeat(v, rep, axis=2))
 
 rng = np.random.default_rng(0)
+tf_4096 = None
 for s in (1024, 2048, 4096, 8192):
     b = max(1, 8192 // s)
     h, d = 16, 64
     q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
                for _ in range(3))
     tf = bench(functools.partial(flash_attention_bshd, causal=True), q, k, v)
+    if s == 4096:
+        tf_4096 = tf
     rec = {"seq": s, "batch": b, "flash_ms": round(tf*1e3, 2),
            "backend": jax.default_backend()}
     if s <= 4096:
@@ -49,6 +52,35 @@ for s in (1024, 2048, 4096, 8192):
         td = bench(dense_bshd, q, k, v)
         rec.update(dense_ms=round(td*1e3, 2), speedup=round(td/tf, 2))
     print(json.dumps(rec), flush=True)
+
+# Block-size sweep at the north-star shape (seq 4096): the winner is
+# banked in the artifact; apply it with FLAGS_flash_block_q/_k (the
+# kernel reads the flags when block sizes aren't passed explicitly)
+s, b, h, d = 4096, 2, 16, 64
+q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+           for _ in range(3))
+# (128, 128) at this exact shape was already measured by the main loop —
+# seed the sweep with it instead of recompiling it
+best = (tf_4096, 128, 128) if tf_4096 is not None else None
+for bq, bk in ((128, 256), (256, 128), (256, 256),
+               (128, 512), (512, 128), (512, 512)):
+    try:
+        t = bench(functools.partial(flash_attention_bshd, causal=True,
+                                    block_q=bq, block_k=bk), q, k, v)
+    except Exception as e:                 # a combo may not fit VMEM
+        print(json.dumps({"sweep_block_q": bq, "sweep_block_k": bk,
+                          "error": repr(e)[:160],
+                          "backend": jax.default_backend()}), flush=True)
+        continue
+    print(json.dumps({"sweep_block_q": bq, "sweep_block_k": bk,
+                      "seq": s, "flash_ms": round(t*1e3, 2),
+                      "backend": jax.default_backend()}), flush=True)
+    if best is None or t < best[0]:
+        best = (t, bq, bk)
+if best is not None:
+    print(json.dumps({"best_block_q": best[1], "best_block_k": best[2],
+                      "flash_ms": round(best[0]*1e3, 2), "seq": s,
+                      "backend": jax.default_backend()}), flush=True)
 
 # GQA (the 70B north-star layout: rep=8): unexpanded-kv kernel vs
 # repeat_interleave + dense

@@ -232,7 +232,7 @@ def step_sd() -> list:
 
 STEPS = {
     "kernels": (f"KERNEL_COMPILE_{ROUND}.json", step_kernels, 2400),
-    "attn": (f"ATTN_BENCH_{ROUND}.json", None, 1800),      # tools/attn_bench
+    "attn": (f"ATTN_BENCH_{ROUND}.json", None, 2700),      # tools/attn_bench
     "rmsnorm": (f"RMSNORM_BENCH_{ROUND}.json", None, 1800),
     "train": (f"BENCH_tpu_{ROUND}.json", step_train_decode, 3600),
     "sd": (f"SD_BENCH_{ROUND}.json", step_sd, 2400),
@@ -272,22 +272,15 @@ def run_step(step: str, test_mode: bool) -> bool:
     if os.path.exists(path):
         if test_mode:  # validation must never pass on a stale artifact
             os.remove(path)
+        elif bench_mod.artifact_banked(path):
+            log(f"{artifact} already banked — skipping")
+            return True
         else:
-            try:
-                with open(path) as f:
-                    prev_failed = json.load(f).get("n_failed_checks", 0)
-            except (OSError, ValueError):
-                prev_failed = 1
-            if prev_failed:
-                # per-check failures may be a window flap, not a real
-                # kernel bug — re-run; a persistent failure re-banks the
-                # same evidence, a flap artifact gets replaced
-                log(f"{artifact} has {prev_failed} failed checks — "
-                    "re-running")
-                os.remove(path)
-            else:
-                log(f"{artifact} already banked — skipping")
-                return True
+            # per-check failures may be a window flap, not a real kernel
+            # bug — re-run. The old artifact stays on disk until the
+            # re-run SUCCEEDS (overwrite-on-success): a window dying
+            # mid-re-run must not erase banked evidence
+            log(f"{artifact} has failed checks — re-running")
     if step in _TOOL_SCRIPTS:
         argv = [sys.executable,
                 os.path.join(REPO, "tools", _TOOL_SCRIPTS[step])]
