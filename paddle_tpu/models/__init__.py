@@ -2,9 +2,15 @@
 
 The reference keeps models out-of-tree (PaddleNLP/PaddleFleetX); this package
 ships the exemplars the north-star metric is measured on (BASELINE.json):
-GPT-3 345M, Llama-2 7B/70B, an ERNIE-style MoE, and an SD UNet.
+GPT-3 345M, Llama-2 7B/70B, an ERNIE-style MoE, and an SD UNet — plus
+the BERT/ERNIE encoder family (MLM/NSP pretraining + classification).
 """
 
+from .bert import (  # noqa: F401
+    BertConfig, BertForMaskedLM, BertForPretraining,
+    BertForSequenceClassification, BertModel, BertPretrainingCriterion,
+    ErnieModel,
+)
 from .gpt import (  # noqa: F401
     GPTConfig, GPTForCausalLM, GPTForCausalLMPipe, GPTModel,
     GPTPretrainingCriterion,

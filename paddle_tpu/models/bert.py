@@ -1,0 +1,287 @@
+"""BERT / ERNIE encoder family (reference model zoo:
+paddlenlp/transformers/{bert,ernie}/modeling.py — the ecosystem's
+flagship encoder models; architecture per Devlin et al. / ERNIE 1.0,
+which shares the BERT encoder and differs in pretraining data/masking
+and ``type_vocab_size``).
+
+Built from paddle_tpu.nn layers exactly like the GPT exemplar: the same
+definition runs eagerly, under jit, and under the fleet wrappers. All
+attention is bidirectional over a padding mask; pretraining losses use
+ignore_index=-100 semantics so masked-LM labels need no separate weight
+tensor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+from .. import ops
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer, LayerList
+from ..nn.layers.common import Dropout, Embedding, LayerNorm, Linear
+from ..nn.param_attr import ParamAttr
+
+__all__ = [
+    "BertConfig", "BertModel", "BertForPretraining", "BertForMaskedLM",
+    "BertForSequenceClassification", "BertPretrainingCriterion",
+    "ErnieModel",
+]
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: Optional[int] = None
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    layer_norm_epsilon: float = 1e-12
+    pad_token_id: int = 0
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @staticmethod
+    def bert_base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def ernie_base() -> "BertConfig":
+        # ERNIE 1.0 zh: same encoder, 18000-word vocab
+        return BertConfig(vocab_size=18000)
+
+    @staticmethod
+    def tiny() -> "BertConfig":
+        return BertConfig(vocab_size=512, hidden_size=64,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          max_position_embeddings=128,
+                          hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0)
+
+
+class BertEmbeddings(Layer):
+    """word + position + token_type embeddings -> LN -> dropout."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = I.Normal(0.0, config.initializer_range)
+        attr = ParamAttr(initializer=init)
+        self.word_embeddings = Embedding(config.vocab_size,
+                                         config.hidden_size,
+                                         weight_attr=attr)
+        self.position_embeddings = Embedding(
+            config.max_position_embeddings, config.hidden_size,
+            weight_attr=attr)
+        self.token_type_embeddings = Embedding(
+            config.type_vocab_size, config.hidden_size, weight_attr=attr)
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_epsilon)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = ops.arange(s, dtype="int64").unsqueeze(0)
+        if token_type_ids is None:
+            token_type_ids = ops.zeros([b, s], dtype="int64")
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        attr = ParamAttr(initializer=I.Normal(0.0,
+                                              config.initializer_range))
+        self.qkv_proj = Linear(h, 3 * h, weight_attr=attr)
+        self.out_proj = Linear(h, h, weight_attr=attr)
+        self.attn_drop_p = config.attention_probs_dropout_prob
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x).reshape(
+            [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = ops.unbind(qkv, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=False,
+            dropout_p=self.attn_drop_p if self.training else 0.0,
+            training=self.training)
+        return self.out_proj(out.reshape([b, s, h]))
+
+
+class BertLayer(Layer):
+    """Post-LN transformer block (the original BERT residual order:
+    LN(x + sublayer(x)), vs GPT's pre-LN)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        attr = ParamAttr(initializer=I.Normal(0.0,
+                                              config.initializer_range))
+        self.attention = BertSelfAttention(config)
+        self.ln_1 = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        self.fc_in = Linear(config.hidden_size, config.intermediate_size,
+                            weight_attr=attr)
+        self.fc_out = Linear(config.intermediate_size, config.hidden_size,
+                             weight_attr=attr)
+        self.ln_2 = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, attn_mask=None):
+        x = self.ln_1(x + self.dropout(self.attention(x, attn_mask)))
+        mlp = self.fc_out(F.gelu(self.fc_in(x), approximate=True))
+        return self.ln_2(x + self.dropout(mlp))
+
+
+class BertPooler(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = Linear(
+            config.hidden_size, config.hidden_size,
+            weight_attr=ParamAttr(initializer=I.Normal(
+                0.0, config.initializer_range)))
+
+    def forward(self, hidden):
+        return ops.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    """Returns ``(sequence_output, pooled_output)`` like the reference
+    BertModel. ``attention_mask``: (B, S) with 1 = real token, 0 = pad
+    (the reference convention); converted to an additive (B, 1, 1, S)
+    key mask broadcast over heads and query positions."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = LayerList(
+            [BertLayer(config) for _ in range(config.num_hidden_layers)])
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                position_ids=None):
+        if attention_mask is None:
+            attention_mask = (input_ids !=
+                              self.config.pad_token_id).astype("int64")
+        add_mask = ((1.0 - attention_mask.astype("float32"))
+                    * -1e30).unsqueeze(1).unsqueeze(1)    # (B, 1, 1, S)
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.encoder:
+            x = layer(x, add_mask)
+        return x, self.pooler(x)
+
+
+class BertLMPredictionHead(Layer):
+    """MLM transform + decoder tied to the word embedding matrix."""
+
+    def __init__(self, config: BertConfig, embedding_weights):
+        super().__init__()
+        self.transform = Linear(
+            config.hidden_size, config.hidden_size,
+            weight_attr=ParamAttr(initializer=I.Normal(
+                0.0, config.initializer_range)))
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_epsilon)
+        self.decoder_weight = embedding_weights          # tied, (V, H)
+        self.decoder_bias = self.create_parameter(
+            [config.vocab_size], is_bias=True)
+
+    def forward(self, hidden):
+        h = self.layer_norm(F.gelu(self.transform(hidden),
+                                   approximate=True))
+        return ops.matmul(h, self.decoder_weight,
+                          transpose_y=True) + self.decoder_bias
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads (reference BertForPretraining)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.cls = BertLMPredictionHead(
+            config, self.bert.embeddings.word_embeddings.weight)
+        self.nsp = Linear(config.hidden_size, 2,
+                          weight_attr=ParamAttr(initializer=I.Normal(
+                              0.0, config.initializer_range)))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.cls(seq), self.nsp(pooled)
+
+
+class BertPretrainingCriterion(Layer):
+    """MLM CE over labeled positions (label -100 = unlabeled) + NSP CE."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.vocab_size = config.vocab_size
+
+    def forward(self, prediction_logits, nsp_logits, masked_lm_labels,
+                next_sentence_labels=None):
+        mlm = F.cross_entropy(
+            prediction_logits.reshape([-1, self.vocab_size]),
+            masked_lm_labels.reshape([-1]), ignore_index=-100,
+            reduction="mean")
+        if next_sentence_labels is None:
+            return mlm
+        nsp = F.cross_entropy(nsp_logits,
+                              next_sentence_labels.reshape([-1]),
+                              reduction="mean")
+        return mlm + nsp
+
+
+class BertForMaskedLM(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.cls = BertLMPredictionHead(
+            config, self.bert.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.cls(seq)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            logits.reshape([-1, self.bert.config.vocab_size]),
+            labels.reshape([-1]), ignore_index=-100, reduction="mean")
+        return logits, loss
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(
+            config.hidden_size, num_classes,
+            weight_attr=ParamAttr(initializer=I.Normal(
+                0.0, config.initializer_range)))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+# ERNIE 1.0 shares the BERT encoder exactly (the pretraining objectives
+# differ, not the module graph) — the reference exposes it as its own
+# class; alias it so ecosystem code reads naturally.
+ErnieModel = BertModel

@@ -1,0 +1,137 @@
+"""BERT/ERNIE encoder family (models/bert.py; reference:
+paddlenlp/transformers/bert/modeling.py)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import (
+    BertConfig, BertForMaskedLM, BertForPretraining,
+    BertForSequenceClassification, BertModel, BertPretrainingCriterion,
+    ErnieModel,
+)
+
+
+def ids(rng, b, s, v):
+    return paddle.to_tensor(rng.integers(1, v, (b, s)).astype(np.int64))
+
+
+class TestBertModel:
+    def test_shapes_and_pooler(self):
+        cfg = BertConfig.tiny()
+        paddle.seed(0)
+        model = BertModel(cfg)
+        rng = np.random.default_rng(0)
+        x = ids(rng, 2, 16, cfg.vocab_size)
+        seq, pooled = model(x)
+        assert seq.shape == [2, 16, cfg.hidden_size]
+        assert pooled.shape == [2, cfg.hidden_size]
+
+    def test_padding_mask_blocks_pad_keys(self):
+        """Changing a PADDED position's token id must not change real
+        positions' outputs (the additive key mask removes pad keys)."""
+        cfg = BertConfig.tiny()
+        paddle.seed(0)
+        model = BertModel(cfg)
+        model.eval()
+        rng = np.random.default_rng(1)
+        a = rng.integers(1, cfg.vocab_size, (1, 8)).astype(np.int64)
+        b = a.copy()
+        b[0, -2:] = 7                       # different junk in pad slots
+        mask = np.ones((1, 8), np.int64)
+        mask[0, -2:] = 0
+        sa, _ = model(paddle.to_tensor(a), attention_mask=paddle.to_tensor(mask))
+        sb, _ = model(paddle.to_tensor(b), attention_mask=paddle.to_tensor(mask))
+        np.testing.assert_allclose(sa.numpy()[:, :6], sb.numpy()[:, :6],
+                                   atol=1e-5)
+        # and WITHOUT the mask the junk does leak (sanity of the sanity)
+        sa2, _ = model(paddle.to_tensor(a))
+        sb2, _ = model(paddle.to_tensor(b))
+        assert np.abs(sa2.numpy()[:, :6] - sb2.numpy()[:, :6]).max() > 1e-4
+
+    def test_token_type_changes_output(self):
+        cfg = BertConfig.tiny()
+        paddle.seed(0)
+        model = BertModel(cfg)
+        model.eval()
+        rng = np.random.default_rng(2)
+        x = ids(rng, 1, 8, cfg.vocab_size)
+        tt = paddle.to_tensor(np.array([[0, 0, 0, 0, 1, 1, 1, 1]],
+                                       np.int64))
+        s0, _ = model(x)
+        s1, _ = model(x, token_type_ids=tt)
+        assert np.abs(s0.numpy() - s1.numpy()).max() > 1e-4
+
+    def test_ernie_alias(self):
+        assert ErnieModel is BertModel
+        assert BertConfig.ernie_base().vocab_size == 18000
+
+
+class TestBertHeads:
+    def test_mlm_head_tied_to_embeddings(self):
+        cfg = BertConfig.tiny()
+        paddle.seed(0)
+        model = BertForMaskedLM(cfg)
+        assert (model.cls.decoder_weight is
+                model.bert.embeddings.word_embeddings.weight)
+        rng = np.random.default_rng(3)
+        x = ids(rng, 2, 8, cfg.vocab_size)
+        logits = model(x)
+        assert logits.shape == [2, 8, cfg.vocab_size]
+
+    def test_pretraining_overfits_tiny_batch(self):
+        cfg = BertConfig.tiny()
+        paddle.seed(0)
+        model = BertForPretraining(cfg)
+        crit = BertPretrainingCriterion(cfg)
+        opt = paddle.optimizer.AdamW(5e-3,
+                                     parameters=model.parameters())
+        rng = np.random.default_rng(4)
+        x = rng.integers(1, cfg.vocab_size, (2, 12)).astype(np.int64)
+        labels = np.full((2, 12), -100, np.int64)
+        labels[:, 3] = x[:, 3]              # two masked positions
+        labels[:, 7] = x[:, 7]
+        inp = x.copy()
+        inp[:, 3] = 0                       # [MASK]-ish
+        inp[:, 7] = 0
+        nsp_y = paddle.to_tensor(np.array([0, 1], np.int64))
+        losses = []
+        for _ in range(15):
+            pred, nsp = model(paddle.to_tensor(inp))
+            loss = crit(pred, nsp, paddle.to_tensor(labels), nsp_y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, losses
+
+    def test_mlm_ignore_index_masks_loss(self):
+        cfg = BertConfig.tiny()
+        paddle.seed(0)
+        model = BertForMaskedLM(cfg)
+        rng = np.random.default_rng(5)
+        x = ids(rng, 1, 8, cfg.vocab_size)
+        all_ignored = paddle.to_tensor(np.full((1, 8), -100, np.int64))
+        _, loss = model(x, labels=all_ignored)
+        assert float(loss) == 0.0           # no labeled positions
+
+    def test_sequence_classification(self):
+        cfg = BertConfig.tiny()
+        paddle.seed(0)
+        model = BertForSequenceClassification(cfg, num_classes=3)
+        rng = np.random.default_rng(6)
+        logits = model(ids(rng, 4, 8, cfg.vocab_size))
+        assert logits.shape == [4, 3]
+
+    def test_state_dict_roundtrip(self):
+        cfg = BertConfig.tiny()
+        paddle.seed(0)
+        m1 = BertForPretraining(cfg)
+        paddle.seed(1)
+        m2 = BertForPretraining(cfg)
+        m2.set_state_dict(m1.state_dict())
+        rng = np.random.default_rng(7)
+        x = ids(rng, 1, 8, cfg.vocab_size)
+        m1.eval(), m2.eval()
+        p1, _ = m1(x)
+        p2, _ = m2(x)
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), atol=1e-6)

@@ -59,11 +59,16 @@ for s in (1024, 2048, 4096, 8192):
 s, b, h, d = 4096, 2, 16, 64
 q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
            for _ in range(3))
-# (128, 128) at this exact shape was already measured by the main loop —
-# seed the sweep with it instead of recompiling it
-best = (tf_4096, 128, 128) if tf_4096 is not None else None
-for bq, bk in ((128, 256), (256, 128), (256, 256),
+# the main loop's s=4096 record used the FLAG-resolved blocks (not
+# necessarily 128/128 if a tuning is already applied) — seed the sweep
+# with it under its TRUE label and skip re-measuring that combo
+from paddle_tpu.flags import get_flag
+seed_bq, seed_bk = int(get_flag("flash_block_q")), int(get_flag("flash_block_k"))
+best = (tf_4096, seed_bq, seed_bk) if tf_4096 is not None else None
+for bq, bk in ((128, 128), (128, 256), (256, 128), (256, 256),
                (128, 512), (512, 128), (512, 512)):
+    if best is not None and (bq, bk) == (seed_bq, seed_bk):
+        continue
     try:
         t = bench(functools.partial(flash_attention_bshd, causal=True,
                                     block_q=bq, block_k=bk), q, k, v)

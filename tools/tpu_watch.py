@@ -17,6 +17,7 @@ lives in tools/chip_sprint.py (strict leverage order — kernel compile
 checks, attn/rmsnorm microbenches, 345M MFU + decode); the watcher just
 probes and arms the sprint, which banks + commits per step itself.
 """
+import json
 import os
 import subprocess
 import sys
@@ -97,32 +98,23 @@ def main() -> None:
     os.makedirs(base_env()["JAX_COMPILATION_CACHE_DIR"], exist_ok=True)
     deadline = time.time() + float(os.environ.get("TPU_WATCH_HOURS", "11")) * 3600
     interval = 120.0
-    retries: dict = {}     # artifact -> failed-check re-run arms so far
     while time.time() < deadline:
-        todo = []
-        for p in ARTIFACTS:
-            if bench_mod.artifact_banked(os.path.join(REPO, p)):
-                continue
-            # failed-check artifacts count as un-banked (the sprint
-            # re-runs them) — but only a bounded number of times, so a
-            # PERSISTENTLY failing check (real kernel bug, not a window
-            # flap) can't re-arm the sprint until the deadline
-            if os.path.exists(os.path.join(REPO, p)):
-                retries[p] = retries.get(p, 0)
-                if retries[p] >= 2:
-                    continue
-            todo.append(p)
+        try:                    # the sprint owns the failed-check retry
+            with open(os.path.join(REPO, ".cache",       # bound; read its
+                                   "sprint_retries.json")) as f:  # ledger
+                retries = json.load(f)
+        except (OSError, ValueError):
+            retries = {}
+        todo = [p for p in ARTIFACTS
+                if not bench_mod.artifact_banked(os.path.join(REPO, p))
+                and not (os.path.exists(os.path.join(REPO, p))
+                         and retries.get(p, 0) > 2)]
         if not todo:
             log("all artifacts banked (or retries exhausted) — exiting")
             return
         state = probe()
         if state == "tpu":
             interval = 120.0
-            # count this arm against every failed-check artifact we are
-            # about to re-run, BEFORE the sprint (a crash still counts)
-            for p in todo:
-                if os.path.exists(os.path.join(REPO, p)):
-                    retries[p] = retries.get(p, 0) + 1
             try:
                 run_sprint()
             except Exception as e:
