@@ -133,7 +133,7 @@ define_flag("flash_attn_min_seqlen", 2048,
             "re-measures and banks ATTN_BENCH_r*.json to validate or "
             "correct this default the next healthy chip window) while "
             "flash wins on memory scaling at long seq. 0 = always flash.")
-define_flag("flash_compact_stats", False,
+define_flag("flash_compact_stats", True,
             "Flash-attention stats stay compact (BH, S) at the kernel "
             "boundary: fwd keeps softmax stats in VMEM scratch and emits "
             "lse via an in-kernel (1, bq) write; bwd loads lse/delta/seg "
