@@ -34,6 +34,26 @@ def _auto_name(prefix="generated_tensor"):
     return f"{prefix}_{n}"
 
 
+# jit/sot capture hooks: a creation sequence number distinguishes tensors
+# born during a capture from pre-existing free variables, and the force
+# listener observes every tensor-data -> Python crossing (guard points)
+_seq = 0
+_force_listener = None   # set by jit/sot during a capture run
+_sot_recorder = None     # set by jit/sot during a capture run
+
+
+def _next_seq() -> int:
+    global _seq
+    _seq += 1
+    return _seq
+
+
+def _notify_force(t, kind: str, value):
+    if _force_listener is not None:
+        _force_listener(t, kind, value)
+    return value
+
+
 class Tensor:
     __slots__ = (
         "_value",
@@ -45,6 +65,7 @@ class Tensor:
         "_out_index",
         "_retain_grads",
         "_backward_hooks",
+        "_seq",             # creation sequence number (jit/sot capture)
         "_static_var_id",   # static Program variable id (static/program.py)
         "dist_attr",        # sharding annotation (auto_parallel): PartitionSpec
         "process_mesh",     # auto_parallel ProcessMesh (shard_tensor output)
@@ -83,6 +104,7 @@ class Tensor:
         self._out_index = 0
         self._retain_grads = False
         self._backward_hooks = []
+        self._seq = _next_seq()
 
     # ------------------------------------------------------------------ meta
     @property
@@ -128,17 +150,19 @@ class Tensor:
 
     # -------------------------------------------------------------- convert
     def numpy(self) -> np.ndarray:
-        return np.asarray(self._value)
+        return _notify_force(self, "array", np.asarray(self._value))
 
     def item(self):
-        return self._value.item() if hasattr(self._value, "item") else np.asarray(self._value).item()
+        v = self._value.item() if hasattr(self._value, "item") else np.asarray(self._value).item()
+        return _notify_force(self, "item", v)
 
     def tolist(self):
-        return np.asarray(self._value).tolist()
+        return _notify_force(self, "array", np.asarray(self._value).tolist())
 
     def __array__(self, dtype=None):
         arr = np.asarray(self._value)
-        return arr.astype(dtype) if dtype is not None else arr
+        return _notify_force(
+            self, "array", arr.astype(dtype) if dtype is not None else arr)
 
     def astype(self, dtype) -> "Tensor":
         return apply_op("cast", lambda x: x.astype(to_jax_dtype(dtype)), self)
@@ -189,11 +213,15 @@ class Tensor:
 
     def detach(self) -> "Tensor":
         t = Tensor(self._value, stop_gradient=True, name=self.name + ".detach")
+        if _sot_recorder is not None:
+            _sot_recorder.on_alias(self, t, stopped=True)
         return t
 
     def detach_(self) -> "Tensor":
         self._grad_node = None
         self.stop_gradient = True
+        if _sot_recorder is not None:
+            _sot_recorder.on_alias(self, self, stopped=True)
         return self
 
     def _accumulate_grad(self, gval) -> None:
@@ -222,7 +250,11 @@ class Tensor:
         return remove
 
     # ---------------------------------------------------------- in-place ops
+    # every in-place path funnels through set_value/_inplace/__setitem__;
+    # an active jit/sot capture cannot represent mutation, so notify it
     def set_value(self, value) -> None:
+        if _sot_recorder is not None:
+            _sot_recorder.on_mutation(self)
         if isinstance(value, Tensor):
             value = value._value
         self._value = jnp.asarray(value, dtype=jnp.result_type(self._value))
@@ -232,6 +264,8 @@ class Tensor:
         return self
 
     def _inplace(self, new_value) -> "Tensor":
+        if _sot_recorder is not None:
+            _sot_recorder.on_mutation(self)
         self._value = new_value
         return self
 
@@ -262,6 +296,8 @@ class Tensor:
         return apply_op("getitem", lambda x: x[idx], self)
 
     def __setitem__(self, idx, v) -> None:
+        if _sot_recorder is not None:
+            _sot_recorder.on_mutation(self)
         idx = _val_index(idx)
         self._value = self._value.at[idx].set(_val(v))
 
@@ -285,24 +321,62 @@ class Tensor:
         )
 
     def __bool__(self) -> bool:
-        return bool(np.asarray(self._value))
+        return _notify_force(self, "bool", bool(np.asarray(self._value)))
 
     def __int__(self) -> int:
-        return int(np.asarray(self._value))
+        return _notify_force(self, "int", int(np.asarray(self._value)))
 
     def __float__(self) -> float:
-        return float(np.asarray(self._value))
+        return _notify_force(self, "float", float(np.asarray(self._value)))
 
     def __index__(self) -> int:
         # lets a scalar int Tensor drive range()/slicing; under tracing
         # jax raises its concretization error, which to_static's guard
         # turns into guidance (instead of range()'s bare TypeError)
-        return self._value.__index__()
+        return _notify_force(self, "int", self._value.__index__())
 
     def __hash__(self):
         return id(self)
 
     # Arithmetic dunders are bound in paddle_tpu/ops/__init__.py.
+
+
+# ----------------------------------------------------- sot mutation watch
+# During a jit/sot capture, EVERY reassignment of an existing tensor's
+# ``_value`` (in-place ops spread across the op modules, optimizer steps,
+# BatchNorm stat updates, functional_call swaps of nested jits) is a
+# mutation the pure replay tape cannot represent. Rather than patching
+# every site, the capture temporarily replaces the ``_value`` slot
+# descriptor with a watching property — zero overhead outside capture,
+# complete coverage during it. Initial assignment (slot still unset, i.e.
+# tensor construction) stays silent.
+_VALUE_MEMBER = Tensor.__dict__["_value"]
+
+
+def _watched_get(self):
+    return _VALUE_MEMBER.__get__(self, Tensor)
+
+
+def _watched_set(self, v):
+    try:
+        _VALUE_MEMBER.__get__(self, Tensor)
+        existed = True
+    except AttributeError:
+        existed = False
+    if existed and _sot_recorder is not None:
+        _sot_recorder.on_mutation(self)
+    _VALUE_MEMBER.__set__(self, v)
+
+
+_WATCH_PROPERTY = property(_watched_get, _watched_set)
+
+
+def _install_mutation_watch() -> None:
+    Tensor._value = _WATCH_PROPERTY
+
+
+def _remove_mutation_watch() -> None:
+    Tensor._value = _VALUE_MEMBER
 
 
 class Parameter(Tensor):
@@ -381,6 +455,8 @@ def apply_op(name: str, fn: Callable, *args, **kwargs) -> Any:
         wrapped.append(t)
     if _static_recorder is not None:
         _static_recorder.record(name, fn, args, kwargs, wrapped)
+    if _sot_recorder is not None:
+        _sot_recorder.record(name, fn, args, kwargs, wrapped, multi)
     return tuple(wrapped) if multi else wrapped[0]
 
 

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 
 from ..core import autograd
 from ..core.tensor import Tensor
-from . import dy2static
+from . import dy2static, sot
 
 
 def _to_value(x):
@@ -120,6 +120,7 @@ class StaticFunction:
         self._static_argnums = static_argnums
         self._full_graph = full_graph
         self._fell_back = False
+        self._sot_fn = None   # built on first graph break (full_graph=False)
         self.input_spec = input_spec
         # dy2static AST conversion (reference: python/paddle/jit/dy2static):
         # data-dependent if/while/for become lax.cond/while_loop/fori_loop
@@ -143,6 +144,8 @@ class StaticFunction:
         self._jitted = _jitted
 
     def __call__(self, *args, **kwargs):
+        if self._sot_fn is not None:
+            return self._sot_fn(*args, **kwargs)
         try:
             out = self._jitted(*tree_to_values(args),
                                **tree_to_values(kwargs))
@@ -158,12 +161,17 @@ class StaticFunction:
             if not self._fell_back:
                 import warnings
                 warnings.warn(
-                    "to_static(full_graph=False): falling back to eager "
-                    "for data-dependent control flow — correct, but this "
-                    "call is NOT compiled. " + _DY2STATIC_HINT,
-                    stacklevel=2)
+                    "to_static(full_graph=False): graph break — continuing "
+                    "under SOT capture (compiled guard-path replays with "
+                    "eager fallback; see paddle_tpu/jit/sot). "
+                    + _DY2STATIC_HINT, stacklevel=2)
                 self._fell_back = True
-            return self._fn(*args, **kwargs)
+            # reference: python/paddle/jit/sot — the subgraph-fallback mode.
+            # All subsequent calls route through the SOT cache (which runs
+            # compiled guard-path replays, or eager where capture cannot
+            # represent the function).
+            self._sot_fn = sot.SymbolicFunction(self._fn)
+            return self._sot_fn(*args, **kwargs)
         return tree_to_tensors(out)
 
     @property

@@ -1,0 +1,508 @@
+"""SOT — symbolic translation with graph breaks and guard-based caching.
+
+Reference: python/paddle/jit/sot (OpcodeExecutor: simulate CPython bytecode,
+build a graph of paddle ops with guards, compile subgraphs, fall back to
+eager at unsupported constructs, re-trace when guards miss).
+
+TPU-native rebuild. Simulating bytecode buys the reference the ability to
+capture ops while *running* arbitrary Python; here the eager layer already
+funnels every op through one dispatch point (core/tensor.py apply_op), so
+the same capability costs an order of magnitude less machinery:
+
+  1. **Capture by execution**: the first call for an input signature runs
+     the original function EAGERLY (correct by construction — every Python
+     construct works) with a recorder installed on the dispatch point. The
+     recorder banks the op tape plus a *guard* for every point where tensor
+     data crossed into Python (``bool()``/``int()``/``item()``/``__index__``)
+     — the places the reference graph-breaks on.
+  2. **Optimistic whole-path replay**: later calls run the banked tape as a
+     SINGLE jitted function that also returns the guard values. Guards are
+     verified on the host after the (compiled) run; on any miss the call
+     re-runs eagerly and banks the new path. Each (signature, guard-outcome)
+     path is one compiled executable — the guard structure is a trie, walked
+     optimistically one whole path at a time.
+  3. **Training works through replays**: a replayed path executes as one op
+     through ``apply_op``, so the generic-vjp tape (core/autograd.py)
+     differentiates the whole subgraph with ``jax.vjp`` — parity with the
+     per-op eager tape, including ``stop_gradient``/``detach`` points
+     recorded per-use inside the tape.
+  4. **Graceful degradation**: constructs replay cannot represent (ops with
+     internal RNG, ``.numpy()``/``tolist()`` escapes, tensors created
+     outside the dispatch point, AMP's per-op dispatch casts, guard-path
+     explosion) permanently fall back to eager for that signature — the
+     reference's "fallback to dygraph" semantics, never an error.
+
+Layering vs the AST path (jit/dy2static.py): ``to_static`` first tries the
+AST conversion + full jit (data-dependent control flow becomes lax.cond /
+while_loop — the fastest outcome); with ``full_graph=False`` anything the
+AST path cannot convert lands here instead of in per-op eager.
+
+Known, documented semantic deltas vs eager (all shared with jax.jit):
+``print``/logging inside a captured function runs only during capture calls;
+free-variable Tensors are assumed to be stable objects (true for Layer
+params/buffers); float guards compare with 1e-5 relative tolerance.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import autograd, tensor as tensor_mod
+from ...core.tensor import Tensor, apply_op
+
+__all__ = ["symbolic_translate", "SymbolicFunction", "psdb"]
+
+MAX_PATHS = 8          # guard-path cap per signature (reference: cache limit)
+MAX_RECORDS = 4096     # tape-length cap
+_INT_GUARD_LIMIT = 1 << 24   # exact int range of float32 guard transport
+
+# op-name markers whose kernels draw fresh global RNG state per eager call —
+# replaying them inside one compiled executable would freeze the draw
+_IMPURE_MARKERS = (
+    "dropout", "rand", "uniform", "normal", "bernoulli", "multinomial",
+    "poisson", "exponential_", "shuffle", "seed",
+)
+
+
+class _Abort(Exception):
+    """Internal: capture hit an unrepresentable construct."""
+
+
+def _contains_tensorish(obj, depth: int = 0) -> bool:
+    if isinstance(obj, (Tensor, jax.Array)) or hasattr(obj, "aval"):
+        return True
+    if depth >= 2:
+        return False
+    if isinstance(obj, (tuple, list)):
+        return any(_contains_tensorish(o, depth + 1) for o in obj)
+    if isinstance(obj, dict):
+        return any(_contains_tensorish(o, depth + 1) for o in obj.values())
+    return False
+
+
+class _Record:
+    __slots__ = ("fn", "kwargs", "arg_descrs", "out_slots", "multi")
+
+    def __init__(self, fn, kwargs, arg_descrs, out_slots, multi):
+        self.fn = fn
+        self.kwargs = kwargs
+        self.arg_descrs = arg_descrs
+        self.out_slots = out_slots
+        self.multi = multi
+
+
+class _Recorder:
+    """Installed on the eager dispatch point for one capture run."""
+
+    def __init__(self, capture_start_seq: int):
+        self.records: List[_Record] = []
+        self.slot_of: Dict[int, int] = {}        # id(Tensor) -> slot
+        self.slot_stopped: Dict[int, bool] = {}  # slot -> stop_gradient at birth
+        self.n_slots = 0
+        self.input_slots: List[int] = []         # slots fed by call args
+        self.captured: List[Tensor] = []         # free-variable tensors
+        self.captured_slots: List[int] = []
+        self.guards: List[Tuple[int, Any]] = []  # (slot, expected python value)
+        self.keepalive: List[Tensor] = []        # keep ids unique during capture
+        self.aborted: Optional[str] = None
+        self._start_seq = capture_start_seq
+
+    # -------------------------------------------------------- slot plumbing
+    def _new_slot(self, t: Tensor) -> int:
+        s = self.n_slots
+        self.n_slots += 1
+        self.slot_of[id(t)] = s
+        self.keepalive.append(t)
+        return s
+
+    def add_input(self, t: Tensor) -> bool:
+        """Returns True if this leaf introduced a new slot."""
+        if id(t) in self.slot_of:
+            return False
+        self.input_slots.append(self._new_slot(t))
+        return True
+
+    def _slot_for_arg(self, t: Tensor) -> int:
+        s = self.slot_of.get(id(t))
+        if s is not None:
+            return s
+        # free variable: must predate the capture — a tensor born DURING the
+        # capture that the recorder never saw was created behind the dispatch
+        # point (e.g. Tensor(...) from raw arrays); replay can't reproduce it
+        if getattr(t, "_seq", 0) >= self._start_seq:
+            raise _Abort(
+                "tensor created outside the op dispatch point during capture")
+        s = self._new_slot(t)
+        self.captured.append(t)
+        self.captured_slots.append(s)
+        return s
+
+    # ------------------------------------------------------------ op events
+    def record(self, name, fn, args, kwargs, wrapped, multi) -> None:
+        if self.aborted:
+            return
+        try:
+            lname = (name or "").lower()
+            if any(m in lname for m in _IMPURE_MARKERS):
+                raise _Abort(f"op {name!r} draws global RNG state")
+            if len(self.records) >= MAX_RECORDS:
+                raise _Abort(f"tape exceeded {MAX_RECORDS} ops")
+            for cell in (getattr(fn, "__closure__", None) or ()):
+                if _contains_tensorish(cell.cell_contents):
+                    raise _Abort(
+                        f"op {name!r} closes over a tensor (e.g. tensor "
+                        "fancy-indexing) — value would be baked stale")
+            descrs = []
+            for a in args:
+                if isinstance(a, Tensor):
+                    descrs.append(("s", self._slot_for_arg(a),
+                                   bool(a.stop_gradient)))
+                else:
+                    if _contains_tensorish(a):
+                        raise _Abort(f"op {name!r} has a tensor nested in a "
+                                     "non-tensor argument")
+                    descrs.append(("k", a, False))
+            if _contains_tensorish(kwargs):
+                raise _Abort(f"op {name!r} has a tensor kwarg")
+            out_slots = []
+            for o in wrapped:
+                if isinstance(o, Tensor):
+                    s = self._new_slot(o)
+                    self.slot_stopped[s] = bool(o.stop_gradient)
+                    out_slots.append(s)
+                else:
+                    out_slots.append(None)
+            self.records.append(
+                _Record(fn, dict(kwargs), descrs, out_slots, multi))
+        except _Abort as e:
+            self.aborted = str(e)
+
+    def on_mutation(self, t: Tensor) -> None:
+        """In-place mutation (set_value/add_/__setitem__/...) cannot be
+        represented by a pure replay tape — fall back to eager."""
+        if not self.aborted:
+            self.aborted = "in-place tensor mutation during capture"
+
+    def on_alias(self, src: Tensor, new: Tensor, stopped: bool) -> None:
+        """detach()/detach_() produced ``new`` sharing ``src``'s value."""
+        if self.aborted:
+            return
+        try:
+            s = self._slot_for_arg(src)
+        except _Abort as e:
+            self.aborted = str(e)
+            return
+        if new is not src:
+            self.slot_of[id(new)] = s
+            self.keepalive.append(new)
+        if stopped:
+            self.slot_stopped[s] = True
+
+    def on_force(self, t: Tensor, kind: str, value) -> None:
+        if self.aborted:
+            return
+        if kind == "array":
+            self.aborted = ".numpy()/tolist()/__array__ escape during capture"
+            return
+        try:
+            if isinstance(value, int) and not isinstance(value, bool) \
+                    and abs(value) > _INT_GUARD_LIMIT:
+                raise _Abort(f"int guard {value} exceeds float32-exact range")
+            self.guards.append((self._slot_for_arg(t), value))
+        except _Abort as e:
+            self.aborted = str(e)
+
+
+def _guard_matches(expected, got: float) -> bool:
+    if isinstance(expected, bool):
+        return (got != 0.0) == expected
+    if isinstance(expected, int):
+        return int(round(got)) == expected
+    if isinstance(expected, float):
+        return bool(np.isclose(got, expected, rtol=1e-5, atol=1e-8))
+    return False
+
+
+class _Path:
+    """One compiled (signature, guard-outcomes) specialization."""
+
+    def __init__(self, rec: _Recorder, input_leaf_positions: List[int],
+                 out_leaves: List[Any], out_treedef):
+        self._fingerprint = None   # set by the owner after construction
+        self.guards = list(rec.guards)
+        self.input_leaf_positions = input_leaf_positions
+        self.out_treedef = out_treedef
+        self.hits = 0
+
+        records = rec.records
+        n_slots = rec.n_slots
+        in_slots = list(rec.input_slots) + list(rec.captured_slots)
+        guard_slots = [s for s, _ in self.guards]
+        stopped = rec.slot_stopped
+
+        # output leaf descriptors: ('t', replay-output-position) | ('k', const)
+        descrs: List[Tuple[str, Any]] = []
+        slot_outs: List[int] = []
+        self._out_stopped: List[bool] = []
+        for leaf in out_leaves:
+            if isinstance(leaf, Tensor):
+                s = rec.slot_of.get(id(leaf))
+                if s is None:
+                    # returned free-variable tensor: route it through replay
+                    s = rec._slot_for_arg(leaf)
+                    in_slots.append(s)
+                descrs.append(("t", len(slot_outs)))
+                slot_outs.append(s)
+                self._out_stopped.append(bool(leaf.stop_gradient))
+            else:
+                descrs.append(("k", leaf))
+        self.out_descrs = descrs
+        # snapshot AFTER out-descr building (returned free variables may
+        # have added captured slots); guard each captured tensor's
+        # stop_gradient — a path captured with a frozen param bakes
+        # lax.stop_gradient into the tape, so unfreezing must recapture
+        self.captured = list(rec.captured)
+        self._captured_sg = [bool(t.stop_gradient) for t in self.captured]
+
+        def _replay(*vals):
+            env: List[Any] = [None] * n_slots
+            for s, v in zip(in_slots, vals):
+                env[s] = v
+            for r in records:
+                a = []
+                for d in r.arg_descrs:
+                    if d[0] == "s":
+                        v = env[d[1]]
+                        a.append(jax.lax.stop_gradient(v) if d[2] else v)
+                    else:
+                        a.append(d[1])
+                o = r.fn(*a, **r.kwargs)
+                outs = o if r.multi else (o,)
+                for s, oo in zip(r.out_slots, outs):
+                    if s is not None:
+                        env[s] = oo
+            gvec = jnp.asarray(
+                [jnp.asarray(env[s], jnp.float32).reshape(()) for s in guard_slots],
+                jnp.float32) if guard_slots else jnp.zeros((0,), jnp.float32)
+            outs = []
+            for pos, s in enumerate(slot_outs):
+                v = env[s]
+                if self._out_stopped[pos] or stopped.get(s):
+                    v = jax.lax.stop_gradient(v)
+                outs.append(v)
+            return (jax.lax.stop_gradient(gvec), *outs)
+
+        self._replay = jax.jit(_replay)
+
+    def try_run(self, leaves: List[Any]):
+        """Run the compiled path; returns output tree or None on guard miss."""
+        if any(bool(t.stop_gradient) != sg
+               for t, sg in zip(self.captured, self._captured_sg)):
+            return None   # trainability of a free variable changed: recapture
+        in_tensors = ([leaves[i] for i in self.input_leaf_positions]
+                      + self.captured)
+        wrapped = apply_op("sot_graph", self._replay, *in_tensors)
+        gvals = np.asarray(wrapped[0]._value)  # single host pull for all guards
+        for (slot, expected), got in zip(self.guards, gvals):
+            if not _guard_matches(expected, float(got)):
+                return None
+        outs = wrapped[1:]
+        leaves_out = []
+        for d in self.out_descrs:
+            if d[0] == "t":
+                t = outs[d[1]]
+                if self._out_stopped[d[1]] and not t.stop_gradient:
+                    t = t.detach()
+                leaves_out.append(t)
+            else:
+                leaves_out.append(d[1])
+        self.hits += 1
+        return jax.tree.unflatten(self.out_treedef, leaves_out)
+
+
+class _SigEntry:
+    __slots__ = ("paths", "eager_reason")
+
+    def __init__(self):
+        self.paths: List[_Path] = []
+        self.eager_reason: Optional[str] = None
+
+
+_capture_depth = 0   # nested SymbolicFunctions flatten into the outer tape
+
+
+class SymbolicFunction:
+    """``symbolic_translate(fn)``: SOT-captured callable with guard caching.
+
+    Stats (for tests and ``paddle.jit.sot`` introspection): ``captures``,
+    ``replay_hits``, ``guard_misses``, ``eager_calls``.
+    """
+
+    def __init__(self, fn: Callable, max_paths: int = MAX_PATHS):
+        self._fn = fn
+        self._max_paths = max_paths
+        self._cache: Dict[Any, _SigEntry] = {}
+        self.captures = 0
+        self.replay_hits = 0
+        self.guard_misses = 0
+        self.eager_calls = 0
+
+    # ------------------------------------------------------------ signature
+    @staticmethod
+    def _signature(leaves, treedef):
+        # grad mode is part of the signature: a path captured under no_grad
+        # (or with stopped inputs) bakes stop_gradient points into the tape
+        parts = [str(treedef), autograd.is_grad_enabled()]
+        seen: Dict[int, int] = {}
+        for i, l in enumerate(leaves):
+            if isinstance(l, Tensor):
+                alias = seen.setdefault(id(l), i)  # aliasing is part of the sig
+                parts.append(("T", tuple(l._value.shape),
+                              str(jnp.result_type(l._value)), alias,
+                              bool(l.stop_gradient)))
+            elif isinstance(l, (bool, int, float, str, type(None), bytes,
+                                complex)):
+                parts.append(("P", type(l).__name__, l))
+            elif isinstance(l, np.ndarray):
+                # baked by reference into the tape: key by CONTENT (repr
+                # summarizes large arrays and would collide)
+                if l.nbytes > (1 << 20):
+                    return None   # too big to digest per call: stay eager
+                import hashlib
+                parts.append(("A", l.shape, str(l.dtype),
+                              hashlib.sha1(np.ascontiguousarray(l)
+                                           .tobytes()).hexdigest()))
+            else:
+                r = repr(l)
+                if " at 0x" in r:
+                    # default object repr: identity-keyed signatures would
+                    # leak one cache entry per call and never replay
+                    return None
+                parts.append(("O", type(l).__name__, r[:200]))
+        return tuple(parts)
+
+    def _plain_eager(self, args, kwargs):
+        self.eager_calls += 1
+        return self._fn(*args, **kwargs)
+
+    def __call__(self, *args, **kwargs):
+        global _capture_depth
+        from ...amp.auto_cast import amp_state
+
+        leaves, treedef = jax.tree.flatten((args, kwargs))
+        tensor_leaves = [l for l in leaves if isinstance(l, Tensor)]
+        if (_capture_depth > 0
+                or amp_state() is not None
+                or tensor_mod._static_recorder is not None
+                or any(isinstance(l._value, jax.core.Tracer)
+                       for l in tensor_leaves)):
+            # nested capture (flatten into outer tape), per-op AMP dispatch,
+            # static Program recording, or an enclosing jax trace: run as-is
+            return self._fn(*args, **kwargs)
+
+        sig = self._signature(leaves, treedef)
+        if sig is None:     # unguardable argument (huge array / raw object)
+            return self._plain_eager(args, kwargs)
+        entry = self._cache.setdefault(sig, _SigEntry())
+        if entry.eager_reason is not None:
+            return self._plain_eager(args, kwargs)
+
+        for path in sorted(entry.paths, key=lambda p: -p.hits):
+            out = path.try_run(leaves)
+            if out is not None:
+                self.replay_hits += 1
+                return out
+            self.guard_misses += 1
+
+        # ------------------------------------------------------- capture run
+        rec = _Recorder(tensor_mod._next_seq())
+        input_leaf_positions = []
+        for i, l in enumerate(leaves):
+            if isinstance(l, Tensor) and rec.add_input(l):
+                input_leaf_positions.append(i)
+        _capture_depth += 1
+        prev_rec = tensor_mod._sot_recorder
+        prev_force = tensor_mod._force_listener
+        tensor_mod._sot_recorder = rec
+        tensor_mod._force_listener = rec.on_force
+        tensor_mod._install_mutation_watch()
+        try:
+            out = self._fn(*args, **kwargs)
+        finally:
+            tensor_mod._remove_mutation_watch()
+            tensor_mod._sot_recorder = prev_rec
+            tensor_mod._force_listener = prev_force
+            _capture_depth -= 1
+        self.captures += 1
+        if rec.aborted:
+            entry.eager_reason = rec.aborted
+            self.eager_calls += 1
+            return out
+        out_leaves, out_treedef = jax.tree.flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))
+        # float forces (__float__/.item() floats) guard on the exact value:
+        # if a new path's bool/int guard outcomes duplicate an existing
+        # path's, only drifting float values separate them — the function
+        # will never replay stably, so stop specializing now instead of
+        # compiling paths up to the cap (value-varying float pulls are the
+        # reference's graph-break-per-call case; tensor comparisons like
+        # ``if x.mean() > 1`` produce stable BOOL guards and replay fine)
+        fp = tuple((s, v) if isinstance(v, (bool, int)) else (s, "f")
+                   for s, v in rec.guards)
+        if any(p._fingerprint == fp and any(
+                isinstance(v, float) and not isinstance(v, bool)
+                for _, v in p.guards) for p in entry.paths):
+            entry.eager_reason = ("float guard value drifts across calls — "
+                                  "cannot specialize")
+            return out
+        try:
+            path = _Path(rec, input_leaf_positions, out_leaves, out_treedef)
+            path._fingerprint = fp
+            entry.paths.append(path)
+        except _Abort as e:
+            entry.eager_reason = str(e)
+        if entry.eager_reason is None and len(entry.paths) >= self._max_paths:
+            entry.eager_reason = f"guard-path cap ({self._max_paths}) reached"
+            warnings.warn(
+                f"sot: {getattr(self._fn, '__name__', self._fn)!r} exceeded "
+                f"{self._max_paths} guard paths for one input signature — "
+                "falling back to eager for it (data-dependent behavior too "
+                "varied to specialize).", stacklevel=2)
+        return out
+
+
+def symbolic_translate(fn: Callable = None, *, max_paths: int = MAX_PATHS,
+                       **_ignored):
+    """``paddle.jit.sot.symbolic_translate`` — SOT-wrap ``fn``.
+
+    Reference signature accepts ``train=``/``build_strategy=`` knobs that
+    collapse here (training flows through the generic-vjp tape either way).
+    """
+    def deco(f):
+        import functools
+        sf = SymbolicFunction(f, max_paths=max_paths)
+        functools.update_wrapper(sf, f, updated=())
+        return sf
+    return deco(fn) if fn is not None else deco
+
+
+class psdb:
+    """Reference: paddle.jit.sot.psdb debugging helpers."""
+
+    @staticmethod
+    def breakgraph():
+        """Force the enclosing capture to fall back to eager (the reference
+        splits the graph here; with whole-path replay the honest equivalent
+        is eager execution for this code path)."""
+        rec = tensor_mod._sot_recorder
+        if rec is not None:
+            rec.aborted = "psdb.breakgraph() requested"
+
+    @staticmethod
+    def in_sot() -> bool:
+        return tensor_mod._sot_recorder is not None

@@ -228,7 +228,7 @@ class TestGuardedClasses:
         with pytest.raises(RuntimeError, match="static.nn.cond"):
             fn(t(np.ones(3)))
 
-    def test_full_graph_false_falls_back_to_eager(self):
+    def test_full_graph_false_falls_back_to_sot(self):
         def fn(x):
             if x.sum() > 0:
                 return float(x.sum()) * x    # unconvertible: host pull
@@ -236,10 +236,13 @@ class TestGuardedClasses:
 
         st = to_static(fn, full_graph=False)
         pos, neg = t(np.ones(3)), t(-np.ones(3))
-        with pytest.warns(UserWarning, match="NOT compiled"):
+        with pytest.warns(UserWarning, match="SOT"):
             np.testing.assert_allclose(st(pos).numpy(), fn(pos).numpy())
-        # both branches reachable: truly eager, not a frozen trace
+        # both branches reachable: guard-specialized, not a frozen trace
         np.testing.assert_allclose(st(neg).numpy(), fn(neg).numpy())
+        # and the break is now COMPILED per guard path (jit/sot), not eager:
+        np.testing.assert_allclose(st(pos).numpy(), fn(pos).numpy())
+        assert st._sot_fn is not None and st._sot_fn.replay_hits >= 1
 
 
 class TestStructuredControlFlow:

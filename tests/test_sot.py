@@ -1,0 +1,376 @@
+"""SOT (symbolic translation with graph breaks) — paddle_tpu/jit/sot.
+
+Reference: python/paddle/jit/sot — bytecode-level capture with guards and
+subgraph fallback. Here: capture-by-execution + guard-trie replay (see the
+module docstring for the mapping). The semantics under test:
+
+  - first call per signature runs eagerly (capture), later calls run ONE
+    compiled executable per guard path;
+  - data-dependent Python control flow specializes per branch via guards
+    (bool / int / item / __index__ forces), re-capturing on guard miss;
+  - gradients through a replay match per-op eager gradients;
+  - unrepresentable constructs (RNG ops, .numpy() escapes, guard-path
+    explosion) degrade to eager — never wrong, never an error;
+  - to_static(full_graph=False) routes graph breaks through SOT.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.sot import SymbolicFunction, psdb, symbolic_translate
+
+
+def make(arr, sg=True):
+    return paddle.to_tensor(np.asarray(arr, np.float32), stop_gradient=sg)
+
+
+class TestCaptureReplay:
+    def test_straight_line_compiles_after_one_capture(self):
+        @symbolic_translate
+        def f(x, y):
+            return x * 2.0 + y.exp()
+
+        x, y = make([1.0, 2.0]), make([0.0, 1.0])
+        r1 = f(x, y)
+        r2 = f(x, y)
+        np.testing.assert_allclose(r1.numpy(), r2.numpy(), rtol=1e-6)
+        np.testing.assert_allclose(
+            r2.numpy(), np.array([1, 2]) * 2 + np.exp([0.0, 1.0]), rtol=1e-5)
+        assert f.captures == 1
+        assert f.replay_hits == 1
+
+    def test_branch_specialization_two_paths(self):
+        @symbolic_translate
+        def f(x):
+            if x.sum() > 0:        # bool guard
+                return x - 1.0
+            return x + 10.0
+
+        pos, negv = make([3.0]), make([-3.0])
+        np.testing.assert_allclose(f(pos).numpy(), [2.0])
+        np.testing.assert_allclose(f(pos).numpy(), [2.0])     # replay
+        np.testing.assert_allclose(f(negv).numpy(), [7.0])    # miss -> capture
+        np.testing.assert_allclose(f(negv).numpy(), [7.0])    # replay path 2
+        np.testing.assert_allclose(f(pos).numpy(), [2.0])     # path 1 again
+        assert f.captures == 2
+        assert f.replay_hits == 3
+        assert f.guard_misses >= 1
+
+    def test_int_force_guard(self):
+        @symbolic_translate
+        def f(x):
+            n = int(x.sum())       # int guard feeding plain Python math
+            return x * float(n + 1)
+
+        a = make([1.0, 2.0])
+        np.testing.assert_allclose(f(a).numpy(), [4.0, 8.0])
+        np.testing.assert_allclose(f(a).numpy(), [4.0, 8.0])
+        b = make([2.0, 3.0])
+        np.testing.assert_allclose(f(b).numpy(), [12.0, 18.0])
+        assert f.captures == 2 and f.replay_hits == 1
+
+    def test_item_guard_in_output(self):
+        @symbolic_translate
+        def f(x):
+            return x + 1.0, x.sum().item()   # python scalar output, guarded
+
+        a = make([1.0, 2.0])
+        t, s = f(a)
+        t2, s2 = f(a)
+        assert s == s2 == pytest.approx(3.0)
+        np.testing.assert_allclose(t2.numpy(), [2.0, 3.0])
+        assert f.replay_hits == 1
+
+    def test_data_dependent_while_trip_count(self):
+        @symbolic_translate
+        def f(x):
+            s = x
+            while s.sum() < 10.0:   # unrolled per path; one guard per test
+                s = s * 2.0
+            return s
+
+        np.testing.assert_allclose(f(make([1.0, 1.0])).numpy(), [8.0, 8.0])
+        np.testing.assert_allclose(f(make([1.0, 1.0])).numpy(), [8.0, 8.0])
+        np.testing.assert_allclose(f(make([3.0, 3.0])).numpy(), [6.0, 6.0])
+        assert f.captures == 2 and f.replay_hits == 1
+
+    def test_shape_change_new_signature(self):
+        @symbolic_translate
+        def f(x):
+            return x * 2.0
+
+        f(make([1.0, 2.0]))
+        f(make([1.0, 2.0, 3.0]))
+        assert f.captures == 2
+        f(make([1.0, 2.0]))
+        f(make([1.0, 2.0, 3.0]))
+        assert f.captures == 2 and f.replay_hits == 2
+
+
+class TestGradients:
+    def test_replay_grads_match_eager(self):
+        def body(x, w):
+            z = x @ w
+            if z.sum() > 0:
+                return (z * z).sum()
+            return (z - 1.0).sum()
+
+        f = symbolic_translate(body)
+        xv = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        wv = np.random.RandomState(1).randn(4, 2).astype(np.float32)
+
+        x, w = make(xv, sg=False), make(wv, sg=False)
+        f(x, w).backward()                    # capture under grad
+        gx_cap, gw_cap = x.grad.numpy().copy(), w.grad.numpy().copy()
+
+        x2, w2 = make(xv, sg=False), make(wv, sg=False)
+        f(x2, w2).backward()                  # replay under grad
+        assert f.replay_hits >= 1
+        np.testing.assert_allclose(x2.grad.numpy(), gx_cap, rtol=1e-5)
+        np.testing.assert_allclose(w2.grad.numpy(), gw_cap, rtol=1e-5)
+
+        x3, w3 = make(xv, sg=False), make(wv, sg=False)
+        body(x3, w3).backward()               # pure eager reference
+        np.testing.assert_allclose(x2.grad.numpy(), x3.grad.numpy(), rtol=1e-5)
+        np.testing.assert_allclose(w2.grad.numpy(), w3.grad.numpy(), rtol=1e-5)
+
+    def test_grad_mode_is_part_of_signature(self):
+        @symbolic_translate
+        def f(x):
+            return (x * x).sum()
+
+        a = make([1.0, 2.0])                  # stopped input
+        f(a)
+        x = make([1.0, 2.0], sg=False)
+        out = f(x)                            # new sig: requires grad
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0], rtol=1e-6)
+        assert f.captures == 2
+
+    def test_detach_inside_capture_blocks_grad_on_replay(self):
+        @symbolic_translate
+        def f(x):
+            return (x.detach() * x).sum()     # d/dx = x (detached factor)
+
+        xv = np.array([2.0, 3.0], np.float32)
+        x = make(xv, sg=False)
+        f(x).backward()
+        x2 = make(xv, sg=False)
+        f(x2).backward()                      # replay
+        assert f.replay_hits == 1
+        np.testing.assert_allclose(x.grad.numpy(), xv, rtol=1e-6)
+        np.testing.assert_allclose(x2.grad.numpy(), xv, rtol=1e-6)
+
+    def test_layer_params_are_captured_inputs(self):
+        """Free-variable params flow grads through replays, and replays read
+        the params' CURRENT values (not capture-time constants)."""
+        lin = paddle.nn.Linear(4, 2)
+
+        @symbolic_translate
+        def step(x):
+            return lin(x).sum()
+
+        xv = np.random.RandomState(2).randn(3, 4).astype(np.float32)
+        x = make(xv)
+        step(x).backward()
+        g1 = lin.weight.grad.numpy().copy()
+        lin.weight.clear_grad(); lin.bias.clear_grad()
+
+        step(x).backward()                    # replay
+        assert step.replay_hits == 1
+        np.testing.assert_allclose(lin.weight.grad.numpy(), g1, rtol=1e-5)
+
+        # mutate the parameter in place (optimizer step analogue): the next
+        # replay must see the new value
+        before = step(x).item()
+        with paddle.no_grad():
+            lin.weight.set_value(lin.weight * 0.0)
+            lin.bias.set_value(lin.bias * 0.0)
+        after = step(x).item()
+        assert after == pytest.approx(0.0, abs=1e-6)
+        assert before != pytest.approx(0.0, abs=1e-6)
+
+
+class TestDegradation:
+    def test_numpy_escape_falls_back_to_eager(self):
+        @symbolic_translate
+        def f(x):
+            return paddle.to_tensor(x.numpy() * 2.0)
+
+        a = make([1.0, 2.0])
+        np.testing.assert_allclose(f(a).numpy(), [2.0, 4.0])
+        np.testing.assert_allclose(f(a).numpy(), [2.0, 4.0])
+        assert f.captures == 1 and f.eager_calls >= 1 and f.replay_hits == 0
+
+    def test_rng_op_falls_back_to_eager(self):
+        @symbolic_translate
+        def f(x):
+            return paddle.nn.functional.dropout(x, p=0.5, training=True)
+
+        a = make(np.ones(1000))
+        r1 = f(a)
+        r2 = f(a)
+        # eager fallback keeps drawing fresh masks — a frozen compiled draw
+        # would make these identical
+        assert f.replay_hits == 0
+        assert not np.allclose(r1.numpy(), r2.numpy())
+
+    def test_guard_path_cap_disables_specialization(self):
+        @symbolic_translate
+        def f(x):
+            n = int(x.sum())      # every distinct value = one guard path
+            return x * float(n)
+
+        with pytest.warns(UserWarning, match="guard paths"):
+            for v in range(1, 12):
+                f(make([float(v)]))
+        captures_at_cap = f.captures
+        f(make([50.0]))           # beyond the cap: plain eager, no capture
+        assert f.captures == captures_at_cap
+        assert f.eager_calls >= 1
+
+    def test_inplace_mutation_falls_back_to_eager(self):
+        """A replay tape is pure; mutation during capture must abort it
+        (code-review r05: silent-drop hazard)."""
+        @symbolic_translate
+        def f(x):
+            x.add_(1.0)            # caller-visible mutation
+            return x * 2.0
+
+        a = make([1.0, 2.0])
+        r1 = f(a)
+        np.testing.assert_allclose(a.numpy(), [2.0, 3.0])   # mutated
+        np.testing.assert_allclose(r1.numpy(), [4.0, 6.0])
+        b = make([1.0, 2.0])
+        r2 = f(b)                  # must run eagerly, mutating b too
+        np.testing.assert_allclose(b.numpy(), [2.0, 3.0])
+        np.testing.assert_allclose(r2.numpy(), [4.0, 6.0])
+        assert f.replay_hits == 0 and f.eager_calls >= 1
+
+    def test_trainability_flip_recaptures(self):
+        """Unfreezing a captured param must not replay the stop_gradient
+        baked at capture time (code-review r05: zero-grad hazard)."""
+        lin = paddle.nn.Linear(3, 2)
+        lin.weight.stop_gradient = True
+        lin.bias.stop_gradient = True
+
+        @symbolic_translate
+        def step(x):
+            return lin(x).sum()
+
+        x = make(np.ones((2, 3)))
+        step(x); step(x)
+        assert step.replay_hits == 1
+        lin.weight.stop_gradient = False      # unfreeze after capture
+        step(x).backward()
+        assert lin.weight.grad is not None
+        assert float(np.abs(lin.weight.grad.numpy()).sum()) > 0
+        assert step.captures == 2             # recaptured, not stale replay
+
+    def test_ndarray_arg_keyed_by_content(self):
+        @symbolic_translate
+        def f(x, mask):
+            return x * paddle.to_tensor(mask)
+
+        a = make([1.0, 2.0])
+        m1 = np.array([1.0, 0.0], np.float32)
+        m2 = np.array([0.0, 1.0], np.float32)
+        np.testing.assert_allclose(f(a, m1).numpy(), [1.0, 0.0])
+        np.testing.assert_allclose(f(a, m2).numpy(), [0.0, 2.0])  # new content
+        np.testing.assert_allclose(f(a, m1).numpy(), [1.0, 0.0])
+
+    def test_raw_object_arg_stays_eager(self):
+        class Cfg:   # default repr carries the object id
+            scale = 3.0
+
+        @symbolic_translate
+        def f(x, cfg):
+            return x * cfg.scale
+
+        a = make([1.0, 2.0])
+        np.testing.assert_allclose(f(a, Cfg()).numpy(), [3.0, 6.0])
+        np.testing.assert_allclose(f(a, Cfg()).numpy(), [3.0, 6.0])
+        assert f.captures == 0 and len(f._cache) == 0   # no per-call leak
+
+    def test_psdb_breakgraph_forces_eager(self):
+        @symbolic_translate
+        def f(x):
+            psdb.breakgraph()
+            return x * 2.0
+
+        a = make([1.0])
+        f(a); f(a)
+        assert f.replay_hits == 0 and f.eager_calls >= 1
+
+    def test_nested_sot_flattens_into_outer_tape(self):
+        @symbolic_translate
+        def inner(x):
+            return x * 3.0
+
+        @symbolic_translate
+        def outer(x):
+            return inner(x) + 1.0
+
+        a = make([2.0])
+        np.testing.assert_allclose(outer(a).numpy(), [7.0])
+        np.testing.assert_allclose(outer(a).numpy(), [7.0])
+        assert outer.captures == 1 and outer.replay_hits == 1
+        assert inner.captures == 0     # ran inside outer's capture only
+
+
+class TestToStaticIntegration:
+    def test_full_graph_false_routes_breaks_through_sot(self):
+        """The reference's default mode: unconvertible data-dependent code
+        gets subgraph capture, not per-op eager."""
+        def f(x):
+            # .item() in Python math defeats the AST converter AND jit
+            s = x.sum().item()
+            if s > 0:
+                return x * 2.0
+            return x - 1.0
+
+        sf = paddle.jit.to_static(f, full_graph=False)
+        a = make([1.0, 2.0])
+        with pytest.warns(UserWarning, match="SOT"):
+            np.testing.assert_allclose(sf(a).numpy(), [2.0, 4.0])
+        np.testing.assert_allclose(sf(a).numpy(), [2.0, 4.0])
+        b = make([-5.0, 1.0])
+        np.testing.assert_allclose(sf(b).numpy(), [-6.0, 0.0])
+        # the wrapped function is now SOT-managed and compiled per path
+        assert sf._sot_fn is not None
+        assert sf._sot_fn.replay_hits >= 1
+
+    def test_full_graph_true_still_raises_with_guidance(self):
+        def f(x):
+            s = x.sum().item()
+            return x * s
+
+        sf = paddle.jit.to_static(f, full_graph=True, input_spec=None)
+        with pytest.raises(RuntimeError, match="data-dependent"):
+            sf(make([1.0]))
+
+
+class TestSignature:
+    def test_alias_pattern_in_signature(self):
+        @symbolic_translate
+        def f(x, y):
+            return x + y
+
+        a = make([1.0, 2.0])
+        f(a, a)               # aliased
+        b = make([3.0, 4.0])
+        r = f(a, b)           # distinct objects: must not reuse aliased path
+        np.testing.assert_allclose(r.numpy(), [4.0, 6.0])
+        assert f.captures == 2
+
+    def test_non_tensor_args_specialize(self):
+        @symbolic_translate
+        def f(x, k):
+            return x * k
+
+        a = make([1.0, 2.0])
+        np.testing.assert_allclose(f(a, 2.0).numpy(), [2.0, 4.0])
+        np.testing.assert_allclose(f(a, 3.0).numpy(), [3.0, 6.0])
+        np.testing.assert_allclose(f(a, 2.0).numpy(), [2.0, 4.0])
+        assert f.captures == 2 and f.replay_hits == 1
