@@ -142,13 +142,15 @@ define_flag("flash_compact_stats", True,
             "until tools/chip_sprint.py validates the Mosaic layouts "
             "compile on a real chip; numerics are parity-tested in "
             "interpret mode either way.")
-define_flag("flash_block_q", 128,
-            "Flash-attention q rows per pallas grid step. 128 matches "
-            "the v5e MXU/VPU tile; tools/attn_bench.py sweeps a (bq, bk) "
-            "grid on-chip and banks the winner in ATTN_BENCH_r*.json — "
-            "set FLAGS_flash_block_q/_k (or pass block_q/block_k) to "
-            "apply a banked tuning without a code change.")
-define_flag("flash_block_k", 128,
+define_flag("flash_block_q", 512,
+            "Flash-attention q rows per pallas grid step. Default 512: "
+            "the r05 on-chip sweep (ATTN_BENCH_r05.json) measured "
+            "512x512 at 76.0 ms vs 108.6 ms for the old 128x128 default "
+            "(seq 4096 fwd+bwd, v5e) — fewer grid steps amortize the "
+            "revisited-accumulator loads. Short sequences snap down "
+            "automatically; set FLAGS_flash_block_q/_k (or pass "
+            "block_q/block_k) to apply a different tuning.")
+define_flag("flash_block_k", 512,
             "Flash-attention kv columns per pallas grid step (see "
             "flash_block_q).")
 define_flag("allocator_strategy", "auto_growth", "Kept for API parity; PJRT owns memory on TPU.")

@@ -46,7 +46,8 @@ except Exception:  # pragma: no cover - CPU CI path (interpret mode)
 def _blocks(block_q, block_k):
     """None -> the FLAGS_flash_block_{q,k} tuning (env-overridable, so a
     banked on-chip sweep from tools/attn_bench.py applies without a code
-    change). The single source of the 128 default is the flag registry."""
+    change). The flag registry is the single source of the default
+    (512x512 since the r05 on-chip sweep)."""
     from ..flags import get_flag
     if block_q is None:
         block_q = int(get_flag("flash_block_q"))

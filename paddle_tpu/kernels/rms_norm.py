@@ -20,6 +20,22 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 _BLOCK_ROWS = 256
+# The bwd kernel keeps ~5 f32 row-block temporaries live (x, g, gw, the
+# dot term, dx); scoped VMEM is 16 MB, so scale rows down as hidden grows.
+# 256 rows x 1024 hidden measured safe on v5e (r05 rmsnorm bench); 256 x
+# 4096 overflowed by 2.1 MB (18.1 MB requested) — hold the product at the
+# known-good 256k elements per block.
+_MAX_BLOCK_ELEMS = 256 * 1024
+
+
+def _block_rows(n: int, h: int) -> int:
+    """0 means "too wide for the kernel" (even the 8-row sublane minimum
+    busts the VMEM budget) — the caller falls back to the XLA composition."""
+    if 8 * h > _MAX_BLOCK_ELEMS:
+        return 0
+    cap = max(8, (_MAX_BLOCK_ELEMS // max(h, 1)) // 8 * 8)
+    block = min(_BLOCK_ROWS, cap)
+    return block if n >= block else max(8, n)
 
 
 def _interpret() -> bool:
@@ -110,7 +126,12 @@ def rms_norm_pallas(x, weight, epsilon: float = 1e-6):
     n = 1
     for s in orig[:-1]:
         n *= s
-    block = _BLOCK_ROWS if n >= _BLOCK_ROWS else max(8, n)
+    block = _block_rows(n, h)
+    if block == 0:   # row too wide for scoped VMEM: XLA composes fine
+        xf = x.astype(jnp.float32)
+        r = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True)
+                          + float(epsilon))
+        return (xf * r * weight.astype(jnp.float32)).astype(x.dtype)
     x2 = x.reshape(n, h)
     pad = (-n) % block
     if pad:
