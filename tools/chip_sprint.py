@@ -195,14 +195,15 @@ def step_kernels() -> list:
 def step_train_decode() -> list:
     """Run bench.py on the ambient backend; refuse fallbacks."""
     env = dict(os.environ)
-    env["BENCH_TIMEOUT"] = env.get("BENCH_TIMEOUT", "3000")
+    # schema-2 bench adds a pipelined window + 2 batched-decode compiles
+    env["BENCH_TIMEOUT"] = env.get("BENCH_TIMEOUT", "4200")
     env["BENCH_PROBE_BUDGET"] = "60"
     # windows flap: bank the 345M MFU + decode number first and leave
     # the SD UNet to its own later step (r05: a wedge cost ~50 min of a
     # live window; never put two compiles between us and an artifact)
     env["BENCH_SD"] = "0"
     r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
-                       env=env, capture_output=True, text=True, timeout=3300)
+                       env=env, capture_output=True, text=True, timeout=4500)
     lines = []
     for ln in r.stdout.splitlines():
         try:
@@ -234,8 +235,10 @@ STEPS = {
     "kernels": (f"KERNEL_COMPILE_{ROUND}.json", step_kernels, 2400),
     "attn": (f"ATTN_BENCH_{ROUND}.json", None, 2700),      # tools/attn_bench
     "rmsnorm": (f"RMSNORM_BENCH_{ROUND}.json", None, 1800),
-    "train": (f"BENCH_tpu_{ROUND}.json", step_train_decode, 3600),
-    "sd": (f"SD_BENCH_{ROUND}.json", step_sd, 2400),
+    "train": (f"BENCH_tpu_{ROUND}.json", step_train_decode, 4800),
+    # SD15's UNet compile through the tunnel alone can eat ~35 min; the
+    # r05 window lost two 40-min slots to mid-compile timeouts
+    "sd": (f"SD_BENCH_{ROUND}.json", step_sd, 5400),
 }
 _TOOL_SCRIPTS = {"attn": "attn_bench.py", "rmsnorm": "rmsnorm_bench.py"}
 
@@ -286,11 +289,20 @@ def run_step(step: str, test_mode: bool) -> bool:
     out_dir = os.path.join(REPO, ".cache") if test_mode else REPO
     path = os.path.join(out_dir, artifact)
     if os.path.exists(path):
+        state = bench_mod.artifact_state(path)
         if test_mode:  # validation must never pass on a stale artifact
             os.remove(path)
-        elif bench_mod.artifact_banked(path):
+        elif state == "banked":
             log(f"{artifact} already banked — skipping")
             return True
+        elif state == "stale_schema":
+            # measurement semantics improved since this was banked: always
+            # re-bench on a healthy window (no retry ledger — that bound
+            # exists for persistent per-check FAILURES, and a schema-stale
+            # artifact is healthy evidence, just measured the old way).
+            # Overwrite-on-success keeps the old artifact until then.
+            log(f"{artifact} banked under an older bench schema — "
+                "re-benching")
         elif _bump_retry(artifact) > 2:
             # a PERSISTENT per-check failure is real evidence, not a
             # window flap — stop burning perishable windows on it (the

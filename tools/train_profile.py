@@ -1,0 +1,231 @@
+"""Profile the GPT-345M train step on the ambient backend and summarize
+where the step time goes (MFU diagnosis — BASELINE.md north star).
+
+Captures a jax.profiler trace around a few steps, parses the XPlane proto
+dumped under --out, and prints a per-op-category time breakdown as JSON
+lines (matmul vs attention kernel vs elementwise vs copy/infeed), plus the
+top-N individual ops. Works through the axon tunnel: device traces may be
+unavailable there, in which case it falls back to a wall-clock phase split
+(dispatch vs host-sync) that still separates tunnel RTT from compute.
+
+Usage: python tools/train_profile.py [--steps 6] [--out .cache/profile]
+Env: BENCH_MODEL/BENCH_BATCH/BENCH_SEQ as bench.py.
+"""
+import glob
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    steps = 6
+    out = os.path.join(REPO, ".cache", "profile")
+    argv = sys.argv[1:]
+    if "--steps" in argv:
+        steps = int(argv[argv.index("--steps") + 1])
+    if "--out" in argv:
+        out = argv[argv.index("--out") + 1]
+
+    import numpy as np
+
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.hapi import TrainStep
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    backend = jax.default_backend()
+    print(json.dumps({"phase": "init", "backend": backend,
+                      "devices": [str(d) for d in jax.devices()]}), flush=True)
+
+    paddle.seed(0)
+    cfg = (GPTConfig.tiny() if os.environ.get("BENCH_MODEL") == "gpt_tiny"
+           else GPTConfig.gpt3_345m())
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    model = GPTForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(),
+                                 multi_precision=True)
+    step = TrainStep(model, opt)   # same construction as bench.py
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1))
+    x = paddle.to_tensor(ids[:, :-1].astype(np.int32))
+    y = paddle.to_tensor(ids[:, 1:].astype(np.int32))
+
+    t0 = time.perf_counter()
+    float(step(x, y))   # compile + one step
+    print(json.dumps({"phase": "compile", "s": round(time.perf_counter() - t0, 2)}),
+          flush=True)
+
+    # wall-clock phase split: per-step synced vs pipelined
+    for _ in range(2):
+        float(step(x, y))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        float(step(x, y))
+    synced = (time.perf_counter() - t0) / steps
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    float(loss)
+    piped = (time.perf_counter() - t0) / steps
+    print(json.dumps({"phase": "wallclock", "synced_step_s": round(synced, 4),
+                      "pipelined_step_s": round(piped, 4),
+                      "per_step_sync_overhead_s": round(synced - piped, 4)}),
+          flush=True)
+
+    # device trace
+    os.makedirs(out, exist_ok=True)
+    try:
+        with jax.profiler.trace(out):
+            for _ in range(steps):
+                loss = step(x, y)
+            float(loss)
+    except Exception as e:
+        print(json.dumps({"phase": "trace", "error": repr(e)[:300]}), flush=True)
+        return 0
+
+    files = sorted(glob.glob(os.path.join(out, "**", "*.xplane.pb"),
+                             recursive=True), key=os.path.getmtime)
+    if not files:
+        print(json.dumps({"phase": "trace", "error": "no xplane dumped"}),
+              flush=True)
+        return 0
+    summarize_xplane(files[-1], steps)
+    return 0
+
+
+def _categorize(name: str) -> str:
+    n = name.lower()
+    if "custom-call" in n or "pallas" in n or "flash" in n:
+        return "pallas/custom"
+    if "fusion" in n:
+        return "fusion"
+    if "conv" in n or "dot" in n or "matmul" in n or "einsum" in n:
+        return "matmul"
+    if any(k in n for k in ("copy", "transpose", "bitcast", "reshape")):
+        return "copy/layout"
+    if any(k in n for k in ("all-reduce", "all-gather", "reduce-scatter",
+                            "collective", "permute")):
+        return "collective"
+    if any(k in n for k in ("infeed", "outfeed", "transfer")):
+        return "host-transfer"
+    return "other"
+
+
+def _read_varint(buf, i):
+    r = 0
+    s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        r |= (b & 0x7F) << s
+        if not b & 0x80:
+            return r, i
+        s += 7
+
+
+def _fields(buf):
+    """Yield (field_no, wire_type, value_bytes_or_int) of a proto message."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = _read_varint(buf, i)
+        fno, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+            yield fno, wt, v
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            yield fno, wt, buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            yield fno, wt, int.from_bytes(buf[i:i + 4], "little")
+            i += 4
+        elif wt == 1:
+            yield fno, wt, int.from_bytes(buf[i:i + 8], "little")
+            i += 8
+        else:  # unsupported group etc.
+            return
+
+
+def summarize_xplane(path: str, steps: int) -> None:
+    """Minimal XPlane proto walk (no tensorboard dependency): decode the
+    XSpace wire format enough to sum event durations per TPU op name."""
+    with open(path, "rb") as f:
+        space = f.read()
+    # XSpace: repeated XPlane planes = 1. Device planes ("/device:TPU:0")
+    # exist for real-chip captures; CPU (and possibly the tunnel) only dump
+    # the "/host:CPU" plane, whose XLA op executions still carry op names —
+    # summarize every plane separately and let the reader pick.
+    per_plane = {}
+    for fno, wt, plane in _fields(space):
+        if fno != 1 or wt != 2:
+            continue
+        # XPlane: name=2(str), lines=3, event_metadata=11 (map<int64,XEventMetadata>)
+        pname = ""
+        metas = {}
+        lines = []
+        for f2, w2, v in _fields(plane):
+            if f2 == 2 and w2 == 2:
+                pname = v.decode("utf-8", "replace")
+            elif f2 == 3 and w2 == 2:
+                lines.append(v)
+            elif f2 == 4 and w2 == 2:
+                # map entry: key=1 varint, value=2 XEventMetadata{id=1,name=2}
+                k = None
+                mname = ""
+                for f3, w3, v3 in _fields(v):
+                    if f3 == 1 and w3 == 0:
+                        k = v3
+                    elif f3 == 2 and w3 == 2:
+                        for f4, w4, v4 in _fields(v3):
+                            if f4 == 2 and w4 == 2:
+                                mname = v4.decode("utf-8", "replace")
+                if k is not None:
+                    metas[k] = mname
+        if pname in ("/host:metadata", "Task Environment"):
+            continue
+        totals, op_totals = per_plane.setdefault(pname, ({}, {}))
+        for line in lines:
+            # XLine: events = 4
+            for f3, w3, ev in _fields(line):
+                if f3 != 4 or w3 != 2:
+                    continue
+                # XEvent: metadata_id=1, duration_ps=3 (packed in offset_ps=2?)
+                mid = dur = 0
+                for f4, w4, v4 in _fields(ev):
+                    if f4 == 1 and w4 == 0:
+                        mid = v4
+                    elif f4 == 3 and w4 == 0:
+                        dur = v4
+                name = metas.get(mid, f"op_{mid}")
+                cat = _categorize(name)
+                totals[cat] = totals.get(cat, 0) + dur
+                op_totals[name] = op_totals.get(name, 0) + dur
+    device_planes = [p for p in per_plane if "TPU" in p or "/device" in p.lower()]
+    show = device_planes or list(per_plane)
+    for pname in show:
+        totals, op_totals = per_plane[pname]
+        tot = sum(totals.values()) or 1
+        print(json.dumps({"phase": "categories", "plane": pname,
+                          "total_ms": round(tot / 1e9, 2),
+                          "per_step_ms": round(tot / 1e9 / max(steps, 1), 2),
+                          **{k: round(v / tot, 4)
+                             for k, v in sorted(totals.items(),
+                                                key=lambda kv: -kv[1])}}),
+              flush=True)
+        top = sorted(op_totals.items(), key=lambda kv: -kv[1])[:15]
+        for name, dur in top:
+            print(json.dumps({"phase": "top_op", "plane": pname,
+                              "name": name[:120],
+                              "ms": round(dur / 1e9, 2),
+                              "frac": round(dur / tot, 4)}), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
