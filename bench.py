@@ -21,6 +21,7 @@ import json
 import os
 import subprocess
 import sys
+from typing import Optional
 
 _PROBE = "import jax; d = jax.devices(); print(len(d), jax.default_backend())"
 
@@ -40,6 +41,51 @@ def cache_env(env: dict) -> dict:
 # healthy window. 2 = pipelined steady-state window + batched decode +
 # flash 512x512 defaults (the r05 mid-round tuning).
 BENCH_SCHEMA = 2
+
+
+def build_train_setup(model_name: Optional[str] = None):
+    """Single source of the bench's model/optimizer/TrainStep recipe.
+    tools/train_profile.py reuses it so the profiled step IS the
+    benchmarked step (same dtype policy, weight decay, master weights).
+    Returns (cfg, batch, seq, build, on_tpu) with ``build(remat) ->
+    (model, TrainStep)``."""
+    import paddle_tpu as paddle
+    from paddle_tpu.flags import is_tpu_backend
+    from paddle_tpu.hapi import TrainStep
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM, LlamaConfig,
+                                   LlamaForCausalLM)
+
+    if model_name is None:
+        model_name = os.environ.get("BENCH_MODEL", "gpt345m")
+    on_tpu = is_tpu_backend()
+    if model_name == "gpt345m":
+        cfg = GPTConfig.gpt3_345m()
+        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        seq = int(os.environ.get("BENCH_SEQ", "1024"))
+        model_cls = GPTForCausalLM
+    elif model_name == "gpt_tiny":
+        cfg = GPTConfig.tiny()
+        batch = int(os.environ.get("BENCH_BATCH", "4"))
+        seq = int(os.environ.get("BENCH_SEQ", "64"))
+        model_cls = GPTForCausalLM
+    else:
+        cfg = LlamaConfig.tiny()
+        batch = int(os.environ.get("BENCH_BATCH", "4"))
+        seq = int(os.environ.get("BENCH_SEQ", "64"))
+        model_cls = LlamaForCausalLM
+
+    def build(remat: bool):
+        paddle.seed(0)
+        model = model_cls(cfg)
+        if on_tpu:
+            # bf16 params + fp32 master weights: the TPU training recipe
+            model.to(dtype="bfloat16")
+        opt = paddle.optimizer.AdamW(
+            1e-4, parameters=model.parameters(), weight_decay=0.01,
+            multi_precision=on_tpu)
+        return model, TrainStep(model, opt, remat=remat)
+
+    return cfg, batch, seq, build, on_tpu
 
 
 def artifact_state(path: str) -> str:
@@ -177,45 +223,13 @@ def _run_bench() -> dict:
     import numpy as np
 
     import paddle_tpu as paddle
-    from paddle_tpu.hapi import TrainStep
-    from paddle_tpu.models import (GPTConfig, GPTForCausalLM, LlamaConfig,
-                                   LlamaForCausalLM)
     from paddle_tpu.utils.metrics import SpeedMeter
 
     import jax
 
     model_name = os.environ.get("BENCH_MODEL", "gpt345m")
     steps = int(os.environ.get("BENCH_STEPS", "12"))
-    from paddle_tpu.flags import is_tpu_backend
-    on_tpu = is_tpu_backend()
-
-    if model_name == "gpt345m":
-        cfg = GPTConfig.gpt3_345m()
-        batch = int(os.environ.get("BENCH_BATCH", "8"))
-        seq = int(os.environ.get("BENCH_SEQ", "1024"))
-        model_cls = GPTForCausalLM
-    elif model_name == "gpt_tiny":
-        cfg = GPTConfig.tiny()
-        batch = int(os.environ.get("BENCH_BATCH", "4"))
-        seq = int(os.environ.get("BENCH_SEQ", "64"))
-        model_cls = GPTForCausalLM
-    else:
-        cfg = LlamaConfig.tiny()
-        batch = int(os.environ.get("BENCH_BATCH", "4"))
-        seq = int(os.environ.get("BENCH_SEQ", "64"))
-        model_cls = LlamaForCausalLM
-
-    def build(remat: bool):
-        paddle.seed(0)
-        model = model_cls(cfg)
-        if on_tpu:
-            # bf16 params + fp32 master weights: the TPU training recipe
-            model.to(dtype="bfloat16")
-        opt = paddle.optimizer.AdamW(
-            1e-4, parameters=model.parameters(), weight_decay=0.01,
-            multi_precision=on_tpu)
-        return model, TrainStep(model, opt, remat=remat)
-
+    cfg, batch, seq, build, on_tpu = build_train_setup(model_name)
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
     model, step = build(remat)
     n_params = sum(p.size for p in model.parameters())
@@ -276,16 +290,22 @@ def _run_bench() -> dict:
     # they diverge the pipelined one is the honest device throughput.
     import time as _time
     pipe_steps = int(os.environ.get("BENCH_PIPE_STEPS", str(max(8, steps))))
-    with paddle.amp.auto_cast(enable=on_tpu, level="O1", dtype="bfloat16"):
-        loss = step(x, y)          # rejoin the pipeline before timing
-        float(loss)
-        t0 = _time.perf_counter()
-        for _ in range(pipe_steps):
-            loss = step(x, y)
-        last_loss = float(loss)    # closes the pipeline
-        pipe_elapsed = _time.perf_counter() - t0
-    pipe_tps = pipe_steps * batch * seq / pipe_elapsed / max(
-        jax.device_count(), 1)
+    pipe_tps = 0.0
+    try:
+        with paddle.amp.auto_cast(enable=on_tpu, level="O1", dtype="bfloat16"):
+            loss = step(x, y)      # rejoin the pipeline before timing
+            float(loss)
+            t0 = _time.perf_counter()
+            for _ in range(pipe_steps):
+                loss = step(x, y)
+            float(loss)            # closes the pipeline (NOT last_loss:
+            # the banked last_loss stays "after `steps` measured steps",
+            # comparable across schema versions)
+            pipe_elapsed = _time.perf_counter() - t0
+        pipe_tps = pipe_steps * batch * seq / pipe_elapsed / max(
+            jax.device_count(), 1)
+    except Exception as e:   # best-effort window: the synced numbers above
+        s["pipelined_error"] = repr(e)[:200]   # are already complete
     synced_tps = s["tokens_per_sec_per_chip"]
     if synced_tps > 0 and pipe_tps > synced_tps:
         # median_step_time_s stays the per-step-synced MEDIAN (robust,
@@ -315,6 +335,8 @@ def _run_bench() -> dict:
         result["mfu_synced"] = s["mfu_synced"]
         result["tokens_per_sec_synced"] = s["tokens_per_sec_synced"]
         result["pipelined_step_time_s"] = s["pipelined_step_time_s"]
+    if "pipelined_error" in s:
+        result["pipelined_error"] = s["pipelined_error"]
     fallback = os.environ.get("_PADDLE_TPU_BENCH_FALLBACK")
     if fallback:
         # MFU against a nominal CPU peak is meaningless (VERDICT r2 weak

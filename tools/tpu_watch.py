@@ -105,10 +105,17 @@ def main() -> None:
                 retries = json.load(f)
         except (OSError, ValueError):
             retries = {}
-        todo = [p for p in ARTIFACTS
-                if not bench_mod.artifact_banked(os.path.join(REPO, p))
-                and not (os.path.exists(os.path.join(REPO, p))
-                         and retries.get(p, 0) > 2)]
+        # mirror chip_sprint.run_step exactly via the shared artifact_state:
+        # 'stale_schema' is ALWAYS todo (the sprint bypasses the retry
+        # ledger for it); the ledger only parks 'failed_checks' artifacts
+        todo = []
+        for p in ARTIFACTS:
+            st = bench_mod.artifact_state(os.path.join(REPO, p))
+            if st == "banked":
+                continue
+            if st == "failed_checks" and retries.get(p, 0) > 2:
+                continue
+            todo.append(p)
         if not todo:
             log("all artifacts banked (or retries exhausted) — exiting")
             return
