@@ -239,8 +239,12 @@ STEPS = {
     # SD15's UNet compile through the tunnel alone can eat ~35 min; the
     # r05 window lost two 40-min slots to mid-compile timeouts
     "sd": (f"SD_BENCH_{ROUND}.json", step_sd, 5400),
+    # where does the 345M step time GO: jax.profiler capture + XPlane
+    # category/top-op breakdown (compile cached by the train step)
+    "profile": (f"PROFILE_{ROUND}.json", None, 2400),
 }
-_TOOL_SCRIPTS = {"attn": "attn_bench.py", "rmsnorm": "rmsnorm_bench.py"}
+_TOOL_SCRIPTS = {"attn": "attn_bench.py", "rmsnorm": "rmsnorm_bench.py",
+                 "profile": "train_profile.py"}
 
 
 def run_worker(step: str) -> None:
@@ -408,7 +412,7 @@ def main() -> int:
     # existence proof: windows are perishable and the microbenches are
     # the cheapest thing to lose (r05: the attn step wedged a live
     # window for its full timeout with train still unbanked behind it)
-    order = ["kernels", "train", "attn", "rmsnorm", "sd"]
+    order = ["kernels", "train", "attn", "rmsnorm", "sd", "profile"]
     if test_mode:
         order = ["kernels"]  # plumbing validation; benches are TPU-priced
     ok = True

@@ -78,7 +78,7 @@ def probe() -> str:
 ROUND = os.environ.get("CHIP_SPRINT_ROUND", "r05")
 ARTIFACTS = [f"KERNEL_COMPILE_{ROUND}.json", f"ATTN_BENCH_{ROUND}.json",
              f"RMSNORM_BENCH_{ROUND}.json", f"BENCH_tpu_{ROUND}.json",
-             f"SD_BENCH_{ROUND}.json"]
+             f"SD_BENCH_{ROUND}.json", f"PROFILE_{ROUND}.json"]
 
 
 def run_sprint() -> None:
@@ -113,7 +113,10 @@ def main() -> None:
             st = bench_mod.artifact_state(os.path.join(REPO, p))
             if st == "banked":
                 continue
-            if st == "failed_checks" and retries.get(p, 0) > 2:
+            # ledger >= 2 means the sprint will PARK this artifact on its
+            # next attempt (_bump_retry pre-bump bound) — arming another
+            # sprint for it alone would only bump the counter
+            if st == "failed_checks" and retries.get(p, 0) >= 2:
                 continue
             todo.append(p)
         if not todo:
