@@ -98,9 +98,10 @@ _DY2STATIC_HINT = (
     "they are static). Fixes, in order of preference: (1) rewrite with "
     "paddle.static.nn.cond / while_loop / switch_case (structured control "
     "flow that compiles); (2) paddle.where for elementwise selects; "
-    "(3) to_static(..., full_graph=False) to fall back to EAGER execution "
-    "for calls that hit data-dependent control flow (correct but "
-    "uncompiled). See tests/test_dy2static.py for the semantics table.")
+    "(3) to_static(..., full_graph=False) — the default — which handles "
+    "such breaks via SOT guarded subgraph capture (compiled per guard "
+    "path, eager only where capture cannot represent the code). "
+    "See tests/test_dy2static.py for the semantics table.")
 
 
 class StaticFunction:
@@ -110,8 +111,13 @@ class StaticFunction:
     Divergence guard (reference: test/dygraph_to_static discipline): the
     reference REWRITES Python control flow into graph ops; here tracing
     would silently take one branch — so data-dependent Python control flow
-    raises with guidance instead (or falls back to eager when
-    ``full_graph=False``)."""
+    raises with guidance (``full_graph=True``) or routes through SOT
+    guarded subgraph capture (``full_graph=False``, the ``to_static``
+    default — see jit/sot).
+
+    The constructor default stays strict (``full_graph=True``): internal
+    users like ``jit_fn`` want a loud error, and only the public
+    ``to_static`` carries the reference's SOT-by-default semantics."""
 
     def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
                  full_graph=True, backend=None, static_argnums=(),
@@ -193,12 +199,32 @@ class StaticFunction:
             return repr(self._fn)
 
     def concrete_program(self, *args, **kwargs):
-        return self._jitted.lower(*tree_to_values(args), **tree_to_values(kwargs))
+        try:
+            return self._jitted.lower(*tree_to_values(args),
+                                      **tree_to_values(kwargs))
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.TracerArrayConversionError) as e:
+            raise RuntimeError(
+                f"jit.save/concrete_program need ONE whole graph, but "
+                f"{getattr(self._fn, '__name__', self._fn)!r} has "
+                "data-dependent Python control flow (it runs under SOT "
+                "subgraph capture, which cannot be exported as a single "
+                "program). " + _DY2STATIC_HINT) from e
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, **kwargs):
-    """``paddle.jit.to_static``: compile an eager function/Layer with XLA."""
+              backend=None, full_graph=False, **kwargs):
+    """``paddle.jit.to_static``: compile an eager function/Layer with XLA.
+
+    ``full_graph`` defaults to False, matching the reference
+    (python/paddle/jit/api.py: SOT is the default mode): the AST
+    conversion + whole-graph jit is tried first, and anything it cannot
+    express falls back to SOT guarded subgraph capture (jit/sot) instead
+    of raising. ``full_graph=True`` keeps the strict mode — data-dependent
+    Python control flow that the AST pass cannot convert raises with
+    guidance."""
 
     def decorate(fn):
         if hasattr(fn, "forward") and not callable(getattr(fn, "__wrapped_layer__", None)):

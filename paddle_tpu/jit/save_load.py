@@ -109,6 +109,16 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
     try:
         specs = _infer_specs(layer, input_spec)
         export_pure(pure, params, specs, path)
+    except (jax.errors.TracerBoolConversionError,
+            jax.errors.ConcretizationTypeError,
+            jax.errors.TracerIntegerConversionError,
+            jax.errors.TracerArrayConversionError) as e:
+        from . import _DY2STATIC_HINT
+        raise RuntimeError(
+            "jit.save exports ONE whole graph, but this function/Layer has "
+            "data-dependent Python control flow (under the default "
+            "to_static mode it runs via SOT subgraph capture, which cannot "
+            "be exported as a single program). " + _DY2STATIC_HINT) from e
     finally:
         for l, was_training in modes:
             l.training = was_training

@@ -23,10 +23,15 @@ Semantics table
 |    binding can't shorten)         |            |                          |
 | for over a Tensor (row iteration) | works      | CONVERTED → fori_loop    |
 |                                   |            | over the leading dim     |
-| unconvertible control flow        | works      | GUARDED: RuntimeError    |
-|   (raise/attr-mutation in branch; |            | with guidance (default   |
-|    mixed return/assign; for-break)|            | full_graph=True)         |
-| ... with full_graph=False         | works      | eager fallback + warning |
+| unconvertible control flow        | works      | DEFAULT (full_graph=     |
+|   (raise/attr-mutation in branch; |            | False, reference parity):|
+|    mixed return/assign; for-break)|            | SOT guarded subgraph     |
+|                                   |            | capture (jit/sot) —      |
+|                                   |            | compiled guard paths,    |
+|                                   |            | eager where unrepresent- |
+|                                   |            | able                     |
+| ... with full_graph=True          | works      | GUARDED: RuntimeError    |
+|                                   |            | with guidance            |
 | static.nn.cond / while_loop /     | works      | EXACT (lax control flow, |
 |   switch_case / case              |            | compiled)                |
 | paddle.where elementwise select   | works      | EXACT                    |
@@ -208,18 +213,29 @@ def _val_of(x):
 
 
 class TestGuardedClasses:
-    """Constructs the transform declines keep the guard-rail semantics."""
+    """Constructs the AST transform declines: strict mode raises with
+    guidance; the DEFAULT (reference-parity full_graph=False) routes them
+    through SOT capture and stays correct."""
 
-    def test_float_conversion_raises(self):
-        @to_static
+    def test_float_conversion_raises_in_strict_mode(self):
+        @to_static(full_graph=True)
         def fn(x):
             return float(x.sum()) * x   # host pull mid-trace
 
         with pytest.raises(RuntimeError, match="control flow"):
             fn(t(np.ones(3)))
 
-    def test_unconvertible_branch_raises_with_guidance(self):
+    def test_float_conversion_works_by_default_via_sot(self):
         @to_static
+        def fn(x):
+            return float(x.sum()) * x
+
+        with pytest.warns(UserWarning, match="SOT"):
+            out = fn(t(np.ones(3)))
+        np.testing.assert_allclose(out.numpy(), 3.0 * np.ones(3), rtol=1e-6)
+
+    def test_unconvertible_branch_raises_with_guidance_in_strict_mode(self):
+        @to_static(full_graph=True)
         def fn(x):
             if x.sum() > 0:             # raise in branch: not converted
                 raise ValueError("positive")
@@ -227,6 +243,19 @@ class TestGuardedClasses:
 
         with pytest.raises(RuntimeError, match="static.nn.cond"):
             fn(t(np.ones(3)))
+
+    def test_raise_in_branch_propagates_by_default(self):
+        @to_static
+        def fn(x):
+            if x.sum() > 0:
+                raise ValueError("positive")
+            return x + 1
+
+        with pytest.warns(UserWarning, match="SOT"):
+            np.testing.assert_allclose(
+                fn(t(-np.ones(3))).numpy(), np.zeros(3), atol=1e-7)
+        with pytest.raises(ValueError, match="positive"):
+            fn(t(np.ones(3)))           # eager semantics: the raise fires
 
     def test_full_graph_false_falls_back_to_sot(self):
         def fn(x):
@@ -424,7 +453,20 @@ class TestBreakContinueLowering:
             st(t(np.zeros(2)), t(5, np.int32)).numpy(),
             fn(t(np.zeros(2)), 5).numpy())
 
-    def test_break_in_for_stays_guarded(self):
+    def test_break_in_for_stays_guarded_in_strict_mode(self):
+        def fn(x, n):
+            acc = x
+            for i in range(n):
+                if acc.sum() > 10:
+                    break
+                acc = acc + 1
+            return acc
+
+        st = to_static(fn, full_graph=True)
+        with pytest.raises(RuntimeError, match="control flow"):
+            st(t(np.zeros(2)), t(5, np.int32))
+
+    def test_break_in_for_works_by_default_via_sot(self):
         def fn(x, n):
             acc = x
             for i in range(n):
@@ -434,8 +476,9 @@ class TestBreakContinueLowering:
             return acc
 
         st = to_static(fn)
-        with pytest.raises(RuntimeError, match="control flow"):
-            st(t(np.zeros(2)), t(5, np.int32))
+        with pytest.warns(UserWarning, match="SOT"):
+            out = st(t(np.zeros(2)), t(5, np.int32))
+        np.testing.assert_allclose(out.numpy(), np.full(2, 5.0), atol=1e-7)
 
 
 class TestForOverTensor:
