@@ -347,11 +347,12 @@ def _run_bench() -> dict:
         result["metric"] = f"{model_name}_tokens_per_sec_cpu_fallback"
         result["value"] = result["tokens_per_sec_per_chip"]
         result["unit"] = "tokens_per_sec_per_chip"
-    try:
-        step.sync_to_model()  # training donated the old param buffers
-        result.update(_decode_bench(model, cfg, paddle, jax))
-    except Exception as e:  # decode bench is best-effort extra signal
-        result["decode_error"] = repr(e)[:200]
+    if os.environ.get("BENCH_DECODE", "1") == "1":
+        try:
+            step.sync_to_model()  # training donated the old param buffers
+            result.update(_decode_bench(model, cfg, paddle, jax))
+        except Exception as e:  # decode bench is best-effort extra signal
+            result["decode_error"] = repr(e)[:200]
     if os.environ.get("BENCH_SD", "1" if on_tpu else "0") == "1":
         # free the GPT training state first: SD15 + AdamW master weights
         # plus the 345M train state would overrun one chip's HBM (the

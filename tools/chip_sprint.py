@@ -217,6 +217,108 @@ def step_train_decode() -> list:
     return [lines[-1]]
 
 
+def step_tune() -> list:
+    """345M train-only batch sweep: the banked MFU runs batch=8; HBM has
+    headroom (≈4.8 GB optimizer+param state of 16 GB), and a larger
+    per-step token count amortizes weight loads. One JSON line per
+    candidate; step_train's artifact stays the primary number."""
+    out = []
+    for batch in (16, 24):
+        env = dict(os.environ)
+        env["BENCH_SD"] = "0"
+        env["BENCH_DECODE"] = "0"       # train-only: 1 compile per point
+        env["BENCH_BATCH"] = str(batch)
+        env["BENCH_PROBE_BUDGET"] = "60"
+        # bigger batches compile+run longer than the batch-8 default run
+        env["BENCH_TIMEOUT"] = env.get("BENCH_TIMEOUT", "2100")
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bench.py")], env=env,
+                capture_output=True, text=True, timeout=2400)
+            lines = []
+            for ln in r.stdout.splitlines():
+                try:
+                    lines.append(json.loads(ln))
+                except (json.JSONDecodeError, ValueError):
+                    continue
+            rec = lines[-1] if lines else {}
+            rec["tune_batch"] = batch
+            # bench.py's parent ALWAYS exits 0 and always prints one JSON
+            # line; a failed point surfaces as metric=bench_error (no
+            # backend). Mark it a failed check rather than letting the
+            # backend-less line poison the whole artifact in require_tpu.
+            if (not lines or r.returncode != 0
+                    or rec.get("metric") == "bench_error"
+                    or rec.get("backend") not in ("tpu", "axon")):
+                rec["ok"] = False
+                rec.setdefault("error", f"rc={r.returncode} "
+                                        f"{r.stderr[-400:]}")
+        except Exception as e:   # timeout/OSError: bank as a failed check
+            rec = {"tune_batch": batch, "ok": False, "error": repr(e)[:300]}
+        out.append(rec)
+    return out
+
+
+def maybe_flip_bench_batch() -> None:
+    """If a swept batch beats the banked batch-8 MFU by >5%, make it the
+    bench default (same banked-decision pattern as the compact-stats
+    flip)."""
+    tune_path = os.path.join(REPO, f"TRAIN_TUNE_{ROUND}.json")
+    bench_path = os.path.join(REPO, f"BENCH_tpu_{ROUND}.json")
+    if not (os.path.exists(tune_path) and os.path.exists(bench_path)):
+        return
+    with open(tune_path) as f:
+        tune = json.load(f)["results"]
+    with open(bench_path) as f:
+        base = json.load(f)["results"][-1]
+    base_mfu = base.get("value") or 0
+    cands = [(r.get("value") or 0, r.get("tune_batch"))
+             for r in tune if r.get("ok") is not False
+             and r.get("unit") == "mfu_fraction"]
+    if not cands:
+        return
+    best_mfu, best_batch = max(cands)
+    if best_mfu <= base_mfu * 1.05:
+        log(f"bench-batch flip: gate not met (best {best_mfu} @ "
+            f"{best_batch} vs banked {base_mfu} @ 8)")
+        return
+    bench_py = os.path.join(REPO, "bench.py")
+    # the flip auto-commits bench.py wholesale: refuse when unrelated
+    # uncommitted edits would be swept into the commit (the decision
+    # stays banked in the tune artifact for manual application)
+    dirty = subprocess.run(["git", "diff", "--quiet", "--", "bench.py"],
+                           cwd=REPO).returncode != 0
+    if dirty:
+        log("bench-batch flip: bench.py has uncommitted edits — skipping "
+            f"(banked decision: batch {best_batch} @ {best_mfu:.4f} MFU)")
+        return
+    with open(bench_py) as f:
+        src = f.read()
+    old = 'batch = int(os.environ.get("BENCH_BATCH", "8"))'
+    if old not in src:
+        log("bench-batch flip: default already changed or moved")
+        return
+    import re as _re
+    m = _re.search(r"BENCH_SCHEMA = (\d+)", src)
+    if not m:
+        log("bench-batch flip: BENCH_SCHEMA marker missing — skipping")
+        return
+    # changing the measured default IS a measurement-semantics change:
+    # bump the schema so the banked batch-8 train artifact goes
+    # stale_schema and re-banks at the new default on the next window
+    src = src.replace(m.group(0), f"BENCH_SCHEMA = {int(m.group(1)) + 1}")
+    src = src.replace(
+        old, f'batch = int(os.environ.get("BENCH_BATCH", "{best_batch}"))')
+    with open(bench_py, "w") as f:
+        f.write(src)
+    commit(bench_py,
+           f"Default 345M bench batch -> {best_batch}: measured "
+           f"{best_mfu:.4f} vs {base_mfu:.4f} MFU at batch 8 on chip "
+           f"(TRAIN_TUNE_{ROUND}.json); bench schema bumped so the train "
+           "artifact re-banks at the new default")
+    log(f"bench-batch flip: APPLIED ({best_batch}, {best_mfu:.4f} MFU)")
+
+
 def step_sd() -> list:
     """SD-1.5 UNet train-step bench (BASELINE configs[4]) on the ambient
     backend, split out of the train step so the flagship MFU artifact
@@ -242,6 +344,8 @@ STEPS = {
     # where does the 345M step time GO: jax.profiler capture + XPlane
     # category/top-op breakdown (compile cached by the train step)
     "profile": (f"PROFILE_{ROUND}.json", None, 2400),
+    # batch sweep: two train-only bench points above the banked batch 8
+    "tune": (f"TRAIN_TUNE_{ROUND}.json", step_tune, 5400),
 }
 _TOOL_SCRIPTS = {"attn": "attn_bench.py", "rmsnorm": "rmsnorm_bench.py",
                  "profile": "train_profile.py"}
@@ -258,10 +362,14 @@ def run_worker(step: str) -> None:
 
 
 def require_tpu(lines: list, test_mode: bool) -> None:
+    """Every SUCCESS record must come from the real chip. ok:False
+    failure records carry no measurement — they are counted as failed
+    checks (bounded retries) rather than poisoning the whole artifact."""
     if test_mode:
         return
     bad = [l.get("backend") for l in lines
-           if l.get("backend") not in ("tpu", "axon")]
+           if l.get("ok") is not False
+           and l.get("backend") not in ("tpu", "axon")]
     if bad:
         raise RuntimeError(f"step ran on {bad[0]!r}, not TPU — not banking")
     fb = [l for l in lines if l.get("fallback")]
@@ -412,7 +520,8 @@ def main() -> int:
     # existence proof: windows are perishable and the microbenches are
     # the cheapest thing to lose (r05: the attn step wedged a live
     # window for its full timeout with train still unbanked behind it)
-    order = ["kernels", "train", "attn", "rmsnorm", "sd", "profile"]
+    order = ["kernels", "train", "attn", "rmsnorm", "sd", "profile",
+             "tune"]
     if test_mode:
         order = ["kernels"]  # plumbing validation; benches are TPU-priced
     ok = True
@@ -436,6 +545,11 @@ def main() -> int:
                 maybe_flip_compact_stats()
             except Exception as e:   # the flip must never kill the sprint
                 log(f"compact-stats flip FAILED: {e!r}"[:400])
+        if step == "tune" and not test_mode:
+            try:
+                maybe_flip_bench_batch()
+            except Exception as e:   # the flip must never kill the sprint
+                log(f"bench-batch flip FAILED: {e!r}"[:400])
     return 0 if ok else 1
 
 
