@@ -32,6 +32,23 @@ __all__ = ["GenerationMixin"]
 _NEG_INF = -1e30
 
 
+def _apply_logit_adjust(lg, seen, step, repetition_penalty, min_new_tokens,
+                        eos_token_id):
+    """Repetition penalty over already-seen tokens (HF/reference semantics:
+    positive logits divide, negative multiply) + the min-length eos mask.
+    Shared by the sampling and beam paths. ``seen``: (rows, V) bool."""
+    if repetition_penalty != 1.0:
+        pen = jnp.where(lg > 0, lg / repetition_penalty,
+                        lg * repetition_penalty)
+        lg = jnp.where(seen, pen, lg)
+    if eos_token_id is not None and min_new_tokens > 0:
+        lg = jnp.where(
+            (step < min_new_tokens)
+            & (jnp.arange(lg.shape[-1]) == eos_token_id)[None, :],
+            _NEG_INF, lg)
+    return lg
+
+
 def _top_k_filter(logits: jax.Array, k: int) -> jax.Array:
     kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
     return jnp.where(logits < kth, _NEG_INF, logits)
@@ -67,11 +84,22 @@ class GenerationMixin:
                  top_k: int = 0, top_p: float = 1.0,
                  eos_token_id: Optional[int] = None,
                  pad_token_id: Optional[int] = None,
+                 repetition_penalty: float = 1.0,
+                 min_new_tokens: int = 0,
+                 num_beams: int = 1,
+                 length_penalty: float = 1.0,
                  return_full_sequence: bool = True):
-        """Greedy/sampled autoregressive decode. Returns the (B, P + N)
+        """Greedy/sampled/beam autoregressive decode. Returns the (B, P + N)
         full sequence Tensor (or (B, N) generated tail when
         ``return_full_sequence=False``). After an ``eos_token_id`` hit a row
-        emits ``pad_token_id`` for the remaining steps (shapes stay static)."""
+        emits ``pad_token_id`` for the remaining steps (shapes stay static).
+
+        ``repetition_penalty`` > 1 divides positive (multiplies negative)
+        logits of every token already present in the row (prompt included),
+        HF/reference semantics. ``min_new_tokens`` masks ``eos_token_id``
+        for the first N steps. ``num_beams`` > 1 switches to beam search
+        (greedy over beams; ``do_sample`` must be False), scoring finished
+        beams with ``sum(logprobs) / len**length_penalty``."""
         from ..core.tensor import Tensor
         from ..framework.random import next_key
         from ..jit import functional_call
@@ -92,22 +120,42 @@ class GenerationMixin:
         if pad_token_id is None:
             pad_token_id = eos_token_id if eos_token_id is not None else 0
 
+        if num_beams > 1 and do_sample:
+            raise ValueError("beam search is greedy over beams — "
+                             "do_sample=True is not supported with "
+                             "num_beams > 1 (reference raises too)")
+
         was_training = self.training
         self.eval()
         try:
             from ..jit import ensure_live
             params, buffers = self.raw_state()
             ensure_live(params, "call step.sync_to_model() before generate().")
-            sig = (b, p, int(max_new_tokens), bool(do_sample), int(top_k),
-                   eos_token_id, pad_token_id)
+            # only the knobs the selected builder consumes: spurious sig
+            # entries would recompile identical programs (seconds on TPU)
+            if num_beams > 1:
+                sig = ("beam", b, p, int(max_new_tokens), int(num_beams),
+                       eos_token_id, pad_token_id, float(length_penalty),
+                       float(repetition_penalty), int(min_new_tokens))
+            else:
+                sig = ("sample", b, p, int(max_new_tokens), bool(do_sample),
+                       int(top_k), eos_token_id, pad_token_id,
+                       float(repetition_penalty), int(min_new_tokens))
             cache = getattr(self, "_generate_jit_cache", None)
             if cache is None:
                 cache = self._generate_jit_cache = {}
             fn = cache.get(sig)
             if fn is None:
-                fn = jax.jit(self._build_generate(
-                    b, p, int(max_new_tokens), bool(do_sample), int(top_k),
-                    eos_token_id, pad_token_id))
+                if num_beams > 1:
+                    fn = jax.jit(self._build_beam_generate(
+                        b, p, int(max_new_tokens), int(num_beams),
+                        eos_token_id, pad_token_id, float(length_penalty),
+                        float(repetition_penalty), int(min_new_tokens)))
+                else:
+                    fn = jax.jit(self._build_generate(
+                        b, p, int(max_new_tokens), bool(do_sample),
+                        int(top_k), eos_token_id, pad_token_id,
+                        float(repetition_penalty), int(min_new_tokens)))
                 cache[sig] = fn
             toks = fn(params, buffers, ids_val, next_key(),
                       jnp.float32(temperature), jnp.float32(top_p))
@@ -119,11 +167,16 @@ class GenerationMixin:
         return Tensor(out, stop_gradient=True)
 
     def _build_generate(self, b, p, n_new, do_sample, top_k,
-                        eos_token_id, pad_token_id):
+                        eos_token_id, pad_token_id,
+                        repetition_penalty=1.0, min_new_tokens=0):
         from ..jit import functional_call
 
-        def select(logits, key, temperature, top_p):
-            lg = logits.astype(jnp.float32)
+        def adjust(lg, seen, step):
+            return _apply_logit_adjust(lg, seen, step, repetition_penalty,
+                                       min_new_tokens, eos_token_id)
+
+        def select(logits, key, temperature, top_p, seen, step):
+            lg = adjust(logits.astype(jnp.float32), seen, step)
             if not do_sample:
                 return jnp.argmax(lg, axis=-1)
             lg = lg / jnp.maximum(temperature, 1e-6)
@@ -138,37 +191,159 @@ class GenerationMixin:
             caches = [(jnp.zeros((b, total, hkv, d), dtype),
                        jnp.zeros((b, total, hkv, d), dtype))
                       for hkv, d in self.cache_spec()]
+            track = repetition_penalty != 1.0
 
             # prefill: writes cache positions [0, p), predicts token p
             logits, caches = functional_call(
                 self, params, ids, caches, jnp.int32(0), buffers=buffers,
                 method="forward_with_cache")
+            # vocab from the logits, NOT self.config: the mixin contract
+            # only requires cache_spec + forward_with_cache. The penalty
+            # applies to prompt tokens too — HF/reference semantics.
+            seen = (jnp.zeros((b, logits.shape[-1]), bool).at[
+                        jnp.arange(b)[:, None], ids].set(True)
+                    if track else jnp.zeros((b, 1), bool))
             key, sub = jax.random.split(key)
-            tok = select(logits[:, -1], sub, temperature, top_p).astype(
-                ids.dtype)
+            tok = select(logits[:, -1], sub, temperature, top_p, seen,
+                         jnp.int32(0)).astype(ids.dtype)
+            if track:
+                seen = seen.at[jnp.arange(b), tok].set(True)
             if eos_token_id is not None:
                 finished = tok == eos_token_id
             else:
                 finished = jnp.zeros((b,), bool)
 
-            def body(carry, _):
-                tok, caches, off, key, finished = carry
+            def body(carry, step):
+                tok, caches, off, key, finished, seen = carry
                 logits, caches = functional_call(
                     self, params, tok[:, None], caches, off, buffers=buffers,
                     method="forward_with_cache")
                 key, sub = jax.random.split(key)
-                nxt = select(logits[:, -1], sub, temperature, top_p).astype(
-                    tok.dtype)
+                nxt = select(logits[:, -1], sub, temperature, top_p, seen,
+                             step).astype(tok.dtype)
                 nxt = jnp.where(finished, jnp.asarray(pad_token_id, tok.dtype),
                                 nxt)
+                if track:
+                    seen = seen.at[jnp.arange(b), nxt].set(True)
                 if eos_token_id is not None:
                     finished = finished | (nxt == eos_token_id)
-                return (nxt, caches, off + 1, key, finished), nxt
+                return (nxt, caches, off + 1, key, finished, seen), nxt
 
-            (_, _, _, _, _), rest = lax.scan(
-                body, (tok, caches, jnp.int32(p), key, finished), None,
-                length=n_new - 1)
+            (_, _, _, _, _, _), rest = lax.scan(
+                body, (tok, caches, jnp.int32(p), key, finished, seen),
+                jnp.arange(1, n_new), length=n_new - 1)
             return jnp.concatenate([tok[:, None],
                                     jnp.moveaxis(rest, 0, 1)], axis=1)
+
+        return gen
+
+    def _build_beam_generate(self, b, p, n_new, beams, eos_token_id,
+                             pad_token_id, length_penalty,
+                             repetition_penalty=1.0, min_new_tokens=0):
+        """Beam search as one jitted program (reference: PaddleNLP
+        GenerationMixin beam_search). Beams ride the batch dimension of the
+        KV caches ((b*beams, ...)), reindexed with take_along_axis at every
+        step; finished beams can only extend with pad at zero extra score.
+        Final: best beam by sum(logprobs) / len**length_penalty, counting
+        tokens up to and including eos."""
+        from ..jit import functional_call
+
+        eos = eos_token_id
+        pad = pad_token_id if pad_token_id is not None else (
+            eos if eos is not None else 0)
+
+        def adjust(lg, seen, step):
+            return _apply_logit_adjust(lg, seen, step, repetition_penalty,
+                                       min_new_tokens, eos)
+
+        def gen(params, buffers, ids, key, temperature, top_p):
+            del key, temperature, top_p   # greedy over beams
+            total = p + n_new
+            dtype = jnp.result_type(next(iter(params.values())))
+            bb = b * beams
+            caches = [(jnp.zeros((bb, total, hkv, d), dtype),
+                       jnp.zeros((bb, total, hkv, d), dtype))
+                      for hkv, d in self.cache_spec()]
+            ids_t = jnp.repeat(ids, beams, axis=0)        # (bb, p)
+            track = repetition_penalty != 1.0
+
+            logits, caches = functional_call(
+                self, params, ids_t, caches, jnp.int32(0), buffers=buffers,
+                method="forward_with_cache")
+            vocab = logits.shape[-1]     # NOT self.config: mixin contract
+            seen = (jnp.zeros((bb, vocab), bool).at[
+                        jnp.arange(bb)[:, None], ids_t].set(True)
+                    if track else jnp.zeros((bb, 1), bool))
+            lp = jax.nn.log_softmax(
+                adjust(logits[:, -1].astype(jnp.float32), seen,
+                       jnp.int32(0)), axis=-1)            # (bb, V)
+            lp = lp.reshape(b, beams, vocab)
+            # all beams of a batch row are identical after prefill: keep
+            # only beam 0's distribution so the top-k picks DISTINCT tokens
+            first = jnp.where(
+                (jnp.arange(beams) == 0)[None, :, None], lp[:, :1], _NEG_INF)
+            scores, idx = lax.top_k(first.reshape(b, -1), beams)  # (b, beams)
+            tok = (idx % vocab).astype(ids.dtype)                 # (b, beams)
+            finished = (tok == eos) if eos is not None \
+                else jnp.zeros((b, beams), bool)
+            lengths = jnp.ones((b, beams), jnp.int32)
+            if track:
+                seen = seen.at[jnp.arange(bb), tok.reshape(bb)].set(True)
+
+            def body(carry, step):
+                tok, caches, off, scores, finished, lengths, seen = carry
+                logits, caches = functional_call(
+                    self, params, tok.reshape(bb)[:, None], caches, off,
+                    buffers=buffers, method="forward_with_cache")
+                lp = jax.nn.log_softmax(
+                    adjust(logits[:, -1].astype(jnp.float32), seen, step),
+                    axis=-1).reshape(b, beams, vocab)
+                # finished beams: only pad continues, at zero extra score
+                pad_row = jnp.where(jnp.arange(vocab) == pad, 0.0, _NEG_INF)
+                lp = jnp.where(finished[:, :, None], pad_row[None, None], lp)
+                cand = scores[:, :, None] + lp                # (b, beams, V)
+                scores, idx = lax.top_k(cand.reshape(b, -1), beams)
+                src = idx // vocab                            # beam origin
+                nxt = (idx % vocab).astype(tok.dtype)
+                # reorder every per-beam state to the chosen origins
+                gather = lambda x: jnp.take_along_axis(x, src, axis=1)
+                finished = gather(finished)
+                lengths = gather(lengths)
+                flat_src = (jnp.arange(b)[:, None] * beams + src).reshape(bb)
+                caches = [(k[flat_src], v[flat_src]) for k, v in caches]
+                if track:
+                    seen = seen[flat_src].at[
+                        jnp.arange(bb), nxt.reshape(bb)].set(True)
+                lengths = jnp.where(finished, lengths, lengths + 1)
+                if eos is not None:
+                    finished = finished | (nxt == eos)
+                return ((nxt, caches, off + 1, scores, finished, lengths,
+                         seen), (nxt, src))
+
+            tok0 = tok                              # position-0 tokens
+            carry = (tok, caches, jnp.int32(p), scores, finished, lengths,
+                     seen)
+            (_, _, _, scores, finished, lengths, _), (steps, origins) = \
+                lax.scan(body, carry, jnp.arange(1, n_new), length=n_new - 1)
+            # backtrack: follow each final beam's origin chain to rebuild
+            # its token sequence ((n_new-1, b, beams) steps/origins)
+            def back(carry, xs):
+                beam_idx = carry                    # (b, beams) into step t
+                step_tok, step_src = xs
+                toks = jnp.take_along_axis(step_tok, beam_idx, axis=1)
+                beam_idx = jnp.take_along_axis(step_src, beam_idx, axis=1)
+                return beam_idx, toks
+
+            init = jnp.tile(jnp.arange(beams)[None], (b, 1))
+            first_beam, rev = lax.scan(back, init, (steps, origins),
+                                       reverse=True)
+            first_tok = jnp.take_along_axis(tok0, first_beam, axis=1)
+            seqs = jnp.concatenate([first_tok[None], rev], axis=0)  # (n,b,beams)
+            seqs = jnp.moveaxis(seqs, 0, 2)                  # (b, beams, n)
+            norm = scores / (lengths.astype(jnp.float32) ** length_penalty)
+            best = jnp.argmax(norm, axis=1)                  # (b,)
+            out = jnp.take_along_axis(
+                seqs, best[:, None, None], axis=1)[:, 0]     # (b, n_new)
+            return out
 
         return gen

@@ -338,3 +338,156 @@ class TestPrefillDifferentiable:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=1e-4, atol=1e-4,
                                        err_msg=f"d{name}")
+
+
+class TestRepetitionPenaltyMinTokens:
+    def test_repetition_penalty_changes_and_matches_manual(self):
+        """Penalized greedy decode == manual eager loop applying the same
+        HF-semantics penalty over seen tokens."""
+        paddle.seed(21)
+        cfg = GPTConfig.tiny()
+        model = GPTForCausalLM(cfg)
+        rng = np.random.default_rng(3)
+        b, p, n, pen = 2, 6, 5, 1.8
+        prompt = rng.integers(0, cfg.vocab_size, (b, p)).astype(np.int32)
+        out = model.generate(paddle.to_tensor(prompt), max_new_tokens=n,
+                             do_sample=False,
+                             repetition_penalty=pen).numpy()
+
+        model.eval()
+        ids = prompt.copy()
+        for _ in range(n):
+            logits = model(paddle.to_tensor(ids)).numpy().astype(np.float32)
+            lg = logits[:, -1]
+            for r in range(b):
+                seen = np.unique(ids[r])
+                lg[r, seen] = np.where(lg[r, seen] > 0,
+                                       lg[r, seen] / pen, lg[r, seen] * pen)
+            nxt = np.argmax(lg, axis=-1).astype(np.int32)
+            ids = np.concatenate([ids, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(out, ids)
+
+    def test_min_new_tokens_blocks_eos(self):
+        paddle.seed(22)
+        cfg = GPTConfig.tiny()
+        model = GPTForCausalLM(cfg)
+        rng = np.random.default_rng(4)
+        prompt = paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size, (2, 6)).astype(np.int32))
+        # find the unconstrained greedy first token, use it as "eos"
+        free = model.generate(prompt, max_new_tokens=1,
+                              do_sample=False).numpy()[:, -1]
+        eos = int(free[0])
+        out = model.generate(prompt, max_new_tokens=4, do_sample=False,
+                             eos_token_id=eos, min_new_tokens=3).numpy()
+        gen = out[:, 6:]
+        # eos is masked for the first 3 generated positions
+        assert not np.any(gen[:, :3] == eos)
+
+
+class TestBeamSearch:
+    def _brute_force(self, model, prompt, n, beams, eos=None, lp=1.0):
+        """Exhaustive beam search in numpy over full sequences."""
+        model.eval()
+
+        def logprobs(ids):
+            lg = model(paddle.to_tensor(ids)).numpy().astype(np.float64)
+            e = lg[:, -1] - lg[:, -1].max(-1, keepdims=True)
+            sm = e - np.log(np.exp(e).sum(-1, keepdims=True))
+            return sm
+
+        b = prompt.shape[0]
+        pad = eos if eos is not None else 0   # implementation's default pad
+        outs = []
+        for r in range(b):
+            # (tokens, score, finished, length)
+            beams_r = [((), 0.0, False, 0)]
+            for step in range(n):
+                cand = {}
+                for toks, sc, fin, ln in beams_r:
+                    if fin:
+                        # finished beams extend only with pad, score frozen
+                        cand[toks + (pad,)] = (sc, True, ln)
+                        continue
+                    ids = np.concatenate(
+                        [prompt[r:r+1], np.array([toks], np.int32)], axis=1) \
+                        if toks else prompt[r:r+1]
+                    sm = logprobs(ids)[0]
+                    for v in range(len(sm)):
+                        key = toks + (v,)
+                        fin2 = (eos is not None and v == eos)
+                        cand[key] = (sc + sm[v], fin2, ln + 1)
+                top = sorted(cand.items(), key=lambda kv: -kv[1][0])[:beams]
+                beams_r = [(k, v[0], v[1], v[2]) for k, v in top]
+            best = max(beams_r, key=lambda t: t[1] / (t[3] ** lp if t[3] else 1))
+            outs.append(best[0])
+        return np.array(outs, np.int32)
+
+    def test_beam_matches_brute_force(self):
+        paddle.seed(23)
+        # tiny vocab keeps the brute force cheap
+        cfg = GPTConfig.tiny()
+        cfg.vocab_size = 17
+        model = GPTForCausalLM(cfg)
+        rng = np.random.default_rng(5)
+        prompt = rng.integers(0, 17, (2, 4)).astype(np.int32)
+        n, beams = 3, 3
+        out = model.generate(paddle.to_tensor(prompt), max_new_tokens=n,
+                             num_beams=beams, do_sample=False,
+                             return_full_sequence=False).numpy()
+        ref = self._brute_force(model, prompt, n, beams)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_beam_beats_or_ties_greedy_logprob(self):
+        paddle.seed(24)
+        cfg = GPTConfig.tiny()
+        model = GPTForCausalLM(cfg)
+        rng = np.random.default_rng(6)
+        prompt = paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size, (2, 5)).astype(np.int32))
+
+        def seq_logprob(full, p):
+            model.eval()
+            lg = model(paddle.to_tensor(full[:, :-1])).numpy().astype(np.float64)
+            e = lg - lg.max(-1, keepdims=True)
+            sm = e - np.log(np.exp(e).sum(-1, keepdims=True))
+            tot = np.zeros(full.shape[0])
+            for j in range(p, full.shape[1]):
+                tot += sm[np.arange(full.shape[0]), j - 1, full[:, j]]
+            return tot
+
+        greedy = model.generate(prompt, max_new_tokens=4,
+                                do_sample=False).numpy()
+        beam = model.generate(prompt, max_new_tokens=4, num_beams=4,
+                              do_sample=False).numpy()
+        lp_g = seq_logprob(greedy, 5)
+        lp_b = seq_logprob(beam, 5)
+        assert np.all(lp_b >= lp_g - 1e-5), (lp_b, lp_g)
+
+    def test_beam_rejects_sampling(self):
+        cfg = GPTConfig.tiny()
+        model = GPTForCausalLM(cfg)
+        prompt = paddle.to_tensor(np.zeros((1, 4), np.int32))
+        with pytest.raises(ValueError, match="beam"):
+            model.generate(prompt, max_new_tokens=2, num_beams=2,
+                           do_sample=True)
+
+    def test_beam_with_eos_matches_brute_force(self):
+        paddle.seed(25)
+        cfg = GPTConfig.tiny()
+        cfg.vocab_size = 13
+        model = GPTForCausalLM(cfg)
+        rng = np.random.default_rng(8)
+        prompt = rng.integers(0, 13, (2, 4)).astype(np.int32)
+        n, beams = 3, 3
+        # pick the unconstrained greedy first token as eos so finished
+        # beams actually arise mid-search
+        free = model.generate(paddle.to_tensor(prompt), max_new_tokens=1,
+                              do_sample=False).numpy()[:, -1]
+        eos = int(free[0])
+        out = model.generate(paddle.to_tensor(prompt), max_new_tokens=n,
+                             num_beams=beams, do_sample=False,
+                             eos_token_id=eos,
+                             return_full_sequence=False).numpy()
+        ref = self._brute_force(model, prompt, n, beams, eos=eos)
+        np.testing.assert_array_equal(out, ref)
