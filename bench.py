@@ -516,6 +516,28 @@ def _decode_bench(model, cfg, paddle, jax) -> dict:
                     dbatch * (steps - 1) / dtb, 1)
         except Exception as e:  # best-effort extra signal
             out["decode_batched_error"] = repr(e)[:200]
+
+    # Weight-only int8 serving: decode is weight-bandwidth-bound (the
+    # bf16 single-stream number sits AT the HBM roofline), so halving
+    # weight bytes should move the roofline itself. Quantizes the model
+    # IN PLACE — this block must stay the last user of `model`.
+    if os.environ.get("BENCH_DECODE_QUANT", "1") == "1":
+        try:
+            from paddle_tpu.nn.quant import quantize_linears
+            quantize_linears(model, algo="weight_only_int8")
+            tq_full = timed(steps)
+            tq_one = timed(1)
+            dtq = tq_full - tq_one
+            if dtq > 0.05 * tq_full:
+                out["decode_tokens_per_sec_int8"] = round(
+                    (steps - 1) / dtq, 1)
+            else:
+                out["decode_tokens_per_sec_int8"] = None
+                out["decode_int8_note"] = (
+                    "prefill dominated the measurement; steady-state "
+                    "int8 rate not identifiable")
+        except Exception as e:  # best-effort extra signal
+            out["decode_int8_error"] = repr(e)[:200]
     return out
 
 

@@ -515,3 +515,78 @@ class TestWeightOnlyQuant:
                                     weight_scale=sc)
         ref = x @ w
         assert np.abs(out.numpy() - ref).max() / np.abs(ref).max() < 0.02
+
+
+class TestQuantizedLinearLayer:
+    def test_from_linear_matches_dense_closely(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn.quant import QuantizedLinear
+
+        paddle.seed(41)
+        lin = nn.Linear(64, 32)
+        x = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal((4, 64)).astype(np.float32))
+        ref = lin(x).numpy()
+        q = QuantizedLinear.from_linear(lin)
+        out = q(x).numpy()
+        rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < 2e-2, rel
+        # the stored weight is genuinely int8 (half the bytes)
+        assert q.quant_weight.numpy().dtype == np.int8
+        # buffers, not parameters: no grads wanted on the serving path
+        names = [n for n, _ in q.named_parameters()]
+        assert "quant_weight" not in names and "weight_scale" not in names
+
+    def test_quantize_linears_walks_model_and_generate_runs(self):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        from paddle_tpu.nn.quant import QuantizedLinear, quantize_linears
+
+        paddle.seed(42)
+        cfg = GPTConfig.tiny()
+        model = GPTForCausalLM(cfg)
+        prompt = paddle.to_tensor(
+            np.random.default_rng(1).integers(
+                0, cfg.vocab_size, (2, 5)).astype(np.int32))
+        full = model.generate(prompt, max_new_tokens=5,
+                              do_sample=False).numpy()
+        n_lin = sum(1 for l in model.sublayers()
+                    if type(l).__name__ == "Linear")
+        quantize_linears(model)
+        n_q = sum(1 for l in model.sublayers()
+                  if isinstance(l, QuantizedLinear))
+        assert n_q == n_lin > 0
+        q = model.generate(prompt, max_new_tokens=5, do_sample=False).numpy()
+        assert (q == full).mean() > 0.8   # int8 rarely flips the argmax
+
+    def test_int4_variant(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn.quant import QuantizedLinear
+
+        paddle.seed(43)
+        lin = nn.Linear(64, 16)
+        x = paddle.to_tensor(
+            np.random.default_rng(2).standard_normal((3, 64)).astype(np.float32))
+        ref = lin(x).numpy()
+        q = QuantizedLinear.from_linear(lin, algo="weight_only_int4")
+        assert q.quant_weight.shape == [32, 16]   # two nibbles per byte
+        out = q(x).numpy()
+        rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < 0.2, rel
+
+    def test_skip_leaves_named_layers_dense(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn.quant import quantize_linears
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.backbone = nn.Linear(8, 8)
+                self.head = nn.Linear(8, 4)
+
+            def forward(self, x):
+                return self.head(self.backbone(x))
+
+        m = M()
+        quantize_linears(m, skip=("head",))
+        assert type(m.head).__name__ == "Linear"
+        assert type(m.backbone).__name__ == "QuantizedLinear"
