@@ -29,6 +29,18 @@ def main():
     out = model.generate(prompt, max_new_tokens=24, do_sample=True,
                          top_k=8, temperature=0.9)
     print("sampled:", out.numpy()[0][:16].tolist(), "...")
+    out = model.generate(prompt, max_new_tokens=24, num_beams=4,
+                         length_penalty=0.8)
+    print("beam-4 :", out.numpy()[0][:16].tolist(), "...")
+    out = model.generate(prompt, max_new_tokens=24, do_sample=False,
+                         repetition_penalty=1.3)
+    print("penalty:", out.numpy()[0][:16].tolist(), "...")
+
+    # weight-only int8 serving: half the weight bytes per decode step
+    from paddle_tpu.nn.quant import quantize_linears
+    quantize_linears(model)
+    out = model.generate(prompt, max_new_tokens=24, do_sample=False)
+    print("int8   :", out.numpy()[0][:16].tolist(), "...")
 
 
 if __name__ == "__main__":
