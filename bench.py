@@ -41,6 +41,9 @@ def cache_env(env: dict) -> dict:
 # healthy window. 2 = pipelined steady-state window + batched decode +
 # flash 512x512 defaults (the r05 mid-round tuning).
 BENCH_SCHEMA = 2
+# same idea for the kernel-compile artifact: bump when NEW kernels join
+# the check list (2 = + paged/block-table decode attention)
+KERNELS_SCHEMA = 2
 
 
 def build_train_setup(model_name: Optional[str] = None):
@@ -95,9 +98,9 @@ def artifact_state(path: str) -> str:
       'banked'        exists, parses, zero failed checks, current schema
       'missing'       absent or unparseable
       'failed_checks' recorded per-check failures (bounded retries)
-      'stale_schema'  measured under an older bench schema (always
-                      re-benched on a healthy window; only the train
-                      artifact carries measurement-schema semantics)
+      'stale_schema'  measured under an older schema (always re-run on a
+                      healthy window; train re-benches on BENCH_SCHEMA
+                      bumps, kernels re-compiles on KERNELS_SCHEMA bumps)
     """
     if not os.path.exists(path):
         return "missing"
@@ -110,7 +113,8 @@ def artifact_state(path: str) -> str:
         return "failed_checks"
     recs = d.get("results", [])
     schema = max([r.get("bench_schema", 1) for r in recs] or [1])
-    if d.get("step") == "train" and schema < BENCH_SCHEMA:
+    current = {"train": BENCH_SCHEMA, "kernels": KERNELS_SCHEMA}
+    if schema < current.get(d.get("step"), 1):
         return "stale_schema"
     return "banked"
 

@@ -1,0 +1,305 @@
+"""Paged KV-cache attention (block tables) for serving.
+
+Reference parity target: the reference's block-attention serving op
+``paddle.incubate.nn.functional.block_multihead_attention``
+(paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu) —
+the vLLM-style PagedAttention design: the KV cache lives in fixed-size
+PAGES drawn from a shared pool, and each sequence owns a block table of
+page indices. Sequences grow without reallocation, freed pages recycle
+across requests, and HBM holds exactly ceil(len/page) pages per sequence
+instead of a max-length ring buffer.
+
+TPU-native pieces:
+  - ``paged_attention`` — Pallas decode kernel: grid (batch, kv_head,
+    page); the page index map reads the SCALAR-PREFETCHED block table, so
+    each kernel step streams one page of the pool straight from HBM (no
+    gather materialization of a contiguous per-sequence view). Online
+    softmax accumulates across pages in VMEM; GQA reads the unexpanded
+    pool at Hkv bandwidth (q heads ride the block's sublane dim).
+  - ``paged_attention_xla`` — gather-based reference (CPU tests, and the
+    fallback wherever pallas is off). Materializes the gathered view —
+    correct, but pays the copy the kernel avoids.
+  - ``PagedKVCache`` — the pool + block-table manager (allocate/append/
+    free; page reuse through a free list), with device-side page writes.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_LANES = 128
+
+
+def _interpret() -> bool:
+    from ..flags import is_tpu_backend
+    return not is_tpu_backend()
+
+
+# ------------------------------------------------------------ the kernel
+def _paged_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, out_ref,
+                  acc_ref, m_ref, l_ref, *, sm_scale: float,
+                  page_size: int, rep: int):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    seq_len = sl_ref[b]
+    n_pages = jnp.maximum((seq_len + page_size - 1) // page_size, 1)
+
+    @pl.when(j < n_pages)
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32)            # (rep, d)
+        k = k_ref[0, 0].astype(jnp.float32)            # (page, d)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # (rep, page)
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (rep, page_size), 1)
+        s = jnp.where(pos < seq_len, s, _NEG_INF)
+
+        # scratch rows are sublane-padded; compute on the first rep rows
+        m_prev = m_ref[0:rep, 0:1]
+        l_prev = l_ref[0:rep, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        m_new = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[0:rep, :] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=1, keepdims=True),
+            (rep, l_ref.shape[1]))
+        m_ref[0:rep, :] = jnp.broadcast_to(m_new, (rep, m_ref.shape[1]))
+        acc_ref[0:rep, :] = alpha * acc_ref[0:rep, :] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_pages - 1)
+    def _emit():
+        l = l_ref[0:rep, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out_ref[0, 0] = (acc_ref[0:rep, :] / l_safe).astype(out_ref.dtype)
+
+
+def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                    block_tables: jax.Array, seq_lens: jax.Array,
+                    sm_scale: Optional[float] = None) -> jax.Array:
+    """Single-token decode attention against a paged pool.
+
+    q:            (B, H, D) — one query token per sequence
+    k/v_pages:    (Hkv, num_pages, page_size, D) — the shared pool
+    block_tables: (B, max_pages) int32 — page i of sequence b is pool page
+                  ``block_tables[b, i]`` (entries past the used count are
+                  ignored; keep them 0)
+    seq_lens:     (B,) int32 — valid tokens per sequence
+    Returns (B, H, D) in q's dtype.
+    """
+    b, h, d = q.shape
+    hkv, _, page_size, _ = k_pages.shape
+    if h % hkv:
+        raise ValueError(f"query heads {h} not divisible by kv heads {hkv}")
+    rep = h // hkv
+    max_pages = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+
+    qg = q.reshape(b, hkv, rep, d)
+    bt = jnp.asarray(block_tables, jnp.int32)
+    sl = jnp.asarray(seq_lens, jnp.int32)
+
+    def q_index(b_, h_, j, bt_ref, sl_ref):
+        return (b_, h_, 0, 0)
+
+    def kv_index(b_, h_, j, bt_ref, sl_ref):
+        return (h_, bt_ref[b_, j], 0, 0)
+
+    rep_pad = -(-rep // 8) * 8
+    grid = (b, hkv, max_pages)
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, sm_scale=float(sm_scale),
+                          page_size=page_size, rep=rep),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, rep, d), q_index),
+                pl.BlockSpec((1, 1, page_size, d), kv_index),
+                pl.BlockSpec((1, 1, page_size, d), kv_index),
+            ],
+            out_specs=pl.BlockSpec((1, 1, rep, d), q_index),
+            scratch_shapes=[
+                pltpu.VMEM((rep_pad, d), jnp.float32),       # acc
+                pltpu.VMEM((rep_pad, _LANES), jnp.float32),  # m
+                pltpu.VMEM((rep_pad, _LANES), jnp.float32),  # l
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rep, d), q.dtype),
+        interpret=_interpret(),
+    )(bt, sl, qg, k_pages, v_pages)
+    return out.reshape(b, h, d)
+
+
+def paged_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
+                        sm_scale=None):
+    """Gather-based reference: materializes each sequence's contiguous
+    view (the copy the Pallas kernel avoids), then masked attention."""
+    b, h, d = q.shape
+    hkv, _, page_size, _ = k_pages.shape
+    rep = h // hkv
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    bt = jnp.asarray(block_tables, jnp.int32)
+    sl = jnp.asarray(seq_lens, jnp.int32)
+    # (Hkv, B, max_pages, page, D) -> (B, T, Hkv, D)
+    k = jnp.moveaxis(k_pages[:, bt], 1, 0)
+    v = jnp.moveaxis(v_pages[:, bt], 1, 0)
+    t = k.shape[2] * page_size
+    k = k.reshape(b, hkv, t, d)
+    v = v.reshape(b, hkv, t, d)
+    qg = q.reshape(b, hkv, rep, d).astype(jnp.float32)
+    s = jnp.einsum("bhrd,bhtd->bhrt", qg, k.astype(jnp.float32)) * sm_scale
+    mask = jnp.arange(t)[None, :] < sl[:, None]
+    s = jnp.where(mask[:, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhrt,bhtd->bhrd", p, v.astype(jnp.float32))
+    return out.reshape(b, h, d).astype(q.dtype)
+
+
+# ------------------------------------------------------- pool management
+def write_paged_kv(k_pages, v_pages, k_new, v_new, block_tables, positions):
+    """Write one token per sequence into the pool at absolute sequence
+    ``positions`` ((B,) int32). k_new/v_new: (B, Hkv, D). Device-side
+    scatter via the block tables; returns the updated pools."""
+    bt = jnp.asarray(block_tables, jnp.int32)
+    pos = jnp.asarray(positions, jnp.int32)
+    b = pos.shape[0]
+    page_size = k_pages.shape[2]
+    page_of = jnp.take_along_axis(bt, (pos // page_size)[:, None],
+                                  axis=1)[:, 0]            # (B,)
+    off = pos % page_size
+    kt = jnp.moveaxis(k_new.astype(k_pages.dtype), 0, 1)   # (Hkv, B, D)
+    vt = jnp.moveaxis(v_new.astype(v_pages.dtype), 0, 1)
+    k_pages = k_pages.at[:, page_of, off].set(kt)
+    v_pages = v_pages.at[:, page_of, off].set(vt)
+    return k_pages, v_pages
+
+
+def write_paged_prompt(k_pages, v_pages, k_new, v_new, block_tables):
+    """Prefill write: k_new/v_new (B, S, Hkv, D) go to positions [0, S)
+    of each sequence. Returns the updated pools."""
+    bt = jnp.asarray(block_tables, jnp.int32)
+    b, s, hkv, d = k_new.shape
+    page_size = k_pages.shape[2]
+    pos = jnp.arange(s, dtype=jnp.int32)
+    pages = jnp.take_along_axis(
+        bt, (pos // page_size)[None, :].repeat(b, 0), axis=1)  # (B, S)
+    off = (pos % page_size)[None, :].repeat(b, 0)
+    kt = jnp.moveaxis(k_new.astype(k_pages.dtype), 2, 0)   # (Hkv, B, S, D)
+    vt = jnp.moveaxis(v_new.astype(v_pages.dtype), 2, 0)
+    k_pages = k_pages.at[:, pages, off].set(kt)
+    v_pages = v_pages.at[:, pages, off].set(vt)
+    return k_pages, v_pages
+
+
+class PagedKVCache:
+    """Host-side page-pool manager: one pool per transformer layer, a
+    block table per live sequence, and a free list that recycles pages
+    across requests (the continuous-batching substrate)."""
+
+    def __init__(self, num_layers: int, num_pages: int, page_size: int,
+                 num_kv_heads: int, head_dim: int, max_batch: int,
+                 max_seq_len: int, dtype=jnp.bfloat16):
+        if page_size % 8:
+            raise ValueError("page_size must be a multiple of 8 (TPU "
+                             "sublane tile)")
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.max_pages_per_seq = -(-max_seq_len // page_size)
+        self.k_pages: List[jax.Array] = [
+            jnp.zeros((num_kv_heads, num_pages, page_size, head_dim), dtype)
+            for _ in range(num_layers)]
+        self.v_pages: List[jax.Array] = [
+            jnp.zeros((num_kv_heads, num_pages, page_size, head_dim), dtype)
+            for _ in range(num_layers)]
+        self.block_tables = np.zeros((max_batch, self.max_pages_per_seq),
+                                     np.int32)
+        self.seq_lens = np.zeros((max_batch,), np.int32)
+        self._pages_used = np.zeros((max_batch,), np.int32)
+        self._free = list(range(num_pages - 1, -1, -1))
+
+    # ------------------------------------------------------------- admin
+    def free_page_count(self) -> int:
+        return len(self._free)
+
+    def allocate(self, seq_idx: int, n_tokens: int) -> None:
+        """Ensure sequence ``seq_idx`` has pages for ``n_tokens`` more
+        tokens; raises RuntimeError when the pool is exhausted (the
+        caller's scheduler decides eviction — same contract as the
+        reference's block manager)."""
+        need = -(-(int(self.seq_lens[seq_idx]) + n_tokens)
+                 // self.page_size)
+        have = int(self._pages_used[seq_idx])
+        if need > self.block_tables.shape[1]:
+            raise RuntimeError(
+                f"sequence {seq_idx} needs {need} pages > max_pages_per_seq "
+                f"{self.block_tables.shape[1]}")
+        for i in range(have, need):
+            if not self._free:
+                # pages popped so far are already recorded in _pages_used
+                # below, so an evict-and-retry caller cannot leak them
+                raise RuntimeError("page pool exhausted")
+            self.block_tables[seq_idx, i] = self._free.pop()
+            self._pages_used[seq_idx] = i + 1
+
+    def free_sequence(self, seq_idx: int) -> None:
+        n = int(self._pages_used[seq_idx])
+        for i in range(n):
+            self._free.append(int(self.block_tables[seq_idx, i]))
+        self.block_tables[seq_idx, :n] = 0
+        self._pages_used[seq_idx] = 0
+        self.seq_lens[seq_idx] = 0
+
+    # ----------------------------------------------------------- writing
+    def prefill(self, layer: int, seq_ids, k_new, v_new) -> None:
+        """Write prompts for the (sub)batch ``seq_ids``; call
+        ``allocate`` first. On layer 0 the seq_lens advance."""
+        bt = jnp.asarray(self.block_tables[seq_ids])
+        self.k_pages[layer], self.v_pages[layer] = write_paged_prompt(
+            self.k_pages[layer], self.v_pages[layer], k_new, v_new, bt)
+        if layer == 0:
+            self.seq_lens[seq_ids] = k_new.shape[1]
+
+    def append(self, layer: int, seq_ids, k_new, v_new) -> None:
+        """Write one decode token per sequence of ``seq_ids`` at position
+        ``seq_lens`` (call ``advance`` once per token AFTER all layers)."""
+        bt = jnp.asarray(self.block_tables[seq_ids])
+        pos = jnp.asarray(self.seq_lens[seq_ids])
+        self.k_pages[layer], self.v_pages[layer] = write_paged_kv(
+            self.k_pages[layer], self.v_pages[layer], k_new, v_new, bt, pos)
+
+    def advance(self, seq_ids) -> None:
+        self.seq_lens[seq_ids] += 1
+
+    # ---------------------------------------------------------- attention
+    def attend(self, layer: int, q, seq_ids) -> jax.Array:
+        """Decode attention of q (B, H, D) for ``seq_ids`` against this
+        layer's pool (lengths INCLUDE any token just appended)."""
+        from ..flags import get_flag
+        bt = jnp.asarray(self.block_tables[seq_ids])
+        sl = jnp.asarray(self.seq_lens[seq_ids] + 1)
+        fn = paged_attention if get_flag("use_pallas") else paged_attention_xla
+        return fn(q, self.k_pages[layer], self.v_pages[layer], bt, sl)

@@ -30,6 +30,7 @@ sys.path.insert(0, REPO)
 import bench as bench_mod
 
 ROUND = os.environ.get("CHIP_SPRINT_ROUND", "r05")
+KERNELS_SCHEMA = bench_mod.KERNELS_SCHEMA
 
 
 def base_env(test_mode: bool) -> dict:
@@ -169,15 +170,17 @@ def step_kernels() -> list:
     check("flash_prefill", jax.jit(flash_prefill), qp, kc, vc,
           jnp.asarray(512, jnp.int32))
 
-    def prefill_parity(qp, kc, vc):
-        ref = cached_attention_dense(qp, kc, vc, 512)
-        got = flash_prefill(qp, kc, vc, 512)
+    def parity(ref_fn, got_fn, *args, tol=0.05):
+        ref, got = ref_fn(*args), got_fn(*args)
         err = float(jnp.max(jnp.abs(ref.astype(jnp.float32)
                                     - got.astype(jnp.float32))))
-        if err >= 0.05:
-            raise AssertionError(f"max_abs_err {err:.5f} >= 0.05")
+        if err >= tol:
+            raise AssertionError(f"max_abs_err {err:.5f} >= {tol}")
         return err
-    check("flash_prefill_parity_vs_dense", prefill_parity, qp, kc, vc)
+
+    check("flash_prefill_parity_vs_dense", parity,
+          lambda *a: cached_attention_dense(*a, 512),
+          lambda *a: flash_prefill(*a, 512), qp, kc, vc)
 
     # rms_norm pallas fwd + bwd (f32: the kernel's reference dtype)
     x = jnp.asarray(rng.standard_normal((b * s, 1024)), jnp.float32)
@@ -189,6 +192,24 @@ def step_kernels() -> list:
         return jax.jit(jax.grad(f, argnums=(0, 1)))(x, w)
     check("rms_norm_bwd", rms_bwd, x, w)
 
+    # paged (block-table) decode attention — the serving-path kernel with
+    # scalar-prefetched page index maps (kernels schema 2)
+    from paddle_tpu.kernels.paged_attention import (paged_attention,
+                                                    paged_attention_xla)
+    hkv, page, num_pages = 2, 64, 32
+    kp = mk(hkv, num_pages, page, d)
+    vp = mk(hkv, num_pages, page, d)
+    qd = mk(4, h, d)
+    bt = jnp.asarray(rng.permutation(num_pages)[:4 * 8].reshape(4, 8),
+                     jnp.int32)
+    sl = jnp.asarray([500, 512, 37, 129], jnp.int32)
+    check("paged_attention", jax.jit(paged_attention), qd, kp, vp, bt, sl)
+
+    check("paged_attention_parity_vs_xla", parity,
+          paged_attention_xla, paged_attention, qd, kp, vp, bt, sl)
+
+    for r in results:
+        r["bench_schema"] = KERNELS_SCHEMA
     return results
 
 
