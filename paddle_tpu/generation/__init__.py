@@ -166,6 +166,103 @@ class GenerationMixin:
             if return_full_sequence else toks
         return Tensor(out, stop_gradient=True)
 
+    def generate_paged(self, input_ids, max_new_tokens: int = 32,
+                       page_size: int = 64, num_pages: Optional[int] = None,
+                       eos_token_id: Optional[int] = None,
+                       pad_token_id: Optional[int] = None,
+                       return_full_sequence: bool = True):
+        """Greedy decode against a PAGED KV cache (reference:
+        block_multihead_attention serving). Unlike ``generate`` (one scan,
+        ring buffers), the token loop runs on the host with ONE jitted
+        step — the structure real serving needs: between tokens a
+        scheduler may admit/evict sequences by editing block tables, and
+        the pool is shared across requests. Numerics match ``generate``'s
+        greedy path exactly (tested)."""
+        from ..core.tensor import Tensor
+        from ..jit import ensure_live, functional_call
+        from ..kernels.paged_attention import PagedDecodeState, PagedKVCache
+
+        ids_val = (input_ids._value if isinstance(input_ids, Tensor)
+                   else jnp.asarray(input_ids))
+        b, p = ids_val.shape
+        n_new = int(max_new_tokens)
+        total = p + n_new
+        maxpos = getattr(getattr(self, "config", None),
+                         "max_position_embeddings", None)
+        if maxpos is not None and total > maxpos:
+            raise ValueError(
+                f"prompt ({p}) + max_new_tokens ({n_new}) = {total} "
+                f"exceeds max_position_embeddings ({maxpos})")
+        if n_new == 0:
+            return Tensor(ids_val if return_full_sequence
+                          else ids_val[:, :0], stop_gradient=True)
+        spec = self.cache_spec()
+        if num_pages is None:
+            num_pages = b * (-(-total // page_size))
+        if pad_token_id is None:
+            pad_token_id = eos_token_id if eos_token_id is not None else 0
+
+        was_training = self.training
+        self.eval()
+        try:
+            params, buffers = self.raw_state()
+            ensure_live(params, "call step.sync_to_model() before "
+                                "generate_paged().")
+            dtype = jnp.result_type(next(iter(params.values())))
+            mgr = PagedKVCache(
+                num_layers=len(spec), num_pages=num_pages,
+                page_size=page_size, num_kv_heads=spec[0][0],
+                head_dim=spec[0][1], max_batch=b, max_seq_len=total,
+                dtype=dtype)
+            for s_ in range(b):
+                mgr.allocate(s_, total)
+            bt = jnp.asarray(mgr.block_tables[:b])
+            zeros = jnp.zeros((b,), jnp.int32)
+            states = [PagedDecodeState(mgr.k_pages[i], mgr.v_pages[i],
+                                       bt, zeros)
+                      for i in range(len(spec))]
+
+            cache = getattr(self, "_generate_jit_cache", None)
+            if cache is None:
+                cache = self._generate_jit_cache = {}
+            sig = ("paged", b, p, page_size, num_pages)
+            fns = cache.get(sig)
+            if fns is None:
+                def run(params, buffers, ids, states, offset):
+                    logits, states = functional_call(
+                        self, params, ids, states, offset, buffers=buffers,
+                        method="forward_with_cache")
+                    return jnp.argmax(
+                        logits[:, -1].astype(jnp.float32), axis=-1), states
+
+                # one wrapper serves both phases (S=p and S=1 retrace
+                # under the same jit); cached per signature like generate
+                fns = cache[sig] = jax.jit(run)
+            prefill = step = fns
+            tok, states = prefill(params, buffers, ids_val, states,
+                                  jnp.int32(0))
+            tok = tok.astype(ids_val.dtype)
+            toks = [tok]
+            finished = ((tok == eos_token_id) if eos_token_id is not None
+                        else jnp.zeros((b,), bool))
+            for i in range(1, n_new):
+                nxt, states = step(params, buffers, tok[:, None], states,
+                                   jnp.int32(p + i - 1))
+                nxt = nxt.astype(tok.dtype)
+                nxt = jnp.where(finished,
+                                jnp.asarray(pad_token_id, tok.dtype), nxt)
+                if eos_token_id is not None:
+                    finished = finished | (nxt == eos_token_id)
+                toks.append(nxt)
+                tok = nxt
+            gen = jnp.stack(toks, axis=1)
+        finally:
+            if was_training:
+                self.train()
+        out = (jnp.concatenate([ids_val, gen], axis=1)
+               if return_full_sequence else gen)
+        return Tensor(out, stop_gradient=True)
+
     def _build_generate(self, b, p, n_new, do_sample, top_k,
                         eos_token_id, pad_token_id,
                         repetition_penalty=1.0, min_new_tokens=0):

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import functools
 import math
-from typing import List, Optional, Tuple
+from typing import Any, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -38,6 +38,17 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 _LANES = 128
+
+
+class PagedDecodeState(NamedTuple):
+    """One layer's paged cache as it rides a jitted decode step: the pool
+    pair, the block tables, and the per-sequence written-token counts.
+    A NamedTuple (= pytree) so it threads through jit/functional_call the
+    same way the ring-buffer (k_cache, v_cache) tuples do."""
+    k_pages: Any
+    v_pages: Any
+    block_tables: Any
+    seq_lens: Any
 
 
 def _interpret() -> bool:

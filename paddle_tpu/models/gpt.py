@@ -76,9 +76,15 @@ class GPTSelfAttention(Layer):
         self.attn_drop_p = config.attention_probs_dropout_prob
 
     def forward(self, x, attn_mask=None, cache=None):
+        from ..kernels.paged_attention import PagedDecodeState
+
         b, s, h = x.shape
         qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = ops.unbind(qkv, axis=2)
+        if cache is not None and isinstance(cache[0], PagedDecodeState):
+            state, _offset = cache
+            out, state = F.paged_scaled_dot_product_attention(q, k, v, state)
+            return self.out_proj(out.reshape([b, s, h])), state
         if cache is not None:
             k_cache, v_cache, offset = cache
             out, k_cache, v_cache = F.cached_scaled_dot_product_attention(
@@ -149,9 +155,14 @@ class GPTModel(Layer):
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
         if caches is not None:
+            from ..kernels.paged_attention import PagedDecodeState
             new_caches = []
-            for block, (kc, vc) in zip(self.h, caches):
-                x, nc = block(x, attn_mask, cache=(kc, vc, offset))
+            for block, entry in zip(self.h, caches):
+                if isinstance(entry, PagedDecodeState):
+                    x, nc = block(x, attn_mask, cache=(entry, offset))
+                else:
+                    kc, vc = entry
+                    x, nc = block(x, attn_mask, cache=(kc, vc, offset))
                 new_caches.append(nc)
             return self.ln_f(x), new_caches
         for block in self.h:

@@ -80,15 +80,23 @@ class LlamaAttention(Layer):
         self.o_proj = Linear(self.num_heads * self.head_dim, h, weight_attr=init, bias_attr=False)
 
     def forward(self, x, attn_mask=None, position_ids=None, cache=None):
+        from ..kernels.paged_attention import PagedDecodeState
+
         b, s, _ = x.shape
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        paged = cache is not None and isinstance(cache[0], PagedDecodeState)
         if cache is not None and position_ids is None:
-            _, _, offset = cache
+            offset = cache[1] if paged else cache[2]
             position_ids = (ops.arange(s, dtype="int32") + offset).unsqueeze(0)
         q, k, _ = FF.fused_rotary_position_embedding(
             q, k, None, position_ids=position_ids, rotary_emb_base=self.rope_theta)
+        if paged:
+            state, _offset = cache
+            out, state = F.paged_scaled_dot_product_attention(q, k, v, state)
+            return self.o_proj(out.reshape(
+                [b, s, self.num_heads * self.head_dim])), state
         if cache is not None:
             k_cache, v_cache, offset = cache
             out, k_cache, v_cache = F.cached_scaled_dot_product_attention(
@@ -157,9 +165,16 @@ class LlamaModel(Layer):
                 caches=None, offset=None):
         x = self.embed_tokens(input_ids)
         if caches is not None:
+            from ..kernels.paged_attention import PagedDecodeState
             new_caches = []
-            for layer, (kc, vc) in zip(self.layers, caches):
-                x, nc = layer(x, attn_mask, position_ids, cache=(kc, vc, offset))
+            for layer, entry in zip(self.layers, caches):
+                if isinstance(entry, PagedDecodeState):
+                    x, nc = layer(x, attn_mask, position_ids,
+                                  cache=(entry, offset))
+                else:
+                    kc, vc = entry
+                    x, nc = layer(x, attn_mask, position_ids,
+                                  cache=(kc, vc, offset))
                 new_caches.append(nc)
             return self.norm(x), new_caches
         for layer in self.layers:

@@ -559,6 +559,54 @@ def cached_scaled_dot_product_attention(query, key, value, k_cache, v_cache,
                     offset)
 
 
+def paged_scaled_dot_product_attention(query, key, value, state):
+    """Paged (block-table) variant of the decode attention (reference:
+    block_multihead_attention's two phases). ``state`` is a per-layer
+    :class:`~paddle_tpu.kernels.paged_attention.PagedDecodeState`.
+
+    Prefill (S > 1, empty cache): the prompt attends causally to ITSELF
+    (no cache read needed), then its k/v write into the pool pages.
+    Decode (S == 1): the token writes at position ``seq_lens`` and
+    attends against the pool through the Pallas block-table kernel (XLA
+    gather fallback when pallas is off). Returns ``(out, new_state)``."""
+    from .. import flags
+    from ..kernels.decode_attention import cached_attention
+    from ..kernels.paged_attention import (PagedDecodeState, paged_attention,
+                                           paged_attention_xla,
+                                           write_paged_kv,
+                                           write_paged_prompt)
+
+    use_pallas = flags.get_flag("use_pallas") and flags.is_tpu_backend()
+
+    def fn(qv, kv, vv, kp, vp, bt, sl):
+        s = qv.shape[1]
+        if s > 1:
+            # prefill contract: the sequences must be EMPTY (chunked
+            # prefill would need cache-reading attention). Enforce it
+            # whenever the lengths are concrete (eager prototyping);
+            # under jit the docstring contract applies.
+            if not isinstance(sl, jax.core.Tracer) and int(jnp.max(sl)):
+                raise ValueError(
+                    "paged prefill (S > 1) requires empty sequences "
+                    f"(seq_lens all 0); got max {int(jnp.max(sl))}. "
+                    "Decode tokens one at a time after the prompt.")
+            kp2, vp2 = write_paged_prompt(kp, vp, kv, vv, bt)
+            # the prompt is the whole valid cache: causal self-attention
+            out = cached_attention(qv, kv, vv, s)
+            sl2 = sl + s
+        else:
+            kp2, vp2 = write_paged_kv(kp, vp, kv[:, 0], vv[:, 0], bt, sl)
+            attend = paged_attention if use_pallas else paged_attention_xla
+            out = attend(qv[:, 0], kp2, vp2, bt, sl + 1)[:, None]
+            sl2 = sl + 1
+        return out, kp2, vp2, sl2
+
+    out, kp2, vp2, sl2 = apply_op(
+        "paged_sdpa", fn, query, key, value,
+        state.k_pages, state.v_pages, state.block_tables, state.seq_lens)
+    return out, PagedDecodeState(kp2, vp2, state.block_tables, sl2)
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
                                  name=None):
