@@ -263,6 +263,174 @@ class GenerationMixin:
                if return_full_sequence else gen)
         return Tensor(out, stop_gradient=True)
 
+    def generate_speculative(self, input_ids, draft_model,
+                             max_new_tokens: int = 32,
+                             num_speculative_tokens: int = 4,
+                             return_full_sequence: bool = True):
+        """Greedy speculative decoding (reference ecosystem: PaddleNLP
+        speculative/draft-model inference; Leviathan et al.): a small
+        ``draft_model`` proposes ``num_speculative_tokens`` tokens per
+        round, the target verifies them in ONE cached forward, and the
+        longest agreeing prefix plus the target's correction are
+        accepted. Greedy speculation is LOSSLESS — the output equals
+        ``generate(..., do_sample=False)`` token for token (tested);
+        rounds cost one draft pass + one target pass for up to γ+1
+        tokens of progress.
+
+        Cache discipline: both models keep static ring buffers; rejected
+        positions simply hold garbage k/v beyond the valid length and
+        are overwritten by later writes (attention masks at the valid
+        length). Round invariants — target cache holds ``seq[:L-1]``,
+        draft cache holds ``seq[:L-1]`` too (the draft consumed exactly
+        the accepted prefix minus the newest token: ``M = L_old + a``
+        and ``L = L_old + a + 1`` keep ``L - M == 1`` every round) — so
+        each round is ONE single-token draft feed + g-1 scan proposals
+        + ONE (g+1)-token target verify, all from cached compilations.
+        Single-sequence only (per-row acceptance lengths diverge in a
+        batch); no eos short-circuit (decode runs to max_new_tokens)."""
+        import numpy as np
+
+        from ..core.tensor import Tensor
+        from ..jit import ensure_live, functional_call
+
+        g = int(num_speculative_tokens)
+        ids_val = (input_ids._value if isinstance(input_ids, Tensor)
+                   else jnp.asarray(input_ids))
+        b, p = ids_val.shape
+        if b != 1:
+            raise ValueError("generate_speculative supports batch=1 "
+                             "(per-row acceptance lengths diverge)")
+        n_new = int(max_new_tokens)
+        cap = p + n_new + g + 2   # slack: a round may overshoot n_new
+        maxpos = getattr(getattr(self, "config", None),
+                         "max_position_embeddings", None)
+        if maxpos is not None and cap > maxpos:
+            raise ValueError(
+                f"prompt ({p}) + max_new_tokens ({n_new}) + speculative "
+                f"slack ({g + 2}) = {cap} exceeds "
+                f"max_position_embeddings ({maxpos})")
+
+        def setup(model):
+            params, buffers = model.raw_state()
+            ensure_live(params, "call step.sync_to_model() first.")
+            dtype = jnp.result_type(next(iter(params.values())))
+            caches = [(jnp.zeros((1, cap, hkv, d), dtype),
+                       jnp.zeros((1, cap, hkv, d), dtype))
+                      for hkv, d in model.cache_spec()]
+            return params, buffers, caches
+
+        def build_fns():
+            @jax.jit
+            def prefill_t(params, buffers, ids, caches):
+                logits, caches = functional_call(
+                    self, params, ids, caches, jnp.int32(0),
+                    buffers=buffers, method="forward_with_cache")
+                return jnp.argmax(logits[0, -1].astype(jnp.float32)), caches
+
+            @jax.jit
+            def prefill_d(params, buffers, ids, caches):
+                _, caches = functional_call(
+                    draft_model, params, ids, caches, jnp.int32(0),
+                    buffers=buffers, method="forward_with_cache")
+                return caches
+
+            @jax.jit
+            def draft_round(params, buffers, tok_in, offset, caches):
+                """Feed the newest accepted token at ``offset`` (the
+                draft's only gap — see the L-M invariant), then propose
+                g greedy tokens."""
+                logits, caches = functional_call(
+                    draft_model, params, tok_in[None, None], caches,
+                    offset, buffers=buffers, method="forward_with_cache")
+                tok = jnp.argmax(
+                    logits[0, -1].astype(jnp.float32)).astype(tok_in.dtype)
+
+                def body(carry, i):
+                    tok, caches = carry
+                    lg, caches = functional_call(
+                        draft_model, params, tok[None, None], caches,
+                        offset + 1 + i, buffers=buffers,
+                        method="forward_with_cache")
+                    nxt = jnp.argmax(
+                        lg[0, -1].astype(jnp.float32)).astype(tok.dtype)
+                    return (nxt, caches), tok
+
+                (last, caches), emitted = lax.scan(
+                    body, (tok, caches), jnp.arange(g - 1, dtype=jnp.int32))
+                return jnp.append(emitted, last), caches
+
+            @jax.jit
+            def verify_round(params, buffers, chunk, offset, caches):
+                """Target forward over [seq[L-1], d1..dg]: greedy picks
+                AFTER each prefix."""
+                logits, caches = functional_call(
+                    self, params, chunk, caches, offset, buffers=buffers,
+                    method="forward_with_cache")
+                return jnp.argmax(
+                    logits[0].astype(jnp.float32), axis=-1), caches
+
+            return prefill_t, prefill_d, draft_round, verify_round
+
+        cache = getattr(self, "_generate_jit_cache", None)
+        if cache is None:
+            cache = self._generate_jit_cache = {}
+        sig = ("spec", p, g, cap)
+        entry = cache.get(sig)
+        # the jitted fns close over draft_model: rebuild if the caller
+        # passes a different draft (identity-checked, not id()-keyed)
+        if entry is None or entry[0] is not draft_model:
+            entry = (draft_model, build_fns())
+            cache[sig] = entry
+        prefill_t, prefill_d, draft_round, verify_round = entry[1]
+
+        was_training = (self.training, draft_model.training)
+        self.eval()
+        draft_model.eval()
+        try:
+            tp, tb, t_caches = setup(self)
+            dp, db, d_caches = setup(draft_model)
+
+            # prompt
+            first, t_caches = prefill_t(tp, tb, ids_val, t_caches)
+            d_caches = prefill_d(dp, db, ids_val, d_caches)
+            np_ids = np.asarray(ids_val)
+            idt = ids_val.dtype
+            seq = list(np_ids[0])
+            seq.append(int(first))
+            L = len(seq)     # accepted length; both caches hold seq[:L-1]
+
+            vchunk = np.zeros((1, g + 1), np_ids.dtype)
+            while len(seq) - p < n_new:
+                props, d_caches = draft_round(
+                    dp, db, jnp.asarray(seq[L - 1], idt),
+                    jnp.int32(L - 1), d_caches)
+                props_np = np.asarray(props)[:g]
+
+                vchunk[0, 0] = seq[L - 1]
+                vchunk[0, 1:g + 1] = props_np
+                greedy, t_caches = verify_round(
+                    tp, tb, jnp.asarray(vchunk, idt), jnp.int32(L - 1),
+                    t_caches)
+                greedy_np = np.asarray(greedy)
+
+                a = 0
+                while a < g and int(props_np[a]) == int(greedy_np[a]):
+                    a += 1
+                seq.extend([int(x) for x in props_np[:a]])
+                seq.append(int(greedy_np[a]))
+                L = len(seq)
+
+            gen = jnp.asarray(np.asarray(seq[p:p + n_new],
+                                         np_ids.dtype))[None, :]
+        finally:
+            if was_training[0]:
+                self.train()
+            if was_training[1]:
+                draft_model.train()
+        out = (jnp.concatenate([ids_val, gen], axis=1)
+               if return_full_sequence else gen)
+        return Tensor(out, stop_gradient=True)
+
     def _build_generate(self, b, p, n_new, do_sample, top_k,
                         eos_token_id, pad_token_id,
                         repetition_penalty=1.0, min_new_tokens=0):

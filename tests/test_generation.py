@@ -491,3 +491,77 @@ class TestBeamSearch:
                              return_full_sequence=False).numpy()
         ref = self._brute_force(model, prompt, n, beams, eos=eos)
         np.testing.assert_array_equal(out, ref)
+
+
+class TestSpeculativeDecode:
+    """Greedy speculative decoding is LOSSLESS: the output must equal the
+    target-only greedy decode token for token, for any draft model."""
+
+    def test_smaller_draft_is_lossless(self):
+        paddle.seed(61)
+        cfg = GPTConfig.tiny()
+        target = GPTForCausalLM(cfg)
+        # a genuinely weaker draft: half the width, one layer
+        paddle.seed(62)
+        dcfg = GPTConfig(vocab_size=cfg.vocab_size, hidden_size=32,
+                         num_hidden_layers=1, num_attention_heads=2,
+                         max_position_embeddings=128)
+        draft = GPTForCausalLM(dcfg)
+        prompt = paddle.to_tensor(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (1, 6)).astype(np.int32))
+        ref = target.generate(prompt, max_new_tokens=9,
+                              do_sample=False).numpy()
+        spec = target.generate_speculative(
+            prompt, draft, max_new_tokens=9,
+            num_speculative_tokens=3).numpy()
+        np.testing.assert_array_equal(ref, spec)
+
+    def test_self_draft_accepts_everything(self):
+        paddle.seed(63)
+        cfg = GPTConfig.tiny()
+        target = GPTForCausalLM(cfg)
+        prompt = paddle.to_tensor(np.random.default_rng(1).integers(
+            0, cfg.vocab_size, (1, 5)).astype(np.int32))
+        ref = target.generate(prompt, max_new_tokens=8,
+                              do_sample=False).numpy()
+        spec = target.generate_speculative(
+            prompt, target, max_new_tokens=8,
+            num_speculative_tokens=4).numpy()
+        np.testing.assert_array_equal(ref, spec)
+
+    def test_gamma_one_edge(self):
+        paddle.seed(64)
+        cfg = GPTConfig.tiny()
+        target = GPTForCausalLM(cfg)
+        paddle.seed(65)
+        draft = GPTForCausalLM(cfg)
+        prompt = paddle.to_tensor(np.random.default_rng(2).integers(
+            0, cfg.vocab_size, (1, 4)).astype(np.int32))
+        ref = target.generate(prompt, max_new_tokens=6,
+                              do_sample=False).numpy()
+        spec = target.generate_speculative(
+            prompt, draft, max_new_tokens=6,
+            num_speculative_tokens=1).numpy()
+        np.testing.assert_array_equal(ref, spec)
+
+    def test_llama_gqa_target(self):
+        paddle.seed(66)
+        cfg = LlamaConfig.tiny()
+        target = LlamaForCausalLM(cfg)
+        paddle.seed(67)
+        draft = LlamaForCausalLM(cfg)
+        prompt = paddle.to_tensor(np.random.default_rng(3).integers(
+            0, cfg.vocab_size, (1, 5)).astype(np.int32))
+        ref = target.generate(prompt, max_new_tokens=7,
+                              do_sample=False).numpy()
+        spec = target.generate_speculative(
+            prompt, draft, max_new_tokens=7,
+            num_speculative_tokens=3).numpy()
+        np.testing.assert_array_equal(ref, spec)
+
+    def test_batch_rejected(self):
+        cfg = GPTConfig.tiny()
+        target = GPTForCausalLM(cfg)
+        prompt = paddle.to_tensor(np.zeros((2, 4), np.int32))
+        with pytest.raises(ValueError, match="batch=1"):
+            target.generate_speculative(prompt, target, max_new_tokens=2)
