@@ -1,0 +1,224 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+Reference parity target: the reference ecosystem's block-attention
+serving runtime (PaddleNLP llm serving over block_multihead_attention /
+the vLLM scheduler design): requests ADMIT into free batch slots the
+moment one opens, every decode step runs the whole fixed-shape batch with
+per-slot ragged lengths, and finished sequences return their pages to the
+shared pool for the next request.
+
+TPU-native structure: exactly TWO compiled programs serve steady state —
+a b=1 prefill per distinct prompt length (bucketable) and ONE fixed-shape
+decode step over max_batch slots. Ragged per-slot positions ride the
+paged kernel's seq_lens; idle slots write into the reserved null page and
+their outputs are ignored. The host loop between tokens is where the
+scheduler lives — admission, eviction, and result collection are plain
+Python on block tables.
+
+Greedy decoding (the deterministic serving mode); sampling composes the
+same way via the logits hook.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..kernels.paged_attention import PagedDecodeState, PagedKVCache
+
+__all__ = ["ServingEngine", "Request"]
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt: np.ndarray                  # (P,) int32
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    tokens: List[int] = field(default_factory=list)
+    slot: Optional[int] = None
+
+
+class ServingEngine:
+    """Drive ``model`` (a GenerationMixin Layer) as a continuous-batching
+    server. ``submit`` enqueues; each ``step`` admits waiting requests
+    into free slots and decodes one token for every active slot;
+    ``run`` steps until drained and returns {rid: tokens}."""
+
+    def __init__(self, model, max_batch: int = 4, page_size: int = 64,
+                 num_pages: Optional[int] = None, max_seq_len: int = 1024):
+        from ..jit import ensure_live
+
+        self.model = model
+        self.max_batch = max_batch
+        self.max_seq_len = max_seq_len
+        spec = model.cache_spec()
+        if num_pages is None:
+            num_pages = 1 + max_batch * (-(-max_seq_len // page_size))
+        params, buffers = model.raw_state()
+        ensure_live(params, "call step.sync_to_model() first.")
+        self._params, self._buffers = params, buffers
+        dtype = jnp.result_type(next(iter(params.values())))
+        self.pool = PagedKVCache(
+            num_layers=len(spec), num_pages=num_pages, page_size=page_size,
+            num_kv_heads=spec[0][0], head_dim=spec[0][1],
+            max_batch=max_batch, max_seq_len=max_seq_len, dtype=dtype,
+            reserve_null_page=True)
+        maxpos = getattr(getattr(model, "config", None),
+                         "max_position_embeddings", None)
+        if maxpos is not None and max_seq_len > maxpos:
+            raise ValueError(
+                f"engine max_seq_len ({max_seq_len}) exceeds the model's "
+                f"max_position_embeddings ({maxpos})")
+        self._slots: List[Optional[Request]] = [None] * max_batch
+        self._queue: List[Request] = []
+        self._results: Dict[int, List[int]] = {}
+        self._last_tok = np.zeros((max_batch,), np.int32)
+        self._next_rid = 0
+        self._prefill_jit = None
+        self._decode_jit = None
+
+    # ------------------------------------------------------------ frontend
+    def submit(self, prompt, max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None) -> int:
+        prompt = np.asarray(
+            prompt._value if hasattr(prompt, "_value") else prompt,
+            np.int32).reshape(-1)
+        if len(prompt) + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds engine max_seq_len "
+                f"({self.max_seq_len})")
+        # a request that can never fit would deadlock FIFO admission
+        need = -(-(len(prompt) + max_new_tokens) // self.pool.page_size)
+        usable = self.pool.num_pages - 1        # null page reserved
+        if need > min(usable, self.pool.max_pages_per_seq):
+            raise ValueError(
+                f"request needs {need} pages but the pool can ever offer "
+                f"{min(usable, self.pool.max_pages_per_seq)}")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(Request(rid, prompt, int(max_new_tokens),
+                                   eos_token_id))
+        return rid
+
+    def has_work(self) -> bool:
+        return bool(self._queue) or any(s is not None for s in self._slots)
+
+    def run(self) -> Dict[int, List[int]]:
+        while self.has_work():
+            self.step()
+        out, self._results = self._results, {}
+        return out
+
+    # ----------------------------------------------------------- internals
+    def _pools(self):
+        return [(self.pool.k_pages[i], self.pool.v_pages[i])
+                for i in range(len(self.pool.k_pages))]
+
+    def _store(self, states) -> None:
+        for i, st in enumerate(states):
+            self.pool.k_pages[i] = _val(st.k_pages)
+            self.pool.v_pages[i] = _val(st.v_pages)
+
+    def _prefill(self, req: Request, slot: int) -> None:
+        from ..jit import functional_call
+
+        p = len(req.prompt)
+        fn = self._prefill_jit
+        if fn is None:
+            def run(params, buffers, ids, pools, bt, sl):
+                states = [PagedDecodeState(k, v, bt, sl) for k, v in pools]
+                logits, states = functional_call(
+                    self.model, params, ids, states, jnp.int32(0),
+                    buffers=buffers, method="forward_with_cache")
+                return (jnp.argmax(logits[0, -1].astype(jnp.float32)),
+                        states)
+            # jit itself caches one compilation per prompt length
+            # (bucket/pad prompts in production to bound that set).
+            # Donate ONLY the pools (each buffer appears once there; bt/sl
+            # are shared by every layer's state and must not be donated):
+            # page writes then alias the pool in place
+            fn = self._prefill_jit = jax.jit(run, donate_argnums=(3,))
+
+        self.pool.allocate(slot, p + req.max_new_tokens)
+        bt = jnp.asarray(self.pool.block_tables[slot:slot + 1])
+        tok, states = fn(self._params, self._buffers,
+                         jnp.asarray(req.prompt[None]), self._pools(),
+                         bt, jnp.zeros((1,), jnp.int32))
+        # b=1 prefill wrote THROUGH slot's block table into the shared
+        # pool arrays; adopt them and the slot's bookkeeping
+        self._store(states)
+        self.pool.seq_lens[slot] = p
+        self._last_tok[slot] = int(tok)
+        req.tokens.append(int(tok))
+        req.slot = slot
+        self._slots[slot] = req
+        self._finish_if_done(req)
+
+    def _finish_if_done(self, req: Request) -> None:
+        done = len(req.tokens) >= req.max_new_tokens or (
+            req.eos_token_id is not None
+            and req.tokens and req.tokens[-1] == req.eos_token_id)
+        if done and req.slot is not None:
+            self.pool.free_sequence(req.slot)
+            self._slots[req.slot] = None
+            self._results[req.rid] = req.tokens
+            req.slot = None
+
+    def step(self) -> None:
+        from ..jit import functional_call
+
+        # admission: fill every free slot that has pages available
+        for slot in range(self.max_batch):
+            if self._slots[slot] is None and self._queue:
+                req = self._queue[0]
+                need = -(-(len(req.prompt) + req.max_new_tokens)
+                         // self.pool.page_size)
+                if need > self.pool.free_page_count():
+                    break           # wait for pages (FIFO, no starvation)
+                self._queue.pop(0)
+                self._prefill(req, slot)
+
+        active = [s for s in self._slots if s is not None]
+        if not active:
+            return
+
+        if self._decode_jit is None:
+            def run(params, buffers, toks, pools, bt, sl):
+                states = [PagedDecodeState(k, v, bt, sl) for k, v in pools]
+                # offset=None -> per-slot positions from states.seq_lens
+                logits, states = functional_call(
+                    self.model, params, toks, states, None,
+                    buffers=buffers, method="forward_with_cache")
+                return (jnp.argmax(logits[:, -1].astype(jnp.float32),
+                                   axis=-1), states)
+            # donate only the pools (see _prefill): per-token page writes
+            # alias in place instead of copying every pool every token
+            self._decode_jit = jax.jit(run, donate_argnums=(3,))
+
+        bt = jnp.asarray(self.pool.block_tables[:self.max_batch])
+        sl = jnp.asarray(self.pool.seq_lens[:self.max_batch])
+        toks, states = self._decode_jit(
+            self._params, self._buffers,
+            jnp.asarray(self._last_tok[:, None]), self._pools(), bt, sl)
+        self._store(states)
+        toks = np.asarray(toks)
+
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue            # idle row wrote the null page; ignore
+            self.pool.seq_lens[slot] += 1
+            tok = int(toks[slot])
+            req.tokens.append(tok)
+            self._last_tok[slot] = tok
+            self._finish_if_done(req)
+
+
+def _val(x):
+    return x._value if hasattr(x, "_value") else x

@@ -40,6 +40,23 @@ _NEG_INF = -1e30
 _LANES = 128
 
 
+def paged_position_ids(s: int, offset, state, dtype: str):
+    """Decode position ids for a paged cache entry: a scalar ``offset``
+    (lockstep batch) broadcasts; ``offset=None`` gives each row ITS
+    written length (continuous batching — slots decode at different
+    positions). Shared by every model wired for paged serving."""
+    from .. import ops
+    from ..core.tensor import Tensor
+
+    base = ops.arange(s, dtype=dtype).unsqueeze(0)
+    if offset is not None:
+        return base + offset
+    sl = state.seq_lens
+    if not isinstance(sl, Tensor):
+        sl = Tensor(sl, stop_gradient=True)
+    return base + sl.astype(dtype).unsqueeze(1)
+
+
 class PagedDecodeState(NamedTuple):
     """One layer's paged cache as it rides a jitted decode step: the pool
     pair, the block tables, and the per-sequence written-token counts.
@@ -233,7 +250,13 @@ class PagedKVCache:
 
     def __init__(self, num_layers: int, num_pages: int, page_size: int,
                  num_kv_heads: int, head_dim: int, max_batch: int,
-                 max_seq_len: int, dtype=jnp.bfloat16):
+                 max_seq_len: int, dtype=jnp.bfloat16,
+                 reserve_null_page: bool = False):
+        """``reserve_null_page``: keep page 0 out of the free list so it
+        only ever holds writes from INACTIVE batch slots (whose block
+        tables are all-zero) — a continuous-batching engine decodes full
+        fixed-shape batches, and idle rows must scribble somewhere that
+        no live sequence owns."""
         if page_size % 8:
             raise ValueError("page_size must be a multiple of 8 (TPU "
                              "sublane tile)")
@@ -250,7 +273,8 @@ class PagedKVCache:
                                      np.int32)
         self.seq_lens = np.zeros((max_batch,), np.int32)
         self._pages_used = np.zeros((max_batch,), np.int32)
-        self._free = list(range(num_pages - 1, -1, -1))
+        first = 1 if reserve_null_page else 0
+        self._free = list(range(num_pages - 1, first - 1, -1))
 
     # ------------------------------------------------------------- admin
     def free_page_count(self) -> int:

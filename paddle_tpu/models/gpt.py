@@ -149,9 +149,7 @@ class GPTModel(Layer):
                 caches=None, offset=None):
         b, s = input_ids.shape
         if position_ids is None:
-            position_ids = ops.arange(s, dtype="int64").unsqueeze(0)
-            if offset is not None:
-                position_ids = position_ids + offset
+            position_ids = self._position_ids(s, offset, caches)
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
         if caches is not None:
@@ -168,6 +166,14 @@ class GPTModel(Layer):
         for block in self.h:
             x = block(x, attn_mask)
         return self.ln_f(x)
+
+    def _position_ids(self, s, offset, caches):
+        from ..kernels.paged_attention import (PagedDecodeState,
+                                               paged_position_ids)
+        if caches and isinstance(caches[0], PagedDecodeState):
+            return paged_position_ids(s, offset, caches[0], "int64")
+        base = ops.arange(s, dtype="int64").unsqueeze(0)
+        return base if offset is None else base + offset
 
 
 class GPTEmbeddingPipe(Layer):

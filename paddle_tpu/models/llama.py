@@ -88,8 +88,13 @@ class LlamaAttention(Layer):
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         paged = cache is not None and isinstance(cache[0], PagedDecodeState)
         if cache is not None and position_ids is None:
-            offset = cache[1] if paged else cache[2]
-            position_ids = (ops.arange(s, dtype="int32") + offset).unsqueeze(0)
+            if paged:
+                from ..kernels.paged_attention import paged_position_ids
+                position_ids = paged_position_ids(s, cache[1], cache[0],
+                                                  "int32")
+            else:
+                position_ids = (ops.arange(s, dtype="int32")
+                                + cache[2]).unsqueeze(0)
         q, k, _ = FF.fused_rotary_position_embedding(
             q, k, None, position_ids=position_ids, rotary_emb_base=self.rope_theta)
         if paged:
