@@ -603,13 +603,19 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     matmul — XLA fuses the int8→bf16 convert and per-channel scale into
     the MXU feed, so the weight lives in HBM at 1/2 (int8) or 1/4
     (int4) the bytes, the GEMM runs in the ACTIVATION dtype (bf16 on
-    the serving path) and accumulates in f32."""
-    from ...core.tensor import Tensor, _val
-    xv = _val(x)
-    scale = _val(weight_scale)
-    wf = _dequantize_weight(_val(weight), scale, weight_dtype, group_size,
-                            xv.dtype)
-    out = jnp.matmul(xv, wf, preferred_element_type=jnp.float32)
-    if bias is not None:
-        out = out + _val(bias)
-    return Tensor(out.astype(xv.dtype))
+    the serving path) and accumulates in f32.
+
+    Dispatches through ``apply_op`` so ACTIVATIONS and bias stay
+    differentiable (the int8 weight is grad-free by dtype): adapter/
+    LoRA-style training over a frozen int8 backbone works."""
+    from ...core.tensor import apply_op
+
+    def fn(xv, qw, bv, scale):
+        wf = _dequantize_weight(qw, scale, weight_dtype, group_size,
+                                xv.dtype)
+        out = jnp.matmul(xv, wf, preferred_element_type=jnp.float32)
+        if bv is not None:
+            out = out + bv
+        return out.astype(xv.dtype)
+
+    return apply_op("weight_only_linear", fn, x, weight, bias, weight_scale)

@@ -97,3 +97,56 @@ class TestServingEngine:
         eng = ServingEngine(model, max_batch=1, page_size=8, max_seq_len=16)
         with pytest.raises(ValueError, match="max_seq_len"):
             eng.submit(np.zeros(14, np.int32), 8)
+
+
+class TestCrossFeatureComposition:
+    def test_int8_model_serves_with_exact_parity(self):
+        from paddle_tpu.nn.quant import quantize_linears
+
+        paddle.seed(81)
+        cfg = GPTConfig.tiny()
+        model = GPTForCausalLM(cfg)
+        quantize_linears(model)
+        rng = np.random.default_rng(0)
+        p1 = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+        p2 = rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)
+        s1, s2 = solo(model, p1, 5), solo(model, p2, 5)
+        eng = ServingEngine(model, max_batch=2, page_size=8, max_seq_len=32)
+        r1, r2 = eng.submit(p1, 5), eng.submit(p2, 5)
+        out = eng.run()
+        assert out[r1] == s1 and out[r2] == s2
+
+    def test_int8_draft_speculative_lossless(self):
+        from paddle_tpu.nn.quant import quantize_linears
+
+        paddle.seed(82)
+        cfg = GPTConfig.tiny()
+        target = GPTForCausalLM(cfg)
+        paddle.seed(83)
+        draft = GPTForCausalLM(cfg)
+        quantize_linears(draft)       # the production pattern: cheap draft
+        prompt = paddle.to_tensor(np.random.default_rng(1).integers(
+            0, cfg.vocab_size, (1, 5)).astype(np.int32))
+        ref = target.generate(prompt, max_new_tokens=8,
+                              do_sample=False).numpy()
+        spec = target.generate_speculative(
+            prompt, draft, max_new_tokens=8,
+            num_speculative_tokens=3).numpy()
+        np.testing.assert_array_equal(ref, spec)
+
+    def test_quantized_layer_activation_grads_flow(self):
+        """Adapter training over a frozen int8 backbone: activations and
+        bias differentiate through weight_only_linear."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn.quant import QuantizedLinear
+
+        paddle.seed(84)
+        lin = nn.Linear(8, 4)
+        q = QuantizedLinear.from_linear(lin)
+        x = paddle.to_tensor(np.random.default_rng(2).standard_normal(
+            (3, 8)).astype(np.float32), stop_gradient=False)
+        out = q(x)
+        out.sum().backward()
+        assert x.grad is not None
+        assert float(np.abs(x.grad.numpy()).sum()) > 0
+        assert q.bias.grad is not None
