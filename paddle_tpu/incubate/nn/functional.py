@@ -619,3 +619,79 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
         return out.astype(xv.dtype)
 
     return apply_op("weight_only_linear", fn, x, weight, bias, weight_scale)
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
+                              seq_lens_decoder, seq_lens_this_time,
+                              block_tables, **kwargs):
+    """reference: paddle.incubate.nn.functional.block_multihead_attention
+    (paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu) —
+    the block(page)-table serving attention. TPU-native subset over
+    kernels/paged_attention:
+
+      - decode phase (``seq_lens_this_time`` all 1): the token writes into
+        its page and attends through the block-table Pallas kernel;
+      - prefill phase (encoder lengths > 0, decoder lengths 0): prompt
+        self-attention + page writes.
+
+    ``qkv``: (B, S, 3, Hkv==H, D) packed (the reference packs q/k/v; MHA
+    layout — GQA callers use paged_scaled_dot_product_attention
+    directly). ``key_cache``/``value_cache``: (Hkv, num_pages, page, D)
+    pools. Returns ``(out, key_cache, value_cache)`` with out (B, S, H*D).
+    Options the CUDA kernel fuses (rope embeddings, cache-quant scales,
+    shift/smooth) are not folded here — pass pre-roped qkv; unsupported
+    kwargs raise rather than silently no-op."""
+    # reference signature carries many fused options with non-None
+    # defaults; only a NON-default value asks for unfolded behavior
+    _ref_defaults = {"max_seq_len": -1, "block_size": None,
+                     "use_neox_style": False, "use_neox_rotary_style": False,
+                     "quant_round_type": 1, "quant_max_bound": 127.0,
+                     "quant_min_bound": -127.0, "out_scale": -1,
+                     "out_shift": None, "out_smooth": None,
+                     "compute_dtype": "default", "rope_theta": 10000.0}
+    unsupported = sorted(
+        k for k, v in kwargs.items()
+        if v is not None and v != _ref_defaults.get(k, None))
+    if unsupported:
+        raise NotImplementedError(
+            "block_multihead_attention TPU subset does not fold "
+            f"{unsupported} — apply rope/quant/offsets outside the op")
+    from ...kernels.paged_attention import PagedDecodeState
+
+    import numpy as _np
+    try:
+        this = _np.asarray(_val(seq_lens_this_time))
+        enc = _np.asarray(_val(seq_lens_encoder))
+    except Exception as e:   # traced lengths: the phase cannot be checked
+        raise NotImplementedError(
+            "block_multihead_attention needs CONCRETE seq_lens (the host-"
+            "facing serving loop); inside jit use "
+            "paged_scaled_dot_product_attention directly") from e
+    qkv_t = qkv if isinstance(qkv, Tensor) else Tensor(qkv)
+    b, s = qkv_t.shape[0], qkv_t.shape[1]
+    # uniform-phase contract (the subset this wrapper supports): ALL rows
+    # prefill (this==S, enc>0) or ALL rows decode one token (this==1).
+    # Inactive rows (this==0) or mixed batches would silently scribble
+    # into pool pages — refuse loudly instead.
+    if (enc > 0).all() and (this == s).all():
+        pass                      # prefill phase
+    elif (enc == 0).all() and (this == 1).all() and s == 1:
+        pass                      # decode phase
+    else:
+        raise NotImplementedError(
+            "block_multihead_attention TPU subset handles uniform batches "
+            "only (all-prefill or all-decode with every row active); for "
+            "ragged/mixed scheduling drive ServingEngine or the paged "
+            "pieces directly")
+    q = qkv_t[:, :, 0]
+    k = qkv_t[:, :, 1]
+    v = qkv_t[:, :, 2]
+    dec = _val(seq_lens_decoder)
+    # the reference's phase encoding: encoder lens set during prefill,
+    # decoder lens set during decode
+    lens = jnp.where(jnp.asarray(enc) > 0, 0, jnp.asarray(dec))
+    state = PagedDecodeState(key_cache, value_cache, block_tables,
+                             lens.astype(jnp.int32))
+    out, state = F.paged_scaled_dot_product_attention(q, k, v, state)
+    h, d = out.shape[2], out.shape[3]
+    return (out.reshape([b, s, h * d]), state.k_pages, state.v_pages)

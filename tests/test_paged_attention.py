@@ -277,3 +277,99 @@ class TestGeneratePaged:
         hits = np.where(row == eos)[0]
         assert hits.size
         assert np.all((row[hits[0] + 1:] == 0) | (row[hits[0] + 1:] == eos))
+
+
+class TestBlockMultiheadAttention:
+    """The reference-named wrapper (incubate.nn.functional.
+    block_multihead_attention) over the paged machinery."""
+
+    def test_decode_phase_matches_paged_attention(self):
+        import paddle_tpu.incubate.nn.functional as FF
+
+        rng = np.random.default_rng(9)
+        b, h, d, page = 2, 2, 16, 8
+        k_pages, v_pages = make_pool(rng, h, 8, page, d)
+        bt = np.array([[1, 3], [5, 0]], np.int32)
+        dec_lens = np.array([9, 4], np.int32)
+        qkv = jnp.asarray(rng.standard_normal((b, 1, 3, h, d)) * 0.5,
+                          jnp.float32)
+
+        out, k2, v2 = FF.block_multihead_attention(
+            qkv, k_pages, v_pages,
+            seq_lens_encoder=np.zeros(b, np.int32),
+            seq_lens_decoder=dec_lens,
+            seq_lens_this_time=np.ones(b, np.int32),
+            block_tables=bt)
+        # reference: write then attend with the standalone pieces
+        kw, vw = write_paged_kv(k_pages, v_pages,
+                                jnp.asarray(qkv[:, 0, 1]),
+                                jnp.asarray(qkv[:, 0, 2]), bt, dec_lens)
+        ref = paged_attention_xla(jnp.asarray(qkv[:, 0, 0]), kw, vw, bt,
+                                  dec_lens + 1)
+        np.testing.assert_allclose(
+            np.asarray(out.numpy()).reshape(b, h * d),
+            np.asarray(ref).reshape(b, h * d), rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(k2.numpy()), np.asarray(kw))
+
+    def test_prefill_phase_writes_pages(self):
+        import paddle_tpu.incubate.nn.functional as FF
+
+        rng = np.random.default_rng(10)
+        b, s, h, d, page = 1, 13, 2, 16, 8
+        k_pages = jnp.zeros((h, 6, page, d), jnp.float32)
+        v_pages = jnp.zeros_like(k_pages)
+        bt = np.array([[2, 4]], np.int32)
+        qkv = jnp.asarray(rng.standard_normal((b, s, 3, h, d)) * 0.5,
+                          jnp.float32)
+        out, k2, v2 = FF.block_multihead_attention(
+            qkv, k_pages, v_pages,
+            seq_lens_encoder=np.full(b, s, np.int32),
+            seq_lens_decoder=np.zeros(b, np.int32),
+            seq_lens_this_time=np.full(b, s, np.int32),
+            block_tables=bt)
+        assert out.shape == [b, s, h * d]
+        got = np.concatenate([np.asarray(k2.numpy())[:, 2],
+                              np.asarray(k2.numpy())[:, 4]], axis=1)[:, :s]
+        want = np.moveaxis(np.asarray(qkv[0, :, 1]), 1, 0)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_unsupported_options_raise(self):
+        import paddle_tpu.incubate.nn.functional as FF
+
+        with pytest.raises(NotImplementedError, match="rope"):
+            FF.block_multihead_attention(
+                jnp.zeros((1, 1, 3, 2, 16), jnp.float32),
+                jnp.zeros((2, 4, 8, 16), jnp.float32),
+                jnp.zeros((2, 4, 8, 16), jnp.float32),
+                np.zeros(1, np.int32), np.ones(1, np.int32),
+                np.ones(1, np.int32), np.zeros((1, 2), np.int32),
+                rotary_embs=object())
+
+    def test_reference_default_kwargs_accepted(self):
+        import paddle_tpu.incubate.nn.functional as FF
+
+        rng = np.random.default_rng(11)
+        b, h, d, page = 1, 2, 16, 8
+        k_pages, v_pages = make_pool(rng, h, 6, page, d)
+        qkv = jnp.asarray(rng.standard_normal((b, 1, 3, h, d)), jnp.float32)
+        out, _, _ = FF.block_multihead_attention(
+            qkv, k_pages, v_pages, np.zeros(b, np.int32),
+            np.array([5], np.int32), np.ones(b, np.int32),
+            np.array([[1, 2]], np.int32),
+            max_seq_len=-1, use_neox_style=False, quant_round_type=1,
+            quant_max_bound=127.0, quant_min_bound=-127.0,
+            compute_dtype="default")
+        assert out.shape == [b, 1, h * d]
+
+    def test_mixed_or_inactive_batches_refused(self):
+        import paddle_tpu.incubate.nn.functional as FF
+
+        rng = np.random.default_rng(12)
+        k_pages, v_pages = make_pool(rng, 2, 6, 8, 16)
+        qkv = jnp.asarray(rng.standard_normal((2, 1, 3, 2, 16)), jnp.float32)
+        with pytest.raises(NotImplementedError, match="uniform"):
+            FF.block_multihead_attention(
+                qkv, k_pages, v_pages, np.zeros(2, np.int32),
+                np.array([5, 0], np.int32),
+                np.array([1, 0], np.int32),       # inactive row
+                np.array([[1, 2], [3, 4]], np.int32))
