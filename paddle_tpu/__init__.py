@@ -98,6 +98,7 @@ from . import models  # noqa: F401
 from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
 from .utils.install_check import run_check  # noqa: F401
+from . import quantization  # noqa: F401
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,

@@ -1,0 +1,238 @@
+"""paddle.quantization — the quantization-aware-training / post-training
+framework (reference: python/paddle/quantization/{config.py,qat.py,ptq.py,
+observers/,quanters/}).
+
+TPU-native mapping:
+  - **PTQ**: observers ride the eager forward during calibration
+    (host-side absmax accumulation — no graph surgery), and ``convert``
+    lowers observed layers straight onto the serving runtime
+    (:class:`paddle_tpu.nn.quant.QuantizedLinear`, int8 weights +
+    per-channel scales dequantized into the MXU feed).
+  - **QAT**: fake-quantization with the straight-through estimator,
+    implemented as ``x + stop_gradient(quant_dequant(x) - x)`` — exact
+    STE under ANY autodiff engine (the generic-vjp tape differentiates
+    the identity path), no custom grad registration needed. The round
+    error is visible in the forward, invisible to the backward.
+
+The reference's per-layer config maps (add_layer_config etc.) collapse
+to the subset real users drive: global activation/weight quanters plus
+type filters.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
+
+import jax.numpy as jnp
+
+import numpy as np
+
+from ..core.tensor import Tensor, apply_op
+from ..nn.layer import Layer
+
+__all__ = ["QuantConfig", "PTQ", "QAT", "AbsmaxObserver",
+           "FakeQuanterWithAbsMaxObserver", "quant_dequant_absmax"]
+
+
+def quant_dequant_absmax(x, scale, bit_length: int = 8):
+    """Symmetric fake quantization with the straight-through estimator:
+    forward sees round(x/scale)*scale clipped to the int range, backward
+    sees identity (gradients pass straight through)."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+
+    def fn(xv, sv):
+        import jax
+        s = jnp.maximum(jnp.asarray(sv, jnp.float32), 1e-8) / qmax
+        q = jnp.clip(jnp.round(xv.astype(jnp.float32) / s), -qmax, qmax)
+        dq = (q * s).astype(xv.dtype)
+        # STE: the value is dq, the gradient is d/dx of the identity
+        return xv + jax.lax.stop_gradient(dq - xv)
+
+    return apply_op("fake_quant_absmax", fn, x, scale)
+
+
+class AbsmaxObserver:
+    """PTQ observer: tracks the running max |x| over calibration batches
+    (reference: AbsmaxObserver / AbsMaxChannelWiseWeightObserver)."""
+
+    def __init__(self, quant_bits: int = 8, channel_wise: bool = False,
+                 axis: int = -1):
+        self.quant_bits = quant_bits
+        self.channel_wise = channel_wise
+        self.axis = axis
+        self._absmax: Optional[np.ndarray] = None
+
+    def observe(self, x) -> None:
+        v = np.abs(np.asarray(x._value if isinstance(x, Tensor) else x,
+                              np.float32))
+        if self.channel_wise:
+            red = tuple(i for i in range(v.ndim)
+                        if i != (self.axis % v.ndim))
+            m = v.max(axis=red)
+        else:
+            m = v.max()
+        self._absmax = m if self._absmax is None else np.maximum(
+            self._absmax, m)
+
+    def scale(self) -> np.ndarray:
+        if self._absmax is None:
+            raise RuntimeError("observer saw no calibration data")
+        return np.maximum(np.asarray(self._absmax, np.float32), 1e-8)
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """QAT quanter (reference: quanters/abs_max.py): maintains a moving
+    absmax and fake-quantizes with STE. Used for activations; weights
+    fake-quantize per-channel against their live absmax."""
+
+    def __init__(self, moving_rate: float = 0.9, bit_length: int = 8):
+        super().__init__()
+        self._rate = moving_rate
+        self._bits = bit_length
+        self.register_buffer("_scale", Tensor(jnp.ones((), jnp.float32),
+                                              stop_gradient=True))
+        self._seen = False
+
+    def forward(self, x):
+        if self.training:
+            # stays on-device: no host pull in the training hot path
+            cur = jnp.max(jnp.abs(jnp.asarray(
+                x._value if isinstance(x, Tensor) else x, jnp.float32)))
+            prev = jnp.asarray(self._scale._value, jnp.float32)
+            new = cur if not self._seen else (
+                self._rate * prev + (1 - self._rate) * cur)
+            self._seen = True
+            self._scale.set_value(new)
+        return quant_dequant_absmax(x, self._scale, self._bits)
+
+
+class QuantConfig:
+    """reference: python/paddle/quantization/config.py. The subset that
+    matters: a global (activation, weight) quanter pair plus per-type
+    opt-outs."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._skip_types: List[Type] = []
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        # per-type overrides collapse to skip-or-default in this subset
+        if activation is None and weight is None:
+            self._skip_types.append(layer_type)
+        return self
+
+    def skipped(self, layer) -> bool:
+        return any(isinstance(layer, t) for t in self._skip_types)
+
+
+class _QATLinear(Layer):
+    """Linear with fake-quantized weight and (optionally) activation.
+    ``config.weight`` supplies the weight quanter factory; the default is
+    per-output-channel absmax STE at 8 bits."""
+
+    def __init__(self, linear, config: QuantConfig):
+        super().__init__()
+        self.linear = linear
+        self.activation_quanter = (config.activation() if config.activation
+                                   else None)
+        self.weight_quanter = (config.weight() if config.weight else None)
+        self._bits = 8
+
+    def forward(self, x):
+        from ..nn import functional as F
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.linear.weight
+        if self.weight_quanter is not None:
+            wq = self.weight_quanter(w)
+        else:
+            wmax = w.abs().max(axis=0)      # per output channel
+            wq = quant_dequant_absmax(w, wmax, self._bits)
+        return F.linear(x, wq, self.linear.bias)
+
+
+class QAT:
+    """reference: python/paddle/quantization/qat.py — insert fake
+    quanters for training; the quantized weights remain float and
+    TRAINABLE (STE gradients)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        from ..nn.layers.common import Linear
+        if not inplace:
+            raise NotImplementedError("TPU QAT quantizes in place "
+                                      "(functional params make copies "
+                                      "cheap at the train-step level)")
+        todo = []
+        for parent in model.sublayers(include_self=True):
+            for name, sub in list(parent._sub_layers.items()):
+                if type(sub) is Linear and not self.config.skipped(sub):
+                    todo.append((parent, name, sub))
+        for parent, name, sub in todo:
+            setattr(parent, name, _QATLinear(sub, self.config))
+        return model
+
+
+class PTQ:
+    """reference: python/paddle/quantization/ptq.py — observe activations
+    and weights over calibration data, then ``convert`` to the int8
+    serving runtime. Observation uses the Layer pre-hook machinery
+    (install/remove are symmetric), and like QAT this subset works in
+    place only."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+        self._observed: List = []
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        from ..nn.layers.common import Linear
+        if not inplace:
+            raise NotImplementedError("TPU PTQ quantizes in place (same "
+                                      "contract as QAT.quantize)")
+        if self._observed:
+            raise RuntimeError("this PTQ instance already has observers "
+                               "installed — convert() first or use a "
+                               "fresh PTQ")
+        for parent in model.sublayers(include_self=True):
+            for name, sub in list(parent._sub_layers.items()):
+                if type(sub) is Linear and not self.config.skipped(sub):
+                    obs = AbsmaxObserver(channel_wise=False)
+
+                    def pre_hook(layer, inputs, _obs=obs):
+                        _obs.observe(inputs[0])
+                        return None
+
+                    handle = sub.register_forward_pre_hook(pre_hook)
+                    self._observed.append((parent, name, sub, obs, handle))
+        return model
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        """Replace observed Linears with int8 QuantizedLinear (weights
+        quantized per-channel; the observed activation range is recorded
+        as metadata — TPU matmuls run bf16 activations, so activation
+        quant collapses to the observed clip range). Layers the
+        calibration data never reached stay in float (with a warning)
+        rather than corrupting the model mid-convert."""
+        import warnings
+
+        from ..nn.quant import QuantizedLinear
+        if not inplace:
+            raise NotImplementedError("TPU PTQ converts in place")
+        for _, _, _, _, handle in self._observed:
+            handle.remove()                 # all hooks off FIRST
+        for parent, name, sub, obs, _ in self._observed:
+            try:
+                scale = obs.scale()
+            except RuntimeError:
+                warnings.warn(
+                    f"PTQ: layer {name!r} saw no calibration data — "
+                    "keeping it in float", stacklevel=2)
+                continue
+            q = QuantizedLinear.from_linear(sub)
+            q.activation_absmax = float(np.max(scale))
+            setattr(parent, name, q)
+        self._observed = []
+        return model

@@ -1,0 +1,165 @@
+"""paddle.quantization — QAT (STE fake quant) and PTQ (observe + convert).
+
+Reference: python/paddle/quantization/{qat.py,ptq.py,observers,quanters}.
+Invariants: STE gradients flow through fake-quantized weights and
+activations (loss trains DOWN through the rounding), PTQ scales come from
+the calibration data, and convert lands on the int8 serving runtime with
+close numerics.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.quantization import (QAT, PTQ, AbsmaxObserver,
+                                     FakeQuanterWithAbsMaxObserver,
+                                     QuantConfig, quant_dequant_absmax)
+
+
+class TestFakeQuant:
+    def test_value_is_quantized_gradient_is_identity(self):
+        x = paddle.to_tensor(np.array([0.11, -0.57, 0.99], np.float32),
+                             stop_gradient=False)
+        scale = paddle.to_tensor(np.float32(1.0))
+        y = quant_dequant_absmax(x, scale, bit_length=8)
+        # forward: snapped to the 127-step grid
+        step = 1.0 / 127.0
+        np.testing.assert_allclose(
+            y.numpy(), np.round(np.array([0.11, -0.57, 0.99]) / step) * step,
+            rtol=1e-6)
+        # backward: straight-through (identity), NOT zero
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(3), rtol=1e-6)
+
+    def test_observer_tracks_absmax(self):
+        obs = AbsmaxObserver()
+        obs.observe(np.array([1.0, -3.0]))
+        obs.observe(np.array([2.0, 0.5]))
+        assert obs.scale() == pytest.approx(3.0)
+
+    def test_channel_wise_observer(self):
+        obs = AbsmaxObserver(channel_wise=True, axis=-1)
+        obs.observe(np.array([[1.0, -4.0], [-2.0, 3.0]], np.float32))
+        np.testing.assert_allclose(obs.scale(), [2.0, 4.0])
+
+
+class TestQAT:
+    def test_qat_model_trains_through_fake_quant(self):
+        paddle.seed(91)
+        model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 8))
+        q = QAT(QuantConfig(activation=FakeQuanterWithAbsMaxObserver))
+        q.quantize(model)
+        # every Linear wrapped
+        names = [type(l).__name__ for l in model.sublayers()]
+        assert names.count("_QATLinear") == 2
+
+        opt = paddle.optimizer.AdamW(5e-3, parameters=model.parameters())
+        x = paddle.to_tensor(np.random.RandomState(0).randn(16, 8)
+                             .astype(np.float32))
+        losses = []
+        for _ in range(40):
+            loss = F.mse_loss(model(x), x)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+    def test_type_skip(self):
+        from paddle_tpu.nn.layers.common import Linear
+
+        model = nn.Sequential(nn.Linear(4, 4))
+        cfg = QuantConfig(activation=None)
+        cfg.add_type_config(Linear)       # no quanters: skip the type
+        QAT(cfg).quantize(model)
+        assert type(model[0]).__name__ == "Linear"
+
+
+class TestPTQ:
+    def test_observe_then_convert_to_int8_runtime(self):
+        from paddle_tpu.nn.quant import QuantizedLinear
+
+        paddle.seed(92)
+        model = nn.Sequential(nn.Linear(16, 16), nn.Tanh(),
+                              nn.Linear(16, 8))
+        rng = np.random.RandomState(1)
+        calib = [paddle.to_tensor(rng.randn(4, 16).astype(np.float32))
+                 for _ in range(3)]
+        ref_out = model(calib[0]).numpy()
+
+        ptq = PTQ(QuantConfig())
+        ptq.quantize(model)
+        for batch in calib:
+            model(batch)                  # observers accumulate
+        ptq.convert(model)
+
+        qlayers = [l for l in model.sublayers()
+                   if isinstance(l, QuantizedLinear)]
+        assert len(qlayers) == 2
+        # observed activation range recorded on the converted layer
+        assert qlayers[0].activation_absmax > 0
+        out = model(calib[0]).numpy()
+        rel = np.abs(out - ref_out).max() / (np.abs(ref_out).max() + 1e-9)
+        assert rel < 0.05, rel
+
+    def test_convert_restores_forward_hooks(self):
+        model = nn.Sequential(nn.Linear(4, 4))
+        ptq = PTQ(QuantConfig())
+        ptq.quantize(model)
+        model(paddle.to_tensor(np.ones((1, 4), np.float32)))
+        ptq.convert(model)
+        assert ptq._observed == []
+
+
+class TestReviewContracts:
+    def test_weight_quanter_config_is_honored(self):
+        calls = []
+
+        class SpyQuanter(nn.Layer):
+            def __init__(self):
+                super().__init__()
+
+            def forward(self, w):
+                calls.append(w.shape)
+                return w
+
+        model = nn.Sequential(nn.Linear(4, 4))
+        QAT(QuantConfig(weight=SpyQuanter)).quantize(model)
+        model(paddle.to_tensor(np.ones((1, 4), np.float32)))
+        assert calls == [[4, 4]]
+
+    def test_ptq_inplace_false_raises(self):
+        model = nn.Sequential(nn.Linear(4, 4))
+        with pytest.raises(NotImplementedError, match="in place"):
+            PTQ(QuantConfig()).quantize(model, inplace=False)
+
+    def test_uncalibrated_layer_stays_float_with_warning(self):
+        from paddle_tpu.nn.quant import QuantizedLinear
+
+        class Branchy(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.used = nn.Linear(4, 4)
+                self.unused = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.used(x)
+
+        m = Branchy()
+        ptq = PTQ(QuantConfig())
+        ptq.quantize(m)
+        m(paddle.to_tensor(np.ones((1, 4), np.float32)))
+        with pytest.warns(UserWarning, match="no calibration data"):
+            ptq.convert(m)
+        assert isinstance(m.used, QuantizedLinear)
+        assert type(m.unused).__name__ == "Linear"   # intact, hook removed
+        assert not m.unused._forward_pre_hooks
+
+    def test_double_quantize_rejected(self):
+        model = nn.Sequential(nn.Linear(4, 4))
+        ptq = PTQ(QuantConfig())
+        ptq.quantize(model)
+        with pytest.raises(RuntimeError, match="already"):
+            ptq.quantize(model)

@@ -54,6 +54,10 @@ stop_gradient
 
 EXTRA = {
     "Tensor": TENSOR_METHODS + TENSOR_PROPERTIES,
+    # quantization framework (upstream python/paddle/quantization):
+    # added r05 second session along with the implementation
+    "quantization": ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver",
+                     "FakeQuanterWithAbsMaxObserver"],
     "": [
         # framework / device / dtype infra (upstream top level)
         "Tensor", "dtype", "finfo", "iinfo", "get_default_dtype",
