@@ -1,0 +1,46 @@
+"""Speculative decoding: a small draft proposes, the target verifies.
+
+Greedy speculation is LOSSLESS — the output equals the target's own
+greedy decode token for token; the win is wall-clock (up to gamma+1
+tokens per target forward when the draft agrees).
+
+Run: JAX_PLATFORMS=cpu python examples/speculative.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _env import ensure_backend
+ensure_backend()
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+
+def main():
+    paddle.seed(0)
+    cfg = GPTConfig.tiny()
+    target = GPTForCausalLM(cfg)
+    # a cheaper draft: half width, one layer, same vocab
+    paddle.seed(1)
+    draft = GPTForCausalLM(GPTConfig(
+        vocab_size=cfg.vocab_size, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, max_position_embeddings=128))
+
+    prompt = paddle.to_tensor(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (1, 8)).astype(np.int32))
+
+    ref = target.generate(prompt, max_new_tokens=16, do_sample=False)
+    spec = target.generate_speculative(prompt, draft, max_new_tokens=16,
+                                       num_speculative_tokens=4)
+    print("greedy     :", ref.numpy()[0, 8:].tolist())
+    print("speculative:", spec.numpy()[0, 8:].tolist())
+    assert (ref.numpy() == spec.numpy()).all()
+    print("identical output — the draft only changes the SCHEDULE")
+
+
+if __name__ == "__main__":
+    main()
