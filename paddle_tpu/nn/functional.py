@@ -559,6 +559,101 @@ def cached_scaled_dot_product_attention(query, key, value, k_cache, v_cache,
                     offset)
 
 
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """reference: python/paddle/nn/functional/flash_attention.py
+    ``flash_attention`` — [B, S, H, D] layout, returns ``(out, softmax)``
+    with softmax None unless requested (the fused kernel never
+    materializes it; ``return_softmax=True`` raises like the reference
+    does on backends without the debug path)."""
+    if return_softmax:
+        raise NotImplementedError(
+            "return_softmax requires materializing the (S, S) matrix the "
+            "flash kernel exists to avoid — use plain "
+            "scaled_dot_product_attention for debugging")
+    if dropout and training:   # inference dropout is a no-op, like the ref
+        raise NotImplementedError("attention dropout is not folded into "
+                                  "the TPU flash kernel")
+    out = scaled_dot_product_attention(query, key, value, is_causal=causal,
+                                       training=training)
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """reference: flash_attn_unpadded (the varlen/packed form over
+    FlashAttnUnpaddedKernel). Packed [total_tokens, H, D] with cumulative
+    sequence boundaries. TPU-native: the packed batch becomes ONE flash
+    call with SEGMENT IDS — the kernel's block-skip masks cross-sequence
+    attention, no unpadding/repacking kernels needed. Causal masking uses
+    LOCAL per-sequence positions; the kernel path serves the dominant
+    self-attention case (identical q/k boundaries), other layouts take
+    the dense segment-masked path."""
+    if return_softmax:
+        raise NotImplementedError("return_softmax: see flash_attention")
+    if dropout and training:
+        raise NotImplementedError("attention dropout is not folded into "
+                                  "the TPU flash kernel")
+    from .. import flags
+    from ..kernels.flash_attention import flash_attention_bshd
+
+    cu_q = _val(cu_seqlens_q)
+    cu_k = _val(cu_seqlens_k)
+    try:   # concrete boundaries: is this a self-attention pack?
+        same_pack = np.array_equal(np.asarray(cu_q), np.asarray(cu_k))
+    except Exception:   # traced inside jit: assume the dominant layout
+        same_pack = True
+    kernel_ok = (flags.get_flag("use_pallas") and flags.is_tpu_backend()
+                 and (same_pack or not causal))
+
+    def fn(qv, kv, vv, cq, ck):
+        tq = qv.shape[0]
+        tk = kv.shape[0]
+        # token i belongs to the sequence whose boundary interval holds i
+        seg_q = jnp.searchsorted(cq, jnp.arange(tq), side="right")[None, :]
+        seg_k = jnp.searchsorted(ck, jnp.arange(tk), side="right")[None, :]
+        sc = scale if scale is not None else 1.0 / math.sqrt(qv.shape[-1])
+        if kernel_ok:
+            # contiguous SELF-attention packing: global causal order ==
+            # per-sequence local order, so global-causal + segment mask
+            # is exact
+            try:
+                out = flash_attention_bshd(
+                    qv[None], kv[None], vv[None], segment_ids=seg_q,
+                    kv_segment_ids=seg_k, causal=causal, sm_scale=sc)
+                return out[0]
+            except NotImplementedError:
+                pass   # packed total not block-divisible
+        h, hkv = qv.shape[1], kv.shape[1]
+        kx = jnp.repeat(kv, h // hkv, axis=1) if hkv != h else kv
+        vx = jnp.repeat(vv, h // hkv, axis=1) if hkv != h else vv
+        s = jnp.einsum("qhd,khd->hqk", qv.astype(jnp.float32),
+                       kx.astype(jnp.float32)) * sc
+        mask = (seg_q[0][:, None] == seg_k[0][None, :])
+        if causal:
+            # LOCAL positions: token index minus its sequence's start
+            start_q = jnp.concatenate([jnp.zeros((1,), cq.dtype),
+                                       cq])[seg_q[0]]
+            start_k = jnp.concatenate([jnp.zeros((1,), ck.dtype),
+                                       ck])[seg_k[0]]
+            loc_q = jnp.arange(tq) - start_q
+            loc_k = jnp.arange(tk) - start_k
+            mask &= loc_q[:, None] >= loc_k[None, :]
+        s = jnp.where(mask[None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        any_vis = jnp.any(mask, axis=-1)[None, :, None]
+        p = jnp.where(any_vis, p, 0.0)
+        return jnp.einsum("hqk,khd->qhd", p,
+                          vx.astype(jnp.float32)).astype(qv.dtype)
+
+    out = apply_op("flash_attn_unpadded", fn, query, key, value, cu_q, cu_k)
+    return out, None
+
+
 def paged_scaled_dot_product_attention(query, key, value, state):
     """Paged (block-table) variant of the decode attention (reference:
     block_multihead_attention's two phases). ``state`` is a per-layer

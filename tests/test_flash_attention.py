@@ -339,3 +339,115 @@ def test_compact_stats_kill_replicated_transients():
 
     assert rep_sig in rep_hlo          # the replicated transients exist
     assert rep_sig not in compact_hlo  # and the compact layout sheds them
+
+
+class TestReferenceFlashAPI:
+    """The reference's user-facing names (python/paddle/nn/functional/
+    flash_attention.py): flash_attention and the varlen packed form."""
+
+    def test_flash_attention_matches_sdpa(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(20)
+        q = paddle.to_tensor(rng.standard_normal((2, 16, 4, 32))
+                             .astype(np.float32))
+        out, sm = F.flash_attention(q, q, q, causal=True)
+        assert sm is None
+        ref = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+
+    def test_flash_attn_unpadded_varlen_causal(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(21)
+        lens = [5, 7, 3]
+        tot, h, d = sum(lens), 4, 32
+        q = paddle.to_tensor(rng.standard_normal((tot, h, d))
+                             .astype(np.float32))
+        k = paddle.to_tensor(rng.standard_normal((tot, h, d))
+                             .astype(np.float32))
+        v = paddle.to_tensor(rng.standard_normal((tot, h, d))
+                             .astype(np.float32))
+        cu = np.cumsum([0] + lens).astype(np.int32)   # reference style
+        out, _ = F.flash_attn_unpadded(q, k, v, cu, cu, max(lens),
+                                       max(lens), causal=True)
+        o = out.numpy()
+        start = 0
+        for L in lens:
+            qs = q.numpy()[start:start + L]
+            ks = k.numpy()[start:start + L]
+            vs = v.numpy()[start:start + L]
+            s = np.einsum("qhd,khd->hqk", qs, ks) / np.sqrt(d)
+            m = np.tril(np.ones((L, L), bool))
+            s = np.where(m[None], s, -1e30)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            ref = np.einsum("hqk,khd->qhd", p, vs)
+            np.testing.assert_allclose(o[start:start + L], ref,
+                                       rtol=2e-4, atol=2e-4)
+            start += L
+
+    def test_unpadded_grads_flow(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(22)
+        q = paddle.to_tensor(rng.standard_normal((8, 2, 16))
+                             .astype(np.float32), stop_gradient=False)
+        cu = np.array([0, 3, 8], np.int32)
+        out, _ = F.flash_attn_unpadded(q, q, q, cu, cu, 5, 5, causal=True)
+        out.sum().backward()
+        assert q.grad is not None
+        assert float(np.abs(q.grad.numpy()).sum()) > 0
+
+    def test_unpadded_cross_attention_causal_uses_local_positions(self):
+        """cu_seqlens_q != cu_seqlens_k with causal=True: masking is by
+        LOCAL per-sequence positions (top-left alignment), not global
+        packed indices (code-review r05: global indices would mask whole
+        rows to zero)."""
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(23)
+        lens_q, lens_k = [2, 3], [4, 5]
+        tq, tk, h, d = sum(lens_q), sum(lens_k), 2, 16
+        q = paddle.to_tensor(rng.standard_normal((tq, h, d))
+                             .astype(np.float32))
+        k = paddle.to_tensor(rng.standard_normal((tk, h, d))
+                             .astype(np.float32))
+        v = paddle.to_tensor(rng.standard_normal((tk, h, d))
+                             .astype(np.float32))
+        cu_q = np.cumsum([0] + lens_q).astype(np.int32)
+        cu_k = np.cumsum([0] + lens_k).astype(np.int32)
+        out, _ = F.flash_attn_unpadded(q, k, v, cu_q, cu_k, max(lens_q),
+                                       max(lens_k), causal=True)
+        o = out.numpy()
+        assert np.abs(o).sum() > 0            # not masked to nothing
+        sq = sk = 0
+        for Lq, Lk in zip(lens_q, lens_k):
+            qs = q.numpy()[sq:sq + Lq]
+            ks = k.numpy()[sk:sk + Lk]
+            vs = v.numpy()[sk:sk + Lk]
+            s = np.einsum("qhd,khd->hqk", qs, ks) / np.sqrt(d)
+            m = np.arange(Lq)[:, None] >= np.arange(Lk)[None, :]
+            s = np.where(m[None], s, -1e30)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            ref = np.einsum("hqk,khd->qhd", p, vs)
+            np.testing.assert_allclose(o[sq:sq + Lq], ref,
+                                       rtol=2e-4, atol=2e-4)
+            sq += Lq
+            sk += Lk
+
+    def test_reference_trailing_kwargs_accepted(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        q = paddle.to_tensor(np.ones((6, 2, 16), np.float32))
+        cu = np.array([0, 3, 6], np.int32)
+        out, _ = F.flash_attn_unpadded(
+            q, q, q, cu, cu, 3, 3, None, 0.1, True, False,
+            fixed_seed_offset=None, rng_name="", training=False)
+        assert out.shape == [6, 2, 16]        # eval dropout is a no-op

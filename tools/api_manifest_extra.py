@@ -173,7 +173,8 @@ EXTRA = {
         "triplet_margin_loss", "triplet_margin_with_distance_loss",
         "cosine_similarity", "linear", "bilinear", "embedding",
         "one_hot", "label_smooth", "class_center_sample",
-        "scaled_dot_product_attention", "sequence_mask", "normalize",
+        "scaled_dot_product_attention", "flash_attention",
+        "flash_attn_unpadded", "sequence_mask", "normalize",
         "local_response_norm", "batch_norm", "group_norm", "instance_norm",
         "layer_norm", "rms_norm", "temporal_shift",
     ],
