@@ -384,7 +384,7 @@ class Parameter(Tensor):
     persistable, optionally ``trainable`` togglable."""
 
     __slots__ = ("optimize_attr", "is_distributed", "split_axis",
-                 "sequence_parallel")
+                 "sequence_parallel", "_lazy_init")
 
     def __init__(self, value, dtype=None, name=None, trainable: bool = True):
         super().__init__(

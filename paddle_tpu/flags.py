@@ -126,13 +126,17 @@ define_flag("check_nan_inf_level", 0, "0: abort on nan/inf; >=1: report only.")
 define_flag("benchmark", False, "Synchronize after each op and log timings.")
 define_flag("deterministic", False, "Force deterministic kernels where possible.")
 define_flag("use_pallas", True, "Use Pallas fused kernels where available (vs pure-XLA fallbacks).")
-define_flag("flash_attn_min_seqlen", 2048,
+define_flag("flash_attn_min_seqlen", 1024,
             "Dispatch sdpa to the Pallas flash kernel only at seq >= this; "
-            "below it XLA's fused dense attention is faster on v5e (r2 "
-            "measurement, artifact NOT committed — tools/tpu_watch.py "
-            "re-measures and banks ATTN_BENCH_r*.json to validate or "
-            "correct this default the next healthy chip window) while "
-            "flash wins on memory scaling at long seq. 0 = always flash.")
+            "0 = always flash. Lowered 2048 -> 1024 on r05 on-chip "
+            "evidence: (a) ATTN_BENCH_r05 block sweep: 512x512 blocks cut "
+            "flash fwd+bwd 108.6 -> 76.0 ms at seq 4096 (dense: 100.6), "
+            "and flash already matched dense at 1024 with the OLD slow "
+            "blocks; (b) PROFILE_r05: the dense path's materialized mask "
+            "+ f32 score temps put copy/layout at 67% of accumulated "
+            "device time on GPT-345M seq 1024; (c) TRAIN_TUNE_r05: dense "
+            "bf16[16,16,1024,1024] score temps (512 MB/layer) OOM the "
+            "batch-16 345M step that flash runs fine.")
 define_flag("flash_compact_stats", True,
             "Flash-attention stats stay compact (BH, S) at the kernel "
             "boundary: fwd keeps softmax stats in VMEM scratch and emits "

@@ -1,2 +1,3 @@
 from . import random  # noqa: F401
 from .random import get_rng_state, seed, set_rng_state  # noqa: F401
+from .lazy import LazyGuard, materialize  # noqa: F401

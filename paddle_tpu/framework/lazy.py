@@ -14,14 +14,23 @@ load or ``Layer.load_raw_state``).
 from __future__ import annotations
 
 _LAZY = False
+_EPOCH = 0
 
 
 class LazyGuard:
-    """Context manager: parameters created inside are meta tensors."""
+    """Context manager: parameters created inside are meta tensors.
+
+    Each outermost guard opens a new *epoch*: parameters created under
+    separate ``with LazyGuard():`` blocks live in separate registries, so
+    materializing one model never touches another (models built inside
+    the SAME guard share an epoch and replay their interleaved RNG stream
+    together, exactly as an eager build would)."""
 
     def __enter__(self):
-        global _LAZY
+        global _LAZY, _EPOCH
         self._prev = _LAZY
+        if not _LAZY:
+            _EPOCH += 1
         _LAZY = True
         return self
 
@@ -33,3 +42,141 @@ class LazyGuard:
 
 def in_lazy_init() -> bool:
     return _LAZY
+
+
+def is_lazy(tensor) -> bool:
+    """True when ``tensor`` is a meta tensor created under ``LazyGuard``
+    (its value is a ``jax.ShapeDtypeStruct`` — shape+dtype, no bytes)."""
+    import jax
+    return tensor is not None and isinstance(
+        getattr(tensor, "_value", None), jax.ShapeDtypeStruct)
+
+
+# Per-epoch creation-order registries of lazy parameters. Initializers
+# draw from the GLOBAL framework RNG stream (framework.random.next_key),
+# so replaying them out of creation order would permute the stream and
+# produce different weights than an eager build with the same seed.
+# Registry: {"entries": [[init, dtype, weakref] | None], "swept": int,
+# "live": int}; a parameter's ``_lazy_init`` holds (epoch, index).
+# materialize_parameter(p) sweeps every live entry of p's OWN epoch
+# created before p first, which makes the lazy path bit-identical to
+# eager construction (tested: TestLazyStreamingQuantize). Entries retire
+# (-> None) on successful init or when the parameter is garbage-collected
+# (weakref callback), and an epoch whose live count hits zero is dropped
+# wholesale — initializer objects don't outlive their model.
+_REGISTRIES: dict = {}
+_CONSUMED = object()  # sentinel: weight was eaten by streaming quantization
+
+
+def _retire(reg: dict, epoch: int, idx: int) -> None:
+    if reg["entries"][idx] is not None:
+        reg["entries"][idx] = None
+        reg["live"] -= 1
+        if reg["live"] == 0:
+            _REGISTRIES.pop(epoch, None)
+
+
+def register_lazy(p, init, dtype) -> None:
+    import weakref
+    reg = _REGISTRIES.get(_EPOCH)
+    if reg is None:
+        # snapshot the global RNG stream position: materialization
+        # replays inits from HERE, so draws between construction and
+        # materialize() cannot shift the replayed weights
+        from .random import get_rng_state
+        reg = _REGISTRIES[_EPOCH] = {"entries": [], "swept": 0, "live": 0,
+                                     "rng_state": get_rng_state()}
+    idx = len(reg["entries"])
+    p._lazy_init = (_EPOCH, idx)
+    epoch = _EPOCH
+
+    def _gone(_ref, _e=epoch, _i=idx):
+        r = _REGISTRIES.get(_e)
+        if r is not None:
+            _retire(r, _e, _i)
+
+    reg["entries"].append([init, dtype, weakref.ref(p, _gone)])
+    reg["live"] += 1
+
+
+def mark_consumed(p) -> None:
+    """Streaming quantization re-lazifies a source weight after folding it
+    into an int8 buffer; mark it so later materialization attempts fail
+    loudly instead of silently skipping or crashing mid-op."""
+    p._lazy_init = _CONSUMED
+
+
+def materialize_parameter(p) -> None:
+    """Run a lazy parameter's recorded initializer in-place (no-op when
+    already live), after first materializing every lazy parameter created
+    before it in the same epoch (RNG-stream order — see ``_REGISTRIES``).
+    Raises when the parameter predates initializer recording: load values
+    instead.
+
+    RNG semantics: the sweep restores the stream position snapshotted at
+    the epoch's first lazy creation, so the replayed weights are
+    bit-identical to an eager build with the same seed even when other
+    RNG consumers ran between construction and materialization (those
+    consumers themselves see a different stream than an eager interleave
+    would give them — the weights are the guarantee). An initializer that
+    raises (e.g. OOM) leaves its entry pending at the exact stream
+    position it started from, so a retry replays it identically.
+
+    Caveat: a lazy parameter garbage-collected (or checkpoint-loaded)
+    before materialization is skipped without consuming its RNG keys, so
+    later parameters shift relative to an eager build that DID initialize
+    it."""
+    if not is_lazy(p):
+        return
+    rec = getattr(p, "_lazy_init", None)
+    if rec is _CONSUMED:
+        raise RuntimeError(
+            f"lazy parameter {p.name!r} was consumed by streaming "
+            "quantization (nn.quant.QuantizedLinear.from_linear); the "
+            "quantized layer replaced it — this source layer is dead")
+    if rec is None:
+        raise RuntimeError(
+            f"lazy parameter {p.name!r} has no recorded initializer; "
+            "materialize it by loading a checkpoint (set_state_dict / "
+            "load_raw_state)")
+    epoch, idx = rec
+    reg = _REGISTRIES.get(epoch)
+    if reg is None:  # every entry retired yet p still lazy: stale _lazy_init
+        raise RuntimeError(
+            f"lazy parameter {p.name!r}'s registry epoch was already "
+            "retired; materialize it by loading a checkpoint")
+    from .random import get_rng_state, set_rng_state
+    outer = get_rng_state()
+    set_rng_state(reg["rng_state"])
+    try:
+        for i in range(reg["swept"], idx + 1):
+            entry = reg["entries"][i]
+            if entry is None:
+                continue
+            init, dtype, ref = entry
+            q = ref()
+            if q is not None and is_lazy(q) and getattr(
+                    q, "_lazy_init", None) == (epoch, i):
+                q._value = init(tuple(q._value.shape), dtype)
+            _retire(reg, epoch, i)  # retire only after a successful init
+    finally:
+        # resume point for later sweeps (exact even after a failed init),
+        # then hand the ambient stream back untouched
+        reg["rng_state"] = get_rng_state()
+        set_rng_state(outer)
+    n = len(reg["entries"])
+    while reg["swept"] < n and reg["entries"][reg["swept"]] is None:
+        reg["swept"] += 1
+
+
+def materialize(layer) -> "object":
+    """Materialize every remaining lazy parameter of ``layer`` in-place
+    by running its recorded initializer (reference: paddle.LazyGuard's
+    deferred startup program). Use after :func:`LazyGuard`-scoped
+    construction when no checkpoint will be loaded — e.g. randomly
+    initialized benchmarks, or after ``nn.quant.quantize_linears`` has
+    streamed the Linear weights into int8 and only embeddings/norms
+    remain lazy. Returns ``layer``."""
+    for _, p in layer.named_parameters():
+        materialize_parameter(p)
+    return layer

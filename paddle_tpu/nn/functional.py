@@ -191,6 +191,10 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
     n_axes = len(tuple(normalized_shape))
 
     def fn(a, *wb):
+        # stats in fp32, output (and affine params) in the input dtype:
+        # keeps a bf16 residual stream bf16 under amp (see amp/auto_cast.py
+        # BLACK_LIST note) without giving up fp32 mean/var numerics
+        wb = tuple(w.astype(a.dtype) for w in wb)
         axes = tuple(range(a.ndim - n_axes, a.ndim))
         mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
         var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
@@ -219,7 +223,7 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
         var = jnp.mean(h * h, axis=-1, keepdims=True)
         out = (h * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
         if w:
-            out = out * w[0]
+            out = out * w[0].astype(a.dtype)
         return out
     args = (x,) + ((weight,) if weight is not None else ())
     return apply_op("rms_norm", fn, *args)
@@ -249,6 +253,7 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         mean_, var_ = rm, rv
 
     def fn(a, *wb):
+        wb = tuple(w.astype(a.dtype) for w in wb)
         shape = stats_shape(a)
         out = (a - mean_.reshape(shape)) * jax.lax.rsqrt(var_.reshape(shape) + epsilon)
         i = 0
@@ -269,6 +274,7 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
                data_format="NCHW", name=None):
     def fn(a, *wb):
+        wb = tuple(w.astype(a.dtype) for w in wb)
         if not data_format.startswith("NC"):
             a_t = jnp.moveaxis(a, -1, 1)
         else:
@@ -301,6 +307,7 @@ def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
 def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
                   use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
     def fn(a, *wb):
+        wb = tuple(w.astype(a.dtype) for w in wb)
         axes = tuple(range(2, a.ndim))
         mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
         var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
@@ -733,9 +740,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
         scores = jnp.einsum("bhsd,bhtd->bhst", qt, kt) / math.sqrt(q.shape[-1])
         if is_causal:
+            # iota comparison instead of a materialized tril constant: XLA
+            # fuses it into the where; the pred[S,S] table showed up as the
+            # TOP op (copy-start, 3% device time) in PROFILE_r05
             s, t = scores.shape[-2], scores.shape[-1]
-            causal = jnp.tril(jnp.ones((s, t), bool))
-            scores = jnp.where(causal, scores, -1e30)
+            rows = jax.lax.broadcasted_iota(jnp.int32, (s, t), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (s, t), 1)
+            scores = jnp.where(rows >= cols, scores, -1e30)
         if mask_val is not None:
             if mask_val.dtype == jnp.bool_:
                 scores = jnp.where(mask_val, scores, -1e30)

@@ -121,7 +121,8 @@ class Layer:
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
         from ..framework.lazy import in_lazy_init
-        if in_lazy_init():
+        lazy = in_lazy_init()
+        if lazy:
             # meta tensor: shape+dtype only, zero bytes (paddle.LazyGuard)
             import jax
             from ..core.dtype import to_jax_dtype
@@ -130,6 +131,13 @@ class Layer:
         else:
             value = init(tuple(int(s) for s in shape), dtype)
         p = Parameter(value, name=name, trainable=trainable)
+        if lazy:
+            # retain the initializer so framework.materialize / streaming
+            # quantization can realize this parameter later without a
+            # checkpoint (reference: lazy_init.py keeps the startup
+            # program's init ops for the same reason)
+            from ..framework.lazy import register_lazy
+            register_lazy(p, init, dtype)
         p.optimize_attr["learning_rate"] = lr
         p.optimize_attr["regularizer"] = regularizer
         return p

@@ -81,18 +81,51 @@ class QuantizedLinear(Layer):
                 f"out_features={self._out_features}, algo={self._algo}")
 
     @staticmethod
-    def from_linear(linear, algo="weight_only_int8", group_size=-1):
+    def from_linear(linear, algo="weight_only_int8", group_size=-1,
+                    _shared=None):
         """Quantize an existing ``nn.Linear``'s weights into a
-        QuantizedLinear (bias carried over by value)."""
+        QuantizedLinear (bias carried over by value).
+
+        Streaming materialization: a Linear built under ``LazyGuard``
+        (meta weight, zero bytes) is materialized HERE — its recorded
+        initializer runs, the bf16 weight is quantized on device, and the
+        source weight is returned to its meta state so the bf16 frees
+        immediately. Peak HBM while quantizing a LazyGuard model is thus
+        the int8 weights accumulated so far plus ONE layer's bf16 weight
+        — how a 7B model (13.4 GB bf16) becomes int8 (6.7 GB) on a single
+        16 GB v5e chip without ever holding the dense model."""
+        from ..framework.lazy import is_lazy, mark_consumed, \
+            materialize_parameter
+
         q = QuantizedLinear(linear._in_features, linear._out_features,
                             algo=algo, group_size=group_size,
                             has_bias=linear.bias is not None)
+        if _shared is not None:
+            # weight already quantized via another Linear sharing the
+            # same Parameter (quantize_linears tying): alias the SAME
+            # buffer Tensors so the tie survives quantization
+            q.register_buffer("quant_weight", _shared[0])
+            q.register_buffer("weight_scale", _shared[1])
+            if linear.bias is not None:
+                materialize_parameter(linear.bias)
+                q.bias.set_value(linear.bias)
+            return q
+        lazy_src = is_lazy(linear.weight)
+        if lazy_src:
+            meta = linear.weight._value  # ShapeDtypeStruct, re-set below
+            materialize_parameter(linear.weight)
         qw, scale = weight_quantize(linear.weight, algo=algo,
                                     group_size=group_size)
         q.quant_weight.set_value(qw)
         q.weight_scale.set_value(scale)
         if linear.bias is not None:
+            materialize_parameter(linear.bias)
             q.bias.set_value(linear.bias)
+        if lazy_src:
+            # free the one-layer bf16 now; the source Linear is dead —
+            # mark it so a later materialize() fails loudly, not silently
+            linear.weight._value = meta
+            mark_consumed(linear.weight)
         return q
 
 
@@ -113,9 +146,22 @@ def quantize_linears(layer, algo="weight_only_int8", group_size=-1,
                 if "int4" in algo and sub._in_features % 2:
                     continue
                 todo.append((parent, name, sub))
+    made = {}     # id(Linear) -> QuantizedLinear: a shared Linear
+    # instance quantizes ONCE and stays shared; a shared weight
+    # PARAMETER across distinct Linears quantizes once and the second
+    # QuantizedLinear aliases the same int8 buffers — either way the tie
+    # survives instead of untying into duplicate copies (and, on the
+    # lazy streaming path, crashing on the second consume of the weight)
+    wcache = {}   # id(weight Parameter) -> (quant_weight, weight_scale)
     for parent, name, sub in todo:
-        setattr(parent, name,
-                QuantizedLinear.from_linear(sub, algo, group_size))
+        q = made.get(id(sub))
+        if q is None:
+            shared = wcache.get(id(sub.weight))
+            q = made[id(sub)] = QuantizedLinear.from_linear(
+                sub, algo, group_size, _shared=shared)
+            if shared is None:
+                wcache[id(sub.weight)] = (q.quant_weight, q.weight_scale)
+        setattr(parent, name, q)
     return layer
 
 

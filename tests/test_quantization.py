@@ -163,3 +163,223 @@ class TestReviewContracts:
         ptq.quantize(model)
         with pytest.raises(RuntimeError, match="already"):
             ptq.quantize(model)
+
+
+class TestLazyStreamingQuantize:
+    """LazyGuard-built models stream into int8 one Linear at a time
+    (paddle_tpu/nn/quant.py from_linear): the recorded initializer runs,
+    the bf16 weight quantizes on device, and the source re-lazifies so
+    peak memory stays int8-so-far + one dense layer — the path that fits
+    Llama-7B int8 onto a single 16 GB chip."""
+
+    def test_from_linear_materializes_and_relazifies(self):
+        from paddle_tpu.framework.lazy import is_lazy
+        from paddle_tpu.nn.quant import QuantizedLinear
+
+        with paddle.LazyGuard():
+            lin = nn.Linear(16, 8)
+        assert is_lazy(lin.weight)
+        q = QuantizedLinear.from_linear(lin)
+        # source weight is back to meta (bf16 freed); quantized buffers live
+        assert is_lazy(lin.weight)
+        assert not is_lazy(q.quant_weight)
+        assert abs(np.asarray(q.weight_scale.numpy())).max() > 0
+
+    def test_lazy_model_quantize_then_materialize_runs(self):
+        from paddle_tpu.framework import materialize
+        from paddle_tpu.framework.lazy import is_lazy
+        from paddle_tpu.nn.quant import QuantizedLinear, quantize_linears
+
+        with paddle.LazyGuard():
+            m = nn.Sequential(nn.Linear(12, 24), nn.ReLU(), nn.Linear(24, 4))
+        quantize_linears(m)
+        materialize(m)  # biases of QuantizedLinear etc.
+        assert not any(is_lazy(p) for p in m.parameters())
+        out = m(paddle.to_tensor(np.random.default_rng(0)
+                                 .standard_normal((3, 12), dtype=np.float32)))
+        assert np.isfinite(out.numpy()).all()
+
+    def test_quantized_matches_eager_quantized(self):
+        """Same seed -> the lazy-streamed int8 model equals quantizing an
+        eagerly built one (initializer replay is exact, not approximate)."""
+        from paddle_tpu.framework import materialize
+        from paddle_tpu.nn.quant import quantize_linears
+
+        def build():
+            paddle.seed(1234)
+            return nn.Sequential(nn.Linear(10, 20), nn.Sigmoid(),
+                                 nn.Linear(20, 5))
+
+        eager = quantize_linears(build())
+        paddle.seed(0)  # streaming replay must not depend on ambient seed
+        with paddle.LazyGuard():
+            lazy = build()
+        quantize_linears(lazy)
+        materialize(lazy)
+        x = paddle.to_tensor(np.random.default_rng(1)
+                             .standard_normal((4, 10), dtype=np.float32))
+        np.testing.assert_allclose(eager(x).numpy(), lazy(x).numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_materialize_without_initializer_record_raises(self):
+        from paddle_tpu.framework.lazy import materialize_parameter
+
+        with paddle.LazyGuard():
+            lin = nn.Linear(4, 4)
+        del lin.weight._lazy_init
+        with pytest.raises(RuntimeError, match="recorded initializer"):
+            materialize_parameter(lin.weight)
+
+    def test_llama_lazy_decode_matches_eager(self):
+        """Regression: materialization must replay the GLOBAL RNG stream
+        in creation order — quantize_linears touches Linears before the
+        earlier-created embedding, and without the creation-order sweep
+        (framework/lazy.py _REGISTRY) the embedding drew later keys and
+        every decode token diverged."""
+        from paddle_tpu.framework import materialize
+        from paddle_tpu.nn.quant import quantize_linears
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        def build():
+            paddle.seed(7)
+            return LlamaForCausalLM(LlamaConfig.tiny())
+
+        eager = quantize_linears(build())
+        with paddle.LazyGuard():
+            lazy = build()
+        quantize_linears(lazy)
+        materialize(lazy)
+        ids = paddle.to_tensor(np.array([[5, 9, 2, 11]], dtype=np.int32))
+        a = eager.generate_paged(ids, max_new_tokens=6, page_size=8).numpy()
+        b = lazy.generate_paged(ids, max_new_tokens=6, page_size=8).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_consumed_source_weight_raises_loudly(self):
+        """Review finding: a streaming-consumed Linear must not be
+        silently skippable or crash deep in weight_quantize — direct
+        materialization raises a clear error."""
+        from paddle_tpu.framework.lazy import materialize_parameter
+        from paddle_tpu.nn.quant import QuantizedLinear
+
+        with paddle.LazyGuard():
+            lin = nn.Linear(8, 8)
+        QuantizedLinear.from_linear(lin)
+        with pytest.raises(RuntimeError, match="consumed by streaming"):
+            materialize_parameter(lin.weight)
+
+    def test_separate_guards_are_isolated_epochs(self):
+        """Review finding: materializing model B must not force-init (or
+        consume the RNG keys of) model A built under a different guard."""
+        from paddle_tpu.framework import materialize
+        from paddle_tpu.framework.lazy import is_lazy
+
+        with paddle.LazyGuard():
+            a = nn.Linear(6, 6)
+        with paddle.LazyGuard():
+            b = nn.Linear(6, 6)
+        materialize(b)
+        assert is_lazy(a.weight)          # untouched
+        assert not is_lazy(b.weight)
+        materialize(a)                    # still materializable
+        assert not is_lazy(a.weight)
+
+    def test_shared_linear_quantizes_once_and_stays_tied(self):
+        """Review finding: a weight-tied (shared-instance) Linear must
+        quantize to ONE shared QuantizedLinear — on both paths."""
+        from paddle_tpu.framework import materialize
+        from paddle_tpu.nn.quant import quantize_linears
+
+        class Tied(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                lin = nn.Linear(8, 8)
+                self.a = lin
+                self.b = lin
+
+            def forward(self, x):
+                return self.b(self.a(x))
+
+        for lazy in (False, True):
+            if lazy:
+                with paddle.LazyGuard():
+                    m = Tied()
+            else:
+                m = Tied()
+            quantize_linears(m)
+            assert m.a is m.b, f"untied (lazy={lazy})"
+            if lazy:
+                materialize(m)
+            out = m(paddle.to_tensor(np.ones((2, 8), np.float32)))
+            assert np.isfinite(out.numpy()).all()
+
+    def test_registry_drops_when_lazy_model_is_garbage_collected(self):
+        """Review finding: registry entries (pinning initializer objects)
+        must not outlive an abandoned lazy model."""
+        import gc
+        from paddle_tpu.framework.lazy import _REGISTRIES
+
+        with paddle.LazyGuard():
+            m = nn.Linear(4, 4)
+        epoch = m.weight._lazy_init[0]
+        assert epoch in _REGISTRIES
+        del m
+        gc.collect()
+        assert epoch not in _REGISTRIES
+
+    def test_parameter_level_tying_quantizes_once(self):
+        """Review finding: two DISTINCT Linear instances sharing one
+        weight Parameter must alias one set of int8 buffers (eager) and
+        must not crash on the consumed sentinel (lazy)."""
+        from paddle_tpu.framework import materialize
+        from paddle_tpu.nn.quant import quantize_linears
+
+        class ParamTied(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a = nn.Linear(8, 8)
+                self.b = nn.Linear(8, 8)
+                self.b.weight = self.a.weight   # tie the Parameter only
+
+            def forward(self, x):
+                return self.b(self.a(x))
+
+        for lazy in (False, True):
+            if lazy:
+                with paddle.LazyGuard():
+                    m = ParamTied()
+            else:
+                m = ParamTied()
+            quantize_linears(m)
+            assert m.a is not m.b
+            assert m.a.quant_weight is m.b.quant_weight, f"untied (lazy={lazy})"
+            assert m.a.weight_scale is m.b.weight_scale
+            if lazy:
+                materialize(m)
+            out = m(paddle.to_tensor(np.ones((2, 8), np.float32)))
+            assert np.isfinite(out.numpy()).all()
+
+    def test_intervening_rng_draws_do_not_shift_replay(self):
+        """Review finding: RNG use between lazy construction and
+        materialization must not change the replayed weights — the epoch
+        snapshots its stream position."""
+        from paddle_tpu.framework import materialize
+
+        paddle.seed(321)
+        eager = nn.Linear(16, 16)
+        paddle.seed(321)
+        with paddle.LazyGuard():
+            lazy = nn.Linear(16, 16)
+        # burn keys between construction and materialization
+        _ = paddle.to_tensor(np.zeros((4, 4), np.float32))
+        paddle.nn.functional.dropout(
+            paddle.to_tensor(np.ones((8, 8), np.float32)), p=0.5,
+            training=True)
+        materialize(lazy)
+        np.testing.assert_array_equal(eager.weight.numpy(),
+                                      lazy.weight.numpy())
+        # and the ambient stream continues where the burn left it (the
+        # sweep restores it) — drawing now must not repeat init keys
+        a = paddle.nn.functional.dropout(
+            paddle.to_tensor(np.ones((8, 8), np.float32)), p=0.5,
+            training=True)
+        assert a is not None
