@@ -56,8 +56,10 @@ def is_lazy(tensor) -> bool:
 # draw from the GLOBAL framework RNG stream (framework.random.next_key),
 # so replaying them out of creation order would permute the stream and
 # produce different weights than an eager build with the same seed.
-# Registry: {"entries": [[init, dtype, weakref] | None], "swept": int,
-# "live": int}; a parameter's ``_lazy_init`` holds (epoch, index).
+# Registry: {"entries": [[init, weakref] | None], "swept": int,
+# "live": int, "rng_state": key}; a parameter's ``_lazy_init`` holds
+# (epoch, index). The materialization dtype is the param struct's
+# CURRENT dtype (Layer.to retypes meta params), not a recorded one.
 # materialize_parameter(p) sweeps every live entry of p's OWN epoch
 # created before p first, which makes the lazy path bit-identical to
 # eager construction (tested: TestLazyStreamingQuantize). Entries retire
@@ -76,7 +78,7 @@ def _retire(reg: dict, epoch: int, idx: int) -> None:
             _REGISTRIES.pop(epoch, None)
 
 
-def register_lazy(p, init, dtype) -> None:
+def register_lazy(p, init) -> None:
     import weakref
     reg = _REGISTRIES.get(_EPOCH)
     if reg is None:
@@ -95,7 +97,7 @@ def register_lazy(p, init, dtype) -> None:
         if r is not None:
             _retire(r, _e, _i)
 
-    reg["entries"].append([init, dtype, weakref.ref(p, _gone)])
+    reg["entries"].append([init, weakref.ref(p, _gone)])
     reg["live"] += 1
 
 
@@ -153,11 +155,14 @@ def materialize_parameter(p) -> None:
             entry = reg["entries"][i]
             if entry is None:
                 continue
-            init, dtype, ref = entry
+            init, ref = entry
             q = ref()
             if q is not None and is_lazy(q) and getattr(
                     q, "_lazy_init", None) == (epoch, i):
-                q._value = init(tuple(q._value.shape), dtype)
+                # honor the struct's CURRENT dtype, not the recorded one:
+                # Layer.to(dtype=...) retypes meta params before
+                # materialization (the 7B-int8 flow builds bf16 this way)
+                q._value = init(tuple(q._value.shape), q._value.dtype)
             _retire(reg, epoch, i)  # retire only after a successful init
     finally:
         # resume point for later sweeps (exact even after a failed init),

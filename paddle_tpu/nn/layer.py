@@ -137,7 +137,7 @@ class Layer:
             # checkpoint (reference: lazy_init.py keeps the startup
             # program's init ops for the same reason)
             from ..framework.lazy import register_lazy
-            register_lazy(p, init, dtype)
+            register_lazy(p, init)
         p.optimize_attr["learning_rate"] = lr
         p.optimize_attr["regularizer"] = regularizer
         return p
@@ -300,6 +300,12 @@ class Layer:
         if dtype is not None:
             jd = to_jax_dtype(dtype)
             for p in self.parameters():
+                if isinstance(p._value, jax.ShapeDtypeStruct):
+                    # lazy (meta) param: retype the struct; the recorded
+                    # initializer materializes in the new dtype later
+                    if jnp.issubdtype(p._value.dtype, jnp.floating):
+                        p._value = jax.ShapeDtypeStruct(p._value.shape, jd)
+                    continue
                 if jnp.issubdtype(jnp.result_type(p._value), jnp.floating):
                     p._value = p._value.astype(jd)
             for b in self.buffers():

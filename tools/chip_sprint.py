@@ -376,9 +376,14 @@ STEPS = {
     "profile": (f"PROFILE_{ROUND}.json", None, 2400),
     # batch sweep: two train-only bench points above the banked batch 8
     "tune": (f"TRAIN_TUNE_{ROUND}.json", step_tune, 5400),
+    # Llama-2-7B int8 serving on the single chip: the streaming-quantize
+    # path (13.4 GB bf16 model -> 6.6 GB int8 without ever holding the
+    # dense weights) + paged-KV decode at batch 1 and 8
+    "decode7b": (f"DECODE7B_{ROUND}.json", None, 5400),
 }
 _TOOL_SCRIPTS = {"attn": "attn_bench.py", "rmsnorm": "rmsnorm_bench.py",
-                 "profile": "train_profile.py"}
+                 "profile": "train_profile.py",
+                 "decode7b": "decode7b_bench.py"}
 
 
 def run_worker(step: str) -> None:
@@ -556,12 +561,12 @@ def main() -> int:
     # the cheapest thing to lose (r05: the attn step wedged a live
     # window for its full timeout with train still unbanked behind it)
     order = ["kernels", "train", "attn", "rmsnorm", "sd", "profile",
-             "tune"]
+             "tune", "decode7b"]
     if test_mode:
         # plumbing validation for every step with new code paths; the
         # attn/rmsnorm tools predate the sprint and train is the bench's
         # own --test-free path (TPU-priced end to end)
-        order = ["kernels", "profile", "tune"]
+        order = ["kernels", "profile", "tune", "decode7b"]
     ok = True
     for step in order:
         if not run_step(step, test_mode):

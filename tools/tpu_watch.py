@@ -79,7 +79,7 @@ ROUND = os.environ.get("CHIP_SPRINT_ROUND", "r05")
 ARTIFACTS = [f"KERNEL_COMPILE_{ROUND}.json", f"ATTN_BENCH_{ROUND}.json",
              f"RMSNORM_BENCH_{ROUND}.json", f"BENCH_tpu_{ROUND}.json",
              f"SD_BENCH_{ROUND}.json", f"PROFILE_{ROUND}.json",
-             f"TRAIN_TUNE_{ROUND}.json"]
+             f"TRAIN_TUNE_{ROUND}.json", f"DECODE7B_{ROUND}.json"]
 
 
 def run_sprint() -> None:
