@@ -137,6 +137,13 @@ define_flag("flash_attn_min_seqlen", 1024,
             "device time on GPT-345M seq 1024; (c) TRAIN_TUNE_r05: dense "
             "bf16[16,16,1024,1024] score temps (512 MB/layer) OOM the "
             "batch-16 345M step that flash runs fine.")
+define_flag("embedding_matmul_grad", "auto",
+            "Embedding-lookup weight gradient as a one-hot matmul "
+            "instead of jnp.take's scatter-add vjp: 'auto' = on TPU "
+            "backends only (XLA lowers big scatter-adds to serialized "
+            "while loops there — PROFILE_r05 top ops), 'on'/'off' = "
+            "force. The matmul accumulates in f32 on the MXU; the "
+            "transient one-hot is [tokens, vocab] in the grad dtype.")
 define_flag("flash_compact_stats", True,
             "Flash-attention stats stay compact (BH, S) at the kernel "
             "boundary: fwd keeps softmax stats in VMEM scratch and emits "

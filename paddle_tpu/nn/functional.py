@@ -160,14 +160,56 @@ def linear(x, weight, bias=None, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    from .. import flags
+
     idx = _val(x)
-    def fn(w):
+    mode = flags.get_flag("embedding_matmul_grad")
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(
+            f"FLAGS_embedding_matmul_grad must be 'auto', 'on' or 'off', "
+            f"got {mode!r}")
+    matmul_grad = (mode == "on"
+                   or (mode == "auto" and flags.is_tpu_backend()))
+    if padding_idx is not None and padding_idx < 0:
+        # paddle semantics: negative padding_idx counts from the end
+        padding_idx = int(weight.shape[0]) + int(padding_idx)
+
+    def take(w):
         out = jnp.take(w, idx, axis=0)
         if padding_idx is not None:
             mask = (idx == padding_idx)[..., None]
             out = jnp.where(mask, 0.0, out)
         return out
-    return apply_op("embedding", fn, weight)
+
+    if not matmul_grad:
+        return apply_op("embedding", take, weight)
+
+    # custom vjp: d_w as a one-hot matmul on the MXU. jnp.take's native
+    # vjp is a scatter-add, which XLA lowers to a serialized while loop
+    # on TPU — PROFILE_r05 showed those loops (carrying the whole
+    # bf16[50304,1024] table) among the top ops of the 345M step. The
+    # one-hot contraction is the same math (sum of grads per token id),
+    # runs as one matmul, and accumulates in f32 for free on the MXU.
+    @jax.custom_vjp
+    def lookup(w):
+        return take(w)
+
+    def fwd(w):
+        return take(w), w.shape[0]
+
+    def bwd(vocab, g):
+        flat_idx = idx.reshape(-1)
+        flat_g = g.reshape(-1, g.shape[-1])
+        if padding_idx is not None:
+            keep = (flat_idx != padding_idx)[:, None]
+            flat_g = jnp.where(keep, flat_g, 0.0)
+        oh = jax.nn.one_hot(flat_idx, vocab, dtype=flat_g.dtype)
+        d_w = jnp.matmul(oh.T, flat_g,
+                         preferred_element_type=jnp.float32)
+        return (d_w.astype(g.dtype),)
+
+    lookup.defvjp(fwd, bwd)
+    return apply_op("embedding", lookup, weight)
 
 
 def one_hot(x, num_classes, name=None):

@@ -195,3 +195,56 @@ class TestEndToEndTraining:
             opt.clear_grad()
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]
+
+
+class TestEmbeddingMatmulGrad:
+    """flags.embedding_matmul_grad: the one-hot-matmul vjp must be the
+    same math as jnp.take's scatter-add vjp (PROFILE_r05 motivated the
+    TPU dispatch; parity is checked here on CPU by forcing 'on')."""
+
+    def _run(self, mode, pad=None):
+        from paddle_tpu import flags
+
+        rng = np.random.default_rng(0)
+        w = rng.standard_normal((37, 16)).astype(np.float32)
+        ids = rng.integers(0, 37, (2, 5)).astype(np.int32)
+        up = rng.standard_normal((2, 5, 16)).astype(np.float32)
+        prev = flags.get_flag("embedding_matmul_grad")
+        paddle.set_flags({"embedding_matmul_grad": mode})
+        try:
+            wt = paddle.to_tensor(w, stop_gradient=False)
+            out = F.embedding(paddle.to_tensor(ids), wt, padding_idx=pad)
+            (out * paddle.to_tensor(up)).sum().backward()
+            return out.numpy(), wt.grad.numpy()
+        finally:
+            paddle.set_flags({"embedding_matmul_grad": prev})
+
+    # negative padding_idx counts from the end (paddle semantics);
+    # 3 and 3-37 must behave identically in BOTH vjp modes
+    @pytest.mark.parametrize("pad", [None, 3, 3 - 37])
+    def test_matmul_vjp_matches_scatter_vjp(self, pad):
+        o_s, g_s = self._run("off", pad)
+        o_m, g_m = self._run("on", pad)
+        np.testing.assert_allclose(o_s, o_m, rtol=1e-6)
+        np.testing.assert_allclose(g_s, g_m, rtol=1e-5, atol=1e-5)
+
+    def test_negative_padding_idx_zeroes_row(self):
+        o, g = self._run("off", pad=3 - 37)
+        op, gp = self._run("off", pad=3)
+        np.testing.assert_array_equal(o, op)
+        np.testing.assert_array_equal(g, gp)
+        assert (g[3] == 0).all()
+
+    def test_auto_is_scatter_on_cpu(self):
+        from paddle_tpu import flags
+        if flags.is_tpu_backend():
+            pytest.skip("auto dispatches the matmul vjp on TPU")
+        # 'auto' must not pay the [tokens, vocab] one-hot on CPU
+        o, g = self._run("auto")
+        o2, g2 = self._run("off")
+        np.testing.assert_array_equal(o, o2)
+        np.testing.assert_array_equal(g, g2)
+
+    def test_invalid_mode_raises(self):
+        with pytest.raises(ValueError, match="embedding_matmul_grad"):
+            self._run("On")
