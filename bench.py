@@ -48,7 +48,9 @@ def cache_env(env: dict) -> dict:
 BENCH_SCHEMA = 3
 # same idea for the kernel-compile artifact: bump when NEW kernels join
 # the check list (2 = + paged/block-table decode attention)
-KERNELS_SCHEMA = 2
+# (3 = + SD-UNet head shapes d=40/80/160 non-causal: the
+# flash_attn_min_seqlen 1024 flip routes them through the kernel)
+KERNELS_SCHEMA = 3
 
 
 def build_train_setup(model_name: Optional[str] = None):

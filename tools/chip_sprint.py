@@ -214,6 +214,20 @@ def step_kernels() -> list:
     check("paged_attention_parity_vs_xla", parity,
           paged_attention_xla, paged_attention, qd, kp, vp, bt, sl)
 
+    # SD-UNet head shapes (kernels schema 3): the flash_attn_min_seqlen
+    # 2048->1024 flip newly routes the UNet's seq-1024 self-attention
+    # (head_dim 80) through the kernel; seq-4096/d=40 was exercised by
+    # the banked SD bench but gets an explicit record here too.
+    # Non-causal, like the UNet.
+    import functools
+    for d_sd, s_sd in ((40, 4096), (80, 1024), (160, 1024)):
+        qs = mk(1, s_sd, 8, d_sd)
+        ks, vs = mk(1, s_sd, 8, d_sd), mk(1, s_sd, 8, d_sd)
+        check(f"flash_fwd_d{d_sd}_s{s_sd}",
+              functools.partial(fwd, causal=False), qs, ks, vs)
+        check(f"flash_bwd_d{d_sd}_s{s_sd}",
+              functools.partial(bwd, causal=False), qs, ks, vs)
+
     for r in results:
         r["bench_schema"] = KERNELS_SCHEMA
     return results
