@@ -252,17 +252,21 @@ class TestHTTPKVRendezvous:
                     HTTPRendezvous)
                 r = HTTPRendezvous({rdzv.endpoint!r})
                 r.register(sys.argv[1], {{"rank": int(sys.argv[2])}})
-                ok = r.barrier(3, timeout=20)
+                ok = r.barrier(3, timeout=90)
                 sys.exit(0 if ok else 7)
             """)
             procs = [subprocess.Popen(
                 [sys.executable, worker, f"w{i}", str(i)],
                 env=_clean_env()) for i in range(2)]
-            # the third member registers in-process (the master node)
+            # the third member registers in-process (the master node).
+            # Generous timeouts: each worker pays the full interpreter +
+            # package import before registering, which takes tens of
+            # seconds on a loaded machine (observed flake in a full-suite
+            # run alongside two other pytest sessions).
             rdzv.register("w2", {"rank": 2})
-            assert rdzv.barrier(3, timeout=20)
+            assert rdzv.barrier(3, timeout=90)
             for p in procs:
-                assert p.wait(timeout=30) == 0
+                assert p.wait(timeout=120) == 0
             assert rdzv.alive_nodes() == ["w0", "w1", "w2"]
         finally:
             rdzv.shutdown()
