@@ -374,6 +374,7 @@ def step_sd() -> list:
 
     rec = bench_mod._sd_unet_bench(paddle, jax, is_tpu_backend())
     rec["backend"] = jax.default_backend()
+    rec["bench_schema"] = bench_mod.BENCH_SCHEMA
     return [rec]
 
 
