@@ -150,3 +150,31 @@ class TestCrossFeatureComposition:
         assert x.grad is not None
         assert float(np.abs(x.grad.numpy()).sum()) > 0
         assert q.bias.grad is not None
+
+    def test_lazy_streamed_int8_model_serves_exactly(self):
+        """The 7B-on-one-chip flow end to end at tiny scale: LazyGuard
+        meta build -> streaming int8 quantize -> materialize -> the
+        continuous-batching engine. Tokens must equal the solo decode of
+        the SAME lazy-built model (and, by RNG replay, of an eager
+        build with the same seed)."""
+        from paddle_tpu.framework import materialize
+        from paddle_tpu.nn.quant import quantize_linears
+
+        def build():
+            paddle.seed(85)
+            return GPTForCausalLM(GPTConfig.tiny())
+
+        eager = quantize_linears(build())
+        with paddle.LazyGuard():
+            model = build()
+        quantize_linears(model)
+        materialize(model)
+        cfg = model.config
+        rng = np.random.default_rng(3)
+        p1 = rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32)
+        p2 = rng.integers(0, cfg.vocab_size, (7,)).astype(np.int32)
+        ref1, ref2 = solo(eager, p1, 5), solo(eager, p2, 5)
+        eng = ServingEngine(model, max_batch=2, page_size=8, max_seq_len=32)
+        r1, r2 = eng.submit(p1, 5), eng.submit(p2, 5)
+        out = eng.run()
+        assert out[r1] == ref1 and out[r2] == ref2
