@@ -75,8 +75,8 @@ def main() -> int:
         v = t._value
         return v.size * v.dtype.itemsize
 
-    int8_bytes = sum(nbytes(b) for _, b in model.named_buffers()
-                     if "quant_weight" in _ or "weight_scale" in _)
+    int8_bytes = sum(nbytes(b) for bname, b in model.named_buffers()
+                     if "quant_weight" in bname or "weight_scale" in bname)
     dense_bytes = sum(nbytes(p) for p in model.parameters())
     # sync on the LAST-dispatched buffer (lm_head's int8 weight): device
     # ops complete in dispatch order, so this waits for the whole
@@ -89,7 +89,8 @@ def main() -> int:
           "int8_weight_gb": round(int8_bytes / 2**30, 3),
           "dense_param_gb": round(dense_bytes / 2**30, 3)})
 
-    bw = 819e9 if _BACKEND in ("tpu", "axon") else 50e9
+    from paddle_tpu.flags import is_tpu_backend
+    bw = 819e9 if is_tpu_backend() else 50e9
     roofline = bw / (int8_bytes + dense_bytes)
     emit({"phase": "roofline", "hbm_gb_per_s": bw / 1e9,
           "single_stream_tokens_per_sec_ceiling": round(roofline, 1)})
