@@ -46,6 +46,25 @@ def main():
         assert results[rid] == solo
         print(f"request {rid}: {results[rid]}  (== solo greedy)")
 
+    # ---- automatic prefix caching: a shared system prompt is prefilled
+    # ONCE; later requests adopt its pages read-only (copy-on-write pool)
+    eng2 = ServingEngine(model, max_batch=2, page_size=8, max_seq_len=64,
+                         prefix_cache=True)
+    system = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+    users = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+             for n in (3, 5, 4)]
+    for i, u in enumerate(users):
+        p = np.concatenate([system, u]).astype(np.int32)
+        rid = eng2.submit(p, max_new_tokens=5)
+        out = eng2.run()[rid]
+        solo = model.generate(
+            paddle.to_tensor(p[None]), max_new_tokens=5,
+            do_sample=False, return_full_sequence=False).numpy()[0].tolist()
+        assert out == solo
+        hit = eng2._prefix.lookup(p)[1]
+        print(f"prefix-cache request {i}: cached prefix {hit} tokens, "
+              f"tokens {out}  (== solo greedy)")
+
 
 if __name__ == "__main__":
     main()

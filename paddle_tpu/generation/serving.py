@@ -42,6 +42,90 @@ class Request:
     eos_token_id: Optional[int] = None
     tokens: List[int] = field(default_factory=list)
     slot: Optional[int] = None
+    # prompt-suffix tokens still to be teacher-forced through the decode
+    # step (prefix-cache admission skipped their prefill)
+    pending: List[int] = field(default_factory=list)
+
+
+class PrefixCache:
+    """Page-aligned prompt-prefix trie over a :class:`PagedKVCache`
+    (reference parity target: the vLLM-style automatic prefix caching in
+    the reference's serving ecosystem).
+
+    Each node maps one FULL page of prompt tokens (keyed by its parent
+    chain, so equal chunks under different prefixes never collide) to the
+    page id holding that chunk's KV. Registered pages carry a cache
+    reference, so they survive their creating request and later requests
+    with the same prefix adopt them read-only instead of re-running
+    prefill. Causality makes this sound: KV at position i depends only on
+    tokens 0..i, so equal page-aligned prefixes have bitwise-equal pages.
+    Eviction drops least-recently-used LEAF nodes only (an interior node
+    must outlive its children or their chains become unreachable)."""
+
+    _ROOT = ("root",)
+
+    def __init__(self, pool: PagedKVCache):
+        self.pool = pool
+        self.page_size = pool.page_size
+        # key -> {"page": int, "parent": key, "children": int, "tick": int}
+        self._nodes: Dict[tuple, dict] = {}
+        self._tick = 0
+
+    def _chunks(self, prompt: np.ndarray):
+        key = self._ROOT
+        for i in range(0, (len(prompt) // self.page_size) * self.page_size,
+                       self.page_size):
+            chunk = prompt[i:i + self.page_size].tobytes()
+            key = (key, chunk)
+            yield key
+
+    def lookup(self, prompt: np.ndarray):
+        """Longest cached page-aligned prefix: (page_ids, n_tokens)."""
+        self._tick += 1
+        pages: List[int] = []
+        for key in self._chunks(prompt):
+            node = self._nodes.get(key)
+            if node is None:
+                break
+            node["tick"] = self._tick
+            pages.append(node["page"])
+        return pages, len(pages) * self.page_size
+
+    def register(self, prompt: np.ndarray, block_row) -> None:
+        """Pin the full prompt pages of a just-prefilled sequence."""
+        self._tick += 1
+        for i, key in enumerate(self._chunks(prompt)):
+            node = self._nodes.get(key)
+            if node is not None:        # dedup: keep the existing page
+                node["tick"] = self._tick
+                continue
+            parent = key[0] if key[0] in self._nodes else None
+            self._nodes[key] = {"page": int(block_row[i]), "parent": parent,
+                                "children": 0, "tick": self._tick}
+            if parent is not None:
+                self._nodes[parent]["children"] += 1
+            self.pool.ref_page(int(block_row[i]))
+
+    def evict(self, n_pages: int) -> int:
+        """Free up to ``n_pages`` pages by dropping LRU leaf nodes whose
+        page only the cache still references (rc == 1); returns pages
+        freed. Leaves shared by live sequences are left pinned — dropping
+        them would free nothing and only destroy future reuse."""
+        freed = 0
+        while freed < n_pages:
+            leaves = [(node["tick"], key) for key, node in
+                      self._nodes.items()
+                      if node["children"] == 0
+                      and self.pool._page_rc[node["page"]] == 1]
+            if not leaves:
+                break
+            _, key = min(leaves)
+            node = self._nodes.pop(key)
+            if node["parent"] is not None:
+                self._nodes[node["parent"]]["children"] -= 1
+            self.pool.unref_page(node["page"])
+            freed += 1
+        return freed
 
 
 class ServingEngine:
@@ -51,7 +135,8 @@ class ServingEngine:
     ``run`` steps until drained and returns {rid: tokens}."""
 
     def __init__(self, model, max_batch: int = 4, page_size: int = 64,
-                 num_pages: Optional[int] = None, max_seq_len: int = 1024):
+                 num_pages: Optional[int] = None, max_seq_len: int = 1024,
+                 prefix_cache: bool = False):
         from ..jit import ensure_live
 
         self.model = model
@@ -82,6 +167,7 @@ class ServingEngine:
         self._next_rid = 0
         self._prefill_jit = None
         self._decode_jit = None
+        self._prefix = PrefixCache(self.pool) if prefix_cache else None
 
     # ------------------------------------------------------------ frontend
     def submit(self, prompt, max_new_tokens: int = 32,
@@ -126,8 +212,45 @@ class ServingEngine:
             self.pool.k_pages[i] = _val(st.k_pages)
             self.pool.v_pages[i] = _val(st.v_pages)
 
+    def _admit_shared(self, req: Request, slot: int, pages: List[int],
+                      n_cached: int) -> None:
+        """Prefix-cache admission: adopt the cached prompt pages read-only
+        and teacher-force the remaining suffix through the ordinary decode
+        step (one token per engine step) — no new compiled program, and
+        the cached portion's prefill compute is skipped entirely. The
+        model output while suffix tokens are pending is a prompt-position
+        logit and is discarded; the step that feeds the LAST suffix token
+        emits the first generated token."""
+        self.pool.adopt_shared(slot, pages)
+        self.pool.seq_lens[slot] = n_cached
+        suffix = req.prompt[n_cached:]
+        self.pool.allocate(slot, len(suffix) + req.max_new_tokens)
+        self._last_tok[slot] = int(suffix[0])
+        req.pending = [int(t) for t in suffix[1:]]
+        req.slot = slot
+        self._slots[slot] = req
+
     def _prefill(self, req: Request, slot: int) -> None:
         from ..jit import functional_call
+
+        if self._prefix is not None:
+            pages, n_cached = self._prefix.lookup(req.prompt)
+            # never cover the WHOLE prompt: the first generated token's
+            # logits are not cached, so at least one prompt token must go
+            # through compute
+            while pages and n_cached >= len(req.prompt):
+                pages = pages[:-1]
+                n_cached -= self.pool.page_size
+            # coverage threshold: the suffix replays one token per decode
+            # step, so a barely-covered long prompt would trade one b=1
+            # prefill for hundreds of full-batch steps — take the shared
+            # path only when the replay is small (a couple of pages) or
+            # the cached part dominates it
+            suffix_len = len(req.prompt) - n_cached
+            if pages and suffix_len <= max(2 * self.pool.page_size,
+                                           n_cached):
+                self._admit_shared(req, slot, pages, n_cached)
+                return
 
         p = len(req.prompt)
         fn = self._prefill_jit
@@ -159,6 +282,10 @@ class ServingEngine:
         req.tokens.append(int(tok))
         req.slot = slot
         self._slots[slot] = req
+        if self._prefix is not None:
+            # pin this prompt's full pages for future shared admissions
+            # (they are immutable: later writes land at seq_len and up)
+            self._prefix.register(req.prompt, self.pool.block_tables[slot])
         self._finish_if_done(req)
 
     def _finish_if_done(self, req: Request) -> None:
@@ -180,6 +307,9 @@ class ServingEngine:
                 req = self._queue[0]
                 need = -(-(len(req.prompt) + req.max_new_tokens)
                          // self.pool.page_size)
+                if need > self.pool.free_page_count() and self._prefix:
+                    # cached-but-unshared pages are reclaimable capacity
+                    self._prefix.evict(need - self.pool.free_page_count())
                 if need > self.pool.free_page_count():
                     break           # wait for pages (FIFO, no starvation)
                 self._queue.pop(0)
@@ -214,7 +344,19 @@ class ServingEngine:
             if req is None:
                 continue            # idle row wrote the null page; ignore
             self.pool.seq_lens[slot] += 1
+            if req.pending:
+                # still teacher-forcing the prompt suffix (prefix-cache
+                # admission): the model output is a prompt-position logit,
+                # not a generated token — feed the next suffix token
+                self._last_tok[slot] = req.pending.pop(0)
+                continue
             tok = int(toks[slot])
+            if self._prefix is not None and not req.tokens:
+                # first generated token of a shared admission: the whole
+                # prompt's KV is now written — register the suffix's full
+                # pages so repeats of THIS prompt deepen the cache too
+                self._prefix.register(req.prompt,
+                                      self.pool.block_tables[slot])
             req.tokens.append(tok)
             self._last_tok[slot] = tok
             self._finish_if_done(req)

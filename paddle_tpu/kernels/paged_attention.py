@@ -273,12 +273,39 @@ class PagedKVCache:
                                      np.int32)
         self.seq_lens = np.zeros((max_batch,), np.int32)
         self._pages_used = np.zeros((max_batch,), np.int32)
+        # per-page reference counts: a page may be owned by one sequence
+        # (rc=1), shared read-only across sequences with a common prompt
+        # prefix, and/or pinned by a prefix cache — it returns to the
+        # free list only when the last reference drops
+        self._page_rc = np.zeros((num_pages,), np.int32)
         first = 1 if reserve_null_page else 0
+        if reserve_null_page:
+            self._page_rc[0] = np.int32(1 << 30)   # immortal scratch page
         self._free = list(range(num_pages - 1, first - 1, -1))
 
     # ------------------------------------------------------------- admin
     def free_page_count(self) -> int:
         return len(self._free)
+
+    def ref_page(self, page_id: int) -> None:
+        self._page_rc[page_id] += 1
+
+    def unref_page(self, page_id: int) -> None:
+        self._page_rc[page_id] -= 1
+        if self._page_rc[page_id] == 0:
+            self._free.append(int(page_id))
+
+    def adopt_shared(self, seq_idx: int, page_ids) -> None:
+        """Install already-written pages (a cached prompt prefix) at the
+        FRONT of ``seq_idx``'s block table, sharing them read-only (+1 ref
+        each). The sequence's writes land beyond them — sharing is
+        full-page-aligned, so shared pages are immutable by construction.
+        Call before ``allocate``; the caller sets ``seq_lens``."""
+        assert self._pages_used[seq_idx] == 0, "adopt into a fresh slot"
+        for i, pid in enumerate(page_ids):
+            self.block_tables[seq_idx, i] = pid
+            self.ref_page(pid)
+        self._pages_used[seq_idx] = len(page_ids)
 
     def allocate(self, seq_idx: int, n_tokens: int) -> None:
         """Ensure sequence ``seq_idx`` has pages for ``n_tokens`` more
@@ -297,13 +324,15 @@ class PagedKVCache:
                 # pages popped so far are already recorded in _pages_used
                 # below, so an evict-and-retry caller cannot leak them
                 raise RuntimeError("page pool exhausted")
-            self.block_tables[seq_idx, i] = self._free.pop()
+            pid = self._free.pop()
+            self.block_tables[seq_idx, i] = pid
+            self._page_rc[pid] = 1
             self._pages_used[seq_idx] = i + 1
 
     def free_sequence(self, seq_idx: int) -> None:
         n = int(self._pages_used[seq_idx])
         for i in range(n):
-            self._free.append(int(self.block_tables[seq_idx, i]))
+            self.unref_page(int(self.block_tables[seq_idx, i]))
         self.block_tables[seq_idx, :n] = 0
         self._pages_used[seq_idx] = 0
         self.seq_lens[seq_idx] = 0

@@ -178,3 +178,143 @@ class TestCrossFeatureComposition:
         r1, r2 = eng.submit(p1, 5), eng.submit(p2, 5)
         out = eng.run()
         assert out[r1] == ref1 and out[r2] == ref2
+
+
+class TestPrefixCache:
+    """Automatic prefix caching (serving.py PrefixCache): requests with a
+    common page-aligned prompt prefix adopt the cached pages read-only
+    and skip that prefix's prefill. The engine invariant is unchanged:
+    every request's tokens equal its solo greedy decode."""
+
+    def _model(self, seed=86):
+        paddle.seed(seed)
+        return GPTForCausalLM(GPTConfig.tiny())
+
+    def test_shared_prefix_exact_parity(self):
+        model = self._model()
+        rng = np.random.default_rng(7)
+        prefix = rng.integers(0, 256, (16,)).astype(np.int32)  # 2 pages @ 8
+        p1 = np.concatenate([prefix, rng.integers(0, 256, (3,))]).astype(np.int32)
+        p2 = np.concatenate([prefix, rng.integers(0, 256, (5,))]).astype(np.int32)
+        ref1, ref2 = solo(model, p1, 6), solo(model, p2, 6)
+
+        eng = ServingEngine(model, max_batch=2, page_size=8,
+                            max_seq_len=64, prefix_cache=True)
+        r1 = eng.submit(p1, 6)
+        out1 = eng.run()
+        assert out1[r1] == ref1
+        # second request: its 2 prefix pages must come from the cache
+        pages, n_cached = eng._prefix.lookup(p2)
+        assert n_cached == 16 and len(pages) == 2
+        r2 = eng.submit(p2, 6)
+        out2 = eng.run()
+        assert out2[r2] == ref2
+
+    def test_identical_prompt_resubmission(self):
+        """Whole-prompt-cached edge: the last page is excluded so the
+        first generated token still goes through compute."""
+        model = self._model(87)
+        rng = np.random.default_rng(8)
+        p = rng.integers(0, 256, (16,)).astype(np.int32)  # exactly 2 pages
+        ref = solo(model, p, 5)
+        eng = ServingEngine(model, max_batch=2, page_size=8,
+                            max_seq_len=64, prefix_cache=True)
+        r1 = eng.submit(p, 5)
+        assert eng.run()[r1] == ref
+        r2 = eng.submit(p, 5)
+        assert eng.run()[r2] == ref   # served from cache
+
+    def test_pages_are_shared_while_both_live(self):
+        model = self._model(88)
+        rng = np.random.default_rng(9)
+        prefix = rng.integers(0, 256, (8,)).astype(np.int32)
+        p1 = np.concatenate([prefix, [1, 2]]).astype(np.int32)
+        p2 = np.concatenate([prefix, [3]]).astype(np.int32)
+        eng = ServingEngine(model, max_batch=2, page_size=8,
+                            max_seq_len=64, prefix_cache=True)
+        eng.submit(p1, 20)
+        eng.step()                      # r1 admitted + prefilled
+        eng.submit(p2, 20)
+        eng.step()                      # r2 admitted via the cache
+        bt = eng.pool.block_tables
+        assert bt[0, 0] == bt[1, 0]     # same physical page
+        assert eng.pool._page_rc[bt[0, 0]] == 3  # 2 sequences + cache pin
+        eng.run()
+
+    def test_eviction_under_pool_pressure(self):
+        """A tiny pool: cached pages must be reclaimed for new requests,
+        and parity must survive the eviction. 3 usable pages; each
+        request needs 3 and pins its 2 full prompt pages on finish, so
+        every admission after the first MUST evict."""
+        model = self._model(89)
+        rng = np.random.default_rng(10)
+        prompts = [rng.integers(0, 256, (16,)).astype(np.int32)
+                   for _ in range(3)]
+        refs = [solo(model, p, 4) for p in prompts]
+        eng = ServingEngine(model, max_batch=1, page_size=8,
+                            num_pages=4, max_seq_len=24, prefix_cache=True)
+        for p, ref in zip(prompts, refs):
+            rid = eng.submit(p, 4)
+            assert eng.run()[rid] == ref
+        # the evictions really ran: only the last prompt's pins survive
+        assert len(eng._prefix._nodes) <= 2
+
+    def test_trie_distinguishes_same_chunk_under_different_prefixes(self):
+        model = self._model(90)
+        rng = np.random.default_rng(11)
+        a = rng.integers(0, 256, (8,)).astype(np.int32)
+        b = rng.integers(0, 256, (8,)).astype(np.int32)
+        c = rng.integers(0, 256, (8,)).astype(np.int32)
+        pab = np.concatenate([a, b, [1]]).astype(np.int32)
+        pcb = np.concatenate([c, b, [1]]).astype(np.int32)  # same 2nd chunk
+        ref_ab, ref_cb = solo(model, pab, 4), solo(model, pcb, 4)
+        eng = ServingEngine(model, max_batch=2, page_size=8,
+                            max_seq_len=64, prefix_cache=True)
+        rab = eng.submit(pab, 4)
+        assert eng.run()[rab] == ref_ab
+        # c+b must NOT reuse a+b's second page (different parent chain)
+        pages, n_cached = eng._prefix.lookup(pcb)
+        assert n_cached == 0
+        rcb = eng.submit(pcb, 4)
+        assert eng.run()[rcb] == ref_cb
+
+    def test_extending_request_deepens_cache(self):
+        """Review finding: shared admissions must register their suffix
+        pages too — a request EXTENDING a cached prefix contributes its
+        own full pages to the trie instead of leaving them unregistered
+        (a multi-turn conversation grows one reusable chain)."""
+        model = self._model(91)
+        rng = np.random.default_rng(12)
+        p_a = rng.integers(0, 256, (16,)).astype(np.int32)  # 2 full pages
+        p_b = np.concatenate(
+            [p_a, rng.integers(0, 256, (9,))]).astype(np.int32)  # +1 page
+        ref_a, ref_b = solo(model, p_a, 4), solo(model, p_b, 4)
+        eng = ServingEngine(model, max_batch=2, page_size=8,
+                            max_seq_len=64, prefix_cache=True)
+        ra = eng.submit(p_a, 4)
+        assert eng.run()[ra] == ref_a
+        rb = eng.submit(p_b, 4)          # adopts a's 2 pages (suffix 9)
+        assert eng.run()[rb] == ref_b
+        # b's shared admission registered ITS third full page
+        pages, n_cached = eng._prefix.lookup(p_b)
+        assert n_cached == 24
+
+    def test_barely_covered_long_prompt_prefills_instead(self):
+        """Review finding: a 1-page cache hit on a long prompt must NOT
+        force a long teacher-forced replay — the coverage threshold sends
+        it down the normal prefill path (and parity holds either way)."""
+        model = self._model(92)
+        rng = np.random.default_rng(13)
+        prefix = rng.integers(0, 256, (8,)).astype(np.int32)
+        long_p = np.concatenate(
+            [prefix, rng.integers(0, 256, (40,))]).astype(np.int32)
+        ref = solo(model, long_p, 4)
+        eng = ServingEngine(model, max_batch=2, page_size=8,
+                            max_seq_len=64, prefix_cache=True)
+        r0 = eng.submit(prefix.copy(), 4)   # seeds the 1-page cache...
+        eng.run()
+        r1 = eng.submit(long_p, 4)          # ...but 40 >> max(16, 8)
+        eng.step()
+        req = next(s for s in eng._slots if s is not None)
+        assert req.pending == []            # went through full prefill
+        assert eng.run()[r1] == ref
