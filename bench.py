@@ -50,7 +50,11 @@ BENCH_SCHEMA = 3
 # the check list (2 = + paged/block-table decode attention)
 # (3 = + SD-UNet head shapes d=40/80/160 non-causal: the
 # flash_attn_min_seqlen 1024 flip routes them through the kernel)
-KERNELS_SCHEMA = 3
+# (4 = + fused_block_decode, the whole-layer serving kernel — its
+# Mosaic compile status gates nothing yet [jnp fallback serves CPU and
+# the flag is the rollback] but must be PROVEN before trusting the
+# fused TPU numbers)
+KERNELS_SCHEMA = 4
 
 
 def build_train_setup(model_name: Optional[str] = None):
@@ -530,6 +534,55 @@ def _decode_bench(model, cfg, paddle, jax) -> dict:
                     dbatch * (steps - 1) / dtb, 1)
         except Exception as e:  # best-effort extra signal
             out["decode_batched_error"] = repr(e)[:200]
+
+    # Fused block decode A/B: the serving engine's steady-state step with
+    # the fused one-kernel-per-layer program (FLAGS_fused_block_decode,
+    # kernels/fused_block_decode.py) vs the generic op-chain step.
+    # Models without block_decode_spec (GPT family) skip — the dedicated
+    # tools/fused_decode_bench.py carries the full A/B either way.
+    if (os.environ.get("BENCH_DECODE_FUSED", "1") == "1"
+            and hasattr(model, "block_decode_spec")):
+        try:
+            from paddle_tpu import flags as _flags
+            from paddle_tpu.generation.program_cache import \
+                decode_program_cache
+            from paddle_tpu.generation.serving import ServingEngine
+
+            fb, fsteps = min(4, max(dbatch, 1)), 16
+            fprompts = [rng.integers(0, cfg.vocab_size, (prompt_len,))
+                        .astype(np.int32) for _ in range(fb)]
+            fpage = 64 if prompt_len + fsteps > 128 else 8
+            fmsl = prompt_len + fsteps + fpage
+
+            def serving_step_s(fused):
+                _flags.set_flags({"fused_block_decode": fused})
+                eng = ServingEngine(model, max_batch=fb, page_size=fpage,
+                                    max_seq_len=fmsl)
+                for p in fprompts:
+                    eng.submit(p, fsteps)
+                eng.step()          # prefills + first decode (compiles)
+                n = 0
+                t0 = time.perf_counter()
+                while eng.has_work():
+                    eng.step()
+                    n += 1
+                dt = (time.perf_counter() - t0) / max(n, 1)
+                return dt, decode_program_cache().trace_count(
+                    eng.decode_key)
+
+            prior = _flags.get_flag("fused_block_decode")
+            try:
+                tf, fused_traces = serving_step_s(True)
+                tu, _ = serving_step_s(False)
+            finally:
+                _flags.set_flags({"fused_block_decode": prior})
+            out["decode_fused_step_ms"] = round(tf * 1000, 3)
+            out["decode_unfused_step_ms"] = round(tu * 1000, 3)
+            if tf > 0:
+                out["decode_fused_speedup"] = round(tu / tf, 3)
+            out["decode_fused_traces"] = fused_traces
+        except Exception as e:  # best-effort extra signal
+            out["decode_fused_error"] = repr(e)[:200]
 
     # Weight-only int8 serving: decode is weight-bandwidth-bound (the
     # bf16 single-stream number sits AT the HBM roofline), so halving

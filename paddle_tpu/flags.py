@@ -78,6 +78,18 @@ class _Registry:
     def _norm(name: str) -> str:
         return name[6:] if name.startswith("FLAGS_") else name
 
+    def snapshot(self, names=None) -> "FlagSnapshot":
+        """Resolve ``names`` (all flags when None) ONCE: one lock
+        acquisition and one env read per flag, returning an immutable
+        view. Hot paths (kernel dispatch) read the snapshot instead of
+        hitting the registry per call."""
+        with self._lock:
+            if names is None:
+                flags = list(self._flags.values())
+            else:
+                flags = [self._flags[self._norm(n)] for n in names]
+        return FlagSnapshot({f.name: f.current() for f in flags})
+
     def all(self) -> Dict[str, Any]:
         return {n: f.current() for n, f in sorted(self._flags.items())}
 
@@ -88,8 +100,53 @@ class _Registry:
         ]
 
 
+class FlagSnapshot:
+    """Immutable point-in-time flag view with mapping and attribute
+    access. Kernels resolve ONE snapshot per trace (`flags.snapshot`)
+    and thread it through their helpers instead of re-importing the
+    registry and re-parsing the environment on every call — the decode
+    hot path dispatches thousands of kernel calls per second and the
+    per-call registry/env round-trips were measurable host overhead."""
+
+    __slots__ = ("_values",)
+
+    def __init__(self, values: Dict[str, Any]):
+        object.__setattr__(self, "_values", dict(values))
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(f"flag {name!r} not in snapshot "
+                                 f"(have {sorted(self._values)})") from None
+
+    def __getitem__(self, name: str) -> Any:
+        return self._values[name[6:] if name.startswith("FLAGS_") else name]
+
+    def __contains__(self, name: str) -> bool:
+        return (name[6:] if name.startswith("FLAGS_") else name) in self._values
+
+    def __setattr__(self, name, value):
+        raise TypeError("FlagSnapshot is immutable")
+
+    def as_tuple(self) -> tuple:
+        """Hashable (name, value) tuple — the ``flag tuple`` component of
+        decode program cache keys."""
+        return tuple(sorted(self._values.items()))
+
+    def __repr__(self) -> str:
+        return f"FlagSnapshot({self._values!r})"
+
+
 _registry = _Registry()
 define_flag = _registry.define
+
+
+def snapshot(names=None) -> FlagSnapshot:
+    """Resolve a set of flags once into an immutable :class:`FlagSnapshot`.
+    ``names`` may be any iterable of flag names (with or without the
+    ``FLAGS_`` prefix); None snapshots every registered flag."""
+    return _registry.snapshot(names)
 
 
 def set_flags(flags: Dict[str, Any]) -> None:
@@ -164,6 +221,16 @@ define_flag("flash_block_q", 512,
 define_flag("flash_block_k", 512,
             "Flash-attention kv columns per pallas grid step (see "
             "flash_block_q).")
+define_flag("fused_block_decode", True,
+            "Serve steady-state decode through the fused transformer-block "
+            "kernel (kernels/fused_block_decode.py): one program per layer "
+            "computes rms_norm -> QKV -> RoPE -> paged attention -> "
+            "out-proj -> rms_norm -> SwiGLU FFN with the per-slot "
+            "activations VMEM-resident, instead of the op chain that "
+            "round-trips HBM between every op. Applies to models exposing "
+            "block_decode_spec() (the Llama family); others keep the "
+            "generic compiled step. Env-overridable "
+            "(FLAGS_fused_block_decode=0) like the flash block flags.")
 define_flag("allocator_strategy", "auto_growth", "Kept for API parity; PJRT owns memory on TPU.")
 define_flag("fraction_of_gpu_memory_to_use", 0.92, "API parity; PJRT owns memory on TPU.")
 define_flag("log_level", 1, "Framework log verbosity (GLOG_v analogue).")
@@ -171,6 +238,18 @@ define_flag("eager_delete_tensor_gb", 0.0, "API parity; JAX GC owns tensor lifet
 define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest.")
 define_flag("embedding_deterministic", 0, "API parity with reference embedding determinism flag.")
 define_flag("cudnn_deterministic", False, "API parity alias of FLAGS_deterministic.")
+
+# The flags a TRACED program can read (kernel dispatch, block tuning,
+# matmul precision, nan checks, embedding grad mode) — the flag-tuple
+# component of decode program cache keys snapshots exactly this set, so
+# changing an eager-only flag (log_level, benchmark, allocator parity
+# shims) never invalidates a compiled serving program.
+PROGRAM_FLAGS = (
+    "fused_block_decode", "use_pallas", "flash_attn_min_seqlen",
+    "flash_block_q", "flash_block_k", "flash_compact_stats",
+    "tpu_matmul_precision", "embedding_matmul_grad", "deterministic",
+    "check_nan_inf", "check_nan_inf_level",
+)
 
 
 def is_tpu_backend() -> bool:

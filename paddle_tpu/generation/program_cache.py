@@ -1,0 +1,142 @@
+"""Decode program cache: one compiled step per serving configuration.
+
+The serving hot path dispatches the SAME program millions of times; what
+varies between deployments is the model, the batch bucket, the page
+budget, the dtype, and the flag settings. This module keys compiled
+decode steps on exactly that tuple so:
+
+  - a re-created :class:`~paddle_tpu.generation.serving.ServingEngine`
+    over the same model re-uses the already-compiled step (no retrace on
+    re-admission — jax.jit caches per *callable*, so a fresh engine
+    building a fresh closure used to recompile from scratch);
+  - ``fused_multi_transformer`` / ``masked_multihead_attention`` decode
+    calls run one cached compiled program instead of dispatching their
+    op chains eagerly per token;
+  - flag resolution happens ONCE at program-build time (the flag tuple
+    is part of the key), never per decode step.
+
+Keys are structural — a model's signature is its class plus the
+name/shape/dtype tree of its state — so two same-config model instances
+share one program; the weights always travel as traced arguments, never
+as baked-in constants.
+
+Lifetime note: the cache never evicts. The fused decode step is a pure
+function of its param dicts, but the GENERIC and PREFILL builders close
+over the model object (functional_call needs the Layer structure), so a
+cached generic program keeps that model — weights included — alive for
+the process. A serving process that retires a model and loads a
+replacement should call :func:`clear_decode_program_cache` (the
+replacement re-compiles once and re-caches).
+
+Every cached program carries a trace probe: the builder receives a
+``note_trace`` callback to call INSIDE the traced python body, which
+executes only when jax actually (re)traces. ``trace_count(key)`` is the
+retrace regression test surface (the acceptance criterion "zero retraces
+across repeated step() calls" asserts it stays at 1).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+__all__ = ["DecodeKey", "DecodeProgramCache", "decode_program_cache",
+           "clear_decode_program_cache", "model_signature"]
+
+
+class DecodeKey(NamedTuple):
+    """(model signature, batch bucket, page budget, dtype, flag tuple) —
+    plus ``kind`` to separate the program families sharing the cache."""
+    kind: str                 # decode_fused | decode_generic | prefill | ...
+    model_sig: str
+    batch_bucket: int
+    page_budget: Tuple        # (num_pages, page_size, max_pages_per_seq)
+    dtype: str
+    flags: Tuple              # flags.snapshot(...).as_tuple()
+
+
+def model_signature(model) -> str:
+    """Structural identity of a model: class + config + the full
+    name/shape/dtype tree of params and buffers, digested. Captures
+    everything that changes the traced program; weight VALUES are traced
+    arguments and deliberately excluded."""
+    parts = [type(model).__name__, repr(getattr(model, "config", None)),
+             f"training={getattr(model, 'training', False)}"]
+    for name, t in sorted(model.named_parameters()):
+        parts.append(f"{name}:{tuple(t.shape)}:{t.dtype}")
+    for name, t in sorted(model.named_buffers()):
+        if t is not None:
+            parts.append(f"b:{name}:{tuple(t.shape)}:{t.dtype}")
+    return hashlib.md5("|".join(parts).encode()).hexdigest()
+
+
+class DecodeProgramCache:
+    """Thread-safe keyed cache of compiled decode steps with per-key
+    trace counting."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._programs: Dict[DecodeKey, Any] = {}
+        self._trace_counts: Dict[DecodeKey, int] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: DecodeKey,
+            builder: Callable[[Callable[[], None]], Any]):
+        """Return the compiled step for ``key``, building it on first
+        use. ``builder(note_trace)`` must return the (jitted) callable
+        and arrange for ``note_trace()`` to run inside the traced body —
+        it then fires exactly once per (re)trace."""
+        with self._lock:
+            fn = self._programs.get(key)
+            if fn is not None:
+                self.hits += 1
+                return fn
+        fn = builder(self._tracer(key))      # may be slow: build unlocked
+        with self._lock:
+            cur = self._programs.setdefault(key, fn)
+            if cur is fn:
+                self.misses += 1
+            else:
+                self.hits += 1               # lost a benign build race
+            return cur
+
+    def _tracer(self, key: DecodeKey) -> Callable[[], None]:
+        def note_trace():
+            with self._lock:
+                self._trace_counts[key] = self._trace_counts.get(key, 0) + 1
+        return note_trace
+
+    def trace_count(self, key: DecodeKey) -> int:
+        with self._lock:
+            return self._trace_counts.get(key, 0)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "programs": len(self._programs),
+                    "traces": dict(self._trace_counts)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._programs.clear()
+            self._trace_counts.clear()
+            self.hits = self.misses = 0
+
+
+_GLOBAL: Optional[DecodeProgramCache] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def decode_program_cache() -> DecodeProgramCache:
+    """The process-wide decode program cache."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = DecodeProgramCache()
+        return _GLOBAL
+
+
+def clear_decode_program_cache() -> None:
+    decode_program_cache().clear()

@@ -21,6 +21,7 @@ same way via the logits hook.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -45,6 +46,8 @@ class Request:
     # prompt-suffix tokens still to be teacher-forced through the decode
     # step (prefix-cache admission skipped their prefill)
     pending: List[int] = field(default_factory=list)
+    # prefix-cache pages this request adopted (pinned until it finishes)
+    pinned: List[int] = field(default_factory=list)
 
 
 class PrefixCache:
@@ -67,8 +70,10 @@ class PrefixCache:
     def __init__(self, pool: PagedKVCache):
         self.pool = pool
         self.page_size = pool.page_size
-        # key -> {"page": int, "parent": key, "children": int, "tick": int}
+        # key -> {"page": int, "parent": key, "children": int, "tick": int,
+        #         "pins": int}
         self._nodes: Dict[tuple, dict] = {}
+        self._by_page: Dict[int, tuple] = {}    # page id -> node key
         self._tick = 0
 
     def _chunks(self, prompt: np.ndarray):
@@ -101,30 +106,51 @@ class PrefixCache:
                 continue
             parent = key[0] if key[0] in self._nodes else None
             self._nodes[key] = {"page": int(block_row[i]), "parent": parent,
-                                "children": 0, "tick": self._tick}
+                                "children": 0, "tick": self._tick,
+                                "pins": 0}
+            self._by_page[int(block_row[i])] = key
             if parent is not None:
                 self._nodes[parent]["children"] += 1
             self.pool.ref_page(int(block_row[i]))
 
+    def pin(self, pages) -> None:
+        """Mark cached pages as adopted by an in-flight request: a pinned
+        node is untouchable by ``evict`` until ``unpin``, independent of
+        what the pool's reference counts happen to say. Call on
+        adoption; ``unpin`` when the adopting request finishes."""
+        for pid in pages:
+            key = self._by_page.get(int(pid))
+            if key is not None:
+                self._nodes[key]["pins"] += 1
+
+    def unpin(self, pages) -> None:
+        for pid in pages:
+            key = self._by_page.get(int(pid))
+            if key is not None and self._nodes[key]["pins"] > 0:
+                self._nodes[key]["pins"] -= 1
+
     def evict(self, n_pages: int) -> int:
-        """Free up to ``n_pages`` pages by dropping LRU leaf nodes whose
-        page only the cache still references (rc == 1); returns pages
-        freed. Leaves shared by live sequences are left pinned — dropping
-        them would free nothing and only destroy future reuse."""
+        """Free up to ``n_pages`` pages by dropping LRU leaf nodes,
+        REFUSING any node that is pinned by an in-flight request's block
+        table (pin count from adoption) or whose page anyone besides the
+        cache still references (rc > 1). Returns the number of pages
+        actually returned to the free list — callers size retry loops on
+        real capacity, so unrefs that free nothing don't count."""
         freed = 0
         while freed < n_pages:
             leaves = [(node["tick"], key) for key, node in
                       self._nodes.items()
-                      if node["children"] == 0
+                      if node["children"] == 0 and node["pins"] == 0
                       and self.pool._page_rc[node["page"]] == 1]
             if not leaves:
                 break
             _, key = min(leaves)
             node = self._nodes.pop(key)
+            self._by_page.pop(node["page"], None)
             if node["parent"] is not None:
                 self._nodes[node["parent"]]["children"] -= 1
-            self.pool.unref_page(node["page"])
-            freed += 1
+            if self.pool.unref_page(node["page"]):
+                freed += 1
         return freed
 
 
@@ -165,9 +191,22 @@ class ServingEngine:
         self._results: Dict[int, List[int]] = {}
         self._last_tok = np.zeros((max_batch,), np.int32)
         self._next_rid = 0
-        self._prefill_jit = None
-        self._decode_jit = None
+        self._prefill_fn = None
+        self._decode_fn = None
+        self.decode_key = None      # set on first decode (test probe)
         self._prefix = PrefixCache(self.pool) if prefix_cache else None
+        # flag resolution happens ONCE per engine; the PROGRAM_FLAGS
+        # snapshot (every flag a traced program can read — kernel
+        # dispatch, flash blocks, compact stats, matmul precision) is
+        # part of the program-cache key, so engines built under
+        # different flag settings compile and cache distinct steps
+        # instead of silently serving a program compiled under stale
+        # flags, while eager-only flags (log_level, benchmark) never
+        # force a spurious recompile
+        from .. import flags as _flags
+        from .program_cache import model_signature
+        self._flags = _flags.snapshot(_flags.PROGRAM_FLAGS)
+        self._model_sig = model_signature(model)
 
     # ------------------------------------------------------------ frontend
     def submit(self, prompt, max_new_tokens: int = 32,
@@ -202,6 +241,64 @@ class ServingEngine:
         out, self._results = self._results, {}
         return out
 
+    # ------------------------------------------------- compiled programs
+    def _key(self, kind: str):
+        from .program_cache import DecodeKey
+        return DecodeKey(
+            kind=kind, model_sig=self._model_sig,
+            batch_bucket=self.max_batch,
+            page_budget=(self.pool.num_pages, self.pool.page_size,
+                         self.pool.max_pages_per_seq),
+            dtype=str(self.pool.k_pages[0].dtype),
+            flags=self._flags.as_tuple())
+
+    def _fused_spec(self):
+        """The model's fused-block layout when the fused path applies:
+        FLAGS_fused_block_decode on, the model publishes
+        ``block_decode_spec()``, and every named weight is live in the
+        param/buffer dicts (a weight-quantized model restructures its
+        Linears into int8 buffers and falls back to the generic step)."""
+        if not self._flags.fused_block_decode:
+            return None
+        get_spec = getattr(self.model, "block_decode_spec", None)
+        if get_spec is None:
+            return None
+        spec = get_spec()
+        if spec is None:
+            return None
+        allp = {**self._buffers, **self._params}
+        names = [spec["embed"], spec["final_norm"]]
+        if spec["lm_head"]:
+            names.append(spec["lm_head"])
+        for lw in spec["layers"]:
+            names.extend(lw.values())
+        if not all(allp.get(n) is not None for n in names):
+            return None
+        return spec
+
+    def _prefill_program(self):
+        if self._prefill_fn is None:
+            from .program_cache import decode_program_cache
+            self._prefill_fn = decode_program_cache().get(
+                self._key("prefill"),
+                functools.partial(_build_prefill, model=self.model))
+        return self._prefill_fn
+
+    def _decode_program(self):
+        if self._decode_fn is None:
+            from .program_cache import decode_program_cache
+            spec = self._fused_spec()
+            key = self._key("decode_fused" if spec else "decode_generic")
+            if spec:
+                builder = functools.partial(_build_fused_decode, spec=spec,
+                                            snap=self._flags)
+            else:
+                builder = functools.partial(_build_generic_decode,
+                                            model=self.model)
+            self._decode_fn = decode_program_cache().get(key, builder)
+            self.decode_key = key
+        return self._decode_fn
+
     # ----------------------------------------------------------- internals
     def _pools(self):
         return [(self.pool.k_pages[i], self.pool.v_pages[i])
@@ -222,6 +319,11 @@ class ServingEngine:
         logit and is discarded; the step that feeds the LAST suffix token
         emits the first generated token."""
         self.pool.adopt_shared(slot, pages)
+        if self._prefix is not None:
+            # pin count on adoption: evict() must never free pages an
+            # in-flight request's block table still points at
+            self._prefix.pin(pages)
+            req.pinned = [int(p) for p in pages]
         self.pool.seq_lens[slot] = n_cached
         suffix = req.prompt[n_cached:]
         self.pool.allocate(slot, len(suffix) + req.max_new_tokens)
@@ -231,8 +333,6 @@ class ServingEngine:
         self._slots[slot] = req
 
     def _prefill(self, req: Request, slot: int) -> None:
-        from ..jit import functional_call
-
         if self._prefix is not None:
             pages, n_cached = self._prefix.lookup(req.prompt)
             # never cover the WHOLE prompt: the first generated token's
@@ -253,21 +353,11 @@ class ServingEngine:
                 return
 
         p = len(req.prompt)
-        fn = self._prefill_jit
-        if fn is None:
-            def run(params, buffers, ids, pools, bt, sl):
-                states = [PagedDecodeState(k, v, bt, sl) for k, v in pools]
-                logits, states = functional_call(
-                    self.model, params, ids, states, jnp.int32(0),
-                    buffers=buffers, method="forward_with_cache")
-                return (jnp.argmax(logits[0, -1].astype(jnp.float32)),
-                        states)
-            # jit itself caches one compilation per prompt length
-            # (bucket/pad prompts in production to bound that set).
-            # Donate ONLY the pools (each buffer appears once there; bt/sl
-            # are shared by every layer's state and must not be donated):
-            # page writes then alias the pool in place
-            fn = self._prefill_jit = jax.jit(run, donate_argnums=(3,))
+        # the cached prefill program: jit itself caches one compilation
+        # per prompt length (bucket/pad prompts in production to bound
+        # that set); the program-cache layer shares those compilations
+        # across engine instances over the same model
+        fn = self._prefill_program()
 
         self.pool.allocate(slot, p + req.max_new_tokens)
         bt = jnp.asarray(self.pool.block_tables[slot:slot + 1])
@@ -294,13 +384,14 @@ class ServingEngine:
             and req.tokens and req.tokens[-1] == req.eos_token_id)
         if done and req.slot is not None:
             self.pool.free_sequence(req.slot)
+            if req.pinned and self._prefix is not None:
+                self._prefix.unpin(req.pinned)
+                req.pinned = []
             self._slots[req.slot] = None
             self._results[req.rid] = req.tokens
             req.slot = None
 
     def step(self) -> None:
-        from ..jit import functional_call
-
         # admission: fill every free slot that has pages available
         for slot in range(self.max_batch):
             if self._slots[slot] is None and self._queue:
@@ -319,22 +410,10 @@ class ServingEngine:
         if not active:
             return
 
-        if self._decode_jit is None:
-            def run(params, buffers, toks, pools, bt, sl):
-                states = [PagedDecodeState(k, v, bt, sl) for k, v in pools]
-                # offset=None -> per-slot positions from states.seq_lens
-                logits, states = functional_call(
-                    self.model, params, toks, states, None,
-                    buffers=buffers, method="forward_with_cache")
-                return (jnp.argmax(logits[:, -1].astype(jnp.float32),
-                                   axis=-1), states)
-            # donate only the pools (see _prefill): per-token page writes
-            # alias in place instead of copying every pool every token
-            self._decode_jit = jax.jit(run, donate_argnums=(3,))
-
+        fn = self._decode_program()
         bt = jnp.asarray(self.pool.block_tables[:self.max_batch])
         sl = jnp.asarray(self.pool.seq_lens[:self.max_batch])
-        toks, states = self._decode_jit(
+        toks, states = fn(
             self._params, self._buffers,
             jnp.asarray(self._last_tok[:, None]), self._pools(), bt, sl)
         self._store(states)
@@ -364,3 +443,76 @@ class ServingEngine:
 
 def _val(x):
     return x._value if hasattr(x, "_value") else x
+
+
+# ------------------------------------------------------ program builders
+# Module-level (not engine methods) so the decode program cache can hand
+# one compiled step to every engine over the same model. All three donate
+# ONLY the pools (each buffer appears once there; bt/sl are shared by
+# every layer's state and must not be donated): page writes then alias
+# the pool memory in place instead of copying every pool every token.
+
+def _build_prefill(note_trace, model):
+    from ..jit import functional_call
+
+    def run(params, buffers, ids, pools, bt, sl):
+        note_trace()
+        states = [PagedDecodeState(k, v, bt, sl) for k, v in pools]
+        logits, states = functional_call(
+            model, params, ids, states, jnp.int32(0),
+            buffers=buffers, method="forward_with_cache")
+        return (jnp.argmax(logits[0, -1].astype(jnp.float32)), states)
+
+    return jax.jit(run, donate_argnums=(3,))
+
+
+def _build_generic_decode(note_trace, model):
+    """The unfused decode step: one functional_call through the model's
+    forward_with_cache (every layer an op chain XLA schedules)."""
+    from ..jit import functional_call
+
+    def run(params, buffers, toks, pools, bt, sl):
+        note_trace()
+        states = [PagedDecodeState(k, v, bt, sl) for k, v in pools]
+        # offset=None -> per-slot positions from states.seq_lens
+        logits, states = functional_call(
+            model, params, toks, states, None,
+            buffers=buffers, method="forward_with_cache")
+        return (jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1),
+                states)
+
+    return jax.jit(run, donate_argnums=(3,))
+
+
+def _build_fused_decode(note_trace, spec, snap):
+    """The fused decode step: embedding lookup, then ONE fused block
+    kernel per layer (kernels/fused_block_decode.py — activations stay
+    VMEM-resident across the block), final norm + lm head. Pure function
+    of the param/buffer dicts — no model closure, so any same-config
+    model shares the compiled program."""
+    from ..kernels.fused_block_decode import (BlockDecodeWeights, _rms,
+                                              fused_block_decode)
+
+    nh, nkv = spec["num_heads"], spec["num_kv_heads"]
+    theta, eps = spec["rope_theta"], spec["epsilon"]
+
+    def run(params, buffers, toks, pools, bt, sl):
+        note_trace()
+        allp = {**buffers, **params}
+        x = jnp.take(allp[spec["embed"]], toks[:, 0], axis=0)   # (B, H)
+        states = []
+        for i, lw in enumerate(spec["layers"]):
+            w = BlockDecodeWeights(**{f: allp[n] for f, n in lw.items()})
+            kp, vp = pools[i]
+            x, kp, vp = fused_block_decode(
+                x, w, kp, vp, bt, sl, num_heads=nh, num_kv_heads=nkv,
+                rope_theta=theta, epsilon=eps, snap=snap)
+            states.append(PagedDecodeState(kp, vp, bt, sl))
+        x = _rms(x, allp[spec["final_norm"]], eps)
+        if spec["lm_head"]:
+            logits = x @ allp[spec["lm_head"]]
+        else:                                   # tied embeddings
+            logits = x @ allp[spec["embed"]].T
+        return jnp.argmax(logits.astype(jnp.float32), axis=-1), states
+
+    return jax.jit(run, donate_argnums=(3,))

@@ -134,10 +134,11 @@ class FusedMultiTransformer(_Layer):
                              for _ in range(num_layers)]
         self.ffn2_biases = [ones(d) for _ in range(num_layers)]
 
-    def forward(self, src, attn_mask=None, caches=None, **kw):
+    def forward(self, src, attn_mask=None, caches=None, time_step=None,
+                **kw):
         return _IF.fused_multi_transformer(
             src, self.ln_scales, self.ln_biases, self.qkv_weights,
             self.qkv_biases, self.out_weights, self.out_biases,
             self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
             self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
-            attn_mask=attn_mask, caches=caches)
+            attn_mask=attn_mask, cache_kvs=caches, time_step=time_step)

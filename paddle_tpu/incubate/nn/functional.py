@@ -274,16 +274,107 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
     (2, B, num_head, max_seq, head_dim). ``time_step`` (int scalar, decode
     phase only) is the number of tokens already cached; when ``cache_kvs``
     is given the call returns ``(out, cache_kvs)``.
-    """
-    from ...kernels.decode_attention import cached_attention, update_kv_cache
 
-    L = len(qkv_weights)
+    Serving fast path: the DECODE phase (s == 1 with caches) dispatches
+    through the decode program cache (generation/program_cache.py) as ONE
+    cached compiled step with the caches donated — reference in-place
+    cache semantics, no per-token retrace and no per-call eager op
+    dispatch. ``FLAGS_fused_block_decode=0`` restores the eager chain.
+    """
     use_cache = cache_kvs is not None
     xv = _val(x)
     b, s, h = xv.shape
 
-    def layer_step(hid, i):
-        qkvw = _val(qkv_weights[i])
+    w = dict(
+        ln_scales=[_val(t) for t in ln_scales],
+        ln_biases=[_val(t) for t in ln_biases] if ln_biases else [],
+        qkv_weights=[_val(t) for t in qkv_weights],
+        qkv_biases=[_val(t) for t in qkv_biases] if qkv_biases else [],
+        linear_weights=[_val(t) for t in linear_weights],
+        linear_biases=[_val(t) for t in linear_biases]
+        if linear_biases else [],
+        ffn_ln_scales=[_val(t) for t in ffn_ln_scales],
+        ffn_ln_biases=[_val(t) for t in ffn_ln_biases]
+        if ffn_ln_biases else [],
+        ffn1_weights=[_val(t) for t in ffn1_weights],
+        ffn1_biases=[_val(t) for t in ffn1_biases] if ffn1_biases else [],
+        ffn2_weights=[_val(t) for t in ffn2_weights],
+        ffn2_biases=[_val(t) for t in ffn2_biases] if ffn2_biases else [],
+    )
+    caches = [_val(c) for c in cache_kvs] if use_cache else []
+    mask = _val(attn_mask) if attn_mask is not None else None
+    rot = (_val(rotary_embs)
+           if rotary_embs is not None and rotary_emb_dims > 0 else None)
+    ts = (jnp.asarray(_val(time_step), jnp.int32).reshape(())
+          if time_step is not None else jnp.int32(0))
+    static = dict(pre_layer_norm=pre_layer_norm, epsilon=epsilon,
+                  activation=activation, trans_qkvw=trans_qkvw,
+                  use_cache=use_cache)
+
+    snap = flags.snapshot(flags.PROGRAM_FLAGS)
+    if use_cache and s == 1 and snap.fused_block_decode:
+        from ...generation.program_cache import (DecodeKey,
+                                                 decode_program_cache)
+        # O(1)-per-call key: layer count + exemplar shapes + bias/extra
+        # presence. Per-layer shape heterogeneity the key misses is
+        # guarded by jit's own shape keying inside the cached program —
+        # hashing every weight leaf per TOKEN is exactly the per-call
+        # host overhead this fast path exists to remove.
+        sig = (f"L{len(w['qkv_weights'])}:{xv.shape}:{xv.dtype}:"
+               f"{caches[0].shape}:{caches[0].dtype}:"
+               f"{w['qkv_weights'][0].shape}:{w['ffn1_weights'][0].shape}:"
+               f"{[bool(w[k]) for k in sorted(w)]}:"
+               f"{mask.shape if mask is not None else None}:"
+               f"{rot.shape if rot is not None else None}:"
+               f"{sorted(static.items())}")
+        key = DecodeKey(kind="fmt_decode", model_sig=sig, batch_bucket=b,
+                        page_budget=(caches[0].shape[3],),
+                        dtype=str(xv.dtype), flags=snap.as_tuple())
+
+        def builder(note_trace):
+            def run(xv, w, caches, ts, mask, rot):
+                note_trace()
+                return _fmt_forward(xv, w, caches, ts, mask, rot, **static)
+            # donate the caches: the decode step then updates them in
+            # place (the reference CUDA op's semantics) instead of
+            # copying every layer's (2, B, H, T, D) buffer per token
+            return jax.jit(run, donate_argnums=(2,))
+
+        fn = decode_program_cache().get(key, builder)
+        hid, cache_out = fn(xv, w, caches, ts, mask, rot)
+    else:
+        hid, cache_out = _fmt_forward(xv, w, caches, ts, mask, rot,
+                                      **static)
+    out = Tensor(hid.astype(xv.dtype), stop_gradient=True)
+    if use_cache:
+        return out, [Tensor(c, stop_gradient=True) for c in cache_out]
+    return out
+
+
+def _arg_sig(trees, static) -> str:
+    """Structural signature of a pytree of arrays + a static config dict
+    (shape/dtype only — values are traced) for decode program keys."""
+    import hashlib
+    parts = [repr(sorted(static.items()))]
+    for leaf in jax.tree_util.tree_leaves(trees):
+        parts.append(f"{getattr(leaf, 'shape', ())}:"
+                     f"{getattr(leaf, 'dtype', type(leaf).__name__)}")
+    return hashlib.md5("|".join(parts).encode()).hexdigest()
+
+
+def _fmt_forward(xv, w, caches, time_step, attn_mask, rotary_embs, *,
+                 pre_layer_norm, epsilon, activation, trans_qkvw,
+                 use_cache):
+    """fused_multi_transformer's whole-stack forward as a pure function
+    of raw arrays — traced once by the decode program cache on the
+    serving path, executed eagerly for prefill / no-cache calls."""
+    from ...kernels.decode_attention import cached_attention, update_kv_cache
+
+    b, s, h = xv.shape
+    cache_out = []
+    hid = xv
+    for i in range(len(w["qkv_weights"])):
+        qkvw = w["qkv_weights"][i]
         if trans_qkvw:          # (3, H, D, E) -> project E -> (3, H, D)
             three, nh, hd, _ = qkvw.shape
         else:
@@ -292,70 +383,59 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
         residual = hid
         ln_in = hid
         if pre_layer_norm:
-            ln_in = _ln(hid, _val(ln_scales[i]),
-                        _val(ln_biases[i]) if ln_biases else None, epsilon)
+            ln_in = _ln(hid, w["ln_scales"][i],
+                        w["ln_biases"][i] if w["ln_biases"] else None,
+                        epsilon)
         qkv = jnp.einsum("bse,nhde->bsnhd", ln_in, qkvw)
-        if qkv_biases:
-            qkv = qkv + _val(qkv_biases[i])[None, None]
+        if w["qkv_biases"]:
+            qkv = qkv + w["qkv_biases"][i][None, None]
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]   # (B,S,H,D)
-        if rotary_embs is not None and rotary_emb_dims > 0:
-            rot = _val(rotary_embs)                           # (2, B, 1, S, D)
-            cos_r, sin_r = rot[0], rot[1]
+        if rotary_embs is not None:
+            cos_r, sin_r = rotary_embs[0], rotary_embs[1]    # (B, 1, S, D)
             q = _apply_rot(q, cos_r, sin_r)
             k = _apply_rot(k, cos_r, sin_r)
         if use_cache:
-            ck = _val(cache_kvs[i])                           # (2,B,H,T,D)
+            ck = caches[i]                                    # (2,B,H,T,D)
             k_cache = jnp.transpose(ck[0], (0, 2, 1, 3))      # (B,T,H,D)
             v_cache = jnp.transpose(ck[1], (0, 2, 1, 3))
-            off = (jnp.asarray(_val(time_step), jnp.int32).reshape(())
-                   if time_step is not None else jnp.int32(0))
+            off = time_step
             k_cache, v_cache = update_kv_cache(k_cache, v_cache, k, v, off)
             attn = cached_attention(q, k_cache, v_cache, off + s)
             new_ck = jnp.stack([jnp.transpose(k_cache, (0, 2, 1, 3)),
                                 jnp.transpose(v_cache, (0, 2, 1, 3))])
             cache_out.append(new_ck)
         else:
-            attn = _causal_sdpa(q, k, v, _val(attn_mask)
-                                if attn_mask is not None else None)
+            attn = _causal_sdpa(q, k, v, attn_mask)
         attn = attn.reshape(b, s, nh * hd)
-        lw = _val(linear_weights[i])
-        out = attn @ lw
-        if linear_biases:
-            out = out + _val(linear_biases[i])
+        out = attn @ w["linear_weights"][i]
+        if w["linear_biases"]:
+            out = out + w["linear_biases"][i]
         hid = residual + out
         if not pre_layer_norm:
-            hid = _ln(hid, _val(ln_scales[i]),
-                      _val(ln_biases[i]) if ln_biases else None, epsilon)
+            hid = _ln(hid, w["ln_scales"][i],
+                      w["ln_biases"][i] if w["ln_biases"] else None,
+                      epsilon)
 
         residual = hid
         ffn_in = hid
         if pre_layer_norm:
-            ffn_in = _ln(hid, _val(ffn_ln_scales[i]),
-                         _val(ffn_ln_biases[i]) if ffn_ln_biases else None,
-                         epsilon)
-        f1 = ffn_in @ _val(ffn1_weights[i])
-        if ffn1_biases:
-            f1 = f1 + _val(ffn1_biases[i])
+            ffn_in = _ln(hid, w["ffn_ln_scales"][i],
+                         w["ffn_ln_biases"][i] if w["ffn_ln_biases"]
+                         else None, epsilon)
+        f1 = ffn_in @ w["ffn1_weights"][i]
+        if w["ffn1_biases"]:
+            f1 = f1 + w["ffn1_biases"][i]
         f1 = jax.nn.gelu(f1, approximate=True) if activation == "gelu" \
             else jax.nn.relu(f1)
-        f2 = f1 @ _val(ffn2_weights[i])
-        if ffn2_biases:
-            f2 = f2 + _val(ffn2_biases[i])
+        f2 = f1 @ w["ffn2_weights"][i]
+        if w["ffn2_biases"]:
+            f2 = f2 + w["ffn2_biases"][i]
         hid = residual + f2
         if not pre_layer_norm:
-            hid = _ln(hid, _val(ffn_ln_scales[i]),
-                      _val(ffn_ln_biases[i]) if ffn_ln_biases else None,
-                      epsilon)
-        return hid
-
-    cache_out = []
-    hid = xv
-    for i in range(L):
-        hid = layer_step(hid, i)
-    out = Tensor(hid.astype(xv.dtype), stop_gradient=True)
-    if use_cache:
-        return out, [Tensor(c, stop_gradient=True) for c in cache_out]
-    return out
+            hid = _ln(hid, w["ffn_ln_scales"][i],
+                      w["ffn_ln_biases"][i] if w["ffn_ln_biases"]
+                      else None, epsilon)
+    return hid, cache_out
 
 
 def _ln(x, scale, bias, eps):
@@ -478,22 +558,88 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
                                **kwargs):
     """reference: incubate.nn.functional.masked_multihead_attention — the
     one-token decode attention against a running cache. Maps onto the
-    decode path of kernels/decode_attention (static cache, GQA-ready)."""
+    decode path of kernels/decode_attention (static cache, GQA-ready).
+    Dispatches through the decode program cache: repeated decode calls at
+    a fixed shape run ONE cached compiled program with the cache donated
+    (in-place update), instead of re-dispatching the op chain eagerly
+    per token (``FLAGS_fused_block_decode=0`` restores eager)."""
     from ...core.tensor import Tensor, _val
-    from ...kernels.decode_attention import cached_attention, update_kv_cache
     xv = _val(x)
-    b, three_hd = xv.shape[0], xv.shape[-1]
+    b = xv.shape[0]
     if cache_kv is None:
         raise ValueError("masked_multihead_attention needs cache_kv")
     ck = _val(cache_kv)                    # (2, B, T, H, D)
-    h, t, d = ck.shape[3], ck.shape[2], ck.shape[4]
+    t = ck.shape[2]
+    cur = _val(sequence_lengths) if sequence_lengths is not None else t - 1
+    cur = jnp.asarray(cur, jnp.int32)
+
+    snap = flags.snapshot(flags.PROGRAM_FLAGS)
+    if snap.fused_block_decode:
+        from ...generation.program_cache import (DecodeKey,
+                                                 decode_program_cache)
+        key = DecodeKey(kind="mmha", model_sig=_arg_sig((xv, ck, cur), {}),
+                        batch_bucket=b, page_budget=(t,),
+                        dtype=str(ck.dtype), flags=snap.as_tuple())
+
+        def builder(note_trace):
+            def run(xv, ck, cur):
+                note_trace()
+                return _mmha_forward(xv, ck, cur)
+            return jax.jit(run, donate_argnums=(1,))
+
+        out, new_cache = decode_program_cache().get(key, builder)(
+            xv, ck, cur)
+    else:
+        out, new_cache = _mmha_forward(xv, ck, cur)
+    return (Tensor(out), Tensor(new_cache))
+
+
+def _mmha_forward(xv, ck, cur):
+    from ...kernels.decode_attention import cached_attention, update_kv_cache
+    b = xv.shape[0]
+    h, d = ck.shape[3], ck.shape[4]
     qkv = xv.reshape(b, 1, 3, h, d)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    cur = _val(sequence_lengths) if sequence_lengths is not None else t - 1
     kc, vc = update_kv_cache(ck[0], ck[1], k, v, cur)
-    out = cached_attention(q, kc, vc, jnp.asarray(cur) + 1)
-    new_cache = jnp.stack([kc, vc])
-    return (Tensor(out.reshape(b, h * d)), Tensor(new_cache))
+    out = cached_attention(q, kc, vc, cur + 1)
+    return out.reshape(b, h * d), jnp.stack([kc, vc])
+
+
+def fused_block_decode(x, ln1_weight, q_proj_weight, k_proj_weight,
+                       v_proj_weight, out_proj_weight, ln2_weight,
+                       gate_proj_weight, up_proj_weight, down_proj_weight,
+                       key_cache, value_cache, block_tables, seq_lens,
+                       num_heads: int, num_kv_heads: Optional[int] = None,
+                       rope_theta: float = 10000.0, epsilon: float = 1e-6):
+    """ONE fused transformer-block decode step over the paged KV cache —
+    the TPU-native fusion of the chain the reference splits across
+    fused_rms_norm + qkv matmuls + fused_rotary_position_embedding +
+    block_multihead_attention + out-proj + swiglu:
+
+        x  <- x + attn(rms_norm(x))        (RoPE + paged append/read
+        x  <- x + ffn(rms_norm(x))          folded into the same kernel)
+
+    ``x``: (B, hidden) — one token per slot. Linear weights use the
+    (in, out) layout; caches/tables as in block_multihead_attention.
+    Dispatches to the Pallas kernel on TPU (FLAGS_use_pallas) and to the
+    jnp composition elsewhere; gated engine-side by
+    ``FLAGS_fused_block_decode``. Returns (out, key_cache, value_cache).
+    """
+    from ...core.tensor import Tensor, _val
+    from ...kernels.fused_block_decode import (BlockDecodeWeights,
+                                               fused_block_decode as _fbd)
+    w = BlockDecodeWeights(
+        ln1=_val(ln1_weight), wq=_val(q_proj_weight), wk=_val(k_proj_weight),
+        wv=_val(v_proj_weight), wo=_val(out_proj_weight),
+        ln2=_val(ln2_weight), wg=_val(gate_proj_weight),
+        wu=_val(up_proj_weight), wd=_val(down_proj_weight))
+    out, kp, vp = _fbd(
+        _val(x), w, _val(key_cache), _val(value_cache), _val(block_tables),
+        _val(seq_lens), num_heads=num_heads,
+        num_kv_heads=num_kv_heads or num_heads, rope_theta=rope_theta,
+        epsilon=epsilon)
+    return (Tensor(out, stop_gradient=True),
+            Tensor(kp, stop_gradient=True), Tensor(vp, stop_gradient=True))
 
 
 def variable_length_memory_efficient_attention(

@@ -43,16 +43,32 @@ try:  # pltpu registers TPU lowerings — unavailable on CPU-only test envs
 except Exception:  # pragma: no cover - CPU CI path (interpret mode)
     pltpu = None
 
-def _blocks(block_q, block_k):
+# the flag set the flash entry points resolve ONCE per call via
+# flags.snapshot (one lock acquisition + env parse), then thread through
+# _blocks/_compact — the decode/serving hot path calls these thousands of
+# times a second and per-helper registry round-trips were host overhead
+_FLASH_FLAGS = ("use_pallas", "flash_block_q", "flash_block_k",
+                "flash_compact_stats")
+
+
+def _flash_snapshot():
+    from ..flags import snapshot
+    return snapshot(_FLASH_FLAGS)
+
+
+def _blocks(block_q, block_k, snap=None):
     """None -> the FLAGS_flash_block_{q,k} tuning (env-overridable, so a
     banked on-chip sweep from tools/attn_bench.py applies without a code
     change). The flag registry is the single source of the default
-    (512x512 since the r05 on-chip sweep)."""
-    from ..flags import get_flag
-    if block_q is None:
-        block_q = int(get_flag("flash_block_q"))
-    if block_k is None:
-        block_k = int(get_flag("flash_block_k"))
+    (512x512 since the r05 on-chip sweep); ``snap`` is the caller's
+    one-per-trace flags.snapshot so this never re-resolves per kernel."""
+    if block_q is None or block_k is None:
+        if snap is None:
+            snap = _flash_snapshot()
+        if block_q is None:
+            block_q = int(snap.flash_block_q)
+        if block_k is None:
+            block_k = int(snap.flash_block_k)
     return block_q, block_k
 
 
@@ -106,14 +122,15 @@ def _sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _compact() -> bool:
+def _compact(snap=None) -> bool:
     """FLAGS_flash_compact_stats: keep softmax stats compact (BH, S) at
     the kernel boundary — no 128x lane-replicated HBM transients. Numerics
     are identical (parity-tested); only Mosaic layouts differ, so the
     default stays off until tools/chip_sprint.py validates on-chip
     compilation."""
-    from ..flags import get_flag
-    return bool(get_flag("flash_compact_stats"))
+    if snap is None:
+        snap = _flash_snapshot()
+    return bool(snap.flash_compact_stats)
 
 
 def _dims(ref_shape):
@@ -654,7 +671,8 @@ def flash_attention_with_lse(q, k, v, causal: bool = True,
     ``flash_attention``."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    block_q, block_k = _blocks(block_q, block_k)
+    snap = _flash_snapshot()
+    block_q, block_k = _blocks(block_q, block_k, snap)
     if n_kv_heads is None:
         n_kv_heads = n_heads
     if n_heads % n_kv_heads:
@@ -667,7 +685,7 @@ def flash_attention_with_lse(q, k, v, causal: bool = True,
             f"counts for GQA inputs")
     return _flash_attention_lse(q, k, v, None, None, causal, sm_scale,
                                 block_q, block_k, n_heads, n_kv_heads,
-                                _compact())
+                                _compact(snap))
 
 
 def flash_attention(q, k, v, segment_ids: Optional[jax.Array] = None,
@@ -683,7 +701,8 @@ def flash_attention(q, k, v, segment_ids: Optional[jax.Array] = None,
     accumulate dk/dv over each group's query heads."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    block_q, block_k = _blocks(block_q, block_k)
+    snap = _flash_snapshot()
+    block_q, block_k = _blocks(block_q, block_k, snap)
     if n_kv_heads is None:
         n_kv_heads = n_heads
     if n_heads % n_kv_heads:
@@ -704,7 +723,7 @@ def flash_attention(q, k, v, segment_ids: Optional[jax.Array] = None,
         kv_segment_ids = segment_ids
     return _flash_attention(q, k, v, segment_ids, kv_segment_ids,
                             causal, sm_scale, block_q, block_k,
-                            n_heads, n_kv_heads, _compact())
+                            n_heads, n_kv_heads, _compact(snap))
 
 
 def flash_attention_bshd(q, k, v, segment_ids=None, kv_segment_ids=None,

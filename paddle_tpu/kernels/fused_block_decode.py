@@ -1,0 +1,559 @@
+"""Fused transformer-block decode: ONE kernel per layer for the serving
+hot path.
+
+Reference parity target: the decode phase of the reference's whole-stack
+fused op (paddle/fluid/operators/fused/fused_multi_transformer_op.cu) and
+its block-attention successor (block_multihead_attention), generalized the
+way ClusterFusion-style decode fusion papers argue for: fuse the FULL
+block step — ``residual + attn(rms_norm(x))`` then
+``residual + ffn(rms_norm(x))`` — not just the attention core.
+
+Why: steady-state decode moves one token per sequence through L layers.
+Every op boundary in the unfused chain (rms_norm -> q/k/v matmuls -> RoPE
+-> paged attention -> out-proj -> rms_norm -> SwiGLU FFN) parks the
+(B, hidden) activation back in HBM and re-loads it, and each op pays its
+own dispatch. The activations are tiny (a few hundred KB); the weights
+are the real traffic. The right TPU program therefore streams each
+weight matrix through VMEM exactly once per step while the activations
+NEVER leave VMEM.
+
+TPU-native design — one ``pallas_call`` with a flat 1-D grid of
+sequential phases (TPU grid steps run in order on a core, so VMEM
+scratch persists across phases):
+
+  Q | K | V   tiled matmuls of the rms-normed activation against the
+              projection weights (contraction x output tiling, f32
+              accumulation in a revisited scratch accumulator);
+  R           in-VMEM RoPE of q/k at each slot's own position
+              (``seq_lens`` rides scalar prefetch) + emit of the new
+              token's k/v for the pool append;
+  A           paged attention: the block-table index map streams one
+              pool page per step straight from HBM (scalar-prefetched
+              block tables, exactly like kernels/paged_attention.py);
+              the just-computed k/v token is folded from VMEM into the
+              online softmax at each row's last valid page — attention
+              covers position ``seq_lens`` WITHOUT the pool write having
+              happened yet;
+  O           out-projection tiles + first residual add into VMEM;
+  F           SwiGLU: gate and up tiles in one pass (two accumulators),
+              silu(g) * u into a VMEM scratch;
+  D           down-projection tiles + second residual add, emitted as
+              the kernel output.
+
+The ONLY HBM round-trip the step still makes for activations is the
+(B, Hkv, D) new-token k/v append, which is scattered into the pool by
+``write_paged_kv`` inside the same compiled program (a few KB; folding
+the scatter into the kernel would stream every visited page back out
+for one written column).
+
+A pure-jnp reference (``fused_block_decode_ref``) is bit-compatible with
+the UNFUSED op chain the models execute (same primitive composition and
+dtypes) — it is the CPU-CI path and the parity oracle for the kernel.
+Mosaic-layout caveat: the kernel's in-VMEM (1, rep*d) <-> (rep, d)
+head-group reshapes follow the flash compact-stats precedent — interpret
+mode proves numerics every round; on-chip compile validation banks
+through tools/chip_sprint.py like every kernel before it.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .paged_attention import paged_attention_xla, write_paged_kv
+
+_NEG_INF = -1e30
+_LANES = 128
+
+__all__ = ["BlockDecodeWeights", "fused_block_decode",
+           "fused_block_decode_pallas", "fused_block_decode_ref"]
+
+
+class BlockDecodeWeights(NamedTuple):
+    """One decoder layer's weights in the (in, out) Linear layout the
+    models use. A NamedTuple (= pytree) so a whole layer threads through
+    jit as one argument."""
+    ln1: Any        # (H,)       input rms_norm weight
+    wq: Any         # (H, nh*d)
+    wk: Any         # (H, nkv*d)
+    wv: Any         # (H, nkv*d)
+    wo: Any         # (nh*d, H)
+    ln2: Any        # (H,)       post-attention rms_norm weight
+    wg: Any         # (H, I)     SwiGLU gate
+    wu: Any         # (H, I)     SwiGLU up
+    wd: Any         # (I, H)     SwiGLU down
+
+
+def _rope_tables(seq_lens: jax.Array, d: int, theta: float):
+    """Per-slot decode rotary tables at positions ``seq_lens`` — the
+    direct compute of incubate's fused_rotary_position_embedding
+    (position_ids branch): (sin, cos), each (B, d) float32."""
+    pos = jnp.asarray(seq_lens, jnp.int32).astype(jnp.float32)
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = pos[:, None] * inv                       # (B, d/2)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)   # (B, d)
+    return jnp.sin(emb), jnp.cos(emb)
+
+
+def _rms(x, w, eps):
+    """F.rms_norm's exact composition (f32 moments, cast, then scale in
+    the activation dtype) so the fused path matches the unfused chain."""
+    h = x.astype(jnp.float32)
+    var = jnp.mean(h * h, axis=-1, keepdims=True)
+    out = (h * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+    return out * w.astype(x.dtype)
+
+
+def _rope_heads(t, sin, cos):
+    """Neox rotate-half at per-row angles; sin/cos (B, d) f32, applied in
+    the activation dtype (the unfused chain's cast point)."""
+    c = cos[:, None, :].astype(t.dtype)
+    s = sin[:, None, :].astype(t.dtype)
+    t1, t2 = jnp.split(t, 2, axis=-1)
+    rot = jnp.concatenate([-t2, t1], axis=-1)
+    return t * c + rot * s
+
+
+def fused_block_decode_ref(x, weights: BlockDecodeWeights, k_pages, v_pages,
+                           block_tables, seq_lens, *, num_heads: int,
+                           num_kv_heads: int, rope_theta: float = 10000.0,
+                           epsilon: float = 1e-6,
+                           sm_scale: Optional[float] = None):
+    """Pure-jnp fused block step — primitive-for-primitive the unfused
+    chain (LlamaDecoderLayer over the paged cache), composed in one
+    function so XLA fuses what it can. CPU-CI path and parity oracle."""
+    b, hidden = x.shape
+    d = weights.wq.shape[1] // num_heads
+    bt = jnp.asarray(block_tables, jnp.int32)
+    sl = jnp.asarray(seq_lens, jnp.int32)
+
+    h = _rms(x, weights.ln1, epsilon)
+    q = (h @ weights.wq).reshape(b, num_heads, d)
+    k = (h @ weights.wk).reshape(b, num_kv_heads, d)
+    v = (h @ weights.wv).reshape(b, num_kv_heads, d)
+    sin, cos = _rope_tables(sl, d, rope_theta)
+    q = _rope_heads(q, sin, cos)
+    k = _rope_heads(k, sin, cos)
+
+    k_pages, v_pages = write_paged_kv(k_pages, v_pages, k, v, bt, sl)
+    attn = paged_attention_xla(q, k_pages, v_pages, bt, sl + 1, sm_scale)
+
+    x2 = x + attn.reshape(b, num_heads * d) @ weights.wo
+    h2 = _rms(x2, weights.ln2, epsilon)
+    f = jax.nn.silu(h2 @ weights.wg) * (h2 @ weights.wu)
+    out = x2 + f @ weights.wd
+    return out, k_pages, v_pages
+
+
+# --------------------------------------------------------------- tiling
+def _tile(n: int, target: int) -> int:
+    """Largest divisor of ``n`` that is <= target, preferring multiples
+    of 128 (lane tiles); falls back to any divisor so odd dims stay
+    correct (just less efficient)."""
+    if n <= target:
+        return n
+    for cand in range(target - target % 128, 0, -128):
+        if n % cand == 0:
+            return cand
+    for cand in range(min(target, n), 0, -1):
+        if n % cand == 0:
+            return cand
+    return n
+
+
+def _f32_dot(a, b):
+    return jax.lax.dot_general(a.astype(jnp.float32), b.astype(jnp.float32),
+                               (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _fused_block_kernel(
+        bt_ref, sl_ref,                                   # scalar prefetch
+        x_ref, ln1_ref, ln2_ref, wq_ref, wk_ref, wv_ref, sin_ref, cos_ref,
+        wo_ref, wg_ref, wu_ref, wd_ref, kp_ref, vp_ref,   # inputs
+        out_ref, knew_ref, vnew_ref,                      # outputs
+        h_ref, qs_ref, ks_ref, vs_ref, ao_ref, x2_ref, fs_ref,
+        acc_a, acc_b, am_ref, mm_ref, ll_ref,             # scratch
+        *, dims: dict):
+    D = dims
+    nh, nkv, d, rep = D["nh"], D["nkv"], D["d"], D["rep"]
+    page, mp = D["page"], D["mp"]
+    eps, scale = D["eps"], D["scale"]
+    t = pl.program_id(0)
+
+    # ---------------------------------------------- t == 0: pre-attn norm
+    @pl.when(t == 0)
+    def _init():
+        xv = x_ref[:].astype(jnp.float32)
+        var = jnp.mean(xv * xv, axis=-1, keepdims=True)
+        h_ref[:] = (xv * jax.lax.rsqrt(var + eps)
+                    * ln1_ref[:].astype(jnp.float32))
+        ao_ref[:] = jnp.zeros_like(ao_ref)
+
+    # ------------------------------------------------ shared matmul phase
+    def _mm(local, n_r, tr, tc, src_ref, w_ref, emit):
+        c = local // n_r
+        r = local % n_r
+
+        @pl.when(r == 0)
+        def _zero():
+            acc_a[:, :tc] = jnp.zeros_like(acc_a[:, :tc])
+
+        src = src_ref[:, pl.ds(r * tr, tr)]
+        acc_a[:, :tc] += _f32_dot(src, w_ref[:])
+
+        @pl.when(r == n_r - 1)
+        def _emit():
+            emit(c, acc_a[:, :tc])
+
+    # Q / K / V projections out of the VMEM-resident normed activation
+    @pl.when((t >= D["off_q"]) & (t < D["off_k"]))
+    def _q():
+        _mm(t - D["off_q"], D["nr_h"], D["tr_h"], D["tc_q"], h_ref, wq_ref,
+            lambda c, acc: qs_ref.__setitem__(
+                (slice(None), pl.ds(c * D["tc_q"], D["tc_q"])), acc))
+
+    @pl.when((t >= D["off_k"]) & (t < D["off_v"]))
+    def _k():
+        _mm(t - D["off_k"], D["nr_h"], D["tr_h"], D["tc_kv"], h_ref, wk_ref,
+            lambda c, acc: ks_ref.__setitem__(
+                (slice(None), pl.ds(c * D["tc_kv"], D["tc_kv"])), acc))
+
+    @pl.when((t >= D["off_v"]) & (t < D["off_r"]))
+    def _v():
+        _mm(t - D["off_v"], D["nr_h"], D["tr_h"], D["tc_kv"], h_ref, wv_ref,
+            lambda c, acc: vs_ref.__setitem__(
+                (slice(None), pl.ds(c * D["tc_kv"], D["tc_kv"])), acc))
+
+    # ------------------------------------- R: in-VMEM rope + k/v emission
+    @pl.when(t == D["off_r"])
+    def _rope():
+        sin = sin_ref[:]
+        cos = cos_ref[:]
+        half = d // 2
+
+        def rot(u):
+            return jnp.concatenate([-u[:, half:], u[:, :half]], axis=1)
+
+        for head in range(nh):
+            c0 = head * d
+            u = qs_ref[:, c0:c0 + d]
+            qs_ref[:, c0:c0 + d] = u * cos + rot(u) * sin
+        for head in range(nkv):
+            c0 = head * d
+            u = ks_ref[:, c0:c0 + d]
+            ks_ref[:, c0:c0 + d] = u * cos + rot(u) * sin
+        knew_ref[:] = ks_ref[:].astype(knew_ref.dtype)
+        vnew_ref[:] = vs_ref[:].astype(vnew_ref.dtype)
+
+    # --------------------------------------- A: paged attention, by page
+    local_a = jnp.clip(t - D["off_a"], 0, D["steps_a"] - 1)
+    j = local_a % mp
+    bh = local_a // mp
+    h_i = bh % nkv
+    b_i = bh // nkv
+    in_a = (t >= D["off_a"]) & (t < D["off_o"])
+
+    def _online(s, vblk):
+        m_prev = mm_ref[0:rep, 0:1]
+        l_prev = ll_ref[0:rep, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        m_new = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        ll_ref[0:rep, :] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=1, keepdims=True),
+            (rep, ll_ref.shape[1]))
+        mm_ref[0:rep, :] = jnp.broadcast_to(m_new, (rep, mm_ref.shape[1]))
+        am_ref[0:rep, :] = alpha * am_ref[0:rep, :] + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(in_a & (j == 0))
+    def _attn_init():
+        am_ref[...] = jnp.zeros_like(am_ref)
+        mm_ref[...] = jnp.full_like(mm_ref, _NEG_INF)
+        ll_ref[...] = jnp.zeros_like(ll_ref)
+
+    seq = sl_ref[b_i]
+    n_pages = jnp.maximum((seq + page - 1) // page, 1)
+
+    @pl.when(in_a & (j < n_pages))
+    def _attn_page():
+        q = qs_ref[pl.ds(b_i, 1), pl.ds(h_i * rep * d, rep * d)]
+        q = q.reshape(rep, d)
+        k = kp_ref[0, 0].astype(jnp.float32)           # (page, d)
+        v = vp_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        pos = j * page + jax.lax.broadcasted_iota(jnp.int32, (rep, page), 1)
+        _online(jnp.where(pos < seq, s, _NEG_INF), v)
+
+        # the token computed THIS step attends too: fold its k/v straight
+        # from VMEM at the row's last valid page — the pool append happens
+        # after the kernel, off the critical path
+        @pl.when(j == n_pages - 1)
+        def _attn_new_token():
+            kn = ks_ref[pl.ds(b_i, 1), pl.ds(h_i * d, d)]   # (1, d)
+            vn = vs_ref[pl.ds(b_i, 1), pl.ds(h_i * d, d)]
+            s_new = jax.lax.dot_general(
+                q, kn, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # (rep, 1)
+            _online(s_new, vn)
+
+    @pl.when(in_a & (j == mp - 1))
+    def _attn_emit():
+        l = ll_ref[0:rep, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o = am_ref[0:rep, :] / l_safe
+        ao_ref[pl.ds(b_i, 1), pl.ds(h_i * rep * d, rep * d)] = \
+            o.reshape(1, rep * d)
+
+    # ------------------------------- O: out-projection + first residual
+    @pl.when((t >= D["off_o"]) & (t < D["off_f"]))
+    def _o():
+        def emit(c, acc):
+            cols = pl.ds(c * D["tc_o"], D["tc_o"])
+            x2_ref[:, cols] = x_ref[:, cols].astype(jnp.float32) + acc
+
+        _mm(t - D["off_o"], D["nr_o"], D["tr_o"], D["tc_o"], ao_ref,
+            wo_ref, emit)
+
+    # ------------------------------------- F: ffn norm + SwiGLU gate/up
+    in_f = (t >= D["off_f"]) & (t < D["off_d"])
+    local_f = jnp.clip(t - D["off_f"], 0, D["steps_f"] - 1)
+
+    @pl.when(in_f & (local_f == 0))
+    def _ffn_norm():
+        xv = x2_ref[:]
+        var = jnp.mean(xv * xv, axis=-1, keepdims=True)
+        h_ref[:] = (xv * jax.lax.rsqrt(var + eps)
+                    * ln2_ref[:].astype(jnp.float32))
+
+    @pl.when(in_f)
+    def _f():
+        tc = D["tc_f"]
+        c = local_f // D["nr_h"]
+        r = local_f % D["nr_h"]
+
+        @pl.when(r == 0)
+        def _zero():
+            acc_a[:, :tc] = jnp.zeros_like(acc_a[:, :tc])
+            acc_b[:, :tc] = jnp.zeros_like(acc_b[:, :tc])
+
+        src = h_ref[:, pl.ds(r * D["tr_h"], D["tr_h"])]
+        acc_a[:, :tc] += _f32_dot(src, wg_ref[:])
+        acc_b[:, :tc] += _f32_dot(src, wu_ref[:])
+
+        @pl.when(r == D["nr_h"] - 1)
+        def _emit():
+            g = acc_a[:, :tc]
+            fs_ref[:, pl.ds(c * tc, tc)] = jax.nn.silu(g) * acc_b[:, :tc]
+
+    # ---------------------------- D: down-projection + second residual
+    @pl.when(t >= D["off_d"])
+    def _d():
+        def emit(c, acc):
+            x2 = x2_ref[:, pl.ds(c * D["tc_d"], D["tc_d"])]
+            out_ref[:, :] = (x2 + acc).astype(out_ref.dtype)
+
+        _mm(t - D["off_d"], D["nr_i"], D["tr_i"], D["tc_d"], fs_ref,
+            wd_ref, emit)
+
+
+def fused_block_decode_pallas(x, weights: BlockDecodeWeights, k_pages,
+                              v_pages, block_tables, seq_lens, *,
+                              num_heads: int, num_kv_heads: int,
+                              rope_theta: float = 10000.0,
+                              epsilon: float = 1e-6,
+                              sm_scale: Optional[float] = None,
+                              interpret: Optional[bool] = None):
+    """One-kernel block decode step (see module docstring).
+
+    x:            (B, H) — one token's hidden state per slot
+    k/v_pages:    (Hkv, num_pages, page, D) shared pools
+    block_tables: (B, max_pages) int32; seq_lens: (B,) int32
+    Returns ``(out, k_pages, v_pages)`` with the new token appended.
+    """
+    if interpret is None:
+        from ..flags import is_tpu_backend
+        interpret = not is_tpu_backend()
+    b, hidden = x.shape
+    nh, nkv = num_heads, num_kv_heads
+    if nh % nkv:
+        raise ValueError(f"query heads {nh} not divisible by kv heads {nkv}")
+    d = weights.wq.shape[1] // nh
+    rep = nh // nkv
+    page = k_pages.shape[2]
+    mp = block_tables.shape[1]
+    inter = weights.wg.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+
+    bt = jnp.asarray(block_tables, jnp.int32)
+    sl = jnp.asarray(seq_lens, jnp.int32)
+    b_pad = -(-b // 8) * 8
+    rep_pad = -(-rep // 8) * 8
+
+    sin, cos = _rope_tables(sl, d, rope_theta)
+    if b_pad != b:
+        pad = [(0, b_pad - b), (0, 0)]
+        x_p = jnp.pad(x, pad)
+        sin, cos = jnp.pad(sin, pad), jnp.pad(cos, pad)
+        bt_p = jnp.pad(bt, pad)
+        sl_p = jnp.pad(sl, (0, b_pad - b))
+    else:
+        x_p, bt_p, sl_p = x, bt, sl
+
+    # tile sizes: contraction x output tiling keeps any one weight block
+    # (plus its double buffer) a small slice of VMEM while activations
+    # stay resident; divisor snapping keeps odd dims correct
+    tr_h = _tile(hidden, 512)       # H-contraction rows (Q/K/V/F)
+    tr_o = _tile(nh * d, 512)       # attn-out contraction rows (O)
+    tr_i = _tile(inter, 512)        # FFN contraction rows (D)
+    tc_q = _tile(nh * d, 256)
+    tc_kv = _tile(nkv * d, 256)
+    tc_o = _tile(hidden, 256)
+    tc_f = _tile(inter, 256)
+    tc_d = _tile(hidden, 256)
+    tc_max = max(tc_q, tc_kv, tc_o, tc_f, tc_d)
+
+    nr_h = hidden // tr_h
+    nr_o = (nh * d) // tr_o
+    nr_i = inter // tr_i
+    steps_q = nr_h * ((nh * d) // tc_q)
+    steps_kv = nr_h * ((nkv * d) // tc_kv)
+    steps_a = b_pad * nkv * mp
+    steps_o = nr_o * (hidden // tc_o)
+    steps_f = nr_h * (inter // tc_f)
+    steps_d = nr_i * (hidden // tc_d)
+
+    off_q = 0
+    off_k = off_q + steps_q
+    off_v = off_k + steps_kv
+    off_r = off_v + steps_kv
+    off_a = off_r + 1
+    off_o = off_a + steps_a
+    off_f = off_o + steps_o
+    off_d = off_f + steps_f
+    total = off_d + steps_d
+
+    dims = dict(nh=nh, nkv=nkv, d=d, rep=rep, page=page, mp=mp,
+                eps=float(epsilon), scale=float(sm_scale),
+                tr_h=tr_h, tr_o=tr_o, tr_i=tr_i, tc_q=tc_q, tc_kv=tc_kv,
+                tc_o=tc_o, tc_f=tc_f, tc_d=tc_d, nr_h=nr_h, nr_o=nr_o,
+                nr_i=nr_i, steps_a=steps_a, steps_f=steps_f,
+                off_q=off_q, off_k=off_k, off_v=off_v, off_r=off_r,
+                off_a=off_a, off_o=off_o, off_f=off_f, off_d=off_d)
+
+    def _const(*_args):
+        return (0, 0)
+
+    def _phase_map(off, steps, n_r):
+        def index(t, bt_ref, sl_ref):
+            local = jnp.clip(t - off, 0, steps - 1)
+            return (local % n_r, local // n_r)
+        return index
+
+    def _kp_map(t, bt_ref, sl_ref):
+        local = jnp.clip(t - off_a, 0, steps_a - 1)
+        jj = local % mp
+        bh = local // mp
+        return (bh % nkv, bt_ref[bh // nkv, jj], 0, 0)
+
+    def _out_map(t, bt_ref, sl_ref):
+        local = jnp.clip(t - off_d, 0, steps_d - 1)
+        return (0, local // nr_i)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(total,),
+        in_specs=[
+            pl.BlockSpec((b_pad, hidden), _const),                  # x
+            pl.BlockSpec((1, hidden), _const),                      # ln1
+            pl.BlockSpec((1, hidden), _const),                      # ln2
+            pl.BlockSpec((tr_h, tc_q),
+                         _phase_map(off_q, steps_q, nr_h)),         # wq
+            pl.BlockSpec((tr_h, tc_kv),
+                         _phase_map(off_k, steps_kv, nr_h)),        # wk
+            pl.BlockSpec((tr_h, tc_kv),
+                         _phase_map(off_v, steps_kv, nr_h)),        # wv
+            pl.BlockSpec((b_pad, d), _const),                       # sin
+            pl.BlockSpec((b_pad, d), _const),                       # cos
+            pl.BlockSpec((tr_o, tc_o),
+                         _phase_map(off_o, steps_o, nr_o)),         # wo
+            pl.BlockSpec((tr_h, tc_f),
+                         _phase_map(off_f, steps_f, nr_h)),         # wg
+            pl.BlockSpec((tr_h, tc_f),
+                         _phase_map(off_f, steps_f, nr_h)),         # wu
+            pl.BlockSpec((tr_i, tc_d),
+                         _phase_map(off_d, steps_d, nr_i)),         # wd
+            pl.BlockSpec((1, 1, page, d), _kp_map),                 # k_pages
+            pl.BlockSpec((1, 1, page, d), _kp_map),                 # v_pages
+        ],
+        out_specs=[
+            pl.BlockSpec((b_pad, tc_d), _out_map),                  # out
+            pl.BlockSpec((b_pad, nkv * d), _const),                 # k_new
+            pl.BlockSpec((b_pad, nkv * d), _const),                 # v_new
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b_pad, hidden), jnp.float32),     # h (normed)
+            pltpu.VMEM((b_pad, nh * d), jnp.float32),     # q
+            pltpu.VMEM((b_pad, nkv * d), jnp.float32),    # k_new
+            pltpu.VMEM((b_pad, nkv * d), jnp.float32),    # v_new
+            pltpu.VMEM((b_pad, nh * d), jnp.float32),     # attn out
+            pltpu.VMEM((b_pad, hidden), jnp.float32),     # x2 (residual)
+            pltpu.VMEM((b_pad, inter), jnp.float32),      # silu(g)*u
+            pltpu.VMEM((b_pad, tc_max), jnp.float32),     # acc a
+            pltpu.VMEM((b_pad, tc_max), jnp.float32),     # acc b
+            pltpu.VMEM((rep_pad, d), jnp.float32),        # attn acc
+            pltpu.VMEM((rep_pad, _LANES), jnp.float32),   # attn m
+            pltpu.VMEM((rep_pad, _LANES), jnp.float32),   # attn l
+        ],
+    )
+
+    out, k_new, v_new = pl.pallas_call(
+        functools.partial(_fused_block_kernel, dims=dims),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b_pad, hidden), x.dtype),
+            jax.ShapeDtypeStruct((b_pad, nkv * d), x.dtype),
+            jax.ShapeDtypeStruct((b_pad, nkv * d), x.dtype),
+        ],
+        interpret=interpret,
+    )(bt_p, sl_p, x_p, weights.ln1.reshape(1, hidden),
+      weights.ln2.reshape(1, hidden), weights.wq, weights.wk, weights.wv,
+      sin, cos, weights.wo, weights.wg, weights.wu, weights.wd,
+      k_pages, v_pages)
+
+    k_pages, v_pages = write_paged_kv(
+        k_pages, v_pages, k_new[:b].reshape(b, nkv, d),
+        v_new[:b].reshape(b, nkv, d), bt, sl)
+    return out[:b], k_pages, v_pages
+
+
+def fused_block_decode(x, weights: BlockDecodeWeights, k_pages, v_pages,
+                       block_tables, seq_lens, *, num_heads: int,
+                       num_kv_heads: int, rope_theta: float = 10000.0,
+                       epsilon: float = 1e-6,
+                       sm_scale: Optional[float] = None, snap=None):
+    """Dispatch one fused block-decode step: the Pallas kernel on a real
+    TPU backend (``FLAGS_use_pallas``), the jnp composition elsewhere.
+    ``snap`` is an optional :func:`paddle_tpu.flags.snapshot` so a caller
+    building a multi-layer program resolves flags ONCE per trace."""
+    from ..flags import is_tpu_backend, snapshot
+    if snap is None:
+        snap = snapshot(("use_pallas",))
+    kwargs = dict(num_heads=num_heads, num_kv_heads=num_kv_heads,
+                  rope_theta=rope_theta, epsilon=epsilon, sm_scale=sm_scale)
+    if snap.use_pallas and is_tpu_backend():
+        return fused_block_decode_pallas(x, weights, k_pages, v_pages,
+                                         block_tables, seq_lens, **kwargs)
+    return fused_block_decode_ref(x, weights, k_pages, v_pages,
+                                  block_tables, seq_lens, **kwargs)

@@ -290,10 +290,15 @@ class PagedKVCache:
     def ref_page(self, page_id: int) -> None:
         self._page_rc[page_id] += 1
 
-    def unref_page(self, page_id: int) -> None:
+    def unref_page(self, page_id: int) -> bool:
+        """Drop one reference; returns True when the page actually
+        returned to the free list (last reference gone) so callers
+        reclaiming capacity can count REAL frees, not unrefs."""
         self._page_rc[page_id] -= 1
         if self._page_rc[page_id] == 0:
             self._free.append(int(page_id))
+            return True
+        return False
 
     def adopt_shared(self, seq_idx: int, page_ids) -> None:
         """Install already-written pages (a cached prompt prefix) at the

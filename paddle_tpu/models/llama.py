@@ -229,6 +229,37 @@ class LlamaForCausalLM(GenerationMixin, Layer):
                                         offset=offset)
         return self.logits(hidden), new_caches
 
+    def block_decode_spec(self):
+        """Per-layer weight layout for the fused block-decode serving
+        path (kernels/fused_block_decode.py): which named parameters form
+        each layer's BlockDecodeWeights, plus the embedding / final-norm
+        / lm-head names and the attention geometry. The serving engine
+        builds its ONE compiled decode step from this — the model's
+        python forward never runs on the decode hot path."""
+        c = self.config
+        layers = []
+        for i in range(c.num_hidden_layers):
+            p = f"llama.layers.{i}."
+            layers.append(dict(
+                ln1=p + "input_layernorm.weight",
+                wq=p + "self_attn.q_proj.weight",
+                wk=p + "self_attn.k_proj.weight",
+                wv=p + "self_attn.v_proj.weight",
+                wo=p + "self_attn.o_proj.weight",
+                ln2=p + "post_attention_layernorm.weight",
+                wg=p + "mlp.gate_proj.weight",
+                wu=p + "mlp.up_proj.weight",
+                wd=p + "mlp.down_proj.weight"))
+        return dict(
+            arch="llama", layers=layers,
+            embed="llama.embed_tokens.weight",
+            final_norm="llama.norm.weight",
+            lm_head=None if self.lm_head is None else "lm_head.weight",
+            num_heads=c.num_attention_heads,
+            num_kv_heads=c.num_key_value_heads,
+            rope_theta=c.rope_theta,
+            epsilon=c.rms_norm_eps)
+
 
 # ===================================================== pipeline-parallel pipe
 class LlamaEmbeddingPipe(Layer):

@@ -214,6 +214,40 @@ def step_kernels() -> list:
     check("paged_attention_parity_vs_xla", parity,
           paged_attention_xla, paged_attention, qd, kp, vp, bt, sl)
 
+    # fused transformer-block decode — the whole-layer serving kernel
+    # (kernels schema 4): compile + hidden-state parity vs the jnp
+    # composition. Exercises the flat phase grid, scalar-prefetched page
+    # maps, in-VMEM rope, and the head-group reshapes KERNEL_DECISIONS.md
+    # flags as the Mosaic-layout risk.
+    from paddle_tpu.kernels.fused_block_decode import (
+        BlockDecodeWeights, fused_block_decode_pallas,
+        fused_block_decode_ref)
+    import functools as _ft
+    hf, nhf, nkvf, inf = 256, 8, 2, 512
+    df = hf // nhf
+    # 0.05-scaled weights keep the block output O(1): the parity gate is
+    # ABSOLUTE (tol 0.05) and bf16 carries ~2-3 significant digits
+    mks = lambda *shape: mk(*shape) * 0.05
+    wblk = BlockDecodeWeights(
+        ln1=mk(hf) * 0.1 + 1.0, wq=mks(hf, nhf * df),
+        wk=mks(hf, nkvf * df), wv=mks(hf, nkvf * df),
+        wo=mks(nhf * df, hf), ln2=mk(hf) * 0.1 + 1.0,
+        wg=mks(hf, inf), wu=mks(hf, inf), wd=mks(inf, hf))
+    kpf = mks(nkvf, num_pages, page, df)
+    vpf = mks(nkvf, num_pages, page, df)
+    xf = mks(4, hf)
+    fbd_kw = dict(num_heads=nhf, num_kv_heads=nkvf)
+    # lengths stay < mp*page: the appended token must land on an
+    # allocated page (the serving engine's allocate() contract)
+    slf = jnp.asarray([500, 511, 37, 129], jnp.int32)
+    check("fused_block_decode",
+          jax.jit(_ft.partial(fused_block_decode_pallas, **fbd_kw)),
+          xf, wblk, kpf, vpf, bt, slf)
+    check("fused_block_decode_parity_vs_ref", parity,
+          lambda *a: fused_block_decode_ref(*a, **fbd_kw)[0],
+          lambda *a: fused_block_decode_pallas(*a, **fbd_kw)[0],
+          xf, wblk, kpf, vpf, bt, slf)
+
     # SD-UNet head shapes (kernels schema 3): the flash_attn_min_seqlen
     # 2048->1024 flip newly routes the UNet's seq-1024 self-attention
     # (head_dim 80) through the kernel; seq-4096/d=40 was exercised by
