@@ -231,6 +231,27 @@ define_flag("fused_block_decode", True,
             "block_decode_spec() (the Llama family); others keep the "
             "generic compiled step. Env-overridable "
             "(FLAGS_fused_block_decode=0) like the flash block flags.")
+define_flag("flash_dispatch_table", "0:flash;2048:dense;4096:512x512",
+            "Per-shape flash-attention dispatch table: ';'-separated "
+            "'<min_seqlen>:<entry>' buckets, entry one of 'flash' (kernel "
+            "with the FLAGS_flash_block_{q,k} defaults), 'dense' (XLA "
+            "dense sdpa), or 'BQxBK' (kernel with those blocks). A query "
+            "length resolves to the bucket with the largest min_seqlen "
+            "<= it; lengths below every bucket use 'flash'. Seeded from "
+            "the r05 on-chip A/B (ATTN_BENCH_r05.json): flash matches "
+            "dense at 1024 (1.01x), LOSES at 2048 (0.86x -> dense "
+            "fallback so the fused path never loses to XLA dense), and "
+            "wins at 4096+ with the 512x512 sweep blocks (76.0 ms vs "
+            "100.6 dense). Applies where sdpa already cleared "
+            "FLAGS_flash_attn_min_seqlen; set to '' to disable the table "
+            "(always flash with the default blocks).")
+define_flag("train_max_in_flight", 32,
+            "Hard cap on dispatched-but-unsynced train steps. The async "
+            "TrainStep window never blocks on the loss; this bound is the "
+            "HBM safety net for callers that never pull metrics (each "
+            "in-flight step holds its input batch buffers until it "
+            "retires). Normal loops sync far earlier via "
+            "metrics_every/sync().")
 define_flag("allocator_strategy", "auto_growth", "Kept for API parity; PJRT owns memory on TPU.")
 define_flag("fraction_of_gpu_memory_to_use", 0.92, "API parity; PJRT owns memory on TPU.")
 define_flag("log_level", 1, "Framework log verbosity (GLOG_v analogue).")
@@ -247,6 +268,7 @@ define_flag("cudnn_deterministic", False, "API parity alias of FLAGS_determinist
 PROGRAM_FLAGS = (
     "fused_block_decode", "use_pallas", "flash_attn_min_seqlen",
     "flash_block_q", "flash_block_k", "flash_compact_stats",
+    "flash_dispatch_table",
     "tpu_matmul_precision", "embedding_matmul_grad", "deterministic",
     "check_nan_inf", "check_nan_inf_level",
 )

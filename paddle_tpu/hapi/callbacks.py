@@ -74,7 +74,14 @@ class CallbackList:
 
 
 class ProgBarLogger(Callback):
-    """Prints loss + ips (samples/sec) — the reference's headline trainer log."""
+    """Prints loss + ips (steps/sec) — the reference's headline trainer log.
+
+    Async-aware: under the dispatch-ahead fit loop the loss arrives only
+    every ``metrics_every`` steps and is stale-by-k (``logs["loss_step"]``
+    names the step it belongs to); in between, ``logs["loss"]`` is None
+    and nothing is printed. The ips figure is computed over wall time
+    since train begin, so it reflects true dispatch throughput rather
+    than per-step host round-trips."""
 
     def __init__(self, log_freq: int = 10, verbose: int = 2):
         super().__init__()
@@ -82,6 +89,11 @@ class ProgBarLogger(Callback):
         self.verbose = verbose
         self._t0 = None
         self._count = 0
+        self._last_print = None
+
+    def on_epoch_begin(self, epoch, logs=None):
+        # callback steps restart each epoch; so must the print throttle
+        self._last_print = None
 
     def on_train_batch_begin(self, step, logs=None):
         if self._t0 is None:
@@ -89,12 +101,27 @@ class ProgBarLogger(Callback):
 
     def on_train_batch_end(self, step, logs=None):
         self._count += 1
-        if self.verbose and step % self.log_freq == 0:
-            dt = time.perf_counter() - (self._t0 or time.perf_counter())
-            ips = self._count / dt if dt > 0 else 0.0
-            loss = logs.get("loss") if logs else None
-            print(f"step {step}: loss {loss:.4f} - {ips:.2f} steps/sec" if loss is not None
-                  else f"step {step}")
+        if not self.verbose:
+            return
+        loss = logs.get("loss") if logs else None
+        is_async = bool(logs) and "loss_step" in logs
+        # async loop: print when a fresh (stale-by-k) loss lands, but
+        # never more often than log_freq (metrics_every=1 syncs every
+        # step — that must not mean a print every step); eager loop:
+        # keep the classic every-log_freq cadence
+        if loss is None:
+            return
+        if not is_async and step % self.log_freq != 0:
+            return
+        if is_async and self._last_print is not None \
+                and step - self._last_print < self.log_freq:
+            return
+        self._last_print = step
+        dt = time.perf_counter() - (self._t0 or time.perf_counter())
+        ips = self._count / dt if dt > 0 else 0.0
+        at = (f" (@step {logs['loss_step']})"
+              if is_async and logs.get("loss_step") != step else "")
+        print(f"step {step}: loss {loss:.4f}{at} - {ips:.2f} steps/sec")
 
 
 class ModelCheckpoint(Callback):
@@ -174,9 +201,15 @@ class VisualDL(Callback):
         self._rows = []
 
     def on_train_batch_end(self, step, logs=None):
-        if logs:
-            self._rows.append({"step": step, **{k: v for k, v in logs.items()
-                                                if isinstance(v, (int, float))}})
+        if not logs:
+            return
+        row = {k: v for k, v in logs.items()
+               if isinstance(v, (int, float)) and v is not None}
+        # async fit: between metric pulls there is nothing to log (loss is
+        # None); scalars land every metrics_every steps, tagged with the
+        # step they belong to (loss_step) — don't write empty rows
+        if any(k not in ("step", "loss_step", "staleness") for k in row):
+            self._rows.append({"step": step, **row})
 
     def on_train_end(self, logs=None):
         import json

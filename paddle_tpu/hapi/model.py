@@ -3,6 +3,15 @@
 fit/evaluate/predict over a Layer + optimizer + loss, with callbacks. The
 inner loop uses the jitted TrainStep when the model's forward is jit-safe
 (static shapes), falling back to eager otherwise.
+
+``fit`` is async-by-default: steps are DISPATCHED without pulling the
+loss (the TRAIN_AB_r05 on-chip A/B showed the same step at MFU 0.4627
+pipelined vs 0.2772 with a per-step host sync), metrics are host-pulled
+every ``metrics_every`` steps (stale-by-k, near-zero wait because the
+pulled loss was dispatched k steps earlier), input batches are staged
+host->device one step ahead (double buffering), and the only hard
+barriers are epoch ends — where checkpoint / early-stop / eval decisions
+need exact state.
 """
 
 from __future__ import annotations
@@ -32,6 +41,11 @@ class Model:
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = list(metrics) if metrics else []
+        if self._train_step is not None:
+            # a rebuilt recipe invalidates the compiled step; pull the
+            # trained params back into the Layer first
+            self._train_step.sync_to_model()
+            self._train_step = None
 
     # ----------------------------------------------------------------- train
     def _loss_value(self, outputs, labels):
@@ -40,6 +54,12 @@ class Model:
         return self._loss(outputs, labels)
 
     def train_batch(self, inputs, labels=None, update=True):
+        if self._train_step is not None:
+            # eager training updates the Layer's tensors; a retained
+            # jitted step would later sync its (now stale) device params
+            # back over them in save() — pull once and drop it
+            self._train_step.sync_to_model()
+            self._train_step = None
         self.network.train()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         outputs = self.network(*inputs)
@@ -69,18 +89,70 @@ class Model:
             out = self.network(*inputs)
         return out
 
+    def _ensure_train_step(self, metrics_every, accumulate_grad_batches=1):
+        """Build (or reuse) the jitted TrainStep for fit's inner loop.
+        Returns None when the recipe can't be jitted (no loss/optimizer,
+        or construction fails) — fit then runs the eager loop."""
+        accum = max(1, int(accumulate_grad_batches or 1))
+        if self._train_step is not None and \
+                self._train_step.grad_accum_steps != accum:
+            # a changed accumulation recipe invalidates the compiled step
+            self._train_step.sync_to_model()
+            self._train_step = None
+        if self._train_step is not None:
+            self._train_step.metrics_every = max(0, int(metrics_every))
+            return self._train_step
+        if self._optimizer is None or self._loss is None:
+            return None
+        try:
+            self._train_step = TrainStep(
+                self.network, self._optimizer, loss_fn=self._loss,
+                grad_accum_steps=accum, metrics_every=metrics_every)
+        except Exception as e:
+            # eager still trains, but at the per-step-sync throughput the
+            # async loop exists to avoid — never degrade silently
+            import warnings
+            warnings.warn(
+                f"Model.fit: could not build the jitted TrainStep "
+                f"({e!r}); falling back to the eager per-step loop "
+                f"(slower). Pass jit=False to silence.")
+            self._train_step = None
+        return self._train_step
+
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
-        from ..io import DataLoader, Dataset
+            accumulate_grad_batches=1, num_iters=None, metrics_every=None,
+            jit=None, prefetch_to_device=True, use_process_workers=False):
+        """Train. Async by default: the jitted TrainStep dispatches ahead
+        of the device and the loss shown to callbacks is stale-by-k
+        (``metrics_every``, default ``log_freq``); hard device syncs
+        happen only every k steps (a near-free pull of an already-computed
+        loss) and at epoch ends, where checkpoint/early-stop/eval read
+        exact state. ``jit=False`` forces the eager per-step loop;
+        ``metrics_every=1`` keeps the jitted loop but syncs every step.
+        ``prefetch_to_device`` stages batch N+1 host->device while step N
+        runs (double buffering). ``use_process_workers`` moves the
+        ``num_workers`` loader workers into OS processes (shared-memory
+        batch transport) for GIL-bound ``__getitem__`` transforms."""
+        from ..io import Dataset, DataLoader, DevicePrefetcher
 
         if isinstance(train_data, Dataset):
             train_loader = DataLoader(train_data, batch_size=batch_size,
                                       shuffle=shuffle, drop_last=drop_last,
-                                      num_workers=num_workers)
+                                      num_workers=num_workers,
+                                      use_process_workers=use_process_workers)
         else:
             train_loader = train_data
+
+        # metrics_every=0 is meaningful (never pull; epoch-end sync only)
+        # — only None defaults to the ProgBar cadence
+        metrics_every = (int(metrics_every) if metrics_every is not None
+                         else max(1, log_freq))
+        step_obj = None
+        if jit is not False:
+            step_obj = self._ensure_train_step(metrics_every,
+                                               accumulate_grad_batches)
 
         cbks = cb_mod.config_callbacks(
             callbacks, model=self, epochs=epochs, verbose=verbose,
@@ -90,23 +162,133 @@ class Model:
         it = 0
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
-            for step, batch in enumerate(train_loader):
+            logs = {}
+            iterator = iter(train_loader)
+            if step_obj is not None and prefetch_to_device:
+                iterator = iter(DevicePrefetcher(iterator, self._stage_batch))
+            # callbacks count steps per epoch; the TrainStep counts
+            # globally — the base translates its loss_step/staleness tags
+            epoch_base = step_obj._step_count if step_obj is not None else 0
+            for step, batch in enumerate(iterator):
                 cbks.on_batch_begin("train", step, {})
-                if isinstance(batch, (list, tuple)) and len(batch) >= 2:
-                    *xs, y = batch
+                if step_obj is not None:
+                    try:
+                        logs = self._async_batch(step_obj, batch, step,
+                                                 epoch_base)
+                    except Exception:
+                        # forward isn't jit-safe (trace errors surface on
+                        # the first dispatch, before any donation
+                        # executes): fall back to the eager loop for the
+                        # rest of training. Failures after ANY successful
+                        # jitted step are real bugs — and falling back
+                        # then would discard the device-side progress the
+                        # Layer's (donated) tensors no longer hold.
+                        if step > 0 or step_obj._step_count > 0:
+                            raise
+                        from .train_step import StagedBatch
+                        raw = (batch.raw if isinstance(batch, StagedBatch)
+                               else batch)
+                        if raw is None:
+                            raise
+                        import sys
+                        import traceback
+                        import warnings
+                        traceback.print_exc(file=sys.stderr)
+                        warnings.warn(
+                            "Model.fit: first jitted step failed (trace "
+                            "above); falling back to the eager per-step "
+                            "loop (slower). Pass jit=False to silence.")
+                        step_obj = self._train_step = None
+                        logs = self._eager_batch(raw, step)
                 else:
-                    xs, y = [batch], None
-                logs = {"loss": self.train_batch(xs, y)[0], "step": step}
+                    logs = self._eager_batch(batch, step)
                 cbks.on_batch_end("train", step, logs)
                 it += 1
                 if num_iters is not None and it >= num_iters:
                     break
+            done = self.stop_training or (num_iters is not None
+                                          and it >= num_iters)
+            want_eval = (eval_data is not None
+                         and (epoch + 1) % eval_freq == 0)
+            if step_obj is not None:
+                # the ONE hard barrier of the epoch: exact loss for
+                # EarlyStopping/checkpoint decisions
+                logs = dict(logs)
+                logs["loss"] = step_obj.sync()
+                m = step_obj.last_metrics
+                if m is not None and m["loss_step"] >= epoch_base:
+                    # retag: the barrier loss is exact — stale tags from
+                    # the last mid-epoch pull must not survive on it
+                    logs["loss_step"] = m["loss_step"] - epoch_base
+                    logs["staleness"] = m["staleness"]
+                if want_eval:
+                    # eval reads the Layer's tensors — pull the on-device
+                    # params back only when something needs them
+                    # (ModelCheckpoint goes through Model.save, which
+                    # syncs on its own cadence; the post-loop sync covers
+                    # fit's end however the loop exits)
+                    step_obj.sync_to_model()
             cbks.on_epoch_end(epoch, logs)
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+            if want_eval:
                 self.evaluate(eval_data, batch_size=batch_size, verbose=0)
-            if self.stop_training or (num_iters is not None and it >= num_iters):
+            if done or self.stop_training:
                 break
+        if step_obj is not None:
+            step_obj.sync_to_model()
         cbks.on_end("train")
+
+    def _stage_batch(self, batch):
+        """Split a loader batch into (inputs..., labels) and stage it on
+        device with the TrainStep's data sharding (async). Batches the
+        jitted loop can't consume pass through unchanged (the loop then
+        falls back to eager)."""
+        ts = self._train_step
+        if ts is not None and isinstance(batch, (list, tuple)) \
+                and len(batch) >= 2:
+            staged = ts.stage(*batch)
+            staged.raw = batch
+            return staged
+        return batch
+
+    def _async_batch(self, step_obj, batch, step, epoch_base=0):
+        """Dispatch one jitted step; never blocks on the loss. Returns
+        callback logs: a fresh (stale-by-k) loss every metrics_every
+        steps, None in between. ``loss_step`` is reported in the
+        callback's per-epoch step numbering (``epoch_base`` = the
+        TrainStep's global count at epoch start), and a pull that found
+        nothing from THIS epoch (the window was just drained by the
+        epoch-end sync) attaches nothing rather than re-labelling the
+        previous epoch's loss."""
+        from .train_step import StagedBatch
+        if not isinstance(batch, StagedBatch):
+            if not (isinstance(batch, (list, tuple)) and len(batch) >= 2):
+                raise NotImplementedError(
+                    "the jitted fit loop needs (inputs..., labels) batches")
+            batch = self._stage_batch(batch)
+        step_obj(batch)
+        logs = {"step": step, "loss": None}
+        m = step_obj.last_metrics
+        if (m is not None and step_obj.metrics_every
+                and step_obj._step_count % step_obj.metrics_every == 0
+                and m["loss_step"] >= epoch_base):
+            logs.update(loss=m["loss"], loss_step=m["loss_step"] - epoch_base,
+                        staleness=m["staleness"])
+        return logs
+
+    def _eager_batch(self, batch, step):
+        from .train_step import StagedBatch
+        if isinstance(batch, StagedBatch):
+            # a prefetcher can hold batches staged BEFORE an eager
+            # fallback dropped the jitted step; replay their raw form
+            if batch.raw is None:
+                raise NotImplementedError(
+                    "eager loop got a StagedBatch without its raw batch")
+            batch = batch.raw
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            *xs, y = batch
+        else:
+            xs, y = [batch], None
+        return {"loss": self.train_batch(xs, y)[0], "step": step}
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None):
@@ -144,6 +326,10 @@ class Model:
     def save(self, path, training=True):
         from ..framework.io import save
 
+        if self._train_step is not None:
+            # fit's params live on device inside the TrainStep; the
+            # Layer's tensors are stale (donated) until synced back
+            self._train_step.sync_to_model()
         save(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:
             save(self._optimizer.state_dict(), path + ".pdopt")

@@ -22,6 +22,7 @@ python/paddle/distributed/fleet/meta_parallel/sharding/.
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
@@ -34,6 +35,20 @@ from ..core.tensor import Tensor
 from ..jit import functional_call, tree_to_values
 from ..optimizer.lr import LRScheduler
 from ..optimizer.optimizer import Optimizer
+
+
+class StagedBatch:
+    """A batch already converted to raw arrays and placed on device with
+    the step's data sharding — what :meth:`TrainStep.stage` returns and
+    ``TrainStep.__call__`` accepts. Staging is async (``jax.device_put``
+    dispatches without blocking), so a loader can stage batch N+1 while
+    the device runs step N (double buffering)."""
+
+    __slots__ = ("vals", "raw")
+
+    def __init__(self, vals: Tuple[Any, ...], raw: Any = None):
+        self.vals = vals
+        self.raw = raw   # original loader batch (eager-fallback replay)
 
 
 class TrainStep:
@@ -54,6 +69,8 @@ class TrainStep:
         gradient_merge_k: Optional[int] = None,
         gradient_merge_avg: bool = True,
         localsgd_k: Optional[int] = None,
+        metrics_every: int = 0,
+        max_in_flight: Optional[int] = None,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -61,6 +78,26 @@ class TrainStep:
         self.mesh = mesh
         self.grad_accum_steps = grad_accum_steps
         self.fused_grad_accum = fused_grad_accum
+        # ---- async dispatch window (the TRAIN_AB_r05 lesson: the same
+        # step runs MFU 0.4627 pipelined vs 0.2772 when the host pulls the
+        # loss every step). __call__ never blocks; losses ride an in-flight
+        # deque. With metrics_every=k, every k-th call host-pulls the loss
+        # dispatched ~k steps ago (already computed -> near-zero wait,
+        # displayed stale-by-k); sync() is the explicit hard barrier. The
+        # max_in_flight cap (FLAGS_train_max_in_flight) bounds dispatch-
+        # ahead so queued batches can't grow HBM without bound even when
+        # the caller never pulls.
+        if max_in_flight is None:
+            from .. import flags
+            max_in_flight = int(flags.get_flag("train_max_in_flight"))
+        self.metrics_every = max(0, int(metrics_every))
+        self.max_in_flight = max(1, int(max_in_flight))
+        self._inflight: deque = deque()
+        self.sync_count = 0      # host-blocking loss pulls (probe-visible)
+        self.throttle_count = 0  # hard-window blocks (0 in a healthy loop)
+        self._trace_count = 0    # step-fn retraces (probe-visible)
+        self.last_metrics: Optional[Dict[str, Any]] = None
+        self._last_loss: Optional[float] = None
         # ---- strategy-driven transforms (reference: fleet/meta_optimizers/
         # gradient_merge_optimizer.py + localsgd_optimizer.py as Program
         # passes; here they are jit transforms of the step). Explicit
@@ -298,11 +335,13 @@ class TrainStep:
             return new_params, new_state
 
         def step(params, opt_state, lr, *batch):
+            self._trace_count += 1   # python body runs only while tracing
             loss, grads = compute_loss_grads(params, batch)
             new_params, new_state = apply_update(params, opt_state, grads, lr)
             return loss, new_params, new_state
 
         def step_merge(params, opt_state, merge, lr, *batch):
+            self._trace_count += 1
             loss, grads = compute_loss_grads(params, batch)
             buf, count = merge
             buf = jax.tree.map(jnp.add, buf, grads)
@@ -379,6 +418,7 @@ class TrainStep:
             return loss, np_, ns
 
         def step(params, opt_state, count, lr, *batch):
+            self._trace_count += 1
             micro = tuple(jax.tree.map(
                 lambda b: b.reshape((dp, b.shape[0] // dp) + b.shape[1:]),
                 b) for b in batch)
@@ -406,8 +446,10 @@ class TrainStep:
             step, donate_argnums=(0, 1, 2) if donate else ())
         self._step_count = 0
 
-    def __call__(self, *batch) -> Tensor:
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+    def stage(self, *batch) -> StagedBatch:
+        """Convert + place a batch on device (async dispatch, never
+        blocks). ``__call__`` accepts the result directly, so a prefetching
+        loader can stage batch N+1 while the device runs step N."""
         vals = tuple(tree_to_values(b) for b in batch)
         if self._data_sharding is not None:
             if jax.process_count() > 1:
@@ -423,6 +465,21 @@ class TrainStep:
             else:
                 vals = tuple(jax.device_put(v, self._data_sharding)
                              for v in vals)
+        else:
+            # unsharded: an explicit async H2D here (instead of letting
+            # the jit dispatch do it) is what overlaps input transfer
+            # with the previous step's compute
+            vals = tuple(jax.tree.map(
+                lambda leaf: leaf if isinstance(leaf, jax.core.Tracer)
+                else jax.device_put(leaf), v) for v in vals)
+        return StagedBatch(vals)
+
+    def __call__(self, *batch) -> Tensor:
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        if len(batch) == 1 and isinstance(batch[0], StagedBatch):
+            vals = batch[0].vals
+        else:
+            vals = self.stage(*batch).vals
         if getattr(self, "_lsgd_count", None) is not None:
             loss, self.params, self.opt_state, self._lsgd_count = \
                 self._jit_step(self.params, self.opt_state,
@@ -437,7 +494,71 @@ class TrainStep:
         if isinstance(self.optimizer._lr, LRScheduler):
             self.optimizer._lr.step()
         self._step_count += 1
+        self._inflight.append((self._step_count - 1, loss))
+        if self.metrics_every and self._step_count % self.metrics_every == 0:
+            self.pull_metrics()
+        while len(self._inflight) > self.max_in_flight:
+            # HBM safety net: a caller that never pulls still can't run
+            # dispatch unboundedly ahead of the chip. Already-executed
+            # entries (a classic caller float()ing every returned loss
+            # keeps the chip fully synced) retire for free — no transfer,
+            # no throttle; only a genuinely outstanding oldest step costs
+            # a host pull (not block_until_ready, which does not reliably
+            # block through the axon tunnel — see bench.py) via its data
+            # dependency.
+            _, old = self._inflight.popleft()
+            ready = getattr(old, "is_ready", None)
+            if ready is not None and ready():
+                continue
+            np.asarray(old)
+            self.throttle_count += 1
         return Tensor(loss, stop_gradient=True)
+
+    # -------------------------------------------------------- async metrics
+    def pull_metrics(self, lag: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """Async metrics pull: host-read the loss dispatched ``lag`` steps
+        ago (default ``metrics_every``), dropping older in-flight entries
+        unread. The pulled value is normally already computed, so this
+        costs one host round-trip, not a pipeline drain — the displayed
+        loss is simply stale-by-``lag``. Counts as one blocking sync.
+        Returns ``{"loss", "loss_step", "staleness"}`` (the previous
+        metrics when nothing is old enough to pull yet)."""
+        lag = (self.metrics_every or 1) if lag is None else max(0, int(lag))
+        target = self._step_count - lag
+        picked = None
+        while self._inflight and self._inflight[0][0] <= target:
+            picked = self._inflight.popleft()
+        if picked is None:
+            return self.last_metrics
+        idx, dev = picked
+        # host pull (not block_until_ready): reliable through the axon
+        # tunnel, and the value is what the caller wants anyway
+        val = float(np.asarray(dev))
+        self.sync_count += 1
+        self._last_loss = val
+        self.last_metrics = {"loss": val, "loss_step": idx,
+                             "staleness": self._step_count - 1 - idx}
+        return self.last_metrics
+
+    def sync(self) -> Optional[float]:
+        """Hard barrier: block until every dispatched step has executed
+        (per-device execution order is dispatch order) and return the
+        latest loss. Epoch ends, checkpoints and early-stop decisions
+        belong here — not in the per-step loop."""
+        if self._inflight:
+            idx, dev = self._inflight[-1]
+            self._inflight.clear()
+            self._last_loss = float(np.asarray(dev))
+            self.sync_count += 1
+            self.last_metrics = {"loss": self._last_loss, "loss_step": idx,
+                                 "staleness": 0}
+        return self._last_loss
+
+    @property
+    def trace_count(self) -> int:
+        """How many times the step function has been (re)traced — the
+        zero-retrace probe: a steady-state loop must hold this at 1."""
+        return self._trace_count
 
     # ------------------------------------------------------------- utilities
     def sync_to_model(self) -> None:

@@ -15,4 +15,7 @@ from .sampler import (  # noqa: F401
     BatchSampler, DistributedBatchSampler, RandomSampler, Sampler,
     SequenceSampler, SubsetRandomSampler, WeightedRandomSampler,
 )
-from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
+from .dataloader import (  # noqa: F401
+    DataLoader, DevicePrefetcher, default_collate_fn, get_worker_info,
+    numpy_collate_fn,
+)

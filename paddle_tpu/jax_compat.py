@@ -1,0 +1,101 @@
+"""Forward-compatibility shims for older jax releases.
+
+The codebase is written against the modern jax surface — ``jax.shard_map``
+with ``check_vma``, ``jax.typeof`` + varying-manual-axes (vma) types,
+``jax.lax.pcast`` — but the container may pin an older jax (0.4.x), where
+``shard_map`` still lives in ``jax.experimental`` and takes ``check_rep``.
+
+Policy: where the new API is *expressible* in the old one, install the
+forward-compatible name here, at import time, so every call site (product
+code AND tests) keeps targeting the current surface.  What is NOT
+expressible — vma tracking itself — stays version-guarded at its call
+sites (``pipeline_zbh1._vary``, ``flash_attention._sds``), which already
+degrade to no-ops when ``jax.typeof``/``pcast`` are absent.
+
+``check_vma`` (new) maps onto ``check_rep`` (old): both gate the
+replication/varying analysis of per-shard outputs; every manual-mesh
+region in this repo that needs the analysis off passes ``False``
+explicitly, which means the mapped flag is exact for our call sites.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+__all__ = ["abstract_mesh", "abstract_mesh_can_lower"]
+
+
+if not hasattr(jax, "shard_map"):  # jax < 0.5: experimental name + check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def _compat_shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                          check_vma=None, axis_names=None, **kw):
+        if check_vma is not None:
+            kw.setdefault("check_rep", check_vma)
+        if axis_names is not None:
+            # new jax names the MANUAL axes; old jax names the complement
+            # (`auto` = axes left to GSPMD)
+            kw.setdefault("auto",
+                          frozenset(mesh.axis_names) - frozenset(axis_names))
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+
+    jax.shard_map = _compat_shard_map
+
+
+if not hasattr(jax.lax, "axis_size"):  # new name; psum(1, axis) is the
+    # classic spelling and is folded to a trace-time constant
+    def _axis_size(axis_name):
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = _axis_size
+
+
+if not hasattr(jax.sharding, "get_abstract_mesh"):
+    # new API: the current trace context's mesh, whose ``manual_axes``
+    # names the axes a surrounding shard_map is manual over. Old jax
+    # keeps the same information in the trace's axis env (shard_map and
+    # pmap bind their axis names there), which is exactly what callers
+    # like mp_layers._manual_axis consult it for.
+    class _AxisEnvMesh:
+        __slots__ = ("manual_axes",)
+
+        def __init__(self, axes):
+            self.manual_axes = frozenset(axes)
+
+    def _get_abstract_mesh():
+        try:
+            names = jax.core.unsafe_get_axis_names_DO_NOT_USE()
+        except Exception:
+            names = ()
+        return _AxisEnvMesh(names)
+
+    jax.sharding.get_abstract_mesh = _get_abstract_mesh
+
+
+def abstract_mesh(axis_sizes, axis_names):
+    """``jax.sharding.AbstractMesh`` across the constructor change:
+    new jax takes ``(axis_sizes, axis_names)``, 0.4.x takes a single
+    ``((name, size), ...)`` shape tuple."""
+    from jax.sharding import AbstractMesh
+
+    params = inspect.signature(AbstractMesh.__init__).parameters
+    if "shape_tuple" in params:  # 0.4.x
+        return AbstractMesh(tuple(zip(axis_names, axis_sizes)))
+    return AbstractMesh(tuple(axis_sizes), tuple(axis_names))
+
+
+def abstract_mesh_can_lower() -> bool:
+    """Whether this jax can LOWER a program against an AbstractMesh.
+    0.4.x AbstractMesh (the ``shape_tuple`` constructor) has
+    ``_device_assignment`` unimplemented, so lowering raises — callers
+    (dryrun_multichip, test_llama70b) gate on this one predicate instead
+    of each re-inspecting the constructor."""
+    from jax.sharding import AbstractMesh
+
+    if not hasattr(AbstractMesh, "_device_assignment"):
+        return False
+    params = inspect.signature(AbstractMesh.__init__).parameters
+    return "shape_tuple" not in params

@@ -48,12 +48,50 @@ except Exception:  # pragma: no cover - CPU CI path (interpret mode)
 # _blocks/_compact — the decode/serving hot path calls these thousands of
 # times a second and per-helper registry round-trips were host overhead
 _FLASH_FLAGS = ("use_pallas", "flash_block_q", "flash_block_k",
-                "flash_compact_stats")
+                "flash_compact_stats", "flash_dispatch_table")
 
 
 def _flash_snapshot():
     from ..flags import snapshot
     return snapshot(_FLASH_FLAGS)
+
+
+def resolve_dispatch(seq_len: int, snap=None):
+    """Per-shape dispatch (FLAGS_flash_dispatch_table): resolve a query
+    length against the ';'-separated ``min_seqlen:entry`` buckets and
+    return ``(kind, blocks)`` — kind ``"flash"`` (blocks ``None`` = the
+    FLAGS_flash_block_{q,k} defaults, or an explicit ``(bq, bk)``
+    override) or ``"dense"`` (the benched-slower shapes: the r05 on-chip
+    A/B has flash LOSING to XLA dense at seq 2048, 0.86x, so that bucket
+    must fall back — a fused path that loses to the unfused one has no
+    reason to exist). A length resolves to the bucket with the largest
+    min_seqlen <= it; lengths below every bucket — and any malformed
+    entry — resolve to flash with the defaults, and an empty table
+    disables per-shape dispatch entirely."""
+    if snap is None:
+        snap = _flash_snapshot()
+    table = (snap.flash_dispatch_table or "").strip()
+    best_min, best = -1, None
+    for entry in table.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        min_s, _, kind = entry.partition(":")
+        try:
+            lo = int(min_s)
+        except ValueError:
+            continue
+        if lo <= seq_len and lo > best_min:
+            best_min, best = lo, kind.strip().lower()
+    if best in (None, "", "flash"):
+        return "flash", None
+    if best == "dense":
+        return "dense", None
+    bq, _, bk = best.partition("x")
+    try:
+        return "flash", (int(bq), int(bk))
+    except ValueError:
+        return "flash", None
 
 
 def _blocks(block_q, block_k, snap=None):
@@ -115,10 +153,13 @@ def _interpret() -> bool:
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct carrying ``like``'s varying-manual-axes: inside a
     check_vma=True shard_map (e.g. the ring-attention sep region) pallas
-    outputs must declare their vma explicitly."""
-    vma = jax.typeof(like).vma
-    if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    outputs must declare their vma explicitly. On jax versions without
+    ``jax.typeof``/vma tracking (< 0.6) there is nothing to declare."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is not None:
+        vma = getattr(typeof(like), "vma", ())
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
 
 

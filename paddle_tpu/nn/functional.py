@@ -756,18 +756,30 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  name=None):
     """SDPA with [batch, seq, heads, dim] layout (paddle convention —
     reference: python/paddle/nn/functional/flash_attention.py).
-    Dispatches to the Pallas flash-attention kernel on TPU when enabled."""
+    Dispatches to the Pallas flash-attention kernel on TPU when enabled,
+    through the per-shape FLAGS_flash_dispatch_table: benched-slower
+    shape buckets resolve to the XLA dense path, benched-faster ones may
+    carry their own block config."""
     from .. import flags
     if (flags.get_flag("use_pallas") and attn_mask is None and dropout_p == 0.0
             and flags.is_tpu_backend()
             and query.shape[1] >= flags.get_flag("flash_attn_min_seqlen")):
         try:
-            from ..kernels.flash_attention import flash_attention_bshd
-            return apply_op("flash_attention",
-                            lambda q, k, v: flash_attention_bshd(q, k, v, causal=is_causal),
-                            query, key, value)
-        except (ImportError, NotImplementedError):
-            pass
+            from ..kernels.flash_attention import (flash_attention_bshd,
+                                                   resolve_dispatch)
+            kind, blk = resolve_dispatch(query.shape[1])
+        except ImportError:
+            kind, blk = "dense", None
+        if kind == "flash":
+            bq, bk = blk if blk is not None else (None, None)
+            try:
+                return apply_op(
+                    "flash_attention",
+                    lambda q, k, v: flash_attention_bshd(
+                        q, k, v, causal=is_causal, block_q=bq, block_k=bk),
+                    query, key, value)
+            except NotImplementedError:
+                pass
 
     mask_val = _val(attn_mask) if attn_mask is not None else None
 

@@ -41,6 +41,13 @@ jax.config.update("jax_platforms", "cpu")
 # against numpy so force exact fp32.
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# NOTE: do NOT enable the persistent XLA compilation cache here. It would
+# halve warm-run wall clock, but this jaxlib (0.4.x CPU) happily caches
+# executables containing host callbacks (pallas interpret mode,
+# pure_callback) and SEGFAULTS deserializing them on the next run —
+# taking the whole pytest process down mid-suite. Revisit when the
+# toolchain moves to a jax that refuses to cache callback programs.
+
 
 @pytest.fixture(autouse=True)
 def _seed_all():

@@ -167,6 +167,12 @@ class TestZBH1WithDP:
     def test_pp_dp_zero1_matches_serial(self):
         """zbh1 + ZeRO-1: optimizer slots dp-sharded, update outside the
         manual region — numerics unchanged vs serial."""
+        if not hasattr(jax, "typeof"):
+            # jax<0.6 (check_rep shard_map, no vma tracking) miscompiles
+            # the zero1 gather/update region: NaN after 2 steps or an
+            # XLA segfault (which would take the whole pytest process
+            # down). Every other zbh1 config is parity-green on old jax.
+            pytest.skip("zbh1+zero1 unstable on jax<0.6 (NaN/segfault)")
         from jax.sharding import Mesh
 
         cfg = LlamaConfig(vocab_size=64, hidden_size=32,

@@ -25,7 +25,7 @@ def main():
 
     import numpy as np
     import jax.numpy as jnp
-    from jax.sharding import AbstractMesh
+    from paddle_tpu.jax_compat import abstract_mesh
 
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLMPipe
@@ -38,7 +38,7 @@ def main():
     with paddle.LazyGuard():
         pipe = LlamaForCausalLMPipe(cfg, num_stages=pp, tensor_parallel=True)
     n_params = sum(int(np.prod(p.shape)) for p in pipe.parameters())
-    mesh = AbstractMesh((dp, pp, mp), ("dp", "pp", "mp"))
+    mesh = abstract_mesh((dp, pp, mp), ("dp", "pp", "mp"))
     opt = AdamW(1e-4, parameters=pipe.parameters(), weight_decay=0.1,
                 multi_precision=True)
     step = PipelineTrainStep(pipe, opt, mesh, num_microbatches=M,

@@ -45,23 +45,9 @@ def base_env() -> dict:
     return bench_mod.cache_env(env)
 
 
-def relay_listening(timeout: float = 3.0) -> bool:
-    """Cheap pre-check (TUNNEL_DIAGNOSIS.md): under the loopback relay
-    (``AXON_LOOPBACK_RELAY=1``), ``jax.devices()`` goes via the relay's
-    :8083 stateless endpoint. Connection refused means no relay process
-    exists — the 150 s PJRT probe would only hang in the claim loop, so
-    skip it and poll again soon. Environments NOT behind the relay (or
-    with a non-default port — set ``AXON_RELAY_PORT``) always fall
-    through to the real probe."""
-    if os.environ.get("AXON_LOOPBACK_RELAY") != "1":
-        return True   # no relay in the path; only the PJRT probe can tell
-    port = int(os.environ.get("AXON_RELAY_PORT", "8083"))
-    import socket
-    try:
-        with socket.create_connection(("127.0.0.1", port), timeout=timeout):
-            return True
-    except OSError:
-        return False
+# single source: bench.py owns the socket pre-check (its own probe loop
+# now runs it too), the watcher just aliases it
+relay_listening = bench_mod.relay_listening
 
 
 def probe() -> str:
