@@ -1,0 +1,7 @@
+"""Static-analysis tooling that ships with the framework.
+
+The reference stack pairs its kernels with correctness tooling
+(FLAGS_check_nan_inf sanitizer layers, op-level debugging hooks); this
+package holds the *static* half: analyzers that catch trace-discipline
+bugs at lint time instead of on-chip.  See :mod:`.tracecheck`.
+"""

@@ -1,0 +1,38 @@
+"""tracecheck — a JAX trace-discipline static analyzer.
+
+The bug classes that actually bit this repo are not numeric — they are
+*trace-discipline* bugs that runtime sanitizers see only after the fact:
+
+- per-call registry flag reads baked into traced programs (the class
+  ``flags.snapshot()`` fixed by hand in r06),
+- host syncs silently defeating the async ``Model.fit`` / serving loops,
+- donated-buffer reuse around ``jax.jit(..., donate_argnums=...)``,
+- fresh-closure jit admissions retracing per call (the class
+  ``generation/program_cache.py`` exists to prevent),
+- wall-clock / stdlib RNG evaluated once at trace time,
+- Python control flow on tensor values inside jitted code.
+
+``tracecheck`` parses the package (AST only — nothing is imported or
+executed), builds a traced-reachability call graph over functions handed
+to ``jax.jit`` / ``pl.pallas_call`` / ``jax.checkpoint`` / ``shard_map``
+/ ``lax`` control flow and the repo's own wrappers (``apply_op``, the
+decode program cache, ``TrainStep``), and applies the TRC rules to code
+reachable under trace.  Findings support inline
+``# tracecheck: disable=TRC00x`` pragmas and a checked-in baseline so
+legacy findings never block; the tier-1 test gates NEW findings only.
+
+Run it locally::
+
+    python tools/tracecheck.py paddle_tpu
+    python tools/tracecheck.py paddle_tpu --json
+    python tools/tracecheck.py paddle_tpu --update-baseline
+"""
+
+from .findings import (Finding, RULES, fingerprint, load_baseline,
+                       subtract_baseline, write_baseline)
+from .analyzer import AnalyzerConfig, analyze_package
+
+__all__ = [
+    "AnalyzerConfig", "Finding", "RULES", "analyze_package", "fingerprint",
+    "load_baseline", "subtract_baseline", "write_baseline",
+]

@@ -1,0 +1,155 @@
+"""Orchestration: parse a package, build the graph, run the rules.
+
+``analyze_package`` is the single entry point the CLI and the tier-1
+test share.  Pure AST — the analyzed package is never imported, so the
+analyzer runs in milliseconds-per-file on CPU with no jax involved.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .callgraph import (CallGraph, FunctionInfo, ModuleInfo, index_module,
+                        mark_roots_from_wrapper_calls)
+from .donors import ModuleDonors
+from .findings import Finding, hotpath_lines, parse_pragmas, suppressed
+from . import rules as R
+
+
+@dataclass
+class AnalyzerConfig:
+    """Tuning knobs.  ``traced_module_patterns``: relpath substrings whose
+    module-level functions are treated as trace roots even without an
+    explicit jit wrapper in view — the op/kernel libraries whose contract
+    is "callable under jit" (model forwards reach them through dynamic
+    dispatch no static analyzer can follow)."""
+    traced_module_patterns: Tuple[str, ...] = (
+        "/kernels/", "/nn/functional", "/ops/", "/incubate/nn/",
+    )
+    exclude_patterns: Tuple[str, ...] = ()
+    rules: Tuple[str, ...] = ("TRC001", "TRC002", "TRC003", "TRC004",
+                              "TRC005", "TRC006")
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding]                  # post-pragma, pre-baseline
+    suppressed: List[Finding]                # pragma-silenced
+    n_files: int = 0
+    n_functions: int = 0
+    n_traced: int = 0
+    errors: List[str] = field(default_factory=list)
+
+
+def _iter_py_files(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def analyze_package(package_path: str,
+                    config: Optional[AnalyzerConfig] = None
+                    ) -> AnalysisResult:
+    """Analyze every ``.py`` under ``package_path`` (a package directory
+    or a single file).  Paths in findings are relative to the package's
+    parent, posix-style ('paddle_tpu/nn/functional.py')."""
+    config = config or AnalyzerConfig()
+    package_path = os.path.abspath(package_path)
+    if os.path.isfile(package_path):
+        parent = os.path.dirname(os.path.dirname(package_path))
+        files = [package_path]
+        package = os.path.basename(os.path.dirname(package_path))
+    else:
+        parent = os.path.dirname(package_path)
+        files = list(_iter_py_files(package_path))
+        package = os.path.basename(package_path)
+
+    result = AnalysisResult(findings=[], suppressed=[])
+    modules: Dict[str, ModuleInfo] = {}
+    for path in files:
+        rel = os.path.relpath(path, parent).replace(os.sep, "/")
+        if any(p in rel for p in config.exclude_patterns):
+            continue
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            mod = index_module(rel, source, package)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            result.errors.append(f"{rel}: {e}")
+            continue
+        modules[_modpath(rel)] = mod
+        result.n_files += 1
+
+    graph = CallGraph(modules, package)
+
+    # roots: wrapper calls + decorators (set during indexing) + traced
+    # module patterns + hotpath markers
+    for mod in modules.values():
+        mark_roots_from_wrapper_calls(mod)
+        hot = hotpath_lines(mod.source_lines)
+        in_traced_module = any(p in "/" + mod.relpath
+                               for p in config.traced_module_patterns)
+        for fi in mod.functions.values():
+            if not fi.qualname:
+                continue
+            if in_traced_module and fi.parent is None and \
+                    not isinstance(fi.node, ast.Lambda):
+                fi.trace_root = True
+            if fi.lineno in hot:
+                fi.hotpath = True
+    graph.propagate_traced()
+
+    donors_by_mod = {mp: ModuleDonors(mod) for mp, mod in modules.items()}
+
+    findings: List[Finding] = []
+    for mp, mod in modules.items():
+        donors = donors_by_mod[mp]
+
+        def donor_resolver(fi: FunctionInfo, call):
+            return donors.donated_positions(fi, call)
+
+        pragmas = parse_pragmas(mod.source_lines)
+        for fi in mod.functions.values():
+            result.n_functions += 1
+            if fi.traced:
+                result.n_traced += 1
+            batch: List[Finding] = []
+            if "TRC001" in config.rules:
+                batch += R.trc001_flag_read_under_trace(fi, graph)
+            if "TRC002" in config.rules:
+                batch += R.trc002_host_sync(fi, graph)
+            if "TRC003" in config.rules:
+                batch += R.trc003_donated_use(fi, graph, donor_resolver)
+            if "TRC004" in config.rules:
+                batch += R.trc004_unstable_jit(fi, graph)
+            if "TRC005" in config.rules:
+                batch += R.trc005_impure_time_rng(fi, graph)
+            if "TRC006" in config.rules:
+                batch += R.trc006_tensor_control_flow(fi, graph)
+            for f in batch:
+                (result.suppressed if suppressed(f, pragmas)
+                 else findings).append(f)
+
+    # de-dup (a call site can be visited via overlapping scans) + order
+    seen = set()
+    uniq: List[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule,
+                                             f.func)):
+        key = (f.rule, f.path, f.line, f.func, f.message)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(f)
+    result.findings = uniq
+    return result
+
+
+def _modpath(rel: str) -> str:
+    p = rel[:-3]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")

@@ -1,0 +1,224 @@
+"""Donor analysis: which call sites dispatch through
+``jax.jit(..., donate_argnums=...)`` and at which positions.
+
+Module-scoped fixpoint (the repo keeps builders and their call sites in
+one module — serving.py, train_step.py, pipeline_parallel.py,
+incubate/nn/functional.py).  Donor-ness propagates through:
+
+- ``x = jax.jit(f, donate_argnums=(..))``          (local / module name)
+- ``self.x = jax.jit(...)``                        (class attribute)
+- ``return jax.jit(...)``                          (returns-donor fn)
+- ``functools.partial(F, ...)`` of a returns-donor F (calling the
+  partial yields the donor)
+- ``cache.get(key, builder)`` where the builder (name or partial) is
+  returns-donor — the decode-program-cache admission idiom: ``get``
+  returns the compiled step the builder built.
+
+Positions are "may donate": ``donate_argnums=(0, 1) if donate else ()``
+contributes {0, 1}.  A donated position that cannot be proven constant
+is dropped (under-reporting beats false alarms in a tier-1 gate).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Tuple
+
+from .callgraph import FunctionInfo, ModuleInfo, callee_name, _dotted
+
+
+def _const_positions(node: ast.AST) -> Tuple[int, ...]:
+    """Every integer constant anywhere in the expression — handles
+    ``(3,)``, ``(0, 1) if donate else ()`` and plain ``0``."""
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, int) \
+                and not isinstance(sub.value, bool):
+            out.append(sub.value)
+    return tuple(sorted(set(out)))
+
+
+class ModuleDonors:
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        # (owner-func qualname or '', local name) -> positions
+        self.named: Dict[Tuple[str, str], Tuple[int, ...]] = {}
+        # attribute chain ('self._jit_step') per class -> positions
+        self.attrs: Dict[Tuple[str, str], Tuple[int, ...]] = {}
+        self._compute()
+
+    # -------------------------------------------------------- donor exprs
+    def _jit_donate_positions(self, node: ast.AST,
+                              owner: FunctionInfo) -> Optional[Tuple[int, ...]]:
+        """Positions if ``node`` evaluates to a donating jitted callable."""
+        if not isinstance(node, ast.Call):
+            return None
+        name = callee_name(node)
+        if name is not None:
+            tail = name.rsplit(".", 1)[-1]
+        elif isinstance(node.func, ast.Attribute):
+            # Call-rooted chain, e.g. decode_program_cache().get(...)
+            tail = node.func.attr
+        else:
+            return None
+        if tail in ("jit", "jit_fn"):
+            for kw in node.keywords:
+                if kw.arg == "donate_argnums":
+                    pos = self._positions_of_value(kw.value, owner)
+                    return pos or None
+            return None
+        # cache.get(key, builder) — admission wrapper returning the
+        # builder's compiled step
+        if tail == "get" and len(node.args) >= 2:
+            rd = self._returns_donor_of(node.args[1], owner)
+            if rd:
+                return rd
+            return None
+        # call of a returns-donor function: fn = self._prefill_program()
+        rd = self._callable_returns_donor(node.func, owner)
+        return rd
+
+    def _positions_of_value(self, value: ast.AST,
+                            owner: FunctionInfo) -> Tuple[int, ...]:
+        pos = _const_positions(value)
+        if pos:
+            return pos
+        # donate_argnums bound to a local name earlier in the function
+        if isinstance(value, ast.Name) and owner is not None and \
+                not isinstance(owner.node, (ast.Module, ast.Lambda)):
+            for stmt in ast.walk(owner.node):
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name) and t.id == value.id:
+                            pos = _const_positions(stmt.value)
+                            if pos:
+                                return pos
+        return ()
+
+    def _callable_returns_donor(self, func: ast.AST,
+                                owner: Optional[FunctionInfo]
+                                ) -> Optional[Tuple[int, ...]]:
+        """Does CALLING this expression yield a donor?  (the expression
+        names a returns-donor function/method)"""
+        chain = _dotted(func)
+        if chain is None:
+            return None
+        parts = chain.split(".")
+        if parts[0] in ("self", "cls") and len(parts) == 2 and owner and \
+                owner.cls:
+            m = self.mod.functions.get(f"{owner.cls}.{parts[1]}")
+            if m is not None and m.returns_donor:
+                return m.returns_donor
+            return None
+        if len(parts) == 1:
+            f = self._lookup_function(parts[0], owner)
+            if f is not None and f.returns_donor:
+                return f.returns_donor
+        return None
+
+    def _returns_donor_of(self, node: ast.AST,
+                          owner: Optional[FunctionInfo]
+                          ) -> Optional[Tuple[int, ...]]:
+        """Value that, when called, returns a donor: a returns-donor
+        function name, or functools.partial of one."""
+        if isinstance(node, ast.Name):
+            f = self._lookup_function(node.id, owner)
+            if f is not None and f.returns_donor:
+                return f.returns_donor
+            # a local bound to a partial/builder earlier in the function:
+            #   builder = functools.partial(_build_x, ...); cache.get(k, builder)
+            if owner is not None and not isinstance(
+                    owner.node, (ast.Module, ast.Lambda)):
+                hit = None
+                for stmt in ast.walk(owner.node):
+                    if isinstance(stmt, ast.Assign) and any(
+                            isinstance(t, ast.Name) and t.id == node.id
+                            for t in stmt.targets):
+                        rd = (None if stmt.value is node else
+                              self._returns_donor_of(stmt.value, owner))
+                        hit = rd if rd else hit
+                return hit
+            return None
+        if isinstance(node, ast.Call):
+            name = callee_name(node)
+            if name and name.rsplit(".", 1)[-1] == "partial" and node.args:
+                return self._returns_donor_of(node.args[0], owner)
+        return None
+
+    def _lookup_function(self, name: str, owner: Optional[FunctionInfo]
+                         ) -> Optional[FunctionInfo]:
+        scope = owner
+        while scope is not None:
+            hit = self.mod.functions.get(scope.qualname + "." + name)
+            if hit is not None:
+                return hit
+            scope = scope.parent
+        return self.mod.functions.get(name)
+
+    # ------------------------------------------------------------ fixpoint
+    def _compute(self) -> None:
+        for _ in range(4):                      # donor chains are short
+            changed = False
+            for fi in list(self.mod.functions.values()):
+                if isinstance(fi.node, (ast.Module, ast.Lambda)):
+                    continue
+                for stmt in ast.walk(fi.node):
+                    if isinstance(stmt, ast.Assign):
+                        pos = self._jit_donate_positions(stmt.value, fi)
+                        if not pos:
+                            continue
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name):
+                                key = (fi.qualname, t.id)
+                                if self.named.get(key) != pos:
+                                    self.named[key] = pos
+                                    changed = True
+                            else:
+                                chain = _dotted(t)
+                                if chain and chain.startswith("self.") \
+                                        and fi.cls:
+                                    key = (fi.cls, chain)
+                                    if self.attrs.get(key) != pos:
+                                        self.attrs[key] = pos
+                                        changed = True
+                    elif isinstance(stmt, ast.Return) and \
+                            stmt.value is not None:
+                        pos = self._jit_donate_positions(stmt.value, fi)
+                        if pos is None:
+                            # `return self._prefill_fn` where the attr
+                            # was assigned a donor in this class
+                            chain = _dotted(stmt.value)
+                            if chain and fi.cls:
+                                pos = self.attrs.get((fi.cls, chain))
+                        if pos and fi.returns_donor != pos:
+                            fi.returns_donor = pos
+                            changed = True
+            if not changed:
+                break
+        # module-level assignments (rare): STEP = jax.jit(...)
+        for stmt in self.mod.tree.body:
+            if isinstance(stmt, ast.Assign):
+                pos = self._jit_donate_positions(stmt.value, None)
+                if pos:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            self.named[("", t.id)] = pos
+
+    # ------------------------------------------------------------ resolver
+    def donated_positions(self, fi: FunctionInfo, call: ast.Call
+                          ) -> Optional[Tuple[int, ...]]:
+        chain = _dotted(call.func)
+        if chain is None:
+            return None
+        parts = chain.split(".")
+        if len(parts) == 1:
+            scope = fi
+            while scope is not None:
+                pos = self.named.get((scope.qualname, parts[0]))
+                if pos:
+                    return pos
+                scope = scope.parent
+            return self.named.get(("", parts[0]))
+        if parts[0] in ("self", "cls") and fi.cls:
+            return self.attrs.get((fi.cls, chain))
+        return None

@@ -1,0 +1,153 @@
+"""Finding model, pragma suppression, and the checked-in baseline.
+
+A finding's *fingerprint* deliberately excludes the line number — it is
+``rule:path:function:stripped-source-text`` — so reformatting or adding
+code above a legacy finding does not churn the baseline.  The baseline
+is a multiset of fingerprints (equal lines in one function count), kept
+as a sorted JSON list so ``--update-baseline`` round-trips byte-stable.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from collections import Counter
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Tuple
+
+# ---------------------------------------------------------------- rules
+RULES: Dict[str, str] = {
+    "TRC001": "registry flag read under trace — resolve a flags.snapshot() "
+              "at the trace boundary and thread it through (per-call "
+              "get_flag values are baked in at trace time and bypass the "
+              "program-cache flag key)",
+    "TRC002": "host synchronization on a traced/async value in a traced "
+              "function or declared hot path (float()/.item()/.numpy()/"
+              "np.asarray()/block_until_ready() stalls the dispatch "
+              "pipeline or fails under trace)",
+    "TRC003": "donated-buffer discipline around jax.jit(donate_argnums=...) "
+              "— a donated argument may not be read after dispatch, and a "
+              "donated view of live object state must be detached first "
+              "(take_*/donate_* ownership handoff)",
+    "TRC004": "unstable jit admission — jax.jit of a fresh closure/lambda "
+              "or inside a loop retraces per call; hoist it or key it "
+              "through a program cache",
+    "TRC005": "wall-clock or stdlib/numpy RNG under trace — evaluated once "
+              "at trace time and baked into the program; use traced "
+              "jax.random keys / pass times in as arguments",
+    "TRC006": "Python if/while on a tensor-valued expression in traced "
+              "code — raises TracerBoolConversionError or silently "
+              "specializes; use lax.cond/jnp.where (guard eager-only "
+              "branches with isinstance(x, Tracer))",
+}
+
+_PRAGMA_RE = re.compile(
+    r"#\s*tracecheck:\s*(disable|hotpath)(?:=([A-Za-z0-9_,\s]+))?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str                 # TRC00x
+    path: str                 # repo-relative posix path
+    line: int                 # 1-based
+    func: str                 # module-relative qualname ('' = module scope)
+    message: str
+    source: str = ""          # stripped source of the offending line
+
+    def format(self) -> str:
+        where = f" [{self.func}]" if self.func else ""
+        return f"{self.path}:{self.line}: {self.rule}{where}: {self.message}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "func": self.func, "message": self.message,
+                "source": self.source, "fingerprint": fingerprint(self)}
+
+
+def fingerprint(f: Finding) -> str:
+    return f"{f.rule}:{f.path}:{f.func}:{f.source}"
+
+
+# -------------------------------------------------------------- pragmas
+def parse_pragmas(source_lines: List[str]) -> Dict[int, set]:
+    """Line -> set of disabled rule codes (empty set = all rules).
+    A pragma applies to its own line and, when the line holds nothing
+    else (a standalone comment), to the following line."""
+    out: Dict[int, set] = {}
+
+    def add(line: int, codes: set) -> None:
+        cur = out.get(line)
+        if cur is None:
+            out[line] = set(codes)
+        elif not cur or not codes:
+            out[line] = set()       # blanket disable absorbs everything
+        else:
+            cur.update(codes)
+
+    for i, text in enumerate(source_lines, start=1):
+        m = _PRAGMA_RE.search(text)
+        if not m or m.group(1) != "disable":
+            continue
+        codes = (set(c.strip().upper() for c in m.group(2).split(",")
+                     if c.strip()) if m.group(2) else set())
+        add(i, codes)
+        if text.strip().startswith("#"):
+            add(i + 1, codes)
+    return out
+
+
+def hotpath_lines(source_lines: List[str]) -> set:
+    """Lines carrying a ``# tracecheck: hotpath`` marker (the marker on a
+    ``def`` line — or the standalone comment line right above it —
+    declares that function a latency hot path for TRC002)."""
+    marked = set()
+    for i, text in enumerate(source_lines, start=1):
+        m = _PRAGMA_RE.search(text)
+        if m and m.group(1) == "hotpath":
+            marked.add(i)
+            if text.strip().startswith("#"):
+                marked.add(i + 1)
+    return marked
+
+
+def suppressed(f: Finding, pragmas: Dict[int, set]) -> bool:
+    codes = pragmas.get(f.line)
+    if codes is None:
+        return False
+    return not codes or f.rule in codes
+
+
+# ------------------------------------------------------------- baseline
+def load_baseline(path) -> Counter:
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return Counter()
+    if isinstance(data, dict):           # {"findings": [...]} envelope
+        data = data.get("findings", [])
+    return Counter(str(e) for e in data)
+
+
+def write_baseline(path, findings: Iterable[Finding]) -> List[str]:
+    entries = sorted(fingerprint(f) for f in findings)
+    with open(path, "w") as fh:
+        json.dump(entries, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return entries
+
+
+def subtract_baseline(findings: List[Finding], baseline: Counter
+                      ) -> Tuple[List[Finding], Counter]:
+    """Split into (new findings, unmatched-baseline leftovers). Multiset
+    semantics: N baselined copies of one fingerprint absorb N findings."""
+    budget = Counter(baseline)
+    new: List[Finding] = []
+    for f in findings:
+        fp = fingerprint(f)
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+        else:
+            new.append(f)
+    leftovers = Counter({k: v for k, v in budget.items() if v > 0})
+    return new, leftovers

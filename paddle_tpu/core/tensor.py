@@ -438,6 +438,10 @@ def apply_op(name: str, fn: Callable, *args, **kwargs) -> Any:
     values = _maybe_amp_cast(name, values)
     out, node = autograd.record_op(name, fn, tensor_args, values, kwargs)
 
+    # deliberate per-op registry read: check_nan_inf is a runtime-
+    # toggleable debug switch (set_flags mid-run must take effect on the
+    # next eager op) and the check itself skips tracers, so no value is
+    # ever baked into a compiled program  # tracecheck: disable=TRC001
     if flags.get_flag("check_nan_inf"):
         _check_nan_inf(name, out)
 
@@ -510,6 +514,8 @@ def _check_nan_inf(op_name: str, out) -> None:
         if not _np.isfinite(arr).all():
             from .. import flags as _flags
             msg = f"Operator {op_name!r} output contains NaN or Inf."
+            # error-path only, tracers already filtered above
+            # tracecheck: disable=TRC001
             if _flags.get_flag("check_nan_inf_level") == 0:
                 raise FloatingPointError(msg)
             print("WARNING:", msg)

@@ -50,7 +50,8 @@ def _chunk_attn(q, k, v, causal, sm_scale, h, hkv):
     Pallas flash kernel (O(block) temps, unexpanded GQA kv) whenever the
     chunk shapes fit its tiling on the current backend; falls back to a
     dense-with-lse computation otherwise (small test chunks)."""
-    from ....flags import get_flag, is_tpu_backend
+    from ....flags import is_tpu_backend, snapshot
+    snap = snapshot(("use_pallas",))
     b, cq, _, d = q.shape
     ck = k.shape[1]
     if is_tpu_backend():
@@ -61,7 +62,7 @@ def _chunk_attn(q, k, v, causal, sm_scale, h, hkv):
         # shard_map (jax hlo_interpreter limitation) — only use it when
         # the values carry no vma (sep-only meshes run check_vma=False)
         ok = not jax.typeof(q).vma
-    if get_flag("use_pallas") and ok:
+    if snap.use_pallas and ok:
         from ....kernels.flash_attention import flash_attention_with_lse
         try:
             qf = jnp.swapaxes(q, 1, 2).reshape(b * h, cq, d)

@@ -300,14 +300,17 @@ class ServingEngine:
         return self._decode_fn
 
     # ----------------------------------------------------------- internals
-    def _pools(self):
-        return [(self.pool.k_pages[i], self.pool.v_pages[i])
-                for i in range(len(self.pool.k_pages))]
+    # Donation discipline (tracecheck TRC003): the compiled programs
+    # donate their pools argument, so the dispatch sites pass
+    # ``self.pool.take_pools()`` — the cache's references are detached
+    # BEFORE the buffers are invalidated by donation, and ``_store``
+    # installs the step's returned pools.  A dispatch that raises leaves
+    # the pool explicitly empty (take_pools refuses a second detach)
+    # rather than silently aliasing deleted device buffers.
 
     def _store(self, states) -> None:
-        for i, st in enumerate(states):
-            self.pool.k_pages[i] = _val(st.k_pages)
-            self.pool.v_pages[i] = _val(st.v_pages)
+        self.pool.install_pools(
+            [(_val(st.k_pages), _val(st.v_pages)) for st in states])
 
     def _admit_shared(self, req: Request, slot: int, pages: List[int],
                       n_cached: int) -> None:
@@ -362,7 +365,8 @@ class ServingEngine:
         self.pool.allocate(slot, p + req.max_new_tokens)
         bt = jnp.asarray(self.pool.block_tables[slot:slot + 1])
         tok, states = fn(self._params, self._buffers,
-                         jnp.asarray(req.prompt[None]), self._pools(),
+                         jnp.asarray(req.prompt[None]),
+                         self.pool.take_pools(),
                          bt, jnp.zeros((1,), jnp.int32))
         # b=1 prefill wrote THROUGH slot's block table into the shared
         # pool arrays; adopt them and the slot's bookkeeping
@@ -391,7 +395,7 @@ class ServingEngine:
             self._results[req.rid] = req.tokens
             req.slot = None
 
-    def step(self) -> None:
+    def step(self) -> None:  # tracecheck: hotpath
         # admission: fill every free slot that has pages available
         for slot in range(self.max_batch):
             if self._slots[slot] is None and self._queue:
@@ -415,8 +419,11 @@ class ServingEngine:
         sl = jnp.asarray(self.pool.seq_lens[:self.max_batch])
         toks, states = fn(
             self._params, self._buffers,
-            jnp.asarray(self._last_tok[:, None]), self._pools(), bt, sl)
+            jnp.asarray(self._last_tok[:, None]),
+            self.pool.take_pools(), bt, sl)
         self._store(states)
+        # the scheduler's designed sync point: admission/eviction need
+        # the concrete token ids  # tracecheck: disable=TRC002
         toks = np.asarray(toks)
 
         for slot, req in enumerate(self._slots):

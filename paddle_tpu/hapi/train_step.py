@@ -446,7 +446,7 @@ class TrainStep:
             step, donate_argnums=(0, 1, 2) if donate else ())
         self._step_count = 0
 
-    def stage(self, *batch) -> StagedBatch:
+    def stage(self, *batch) -> StagedBatch:  # tracecheck: hotpath
         """Convert + place a batch on device (async dispatch, never
         blocks). ``__call__`` accepts the result directly, so a prefetching
         loader can stage batch N+1 while the device runs step N."""
@@ -474,7 +474,7 @@ class TrainStep:
                 else jax.device_put(leaf), v) for v in vals)
         return StagedBatch(vals)
 
-    def __call__(self, *batch) -> Tensor:
+    def __call__(self, *batch) -> Tensor:  # tracecheck: hotpath
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         if len(batch) == 1 and isinstance(batch[0], StagedBatch):
             vals = batch[0].vals
@@ -510,6 +510,8 @@ class TrainStep:
             ready = getattr(old, "is_ready", None)
             if ready is not None and ready():
                 continue
+            # deliberate bounded sync — the documented HBM safety net
+            # tracecheck: disable=TRC002
             np.asarray(old)
             self.throttle_count += 1
         return Tensor(loss, stop_gradient=True)

@@ -23,7 +23,7 @@ def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
     """reference: paddle/phi/kernels/fusion/gpu rms_norm fused op. On TPU the
     residual-add + rms_norm composition is one XLA fusion; a Pallas variant
     exists for the long-row case (paddle_tpu/kernels/rms_norm.py)."""
-    if flags.get_flag("use_pallas") and flags.is_tpu_backend():
+    if flags.snapshot(("use_pallas",)).use_pallas and flags.is_tpu_backend():
         try:
             from ...kernels.rms_norm import rms_norm_pallas
             h = x

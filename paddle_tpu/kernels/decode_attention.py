@@ -66,8 +66,8 @@ def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     VERDICT r2 weak #2); falls back to the einsum path off-TPU or for
     unsupported shapes."""
     if q.shape[1] > 1:
-        from ..flags import get_flag, is_tpu_backend
-        if get_flag("use_pallas") and is_tpu_backend():
+        from ..flags import is_tpu_backend, snapshot
+        if snapshot(("use_pallas",)).use_pallas and is_tpu_backend():
             try:
                 return _prefill_diff(q, k_cache, v_cache,
                                      jnp.asarray(cur_len, jnp.int32),

@@ -734,15 +734,19 @@ def flash_attention(q, k, v, segment_ids: Optional[jax.Array] = None,
                     causal: bool = True, sm_scale: Optional[float] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
-                    n_heads: int = 1, n_kv_heads: Optional[int] = None):
+                    n_heads: int = 1, n_kv_heads: Optional[int] = None,
+                    snap=None):
     """(BH, S, D)-layout flash attention. segment_ids: (BH, S) int32 — rows
     attend only within their segment (varlen batches packed statically).
     GQA: pass q as (B*n_heads, S, D) and k/v as (B*n_kv_heads, Skv, D) —
     the kernels read the UNEXPANDED kv via index maps (Hkv bandwidth) and
-    accumulate dk/dv over each group's query heads."""
+    accumulate dk/dv over each group's query heads.  ``snap``: the
+    caller's trace-boundary flags snapshot (must cover _FLASH_FLAGS);
+    resolved here only when the caller didn't already."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    snap = _flash_snapshot()
+    if snap is None:
+        snap = _flash_snapshot()
     block_q, block_k = _blocks(block_q, block_k, snap)
     if n_kv_heads is None:
         n_kv_heads = n_heads
@@ -771,7 +775,8 @@ def flash_attention_bshd(q, k, v, segment_ids=None, kv_segment_ids=None,
                          causal: bool = True,
                          sm_scale: Optional[float] = None,
                          block_q: Optional[int] = None,
-                         block_k: Optional[int] = None):
+                         block_k: Optional[int] = None,
+                         snap=None):
     """Paddle-convention (B, S, H, D) wrapper (reference:
     python/paddle/nn/functional/flash_attention.py uses [batch, seq, heads,
     dim]). ``segment_ids``: (B, S_q); ``kv_segment_ids``: (B, S_kv),
@@ -796,5 +801,6 @@ def flash_attention_bshd(q, k, v, segment_ids=None, kv_segment_ids=None,
         seg_q = jnp.repeat(segment_ids, h, axis=0)
         seg_kv = jnp.repeat(kv_segment_ids, hkv, axis=0)
     out = flash_attention(qf, kf, vf, seg_q, seg_kv, causal, sm_scale,
-                          block_q, block_k, n_heads=h, n_kv_heads=hkv)
+                          block_q, block_k, n_heads=h, n_kv_heads=hkv,
+                          snap=snap)
     return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)

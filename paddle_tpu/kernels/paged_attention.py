@@ -363,12 +363,43 @@ class PagedKVCache:
     def advance(self, seq_ids) -> None:
         self.seq_lens[seq_ids] += 1
 
+    # ------------------------------------------------- donation handoff
+    def take_pools(self) -> List[Tuple[jax.Array, jax.Array]]:
+        """Detach and return the per-layer ``(k, v)`` pool pairs for a
+        donating dispatch (``jax.jit(..., donate_argnums=...)``): the
+        cache's own references are cleared, so nothing can read the
+        donated — hence invalidated — buffers through this object while
+        the step is in flight.  The dispatcher MUST hand the step's
+        returned pools back via :meth:`install_pools`; until then the
+        cache is deliberately unusable (a failed dispatch leaves it
+        empty and loudly broken instead of silently aliasing dead
+        buffers).  tracecheck rule TRC003 recognizes the ``take_*``
+        naming as the sanctioned ownership-transfer idiom."""
+        if self.k_pages[0] is None:
+            raise RuntimeError(
+                "take_pools: pools already detached (a donating dispatch "
+                "is in flight or failed without install_pools)")
+        pairs = [(self.k_pages[i], self.v_pages[i])
+                 for i in range(len(self.k_pages))]
+        for i in range(len(self.k_pages)):
+            self.k_pages[i] = None
+            self.v_pages[i] = None
+        return pairs
+
+    def install_pools(self, pairs) -> None:
+        """Install the pool pairs a donating step returned (the other
+        half of :meth:`take_pools`)."""
+        for i, (k, v) in enumerate(pairs):
+            self.k_pages[i] = k
+            self.v_pages[i] = v
+
     # ---------------------------------------------------------- attention
     def attend(self, layer: int, q, seq_ids) -> jax.Array:
         """Decode attention of q (B, H, D) for ``seq_ids`` against this
         layer's pool (lengths INCLUDE any token just appended)."""
-        from ..flags import get_flag
+        from ..flags import snapshot
+        snap = snapshot(("use_pallas",))
         bt = jnp.asarray(self.block_tables[seq_ids])
         sl = jnp.asarray(self.seq_lens[seq_ids] + 1)
-        fn = paged_attention if get_flag("use_pallas") else paged_attention_xla
+        fn = paged_attention if snap.use_pallas else paged_attention_xla
         return fn(q, self.k_pages[layer], self.v_pages[layer], bt, sl)

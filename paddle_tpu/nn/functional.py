@@ -163,7 +163,10 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     from .. import flags
 
     idx = _val(x)
-    mode = flags.get_flag("embedding_matmul_grad")
+    # one snapshot at the trace boundary (tracecheck TRC001): a bare
+    # get_flag here would bake per-trace and bypass program-cache keys
+    snap = flags.snapshot(("embedding_matmul_grad",))
+    mode = snap.embedding_matmul_grad
     if mode not in ("auto", "on", "off"):
         raise ValueError(
             f"FLAGS_embedding_matmul_grad must be 'auto', 'on' or 'off', "
@@ -656,7 +659,8 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         same_pack = np.array_equal(np.asarray(cu_q), np.asarray(cu_k))
     except Exception:   # traced inside jit: assume the dominant layout
         same_pack = True
-    kernel_ok = (flags.get_flag("use_pallas") and flags.is_tpu_backend()
+    snap = flags.snapshot(("use_pallas",))
+    kernel_ok = (snap.use_pallas and flags.is_tpu_backend()
                  and (same_pack or not causal))
 
     def fn(qv, kv, vv, cq, ck):
@@ -720,7 +724,8 @@ def paged_scaled_dot_product_attention(query, key, value, state):
                                            write_paged_kv,
                                            write_paged_prompt)
 
-    use_pallas = flags.get_flag("use_pallas") and flags.is_tpu_backend()
+    use_pallas = (flags.snapshot(("use_pallas",)).use_pallas
+                  and flags.is_tpu_backend())
 
     def fn(qv, kv, vv, kp, vp, bt, sl):
         s = qv.shape[1]
@@ -761,13 +766,20 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     shape buckets resolve to the XLA dense path, benched-faster ones may
     carry their own block config."""
     from .. import flags
-    if (flags.get_flag("use_pallas") and attn_mask is None and dropout_p == 0.0
+    # one snapshot covering the whole flash-dispatch decision (kernel
+    # on/off, the min-seqlen gate, the per-shape table and its block
+    # overrides) — resolved once per trace and threaded through
+    # resolve_dispatch, never re-read per helper (tracecheck TRC001)
+    snap = flags.snapshot(("use_pallas", "flash_attn_min_seqlen",
+                           "flash_block_q", "flash_block_k",
+                           "flash_compact_stats", "flash_dispatch_table"))
+    if (snap.use_pallas and attn_mask is None and dropout_p == 0.0
             and flags.is_tpu_backend()
-            and query.shape[1] >= flags.get_flag("flash_attn_min_seqlen")):
+            and query.shape[1] >= snap.flash_attn_min_seqlen):
         try:
             from ..kernels.flash_attention import (flash_attention_bshd,
                                                    resolve_dispatch)
-            kind, blk = resolve_dispatch(query.shape[1])
+            kind, blk = resolve_dispatch(query.shape[1], snap)
         except ImportError:
             kind, blk = "dense", None
         if kind == "flash":
@@ -776,7 +788,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                 return apply_op(
                     "flash_attention",
                     lambda q, k, v: flash_attention_bshd(
-                        q, k, v, causal=is_causal, block_q=bq, block_k=bk),
+                        q, k, v, causal=is_causal, block_q=bq, block_k=bk,
+                        snap=snap),
                     query, key, value)
             except NotImplementedError:
                 pass

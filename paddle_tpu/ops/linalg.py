@@ -14,17 +14,22 @@ from ..core.tensor import Tensor, apply_op, _val
 
 
 def _precision():
-    p = flags.get_flag("tpu_matmul_precision")
+    # snapshot at the op boundary, closed over by the traced fn — a
+    # bare get_flag inside fn would re-read the registry per trace and
+    # bake a value program-cache keys never see (tracecheck TRC001)
+    p = flags.snapshot(("tpu_matmul_precision",)).tpu_matmul_precision
     return None if p == "default" else p
 
 
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    prec = _precision()
+
     def fn(a, b):
         if transpose_x:
             a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
         if transpose_y:
             b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
-        return jnp.matmul(a, b, precision=_precision())
+        return jnp.matmul(a, b, precision=prec)
     return apply_op("matmul", fn, x, y)
 
 
@@ -53,7 +58,9 @@ def mv(x, vec, name=None):
 
 
 def einsum(equation, *operands):
-    return apply_op("einsum", lambda *ops: jnp.einsum(equation, *ops, precision=_precision()),
+    prec = _precision()
+    return apply_op("einsum",
+                    lambda *ops: jnp.einsum(equation, *ops, precision=prec),
                     *operands)
 
 

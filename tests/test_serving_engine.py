@@ -99,6 +99,73 @@ class TestServingEngine:
             eng.submit(np.zeros(14, np.int32), 8)
 
 
+class TestDonationDiscipline:
+    """TRC003 regression (tracecheck): the compiled prefill/decode steps
+    donate their pools argument, so the engine must detach the pool's
+    own references BEFORE dispatch (``take_pools``) and install the
+    step's returned arrays after (``install_pools``) — never leaving a
+    window where ``pool.k_pages`` aliases donated (invalidated)
+    buffers."""
+
+    def _engine(self):
+        paddle.seed(79)
+        cfg = GPTConfig.tiny()
+        model = GPTForCausalLM(cfg)
+        rng = np.random.default_rng(9)
+        prompt = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+        eng = ServingEngine(model, max_batch=2, page_size=8, max_seq_len=32)
+        return eng, prompt
+
+    def test_take_pools_detaches_and_install_restores(self):
+        eng, _ = self._engine()
+        before = list(eng.pool.k_pages)
+        pairs = eng.pool.take_pools()
+        assert all(k is None for k in eng.pool.k_pages)
+        assert all(v is None for v in eng.pool.v_pages)
+        # double-detach is the use-after-donate shape — must refuse
+        with pytest.raises(RuntimeError, match="already detached"):
+            eng.pool.take_pools()
+        eng.pool.install_pools(pairs)
+        assert all(k is b for k, b in zip(eng.pool.k_pages, before))
+
+    def test_steps_reinstall_fresh_pools(self):
+        eng, prompt = self._engine()
+        eng.submit(prompt, 4)
+        eng.step()                      # prefill dispatch (donating)
+        assert all(k is not None for k in eng.pool.k_pages)
+        eng.step()                      # decode dispatch (donating)
+        assert all(k is not None for k in eng.pool.k_pages)
+        assert all(v is not None for v in eng.pool.v_pages)
+        out = eng.run()
+        assert len(out[0]) == 4
+
+    def test_failed_dispatch_leaves_pool_loudly_broken(self, monkeypatch):
+        """A dispatch that raises AFTER donation must not leave the
+        engine silently aliasing dead buffers: the pool stays detached
+        and the next dispatch refuses instead of serving garbage."""
+        eng, prompt = self._engine()
+        eng.submit(prompt, 4)
+        eng.step()                      # healthy prefill+decode
+
+        def boom(*a, **k):
+            raise RuntimeError("simulated post-dispatch failure")
+
+        monkeypatch.setattr(eng, "_decode_fn", boom)
+        with pytest.raises(RuntimeError, match="simulated"):
+            eng.step()
+        assert all(k is None for k in eng.pool.k_pages)
+        monkeypatch.undo()              # restore the real program...
+        with pytest.raises(RuntimeError, match="already detached"):
+            eng.step()                  # ...but the pool is gone: refuse
+
+    def test_serving_results_unchanged_by_handoff(self):
+        eng, prompt = self._engine()
+        ref = solo(eng.model, prompt, 6)
+        rid = eng.submit(prompt, 6)
+        out = eng.run()
+        assert out[rid] == ref
+
+
 class TestCrossFeatureComposition:
     def test_int8_model_serves_with_exact_parity(self):
         from paddle_tpu.nn.quant import quantize_linears

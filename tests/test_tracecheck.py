@@ -1,0 +1,677 @@
+"""tracecheck: the trace-discipline static analyzer (tier-1 gate).
+
+Three layers:
+  1. per-rule fixture tests — a flagged snippet, a clean twin, and a
+     pragma-suppressed copy for each TRC rule;
+  2. machinery tests — baseline round-trip stability, multiset
+     semantics, CLI exit codes;
+  3. the package gate — ``paddle_tpu`` analyzed end to end must show
+     ZERO findings beyond the checked-in baseline, inside the
+     acceptance time budget.
+
+Pure AST: no jax import, no device, safe under ``-m 'not slow'``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from paddle_tpu.analysis.tracecheck import (AnalyzerConfig, analyze_package,
+                                            load_baseline, subtract_baseline,
+                                            write_baseline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "paddle_tpu")
+BASELINE = os.path.join(REPO, "tools", "tracecheck_baseline.json")
+
+pytestmark = pytest.mark.tracecheck
+
+
+# --------------------------------------------------------------- harness
+def run_snippet(tmp_path, source, config=None, name="mod.py"):
+    """Analyze one module as a tiny package; returns finding list."""
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / name).write_text(textwrap.dedent(source))
+    result = analyze_package(str(pkg), config)
+    assert not result.errors, result.errors
+    return result
+
+
+def codes(result):
+    return [f.rule for f in result.findings]
+
+
+# ---------------------------------------------------------------- TRC001
+TRC001_FLAGGED = """
+    import jax
+    from .flags import get_flag
+
+    def kernel(x):
+        if get_flag("use_pallas"):
+            return x * 2
+        return x
+
+    step = jax.jit(kernel)
+"""
+
+TRC001_CLEAN = """
+    import jax
+    from . import flags
+
+    def entry(x):
+        snap = flags.snapshot(("use_pallas",))
+        return jax.jit(lambda a: a * (2 if snap.use_pallas else 1))
+"""
+
+
+def test_trc001_flags_read_under_trace(tmp_path):
+    res = run_snippet(tmp_path, TRC001_FLAGGED)
+    assert codes(res) == ["TRC001"]
+    assert "snapshot" in res.findings[0].message
+
+
+def test_trc001_clean_snapshot_twin(tmp_path):
+    res = run_snippet(tmp_path, TRC001_CLEAN)
+    assert "TRC001" not in codes(res)
+
+
+def test_trc001_pragma(tmp_path):
+    res = run_snippet(tmp_path, TRC001_FLAGGED.replace(
+        'if get_flag("use_pallas"):',
+        'if get_flag("use_pallas"):  # tracecheck: disable=TRC001'))
+    assert "TRC001" not in codes(res)
+    assert len(res.suppressed) == 1
+
+
+def test_trc001_untraced_function_not_flagged(tmp_path):
+    res = run_snippet(tmp_path, """
+        from .flags import get_flag
+
+        def eager_config():
+            return get_flag("use_pallas")
+    """)
+    assert codes(res) == []
+
+
+# ---------------------------------------------------------------- TRC002
+TRC002_FLAGGED = """
+    import jax
+    import numpy as np
+
+    def body(x):
+        host = np.asarray(x)
+        return x.item() + host.sum()
+
+    step = jax.jit(body)
+"""
+
+
+def test_trc002_host_sync_under_trace(tmp_path):
+    res = run_snippet(tmp_path, TRC002_FLAGGED)
+    assert codes(res).count("TRC002") == 2        # np.asarray + .item()
+
+
+def test_trc002_clean_twin(tmp_path):
+    res = run_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def body(x):
+            return jnp.asarray(x).sum()
+
+        step = jax.jit(body)
+    """)
+    assert codes(res) == []
+
+
+def test_trc002_pragma(tmp_path):
+    res = run_snippet(tmp_path, TRC002_FLAGGED.replace(
+        "host = np.asarray(x)",
+        "host = np.asarray(x)  # tracecheck: disable=TRC002")
+        .replace("return x.item() + host.sum()",
+                 "return x.item() + host.sum()  "
+                 "# tracecheck: disable=TRC002"))
+    assert codes(res) == []
+    assert len(res.suppressed) == 2
+
+
+def test_trc002_hotpath_marker(tmp_path):
+    res = run_snippet(tmp_path, """
+        import numpy as np
+
+        class Engine:
+            def step(self, dev):  # tracecheck: hotpath
+                return float(np.asarray(dev))
+
+            def sync(self, dev):
+                return float(np.asarray(dev))
+    """)
+    # step: np.asarray + float flagged; unmarked sync: neither
+    assert codes(res) == ["TRC002", "TRC002"]
+    assert all(f.func == "Engine.step" for f in res.findings)
+
+
+def test_trc002_trace_time_constant_not_flagged(tmp_path):
+    # np.asarray of LOCAL host data is ordinary trace-time constant
+    # building (e.g. a static schedule table) — must not flag
+    res = run_snippet(tmp_path, """
+        import jax
+        import numpy as np
+
+        def body(x):
+            table = np.asarray([1, 2, 3])
+            return x + table.sum()
+
+        step = jax.jit(body)
+    """)
+    assert codes(res) == []
+
+
+# ---------------------------------------------------------------- TRC003
+TRC003_FLAGGED = """
+    import jax
+
+    def train(step_fn, params, opt, batch):
+        loss, new_params = step_fn(params, opt, batch)
+        return loss, params["w"]          # params was donated
+
+    def build(step):
+        return jax.jit(step, donate_argnums=(0,))
+
+    step_fn = jax.jit(lambda p, o, b: (0.0, p), donate_argnums=(0,))
+"""
+
+
+def test_trc003_use_after_donate(tmp_path):
+    res = run_snippet(tmp_path, TRC003_FLAGGED)
+    assert codes(res) == ["TRC003"]
+    assert "'params'" in res.findings[0].message
+
+
+def test_trc003_pragma(tmp_path):
+    res = run_snippet(tmp_path, TRC003_FLAGGED.replace(
+        'return loss, params["w"]          # params was donated',
+        'return loss, params["w"]  # tracecheck: disable=TRC003'))
+    assert "TRC003" not in codes(res)
+    assert len(res.suppressed) == 1
+
+
+def test_trc003_rebind_same_statement_clean(tmp_path):
+    res = run_snippet(tmp_path, """
+        import jax
+
+        step_fn = jax.jit(lambda p, b: (0.0, p), donate_argnums=(0,))
+
+        def train(params, batch):
+            loss, params = step_fn(params, batch)
+            return loss, params["w"]      # rebound: the NEW params
+    """)
+    assert codes(res) == []
+
+
+def test_trc003_sibling_branches_are_exclusive(tmp_path):
+    # donation in one branch must not flag a read in a sibling branch
+    res = run_snippet(tmp_path, """
+        import jax
+
+        step_fn = jax.jit(lambda p, b: (0.0, p), donate_argnums=(0,))
+
+        def train(params, batch, merged):
+            if merged:
+                loss, params = step_fn(params, batch)
+            else:
+                loss = params["w"]
+            return loss
+    """)
+    assert codes(res) == []
+
+
+def test_trc003_live_state_view_donated(tmp_path):
+    res = run_snippet(tmp_path, """
+        import jax
+
+        def build():
+            def run(pools, t):
+                return (t, pools)
+            return jax.jit(run, donate_argnums=(0,))
+
+        class Engine:
+            def __init__(self):
+                self._fn = build()
+
+            def step(self, t):
+                out, states = self._fn(self.view(), t)
+                self.install(states)
+                return out
+
+            def view(self):
+                return [self.k, self.v]
+
+            def install(self, states):
+                self.k, self.v = states
+    """)
+    assert codes(res) == ["TRC003"]
+    assert "take_" in res.findings[0].message
+
+
+def test_trc003_take_handoff_clean(tmp_path):
+    res = run_snippet(tmp_path, """
+        import jax
+
+        def build():
+            def run(pools, t):
+                return (t, pools)
+            return jax.jit(run, donate_argnums=(0,))
+
+        class Engine:
+            def __init__(self):
+                self._fn = build()
+
+            def step(self, t):
+                out, states = self._fn(self.take_pools(), t)
+                self.install(states)
+                return out
+
+            def take_pools(self):
+                pairs, self.k, self.v = [self.k, self.v], None, None
+                return pairs
+
+            def install(self, states):
+                self.k, self.v = states
+    """)
+    assert codes(res) == []
+
+
+def test_trc003_program_cache_admission_resolved(tmp_path):
+    # the decode-program-cache idiom: builder -> cache.get -> dispatch
+    res = run_snippet(tmp_path, """
+        import functools
+        import jax
+
+        def _build(note):
+            def run(params, pools):
+                note()
+                return pools
+            return jax.jit(run, donate_argnums=(1,))
+
+        class Engine:
+            def program(self, cache):
+                return cache.get("key", functools.partial(_build))
+
+            def step(self, cache, params, pools):
+                fn = self.program(cache)
+                out = fn(params, pools)
+                return out, pools[0]      # pools was donated
+    """)
+    assert codes(res) == ["TRC003"]
+
+
+# ---------------------------------------------------------------- TRC004
+TRC004_FLAGGED = """
+    import jax
+
+    def train(fns, xs):
+        out = []
+        for f, x in zip(fns, xs):
+            out.append(jax.jit(f)(x))
+        return out
+"""
+
+
+def test_trc004_jit_in_loop(tmp_path):
+    res = run_snippet(tmp_path, TRC004_FLAGGED)
+    assert "TRC004" in codes(res)
+
+
+def test_trc004_immediately_invoked(tmp_path):
+    res = run_snippet(tmp_path, """
+        import jax
+
+        def apply(f, x):
+            return jax.jit(f)(x)
+    """)
+    assert codes(res) == ["TRC004"]
+    assert "immediately invoked" in res.findings[0].message
+
+
+def test_trc004_fresh_lambda(tmp_path):
+    res = run_snippet(tmp_path, """
+        import jax
+
+        def make(scale):
+            fn = jax.jit(lambda x: x * scale)
+            return fn
+    """)
+    assert codes(res) == ["TRC004"]
+
+
+def test_trc004_clean_module_level_and_builder(tmp_path):
+    res = run_snippet(tmp_path, """
+        import jax
+
+        def _build(model):
+            def run(params, x):
+                return params, x
+            return jax.jit(run, donate_argnums=(0,))
+
+        step = jax.jit(lambda x: x * 2)   # module level: admitted once
+    """)
+    assert codes(res) == []
+
+
+def test_trc004_pragma(tmp_path):
+    res = run_snippet(tmp_path, TRC004_FLAGGED.replace(
+        "out.append(jax.jit(f)(x))",
+        "out.append(jax.jit(f)(x))  # tracecheck: disable=TRC004"))
+    assert "TRC004" not in codes(res)
+
+
+# ---------------------------------------------------------------- TRC005
+TRC005_FLAGGED = """
+    import time
+
+    import jax
+    import numpy as np
+
+    def body(x):
+        t0 = time.time()
+        noise = np.random.normal(size=(4,))
+        return x + noise + t0
+
+    step = jax.jit(body)
+"""
+
+
+def test_trc005_clock_and_rng_under_trace(tmp_path):
+    res = run_snippet(tmp_path, TRC005_FLAGGED)
+    assert codes(res) == ["TRC005", "TRC005"]
+    msgs = " ".join(f.message for f in res.findings)
+    assert "time.time" in msgs and "np.random" in msgs
+
+
+def test_trc005_clean_jax_random_twin(tmp_path):
+    res = run_snippet(tmp_path, """
+        import jax
+
+        def body(x, key, t0):
+            noise = jax.random.normal(key, (4,))
+            return x + noise + t0
+
+        step = jax.jit(body)
+    """)
+    assert codes(res) == []
+
+
+def test_trc005_eager_timing_not_flagged(tmp_path):
+    res = run_snippet(tmp_path, """
+        import time
+
+        def benchmark(fn, x):
+            t0 = time.time()
+            fn(x)
+            return time.time() - t0
+    """)
+    assert codes(res) == []
+
+
+def test_trc005_pragma(tmp_path):
+    res = run_snippet(tmp_path, TRC005_FLAGGED
+                      .replace("t0 = time.time()",
+                               "t0 = time.time()  "
+                               "# tracecheck: disable=TRC005")
+                      .replace("noise = np.random.normal(size=(4,))",
+                               "noise = np.random.normal(size=(4,))  "
+                               "# tracecheck: disable=TRC005"))
+    assert codes(res) == []
+
+
+# ---------------------------------------------------------------- TRC006
+TRC006_FLAGGED = """
+    import jax
+    import jax.numpy as jnp
+
+    def body(x):
+        if jnp.max(x) > 0:
+            return x * 2
+        return x
+
+    step = jax.jit(body)
+"""
+
+
+def test_trc006_tensor_if_under_trace(tmp_path):
+    res = run_snippet(tmp_path, TRC006_FLAGGED)
+    assert codes(res) == ["TRC006"]
+
+
+def test_trc006_tainted_local(tmp_path):
+    res = run_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def body(x):
+            m = jnp.mean(x)
+            while m > 0:
+                m = m - 1
+            return m
+
+        step = jax.jit(body)
+    """)
+    assert codes(res) == ["TRC006"]
+
+
+def test_trc006_static_predicates_clean(tmp_path):
+    res = run_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def body(x, y):
+            lg = jnp.log(x)
+            if lg.ndim == x.ndim:          # rank: static under trace
+                lg = jnp.squeeze(lg)
+            if y is None:                  # identity: static
+                y = lg
+            if jnp.iscomplexobj(x):        # dtype predicate: static
+                y = y.real
+            return y
+
+        step = jax.jit(body)
+    """)
+    assert codes(res) == []
+
+
+def test_trc006_tracer_guard_clean(tmp_path):
+    res = run_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def body(x):
+            s = jnp.sum(x)
+            if not isinstance(s, jax.core.Tracer) and int(s) > 0:
+                raise ValueError("bad")
+            return s
+
+        step = jax.jit(body)
+    """)
+    assert codes(res) == []
+
+
+def test_trc006_pragma(tmp_path):
+    res = run_snippet(tmp_path, TRC006_FLAGGED.replace(
+        "if jnp.max(x) > 0:",
+        "if jnp.max(x) > 0:  # tracecheck: disable=TRC006"))
+    assert codes(res) == []
+
+
+# ---------------------------------------------- reachability / callgraph
+def test_reachability_through_helper_calls(tmp_path):
+    # flag read two calls below the jitted root is still caught
+    res = run_snippet(tmp_path, """
+        import jax
+        from .flags import get_flag
+
+        def leaf(x):
+            return x * (2 if get_flag("use_pallas") else 1)
+
+        def mid(x):
+            return leaf(x) + 1
+
+        def root(x):
+            return mid(x)
+
+        step = jax.jit(root)
+    """)
+    assert codes(res) == ["TRC001"]
+    assert res.findings[0].func == "leaf"
+
+
+def test_tree_map_lambda_is_not_traced(tmp_path):
+    # jax.tree.map is NOT a tracer; only lax-rooted control flow is
+    res = run_snippet(tmp_path, """
+        import jax
+        import numpy as np
+        from jax import lax
+
+        def stage(batch):
+            return jax.tree.map(lambda b: np.asarray(b), batch)
+
+        def scanned(xs):
+            return lax.scan(lambda c, x: (c, np.asarray(x)), 0, xs)
+    """)
+    assert codes(res) == ["TRC002"]
+    assert res.findings[0].path.endswith("mod.py")
+    assert "scanned" in res.findings[0].func
+
+
+# -------------------------------------------------------------- baseline
+def test_baseline_round_trip_stable(tmp_path):
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(TRC001_FLAGGED))
+    res = analyze_package(str(pkg))
+    assert res.findings
+
+    b1 = tmp_path / "baseline.json"
+    entries1 = write_baseline(str(b1), res.findings)
+    assert entries1 == sorted(entries1)
+
+    # round-trip: findings re-analyzed against the written baseline are
+    # fully absorbed, and a rewrite is byte-identical
+    new, leftovers = subtract_baseline(
+        analyze_package(str(pkg)).findings, load_baseline(str(b1)))
+    assert new == [] and not leftovers
+    raw1 = b1.read_text()
+    write_baseline(str(b1), analyze_package(str(pkg)).findings)
+    assert b1.read_text() == raw1
+
+
+def test_baseline_is_line_number_stable(tmp_path):
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(TRC001_FLAGGED))
+    b = tmp_path / "baseline.json"
+    write_baseline(str(b), analyze_package(str(pkg)).findings)
+
+    # shift every finding down by adding code ABOVE — fingerprints hold
+    (pkg / "mod.py").write_text(
+        "X = 1\nY = 2\n\n" + textwrap.dedent(TRC001_FLAGGED))
+    new, leftovers = subtract_baseline(
+        analyze_package(str(pkg)).findings, load_baseline(str(b)))
+    assert new == [] and not leftovers
+
+
+def test_baseline_multiset_semantics(tmp_path):
+    # two identical offending lines need two baseline entries
+    src = """
+        import jax
+        from .flags import get_flag
+
+        def body(x):
+            a = get_flag("use_pallas")
+            a = get_flag("use_pallas")
+            return x * a
+
+        step = jax.jit(body)
+    """
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(src))
+    findings = analyze_package(str(pkg)).findings
+    assert len(findings) == 2
+    b = tmp_path / "baseline.json"
+    write_baseline(str(b), findings[:1])          # baseline only ONE
+    new, _ = subtract_baseline(findings, load_baseline(str(b)))
+    assert len(new) == 1
+
+
+# ------------------------------------------------------------------- CLI
+def test_cli_exit_codes_and_json(tmp_path):
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(TRC001_FLAGGED))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    cli = [sys.executable, os.path.join(REPO, "tools", "tracecheck.py")]
+
+    r = subprocess.run(cli + [str(pkg), "--no-baseline", "--json"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 1
+    payload = json.loads(r.stdout)
+    assert [f["rule"] for f in payload["findings"]] == ["TRC001"]
+
+    b = tmp_path / "baseline.json"
+    r = subprocess.run(cli + [str(pkg), "--baseline", str(b),
+                              "--update-baseline"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0 and b.exists()
+
+    r = subprocess.run(cli + [str(pkg), "--baseline", str(b)],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 new finding(s)" in r.stdout
+
+
+# ------------------------------------------------------- the tier-1 gate
+def test_package_gate_zero_new_findings():
+    """THE gate: the whole package analyzed against the checked-in
+    baseline — any new finding fails tier-1 (fix it, pragma it with a
+    reason, or consciously re-baseline)."""
+    t0 = time.time()
+    result = analyze_package(PKG)
+    elapsed = time.time() - t0
+    assert not result.errors, result.errors
+
+    new, leftovers = subtract_baseline(result.findings,
+                                       load_baseline(BASELINE))
+    assert new == [], (
+        "tracecheck found NEW trace-discipline findings:\n"
+        + "\n".join(f.format() for f in new)
+        + "\n\nfix them, add a '# tracecheck: disable=TRC00x' pragma "
+          "with a reason, or (legacy only) re-run "
+          "'python tools/tracecheck.py paddle_tpu --update-baseline'")
+    assert not leftovers, (
+        "stale baseline entries (the code they referenced is gone) — "
+        "run 'python tools/tracecheck.py paddle_tpu --update-baseline':\n"
+        + "\n".join(sorted(leftovers)))
+    # acceptance budget: < 15 s on CPU (typically < 3 s)
+    assert elapsed < 15.0, f"tracecheck took {elapsed:.1f}s"
+
+
+def test_package_gate_scale_sanity():
+    """The reachability analysis must actually cover the package — if a
+    refactor silently breaks root detection the gate would pass
+    vacuously.  Lower bounds, not exact counts."""
+    result = analyze_package(PKG)
+    assert result.n_files > 150
+    assert result.n_functions > 2000
+    assert result.n_traced > 500

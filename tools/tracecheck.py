@@ -1,0 +1,38 @@
+#!/usr/bin/env python
+"""Trace-discipline lint gate (see paddle_tpu/analysis/tracecheck/).
+
+Usage:
+    python tools/tracecheck.py paddle_tpu              # gate (exit 1 on new)
+    python tools/tracecheck.py paddle_tpu --json
+    python tools/tracecheck.py paddle_tpu --update-baseline
+    python tools/tracecheck.py --list-rules
+
+Pure AST — the analyzer is loaded standalone (not through
+``paddle_tpu/__init__``), so this runs in ~2 s with no jax import and
+no device; safe as a pre-commit hook or bare CI step.  The checked-in
+baseline lives at tools/tracecheck_baseline.json; the tier-1 test
+(tests/test_tracecheck.py) fails on any finding beyond it.
+"""
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = os.path.join(REPO, "paddle_tpu", "analysis", "tracecheck")
+
+
+def _load_standalone():
+    """Import the tracecheck package WITHOUT triggering the framework's
+    top-level __init__ (which pulls in jax)."""
+    spec = importlib.util.spec_from_file_location(
+        "tracecheck", os.path.join(PKG_DIR, "__init__.py"),
+        submodule_search_locations=[PKG_DIR])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["tracecheck"] = mod
+    spec.loader.exec_module(mod)
+    return importlib.import_module("tracecheck.cli")
+
+
+if __name__ == "__main__":
+    sys.exit(_load_standalone().main())
