@@ -421,6 +421,15 @@ def _run_bench() -> dict:
             result.update(_sd_unet_bench(paddle, jax, on_tpu))
         except Exception as e:  # best-effort extra signal
             result["sd_error"] = repr(e)[:200]
+    # embed the telemetry snapshot: every banked perf row carries its own
+    # retrace / cache-hit / sync-count evidence (tools/telemetry_dump.py
+    # renders it back)
+    try:
+        from paddle_tpu import observability as _obs
+        if _obs.enabled():
+            result["telemetry"] = _obs.registry().snapshot()
+    except Exception as e:  # best-effort extra signal
+        result["telemetry_error"] = repr(e)[:200]
     return result
 
 

@@ -98,6 +98,7 @@ linalg = _importlib.import_module(".linalg", __name__)
 from . import models  # noqa: F401
 from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
+from . import observability  # noqa: F401  (host-side metrics + spans)
 from .utils.install_check import run_check  # noqa: F401
 from . import quantization  # noqa: F401
 

@@ -31,7 +31,7 @@ class AnalyzerConfig:
     )
     exclude_patterns: Tuple[str, ...] = ()
     rules: Tuple[str, ...] = ("TRC001", "TRC002", "TRC003", "TRC004",
-                              "TRC005", "TRC006")
+                              "TRC005", "TRC006", "TRC007")
 
 
 @dataclass
@@ -131,6 +131,8 @@ def analyze_package(package_path: str,
                 batch += R.trc005_impure_time_rng(fi, graph)
             if "TRC006" in config.rules:
                 batch += R.trc006_tensor_control_flow(fi, graph)
+            if "TRC007" in config.rules:
+                batch += R.trc007_telemetry_under_trace(fi, graph)
             for f in batch:
                 (result.suppressed if suppressed(f, pragmas)
                  else findings).append(f)

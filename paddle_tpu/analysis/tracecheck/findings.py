@@ -39,6 +39,11 @@ RULES: Dict[str, str] = {
               "code — raises TracerBoolConversionError or silently "
               "specializes; use lax.cond/jnp.where (guard eager-only "
               "branches with isinstance(x, Tracer))",
+    "TRC007": "telemetry write (observability registry/span tracer) in "
+              "trace-reachable code — host-side only, a write under "
+              "trace fires once at trace time or fails on a tracer; in "
+              "declared hotpath code the write is legal but must carry "
+              "an explicit pragma with a reason (per-step host cost)",
 }
 
 _PRAGMA_RE = re.compile(

@@ -561,6 +561,115 @@ def _under_skipped(node: ast.AST, parent: dict, skipped: set) -> bool:
     return False
 
 
+def _is_observability_name(fi: FunctionInfo, name: str) -> bool:
+    """Is local name ``name`` imported from the observability package
+    (``from .. import observability as obs`` / ``from ..observability
+    import span``)?"""
+    target = fi.module.module_aliases.get(name, "")
+    if target.endswith("observability") or ".observability." in target:
+        return True
+    imp = fi.module.imported_names.get(name)
+    return bool(imp and "observability" in imp[0])
+
+
+def _module_imports_observability(fi: FunctionInfo) -> bool:
+    for target in fi.module.module_aliases.values():
+        if target.endswith("observability") or ".observability." in target:
+            return True
+    for modname, _orig in fi.module.imported_names.values():
+        if "observability" in modname:
+            return True
+    return False
+
+
+# instrument/tracer write methods distinctive enough to flag by name —
+# but only in modules that import the observability package, so e.g. a
+# quantization observer's ``.observe()`` never false-positives
+_TELEMETRY_METHODS = {"inc", "dec", "observe", "span", "event"}
+
+# the sanctioned hot-path aggregation idiom (like take_* for TRC003):
+# batching a step's gauge/counter writes into one enabled-guarded
+# ``_observe_*`` helper is the annotation — the name is the pragma
+_OBSERVE_PREFIX = "_observe_"
+
+
+def _telemetry_writes(fi: FunctionInfo) -> List:
+    """Direct telemetry write call sites in this function's body:
+    ``[(node, dotted_name), ...]``."""
+    obs_imported = _module_imports_observability(fi)
+    out = []
+    for node in _body_walk(fi):
+        if not isinstance(node, ast.Call):
+            continue
+        name = callee_name(node)
+        if name is None:
+            continue
+        parts = name.split(".")
+        if len(parts) == 1:
+            if _is_observability_name(fi, parts[0]):
+                out.append((node, name))
+        elif _is_observability_name(fi, parts[0]):
+            out.append((node, name))
+        elif obs_imported and parts[-1] in _TELEMETRY_METHODS:
+            out.append((node, name))
+    return out
+
+
+def trc007_telemetry_under_trace(fi: FunctionInfo, graph: CallGraph
+                                 ) -> List[Finding]:
+    """Telemetry is host-side only. In TRACE-REACHABLE code a registry/
+    tracer write either fails on tracers or fires once at trace time and
+    silently freezes — record at the dispatch boundary instead. In
+    declared ``# tracecheck: hotpath`` code a telemetry write is legal
+    but costs the path it observes, so it must carry an explicit
+    ``# tracecheck: disable=TRC007`` pragma with a reason; the scan
+    also reaches ONE call level into same-module helpers (batching a
+    step's writes into an enabled-guarded ``_observe_*`` helper is the
+    sanctioned idiom and exempt by name)."""
+    out: List[Finding] = []
+    if fi.traced:
+        for node, name in _telemetry_writes(fi):
+            out.append(_finding(
+                fi, node, "TRC007",
+                f"telemetry write {name}(...) in trace-reachable code — "
+                "the metrics registry and span tracer are host-side "
+                "only (a write here fires once at trace time and "
+                "freezes, or fails on a tracer); record at the dispatch "
+                "boundary instead"))
+        return out
+    if not fi.hotpath:
+        return []
+    for node, name in _telemetry_writes(fi):
+        out.append(_finding(
+            fi, node, "TRC007",
+            f"telemetry write {name}(...) on a declared hot path — "
+            "acknowledge the per-step host cost with an inline "
+            "`# tracecheck: disable=TRC007` pragma and a reason"))
+    # one-level helper reach: a hot path routing writes through a plain
+    # same-module helper doesn't escape the annotation contract
+    for node in _body_walk(fi):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = callee_name(node)
+        if cname is None or \
+                cname.rsplit(".", 1)[-1].startswith(_OBSERVE_PREFIX):
+            continue
+        for callee in graph.resolve_call(fi, node):
+            if callee.module is not fi.module or callee.hotpath \
+                    or callee.traced:
+                continue        # other modules / directly-scanned defs
+            helper = callee.qualname.rsplit(".", 1)[-1]
+            if helper.startswith(_OBSERVE_PREFIX):
+                continue
+            for wnode, wname in _telemetry_writes(callee):
+                out.append(_finding(
+                    callee, wnode, "TRC007",
+                    f"telemetry write {wname}(...) reached one call from "
+                    f"hot path '{fi.qualname}' — pragma it with a "
+                    "reason, or batch it into an `_observe_*` helper"))
+    return out
+
+
 def trc006_tensor_control_flow(fi: FunctionInfo, graph: CallGraph
                                ) -> List[Finding]:
     if not fi.traced or isinstance(fi.node, (ast.Module, ast.Lambda)):

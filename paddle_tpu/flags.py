@@ -257,6 +257,17 @@ define_flag("fraction_of_gpu_memory_to_use", 0.92, "API parity; PJRT owns memory
 define_flag("log_level", 1, "Framework log verbosity (GLOG_v analogue).")
 define_flag("eager_delete_tensor_gb", 0.0, "API parity; JAX GC owns tensor lifetime.")
 define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest.")
+define_flag("telemetry", True,
+            "Host-side runtime telemetry (paddle_tpu.observability): the "
+            "process-wide metrics registry and span tracer. Eager-only by "
+            "design — telemetry never executes under trace and is NOT part "
+            "of PROGRAM_FLAGS, so toggling it can never recompile a serving "
+            "or train program. Off = instrumented code binds no-op stubs at "
+            "construction time (zero registry lookups on hot paths).")
+define_flag("telemetry_ring", 16384,
+            "Span-tracer ring-buffer capacity in events; the oldest events "
+            "drop first, so a long-lived server keeps a bounded, recent "
+            "timeline window.")
 define_flag("embedding_deterministic", 0, "API parity with reference embedding determinism flag.")
 define_flag("cudnn_deterministic", False, "API parity alias of FLAGS_deterministic.")
 

@@ -33,13 +33,21 @@ Every cached program carries a trace probe: the builder receives a
 executes only when jax actually (re)traces. ``trace_count(key)`` is the
 retrace regression test surface (the acceptance criterion "zero retraces
 across repeated step() calls" asserts it stays at 1).
+
+Telemetry (``FLAGS_telemetry``): hits/misses/traces mirror onto the
+process metrics registry, and every dispatch that (re)traced is charged
+its full wall clock to a per-kind compile-time histogram — a retrace
+regression shows up with a COST attached, not just a count. The timing
+wrapper exists only when telemetry is on; off, ``get`` returns the bare
+compiled callable (zero added work per decode step).
 """
 
 from __future__ import annotations
 
 import hashlib
 import threading
-from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 __all__ = ["DecodeKey", "DecodeProgramCache", "decode_program_cache",
            "clear_decode_program_cache", "model_signature"]
@@ -76,11 +84,37 @@ class DecodeProgramCache:
     trace counting."""
 
     def __init__(self):
+        from .. import observability as obs
+
         self._lock = threading.Lock()
         self._programs: Dict[DecodeKey, Any] = {}
         self._trace_counts: Dict[DecodeKey, int] = {}
+        # per-key mutable trace cell [count]: the dispatch timing wrapper
+        # reads it lock-free to detect "this call (re)traced"
+        self._trace_cells: Dict[DecodeKey, List[int]] = {}
+        self._compile_seconds: Dict[DecodeKey, float] = {}
         self.hits = 0
         self.misses = 0
+        self._telemetry = obs.enabled()
+        if self._telemetry:
+            r = obs.registry()
+            self._m_hits = r.counter(
+                "program_cache_hits",
+                "decode program cache admissions served from cache")
+            self._m_misses = r.counter(
+                "program_cache_misses",
+                "decode program cache admissions that built a program")
+            self._m_traces = r.counter(
+                "program_cache_traces",
+                "jax (re)traces of cached programs (steady state: one "
+                "per key)", labels=("kind",))
+            self._m_compile = r.histogram(
+                "program_cache_compile_seconds",
+                "wall clock of dispatches that (re)traced — trace + "
+                "compile cost per program kind", labels=("kind",))
+        else:
+            self._m_hits = self._m_misses = obs.NULL
+            self._m_traces = self._m_compile = obs.NULL
 
     def get(self, key: DecodeKey,
             builder: Callable[[Callable[[], None]], Any]):
@@ -92,36 +126,81 @@ class DecodeProgramCache:
             fn = self._programs.get(key)
             if fn is not None:
                 self.hits += 1
+                self._m_hits.inc()
                 return fn
         fn = builder(self._tracer(key))      # may be slow: build unlocked
+        if self._telemetry:
+            fn = self._timed_dispatch(key, fn)
         with self._lock:
             cur = self._programs.setdefault(key, fn)
             if cur is fn:
                 self.misses += 1
+                self._m_misses.inc()
             else:
                 self.hits += 1               # lost a benign build race
+                self._m_hits.inc()
             return cur
 
     def _tracer(self, key: DecodeKey) -> Callable[[], None]:
+        with self._lock:
+            cell = self._trace_cells.setdefault(key, [0])
+
         def note_trace():
+            # runs INSIDE the traced python body, so it fires exactly
+            # once per (re)trace — a host-side trace-TIME write, which
+            # is the deliberate exception to "no telemetry under trace"
+            cell[0] += 1
             with self._lock:
                 self._trace_counts[key] = self._trace_counts.get(key, 0) + 1
+            self._m_traces.labels(kind=key.kind).inc()
         return note_trace
+
+    def _timed_dispatch(self, key: DecodeKey, fn):
+        """Wrap a compiled step so any dispatch that (re)traced is
+        charged its wall clock to the compile histogram. Steady-state
+        cost: one list read + two perf_counter calls per step (~100 ns
+        against a ~ms decode step)."""
+        with self._lock:
+            cell = self._trace_cells.setdefault(key, [0])
+        hist = self._m_compile.labels(kind=key.kind)
+
+        def dispatch(*args, **kwargs):
+            before = cell[0]
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            if cell[0] != before:
+                dt = time.perf_counter() - t0
+                hist.observe(dt)
+                with self._lock:
+                    self._compile_seconds[key] = (
+                        self._compile_seconds.get(key, 0.0) + dt)
+            return out
+
+        return dispatch
 
     def trace_count(self, key: DecodeKey) -> int:
         with self._lock:
             return self._trace_counts.get(key, 0)
 
+    def compile_seconds(self, key: DecodeKey) -> float:
+        """Accumulated trace+compile wall clock banked for ``key``
+        (0.0 with telemetry off — the timing wrapper is not installed)."""
+        with self._lock:
+            return self._compile_seconds.get(key, 0.0)
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             return {"hits": self.hits, "misses": self.misses,
                     "programs": len(self._programs),
-                    "traces": dict(self._trace_counts)}
+                    "traces": dict(self._trace_counts),
+                    "compile_seconds": dict(self._compile_seconds)}
 
     def clear(self) -> None:
         with self._lock:
             self._programs.clear()
             self._trace_counts.clear()
+            self._trace_cells.clear()
+            self._compile_seconds.clear()
             self.hits = self.misses = 0
 
 
@@ -139,4 +218,11 @@ def decode_program_cache() -> DecodeProgramCache:
 
 
 def clear_decode_program_cache() -> None:
-    decode_program_cache().clear()
+    """Drop every cached program AND the cache instance itself, so the
+    next :func:`decode_program_cache` call rebinds telemetry under the
+    current ``FLAGS_telemetry`` setting."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is not None:
+            _GLOBAL.clear()
+        _GLOBAL = None

@@ -22,6 +22,7 @@ same way via the logits hook.
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -30,6 +31,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import observability as obs
 from ..kernels.paged_attention import PagedDecodeState, PagedKVCache
 
 __all__ = ["ServingEngine", "Request"]
@@ -48,6 +50,101 @@ class Request:
     pending: List[int] = field(default_factory=list)
     # prefix-cache pages this request adopted (pinned until it finishes)
     pinned: List[int] = field(default_factory=list)
+    # telemetry lifecycle stamps (perf_counter): submit time and the
+    # last generated-token time (inter-token latency baseline)
+    t_submit: float = 0.0
+    t_last: float = 0.0
+
+
+class _EngineTelemetry:
+    """Pre-bound instrument handles for the serving hot path: resolved
+    once per engine, one attribute read per write inside ``step()`` —
+    no registry lookups, no flag reads per token."""
+
+    enabled = True
+
+    def __init__(self):
+        r = obs.registry()
+        t = obs.tracer()
+        self.span = t.span
+        self.event = t.event
+        self.submitted = r.counter(
+            "serving_requests_submitted", "requests accepted by submit()")
+        self.finished = r.counter(
+            "serving_requests_finished", "requests that completed")
+        self.prefills = r.counter(
+            "serving_prefills", "b=1 prefill programs dispatched")
+        self.shared_admits = r.counter(
+            "serving_shared_admissions",
+            "admissions that adopted cached prefix pages (prefill skipped)")
+        self.decode_steps = r.counter(
+            "serving_decode_steps", "full-batch decode steps dispatched")
+        self.ttft = r.histogram(
+            "serving_ttft_seconds",
+            "time to first generated token, submit() to host-visible")
+        self.itl = r.histogram(
+            "serving_inter_token_seconds",
+            "per-request latency between consecutive generated tokens")
+        self.queue_depth = r.gauge(
+            "serving_queue_depth", "requests waiting for a batch slot")
+        self.occupancy = r.gauge(
+            "serving_batch_occupancy",
+            "active slots in the fixed-shape decode batch")
+        self.kv_pages_in_use = r.gauge(
+            "serving_kv_pages_in_use",
+            "KV pool pages held by sequences or the prefix cache "
+            "(excludes the reserved null page)")
+        self.prefix_pinned = r.gauge(
+            "serving_prefix_pinned_pages",
+            "prefix-cache pages pinned by in-flight requests — the "
+            "pressure that caps evict() reclaim")
+        self.evict_short = r.counter(
+            "serving_prefix_evict_shortfall_pages",
+            "pages evict() was asked for but could not free "
+            "(pinned/shared)")
+
+
+class _NullEngineTelemetry:
+    """FLAGS_telemetry=0 binding: every write is a no-op method call."""
+
+    enabled = False
+
+    def __init__(self):
+        self.span = obs.null_span
+        self.event = obs.null_event
+        self.submitted = self.finished = self.prefills = obs.NULL
+        self.shared_admits = self.decode_steps = obs.NULL
+        self.ttft = self.itl = obs.NULL
+        self.queue_depth = self.occupancy = obs.NULL
+        self.kv_pages_in_use = self.prefix_pinned = obs.NULL
+        self.evict_short = obs.NULL
+
+
+class _PrefixTelemetry:
+    enabled = True
+
+    def __init__(self):
+        r = obs.registry()
+        self.hits = r.counter(
+            "prefix_cache_hits", "lookups that matched >= 1 cached page")
+        self.misses = r.counter(
+            "prefix_cache_misses", "lookups that matched nothing")
+        self.hit_pages = r.counter(
+            "prefix_cache_hit_pages", "cached pages returned by lookups")
+        self.registered_pages = r.counter(
+            "prefix_cache_registered_pages",
+            "new prompt pages registered into the trie")
+        self.evicted_pages = r.counter(
+            "prefix_cache_evicted_pages",
+            "pages actually returned to the free list by evict()")
+
+
+class _NullPrefixTelemetry:
+    enabled = False
+
+    def __init__(self):
+        self.hits = self.misses = self.hit_pages = obs.NULL
+        self.registered_pages = self.evicted_pages = obs.NULL
 
 
 class PrefixCache:
@@ -75,6 +172,9 @@ class PrefixCache:
         self._nodes: Dict[tuple, dict] = {}
         self._by_page: Dict[int, tuple] = {}    # page id -> node key
         self._tick = 0
+        self._pinned_nodes = 0      # nodes with pins > 0 (O(1) gauge)
+        self._m = (_PrefixTelemetry() if obs.enabled()
+                   else _NullPrefixTelemetry())
 
     def _chunks(self, prompt: np.ndarray):
         key = self._ROOT
@@ -94,6 +194,11 @@ class PrefixCache:
                 break
             node["tick"] = self._tick
             pages.append(node["page"])
+        if pages:
+            self._m.hits.inc()
+            self._m.hit_pages.inc(len(pages))
+        else:
+            self._m.misses.inc()
         return pages, len(pages) * self.page_size
 
     def register(self, prompt: np.ndarray, block_row) -> None:
@@ -112,6 +217,7 @@ class PrefixCache:
             if parent is not None:
                 self._nodes[parent]["children"] += 1
             self.pool.ref_page(int(block_row[i]))
+            self._m.registered_pages.inc()
 
     def pin(self, pages) -> None:
         """Mark cached pages as adopted by an in-flight request: a pinned
@@ -121,13 +227,19 @@ class PrefixCache:
         for pid in pages:
             key = self._by_page.get(int(pid))
             if key is not None:
-                self._nodes[key]["pins"] += 1
+                node = self._nodes[key]
+                node["pins"] += 1
+                if node["pins"] == 1:
+                    self._pinned_nodes += 1
 
     def unpin(self, pages) -> None:
         for pid in pages:
             key = self._by_page.get(int(pid))
             if key is not None and self._nodes[key]["pins"] > 0:
-                self._nodes[key]["pins"] -= 1
+                node = self._nodes[key]
+                node["pins"] -= 1
+                if node["pins"] == 0:
+                    self._pinned_nodes -= 1
 
     def evict(self, n_pages: int) -> int:
         """Free up to ``n_pages`` pages by dropping LRU leaf nodes,
@@ -151,7 +263,17 @@ class PrefixCache:
                 self._nodes[node["parent"]]["children"] -= 1
             if self.pool.unref_page(node["page"]):
                 freed += 1
+        if freed:
+            self._m.evicted_pages.inc(freed)
         return freed
+
+    def pinned_page_count(self) -> int:
+        """Pages untouchable by ``evict`` because an in-flight request's
+        block table still points at them — the pinned-page pressure a
+        shortfalling evict() reports instead of silently under-freeing.
+        O(1): maintained on pin/unpin transitions (evict only ever drops
+        pins==0 nodes), so the per-step gauge refresh costs nothing."""
+        return self._pinned_nodes
 
 
 class ServingEngine:
@@ -207,6 +329,10 @@ class ServingEngine:
         from .program_cache import model_signature
         self._flags = _flags.snapshot(_flags.PROGRAM_FLAGS)
         self._model_sig = model_signature(model)
+        # telemetry binding is per-engine and resolved once here (the
+        # no-op stubs cost one method call per write when disabled)
+        self._m = (_EngineTelemetry() if obs.enabled()
+                   else _NullEngineTelemetry())
 
     # ------------------------------------------------------------ frontend
     def submit(self, prompt, max_new_tokens: int = 32,
@@ -228,8 +354,10 @@ class ServingEngine:
                 f"{min(usable, self.pool.max_pages_per_seq)}")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(Request(rid, prompt, int(max_new_tokens),
-                                   eos_token_id))
+        req = Request(rid, prompt, int(max_new_tokens), eos_token_id)
+        req.t_submit = time.perf_counter()
+        self._queue.append(req)
+        self._m.submitted.inc()
         return rid
 
     def has_work(self) -> bool:
@@ -334,8 +462,13 @@ class ServingEngine:
         req.pending = [int(t) for t in suffix[1:]]
         req.slot = slot
         self._slots[slot] = req
+        self._m.shared_admits.inc()
 
     def _prefill(self, req: Request, slot: int) -> None:
+        # queued phase closes at admission: submit() -> here (once per
+        # REQUEST, not per token)  # tracecheck: disable=TRC007
+        self._m.event("request.queued", req.t_submit, time.perf_counter(),
+                      rid=req.rid)
         if self._prefix is not None:
             pages, n_cached = self._prefix.lookup(req.prompt)
             # never cover the WHOLE prompt: the first generated token's
@@ -364,16 +497,25 @@ class ServingEngine:
 
         self.pool.allocate(slot, p + req.max_new_tokens)
         bt = jnp.asarray(self.pool.block_tables[slot:slot + 1])
-        tok, states = fn(self._params, self._buffers,
-                         jnp.asarray(req.prompt[None]),
-                         self.pool.take_pools(),
-                         bt, jnp.zeros((1,), jnp.int32))
-        # b=1 prefill wrote THROUGH slot's block table into the shared
-        # pool arrays; adopt them and the slot's bookkeeping
-        self._store(states)
+        # per-request prefill timeline span  # tracecheck: disable=TRC007
+        with self._m.span("request.prefill", rid=req.rid, prompt_len=p):
+            tok, states = fn(self._params, self._buffers,
+                             jnp.asarray(req.prompt[None]),
+                             self.pool.take_pools(),
+                             bt, jnp.zeros((1,), jnp.int32))
+            # b=1 prefill wrote THROUGH slot's block table into the
+            # shared pool arrays; adopt them and the slot's bookkeeping
+            self._store(states)
+            tok = int(tok)              # the span owns the token pull
+        # once per admitted request  # tracecheck: disable=TRC007
+        self._m.prefills.inc()
         self.pool.seq_lens[slot] = p
-        self._last_tok[slot] = int(tok)
-        req.tokens.append(int(tok))
+        self._last_tok[slot] = tok
+        tnow = time.perf_counter()
+        req.t_last = tnow
+        # TTFT closes on the prefill's token  # tracecheck: disable=TRC007
+        self._m.ttft.observe(tnow - req.t_submit)
+        req.tokens.append(tok)
         req.slot = slot
         self._slots[slot] = req
         if self._prefix is not None:
@@ -394,6 +536,13 @@ class ServingEngine:
             self._slots[req.slot] = None
             self._results[req.rid] = req.tokens
             req.slot = None
+            # once per finished request  # tracecheck: disable=TRC007
+            self._m.finished.inc()
+            if self._m.enabled:
+                # lifecycle close event  # tracecheck: disable=TRC007
+                self._m.event("request.complete", req.t_submit,
+                              time.perf_counter(), rid=req.rid,
+                              tokens=len(req.tokens))
 
     def step(self) -> None:  # tracecheck: hotpath
         # admission: fill every free slot that has pages available
@@ -403,20 +552,27 @@ class ServingEngine:
                 need = -(-(len(req.prompt) + req.max_new_tokens)
                          // self.pool.page_size)
                 if need > self.pool.free_page_count() and self._prefix:
-                    # cached-but-unshared pages are reclaimable capacity
-                    self._prefix.evict(need - self.pool.free_page_count())
+                    # cached-but-unshared pages are reclaimable capacity;
+                    # a shortfall (pinned/shared pages refusing eviction)
+                    # is banked as pressure, not silently swallowed
+                    want = need - self.pool.free_page_count()
+                    freed = self._prefix.evict(want)
+                    if freed < want:
+                        self._observe_evict_shortfall(want - freed)
                 if need > self.pool.free_page_count():
                     break           # wait for pages (FIFO, no starvation)
                 self._queue.pop(0)
                 self._prefill(req, slot)
 
         active = [s for s in self._slots if s is not None]
+        self._observe_step_begin(len(active))
         if not active:
             return
 
         fn = self._decode_program()
         bt = jnp.asarray(self.pool.block_tables[:self.max_batch])
         sl = jnp.asarray(self.pool.seq_lens[:self.max_batch])
+        t0 = time.perf_counter() if self._m.enabled else 0.0
         toks, states = fn(
             self._params, self._buffers,
             jnp.asarray(self._last_tok[:, None]),
@@ -426,6 +582,11 @@ class ServingEngine:
         # the concrete token ids  # tracecheck: disable=TRC002
         toks = np.asarray(toks)
 
+        now = time.perf_counter() if self._m.enabled else 0.0
+        # one retroactive timeline event per step (cheaper than a span
+        # object on the hot path; under a jax capture the compiled step
+        # shows up natively)  # tracecheck: disable=TRC007
+        self._m.event("engine.decode_step", t0, now, active=len(active))
         for slot, req in enumerate(self._slots):
             if req is None:
                 continue            # idle row wrote the null page; ignore
@@ -443,9 +604,57 @@ class ServingEngine:
                 # pages so repeats of THIS prompt deepen the cache too
                 self._prefix.register(req.prompt,
                                       self.pool.block_tables[slot])
+            if req.tokens:
+                # per-token host-side latency write, bench-gated <2%
+                # tracecheck: disable=TRC007
+                self._m.itl.observe(now - req.t_last)
+            else:
+                # first token of a shared admission: TTFT closes here
+                # tracecheck: disable=TRC007
+                self._m.ttft.observe(now - req.t_submit)
+            req.t_last = now
             req.tokens.append(tok)
             self._last_tok[slot] = tok
             self._finish_if_done(req)
+        self._observe_step_end()
+
+    # ------------------------------------------------- telemetry helpers
+    # NOT hotpath-marked: plain host bookkeeping called once per step()
+    # (the per-token writes stay inline above under pragma'd lines).
+
+    def _observe_step_begin(self, n_active: int) -> None:
+        m = self._m
+        if not m.enabled:
+            return
+        if n_active:
+            m.decode_steps.inc()
+        else:
+            # idle step: nothing decoded, but keep the gauges honest
+            self._observe_step_end()
+
+    def _observe_step_end(self) -> None:
+        """One gauge refresh per step, AFTER finishes freed their
+        slots/pages (and unpinned prefix pages), so a drained engine
+        reads 0 everywhere instead of freezing at shortfall-time or
+        pre-free values."""
+        m = self._m
+        if not m.enabled:
+            return
+        m.queue_depth.set(len(self._queue))
+        m.occupancy.set(self.max_batch - self._slots.count(None))
+        m.kv_pages_in_use.set(
+            self.pool.num_pages - 1 - self.pool.free_page_count())
+        if self._prefix is not None:
+            m.prefix_pinned.set(self._prefix.pinned_page_count())
+
+    def _observe_evict_shortfall(self, short: int) -> None:
+        """``evict()`` freed fewer pages than the admission asked for:
+        record how many, and the pinned-page pressure that explains it."""
+        m = self._m
+        if not m.enabled or self._prefix is None:
+            return
+        m.evict_short.inc(short)
+        m.prefix_pinned.set(self._prefix.pinned_page_count())
 
 
 def _val(x):

@@ -212,9 +212,12 @@ class Model:
                          and (epoch + 1) % eval_freq == 0)
             if step_obj is not None:
                 # the ONE hard barrier of the epoch: exact loss for
-                # EarlyStopping/checkpoint decisions
+                # EarlyStopping/checkpoint decisions (the epoch_sync span
+                # nests the TrainStep's own train.sync span)
+                from ..observability import span as _span
                 logs = dict(logs)
-                logs["loss"] = step_obj.sync()
+                with _span("fit.epoch_sync", epoch=epoch):
+                    logs["loss"] = step_obj.sync()
                 m = step_obj.last_metrics
                 if m is not None and m["loss_step"] >= epoch_base:
                     # retag: the barrier loss is exact — stale tags from

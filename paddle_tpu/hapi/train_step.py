@@ -22,6 +22,7 @@ python/paddle/distributed/fleet/meta_parallel/sharding/.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -31,10 +32,50 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import observability as obs
 from ..core.tensor import Tensor
 from ..jit import functional_call, tree_to_values
 from ..optimizer.lr import LRScheduler
 from ..optimizer.optimizer import Optimizer
+
+
+class _TrainTelemetry:
+    """Pre-bound registry handles for the train loop (resolved once per
+    TrainStep; the probe attributes sync_count/trace_count stay the
+    test surface — these mirror them onto the exportable registry)."""
+
+    enabled = True
+
+    def __init__(self):
+        r = obs.registry()
+        self.span = obs.tracer().span
+        self.syncs = r.counter(
+            "train_syncs", "host-blocking loss pulls (pull_metrics/sync)")
+        self.throttles = r.counter(
+            "train_throttles",
+            "hard in-flight-window blocks (0 in a healthy loop)")
+        self.traces = r.counter(
+            "train_step_traces",
+            "(re)traces of the jitted train step (steady state: 1)")
+        self.in_flight = r.gauge(
+            "train_in_flight",
+            "dispatched-but-unsynced steps in the async window")
+        self.staleness = r.gauge(
+            "train_metrics_staleness",
+            "steps between the displayed loss and the newest dispatch")
+        self.pull_seconds = r.histogram(
+            "train_pull_seconds",
+            "wall clock of host metric pulls (near-zero when the pulled "
+            "loss was dispatched >= k steps ago)")
+
+
+class _NullTrainTelemetry:
+    enabled = False
+
+    def __init__(self):
+        self.span = obs.null_span
+        self.syncs = self.throttles = self.traces = obs.NULL
+        self.in_flight = self.staleness = self.pull_seconds = obs.NULL
 
 
 class StagedBatch:
@@ -96,6 +137,9 @@ class TrainStep:
         self.sync_count = 0      # host-blocking loss pulls (probe-visible)
         self.throttle_count = 0  # hard-window blocks (0 in a healthy loop)
         self._trace_count = 0    # step-fn retraces (probe-visible)
+        self._m = (_TrainTelemetry() if obs.enabled()
+                   else _NullTrainTelemetry())
+        self._traces_seen = 0    # registry mirror high-water mark
         self.last_metrics: Optional[Dict[str, Any]] = None
         self._last_loss: Optional[float] = None
         # ---- strategy-driven transforms (reference: fleet/meta_optimizers/
@@ -514,7 +558,26 @@ class TrainStep:
             # tracecheck: disable=TRC002
             np.asarray(old)
             self.throttle_count += 1
+            # throttles must be visible in exported snapshots (a nonzero
+            # rate means the caller never pulls)
+            # tracecheck: disable=TRC007
+            self._m.throttles.inc()
+        # gauge AFTER the pull/throttle drains: it must read what is
+        # actually still outstanding, not the pre-drain peak
+        self._observe_dispatch()
         return Tensor(loss, stop_gradient=True)
+
+    def _observe_dispatch(self) -> None:
+        """Post-dispatch host-side telemetry: async-window depth and the
+        retrace mirror (trace_count deltas observed HERE, on the host
+        side of the jit boundary — never inside the traced body)."""
+        m = self._m
+        if not m.enabled:
+            return
+        m.in_flight.set(len(self._inflight))
+        if self._trace_count != self._traces_seen:
+            m.traces.inc(self._trace_count - self._traces_seen)
+            self._traces_seen = self._trace_count
 
     # -------------------------------------------------------- async metrics
     def pull_metrics(self, lag: Optional[int] = None) -> Optional[Dict[str, Any]]:
@@ -535,11 +598,22 @@ class TrainStep:
         idx, dev = picked
         # host pull (not block_until_ready): reliable through the axon
         # tunnel, and the value is what the caller wants anyway
-        val = float(np.asarray(dev))
+        t0 = time.perf_counter()
+        # the k-step metrics cadence, not per-step
+        # tracecheck: disable=TRC007
+        with self._m.span("train.pull_metrics", step=idx):
+            val = float(np.asarray(dev))
         self.sync_count += 1
         self._last_loss = val
         self.last_metrics = {"loss": val, "loss_step": idx,
                              "staleness": self._step_count - 1 - idx}
+        if self._m.enabled:
+            # once per pull (every k steps)  # tracecheck: disable=TRC007
+            self._m.syncs.inc()
+            # tracecheck: disable=TRC007
+            self._m.pull_seconds.observe(time.perf_counter() - t0)
+            self._m.staleness.set(self.last_metrics["staleness"])
+            self._m.in_flight.set(len(self._inflight))
         return self.last_metrics
 
     def sync(self) -> Optional[float]:
@@ -550,10 +624,15 @@ class TrainStep:
         if self._inflight:
             idx, dev = self._inflight[-1]
             self._inflight.clear()
-            self._last_loss = float(np.asarray(dev))
+            with self._m.span("train.sync", step=idx):
+                self._last_loss = float(np.asarray(dev))
             self.sync_count += 1
             self.last_metrics = {"loss": self._last_loss, "loss_step": idx,
                                  "staleness": 0}
+            if self._m.enabled:
+                self._m.syncs.inc()
+                self._m.staleness.set(0)
+                self._m.in_flight.set(0)
         return self._last_loss
 
     @property

@@ -30,6 +30,7 @@ import collections
 import os
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Optional
 
@@ -523,9 +524,23 @@ class DevicePrefetcher:
 
     def __init__(self, data, stage_fn: Optional[Callable] = None,
                  depth: int = 2):
+        from .. import observability as obs
+
         self._data = data
         self._stage = stage_fn if stage_fn is not None else _default_stage
         self.depth = max(1, int(depth))
+        self._telemetry = obs.enabled()
+        if self._telemetry:
+            r = obs.registry()
+            self._m_staged = r.counter(
+                "io_batches_staged",
+                "batches staged host->device by DevicePrefetcher")
+            self._m_stage_s = r.histogram(
+                "io_stage_seconds",
+                "host wall clock per staging dispatch (fetch + async "
+                "device_put; the H2D copy itself overlaps compute)")
+        else:
+            self._m_staged = self._m_stage_s = obs.NULL
 
     def __iter__(self):
         buf = collections.deque()
@@ -534,9 +549,15 @@ class DevicePrefetcher:
         while True:
             while not exhausted and len(buf) < self.depth:
                 try:
-                    buf.append(self._stage(next(it)))
+                    nxt = next(it)
                 except StopIteration:
                     exhausted = True
+                    continue
+                t0 = time.perf_counter() if self._telemetry else 0.0
+                buf.append(self._stage(nxt))
+                if self._telemetry:
+                    self._m_stage_s.observe(time.perf_counter() - t0)
+                    self._m_staged.inc()
             if not buf:
                 return
             yield buf.popleft()

@@ -1,0 +1,79 @@
+"""Runtime telemetry: unified metrics registry + span tracing.
+
+The signal layer every serving/perf claim stands on: a process-wide
+:mod:`metrics <paddle_tpu.observability.metrics>` registry (counters,
+gauges, fixed-exponential-bucket histograms; JSON snapshot + Prometheus
+text) and a :mod:`span tracer <paddle_tpu.observability.tracing>`
+(nested host-side timing events -> Chrome-trace JSON, mirrored into
+``jax.profiler`` captures).
+
+Instrumented subsystems: ``generation.serving.ServingEngine`` (request
+lifecycle spans, TTFT/inter-token histograms, queue/occupancy/KV-pool
+gauges, prefix-cache counters), ``hapi.train_step.TrainStep`` (in-flight
+window depth, sync/throttle/retrace counters, pull/sync spans),
+``generation.program_cache`` (hit/miss counters, compile wall-time
+histograms) and ``io.DevicePrefetcher``. ``tools/telemetry_dump.py``
+renders snapshots; ``bench.py`` and the ``tools/*_bench.py`` drivers
+embed a snapshot in their ``BENCH_*.json`` output.
+
+Everything is gated behind ``FLAGS_telemetry`` (default on). The
+contract is HOST-SIDE ONLY: a telemetry write must never be reachable
+under trace (it would fire once at trace time and freeze, or fail on a
+tracer) — tracecheck rule TRC007 enforces this, and additionally
+requires an explicit pragma + reason for writes in declared
+``# tracecheck: hotpath`` code.
+
+Usage::
+
+    from paddle_tpu import observability as obs
+
+    reqs = obs.registry().counter("my_requests", "requests seen")
+    lat = obs.registry().histogram("my_latency_seconds")
+    with obs.span("handle", rid=7):
+        ...
+        lat.observe(dt)
+    obs.registry().snapshot()          # JSON-able dict
+    obs.to_prometheus()                # text exposition format
+    obs.tracer().save("trace.json")    # open in chrome://tracing
+"""
+
+from __future__ import annotations
+
+from .metrics import (Counter, Gauge, Histogram, LATENCY_BUCKETS,
+                      MetricsRegistry, NULL, exponential_buckets, registry,
+                      series_quantile)
+from .tracing import (NULL_SPAN, Span, SpanTracer, null_event, null_span,
+                      tracer)
+from .export import (chrome_trace, save_chrome_trace, save_snapshot,
+                     to_prometheus)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "NULL",
+    "LATENCY_BUCKETS", "exponential_buckets", "registry",
+    "series_quantile", "Span", "SpanTracer", "NULL_SPAN", "tracer",
+    "null_span", "null_event", "chrome_trace", "save_chrome_trace",
+    "save_snapshot", "to_prometheus", "enabled", "span", "snapshot",
+]
+
+
+def enabled() -> bool:
+    """Resolve ``FLAGS_telemetry``. Call at CONSTRUCTION time and bind
+    either real instruments or the ``NULL``/``null_span`` stubs — never
+    per hot-path call (instrumented objects keep whichever binding they
+    were built under; rebuild after toggling the flag)."""
+    from .. import flags
+    return bool(flags.get_flag("telemetry"))
+
+
+def span(name: str, **args):
+    """Convenience scoped span honoring ``FLAGS_telemetry`` per call —
+    for warm paths (epoch boundaries, loaders). Hot paths pre-bind
+    ``tracer().span`` instead."""
+    if not enabled():
+        return NULL_SPAN
+    return tracer().span(name, **args)
+
+
+def snapshot():
+    """The live registry snapshot (JSON-able)."""
+    return registry().snapshot()

@@ -1,0 +1,191 @@
+"""Span tracer: nested host-side timing events -> Chrome-trace JSON.
+
+``tracer().span("prefill", rid=3)`` is a context manager (and
+decorator) that records one complete event — name, wall-clock begin,
+duration, thread — into a bounded ring buffer. The export is the Chrome
+``traceEvents`` format (``chrome://tracing`` / Perfetto opens it
+directly), so a serving run under load produces a per-request timeline
+with zero external dependencies.
+
+Interop with the profiler facade: every span also enters a
+``jax.profiler.TraceAnnotation`` (the primitive behind
+``paddle_tpu.profiler.RecordEvent``), so when a ``jax.profiler`` device
+capture is active the same spans land inside the XPlane trace alongside
+the XLA events. The reverse direction holds too:
+``profiler.RecordEvent`` scopes are mirrored into this ring buffer.
+
+Host-side only, like the metrics registry — a span entered under trace
+would time the TRACE, not the execution, and is flagged by tracecheck
+rule TRC007.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = ["SpanTracer", "Span", "NULL_SPAN", "tracer", "null_span",
+           "null_event"]
+
+try:                                    # the annotation is optional:
+    import jax                          # pure-host tools can trace spans
+    _ANNOTATION = jax.profiler.TraceAnnotation
+except Exception:                       # pragma: no cover - import guard
+    _ANNOTATION = None
+
+try:
+    # jax 0.4.x internal: the live profiler session. Entering a
+    # TraceAnnotation costs ~10 µs per span on the decode hot path;
+    # outside a capture it annotates nothing, so spans skip it unless a
+    # session is actually recording. Private API — on any drift we fall
+    # back to always annotating (correct, just slower under load).
+    from jax._src.profiler import _profile_state as _JAX_PROFILE_STATE
+except Exception:                       # pragma: no cover - version drift
+    _JAX_PROFILE_STATE = None
+
+
+def _capture_active() -> bool:
+    if _JAX_PROFILE_STATE is None:
+        return True                     # can't tell: keep annotations
+    try:
+        return _JAX_PROFILE_STATE.profile_session is not None
+    except Exception:                   # pragma: no cover - state drift
+        return True
+
+
+class Span:
+    """One timed scope. Context manager; also usable as a decorator
+    (``@tracer().span("load")`` — note the enabled/disabled decision is
+    then frozen at decoration time; prefer the ``with`` form for code
+    whose telemetry flag may toggle)."""
+
+    __slots__ = ("_tracer", "name", "args", "_t0", "_ann")
+
+    def __init__(self, tr: "SpanTracer", name: str,
+                 args: Optional[Dict[str, Any]] = None):
+        self._tracer = tr
+        self.name = name
+        self.args = args or {}
+        self._t0 = 0.0
+        self._ann = None
+
+    def __enter__(self) -> "Span":
+        if _ANNOTATION is not None and _capture_active():
+            try:
+                self._ann = _ANNOTATION(self.name)
+                self._ann.__enter__()
+            except Exception:           # annotation is best-effort
+                self._ann = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        t1 = time.perf_counter()
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        self._tracer._append(self.name, self._t0, t1, self.args)
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with Span(self._tracer, self.name, self.args):
+                return fn(*a, **kw)
+        return wrapper
+
+
+class _NullSpan:
+    """No-op stand-in bound when telemetry is off."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __call__(self, fn):
+        return fn
+
+
+NULL_SPAN = _NullSpan()
+
+
+def null_span(name: str, **args) -> _NullSpan:
+    return NULL_SPAN
+
+
+def null_event(name: str, t0: float, t1: float, **args) -> None:
+    return None
+
+
+class SpanTracer:
+    """Bounded ring buffer of complete events (Chrome-trace ``"X"``
+    phase). Appends are deque ops under the GIL — no lock on the record
+    path; ``events()``/exports copy."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            from .. import flags
+            capacity = int(flags.get_flag("telemetry_ring"))
+        self._events: deque = deque(maxlen=max(1, capacity))
+        self._pid = os.getpid()
+
+    # ------------------------------------------------------------ record
+    def span(self, name: str, **args) -> Span:
+        return Span(self, name, args)
+
+    def event(self, name: str, t0: float, t1: float, **args) -> None:
+        """Retroactive complete event from explicit ``perf_counter``
+        begin/end stamps (request lifecycle phases whose boundaries were
+        observed before the phase name was known)."""
+        self._append(name, t0, t1, args)
+
+    def _append(self, name, t0, t1, args) -> None:
+        self._events.append({
+            "name": name, "ph": "X",
+            "ts": t0 * 1e6,                       # Chrome wants µs
+            "dur": max(0.0, (t1 - t0)) * 1e6,
+            "pid": self._pid, "tid": threading.get_ident(),
+            "args": dict(args),
+        })
+
+    # ------------------------------------------------------------ export
+    def events(self) -> List[Dict[str, Any]]:
+        return list(self._events)
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """The ring as a Chrome-trace/Perfetto JSON object."""
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+
+    def save(self, path: str) -> None:
+        import json
+        with open(path, "w") as fh:
+            json.dump(self.chrome_trace(), fh)
+
+    def clear(self) -> None:
+        self._events.clear()
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+
+_TRACER: Optional[SpanTracer] = None
+_TRACER_LOCK = threading.Lock()
+
+
+def tracer() -> SpanTracer:
+    """The process-wide span tracer (ring size from
+    ``FLAGS_telemetry_ring`` at first use)."""
+    global _TRACER
+    if _TRACER is None:
+        with _TRACER_LOCK:
+            if _TRACER is None:
+                _TRACER = SpanTracer()
+    return _TRACER

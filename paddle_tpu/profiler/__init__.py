@@ -71,11 +71,21 @@ def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
 
 
 class RecordEvent:
-    """User-scope annotation -> jax.profiler.TraceAnnotation."""
+    """User-scope annotation -> jax.profiler.TraceAnnotation, mirrored
+    into the observability span ring (``FLAGS_telemetry``) so RecordEvent
+    scopes land in the exported Chrome-trace timeline alongside engine/
+    train spans — and observability spans land in jax.profiler captures
+    through the same TraceAnnotation primitive."""
 
     def __init__(self, name: str, event_type=None):
+        from ..observability import enabled as _tel_on, tracer as _tracer
+
         self.name = name
         self._ann = jax.profiler.TraceAnnotation(name)
+        # bind-at-construction like every other instrumented site: one
+        # flag resolve per RecordEvent, zero per begin/end pair
+        self._mirror = _tracer().event if _tel_on() else None
+        self._t0 = None
 
     def __enter__(self):
         self.begin()
@@ -87,9 +97,14 @@ class RecordEvent:
 
     def begin(self):
         self._ann.__enter__()
+        self._t0 = time.perf_counter()
 
     def end(self):
         self._ann.__exit__(None, None, None)
+        if self._t0 is not None:
+            if self._mirror is not None:
+                self._mirror(self.name, self._t0, time.perf_counter())
+            self._t0 = None
 
 
 class Profiler:
@@ -176,6 +191,13 @@ class Profiler:
 
     def export(self, path: str, format: str = "json"):
         print(f"traces are exported by jax.profiler to {self._log_dir}")
+
+    def export_telemetry(self, path: str):
+        """Write the observability span ring (engine/train/RecordEvent
+        host spans) as Chrome-trace JSON — the host-side companion to
+        the jax.profiler device capture in ``self._log_dir``."""
+        from ..observability import save_chrome_trace
+        save_chrome_trace(path)
 
 
 def load_profiler_result(filename: str):

@@ -1,0 +1,549 @@
+"""Runtime telemetry (paddle_tpu/observability): registry correctness,
+span tracing, the instrumented serving/train/cache subsystems, the
+FLAGS_telemetry=off zero-residue contract, and the TRC007 tracecheck
+rule ("no telemetry write reachable under trace").
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import flags, observability as obs
+from paddle_tpu.generation.program_cache import (clear_decode_program_cache,
+                                                 decode_program_cache)
+from paddle_tpu.generation.serving import ServingEngine
+from paddle_tpu.models import (GPTConfig, GPTForCausalLM, LlamaConfig,
+                               LlamaForCausalLM)
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """Each test sees an empty registry/ring and telemetry ON; the
+    decode program cache is dropped so it rebinds instruments under the
+    test's flag state."""
+    prior = flags.get_flag("telemetry")
+    flags.set_flags({"telemetry": True})
+    obs.registry().clear()
+    obs.tracer().clear()
+    clear_decode_program_cache()
+    yield
+    flags.set_flags({"telemetry": prior})
+    obs.registry().clear()
+    obs.tracer().clear()
+    clear_decode_program_cache()
+
+
+def metric(snap, name):
+    return snap["metrics"][name]["series"][0]
+
+
+# ------------------------------------------------------------- registry
+class TestRegistry:
+    def test_counter_and_gauge(self):
+        r = obs.registry()
+        c = r.counter("t_reqs", "help text")
+        c.inc()
+        c.inc(2.5)
+        g = r.gauge("t_depth")
+        g.set(7)
+        g.inc()
+        g.dec(3)
+        snap = r.snapshot()
+        assert metric(snap, "t_reqs")["value"] == 3.5
+        assert snap["metrics"]["t_reqs"]["help"] == "help text"
+        assert metric(snap, "t_depth")["value"] == 5
+
+    def test_families_are_idempotent_and_typed(self):
+        r = obs.registry()
+        assert r.counter("t_same") is r.counter("t_same")
+        with pytest.raises(ValueError):
+            r.gauge("t_same")
+        with pytest.raises(ValueError):
+            r.counter("t_same", labels=("k",))
+        # histogram bucket layout is part of the schema: a silent
+        # re-registration under different buckets would quantize the
+        # second caller's data onto the wrong ladder
+        h = r.histogram("t_same_h", buckets=(0.1, 1.0))
+        assert r.histogram("t_same_h", buckets=(0.1, 1.0)) is h
+        with pytest.raises(ValueError):
+            r.histogram("t_same_h", buckets=(0.5, 5.0))
+
+    def test_labels(self):
+        r = obs.registry()
+        fam = r.counter("t_hits", labels=("kind",))
+        fam.labels(kind="a").inc()
+        fam.labels(kind="a").inc()
+        fam.labels(kind="b").inc(5)
+        with pytest.raises(ValueError):
+            fam.labels(wrong="x")
+        series = {tuple(s["labels"].items()): s["value"]
+                  for s in r.snapshot()["metrics"]["t_hits"]["series"]}
+        assert series[(("kind", "a"),)] == 2
+        assert series[(("kind", "b"),)] == 5
+
+    def test_histogram_buckets_and_quantiles(self):
+        h = obs.registry().histogram(
+            "t_lat", buckets=obs.exponential_buckets(0.001, 2.0, 10))
+        for v in (0.0015, 0.003, 0.003, 0.1):
+            h.observe(v)
+        entry = metric(obs.registry().snapshot(), "t_lat")
+        assert entry["count"] == 4
+        assert entry["counts"][-1] == 0           # nothing overflowed
+        assert sum(entry["counts"]) == 4
+        assert entry["min"] == pytest.approx(0.0015)
+        assert entry["max"] == pytest.approx(0.1)
+        p50 = obs.series_quantile(entry, 0.5)
+        assert 0.0015 <= p50 <= 0.004
+        # quantiles clamp to the observed range
+        assert obs.series_quantile(entry, 0.99) <= 0.1
+        assert h.quantile(0.5) == p50
+
+    def test_histogram_overflow_bucket(self):
+        h = obs.registry().histogram("t_over",
+                                     buckets=(0.1, 0.2))
+        h.observe(99.0)
+        entry = metric(obs.registry().snapshot(), "t_over")
+        assert entry["counts"] == [0, 0, 1]
+        assert obs.series_quantile(entry, 0.5) == pytest.approx(99.0)
+
+    def test_snapshot_json_round_trip(self):
+        h = obs.registry().histogram("t_rt")
+        h.observe(0.01)
+        h.observe(0.02)
+        snap = json.loads(json.dumps(obs.registry().snapshot()))
+        entry = metric(snap, "t_rt")
+        assert entry["count"] == 2
+        assert obs.series_quantile(entry, 0.5) is not None
+
+    def test_prometheus_text(self):
+        r = obs.registry()
+        r.counter("t_c", "a counter").inc(3)
+        fam = r.histogram("t_h", labels=("k",), buckets=(0.1, 1.0))
+        fam.labels(k="x").observe(0.5)
+        text = obs.to_prometheus()
+        assert "# TYPE t_c counter" in text
+        assert "t_c 3" in text
+        assert 't_h_bucket{k="x",le="0.1"} 0' in text
+        assert 't_h_bucket{k="x",le="1"} 1' in text
+        assert 't_h_bucket{k="x",le="+Inf"} 1' in text
+        assert 't_h_count{k="x"} 1' in text
+
+
+# ---------------------------------------------------------------- spans
+class TestSpans:
+    def test_nesting_containment(self):
+        tr = obs.tracer()
+        with tr.span("outer", a=1):
+            with tr.span("inner"):
+                pass
+        ev = {e["name"]: e for e in tr.events()}
+        o, i = ev["outer"], ev["inner"]
+        assert o["ts"] <= i["ts"]
+        assert i["ts"] + i["dur"] <= o["ts"] + o["dur"] + 1e-3
+        assert o["args"] == {"a": 1}
+
+    def test_chrome_trace_schema(self, tmp_path):
+        tr = obs.tracer()
+        with tr.span("s1"):
+            pass
+        tr.event("retro", 1.0, 2.0, rid=4)
+        path = tmp_path / "trace.json"
+        tr.save(str(path))
+        doc = json.loads(path.read_text())
+        assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+        for e in doc["traceEvents"]:
+            assert e["ph"] == "X"
+            assert {"name", "ts", "dur", "pid", "tid", "args"} <= set(e)
+            assert e["dur"] >= 0
+        retro = [e for e in doc["traceEvents"] if e["name"] == "retro"][0]
+        assert retro["dur"] == pytest.approx(1e6)
+        assert retro["args"]["rid"] == 4
+
+    def test_decorator_form(self):
+        calls = []
+
+        @obs.tracer().span("deco")
+        def f(x):
+            calls.append(x)
+            return x + 1
+
+        assert f(1) == 2 and f(2) == 3
+        assert [e["name"] for e in obs.tracer().events()] == ["deco", "deco"]
+
+    def test_ring_is_bounded(self):
+        tr = obs.SpanTracer(capacity=4)
+        for i in range(10):
+            tr.event(f"e{i}", 0.0, 0.1)
+        names = [e["name"] for e in tr.events()]
+        assert names == ["e6", "e7", "e8", "e9"]
+
+    def test_record_event_mirrors_into_ring(self):
+        from paddle_tpu.profiler import RecordEvent
+        with RecordEvent("user_scope"):
+            pass
+        assert [e["name"] for e in obs.tracer().events()] == ["user_scope"]
+
+
+# ------------------------------------------------- serving lifecycle
+def _run_engine(model, cfg, n_req=3, tokens=5, **engine_kw):
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, cfg.vocab_size, (4 + 3 * i,))
+               .astype(np.int32) for i in range(n_req)]
+    eng = ServingEngine(model, max_batch=2, page_size=8, max_seq_len=48,
+                        **engine_kw)
+    for p in prompts:
+        eng.submit(p, tokens)
+    out = eng.run()
+    return eng, out
+
+
+class TestServingTelemetry:
+    def _check_lifecycle(self, model, cfg, expected_kind):
+        n_req, tokens = 3, 5
+        eng, out = _run_engine(model, cfg, n_req, tokens)
+        assert eng.decode_key.kind == expected_kind
+        snap = obs.registry().snapshot()
+        assert metric(snap, "serving_requests_submitted")["value"] == n_req
+        assert metric(snap, "serving_requests_finished")["value"] == n_req
+        assert metric(snap, "serving_prefills")["value"] == n_req
+        # one TTFT per request; ITL covers every later token
+        assert metric(snap, "serving_ttft_seconds")["count"] == n_req
+        total = sum(len(v) for v in out.values())
+        assert metric(snap, "serving_inter_token_seconds")["count"] == \
+            total - n_req
+        assert obs.series_quantile(
+            metric(snap, "serving_ttft_seconds"), 0.99) is not None
+        assert metric(snap, "serving_queue_depth")["value"] == 0
+        assert metric(snap, "serving_kv_pages_in_use")["value"] == 0
+        assert metric(snap, "serving_decode_steps")["value"] > 0
+        # a complete per-request timeline in the span ring
+        names = [e["name"] for e in obs.tracer().events()]
+        assert names.count("request.queued") == n_req
+        assert names.count("request.prefill") == n_req
+        assert names.count("request.complete") == n_req
+        assert names.count("engine.decode_step") == \
+            metric(snap, "serving_decode_steps")["value"]
+        completes = [e for e in obs.tracer().events()
+                     if e["name"] == "request.complete"]
+        assert sorted(e["args"]["rid"] for e in completes) == list(out)
+        # zero steady-state retraces, now visible in the snapshot
+        traces = {s["labels"]["kind"]: s["value"] for s in
+                  snap["metrics"]["program_cache_traces"]["series"]}
+        assert traces[expected_kind] == 1
+        # chrome export is valid JSON with the same events
+        doc = json.loads(json.dumps(obs.tracer().chrome_trace()))
+        assert len(doc["traceEvents"]) == len(names)
+
+    def test_lifecycle_fused_decode_path(self):
+        paddle.seed(81)
+        cfg = LlamaConfig.tiny()
+        self._check_lifecycle(LlamaForCausalLM(cfg), cfg, "decode_fused")
+
+    def test_lifecycle_generic_decode_path(self):
+        paddle.seed(82)
+        cfg = GPTConfig.tiny()
+        self._check_lifecycle(GPTForCausalLM(cfg), cfg, "decode_generic")
+
+    def test_prefix_cache_hit_miss_counters(self):
+        paddle.seed(83)
+        cfg = GPTConfig.tiny()
+        model = GPTForCausalLM(cfg)
+        rng = np.random.default_rng(5)
+        prompt = rng.integers(0, cfg.vocab_size, (19,)).astype(np.int32)
+        eng = ServingEngine(model, max_batch=1, page_size=8,
+                            max_seq_len=64, prefix_cache=True)
+        eng.submit(prompt, 4)
+        eng.run()
+        eng.submit(prompt.copy(), 4)      # identical prompt: shared admit
+        eng.run()
+        snap = obs.registry().snapshot()
+        assert metric(snap, "prefix_cache_misses")["value"] == 1
+        assert metric(snap, "prefix_cache_hits")["value"] == 1
+        assert metric(snap, "prefix_cache_hit_pages")["value"] == 2
+        assert metric(snap, "prefix_cache_registered_pages")["value"] >= 2
+        assert metric(snap, "serving_shared_admissions")["value"] == 1
+
+    def test_evict_shortfall_records_pinned_pressure(self):
+        """A pool too tight to admit while cached pages are pinned must
+        bank the shortfall + pinned-page gauge instead of silently
+        under-freeing (the old callers dropped evict()'s return)."""
+        paddle.seed(84)
+        cfg = GPTConfig.tiny()
+        model = GPTForCausalLM(cfg)
+        rng = np.random.default_rng(6)
+        p_long = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+        # pool: null + 4 usable pages; the 16-token prompt + 8 new takes 3
+        eng = ServingEngine(model, max_batch=2, page_size=8,
+                            max_seq_len=24, num_pages=5, prefix_cache=True)
+        eng.submit(p_long, 6)
+        eng.step()                         # admitted; 2 prompt pages cached
+        eng.submit(rng.integers(0, cfg.vocab_size, (16,))
+                   .astype(np.int32), 6)   # needs 3 pages; 1 free; evict
+        eng.step()                         # shortfall: pages rc>1 + pinned
+        snap = obs.registry().snapshot()
+        assert metric(snap, "serving_prefix_evict_shortfall_pages")[
+            "value"] > 0
+        eng.run()
+
+    def test_program_cache_compile_time_banked(self):
+        paddle.seed(85)
+        cfg = GPTConfig.tiny()
+        _run_engine(GPTForCausalLM(cfg), cfg, n_req=2)
+        cache = decode_program_cache()
+        stats = cache.stats()
+        assert stats["compile_seconds"]            # some key was charged
+        assert all(v > 0 for v in stats["compile_seconds"].values())
+        snap = obs.registry().snapshot()
+        series = {s["labels"]["kind"]: s for s in
+                  snap["metrics"]["program_cache_compile_seconds"]["series"]}
+        assert series["decode_generic"]["count"] == 1
+        assert series["decode_generic"]["sum"] > 0
+        # a second engine over the same model reuses both programs
+        paddle.seed(85)
+        _run_engine(GPTForCausalLM(cfg), cfg, n_req=2)
+        assert metric(obs.registry().snapshot(),
+                      "program_cache_hits")["value"] >= 2
+
+
+# ------------------------------------------------------------ training
+class TestTrainTelemetry:
+    def _fit(self, steps=6, k=2):
+        from paddle_tpu.hapi import TrainStep
+
+        paddle.seed(86)
+        cfg = GPTConfig.tiny()
+        model = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+
+        def loss_fn(logits, y):
+            import paddle_tpu.nn.functional as F
+            return F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]), y.reshape([-1]))
+
+        step = TrainStep(model, opt, loss_fn=loss_fn, metrics_every=k)
+        rng = np.random.default_rng(7)
+        ids = rng.integers(0, cfg.vocab_size, (2, 9))
+        x = paddle.to_tensor(ids[:, :-1].astype(np.int32))
+        y = paddle.to_tensor(ids[:, 1:].astype(np.int32))
+        for _ in range(steps):
+            step(x, y)
+        step.sync()
+        return step
+
+    def test_counters_mirror_probes_and_spans_recorded(self):
+        step = self._fit(steps=6, k=2)
+        snap = obs.registry().snapshot()
+        assert metric(snap, "train_syncs")["value"] == step.sync_count
+        assert metric(snap, "train_step_traces")["value"] == \
+            step.trace_count == 1
+        assert metric(snap, "train_throttles")["value"] == 0
+        assert metric(snap, "train_in_flight")["value"] == 0  # post-sync
+        assert metric(snap, "train_pull_seconds")["count"] >= 1
+        names = [e["name"] for e in obs.tracer().events()]
+        assert "train.pull_metrics" in names
+        assert "train.sync" in names
+
+    def test_fit_epoch_sync_span_nests_train_sync(self):
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.io import Dataset
+
+        paddle.seed(87)
+        cfg = GPTConfig.tiny()
+        net = GPTForCausalLM(cfg)
+
+        class DS(Dataset):
+            def __init__(self):
+                rng = np.random.default_rng(8)
+                self.d = rng.integers(0, cfg.vocab_size,
+                                      (8, 9)).astype(np.int32)
+
+            def __len__(self):
+                return len(self.d)
+
+            def __getitem__(self, i):
+                return self.d[i, :-1], self.d[i, 1:]
+
+        def ce(logits, y):
+            import paddle_tpu.nn.functional as F
+            return F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]), y.reshape([-1]))
+
+        m = Model(net)
+        m.prepare(paddle.optimizer.AdamW(1e-4,
+                                         parameters=net.parameters()),
+                  loss=ce)
+        m.fit(DS(), batch_size=4, epochs=1, verbose=0)
+        ev = {e["name"]: e for e in obs.tracer().events()}
+        assert "fit.epoch_sync" in ev and "train.sync" in ev
+        outer, inner = ev["fit.epoch_sync"], ev["train.sync"]
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+        # the prefetcher staged batches through the instrumented path
+        snap = obs.registry().snapshot()
+        assert metric(snap, "io_batches_staged")["value"] >= 2
+
+
+# -------------------------------------------------------- off = no-op
+class TestTelemetryOff:
+    def test_zero_residue(self):
+        flags.set_flags({"telemetry": False})
+        clear_decode_program_cache()
+        paddle.seed(88)
+        cfg = LlamaConfig.tiny()
+        eng, out = _run_engine(LlamaForCausalLM(cfg), cfg, n_req=2,
+                               prefix_cache=True)
+        assert all(len(v) == 5 for v in out.values())
+        assert obs.registry().snapshot()["metrics"] == {}
+        assert len(obs.tracer()) == 0
+        # the cache skipped the timing wrapper entirely
+        assert decode_program_cache().compile_seconds(eng.decode_key) == 0.0
+        assert decode_program_cache().stats()["compile_seconds"] == {}
+
+    def test_off_train_step_leaves_nothing(self):
+        from paddle_tpu.hapi import TrainStep
+
+        flags.set_flags({"telemetry": False})
+        paddle.seed(89)
+        cfg = GPTConfig.tiny()
+        model = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+
+        def loss_fn(logits, y):
+            import paddle_tpu.nn.functional as F
+            return F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]), y.reshape([-1]))
+
+        step = TrainStep(model, opt, loss_fn=loss_fn, metrics_every=1)
+        rng = np.random.default_rng(9)
+        ids = rng.integers(0, cfg.vocab_size, (2, 9))
+        x = paddle.to_tensor(ids[:, :-1].astype(np.int32))
+        y = paddle.to_tensor(ids[:, 1:].astype(np.int32))
+        step(x, y)
+        step.sync()
+        assert step.sync_count >= 1        # probes still work
+        assert obs.registry().snapshot()["metrics"] == {}
+        assert len(obs.tracer()) == 0
+
+
+# ------------------------------------------------- tracecheck: TRC007
+class TestTrc007:
+    def run_snippet(self, tmp_path, source):
+        import textwrap
+
+        from paddle_tpu.analysis.tracecheck import analyze_package
+
+        pkg = tmp_path / "fixpkg"
+        pkg.mkdir(exist_ok=True)
+        (pkg / "__init__.py").write_text("")
+        (pkg / "mod.py").write_text(textwrap.dedent(source))
+        res = analyze_package(str(pkg))
+        assert not res.errors, res.errors
+        return res
+
+    FLAGGED = """
+        import jax
+        from . import observability as obs
+
+        def body(x):
+            obs.registry().counter("c").inc()
+            return x
+
+        step = jax.jit(body)
+    """
+
+    def test_write_under_trace_flagged(self, tmp_path):
+        res = self.run_snippet(tmp_path, self.FLAGGED)
+        assert "TRC007" in [f.rule for f in res.findings]
+        assert "host-side" in [f for f in res.findings
+                               if f.rule == "TRC007"][0].message
+
+    def test_clean_host_side_twin(self, tmp_path):
+        res = self.run_snippet(tmp_path, """
+            import jax
+            from . import observability as obs
+
+            def body(x):
+                return x * 2
+
+            step = jax.jit(body)
+
+            def drive(x):
+                c = obs.registry().counter("c")
+                out = step(x)
+                c.inc()
+                return out
+        """)
+        assert [f.rule for f in res.findings] == []
+
+    def test_hotpath_write_needs_pragma(self, tmp_path):
+        src = """
+            from . import observability as obs
+
+            _C = obs.registry().counter("c")
+
+            def hot(x):  # tracecheck: hotpath
+                _C.inc()
+                return x
+        """
+        res = self.run_snippet(tmp_path, src)
+        assert [f.rule for f in res.findings] == ["TRC007"]
+        res = self.run_snippet(tmp_path, src.replace(
+            "_C.inc()", "_C.inc()  # tracecheck: disable=TRC007"))
+        assert [f.rule for f in res.findings] == []
+        assert len(res.suppressed) == 1
+
+    def test_hotpath_reaches_one_level_into_helpers(self, tmp_path):
+        """Routing a hot path's writes through a plain same-module
+        helper doesn't dodge the annotation contract; the sanctioned
+        `_observe_*` helper idiom is exempt by name."""
+        src = """
+            from . import observability as obs
+
+            class Eng:
+                def __init__(self):
+                    self._c = obs.registry().counter("c")
+
+                def step(self, x):  # tracecheck: hotpath
+                    self.{helper}(x)
+                    return x
+
+                def {helper}(self, x):
+                    self._c.inc()
+        """
+        res = self.run_snippet(tmp_path, src.format(helper="_note"))
+        assert [f.rule for f in res.findings] == ["TRC007"]
+        assert "_note" in res.findings[0].func
+        res = self.run_snippet(tmp_path, src.format(helper="_observe_x"))
+        assert [f.rule for f in res.findings] == []
+
+    def test_method_heuristic_needs_observability_import(self, tmp_path):
+        # .observe() in a module that never imports observability (e.g.
+        # a quantization observer) is not telemetry
+        res = self.run_snippet(tmp_path, """
+            import jax
+
+            def body(x, watcher):
+                watcher.observe(x)
+                return x
+
+            step = jax.jit(body)
+        """)
+        assert [f.rule for f in res.findings] == []
+
+    def test_package_has_no_telemetry_under_trace(self):
+        """The repo-wide assertion: no registry/span write is reachable
+        under trace anywhere in paddle_tpu (hotpath sites are pragma'd
+        with reasons, which is exactly the annotation contract)."""
+        import os
+
+        from paddle_tpu.analysis.tracecheck import (AnalyzerConfig,
+                                                    analyze_package)
+
+        pkg = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "paddle_tpu")
+        res = analyze_package(pkg, AnalyzerConfig(rules=("TRC007",)))
+        assert [f.format() for f in res.findings] == []

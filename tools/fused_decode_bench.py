@@ -121,8 +121,13 @@ def main() -> int:
 
     speedup = (round(unfused["step_ms"] / fused["step_ms"], 3)
                if fused["step_ms"] else None)
+    # the banked row carries its own retrace/cache/latency evidence
+    # (tools/telemetry_dump.py renders it back)
+    from paddle_tpu import observability as obs
+    telemetry = obs.registry().snapshot() if obs.enabled() else None
     emit({
         "metric": "fused_decode_step_ms",
+        "telemetry": telemetry,
         "value": fused["step_ms"],
         "unit": "ms_per_step",
         "vs_baseline": speedup,

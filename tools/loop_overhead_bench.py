@@ -159,8 +159,13 @@ def main() -> int:
 
     speedup = (round(synced["step_ms"] / is_async["step_ms"], 3)
                if is_async["step_ms"] else None)
+    # the banked row carries its own sync/throttle/retrace evidence
+    # (tools/telemetry_dump.py renders it back)
+    from paddle_tpu import observability as obs
+    telemetry = obs.registry().snapshot() if obs.enabled() else None
     emit({
         "metric": "fit_async_step_ms",
+        "telemetry": telemetry,
         "value": is_async["step_ms"],
         "unit": "ms_per_step",
         "vs_baseline": speedup,
