@@ -270,6 +270,39 @@ define_flag("telemetry_ring", 16384,
             "timeline window.")
 define_flag("embedding_deterministic", 0, "API parity with reference embedding determinism flag.")
 define_flag("cudnn_deterministic", False, "API parity alias of FLAGS_deterministic.")
+define_flag("fault_inject", "",
+            "Deterministic fault-injection spec (paddle_tpu.testing."
+            "faults): ';'-separated '<site>:every=N' / '<site>:p=F"
+            "[:seed=N][:times=N][:after=N]' entries arming named "
+            "injection sites (prefill, decode_dispatch, program_build, "
+            "train_dispatch, train_sync, dataloader_worker, "
+            "checkpoint_save). Empty (default) = disabled: components "
+            "bind no-op stubs at construction, zero hot-path cost. "
+            "Eager-only by design — injection never changes a traced "
+            "program, so it is NOT part of PROGRAM_FLAGS.")
+define_flag("serving_max_retries", 3,
+            "ServingEngine replay-recovery budget: how many consecutive "
+            "NO-PROGRESS replays a request survives before it is "
+            "terminated FAILED. A replay after new tokens were emitted "
+            "resets the count — the budget guards wedged requests, not "
+            "long ones under a flaky backend.")
+define_flag("serving_retry_backoff", 0.05,
+            "Base seconds of the serving recovery backoff; doubles per "
+            "consecutive no-progress recovery (capped at 2 s), resets "
+            "once any request makes progress.")
+define_flag("train_max_retries", 2,
+            "Model.fit step-recovery budget: retries of a failed "
+            "dispatch (sync to last-good state, emergency checkpoint, "
+            "backoff, re-dispatch) before the original exception "
+            "propagates.")
+define_flag("train_retry_backoff", 0.05,
+            "Base seconds of the fit recovery backoff; doubles per "
+            "attempt (capped at 2 s).")
+define_flag("dataloader_max_worker_restarts", 2,
+            "Per-worker restart budget for process DataLoader workers "
+            "that die mid-epoch (total budget = this * num_workers); "
+            "beyond it the epoch fails with the restart ledger in the "
+            "message.")
 
 # The flags a TRACED program can read (kernel dispatch, block tuning,
 # matmul precision, nan checks, embedding grad mode) — the flag-tuple

@@ -67,7 +67,13 @@ def _unpack(obj: Any, return_numpy=False) -> Any:
 
 
 def save(obj: Any, path: str, protocol: int = 4, **configs) -> None:
-    """``paddle.save``: pickle nested structures of Tensors to ``path``."""
+    """``paddle.save``: pickle nested structures of Tensors to ``path``.
+
+    ``checkpoint_save`` is a fault-injection site (FLAGS_fault_inject):
+    it fires BEFORE anything touches disk, so an injected save failure
+    never leaves a truncated checkpoint behind."""
+    from ..testing import faults
+    faults.check("checkpoint_save", path=path)
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

@@ -85,7 +85,12 @@ class DecodeProgramCache:
 
     def __init__(self):
         from .. import observability as obs
+        from ..testing import faults
 
+        # build-path fault injection (FLAGS_fault_inject
+        # 'program_build:...'): bound at cache construction; use
+        # clear_decode_program_cache() to re-arm after a flag change
+        self._f_build = faults.site("program_build")
         self._lock = threading.Lock()
         self._programs: Dict[DecodeKey, Any] = {}
         self._trace_counts: Dict[DecodeKey, int] = {}
@@ -128,6 +133,7 @@ class DecodeProgramCache:
                 self.hits += 1
                 self._m_hits.inc()
                 return fn
+        self._f_build.check(kind=key.kind)   # injected build failure
         fn = builder(self._tracer(key))      # may be slow: build unlocked
         if self._telemetry:
             fn = self._timed_dispatch(key, fn)

@@ -33,8 +33,12 @@ import jax.numpy as jnp
 
 from .. import observability as obs
 from ..kernels.paged_attention import PagedDecodeState, PagedKVCache
+from ..testing import faults
 
 __all__ = ["ServingEngine", "Request"]
+
+# terminal request statuses (Request.status / ServingEngine.status)
+OK, FAILED, TIMEOUT = "OK", "FAILED", "TIMEOUT"
 
 
 @dataclass
@@ -54,6 +58,16 @@ class Request:
     # last generated-token time (inter-token latency baseline)
     t_submit: float = 0.0
     t_last: float = 0.0
+    # absolute perf_counter cutoff (submit(deadline=...)); enforced at
+    # step boundaries — None = no deadline
+    deadline: Optional[float] = None
+    # terminal status ("PENDING" while queued/in flight)
+    status: str = "PENDING"
+    error: Optional[str] = None
+    # replay-recovery bookkeeping: consecutive no-progress replays, and
+    # the token count at the last failure (progress resets the budget)
+    retries: int = 0
+    progress_mark: int = -1
 
 
 class _EngineTelemetry:
@@ -102,6 +116,31 @@ class _EngineTelemetry:
             "serving_prefix_evict_shortfall_pages",
             "pages evict() was asked for but could not free "
             "(pinned/shared)")
+        # ---- fault-tolerance instruments (replay recovery, r10)
+        self.retries = r.counter(
+            "serving_retries_total",
+            "in-flight request replays re-queued by recovery after a "
+            "failed dispatch")
+        self.recoveries = r.counter(
+            "serving_recoveries",
+            "replay-recovery events: failed dispatch -> fresh pools + "
+            "re-queue of all in-flight requests")
+        self.requests_failed = r.counter(
+            "serving_requests_failed",
+            "requests terminated FAILED (no-progress retry budget "
+            "exhausted)")
+        self.requests_timeout = r.counter(
+            "serving_requests_timeout",
+            "requests terminated TIMEOUT (per-request deadline or the "
+            "run(max_wall=...) watchdog)")
+        self.recovery_seconds = r.histogram(
+            "serving_recovery_seconds",
+            "wall clock of one replay recovery (fresh pools + requeue, "
+            "excluding backoff sleep)")
+        self.page_pressure = r.gauge(
+            "serving_page_pressure",
+            "KV pages short at the last page-blocked admission (0 = "
+            "admission is not page-blocked)")
 
 
 class _NullEngineTelemetry:
@@ -118,6 +157,9 @@ class _NullEngineTelemetry:
         self.queue_depth = self.occupancy = obs.NULL
         self.kv_pages_in_use = self.prefix_pinned = obs.NULL
         self.evict_short = obs.NULL
+        self.retries = self.recoveries = obs.NULL
+        self.requests_failed = self.requests_timeout = obs.NULL
+        self.recovery_seconds = self.page_pressure = obs.NULL
 
 
 class _PrefixTelemetry:
@@ -297,11 +339,14 @@ class ServingEngine:
         ensure_live(params, "call step.sync_to_model() first.")
         self._params, self._buffers = params, buffers
         dtype = jnp.result_type(next(iter(params.values())))
-        self.pool = PagedKVCache(
+        # pool geometry is kept so replay recovery can allocate FRESH
+        # pools with the identical shape (same compiled programs apply)
+        self._pool_geom = dict(
             num_layers=len(spec), num_pages=num_pages, page_size=page_size,
             num_kv_heads=spec[0][0], head_dim=spec[0][1],
             max_batch=max_batch, max_seq_len=max_seq_len, dtype=dtype,
             reserve_null_page=True)
+        self.pool = PagedKVCache(**self._pool_geom)
         maxpos = getattr(getattr(model, "config", None),
                          "max_position_embeddings", None)
         if maxpos is not None and max_seq_len > maxpos:
@@ -311,12 +356,25 @@ class ServingEngine:
         self._slots: List[Optional[Request]] = [None] * max_batch
         self._queue: List[Request] = []
         self._results: Dict[int, List[int]] = {}
+        self._status: Dict[int, str] = {}
         self._last_tok = np.zeros((max_batch,), np.int32)
         self._next_rid = 0
         self._prefill_fn = None
         self._decode_fn = None
         self.decode_key = None      # set on first decode (test probe)
+        self._prefix_enabled = bool(prefix_cache)
         self._prefix = PrefixCache(self.pool) if prefix_cache else None
+        # ---- fault tolerance: injection sites bind at construction
+        # (NULL stubs when FLAGS_fault_inject is unset — zero hot-path
+        # cost, the telemetry idiom) and the replay-recovery budget
+        from .. import flags as _rflags
+        self._f_prefill = faults.site("prefill")
+        self._f_decode = faults.site("decode_dispatch")
+        self.max_retries = int(_rflags.get_flag("serving_max_retries"))
+        self.retry_backoff = float(
+            _rflags.get_flag("serving_retry_backoff"))
+        self._consec_failures = 0   # engine-wide no-progress failures
+        self._failed_admission: Optional[Request] = None
         # flag resolution happens ONCE per engine; the PROGRAM_FLAGS
         # snapshot (every flag a traced program can read — kernel
         # dispatch, flash blocks, compact stats, matmul precision) is
@@ -336,7 +394,12 @@ class ServingEngine:
 
     # ------------------------------------------------------------ frontend
     def submit(self, prompt, max_new_tokens: int = 32,
-               eos_token_id: Optional[int] = None) -> int:
+               eos_token_id: Optional[int] = None,
+               deadline: Optional[float] = None) -> int:
+        """Enqueue one request. ``deadline`` (seconds from now) bounds
+        its total latency: a request past its deadline — queued or in
+        flight — is terminated ``TIMEOUT`` at the next step boundary
+        with whatever tokens it produced."""
         prompt = np.asarray(
             prompt._value if hasattr(prompt, "_value") else prompt,
             np.int32).reshape(-1)
@@ -356,6 +419,8 @@ class ServingEngine:
         self._next_rid += 1
         req = Request(rid, prompt, int(max_new_tokens), eos_token_id)
         req.t_submit = time.perf_counter()
+        if deadline is not None:
+            req.deadline = req.t_submit + float(deadline)
         self._queue.append(req)
         self._m.submitted.inc()
         return rid
@@ -363,11 +428,42 @@ class ServingEngine:
     def has_work(self) -> bool:
         return bool(self._queue) or any(s is not None for s in self._slots)
 
-    def run(self) -> Dict[int, List[int]]:
+    def run(self, max_wall: Optional[float] = None) -> Dict[int, List[int]]:
+        """Step until drained and return ``{rid: tokens}`` (partial
+        tokens for FAILED/TIMEOUT requests — check :meth:`status`).
+        ``max_wall`` is the watchdog: past it, everything still queued
+        or in flight is terminated ``TIMEOUT`` and ``run`` returns
+        instead of spinning on a wedged backend."""
+        t0 = time.perf_counter()
         while self.has_work():
+            if max_wall is not None and \
+                    time.perf_counter() - t0 > max_wall:
+                self._expire_all("run(max_wall=%.3f) watchdog" % max_wall)
+                break
             self.step()
         out, self._results = self._results, {}
+        # statuses are retained for exactly the requests this drain
+        # returned: a long-lived engine must not accumulate one status
+        # entry per request forever
+        self._status = {rid: self._status[rid] for rid in out
+                        if rid in self._status}
         return out
+
+    def results(self) -> Dict[int, List[int]]:
+        """Completed results accumulated so far, WITHOUT draining them —
+        the exception-safety accessor: after a mid-``run`` raise, every
+        request that finished before the failure is retrievable here
+        (``run`` only hands over-and-clears on a clean drain)."""
+        return {rid: list(toks) for rid, toks in self._results.items()}
+
+    def status(self, rid: int) -> str:
+        """Terminal status for ``rid``: ``OK`` / ``FAILED`` / ``TIMEOUT``
+        (``PENDING`` while queued or in flight). Statuses survive until
+        the NEXT completed ``run`` drain, then prune with its results."""
+        return self._status.get(rid, "PENDING")
+
+    def statuses(self) -> Dict[int, str]:
+        return dict(self._status)
 
     # ------------------------------------------------- compiled programs
     def _key(self, kind: str):
@@ -464,12 +560,25 @@ class ServingEngine:
         self._slots[slot] = req
         self._m.shared_admits.inc()
 
+    def _admission_feed(self, req: Request) -> np.ndarray:
+        """What prefill teacher-forces for this admission. First
+        admission: the prompt. Replay admission (recovery re-queued an
+        in-flight request): prompt + every already-emitted token — all
+        host-side state — so the b=1 prefill reconstructs the KV cache
+        and its argmax IS the next greedy token. Greedy decoding makes
+        the replayed continuation identical to the uninterrupted one."""
+        if not req.tokens:
+            return req.prompt
+        return np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)])
+
     def _prefill(self, req: Request, slot: int) -> None:
         # queued phase closes at admission: submit() -> here (once per
         # REQUEST, not per token)  # tracecheck: disable=TRC007
         self._m.event("request.queued", req.t_submit, time.perf_counter(),
                       rid=req.rid)
-        if self._prefix is not None:
+        replay = bool(req.tokens)
+        if self._prefix is not None and not replay:
             pages, n_cached = self._prefix.lookup(req.prompt)
             # never cover the WHOLE prompt: the first generated token's
             # logits are not cached, so at least one prompt token must go
@@ -488,21 +597,24 @@ class ServingEngine:
                 self._admit_shared(req, slot, pages, n_cached)
                 return
 
-        p = len(req.prompt)
+        feed = self._admission_feed(req)
+        p = len(feed)
         # the cached prefill program: jit itself caches one compilation
         # per prompt length (bucket/pad prompts in production to bound
         # that set); the program-cache layer shares those compilations
         # across engine instances over the same model
         fn = self._prefill_program()
 
-        self.pool.allocate(slot, p + req.max_new_tokens)
+        remaining = req.max_new_tokens - len(req.tokens)
+        self.pool.allocate(slot, p + remaining)
         bt = jnp.asarray(self.pool.block_tables[slot:slot + 1])
         # per-request prefill timeline span  # tracecheck: disable=TRC007
         with self._m.span("request.prefill", rid=req.rid, prompt_len=p):
+            pools = self.pool.take_pools()
+            self._f_prefill.check()
             tok, states = fn(self._params, self._buffers,
-                             jnp.asarray(req.prompt[None]),
-                             self.pool.take_pools(),
-                             bt, jnp.zeros((1,), jnp.int32))
+                             jnp.asarray(feed[None]),
+                             pools, bt, jnp.zeros((1,), jnp.int32))
             # b=1 prefill wrote THROUGH slot's block table into the
             # shared pool arrays; adopt them and the slot's bookkeeping
             self._store(states)
@@ -512,30 +624,50 @@ class ServingEngine:
         self.pool.seq_lens[slot] = p
         self._last_tok[slot] = tok
         tnow = time.perf_counter()
+        if replay:
+            # the replayed prefill's token continues the sequence: its
+            # latency is inter-token, not a second TTFT
+            # tracecheck: disable=TRC007
+            self._m.itl.observe(tnow - req.t_last)
+        else:
+            # TTFT closes on the prefill's token
+            # tracecheck: disable=TRC007
+            self._m.ttft.observe(tnow - req.t_submit)
         req.t_last = tnow
-        # TTFT closes on the prefill's token  # tracecheck: disable=TRC007
-        self._m.ttft.observe(tnow - req.t_submit)
         req.tokens.append(tok)
         req.slot = slot
         self._slots[slot] = req
-        if self._prefix is not None:
+        if self._prefix is not None and not replay:
             # pin this prompt's full pages for future shared admissions
             # (they are immutable: later writes land at seq_len and up)
             self._prefix.register(req.prompt, self.pool.block_tables[slot])
         self._finish_if_done(req)
+
+    def _finalize(self, req: Request, status: str,
+                  error: Optional[str] = None) -> None:
+        """Terminal bookkeeping shared by every way a request ends:
+        release its slot/pages/pins, bank its tokens (partial for
+        FAILED/TIMEOUT) and record the status. Pure host state — no
+        telemetry here (callers observe through ``_observe_*``)."""
+        if req.slot is not None:
+            self.pool.free_sequence(req.slot)
+            self._slots[req.slot] = None
+            req.slot = None
+        if req.pinned and self._prefix is not None:
+            self._prefix.unpin(req.pinned)
+        req.pinned = []
+        req.pending = []
+        req.status = status
+        req.error = error
+        self._results[req.rid] = req.tokens
+        self._status[req.rid] = status
 
     def _finish_if_done(self, req: Request) -> None:
         done = len(req.tokens) >= req.max_new_tokens or (
             req.eos_token_id is not None
             and req.tokens and req.tokens[-1] == req.eos_token_id)
         if done and req.slot is not None:
-            self.pool.free_sequence(req.slot)
-            if req.pinned and self._prefix is not None:
-                self._prefix.unpin(req.pinned)
-                req.pinned = []
-            self._slots[req.slot] = None
-            self._results[req.rid] = req.tokens
-            req.slot = None
+            self._finalize(req, OK)
             # once per finished request  # tracecheck: disable=TRC007
             self._m.finished.inc()
             if self._m.enabled:
@@ -544,7 +676,128 @@ class ServingEngine:
                               time.perf_counter(), rid=req.rid,
                               tokens=len(req.tokens))
 
+    def _sweep_deadlines(self) -> None:
+        """Step-boundary deadline enforcement: terminate every queued or
+        in-flight request past its ``submit(deadline=...)`` cutoff with
+        status TIMEOUT and its partial tokens banked."""
+        now = time.perf_counter()
+        expired = [r for r in self._slots
+                   if r is not None and r.deadline is not None
+                   and now > r.deadline]
+        expired += [r for r in self._queue
+                    if r.deadline is not None and now > r.deadline]
+        if not expired:
+            return
+        rids = {r.rid for r in expired}
+        self._queue = [r for r in self._queue if r.rid not in rids]
+        for req in expired:
+            self._finalize(req, TIMEOUT, "deadline exceeded")
+        self._observe_timeouts(len(expired))
+
+    def _expire_all(self, why: str) -> None:
+        """The ``run(max_wall=...)`` watchdog tripped: terminate every
+        remaining request TIMEOUT instead of spinning forever."""
+        remaining = [r for r in self._slots if r is not None]
+        remaining += list(self._queue)
+        self._queue = []
+        for req in remaining:
+            self._finalize(req, TIMEOUT, why)
+        if remaining:
+            self._observe_timeouts(len(remaining))
+        self._observe_step_end()
+
     def step(self) -> None:  # tracecheck: hotpath
+        """One scheduler round: deadline sweep, admission, one decode
+        dispatch. A failed dispatch does NOT propagate — replay recovery
+        (fresh pools, re-queue of all in-flight requests, bounded
+        retries with exponential backoff) runs instead, and requests
+        only ever end in a terminal OK/FAILED/TIMEOUT status."""
+        try:
+            self._step_inner()
+            self._consec_failures = 0
+        except Exception as exc:
+            self._recover_dispatch(exc)
+
+    def _recover_dispatch(self, exc: Exception) -> None:
+        """Replay recovery. The donated dispatch died, so the pool is
+        already detached (r08 discipline) and its device buffers are
+        unrecoverable — but every request's prompt AND emitted tokens
+        are host-side state. Allocate fresh pools, terminate requests
+        whose no-progress retry budget is exhausted, re-queue the rest
+        for re-prefill from prompt + emitted tokens (greedy decoding
+        makes the replayed continuation bit-identical), and back off
+        exponentially while nothing progresses."""
+        t0 = time.perf_counter()
+        live = [r for r in self._slots if r is not None]
+        failed_adm = self._failed_admission
+        self._failed_admission = None
+        # a failed admission was rolled back before the raise, so it is
+        # never also in a slot
+        victims = live + ([failed_adm] if failed_adm is not None else [])
+        if not victims:
+            # nothing was in flight: this is not a dispatch failure the
+            # replay machinery can absorb — a bookkeeping error must
+            # stay loud (results so far remain retrievable, see
+            # ``results()``)
+            raise exc
+        self._rebuild_pool()
+        survivors: List[Request] = []
+        failed: List[Request] = []
+        any_progress = False
+        for req in victims:
+            req.slot = None
+            req.pending = []
+            req.pinned = []     # pinned pages died with the old pool
+            progress = len(req.tokens)
+            if progress > req.progress_mark:
+                any_progress = True
+                req.retries = 1
+            else:
+                req.retries += 1
+            req.progress_mark = progress
+            if req.retries > self.max_retries:
+                failed.append(req)
+            else:
+                survivors.append(req)
+        self._slots = [None] * self.max_batch
+        self._last_tok[:] = 0
+        for req in failed:
+            self._finalize(req, FAILED, repr(exc))
+        # replays keep their submission order relative to the queue
+        self._queue = sorted(survivors + self._queue,
+                             key=lambda r: r.rid)
+        self._consec_failures = (1 if any_progress
+                                 else self._consec_failures + 1)
+        self._observe_recovery(len(survivors), len(failed),
+                               time.perf_counter() - t0)
+        if self._queue:
+            time.sleep(min(
+                self.retry_backoff * (2 ** (self._consec_failures - 1)),
+                2.0))
+
+    def _rebuild_pool(self) -> None:
+        """Fresh pools with the identical geometry, so the already-
+        compiled prefill/decode programs (keyed on that geometry) serve
+        the replays without a retrace. The prefix cache indexed pages of
+        the dead pool and restarts empty."""
+        self.pool = PagedKVCache(**self._pool_geom)
+        self._prefix = (PrefixCache(self.pool)
+                        if self._prefix_enabled else None)
+
+    def _rollback_admission(self, req: Request, slot: int) -> None:
+        """Undo a partial admission (page exhaustion mid-``allocate``):
+        return the slot's pages, drop adopted pins, clear teacher-forced
+        state — the request goes back to the queue head intact."""
+        self.pool.free_sequence(slot)
+        if req.pinned and self._prefix is not None:
+            self._prefix.unpin(req.pinned)
+        req.pinned = []
+        req.pending = []
+        req.slot = None
+        self._slots[slot] = None
+
+    def _step_inner(self) -> None:  # tracecheck: hotpath
+        self._sweep_deadlines()
         # admission: fill every free slot that has pages available
         for slot in range(self.max_batch):
             if self._slots[slot] is None and self._queue:
@@ -560,9 +813,32 @@ class ServingEngine:
                     if freed < want:
                         self._observe_evict_shortfall(want - freed)
                 if need > self.pool.free_page_count():
-                    break           # wait for pages (FIFO, no starvation)
+                    # graceful degradation: the request WAITS in the
+                    # queue (FIFO, no starvation) and the shortfall is
+                    # published as pressure, not an exception
+                    self._observe_page_pressure(
+                        need - self.pool.free_page_count())
+                    break
                 self._queue.pop(0)
-                self._prefill(req, slot)
+                try:
+                    self._prefill(req, slot)
+                except Exception as e:
+                    if isinstance(e, RuntimeError) and \
+                            "page pool exhausted" in str(e):
+                        # allocate came up short mid-step (pinned pages
+                        # under-counted by the pre-check): back off to
+                        # the queue instead of killing the step
+                        self._rollback_admission(req, slot)
+                        self._queue.insert(0, req)
+                        self._observe_page_pressure(max(
+                            1, need - self.pool.free_page_count()))
+                        break
+                    # dispatch failure: hand the request to recovery
+                    # (it holds no slot state after the rollback)
+                    self._rollback_admission(req, slot)
+                    self._failed_admission = req
+                    raise
+                self._observe_page_pressure(0)
 
         active = [s for s in self._slots if s is not None]
         self._observe_step_begin(len(active))
@@ -573,10 +849,12 @@ class ServingEngine:
         bt = jnp.asarray(self.pool.block_tables[:self.max_batch])
         sl = jnp.asarray(self.pool.seq_lens[:self.max_batch])
         t0 = time.perf_counter() if self._m.enabled else 0.0
+        pools = self.pool.take_pools()
+        self._f_decode.check()
         toks, states = fn(
             self._params, self._buffers,
             jnp.asarray(self._last_tok[:, None]),
-            self.pool.take_pools(), bt, sl)
+            pools, bt, sl)
         self._store(states)
         # the scheduler's designed sync point: admission/eviction need
         # the concrete token ids  # tracecheck: disable=TRC002
@@ -644,8 +922,34 @@ class ServingEngine:
         m.occupancy.set(self.max_batch - self._slots.count(None))
         m.kv_pages_in_use.set(
             self.pool.num_pages - 1 - self.pool.free_page_count())
+        if not self._queue:
+            m.page_pressure.set(0)      # an empty queue has no pressure
         if self._prefix is not None:
             m.prefix_pinned.set(self._prefix.pinned_page_count())
+
+    def _observe_page_pressure(self, short: int) -> None:
+        """Admission is (or stopped being) page-blocked: publish how
+        many pages short the queue head is."""
+        if self._m.enabled:
+            self._m.page_pressure.set(short)
+
+    def _observe_timeouts(self, n: int) -> None:
+        if self._m.enabled:
+            self._m.requests_timeout.inc(n)
+
+    def _observe_recovery(self, n_replayed: int, n_failed: int,
+                          dt: float) -> None:
+        """One replay-recovery event: how many requests were re-queued,
+        how many were terminated FAILED, and the recovery wall clock."""
+        m = self._m
+        if not m.enabled:
+            return
+        m.recoveries.inc()
+        if n_replayed:
+            m.retries.inc(n_replayed)
+        if n_failed:
+            m.requests_failed.inc(n_failed)
+        m.recovery_seconds.observe(dt)
 
     def _observe_evict_shortfall(self, short: int) -> None:
         """``evict()`` freed fewer pages than the admission asked for:

@@ -16,7 +16,9 @@ need exact state.
 
 from __future__ import annotations
 
+import os
 import time
+import warnings
 from typing import List, Optional
 
 import numpy as np
@@ -24,8 +26,34 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..metric import Metric
 from ..nn.layer import Layer
+from ..testing.faults import InjectedFault
 from . import callbacks as cb_mod
 from .train_step import TrainStep
+
+
+def _fit_recovery_metrics():
+    """Lazily-bound fit-recovery counters on the r09 registry (None
+    with telemetry off). Resolved per fit-recovery event — a cold path
+    by definition."""
+    from .. import observability as obs
+    if not obs.enabled():
+        return None
+    r = obs.registry()
+    return {
+        "retries": r.counter(
+            "train_retries_total",
+            "fit step-recovery attempts (sync to last-good state, "
+            "emergency checkpoint, backoff, re-dispatch)"),
+        "recoveries": r.counter(
+            "train_recoveries",
+            "fit step recoveries that resumed training"),
+        "ckpts": r.counter(
+            "train_emergency_checkpoints",
+            "emergency checkpoints written by fit recovery / nan_policy"),
+        "nans": r.counter(
+            "train_nan_losses",
+            "non-finite losses seen by the fit NaN/inf policy"),
+    }
 
 
 class Model:
@@ -123,7 +151,8 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, metrics_every=None,
-            jit=None, prefetch_to_device=True, use_process_workers=False):
+            jit=None, prefetch_to_device=True, use_process_workers=False,
+            nan_policy="raise"):
         """Train. Async by default: the jitted TrainStep dispatches ahead
         of the device and the loss shown to callbacks is stale-by-k
         (``metrics_every``, default ``log_freq``); hard device syncs
@@ -134,8 +163,23 @@ class Model:
         ``prefetch_to_device`` stages batch N+1 host->device while step N
         runs (double buffering). ``use_process_workers`` moves the
         ``num_workers`` loader workers into OS processes (shared-memory
-        batch transport) for GIL-bound ``__getitem__`` transforms."""
+        batch transport) for GIL-bound ``__getitem__`` transforms.
+
+        Fault tolerance: a step that fails mid-flight (after at least
+        one good step) is recovered — the async window drains to the
+        last-good state, an emergency checkpoint is written under
+        ``save_dir`` and the batch is re-dispatched with exponential
+        backoff, ``FLAGS_train_max_retries`` times — before the original
+        exception propagates. ``nan_policy`` decides what a non-finite
+        loss does: ``'raise'`` (default) raises ``FloatingPointError``,
+        ``'skip'`` counts it and keeps training, ``'stop'`` writes the
+        emergency checkpoint and ends training cleanly."""
         from ..io import Dataset, DataLoader, DevicePrefetcher
+
+        if nan_policy not in ("raise", "skip", "stop"):
+            raise ValueError(
+                f"nan_policy must be 'raise', 'skip' or 'stop'; got "
+                f"{nan_policy!r}")
 
         if isinstance(train_data, Dataset):
             train_loader = DataLoader(train_data, batch_size=batch_size,
@@ -172,38 +216,53 @@ class Model:
             for step, batch in enumerate(iterator):
                 cbks.on_batch_begin("train", step, {})
                 if step_obj is not None:
+                    n0 = step_obj._step_count
                     try:
                         logs = self._async_batch(step_obj, batch, step,
                                                  epoch_base)
-                    except Exception:
-                        # forward isn't jit-safe (trace errors surface on
-                        # the first dispatch, before any donation
-                        # executes): fall back to the eager loop for the
-                        # rest of training. Failures after ANY successful
-                        # jitted step are real bugs — and falling back
-                        # then would discard the device-side progress the
-                        # Layer's (donated) tensors no longer hold.
-                        if step > 0 or step_obj._step_count > 0:
-                            raise
-                        from .train_step import StagedBatch
-                        raw = (batch.raw if isinstance(batch, StagedBatch)
-                               else batch)
-                        if raw is None:
-                            raise
-                        import sys
-                        import traceback
-                        import warnings
-                        traceback.print_exc(file=sys.stderr)
-                        warnings.warn(
-                            "Model.fit: first jitted step failed (trace "
-                            "above); falling back to the eager per-step "
-                            "loop (slower). Pass jit=False to silence.")
-                        step_obj = self._train_step = None
-                        logs = self._eager_batch(raw, step)
+                    except Exception as exc:
+                        # Failures AFTER any successful jitted step (or
+                        # an injected fault at any point) go through
+                        # step recovery: drain to last-good state,
+                        # emergency checkpoint, bounded backoff retry.
+                        # A step-0 trace error instead falls back to the
+                        # eager loop — the forward isn't jit-safe and no
+                        # device progress exists to protect yet.
+                        if step > 0 or step_obj._step_count > 0 or \
+                                isinstance(exc, InjectedFault):
+                            logs = self._recover_batch(
+                                step_obj, batch, step, epoch_base,
+                                save_dir, exc,
+                                dispatched=step_obj._step_count > n0)
+                        else:
+                            from .train_step import StagedBatch
+                            raw = (batch.raw
+                                   if isinstance(batch, StagedBatch)
+                                   else batch)
+                            if raw is None:
+                                raise
+                            import sys
+                            import traceback
+                            traceback.print_exc(file=sys.stderr)
+                            warnings.warn(
+                                "Model.fit: first jitted step failed "
+                                "(trace above); falling back to the "
+                                "eager per-step loop (slower). Pass "
+                                "jit=False to silence.")
+                            step_obj = self._train_step = None
+                            logs = self._eager_batch(raw, step)
                 else:
                     logs = self._eager_batch(batch, step)
+                loss_val = (logs.get("loss")
+                            if isinstance(logs, dict) else None)
+                if loss_val is not None and not np.isfinite(loss_val):
+                    self._handle_nan(nan_policy, save_dir,
+                                     float(loss_val),
+                                     where=f"epoch {epoch} step {step}")
                 cbks.on_batch_end("train", step, logs)
                 it += 1
+                if self.stop_training:
+                    break               # nan_policy='stop' mid-epoch
                 if num_iters is not None and it >= num_iters:
                     break
             done = self.stop_training or (num_iters is not None
@@ -217,7 +276,12 @@ class Model:
                 from ..observability import span as _span
                 logs = dict(logs)
                 with _span("fit.epoch_sync", epoch=epoch):
-                    logs["loss"] = step_obj.sync()
+                    logs["loss"] = self._sync_with_retry(step_obj)
+                if logs["loss"] is not None and \
+                        not np.isfinite(logs["loss"]):
+                    self._handle_nan(nan_policy, save_dir,
+                                     float(logs["loss"]),
+                                     where=f"epoch {epoch} sync")
                 m = step_obj.last_metrics
                 if m is not None and m["loss_step"] >= epoch_base:
                     # retag: the barrier loss is exact — stale tags from
@@ -239,6 +303,125 @@ class Model:
         if step_obj is not None:
             step_obj.sync_to_model()
         cbks.on_end("train")
+
+    # ------------------------------------------------------ fault tolerance
+    def _sync_with_retry(self, step_obj):
+        """Epoch-boundary sync with bounded retry of INJECTED sync
+        faults only (host-side by construction — the window is intact);
+        a real device failure propagates untouched."""
+        from .. import flags
+        max_retries = int(flags.get_flag("train_max_retries"))
+        backoff = float(flags.get_flag("train_retry_backoff"))
+        for attempt in range(max_retries + 1):
+            try:
+                return step_obj.sync()
+            except InjectedFault:
+                if attempt == max_retries:
+                    raise
+                time.sleep(min(backoff * (2 ** attempt), 2.0))
+
+    def _recover_batch(self, step_obj, batch, step, epoch_base, save_dir,
+                       exc, dispatched):
+        """Step recovery: the dispatch (or its metrics pull) raised.
+        Sync the async window to the last-good state — a dispatch-time
+        failure never consumed its donated buffers, so every previously
+        dispatched step retires cleanly — write an emergency checkpoint
+        under ``save_dir``, back off, and re-dispatch the same batch.
+        ``dispatched``: the failed call got PAST its dispatch (the
+        raise came from the metrics pull), so the update is already
+        applied and re-dispatching would train the batch twice — resume
+        from the sync instead. Raises the last failure once
+        ``FLAGS_train_max_retries`` is exhausted."""
+        from .. import flags
+        max_retries = int(flags.get_flag("train_max_retries"))
+        backoff = float(flags.get_flag("train_retry_backoff"))
+        m = _fit_recovery_metrics()
+        warnings.warn(
+            f"Model.fit: step {step} failed ({exc!r}); attempting "
+            f"recovery (sync to last-good state + emergency checkpoint, "
+            f"<= {max_retries} retries)")
+        last = exc
+        for attempt in range(1, max_retries + 1):
+            if m:
+                m["retries"].inc()
+            try:
+                step_obj.sync()
+            except InjectedFault as e:
+                last = e
+                time.sleep(min(backoff * (2 ** (attempt - 1)), 2.0))
+                continue
+            except Exception as e:
+                # a step already in flight failed ON DEVICE: its donated
+                # params are gone and nothing host-side can replay them
+                raise RuntimeError(
+                    "Model.fit recovery: draining the in-flight window "
+                    "failed — a dispatched step died on device and its "
+                    "donated state is unrecoverable; restart from the "
+                    "last checkpoint") from e
+            self._emergency_checkpoint(save_dir, m)
+            if dispatched:
+                # the update applied before the raise; resuming from the
+                # sync is the exactly-once behavior
+                if m:
+                    m["recoveries"].inc()
+                return {"step": step, "loss": step_obj._last_loss}
+            time.sleep(min(backoff * (2 ** (attempt - 1)), 2.0))
+            try:
+                logs = self._async_batch(step_obj, batch, step,
+                                         epoch_base)
+                if m:
+                    m["recoveries"].inc()
+                return logs
+            except Exception as e:
+                last = e
+        raise last
+
+    def _emergency_checkpoint(self, save_dir, m=None):
+        """Best-effort pre-retry checkpoint (``<save_dir>/emergency``):
+        the state every successfully dispatched step produced, saved
+        before anything is re-dispatched. Its own save path is retried
+        (checkpoint_save is an injection site too); total failure warns
+        and recovery proceeds — a missing checkpoint must not turn a
+        recoverable step failure into a fatal one."""
+        if save_dir is None:
+            return None
+        path = os.path.join(save_dir, "emergency")
+        os.makedirs(save_dir, exist_ok=True)
+        err = None
+        for attempt in range(3):
+            try:
+                self.save(path)
+                if m:
+                    m["ckpts"].inc()
+                return path
+            except Exception as e:
+                err = e
+                time.sleep(0.02 * (2 ** attempt))
+        warnings.warn(
+            f"Model.fit: emergency checkpoint failed 3 times ({err!r}); "
+            f"continuing recovery without it")
+        return None
+
+    def _handle_nan(self, policy, save_dir, loss, where):
+        """Apply the fit ``nan_policy`` to one non-finite loss."""
+        m = _fit_recovery_metrics()
+        if m:
+            m["nans"].inc()
+        if policy == "raise":
+            raise FloatingPointError(
+                f"Model.fit: non-finite loss {loss} at {where} "
+                f"(nan_policy='raise'; use 'skip' or 'stop' to "
+                f"tolerate)")
+        if policy == "stop":
+            warnings.warn(
+                f"Model.fit: non-finite loss {loss} at {where}; "
+                f"nan_policy='stop' — emergency checkpoint + clean stop")
+            self._emergency_checkpoint(save_dir, m)
+            self.stop_training = True
+        else:
+            warnings.warn(
+                f"Model.fit: non-finite loss {loss} at {where}; "
+                f"nan_policy='skip' — continuing")
 
     def _stage_batch(self, batch):
         """Split a loader batch into (inputs..., labels) and stage it on

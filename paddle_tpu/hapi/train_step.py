@@ -139,6 +139,11 @@ class TrainStep:
         self._trace_count = 0    # step-fn retraces (probe-visible)
         self._m = (_TrainTelemetry() if obs.enabled()
                    else _NullTrainTelemetry())
+        # fault-injection sites (paddle_tpu.testing.faults): bound at
+        # construction like telemetry — NULL stubs when disabled
+        from ..testing import faults
+        self._f_dispatch = faults.site("train_dispatch")
+        self._f_sync = faults.site("train_sync")
         self._traces_seen = 0    # registry mirror high-water mark
         self.last_metrics: Optional[Dict[str, Any]] = None
         self._last_loss: Optional[float] = None
@@ -519,6 +524,11 @@ class TrainStep:
         return StagedBatch(vals)
 
     def __call__(self, *batch) -> Tensor:  # tracecheck: hotpath
+        # the injected train_dispatch failure fires HERE, before any
+        # state mutates: params/opt_state still hold live buffers (the
+        # donating call below never ran), so fit's recovery can sync to
+        # last-good state and simply re-dispatch the same batch
+        self._f_dispatch.check()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         if len(batch) == 1 and isinstance(batch[0], StagedBatch):
             vals = batch[0].vals
@@ -588,6 +598,9 @@ class TrainStep:
         loss is simply stale-by-``lag``. Counts as one blocking sync.
         Returns ``{"loss", "loss_step", "staleness"}`` (the previous
         metrics when nothing is old enough to pull yet)."""
+        # injected train_sync failure fires before any window mutation,
+        # so a caller can retry the pull verbatim
+        self._f_sync.check()
         lag = (self.metrics_every or 1) if lag is None else max(0, int(lag))
         target = self._step_count - lag
         picked = None
@@ -621,6 +634,7 @@ class TrainStep:
         (per-device execution order is dispatch order) and return the
         latest loss. Epoch ends, checkpoints and early-stop decisions
         belong here — not in the per-step loop."""
+        self._f_sync.check()
         if self._inflight:
             idx, dev = self._inflight[-1]
             self._inflight.clear()

@@ -181,6 +181,12 @@ def _process_worker_loop(dataset, collate_fn, index_q, result_q, wid,
     global _worker_info
     _worker_info = _WorkerInfo(wid, num_workers, dataset)
     np.random.seed((base_seed + wid) % (2 ** 32))
+    # fault injection (FLAGS_fault_inject 'dataloader_worker:...'): an
+    # armed site makes this worker HARD-EXIT mid-batch — the death shape
+    # the parent's restart-with-backoff machinery recovers from, as
+    # opposed to a clean exception (which rides result_q and re-raises)
+    from ..testing import faults as _faults
+    _fault = _faults.site("dataloader_worker")
     try:
         if worker_init_fn is not None:
             worker_init_fn(wid)
@@ -189,6 +195,10 @@ def _process_worker_loop(dataset, collate_fn, index_q, result_q, wid,
             if item is None:
                 return
             bidx, indices = item
+            try:
+                _fault.check(batch=bidx)
+            except _faults.InjectedFault:
+                os._exit(3)     # simulated worker death, not an error
             segs = []
             try:
                 batch = collate_fn([dataset[i] for i in indices])
@@ -336,44 +346,78 @@ class DataLoader:
             base_seed = default_seed() + self._epoch
             index_q = ctx.Queue()
             result_q = ctx.Queue()
-            workers = [
-                ctx.Process(
+
+            def spawn(wid):
+                w = ctx.Process(
                     target=_process_worker_loop,
                     args=(dataset, collate, index_q, result_q, wid,
                           n, self.worker_init_fn, base_seed),
                     daemon=True)
-                for wid in range(n)]
-            for w in workers:
                 w.start()
+                return w
+
+            workers = [spawn(wid) for wid in range(n)]
+            from .. import flags as _flags
+            from .. import observability as obs
+            restart_budget = n * max(0, int(
+                _flags.get_flag("dataloader_max_worker_restarts")))
+            m_restarts = (obs.registry().counter(
+                "io_worker_restarts",
+                "process DataLoader workers restarted after dying "
+                "mid-epoch") if obs.enabled() else obs.NULL)
             sampler_it = enumerate(iter(self.batch_sampler))
-            outstanding = 0
+            pending = {}        # bidx -> indices, fed but not delivered
             buffered = {}
             next_yield = 0
+            restarts = 0
+            # short poll so worker death is noticed promptly; ``timeout``
+            # (the user knob) is enforced as accumulated silent time
+            poll = min(timeout, 0.25) if timeout else 0.25
+            silent = 0.0
             try:
                 def feed():
-                    nonlocal outstanding
                     item = next(sampler_it, None)
                     if item is not None:
-                        index_q.put(item)
-                        outstanding += 1
+                        bidx, indices = item
+                        pending[bidx] = list(indices)
+                        index_q.put((bidx, pending[bidx]))
 
                 for _ in range(n * self.prefetch_factor):
                     feed()
-                while outstanding:
+                while pending:
                     try:
-                        bidx, status, payload = result_q.get(
-                            timeout=timeout or 5.0)
+                        bidx, status, payload = result_q.get(timeout=poll)
                     except queue.Empty:
-                        # ANY dead worker mid-epoch is a hard crash (clean
-                        # worker exceptions come back on result_q; the
-                        # shutdown sentinel is only sent after the loop):
-                        # the batch it held is lost, so waiting on the
-                        # remaining workers would hang forever
-                        if any(not w.is_alive() for w in workers):
-                            raise RuntimeError(
-                                "DataLoader process worker died without "
-                                "delivering a batch")
-                        if timeout:
+                        silent += poll
+                        dead = [i for i, w in enumerate(workers)
+                                if not w.is_alive()]
+                        if dead:
+                            # a dead worker's batch is lost (clean worker
+                            # exceptions ride result_q; the shutdown
+                            # sentinel is only sent after the loop) and
+                            # waiting on the survivors would hang forever.
+                            # Restart with backoff and resubmit every
+                            # undelivered batch — WHICH one died with the
+                            # worker is unknowable (the index queue is
+                            # shared), so survivors may redo a few;
+                            # duplicate deliveries are discarded below.
+                            if restarts + len(dead) > restart_budget:
+                                raise RuntimeError(
+                                    f"DataLoader process workers died "
+                                    f"{restarts + len(dead)} times (budget"
+                                    f" {restart_budget}); giving up — see "
+                                    f"FLAGS_dataloader_max_worker_restarts")
+                            time.sleep(min(0.05 * (2 ** restarts), 1.0))
+                            for i in dead:
+                                workers[i].join(timeout=0.5)
+                                workers[i] = spawn(i)
+                            restarts += len(dead)
+                            m_restarts.inc(len(dead))
+                            for bidx2 in sorted(pending):
+                                index_q.put((bidx2, pending[bidx2]))
+                            silent = 0.0
+                            continue
+                        if timeout and silent >= timeout:
                             # workers alive but slow: a timeout, not a
                             # death — report it as what it is
                             raise RuntimeError(
@@ -381,7 +425,14 @@ class DataLoader:
                                 f"after {timeout}s (workers alive; raise "
                                 f"timeout or speed up __getitem__)")
                         continue
-                    outstanding -= 1
+                    silent = 0.0
+                    if bidx not in pending:
+                        # duplicate of a resubmitted batch (the original
+                        # arrived after a restart resubmit): drop it
+                        if status == "ok":
+                            _shm_discard(payload)
+                        continue
+                    del pending[bidx]
                     feed()
                     buffered[bidx] = (status, payload)
                     while next_yield in buffered:

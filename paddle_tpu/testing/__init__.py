@@ -1,0 +1,13 @@
+"""Robustness-testing utilities.
+
+:mod:`paddle_tpu.testing.faults` is the deterministic fault-injection
+registry (``FLAGS_fault_inject``) that the serving/training recovery
+machinery is exercised against — see MIGRATION.md "Fault tolerance" and
+``tools/fault_drill.py`` for the chaos-drill harness.
+"""
+
+from __future__ import annotations
+
+from . import faults
+
+__all__ = ["faults"]

@@ -9,9 +9,17 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+from paddle_tpu import flags
 from paddle_tpu.generation.serving import ServingEngine
 from paddle_tpu.models import (GPTConfig, GPTForCausalLM, LlamaConfig,
                                LlamaForCausalLM)
+from paddle_tpu.testing import faults
+
+
+def fault_spec(spec, backoff=0.001):
+    """Arm FLAGS_fault_inject for the engines built inside the block
+    (sites bind at construction); restores + resets on exit."""
+    return faults.armed(spec, serving_retry_backoff=backoff)
 
 
 def solo(model, prompt, n, eos=None):
@@ -139,24 +147,152 @@ class TestDonationDiscipline:
         out = eng.run()
         assert len(out[0]) == 4
 
-    def test_failed_dispatch_leaves_pool_loudly_broken(self, monkeypatch):
-        """A dispatch that raises AFTER donation must not leave the
-        engine silently aliasing dead buffers: the pool stays detached
-        and the next dispatch refuses instead of serving garbage."""
+    def test_transient_dispatch_failure_recovers_with_parity(self):
+        """r10 replay recovery: a dispatch that raises AFTER donation
+        leaves the pool detached (r08) — recovery now allocates fresh
+        pools and re-queues the in-flight request for re-prefill from
+        prompt + emitted tokens, and the final output is bit-identical
+        to the unfailed run."""
+        flags.set_flags({"serving_retry_backoff": 0.001})
         eng, prompt = self._engine()
-        eng.submit(prompt, 4)
-        eng.step()                      # healthy prefill+decode
+        ref = solo(eng.model, prompt, 6)
+        rid = eng.submit(prompt, 6)
+        eng.step(); eng.step()          # prefill + one decode
+
+        real = eng._decode_fn
+        boomed = []
+
+        def boom_once(*a, **k):
+            if not boomed:
+                boomed.append(1)
+                raise RuntimeError("simulated post-dispatch failure")
+            return real(*a, **k)
+
+        eng._decode_fn = boom_once
+        out = eng.run()                 # recovery happens inside
+        assert boomed and out[rid] == ref
+        assert eng.status(rid) == "OK"
+        assert all(k is not None for k in eng.pool.k_pages)
+
+    def test_retry_exhaustion_fails_requests_without_killing_run(self):
+        """Persistent no-progress failures terminate the victims FAILED
+        instead of raising out of run(), and the engine serves new
+        requests afterwards on its fresh pool."""
+        flags.set_flags({"serving_retry_backoff": 0.001})
+        eng, prompt = self._engine()
+        ref = solo(eng.model, prompt, 4)
 
         def boom(*a, **k):
-            raise RuntimeError("simulated post-dispatch failure")
+            raise RuntimeError("wedged backend")
 
-        monkeypatch.setattr(eng, "_decode_fn", boom)
-        with pytest.raises(RuntimeError, match="simulated"):
-            eng.step()
-        assert all(k is None for k in eng.pool.k_pages)
-        monkeypatch.undo()              # restore the real program...
-        with pytest.raises(RuntimeError, match="already detached"):
-            eng.step()                  # ...but the pool is gone: refuse
+        eng._prefill_fn = boom          # no prefill -> no progress ever
+        eng._decode_fn = boom
+        rid = eng.submit(prompt, 4)
+        out = eng.run()                 # returns; does NOT raise
+        assert eng.status(rid) == "FAILED"
+        assert out[rid] == []           # partial tokens (none emitted)
+        # the engine is NOT wedged: fresh pool + real programs serve on
+        eng._prefill_fn = None
+        eng._decode_fn = None
+        rid2 = eng.submit(prompt, 4)
+        assert eng.run()[rid2] == ref
+        assert eng.status(rid2) == "OK"
+
+    def test_injected_decode_faults_replay_parity_generic(self):
+        """FLAGS_fault_inject chaos on the GENERIC decode path: every
+        3rd decode dispatch dies post-detach; outputs stay bit-identical
+        to the fault-free run and nothing wedges."""
+        eng, _ = self._engine()
+        rng = np.random.default_rng(21)
+        prompts = [rng.integers(0, eng.model.config.vocab_size,
+                                (n,)).astype(np.int32)
+                   for n in (5, 9, 7)]
+        refs = [solo(eng.model, p, 5) for p in prompts]
+        with fault_spec("decode_dispatch:every=3"):
+            chaos = ServingEngine(eng.model, max_batch=2, page_size=8,
+                                  max_seq_len=32)
+            rids = [chaos.submit(p, 5) for p in prompts]
+            out = chaos.run()
+        assert chaos.decode_key.kind == "decode_generic"
+        assert [out[r] for r in rids] == refs
+        assert all(chaos.status(r) == "OK" for r in rids)
+
+    def test_injected_decode_faults_replay_parity_fused(self):
+        """Same chaos drill on the FUSED block-decode path (Llama
+        publishes block_decode_spec): replay recovery must be
+        path-agnostic."""
+        paddle.seed(95)
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLM(cfg)
+        rng = np.random.default_rng(22)
+        prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+                   for n in (4, 11)]
+        refs = [solo(model, p, 5) for p in prompts]
+        with fault_spec("decode_dispatch:every=3;prefill:p=0.2:seed=11"):
+            chaos = ServingEngine(model, max_batch=2, page_size=8,
+                                  max_seq_len=32)
+            rids = [chaos.submit(p, 5) for p in prompts]
+            out = chaos.run()
+        assert chaos.decode_key.kind == "decode_fused"
+        assert [out[r] for r in rids] == refs
+        assert all(chaos.status(r) == "OK" for r in rids)
+
+    def test_deadline_eviction_at_step_boundary(self):
+        """submit(deadline=...): an expired request — queued or in
+        flight — is terminated TIMEOUT at the next step boundary with
+        its partial tokens banked, and its slot/pages recycle."""
+        import time as _time
+        eng, prompt = self._engine()
+        rid_dead = eng.submit(prompt, 6, deadline=0.0)
+        rid_live = eng.submit(prompt, 4)
+        _time.sleep(0.005)
+        out = eng.run()
+        assert eng.status(rid_dead) == "TIMEOUT"
+        assert out[rid_dead] == []
+        assert eng.status(rid_live) == "OK"
+        assert len(out[rid_live]) == 4
+        # every page returned (null page excluded)
+        assert eng.pool.free_page_count() == eng.pool.num_pages - 1
+
+    def test_run_max_wall_watchdog(self):
+        eng, prompt = self._engine()
+        ra = eng.submit(prompt, 4)
+        rb = eng.submit(prompt, 4)
+        out = eng.run(max_wall=0.0)     # expires before the first step
+        assert eng.status(ra) == "TIMEOUT" and eng.status(rb) == "TIMEOUT"
+        assert out[ra] == [] and out[rb] == []
+        assert not eng.has_work()
+
+    def test_results_preserved_after_mid_run_raise(self, monkeypatch):
+        """Exception safety: a raise escaping the recovery machinery
+        (here: the step loop itself breaks) must leave already-completed
+        results retrievable via results()."""
+        paddle.seed(79)
+        model = GPTForCausalLM(GPTConfig.tiny())
+        prompt = np.random.default_rng(9).integers(
+            0, model.config.vocab_size, (5,)).astype(np.int32)
+        ref = solo(model, prompt, 4)
+        # max_batch=1 serializes: r1 completes before r2 admits
+        eng = ServingEngine(model, max_batch=1, page_size=8,
+                            max_seq_len=32)
+        r1 = eng.submit(prompt, 4)
+        r2 = eng.submit(prompt, 4)
+        real_step = eng.step
+        calls = []
+
+        def step_then_boom():
+            # r1 completes in 3 steps (prefill + decode both emit);
+            # boom while r2 is still mid-flight
+            if len(calls) >= 4:
+                raise RuntimeError("loop bug outside recovery")
+            calls.append(1)
+            real_step()
+
+        monkeypatch.setattr(eng, "step", step_then_boom)
+        with pytest.raises(RuntimeError, match="loop bug"):
+            eng.run()
+        assert eng.results()[r1] == ref
+        assert eng.status(r1) == "OK" and eng.status(r2) == "PENDING"
 
     def test_serving_results_unchanged_by_handoff(self):
         eng, prompt = self._engine()

@@ -1,0 +1,249 @@
+"""Chaos drill: inject deterministic faults into serving, training and
+data loading, and verify the r10 recovery machinery holds its
+invariants under fire.
+
+Arms (each runs a fault-free baseline first, then the chaos pass):
+
+  serving     ServingEngine under the acceptance mix
+              ``decode_dispatch:every=5;prefill:p=0.1:seed=7``:
+              every request must complete with BIT-IDENTICAL greedy
+              tokens vs. the fault-free run, zero wedged requests, and
+              the engine must end drained with live pools.
+  training    ``Model.fit`` under ``train_dispatch`` faults (+ one
+              injected ``checkpoint_save`` failure): training completes,
+              the emergency checkpoint lands, the final loss is finite.
+  dataloader  process workers under ``dataloader_worker`` deaths:
+              the epoch delivers every batch in sampler order through
+              restart-with-backoff.
+
+Emits one JSON line per arm and a final combined ledger; ``--out FILE``
+banks the ledger (the repo convention: FAULT_DRILL_r10.json). Exit code
+0 = every arm green. The short-budget tier-1 slice of this drill lives
+in tests/test_faults.py under the ``faults`` marker.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import warnings
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DRILL_SCHEMA = 1
+SERVING_SPEC = "decode_dispatch:every=5;prefill:p=0.1:seed=7"
+TRAIN_SPEC = ("train_dispatch:every=5:times=3;"
+              "checkpoint_save:every=1:times=1")
+LOADER_SPEC = "dataloader_worker:every=3:times=1"
+
+
+def emit(d):
+    print(json.dumps(d), flush=True)
+
+
+def counters(*names):
+    import paddle_tpu.observability as obs
+    snap = obs.snapshot()["metrics"]
+    out = {}
+    for name in names:
+        fam = snap.get(name)
+        if fam is None:
+            continue
+        for s in fam["series"]:
+            key = name
+            if s["labels"]:
+                key += "{" + ",".join(
+                    f"{k}={v}" for k, v in sorted(s["labels"].items())) + "}"
+            out[key] = s.get("value", s.get("count"))
+    return out
+
+
+def delta(after, before):
+    return {k: round(v - before.get(k, 0.0), 6)
+            for k, v in after.items() if v != before.get(k, 0.0)}
+
+
+SERVING_COUNTERS = (
+    "faults_injected", "serving_recoveries", "serving_retries_total",
+    "serving_requests_failed", "serving_requests_timeout",
+    "serving_requests_finished")
+TRAIN_COUNTERS = (
+    "faults_injected", "train_retries_total", "train_recoveries",
+    "train_emergency_checkpoints", "train_nan_losses")
+LOADER_COUNTERS = ("faults_injected", "io_worker_restarts")
+
+
+def drill_serving(n_requests, max_new):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import flags
+    from paddle_tpu.generation.serving import ServingEngine
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.testing import faults
+
+    paddle.seed(51)
+    model = GPTForCausalLM(GPTConfig.tiny())
+    rng = np.random.default_rng(17)
+    prompts = [rng.integers(0, model.config.vocab_size,
+                            (int(rng.integers(4, 13)),)).astype(np.int32)
+               for _ in range(n_requests)]
+
+    def run_engine():
+        eng = ServingEngine(model, max_batch=2, page_size=8,
+                            max_seq_len=64)
+        rids = [eng.submit(p, max_new) for p in prompts]
+        out = eng.run(max_wall=300.0)
+        return eng, [out[r] for r in rids], [eng.status(r) for r in rids]
+
+    _, baseline, base_status = run_engine()
+    before = counters(*SERVING_COUNTERS)
+    flags.set_flags({"fault_inject": SERVING_SPEC,
+                     "serving_retry_backoff": 0.001})
+    try:
+        eng, chaos, status = run_engine()
+    finally:
+        flags.set_flags({"fault_inject": "",
+                         "serving_retry_backoff": 0.05})
+        faults.reset()
+    ctr = delta(counters(*SERVING_COUNTERS), before)
+    ok = (chaos == baseline
+          and all(s == "OK" for s in status)
+          and all(s == "OK" for s in base_status)
+          and not eng.has_work()
+          and all(k is not None for k in eng.pool.k_pages)
+          and ctr.get("faults_injected{site=decode_dispatch}", 0) +
+          ctr.get("faults_injected{site=prefill}", 0) >= 1)
+    row = {"arm": "serving", "ok": ok, "spec": SERVING_SPEC,
+           "requests": n_requests, "max_new_tokens": max_new,
+           "bit_identical": chaos == baseline,
+           "statuses": status, "counters": ctr}
+    emit(row)
+    return row
+
+
+def drill_training(epochs):
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import flags
+    from paddle_tpu.hapi.model import Model
+    from paddle_tpu.io import Dataset
+    from paddle_tpu.testing import faults
+
+    class Reg(Dataset):
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            rng = np.random.default_rng(i)
+            x = rng.standard_normal(8).astype(np.float32)
+            return x, x
+
+    def build():
+        paddle.seed(0)
+        net = nn.Linear(8, 8)
+        m = Model(net)
+        m.prepare(
+            paddle.optimizer.AdamW(1e-2, parameters=net.parameters()),
+            loss=lambda out, y: ((out - y) ** 2).mean())
+        return m
+
+    before = counters(*TRAIN_COUNTERS)
+    flags.set_flags({"fault_inject": TRAIN_SPEC,
+                     "train_retry_backoff": 0.001})
+    tmp = tempfile.mkdtemp(prefix="fault_drill_")
+    try:
+        m = build()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m.fit(Reg(), batch_size=4, epochs=epochs, verbose=0,
+                  save_dir=tmp, metrics_every=2)
+        final = m.evaluate(Reg(), batch_size=4)["loss"]
+    finally:
+        flags.set_flags({"fault_inject": "", "train_retry_backoff": 0.05})
+        faults.reset()
+    ctr = delta(counters(*TRAIN_COUNTERS), before)
+    ckpt = os.path.join(tmp, "emergency.pdparams")
+    ok = (os.path.exists(ckpt)
+          and final is not None and np.isfinite(final)
+          and ctr.get("train_recoveries", 0) >= 1
+          and ctr.get("faults_injected{site=train_dispatch}", 0) >= 1)
+    row = {"arm": "training", "ok": ok, "spec": TRAIN_SPEC,
+           "epochs": epochs, "final_eval_loss": float(final),
+           "emergency_checkpoint": os.path.exists(ckpt),
+           "counters": ctr}
+    emit(row)
+    return row
+
+
+def drill_dataloader():
+    import numpy as np
+    from paddle_tpu import flags
+    from paddle_tpu.io import DataLoader, Dataset
+    from paddle_tpu.testing import faults
+
+    class Rows(Dataset):
+        def __len__(self):
+            return 40
+
+        def __getitem__(self, i):
+            return np.full((4,), i, np.float32)
+
+    before = counters(*LOADER_COUNTERS)
+    flags.set_flags({"fault_inject": LOADER_SPEC,
+                     "dataloader_max_worker_restarts": 16})
+    try:
+        dl = DataLoader(Rows(), batch_size=4, num_workers=2,
+                        use_process_workers=True)
+        got = [int(np.asarray(b.numpy())[0, 0]) for b in dl]
+    finally:
+        flags.set_flags({"fault_inject": "",
+                         "dataloader_max_worker_restarts": 2})
+        faults.reset()
+    ctr = delta(counters(*LOADER_COUNTERS), before)
+    ok = (got == list(range(0, 40, 4))
+          and ctr.get("io_worker_restarts", 0) >= 1)
+    row = {"arm": "dataloader", "ok": ok, "spec": LOADER_SPEC,
+           "batches": len(got), "ordered": got == list(range(0, 40, 4)),
+           "counters": ctr}
+    emit(row)
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None,
+                    help="bank the combined ledger JSON here "
+                         "(e.g. FAULT_DRILL_r10.json)")
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--max-new", type=int, default=6)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--arms", default="serving,training,dataloader")
+    args = ap.parse_args()
+
+    import jax
+    backend = jax.default_backend()
+    arms = {}
+    want = args.arms.split(",")
+    if "serving" in want:
+        arms["serving"] = drill_serving(args.requests, args.max_new)
+    if "training" in want:
+        arms["training"] = drill_training(args.epochs)
+    if "dataloader" in want:
+        arms["dataloader"] = drill_dataloader()
+
+    ok = all(a["ok"] for a in arms.values())
+    ledger = {"schema": DRILL_SCHEMA, "drill": "fault_drill",
+              "backend": backend, "ok": ok, "arms": arms}
+    emit({"final": True, "ok": ok, "backend": backend})
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(ledger, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
