@@ -3,5 +3,7 @@
 The reference stack pairs its kernels with correctness tooling
 (FLAGS_check_nan_inf sanitizer layers, op-level debugging hooks); this
 package holds the *static* half: analyzers that catch trace-discipline
-bugs at lint time instead of on-chip.  See :mod:`.tracecheck`.
+and SPMD collective-discipline bugs at lint time instead of on-chip.
+See :mod:`.tracecheck` (TRC rules) and :mod:`.meshcheck` (MSH rules);
+``tools/analyze.py`` runs both over one shared parse.
 """

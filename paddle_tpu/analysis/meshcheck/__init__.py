@@ -1,0 +1,53 @@
+"""meshcheck — an SPMD collective-discipline static analyzer.
+
+tracecheck (r08) gates *trace* discipline; meshcheck gates the bug
+class the sharded serving/training push multiplies: collectives whose
+correctness depends on every member of a mesh axis agreeing on WHAT to
+issue and WHEN.  Megatron-LM-style tensor/pipeline parallelism and the
+GSPMD line of work both treat collective-order agreement across ranks
+as the invariant everything rests on — and the failure mode is the
+worst kind: a single-host test passes while the multi-process run
+deadlocks every host with no traceback.
+
+Rules (all pure AST over the shared tracecheck parse):
+
+- **MSH001** collective over an axis name bound by no enclosing
+  mesh/shard_map and absent from the topology vocabulary (extracted
+  from ``fleet/base_topology._HYBRID_AXES``, so dp/pp/sharding/sep/mp
+  are first-class); includes group ``.axis_name`` reads that ignore
+  ``.global_axis``.
+- **MSH002** collective reachable under tensor-valued ``if``/``while``
+  (divergent-collective deadlock; reuses TRC006's predicate
+  classifier, so static shape/dtype predicates are exempt).
+- **MSH003** exclusive branches issuing different collective sequences
+  on a rank-dependent predicate (order-divergence hang).
+- **MSH004** unpaired p2p/``ppermute`` discipline: permutes under
+  ``lax.cond``/``switch`` branches, eager send/recv under
+  rank-conditional guards.
+- **MSH005** rank/process-id-dependent Python branching in
+  collective-issuing code (host-divergent trace -> mismatched
+  programs).
+- **MSH006** host callbacks/telemetry inside shard_map bodies
+  (composes with TRC007).
+
+Findings support inline ``# meshcheck: disable=MSH00x`` pragmas and a
+checked-in baseline (tools/meshcheck_baseline.json); the tier-1 test
+gates NEW findings only.
+
+Run it locally::
+
+    python tools/analyze.py                    # tracecheck + meshcheck
+    python tools/analyze.py --suite meshcheck
+    python tools/analyze.py --update-baseline
+"""
+
+from ..tracecheck.findings import (Finding, fingerprint, load_baseline,
+                                   subtract_baseline, write_baseline)
+from .analyzer import AnalyzerConfig, AnalysisResult, analyze_package
+from .rules import MESH_RULES
+
+__all__ = [
+    "AnalyzerConfig", "AnalysisResult", "Finding", "MESH_RULES",
+    "analyze_package", "fingerprint", "load_baseline",
+    "subtract_baseline", "write_baseline",
+]

@@ -1,0 +1,89 @@
+"""Orchestration: parse (or reuse a parse), build the SPMD context, run
+the MSH rules.
+
+``analyze_package`` mirrors tracecheck's entry point and accepts the
+same :class:`ParsedPackage` so the unified CLI (tools/analyze.py) runs
+both suites over ONE ast.parse pass.  The context build is strictly
+read-only over the shared ``ModuleInfo`` objects — running meshcheck
+never changes what tracecheck reports on the same parse, in either
+order.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..tracecheck.analyzer import ParsedPackage, parse_package
+from ..tracecheck.callgraph import CallGraph
+from ..tracecheck.findings import (Finding, dedupe_findings,
+                                   parse_pragmas, suppressed)
+from .mesh_model import build_context
+from . import rules as MR
+
+
+@dataclass
+class AnalyzerConfig:
+    exclude_patterns: tuple = ()
+    rules: tuple = ("MSH001", "MSH002", "MSH003", "MSH004", "MSH005",
+                    "MSH006")
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding]              # post-pragma, pre-baseline
+    suppressed: List[Finding]            # pragma-silenced
+    n_files: int = 0
+    n_functions: int = 0
+    n_spmd: int = 0                      # per-shard / collective-bearing
+    n_collective_sites: int = 0
+    errors: List[str] = field(default_factory=list)
+
+
+_RULE_FNS = {
+    "MSH001": MR.msh001_axis_binding,
+    "MSH002": MR.msh002_collective_under_tensor_branch,
+    "MSH003": MR.msh003_divergent_sequences,
+    "MSH004": MR.msh004_permute_discipline,
+    "MSH005": MR.msh005_rank_divergent_trace,
+    "MSH006": MR.msh006_host_callbacks,
+}
+
+
+def analyze_package(package_path: str,
+                    config: Optional[AnalyzerConfig] = None,
+                    parsed: Optional[ParsedPackage] = None
+                    ) -> AnalysisResult:
+    config = config or AnalyzerConfig()
+    if parsed is None:
+        parsed = parse_package(package_path, config.exclude_patterns)
+    else:
+        parsed = parsed.filtered(config.exclude_patterns)
+
+    result = AnalysisResult(findings=[], suppressed=[])
+    result.errors = list(parsed.errors)
+    result.n_files = parsed.n_files
+
+    graph = CallGraph(parsed.modules, parsed.package)
+    ctx = build_context(parsed.modules, graph)
+    result.n_spmd = len(ctx.spmd_fns)
+    result.n_collective_sites = sum(
+        len(v) for v in ctx.collectives.values())
+
+    findings: List[Finding] = []
+    for mod in parsed.modules.values():
+        pragmas = parse_pragmas(mod.source_lines, tool="meshcheck")
+        for fi in mod.functions.values():
+            result.n_functions += 1
+            batch: List[Finding] = []
+            for code in config.rules:
+                fn = _RULE_FNS.get(code)
+                if fn is not None:
+                    batch += fn(fi, ctx)
+            for f in batch:
+                (result.suppressed if suppressed(f, pragmas)
+                 else findings).append(f)
+
+    result.findings = dedupe_findings(findings)
+    return result

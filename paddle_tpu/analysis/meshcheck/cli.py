@@ -1,9 +1,8 @@
-"""tracecheck CLI.
+"""meshcheck CLI (single-suite; tools/analyze.py runs both suites over
+one parse).
 
 Exit codes: 0 clean (or all findings baselined/suppressed), 1 new
-findings, 2 usage/parse errors.  ``--update-baseline`` rewrites the
-baseline to exactly the current findings (sorted, byte-stable) and
-exits 0 — the gate for future PRs is "no findings beyond this file".
+findings, 2 usage/parse errors.
 """
 
 from __future__ import annotations
@@ -15,17 +14,19 @@ import sys
 import time
 from typing import List, Optional
 
+from ..tracecheck.findings import (load_baseline, subtract_baseline,
+                                   write_baseline)
 from .analyzer import AnalyzerConfig, analyze_package
-from .findings import (RULES, load_baseline, subtract_baseline,
-                       write_baseline)
+from .rules import MESH_RULES
 
-DEFAULT_BASELINE = os.path.join("tools", "tracecheck_baseline.json")
+DEFAULT_BASELINE = os.path.join("tools", "meshcheck_baseline.json")
 
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
-        prog="tracecheck",
-        description="JAX trace-discipline static analyzer (TRC001-006).")
+        prog="meshcheck",
+        description="SPMD collective-discipline static analyzer "
+                    "(MSH001-006).")
     p.add_argument("path", nargs="?", default="paddle_tpu",
                    help="package directory (or single file) to analyze")
     p.add_argument("--json", action="store_true", dest="as_json",
@@ -42,7 +43,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalogue and exit")
     p.add_argument("--stats", action="store_true",
-                   help="print file/function/reachability counters")
+                   help="print file/function/SPMD-coverage counters")
     return p
 
 
@@ -54,17 +55,17 @@ def _default_baseline_path(pkg_path: str) -> str:
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
-        for code in sorted(RULES):
-            print(f"{code}: {RULES[code]}")
+        for code in sorted(MESH_RULES):
+            print(f"{code}: {MESH_RULES[code]}")
         return 0
     if not os.path.exists(args.path):
-        print(f"tracecheck: no such path: {args.path}", file=sys.stderr)
+        print(f"meshcheck: no such path: {args.path}", file=sys.stderr)
         return 2
 
     config = AnalyzerConfig()
     if args.rules:
         if args.update_baseline:
-            print("tracecheck: --rules cannot be combined with "
+            print("meshcheck: --rules cannot be combined with "
                   "--update-baseline (it would clobber the other "
                   "rules' baseline entries)", file=sys.stderr)
             return 2
@@ -76,16 +77,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     result = analyze_package(args.path, config)
     elapsed = time.time() - t0
     for err in result.errors:
-        print(f"tracecheck: parse error: {err}", file=sys.stderr)
+        print(f"meshcheck: parse error: {err}", file=sys.stderr)
     if result.errors:
-        # an unparseable file would silently shrink coverage — a gate
-        # that cannot see the whole package must not pass
         return 2
 
     baseline_path = args.baseline or _default_baseline_path(args.path)
     if args.update_baseline:
         entries = write_baseline(baseline_path, result.findings)
-        print(f"tracecheck: baselined {len(entries)} finding(s) -> "
+        print(f"meshcheck: baselined {len(entries)} finding(s) -> "
               f"{baseline_path}")
         return 0
 
@@ -105,7 +104,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             "stale_baseline_entries": sorted(leftovers),
             "files": result.n_files,
             "functions": result.n_functions,
-            "traced_functions": result.n_traced,
+            "spmd_functions": result.n_spmd,
+            "collective_sites": result.n_collective_sites,
             "elapsed_s": round(elapsed, 3),
         }, indent=1, sort_keys=True))
     else:
@@ -113,9 +113,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f.format())
         if args.stats:
             print(f"-- {result.n_files} files, {result.n_functions} "
-                  f"functions ({result.n_traced} trace-reachable) in "
+                  f"functions ({result.n_spmd} SPMD-reachable, "
+                  f"{result.n_collective_sites} collective sites) in "
                   f"{elapsed:.2f}s")
-        summary = (f"tracecheck: {len(new)} new finding(s), "
+        summary = (f"meshcheck: {len(new)} new finding(s), "
                    f"{n_baselined} baselined, "
                    f"{len(result.suppressed)} pragma-suppressed")
         if leftovers:

@@ -1,0 +1,369 @@
+"""The SPMD model meshcheck reasons over (pure AST, shared parse).
+
+Three questions drive the MSH rules:
+
+1. **Which calls are named-axis collectives, and what axis do they
+   address?**  ``jax.lax`` collectives (psum/all_gather/ppermute/
+   all_to_all/...) plus the repo's own wrappers
+   (``communication/in_jit.py``, ``layers/mpu/mp_ops.py``) — each with
+   the position of its axis-name argument.
+
+2. **What axis names exist?**  The topology vocabulary is extracted from
+   ``fleet/base_topology.py``'s ``_HYBRID_AXES`` (dp/pp/sharding/sep/mp
+   are first-class), extended per module by axes declared in
+   ``Mesh(...)``/``shard_map(axis_names=...)``/``pmap(axis_name=...)``/
+   ``PartitionSpec`` literals — a module that builds its own mesh binds
+   its own names.
+
+3. **Which functions run per-shard / under divergent control flow?**
+   Functions passed to ``shard_map``/``pmap`` (and their callees) are
+   shard_map bodies; functions passed as ``lax.cond``/``switch``
+   branches run divergently per shard; any function that (transitively)
+   issues a named-axis collective is per-shard by definition —
+   collectives are only legal inside a manual mesh region.
+
+Everything here is READ-ONLY over the shared :class:`ModuleInfo` objects
+so running meshcheck never perturbs a tracecheck pass on the same parse
+(tracecheck's ``traced``/``trace_root`` flags are its own).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..tracecheck.callgraph import (CallGraph, FunctionInfo, ModuleInfo,
+                                    callee_name, is_wrapper_decorator,
+                                    wrapper_positions)
+
+# fallback when base_topology.py is outside the analyzed path
+AXIS_FALLBACK = ("dp", "pp", "sharding", "sep", "mp")
+
+# jax.lax named-axis collectives: terminal name -> positional index of
+# the axis-name argument.  axis_index IS a collective for binding
+# purposes (unbound name fails / divergent value) even though it moves
+# no data.  lax.pcast/psum2-style vma bookkeeping is excluded: it
+# compiles to nothing and is sound under divergence.
+LAX_COLLECTIVES: Dict[str, int] = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
+    "all_gather": 1, "ppermute": 1, "pshuffle": 1, "all_to_all": 1,
+    "axis_index": 0, "pbroadcast": 1,
+}
+# static mesh-shape queries: MSH001 binding check only — never data
+# movement, so MSH002-005 ignore them
+AXIS_QUERIES: Dict[str, int] = {"axis_size": 0}
+
+# point-to-point / permutation collectives (MSH004 discipline)
+PERMUTE_TAILS = {"ppermute", "pshuffle", "shift_right", "shift_left"}
+
+# repo collective wrappers, resolved through the call graph so aliasing
+# never fools the match: (module-relpath substring, name -> axis pos)
+WRAPPER_TABLES: Tuple[Tuple[str, Dict[str, int]], ...] = (
+    ("communication/in_jit", {
+        "all_reduce": 2, "all_gather": 1, "reduce_scatter": 1,
+        "all_to_all": 1, "ppermute": 1, "shift_right": 1, "shift_left": 1,
+        "broadcast": 2, "pgather": 1, "axis_rank": 0, "axis_size": 0,
+    }),
+    ("layers/mpu/mp_ops", {
+        "_mp_allreduce": 1, "_c_split": 1, "_c_concat": 1,
+        "_reduce_scatter": 1, "_all_gather": 1, "_parallel_matmul": 2,
+        "_parallel_embedding": 2,
+    }),
+    ("utils/sequence_parallel_utils", {
+        "scatter": 1, "gather": 1, "all_gather": 1, "reduce_scatter": 1,
+    }),
+)
+
+# eager p2p surface (mailbox send/recv family) for MSH004's
+# rank-conditional-issuance check
+P2P_TAILS = {"send", "recv", "isend", "irecv"}
+
+# axis-declaring constructors: any string constant inside their call is
+# a locally-bound axis name for this module
+_AXIS_BINDERS = {"Mesh", "AbstractMesh", "abstract_mesh", "shard_map",
+                 "pmap", "PartitionSpec", "P", "NamedSharding"}
+
+_SHARD_MAP_TAILS = {"shard_map", "pmap"}
+# divergent-branch positions: cond's two branch callables; switch takes
+# its branches as ONE sequence at position 1 (_wrapper_arg_fns unpacks
+# list/tuple arguments) — positions 2+ are operands, not callables
+_COND_TAILS = {"cond": (1, 2), "switch": (1,)}
+
+
+@dataclass
+class CollectiveSite:
+    call: ast.Call
+    tail: str                      # canonical op name (psum, ppermute, ...)
+    axis_node: Optional[ast.expr]  # the axis-name argument, if present
+    query_only: bool = False       # axis_size-style: binding check only
+
+
+@dataclass
+class SpmdContext:
+    graph: CallGraph
+    topology_axes: frozenset
+    module_axes: Dict[str, Set[str]]            # modpath -> declared axes
+    collectives: Dict[int, List[CollectiveSite]]  # id(fi) -> sites
+    reaches: Set[int]          # id(fi): transitively issues a collective
+    spmd_fns: Set[int]         # id(fi): runs per-shard (roots + closure)
+    shardmap_reach: Set[int]   # id(fi): reachable from a shard_map body
+    cond_reach: Set[int]       # id(fi): reachable from a cond/switch branch
+    fn_of: Dict[int, FunctionInfo] = field(default_factory=dict)
+
+
+def _is_lax_rooted(fi: FunctionInfo, name: str) -> bool:
+    """'lax.psum' / 'jax.lax.psum' / bare 'psum' imported from jax.lax."""
+    parts = name.split(".")
+    if len(parts) == 1:
+        imp = fi.module.imported_names.get(parts[0])
+        return bool(imp and (imp[0] or "").endswith("lax"))
+    if "lax" in parts[:-1]:
+        return True
+    root = fi.module.module_aliases.get(parts[0], "")
+    return root.endswith("lax")
+
+
+def _axis_argument(call: ast.Call, pos: int) -> Optional[ast.expr]:
+    if pos < len(call.args):
+        arg = call.args[pos]
+        if not isinstance(arg, ast.Starred):
+            return arg
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    return None
+
+
+def classify_collective(fi: FunctionInfo, call: ast.Call,
+                        graph: CallGraph) -> Optional[CollectiveSite]:
+    """Is this call a named-axis collective (or axis query)?"""
+    name = callee_name(call)
+    if name is None:
+        return None
+    tail = name.rsplit(".", 1)[-1]
+    if tail in LAX_COLLECTIVES and _is_lax_rooted(fi, name):
+        return CollectiveSite(call, tail, _axis_argument(
+            call, LAX_COLLECTIVES[tail]))
+    if tail in AXIS_QUERIES and _is_lax_rooted(fi, name):
+        return CollectiveSite(call, tail, _axis_argument(
+            call, AXIS_QUERIES[tail]), query_only=True)
+    # repo wrappers: resolve the callee, match by defining module
+    for callee in graph.resolve_call(fi, call):
+        rel = callee.module.relpath
+        for hint, table in WRAPPER_TABLES:
+            if hint in rel and callee.name in table:
+                return CollectiveSite(call, callee.name, _axis_argument(
+                    call, table[callee.name]))
+    return None
+
+
+def is_p2p_call(fi: FunctionInfo, call: ast.Call,
+                graph: CallGraph) -> bool:
+    """Eager mailbox p2p (send/recv/isend/irecv) out of the package's
+    communication tree — by resolution or by alias into
+    ``*.distributed``.  ``batch_isend_irecv`` counts too."""
+    name = callee_name(call)
+    if name is None:
+        return False
+    tail = name.rsplit(".", 1)[-1]
+    if tail == "batch_isend_irecv":
+        return True
+    if tail not in P2P_TAILS:
+        return False
+    for callee in graph.resolve_call(fi, call):
+        rel = callee.module.relpath
+        if "communication/p2p" in rel or "communication/stream" in rel:
+            return True
+    parts = name.split(".")
+    if len(parts) >= 2:
+        target = fi.module.module_aliases.get(parts[0], "")
+        if not target:
+            imp = fi.module.imported_names.get(parts[0])
+            target = f"{imp[0]}.{imp[1]}" if imp else ""
+        return "distributed" in target
+    imp = fi.module.imported_names.get(tail)
+    return bool(imp and ("communication" in imp[0] or
+                         "distributed" in imp[0]))
+
+
+# ------------------------------------------------------------ vocabulary
+def topology_axis_vocabulary(modules: Dict[str, ModuleInfo]) -> frozenset:
+    """The hybrid-parallel axis names, read from base_topology.py's
+    ``_HYBRID_AXES`` assignment (so a renamed/extended topology flows
+    into the analyzer without code changes)."""
+    for mod in modules.values():
+        if not mod.relpath.endswith("fleet/base_topology.py"):
+            continue
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign):
+                targets = [t.id for t in node.targets
+                           if isinstance(t, ast.Name)]
+                if "_HYBRID_AXES" in targets and isinstance(
+                        node.value, (ast.Tuple, ast.List)):
+                    names = [e.value for e in node.value.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, str)]
+                    if names:
+                        return frozenset(names)
+    return frozenset(AXIS_FALLBACK)
+
+
+def module_declared_axes(mod: ModuleInfo) -> Set[str]:
+    """Axis names this module binds itself: string constants inside
+    ``Mesh``/``AbstractMesh``/``shard_map``/``pmap``/``PartitionSpec``
+    construction calls — a module that builds a mesh over axis "x" may
+    address "x"."""
+    out: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = callee_name(node)
+        if name is None or name.rsplit(".", 1)[-1] not in _AXIS_BINDERS:
+            continue
+        for sub in ast.iter_child_nodes(node):
+            for c in ast.walk(sub):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    out.add(c.value)
+    return out
+
+
+# ------------------------------------------------------------- the graph
+def _local_named(mod: ModuleInfo, owner: Optional[FunctionInfo], n: str):
+    scope = owner
+    while scope is not None:
+        hit = mod.functions.get(
+            (scope.qualname + "." if scope.qualname else "") + n)
+        if hit is not None:
+            return hit
+        scope = scope.parent
+    return mod.functions.get(n)
+
+
+def _wrapper_arg_fns(mod: ModuleInfo, owner: FunctionInfo,
+                     call: ast.Call, positions: Tuple[int, ...],
+                     lambda_by_pos: Dict[Tuple[int, int], FunctionInfo]
+                     ) -> List[FunctionInfo]:
+    """The function-valued arguments a wrapper call executes (Name,
+    Lambda, or partial(f, ...)) — the execution edges reachability has
+    to follow even though no direct call expression exists."""
+    hits: List[FunctionInfo] = []
+    args: List[ast.expr] = []
+    for p in positions:
+        if p >= len(call.args):
+            continue
+        arg = call.args[p]
+        # lax.switch-style: the branches arrive as one list/tuple
+        if isinstance(arg, (ast.List, ast.Tuple)):
+            args.extend(arg.elts)
+        else:
+            args.append(arg)
+    for arg in args:
+        if isinstance(arg, ast.Lambda):
+            hit = lambda_by_pos.get((arg.lineno, arg.col_offset))
+            if hit:
+                hits.append(hit)
+        elif isinstance(arg, ast.Name):
+            hit = _local_named(mod, owner if owner.qualname else None,
+                               arg.id)
+            if hit is not None:
+                hits.append(hit)
+        elif isinstance(arg, ast.Call):
+            n = callee_name(arg)
+            if n and n.rsplit(".", 1)[-1] == "partial" and arg.args and \
+                    isinstance(arg.args[0], ast.Name):
+                hit = _local_named(mod, owner if owner.qualname else None,
+                                   arg.args[0].id)
+                if hit is not None:
+                    hits.append(hit)
+    return hits
+
+
+def build_context(modules: Dict[str, ModuleInfo],
+                  graph: CallGraph) -> SpmdContext:
+    topo = topology_axis_vocabulary(modules)
+    module_axes = {mp: module_declared_axes(mod)
+                   for mp, mod in modules.items()}
+
+    fn_of: Dict[int, FunctionInfo] = {}
+    collectives: Dict[int, List[CollectiveSite]] = {}
+    edges: Dict[int, List[FunctionInfo]] = {}
+    roots: Set[int] = set()
+    shardmap_bodies: Set[int] = set()
+    cond_branches: Set[int] = set()
+
+    for mp, mod in modules.items():
+        lambda_by_pos = {
+            (f.node.lineno, f.node.col_offset): f
+            for f in mod.functions.values()
+            if isinstance(f.node, ast.Lambda)}
+        for fi in mod.functions.values():
+            fn_of[id(fi)] = fi
+            # decorator roots, re-derived READ-ONLY (never consult
+            # fi.trace_root: tracecheck mutates it during ITS analysis,
+            # and sharing a parse must not make suite order observable)
+            decs = getattr(fi.node, "decorator_list", ())
+            if any(is_wrapper_decorator(d) for d in decs):
+                roots.add(id(fi))
+            sites: List[CollectiveSite] = []
+            out_edges: List[FunctionInfo] = []
+            for call in fi.calls:
+                site = classify_collective(fi, call, graph)
+                if site is not None:
+                    sites.append(site)
+                out_edges.extend(graph.resolve_call(fi, call))
+                pos = wrapper_positions(call)
+                if pos is not None:
+                    name = callee_name(call) or ""
+                    tail = name.rsplit(".", 1)[-1]
+                    if tail in _COND_TAILS:
+                        # branch callables only — the remaining switch
+                        # positions are operands, not functions
+                        pos = _COND_TAILS[tail]
+                    arg_fns = _wrapper_arg_fns(mod, fi, call, pos,
+                                               lambda_by_pos)
+                    out_edges.extend(arg_fns)
+                    roots.update(id(f) for f in arg_fns)
+                    if tail in _SHARD_MAP_TAILS:
+                        shardmap_bodies.update(id(f) for f in arg_fns)
+                    if tail in _COND_TAILS:
+                        cond_branches.update(id(f) for f in arg_fns)
+            if sites:
+                collectives[id(fi)] = sites
+            edges[id(fi)] = out_edges
+
+    def forward_closure(seed: Set[int]) -> Set[int]:
+        out = set(seed)
+        work = list(seed)
+        while work:
+            cur = work.pop()
+            for callee in edges.get(cur, ()):
+                if id(callee) not in out:
+                    out.add(id(callee))
+                    work.append(id(callee))
+        return out
+
+    # reverse closure: who transitively issues a DATA-MOVING collective
+    # (query-only axis_size sites are static and sound under divergence
+    # — they must not seed the divergent-deadlock reachability)
+    rev: Dict[int, List[int]] = {}
+    for src, outs in edges.items():
+        for callee in outs:
+            rev.setdefault(id(callee), []).append(src)
+    moving = {fid for fid, sites in collectives.items()
+              if any(not s.query_only for s in sites)}
+    reaches = set(moving)
+    work = list(reaches)
+    while work:
+        cur = work.pop()
+        for caller in rev.get(cur, ()):
+            if caller not in reaches:
+                reaches.add(caller)
+                work.append(caller)
+
+    spmd = forward_closure(roots | moving)
+    return SpmdContext(
+        graph=graph, topology_axes=topo, module_axes=module_axes,
+        collectives=collectives, reaches=reaches, spmd_fns=spmd,
+        shardmap_reach=forward_closure(shardmap_bodies),
+        cond_reach=forward_closure(cond_branches), fn_of=fn_of)

@@ -1,0 +1,479 @@
+"""The MSH rule checkers.
+
+Each rule is ``(FunctionInfo, SpmdContext) -> List[Finding]`` over ONE
+function body (nested defs are their own FunctionInfo).  The rules
+encode the SPMD contract Megatron-LM/GSPMD-style systems rest on: every
+member of a mesh axis must issue the SAME collective sequence in the
+SAME order — so axis names must resolve, collectives may not hide under
+divergent control flow, and p2p permutes must be issued by every shard
+unconditionally.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..tracecheck import rules as R
+from ..tracecheck.callgraph import callee_name
+from ..tracecheck.findings import Finding
+from .mesh_model import (PERMUTE_TAILS, SpmdContext, classify_collective,
+                         is_p2p_call)
+
+MESH_RULES: Dict[str, str] = {
+    "MSH001": "collective over an axis name that is neither a topology "
+              "axis (fleet/base_topology._HYBRID_AXES) nor bound by a "
+              "mesh/shard_map declared in the module — resolves only by "
+              "accident, and a group's .axis_name read without "
+              ".global_axis addresses the wrong mesh axis for "
+              "topology-derived groups",
+    "MSH002": "collective reachable under a tensor-valued Python "
+              "if/while in per-shard code — shards concretize the "
+              "predicate differently (or trace fails), so only some "
+              "members issue the collective: every host deadlocks at "
+              "the first mismatched collective",
+    "MSH003": "mutually exclusive branches issue DIFFERENT collective "
+              "sequences on a rank-dependent predicate — members of the "
+              "axis disagree on the order of collectives and the mesh "
+              "hangs at the first mismatch; hoist collectives out of "
+              "the branch or make the sequences identical",
+    "MSH004": "unpaired point-to-point discipline: a "
+              "ppermute/shift/send/recv issued under divergent control "
+              "flow (lax.cond/switch branch, or a rank-conditional "
+              "Python guard) — a permute only some shards issue, or a "
+              "send whose matching recv is built by a different "
+              "conditional, hangs the pipeline; issue permutes "
+              "unconditionally each tick (zbh1 idiom) and pair "
+              "send/recv keys by construction",
+    "MSH005": "rank/process-id-dependent Python branching in "
+              "collective-issuing code — each process traces a "
+              "DIFFERENT program, so compiled collective schedules "
+              "disagree across hosts; use traced lax.cond + masked "
+              "psum (zbh1 idiom) or hoist the branch out of the traced "
+              "region",
+    "MSH006": "host callback or telemetry write inside a shard_map "
+              "body — runs per shard per step on every host (TRC007's "
+              "trace-time hazard compounded by mesh fan-out) and can "
+              "desynchronize the per-shard schedule; record at the "
+              "dispatch boundary instead",
+}
+
+_RANKISH_CALL_TAILS = {"axis_index", "process_index", "get_rank",
+                       "get_stage_id", "get_group_rank", "axis_rank",
+                       "get_local_rank", "get_data_parallel_rank",
+                       "get_model_parallel_rank",
+                       "get_sharding_parallel_rank",
+                       "get_sep_parallel_rank", "is_first_stage",
+                       "is_last_stage"}
+
+_RANKISH_IDENT = re.compile(
+    r"(^rank$|_rank$|^rank_|stage_id|first_stage|last_stage|"
+    r"^is_first$|^is_last$|^global_rank$|^proc_id$|^process_index$)")
+
+
+def _finding(fi, node: ast.AST, rule: str, msg: str) -> Finding:
+    line = getattr(node, "lineno", fi.lineno)
+    return Finding(rule=rule, path=fi.module.relpath, line=line,
+                   func=fi.qualname, message=msg,
+                   source=fi.module.line(line))
+
+
+def _calls_in_order(node: ast.AST) -> Iterator[ast.Call]:
+    """Pre-order call sites, never entering nested function defs."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+        return
+    if isinstance(node, ast.Call):
+        yield node
+    for child in ast.iter_child_nodes(node):
+        yield from _calls_in_order(child)
+
+
+def _rankish_test(test: ast.expr) -> Optional[str]:
+    """Does this predicate read a rank/stage/process identity?  Returns
+    the identifying name, or None."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            name = callee_name(node)
+            if name and name.rsplit(".", 1)[-1] in _RANKISH_CALL_TAILS:
+                return name
+        elif isinstance(node, ast.Name):
+            if _RANKISH_IDENT.search(node.id.lower()):
+                return node.id
+        elif isinstance(node, ast.Attribute):
+            if _RANKISH_IDENT.search(node.attr.lower()):
+                return node.attr
+    return None
+
+
+def _if_statements(fi) -> Iterator[ast.stmt]:
+    """If/While statements of this function body (not nested defs)."""
+    if isinstance(fi.node, (ast.Module, ast.Lambda)):
+        return
+    stack: List[ast.AST] = list(fi.node.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, (ast.If, ast.While)):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ------------------------------------------------------------------ MSH001
+def _param_default(fi, name: str) -> Tuple[bool, Optional[str]]:
+    """(is_parameter, string_default_or_None), searching enclosing
+    scopes so a nested helper sees the outer function's signature."""
+    scope = fi
+    while scope is not None:
+        node = scope.node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            a = node.args
+            pos = list(a.posonlyargs) + list(a.args)
+            n_def = len(a.defaults)
+            for i, p in enumerate(pos):
+                if p.arg != name:
+                    continue
+                di = i - (len(pos) - n_def)
+                d = a.defaults[di] if di >= 0 else None
+                return True, (d.value if isinstance(d, ast.Constant)
+                              and isinstance(d.value, str) else None)
+            for p, d in zip(a.kwonlyargs, a.kw_defaults):
+                if p.arg == name:
+                    return True, (d.value if isinstance(d, ast.Constant)
+                                  and isinstance(d.value, str) else None)
+            if name in {x.arg for x in (a.vararg, a.kwarg) if x}:
+                return True, None
+        scope = scope.parent
+    return False, None
+
+
+def _axis_names_of(fi, node: ast.expr) -> List[Tuple[str, str]]:
+    """Statically-known axis names an axis argument denotes:
+    [(name, provenance), ...]."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [(node.value, "")]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append((e.value, ""))
+        return out
+    if isinstance(node, ast.Name):
+        is_param, default = _param_default(fi, node.id)
+        if is_param:
+            if default is not None:
+                return [(default, f" (default of parameter "
+                                  f"'{node.id}')")]
+            return []
+        # simple local binding: name = "literal"
+        if not isinstance(fi.node, (ast.Module, ast.Lambda)):
+            for stmt in R._flatten_statements(list(fi.node.body)):
+                if isinstance(stmt, ast.Assign) and \
+                        isinstance(stmt.value, ast.Constant) and \
+                        isinstance(stmt.value.value, str) and \
+                        any(isinstance(t, ast.Name) and t.id == node.id
+                            for t in stmt.targets):
+                    return [(stmt.value.value, f" (via '{node.id}')")]
+    return []
+
+
+def _axis_bound(fi, ctx: SpmdContext, name: str) -> bool:
+    if name in ctx.topology_axes:
+        return True
+    mp = ctx.graph.modpath_of(fi.module)
+    return name in ctx.module_axes.get(mp, ())
+
+
+def _group_axis_reads(fi) -> List[Finding]:
+    """A group's ``.axis_name`` read without consulting
+    ``.global_axis`` (and without pairing it with the group's OWN
+    ``.mesh``): topology-derived groups address collectives by their
+    GLOBAL mesh axis — ``communication.group.resolve_group_axis`` is
+    the sanctioned resolver."""
+    reads: List[Tuple[str, ast.AST]] = []
+    mentions_global = False
+    mesh_objs = set()
+    for node in R._body_walk(fi):
+        if isinstance(node, ast.Attribute):
+            if node.attr == "global_axis":
+                mentions_global = True
+            elif node.attr in ("mesh", "get_mesh") and \
+                    isinstance(node.value, ast.Name):
+                mesh_objs.add(node.value.id)
+            elif node.attr == "axis_name" and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id not in ("self", "cls") and \
+                    isinstance(node.ctx, ast.Load):
+                reads.append((node.value.id, node))
+        elif isinstance(node, ast.Constant) and node.value == "global_axis":
+            mentions_global = True
+        elif isinstance(node, ast.Call):
+            name = callee_name(node)
+            if name == "getattr" and len(node.args) >= 2 and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id not in ("self", "cls") and \
+                    isinstance(node.args[1], ast.Constant) and \
+                    node.args[1].value == "axis_name":
+                reads.append((node.args[0].id, node))
+    if mentions_global or not reads:
+        return []
+    seen = set()
+    out = []
+    for obj, node in reads:
+        if obj in mesh_objs or obj in seen:
+            continue            # paired with the group's own 1-D mesh
+        seen.add(obj)
+        out.append(_finding(
+            fi, node, "MSH001",
+            f"'{obj}.axis_name' resolved without consulting "
+            f"'{obj}.global_axis' — a group derived from a topology "
+            "axis addresses collectives by its GLOBAL mesh axis "
+            "(dp/mp/pp/...), not its private 1-D mesh name; use "
+            "communication.group.resolve_group_axis (global_axis "
+            "first, then axis_name)"))
+    return out
+
+
+def msh001_axis_binding(fi, ctx: SpmdContext) -> List[Finding]:
+    out: List[Finding] = []
+    for site in ctx.collectives.get(id(fi), ()):
+        if site.axis_node is None:
+            continue
+        for name, how in _axis_names_of(fi, site.axis_node):
+            if _axis_bound(fi, ctx, name):
+                continue
+            out.append(_finding(
+                fi, site.call, "MSH001",
+                f"collective {site.tail}(...) over axis '{name}'{how} — "
+                f"not a topology axis "
+                f"({'/'.join(sorted(ctx.topology_axes))}) and not bound "
+                "by any mesh/shard_map declared in this module; the "
+                "name resolves only if some caller binds it, and a "
+                "multi-process run hangs or fails where a single-host "
+                "test cannot see it"))
+    out.extend(_group_axis_reads(fi))
+    return out
+
+
+# ------------------------------------------------------------------ MSH002
+def msh002_collective_under_tensor_branch(fi, ctx: SpmdContext
+                                          ) -> List[Finding]:
+    if id(fi) not in ctx.spmd_fns or \
+            isinstance(fi.node, (ast.Module, ast.Lambda)):
+        return []
+    tainted: set = set()
+    out: List[Finding] = []
+    for stmt in R._flatten_statements(list(fi.node.body)):
+        if isinstance(stmt, ast.Assign):
+            desc = R._tensorish(fi, stmt.value, tainted)
+            for c in R._assigned_chains(stmt):
+                if "." not in c:
+                    (tainted.add(c) if desc else tainted.discard(c))
+        if not isinstance(stmt, (ast.If, ast.While)):
+            continue
+        if R._test_has_tracer_guard(stmt.test):
+            continue
+        desc = R._tensorish(fi, stmt.test, tainted)
+        if desc is None:
+            continue
+        kind = "while" if isinstance(stmt, ast.While) else "if"
+        for blk in R._sub_blocks(stmt):
+            for s2 in R._flatten_statements(blk):
+                for call in R._header_calls(s2):
+                    site = classify_collective(fi, call, ctx.graph)
+                    if site is not None and not site.query_only:
+                        out.append(_finding(
+                            fi, call, "MSH002",
+                            f"collective {site.tail}(...) under "
+                            f"tensor-valued `{kind}` ({desc}) — shards "
+                            "concretize the predicate independently, so "
+                            "only some members issue the collective and "
+                            "every host deadlocks at the first "
+                            "mismatch; use lax.cond with the collective "
+                            "hoisted out, or mask with jnp.where"))
+                        continue
+                    if any(id(c) in ctx.reaches
+                           for c in ctx.graph.resolve_call(fi, call)):
+                        out.append(_finding(
+                            fi, call, "MSH002",
+                            f"call under tensor-valued `{kind}` ({desc}) "
+                            "reaches collectives — divergent-collective "
+                            "deadlock; hoist the collective-bearing "
+                            "call out of the branch"))
+    return out
+
+
+# ------------------------------------------------------------------ MSH003
+def _collective_sequence(fi, stmts, ctx: SpmdContext
+                         ) -> List[Tuple[str, str]]:
+    """Ordered (op, axis) sequence a statement list issues: direct
+    collectives plus one level of resolved same-package calls."""
+    seq: List[Tuple[str, str]] = []
+    for stmt in stmts:
+        for call in _calls_in_order(stmt):
+            site = classify_collective(fi, call, ctx.graph)
+            if site is not None:
+                if site.query_only:
+                    continue
+                names = _axis_names_of(fi, site.axis_node) \
+                    if site.axis_node is not None else []
+                seq.append((site.tail,
+                            names[0][0] if names else "?"))
+                continue
+            for callee in ctx.graph.resolve_call(fi, call):
+                for sub in ctx.collectives.get(id(callee), ()):
+                    if sub.query_only:
+                        continue
+                    names = _axis_names_of(callee, sub.axis_node) \
+                        if sub.axis_node is not None else []
+                    seq.append((sub.tail,
+                                names[0][0] if names else "?"))
+    return seq
+
+
+def msh003_divergent_sequences(fi, ctx: SpmdContext) -> List[Finding]:
+    if id(fi) not in ctx.spmd_fns and id(fi) not in ctx.reaches:
+        return []
+    out: List[Finding] = []
+    for stmt in _if_statements(fi):
+        if not isinstance(stmt, ast.If) or not stmt.orelse:
+            continue
+        why = _rankish_test(stmt.test)
+        if why is None:
+            continue            # uniform/static predicates are sound
+        seq_a = _collective_sequence(fi, stmt.body, ctx)
+        seq_b = _collective_sequence(fi, stmt.orelse, ctx)
+        if seq_a == seq_b or not (seq_a or seq_b):
+            continue
+
+        def fmt(seq):
+            return "[" + ", ".join(f"{t}@{a}" for t, a in seq) + "]"
+
+        out.append(_finding(
+            fi, stmt, "MSH003",
+            f"exclusive branches on rank-dependent predicate ({why}) "
+            f"issue different collective sequences: {fmt(seq_a)} vs "
+            f"{fmt(seq_b)} — members of the axis disagree on collective "
+            "order and hang at the first mismatch; issue the same "
+            "sequence on both paths (mask unused results) or hoist the "
+            "collectives above the branch"))
+    return out
+
+
+# ------------------------------------------------------------------ MSH004
+def msh004_permute_discipline(fi, ctx: SpmdContext) -> List[Finding]:
+    out: List[Finding] = []
+    # (a) permute inside a lax.cond/switch branch: divergent issuance
+    if id(fi) in ctx.cond_reach:
+        for site in ctx.collectives.get(id(fi), ()):
+            if site.tail in PERMUTE_TAILS:
+                out.append(_finding(
+                    fi, site.call, "MSH004",
+                    f"{site.tail}(...) inside a lax.cond/switch branch "
+                    "— only the shards taking this branch issue the "
+                    "permute, and collective-permute requires every "
+                    "member of the axis each step; issue it "
+                    "unconditionally outside the branch and mask the "
+                    "payload instead (zbh1 tick idiom)"))
+    if isinstance(fi.node, (ast.Module, ast.Lambda)):
+        return out
+
+    # (b) eager p2p issued under a rank-conditional guard
+    def flag(call, how):
+        out.append(_finding(
+            fi, call, "MSH004",
+            f"p2p {callee_name(call)}(...) {how} — pairing of sends "
+            "and recvs is decided by per-rank host control flow, so a "
+            "mismatched branch strands the peer; derive both endpoints "
+            "of every transfer from the topology so keys pair by "
+            "construction (and keep the pairing under test)"))
+
+    def scan(stmts: List[ast.stmt], active: Optional[str]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                why = _rankish_test(stmt.test)
+                if why is not None:
+                    for blk in (stmt.body, stmt.orelse):
+                        for s2 in blk:
+                            for call in _calls_in_order(s2):
+                                if is_p2p_call(fi, call, ctx.graph):
+                                    flag(call, "issued under the "
+                                         f"rank-conditional branch "
+                                         f"({why})")
+                    if any(isinstance(s, ast.Return) for s in stmt.body):
+                        active = why
+                    continue
+            if active is not None:
+                for call in _calls_in_order(stmt):
+                    if is_p2p_call(fi, call, ctx.graph):
+                        flag(call, "guarded by a rank-conditional "
+                             f"early return ({active})")
+            else:
+                for blk in R._sub_blocks(stmt):
+                    scan(blk, active)
+
+    scan(list(fi.node.body), None)
+    return out
+
+
+# ------------------------------------------------------------------ MSH005
+def msh005_rank_divergent_trace(fi, ctx: SpmdContext) -> List[Finding]:
+    if id(fi) not in ctx.reaches or \
+            isinstance(fi.node, (ast.Module, ast.Lambda)):
+        return []
+    out: List[Finding] = []
+    for stmt in _if_statements(fi):
+        why = _rankish_test(stmt.test)
+        if why is None:
+            continue
+        kind = "while" if isinstance(stmt, ast.While) else "if"
+        out.append(_finding(
+            fi, stmt, "MSH005",
+            f"Python `{kind}` on rank/process identity ({why}) in "
+            "collective-issuing code — each process traces a DIFFERENT "
+            "program, so compiled collective schedules disagree across "
+            "hosts; use lax.cond on a traced axis_index + masked psum "
+            "(zbh1 idiom) or hoist the branch out of the traced region"))
+    return out
+
+
+# ------------------------------------------------------------------ MSH006
+_CALLBACK_TAILS = {"pure_callback", "io_callback"}
+_DEBUG_TAILS = {"print", "callback", "breakpoint"}
+
+
+def msh006_host_callbacks(fi, ctx: SpmdContext) -> List[Finding]:
+    if id(fi) not in ctx.shardmap_reach:
+        return []
+    out: List[Finding] = []
+    for node in R._body_walk(fi):
+        if not isinstance(node, ast.Call):
+            continue
+        name = callee_name(node)
+        if name is None:
+            continue
+        parts = name.split(".")
+        tail = parts[-1]
+        hit = (tail in _CALLBACK_TAILS
+               or ("debug" in parts[:-1] and tail in _DEBUG_TAILS)
+               or "host_callback" in parts)
+        if hit:
+            out.append(_finding(
+                fi, node, "MSH006",
+                f"host callback {name}(...) inside a shard_map body — "
+                "executes per shard per step on every host and can "
+                "desynchronize the per-shard schedule; move it to the "
+                "dispatch boundary (or jax.debug outside the manual "
+                "region)"))
+    for node, name in R._telemetry_writes(fi):
+        out.append(_finding(
+            fi, node, "MSH006",
+            f"telemetry write {name}(...) inside a shard_map body — "
+            "host-side state mutated per shard per step (TRC007's "
+            "hazard compounded by mesh fan-out); record at the "
+            "dispatch boundary"))
+    return out

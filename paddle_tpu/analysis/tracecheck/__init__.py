@@ -30,9 +30,11 @@ Run it locally::
 
 from .findings import (Finding, RULES, fingerprint, load_baseline,
                        subtract_baseline, write_baseline)
-from .analyzer import AnalyzerConfig, analyze_package
+from .analyzer import (AnalyzerConfig, ParsedPackage, analyze_package,
+                       parse_package)
 
 __all__ = [
-    "AnalyzerConfig", "Finding", "RULES", "analyze_package", "fingerprint",
-    "load_baseline", "subtract_baseline", "write_baseline",
+    "AnalyzerConfig", "Finding", "ParsedPackage", "RULES",
+    "analyze_package", "fingerprint", "load_baseline", "parse_package",
+    "subtract_baseline", "write_baseline",
 ]

@@ -15,7 +15,8 @@ from typing import Dict, List, Optional, Tuple
 from .callgraph import (CallGraph, FunctionInfo, ModuleInfo, index_module,
                         mark_roots_from_wrapper_calls)
 from .donors import ModuleDonors
-from .findings import Finding, hotpath_lines, parse_pragmas, suppressed
+from .findings import (Finding, dedupe_findings, hotpath_lines,
+                       parse_pragmas, suppressed)
 from . import rules as R
 
 
@@ -52,13 +53,35 @@ def _iter_py_files(root: str):
                 yield os.path.join(dirpath, fn)
 
 
-def analyze_package(package_path: str,
-                    config: Optional[AnalyzerConfig] = None
-                    ) -> AnalysisResult:
-    """Analyze every ``.py`` under ``package_path`` (a package directory
-    or a single file).  Paths in findings are relative to the package's
-    parent, posix-style ('paddle_tpu/nn/functional.py')."""
-    config = config or AnalyzerConfig()
+@dataclass
+class ParsedPackage:
+    """One parsed package: the ast.parse output the rule suites share.
+    Parsing dominates analyzer wall clock, so the unified CLI
+    (tools/analyze.py) parses once and hands the same ParsedPackage to
+    tracecheck AND meshcheck."""
+    package: str
+    modules: Dict[str, ModuleInfo]
+    errors: List[str] = field(default_factory=list)
+    n_files: int = 0
+
+    def filtered(self, exclude_patterns: Tuple[str, ...]
+                 ) -> "ParsedPackage":
+        """A view with this exclude set applied — a shared parse may
+        have been built with a different (or no) one, and both suites'
+        entry paths must agree."""
+        if not exclude_patterns:
+            return self
+        kept = {mp: m for mp, m in self.modules.items()
+                if not any(p in m.relpath for p in exclude_patterns)}
+        return ParsedPackage(self.package, kept, list(self.errors),
+                             len(kept))
+
+
+def parse_package(package_path: str,
+                  exclude_patterns: Tuple[str, ...] = ()) -> ParsedPackage:
+    """Parse every ``.py`` under ``package_path`` (a package directory or
+    a single file).  Paths are relative to the package's parent,
+    posix-style ('paddle_tpu/nn/functional.py')."""
     package_path = os.path.abspath(package_path)
     if os.path.isfile(package_path):
         parent = os.path.dirname(os.path.dirname(package_path))
@@ -69,23 +92,44 @@ def analyze_package(package_path: str,
         files = list(_iter_py_files(package_path))
         package = os.path.basename(package_path)
 
-    result = AnalysisResult(findings=[], suppressed=[])
-    modules: Dict[str, ModuleInfo] = {}
+    parsed = ParsedPackage(package=package, modules={})
     for path in files:
         rel = os.path.relpath(path, parent).replace(os.sep, "/")
-        if any(p in rel for p in config.exclude_patterns):
+        if any(p in rel for p in exclude_patterns):
             continue
         try:
             with open(path, encoding="utf-8") as fh:
                 source = fh.read()
             mod = index_module(rel, source, package)
         except (SyntaxError, UnicodeDecodeError) as e:
-            result.errors.append(f"{rel}: {e}")
+            parsed.errors.append(f"{rel}: {e}")
             continue
-        modules[_modpath(rel)] = mod
-        result.n_files += 1
+        parsed.modules[_modpath(rel)] = mod
+        parsed.n_files += 1
+    return parsed
 
-    graph = CallGraph(modules, package)
+
+def analyze_package(package_path: str,
+                    config: Optional[AnalyzerConfig] = None,
+                    parsed: Optional[ParsedPackage] = None
+                    ) -> AnalysisResult:
+    """Analyze every ``.py`` under ``package_path`` (a package directory
+    or a single file).  Paths in findings are relative to the package's
+    parent, posix-style ('paddle_tpu/nn/functional.py').  ``parsed``
+    reuses an existing parse (the root/traced flags this pass sets on it
+    are monotone and idempotent, so re-analysis is stable)."""
+    config = config or AnalyzerConfig()
+    if parsed is None:
+        parsed = parse_package(package_path, config.exclude_patterns)
+    else:
+        parsed = parsed.filtered(config.exclude_patterns)
+    modules = parsed.modules
+
+    result = AnalysisResult(findings=[], suppressed=[])
+    result.errors = list(parsed.errors)
+    result.n_files = parsed.n_files
+
+    graph = CallGraph(modules, parsed.package)
 
     # roots: wrapper calls + decorators (set during indexing) + traced
     # module patterns + hotpath markers
@@ -137,16 +181,7 @@ def analyze_package(package_path: str,
                 (result.suppressed if suppressed(f, pragmas)
                  else findings).append(f)
 
-    # de-dup (a call site can be visited via overlapping scans) + order
-    seen = set()
-    uniq: List[Finding] = []
-    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule,
-                                             f.func)):
-        key = (f.rule, f.path, f.line, f.func, f.message)
-        if key not in seen:
-            seen.add(key)
-            uniq.append(f)
-    result.findings = uniq
+    result.findings = dedupe_findings(findings)
     return result
 
 

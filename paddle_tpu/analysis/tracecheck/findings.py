@@ -46,8 +46,17 @@ RULES: Dict[str, str] = {
               "an explicit pragma with a reason (per-step host cost)",
 }
 
-_PRAGMA_RE = re.compile(
-    r"#\s*tracecheck:\s*(disable|hotpath)(?:=([A-Za-z0-9_,\s]+))?")
+def pragma_re(tool: str = "tracecheck") -> "re.Pattern":
+    """The inline-pragma pattern for one analyzer.  The machinery below is
+    shared with meshcheck (``# meshcheck: disable=MSH00x``); each suite
+    recognizes only its own tool prefix so a pragma never silences the
+    other suite's rules."""
+    return re.compile(
+        r"#\s*" + re.escape(tool) +
+        r":\s*(disable|hotpath)(?:=([A-Za-z0-9_,\s]+))?")
+
+
+_PRAGMA_RE = pragma_re("tracecheck")
 
 
 @dataclass(frozen=True)
@@ -73,12 +82,29 @@ def fingerprint(f: Finding) -> str:
     return f"{f.rule}:{f.path}:{f.func}:{f.source}"
 
 
+def dedupe_findings(findings: List[Finding]) -> List[Finding]:
+    """Sorted, exact-duplicate-free finding list (a call site can be
+    visited via overlapping scans) — the one finalization both suites
+    share, so their ordering/dedup semantics can never drift."""
+    seen = set()
+    uniq: List[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule,
+                                             f.func)):
+        key = (f.rule, f.path, f.line, f.func, f.message)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(f)
+    return uniq
+
+
 # -------------------------------------------------------------- pragmas
-def parse_pragmas(source_lines: List[str]) -> Dict[int, set]:
+def parse_pragmas(source_lines: List[str],
+                  tool: str = "tracecheck") -> Dict[int, set]:
     """Line -> set of disabled rule codes (empty set = all rules).
     A pragma applies to its own line and, when the line holds nothing
     else (a standalone comment), to the following line."""
     out: Dict[int, set] = {}
+    pat = _PRAGMA_RE if tool == "tracecheck" else pragma_re(tool)
 
     def add(line: int, codes: set) -> None:
         cur = out.get(line)
@@ -90,7 +116,7 @@ def parse_pragmas(source_lines: List[str]) -> Dict[int, set]:
             cur.update(codes)
 
     for i, text in enumerate(source_lines, start=1):
-        m = _PRAGMA_RE.search(text)
+        m = pat.search(text)
         if not m or m.group(1) != "disable":
             continue
         codes = (set(c.strip().upper() for c in m.group(2).split(",")

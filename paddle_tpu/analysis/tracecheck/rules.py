@@ -476,9 +476,12 @@ def _test_has_tracer_guard(test: ast.expr) -> bool:
 
 
 # trace-STATIC jnp predicates: dtype/shape/rank queries return concrete
-# python values even on tracers — branching on them is fine
+# python values even on tracers — branching on them is fine.
+# lax.axis_size is a static mesh-shape query (NOT axis_index, which
+# returns a tracer).
 _STATIC_JNP = {"shape", "ndim", "size", "result_type", "dtype",
-               "iscomplexobj", "isrealobj", "issubdtype", "isdtype"}
+               "iscomplexobj", "isrealobj", "issubdtype", "isdtype",
+               "axis_size"}
 # value-producing reductions commonly branched on: x.any(), x.sum() > 0
 _VALUE_METHODS = {"any", "all", "sum", "max", "min", "mean", "prod"}
 # concretizers: int(x)/float(x)/bool(x) yield host values (or raise at

@@ -96,6 +96,19 @@ class Group:
         return f"Group(id={self.id}, ranks={self.ranks}, backend=xla)"
 
 
+def resolve_group_axis(group, default: Optional[str] = None
+                       ) -> Optional[str]:
+    """The mesh axis a group's collectives address: the GLOBAL mesh
+    axis for topology-derived groups (``global_axis``), else the
+    group's own axis name.  The single resolution order every consumer
+    (TP layers, sharding, MoE, in_jit) shares — a group's private 1-D
+    mesh name ("g") is only meaningful on the group's own mesh."""
+    if group is None:
+        return default
+    return (getattr(group, "global_axis", None)
+            or getattr(group, "axis_name", None) or default)
+
+
 _GROUP_MAP: Dict[int, Group] = {}
 _next_gid = [1]
 

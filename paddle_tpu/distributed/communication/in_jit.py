@@ -20,13 +20,16 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .group import Group, ReduceOp
+from .group import ReduceOp, resolve_group_axis
 
 
 def _axis(axis_or_group) -> str:
-    if isinstance(axis_or_group, Group):
-        return axis_or_group.global_axis or axis_or_group.axis_name
-    return axis_or_group
+    if isinstance(axis_or_group, str):
+        return axis_or_group
+    # duck-typed: Group AND topology CommGroup resolve the same way
+    # (global_axis for topology-derived groups, else the group's own
+    # axis name) through the one shared resolver
+    return resolve_group_axis(axis_or_group) or axis_or_group
 
 
 def axis_rank(axis_or_group) -> jax.Array:

@@ -40,11 +40,15 @@ class CommGroup:
     shard_map; ``ranks`` reflect the logical rank grid.
     """
 
-    _next_id = itertools.count()
-
     def __init__(self, mesh: Optional[Mesh], axis_name: Optional[str],
                  ranks: List[int], rank: int):
-        self.id = next(CommGroup._next_id)
+        # deterministic identity: two CommGroups over the same axis and
+        # member set ARE the same logical group, whichever HCG instance
+        # built them — the eager p2p mailbox keys transfers by group id,
+        # so a per-instance counter would strand every send whose recv
+        # came through a different (but identical) group object
+        self.id = f"{axis_name or 'world'}:" + ",".join(
+            str(int(r)) for r in ranks)
         self.mesh = mesh
         self.axis_name = axis_name
         self.ranks = list(ranks)
@@ -150,6 +154,7 @@ class HybridCommunicateGroup:
         self.nranks = topology.world_size()
         self.global_rank = global_rank
         self._mesh = mesh if mesh is not None else self._build_mesh()
+        self._axis_groups: Dict[str, CommGroup] = {}
 
         coord = self._topo.get_coord(global_rank)
         self._dp_rank = coord.data if hasattr(coord, "data") else 0
@@ -191,12 +196,17 @@ class HybridCommunicateGroup:
         return "SHARDING_PARALLEL"
 
     def _axis_group(self, axis: str, rank_in_axis: int) -> CommGroup:
+        cached = self._axis_groups.get(axis)
+        if cached is not None:
+            return cached
         name_map = {"dp": "data", "pp": "pipe", "sharding": "sharding",
                     "sep": "sep", "mp": "model"}
         comm_lists = self._topo.get_comm_list(name_map[axis])
         my = next((g for g in comm_lists if self.global_rank in g), comm_lists[0])
-        return CommGroup(self._mesh, axis, my, my.index(self.global_rank)
-                         if self.global_rank in my else 0)
+        grp = CommGroup(self._mesh, axis, my, my.index(self.global_rank)
+                        if self.global_rank in my else 0)
+        self._axis_groups[axis] = grp
+        return grp
 
     # --------------------------------------------------------------- global
     def get_global_rank(self) -> int:
@@ -306,3 +316,8 @@ def get_hybrid_communicate_group() -> HybridCommunicateGroup:
 def _reset_hcg():
     global _CURRENT_HCG
     _CURRENT_HCG = None
+    # deterministic CommGroup ids mean a rebuilt topology re-derives the
+    # SAME mailbox keys — drain undelivered p2p sends so a stale tensor
+    # from a torn-down run can never be delivered into the next one
+    from ..communication import p2p
+    p2p._MAILBOX.clear()

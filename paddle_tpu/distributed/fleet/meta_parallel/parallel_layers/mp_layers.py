@@ -35,7 +35,8 @@ from ...base_topology import try_get_hybrid_communicate_group
 
 def _mp_degree_and_axis(mp_group) -> tuple:
     if mp_group is not None:
-        return mp_group.nranks, getattr(mp_group, "axis_name", "mp") or "mp"
+        from ....communication.group import resolve_group_axis
+        return mp_group.nranks, resolve_group_axis(mp_group, "mp")
     hcg = try_get_hybrid_communicate_group()
     if hcg is not None:
         return hcg.get_model_parallel_world_size(), "mp"

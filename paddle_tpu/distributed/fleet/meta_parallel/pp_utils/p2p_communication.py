@@ -52,36 +52,76 @@ class SendRecvMeta:
 
 
 def _pp_group(hcg):
-    return hcg.get_pipe_parallel_group() if hcg is not None else None
+    if hcg is None:
+        from ...base_topology import try_get_hybrid_communicate_group
+        hcg = try_get_hybrid_communicate_group()
+    return (hcg.get_pipe_parallel_group() if hcg is not None
+            else None), hcg
 
 
-def send_forward(output_tensor, pp_last_stage: bool, hcg=None):
-    if pp_last_stage:
+def _stage_and_world(hcg):
+    """(this stage's id, pp world size).  The stage id comes from the
+    TOPOLOGY (the hcg's pipe coordinate == its rank within the cached pp
+    group), never from process identity — both endpoints of every
+    transfer below are derived from it, so a ``send_forward`` at stage s
+    and the ``recv_forward`` at stage s+1 address the same mailbox key
+    (src=s, dst=s+1) by construction.  Without a topology there IS no
+    stage identity and no pairable key — fail loudly instead of
+    stranding the peer."""
+    if hcg is None:
+        raise RuntimeError(
+            "pp_utils p2p needs a hybrid topology to derive both "
+            "endpoints of the transfer: call fleet.init(...) first or "
+            "pass hcg= explicitly")
+    return hcg.get_stage_id(), hcg.get_pipe_parallel_world_size()
+
+
+def send_forward(output_tensor, pp_last_stage: bool = None, hcg=None):
+    if pp_last_stage:           # explicit boundary no-op: no transfer,
+        return None             # no stage identity or topology needed
+    g, hcg = _pp_group(hcg)
+    s, world = _stage_and_world(hcg)
+    if pp_last_stage is None and s == world - 1:
         return None
-    g = _pp_group(hcg)
-    nxt = (g.rank + 1) % g.nranks if g else 1
-    return dist.send(output_tensor, dst=nxt, group=g)
+    # stage-conditional by design (boundary stages sit out one transfer,
+    # mirroring the reference API); both endpoints derive from the stage
+    # id so the keys pair by construction — TestPipelineP2P drives every
+    # consecutive stage pair  # meshcheck: disable=MSH004
+    return dist.send(output_tensor, dst=s + 1, group=g, src=s)
 
 
-def recv_forward(pp_first_stage: bool, ref_tensor=None, hcg=None):
-    if pp_first_stage:
+def recv_forward(pp_first_stage: bool = None, ref_tensor=None, hcg=None):
+    if pp_first_stage:          # explicit boundary no-op
         return None
-    g = _pp_group(hcg)
-    prev = (g.rank - 1) % g.nranks if g else 0
-    return dist.recv(ref_tensor, src=prev, group=g)
-
-
-def send_backward(input_tensor_grad, pp_first_stage: bool, hcg=None):
-    if pp_first_stage:
+    g, hcg = _pp_group(hcg)
+    s, world = _stage_and_world(hcg)
+    if pp_first_stage is None and s == 0:
         return None
-    g = _pp_group(hcg)
-    prev = (g.rank - 1) % g.nranks if g else 0
-    return dist.send(input_tensor_grad, dst=prev, group=g)
+    # paired with stage s-1's send_forward key (s-1, s) by construction
+    # meshcheck: disable=MSH004
+    return dist.recv(ref_tensor, src=s - 1, group=g, dst=s)
 
 
-def recv_backward(pp_last_stage: bool, ref_tensor=None, hcg=None):
-    if pp_last_stage:
+def send_backward(input_tensor_grad, pp_first_stage: bool = None,
+                  hcg=None):
+    if pp_first_stage:          # explicit boundary no-op
         return None
-    g = _pp_group(hcg)
-    nxt = (g.rank + 1) % g.nranks if g else 1
-    return dist.recv(ref_tensor, src=nxt, group=g)
+    g, hcg = _pp_group(hcg)
+    s, world = _stage_and_world(hcg)
+    if pp_first_stage is None and s == 0:
+        return None
+    # paired with stage s-1's recv_backward key (s, s-1) by construction
+    # meshcheck: disable=MSH004
+    return dist.send(input_tensor_grad, dst=s - 1, group=g, src=s)
+
+
+def recv_backward(pp_last_stage: bool = None, ref_tensor=None, hcg=None):
+    if pp_last_stage:           # explicit boundary no-op
+        return None
+    g, hcg = _pp_group(hcg)
+    s, world = _stage_and_world(hcg)
+    if pp_last_stage is None and s == world - 1:
+        return None
+    # paired with stage s+1's send_backward key (s+1, s) by construction
+    # meshcheck: disable=MSH004
+    return dist.recv(ref_tensor, src=s + 1, group=g, dst=s)

@@ -23,8 +23,10 @@ from .group_sharded_utils import resolve_sharding_axis
 
 
 def _sharding_axis_for(group) -> str:
-    if group is not None and getattr(group, "axis_name", None):
-        return group.axis_name
+    from ....communication.group import resolve_group_axis
+    axis = resolve_group_axis(group)
+    if axis:
+        return axis
     hcg = try_get_hybrid_communicate_group()
     if hcg is not None:
         mesh = hcg.get_mesh()

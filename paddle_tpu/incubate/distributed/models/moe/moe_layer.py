@@ -161,7 +161,12 @@ class MoELayer(Layer):
         super().__init__()
         self.d_model = d_model
         if expert_axis is None and moe_group is not None:
-            expert_axis = getattr(moe_group, "axis_name", None)
+            # the expert weights' dist_attr names an axis of the GLOBAL
+            # training mesh; a private 1-D group mesh name would
+            # silently leave the experts replicated
+            from .....distributed.communication.group import (
+                resolve_group_axis)
+            expert_axis = resolve_group_axis(moe_group)
         self.expert_axis = expert_axis
 
         if isinstance(experts, Experts):

@@ -266,3 +266,144 @@ class TestInJitCollectives:
             lambda v: in_jit.all_to_all(v, "g", split_axis=1, concat_axis=1),
             mesh=mesh, in_specs=P("g", None), out_specs=P("g", None)))
         np.testing.assert_allclose(np.asarray(f(x)), x.T)
+
+
+class TestPipelineP2P:
+    """The pp_utils p2p surface pairs sends and recvs BY CONSTRUCTION
+    (the r11 MSH004 fix): both endpoints of every transfer derive from
+    the topology's stage id, and group identity is deterministic, so a
+    send_forward at stage s and the recv_forward at stage s+1 hit the
+    same mailbox key whichever HCG instance each side built."""
+
+    def setup_method(self):
+        from paddle_tpu.distributed.communication import p2p
+        from paddle_tpu.distributed.fleet.base_topology import _reset_hcg
+        p2p._MAILBOX.clear()
+        _reset_hcg()
+
+    teardown_method = setup_method
+
+    def _stage_hcgs(self, S):
+        from paddle_tpu.distributed.fleet.base_topology import (
+            CommunicateTopology, HybridCommunicateGroup)
+        topo = CommunicateTopology(
+            ("data", "pipe", "sharding", "sep", "model"), (1, S, 1, 1, 1))
+        return [HybridCommunicateGroup(topo, global_rank=s)
+                for s in range(S)]
+
+    def test_forward_handoff_every_stage_pair(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_utils import (
+            p2p_communication as p2p)
+        hcgs = self._stage_hcgs(4)
+        assert [h.get_stage_id() for h in hcgs] == [0, 1, 2, 3]
+        for s in range(3):
+            p2p.send_forward(paddle.to_tensor(np.full(4, float(s))),
+                             hcg=hcgs[s])
+        # the last stage sits out the send; the first sits out the recv
+        assert p2p.send_forward(paddle.ones([4]), hcg=hcgs[3]) is None
+        assert p2p.recv_forward(hcg=hcgs[0]) is None
+        for s in range(1, 4):
+            ref = paddle.zeros([4])
+            p2p.recv_forward(ref_tensor=ref, hcg=hcgs[s])
+            np.testing.assert_allclose(ref.numpy(), np.full(4, float(s - 1)))
+
+    def test_backward_handoff_every_stage_pair(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_utils import (
+            p2p_communication as p2p)
+        hcgs = self._stage_hcgs(3)
+        for s in range(1, 3):
+            p2p.send_backward(paddle.to_tensor(np.full(2, 10.0 + s)),
+                              hcg=hcgs[s])
+        assert p2p.send_backward(paddle.ones([2]), hcg=hcgs[0]) is None
+        assert p2p.recv_backward(hcg=hcgs[2]) is None
+        for s in range(2):
+            ref = paddle.zeros([2])
+            p2p.recv_backward(ref_tensor=ref, hcg=hcgs[s])
+            np.testing.assert_allclose(ref.numpy(), np.full(2, 11.0 + s))
+
+    def test_explicit_stage_flags_still_honoured(self):
+        # reference-signature callers pass pp_last_stage/pp_first_stage
+        # explicitly; the derived default must not override them
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_utils import (
+            p2p_communication as p2p)
+        hcgs = self._stage_hcgs(2)
+        assert p2p.send_forward(paddle.ones([2]), True, hcg=hcgs[0]) is None
+        p2p.send_forward(paddle.ones([2]), False, hcg=hcgs[0])
+        ref = paddle.zeros([2])
+        p2p.recv_forward(False, ref, hcg=hcgs[1])
+        np.testing.assert_allclose(ref.numpy(), np.ones(2))
+
+    def test_group_identity_deterministic_across_hcg_instances(self):
+        hcgs = self._stage_hcgs(2)
+        g0 = hcgs[0].get_pipe_parallel_group()
+        # cached: repeated getter calls return the SAME object
+        assert hcgs[0].get_pipe_parallel_group() is g0
+        # deterministic: the peer's instance derives the same identity
+        g1 = hcgs[1].get_pipe_parallel_group()
+        assert g0.id == g1.id
+        assert g0.rank == 0 and g1.rank == 1
+
+    def test_no_topology_fails_loudly(self):
+        # without a topology there is no stage identity and no pairable
+        # mailbox key — a transfer must refuse, not strand a peer...
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_utils import (
+            p2p_communication as p2p)
+        with pytest.raises(RuntimeError, match="hybrid topology"):
+            p2p.send_forward(paddle.ones([2]), False)
+        with pytest.raises(RuntimeError, match="hybrid topology"):
+            p2p.recv_forward(False, paddle.zeros([2]))
+        # ...but an explicit boundary no-op transfers nothing and needs
+        # no topology (reference-signature callers at the edge stages)
+        assert p2p.send_forward(paddle.ones([2]), True) is None
+        assert p2p.recv_forward(True) is None
+        assert p2p.send_backward(paddle.ones([2]), True) is None
+        assert p2p.recv_backward(True) is None
+
+    def test_send_recv_meta_roundtrip(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_utils import (
+            p2p_communication as p2p)
+        meta = p2p.SendRecvMeta()
+        meta.send_meta((paddle.ones([2, 3]),))
+        meta.recv_meta()
+        assert meta.recv_shape_message == ((2, 3),)
+
+
+class TestGroupAxisResolution:
+    """Topology-derived groups address collectives by their GLOBAL mesh
+    axis (the r11 MSH001 fix): consumers resolve global_axis before the
+    group's private 1-D mesh name."""
+
+    def _axis_group(self, global_axis):
+        from paddle_tpu.distributed.communication.group import Group
+        return Group(99, [0, 1, 2, 3], axis_name="g",
+                     global_axis=global_axis)
+
+    def test_mp_layers_prefer_global_axis(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers \
+            import mp_layers
+        g = self._axis_group("mp")
+        assert mp_layers._mp_degree_and_axis(g) == (4, "mp")
+        lin = mp_layers.ColumnParallelLinear(8, 16, mp_group=g)
+        assert lin.axis == "mp"
+        # a CommGroup (axis_name IS the global axis) resolves unchanged
+        from paddle_tpu.distributed.fleet.base_topology import CommGroup
+        cg = CommGroup(None, "mp", [0, 1], 0)
+        assert mp_layers._mp_degree_and_axis(cg) == (2, "mp")
+
+    def test_sharding_axis_prefers_global_axis(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.sharding import (
+            group_sharded_stage)
+        g = self._axis_group("sharding")
+        assert group_sharded_stage._sharding_axis_for(g) == "sharding"
+        assert group_sharded_stage._sharding_axis_for(None) == "sharding"
+
+    def test_moe_expert_axis_prefers_global_axis(self):
+        from jax.sharding import PartitionSpec as P
+        from paddle_tpu.incubate.distributed.models.moe.moe_layer import (
+            MoELayer)
+        g = self._axis_group("dp")
+        layer = MoELayer(d_model=8, num_expert=2, d_hidden=16,
+                         moe_group=g)
+        assert layer.expert_axis == "dp"
+        assert tuple(layer.experts.w1.dist_attr) == tuple(P("dp", None,
+                                                            None))

@@ -1,0 +1,725 @@
+"""meshcheck: the SPMD collective-discipline static analyzer (tier-1).
+
+Three layers, mirroring test_tracecheck:
+  1. per-rule fixture tests — a flagged snippet, a clean twin, and a
+     pragma-suppressed copy for each MSH rule;
+  2. machinery tests — pragma isolation between suites, baseline
+     round-trip, shared-parse order independence, unified-CLI exit
+     codes;
+  3. the package gate — ``paddle_tpu`` analyzed end to end must show
+     ZERO findings beyond tools/meshcheck_baseline.json, inside the
+     acceptance time budget (shared parse with tracecheck).
+
+Pure AST: no jax import required by the analyzer itself.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from paddle_tpu.analysis.meshcheck import (AnalyzerConfig, analyze_package,
+                                           load_baseline, subtract_baseline,
+                                           write_baseline, MESH_RULES)
+from paddle_tpu.analysis import tracecheck as tc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "paddle_tpu")
+BASELINE = os.path.join(REPO, "tools", "meshcheck_baseline.json")
+
+pytestmark = pytest.mark.meshcheck
+
+
+# --------------------------------------------------------------- harness
+def run_snippet(tmp_path, source, config=None, name="mod.py", extra=None):
+    """Analyze one module as a tiny package; returns the result."""
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / name).write_text(textwrap.dedent(source))
+    for fname, src in (extra or {}).items():
+        (pkg / fname).write_text(textwrap.dedent(src))
+    result = analyze_package(str(pkg), config)
+    assert not result.errors, result.errors
+    return result
+
+
+def codes(result):
+    return [f.rule for f in result.findings]
+
+
+# ---------------------------------------------------------------- MSH001
+MSH001_FLAGGED = """
+    from jax import lax
+
+    def bad(x):
+        return lax.psum(x, "tp")
+"""
+
+
+def test_msh001_unbound_literal_axis(tmp_path):
+    res = run_snippet(tmp_path, MSH001_FLAGGED)
+    assert codes(res) == ["MSH001"]
+    assert "'tp'" in res.findings[0].message
+
+
+def test_msh001_topology_axis_clean(tmp_path):
+    # dp/pp/sharding/sep/mp are first-class (topology vocabulary)
+    res = run_snippet(tmp_path, """
+        from jax import lax
+
+        def ok(x):
+            return lax.psum(lax.all_gather(x, "mp", axis=0), "sep")
+    """)
+    assert codes(res) == []
+
+
+def test_msh001_module_declared_mesh_axis_clean(tmp_path):
+    # a module that builds its own mesh binds its own axis names
+    res = run_snippet(tmp_path, """
+        import numpy as np
+        import jax
+        from jax import lax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def run():
+            mesh = Mesh(np.array(jax.devices()), axis_names=("x",))
+            return jax.shard_map(lambda a: lax.psum(a, "x"), mesh=mesh,
+                                 in_specs=P("x"), out_specs=P())
+    """)
+    assert codes(res) == []
+
+
+def test_msh001_parameter_threaded_axis_clean(tmp_path):
+    # a parameter without a default is the caller's contract
+    res = run_snippet(tmp_path, """
+        from jax import lax
+
+        def ok(x, axis_name):
+            return lax.psum(x, axis_name)
+    """)
+    assert codes(res) == []
+
+
+def test_msh001_bad_parameter_default(tmp_path):
+    res = run_snippet(tmp_path, """
+        from jax import lax
+
+        def bad(x, axis_name="model"):
+            return lax.psum(x, axis_name)
+    """)
+    assert codes(res) == ["MSH001"]
+    assert "default of parameter" in res.findings[0].message
+
+
+def test_msh001_nested_helper_sees_outer_default(tmp_path):
+    # ring_flash_attention's rotate() idiom: the nested fn's axis comes
+    # from the enclosing function's (vocabulary) default
+    res = run_snippet(tmp_path, """
+        from jax import lax
+
+        def ring(x, axis_name="sep"):
+            def rotate(t):
+                return lax.ppermute(t, axis_name, [(0, 1), (1, 0)])
+            return rotate(x)
+    """)
+    assert codes(res) == []
+
+
+def test_msh001_group_axis_name_without_global_axis(tmp_path):
+    res = run_snippet(tmp_path, """
+        def resolve(group):
+            return group.nranks, getattr(group, "axis_name", "mp")
+    """)
+    assert codes(res) == ["MSH001"]
+    assert "global_axis" in res.findings[0].message
+
+
+def test_msh001_group_axis_clean_twins(tmp_path):
+    # in_jit._axis resolution order, and the group's-own-mesh pairing
+    res = run_snippet(tmp_path, """
+        def resolve(group):
+            return group.global_axis or group.axis_name
+
+        def distribute(group, spec_cls):
+            return (group.mesh, spec_cls(group.axis_name))
+    """)
+    assert codes(res) == []
+
+
+def test_msh001_pragma(tmp_path):
+    res = run_snippet(tmp_path, MSH001_FLAGGED.replace(
+        'return lax.psum(x, "tp")',
+        'return lax.psum(x, "tp")  # meshcheck: disable=MSH001'))
+    assert codes(res) == []
+    assert len(res.suppressed) == 1
+
+
+def test_tracecheck_pragma_does_not_silence_meshcheck(tmp_path):
+    # suite isolation: a tracecheck pragma must not absorb MSH findings
+    res = run_snippet(tmp_path, MSH001_FLAGGED.replace(
+        'return lax.psum(x, "tp")',
+        'return lax.psum(x, "tp")  # tracecheck: disable=TRC001'))
+    assert codes(res) == ["MSH001"]
+
+
+# ---------------------------------------------------------------- MSH002
+MSH002_FLAGGED = """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def body(x):
+        if jnp.max(x) > 0:
+            x = lax.psum(x, "mp")
+        return x
+
+    step = jax.jit(body)
+"""
+
+
+def test_msh002_collective_under_tensor_if(tmp_path):
+    res = run_snippet(tmp_path, MSH002_FLAGGED)
+    assert codes(res) == ["MSH002"]
+    assert "psum" in res.findings[0].message
+
+
+def test_msh002_static_shape_branch_clean(tmp_path):
+    # the tensor-predicate-exempt static-shape branch: shape/rank/dtype
+    # and lax.axis_size are concrete under trace — branching is uniform
+    res = run_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def body(x, h):
+            p = lax.axis_size("mp")
+            if x.shape[0] == 4:
+                x = lax.psum(x, "mp")
+            if h % p:
+                x = lax.all_gather(x, "mp", axis=0)
+            return x
+
+        step = jax.jit(body)
+    """)
+    assert codes(res) == []
+
+
+def test_msh002_reaches_collective_through_helper(tmp_path):
+    res = run_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def helper(x):
+            return lax.psum(x, "mp")
+
+        def body(x):
+            m = jnp.mean(x)
+            while m > 0:
+                x = helper(x)
+                m = m - 1
+            return x
+
+        step = jax.jit(body)
+    """)
+    assert "MSH002" in codes(res)
+
+
+def test_msh002_query_only_helper_clean(tmp_path):
+    # a helper that only queries axis_size moves no data — calling it
+    # under a tensor branch is sound and must not flag
+    res = run_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def n_shards():
+            return lax.axis_size("mp")
+
+        def body(x):
+            if jnp.max(x) > 0:
+                x = x * n_shards()
+            return lax.psum(x, "mp")
+
+        step = jax.jit(body)
+    """)
+    assert codes(res) == []
+
+
+def test_msh002_pragma(tmp_path):
+    res = run_snippet(tmp_path, MSH002_FLAGGED.replace(
+        'x = lax.psum(x, "mp")',
+        'x = lax.psum(x, "mp")  # meshcheck: disable=MSH002'))
+    assert codes(res) == []
+
+
+# ---------------------------------------------------------------- MSH003
+MSH003_FLAGGED = """
+    from jax import lax
+
+    def exchange(x, rank):
+        if rank == 0:
+            return lax.psum(x, "mp")
+        else:
+            return lax.all_gather(x, "mp", axis=0)
+"""
+
+
+def test_msh003_divergent_sequences_on_rank(tmp_path):
+    res = run_snippet(tmp_path, MSH003_FLAGGED)
+    assert "MSH003" in codes(res)
+    assert "psum@mp" in res.findings[0].message
+
+
+def test_msh003_same_sequence_clean(tmp_path):
+    res = run_snippet(tmp_path, """
+        from jax import lax
+
+        def exchange(x, rank):
+            if rank == 0:
+                return lax.psum(x * 2, "mp")
+            else:
+                return lax.psum(x, "mp")
+    """)
+    assert "MSH003" not in codes(res)
+
+
+def test_msh003_static_config_predicate_clean(tmp_path):
+    # a host-uniform config flag (same on every process) may pick
+    # between collective algorithms — the ulysses GQA idiom
+    res = run_snippet(tmp_path, """
+        from jax import lax
+
+        def attention(x, causal):
+            if causal:
+                return lax.all_to_all(x, "sep", 2, 1)
+            else:
+                return lax.all_gather(x, "sep", axis=1)
+    """)
+    assert "MSH003" not in codes(res)
+
+
+def test_msh003_pragma(tmp_path):
+    res = run_snippet(tmp_path, MSH003_FLAGGED.replace(
+        "if rank == 0:",
+        "if rank == 0:  # meshcheck: disable=MSH003"))
+    assert "MSH003" not in codes(res)
+
+
+# ---------------------------------------------------------------- MSH004
+MSH004_COND_PERMUTE = """
+    from jax import lax
+
+    def tick(x):
+        def fire(v):
+            return lax.ppermute(v, "pp", [(0, 1), (1, 0)])
+
+        def hold(v):
+            return v
+
+        return lax.cond(x.sum() > 0, fire, hold, x)
+"""
+
+
+def test_msh004_permute_in_cond_branch(tmp_path):
+    res = run_snippet(tmp_path, MSH004_COND_PERMUTE)
+    assert "MSH004" in codes(res)
+    assert "cond" in res.findings[0].message
+
+
+def test_msh004_permute_in_switch_branch_list(tmp_path):
+    # lax.switch takes its branches as ONE sequence at position 1 (the
+    # zbh1/ring spelling) — branch unpacking must still see them
+    res = run_snippet(tmp_path, """
+        from jax import lax
+
+        def tick(mode, x):
+            def fire(v):
+                return lax.ppermute(v, "pp", [(0, 1), (1, 0)])
+
+            def hold(v):
+                return v
+
+            return lax.switch(mode, [hold, fire], x)
+    """)
+    assert "MSH004" in codes(res)
+
+
+def test_msh004_matched_permutes_clean(tmp_path):
+    # the zbh1 tick idiom: every shard issues BOTH permutes every tick,
+    # unconditionally — payloads are masked, the schedule never diverges
+    res = run_snippet(tmp_path, """
+        import jax
+        from jax import lax
+
+        def tick(carry, x):
+            up = lax.ppermute(carry, "pp", [(0, 1), (1, 0)])
+            dn = lax.ppermute(x, "pp", [(1, 0), (0, 1)])
+            return up, dn
+
+        def schedule(c, xs):
+            return lax.scan(tick, c, xs)
+    """)
+    assert codes(res) == []
+
+
+P2P_MODULE = """
+    def send(tensor, dst=0, group=None, src=0):
+        return tensor
+
+    def recv(tensor, src=0, group=None, dst=0):
+        return tensor
+"""
+
+MSH004_P2P = """
+    from .communication import send, recv
+
+    def send_forward(x, last_stage):
+        if last_stage:
+            return None
+        return send(x, dst=1)
+
+    def exchange(x, rank):
+        if rank == 0:
+            send(x, dst=1)
+        else:
+            recv(x, src=0)
+"""
+
+
+def test_msh004_rank_conditional_p2p(tmp_path):
+    res = run_snippet(tmp_path, MSH004_P2P,
+                      extra={"communication.py": P2P_MODULE})
+    assert codes(res).count("MSH004") == 3   # guarded send + both branches
+
+
+def test_msh004_unconditional_p2p_clean(tmp_path):
+    res = run_snippet(tmp_path, """
+        from .communication import send, recv
+
+        def handoff(x, stage):
+            send(x, dst=stage + 1, src=stage)
+            return recv(x, src=stage - 1, dst=stage)
+    """, extra={"communication.py": P2P_MODULE})
+    assert codes(res) == []
+
+
+def test_msh004_pragma(tmp_path):
+    res = run_snippet(tmp_path, MSH004_P2P.replace(
+        "return send(x, dst=1)",
+        "return send(x, dst=1)  # meshcheck: disable=MSH004").replace(
+        "send(x, dst=1)\n        else",
+        "send(x, dst=1)  # meshcheck: disable=MSH004\n        else")
+        .replace("recv(x, src=0)",
+                 "recv(x, src=0)  # meshcheck: disable=MSH004"),
+        extra={"communication.py": P2P_MODULE})
+    assert codes(res) == []
+    assert len(res.suppressed) == 3
+
+
+# ---------------------------------------------------------------- MSH005
+MSH005_FLAGGED = """
+    from jax import lax
+
+    def step(x, rank):
+        if rank == 0:
+            x = x + 1
+        return lax.psum(x, "mp")
+"""
+
+
+def test_msh005_rank_branch_in_collective_code(tmp_path):
+    res = run_snippet(tmp_path, MSH005_FLAGGED)
+    assert "MSH005" in codes(res)
+
+
+def test_msh005_lax_cond_clean(tmp_path):
+    # the sanctioned spelling: traced cond on axis_index + masked psum
+    res = run_snippet(tmp_path, """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def step(x):
+            is_first = lax.axis_index("pp") == 0
+            x = lax.cond(is_first, lambda v: v + 1, lambda v: v, x)
+            return lax.psum(jnp.where(is_first, x, 0.0), "pp")
+    """)
+    assert "MSH005" not in codes(res)
+
+
+def test_msh005_rank_branch_without_collectives_clean(tmp_path):
+    # host bookkeeping on rank is fine when no collective is in reach
+    res = run_snippet(tmp_path, """
+        def log_line(metrics, rank):
+            if rank == 0:
+                return f"step {metrics}"
+            return None
+    """)
+    assert codes(res) == []
+
+
+def test_msh005_pragma(tmp_path):
+    res = run_snippet(tmp_path, MSH005_FLAGGED.replace(
+        "if rank == 0:",
+        "if rank == 0:  # meshcheck: disable=MSH005"))
+    assert "MSH005" not in codes(res)
+
+
+# ---------------------------------------------------------------- MSH006
+MSH006_FLAGGED = """
+    import jax
+    from jax import lax
+
+    def body(x):
+        jax.debug.print("x={x}", x=x)
+        return lax.psum(x, "mp")
+
+    def run(mesh, specs):
+        return jax.shard_map(body, mesh=mesh, in_specs=specs,
+                             out_specs=specs)
+"""
+
+
+def test_msh006_debug_print_in_shard_map_body(tmp_path):
+    res = run_snippet(tmp_path, MSH006_FLAGGED)
+    assert "MSH006" in codes(res)
+
+
+def test_msh006_telemetry_in_shard_map_body(tmp_path):
+    res = run_snippet(tmp_path, """
+        import jax
+        from jax import lax
+        from . import observability as obs
+
+        def body(x):
+            obs.counter("steps").inc()
+            return lax.psum(x, "mp")
+
+        def run(mesh, specs):
+            return jax.shard_map(body, mesh=mesh, in_specs=specs,
+                                 out_specs=specs)
+    """, extra={"observability.py": "def counter(name):\n    return None\n"})
+    assert "MSH006" in codes(res)
+
+
+def test_msh006_jit_level_callback_clean(tmp_path):
+    # pure_callback under plain jit is TRC territory, not mesh fan-out
+    res = run_snippet(tmp_path, """
+        import jax
+
+        def body(x):
+            return jax.pure_callback(lambda v: v, x, x)
+
+        step = jax.jit(body)
+    """)
+    assert "MSH006" not in codes(res)
+
+
+def test_msh006_pragma(tmp_path):
+    res = run_snippet(tmp_path, MSH006_FLAGGED.replace(
+        'jax.debug.print("x={x}", x=x)',
+        'jax.debug.print("x={x}", x=x)  # meshcheck: disable=MSH006'))
+    assert "MSH006" not in codes(res)
+
+
+# ---------------------------------------------------- machinery / parse
+def test_rule_catalogue_complete():
+    assert set(MESH_RULES) == {"MSH001", "MSH002", "MSH003", "MSH004",
+                               "MSH005", "MSH006"}
+    assert set(AnalyzerConfig().rules) == set(MESH_RULES)
+
+
+def test_baseline_round_trip_stable(tmp_path):
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(MSH001_FLAGGED))
+    res = analyze_package(str(pkg))
+    assert res.findings
+
+    b1 = tmp_path / "baseline.json"
+    entries1 = write_baseline(str(b1), res.findings)
+    assert entries1 == sorted(entries1)
+    new, leftovers = subtract_baseline(
+        analyze_package(str(pkg)).findings, load_baseline(str(b1)))
+    assert new == [] and not leftovers
+
+    # line-number stability: shift every finding down — fingerprints hold
+    (pkg / "mod.py").write_text(
+        "X = 1\nY = 2\n\n" + textwrap.dedent(MSH001_FLAGGED))
+    new, leftovers = subtract_baseline(
+        analyze_package(str(pkg)).findings, load_baseline(str(b1)))
+    assert new == [] and not leftovers
+
+
+def test_baseline_multiset_semantics(tmp_path):
+    src = """
+        from jax import lax
+
+        def bad(x):
+            x = lax.psum(x, "tp")
+            x = lax.psum(x, "tp")
+            return x
+    """
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(src))
+    findings = analyze_package(str(pkg)).findings
+    assert len(findings) == 2
+    b = tmp_path / "baseline.json"
+    write_baseline(str(b), findings[:1])
+    new, _ = subtract_baseline(findings, load_baseline(str(b)))
+    assert len(new) == 1
+
+
+def test_shared_parse_order_independence():
+    """Both suites over ONE parse must report exactly what they report
+    standalone, in either order — meshcheck is read-only over the
+    shared ModuleInfos, and tracecheck's flag mutations are monotone."""
+    mc_alone = analyze_package(PKG)
+    tc_alone = tc.analyze_package(PKG)
+
+    parsed = tc.parse_package(PKG)
+    tc_first = tc.analyze_package(PKG, parsed=parsed)
+    mc_after_tc = analyze_package(PKG, parsed=parsed)
+
+    parsed2 = tc.parse_package(PKG)
+    mc_first = analyze_package(PKG, parsed=parsed2)
+    tc_after_mc = tc.analyze_package(PKG, parsed=parsed2)
+
+    def sig(res):
+        return [f.format() for f in res.findings]
+
+    assert sig(mc_after_tc) == sig(mc_alone) == sig(mc_first)
+    assert sig(tc_first) == sig(tc_alone) == sig(tc_after_mc)
+    # coverage counters must be order-independent too, not just the
+    # findings that happen to survive on today's package
+    assert mc_after_tc.n_spmd == mc_alone.n_spmd == mc_first.n_spmd
+    assert tc_first.n_traced == tc_alone.n_traced == tc_after_mc.n_traced
+
+
+def test_exclude_patterns_apply_to_shared_parse(tmp_path):
+    # a prebuilt ParsedPackage may carry files this config excludes —
+    # both entry paths (fresh parse vs parsed=) must agree
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(MSH001_FLAGGED))
+    parsed = tc.parse_package(str(pkg))
+    cfg = AnalyzerConfig(exclude_patterns=("mod.py",))
+    assert analyze_package(str(pkg), cfg, parsed=parsed).findings == []
+    assert analyze_package(str(pkg), cfg).findings == []
+    tcfg = tc.AnalyzerConfig(exclude_patterns=("mod.py",))
+    assert tc.analyze_package(str(pkg), tcfg, parsed=parsed).findings == []
+
+
+def test_topology_vocabulary_extracted_from_base_topology():
+    from paddle_tpu.analysis.meshcheck.mesh_model import (
+        topology_axis_vocabulary)
+    parsed = tc.parse_package(PKG)
+    vocab = topology_axis_vocabulary(parsed.modules)
+    assert vocab == frozenset(("dp", "pp", "sharding", "sep", "mp"))
+
+
+# ------------------------------------------------------------------- CLI
+def test_unified_cli_single_parse_and_exit_codes(tmp_path):
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(MSH001_FLAGGED) +
+                                textwrap.dedent("""
+        import jax
+        from .flags import get_flag
+
+        def kernel(x):
+            return x * get_flag("use_pallas")
+
+        step = jax.jit(kernel)
+    """))
+    (tmp_path / "tools").mkdir()
+    env = dict(os.environ, PYTHONPATH=REPO)
+    cli = [sys.executable, os.path.join(REPO, "tools", "analyze.py")]
+
+    r = subprocess.run(cli + [str(pkg), "--no-baseline", "--json"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 1, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert [f["rule"] for f in payload["tracecheck"]["findings"]] == \
+        ["TRC001"]
+    assert [f["rule"] for f in payload["meshcheck"]["findings"]] == \
+        ["MSH001"]
+
+    r = subprocess.run(cli + [str(pkg), "--update-baseline"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert (tmp_path / "tools" / "meshcheck_baseline.json").exists()
+    assert (tmp_path / "tools" / "tracecheck_baseline.json").exists()
+
+    r = subprocess.run(cli + [str(pkg)], capture_output=True, text=True,
+                       env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    r = subprocess.run(cli + [str(pkg), "--suite", "meshcheck",
+                              "--no-baseline"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 1
+    assert "MSH001" in r.stdout and "TRC001" not in r.stdout
+
+    r = subprocess.run(cli + ["--list-rules"], capture_output=True,
+                       text=True, env=env)
+    assert r.returncode == 0
+    assert "TRC001" in r.stdout and "MSH006" in r.stdout
+
+
+# ------------------------------------------------------- the tier-1 gate
+def test_package_gate_zero_new_findings():
+    """THE gate: the whole package against the checked-in baseline —
+    any new finding fails tier-1 (fix it, pragma it with a reason, or
+    consciously re-baseline)."""
+    t0 = time.time()
+    result = analyze_package(PKG)
+    elapsed = time.time() - t0
+    assert not result.errors, result.errors
+
+    new, leftovers = subtract_baseline(result.findings,
+                                       load_baseline(BASELINE))
+    assert new == [], (
+        "meshcheck found NEW collective-discipline findings:\n"
+        + "\n".join(f.format() for f in new)
+        + "\n\nfix them, add a '# meshcheck: disable=MSH00x' pragma "
+          "with a reason, or (legacy only) re-run "
+          "'python tools/analyze.py --suite meshcheck "
+          "--update-baseline'")
+    assert not leftovers, (
+        "stale baseline entries — run 'python tools/analyze.py "
+        "--suite meshcheck --update-baseline':\n"
+        + "\n".join(sorted(leftovers)))
+    assert elapsed < 15.0, f"meshcheck took {elapsed:.1f}s"
+
+
+def test_combined_gate_single_parse_budget():
+    """tracecheck + meshcheck over ONE parse stay inside the r08 ~15 s
+    tier-1 budget."""
+    t0 = time.time()
+    parsed = tc.parse_package(PKG)
+    tc_res = tc.analyze_package(PKG, parsed=parsed)
+    mc_res = analyze_package(PKG, parsed=parsed)
+    elapsed = time.time() - t0
+    assert not tc_res.errors and not mc_res.errors
+    assert elapsed < 15.0, f"combined analysis took {elapsed:.1f}s"
+
+
+def test_package_gate_scale_sanity():
+    """Coverage floor: if collective/SPMD detection silently breaks the
+    gate would pass vacuously.  Lower bounds, not exact counts."""
+    result = analyze_package(PKG)
+    assert result.n_files > 150
+    assert result.n_functions > 2000
+    assert result.n_spmd > 300
+    assert result.n_collective_sites > 40

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Unified static-analysis gate: tracecheck + meshcheck in ONE parse.
+
+Usage:
+    python tools/analyze.py                      # both suites, gate
+    python tools/analyze.py --suite meshcheck    # one suite
+    python tools/analyze.py --json
+    python tools/analyze.py --update-baseline    # rewrites BOTH baselines
+    python tools/analyze.py --list-rules
+
+The package is parsed ONCE (ast.parse dominates analyzer wall clock);
+both suites consume the same ParsedPackage, so the combined tier-1 gate
+stays inside the r08 ~15 s budget.  Pure AST — the analysis package is
+loaded standalone (never through ``paddle_tpu/__init__``), so no jax
+import, no device; safe as a pre-commit hook or bare CI step.
+
+Baselines: tools/tracecheck_baseline.json, tools/meshcheck_baseline.json.
+Exit codes: 0 clean, 1 new findings (either suite), 2 usage/parse errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ANALYSIS_DIR = os.path.join(REPO, "paddle_tpu", "analysis")
+
+SUITES = ("tracecheck", "meshcheck")
+
+
+def _load_analysis():
+    """Import paddle_tpu.analysis WITHOUT triggering the framework's
+    top-level __init__ (which pulls in jax).  Loaded as the standalone
+    package ``ptanalysis`` so the suites' relative imports
+    (``from ..tracecheck import ...``) resolve."""
+    spec = importlib.util.spec_from_file_location(
+        "ptanalysis", os.path.join(ANALYSIS_DIR, "__init__.py"),
+        submodule_search_locations=[ANALYSIS_DIR])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["ptanalysis"] = mod
+    spec.loader.exec_module(mod)
+    import importlib as _il
+    return (_il.import_module("ptanalysis.tracecheck"),
+            _il.import_module("ptanalysis.meshcheck"))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="analyze",
+        description="Run the tracecheck (TRC) + meshcheck (MSH) static "
+                    "analyzers over one AST parse.")
+    p.add_argument("path", nargs="?",
+                   default=os.path.join(REPO, "paddle_tpu"),
+                   help="package directory (or single file) to analyze")
+    p.add_argument("--suite", choices=("all",) + SUITES, default="all")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore baselines: report every finding")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the selected suites' baselines from "
+                        "current findings")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated subset of rules (TRC00x/MSH00x; "
+                        "each suite picks out its own)")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--stats", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    tc, mc = _load_analysis()
+
+    if args.list_rules:
+        for code in sorted(tc.RULES):
+            print(f"{code}: {tc.RULES[code]}")
+        for code in sorted(mc.MESH_RULES):
+            print(f"{code}: {mc.MESH_RULES[code]}")
+        return 0
+    if not os.path.exists(args.path):
+        print(f"analyze: no such path: {args.path}", file=sys.stderr)
+        return 2
+
+    suites = SUITES if args.suite == "all" else (args.suite,)
+    wanted = None
+    if args.rules:
+        if args.update_baseline:
+            # a rule-filtered run sees a subset of findings; writing it
+            # out would erase every unselected rule's baseline entries
+            print("analyze: --rules cannot be combined with "
+                  "--update-baseline (it would clobber the other "
+                  "rules' baseline entries)", file=sys.stderr)
+            return 2
+        wanted = {r.strip().upper() for r in args.rules.split(",")
+                  if r.strip()}
+
+    t0 = time.time()
+    parsed = tc.parse_package(args.path)
+    for err in parsed.errors:
+        print(f"analyze: parse error: {err}", file=sys.stderr)
+    if parsed.errors:
+        # an unparseable file would silently shrink coverage — a gate
+        # that cannot see the whole package must not pass
+        return 2
+
+    parent = os.path.dirname(os.path.abspath(args.path.rstrip(os.sep)))
+    baseline_paths = {
+        "tracecheck": os.path.join(parent, "tools",
+                                   "tracecheck_baseline.json"),
+        "meshcheck": os.path.join(parent, "tools",
+                                  "meshcheck_baseline.json"),
+    }
+
+    payload = {}
+    any_new = False
+    for suite in suites:
+        pkg = tc if suite == "tracecheck" else mc
+        config = pkg.AnalyzerConfig()
+        if wanted is not None:
+            sub = tuple(r for r in config.rules if r in wanted)
+            if not sub:
+                continue
+            config = pkg.AnalyzerConfig(rules=sub)
+        result = pkg.analyze_package(args.path, config, parsed=parsed)
+
+        bl_path = baseline_paths[suite]
+        if args.update_baseline:
+            entries = pkg.write_baseline(bl_path, result.findings)
+            print(f"{suite}: baselined {len(entries)} finding(s) -> "
+                  f"{bl_path}")
+            continue
+        baseline = (pkg.load_baseline(bl_path)
+                    if not args.no_baseline else None)
+        if baseline:
+            new, leftovers = pkg.subtract_baseline(result.findings,
+                                                   baseline)
+            n_baselined = len(result.findings) - len(new)
+        else:
+            new, leftovers, n_baselined = result.findings, {}, 0
+        any_new = any_new or bool(new)
+
+        payload[suite] = {
+            "findings": [f.to_json() for f in new],
+            "baselined": n_baselined,
+            "suppressed": len(result.suppressed),
+            "stale_baseline_entries": sorted(leftovers),
+        }
+        if not args.as_json:
+            for f in new:
+                print(f.format())
+            summary = (f"{suite}: {len(new)} new finding(s), "
+                       f"{n_baselined} baselined, "
+                       f"{len(result.suppressed)} pragma-suppressed")
+            if leftovers:
+                summary += (f"; {sum(leftovers.values())} stale "
+                            "baseline entr(ies) — run --update-baseline")
+            print(summary)
+
+    elapsed = time.time() - t0
+    if args.update_baseline:
+        return 0
+    if args.as_json:
+        payload["files"] = parsed.n_files
+        payload["elapsed_s"] = round(elapsed, 3)
+        print(json.dumps(payload, indent=1, sort_keys=True))
+    elif args.stats:
+        print(f"-- {parsed.n_files} files, one parse, "
+              f"{len(suites)} suite(s) in {elapsed:.2f}s")
+    return 1 if any_new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

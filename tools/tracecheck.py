@@ -12,6 +12,10 @@ Pure AST — the analyzer is loaded standalone (not through
 no device; safe as a pre-commit hook or bare CI step.  The checked-in
 baseline lives at tools/tracecheck_baseline.json; the tier-1 test
 (tests/test_tracecheck.py) fails on any finding beyond it.
+
+``python tools/analyze.py`` runs this suite AND meshcheck (MSH001-006,
+SPMD collective discipline) over one shared parse — prefer it for the
+full gate.
 """
 
 import importlib.util
