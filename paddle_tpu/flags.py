@@ -290,6 +290,42 @@ define_flag("serving_retry_backoff", 0.05,
             "Base seconds of the serving recovery backoff; doubles per "
             "consecutive no-progress recovery (capped at 2 s), resets "
             "once any request makes progress.")
+define_flag("serving_prefill_chunk", 256,
+            "ServingEngine chunked-prefill granularity in tokens: a "
+            "prompt longer than this prefills in fixed-size chunks "
+            "interleaved with decode steps (ONE cached b=1 program per "
+            "chunk length — the final partial chunk pads, so prompt "
+            "length never forces a retrace), bounding the decode stall "
+            "a long-prompt arrival can cause to one chunk instead of "
+            "the whole prompt. Prompts at or under the chunk keep the "
+            "exact monolithic prefill program. 0 = chunking off "
+            "(monolithic prefill, the pre-r12 behavior). Eager-only: "
+            "the chunk size reaches compiled programs through the "
+            "program-cache key, never through a traced flag read.")
+define_flag("serving_bucket_ladder", "4,8,16,32",
+            "ServingEngine batch-bucket ladder: ','-separated decode "
+            "batch sizes. The engine runs its decode step at the "
+            "smallest rung covering current demand and migrates "
+            "between rungs as occupancy changes (grow immediately on "
+            "queue pressure, shrink after FLAGS_serving_bucket_patience "
+            "idle steps); each rung's program compiles once and is "
+            "cached. Rungs above the engine's max_batch are dropped and "
+            "max_batch itself is always a rung, so max_batch=4 serves "
+            "exactly the pre-r12 fixed-shape behavior.")
+define_flag("serving_bucket_patience", 8,
+            "Steps a lower bucket rung must stay sufficient before the "
+            "serving engine shrinks its decode batch to it (hysteresis "
+            "against occupancy flapping; growth is immediate).")
+define_flag("serving_page_budget", 0,
+            "USABLE KV page-pool pages for ServingEngine when "
+            "num_pages is not passed, decoupling pool memory from the "
+            "bucket ladder's top rung. 0 (default) keeps the "
+            "worst-case formula 1 + max_batch * "
+            "ceil(max_seq_len / page_size); a positive value N "
+            "allocates N + 1 pages (one reserved null scribble page, "
+            "like the formula's +1) and lets admission control "
+            "(page-pressure queueing + prefix-cache eviction) absorb "
+            "the difference.")
 define_flag("train_max_retries", 2,
             "Model.fit step-recovery budget: retries of a failed "
             "dispatch (sync to last-good state, emergency checkpoint, "

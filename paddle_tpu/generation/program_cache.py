@@ -55,13 +55,16 @@ __all__ = ["DecodeKey", "DecodeProgramCache", "decode_program_cache",
 
 class DecodeKey(NamedTuple):
     """(model signature, batch bucket, page budget, dtype, flag tuple) —
-    plus ``kind`` to separate the program families sharing the cache."""
+    plus ``kind`` to separate the program families sharing the cache and
+    ``extra`` for kind-specific geometry (the chunked-prefill programs
+    key on their chunk length here; empty for the classic kinds)."""
     kind: str                 # decode_fused | decode_generic | prefill | ...
     model_sig: str
     batch_bucket: int
     page_budget: Tuple        # (num_pages, page_size, max_pages_per_seq)
     dtype: str
     flags: Tuple              # flags.snapshot(...).as_tuple()
+    extra: Tuple = ()         # kind-specific, e.g. (chunk_len,)
 
 
 def model_signature(model) -> str:

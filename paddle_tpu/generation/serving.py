@@ -24,7 +24,7 @@ from __future__ import annotations
 import functools
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -65,9 +65,22 @@ class Request:
     status: str = "PENDING"
     error: Optional[str] = None
     # replay-recovery bookkeeping: consecutive no-progress replays, and
-    # the token count at the last failure (progress resets the budget)
+    # the (tokens, prefill-cursor) high-water mark at the last failure
+    # (progress on EITHER axis resets the budget — a long prompt's
+    # chunks are progress before any token exists)
     retries: int = 0
-    progress_mark: int = -1
+    progress_mark: Tuple[int, int] = (-1, -1)
+    # chunked-prefill cursor: tokens of ``feed`` already written to the
+    # KV pool (None = not mid-prefill); ``feed`` is the teacher-forced
+    # token stream (prompt, plus emitted tokens on replay)
+    prefill_pos: Optional[int] = None
+    feed: Optional[np.ndarray] = None
+    # streaming: host callback fired per generated token and once at
+    # terminal status — on_token(rid, token_id_or_None, done)
+    on_token: Optional[Callable[[int, Optional[int], bool], None]] = None
+    # prefix-aware admission bookkeeping: how many cached-prefix
+    # requests bypassed THIS request while it was the page-blocked head
+    bypassed: int = 0
 
 
 class _EngineTelemetry:
@@ -141,6 +154,26 @@ class _EngineTelemetry:
             "serving_page_pressure",
             "KV pages short at the last page-blocked admission (0 = "
             "admission is not page-blocked)")
+        # ---- continuous-batching instruments (chunked prefill +
+        # bucket ladder, r12)
+        self.prefill_chunk_s = r.histogram(
+            "serving_prefill_chunk_seconds",
+            "wall clock of one chunked-prefill chunk dispatch — the "
+            "bound on how long a long-prompt arrival can stall decode")
+        self.decode_stall_s = r.histogram(
+            "serving_decode_stall_seconds",
+            "per-step wall clock decoding slots spent waiting on "
+            "scheduler + prefill work before the decode dispatch "
+            "(observed only on steps that ran prefill work while "
+            "decode-ready requests were waiting)")
+        self.bucket = r.gauge(
+            "serving_bucket",
+            "current decode batch-bucket rung of the bucket ladder")
+        self.migrations = r.counter(
+            "serving_bucket_migrations",
+            "bucket-ladder migrations (grow or shrink) — each rung's "
+            "program compiles once, so steady state stops migrating "
+            "or cycles between already-compiled rungs")
 
 
 class _NullEngineTelemetry:
@@ -160,6 +193,8 @@ class _NullEngineTelemetry:
         self.retries = self.recoveries = obs.NULL
         self.requests_failed = self.requests_timeout = obs.NULL
         self.recovery_seconds = self.page_pressure = obs.NULL
+        self.prefill_chunk_s = self.decode_stall_s = obs.NULL
+        self.bucket = self.migrations = obs.NULL
 
 
 class _PrefixTelemetry:
@@ -317,16 +352,36 @@ class PrefixCache:
         pins==0 nodes), so the per-step gauge refresh costs nothing."""
         return self._pinned_nodes
 
+    def peek(self, prompt: np.ndarray) -> int:
+        """Length (tokens) of the cached page-aligned prefix WITHOUT
+        touching LRU ticks or hit/miss telemetry — the scheduler's
+        prefix-aware admission probe (``lookup`` is the real,
+        stats-bearing read at admission time)."""
+        n = 0
+        for key in self._chunks(prompt):
+            if key not in self._nodes:
+                break
+            n += self.page_size
+        return n
+
 
 class ServingEngine:
     """Drive ``model`` (a GenerationMixin Layer) as a continuous-batching
-    server. ``submit`` enqueues; each ``step`` admits waiting requests
-    into free slots and decodes one token for every active slot;
-    ``run`` steps until drained and returns {rid: tokens}."""
+    server. ``submit`` enqueues (deadline-slack-ordered, prefix-cache-
+    aware admission); each ``step`` admits waiting requests, runs at
+    most ONE prefill chunk (long prompts interleave with decode instead
+    of stalling it), migrates the decode batch between bucket-ladder
+    rungs as occupancy changes, and decodes one token for every active
+    slot. ``run`` steps until drained and returns {rid: tokens}; the
+    non-blocking surface is ``run_step``/``poll`` plus per-token
+    ``submit(on_token=...)`` streaming callbacks."""
 
     def __init__(self, model, max_batch: int = 4, page_size: int = 64,
                  num_pages: Optional[int] = None, max_seq_len: int = 1024,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 bucket_ladder: Optional[Tuple[int, ...]] = None,
+                 prefill_chunk: Optional[int] = None):
+        from .. import flags as _flags
         from ..jit import ensure_live
 
         self.model = model
@@ -334,7 +389,45 @@ class ServingEngine:
         self.max_seq_len = max_seq_len
         spec = model.cache_spec()
         if num_pages is None:
-            num_pages = 1 + max_batch * (-(-max_seq_len // page_size))
+            # the pool budget decouples from the ladder's top rung:
+            # FLAGS_serving_page_budget caps memory and lets admission
+            # control absorb the difference; 0 keeps the worst-case
+            # formula
+            budget = int(_flags.get_flag("serving_page_budget"))
+            # +1 pays for the reserved null page in BOTH modes, so a
+            # budget of N means N USABLE pages (the formula's explicit
+            # +1 already did)
+            num_pages = (budget + 1 if budget > 0 else
+                         1 + max_batch * (-(-max_seq_len // page_size)))
+        # ---- chunked prefill: prompts longer than ``chunk`` prefill in
+        # fixed-size chunks interleaved with decode steps (0 = off)
+        self.chunk = int(_flags.get_flag("serving_prefill_chunk")
+                         if prefill_chunk is None else prefill_chunk)
+        if self.chunk < 0:
+            raise ValueError(f"prefill_chunk must be >= 0, got {self.chunk}")
+        # ---- batch-bucket ladder: decode runs at the smallest rung
+        # covering demand; rungs above max_batch drop, max_batch is
+        # always the top rung (so max_batch=4 == the fixed pre-r12 shape)
+        if bucket_ladder is None:
+            raw = str(_flags.get_flag("serving_bucket_ladder"))
+            rungs = [int(r) for r in raw.replace(";", ",").split(",")
+                     if r.strip()]
+        else:
+            rungs = [int(r) for r in bucket_ladder]
+        if any(r < 1 for r in rungs):
+            raise ValueError(f"bucket ladder rungs must be >= 1: {rungs}")
+        self.ladder: Tuple[int, ...] = tuple(sorted(
+            {r for r in rungs if r <= max_batch} | {max_batch}))
+        self.bucket = self.ladder[0]
+        self.bucket_patience = int(
+            _flags.get_flag("serving_bucket_patience"))
+        self._shrink_wait = 0
+        # prefill-unit fairness flip-flop (chunks' turn when True)
+        self._chunk_turn = False
+        # host-side probes (test/bench surface, telemetry-independent)
+        self.bucket_migrations = 0
+        self.chunk_dispatches = 0
+        self.max_decode_stall = 0.0
         params, buffers = model.raw_state()
         ensure_live(params, "call step.sync_to_model() first.")
         self._params, self._buffers = params, buffers
@@ -360,21 +453,35 @@ class ServingEngine:
         self._last_tok = np.zeros((max_batch,), np.int32)
         self._next_rid = 0
         self._prefill_fn = None
-        self._decode_fn = None
-        self.decode_key = None      # set on first decode (test probe)
+        self._chunk_fn = None
+        self._decode_fns: Dict[int, object] = {}    # bucket rung -> fn
+        self._decode_keys: Dict[int, object] = {}
+        self.decode_key = None      # key of the current rung (test probe)
+        # streaming: (callback, rid, token|None, done) events buffered
+        # during a step and drained AFTER dispatch/recovery, so a user
+        # callback that raises never masquerades as a dispatch failure
+        self._events: List[tuple] = []
         self._prefix_enabled = bool(prefix_cache)
         self._prefix = PrefixCache(self.pool) if prefix_cache else None
         # ---- fault tolerance: injection sites bind at construction
         # (NULL stubs when FLAGS_fault_inject is unset — zero hot-path
         # cost, the telemetry idiom) and the replay-recovery budget
-        from .. import flags as _rflags
         self._f_prefill = faults.site("prefill")
+        self._f_chunk = faults.site("chunk_prefill")
         self._f_decode = faults.site("decode_dispatch")
-        self.max_retries = int(_rflags.get_flag("serving_max_retries"))
+        self._f_migrate = faults.site("bucket_migrate")
+        self.max_retries = int(_flags.get_flag("serving_max_retries"))
         self.retry_backoff = float(
-            _rflags.get_flag("serving_retry_backoff"))
+            _flags.get_flag("serving_retry_backoff"))
         self._consec_failures = 0   # engine-wide no-progress failures
         self._failed_admission: Optional[Request] = None
+        self._head_blocked = False  # last _next_admission left the
+        # slack head page-blocked (bypass admits must not clear gauges)
+        # per-step memo of _shared_adopt_pages by rid: the scheduler
+        # probes the same requests several times per step (migration
+        # demand, head bill per free slot, bypass scan, unit routing)
+        # and each probe re-walks the prefix trie over the full prompt
+        self._probe_memo: Dict[int, int] = {}
         # flag resolution happens ONCE per engine; the PROGRAM_FLAGS
         # snapshot (every flag a traced program can read — kernel
         # dispatch, flash blocks, compact stats, matmul precision) is
@@ -383,7 +490,6 @@ class ServingEngine:
         # instead of silently serving a program compiled under stale
         # flags, while eager-only flags (log_level, benchmark) never
         # force a spurious recompile
-        from .. import flags as _flags
         from .program_cache import model_signature
         self._flags = _flags.snapshot(_flags.PROGRAM_FLAGS)
         self._model_sig = model_signature(model)
@@ -391,15 +497,25 @@ class ServingEngine:
         # no-op stubs cost one method call per write when disabled)
         self._m = (_EngineTelemetry() if obs.enabled()
                    else _NullEngineTelemetry())
+        self._observe_bucket()
 
     # ------------------------------------------------------------ frontend
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None,
-               deadline: Optional[float] = None) -> int:
+               deadline: Optional[float] = None,
+               on_token: Optional[Callable] = None) -> int:
         """Enqueue one request. ``deadline`` (seconds from now) bounds
         its total latency: a request past its deadline — queued or in
         flight — is terminated ``TIMEOUT`` at the next step boundary
-        with whatever tokens it produced."""
+        with whatever tokens it produced. The scheduler admits by
+        deadline SLACK (tightest first; no-deadline requests keep FIFO
+        order among themselves). ``on_token(rid, token, done)`` streams
+        tokens as they are generated: one call per token with
+        ``done=False``, then one final ``(rid, None, True)`` when the
+        request reaches a terminal status — callbacks fire on the
+        caller's thread at step boundaries, after dispatch/recovery, so
+        a raising callback surfaces to the caller instead of tripping
+        replay recovery."""
         prompt = np.asarray(
             prompt._value if hasattr(prompt, "_value") else prompt,
             np.int32).reshape(-1)
@@ -418,6 +534,7 @@ class ServingEngine:
         rid = self._next_rid
         self._next_rid += 1
         req = Request(rid, prompt, int(max_new_tokens), eos_token_id)
+        req.on_token = on_token
         req.t_submit = time.perf_counter()
         if deadline is not None:
             req.deadline = req.t_submit + float(deadline)
@@ -427,6 +544,29 @@ class ServingEngine:
 
     def has_work(self) -> bool:
         return bool(self._queue) or any(s is not None for s in self._slots)
+
+    def run_step(self) -> bool:
+        """The non-blocking pump: one scheduler round (admission, at
+        most one prefill chunk, one decode dispatch), then returns
+        whether work remains — callers interleave ``run_step`` with
+        ``poll``/``results`` to drain tokens while the engine runs,
+        instead of blocking in :meth:`run`."""
+        self.step()
+        return self.has_work()
+
+    def poll(self, rid: int) -> Dict[str, object]:
+        """Non-blocking progress probe for one request: ``{"status",
+        "tokens", "done"}`` with the tokens emitted SO FAR (a snapshot —
+        safe to mutate). Completed requests report their terminal
+        status until :meth:`run`'s next drain prunes them."""
+        if rid in self._results:
+            return {"status": self._status.get(rid, OK),
+                    "tokens": list(self._results[rid]), "done": True}
+        for req in list(self._slots) + self._queue:
+            if req is not None and req.rid == rid:
+                return {"status": "PENDING", "tokens": list(req.tokens),
+                        "done": False}
+        raise KeyError(f"unknown or already-drained request id {rid}")
 
     def run(self, max_wall: Optional[float] = None) -> Dict[int, List[int]]:
         """Step until drained and return ``{rid: tokens}`` (partial
@@ -439,6 +579,7 @@ class ServingEngine:
             if max_wall is not None and \
                     time.perf_counter() - t0 > max_wall:
                 self._expire_all("run(max_wall=%.3f) watchdog" % max_wall)
+                self._drain_events()
                 break
             self.step()
         out, self._results = self._results, {}
@@ -456,6 +597,19 @@ class ServingEngine:
         (``run`` only hands over-and-clears on a clean drain)."""
         return {rid: list(toks) for rid, toks in self._results.items()}
 
+    def take_results(self) -> Dict[int, List[int]]:
+        """Drain completed results (and their statuses): the
+        ``run_step()`` loop's collection surface. A long-lived server
+        pumping ``run_step`` must drain through here (or through
+        ``run``) — ``results()``/``poll()`` deliberately never free the
+        per-request entries, so without a drain they grow one entry per
+        completed request forever. Check :meth:`status`/:meth:`statuses`
+        BEFORE draining; drained rids poll as unknown afterwards."""
+        out, self._results = self._results, {}
+        for rid in out:
+            self._status.pop(rid, None)
+        return out
+
     def status(self, rid: int) -> str:
         """Terminal status for ``rid``: ``OK`` / ``FAILED`` / ``TIMEOUT``
         (``PENDING`` while queued or in flight). Statuses survive until
@@ -466,15 +620,16 @@ class ServingEngine:
         return dict(self._status)
 
     # ------------------------------------------------- compiled programs
-    def _key(self, kind: str):
+    def _key(self, kind: str, bucket: Optional[int] = None,
+             extra: Tuple = ()):
         from .program_cache import DecodeKey
         return DecodeKey(
             kind=kind, model_sig=self._model_sig,
-            batch_bucket=self.max_batch,
+            batch_bucket=self.max_batch if bucket is None else bucket,
             page_budget=(self.pool.num_pages, self.pool.page_size,
                          self.pool.max_pages_per_seq),
             dtype=str(self.pool.k_pages[0].dtype),
-            flags=self._flags.as_tuple())
+            flags=self._flags.as_tuple(), extra=extra)
 
     def _fused_spec(self):
         """The model's fused-block layout when the fused path applies:
@@ -508,20 +663,40 @@ class ServingEngine:
                 functools.partial(_build_prefill, model=self.model))
         return self._prefill_fn
 
-    def _decode_program(self):
-        if self._decode_fn is None:
+    def _chunk_program(self):
+        """The chunked-prefill program: ONE cached compiled step per
+        (chunk length, model/pool config) — every chunk of every prompt
+        dispatches the same fixed (1, chunk) shape (the final partial
+        chunk pads), so prompt length never retraces."""
+        if self._chunk_fn is None:
+            from .program_cache import decode_program_cache
+            self._chunk_fn = decode_program_cache().get(
+                self._key("prefill_chunk", bucket=1,
+                          extra=(self.chunk,)),
+                functools.partial(_build_chunk_prefill, model=self.model))
+        return self._chunk_fn
+
+    def _decode_program(self, bucket: int):
+        """The decode step for one bucket rung, compiled once per rung
+        and cached — bucket migration swaps between already-compiled
+        programs instead of retracing."""
+        fn = self._decode_fns.get(bucket)
+        if fn is None:
             from .program_cache import decode_program_cache
             spec = self._fused_spec()
-            key = self._key("decode_fused" if spec else "decode_generic")
+            key = self._key("decode_fused" if spec else "decode_generic",
+                            bucket=bucket)
             if spec:
                 builder = functools.partial(_build_fused_decode, spec=spec,
                                             snap=self._flags)
             else:
                 builder = functools.partial(_build_generic_decode,
                                             model=self.model)
-            self._decode_fn = decode_program_cache().get(key, builder)
-            self.decode_key = key
-        return self._decode_fn
+            fn = decode_program_cache().get(key, builder)
+            self._decode_fns[bucket] = fn
+            self._decode_keys[bucket] = key
+        self.decode_key = self._decode_keys.get(bucket, self.decode_key)
+        return fn
 
     # ----------------------------------------------------------- internals
     # Donation discipline (tracecheck TRC003): the compiled programs
@@ -538,13 +713,16 @@ class ServingEngine:
 
     def _admit_shared(self, req: Request, slot: int, pages: List[int],
                       n_cached: int) -> None:
-        """Prefix-cache admission: adopt the cached prompt pages read-only
-        and teacher-force the remaining suffix through the ordinary decode
-        step (one token per engine step) — no new compiled program, and
-        the cached portion's prefill compute is skipped entirely. The
-        model output while suffix tokens are pending is a prompt-position
-        logit and is discarded; the step that feeds the LAST suffix token
-        emits the first generated token."""
+        """Prefix-cache admission: adopt the cached prompt pages
+        read-only — the cached portion's prefill compute is skipped
+        entirely. A SHORT remaining suffix teacher-forces through the
+        ordinary decode step (one token per engine step, no extra
+        program: the model output while suffix tokens are pending is a
+        prompt-position logit and is discarded; the step that feeds the
+        LAST suffix token emits the first generated token). A LONG
+        suffix, with chunking enabled, prefills from the adopted-prefix
+        cursor in chunks instead — the chunk program natively starts at
+        a nonzero position."""
         self.pool.adopt_shared(slot, pages)
         if self._prefix is not None:
             # pin count on adoption: evict() must never free pages an
@@ -554,8 +732,12 @@ class ServingEngine:
         self.pool.seq_lens[slot] = n_cached
         suffix = req.prompt[n_cached:]
         self.pool.allocate(slot, len(suffix) + req.max_new_tokens)
-        self._last_tok[slot] = int(suffix[0])
-        req.pending = [int(t) for t in suffix[1:]]
+        if self.chunk and len(suffix) > 2 * self.pool.page_size:
+            req.feed = req.prompt
+            req.prefill_pos = n_cached
+        else:
+            self._last_tok[slot] = int(suffix[0])
+            req.pending = [int(t) for t in suffix[1:]]
         req.slot = slot
         self._slots[slot] = req
         self._m.shared_admits.inc()
@@ -572,7 +754,11 @@ class ServingEngine:
         return np.concatenate(
             [req.prompt, np.asarray(req.tokens, np.int32)])
 
-    def _prefill(self, req: Request, slot: int) -> None:
+    def _admit(self, req: Request, slot: int) -> bool:
+        """Route one admission: prefix-cache shared adoption when the
+        prompt's pages already live in the pool, the chunked-prefill
+        cursor for long prompts, the classic monolithic b=1 prefill
+        otherwise."""
         # queued phase closes at admission: submit() -> here (once per
         # REQUEST, not per token)  # tracecheck: disable=TRC007
         self._m.event("request.queued", req.t_submit, time.perf_counter(),
@@ -586,18 +772,40 @@ class ServingEngine:
             while pages and n_cached >= len(req.prompt):
                 pages = pages[:-1]
                 n_cached -= self.pool.page_size
-            # coverage threshold: the suffix replays one token per decode
-            # step, so a barely-covered long prompt would trade one b=1
-            # prefill for hundreds of full-batch steps — take the shared
-            # path only when the replay is small (a couple of pages) or
-            # the cached part dominates it
+            # coverage threshold (monolithic mode only): the suffix
+            # replays one token per decode step, so a barely-covered
+            # long prompt would trade one b=1 prefill for hundreds of
+            # full-batch steps. With chunking on, long suffixes prefill
+            # in chunks from the adopted cursor instead, so ANY hit is
+            # worth taking.
             suffix_len = len(req.prompt) - n_cached
-            if pages and suffix_len <= max(2 * self.pool.page_size,
-                                           n_cached):
+            if pages and (self.chunk
+                          or suffix_len <= max(2 * self.pool.page_size,
+                                               n_cached)):
                 self._admit_shared(req, slot, pages, n_cached)
-                return
-
+                return False    # no prefill compute dispatched
         feed = self._admission_feed(req)
+        if self.chunk and len(feed) > self.chunk:
+            # chunked admission: allocate the full page span now, then
+            # prefill one chunk per step() so decode never stalls for
+            # more than one chunk
+            remaining = req.max_new_tokens - len(req.tokens)
+            self.pool.allocate(slot, len(feed) + remaining)
+            req.feed = feed
+            req.prefill_pos = 0
+            req.slot = slot
+            self._slots[slot] = req
+            return False    # chunks dispatch one per step, not here
+        self._prefill(req, slot, feed)
+        return True         # monolithic prefill compute ran this step
+
+    def _prefill(self, req: Request, slot: int,
+                 feed: Optional[np.ndarray] = None) -> None:
+        """Monolithic b=1 whole-prompt prefill (prompts at or under the
+        chunk size, and every prompt when chunking is off)."""
+        replay = bool(req.tokens)
+        if feed is None:
+            feed = self._admission_feed(req)
         p = len(feed)
         # the cached prefill program: jit itself caches one compilation
         # per prompt length (bucket/pad prompts in production to bound
@@ -635,6 +843,7 @@ class ServingEngine:
             self._m.ttft.observe(tnow - req.t_submit)
         req.t_last = tnow
         req.tokens.append(tok)
+        self._emit(req, tok)
         req.slot = slot
         self._slots[slot] = req
         if self._prefix is not None and not replay:
@@ -642,6 +851,90 @@ class ServingEngine:
             # (they are immutable: later writes land at seq_len and up)
             self._prefix.register(req.prompt, self.pool.block_tables[slot])
         self._finish_if_done(req)
+
+    def _prefill_chunk(self, req: Request) -> None:
+        """One chunk of one mid-prefill request: write ``chunk`` tokens
+        of its feed into the KV pool at the cursor and advance it. Every
+        chunk dispatches the SAME cached (1, chunk) program — the final
+        partial chunk pads (pad KV is causally masked and pad positions
+        past the block table drop), and only the final chunk's argmax is
+        pulled to the host: it is the request's first generated token
+        (or, on replay, the next greedy continuation token)."""
+        feed, pos, c = req.feed, req.prefill_pos, self.chunk
+        end = min(pos + c, len(feed))
+        last = end == len(feed)
+        ids = np.zeros((c,), np.int32)
+        ids[:end - pos] = feed[pos:end]
+        fn = self._chunk_program()
+        slot = req.slot
+        bt = jnp.asarray(self.pool.block_tables[slot:slot + 1])
+        sl = jnp.asarray(np.full((1,), pos, np.int32))
+        t0 = time.perf_counter() if self._m.enabled else 0.0
+        pools = self.pool.take_pools()
+        self._f_chunk.check()
+        tok, states = fn(self._params, self._buffers,
+                         jnp.asarray(ids[None]), pools, bt, sl,
+                         jnp.int32(end - pos - 1))
+        self._store(states)
+        self.pool.seq_lens[slot] = end
+        req.prefill_pos = end
+        self.chunk_dispatches += 1
+        if not last:
+            # the non-final argmax is garbage-padded and never pulled:
+            # the dispatch stays async  # tracecheck: disable=TRC007
+            self._observe_chunk(time.perf_counter() - t0)
+            return
+        tok = int(tok)      # designed sync: the first generated token
+        tnow = time.perf_counter()
+        self._observe_chunk(tnow - t0, final=True)
+        replay = bool(req.tokens)
+        if replay:
+            # a replayed prefill's token continues the sequence: its
+            # latency is inter-token, not a second TTFT
+            # tracecheck: disable=TRC007
+            self._m.itl.observe(tnow - req.t_last)
+        else:
+            # TTFT closes on the final chunk's token
+            # tracecheck: disable=TRC007
+            self._m.ttft.observe(tnow - req.t_submit)
+        req.t_last = tnow
+        req.tokens.append(tok)
+        self._emit(req, tok)
+        self._last_tok[slot] = tok
+        req.prefill_pos = None
+        req.feed = None
+        if self._prefix is not None and not replay:
+            # the whole prompt's KV is now written (adopted prefix +
+            # chunked suffix): register its full pages — repeats of
+            # this prompt deepen the cache
+            self._prefix.register(req.prompt, self.pool.block_tables[slot])
+        self._finish_if_done(req)
+
+    def _chunk_step(self) -> bool:
+        """At most ONE prefill chunk per engine step — the stall a
+        long-prompt arrival can impose on decoding requests is bounded
+        by one chunk, never a whole prompt. Among mid-prefill requests
+        the scheduler order (deadline slack, then FIFO) picks."""
+        cands = [r for r in self._slots
+                 if r is not None and r.prefill_pos is not None]
+        if not cands:
+            return False
+        now = time.perf_counter()
+        req = min(cands, key=lambda r: self._slack_key(r, now))
+        self._prefill_chunk(req)
+        return True
+
+    def _emit(self, req: Request, tok: Optional[int],
+              done: bool = False) -> None:
+        """Buffer one streaming event; :meth:`step` drains the buffer
+        to the callbacks after dispatch/recovery completes."""
+        if req.on_token is not None:
+            self._events.append((req.on_token, req.rid, tok, done))
+
+    def _drain_events(self) -> None:
+        while self._events:
+            cb, rid, tok, done = self._events.pop(0)
+            cb(rid, tok, done)
 
     def _finalize(self, req: Request, status: str,
                   error: Optional[str] = None) -> None:
@@ -657,10 +950,13 @@ class ServingEngine:
             self._prefix.unpin(req.pinned)
         req.pinned = []
         req.pending = []
+        req.prefill_pos = None
+        req.feed = None
         req.status = status
         req.error = error
         self._results[req.rid] = req.tokens
         self._status[req.rid] = status
+        self._emit(req, None, done=True)
 
     def _finish_if_done(self, req: Request) -> None:
         done = len(req.tokens) >= req.max_new_tokens or (
@@ -707,16 +1003,21 @@ class ServingEngine:
         self._observe_step_end()
 
     def step(self) -> None:  # tracecheck: hotpath
-        """One scheduler round: deadline sweep, admission, one decode
-        dispatch. A failed dispatch does NOT propagate — replay recovery
-        (fresh pools, re-queue of all in-flight requests, bounded
-        retries with exponential backoff) runs instead, and requests
-        only ever end in a terminal OK/FAILED/TIMEOUT status."""
+        """One scheduler round: deadline sweep, bucket migration,
+        admission, at most one prefill chunk, one decode dispatch. A
+        failed dispatch does NOT propagate — replay recovery (fresh
+        pools, re-queue of all in-flight requests, bounded retries with
+        exponential backoff) runs instead, and requests only ever end
+        in a terminal OK/FAILED/TIMEOUT status. Streaming callbacks
+        drain LAST, outside the recovery boundary: a raising callback
+        surfaces to the caller, never as a fake dispatch failure."""
         try:
             self._step_inner()
             self._consec_failures = 0
         except Exception as exc:
             self._recover_dispatch(exc)
+        finally:
+            self._drain_events()
 
     def _recover_dispatch(self, exc: Exception) -> None:
         """Replay recovery. The donated dispatch died, so the pool is
@@ -735,7 +1036,24 @@ class ServingEngine:
         # never also in a slot
         victims = live + ([failed_adm] if failed_adm is not None else [])
         if not victims:
-            # nothing was in flight: this is not a dispatch failure the
+            if self._queue and self._consec_failures < self.max_retries:
+                # nothing in flight died but work is queued — e.g. a
+                # bucket-migration fault BEFORE admission. No request
+                # state was lost, so back off and press on; the
+                # engine-wide no-progress budget still bounds this, so
+                # a real scheduler bookkeeping bug surfaces loudly
+                # after max_retries consecutive failures instead of
+                # spinning forever.
+                if self.pool.k_pages and self.pool.k_pages[0] is None:
+                    self._rebuild_pool()    # a detached pool stays dead
+                self._consec_failures += 1
+                self._observe_recovery(0, 0, time.perf_counter() - t0)
+                time.sleep(min(
+                    self.retry_backoff * (2 ** (self._consec_failures - 1)),
+                    2.0))
+                return
+            # nothing was in flight and nothing is queued (or the
+            # budget is spent): this is not a dispatch failure the
             # replay machinery can absorb — a bookkeeping error must
             # stay loud (results so far remain retrievable, see
             # ``results()``)
@@ -745,16 +1063,26 @@ class ServingEngine:
         failed: List[Request] = []
         any_progress = False
         for req in victims:
+            # progress is (tokens, prefill cursor): a long prompt's
+            # chunks count as progress before any token exists, so a
+            # transient mid-prefill fault doesn't eat the retry budget.
+            # The mark is a HIGH-WATER mark — it never moves backwards:
+            # the cursor resets to 0 on every replay, and an oscillating
+            # failure point below the best attempt must not read as
+            # fresh progress or a persistently flaky backend could
+            # reset the retry budget forever.
+            progress = (len(req.tokens), req.prefill_pos or 0)
             req.slot = None
             req.pending = []
             req.pinned = []     # pinned pages died with the old pool
-            progress = len(req.tokens)
+            req.prefill_pos = None      # replay re-prefills from host
+            req.feed = None             # state (prompt + tokens)
             if progress > req.progress_mark:
                 any_progress = True
                 req.retries = 1
+                req.progress_mark = progress
             else:
                 req.retries += 1
-            req.progress_mark = progress
             if req.retries > self.max_retries:
                 failed.append(req)
             else:
@@ -793,67 +1121,272 @@ class ServingEngine:
             self._prefix.unpin(req.pinned)
         req.pinned = []
         req.pending = []
+        req.prefill_pos = None
+        req.feed = None
         req.slot = None
         self._slots[slot] = None
 
+    # ---------------------------------------------------- the scheduler
+    _BYPASS_BUDGET = 4   # cached-prefix bypasses one blocked head allows
+    _BYPASS_SCAN = 8     # queue depth scanned for a bypass candidate
+
+    @staticmethod
+    def _slack_key(req: Request, now: float):
+        """Scheduler order: deadline slack ascending (tightest budget
+        first); every no-deadline request ties at +inf, so among
+        themselves they keep classic FIFO arrival order by rid."""
+        slack = (req.deadline - now) if req.deadline is not None \
+            else float("inf")
+        return (slack, req.rid)
+
+    def _pages_needed(self, req: Request) -> int:
+        return -(-(len(req.prompt) + req.max_new_tokens)
+                 // self.pool.page_size)
+
+    def _admission_order(self) -> List[Request]:
+        """This step's admission order, computed ONCE per step (slack
+        depends only on the clock, not on pages, so the order is stable
+        across the step's slot loop): deadline slack ascending, FIFO by
+        rid among no-deadline ties."""
+        now = time.perf_counter()
+        return sorted(self._queue, key=lambda r: self._slack_key(r, now))
+
+    def _shared_adopt_pages(self, req: Request) -> int:
+        """Pages an admission of ``req`` would adopt read-only from the
+        prefix cache (0 = it would NOT take the shared route). The one
+        probe that mirrors ``_admit``'s actual routing — a probe that
+        disagrees with ``_admit`` would misprice admissions: replays
+        never share, a whole-prompt hit trims, and chunking-off mode
+        applies the coverage threshold."""
+        if self._prefix is None or req.tokens:
+            return 0
+        memo = self._probe_memo.get(req.rid)
+        if memo is not None:
+            return memo
+        n = self._prefix.peek(req.prompt)
+        while n >= len(req.prompt):
+            n -= self.pool.page_size
+        if n <= 0 or (not self.chunk
+                      and len(req.prompt) - n
+                      > max(2 * self.pool.page_size, n)):
+            n = 0           # miss, or _admit's monolithic coverage
+                            # threshold would refuse the hit
+        pages = n // self.pool.page_size
+        self._probe_memo[req.rid] = pages
+        return pages
+
+    def _fresh_pages_needed(self, req: Request) -> int:
+        """Fresh (free-list) pages admitting ``req`` costs right now —
+        total span minus whatever its cached prefix supplies."""
+        return self._pages_needed(req) - self._shared_adopt_pages(req)
+
+    def _needs_prefill_unit(self, req: Request) -> bool:
+        """Would admitting ``req`` dispatch a monolithic prefill — the
+        step's single prefill-compute unit? Shared adoptions and
+        chunked admissions are cursor-only host bookkeeping."""
+        if self._shared_adopt_pages(req):
+            return False
+        if self.chunk and len(req.prompt) + len(req.tokens) > self.chunk:
+            return False
+        return True
+
+    def _next_admission(self, order: List[Request]) -> Optional[Request]:
+        """The next request to admit from this step's ``order``, or
+        None when admission must wait. The slack head goes first; a
+        page-blocked head first reclaims cached-but-unshared pages
+        (evict), then may be BYPASSED — boundedly, so it never starves
+        — by a request whose prompt prefix already lives in the prefix
+        cache: that request admits onto pages it shares instead of
+        fresh ones, so it lands where its pages already live without
+        consuming the head's."""
+        head = order[0]
+        self._head_blocked = False
+        # the head's page bill is its FRESH need: a head whose prompt
+        # prefix already lives in the cache admits onto shared pages
+        # and only pays for the suffix — gating it on the full span
+        # would declare an admittable head blocked (and eviction could
+        # even cannibalize its own cached prefix)
+        need = self._fresh_pages_needed(head)
+        if need > self.pool.free_page_count() and self._prefix:
+            # cached-but-unshared pages are reclaimable capacity;
+            # a shortfall (pinned/shared pages refusing eviction)
+            # is banked as pressure, not silently swallowed
+            want = need - self.pool.free_page_count()
+            freed = self._prefix.evict(want)
+            if freed < want:
+                self._observe_evict_shortfall(want - freed)
+            # eviction mutates the trie — LRU may even have dropped
+            # part of the HEAD's own cached prefix — so its bill must
+            # be repriced, not tested against the stale estimate
+            self._probe_memo.clear()
+            need = self._fresh_pages_needed(head)
+        if need <= self.pool.free_page_count():
+            return head
+        # graceful degradation: the head WAITS in the queue (no
+        # starvation) and the shortfall is published as pressure
+        self._head_blocked = True
+        self._observe_page_pressure(need - self.pool.free_page_count())
+        if self._prefix is not None and head.bypassed < self._BYPASS_BUDGET:
+            for req in order[1:1 + self._BYPASS_SCAN]:
+                adopt = self._shared_adopt_pages(req)
+                if adopt and (self._pages_needed(req) - adopt
+                              <= self.pool.free_page_count()):
+                    head.bypassed += 1
+                    return req
+        return None
+
+    def _maybe_migrate(self, order: List[Request]) -> None:
+        """Bucket-ladder control: pick the smallest rung covering
+        current demand, capped at the top rung. Demand counts only
+        queued work the page pool could actually admit, scanned in the
+        SAME deadline-slack order admission uses (head-of-line on that
+        order) — a page-BLOCKED queue must not inflate the bucket to
+        rungs whose slots can never fill, where every decode step would
+        pay for idle rows. Growth is immediate — admittable work is
+        waiting; shrink waits out
+        ``FLAGS_serving_bucket_patience`` steps of sustained lower
+        demand so occupancy flapping never thrashes programs."""
+        if len(self.ladder) == 1:
+            return
+        active = sum(1 for r in self._slots if r is not None)
+        free = self.pool.free_page_count()
+        admittable = 0
+        for req in order[:self.max_batch]:
+            need = self._fresh_pages_needed(req)
+            if need > free:
+                break
+            free -= need
+            admittable += 1
+        demand = max(1, min(active + admittable, self.max_batch))
+        target = next(r for r in self.ladder if r >= demand)
+        if target > self.bucket:
+            self._migrate(target)
+            self._shrink_wait = 0
+        elif target < self.bucket:
+            self._shrink_wait += 1
+            if self._shrink_wait >= self.bucket_patience:
+                self._migrate(target)
+                self._shrink_wait = 0
+        else:
+            self._shrink_wait = 0
+
+    def _migrate(self, target: int) -> None:
+        """Move the decode batch to rung ``target``: shrinking compacts
+        the active sequences into the low slots (pure host-side
+        block-table row moves — KV pages never copy), growing just
+        widens the next dispatch. Each rung's program compiles once and
+        stays cached, so steady-state migration is retrace-free."""
+        self._f_migrate.check(phase="begin", frm=self.bucket, to=target)
+        if target < self.bucket:
+            dst = 0
+            for s in range(target, self.max_batch):
+                req = self._slots[s]
+                if req is None:
+                    continue
+                while self._slots[dst] is not None:
+                    dst += 1        # always < target: target covers active
+                self.pool.move_sequence(s, dst)
+                self._last_tok[dst] = self._last_tok[s]
+                self._slots[dst] = req
+                self._slots[s] = None
+                req.slot = dst
+                self._f_migrate.check(phase="move", rid=req.rid)
+        self.bucket = target
+        self.bucket_migrations += 1
+        self._f_migrate.check(phase="commit")
+        self._observe_bucket(migrated=True)
+
     def _step_inner(self) -> None:  # tracecheck: hotpath
         self._sweep_deadlines()
-        # admission: fill every free slot that has pages available
-        for slot in range(self.max_batch):
-            if self._slots[slot] is None and self._queue:
-                req = self._queue[0]
-                need = -(-(len(req.prompt) + req.max_new_tokens)
-                         // self.pool.page_size)
-                if need > self.pool.free_page_count() and self._prefix:
-                    # cached-but-unshared pages are reclaimable capacity;
-                    # a shortfall (pinned/shared pages refusing eviction)
-                    # is banked as pressure, not silently swallowed
-                    want = need - self.pool.free_page_count()
-                    freed = self._prefix.evict(want)
-                    if freed < want:
-                        self._observe_evict_shortfall(want - freed)
-                if need > self.pool.free_page_count():
-                    # graceful degradation: the request WAITS in the
-                    # queue (FIFO, no starvation) and the shortfall is
-                    # published as pressure, not an exception
-                    self._observe_page_pressure(
-                        need - self.pool.free_page_count())
-                    break
-                self._queue.pop(0)
-                try:
-                    self._prefill(req, slot)
-                except Exception as e:
-                    if isinstance(e, RuntimeError) and \
-                            "page pool exhausted" in str(e):
-                        # allocate came up short mid-step (pinned pages
-                        # under-counted by the pre-check): back off to
-                        # the queue instead of killing the step
-                        self._rollback_admission(req, slot)
-                        self._queue.insert(0, req)
-                        self._observe_page_pressure(max(
-                            1, need - self.pool.free_page_count()))
-                        break
-                    # dispatch failure: hand the request to recovery
-                    # (it holds no slot state after the rollback)
+        self._probe_memo.clear()    # prefix probes are per-step
+        # decode-ready requests present BEFORE this step's scheduler +
+        # prefill work: the population that work below is stalling
+        waiting = any(r is not None and r.prefill_pos is None
+                      for r in self._slots)
+        t_sched = time.perf_counter()
+        # the step's admission order, sorted once and shared by the
+        # migration demand estimate and the slot-fill loop below
+        order = self._admission_order() if self._queue else []
+        self._maybe_migrate(order)
+        # the step's ONE prefill-compute unit alternates between new
+        # monolithic admissions and in-flight chunks under contention:
+        # admissions always winning would starve a mid-prefill long
+        # prompt forever under a stream of short arrivals; chunks are
+        # finite per request, and a unit-needing head stops admission
+        # (head-of-line), so neither side starves
+        chunk_pending = any(r is not None and r.prefill_pos is not None
+                            for r in self._slots)
+        did_prefill = False
+        chunk_ran_first = False
+        if chunk_pending and self._chunk_turn:
+            chunk_ran_first = self._chunk_step()
+            did_prefill = chunk_ran_first
+        for slot in range(self.bucket):
+            if self._slots[slot] is not None or not order:
+                continue
+            req = self._next_admission(order)
+            if req is None:
+                break       # head page-blocked: wait, keep order
+            if did_prefill and self._needs_prefill_unit(req):
+                # the unit is spent: a monolithic-prefill head admits
+                # next step (head-of-line — nothing jumps it); cursor-
+                # only admissions behind a served head keep filling
+                break
+            order.remove(req)
+            self._queue.remove(req)
+            try:
+                did_prefill |= self._admit(req, slot)
+            except Exception as e:
+                if isinstance(e, RuntimeError) and \
+                        "page pool exhausted" in str(e):
+                    # allocate came up short mid-step (pinned pages
+                    # under-counted by the pre-check): back off to
+                    # the queue instead of killing the step
                     self._rollback_admission(req, slot)
-                    self._failed_admission = req
-                    raise
+                    self._queue.insert(0, req)
+                    self._observe_page_pressure(max(
+                        1, self._pages_needed(req)
+                        - self.pool.free_page_count()))
+                    break
+                # dispatch failure: hand the request to recovery
+                # (it holds no slot state after the rollback)
+                self._rollback_admission(req, slot)
+                self._failed_admission = req
+                raise
+            if not self._head_blocked:
+                # a BYPASS admission must not clear the pressure the
+                # still-blocked head just published
                 self._observe_page_pressure(0)
+        # ONE prefill-compute unit per step (one monolithic prefill OR
+        # one chunk — admitting several prefills back to back would
+        # stack their stalls on every decoding request; the load bench
+        # measured admission bursts, not long prompts, as the worst
+        # stall): if admission spent it, chunks wait for their turn
+        admission_used_unit = did_prefill and not chunk_ran_first
+        if not did_prefill:
+            did_prefill = self._chunk_step()
+        # fairness flip: when chunks were pending but an admission took
+        # the unit, the next contended step is the chunks'
+        self._chunk_turn = chunk_pending and admission_used_unit
+        if waiting and did_prefill:
+            self._observe_stall(time.perf_counter() - t_sched)
 
-        active = [s for s in self._slots if s is not None]
-        self._observe_step_begin(len(active))
-        if not active:
+        decode_rows = [r for r in self._slots
+                       if r is not None and r.prefill_pos is None]
+        self._observe_step_begin(len(decode_rows))
+        if not decode_rows:
             return
 
-        fn = self._decode_program()
-        bt = jnp.asarray(self.pool.block_tables[:self.max_batch])
-        sl = jnp.asarray(self.pool.seq_lens[:self.max_batch])
+        b = self.bucket
+        fn = self._decode_program(b)
+        bt = jnp.asarray(self.pool.block_tables[:b])
+        sl = jnp.asarray(self.pool.seq_lens[:b])
         t0 = time.perf_counter() if self._m.enabled else 0.0
         pools = self.pool.take_pools()
         self._f_decode.check()
         toks, states = fn(
             self._params, self._buffers,
-            jnp.asarray(self._last_tok[:, None]),
+            jnp.asarray(self._last_tok[:b, None]),
             pools, bt, sl)
         self._store(states)
         # the scheduler's designed sync point: admission/eviction need
@@ -864,10 +1397,16 @@ class ServingEngine:
         # one retroactive timeline event per step (cheaper than a span
         # object on the hot path; under a jax capture the compiled step
         # shows up natively)  # tracecheck: disable=TRC007
-        self._m.event("engine.decode_step", t0, now, active=len(active))
+        self._m.event("engine.decode_step", t0, now,
+                      active=len(decode_rows))
         for slot, req in enumerate(self._slots):
             if req is None:
                 continue            # idle row wrote the null page; ignore
+            if req.prefill_pos is not None:
+                # mid-chunk-prefill slot: its decode row computed (and
+                # wrote) garbage at the cursor position — the next chunk
+                # overwrites that position and the cursor never advanced
+                continue
             self.pool.seq_lens[slot] += 1
             if req.pending:
                 # still teacher-forcing the prompt suffix (prefix-cache
@@ -892,6 +1431,7 @@ class ServingEngine:
                 self._m.ttft.observe(now - req.t_submit)
             req.t_last = now
             req.tokens.append(tok)
+            self._emit(req, tok)
             self._last_tok[slot] = tok
             self._finish_if_done(req)
         self._observe_step_end()
@@ -960,6 +1500,33 @@ class ServingEngine:
         m.evict_short.inc(short)
         m.prefix_pinned.set(self._prefix.pinned_page_count())
 
+    def _observe_chunk(self, dt: float, final: bool = False) -> None:
+        """One chunked-prefill dispatch retired: bank its wall clock —
+        the unit a long-prompt arrival can stall decode by. The final
+        chunk also closes the per-request prefill counter."""
+        if self._m.enabled:
+            self._m.prefill_chunk_s.observe(dt)
+            if final:
+                self._m.prefills.inc()
+
+    def _observe_stall(self, dt: float) -> None:
+        """Scheduler + prefill work ran this step while decode-ready
+        requests waited: that wall clock is the decode stall. The host
+        probe (``max_decode_stall``) updates regardless of telemetry —
+        the load bench asserts its bound."""
+        if dt > self.max_decode_stall:
+            self.max_decode_stall = dt
+        if self._m.enabled:
+            self._m.decode_stall_s.observe(dt)
+
+    def _observe_bucket(self, migrated: bool = False) -> None:
+        """The bucket gauge only moves on migration (plus once at
+        construction), so it refreshes there instead of per step."""
+        if self._m.enabled:
+            self._m.bucket.set(self.bucket)
+            if migrated:
+                self._m.migrations.inc()
+
 
 def _val(x):
     return x._value if hasattr(x, "_value") else x
@@ -982,6 +1549,32 @@ def _build_prefill(note_trace, model):
             model, params, ids, states, jnp.int32(0),
             buffers=buffers, method="forward_with_cache")
         return (jnp.argmax(logits[0, -1].astype(jnp.float32)), states)
+
+    return jax.jit(run, donate_argnums=(3,))
+
+
+def _build_chunk_prefill(note_trace, model):
+    """The chunked-prefill step: one fixed-size b=1 chunk of prompt
+    through the model against the PAGED pool. ``PagedChunkState`` routes
+    attention onto the cache-READING prefill path — the chunk writes its
+    KV at positions ``sl .. sl+C-1`` and attends to the already-written
+    prefix plus itself causally — and ``sl[0]`` is the rotary/positional
+    offset, so ONE compiled program serves every chunk of every prompt
+    (the final partial chunk pads; pad rows are causally invisible to
+    real rows and ``last_idx`` picks the real tail's logits). The argmax
+    return is meaningful only on the final chunk — earlier dispatches
+    never pull it, so they stay async."""
+    from ..jit import functional_call
+    from ..kernels.paged_attention import PagedChunkState
+
+    def run(params, buffers, ids, pools, bt, sl, last_idx):
+        note_trace()
+        states = [PagedChunkState(k, v, bt, sl) for k, v in pools]
+        logits, states = functional_call(
+            model, params, ids, states, sl[0],
+            buffers=buffers, method="forward_with_cache")
+        return (jnp.argmax(logits[0, last_idx].astype(jnp.float32)),
+                states)
 
     return jax.jit(run, donate_argnums=(3,))
 

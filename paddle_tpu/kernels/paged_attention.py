@@ -68,6 +68,34 @@ class PagedDecodeState(NamedTuple):
     seq_lens: Any
 
 
+class PagedChunkState(NamedTuple):
+    """The chunked-prefill twin of :class:`PagedDecodeState`: same pytree
+    shape, but its TYPE statically routes S > 1 attention onto the
+    cache-READING prefill path — the query chunk lands at positions
+    ``seq_lens .. seq_lens+S-1`` and attends to the already-written
+    prefix plus itself causally, instead of requiring empty sequences.
+    The serving engine's chunk programs trace with this type so one
+    compiled program serves every chunk of every prompt; decode (S == 1)
+    behaves identically to PagedDecodeState.
+
+    Length contract: the returned state's ``seq_lens`` advance by the
+    FULL chunk width S — S is a static shape, so a padded final chunk
+    overcounts by its pad tail. The DRIVER owns the true lengths (it
+    knows how many fed tokens were real) and must carry them host-side,
+    as ``ServingEngine`` does; never feed a padded chunk's returned
+    ``seq_lens`` back as ground truth."""
+    k_pages: Any
+    v_pages: Any
+    block_tables: Any
+    seq_lens: Any
+
+
+def is_paged_state(entry) -> bool:
+    """Static (trace-time) test for either paged-cache state flavor —
+    the dispatch models use to route attention onto the paged path."""
+    return isinstance(entry, (PagedDecodeState, PagedChunkState))
+
+
 def _interpret() -> bool:
     from ..flags import is_tpu_backend
     return not is_tpu_backend()
@@ -229,18 +257,54 @@ def write_paged_kv(k_pages, v_pages, k_new, v_new, block_tables, positions):
 def write_paged_prompt(k_pages, v_pages, k_new, v_new, block_tables):
     """Prefill write: k_new/v_new (B, S, Hkv, D) go to positions [0, S)
     of each sequence. Returns the updated pools."""
+    b = k_new.shape[0]
+    return write_paged_prompt_at(k_pages, v_pages, k_new, v_new,
+                                 block_tables, jnp.zeros((b,), jnp.int32))
+
+
+def write_paged_prompt_at(k_pages, v_pages, k_new, v_new, block_tables,
+                          start):
+    """Prefill write at an offset: k_new/v_new (B, S, Hkv, D) land at
+    positions [start, start+S) of each sequence (``start`` (B,) int32 —
+    the chunked-prefill cursor; :func:`write_paged_prompt` is the
+    start=0 case). Positions past the block table's width are DROPPED
+    (scatter mode="drop"): the final chunk of a prompt pads to the fixed
+    chunk length, and its pad tail must never clamp onto a live page."""
     bt = jnp.asarray(block_tables, jnp.int32)
     b, s, hkv, d = k_new.shape
     page_size = k_pages.shape[2]
-    pos = jnp.arange(s, dtype=jnp.int32)
+    pos = (jnp.asarray(start, jnp.int32)[:, None]
+           + jnp.arange(s, dtype=jnp.int32)[None, :])        # (B, S)
+    page_idx = pos // page_size
+    in_range = page_idx < bt.shape[1]
     pages = jnp.take_along_axis(
-        bt, (pos // page_size)[None, :].repeat(b, 0), axis=1)  # (B, S)
-    off = (pos % page_size)[None, :].repeat(b, 0)
+        bt, jnp.minimum(page_idx, bt.shape[1] - 1), axis=1)  # (B, S)
+    # out-of-range positions get an out-of-range POOL page so the
+    # mode="drop" scatter discards them
+    pages = jnp.where(in_range, pages, k_pages.shape[1])
+    off = pos % page_size
     kt = jnp.moveaxis(k_new.astype(k_pages.dtype), 2, 0)   # (Hkv, B, S, D)
     vt = jnp.moveaxis(v_new.astype(v_pages.dtype), 2, 0)
-    k_pages = k_pages.at[:, pages, off].set(kt)
-    v_pages = v_pages.at[:, pages, off].set(vt)
+    k_pages = k_pages.at[:, pages, off].set(kt, mode="drop")
+    v_pages = v_pages.at[:, pages, off].set(vt, mode="drop")
     return k_pages, v_pages
+
+
+def gather_paged_view(k_pages, v_pages, block_tables):
+    """Materialize each sequence's contiguous ``(B, T, Hkv, D)`` cache
+    view from its pages (T = max_pages * page_size) — the gather the
+    decode kernel avoids. Chunked prefill amortizes this copy over its
+    whole query chunk and feeds the view to ``cached_attention`` (flash
+    prefill on chip, dense einsum elsewhere); a chunk-native Pallas
+    kernel that skips the gather is a ROADMAP item for the next on-chip
+    window."""
+    bt = jnp.asarray(block_tables, jnp.int32)
+    hkv, _, page_size, d = k_pages.shape
+    b, max_pages = bt.shape
+    t = max_pages * page_size
+    k = jnp.moveaxis(k_pages[:, bt], 1, 0).reshape(b, hkv, t, d)
+    v = jnp.moveaxis(v_pages[:, bt], 1, 0).reshape(b, hkv, t, d)
+    return jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2)     # (B, T, Hkv, D)
 
 
 class PagedKVCache:
@@ -333,6 +397,23 @@ class PagedKVCache:
             self.block_tables[seq_idx, i] = pid
             self._page_rc[pid] = 1
             self._pages_used[seq_idx] = i + 1
+
+    def move_sequence(self, src: int, dst: int) -> None:
+        """Relocate sequence ``src``'s bookkeeping row to the empty slot
+        ``dst`` (bucket-shrink compaction): pure host-side index moves —
+        the pool arrays, page contents and reference counts are
+        untouched, only the block-table row changes slots."""
+        if self._pages_used[dst] or self.seq_lens[dst]:
+            raise RuntimeError(
+                f"move_sequence: destination slot {dst} is not empty")
+        n = int(self._pages_used[src])
+        self.block_tables[dst, :n] = self.block_tables[src, :n]
+        self.block_tables[dst, n:] = 0
+        self.seq_lens[dst] = self.seq_lens[src]
+        self._pages_used[dst] = self._pages_used[src]
+        self.block_tables[src, :n] = 0
+        self.seq_lens[src] = 0
+        self._pages_used[src] = 0
 
     def free_sequence(self, seq_idx: int) -> None:
         n = int(self._pages_used[seq_idx])

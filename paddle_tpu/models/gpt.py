@@ -76,12 +76,12 @@ class GPTSelfAttention(Layer):
         self.attn_drop_p = config.attention_probs_dropout_prob
 
     def forward(self, x, attn_mask=None, cache=None):
-        from ..kernels.paged_attention import PagedDecodeState
+        from ..kernels.paged_attention import is_paged_state
 
         b, s, h = x.shape
         qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = ops.unbind(qkv, axis=2)
-        if cache is not None and isinstance(cache[0], PagedDecodeState):
+        if cache is not None and is_paged_state(cache[0]):
             state, _offset = cache
             out, state = F.paged_scaled_dot_product_attention(q, k, v, state)
             return self.out_proj(out.reshape([b, s, h])), state
@@ -153,10 +153,10 @@ class GPTModel(Layer):
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
         if caches is not None:
-            from ..kernels.paged_attention import PagedDecodeState
+            from ..kernels.paged_attention import is_paged_state
             new_caches = []
             for block, entry in zip(self.h, caches):
-                if isinstance(entry, PagedDecodeState):
+                if is_paged_state(entry):
                     x, nc = block(x, attn_mask, cache=(entry, offset))
                 else:
                     kc, vc = entry
@@ -168,9 +168,9 @@ class GPTModel(Layer):
         return self.ln_f(x)
 
     def _position_ids(self, s, offset, caches):
-        from ..kernels.paged_attention import (PagedDecodeState,
+        from ..kernels.paged_attention import (is_paged_state,
                                                paged_position_ids)
-        if caches and isinstance(caches[0], PagedDecodeState):
+        if caches and is_paged_state(caches[0]):
             return paged_position_ids(s, offset, caches[0], "int64")
         base = ops.arange(s, dtype="int64").unsqueeze(0)
         return base if offset is None else base + offset

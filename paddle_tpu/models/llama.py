@@ -80,13 +80,13 @@ class LlamaAttention(Layer):
         self.o_proj = Linear(self.num_heads * self.head_dim, h, weight_attr=init, bias_attr=False)
 
     def forward(self, x, attn_mask=None, position_ids=None, cache=None):
-        from ..kernels.paged_attention import PagedDecodeState
+        from ..kernels.paged_attention import is_paged_state
 
         b, s, _ = x.shape
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
-        paged = cache is not None and isinstance(cache[0], PagedDecodeState)
+        paged = cache is not None and is_paged_state(cache[0])
         if cache is not None and position_ids is None:
             if paged:
                 from ..kernels.paged_attention import paged_position_ids
@@ -170,10 +170,10 @@ class LlamaModel(Layer):
                 caches=None, offset=None):
         x = self.embed_tokens(input_ids)
         if caches is not None:
-            from ..kernels.paged_attention import PagedDecodeState
+            from ..kernels.paged_attention import is_paged_state
             new_caches = []
             for layer, entry in zip(self.layers, caches):
-                if isinstance(entry, PagedDecodeState):
+                if is_paged_state(entry):
                     x, nc = layer(x, attn_mask, position_ids,
                                   cache=(entry, offset))
                 else:

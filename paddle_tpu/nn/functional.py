@@ -710,35 +710,64 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 def paged_scaled_dot_product_attention(query, key, value, state):
     """Paged (block-table) variant of the decode attention (reference:
     block_multihead_attention's two phases). ``state`` is a per-layer
-    :class:`~paddle_tpu.kernels.paged_attention.PagedDecodeState`.
+    :class:`~paddle_tpu.kernels.paged_attention.PagedDecodeState` or —
+    for chunked prefill — a ``PagedChunkState``; the state TYPE routes
+    the S > 1 phase statically at trace time.
 
-    Prefill (S > 1, empty cache): the prompt attends causally to ITSELF
-    (no cache read needed), then its k/v write into the pool pages.
+    Prefill (S > 1, PagedDecodeState, empty cache): the prompt attends
+    causally to ITSELF (no cache read needed), then its k/v write into
+    the pool pages.
+    Chunked prefill (S > 1, PagedChunkState, B = 1): the chunk writes at
+    positions ``seq_lens .. seq_lens+S-1`` and attends to the
+    already-written prefix PLUS itself causally over the gathered pool
+    view (``cached_attention``: flash prefill on chip, dense einsum
+    elsewhere). Pad positions past the block table are dropped — but
+    the returned state's ``seq_lens`` still advance by the full static
+    S, so a PADDED final chunk overcounts by its pad tail: the driver
+    owns the true lengths (see the PagedChunkState length contract).
     Decode (S == 1): the token writes at position ``seq_lens`` and
     attends against the pool through the Pallas block-table kernel (XLA
     gather fallback when pallas is off). Returns ``(out, new_state)``."""
     from .. import flags
     from ..kernels.decode_attention import cached_attention
-    from ..kernels.paged_attention import (PagedDecodeState, paged_attention,
+    from ..kernels.paged_attention import (PagedChunkState, paged_attention,
                                            paged_attention_xla,
+                                           gather_paged_view,
                                            write_paged_kv,
-                                           write_paged_prompt)
+                                           write_paged_prompt,
+                                           write_paged_prompt_at)
 
     use_pallas = (flags.snapshot(("use_pallas",)).use_pallas
                   and flags.is_tpu_backend())
+    chunked = isinstance(state, PagedChunkState)
 
     def fn(qv, kv, vv, kp, vp, bt, sl):
         s = qv.shape[1]
-        if s > 1:
-            # prefill contract: the sequences must be EMPTY (chunked
-            # prefill would need cache-reading attention). Enforce it
-            # whenever the lengths are concrete (eager prototyping);
-            # under jit the docstring contract applies.
+        if s > 1 and chunked:
+            if qv.shape[0] != 1:
+                raise NotImplementedError(
+                    "chunked paged prefill is per-request (B = 1); got "
+                    f"batch {qv.shape[0]}")
+            kp2, vp2 = write_paged_prompt_at(kp, vp, kv, vv, bt, sl)
+            kg, vg = gather_paged_view(kp2, vp2, bt)
+            # query rows sit at absolute positions sl .. sl+s-1; rows
+            # past the real prompt tail (final-chunk padding) emit
+            # garbage the caller discards, and their K is masked off
+            # every earlier row by causality
+            out = cached_attention(qv, kg, vg, sl[0] + s)
+            sl2 = sl + s
+        elif s > 1:
+            # whole-prompt prefill contract: the sequences must be
+            # EMPTY (chunked prefill rides PagedChunkState instead).
+            # Enforce it whenever the lengths are concrete (eager
+            # prototyping); under jit the docstring contract applies.
             if not isinstance(sl, jax.core.Tracer) and int(jnp.max(sl)):
                 raise ValueError(
                     "paged prefill (S > 1) requires empty sequences "
                     f"(seq_lens all 0); got max {int(jnp.max(sl))}. "
-                    "Decode tokens one at a time after the prompt.")
+                    "Use a PagedChunkState (chunked prefill) to extend "
+                    "non-empty sequences, or decode one token at a "
+                    "time after the prompt.")
             kp2, vp2 = write_paged_prompt(kp, vp, kv, vv, bt)
             # the prompt is the whole valid cache: causal self-attention
             out = cached_attention(qv, kv, vv, s)
@@ -753,7 +782,7 @@ def paged_scaled_dot_product_attention(query, key, value, state):
     out, kp2, vp2, sl2 = apply_op(
         "paged_sdpa", fn, query, key, value,
         state.k_pages, state.v_pages, state.block_tables, state.seq_lens)
-    return out, PagedDecodeState(kp2, vp2, state.block_tables, sl2)
+    return out, type(state)(kp2, vp2, state.block_tables, sl2)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,

@@ -27,8 +27,14 @@ Sites (KNOWN_SITES; an unknown site in the spec is a construction-time
 ``ValueError``, never a silently-dead injection):
 
     prefill             ServingEngine b=1 prefill dispatch (post-detach)
+    chunk_prefill       ServingEngine chunked-prefill chunk dispatch
+                        (post-detach — one chunk of a long prompt dies
+                        mid-prefill, before the request has any tokens)
     decode_dispatch     ServingEngine full-batch decode dispatch
                         (post-detach: the pool is already taken)
+    bucket_migrate      ServingEngine bucket-ladder migration (checked
+                        at begin, per compacted sequence, and at
+                        commit, so every=N schedules land mid-move)
     program_build       decode program cache build (compile path)
     train_dispatch      TrainStep.__call__ before the jitted dispatch
     train_sync          TrainStep.pull_metrics / sync host pulls
@@ -69,8 +75,8 @@ __all__ = [
 ]
 
 KNOWN_SITES = frozenset({
-    "prefill", "decode_dispatch", "program_build",
-    "train_dispatch", "train_sync", "dataloader_worker",
+    "prefill", "chunk_prefill", "decode_dispatch", "bucket_migrate",
+    "program_build", "train_dispatch", "train_sync", "dataloader_worker",
     "checkpoint_save",
 })
 

@@ -197,7 +197,7 @@ class TestDecodeProgramCache:
             eng2.submit(p, 4)
         eng2.run()
         assert eng2.decode_key == key
-        assert eng2._decode_fn is eng._decode_fn
+        assert eng2._decode_fns[eng2.bucket] is eng._decode_fns[eng.bucket]
         assert cache.trace_count(key) == traced_once
 
     def test_distinct_buckets_get_distinct_programs(self):
@@ -211,7 +211,7 @@ class TestDecodeProgramCache:
         e1.submit(p, 2); e1.run()
         e2.submit(p, 2); e2.run()
         assert e1.decode_key != e2.decode_key
-        assert e1._decode_fn is not e2._decode_fn
+        assert e1._decode_fns[e1.bucket] is not e2._decode_fns[e2.bucket]
 
     def test_eager_only_flags_do_not_invalidate_programs(self):
         """The key snapshots PROGRAM_FLAGS only: changing an eager-only
@@ -231,7 +231,7 @@ class TestDecodeProgramCache:
             flags.set_flags({"log_level": 0})
             e2 = mk(); e2.submit(p, 2); e2.run()
             assert e2.decode_key == e1.decode_key
-            assert e2._decode_fn is e1._decode_fn
+            assert e2._decode_fns[e2.bucket] is e1._decode_fns[e1.bucket]
             flags.set_flags({"flash_block_q": 256})
             e3 = mk(); e3.submit(p, 2); e3.run()
             assert e3.decode_key != e1.decode_key

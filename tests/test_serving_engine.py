@@ -159,7 +159,7 @@ class TestDonationDiscipline:
         rid = eng.submit(prompt, 6)
         eng.step(); eng.step()          # prefill + one decode
 
-        real = eng._decode_fn
+        real = eng._decode_fns[eng.bucket]
         boomed = []
 
         def boom_once(*a, **k):
@@ -168,7 +168,7 @@ class TestDonationDiscipline:
                 raise RuntimeError("simulated post-dispatch failure")
             return real(*a, **k)
 
-        eng._decode_fn = boom_once
+        eng._decode_fns[eng.bucket] = boom_once
         out = eng.run()                 # recovery happens inside
         assert boomed and out[rid] == ref
         assert eng.status(rid) == "OK"
@@ -186,14 +186,14 @@ class TestDonationDiscipline:
             raise RuntimeError("wedged backend")
 
         eng._prefill_fn = boom          # no prefill -> no progress ever
-        eng._decode_fn = boom
+        eng._decode_fns = {b: boom for b in eng.ladder}
         rid = eng.submit(prompt, 4)
         out = eng.run()                 # returns; does NOT raise
         assert eng.status(rid) == "FAILED"
         assert out[rid] == []           # partial tokens (none emitted)
         # the engine is NOT wedged: fresh pool + real programs serve on
         eng._prefill_fn = None
-        eng._decode_fn = None
+        eng._decode_fns = {}
         rid2 = eng.submit(prompt, 4)
         assert eng.run()[rid2] == ref
         assert eng.status(rid2) == "OK"

@@ -1,0 +1,75 @@
+"""The serving load generator (tools/serving_load.py) — tier-1 slice.
+
+The ``serving_load`` marker runs the deterministic --quick
+configuration end to end on CPU: seeded Poisson multi-tenant arrivals,
+both arms (chunked + monolithic), and asserts the acceptance bars the
+banked SERVING_LOAD_r12.json artifact reports — greedy bit-identity
+across arms, zero steady-state retraces read from the telemetry
+snapshot, every request OK, streaming consistency, and the decode
+stall bound (chunked max stall < the monolithic whole-prompt stall).
+The full-size sweep stays out of tier-1 behind ``-m slow``.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import serving_load  # noqa: E402
+
+
+def _assert_acceptance(doc):
+    assert doc["ok"], json.dumps(
+        {k: v for k, v in doc.items() if k != "telemetry"}, indent=1)
+    assert doc["parity_bit_identical"]
+    assert doc["stall"]["bounded_by_chunk"]
+    for arm, m in doc["arms"].items():
+        assert m["all_ok"], (arm, m["statuses"])
+        assert m["steady_retraces"] == 0, (arm, m["steady_retraces"])
+        assert m["streamed_matches_results"], arm
+        assert m["tokens_total"] > 0 and m["tokens_per_s"] > 0
+        assert m["ttft_s"]["p50"] is not None
+        assert m["ttft_s"]["p99"] >= m["ttft_s"]["p50"]
+        assert m["inter_token_s"]["p99"] is not None
+    # the chunked arm actually chunked; the monolithic arm did not
+    assert doc["arms"]["chunked"]["chunk_dispatches"] > 0
+    assert doc["arms"]["monolithic"]["chunk_dispatches"] == 0
+    # telemetry snapshot rides along (the repo artifact convention)
+    assert "metrics" in doc["telemetry"]
+
+
+@pytest.mark.serving_load
+def test_quick_slice_meets_acceptance():
+    """Fixed seed, small model, CPU: the deterministic tier-1 pass of
+    the load generator must hold every acceptance bar."""
+    doc = serving_load.bench(per_tenant=6, seed=712, quick=True)
+    _assert_acceptance(doc)
+
+
+@pytest.mark.serving_load
+def test_banked_artifact_matches_schema():
+    """The checked-in SERVING_LOAD_r12.json was produced by this tool
+    at the acceptance bars (regenerate with
+    ``python tools/serving_load.py --out SERVING_LOAD_r12.json``)."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "SERVING_LOAD_r12.json")
+    if not os.path.exists(path):
+        pytest.skip("artifact not banked in this checkout")
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["schema"] == serving_load.SCHEMA
+    assert doc["bench"] == "serving_load"
+    _assert_acceptance(doc)
+
+
+@pytest.mark.serving_load
+@pytest.mark.slow
+def test_full_sweep():
+    """The full-size sweep (what --out banks); slow-marked out of
+    tier-1."""
+    doc = serving_load.bench(per_tenant=16, seed=712, quick=False)
+    _assert_acceptance(doc)
+    assert doc["arms"]["chunked"]["bucket_migrations"] > 0

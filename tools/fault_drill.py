@@ -9,6 +9,18 @@ Arms (each runs a fault-free baseline first, then the chaos pass):
               every request must complete with BIT-IDENTICAL greedy
               tokens vs. the fault-free run, zero wedged requests, and
               the engine must end drained with live pools.
+  serving_chunked
+              The r12 continuous-batching machinery under fire: long
+              prompts through CHUNKED prefill on a 2/4 bucket ladder,
+              with ``chunk_prefill`` dying mid-prefill (post-detach,
+              before the request has any tokens), ``bucket_migrate``
+              dying mid-migration, and decode faults layered on top.
+              Same bar: bit-identical greedy continuation (the r10
+              replay-recovery guarantee drilled through the new
+              sites). Fault schedules are ``times=``-bounded — an
+              unbounded ``every=N`` below the chunks-per-prompt count
+              is a genuinely wedged backend, which the no-progress
+              budget rightly terminates FAILED.
   training    ``Model.fit`` under ``train_dispatch`` faults (+ one
               injected ``checkpoint_save`` failure): training completes,
               the emergency checkpoint lands, the final loss is finite.
@@ -34,6 +46,9 @@ sys.path.insert(0, REPO)
 
 DRILL_SCHEMA = 1
 SERVING_SPEC = "decode_dispatch:every=5;prefill:p=0.1:seed=7"
+CHUNKED_SPEC = ("chunk_prefill:every=3:times=2;"
+                "bucket_migrate:every=2:times=2;"
+                "decode_dispatch:every=7:times=2")
 TRAIN_SPEC = ("train_dispatch:every=5:times=3;"
               "checkpoint_save:every=1:times=1")
 LOADER_SPEC = "dataloader_worker:every=3:times=1"
@@ -78,7 +93,6 @@ LOADER_COUNTERS = ("faults_injected", "io_worker_restarts")
 def drill_serving(n_requests, max_new):
     import numpy as np
     import paddle_tpu as paddle
-    from paddle_tpu import flags
     from paddle_tpu.generation.serving import ServingEngine
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
     from paddle_tpu.testing import faults
@@ -99,14 +113,11 @@ def drill_serving(n_requests, max_new):
 
     _, baseline, base_status = run_engine()
     before = counters(*SERVING_COUNTERS)
-    flags.set_flags({"fault_inject": SERVING_SPEC,
-                     "serving_retry_backoff": 0.001})
-    try:
+    # wide retry budget: the drill proves bit-identical RECOVERY under
+    # sustained chaos; the no-progress FAILED valve is tested on its own
+    with faults.armed(SERVING_SPEC, serving_retry_backoff=0.001,
+                      serving_max_retries=8):
         eng, chaos, status = run_engine()
-    finally:
-        flags.set_flags({"fault_inject": "",
-                         "serving_retry_backoff": 0.05})
-        faults.reset()
     ctr = delta(counters(*SERVING_COUNTERS), before)
     ok = (chaos == baseline
           and all(s == "OK" for s in status)
@@ -123,11 +134,58 @@ def drill_serving(n_requests, max_new):
     return row
 
 
+def drill_serving_chunked(n_requests, max_new):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.generation.serving import ServingEngine
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.testing import faults
+
+    paddle.seed(53)
+    model = GPTForCausalLM(GPTConfig.tiny())
+    rng = np.random.default_rng(19)
+    # long prompts: every request takes several chunks at chunk=8
+    prompts = [rng.integers(0, model.config.vocab_size,
+                            (int(rng.integers(20, 45)),)).astype(np.int32)
+               for _ in range(n_requests)]
+
+    def run_engine():
+        eng = ServingEngine(model, max_batch=4, page_size=8,
+                            max_seq_len=64, bucket_ladder=(2, 4),
+                            prefill_chunk=8)
+        rids = [eng.submit(p, max_new) for p in prompts]
+        out = eng.run(max_wall=300.0)
+        return eng, [out[r] for r in rids], [eng.status(r) for r in rids]
+
+    _, baseline, base_status = run_engine()
+    before = counters(*SERVING_COUNTERS)
+    with faults.armed(CHUNKED_SPEC, serving_retry_backoff=0.001,
+                      serving_bucket_patience=2):
+        eng, chaos, status = run_engine()
+    ctr = delta(counters(*SERVING_COUNTERS), before)
+    chunk_fires = ctr.get("faults_injected{site=chunk_prefill}", 0)
+    migrate_fires = ctr.get("faults_injected{site=bucket_migrate}", 0)
+    ok = (chaos == baseline
+          and all(s == "OK" for s in status)
+          and all(s == "OK" for s in base_status)
+          and not eng.has_work()
+          and all(k is not None for k in eng.pool.k_pages)
+          and chunk_fires >= 1 and migrate_fires >= 1
+          and eng.chunk_dispatches >= 1)
+    row = {"arm": "serving_chunked", "ok": ok, "spec": CHUNKED_SPEC,
+           "requests": n_requests, "max_new_tokens": max_new,
+           "bit_identical": chaos == baseline,
+           "statuses": status, "chunk_dispatches": eng.chunk_dispatches,
+           "bucket_migrations": eng.bucket_migrations,
+           "counters": ctr}
+    emit(row)
+    return row
+
+
 def drill_training(epochs):
     import numpy as np
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
-    from paddle_tpu import flags
     from paddle_tpu.hapi.model import Model
     from paddle_tpu.io import Dataset
     from paddle_tpu.testing import faults
@@ -151,19 +209,14 @@ def drill_training(epochs):
         return m
 
     before = counters(*TRAIN_COUNTERS)
-    flags.set_flags({"fault_inject": TRAIN_SPEC,
-                     "train_retry_backoff": 0.001})
     tmp = tempfile.mkdtemp(prefix="fault_drill_")
-    try:
+    with faults.armed(TRAIN_SPEC, train_retry_backoff=0.001):
         m = build()
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")
             m.fit(Reg(), batch_size=4, epochs=epochs, verbose=0,
                   save_dir=tmp, metrics_every=2)
         final = m.evaluate(Reg(), batch_size=4)["loss"]
-    finally:
-        flags.set_flags({"fault_inject": "", "train_retry_backoff": 0.05})
-        faults.reset()
     ctr = delta(counters(*TRAIN_COUNTERS), before)
     ckpt = os.path.join(tmp, "emergency.pdparams")
     ok = (os.path.exists(ckpt)
@@ -180,7 +233,6 @@ def drill_training(epochs):
 
 def drill_dataloader():
     import numpy as np
-    from paddle_tpu import flags
     from paddle_tpu.io import DataLoader, Dataset
     from paddle_tpu.testing import faults
 
@@ -192,16 +244,10 @@ def drill_dataloader():
             return np.full((4,), i, np.float32)
 
     before = counters(*LOADER_COUNTERS)
-    flags.set_flags({"fault_inject": LOADER_SPEC,
-                     "dataloader_max_worker_restarts": 16})
-    try:
+    with faults.armed(LOADER_SPEC, dataloader_max_worker_restarts=16):
         dl = DataLoader(Rows(), batch_size=4, num_workers=2,
                         use_process_workers=True)
         got = [int(np.asarray(b.numpy())[0, 0]) for b in dl]
-    finally:
-        flags.set_flags({"fault_inject": "",
-                         "dataloader_max_worker_restarts": 2})
-        faults.reset()
     ctr = delta(counters(*LOADER_COUNTERS), before)
     ok = (got == list(range(0, 40, 4))
           and ctr.get("io_worker_restarts", 0) >= 1)
@@ -220,7 +266,8 @@ def main():
     ap.add_argument("--requests", type=int, default=8)
     ap.add_argument("--max-new", type=int, default=6)
     ap.add_argument("--epochs", type=int, default=2)
-    ap.add_argument("--arms", default="serving,training,dataloader")
+    ap.add_argument("--arms",
+                    default="serving,serving_chunked,training,dataloader")
     args = ap.parse_args()
 
     import jax
@@ -229,6 +276,9 @@ def main():
     want = args.arms.split(",")
     if "serving" in want:
         arms["serving"] = drill_serving(args.requests, args.max_new)
+    if "serving_chunked" in want:
+        arms["serving_chunked"] = drill_serving_chunked(
+            args.requests, args.max_new)
     if "training" in want:
         arms["training"] = drill_training(args.epochs)
     if "dataloader" in want:

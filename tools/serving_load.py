@@ -1,0 +1,323 @@
+"""Seeded Poisson multi-tenant load generator for the serving engine.
+
+The acceptance bench for the r12 production continuous-batching loop:
+a deterministic (seeded) open-loop Poisson request stream from several
+tenants — a chat tenant with short shared-prefix prompts, a long-prompt
+tenant (the decode-stall antagonist), and an SLO tenant submitting with
+deadlines — is paced in real time against a ServingEngine, twice:
+
+  chunked      chunked prefill + the bucket ladder (the r12 loop)
+  monolithic   whole-prompt prefill, fixed top-rung bucket (pre-r12)
+
+Each arm runs a WARMUP pass first (same prompt-length set, every ladder
+rung dispatched) so the measured pass exercises steady state; metrics
+come from the r09 telemetry snapshot DELTA across the measured pass:
+
+  - sustained throughput (generated tokens / wall)
+  - p50/p99 TTFT and inter-token latency (histogram bucket deltas)
+  - ZERO program-cache traces at steady state (the retrace ledger)
+  - max decode stall (engine probe): with chunking the worst stall a
+    long-prompt arrival imposes on decoding requests is ~one chunk;
+    monolithic pays the whole prompt — the artifact asserts
+    chunked_max < monolithic_max
+
+plus a cross-arm greedy BIT-IDENTITY check (same schedule, same rids,
+same tokens). ``--out SERVING_LOAD_r12.json`` banks the ledger;
+``--quick`` is the deterministic tier-1 slice driven by
+tests/test_serving_load.py (marker ``serving_load``).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SCHEMA = 1
+
+# tenant mix: (name, rate req/s, prompt lengths cycled, shared-prefix
+# tokens, max_new, deadline seconds or None)
+TENANTS = (
+    ("chat", 24.0, (12, 24), 8, 12, None),
+    ("long", 4.0, (320,), 0, 8, None),
+    ("slo", 12.0, (16,), 0, 8, 30.0),
+)
+QUICK_TENANTS = (
+    ("chat", 20.0, (12,), 8, 6, None),
+    # long prompts must be long enough that prefill cost is token-work,
+    # not dispatch overhead, or the stall comparison loses its margin
+    # at tiny-model scale
+    ("long", 6.0, (320,), 0, 6, None),
+    ("slo", 10.0, (16,), 0, 4, 30.0),
+)
+
+
+def make_arrivals(tenants, per_tenant, vocab, seed):
+    """The deterministic request schedule: per-tenant exponential
+    inter-arrival gaps and prompt bodies from a private seeded stream
+    (tenant prompts share a fixed prefix to exercise the prefix cache),
+    merged by arrival time."""
+    import numpy as np
+
+    arrivals = []
+    for ti, (name, rate, lens, shared, max_new, deadline) in \
+            enumerate(tenants):
+        rng = np.random.default_rng((seed, ti))
+        prefix = rng.integers(0, vocab, (shared,)).astype(np.int32)
+        t = 0.0
+        for i in range(per_tenant):
+            t += float(rng.exponential(1.0 / rate))
+            ln = int(lens[i % len(lens)])
+            body = rng.integers(0, vocab, (ln - shared,)).astype(np.int32)
+            prompt = np.concatenate([prefix, body]).astype(np.int32)
+            arrivals.append(dict(t=t, tenant=name, prompt=prompt,
+                                 max_new=int(max_new), deadline=deadline))
+    arrivals.sort(key=lambda a: (a["t"], a["tenant"]))
+    return arrivals
+
+
+def make_engine(model, arm, cfg):
+    from paddle_tpu.generation.serving import ServingEngine
+
+    chunked = arm == "chunked"
+    return ServingEngine(
+        model, max_batch=cfg["max_batch"], page_size=cfg["page_size"],
+        max_seq_len=cfg["max_seq_len"], prefix_cache=True,
+        bucket_ladder=(cfg["ladder"] if chunked
+                       else (cfg["max_batch"],)),
+        prefill_chunk=(cfg["chunk"] if chunked else 0))
+
+
+def warmup_arm(model, arm, cfg, lens):
+    """Compile every program the measured pass can touch: one prefill
+    per distinct prompt length (or the chunk program for long ones),
+    and one decode dispatch at EVERY ladder rung — a rung first visited
+    mid-measurement would read as a steady-state retrace."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    eng = make_engine(model, arm, cfg)
+    for ln in sorted(set(lens)):
+        eng.submit(rng.integers(0, cfg["vocab"], (ln,)).astype(np.int32),
+                   4)
+        eng.run(max_wall=300.0)
+    for rung in eng.ladder:
+        for _ in range(rung):
+            eng.submit(rng.integers(0, cfg["vocab"], (8,))
+                       .astype(np.int32), 4)
+        eng.run(max_wall=300.0)
+
+
+def trace_total(snap):
+    fam = snap["metrics"].get("program_cache_traces")
+    if fam is None:
+        return 0.0
+    return sum(s["value"] for s in fam["series"])
+
+
+def hist_delta(before, after, name):
+    """Measured-pass histogram view: bucket-wise delta of the two
+    cumulative snapshots (min/max dropped — unknown for the window)."""
+    fa = after["metrics"].get(name)
+    if fa is None or not fa["series"]:
+        return None
+    sa = fa["series"][0]
+    fb = before["metrics"].get(name)
+    if fb is None or not fb["series"]:
+        return dict(sa)
+    sb = fb["series"][0]
+    return {"labels": {}, "count": sa["count"] - sb["count"],
+            "sum": sa["sum"] - sb["sum"], "buckets": sa["buckets"],
+            "counts": [a - b for a, b in zip(sa["counts"], sb["counts"])],
+            "min": None, "max": None}
+
+
+def quantiles(before, after, name, qs=(0.5, 0.99)):
+    from paddle_tpu.observability import series_quantile
+
+    entry = hist_delta(before, after, name)
+    if entry is None or not entry["count"]:
+        return {f"p{int(q * 100)}": None for q in qs}
+    return {f"p{int(q * 100)}": round(series_quantile(entry, q), 6)
+            for q in qs}
+
+
+# virtual steps per second: the arrival clock ticks once per scheduler
+# round rather than per wall second, so WHICH step each request lands
+# on — and therefore whether a long-prompt arrival overlaps live
+# decodes — is a pure function of the seed, not of machine load.
+# Latencies are still measured in real wall time.
+STEPS_PER_SEC = 250
+
+
+REPEATS = 3     # measured passes per arm: the banked max stall is the
+# MIN over passes of each pass's max — the schedule is deterministic,
+# so the structural worst stall recurs every pass while a one-off OS/GC
+# spike does not (a single pass's max is spike-polluted on shared CPU)
+
+
+def run_arm(model, arm, cfg, arrivals):
+    """The measured passes for one arm: warmed programs, deterministic
+    step-indexed pacing, streaming callbacks collecting every token,
+    telemetry snapshot delta spanning all passes (so the zero-retrace
+    bar covers every pass)."""
+    import paddle_tpu.observability as obs
+
+    warmup_arm(model, arm, cfg,
+               [len(a["prompt"]) for a in arrivals])
+    due = [int(a["t"] * STEPS_PER_SEC) for a in arrivals]
+
+    def one_pass():
+        eng = make_engine(model, arm, cfg)
+        streamed = {}
+
+        def on_token(rid, tok, done):
+            if not done:
+                streamed.setdefault(rid, []).append(tok)
+
+        rids = []
+        i = 0
+        tick = 0
+        t0 = time.perf_counter()
+        while i < len(arrivals) or eng.has_work():
+            while i < len(arrivals) and due[i] <= tick:
+                a = arrivals[i]
+                rids.append(eng.submit(a["prompt"], a["max_new"],
+                                       deadline=a["deadline"],
+                                       on_token=on_token))
+                i += 1
+            tick += 1
+            if eng.has_work():
+                eng.run_step()
+        wall = time.perf_counter() - t0
+        return eng, rids, streamed, wall
+
+    before = obs.snapshot()
+    walls, stalls = [], []
+    for _ in range(REPEATS):
+        eng, rids, streamed, wall = one_pass()
+        walls.append(wall)
+        stalls.append(round(eng.max_decode_stall, 6))
+    after = obs.snapshot()
+
+    out = eng.results()
+    statuses = [eng.status(r) for r in rids]
+    tokens_total = sum(len(out.get(r, [])) for r in rids)
+    wall = walls[-1]
+    metrics = {
+        "requests": len(rids),
+        "passes": REPEATS,
+        "statuses": {s: statuses.count(s) for s in set(statuses)},
+        "all_ok": all(s == "OK" for s in statuses),
+        "tokens_total": tokens_total,
+        "wall_s": round(wall, 4),
+        "tokens_per_s": round(tokens_total / wall, 2) if wall else None,
+        "ttft_s": quantiles(before, after, "serving_ttft_seconds"),
+        "inter_token_s": quantiles(before, after,
+                                   "serving_inter_token_seconds"),
+        "prefill_chunk_s": quantiles(before, after,
+                                     "serving_prefill_chunk_seconds"),
+        "decode_stall_s": quantiles(before, after,
+                                    "serving_decode_stall_seconds"),
+        "max_decode_stall_s": min(stalls),
+        "max_decode_stall_per_pass_s": stalls,
+        "steady_retraces": trace_total(after) - trace_total(before),
+        "bucket_migrations": eng.bucket_migrations,
+        "chunk_dispatches": eng.chunk_dispatches,
+        "streamed_matches_results": all(
+            streamed.get(r, []) == out.get(r, []) for r in rids),
+    }
+    return metrics, {r: out.get(r, []) for r in rids}
+
+
+def bench(per_tenant, seed, quick=False):
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    tenants = QUICK_TENANTS if quick else TENANTS
+    cfg = (dict(vocab=256, max_batch=8, page_size=8,
+                max_seq_len=384, ladder=(2, 4, 8), chunk=16)
+           if quick else
+           dict(vocab=256, max_batch=8, page_size=8,
+                max_seq_len=512, ladder=(2, 4, 8), chunk=32))
+    paddle.seed(1234)
+    mcfg = GPTConfig.tiny()
+    # the long tenant's prompts need position room beyond tiny's 128
+    mcfg.max_position_embeddings = cfg["max_seq_len"]
+    model = GPTForCausalLM(mcfg)
+    arrivals = make_arrivals(tenants, per_tenant, cfg["vocab"], seed)
+
+    arms = {}
+    outputs = {}
+    for arm in ("chunked", "monolithic"):
+        arms[arm], outputs[arm] = run_arm(model, arm, cfg, arrivals)
+
+    parity = outputs["chunked"] == outputs["monolithic"]
+    c_stall = arms["chunked"]["max_decode_stall_s"]
+    m_stall = arms["monolithic"]["max_decode_stall_s"]
+    stall = {
+        "chunked_max_s": c_stall,
+        "monolithic_max_s": m_stall,
+        # the acceptance bar: the worst stall any decoding request saw
+        # shrinks from a whole-prompt prefill to ~one chunk. Both
+        # maxima are min-over-passes (the structural stall recurs every
+        # pass; a one-off OS/GC spike does not), the long tenant's
+        # prompts are 10-20x the chunk so the margin survives ordinary
+        # shared-CPU noise, and overlap between a long arrival and live
+        # decodes is deterministic (step-indexed pacing), not a race
+        # against machine load.
+        "ratio": round(c_stall / m_stall, 4) if m_stall else None,
+        "bounded_by_chunk": bool(m_stall and c_stall < m_stall),
+    }
+    ok = (parity
+          and stall["bounded_by_chunk"]
+          and all(a["all_ok"] for a in arms.values())
+          and all(a["steady_retraces"] == 0 for a in arms.values())
+          and all(a["streamed_matches_results"] for a in arms.values()))
+    import paddle_tpu.observability as obs
+    return {
+        "schema": SCHEMA, "bench": "serving_load",
+        "backend": jax.default_backend(), "seed": seed,
+        "config": {**{k: v for k, v in cfg.items()},
+                   "ladder": list(cfg["ladder"]),
+                   "tenants": [list(t[:2]) + [list(t[2])] + list(t[3:])
+                               for t in tenants],
+                   "requests_per_tenant": per_tenant,
+                   "quick": bool(quick)},
+        "arms": arms,
+        "parity_bit_identical": parity,
+        "stall": stall,
+        "ok": bool(ok),
+        "telemetry": obs.snapshot(),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=None,
+                    help="bank the ledger JSON here "
+                         "(repo convention: SERVING_LOAD_r12.json)")
+    ap.add_argument("--per-tenant", type=int, default=16,
+                    help="requests per tenant")
+    ap.add_argument("--seed", type=int, default=712)
+    ap.add_argument("--quick", action="store_true",
+                    help="the small deterministic tier-1 slice")
+    args = ap.parse_args()
+
+    doc = bench(args.per_tenant, args.seed, quick=args.quick)
+    brief = {k: v for k, v in doc.items() if k != "telemetry"}
+    print(json.dumps(brief, indent=2, sort_keys=True))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+    return 0 if doc["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
