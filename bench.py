@@ -428,6 +428,10 @@ def _run_bench() -> dict:
         from paddle_tpu import observability as _obs
         if _obs.enabled():
             result["telemetry"] = _obs.registry().snapshot()
+            # memwatch section: per-program compiled memory + watermarks
+            # (the on-chip re-bank sprint captures memory for free;
+            # telemetry_dump --memory renders it back)
+            result["memory"] = _obs.memory.section()
     except Exception as e:  # best-effort extra signal
         result["telemetry_error"] = repr(e)[:200]
     return result

@@ -720,23 +720,15 @@ class PipelineTrainStep:
     def per_device_state_bytes(self) -> Dict[str, int]:
         """Analytic per-device bytes of the resident training state
         (params + optimizer slots + master weights), from the sharding
-        table — the HBM-fit check for a target topology."""
+        table — the HBM-fit check for a target topology. Accounting
+        (per-dim CEIL division, so non-divisible dims that pad up on
+        device never undercount) lives in the shared memwatch helper —
+        one code path with ``tools/memory_70b.py``."""
+        from ....observability.memory import sharded_param_bytes
 
         def shard_bytes(sds, sharding):
-            # per-dim ceil division: a dim not divisible by its mesh axes
-            # pads up on device, so flat total//prod would UNDERcount and
-            # let a topology pass the fit check yet OOM on hardware
-            n = 1
-            spec = sharding.spec
-            for i, dim in enumerate(sds.shape):
-                denom = 1
-                if i < len(spec) and spec[i] is not None:
-                    entry = spec[i]
-                    for name in ((entry,) if isinstance(entry, str)
-                                 else entry):
-                        denom *= self.mesh.shape[name]
-                n *= -(-dim // denom)
-            return n * jnp.dtype(sds.dtype).itemsize
+            return sharded_param_bytes(sds.shape, sds.dtype,
+                                       sharding.spec, self.mesh.shape)
 
         out = {"params": 0, "slots": 0, "master": 0}
         for k, v in self.params.items():

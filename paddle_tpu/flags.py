@@ -264,6 +264,18 @@ define_flag("telemetry", True,
             "of PROGRAM_FLAGS, so toggling it can never recompile a serving "
             "or train program. Off = instrumented code binds no-op stubs at "
             "construction time (zero registry lookups on hot paths).")
+define_flag("memwatch", True,
+            "Compiled-program memory capture (observability.memory): "
+            "every program admitted by the decode program cache and "
+            "every jitted TrainStep banks its XLA CompiledMemoryStats "
+            "(argument/output/temp/alias/code bytes) as "
+            "program_memory_bytes gauges + the memwatch program table. "
+            "Capture costs ONE duplicate lower()+compile() per "
+            "(re)trace — charged at the same moment r09's compile-time "
+            "histogram already bills — and nothing per steady-state "
+            "step. Rides the FLAGS_telemetry gate (telemetry off = "
+            "memwatch off). Eager-only by design, NOT in PROGRAM_FLAGS: "
+            "toggling never recompiles a serving or train program.")
 define_flag("telemetry_ring", 16384,
             "Span-tracer ring-buffer capacity in events; the oldest events "
             "drop first, so a long-lived server keeps a bounded, recent "

@@ -40,6 +40,12 @@ its full wall clock to a per-kind compile-time histogram — a retrace
 regression shows up with a COST attached, not just a count. The timing
 wrapper exists only when telemetry is on; off, ``get`` returns the bare
 compiled callable (zero added work per decode step).
+
+Memwatch (``FLAGS_memwatch``, riding the telemetry gate): the same
+wrapper banks every (re)traced program's ``CompiledMemoryStats`` into
+``program_memory_bytes{kind,bucket,extra,section}`` — each cached
+program carries a memory signature next to its compile-time counter
+(see ``paddle_tpu/observability/memory.py``).
 """
 
 from __future__ import annotations
@@ -104,6 +110,11 @@ class DecodeProgramCache:
         self.hits = 0
         self.misses = 0
         self._telemetry = obs.enabled()
+        # memwatch (FLAGS_memwatch, riding the telemetry gate): a
+        # dispatch that (re)traced additionally banks the program's
+        # CompiledMemoryStats — one duplicate lower+compile at exactly
+        # the moment the compile-seconds histogram already charges
+        self._memwatch = self._telemetry and obs.memory.enabled()
         if self._telemetry:
             r = obs.registry()
             self._m_hits = r.counter(
@@ -166,9 +177,15 @@ class DecodeProgramCache:
 
     def _timed_dispatch(self, key: DecodeKey, fn):
         """Wrap a compiled step so any dispatch that (re)traced is
-        charged its wall clock to the compile histogram. Steady-state
+        charged its wall clock to the compile histogram — and, with
+        memwatch on, banks the program's CompiledMemoryStats (an AOT
+        lower+compile over the SAME avals: donation only invalidates
+        buffers, avals survive, so this is safe post-dispatch and each
+        retrace re-captures with the args that caused it). Steady-state
         cost: one list read + two perf_counter calls per step (~100 ns
         against a ~ms decode step)."""
+        from .. import observability as obs
+
         with self._lock:
             cell = self._trace_cells.setdefault(key, [0])
         hist = self._m_compile.labels(kind=key.kind)
@@ -183,6 +200,10 @@ class DecodeProgramCache:
                 with self._lock:
                     self._compile_seconds[key] = (
                         self._compile_seconds.get(key, 0.0) + dt)
+                if self._memwatch:
+                    obs.memory.capture_program(
+                        key.kind, key.batch_bucket, key.extra,
+                        fn, args, kwargs, model=key.model_sig[:8])
             return out
 
         return dispatch

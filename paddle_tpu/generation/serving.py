@@ -174,6 +174,28 @@ class _EngineTelemetry:
             "bucket-ladder migrations (grow or shrink) — each rung's "
             "program compiles once, so steady state stops migrating "
             "or cycles between already-compiled rungs")
+        # ---- memwatch pool ledger (r13): step-end gauges over the
+        # PagedKVCache ledger, pre-resolved per state label
+        pages = r.gauge(
+            "kv_pool_pages",
+            "KV page-pool ledger by state: used (held by sequences or "
+            "the prefix cache), free, shared (refcount > 1), pinned "
+            "(prefix pages an in-flight request's block table holds)",
+            labels=("state",))
+        pbytes = r.gauge(
+            "kv_pool_bytes",
+            "KV page-pool ledger in bytes (all layers, k+v)",
+            labels=("state",))
+        self.pool_pages = {s: pages.labels(state=s)
+                           for s in ("used", "free", "shared", "pinned")}
+        self.pool_bytes = {s: pbytes.labels(state=s)
+                           for s in ("used", "free", "shared", "pinned")}
+        self.pool_frag = r.gauge(
+            "kv_pool_fragmentation",
+            "free-list fragmentation: 1 - largest contiguous free run "
+            "/ free pages (0 = clean; recomputed only when the free "
+            "list changed)")
+        self.counter_track = t.counter
 
 
 class _NullEngineTelemetry:
@@ -195,6 +217,12 @@ class _NullEngineTelemetry:
         self.recovery_seconds = self.page_pressure = obs.NULL
         self.prefill_chunk_s = self.decode_stall_s = obs.NULL
         self.bucket = self.migrations = obs.NULL
+        self.pool_pages = {s: obs.NULL
+                           for s in ("used", "free", "shared", "pinned")}
+        self.pool_bytes = {s: obs.NULL
+                           for s in ("used", "free", "shared", "pinned")}
+        self.pool_frag = obs.NULL
+        self.counter_track = obs.null_counter
 
 
 class _PrefixTelemetry:
@@ -497,6 +525,10 @@ class ServingEngine:
         # no-op stubs cost one method call per write when disabled)
         self._m = (_EngineTelemetry() if obs.enabled()
                    else _NullEngineTelemetry())
+        # pool-ledger fragmentation memo: recompute only when the pool's
+        # free-list epoch moved (steady-state decode never moves it)
+        self._pool_frag_epoch = -1
+        self._pool_frag = 0.0
         self._observe_bucket()
 
     # ------------------------------------------------------------ frontend
@@ -1111,6 +1143,7 @@ class ServingEngine:
         self.pool = PagedKVCache(**self._pool_geom)
         self._prefix = (PrefixCache(self.pool)
                         if self._prefix_enabled else None)
+        self._pool_frag_epoch = -1      # fresh pool: re-publish ledger
 
     def _rollback_admission(self, req: Request, slot: int) -> None:
         """Undo a partial admission (page exhaustion mid-``allocate``):
@@ -1460,12 +1493,45 @@ class ServingEngine:
             return
         m.queue_depth.set(len(self._queue))
         m.occupancy.set(self.max_batch - self._slots.count(None))
-        m.kv_pages_in_use.set(
-            self.pool.num_pages - 1 - self.pool.free_page_count())
         if not self._queue:
             m.page_pressure.set(0)      # an empty queue has no pressure
+        self._observe_pool_ledger()
+
+    def _observe_pool_ledger(self) -> None:
+        """memwatch pool ledger (r13): the PagedKVCache ledger as
+        step-end gauges plus one Perfetto counter sample, so memory
+        watermarks line up with the serving timeline. All O(1) reads;
+        fragmentation (a numpy sort over the free list) recomputes only
+        when the free-list epoch moved — steady-state decode steps
+        never touch the list and pay nothing for it."""
+        m = self._m
+        led = self.pool.ledger(fragmentation=False)
+        pinned = (self._prefix.pinned_page_count()
+                  if self._prefix is not None else 0)
+        # the r09 gauges read the same pool state: set them from the
+        # ledger rather than recomputing (serving pools always reserve
+        # the null page, so pages_in_use == num_pages - 1 - free)
+        m.kv_pages_in_use.set(led["pages_in_use"])
         if self._prefix is not None:
-            m.prefix_pinned.set(self._prefix.pinned_page_count())
+            m.prefix_pinned.set(pinned)
+        m.pool_pages["used"].set(led["pages_in_use"])
+        m.pool_pages["free"].set(led["pages_free"])
+        m.pool_pages["shared"].set(led["pages_shared"])
+        m.pool_pages["pinned"].set(pinned)
+        m.pool_bytes["used"].set(led["bytes_in_use"])
+        m.pool_bytes["free"].set(led["bytes_free"])
+        m.pool_bytes["shared"].set(
+            led["pages_shared"] * led["bytes_per_page"])
+        m.pool_bytes["pinned"].set(pinned * led["bytes_per_page"])
+        if led["epoch"] != self._pool_frag_epoch:
+            self._pool_frag_epoch = led["epoch"]
+            self._pool_frag = self.pool.free_list_fragmentation()
+            m.pool_frag.set(self._pool_frag)
+        m.counter_track(
+            "kv_pool", time.perf_counter(),
+            pages_in_use=led["pages_in_use"],
+            bytes_in_use=led["bytes_in_use"],
+            pages_shared=led["pages_shared"], pages_pinned=pinned)
 
     def _observe_page_pressure(self, short: int) -> None:
         """Admission is (or stopped being) page-blocked: publish how
@@ -1490,6 +1556,9 @@ class ServingEngine:
         if n_failed:
             m.requests_failed.inc(n_failed)
         m.recovery_seconds.observe(dt)
+        # the ledger must reflect the FRESH pool immediately (the step
+        # that died never reached its step-end refresh)
+        self._observe_pool_ledger()
 
     def _observe_evict_shortfall(self, short: int) -> None:
         """``evict()`` freed fewer pages than the admission asked for:

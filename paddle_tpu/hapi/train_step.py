@@ -139,6 +139,10 @@ class TrainStep:
         self._trace_count = 0    # step-fn retraces (probe-visible)
         self._m = (_TrainTelemetry() if obs.enabled()
                    else _NullTrainTelemetry())
+        # memwatch: bank the compiled step's CompiledMemoryStats when a
+        # dispatch (re)traced (construction-time binding, r09 idiom)
+        self._memwatch = obs.enabled() and obs.memory.enabled()
+        self._memwatch_model_sig = None   # computed on first capture
         # fault-injection sites (paddle_tpu.testing.faults): bound at
         # construction like telemetry — NULL stubs when disabled
         from ..testing import faults
@@ -574,13 +578,17 @@ class TrainStep:
             self._m.throttles.inc()
         # gauge AFTER the pull/throttle drains: it must read what is
         # actually still outstanding, not the pre-drain peak
-        self._observe_dispatch()
+        self._observe_dispatch(vals)
         return Tensor(loss, stop_gradient=True)
 
-    def _observe_dispatch(self) -> None:
+    def _observe_dispatch(self, vals=None) -> None:
         """Post-dispatch host-side telemetry: async-window depth and the
         retrace mirror (trace_count deltas observed HERE, on the host
-        side of the jit boundary — never inside the traced body)."""
+        side of the jit boundary — never inside the traced body). A
+        detected (re)trace additionally banks the step's
+        CompiledMemoryStats under memwatch — an AOT lower over the
+        post-donation state (``self.params`` already holds the returned
+        live arrays with identical avals)."""
         m = self._m
         if not m.enabled:
             return
@@ -588,6 +596,43 @@ class TrainStep:
         if self._trace_count != self._traces_seen:
             m.traces.inc(self._trace_count - self._traces_seen)
             self._traces_seen = self._trace_count
+            if self._memwatch and vals is not None:
+                self._observe_compiled_memory(vals)
+
+    def _observe_compiled_memory(self, vals) -> None:
+        """Bank the jitted step's memory sections (memwatch). One
+        duplicate lower+compile per (re)trace — steady state pays
+        nothing; failures count, never raise. The lr scalar is rebuilt
+        here (same aval as the dispatch's) rather than threaded through
+        from ``__call__``."""
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        try:
+            batch_dim = int(jax.tree.leaves(vals)[0].shape[0])
+        except Exception:
+            batch_dim = 0
+        if getattr(self, "_lsgd_count", None) is not None:
+            args = (self.params, self.opt_state, self._lsgd_count,
+                    lr, *vals)
+            extra = ("localsgd",)
+        elif self._merge is not None:
+            args = (self.params, self.opt_state, self._merge, lr, *vals)
+            extra = ("gradient_merge",)
+        else:
+            args = (self.params, self.opt_state, lr, *vals)
+            extra = ()
+        # model label = signature prefix, like the serving path: two
+        # differently-sized models of one class must not collide in the
+        # program table (class name alone would, last write winning)
+        sig = self._memwatch_model_sig
+        if sig is None:
+            from ..generation.program_cache import model_signature
+            try:
+                sig = model_signature(self.model)[:8]
+            except Exception:
+                sig = type(self.model).__name__
+            self._memwatch_model_sig = sig
+        obs.memory.capture_program("train_step", batch_dim, extra,
+                                   self._jit_step, args, model=sig)
 
     # -------------------------------------------------------- async metrics
     def pull_metrics(self, lag: Optional[int] = None) -> Optional[Dict[str, Any]]:

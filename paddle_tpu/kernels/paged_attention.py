@@ -327,6 +327,15 @@ class PagedKVCache:
         self.page_size = page_size
         self.num_pages = num_pages
         self.max_pages_per_seq = -(-max_seq_len // page_size)
+        self.reserved_null_page = bool(reserve_null_page)
+        # memwatch ledger bookkeeping, all O(1)-maintained (the r09
+        # pin-transition idiom): pages shared across >1 reference, and
+        # a free-list mutation epoch so fragmentation recomputes only
+        # when allocate/free actually changed the list
+        self._shared_pages = 0
+        self._free_epoch = 0
+        self.bytes_per_page = (num_layers * 2 * num_kv_heads * page_size
+                               * head_dim * jnp.dtype(dtype).itemsize)
         self.k_pages: List[jax.Array] = [
             jnp.zeros((num_kv_heads, num_pages, page_size, head_dim), dtype)
             for _ in range(num_layers)]
@@ -351,16 +360,62 @@ class PagedKVCache:
     def free_page_count(self) -> int:
         return len(self._free)
 
+    def ledger(self, fragmentation: bool = True) -> dict:
+        """The memwatch pool ledger: pages/bytes in use, free, and
+        shared (rc > 1, O(1)-maintained on ref transitions like the r09
+        pin counter — never a pool scan), plus free-list fragmentation
+        (1 - largest contiguous free run / free pages: 0 = one clean
+        run, ->1 = free capacity shredded into single pages; paged
+        attention itself is immune, but contiguity is what any future
+        defrag/compaction or contiguous-gather fast path would buy).
+        ``epoch`` increments on every free-list mutation so per-step
+        publishers skip the fragmentation recompute on steady-state
+        decode steps (which never touch the list)."""
+        usable = self.num_pages - (1 if self.reserved_null_page else 0)
+        free = len(self._free)
+        out = {
+            "usable_pages": usable,
+            "pages_in_use": usable - free,
+            "pages_free": free,
+            "pages_shared": self._shared_pages,
+            "bytes_per_page": self.bytes_per_page,
+            "bytes_in_use": (usable - free) * self.bytes_per_page,
+            "bytes_free": free * self.bytes_per_page,
+            "epoch": self._free_epoch,
+        }
+        if fragmentation:
+            out["fragmentation"] = self.free_list_fragmentation()
+        return out
+
+    def free_list_fragmentation(self) -> float:
+        """1 - (largest contiguous page-id run / free pages); 0.0 when
+        the free list is empty or one contiguous block. One numpy sort
+        over the free list — call on epoch change, not per step."""
+        n = len(self._free)
+        if n <= 1:
+            return 0.0
+        # host-only ledger probe over the python free list — never
+        # reachable from a traced body  # tracecheck: disable=TRC002
+        ids = np.sort(np.asarray(self._free, np.int64))
+        breaks = np.flatnonzero(np.diff(ids) != 1)
+        runs = np.diff(np.concatenate(([-1], breaks, [n - 1])))
+        return float(1.0 - int(runs.max()) / n)
+
     def ref_page(self, page_id: int) -> None:
         self._page_rc[page_id] += 1
+        if self._page_rc[page_id] == 2:     # 1 -> 2: became shared
+            self._shared_pages += 1
 
     def unref_page(self, page_id: int) -> bool:
         """Drop one reference; returns True when the page actually
         returned to the free list (last reference gone) so callers
         reclaiming capacity can count REAL frees, not unrefs."""
         self._page_rc[page_id] -= 1
+        if self._page_rc[page_id] == 1:     # 2 -> 1: stopped sharing
+            self._shared_pages -= 1
         if self._page_rc[page_id] == 0:
             self._free.append(int(page_id))
+            self._free_epoch += 1
             return True
         return False
 
@@ -394,6 +449,7 @@ class PagedKVCache:
                 # below, so an evict-and-retry caller cannot leak them
                 raise RuntimeError("page pool exhausted")
             pid = self._free.pop()
+            self._free_epoch += 1
             self.block_tables[seq_idx, i] = pid
             self._page_rc[pid] = 1
             self._pages_used[seq_idx] = i + 1

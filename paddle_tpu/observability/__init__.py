@@ -42,17 +42,19 @@ from __future__ import annotations
 from .metrics import (Counter, Gauge, Histogram, LATENCY_BUCKETS,
                       MetricsRegistry, NULL, exponential_buckets, registry,
                       series_quantile)
-from .tracing import (NULL_SPAN, Span, SpanTracer, null_event, null_span,
-                      tracer)
+from .tracing import (NULL_SPAN, Span, SpanTracer, null_counter, null_event,
+                      null_span, tracer)
 from .export import (chrome_trace, save_chrome_trace, save_snapshot,
                      to_prometheus)
+from . import memory
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "NULL",
     "LATENCY_BUCKETS", "exponential_buckets", "registry",
     "series_quantile", "Span", "SpanTracer", "NULL_SPAN", "tracer",
-    "null_span", "null_event", "chrome_trace", "save_chrome_trace",
-    "save_snapshot", "to_prometheus", "enabled", "span", "snapshot",
+    "null_span", "null_event", "null_counter", "chrome_trace",
+    "save_chrome_trace", "save_snapshot", "to_prometheus", "enabled",
+    "span", "snapshot", "memory",
 ]
 
 

@@ -1,0 +1,592 @@
+"""memwatch: the HBM/memory observatory — third pillar beside
+:mod:`metrics` and :mod:`tracing`.
+
+Answers "where does *memory* go" the way r09 answered "where does time
+go", with three instruments sharing one accounting vocabulary:
+
+  1. **Compiled-program capture** — every program admitted by the decode
+     program cache and every jitted ``TrainStep`` records its XLA
+     ``CompiledMemoryStats`` (argument / output / temp / alias /
+     generated-code bytes, plus the derived peak) into the registry as
+     ``program_memory_bytes{kind,bucket,extra,section}`` gauges and a
+     host-side row table (:func:`program_table`). Capture costs ONE
+     duplicate ``lower().compile()`` per (re)trace — XLA's buffer
+     assignment is the only source of truth for temp/peak, and this
+     jaxlib exposes no handle to the executable the jit dispatch itself
+     built. The cost lands exactly where r09's compile-seconds histogram
+     already charges retraces; ``FLAGS_memwatch=0`` drops it while
+     keeping the rest of telemetry.
+  2. **Live pool ledger** — the serving engine publishes its
+     :class:`~paddle_tpu.kernels.paged_attention.PagedKVCache` ledger
+     (pages/bytes used, free, shared, pinned; free-list fragmentation)
+     as step-end gauges plus a Perfetto counter track, and
+     :func:`sample_device_memory` banks backend watermarks
+     (``device.memory_stats()`` where the PJRT backend supports it;
+     host peak RSS always).
+  3. **Analytic estimator / what-if planner** — :func:`estimate_program`
+     and :func:`estimate_engine_memory` predict the same sections from
+     avals + pool geometry + model dims WITHOUT compiling, for
+     configurations too big to build locally ("does 7B int8 + page
+     budget P + rung 32 fit in 16 GB?"). Validated against
+     ``CompiledMemoryStats`` on tier-1-sized programs
+     (tests/test_memwatch.py asserts temp+output within 10%).
+
+Gating follows the r09 contract exactly: everything is host-side (the
+capture itself runs at trace time, never under trace), rides
+``FLAGS_telemetry`` (off = the null-stub binding, zero residue), and
+``FLAGS_memwatch`` additionally gates the duplicate-compile capture.
+Neither flag is in ``PROGRAM_FLAGS`` — toggling them never recompiles a
+serving or train program.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "enabled", "stats_from_compiled", "capture_jitted", "capture_program",
+    "record_program", "program_table", "clear_program_table",
+    "sample_device_memory", "section",
+    "estimate_program", "estimate_decode_program", "estimate_prefill_program",
+    "estimate_engine_memory", "fits", "sharded_param_bytes",
+    "compare_program_rows", "PoolGeometry", "ModelDims", "weight_bytes",
+    "aval_bytes", "MEMWATCH_SCHEMA",
+]
+
+MEMWATCH_SCHEMA = 1
+
+# the CompiledMemoryStats sections every surface (gauges, table rows,
+# banked artifacts, estimator output) agrees on
+SECTIONS = ("argument", "output", "temp", "alias", "generated_code", "peak")
+
+_TABLE: Dict[Tuple[str, str, int, str], Dict[str, Any]] = {}
+_TABLE_LOCK = threading.Lock()
+
+
+def enabled() -> bool:
+    """Memwatch capture gate: ``FLAGS_telemetry`` AND ``FLAGS_memwatch``.
+    Resolve at CONSTRUCTION time like every observability binding."""
+    from .. import flags
+    return bool(flags.get_flag("telemetry")) and \
+        bool(flags.get_flag("memwatch"))
+
+
+# --------------------------------------------------------------- capture
+def stats_from_compiled(compiled) -> Dict[str, int]:
+    """The section dict for one compiled executable (``jax.stages
+    .Compiled`` or anything exposing ``memory_analysis()``). ``peak`` is
+    derived: arguments + outputs - aliased (donation) + temp + code —
+    the resident HBM high-water of one dispatch."""
+    ma = compiled.memory_analysis() if hasattr(compiled, "memory_analysis") \
+        else compiled
+    out = {
+        "argument": int(ma.argument_size_in_bytes),
+        "output": int(ma.output_size_in_bytes),
+        "temp": int(ma.temp_size_in_bytes),
+        "alias": int(ma.alias_size_in_bytes),
+        "generated_code": int(ma.generated_code_size_in_bytes),
+    }
+    out["peak"] = (out["argument"] + out["output"] - out["alias"]
+                   + out["temp"] + out["generated_code"])
+    return out
+
+
+def capture_jitted(fn, args: Sequence[Any],
+                   kwargs: Optional[Dict[str, Any]] = None
+                   ) -> Optional[Dict[str, int]]:
+    """AOT lower+compile ``fn`` (a jitted callable) at ``args``' avals
+    and return the section dict, or None when the backend/lowering
+    refuses (abstract avals survive donation, so this works even after
+    the dispatch consumed the donated buffers)."""
+    try:
+        compiled = fn.lower(*args, **(kwargs or {})).compile()
+        return stats_from_compiled(compiled)
+    except Exception:
+        return None
+
+
+def record_program(kind: str, bucket: int, stats: Dict[str, int],
+                   extra: Any = (), model: str = "") -> None:
+    """Bank one program's section dict: registry gauges
+    ``program_memory_bytes{model,kind,bucket,extra,section}`` (last
+    write wins, the gauge contract) plus the host-side row table the
+    benches and the regression gate read. ``model`` disambiguates
+    same-shaped programs of different models sharing the process (the
+    program cache passes a model-signature prefix, TrainStep the model
+    class name)."""
+    from .metrics import registry
+    ex = _extra_str(extra)
+    fam = registry().gauge(
+        "program_memory_bytes",
+        "XLA CompiledMemoryStats of cached compiled programs, by "
+        "section (peak = argument + output - alias + temp + code)",
+        labels=("model", "kind", "bucket", "extra", "section"))
+    for sec in SECTIONS:
+        fam.labels(model=model, kind=kind, bucket=str(bucket), extra=ex,
+                   section=sec).set(float(stats.get(sec, 0)))
+    with _TABLE_LOCK:
+        row = _TABLE.setdefault((model, kind, int(bucket), ex), {
+            "model": model, "kind": kind, "bucket": int(bucket),
+            "extra": ex, "captures": 0})
+        row.update({sec: int(stats.get(sec, 0)) for sec in SECTIONS})
+        row["captures"] += 1
+
+
+def capture_program(kind: str, bucket: int, extra: Any, fn,
+                    args: Sequence[Any],
+                    kwargs: Optional[Dict[str, Any]] = None,
+                    model: str = "") -> bool:
+    """Capture + record one cached program (the program-cache /
+    TrainStep hook). Failures are counted, never raised — memory
+    accounting must not take down a dispatch that already succeeded."""
+    stats = capture_jitted(fn, args, kwargs)
+    if stats is None:
+        from .metrics import registry
+        registry().counter(
+            "memwatch_capture_failures",
+            "compiled-memory captures the backend refused",
+            labels=("kind",)).labels(kind=kind).inc()
+        return False
+    record_program(kind, bucket, stats, extra, model=model)
+    return True
+
+
+def program_table() -> List[Dict[str, Any]]:
+    """Every captured program's row (sorted, JSON-able) — the artifact
+    the benches embed and ``MEMWATCH_*.json`` banks."""
+    with _TABLE_LOCK:
+        rows = [dict(r) for r in _TABLE.values()]
+    return sorted(rows, key=lambda r: (r["model"], r["kind"], r["bucket"],
+                                       r["extra"]))
+
+
+def clear_program_table() -> None:
+    with _TABLE_LOCK:
+        _TABLE.clear()
+
+
+TABLE_COLUMNS = ("model", "kind", "bucket", "extra", "argument", "output",
+                 "temp", "alias", "peak")
+
+
+def format_program_table(rows: Sequence[Dict[str, Any]]) -> str:
+    """Render program rows as the fixed-width table every CLI view
+    shares (``tools/memwatch.py view``, ``tools/telemetry_dump.py
+    --memory``) — one renderer, like one accounting path."""
+    lines = ["  ".join(f"{h:>14s}" for h in TABLE_COLUMNS)]
+    for r in rows:
+        lines.append("  ".join(f"{str(r.get(h, '')):>14s}"
+                               for h in TABLE_COLUMNS))
+    return "\n".join(lines)
+
+
+def _extra_str(extra: Any) -> str:
+    if extra in ((), None, ""):
+        return ""
+    if isinstance(extra, (tuple, list)):
+        return ",".join(str(e) for e in extra)
+    return str(extra)
+
+
+# ---------------------------------------------------- device watermarks
+def sample_device_memory(publish: bool = True) -> Dict[str, Any]:
+    """Backend memory watermarks where the PJRT backend exposes them
+    (``device.memory_stats()`` — TPU/GPU report bytes_in_use /
+    peak_bytes_in_use / bytes_limit; CPU returns None), plus the host
+    process peak RSS. Publishes ``device_memory_bytes{device,stat}`` /
+    ``host_memory_bytes{stat}`` gauges when telemetry is on and returns
+    the raw JSON-able sample either way."""
+    out: Dict[str, Any] = {"devices": {}, "host": {}}
+    try:
+        import jax
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if stats:
+                out["devices"][str(d.id)] = {
+                    k: int(v) for k, v in stats.items()
+                    if isinstance(v, (int, float))}
+    except Exception:
+        pass
+    try:
+        import resource
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        # linux reports ru_maxrss in KiB; darwin reports bytes
+        scale = 1 if sys.platform == "darwin" else 1024
+        out["host"]["peak_rss"] = int(ru.ru_maxrss) * scale
+    except Exception:
+        pass
+    if publish:
+        from . import enabled as _telemetry_on
+        if _telemetry_on():
+            from .metrics import registry
+            r = registry()
+            if out["devices"]:
+                fam = r.gauge("device_memory_bytes",
+                              "PJRT device memory watermarks "
+                              "(device.memory_stats())",
+                              labels=("device", "stat"))
+                for dev, stats in out["devices"].items():
+                    for k, v in stats.items():
+                        fam.labels(device=dev, stat=k).set(float(v))
+            if out["host"]:
+                fam = r.gauge("host_memory_bytes",
+                              "host process memory watermarks",
+                              labels=("stat",))
+                for k, v in out["host"].items():
+                    fam.labels(stat=k).set(float(v))
+    return out
+
+
+def section() -> Dict[str, Any]:
+    """The ``"memory"`` section benches embed next to ``"telemetry"``:
+    the captured program table + device/host watermarks. (The live pool
+    ledger and the per-program gauges already ride the telemetry
+    snapshot itself.)"""
+    return {"schema": MEMWATCH_SCHEMA,
+            "programs": program_table(),
+            "watermarks": sample_device_memory()}
+
+
+# ------------------------------------------------------------ estimator
+# The analytic twin of stats_from_compiled: predict the same sections
+# from avals + geometry WITHOUT compiling. Exact for arguments/outputs/
+# alias (those are just the avals); temp is a calibrated working-set
+# model (XLA's buffer assignment reuses aggressively, so temp is a
+# max-live, not a sum of intermediates). Calibration constants below
+# were fit against CompiledMemoryStats on the tier-1 CPU programs and
+# are validated to the 10% temp+output bar in tests/test_memwatch.py.
+
+_DECODE_TEMP_K = 1.25     # decode: full working-set chain stays live-ish
+_PREFILL_TEMP_K = 1.0     # prefill/chunk: two largest stage buffers
+
+
+def aval_bytes(x) -> int:
+    """Bytes of one array-like / ShapeDtypeStruct / (shape, dtype)."""
+    if isinstance(x, tuple) and len(x) == 2:
+        shape, dtype = x
+    else:
+        shape, dtype = x.shape, x.dtype
+    return int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+
+
+def estimate_program(arg_avals: Sequence[Any], out_avals: Sequence[Any],
+                     donated: Sequence[int] = (),
+                     temp: int = 0, generated_code: int = 0
+                     ) -> Dict[str, int]:
+    """Generic donation-aware section estimate from flat aval lists:
+    ``donated`` indexes into ``arg_avals``; those bytes alias outputs
+    instead of doubling the peak."""
+    arg = sum(aval_bytes(a) for a in arg_avals)
+    out = sum(aval_bytes(a) for a in out_avals)
+    alias = sum(aval_bytes(arg_avals[i]) for i in donated)
+    est = {"argument": arg, "output": out, "temp": int(temp),
+           "alias": alias, "generated_code": int(generated_code)}
+    est["peak"] = arg + out - alias + est["temp"] + est["generated_code"]
+    return est
+
+
+class PoolGeometry:
+    """The KV pool shape vocabulary every estimate walks: mirrors
+    :class:`PagedKVCache`'s constructor args."""
+
+    __slots__ = ("num_layers", "num_pages", "page_size", "num_kv_heads",
+                 "head_dim", "max_pages_per_seq", "dtype")
+
+    def __init__(self, num_layers: int, num_pages: int, page_size: int,
+                 num_kv_heads: int, head_dim: int, max_pages_per_seq: int,
+                 dtype: Any = "float32"):
+        self.num_layers = int(num_layers)
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.num_kv_heads = int(num_kv_heads)
+        self.head_dim = int(head_dim)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        self.dtype = np.dtype(dtype) if not hasattr(dtype, "itemsize") \
+            else dtype
+
+    @classmethod
+    def of_pool(cls, pool) -> "PoolGeometry":
+        """Geometry of a live :class:`PagedKVCache`."""
+        k0 = pool.k_pages[0]
+        hkv, num_pages, page, d = k0.shape
+        return cls(len(pool.k_pages), num_pages, page, hkv, d,
+                   pool.max_pages_per_seq, k0.dtype)
+
+    def pool_bytes(self) -> int:
+        """Both pools, all layers — the donated/aliased block."""
+        return (self.num_layers * 2 * self.num_kv_heads * self.num_pages
+                * self.page_size * self.head_dim
+                * np.dtype(self.dtype).itemsize)
+
+    def tables_bytes(self, batch: int) -> int:
+        """block table + seq_lens for one dispatch (int32)."""
+        return batch * (self.max_pages_per_seq + 1) * 4
+
+    @property
+    def max_seq(self) -> int:
+        return self.max_pages_per_seq * self.page_size
+
+
+class ModelDims:
+    """The model dims the temp model needs — constructable from any
+    config exposing the Llama/GPT field names, or from explicit kwargs
+    (the planner's too-big-to-build path)."""
+
+    __slots__ = ("hidden", "layers", "heads", "kv_heads", "intermediate",
+                 "vocab", "param_count")
+
+    def __init__(self, hidden: int, layers: int, heads: int,
+                 kv_heads: Optional[int], intermediate: int, vocab: int,
+                 param_count: Optional[int] = None):
+        self.hidden = int(hidden)
+        self.layers = int(layers)
+        self.heads = int(heads)
+        self.kv_heads = int(kv_heads if kv_heads else heads)
+        self.intermediate = int(intermediate)
+        self.vocab = int(vocab)
+        self.param_count = param_count
+
+    @classmethod
+    def of_config(cls, cfg) -> "ModelDims":
+        inter = getattr(cfg, "intermediate_size", None)
+        if inter is None:                      # GPT publishes a 4x MLP
+            inter = 4 * cfg.hidden_size
+        n = cfg.num_params() if hasattr(cfg, "num_params") else None
+        return cls(cfg.hidden_size, cfg.num_hidden_layers,
+                   cfg.num_attention_heads,
+                   getattr(cfg, "num_key_value_heads", None),
+                   inter, cfg.vocab_size, n)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+    @property
+    def kv_dim(self) -> int:
+        return self.kv_heads * self.head_dim
+
+
+def _decode_temp(dims: ModelDims, geom: PoolGeometry, batch: int) -> int:
+    """Decode-step temp model: per-row working set of one layer chain
+    (x/qkv round-trips, attention scores over the gathered width, FFN)
+    summed over layers, plus the logits row — all f32 (kernels
+    accumulate in f32), scaled by the calibrated live-set factor."""
+    per_layer = (4 * dims.hidden            # x, q, attn-out, residual
+                 + 2 * dims.kv_dim          # k, v new-token rows
+                 + dims.heads * geom.max_seq   # attention scores
+                 + 2 * dims.intermediate)   # gate/up FFN halves
+    elems = batch * (dims.layers * per_layer + dims.vocab)
+    return int(_DECODE_TEMP_K * elems * 4)
+
+
+def _prefill_temp(dims: ModelDims, geom: PoolGeometry, s: int) -> int:
+    """Prefill/chunk temp model (b=1, S query tokens): XLA's buffer
+    reuse keeps roughly the two largest stage buffers live at the
+    worst program point — scores, the gathered KV view, the FFN
+    intermediate, the logits block, or the QKV block."""
+    stages = [
+        dims.heads * s * geom.max_seq,      # attention scores
+        2 * geom.max_seq * dims.kv_dim,     # gathered k+v view
+        2 * s * dims.intermediate,          # gate/up FFN halves
+        s * dims.vocab,                     # logits
+        s * 4 * dims.hidden,                # q/k/v/x block
+    ]
+    top2 = sum(sorted(stages)[-2:])
+    return int(_PREFILL_TEMP_K * top2 * 4)
+
+
+def estimate_decode_program(dims: ModelDims, geom: PoolGeometry,
+                            batch: int, param_bytes: int) -> Dict[str, int]:
+    """Predicted sections of one decode-step program (fused or generic —
+    the calibrated model covers both): params + pools + tables in,
+    donated pools + token ids out."""
+    pool = geom.pool_bytes()
+    tables = geom.tables_bytes(batch)
+    arg = param_bytes + pool + tables + batch * 4         # toks (B,1)
+    out = pool + tables + batch * 4                       # argmax ids
+    return {
+        "argument": arg, "output": out,
+        "temp": _decode_temp(dims, geom, batch),
+        "alias": pool, "generated_code": 0,
+        "peak": arg + out - pool + _decode_temp(dims, geom, batch),
+    }
+
+
+def estimate_prefill_program(dims: ModelDims, geom: PoolGeometry,
+                             s: int, param_bytes: int) -> Dict[str, int]:
+    """Predicted sections of a b=1 prefill (monolithic length ``s``) or
+    chunked-prefill (``s`` = chunk) program."""
+    pool = geom.pool_bytes()
+    tables = geom.tables_bytes(1)
+    arg = param_bytes + pool + tables + s * 4             # ids (1, S)
+    out = pool + tables + 4                               # argmax id
+    temp = _prefill_temp(dims, geom, s)
+    return {"argument": arg, "output": out, "temp": temp,
+            "alias": pool, "generated_code": 0,
+            "peak": arg + out - pool + temp}
+
+
+# ------------------------------------------------------ what-if planner
+_WEIGHT_BYTES = {"float32": 4.0, "f32": 4.0, "bfloat16": 2.0, "bf16": 2.0,
+                 "float16": 2.0, "int8": 1.0, "int4": 0.5}
+
+
+def weight_bytes(param_count: int, dtype: str,
+                 scale_group: int = 128) -> int:
+    """Model weight bytes for a storage dtype. Quantized dtypes carry
+    per-group f32 scales (``scale_group`` weights per scale — the
+    streaming-int8 path stores per-channel scales, which this bounds)."""
+    per = _WEIGHT_BYTES[str(dtype)]
+    total = param_count * per
+    if per < 2.0:                       # quantized: add the scales
+        total += param_count / scale_group * 4
+    return int(total)
+
+
+def estimate_engine_memory(dims: ModelDims, *,
+                           page_size: int = 64,
+                           page_budget: Optional[int] = None,
+                           max_batch: int = 8,
+                           max_seq_len: int = 1024,
+                           chunk: int = 0,
+                           weight_dtype: str = "bfloat16",
+                           kv_dtype: str = "bfloat16",
+                           param_count: Optional[int] = None
+                           ) -> Dict[str, Any]:
+    """The what-if planner: predicted steady-state serving HBM for a
+    configuration that may be too big to compile locally. Returns the
+    transparent breakdown ``tools/memwatch.py plan`` renders; compare
+    ``total`` against the chip's HBM. ``page_budget`` = USABLE pages
+    (the FLAGS_serving_page_budget contract: +1 null page rides on
+    top); None = the worst-case formula."""
+    n_params = param_count or dims.param_count
+    if n_params is None:
+        raise ValueError("need param_count (config.num_params() or "
+                         "explicit)")
+    pages_per_seq = -(-max_seq_len // page_size)
+    usable = (int(page_budget) if page_budget
+              else max_batch * pages_per_seq)
+    geom = PoolGeometry(dims.layers, usable + 1, page_size, dims.kv_heads,
+                        dims.head_dim, pages_per_seq, np.dtype(
+                            "int8" if str(kv_dtype) == "int8"
+                            else "float16"))  # 2B stand-in for bf16
+    if str(kv_dtype) in ("bfloat16", "bf16", "float16"):
+        kv_item = 2
+    elif str(kv_dtype) == "int8":
+        kv_item = 1
+    else:
+        kv_item = np.dtype(kv_dtype).itemsize
+    pool = (dims.layers * 2 * dims.kv_heads * (usable + 1) * page_size
+            * dims.head_dim * kv_item)
+    if str(kv_dtype) == "int8":
+        # per-page f32 scales stored alongside the pool (k and v)
+        pool += dims.layers * 2 * dims.kv_heads * (usable + 1) * 4
+    weights = weight_bytes(n_params, weight_dtype)
+    decode_tmp = _decode_temp(dims, geom, max_batch)
+    chunk_tmp = _prefill_temp(dims, geom, chunk) if chunk else 0
+    tables = geom.tables_bytes(max_batch)
+    # XLA program text + runtime allocations scale with model size; a
+    # visible margin line, not silent slack
+    margin = max(64 << 20, int(0.05 * weights))
+    workspace = max(decode_tmp, chunk_tmp)
+    total = weights + pool + workspace + tables + margin
+    return {
+        "dims": {"hidden": dims.hidden, "layers": dims.layers,
+                 "heads": dims.heads, "kv_heads": dims.kv_heads,
+                 "intermediate": dims.intermediate, "vocab": dims.vocab,
+                 "params": int(n_params)},
+        "config": {"page_size": page_size, "usable_pages": usable,
+                   "max_batch": max_batch, "max_seq_len": max_seq_len,
+                   "chunk": chunk, "weight_dtype": str(weight_dtype),
+                   "kv_dtype": str(kv_dtype)},
+        "breakdown": {
+            "weights": weights, "kv_pool": pool,
+            "decode_workspace": decode_tmp,
+            "chunk_prefill_workspace": chunk_tmp,
+            "block_tables": tables,
+            "xla_code_and_runtime_margin": margin,
+        },
+        "total": int(total),
+    }
+
+
+def fits(plan: Dict[str, Any], hbm_bytes: int) -> Dict[str, Any]:
+    """Verdict + headroom for one planner breakdown against a chip."""
+    total = plan["total"]
+    return {"hbm_bytes": int(hbm_bytes), "total": int(total),
+            "fits": total <= hbm_bytes,
+            "headroom_bytes": int(hbm_bytes - total)}
+
+
+# --------------------------------------------- sharded-state accounting
+def sharded_param_bytes(shape: Sequence[int], dtype: Any, spec,
+                        mesh_shape: Dict[str, int]) -> int:
+    """Per-device bytes of one sharded array: per-dim CEIL division (a
+    dim not divisible by its mesh axes pads up on device, so flat
+    ``total // prod`` would undercount and let a topology pass the fit
+    check yet OOM on hardware). The one shard-accounting code path —
+    ``PipelineTrainStep.per_device_state_bytes`` and
+    ``tools/memory_70b.py`` both call through here."""
+    n = 1
+    entries = tuple(spec) if spec is not None else ()
+    for i, dim in enumerate(shape):
+        denom = 1
+        if i < len(entries) and entries[i] is not None:
+            entry = entries[i]
+            for name in ((entry,) if isinstance(entry, str) else entry):
+                denom *= int(mesh_shape[name])
+        n *= -(-int(dim) // denom)
+    return n * np.dtype(dtype).itemsize
+
+
+# -------------------------------------------------------- regression gate
+def compare_program_rows(banked: List[Dict[str, Any]],
+                         current: List[Dict[str, Any]],
+                         tolerance: float = 0.10) -> List[Dict[str, Any]]:
+    """The memory analogue of the zero-retrace gate: flag every program
+    whose ``temp`` or ``peak`` grew beyond ``tolerance`` vs the banked
+    artifact. Programs only in one table are reported informationally
+    (``"missing"``/``"new"``) and do not fail the gate — a config drift
+    shows up as growth on the programs both runs share."""
+    key = lambda r: (r.get("model", ""), r["kind"], r["bucket"],
+                     r.get("extra", ""))
+    cur = {key(r): r for r in current}
+    findings: List[Dict[str, Any]] = []
+    seen = set()
+    for row in banked:
+        k = key(row)
+        seen.add(k)
+        now = cur.get(k)
+        if now is None:
+            findings.append({"model": row.get("model", ""),
+                             "kind": row["kind"], "bucket": row["bucket"],
+                             "extra": row.get("extra", ""),
+                             "verdict": "missing"})
+            continue
+        for sec in ("temp", "peak"):
+            old_v, new_v = int(row.get(sec, 0)), int(now.get(sec, 0))
+            # a zero banked value is NOT a free pass: byte sizes are
+            # deterministic per backend, so 0 -> anything is real growth
+            if new_v > old_v * (1.0 + tolerance) and new_v > old_v:
+                findings.append({
+                    "model": row.get("model", ""),
+                    "kind": row["kind"], "bucket": row["bucket"],
+                    "extra": row.get("extra", ""), "section": sec,
+                    "banked": old_v, "current": new_v,
+                    "growth": (round(new_v / old_v - 1.0, 4)
+                               if old_v else None),
+                    "verdict": "grew"})
+    for k, row in cur.items():
+        if k not in seen:
+            findings.append({"model": row.get("model", ""),
+                             "kind": row["kind"], "bucket": row["bucket"],
+                             "extra": row.get("extra", ""),
+                             "verdict": "new"})
+    return findings

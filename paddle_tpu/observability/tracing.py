@@ -29,7 +29,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 __all__ = ["SpanTracer", "Span", "NULL_SPAN", "tracer", "null_span",
-           "null_event"]
+           "null_event", "null_counter"]
 
 try:                                    # the annotation is optional:
     import jax                          # pure-host tools can trace spans
@@ -125,6 +125,10 @@ def null_event(name: str, t0: float, t1: float, **args) -> None:
     return None
 
 
+def null_counter(name: str, t: float, **values) -> None:
+    return None
+
+
 class SpanTracer:
     """Bounded ring buffer of complete events (Chrome-trace ``"X"``
     phase). Appends are deque ops under the GIL — no lock on the record
@@ -146,6 +150,18 @@ class SpanTracer:
         begin/end stamps (request lifecycle phases whose boundaries were
         observed before the phase name was known)."""
         self._append(name, t0, t1, args)
+
+    def counter(self, name: str, t: float, **values) -> None:
+        """Perfetto counter sample (Chrome-trace ``"C"`` phase): each
+        key of ``values`` renders as its own counter track aligned with
+        the span timeline — how pool bytes/pages-in-use line up against
+        the serving steps in one view. One deque append, like spans."""
+        self._events.append({
+            "name": name, "ph": "C",
+            "ts": t * 1e6,
+            "pid": self._pid, "tid": threading.get_ident(),
+            "args": {k: float(v) for k, v in values.items()},
+        })
 
     def _append(self, name, t0, t1, args) -> None:
         self._events.append({

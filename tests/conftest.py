@@ -49,6 +49,14 @@ jax.config.update("jax_default_matmul_precision", "highest")
 # toolchain moves to a jax that refuses to cache callback programs.
 
 
+# Memwatch capture (FLAGS_memwatch) costs one duplicate lower+compile
+# per (re)traced program — across a suite that builds hundreds of tiny
+# programs that is real wall clock for zero coverage gain, so tier-1
+# runs with it off by default (the production default stays ON).
+# tests/test_memwatch.py arms it explicitly around its capture tests.
+os.environ.setdefault("FLAGS_memwatch", "0")
+
+
 @pytest.fixture(autouse=True)
 def _seed_all():
     import paddle_tpu as paddle

@@ -9,19 +9,10 @@ paddle_tpu. Run: python tools/api_coverage.py
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import toolenv  # noqa: E402
 
-try:
-    from jax._src import xla_bridge as _xb
-    for _name in list(_xb._backend_factories):
-        if _name != "cpu":
-            _xb._backend_factories.pop(_name, None)
-    _xb._platform_aliases.setdefault("tpu", "tpu")
-except Exception:
-    pass
-import jax
-jax.config.update("jax_platforms", "cpu")
+toolenv.force_cpu()
 
 # ---------------------------------------------------------------- manifest
 # module path (under paddle.*) -> public names (curated from the upstream

@@ -128,6 +128,7 @@ def main() -> int:
     emit({
         "metric": "fused_decode_step_ms",
         "telemetry": telemetry,
+        "memory": obs.memory.section() if obs.enabled() else None,
         "value": fused["step_ms"],
         "unit": "ms_per_step",
         "vs_baseline": speedup,

@@ -7,22 +7,13 @@ resident-state accounting + lowering evidence. Run under the test env:
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import toolenv  # noqa: E402
 
 
 def main():
+    toolenv.force_cpu()
     import jax
-    try:  # keep the axon tunnel plugin from hijacking the cpu run
-        from jax._src import xla_bridge as _xb
-        for _name in list(_xb._backend_factories):
-            if _name != "cpu":
-                _xb._backend_factories.pop(_name, None)
-        _xb._platform_aliases.setdefault("tpu", "tpu")
-    except Exception:
-        pass
-    jax.config.update("jax_platforms", "cpu")
-
     import numpy as np
     import jax.numpy as jnp
     from paddle_tpu.jax_compat import abstract_mesh
@@ -46,11 +37,20 @@ def main():
                              sharding_axis="dp", abstract=True,
                              param_dtype=jnp.bfloat16)
 
+    # per-device resident state via the shared memwatch shard
+    # accounting (observability/memory.sharded_param_bytes)
     by = step.per_device_state_bytes()
     b, s = 16, 4096
-    lowered = step.lower(jax.ShapeDtypeStruct((b, s), jnp.int32),
-                         jax.ShapeDtypeStruct((b, s), jnp.int32))
-    text = lowered.as_text()
+    from paddle_tpu.jax_compat import abstract_mesh_can_lower
+    if abstract_mesh_can_lower():
+        lowered = step.lower(jax.ShapeDtypeStruct((b, s), jnp.int32),
+                             jax.ShapeDtypeStruct((b, s), jnp.int32))
+        text = lowered.as_text()
+    else:
+        # same version gate as tests/test_llama70b.py: this jax cannot
+        # lower an AbstractMesh program; the sharding-table accounting
+        # above is jax-version-independent and still banks
+        text = ""
 
     rows = []
     for k in sorted(step.params):
@@ -85,18 +85,24 @@ def main():
                f"14 bytes/param state would be {14*n_params/128/1e9:.2f} "
                "GB/device.\n")
     out.append("## Lowering evidence\n")
-    n_cp = text.count("collective_permute")
-    out.append(f"- StableHLO module: {len(text):,} chars, "
-               f"mesh `{'dp=2, pp=8, mp=8'}`, "
-               f"`num_partitions = 128` present: "
-               f"{'num_partitions = 128' in text}")
-    out.append(f"- sharding annotations: sdy={'sdy.sharding' in text}, "
-               f"collective_permute sites: {n_cp} (0 is expected pre-"
-               "partitioning: shardy lowers sharding as `sdy` annotations "
-               "and XLA inserts the pp-ring collective-permutes during "
-               "SPMD propagation at compile time)")
-    out.append(f"- while/scan loops: {text.count('stablehlo.while')}, "
-               f"dots: {text.count('stablehlo.dot')}")
+    if not text:
+        out.append("- SKIPPED on this jax: AbstractMesh lowering is "
+                   "version-gated (paddle_tpu.jax_compat."
+                   "abstract_mesh_can_lower() is False on 0.4.x) — "
+                   "re-run on jax >= 0.6 to regenerate this section.")
+    else:
+        n_cp = text.count("collective_permute")
+        out.append(f"- StableHLO module: {len(text):,} chars, "
+                   f"mesh `{'dp=2, pp=8, mp=8'}`, "
+                   f"`num_partitions = 128` present: "
+                   f"{'num_partitions = 128' in text}")
+        out.append(f"- sharding annotations: sdy={'sdy.sharding' in text}, "
+                   f"collective_permute sites: {n_cp} (0 is expected pre-"
+                   "partitioning: shardy lowers sharding as `sdy` "
+                   "annotations and XLA inserts the pp-ring collective-"
+                   "permutes during SPMD propagation at compile time)")
+        out.append(f"- while/scan loops: {text.count('stablehlo.while')}, "
+                   f"dots: {text.count('stablehlo.dot')}")
     out.append("")
     out.append("## Sharding table (param -> (shape, dtype, param spec, "
                "opt-state spec))\n")

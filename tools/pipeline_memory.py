@@ -16,28 +16,15 @@ same machinery either way.
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import toolenv  # noqa: E402
+
+toolenv.force_cpu(devices=8)
 
 import numpy as np  # noqa: E402
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
-
-# drop non-cpu PJRT factories (the ambient TPU-tunnel plugin can hang) —
-# same trick as tests/conftest.py
-try:
-    from jax._src import xla_bridge as _xb
-    for _name in list(_xb._backend_factories):
-        if _name != "cpu":
-            _xb._backend_factories.pop(_name, None)
-    _xb._platform_aliases.setdefault("tpu", "tpu")
-except Exception:
-    pass
-jax.config.update("jax_platforms", "cpu")
 
 
 def measure(M, remat, V=1, n_layers=8, hidden=128, seq=128, vocab=128):
@@ -63,8 +50,9 @@ def measure(M, remat, V=1, n_layers=8, hidden=128, seq=128, vocab=128):
     lr = jnp.asarray(1e-3, jnp.float32)
     compiled = step._jit_step.lower(
         step.params, step.opt_state, lr, x, y).compile()
-    ma = compiled.memory_analysis()
-    return ma.temp_size_in_bytes
+    # the one accounting code path: memwatch's section extraction
+    from paddle_tpu.observability import memory as memwatch
+    return memwatch.stats_from_compiled(compiled)["temp"]
 
 
 def measure_zbh1(M, n_layers=8, hidden=128, seq=128, vocab=128,
@@ -95,7 +83,8 @@ def measure_zbh1(M, n_layers=8, hidden=128, seq=128, vocab=128,
     lr = jnp.asarray(1e-3, jnp.float32)
     compiled = step._jit_step.lower(
         step.params, step.opt_state, lr, x, y).compile()
-    temp = compiled.memory_analysis().temp_size_in_bytes
+    from paddle_tpu.observability import memory as memwatch
+    temp = memwatch.stats_from_compiled(compiled)["temp"]
     try:
         flops = float(compiled.cost_analysis().get("flops", 0.0))
     except Exception:

@@ -8,25 +8,14 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import toolenv  # noqa: E402
+
+toolenv.force_cpu(devices=8)
 
 import numpy as np  # noqa: E402
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
-
-try:
-    from jax._src import xla_bridge as _xb
-    for _name in list(_xb._backend_factories):
-        if _name != "cpu":
-            _xb._backend_factories.pop(_name, None)
-    _xb._platform_aliases.setdefault("tpu", "tpu")
-except Exception:
-    pass
-jax.config.update("jax_platforms", "cpu")
 
 
 def comm_table():

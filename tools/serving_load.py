@@ -294,6 +294,9 @@ def bench(per_tenant, seed, quick=False):
         "stall": stall,
         "ok": bool(ok),
         "telemetry": obs.snapshot(),
+        # memwatch: the chunk/ladder programs' compiled-memory rows ride
+        # the banked artifact (telemetry_dump --memory renders them)
+        "memory": obs.memory.section() if obs.enabled() else None,
     }
 
 

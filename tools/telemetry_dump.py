@@ -7,7 +7,9 @@ Three modes:
      artifact that embeds one under a ``"telemetry"`` key (top-level or
      inside a ``"results"`` row) — and render it as a human table,
      ``--json``, or ``--prom`` (Prometheus text exposition format).
-     Histograms get derived p50/p90/p99 columns.
+     Histograms get derived p50/p90/p99 columns. ``--memory`` renders
+     the memwatch view instead: the per-program CompiledMemoryStats
+     table, the KV pool ledger gauges, and device/host watermarks.
 
          python tools/telemetry_dump.py FUSED_DECODE_BENCH_r06.json
          python tools/telemetry_dump.py snap.json --prom
@@ -46,6 +48,88 @@ def extract_snapshot(doc: dict):
             return row["telemetry"]
     raise SystemExit("no metrics snapshot found (expected a "
                      "snapshot dict or an artifact with a 'telemetry' key)")
+
+
+def extract_memory(doc: dict):
+    """An artifact's ``"memory"`` section from any of the accepted
+    shapes (same contract as extract_snapshot: top-level or inside a
+    ``"results"`` row), or None."""
+    if not isinstance(doc, dict):
+        return None
+    if isinstance(doc.get("memory"), dict):
+        return doc["memory"]
+    for row in doc.get("results", []):
+        if isinstance(row, dict) and isinstance(row.get("memory"), dict):
+            return row["memory"]
+    return None
+
+
+def render_memory(snap: dict, doc: dict = None) -> str:
+    """The --memory view: per-program compiled-memory table (pivoted
+    from the program_memory_bytes gauges, or an artifact's explicit
+    "memory" section) + the KV pool ledger + device/host watermarks."""
+    lines = []
+    mets = snap.get("metrics", {})
+    mem = extract_memory(doc) if doc else None
+    # ---- per-program table: prefer an artifact's banked rows, else
+    # pivot the gauge series back into rows
+    rows = []
+    if mem:
+        rows = mem.get("programs", [])
+    if not rows:
+        by_key = {}
+        fam = mets.get("program_memory_bytes", {"series": []})
+        for s in fam["series"]:
+            lbl = s["labels"]
+            key = (lbl.get("model", ""), lbl["kind"], lbl["bucket"],
+                   lbl.get("extra", ""))
+            row = by_key.setdefault(key, {
+                "model": key[0], "kind": key[1], "bucket": key[2],
+                "extra": key[3]})
+            row[lbl["section"]] = int(s["value"])
+        rows = [by_key[k] for k in sorted(by_key)]
+    if rows:
+        from paddle_tpu.observability.memory import format_program_table
+
+        lines.append("# program memory (CompiledMemoryStats, bytes)")
+        lines.append(format_program_table(rows))
+    else:
+        lines.append("# no program memory rows (FLAGS_memwatch off, or "
+                     "nothing compiled)")
+    # ---- pool ledger gauges
+    led = []
+    for name in ("kv_pool_pages", "kv_pool_bytes"):
+        for s in mets.get(name, {"series": []})["series"]:
+            led.append(f"  {name}{{state={s['labels']['state']}}} "
+                       f"= {s['value']:g}")
+    for name in ("kv_pool_fragmentation", "serving_kv_pages_in_use",
+                 "serving_prefix_pinned_pages"):
+        for s in mets.get(name, {"series": []})["series"]:
+            led.append(f"  {name} = {s['value']:g}")
+    if led:
+        lines.append("# kv pool ledger")
+        lines.extend(led)
+    # ---- watermarks: live gauges when present; banked artifacts carry
+    # them under memory.watermarks instead (benches snapshot telemetry
+    # BEFORE obs.memory.section() publishes the gauges)
+    wm = []
+    for name in ("device_memory_bytes", "host_memory_bytes"):
+        for s in mets.get(name, {"series": []})["series"]:
+            lbl = ",".join(f"{k}={v}" for k, v in
+                           sorted(s["labels"].items()))
+            wm.append(f"  {name}{{{lbl}}} = {s['value']:g}")
+    if not wm and mem and isinstance(mem.get("watermarks"), dict):
+        banked_wm = mem["watermarks"]
+        for dev, stats in sorted(banked_wm.get("devices", {}).items()):
+            for k, v in sorted(stats.items()):
+                wm.append(f"  device_memory_bytes{{device={dev},"
+                          f"stat={k}}} = {v:g}")
+        for k, v in sorted(banked_wm.get("host", {}).items()):
+            wm.append(f"  host_memory_bytes{{stat={k}}} = {v:g}")
+    if wm:
+        lines.append("# watermarks")
+        lines.extend(wm)
+    return "\n".join(lines)
 
 
 def render_table(snap: dict) -> str:
@@ -95,8 +179,8 @@ def run_demo(n_requests: int, tokens: int, trace_path, overhead: bool):
     def mixed_load():
         """The snapshot/timeline workload: staggered lengths + prefix
         cache, telemetry on."""
-        flags.set_flags({"telemetry": True})
-        clear_decode_program_cache()     # rebind cache telemetry
+        flags.set_flags({"telemetry": True, "memwatch": True})
+        clear_decode_program_cache()     # rebind cache telemetry+memwatch
         eng = ServingEngine(model, max_batch=4, page_size=8,
                             max_seq_len=max_seq, prefix_cache=True)
         for p in prompts:
@@ -122,7 +206,7 @@ def run_demo(n_requests: int, tokens: int, trace_path, overhead: bool):
             out[which].append((time.perf_counter() - t0) * 1e3)
             i += 1
 
-    prior = flags.get_flag("telemetry")
+    prior = flags.snapshot(("telemetry", "memwatch")).as_tuple()
     try:
         retraces = mixed_load()
         snap = obs.registry().snapshot()
@@ -166,7 +250,7 @@ def run_demo(n_requests: int, tokens: int, trace_path, overhead: bool):
                               if off else None))
         print(json.dumps(result), file=sys.stderr)
     finally:
-        flags.set_flags({"telemetry": prior})
+        flags.set_flags(dict(prior))
         clear_decode_program_cache()
     return snap
 
@@ -179,6 +263,9 @@ def main() -> int:
                     help="emit the snapshot as JSON")
     ap.add_argument("--prom", action="store_true",
                     help="emit Prometheus text exposition format")
+    ap.add_argument("--memory", action="store_true",
+                    help="memwatch view: per-program compiled-memory "
+                    "table + KV pool ledger + watermarks")
     ap.add_argument("--demo", action="store_true",
                     help="run a tiny in-process ServingEngine load and "
                     "dump ITS telemetry")
@@ -190,6 +277,7 @@ def main() -> int:
     ap.add_argument("--tokens", type=int, default=16)
     args = ap.parse_args()
 
+    doc = None
     if args.demo:
         snap = run_demo(args.requests, args.tokens, args.trace,
                         args.overhead)
@@ -207,6 +295,8 @@ def main() -> int:
     elif args.as_json:
         json.dump(snap, sys.stdout, indent=1)
         sys.stdout.write("\n")
+    elif args.memory:
+        print(render_memory(snap, doc))
     else:
         print(render_table(snap))
     return 0

@@ -1,0 +1,55 @@
+"""Shared CPU-run preamble for the repo's standalone tools.
+
+Every bench/report script used to copy-paste the same block: put the
+repo root on ``sys.path``, pin ``JAX_PLATFORMS=cpu`` before jax import,
+drop the ambient TPU-tunnel PJRT plugin from the factory registry (its
+backend discovery can hang when the tunnel is down), and keep ``tpu`` a
+KNOWN platform name so pallas/checkify lowering registration validates.
+This module is the one copy (same trick as tests/conftest.py).
+
+Usage, FIRST thing in a tool (the script's own directory is on
+``sys.path`` when run as ``python tools/<name>.py``)::
+
+    import toolenv
+    toolenv.force_cpu()            # or force_cpu(devices=8)
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def repo_root() -> str:
+    return REPO
+
+
+def force_cpu(devices: int = 0) -> None:
+    """Pin this process to the CPU backend (``devices`` > 0 additionally
+    forces an N-device simulated host platform) and scrub non-CPU PJRT
+    factories. Idempotent; safe whether or not jax was imported yet."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if devices:
+        xla_flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xla_flags:
+            os.environ["XLA_FLAGS"] = (
+                xla_flags
+                + f" --xla_force_host_platform_device_count={devices}"
+            ).strip()
+    # the axon tunnel plugin must not hijack (or hang) a CPU run
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+    import jax
+    try:
+        from jax._src import xla_bridge as _xb
+        for _name in list(_xb._backend_factories):
+            if _name != "cpu":
+                _xb._backend_factories.pop(_name, None)
+        _xb._platform_aliases.setdefault("tpu", "tpu")
+    except Exception:
+        pass
+    # the ambient env may have imported jax already with a TPU platform
+    # pinned — override the live config, not just the env
+    jax.config.update("jax_platforms", "cpu")
