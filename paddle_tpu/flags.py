@@ -286,8 +286,9 @@ define_flag("fault_inject", "",
             "Deterministic fault-injection spec (paddle_tpu.testing."
             "faults): ';'-separated '<site>:every=N' / '<site>:p=F"
             "[:seed=N][:times=N][:after=N]' entries arming named "
-            "injection sites (prefill, decode_dispatch, program_build, "
-            "train_dispatch, train_sync, dataloader_worker, "
+            "injection sites (prefill, decode_dispatch, preempt, "
+            "kv_spill, router_dispatch, program_build, train_dispatch, "
+            "train_sync, dataloader_worker, "
             "checkpoint_save). Empty (default) = disabled: components "
             "bind no-op stubs at construction, zero hot-path cost. "
             "Eager-only by design — injection never changes a traced "
@@ -338,6 +339,46 @@ define_flag("serving_page_budget", 0,
             "like the formula's +1) and lets admission control "
             "(page-pressure queueing + prefix-cache eviction) absorb "
             "the difference.")
+define_flag("serving_preempt", True,
+            "SLO-aware preemption inside ServingEngine: when a "
+            "tight-deadline arrival cannot admit (no free slot, or "
+            "page-blocked after prefix-cache eviction), the SLACKEST "
+            "running request may be unseated and re-queued for "
+            "replay-from-host-state (the r10 recovery path IS the "
+            "preemption mechanism, so the victim's resumed greedy "
+            "continuation is bit-identical). Bounded per victim by "
+            "FLAGS_serving_preempt_budget; a victim is only unseated "
+            "for an arrival whose deadline slack is smaller by at "
+            "least FLAGS_serving_preempt_margin seconds. Eager-only: "
+            "scheduling policy, never part of a traced program.")
+define_flag("serving_preempt_budget", 2,
+            "How many times one request may be preempted (unseated and "
+            "re-queued for replay) before it becomes untouchable — the "
+            "starvation bound on SLO preemption. Preemptions never "
+            "count against the replay-recovery retry budget: a "
+            "preempted request is healthy, just displaced.")
+define_flag("serving_preempt_horizon", 1.0,
+            "Only preempt for an arrival whose deadline slack is "
+            "already below this many seconds — a head with comfortable "
+            "slack waits like everyone else (preemption is for "
+            "endangered SLOs, not queue-jumping). Raise for slower "
+            "backends; 0 disables preemption as surely as "
+            "FLAGS_serving_preempt=0.")
+define_flag("serving_preempt_margin", 0.0,
+            "Minimum seconds of deadline-slack difference (victim "
+            "slack minus arrival slack) before preemption triggers; "
+            "no-deadline victims have infinite slack and always clear "
+            "the margin. 0 = any tighter deadline may preempt.")
+define_flag("serving_kv_host_tier_pages", 0,
+            "Host-RAM KV tier capacity in pages (0 = tiering off). "
+            "With a positive budget, prefix-cache eviction SPILLS cold "
+            "shared pages (cache-only reference, unpinned) to host RAM "
+            "instead of dropping them, and pages them back on prefix "
+            "adoption — the shared-prefix working set scales past the "
+            "device page budget at the cost of one host round-trip per "
+            "re-adopted page. Beyond the host budget the coldest "
+            "spilled pages drop entirely (classic eviction). Eager-"
+            "only: pure pool bookkeeping, never traced.")
 define_flag("train_max_retries", 2,
             "Model.fit step-recovery budget: retries of a failed "
             "dispatch (sync to last-good state, emergency checkpoint, "

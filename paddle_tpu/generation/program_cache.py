@@ -126,11 +126,14 @@ class DecodeProgramCache:
             self._m_traces = r.counter(
                 "program_cache_traces",
                 "jax (re)traces of cached programs (steady state: one "
-                "per key)", labels=("kind",))
+                "per key); model = signature prefix, so two models' "
+                "programs — or a fleet serving several — never share "
+                "a series", labels=("kind", "model"))
             self._m_compile = r.histogram(
                 "program_cache_compile_seconds",
                 "wall clock of dispatches that (re)traced — trace + "
-                "compile cost per program kind", labels=("kind",))
+                "compile cost per program kind and model",
+                labels=("kind", "model"))
         else:
             self._m_hits = self._m_misses = obs.NULL
             self._m_traces = self._m_compile = obs.NULL
@@ -172,7 +175,8 @@ class DecodeProgramCache:
             cell[0] += 1
             with self._lock:
                 self._trace_counts[key] = self._trace_counts.get(key, 0) + 1
-            self._m_traces.labels(kind=key.kind).inc()
+            self._m_traces.labels(kind=key.kind,
+                                  model=key.model_sig[:8]).inc()
         return note_trace
 
     def _timed_dispatch(self, key: DecodeKey, fn):
@@ -188,7 +192,8 @@ class DecodeProgramCache:
 
         with self._lock:
             cell = self._trace_cells.setdefault(key, [0])
-        hist = self._m_compile.labels(kind=key.kind)
+        hist = self._m_compile.labels(kind=key.kind,
+                                      model=key.model_sig[:8])
 
         def dispatch(*args, **kwargs):
             before = cell[0]

@@ -81,120 +81,162 @@ class Request:
     # prefix-aware admission bookkeeping: how many cached-prefix
     # requests bypassed THIS request while it was the page-blocked head
     bypassed: int = 0
+    # SLO preemption bookkeeping: times this request was unseated for a
+    # tighter-deadline arrival (bounded by FLAGS_serving_preempt_budget;
+    # never counts against the replay-recovery retry budget)
+    preempts: int = 0
+
+
+_POOL_STATES = ("used", "free", "shared", "pinned", "spilled")
 
 
 class _EngineTelemetry:
     """Pre-bound instrument handles for the serving hot path: resolved
     once per engine, one attribute read per write inside ``step()`` —
-    no registry lookups, no flag reads per token."""
+    no registry lookups, no flag reads per token.
+
+    Every family carries a ``replica`` label (r14): two engines in one
+    process — the fleet case — used to collide on one series, so one
+    replica's TTFT polluted another's and the KV gauges flapped between
+    pools. The label is threaded from the engine's ``replica`` id and
+    each engine binds its own child instruments here, once."""
 
     enabled = True
 
-    def __init__(self):
+    def __init__(self, replica: str = "0"):
         r = obs.registry()
         t = obs.tracer()
+        rl = ("replica",)
+
+        def c(name, help):
+            return r.counter(name, help, labels=rl).labels(replica=replica)
+
+        def g(name, help):
+            return r.gauge(name, help, labels=rl).labels(replica=replica)
+
+        def h(name, help):
+            return r.histogram(name, help,
+                               labels=rl).labels(replica=replica)
+
         self.span = t.span
         self.event = t.event
-        self.submitted = r.counter(
+        self.submitted = c(
             "serving_requests_submitted", "requests accepted by submit()")
-        self.finished = r.counter(
+        self.finished = c(
             "serving_requests_finished", "requests that completed")
-        self.prefills = r.counter(
+        self.prefills = c(
             "serving_prefills", "b=1 prefill programs dispatched")
-        self.shared_admits = r.counter(
+        self.shared_admits = c(
             "serving_shared_admissions",
             "admissions that adopted cached prefix pages (prefill skipped)")
-        self.decode_steps = r.counter(
+        self.decode_steps = c(
             "serving_decode_steps", "full-batch decode steps dispatched")
-        self.ttft = r.histogram(
+        self.ttft = h(
             "serving_ttft_seconds",
             "time to first generated token, submit() to host-visible")
-        self.itl = r.histogram(
+        self.itl = h(
             "serving_inter_token_seconds",
             "per-request latency between consecutive generated tokens")
-        self.queue_depth = r.gauge(
+        self.queue_depth = g(
             "serving_queue_depth", "requests waiting for a batch slot")
-        self.occupancy = r.gauge(
+        self.occupancy = g(
             "serving_batch_occupancy",
             "active slots in the fixed-shape decode batch")
-        self.kv_pages_in_use = r.gauge(
+        self.kv_pages_in_use = g(
             "serving_kv_pages_in_use",
             "KV pool pages held by sequences or the prefix cache "
             "(excludes the reserved null page)")
-        self.prefix_pinned = r.gauge(
+        self.prefix_pinned = g(
             "serving_prefix_pinned_pages",
             "prefix-cache pages pinned by in-flight requests — the "
             "pressure that caps evict() reclaim")
-        self.evict_short = r.counter(
+        self.evict_short = c(
             "serving_prefix_evict_shortfall_pages",
             "pages evict() was asked for but could not free "
             "(pinned/shared)")
         # ---- fault-tolerance instruments (replay recovery, r10)
-        self.retries = r.counter(
+        self.retries = c(
             "serving_retries_total",
             "in-flight request replays re-queued by recovery after a "
             "failed dispatch")
-        self.recoveries = r.counter(
+        self.recoveries = c(
             "serving_recoveries",
             "replay-recovery events: failed dispatch -> fresh pools + "
             "re-queue of all in-flight requests")
-        self.requests_failed = r.counter(
+        self.requests_failed = c(
             "serving_requests_failed",
             "requests terminated FAILED (no-progress retry budget "
             "exhausted)")
-        self.requests_timeout = r.counter(
+        self.requests_timeout = c(
             "serving_requests_timeout",
             "requests terminated TIMEOUT (per-request deadline or the "
             "run(max_wall=...) watchdog)")
-        self.recovery_seconds = r.histogram(
+        self.recovery_seconds = h(
             "serving_recovery_seconds",
             "wall clock of one replay recovery (fresh pools + requeue, "
             "excluding backoff sleep)")
-        self.page_pressure = r.gauge(
+        self.page_pressure = g(
             "serving_page_pressure",
             "KV pages short at the last page-blocked admission (0 = "
             "admission is not page-blocked)")
         # ---- continuous-batching instruments (chunked prefill +
         # bucket ladder, r12)
-        self.prefill_chunk_s = r.histogram(
+        self.prefill_chunk_s = h(
             "serving_prefill_chunk_seconds",
             "wall clock of one chunked-prefill chunk dispatch — the "
             "bound on how long a long-prompt arrival can stall decode")
-        self.decode_stall_s = r.histogram(
+        self.decode_stall_s = h(
             "serving_decode_stall_seconds",
             "per-step wall clock decoding slots spent waiting on "
             "scheduler + prefill work before the decode dispatch "
             "(observed only on steps that ran prefill work while "
             "decode-ready requests were waiting)")
-        self.bucket = r.gauge(
+        self.bucket = g(
             "serving_bucket",
             "current decode batch-bucket rung of the bucket ladder")
-        self.migrations = r.counter(
+        self.migrations = c(
             "serving_bucket_migrations",
             "bucket-ladder migrations (grow or shrink) — each rung's "
             "program compiles once, so steady state stops migrating "
             "or cycles between already-compiled rungs")
+        # ---- SLO-aware preemption (r14)
+        self.preemptions = c(
+            "serving_preemptions",
+            "running requests unseated for a tighter-deadline arrival "
+            "and re-queued for bit-identical replay from host state")
+        self.preempted_tokens = c(
+            "serving_preempted_tokens_replayed",
+            "decode tokens preemption victims will regenerate on "
+            "replay — the compute a preemption trades for deadline "
+            "slack")
         # ---- memwatch pool ledger (r13): step-end gauges over the
-        # PagedKVCache ledger, pre-resolved per state label
+        # PagedKVCache ledger, pre-resolved per state label; "spilled"
+        # (r14) is the host-RAM tier
         pages = r.gauge(
             "kv_pool_pages",
             "KV page-pool ledger by state: used (held by sequences or "
             "the prefix cache), free, shared (refcount > 1), pinned "
-            "(prefix pages an in-flight request's block table holds)",
-            labels=("state",))
+            "(prefix pages an in-flight request's block table holds), "
+            "spilled (prefix pages resident only in the host-RAM tier)",
+            labels=("replica", "state"))
         pbytes = r.gauge(
             "kv_pool_bytes",
             "KV page-pool ledger in bytes (all layers, k+v)",
-            labels=("state",))
-        self.pool_pages = {s: pages.labels(state=s)
-                           for s in ("used", "free", "shared", "pinned")}
-        self.pool_bytes = {s: pbytes.labels(state=s)
-                           for s in ("used", "free", "shared", "pinned")}
-        self.pool_frag = r.gauge(
+            labels=("replica", "state"))
+        self.pool_pages = {s: pages.labels(replica=replica, state=s)
+                           for s in _POOL_STATES}
+        self.pool_bytes = {s: pbytes.labels(replica=replica, state=s)
+                           for s in _POOL_STATES}
+        self.pool_frag = g(
             "kv_pool_fragmentation",
             "free-list fragmentation: 1 - largest contiguous free run "
             "/ free pages (0 = clean; recomputed only when the free "
             "list changed)")
+        self.host_tier_peak = g(
+            "kv_host_tier_peak_pages",
+            "high-water mark of pages resident in the host-RAM KV "
+            "tier — the tier watermark memwatch prices against host "
+            "memory")
         self.counter_track = t.counter
 
 
@@ -203,7 +245,7 @@ class _NullEngineTelemetry:
 
     enabled = False
 
-    def __init__(self):
+    def __init__(self, replica: str = "0"):
         self.span = obs.null_span
         self.event = obs.null_event
         self.submitted = self.finished = self.prefills = obs.NULL
@@ -217,39 +259,58 @@ class _NullEngineTelemetry:
         self.recovery_seconds = self.page_pressure = obs.NULL
         self.prefill_chunk_s = self.decode_stall_s = obs.NULL
         self.bucket = self.migrations = obs.NULL
-        self.pool_pages = {s: obs.NULL
-                           for s in ("used", "free", "shared", "pinned")}
-        self.pool_bytes = {s: obs.NULL
-                           for s in ("used", "free", "shared", "pinned")}
-        self.pool_frag = obs.NULL
+        self.preemptions = self.preempted_tokens = obs.NULL
+        self.pool_pages = {s: obs.NULL for s in _POOL_STATES}
+        self.pool_bytes = {s: obs.NULL for s in _POOL_STATES}
+        self.pool_frag = self.host_tier_peak = obs.NULL
         self.counter_track = obs.null_counter
 
 
 class _PrefixTelemetry:
     enabled = True
 
-    def __init__(self):
+    def __init__(self, replica: str = "0"):
         r = obs.registry()
-        self.hits = r.counter(
+        rl = ("replica",)
+
+        def c(name, help):
+            return r.counter(name, help, labels=rl).labels(replica=replica)
+
+        self.hits = c(
             "prefix_cache_hits", "lookups that matched >= 1 cached page")
-        self.misses = r.counter(
+        self.misses = c(
             "prefix_cache_misses", "lookups that matched nothing")
-        self.hit_pages = r.counter(
+        self.hit_pages = c(
             "prefix_cache_hit_pages", "cached pages returned by lookups")
-        self.registered_pages = r.counter(
+        self.registered_pages = c(
             "prefix_cache_registered_pages",
             "new prompt pages registered into the trie")
-        self.evicted_pages = r.counter(
+        self.evicted_pages = c(
             "prefix_cache_evicted_pages",
             "pages actually returned to the free list by evict()")
+        # ---- host-RAM tiering (r14)
+        self.spilled_pages = c(
+            "prefix_cache_spilled_pages",
+            "cold prefix pages spilled to the host-RAM tier (device "
+            "page freed, KV bytes retained host-side)")
+        self.restored_pages = c(
+            "prefix_cache_restored_pages",
+            "spilled prefix pages paged back onto the device on "
+            "prefix adoption")
+        self.dropped_spilled = c(
+            "prefix_cache_dropped_spilled_pages",
+            "spilled pages evicted from the host tier entirely "
+            "(host-tier budget pressure)")
 
 
 class _NullPrefixTelemetry:
     enabled = False
 
-    def __init__(self):
+    def __init__(self, replica: str = "0"):
         self.hits = self.misses = self.hit_pages = obs.NULL
         self.registered_pages = self.evicted_pages = obs.NULL
+        self.spilled_pages = self.restored_pages = obs.NULL
+        self.dropped_spilled = obs.NULL
 
 
 class PrefixCache:
@@ -265,21 +326,40 @@ class PrefixCache:
     prefill. Causality makes this sound: KV at position i depends only on
     tokens 0..i, so equal page-aligned prefixes have bitwise-equal pages.
     Eviction drops least-recently-used LEAF nodes only (an interior node
-    must outlive its children or their chains become unreachable)."""
+    must outlive its children or their chains become unreachable).
+
+    Host-RAM tiering (r14, ``host_tier_pages`` > 0): eviction pressure
+    first SPILLS cold nodes — device page copied to host RAM
+    (:meth:`PagedKVCache.spill_page`) and returned to the free list,
+    trie node kept with the host copy — and ``lookup`` pages spilled
+    chain nodes back in on adoption (one restore write beats re-running
+    the chunk's prefill compute). Spill candidates come straight from
+    the r13 ledger states: only pages the cache alone references
+    (rc == 1, i.e. not ``shared`` with a live sequence) and that no
+    in-flight request pins; when free-list fragmentation is high the
+    policy prefers spilling pages adjacent to free runs, so spills heal
+    the free list instead of shredding it further. Past the host-tier
+    budget the coldest spilled LEAF nodes drop entirely (classic
+    eviction)."""
 
     _ROOT = ("root",)
 
-    def __init__(self, pool: PagedKVCache):
+    def __init__(self, pool: PagedKVCache, replica: str = "0",
+                 host_tier_pages: int = 0):
         self.pool = pool
         self.page_size = pool.page_size
-        # key -> {"page": int, "parent": key, "children": int, "tick": int,
-        #         "pins": int}
+        self.host_tier_pages = int(host_tier_pages)
+        # key -> {"page": int|None, "parent": key, "children": int,
+        #         "tick": int, "pins": int, "host": HostPage|None}
+        # (page is None exactly while the node is spilled)
         self._nodes: Dict[tuple, dict] = {}
         self._by_page: Dict[int, tuple] = {}    # page id -> node key
         self._tick = 0
         self._pinned_nodes = 0      # nodes with pins > 0 (O(1) gauge)
-        self._m = (_PrefixTelemetry() if obs.enabled()
-                   else _NullPrefixTelemetry())
+        self._spilled_nodes = 0     # nodes in the host tier (O(1))
+        self._f_spill = faults.site("kv_spill")
+        self._m = (_PrefixTelemetry(replica) if obs.enabled()
+                   else _NullPrefixTelemetry(replica))
 
     def _chunks(self, prompt: np.ndarray):
         key = self._ROOT
@@ -289,14 +369,30 @@ class PrefixCache:
             key = (key, chunk)
             yield key
 
-    def lookup(self, prompt: np.ndarray):
-        """Longest cached page-aligned prefix: (page_ids, n_tokens)."""
+    def lookup(self, prompt: np.ndarray, max_cover: Optional[int] = None):
+        """Longest cached page-aligned prefix: (page_ids, n_tokens).
+        Spilled chain nodes are paged back in from the host tier when a
+        free device page exists (restore is one pool write; the
+        alternative is re-running the chunk's prefill compute); the hit
+        ends at the first spilled node that cannot be restored.
+        ``max_cover`` caps the returned coverage in tokens — the engine
+        passes ``len(prompt) - 1`` because it can never adopt a
+        whole-prompt hit (the first generated token's logits are not
+        cached), and a restore spent on a page the caller then discards
+        would consume a free page for nothing."""
         self._tick += 1
         pages: List[int] = []
         for key in self._chunks(prompt):
+            if max_cover is not None and \
+                    (len(pages) + 1) * self.page_size > max_cover:
+                break           # the caller could not adopt this page
             node = self._nodes.get(key)
             if node is None:
                 break
+            if node["host"] is not None:
+                if self.pool.free_page_count() == 0:
+                    break       # no room to page in: hit ends here
+                self._restore_node(key, node)
             node["tick"] = self._tick
             pages.append(node["page"])
         if pages:
@@ -306,6 +402,21 @@ class PrefixCache:
             self._m.misses.inc()
         return pages, len(pages) * self.page_size
 
+    def _restore_node(self, key: tuple, node: dict) -> None:
+        """Page one spilled node back onto the device: fresh page off
+        the free list, host bytes written back, cache reference
+        restored. The fault check runs BEFORE any mutation, so an
+        injected restore failure leaves the tier consistent and simply
+        propagates into replay recovery."""
+        self._f_spill.check(op="restore")
+        pid = self.pool.take_free_page()
+        self.pool.restore_page(node["host"], pid)
+        node["host"] = None
+        node["page"] = pid
+        self._by_page[pid] = key
+        self._spilled_nodes -= 1
+        self._m.restored_pages.inc()
+
     def register(self, prompt: np.ndarray, block_row) -> None:
         """Pin the full prompt pages of a just-prefilled sequence."""
         self._tick += 1
@@ -313,11 +424,23 @@ class PrefixCache:
             node = self._nodes.get(key)
             if node is not None:        # dedup: keep the existing page
                 node["tick"] = self._tick
+                if node["host"] is not None:
+                    # the just-prefilled sequence re-materialized this
+                    # chunk's KV on device (equal page-aligned prefixes
+                    # are bitwise-equal): flip the node back to
+                    # resident on the sequence's page and drop the
+                    # host copy — a free re-adoption
+                    self.pool.forget_spilled(node["host"])
+                    node["host"] = None
+                    node["page"] = int(block_row[i])
+                    self._by_page[int(block_row[i])] = key
+                    self._spilled_nodes -= 1
+                    self.pool.ref_page(int(block_row[i]))
                 continue
             parent = key[0] if key[0] in self._nodes else None
             self._nodes[key] = {"page": int(block_row[i]), "parent": parent,
                                 "children": 0, "tick": self._tick,
-                                "pins": 0}
+                                "pins": 0, "host": None}
             self._by_page[int(block_row[i])] = key
             if parent is not None:
                 self._nodes[parent]["children"] += 1
@@ -347,30 +470,164 @@ class PrefixCache:
                     self._pinned_nodes -= 1
 
     def evict(self, n_pages: int) -> int:
-        """Free up to ``n_pages`` pages by dropping LRU leaf nodes,
-        REFUSING any node that is pinned by an in-flight request's block
-        table (pin count from adoption) or whose page anyone besides the
-        cache still references (rc > 1). Returns the number of pages
-        actually returned to the free list — callers size retry loops on
-        real capacity, so unrefs that free nothing don't count."""
-        freed = 0
-        while freed < n_pages:
+        """Free up to ``n_pages`` device pages, REFUSING any node that
+        is pinned by an in-flight request's block table (pin count from
+        adoption) or whose page anyone besides the cache still
+        references (rc > 1). With a host tier armed, cold nodes SPILL
+        first (device page freed, KV retained host-side for later
+        restore); whatever spilling cannot cover falls back to dropping
+        LRU leaf nodes outright. Returns the number of pages actually
+        returned to the free list — callers size retry loops on real
+        capacity, so unrefs that free nothing don't count."""
+        freed = self.spill(n_pages) if self.host_tier_pages > 0 else 0
+        dropped = 0
+        while freed + dropped < n_pages:
             leaves = [(node["tick"], key) for key, node in
                       self._nodes.items()
                       if node["children"] == 0 and node["pins"] == 0
+                      and node["host"] is None
                       and self.pool._page_rc[node["page"]] == 1]
             if not leaves:
                 break
-            _, key = min(leaves)
-            node = self._nodes.pop(key)
-            self._by_page.pop(node["page"], None)
-            if node["parent"] is not None:
-                self._nodes[node["parent"]]["children"] -= 1
-            if self.pool.unref_page(node["page"]):
+            _, key = min(leaves, key=lambda t: t[0])
+            if self._drop_node(key):
+                dropped += 1
+        if dropped:
+            self._m.evicted_pages.inc(dropped)
+        return freed + dropped
+
+    def _drop_node(self, key: tuple) -> bool:
+        """Remove one trie node entirely. Returns True when a DEVICE
+        page actually returned to the free list (a spilled node's drop
+        frees host RAM, not device pages)."""
+        node = self._nodes.pop(key)
+        if node["parent"] is not None:
+            self._nodes[node["parent"]]["children"] -= 1
+        if node["host"] is not None:
+            self.pool.forget_spilled(node["host"])
+            self._spilled_nodes -= 1
+            return False
+        self._by_page.pop(node["page"], None)
+        return self.pool.unref_page(node["page"])
+
+    def spill(self, n_pages: int) -> int:
+        """Move up to ``n_pages`` cold resident nodes to the host tier,
+        freeing their device pages. Candidates are exactly what the
+        r13 ledger calls cache-only pages: unpinned, rc == 1 (a shared
+        or adopted page never spills under a live reader). LRU order;
+        under high free-list fragmentation the policy prefers, among
+        the colder half, pages adjacent to the current free list so
+        each spill extends a contiguous run. Past the host budget the
+        coldest spilled leaves drop entirely."""
+        freed = 0
+        # one sort per spill() call (the per-page state this loop
+        # mutates never re-ranks the survivors; re-sorting per page
+        # made a blocked admission quadratic in the spill batch)
+        cands = sorted(
+            ((node["tick"], key) for key, node in self._nodes.items()
+             if node["host"] is None and node["pins"] == 0
+             and self.pool._page_rc[node["page"]] == 1),
+            key=lambda t: t[0])     # trie keys are not comparable
+        frag = (len(cands) > 1
+                and self.pool.free_list_fragmentation() > 0.5)
+        free = set(self.pool._free) if frag else None
+        while freed < n_pages and cands:
+            # the host tier is a HARD budget (operators size it
+            # against real host RAM): make room by dropping the
+            # coldest spilled leaves BEFORE spilling in, and stop
+            # spilling entirely when nothing is droppable (all
+            # spilled nodes interior with live children)
+            if self._spilled_nodes >= self.host_tier_pages:
+                self._drop_spilled_until(self.host_tier_pages - 1)
+                if self._spilled_nodes >= self.host_tier_pages:
+                    break
+            idx = 0
+            if frag:
+                # fragmentation-aware tie-break: among the colder half,
+                # spill a page that extends an existing free run
+                for j in range(max(1, len(cands) // 2)):
+                    pid = self._nodes[cands[j][1]]["page"]
+                    if pid + 1 in free or pid - 1 in free:
+                        idx = j
+                        break
+            _, key = cands.pop(idx)
+            node = self._nodes[key]
+            pid = node["page"]
+            # fault check BEFORE mutation: an injected spill failure
+            # leaves the node resident and propagates into replay
+            self._f_spill.check(op="spill", page=pid)
+            node["host"] = self.pool.spill_page(pid)
+            node["page"] = None
+            self._by_page.pop(pid, None)
+            self._spilled_nodes += 1
+            if self.pool.unref_page(pid):
                 freed += 1
-        if freed:
-            self._m.evicted_pages.inc(freed)
+                if free is not None:
+                    free.add(pid)
+            self._m.spilled_pages.inc()
         return freed
+
+    def _drop_spilled_until(self, limit: int) -> None:
+        """Drop the coldest spilled LEAF nodes until the host tier
+        holds at most ``limit`` pages (an interior spilled node waits
+        for its children — dropping it would orphan their chains).
+        ``spill`` calls this before every page it moves in, so the
+        spilled census never exceeds ``host_tier_pages``."""
+        while self._spilled_nodes > max(0, limit):
+            spilled_leaves = [(node["tick"], key) for key, node in
+                              self._nodes.items()
+                              if node["host"] is not None
+                              and node["children"] == 0
+                              and node["pins"] == 0]
+            if not spilled_leaves:
+                break
+            _, key = min(spilled_leaves, key=lambda t: t[0])
+            self._drop_node(key)
+            self._m.dropped_spilled.inc()
+
+    def spilled_page_count(self) -> int:
+        """Pages currently resident only in the host tier (O(1))."""
+        return self._spilled_nodes
+
+    def evictable_page_count(self) -> int:
+        """Device pages ``evict``/``spill`` could free right now —
+        resident, unpinned, cache-only (rc == 1). The preemption
+        trigger consults this so a tight-deadline arrival never
+        preempts a victim while plain eviction could still pay its
+        page bill. With a host tier armed, any such node spills
+        regardless of trie position; without one, ``evict`` drops
+        LEAVES only, so a pinned/shared/spilled descendant blocks
+        every ancestor from the cascade — counting those would make
+        the preemption trigger skip a victim for pages eviction can
+        never actually free."""
+        free_ok = (lambda node: node["host"] is None
+                   and node["pins"] == 0
+                   and self.pool._page_rc[node["page"]] == 1)
+        blocked: set = set()
+        for node in self._nodes.values():
+            if free_ok(node):
+                continue
+            k = node["parent"]
+            while k is not None and k not in blocked:
+                blocked.add(k)
+                parent = self._nodes.get(k)
+                k = parent["parent"] if parent is not None else None
+        droppable = sum(1 for key, node in self._nodes.items()
+                        if key not in blocked and free_ok(node))
+        if self.host_tier_pages <= 0:
+            return droppable
+        # tier armed: nodes beyond the leaf-drop cascade free via
+        # SPILL, but only as far as the HARD tier budget has room —
+        # current headroom plus droppable spilled leaves (each drop
+        # opens one slot; no cascade credit, so this under- rather
+        # than over-estimates and the preemption trigger errs toward
+        # protecting the deadline)
+        flat = sum(1 for node in self._nodes.values() if free_ok(node))
+        room = max(0, self.host_tier_pages - self._spilled_nodes)
+        room += sum(1 for node in self._nodes.values()
+                    if node["host"] is not None
+                    and node["children"] == 0 and node["pins"] == 0)
+        return droppable + min(room, max(0, flat - droppable))
 
     def pinned_page_count(self) -> int:
         """Pages untouchable by ``evict`` because an in-flight request's
@@ -380,14 +637,23 @@ class PrefixCache:
         pins==0 nodes), so the per-step gauge refresh costs nothing."""
         return self._pinned_nodes
 
-    def peek(self, prompt: np.ndarray) -> int:
+    def peek(self, prompt: np.ndarray,
+             include_spilled: bool = False) -> int:
         """Length (tokens) of the cached page-aligned prefix WITHOUT
         touching LRU ticks or hit/miss telemetry — the scheduler's
         prefix-aware admission probe (``lookup`` is the real,
-        stats-bearing read at admission time)."""
+        stats-bearing read at admission time). By default the probe
+        counts DEVICE-resident pages only, so admission pricing stays
+        honest (restoring a spilled page consumes a free page, exactly
+        like fresh allocation); the fleet router's affinity probe passes
+        ``include_spilled=True`` because a host-tier hit still beats
+        re-running prefill on a cold replica."""
         n = 0
         for key in self._chunks(prompt):
-            if key not in self._nodes:
+            node = self._nodes.get(key)
+            if node is None:
+                break
+            if node["host"] is not None and not include_spilled:
                 break
             n += self.page_size
         return n
@@ -408,11 +674,17 @@ class ServingEngine:
                  num_pages: Optional[int] = None, max_seq_len: int = 1024,
                  prefix_cache: bool = False,
                  bucket_ladder: Optional[Tuple[int, ...]] = None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 replica: str = "0",
+                 host_tier_pages: Optional[int] = None):
         from .. import flags as _flags
         from ..jit import ensure_live
 
         self.model = model
+        # identity of this engine in a multi-engine (fleet) process:
+        # threaded as the `replica` label through every metric family,
+        # so per-replica series never collide
+        self.replica = str(replica)
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len
         spec = model.cache_spec()
@@ -474,6 +746,22 @@ class ServingEngine:
             raise ValueError(
                 f"engine max_seq_len ({max_seq_len}) exceeds the model's "
                 f"max_position_embeddings ({maxpos})")
+        # ---- host-RAM KV tier (r14): prefix-cache eviction spills to
+        # host RAM up to this many pages instead of dropping (0 = off)
+        self.host_tier_pages = int(
+            _flags.get_flag("serving_kv_host_tier_pages")
+            if host_tier_pages is None else host_tier_pages)
+        # ---- SLO-aware preemption (r14): a tight-deadline arrival may
+        # unseat the slackest running request (bounded per victim),
+        # which replays later from host state bit-identically
+        self.preempt_enabled = bool(_flags.get_flag("serving_preempt"))
+        self.preempt_budget = int(_flags.get_flag("serving_preempt_budget"))
+        self.preempt_margin = float(
+            _flags.get_flag("serving_preempt_margin"))
+        self.preempt_horizon = float(
+            _flags.get_flag("serving_preempt_horizon"))
+        self.preemptions = 0        # host probe (telemetry-independent)
+        self._host_tier_peak = 0
         self._slots: List[Optional[Request]] = [None] * max_batch
         self._queue: List[Request] = []
         self._results: Dict[int, List[int]] = {}
@@ -490,7 +778,9 @@ class ServingEngine:
         # callback that raises never masquerades as a dispatch failure
         self._events: List[tuple] = []
         self._prefix_enabled = bool(prefix_cache)
-        self._prefix = PrefixCache(self.pool) if prefix_cache else None
+        self._prefix = (PrefixCache(self.pool, replica=self.replica,
+                                    host_tier_pages=self.host_tier_pages)
+                        if prefix_cache else None)
         # ---- fault tolerance: injection sites bind at construction
         # (NULL stubs when FLAGS_fault_inject is unset — zero hot-path
         # cost, the telemetry idiom) and the replay-recovery budget
@@ -498,6 +788,7 @@ class ServingEngine:
         self._f_chunk = faults.site("chunk_prefill")
         self._f_decode = faults.site("decode_dispatch")
         self._f_migrate = faults.site("bucket_migrate")
+        self._f_preempt = faults.site("preempt")
         self.max_retries = int(_flags.get_flag("serving_max_retries"))
         self.retry_backoff = float(
             _flags.get_flag("serving_retry_backoff"))
@@ -522,9 +813,10 @@ class ServingEngine:
         self._flags = _flags.snapshot(_flags.PROGRAM_FLAGS)
         self._model_sig = model_signature(model)
         # telemetry binding is per-engine and resolved once here (the
-        # no-op stubs cost one method call per write when disabled)
-        self._m = (_EngineTelemetry() if obs.enabled()
-                   else _NullEngineTelemetry())
+        # no-op stubs cost one method call per write when disabled);
+        # the replica id labels every series so fleet engines coexist
+        self._m = (_EngineTelemetry(self.replica) if obs.enabled()
+                   else _NullEngineTelemetry(self.replica))
         # pool-ledger fragmentation memo: recompute only when the pool's
         # free-list epoch moved (steady-state decode never moves it)
         self._pool_frag_epoch = -1
@@ -576,6 +868,13 @@ class ServingEngine:
 
     def has_work(self) -> bool:
         return bool(self._queue) or any(s is not None for s in self._slots)
+
+    def load(self) -> Tuple[int, int]:
+        """``(deadline_bearing, total)`` live request counts (queued +
+        in flight) — the fleet router's deadline-aware load-balance
+        probe. Cheap: two list scans over host bookkeeping."""
+        live = [r for r in self._slots if r is not None] + self._queue
+        return (sum(1 for r in live if r.deadline is not None), len(live))
 
     def run_step(self) -> bool:
         """The non-blocking pump: one scheduler round (admission, at
@@ -650,6 +949,48 @@ class ServingEngine:
 
     def statuses(self) -> Dict[int, str]:
         return dict(self._status)
+
+    # ---------------------------------------------- fleet router surface
+    def export_requests(self) -> List[Request]:
+        """Detach every live request — in flight and queued — as pure
+        host state, in submission order: the fleet router's
+        replica-loss harvest. In-flight requests reset to replay form
+        (prompt + emitted tokens; pins, slots, cursors dropped), so
+        re-routing them through another replica's admission produces
+        the bit-identical greedy continuation. The engine is left with
+        no pending work; completed results stay until drained. Pages
+        release when the pool is still alive (a lost replica's pool may
+        be detached — its device state is gone either way)."""
+        live = [r for r in self._slots if r is not None]
+        pool_alive = self.pool.k_pages and self.pool.k_pages[0] is not None
+        out = sorted(live + self._queue, key=lambda r: r.rid)
+        for req in live:
+            if pool_alive and req.slot is not None:
+                self.pool.free_sequence(req.slot)
+        for req in out:
+            self._to_replay_form(req)
+        self._slots = [None] * self.max_batch
+        self._queue = []
+        self._last_tok[:] = 0
+        return out
+
+    def inject_request(self, req: Request) -> int:
+        """Enqueue an EXISTING request object under a fresh local rid —
+        the fleet router's re-route half of :meth:`export_requests`.
+        Prompt, emitted tokens, deadline, budgets and the streaming
+        callback all ride along, so admission treats a token-bearing
+        injection exactly like a replay (prefill from prompt + tokens,
+        bit-identical greedy continuation)."""
+        req.rid = self._next_rid
+        self._next_rid += 1
+        req.status = "PENDING"
+        req.error = None
+        self._queue.append(req)
+        # NOT counted as a submission: the request was submitted once,
+        # on its original replica — fleet_rerouted_requests is the
+        # re-route count, and double-counting here would inflate every
+        # fleet-wide sum over serving_requests_submitted{replica}
+        return req.rid
 
     # ------------------------------------------------- compiled programs
     def _key(self, kind: str, bucket: Optional[int] = None,
@@ -774,6 +1115,30 @@ class ServingEngine:
         self._slots[slot] = req
         self._m.shared_admits.inc()
 
+    def _covers_enough(self, req: Request, n_cached: int) -> bool:
+        """The monolithic-mode coverage threshold: the suffix replays
+        one token per decode step, so a barely-covered long prompt
+        would trade one b=1 prefill for hundreds of full-batch steps.
+        With chunking on, long suffixes prefill in chunks from the
+        adopted cursor instead, so ANY hit is worth taking (callers
+        short-circuit on ``self.chunk``)."""
+        return (len(req.prompt) - n_cached
+                <= max(2 * self.pool.page_size, n_cached))
+
+    def _hit_worth_taking(self, req: Request) -> bool:
+        """Would ``_admit`` accept this request's prefix hit? Mirrored
+        on POTENTIAL coverage (spilled pages included) BEFORE lookup
+        runs: with chunking off, a hit the coverage threshold refuses
+        must be detected up front, or lookup's restores would consume
+        free pages ``_next_admission`` never priced — the subsequent
+        full-span allocate could exhaust the pool mid-step."""
+        if self.chunk:
+            return True
+        n = self._prefix.peek(req.prompt, include_spilled=True)
+        while n >= len(req.prompt):
+            n -= self.pool.page_size
+        return n > 0 and self._covers_enough(req, n)
+
     def _admission_feed(self, req: Request) -> np.ndarray:
         """What prefill teacher-forces for this admission. First
         admission: the prompt. Replay admission (recovery re-queued an
@@ -796,24 +1161,16 @@ class ServingEngine:
         self._m.event("request.queued", req.t_submit, time.perf_counter(),
                       rid=req.rid)
         replay = bool(req.tokens)
-        if self._prefix is not None and not replay:
-            pages, n_cached = self._prefix.lookup(req.prompt)
-            # never cover the WHOLE prompt: the first generated token's
-            # logits are not cached, so at least one prompt token must go
-            # through compute
-            while pages and n_cached >= len(req.prompt):
-                pages = pages[:-1]
-                n_cached -= self.pool.page_size
-            # coverage threshold (monolithic mode only): the suffix
-            # replays one token per decode step, so a barely-covered
-            # long prompt would trade one b=1 prefill for hundreds of
-            # full-batch steps. With chunking on, long suffixes prefill
-            # in chunks from the adopted cursor instead, so ANY hit is
-            # worth taking.
-            suffix_len = len(req.prompt) - n_cached
-            if pages and (self.chunk
-                          or suffix_len <= max(2 * self.pool.page_size,
-                                               n_cached)):
+        if self._prefix is not None and not replay \
+                and self._hit_worth_taking(req):
+            # max_cover never covers the WHOLE prompt: the first
+            # generated token's logits are not cached, so at least one
+            # prompt token must go through compute — and lookup must
+            # not restore a spilled page an over-cover would discard
+            pages, n_cached = self._prefix.lookup(
+                req.prompt, max_cover=len(req.prompt) - 1)
+            if pages and (self.chunk or self._covers_enough(
+                    req, n_cached)):
                 self._admit_shared(req, slot, pages, n_cached)
                 return False    # no prefill compute dispatched
         feed = self._admission_feed(req)
@@ -956,6 +1313,24 @@ class ServingEngine:
         self._prefill_chunk(req)
         return True
 
+    def _to_replay_form(self, req: Request, unpin: bool = True) -> None:
+        """Reset a request's per-admission transient state to pure
+        replay form (prompt + emitted tokens drive any re-admission).
+        Every path that detaches a live request funnels through here —
+        terminal finalize, replay recovery, SLO preemption, fleet
+        export — so a new transient field added to ``Request`` gets its
+        reset in ONE place instead of four. ``unpin=False`` when the
+        pool the pins indexed is already dead (recovery rebuilt pool
+        AND prefix cache; the fresh cache never saw those pages)."""
+        if unpin and req.pinned and self._prefix is not None:
+            self._prefix.unpin(req.pinned)
+        req.pinned = []
+        req.pending = []
+        req.prefill_pos = None
+        req.feed = None
+        req.slot = None
+        req.bypassed = 0
+
     def _emit(self, req: Request, tok: Optional[int],
               done: bool = False) -> None:
         """Buffer one streaming event; :meth:`step` drains the buffer
@@ -977,13 +1352,7 @@ class ServingEngine:
         if req.slot is not None:
             self.pool.free_sequence(req.slot)
             self._slots[req.slot] = None
-            req.slot = None
-        if req.pinned and self._prefix is not None:
-            self._prefix.unpin(req.pinned)
-        req.pinned = []
-        req.pending = []
-        req.prefill_pos = None
-        req.feed = None
+        self._to_replay_form(req)
         req.status = status
         req.error = error
         self._results[req.rid] = req.tokens
@@ -1104,11 +1473,10 @@ class ServingEngine:
             # fresh progress or a persistently flaky backend could
             # reset the retry budget forever.
             progress = (len(req.tokens), req.prefill_pos or 0)
-            req.slot = None
-            req.pending = []
-            req.pinned = []     # pinned pages died with the old pool
-            req.prefill_pos = None      # replay re-prefills from host
-            req.feed = None             # state (prompt + tokens)
+            # unpin=False: the pinned pages died with the old pool and
+            # the rebuilt prefix cache never saw them; replay
+            # re-prefills from host state (prompt + tokens)
+            self._to_replay_form(req, unpin=False)
             if progress > req.progress_mark:
                 any_progress = True
                 req.retries = 1
@@ -1141,7 +1509,8 @@ class ServingEngine:
         the replays without a retrace. The prefix cache indexed pages of
         the dead pool and restarts empty."""
         self.pool = PagedKVCache(**self._pool_geom)
-        self._prefix = (PrefixCache(self.pool)
+        self._prefix = (PrefixCache(self.pool, replica=self.replica,
+                                    host_tier_pages=self.host_tier_pages)
                         if self._prefix_enabled else None)
         self._pool_frag_epoch = -1      # fresh pool: re-publish ledger
 
@@ -1200,8 +1569,7 @@ class ServingEngine:
         while n >= len(req.prompt):
             n -= self.pool.page_size
         if n <= 0 or (not self.chunk
-                      and len(req.prompt) - n
-                      > max(2 * self.pool.page_size, n)):
+                      and not self._covers_enough(req, n)):
             n = 0           # miss, or _admit's monolithic coverage
                             # threshold would refuse the hit
         pages = n // self.pool.page_size
@@ -1329,6 +1697,83 @@ class ServingEngine:
         self._f_migrate.check(phase="commit")
         self._observe_bucket(migrated=True)
 
+    # ------------------------------------------------ SLO preemption
+    def _preempt_for(self, order: List[Request]) -> None:
+        """Bounded eviction of running work for an ENDANGERED deadline:
+        when the tightest-slack waiting request (a) has a deadline with
+        slack already inside ``FLAGS_serving_preempt_horizon``, and (b)
+        cannot admit — every slot is occupied, or its fresh-page bill
+        exceeds free + evictable pages — unseat the SLACKEST running
+        request whose slack exceeds the head's by at least the margin.
+        The victim goes back to the queue intact (prompt + emitted
+        tokens are host state) and its later re-admission replays the
+        r10 recovery path, so the resumed greedy continuation is
+        bit-identical; each victim is preemptible at most
+        ``FLAGS_serving_preempt_budget`` times, and preemptions never
+        touch the replay-recovery retry budget."""
+        if not self.preempt_enabled or not order:
+            return
+        head = order[0]
+        if head.deadline is None:
+            return                  # only deadline pressure preempts
+        now = time.perf_counter()
+        head_slack = head.deadline - now
+        if head_slack > self.preempt_horizon:
+            return                  # comfortable slack: wait in line
+        while True:
+            # free slots within the CURRENT bucket rung: the fill loop
+            # only admits into slots below self.bucket, and a ladder's
+            # out-of-rung slots are always None — counting those would
+            # read a saturated rung as admittable and never preempt
+            # (migration can't grow the rung either: a page-blocked
+            # head is not "admittable demand")
+            free_slots = self._slots[:self.bucket].count(None)
+            need = self._fresh_pages_needed(head)
+            reclaimable = (self.pool.free_page_count()
+                           + (self._prefix.evictable_page_count()
+                              if self._prefix is not None else 0))
+            if free_slots and need <= reclaimable:
+                return              # admittable without a victim
+            cands = [r for r in self._slots
+                     if r is not None and r.rid != head.rid
+                     and r.preempts < self.preempt_budget]
+            victim = None
+            best = (-1.0, -1)
+            # STRICTLY slacker than head + margin: an equal-slack pair
+            # must never swap seats (each swap replays a healthy
+            # request's whole prefill for zero deadline benefit)
+            for r in cands:
+                slack = ((r.deadline - now) if r.deadline is not None
+                         else float("inf"))
+                if slack <= head_slack + self.preempt_margin:
+                    continue
+                if (slack, r.rid) > best:
+                    best = (slack, r.rid)
+                    victim = r
+            if victim is None:
+                return              # nobody meaningfully slacker
+            # fault check BEFORE any mutation: an injected preemption
+            # failure propagates into replay recovery cleanly
+            self._f_preempt.check(rid=victim.rid)
+            self._unseat(victim)
+            # pages moved: reprice the head's bill before looping
+            self._probe_memo.clear()
+
+    def _unseat(self, req: Request) -> None:
+        """Return one RUNNING request to the queue as pure host state —
+        the preemption primitive. Slot, pages and pins release; tokens
+        and the deadline stay; admission later replays it from prompt +
+        emitted tokens (greedy => bit-identical continuation)."""
+        slot = req.slot
+        self.pool.free_sequence(slot)
+        self._slots[slot] = None
+        self._last_tok[slot] = 0
+        self._to_replay_form(req)
+        req.preempts += 1
+        self.preemptions += 1
+        self._queue.append(req)
+        self._observe_preemption(req)
+
     def _step_inner(self) -> None:  # tracecheck: hotpath
         self._sweep_deadlines()
         self._probe_memo.clear()    # prefix probes are per-step
@@ -1341,6 +1786,9 @@ class ServingEngine:
         # migration demand estimate and the slot-fill loop below
         order = self._admission_order() if self._queue else []
         self._maybe_migrate(order)
+        # SLO preemption runs BEFORE the slot fill: an unseated victim's
+        # slot admits the endangered head in this very step
+        self._preempt_for(order)
         # the step's ONE prefill-compute unit alternates between new
         # monolithic admissions and in-flight chunks under contention:
         # admissions always winning would starve a mid-prefill long
@@ -1518,11 +1966,17 @@ class ServingEngine:
         m.pool_pages["free"].set(led["pages_free"])
         m.pool_pages["shared"].set(led["pages_shared"])
         m.pool_pages["pinned"].set(pinned)
+        m.pool_pages["spilled"].set(led["pages_spilled"])
         m.pool_bytes["used"].set(led["bytes_in_use"])
         m.pool_bytes["free"].set(led["bytes_free"])
         m.pool_bytes["shared"].set(
             led["pages_shared"] * led["bytes_per_page"])
         m.pool_bytes["pinned"].set(pinned * led["bytes_per_page"])
+        m.pool_bytes["spilled"].set(led["bytes_spilled"])
+        if led["pages_spilled"] > self._host_tier_peak:
+            # tier watermark: the host-RAM bytes memwatch prices
+            self._host_tier_peak = led["pages_spilled"]
+            m.host_tier_peak.set(self._host_tier_peak)
         if led["epoch"] != self._pool_frag_epoch:
             self._pool_frag_epoch = led["epoch"]
             self._pool_frag = self.pool.free_list_fragmentation()
@@ -1531,7 +1985,8 @@ class ServingEngine:
             "kv_pool", time.perf_counter(),
             pages_in_use=led["pages_in_use"],
             bytes_in_use=led["bytes_in_use"],
-            pages_shared=led["pages_shared"], pages_pinned=pinned)
+            pages_shared=led["pages_shared"], pages_pinned=pinned,
+            pages_spilled=led["pages_spilled"])
 
     def _observe_page_pressure(self, short: int) -> None:
         """Admission is (or stopped being) page-blocked: publish how
@@ -1568,6 +2023,16 @@ class ServingEngine:
             return
         m.evict_short.inc(short)
         m.prefix_pinned.set(self._prefix.pinned_page_count())
+
+    def _observe_preemption(self, req: Request) -> None:
+        """One victim unseated for a tighter deadline: count it and the
+        decode tokens its replay will regenerate."""
+        m = self._m
+        if not m.enabled:
+            return
+        m.preemptions.inc()
+        if req.tokens:
+            m.preempted_tokens.inc(len(req.tokens))
 
     def _observe_chunk(self, dt: float, final: bool = False) -> None:
         """One chunked-prefill dispatch retired: bank its wall clock —

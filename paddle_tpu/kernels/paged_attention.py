@@ -307,6 +307,22 @@ def gather_paged_view(k_pages, v_pages, block_tables):
     return jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2)     # (B, T, Hkv, D)
 
 
+class HostPage:
+    """One KV page spilled to host RAM: the per-layer ``(k, v)`` numpy
+    copies of a pool page, ready to be written back into any free
+    device page by :meth:`PagedKVCache.restore_page`. Owned by whoever
+    orchestrates tiering (the serving PrefixCache) — the pool only
+    counts it so the ledger's ``spilled`` state stays honest."""
+
+    __slots__ = ("k", "v", "nbytes")
+
+    def __init__(self, k: List[np.ndarray], v: List[np.ndarray],
+                 nbytes: int):
+        self.k = k
+        self.v = v
+        self.nbytes = nbytes
+
+
 class PagedKVCache:
     """Host-side page-pool manager: one pool per transformer layer, a
     block table per live sequence, and a free list that recycles pages
@@ -334,6 +350,11 @@ class PagedKVCache:
         # when allocate/free actually changed the list
         self._shared_pages = 0
         self._free_epoch = 0
+        # host-RAM tier census: pages currently spilled via spill_page
+        # (decremented on restore_page / forget_spilled) — the ledger's
+        # "spilled" state. The HostPage objects themselves live with
+        # the tiering orchestrator (the serving PrefixCache).
+        self._spilled_pages = 0
         self.bytes_per_page = (num_layers * 2 * num_kv_heads * page_size
                                * head_dim * jnp.dtype(dtype).itemsize)
         self.k_pages: List[jax.Array] = [
@@ -378,9 +399,11 @@ class PagedKVCache:
             "pages_in_use": usable - free,
             "pages_free": free,
             "pages_shared": self._shared_pages,
+            "pages_spilled": self._spilled_pages,
             "bytes_per_page": self.bytes_per_page,
             "bytes_in_use": (usable - free) * self.bytes_per_page,
             "bytes_free": free * self.bytes_per_page,
+            "bytes_spilled": self._spilled_pages * self.bytes_per_page,
             "epoch": self._free_epoch,
         }
         if fragmentation:
@@ -430,6 +453,66 @@ class PagedKVCache:
             self.block_tables[seq_idx, i] = pid
             self.ref_page(pid)
         self._pages_used[seq_idx] = len(page_ids)
+
+    # ------------------------------------------------ host-RAM tiering
+    # Scheduler-time only: spill/restore read and write the live pool
+    # arrays, so they must never run while a donating dispatch holds
+    # the pools detached (take_pools raises through the read if so).
+
+    def spill_page(self, page_id: int) -> HostPage:
+        """Copy one pool page to host RAM (every layer's k and v rows)
+        and return the :class:`HostPage`. The caller still owns the
+        page's reference — drop it via ``unref_page`` to actually free
+        the device page (the spill-then-free split keeps a failed spill
+        from losing the page)."""
+        pid = int(page_id)
+        # deliberate host pulls: spilling IS the device->host copy, and
+        # it only ever runs at scheduler time between dispatched steps.
+        # np.array (not asarray): numpy-backed pools would hand back a
+        # VIEW of a buffer whose page id gets recycled
+        # tracecheck: disable=TRC002
+        ks = [np.array(self.k_pages[i][:, pid])
+              for i in range(len(self.k_pages))]
+        # tracecheck: disable=TRC002
+        vs = [np.array(self.v_pages[i][:, pid])
+              for i in range(len(self.v_pages))]
+        self._spilled_pages += 1
+        return HostPage(ks, vs, self.bytes_per_page)
+
+    def restore_page(self, host: HostPage, page_id: int) -> None:
+        """Write a spilled page back into device page ``page_id`` (a
+        page the caller just took from the free list) and retire the
+        host copy from the spilled census. The pool arrays may be
+        numpy-backed between dispatches (a donating step's returned
+        tensors unwrap to read-only host views on CPU backends), so
+        both flavors route through a functional ``jnp .at[].set`` —
+        one pool-copy-sized write per layer, the price of a restore
+        (still far cheaper than re-running the chunk's prefill)."""
+        pid = int(page_id)
+        for i in range(len(self.k_pages)):
+            k = jnp.asarray(self.k_pages[i])
+            v = jnp.asarray(self.v_pages[i])
+            self.k_pages[i] = k.at[:, pid].set(host.k[i])
+            self.v_pages[i] = v.at[:, pid].set(host.v[i])
+        self._spilled_pages -= 1
+
+    def forget_spilled(self, host: HostPage) -> None:
+        """A spilled page is being dropped entirely (host-tier budget
+        eviction): retire it from the spilled census without a device
+        write."""
+        self._spilled_pages -= 1
+
+    def take_free_page(self) -> int:
+        """Pop one page from the free list with reference count 1 —
+        the restore path's single-page allocation (sequence-shaped
+        ``allocate`` sizes whole block tables). Raises like
+        ``allocate`` when the pool is exhausted."""
+        if not self._free:
+            raise RuntimeError("page pool exhausted")
+        pid = self._free.pop()
+        self._free_epoch += 1
+        self._page_rc[pid] = 1
+        return pid
 
     def allocate(self, seq_idx: int, n_tokens: int) -> None:
         """Ensure sequence ``seq_idx`` has pages for ``n_tokens`` more

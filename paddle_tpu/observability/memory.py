@@ -458,6 +458,7 @@ def estimate_engine_memory(dims: ModelDims, *,
                            chunk: int = 0,
                            weight_dtype: str = "bfloat16",
                            kv_dtype: str = "bfloat16",
+                           host_tier_pages: int = 0,
                            param_count: Optional[int] = None
                            ) -> Dict[str, Any]:
     """The what-if planner: predicted steady-state serving HBM for a
@@ -465,7 +466,10 @@ def estimate_engine_memory(dims: ModelDims, *,
     transparent breakdown ``tools/memwatch.py plan`` renders; compare
     ``total`` against the chip's HBM. ``page_budget`` = USABLE pages
     (the FLAGS_serving_page_budget contract: +1 null page rides on
-    top); None = the worst-case formula."""
+    top); None = the worst-case formula. ``host_tier_pages`` (r14)
+    prices the host-RAM KV tier alongside: its bytes land under
+    ``host_tier`` — host RAM, NOT HBM — so device and host are planned
+    jointly but never summed into one number."""
     n_params = param_count or dims.param_count
     if n_params is None:
         raise ValueError("need param_count (config.num_params() or "
@@ -497,6 +501,11 @@ def estimate_engine_memory(dims: ModelDims, *,
     margin = max(64 << 20, int(0.05 * weights))
     workspace = max(decode_tmp, chunk_tmp)
     total = weights + pool + workspace + tables + margin
+    # host-RAM tier: same per-page geometry as the device pool (spill
+    # copies pages verbatim, scales included), priced against HOST
+    # memory — derived from the pool term so the two can never drift
+    bytes_per_page = pool // (usable + 1)
+    host_tier = int(host_tier_pages) * bytes_per_page
     return {
         "dims": {"hidden": dims.hidden, "layers": dims.layers,
                  "heads": dims.heads, "kv_heads": dims.kv_heads,
@@ -505,7 +514,8 @@ def estimate_engine_memory(dims: ModelDims, *,
         "config": {"page_size": page_size, "usable_pages": usable,
                    "max_batch": max_batch, "max_seq_len": max_seq_len,
                    "chunk": chunk, "weight_dtype": str(weight_dtype),
-                   "kv_dtype": str(kv_dtype)},
+                   "kv_dtype": str(kv_dtype),
+                   "host_tier_pages": int(host_tier_pages)},
         "breakdown": {
             "weights": weights, "kv_pool": pool,
             "decode_workspace": decode_tmp,
@@ -514,6 +524,9 @@ def estimate_engine_memory(dims: ModelDims, *,
             "xla_code_and_runtime_margin": margin,
         },
         "total": int(total),
+        "host_tier": {"pages": int(host_tier_pages),
+                      "bytes": int(host_tier),
+                      "bytes_per_page": int(bytes_per_page)},
     }
 
 

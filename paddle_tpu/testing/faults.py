@@ -35,6 +35,16 @@ Sites (KNOWN_SITES; an unknown site in the spec is a construction-time
     bucket_migrate      ServingEngine bucket-ladder migration (checked
                         at begin, per compacted sequence, and at
                         commit, so every=N schedules land mid-move)
+    preempt             ServingEngine SLO preemption — checked before a
+                        slack victim is unseated for a tight-deadline
+                        arrival (recovery replays everything in flight)
+    kv_spill            PagedKVCache host-RAM tiering — checked before
+                        each page spill AND each page restore (ctx
+                        carries op="spill"/"restore")
+    router_dispatch     FleetRouter per-replica drive — a fire is a
+                        whole-replica loss: the router harvests the
+                        replica's host-side request state and re-routes
+                        it across the surviving fleet
     program_build       decode program cache build (compile path)
     train_dispatch      TrainStep.__call__ before the jitted dispatch
     train_sync          TrainStep.pull_metrics / sync host pulls
@@ -76,6 +86,7 @@ __all__ = [
 
 KNOWN_SITES = frozenset({
     "prefill", "chunk_prefill", "decode_dispatch", "bucket_migrate",
+    "preempt", "kv_spill", "router_dispatch",
     "program_build", "train_dispatch", "train_sync", "dataloader_worker",
     "checkpoint_save",
 })

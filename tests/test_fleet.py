@@ -1,0 +1,551 @@
+"""Fleet serving (r14): prefix-affinity replica router, SLO-aware
+preemption, and host-RAM KV tiering.
+
+Three invariants anchor every test here:
+
+  * greedy bit-identity — routing, preemption, tiering and replica
+    loss are all pure SCHEDULING/PLACEMENT machinery; each request's
+    tokens must equal its solo greedy decode no matter which replica
+    served it, how many times it was preempted, or how many of its
+    prefix pages round-tripped through host RAM;
+  * bounded disruption — preemption budgets, host-tier budgets and the
+    router's replica-loss budget all cap their mechanisms, so a
+    pathological workload degrades instead of livelocking;
+  * per-replica observability — the r14 ``replica`` label keeps two
+    engines in one process on separate metric series (the r09
+    registry used to collide them).
+
+The ``fleet`` marker selects this suite; the deterministic --quick
+slice of tools/serving_load.py --fleet runs in tier-1 here, the full
+sweep stays behind ``-m slow``.
+"""
+
+import contextlib
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as obs
+from paddle_tpu import flags
+from paddle_tpu.generation.fleet import FleetRouter
+from paddle_tpu.generation.serving import ServingEngine
+from paddle_tpu.kernels.paged_attention import PagedKVCache
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.testing import faults
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import serving_load  # noqa: E402
+
+pytestmark = pytest.mark.fleet
+
+
+@contextlib.contextmanager
+def set_flags(**kw):
+    prev = {k: flags.get_flag(k) for k in kw}
+    flags.set_flags(kw)
+    try:
+        yield
+    finally:
+        flags.set_flags(prev)
+
+
+def gpt_model(seed=211):
+    paddle.seed(seed)
+    return GPTForCausalLM(GPTConfig.tiny())
+
+
+def counter_value(name, **labels):
+    """One series' current value from the process registry (counters
+    are cumulative process-wide — tests isolate via unique replica
+    ids, not via resets)."""
+    fam = obs.snapshot()["metrics"].get(name)
+    if fam is None:
+        return 0.0
+    for s in fam["series"]:
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            return s.get("value", s.get("count", 0.0))
+    return 0.0
+
+
+def org_prompts(n_orgs, body_count, prefix_tokens, body_tokens, seed=5,
+                vocab=256):
+    """Per-org shared-prefix prompts: the affinity/tiering workload."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for oi in range(n_orgs):
+        prefix = rng.integers(0, vocab, (prefix_tokens,)).astype(np.int32)
+        for _ in range(body_count):
+            body = rng.integers(0, vocab, (body_tokens,)).astype(np.int32)
+            out.append((oi, np.concatenate([prefix, body])))
+    return out
+
+
+class TestRouting:
+    """Placement policy: affinity -> deadline-aware balance ->
+    round-robin fallback."""
+
+    def test_cold_prompts_round_robin(self):
+        model = gpt_model()
+        fleet = FleetRouter(model, replicas=3, max_batch=2, page_size=8,
+                            max_seq_len=64)
+        rng = np.random.default_rng(0)
+        for _ in range(6):
+            fleet.submit(rng.integers(0, 256, (9,)).astype(np.int32), 2)
+        reasons = [w for _, _, w in fleet.placements]
+        assert reasons == ["round_robin"] * 6
+        # uniform spread: two full cycles over the three replicas
+        ris = [ri for _, ri, _ in fleet.placements]
+        assert ris == [0, 1, 2, 0, 1, 2]
+        fleet.run()
+
+    def test_affinity_routes_to_warm_replica(self):
+        model = gpt_model()
+        fleet = FleetRouter(model, replicas=3, max_batch=2, page_size=8,
+                            max_seq_len=64)
+        prompts = org_prompts(1, 3, 16, 1, seed=3)
+        # warm replica 1 with the org prefix (pinned placement)
+        r0 = fleet.submit(prompts[0][1], 3, replica=1)
+        out = fleet.run()
+        # same-prefix follow-ups must chase the cache to replica 1
+        rids = [fleet.submit(p, 3) for _, p in prompts[1:]]
+        placed = {rid: (ri, why) for rid, ri, why in fleet.placements}
+        for rid in rids:
+            assert placed[rid] == (1, "affinity"), placed[rid]
+        out2 = fleet.run()
+        # bit-identity: an affinity hit adopts shared pages, and the
+        # continuation still equals the cold decode of the same prompt
+        solo = FleetRouter(model, replicas=1, max_batch=2, page_size=8,
+                           max_seq_len=64)
+        srids = [solo.submit(p, 3) for _, p in prompts]
+        sout = solo.run()
+        assert out[r0] == sout[srids[0]]
+        assert [out2[r] for r in rids] == [sout[r] for r in srids[1:]]
+
+    def test_round_robin_policy_ignores_cache(self):
+        model = gpt_model()
+        fleet = FleetRouter(model, replicas=3, policy="round_robin",
+                            max_batch=2, page_size=8, max_seq_len=64)
+        prompts = org_prompts(1, 4, 16, 1, seed=4)
+        for _, p in prompts:
+            fleet.submit(p, 2)
+        fleet.run()
+        reasons = {w for _, _, w in fleet.placements}
+        assert reasons == {"round_robin"}
+
+    def test_balance_tiebreak_prefers_less_loaded(self):
+        model = gpt_model()
+        fleet = FleetRouter(model, replicas=2, max_batch=2, page_size=8,
+                            max_seq_len=64)
+        prompts = org_prompts(1, 4, 16, 1, seed=6)
+        # warm BOTH replicas with the same prefix
+        fleet.submit(prompts[0][1], 2, replica=0)
+        fleet.submit(prompts[1][1], 2, replica=1)
+        fleet.run()
+        # pile deadline-free work on replica 0, then place: the
+        # affinity tie must break toward the emptier replica 1
+        fleet.submit(prompts[2][1], 8, replica=0)
+        rid = fleet.submit(prompts[3][1], 2)
+        placed = {r: (ri, why) for r, ri, why in fleet.placements}
+        assert placed[rid] == (1, "balance"), placed[rid]
+        fleet.run()
+
+    def test_unknown_policy_rejected(self):
+        with pytest.raises(ValueError):
+            FleetRouter(gpt_model(), replicas=2, policy="hash")
+        with pytest.raises(ValueError):
+            FleetRouter(gpt_model(), replicas=0)
+
+    def test_streaming_callback_carries_fleet_rid(self):
+        model = gpt_model()
+        fleet = FleetRouter(model, replicas=2, max_batch=2, page_size=8,
+                            max_seq_len=64)
+        seen = []
+        rng = np.random.default_rng(9)
+        rid = fleet.submit(rng.integers(0, 256, (7,)).astype(np.int32), 3,
+                           on_token=lambda r, t, d: seen.append((r, t, d)))
+        out = fleet.run()
+        toks = [t for r, t, d in seen if not d]
+        assert {r for r, _, _ in seen} == {rid}
+        assert toks == out[rid]
+        assert seen[-1] == (rid, None, True)
+
+
+class TestPreemption:
+    """SLO-aware preemption: replay-from-host-state IS the preemption
+    mechanism, so a victim's resumed greedy continuation is
+    bit-identical — and every knob bounds it."""
+
+    def _run(self, model, preempt, deadline=0.8, budget=None):
+        ctx = {"serving_preempt": preempt}
+        if budget is not None:
+            ctx["serving_preempt_budget"] = budget
+        rng = np.random.default_rng(13)
+        long_prompts = [rng.integers(0, 256, (10,)).astype(np.int32)
+                        for _ in range(2)]
+        tight_prompt = rng.integers(0, 256, (6,)).astype(np.int32)
+        with set_flags(**ctx):
+            eng = ServingEngine(model, max_batch=2, page_size=8,
+                                max_seq_len=64,
+                                replica=f"pre{preempt}{budget}")
+            brids = [eng.submit(p, 24) for p in long_prompts]
+            # both slots decoding before the tight arrival lands
+            for _ in range(4):
+                eng.run_step()
+            trid = eng.submit(tight_prompt, 3, deadline=deadline)
+            out = eng.run(max_wall=60.0)
+            st = {r: eng.status(r) for r in brids + [trid]}
+        return eng, out, st, brids, trid
+
+    def test_preempt_bit_identity(self):
+        model = gpt_model()
+        # warmup compiles every program both arms touch, so the tight
+        # deadline never races a first-trace compile
+        self._run(model, preempt=False, deadline=60.0)
+        eng_off, out_off, st_off, b_off, t_off = self._run(
+            model, preempt=False, deadline=30.0)
+        eng_on, out_on, st_on, b_on, t_on = self._run(
+            model, preempt=True, deadline=0.8)
+        assert eng_on.preemptions >= 1
+        assert eng_off.preemptions == 0
+        assert all(s == "OK" for s in st_on.values()), st_on
+        assert all(s == "OK" for s in st_off.values()), st_off
+        # victims AND the tight request: identical greedy tokens
+        assert [out_on[r] for r in b_on] == [out_off[r] for r in b_off]
+        assert out_on[t_on] == out_off[t_off]
+        # per-replica preemption counters landed on the on-arm's series
+        assert counter_value("serving_preemptions",
+                             replica=eng_on.replica) >= 1
+        assert counter_value("serving_preemptions",
+                             replica=eng_off.replica) == 0
+
+    def test_budget_zero_never_preempts(self):
+        model = gpt_model()
+        self._run(model, preempt=False, deadline=60.0)      # warm
+        eng, out, st, _, _ = self._run(model, preempt=True, budget=0)
+        assert eng.preemptions == 0
+        assert all(s == "OK" for s in st.values()), st
+
+    def test_comfortable_slack_waits_in_line(self):
+        model = gpt_model()
+        self._run(model, preempt=False, deadline=60.0)      # warm
+        # slack 30s >> horizon 1s: no preemption, the arrival queues
+        eng, out, st, _, _ = self._run(model, preempt=True, deadline=30.0)
+        assert eng.preemptions == 0
+        assert all(s == "OK" for s in st.values()), st
+
+    def test_preempt_fault_recovers_bit_identical(self):
+        model = gpt_model()
+        self._run(model, preempt=False, deadline=60.0)      # warm
+        _, out_ref, st_ref, b_ref, t_ref = self._run(
+            model, preempt=True, deadline=0.8)
+        with faults.armed("preempt:every=1:times=1",
+                          serving_retry_backoff=0.001):
+            eng, out, st, brids, trid = self._run(
+                model, preempt=True, deadline=0.8)
+        assert all(s == "OK" for s in st.values()), st
+        assert [out[r] for r in brids] == [out_ref[r] for r in b_ref]
+        assert out[trid] == out_ref[t_ref]
+
+
+class TestTiering:
+    """Host-RAM KV tier: spill on eviction pressure, restore on
+    adoption, budget-bounded, bit-identical."""
+
+    def _pool(self, num_pages=8):
+        return PagedKVCache(num_layers=2, num_pages=num_pages,
+                            page_size=8, num_kv_heads=2, head_dim=4,
+                            max_batch=2, max_seq_len=64,
+                            dtype=np.float32)
+
+    def test_spill_restore_round_trips_bytes(self):
+        import jax.numpy as jnp
+
+        pool = self._pool()
+        rng = np.random.default_rng(0)
+        pid = pool.take_free_page()
+        want_k, want_v = [], []
+        for i in range(2):
+            k = rng.standard_normal((2, 8, 4)).astype(np.float32)
+            v = rng.standard_normal((2, 8, 4)).astype(np.float32)
+            pool.k_pages[i] = pool.k_pages[i].at[:, pid].set(k)
+            pool.v_pages[i] = pool.v_pages[i].at[:, pid].set(v)
+            want_k.append(k)
+            want_v.append(v)
+        host = pool.spill_page(pid)
+        assert pool.ledger()["pages_spilled"] == 1
+        assert host.nbytes == pool.bytes_per_page
+        pool.unref_page(pid)
+        # scribble over the recycled device page, then restore into a
+        # fresh one: the host copy must round-trip bit-exactly
+        new = pool.take_free_page()
+        pool.restore_page(host, new)
+        assert pool.ledger()["pages_spilled"] == 0
+        for i in range(2):
+            np.testing.assert_array_equal(
+                np.asarray(pool.k_pages[i][:, new]), want_k[i])
+            np.testing.assert_array_equal(
+                np.asarray(pool.v_pages[i][:, new]), want_v[i])
+
+    def test_engine_round_trip_bit_identical_under_pressure(self):
+        model = gpt_model()
+        # 4 orgs x 4 prompt pages = 16-page working set vs 11 usable
+        # device pages: round 1 spills, round 2 restores on adoption
+        prompts = org_prompts(4, 1, 24, 8, seed=21)
+        rounds = [p for _, p in prompts] * 2
+
+        def run(tiered, tag):
+            eng = ServingEngine(
+                model, max_batch=1, page_size=8, max_seq_len=64,
+                prefix_cache=True,
+                num_pages=12 if tiered else 64,
+                host_tier_pages=64 if tiered else 0,
+                replica=tag)
+            outs = []
+            for p in rounds:
+                rid = eng.submit(p.copy(), 4)
+                outs.append(eng.run(max_wall=60.0)[rid])
+            return eng, outs
+
+        ref_eng, ref = run(False, "tref")
+        tier_eng, tier = run(True, "ttier")
+        assert tier == ref          # zero correctness drift
+        spilled = counter_value("prefix_cache_spilled_pages",
+                                replica="ttier")
+        restored = counter_value("prefix_cache_restored_pages",
+                                 replica="ttier")
+        assert spilled >= 1 and restored >= 1, (spilled, restored)
+        assert tier_eng._host_tier_peak >= 1
+        # the registered working set genuinely exceeded the device pool
+        assert 4 * 4 > 12 - 1
+
+    def test_host_budget_drops_coldest(self):
+        # SHORT (2-page) chains so whole chains spill — a fully
+        # spilled chain's leaf is what budget pressure drops; long
+        # chains would instead cap by refusing new spills (their
+        # spilled prefix is interior, never droppable)
+        model = gpt_model()
+        prompts = org_prompts(6, 1, 8, 8, seed=22)
+        eng = ServingEngine(model, max_batch=1, page_size=8,
+                            max_seq_len=64, prefix_cache=True,
+                            num_pages=9, host_tier_pages=2,
+                            replica="tbudget")
+        for _ in range(2):
+            for _, p in prompts:
+                rid = eng.submit(p.copy(), 4)
+                eng.run(max_wall=60.0)
+        # the tier NEVER exceeds its 2-page budget (hard bound, the
+        # memwatch host-RAM pricing contract); overflow dropped
+        assert eng._prefix.spilled_page_count() <= 2
+        assert eng.pool.ledger()["pages_spilled"] <= 2
+        assert counter_value("prefix_cache_dropped_spilled_pages",
+                             replica="tbudget") >= 1
+
+    def test_peek_excludes_spilled_by_default(self):
+        model = gpt_model()
+        prompt = org_prompts(1, 1, 24, 8, seed=23)[0][1]
+        eng = ServingEngine(model, max_batch=1, page_size=8,
+                            max_seq_len=64, prefix_cache=True,
+                            num_pages=16, host_tier_pages=8,
+                            replica="tpeek")
+        rid = eng.submit(prompt.copy(), 2)
+        eng.run(max_wall=60.0)
+        warm = eng._prefix.peek(prompt)
+        assert warm >= 8            # prompt pages cached on device
+        spilled = eng._prefix.spill(16)
+        assert spilled >= 1
+        # admission pricing ignores host-resident pages; the fleet
+        # affinity probe opts in
+        assert eng._prefix.peek(prompt) == 0
+        assert eng._prefix.peek(prompt, include_spilled=True) == warm
+
+    def test_spill_fault_recovers_bit_identical(self):
+        model = gpt_model()
+        prompts = org_prompts(4, 1, 24, 8, seed=24)
+        rounds = [p for _, p in prompts] * 2
+
+        def run(tag, spec=None):
+            eng = ServingEngine(model, max_batch=1, page_size=8,
+                                max_seq_len=64, prefix_cache=True,
+                                num_pages=12, host_tier_pages=64,
+                                replica=tag)
+            outs = []
+            for p in rounds:
+                rid = eng.submit(p.copy(), 4)
+                outs.append(eng.run(max_wall=60.0)[rid])
+            return outs
+
+        ref = run("sfref")
+        with faults.armed("kv_spill:every=3:times=2",
+                          serving_retry_backoff=0.001):
+            chaos = run("sfchaos")
+        assert chaos == ref
+
+
+class TestReplicaLoss:
+    """The router_dispatch drill: a lost replica's work re-routes from
+    host state and finishes bit-identically on the survivors."""
+
+    def _submit_mix(self, fleet):
+        rng = np.random.default_rng(31)
+        shared = org_prompts(2, 3, 16, 1, seed=32)
+        rids = []
+        for _, p in shared:
+            rids.append(fleet.submit(p, 4))
+        for _ in range(2):
+            rids.append(fleet.submit(
+                rng.integers(0, 256, (9,)).astype(np.int32), 4))
+        return rids
+
+    def test_loss_reroutes_bit_identical(self):
+        model = gpt_model()
+        base = FleetRouter(model, replicas=2, max_batch=2, page_size=8,
+                           max_seq_len=64)
+        brids = self._submit_mix(base)
+        bout = base.run(max_wall=120.0)
+        with faults.armed("router_dispatch:every=4:times=2"):
+            fleet = FleetRouter(model, replicas=2, max_batch=2,
+                                page_size=8, max_seq_len=64)
+            rids = self._submit_mix(fleet)
+            out = fleet.run(max_wall=120.0)
+        assert fleet.losses >= 1
+        assert fleet.rerouted >= 1
+        st = {r: fleet.status(r) for r in rids}
+        assert all(s == "OK" for s in st.values()), st
+        assert [out[r] for r in rids] == [bout[r] for r in brids]
+        assert not fleet.has_work()
+
+    def test_crash_loop_bounded_by_loss_budget(self):
+        model = gpt_model()
+        with faults.armed("router_dispatch:every=1"):    # unbounded
+            fleet = FleetRouter(model, replicas=2, max_batch=2,
+                                page_size=8, max_seq_len=64)
+            self._submit_mix(fleet)
+            with pytest.raises(faults.InjectedFault):
+                fleet.run(max_wall=60.0)
+
+    def test_raising_callback_is_not_a_loss(self):
+        """The engine contract: a raising user streaming callback
+        surfaces to the caller — the router must not read it as a
+        replica loss and replay the whole replica."""
+        model = gpt_model()
+        fleet = FleetRouter(model, replicas=2, max_batch=2, page_size=8,
+                            max_seq_len=64)
+        rng = np.random.default_rng(43)
+
+        def bad_cb(rid, tok, done):
+            raise ValueError("client bug")
+
+        fleet.submit(rng.integers(0, 256, (7,)).astype(np.int32), 3,
+                     on_token=bad_cb)
+        with pytest.raises(ValueError, match="client bug"):
+            while fleet.has_work():
+                fleet.run_step()
+        assert fleet.losses == 0
+
+    def test_results_survive_loss(self):
+        """Completed work banks ABOVE the engines: a replica loss after
+        some requests finished must not lose their tokens."""
+        model = gpt_model()
+        fleet = FleetRouter(model, replicas=2, max_batch=2, page_size=8,
+                            max_seq_len=64)
+        rng = np.random.default_rng(41)
+        rids = [fleet.submit(rng.integers(0, 256, (7,)).astype(np.int32),
+                             3) for _ in range(4)]
+        while fleet.has_work() and not fleet.results():
+            fleet.run_step()
+        # forcibly lose both replicas; finished results must survive
+        for ri in range(2):
+            if fleet.engines[ri].has_work():
+                fleet._lose_replica(ri, RuntimeError("test loss"))
+        out = fleet.run(max_wall=120.0)
+        assert sorted(out) == sorted(rids)
+        assert all(fleet.status(r) == "OK" for r in rids)
+
+
+class TestReplicaLabels:
+    """The r14 satellite fix: two engines in one process must land on
+    DISTINCT per-replica metric series (they used to collide)."""
+
+    def test_engine_series_do_not_collide(self):
+        model = gpt_model()
+        rng = np.random.default_rng(51)
+        engs = [ServingEngine(model, max_batch=2, page_size=8,
+                              max_seq_len=64, replica=f"lbl{i}")
+                for i in range(2)]
+        for n, eng in zip((1, 2), engs):
+            for _ in range(n):
+                eng.submit(rng.integers(0, 256, (6,)).astype(np.int32), 2)
+            eng.run(max_wall=60.0)
+        assert counter_value("serving_requests_submitted",
+                             replica="lbl0") == 1
+        assert counter_value("serving_requests_submitted",
+                             replica="lbl1") == 2
+        # the kv gauges split per replica too (state x replica series)
+        fam = obs.snapshot()["metrics"]["kv_pool_pages"]
+        reps = {s["labels"]["replica"] for s in fam["series"]}
+        assert {"lbl0", "lbl1"} <= reps
+
+    def test_fleet_routing_counters(self):
+        model = gpt_model()
+        fleet = FleetRouter(model, replicas=2, max_batch=2, page_size=8,
+                            max_seq_len=64)
+        rng = np.random.default_rng(52)
+        before = counter_value("fleet_requests_routed", replica="0",
+                               reason="round_robin")
+        fleet.submit(rng.integers(0, 256, (6,)).astype(np.int32), 2)
+        fleet.run()
+        after = counter_value("fleet_requests_routed", replica="0",
+                              reason="round_robin")
+        assert after == before + 1
+
+
+class TestQuickSlice:
+    """The deterministic --quick slice of the fleet acceptance bench
+    (tools/serving_load.py --fleet) runs in tier-1."""
+
+    @staticmethod
+    def _assert_acceptance(doc):
+        assert doc["ok"], json.dumps(
+            {k: v for k, v in doc.items()
+             if k not in ("telemetry", "memory")}, indent=1)
+        routing = doc["sections"]["routing"]
+        assert routing["parity_bit_identical"]
+        assert routing["ttft_p99_ratio"] < 1.0
+        aff = routing["arms"]["prefix_affinity"]
+        assert aff["placements"]["affinity"] > 0
+        pre = doc["sections"]["preemption"]
+        assert pre["victims_bit_identical"] and pre["slo_bit_identical"]
+        assert pre["preempt_on"]["preemptions"] > 0
+        assert pre["preempt_off"]["preemptions"] == 0
+        assert pre["slo_ttft_p99_ratio"] < 1.0
+        tier = doc["sections"]["tiering"]
+        assert tier["parity_bit_identical"]
+        assert tier["spilled_pages"] > 0 and tier["restored_pages"] > 0
+        assert (tier["prefix_working_set_pages"]
+                > tier["device_pages"])
+        assert "metrics" in doc["telemetry"]
+
+    def test_quick_slice_meets_acceptance(self):
+        doc = serving_load.bench_fleet(seed=712, quick=True)
+        self._assert_acceptance(doc)
+
+    def test_banked_artifact_matches_schema(self):
+        path = os.path.join(os.path.dirname(__file__), "..",
+                            "FLEET_LOAD_r14.json")
+        if not os.path.exists(path):
+            pytest.skip("artifact not banked in this checkout")
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["schema"] == serving_load.FLEET_SCHEMA
+        assert doc["bench"] == "fleet_load"
+        self._assert_acceptance(doc)
+
+    @pytest.mark.slow
+    def test_full_sweep(self):
+        doc = serving_load.bench_fleet(seed=712, quick=False)
+        self._assert_acceptance(doc)

@@ -21,6 +21,17 @@ Arms (each runs a fault-free baseline first, then the chaos pass):
               unbounded ``every=N`` below the chunks-per-prompt count
               is a genuinely wedged backend, which the no-progress
               budget rightly terminates FAILED.
+  fleet       The r14 multi-replica router under fire: a 2-replica
+              ``FleetRouter`` (prefix cache + host-RAM KV tier armed)
+              with ``router_dispatch`` killing whole replicas
+              (recovery = harvest host-side request state, rebuild the
+              replica, re-route the harvest through normal placement
+              across the fleet), ``kv_spill`` dying mid-spill/restore,
+              and ``preempt`` dying as a victim is unseated — tight-
+              deadline arrivals drive real preemptions. Same bar:
+              every request OK with BIT-IDENTICAL greedy tokens vs the
+              fault-free fleet, ``fleet_replica_losses`` and re-routes
+              observed, the fleet drained.
   training    ``Model.fit`` under ``train_dispatch`` faults (+ one
               injected ``checkpoint_save`` failure): training completes,
               the emergency checkpoint lands, the final loss is finite.
@@ -52,6 +63,9 @@ CHUNKED_SPEC = ("chunk_prefill:every=3:times=2;"
 TRAIN_SPEC = ("train_dispatch:every=5:times=3;"
               "checkpoint_save:every=1:times=1")
 LOADER_SPEC = "dataloader_worker:every=3:times=1"
+FLEET_SPEC = ("router_dispatch:every=6:times=2;"
+              "kv_spill:every=3:times=2;"
+              "preempt:every=1:times=1")
 
 
 def emit(d):
@@ -88,6 +102,10 @@ TRAIN_COUNTERS = (
     "faults_injected", "train_retries_total", "train_recoveries",
     "train_emergency_checkpoints", "train_nan_losses")
 LOADER_COUNTERS = ("faults_injected", "io_worker_restarts")
+FLEET_COUNTERS = SERVING_COUNTERS + (
+    "fleet_replica_losses", "fleet_rerouted_requests",
+    "serving_preemptions", "prefix_cache_spilled_pages",
+    "prefix_cache_restored_pages")
 
 
 def drill_serving(n_requests, max_new):
@@ -182,6 +200,102 @@ def drill_serving_chunked(n_requests, max_new):
     return row
 
 
+def drill_fleet(max_new):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import flags as _flags
+    from paddle_tpu.generation.fleet import FleetRouter
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.testing import faults
+
+    paddle.seed(57)
+    model = GPTForCausalLM(GPTConfig.tiny())
+    rng = np.random.default_rng(23)
+    # shared-prefix orgs whose working set (4 orgs x 4 prompt pages)
+    # exceeds the 13-usable-page device pool -> the host tier spills
+    # and restores under normal operation, so kv_spill has real fires
+    orgs = [rng.integers(0, 256, (24,)).astype(np.int32)
+            for _ in range(4)]
+    shared = []
+    for _ in range(2):
+        for pf in orgs:
+            body = rng.integers(0, 256, (8,)).astype(np.int32)
+            shared.append(np.concatenate([pf, body]))
+    long_prompts = [rng.integers(0, 256, (10,)).astype(np.int32)
+                    for _ in range(4)]
+    tight_prompts = [rng.integers(0, 256, (6,)).astype(np.int32)
+                     for _ in range(2)]
+
+    def run_fleet():
+        # 10 usable pages/replica vs ~2 orgs x 4 cached pages + a
+        # 5-page live request: eviction pressure spills to the host
+        # tier in steady state, so kv_spill has real fires
+        fleet = FleetRouter(model, replicas=2, max_batch=2, page_size=8,
+                            max_seq_len=64, num_pages=11,
+                            host_tier_pages=64)
+        # saturate every slot with no-deadline long generations first,
+        # so the deadline-bearing arrivals below genuinely PREEMPT
+        rids = [fleet.submit(p, 24, replica=i % 2)
+                for i, p in enumerate(long_prompts)]
+        for _ in range(6):
+            fleet.run_step()
+        rids += [fleet.submit(p, 3, deadline=20.0)
+                 for p in tight_prompts]
+        rids += [fleet.submit(p, max_new) for p in shared]
+        out = fleet.run(max_wall=300.0)
+        return fleet, rids, out
+
+    prev = {"serving_preempt_horizon": _flags.get_flag(
+        "serving_preempt_horizon")}
+    # wide horizon: preemption triggers on queue pressure, not on a
+    # wall-clock race the drill box would have to win
+    _flags.set_flags({"serving_preempt_horizon": 30.0})
+    try:
+        bfleet, brids, bout = run_fleet()
+        baseline = [bout.get(r) for r in brids]
+        base_status = [bfleet.status(r) for r in brids]
+        before = counters(*FLEET_COUNTERS)
+        with faults.armed(FLEET_SPEC, serving_retry_backoff=0.001,
+                          serving_max_retries=8):
+            fleet, rids, out = run_fleet()
+            chaos = [out.get(r) for r in rids]
+            status = [fleet.status(r) for r in rids]
+        ctr = delta(counters(*FLEET_COUNTERS), before)
+    finally:
+        _flags.set_flags(prev)
+
+    def fires(site):
+        return ctr.get(f"faults_injected{{site={site}}}", 0)
+
+    # successful-preemption mechanics prove out on the BASELINE fleet
+    # (its engines are never rebuilt, so the host probe survives); the
+    # chaos arm proves the preempt-fault fire recovers bit-identically
+    # — after replay recovery the tight request admits first by slack,
+    # so the attempt does not necessarily recur
+    base_preempts = sum(e.preemptions for e in bfleet.engines)
+    ok = (chaos == baseline
+          and all(s == "OK" for s in status)
+          and all(s == "OK" for s in base_status)
+          and not fleet.has_work()
+          and fleet.losses >= 1 and fleet.rerouted >= 1
+          and fires("router_dispatch") >= 1
+          and fires("kv_spill") >= 1
+          and fires("preempt") >= 1
+          and base_preempts >= 1)
+    row = {"arm": "fleet", "ok": ok, "spec": FLEET_SPEC,
+           "requests": len(rids), "max_new_tokens": max_new,
+           "bit_identical": chaos == baseline,
+           "statuses": status,
+           "replica_losses": fleet.losses,
+           "rerouted_requests": fleet.rerouted,
+           "baseline_preemptions": base_preempts,
+           "chaos_preemptions": sum(e.preemptions
+                                    for e in fleet.engines),
+           "counters": ctr}
+    emit(row)
+    return row
+
+
 def drill_training(epochs):
     import numpy as np
     import paddle_tpu as paddle
@@ -267,7 +381,8 @@ def main():
     ap.add_argument("--max-new", type=int, default=6)
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--arms",
-                    default="serving,serving_chunked,training,dataloader")
+                    default="serving,serving_chunked,fleet,training,"
+                            "dataloader")
     args = ap.parse_args()
 
     import jax
@@ -279,6 +394,8 @@ def main():
     if "serving_chunked" in want:
         arms["serving_chunked"] = drill_serving_chunked(
             args.requests, args.max_new)
+    if "fleet" in want:
+        arms["fleet"] = drill_fleet(args.max_new)
     if "training" in want:
         arms["training"] = drill_training(args.epochs)
     if "dataloader" in want:

@@ -72,7 +72,8 @@ def cmd_plan(args) -> int:
     dims = _dims(args.model)
     kw = dict(page_size=args.page_size, max_batch=args.rung,
               max_seq_len=args.max_seq, chunk=args.chunk,
-              weight_dtype=args.weight_dtype, kv_dtype=args.kv_dtype)
+              weight_dtype=args.weight_dtype, kv_dtype=args.kv_dtype,
+              host_tier_pages=args.host_tier_pages)
     plan = memwatch.estimate_engine_memory(
         dims, page_budget=args.page_budget, **kw)
     hbm = int(args.hbm_gb * GB)
@@ -84,13 +85,26 @@ def cmd_plan(args) -> int:
     print(f"# memwatch plan: {args.model} weights={args.weight_dtype} "
           f"kv={args.kv_dtype} rung={args.rung} chunk={args.chunk} "
           f"pages={plan['config']['usable_pages']}x{args.page_size} "
-          f"max_seq={args.max_seq}")
+          f"max_seq={args.max_seq} host_tier={args.host_tier_pages}")
     for k, v in plan["breakdown"].items():
         print(f"  {k:32s} {fmt(v)}")
-    print(f"  {'TOTAL':32s} {fmt(plan['total'])}")
+    print(f"  {'TOTAL (device HBM)':32s} {fmt(plan['total'])}")
     print(f"  {'HBM':32s} {fmt(hbm)}")
     print(f"  -> {'FITS' if verdict['fits'] else 'DOES NOT FIT'} "
           f"(headroom {verdict['headroom_bytes'] / GB:+.3f} GB)")
+    # host-RAM KV tier: priced jointly, billed to host not HBM — the
+    # serving ledger's kv_pool_bytes{state="spilled"} /
+    # kv_host_tier_peak_pages gauges report the live tier against this
+    ht = plan["host_tier"]
+    if ht["pages"]:
+        eff = ht["pages"] + plan["config"]["usable_pages"]
+        print(f"  {'host KV tier (host RAM)':32s} {fmt(ht['bytes'])}  "
+              f"[{ht['pages']} pages -> effective prefix working set "
+              f"{eff} pages]")
+        if args.host_ram_gb:
+            host = int(args.host_ram_gb * GB)
+            print(f"  {'host RAM':32s} {fmt(host)}  "
+                  f"(tier headroom {(host - ht['bytes']) / GB:+.3f} GB)")
     # the planner's most actionable number: the largest page budget
     # that still fits this config (binary search over the analytic
     # model — each probe is arithmetic, not a compile)
@@ -311,6 +325,13 @@ def main() -> int:
     p.add_argument("--chunk", type=int, default=256)
     p.add_argument("--max-seq", type=int, default=2048)
     p.add_argument("--hbm-gb", type=float, default=16.0)
+    p.add_argument("--host-tier-pages", type=int, default=0,
+                   help="host-RAM KV tier pages "
+                        "(FLAGS_serving_kv_host_tier_pages): priced "
+                        "against host RAM, jointly with device HBM")
+    p.add_argument("--host-ram-gb", type=float, default=0.0,
+                   help="report host-tier headroom against this much "
+                        "host RAM (0 = just report tier bytes)")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_plan)
 

@@ -1,4 +1,5 @@
-"""Seeded Poisson multi-tenant load generator for the serving engine.
+"""Seeded Poisson multi-tenant load generator for the serving engine
+and (``--fleet``) the multi-replica fleet router.
 
 The acceptance bench for the r12 production continuous-batching loop:
 a deterministic (seeded) open-loop Poisson request stream from several
@@ -25,6 +26,31 @@ plus a cross-arm greedy BIT-IDENTITY check (same schedule, same rids,
 same tokens). ``--out SERVING_LOAD_r12.json`` banks the ledger;
 ``--quick`` is the deterministic tier-1 slice driven by
 tests/test_serving_load.py (marker ``serving_load``).
+
+``--fleet`` (r14) runs the FLEET acceptance bench instead — three
+sections over ``paddle_tpu/generation/fleet.py``:
+
+  routing     N replicas, per-org shared-prefix tenants, Poisson
+              arrivals, prefix-AFFINITY vs ROUND-ROBIN arms: affinity
+              concentrates each org's prefix on one replica (shared
+              admissions skip prefill) while round-robin smears it
+              across all N and thrashes eviction — TTFT p99 must be
+              lower under affinity, outputs bit-identical, with
+              per-replica telemetry deltas banked.
+  preemption  2 replicas saturated by no-deadline long generations
+              while tight-deadline arrivals land: FLAGS_serving_preempt
+              on vs off. The on-arm must hold tight-tenant TTFT p99
+              under the off-arm's while every preempted victim still
+              finishes bit-identically (replay-from-host-state IS the
+              preemption mechanism).
+  tiering     one replica whose device page budget is SMALLER than the
+              org-prefix working set, host tier armed, vs a big-pool
+              no-tier reference: spills + restores must occur, the
+              registered working set must exceed the device budget,
+              and every output must match the reference bit-for-bit.
+
+``--out FLEET_LOAD_r14.json`` banks that ledger; the quick slice is
+driven by tests/test_fleet.py (marker ``fleet``).
 """
 
 import argparse
@@ -300,6 +326,391 @@ def bench(per_tenant, seed, quick=False):
     }
 
 
+# ===================================================== fleet bench (r14)
+FLEET_SCHEMA = 1
+
+
+def replica_counter_deltas(before, after, names):
+    """Per-replica counter/histogram-count deltas: the per-replica
+    telemetry view the r14 `replica` label makes possible."""
+    out = {}
+    for name in names:
+        fa = after["metrics"].get(name)
+        if fa is None:
+            continue
+        prev = {}
+        fb = before["metrics"].get(name)
+        if fb is not None:
+            prev = {tuple(sorted(s["labels"].items())): s
+                    for s in fb["series"]}
+        for s in fa["series"]:
+            rep = s["labels"].get("replica", "")
+            b = prev.get(tuple(sorted(s["labels"].items())))
+            if "value" in s:
+                d = s["value"] - (b["value"] if b else 0.0)
+            else:
+                d = s["count"] - (b["count"] if b else 0)
+            if d:
+                out.setdefault(rep, {})[name] = round(d, 6)
+    return out
+
+
+_FLEET_REPLICA_FAMILIES = (
+    "serving_requests_submitted", "serving_prefills",
+    "serving_shared_admissions", "serving_ttft_seconds",
+    "prefix_cache_hits", "prefix_cache_misses",
+    "prefix_cache_hit_pages", "prefix_cache_evicted_pages",
+    "prefix_cache_spilled_pages", "prefix_cache_restored_pages",
+    "serving_preemptions", "serving_requests_timeout",
+    "fleet_requests_routed")
+
+
+def _fleet_model(cfg):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(1234)
+    mcfg = GPTConfig.tiny()
+    mcfg.max_position_embeddings = cfg["max_seq_len"]
+    return GPTForCausalLM(mcfg)
+
+
+def make_org_arrivals(n_orgs, per_org, prefix_len, body_len, vocab, seed,
+                      max_new, deadline=None, rate=20.0):
+    """Per-org shared-prefix Poisson arrivals: each org's prompts open
+    with the org's own ``prefix_len``-token system prompt."""
+    import numpy as np
+
+    arrivals = []
+    for oi in range(n_orgs):
+        rng = np.random.default_rng((seed, oi))
+        prefix = rng.integers(0, vocab, (prefix_len,)).astype(np.int32)
+        t = 0.0
+        for _ in range(per_org):
+            t += float(rng.exponential(1.0 / rate))
+            body = rng.integers(0, vocab, (body_len,)).astype(np.int32)
+            arrivals.append(dict(
+                t=t, tenant=f"org{oi}",
+                prompt=np.concatenate([prefix, body]),
+                max_new=int(max_new), deadline=deadline))
+    arrivals.sort(key=lambda a: (a["t"], a["tenant"]))
+    return arrivals
+
+
+def _drive_fleet(fleet, arrivals, max_wall=300.0):
+    """Deterministic step-indexed pacing (the r12 discipline): WHICH
+    router round each arrival lands on is a pure function of the
+    schedule, not machine load. TTFT comes from HOST stamps (submit
+    wall -> first streamed token wall) so the A/B compares exact
+    values, not histogram-bucket interpolations."""
+    import time as _time
+
+    due = [int(a["t"] * STEPS_PER_SEC) for a in arrivals]
+    submit_t, ttft = {}, {}
+
+    def cb(rid, tok, done):
+        if not done and rid not in ttft:
+            ttft[rid] = _time.perf_counter() - submit_t[rid]
+
+    rids, i, tick = [], 0, 0
+    t0 = _time.perf_counter()
+    while i < len(arrivals) or fleet.has_work():
+        if _time.perf_counter() - t0 > max_wall:
+            break
+        while i < len(arrivals) and due[i] <= tick:
+            a = arrivals[i]
+            ts = _time.perf_counter()
+            rid = fleet.submit(a["prompt"], a["max_new"],
+                               deadline=a["deadline"], on_token=cb)
+            submit_t[rid] = ts
+            rids.append(rid)
+            i += 1
+        tick += 1
+        if fleet.has_work():
+            fleet.run_step()
+    st = fleet.statuses()               # BEFORE the drain frees them
+    out = fleet.take_results()
+    return rids, out, {r: st.get(r, "PENDING") for r in rids}, ttft
+
+
+def fleet_routing_section(cfg, seed):
+    """Affinity vs round-robin A/B over identical arrivals. Pass 0 is
+    the WARMUP (programs compile, caches fill — each org's first
+    request is a cold miss under either policy); the measured passes
+    run the same schedule against the warm fleet, where affinity keeps
+    every org on its cache-resident replica while round-robin smears
+    the orgs across all replicas and thrashes eviction."""
+    import numpy as np
+
+    import paddle_tpu.observability as obs
+    from paddle_tpu.generation.fleet import FleetRouter
+
+    model = _fleet_model(cfg)
+    arrivals = make_org_arrivals(
+        cfg["orgs"], cfg["per_org"], cfg["prefix"], cfg["body"],
+        cfg["vocab"], seed, cfg["max_new"])
+
+    arms, outputs = {}, {}
+    for policy in ("prefix_affinity", "round_robin"):
+        fleet = FleetRouter(
+            model, replicas=cfg["replicas"], policy=policy,
+            max_batch=cfg["max_batch"], page_size=cfg["page_size"],
+            max_seq_len=cfg["max_seq_len"], num_pages=cfg["num_pages"])
+        _drive_fleet(fleet, arrivals)           # warmup pass
+        p99s, passes = [], []
+        before = obs.snapshot()
+        for _ in range(REPEATS):
+            rids, out, st, ttft = _drive_fleet(fleet, arrivals)
+            vals = [ttft[r] for r in rids if r in ttft]
+            q = {"p50": round(float(np.quantile(vals, 0.5)), 6),
+                 "p99": round(float(np.quantile(vals, 0.99)), 6)}
+            p99s.append(q["p99"])
+            passes.append(q)
+        after = obs.snapshot()
+        arms[policy] = {
+            "requests": len(rids),
+            "all_ok": all(s == "OK" for s in st.values()),
+            # min over passes: the structural gap (prefill skipped vs
+            # re-run) recurs every pass, a one-off OS spike does not
+            "ttft_p99_s": min(p99s),
+            "ttft_per_pass": passes,
+            "per_replica": replica_counter_deltas(
+                before, after, _FLEET_REPLICA_FAMILIES),
+            "placements": {why: sum(1 for _, _, w in fleet.placements
+                                    if w == why)
+                           for why in ("affinity", "balance",
+                                       "round_robin", "pinned")},
+        }
+        outputs[policy] = {r: out.get(r, []) for r in rids}
+    parity = outputs["prefix_affinity"] == outputs["round_robin"]
+    aff, rr = arms["prefix_affinity"], arms["round_robin"]
+    ok = (parity and aff["all_ok"] and rr["all_ok"]
+          and aff["ttft_p99_s"] < rr["ttft_p99_s"]
+          and aff["placements"]["affinity"] > 0)
+    return {"arms": arms, "parity_bit_identical": parity,
+            "ttft_p99_ratio": round(
+                aff["ttft_p99_s"] / rr["ttft_p99_s"], 4)
+            if rr["ttft_p99_s"] else None,
+            "ok": bool(ok)}
+
+
+def fleet_preemption_section(cfg, seed):
+    """Tight-deadline p99 under overload: FLAGS_serving_preempt A/B."""
+    import numpy as np
+
+    import paddle_tpu.observability as obs
+    from paddle_tpu import flags
+    from paddle_tpu.generation.fleet import FleetRouter
+
+    model = _fleet_model(cfg)
+    rng = np.random.default_rng((seed, 99))
+    batch_prompts = [rng.integers(0, cfg["vocab"], (12,)).astype(np.int32)
+                     for _ in range(cfg["replicas"] * cfg["max_batch"])]
+    slo_prompts = [rng.integers(0, cfg["vocab"], (10,)).astype(np.int32)
+                   for _ in range(cfg["slo_requests"])]
+
+    # one warmup fleet compiles everything both arms touch: chunked
+    # prefill (all prompts AND replay feeds exceed the chunk, so no
+    # prompt length ever forces a fresh compile mid-measurement) plus
+    # the decode rung
+    warm = FleetRouter(model, replicas=1, max_batch=cfg["max_batch"],
+                       page_size=cfg["page_size"],
+                       max_seq_len=cfg["max_seq_len"],
+                       prefill_chunk=cfg["page_size"])
+    warm.submit(batch_prompts[0], 2)
+    warm.submit(slo_prompts[0], 2)
+    warm.run(max_wall=120.0)
+
+    def run_arm(preempt_on):
+        import time as _time
+
+        prev = {k: flags.get_flag(k) for k in
+                ("serving_preempt", "serving_preempt_horizon")}
+        flags.set_flags({"serving_preempt": preempt_on,
+                         "serving_preempt_horizon": 30.0})
+        try:
+            before = obs.snapshot()
+            fleet = FleetRouter(
+                model, replicas=cfg["replicas"],
+                max_batch=cfg["max_batch"], page_size=cfg["page_size"],
+                max_seq_len=cfg["max_seq_len"],
+                prefill_chunk=cfg["page_size"])
+            # saturate every slot with no-deadline long generations
+            brids = [fleet.submit(p, cfg["batch_tokens"],
+                                  replica=i % cfg["replicas"])
+                     for i, p in enumerate(batch_prompts)]
+            guard = 0
+            while any(e._slots.count(None) for e in fleet.engines) \
+                    and guard < 200:
+                fleet.run_step()        # until every slot is decoding
+                guard += 1
+            # tight-deadline arrivals land mid-overload; TTFT from
+            # host stamps, slo tenant only
+            submit_t, ttft = {}, {}
+
+            def cb(rid, tok, done):
+                if not done and rid not in ttft:
+                    ttft[rid] = _time.perf_counter() - submit_t[rid]
+
+            srids = []
+            for p in slo_prompts:
+                ts = _time.perf_counter()
+                rid = fleet.submit(p, cfg["slo_tokens"],
+                                   deadline=cfg["slo_deadline"],
+                                   on_token=cb)
+                submit_t[rid] = ts
+                srids.append(rid)
+            t0 = _time.perf_counter()
+            while fleet.has_work() and \
+                    _time.perf_counter() - t0 < 300.0:
+                fleet.run_step()
+            st = fleet.statuses()
+            out = fleet.take_results()
+            after = obs.snapshot()
+            import numpy as np
+            vals = [ttft[r] for r in srids if r in ttft]
+            preempts = sum(e.preemptions for e in fleet.engines)
+            return {
+                "batch": {r: out.get(r, []) for r in brids},
+                "slo": {r: out.get(r, []) for r in srids},
+                "statuses": {r: st.get(r, "PENDING")
+                             for r in brids + srids},
+                "slo_ttft_p99_s": round(
+                    float(np.quantile(vals, 0.99)), 6) if vals else None,
+                "slo_ttft_p50_s": round(
+                    float(np.quantile(vals, 0.5)), 6) if vals else None,
+                "preemptions": preempts,
+                "per_replica": replica_counter_deltas(
+                    before, after, _FLEET_REPLICA_FAMILIES),
+            }
+        finally:
+            flags.set_flags(prev)
+
+    on, off = run_arm(True), run_arm(False)
+    # the victims' outputs must be bit-identical across arms (replay IS
+    # preemption), and every request must end OK in the on-arm
+    batch_parity = on["batch"] == off["batch"]
+    slo_parity = on["slo"] == off["slo"]
+    ok = (batch_parity and slo_parity
+          and on["preemptions"] > 0 and off["preemptions"] == 0
+          and all(s == "OK" for s in on["statuses"].values())
+          and on["slo_ttft_p99_s"] is not None
+          and off["slo_ttft_p99_s"] is not None
+          and on["slo_ttft_p99_s"] < off["slo_ttft_p99_s"])
+    return {
+        "preempt_on": {k: v for k, v in on.items()
+                       if k not in ("batch", "slo")},
+        "preempt_off": {k: v for k, v in off.items()
+                        if k not in ("batch", "slo")},
+        "victims_bit_identical": batch_parity,
+        "slo_bit_identical": slo_parity,
+        "slo_ttft_p99_ratio": round(
+            on["slo_ttft_p99_s"] / off["slo_ttft_p99_s"], 4)
+        if off["slo_ttft_p99_s"] else None,
+        "ok": bool(ok)}
+
+
+def fleet_tiering_section(cfg, seed):
+    """Prefix working set > device page budget, host tier absorbing
+    the overflow, vs a big-pool no-tier reference."""
+    import numpy as np
+
+    import paddle_tpu.observability as obs
+    from paddle_tpu.generation.serving import ServingEngine
+
+    model = _fleet_model(cfg)
+    rng = np.random.default_rng((seed, 7))
+    ps = cfg["page_size"]
+    prefixes = [rng.integers(0, cfg["vocab"],
+                             (cfg["tier_prefix"],)).astype(np.int32)
+                for _ in range(cfg["tier_orgs"])]
+    rounds = []
+    for rnd in range(cfg["tier_rounds"]):
+        for pf in prefixes:
+            body = rng.integers(0, cfg["vocab"], (ps,)).astype(np.int32)
+            rounds.append(np.concatenate([pf, body]))
+
+    def run_arm(tiered):
+        eng = ServingEngine(
+            model, max_batch=1, page_size=ps,
+            max_seq_len=cfg["max_seq_len"], prefix_cache=True,
+            num_pages=(cfg["tier_device_pages"] + 1 if tiered else 256),
+            host_tier_pages=(cfg["tier_host_pages"] if tiered else 0),
+            replica="tier" if tiered else "ref")
+        outs = []
+        for p in rounds:
+            rid = eng.submit(p.copy(), cfg["max_new"])
+            out = eng.run(max_wall=120.0)
+            outs.append(out[rid])
+        return eng, outs
+
+    before = obs.snapshot()
+    ref_eng, ref = run_arm(False)
+    tier_eng, tier = run_arm(True)
+    after = obs.snapshot()
+    pr = replica_counter_deltas(before, after, _FLEET_REPLICA_FAMILIES)
+    spills = pr.get("tier", {}).get("prefix_cache_spilled_pages", 0)
+    restores = pr.get("tier", {}).get("prefix_cache_restored_pages", 0)
+    working_set = (cfg["tier_orgs"]
+                   * (-(-cfg["tier_prefix"] // ps) + 1))
+    parity = tier == ref
+    ok = (parity and spills > 0 and restores > 0
+          and working_set > cfg["tier_device_pages"])
+    return {
+        "device_pages": cfg["tier_device_pages"],
+        "host_tier_pages": cfg["tier_host_pages"],
+        "prefix_working_set_pages": working_set,
+        "spilled_pages": spills, "restored_pages": restores,
+        "host_tier_peak_pages": tier_eng._host_tier_peak,
+        "requests": len(rounds),
+        "parity_bit_identical": parity,
+        "ok": bool(ok)}
+
+
+def bench_fleet(seed, quick=False):
+    import jax
+
+    import paddle_tpu.observability as obs
+
+    # routing geometry: prompt = prefix + ONE body token, prefix a
+    # page multiple — a warm-cache hit adopts every prefix page and
+    # teacher-forces nothing, so TTFT(hit) is one decode step while
+    # TTFT(miss) pays the whole monolithic prefill; per-replica pools
+    # hold one org's working set comfortably but NOT all orgs', so
+    # round-robin placement thrashes eviction at steady state
+    cfg = (dict(vocab=256, replicas=3, max_batch=2, page_size=8,
+                max_seq_len=128, num_pages=33, orgs=3, per_org=6,
+                prefix=120, body=1, max_new=4,
+                slo_requests=3, slo_tokens=3, slo_deadline=20.0,
+                batch_tokens=48,
+                tier_orgs=5, tier_prefix=24, tier_rounds=2,
+                tier_device_pages=10, tier_host_pages=64)
+           if quick else
+           dict(vocab=256, replicas=3, max_batch=2, page_size=8,
+                max_seq_len=256, num_pages=79, orgs=4, per_org=10,
+                prefix=200, body=1, max_new=6,
+                slo_requests=5, slo_tokens=4, slo_deadline=20.0,
+                batch_tokens=72,
+                tier_orgs=6, tier_prefix=32, tier_rounds=3,
+                tier_device_pages=14, tier_host_pages=96))
+    sections = {
+        "routing": fleet_routing_section(cfg, seed),
+        "preemption": fleet_preemption_section(cfg, seed),
+        "tiering": fleet_tiering_section(cfg, seed),
+    }
+    ok = all(s["ok"] for s in sections.values())
+    return {
+        "schema": FLEET_SCHEMA, "bench": "fleet_load",
+        "backend": jax.default_backend(), "seed": seed,
+        "config": {k: (list(v) if isinstance(v, tuple) else v)
+                   for k, v in cfg.items()},
+        "sections": sections,
+        "ok": bool(ok),
+        "telemetry": obs.snapshot(),
+        "memory": obs.memory.section() if obs.enabled() else None,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=None,
@@ -310,9 +721,14 @@ def main():
     ap.add_argument("--seed", type=int, default=712)
     ap.add_argument("--quick", action="store_true",
                     help="the small deterministic tier-1 slice")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the r14 fleet acceptance bench (routing "
+                         "A/B + preemption + tiering) instead of the "
+                         "single-engine chunked/monolithic A/B")
     args = ap.parse_args()
 
-    doc = bench(args.per_tenant, args.seed, quick=args.quick)
+    doc = (bench_fleet(args.seed, quick=args.quick) if args.fleet
+           else bench(args.per_tenant, args.seed, quick=args.quick))
     brief = {k: v for k, v in doc.items() if k != "telemetry"}
     print(json.dumps(brief, indent=2, sort_keys=True))
     if args.out:

@@ -100,12 +100,17 @@ def render_memory(snap: dict, doc: dict = None) -> str:
     led = []
     for name in ("kv_pool_pages", "kv_pool_bytes"):
         for s in mets.get(name, {"series": []})["series"]:
-            led.append(f"  {name}{{state={s['labels']['state']}}} "
-                       f"= {s['value']:g}")
+            lbl = ",".join(f"{k}={v}" for k, v in
+                           sorted(s["labels"].items()))
+            led.append(f"  {name}{{{lbl}}} = {s['value']:g}")
     for name in ("kv_pool_fragmentation", "serving_kv_pages_in_use",
-                 "serving_prefix_pinned_pages"):
+                 "serving_prefix_pinned_pages",
+                 "kv_host_tier_peak_pages"):
         for s in mets.get(name, {"series": []})["series"]:
-            led.append(f"  {name} = {s['value']:g}")
+            lbl = ",".join(f"{k}={v}" for k, v in
+                           sorted(s["labels"].items()))
+            suffix = f"{{{lbl}}}" if lbl else ""
+            led.append(f"  {name}{suffix} = {s['value']:g}")
     if led:
         lines.append("# kv pool ledger")
         lines.extend(led)
