@@ -2,8 +2,9 @@
 
 The reference stack pairs its kernels with correctness tooling
 (FLAGS_check_nan_inf sanitizer layers, op-level debugging hooks); this
-package holds the *static* half: analyzers that catch trace-discipline
-and SPMD collective-discipline bugs at lint time instead of on-chip.
-See :mod:`.tracecheck` (TRC rules) and :mod:`.meshcheck` (MSH rules);
-``tools/analyze.py`` runs both over one shared parse.
+package holds the *static* half: analyzers that catch trace-discipline,
+SPMD collective-discipline, and recovery-discipline bugs at lint time
+instead of on-chip (or at drill time).  See :mod:`.tracecheck` (TRC
+rules), :mod:`.meshcheck` (MSH rules), and :mod:`.faultcheck` (FLT
+rules); ``tools/analyze.py`` runs all three over one shared parse.
 """

@@ -1,0 +1,64 @@
+"""faultcheck — a recovery-discipline static analyzer.
+
+tracecheck (r08) gates *trace* discipline and meshcheck (r11) gates
+*collective* discipline; faultcheck gates the invariants the r10–r14
+fault-tolerance arc established and every review pass since has
+re-checked by hand: replay-from-host-state only works when donated
+dispatches sit inside recovery seams, fault-site checks fire BEFORE the
+mutation they guard, exported replay state stays host-pure, retry loops
+carry budgets, and metric families keep one schema per name.  Fault
+drills only exercise the schedules you arm; the lint covers every seam
+on every run.
+
+Rules (all pure AST over the shared tracecheck parse):
+
+- **FLT001** donated dispatch of handoff-detached state (an argument
+  produced by ``take_*``/``donate_*``/``detach_*``) reachable outside a
+  recovery seam — no enclosing/covering ``try`` routes the failure
+  through ``take_*``/``install_*``/``_to_replay_form``-style recovery,
+  so a failed dispatch leaves the detached state dead with nobody to
+  rebuild it (reuses tracecheck's donor call graph).
+- **FLT002** fault-site ``check()`` ordered AFTER a state mutation it
+  guards (the r14 kv_spill "fire BEFORE mutation" rule, via
+  statement-dominance within the function): an injected fire must
+  propagate into replay recovery from a consistent state, never from a
+  half-applied one.
+- **FLT003** replay-state purity: a field of an exported
+  request/replay structure assigned from a ``jnp.``/device-producing
+  expression — replay state must be host values (device buffers die
+  with the pool the failure killed).
+- **FLT004** retry/backoff loop without a ``FLAGS_*max_retries``-style
+  bound, deadline, or progress mark — an unbounded sleep-retry loop
+  spins forever on a wedged backend instead of failing loudly.
+- **FLT005** metric-family label discipline: families registered from
+  per-replica code must bind the ``replica`` label, and re-registration
+  of one family name with mismatched label sets / kinds / bucket
+  layouts (the exact r14 fleet collision class, made static).
+- **FLT006** broad ``except`` in recovery-reachable code that neither
+  re-raises, counts a counter, nor sets a terminal status — a swallowed
+  failure inside the recovery machinery is an invisible wedge.
+
+Findings support inline ``# faultcheck: disable=FLT00x`` pragmas (suite
+-scoped: a tracecheck/meshcheck pragma never silences FLT rules) and a
+checked-in baseline (tools/faultcheck_baseline.json, kept empty — the
+r08/r11 precedent is fix, don't baseline); the tier-1 test gates NEW
+findings only.
+
+Run it locally::
+
+    python tools/analyze.py                     # all three suites
+    python tools/analyze.py --suite faultcheck
+    python tools/analyze.py --changed-only      # git-diff-scoped
+    python tools/analyze.py --format sarif      # CI annotation
+"""
+
+from ..tracecheck.findings import (Finding, fingerprint, load_baseline,
+                                   subtract_baseline, write_baseline)
+from .analyzer import AnalyzerConfig, AnalysisResult, analyze_package
+from .rules import FAULT_RULES
+
+__all__ = [
+    "AnalyzerConfig", "AnalysisResult", "Finding", "FAULT_RULES",
+    "analyze_package", "fingerprint", "load_baseline",
+    "subtract_baseline", "write_baseline",
+]

@@ -1,0 +1,90 @@
+"""Orchestration: parse (or reuse a parse), build the recovery model,
+run the FLT rules.
+
+``analyze_package`` mirrors tracecheck's and meshcheck's entry points
+and accepts the same :class:`ParsedPackage`, so the unified CLI
+(tools/analyze.py) runs all THREE suites over ONE ast.parse pass.  The
+context build is read-only over the shared ``ModuleInfo`` objects (the
+donor pass re-derives tracecheck's idempotent fixpoint), so running
+faultcheck never changes what the other suites report on the same
+parse, in either order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..tracecheck.analyzer import ParsedPackage, parse_package
+from ..tracecheck.callgraph import CallGraph
+from ..tracecheck.findings import (Finding, dedupe_findings,
+                                   parse_pragmas, suppressed)
+from .fault_model import build_context
+from . import rules as FR
+
+
+@dataclass
+class AnalyzerConfig:
+    exclude_patterns: tuple = ()
+    rules: tuple = ("FLT001", "FLT002", "FLT003", "FLT004", "FLT005",
+                    "FLT006")
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding]              # post-pragma, pre-baseline
+    suppressed: List[Finding]            # pragma-silenced
+    n_files: int = 0
+    n_functions: int = 0
+    n_recovery: int = 0                  # recovery-reachable functions
+    n_covered: int = 0                   # recovery-covered functions
+    n_registrations: int = 0             # metric-family registrations
+    errors: List[str] = field(default_factory=list)
+
+
+_RULE_FNS = {
+    "FLT001": FR.flt001_dispatch_outside_seam,
+    "FLT002": FR.flt002_check_after_mutation,
+    "FLT003": FR.flt003_replay_state_purity,
+    "FLT004": FR.flt004_unbounded_retry,
+    "FLT005": FR.flt005_metric_label_discipline,
+    "FLT006": FR.flt006_swallowed_in_recovery,
+}
+
+
+def analyze_package(package_path: str,
+                    config: Optional[AnalyzerConfig] = None,
+                    parsed: Optional[ParsedPackage] = None
+                    ) -> AnalysisResult:
+    config = config or AnalyzerConfig()
+    if parsed is None:
+        parsed = parse_package(package_path, config.exclude_patterns)
+    else:
+        parsed = parsed.filtered(config.exclude_patterns)
+
+    result = AnalysisResult(findings=[], suppressed=[])
+    result.errors = list(parsed.errors)
+    result.n_files = parsed.n_files
+
+    graph = CallGraph(parsed.modules, parsed.package)
+    ctx = build_context(parsed.modules, graph)
+    result.n_recovery = len(ctx.recovery_reach)
+    result.n_covered = len(ctx.covered)
+    result.n_registrations = ctx.n_registrations
+
+    findings: List[Finding] = []
+    for mod in parsed.modules.values():
+        pragmas = parse_pragmas(mod.source_lines, tool="faultcheck")
+        for fi in mod.functions.values():
+            result.n_functions += 1
+            batch: List[Finding] = []
+            for code in config.rules:
+                fn = _RULE_FNS.get(code)
+                if fn is not None:
+                    batch += fn(fi, ctx)
+            for f in batch:
+                (result.suppressed if suppressed(f, pragmas)
+                 else findings).append(f)
+
+    result.findings = dedupe_findings(findings)
+    return result

@@ -1,0 +1,557 @@
+"""The recovery model faultcheck reasons over (pure AST, shared parse).
+
+Four questions drive the FLT rules:
+
+1. **Where are the recovery seams?**  A ``try`` whose handler routes the
+   failure through recovery — a call whose name matches the recovery
+   vocabulary (``_recover*``/``_lose_*``/``_to_replay_form``/
+   ``export_requests``) or an ownership-handoff prefix (``take_*``/
+   ``install_*``/``donate_*``/``detach_*``), directly or one resolved
+   call level down.  Functions called from a seam's ``try`` body (and
+   their call-graph closure) are *recovery-covered*: a donated dispatch
+   there has a catcher that can replay from host state.
+
+2. **Which calls dispatch donated, handoff-detached state?**  Reuses
+   tracecheck's module-scoped donor pass (``jax.jit(donate_argnums)``
+   propagated through names/attrs/returns/partials/``cache.get``
+   builders); faultcheck additionally asks whether the donated argument
+   was produced by a ``take_*``-style handoff — that is the state a
+   failed dispatch strands.
+
+3. **Where are fault-injection sites checked, and what do metric
+   registrations declare?**  ``faults.site(...)`` handles (class attrs
+   and locals) and their ``.check()`` call sites feed FLT002; registry
+   ``counter``/``gauge``/``histogram`` registrations (including one
+   level through the pre-bound-helper idiom) feed FLT005.
+
+4. **What is replay state?**  Classes named in the signatures of the
+   replay seam functions (``_to_replay_form``/``export_requests``/
+   ``inject_request``) plus ``Request`` itself; FLT003 polices stores
+   into their fields.
+
+Everything here is READ-ONLY over the shared :class:`ModuleInfo`
+objects (the donor pass re-derives the same idempotent fixpoint
+tracecheck computes), so running faultcheck never changes what the
+other suites report on the same parse, in either order.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..tracecheck.callgraph import (CallGraph, FunctionInfo, ModuleInfo,
+                                    _dotted, callee_name)
+from ..tracecheck.donors import ModuleDonors
+from ..tracecheck.rules import _body_walk
+
+# ownership-handoff prefixes (the TRC003 vocabulary): a donated argument
+# built by one of these has been detached from live object state
+HANDOFF_PREFIXES = ("take_", "install_", "donate_", "detach_")
+
+# recovery-routing vocabulary: a handler calling one of these absorbs
+# the failure into the replay machinery instead of letting state rot
+_RECOVERY_NAME = re.compile(
+    r"^(recover|re_?route|lose_|to_replay_form$|export_requests$|"
+    r"harvest|rebuild_pool$|finalize$)")
+
+_REGISTRY_METHODS = ("counter", "gauge", "histogram")
+
+
+def routes_recovery(tail: str) -> bool:
+    """Does a call with this terminal name route a caught failure into
+    recovery?"""
+    return bool(_RECOVERY_NAME.match(tail.lstrip("_"))) or \
+        tail.startswith(HANDOFF_PREFIXES)
+
+
+@dataclass
+class RegSite:
+    """One metric-family registration: ``r.counter("name", ..., labels=
+    (...))`` — or a call into a one-registration helper that threads its
+    first parameter through as the family name (the pre-bound telemetry
+    class idiom)."""
+    call: ast.Call
+    fi: FunctionInfo
+    name: str                        # the family name literal
+    kind: str                        # counter / gauge / histogram
+    labels: Optional[Tuple[str, ...]]  # None = not statically known
+    buckets_sig: Optional[str]       # histogram layout signature
+    replica_scoped: bool             # registered from per-replica code
+
+    def schema(self) -> Tuple:
+        return (self.kind, self.labels, self.buckets_sig)
+
+
+@dataclass
+class FaultContext:
+    graph: CallGraph
+    # id(fi) -> donated-position resolver results live on demand via
+    # the per-module donor passes
+    donors: Dict[str, ModuleDonors]
+    covered: Set[int]                 # id(fi): recovery-covered closure
+    routing_trys: Dict[int, List[ast.Try]]   # id(fi) -> seam trys in fi
+    recovery_reach: Set[int]          # id(fi): reachable FROM recovery
+    site_attrs: Dict[str, Set[Tuple[str, str]]]   # modpath -> (cls, chain)
+    site_locals: Dict[str, Set[Tuple[str, str]]]  # modpath -> (qualname, nm)
+    reg_sites: Dict[int, List[RegSite]]           # id(fi) -> registrations
+    reg_conflicts: Dict[int, str]     # id(call) -> conflict description
+    replay_classes: frozenset = frozenset()
+    fn_of: Dict[int, FunctionInfo] = field(default_factory=dict)
+    n_registrations: int = 0
+
+
+# ------------------------------------------------------------ faults vocab
+def _is_faults_module_name(mod: ModuleInfo, root: str) -> bool:
+    """Does local name ``root`` refer to the fault-injection module
+    (``from ..testing import faults`` / ``import x.testing.faults``)?"""
+    target = mod.module_aliases.get(root, "")
+    if target.endswith("faults") or ".faults." in target:
+        return True
+    imp = mod.imported_names.get(root)
+    return bool(imp and (imp[1] == "faults" or imp[0].endswith("faults")))
+
+
+def _is_site_binding(mod: ModuleInfo, value: ast.AST) -> bool:
+    """Is ``value`` a ``faults.site(...)`` call (any alias spelling)?"""
+    if not isinstance(value, ast.Call):
+        return False
+    name = callee_name(value)
+    if name is None:
+        return False
+    parts = name.split(".")
+    if parts[-1] != "site":
+        return False
+    if len(parts) == 1:
+        imp = mod.imported_names.get("site")
+        if imp and imp[0].endswith("faults"):
+            return True
+        # the faults module's own helpers call site() unqualified
+        return mod.relpath.endswith("testing/faults.py")
+    return _is_faults_module_name(mod, parts[0])
+
+
+def collect_fault_handles(mod: ModuleInfo
+                          ) -> Tuple[Set[Tuple[str, str]],
+                                     Set[Tuple[str, str]]]:
+    """(attr handles, local handles) bound from ``faults.site(...)`` in
+    this module: ``self._f_x = faults.site("...")`` per class, and
+    ``_fault = faults.site("...")`` per function."""
+    attrs: Set[Tuple[str, str]] = set()
+    locals_: Set[Tuple[str, str]] = set()
+    for fi in mod.functions.values():
+        if isinstance(fi.node, (ast.Module, ast.Lambda)):
+            continue
+        for stmt in _body_walk(fi):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            if not _is_site_binding(mod, stmt.value):
+                continue
+            for t in stmt.targets:
+                chain = _dotted(t)
+                if chain is None:
+                    continue
+                if chain.startswith(("self.", "cls.")) and fi.cls:
+                    attrs.add((fi.cls, chain))
+                elif "." not in chain:
+                    locals_.add((fi.qualname, chain))
+    # module-scope handles (`_F = faults.site(...)` at top level) bind
+    # under the '' scope every function's lookup chain falls back to;
+    # walk top-level statements only, never into def/class bodies
+    stack = list(mod.tree.body)
+    while stack:
+        stmt = stack.pop()
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(stmt, ast.Assign) and \
+                _is_site_binding(mod, stmt.value):
+            for t in stmt.targets:
+                chain = _dotted(t)
+                if chain is not None and "." not in chain:
+                    locals_.add(("", chain))
+        stack.extend(ast.iter_child_nodes(stmt))
+    return attrs, locals_
+
+
+def is_fault_check(fi: FunctionInfo, call: ast.Call,
+                   ctx: "FaultContext") -> bool:
+    """Is this call a fault-site ``check()`` — on a bound handle or the
+    module-level ``faults.check("site", ...)`` convenience?"""
+    name = callee_name(call)
+    if name is None:
+        return False
+    parts = name.split(".")
+    if parts[-1] != "check":
+        return False
+    mp = ctx.graph.modpath_of(fi.module)
+    if len(parts) >= 2 and _is_faults_module_name(fi.module, parts[0]):
+        return True                       # faults.check("site", ...)
+    chain = ".".join(parts[:-1])
+    if parts[0] in ("self", "cls") and fi.cls:
+        return (fi.cls, chain) in ctx.site_attrs.get(mp, ())
+    if len(parts) == 1:
+        return False
+    scope = fi
+    while scope is not None:
+        if (scope.qualname, chain) in ctx.site_locals.get(mp, ()):
+            return True
+        scope = scope.parent
+    return ("", chain) in ctx.site_locals.get(mp, ())
+
+
+# --------------------------------------------------------- recovery seams
+def _walk_stmts(stmts: List[ast.stmt]):
+    """Pre-order walk of a statement list that PRUNES nested function
+    bodies (``ast.walk`` + ``continue`` only skips the def node itself
+    — its body would still be attributed to the enclosing function)."""
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _handler_calls(t: ast.Try) -> List[ast.Call]:
+    out: List[ast.Call] = []
+    for h in t.handlers:
+        for node in _walk_stmts(h.body):
+            if isinstance(node, ast.Call):
+                out.append(node)
+    return out
+
+
+def _try_routes_recovery(fi: FunctionInfo, t: ast.Try,
+                         graph: CallGraph) -> bool:
+    for call in _handler_calls(t):
+        name = callee_name(call)
+        if name is None:
+            continue
+        if routes_recovery(name.rsplit(".", 1)[-1]):
+            return True
+        # one resolved level: the handler delegates to a helper that
+        # routes (handler -> self._absorb() -> _to_replay_form)
+        for callee in graph.resolve_call(fi, call):
+            for sub in callee.calls:
+                sname = callee_name(sub)
+                if sname and routes_recovery(sname.rsplit(".", 1)[-1]):
+                    return True
+    return False
+
+
+def _function_trys(fi: FunctionInfo) -> List[ast.Try]:
+    """Try statements of THIS function body only — a nested closure's
+    try belongs to the closure's own FunctionInfo, not the enclosing
+    function (attributing it outward would mint phantom seams)."""
+    return [node for node in _body_walk(fi)
+            if isinstance(node, ast.Try)]
+
+
+def _calls_in(stmts: List[ast.stmt]) -> List[ast.Call]:
+    return [node for node in _walk_stmts(stmts)
+            if isinstance(node, ast.Call)]
+
+
+# ------------------------------------------------------ metric registries
+def _is_registry_expr(fi: FunctionInfo, node: ast.AST) -> bool:
+    """Does this expression evaluate to the metrics registry —
+    ``registry()`` / ``obs.registry()`` / a name bound from one in an
+    enclosing scope?"""
+    if isinstance(node, ast.Call):
+        name = callee_name(node)
+        return bool(name and name.rsplit(".", 1)[-1] == "registry")
+    chain = _dotted(node)
+    if chain is None or "." in chain:
+        return False
+    scope = fi
+    while scope is not None:
+        if not isinstance(scope.node, (ast.Module, ast.Lambda)):
+            for stmt in ast.walk(scope.node):
+                if isinstance(stmt, ast.Assign) and \
+                        isinstance(stmt.value, ast.Call) and \
+                        any(isinstance(t, ast.Name) and t.id == chain
+                            for t in stmt.targets):
+                    vn = callee_name(stmt.value)
+                    if vn and vn.rsplit(".", 1)[-1] == "registry":
+                        return True
+        scope = scope.parent
+    return False
+
+
+def _resolve_label_tuple(fi: FunctionInfo, node: ast.AST
+                         ) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    if isinstance(node, ast.Name):
+        scope = fi
+        while scope is not None:
+            if not isinstance(scope.node, (ast.Module, ast.Lambda)):
+                for stmt in ast.walk(scope.node):
+                    if isinstance(stmt, ast.Assign) and any(
+                            isinstance(t, ast.Name) and t.id == node.id
+                            for t in stmt.targets):
+                        return _resolve_label_tuple(scope, stmt.value)
+            scope = scope.parent
+    return None
+
+
+def _scope_has_replica_param(fi: FunctionInfo) -> bool:
+    """The per-replica scope test: this function, an enclosing scope, or
+    the enclosing class's ``__init__`` takes a ``replica`` parameter."""
+    scope = fi
+    while scope is not None:
+        node = scope.node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+            if "replica" in names:
+                return True
+        scope = scope.parent
+    if fi.cls:
+        init = fi.module.functions.get(f"{fi.cls}.__init__")
+        if init is not None and init is not fi:
+            a = getattr(init.node, "args", None)
+            if a is not None and "replica" in [
+                    p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]:
+                return True
+    return False
+
+
+def _direct_registration(fi: FunctionInfo, call: ast.Call
+                         ) -> Optional[Tuple[str, str, Optional[ast.AST],
+                                             Optional[str]]]:
+    """(name_literal_or_param, kind, labels_node, buckets_sig) when
+    ``call`` is a registry registration; name may be a parameter name
+    (helper idiom) — the caller decides what to do with it."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    kind = call.func.attr
+    if kind not in _REGISTRY_METHODS:
+        return None
+    if not _is_registry_expr(fi, call.func.value):
+        return None
+    labels_node: Optional[ast.AST] = None
+    buckets_sig: Optional[str] = None
+    if len(call.args) >= 3:
+        labels_node = call.args[2]
+    for kw in call.keywords:
+        if kw.arg == "labels":
+            labels_node = kw.value
+        elif kw.arg == "buckets":
+            buckets_sig = ast.dump(kw.value)
+    if kind == "histogram" and buckets_sig is None and \
+            len(call.args) >= 4:
+        buckets_sig = ast.dump(call.args[3])
+    if not call.args:
+        return None
+    first = call.args[0]
+    if isinstance(first, ast.Constant) and isinstance(first.value, str):
+        return (first.value, kind, labels_node, buckets_sig)
+    if isinstance(first, ast.Name):
+        return (first.id, kind, labels_node, buckets_sig)
+    return None
+
+
+def _helper_registration(helper: FunctionInfo
+                         ) -> Optional[Tuple[str, Optional[Tuple[str, ...]],
+                                             Optional[str]]]:
+    """If ``helper`` is a one-registration wrapper whose first parameter
+    is threaded through as the family name (the ``def c(name, help):
+    return r.counter(name, help, labels=rl)`` idiom), return
+    (kind, labels, buckets_sig)."""
+    node = helper.node
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    a = node.args
+    pos = a.posonlyargs + a.args
+    if not pos:
+        return None
+    first_param = pos[0].arg
+    regs = []
+    for call in helper.calls:
+        got = _direct_registration(helper, call)
+        if got is not None:
+            regs.append(got)
+    if len(regs) != 1:
+        return None
+    name, kind, labels_node, buckets_sig = regs[0]
+    if name != first_param:
+        return None
+    labels = (_resolve_label_tuple(helper, labels_node)
+              if labels_node is not None else ())
+    return (kind, labels, buckets_sig)
+
+
+def collect_registrations(modules: Dict[str, ModuleInfo],
+                          graph: CallGraph) -> Dict[int, List[RegSite]]:
+    out: Dict[int, List[RegSite]] = {}
+    for mod in modules.values():
+        for fi in mod.functions.values():
+            sites: List[RegSite] = []
+            scoped = _scope_has_replica_param(fi)
+            for call in fi.calls:
+                got = _direct_registration(fi, call)
+                if got is not None:
+                    name, kind, labels_node, buckets_sig = got
+                    if not (isinstance(call.args[0], ast.Constant)):
+                        continue        # param-named: the helper's caller
+                                        # carries the literal
+                    labels = (_resolve_label_tuple(fi, labels_node)
+                              if labels_node is not None else ())
+                    sites.append(RegSite(call, fi, name, kind, labels,
+                                         buckets_sig, scoped))
+                    continue
+                # one level through the pre-bound helper idiom:
+                # self.x = c("family_name", "help")
+                if not call.args:
+                    continue
+                first = call.args[0]
+                if not (isinstance(first, ast.Constant)
+                        and isinstance(first.value, str)):
+                    continue
+                for callee in graph.resolve_call(fi, call):
+                    if callee.module is not mod:
+                        continue
+                    helper = _helper_registration(callee)
+                    if helper is not None:
+                        kind, labels, buckets_sig = helper
+                        sites.append(RegSite(call, fi, first.value, kind,
+                                             labels, buckets_sig, scoped))
+                        break
+            if sites:
+                out[id(fi)] = sites
+    return out
+
+
+def _fmt_schema(site: RegSite) -> str:
+    lbl = ("?" if site.labels is None
+           else "{" + ", ".join(site.labels) + "}")
+    extra = " (custom buckets)" if site.buckets_sig else ""
+    return f"{site.kind}{lbl}{extra}"
+
+
+def find_registration_conflicts(reg_sites: Dict[int, List[RegSite]]
+                                ) -> Dict[int, str]:
+    """id(call) -> message for every registration whose (kind, labels,
+    buckets) disagrees with another registration of the same family
+    name.  Unknown label sets never conflict (under-reporting beats
+    false alarms in a tier-1 gate)."""
+    by_name: Dict[str, List[RegSite]] = {}
+    for sites in reg_sites.values():
+        for s in sites:
+            by_name.setdefault(s.name, []).append(s)
+    conflicts: Dict[int, str] = {}
+    for name, sites in by_name.items():
+        known = [s for s in sites if s.labels is not None]
+        schemas = {s.schema() for s in known}
+        if len(schemas) <= 1:
+            continue
+        for s in known:
+            others = sorted(
+                {f"{o.fi.module.relpath}:{o.call.lineno} as "
+                 f"{_fmt_schema(o)}"
+                 for o in known if o.schema() != s.schema()})
+            conflicts[id(s.call)] = (
+                f"metric family '{name}' registered as {_fmt_schema(s)} "
+                f"here but with a different schema at "
+                f"{'; '.join(others)} — the registry raises on the "
+                "second registration at runtime, and which replica/"
+                "component wins depends on construction order")
+    return conflicts
+
+
+# ------------------------------------------------------- replay vocabulary
+_REPLAY_SEAM_FNS = ("_to_replay_form", "export_requests", "inject_request")
+
+
+def replay_class_vocabulary(modules: Dict[str, ModuleInfo]) -> frozenset:
+    """Class names that flow through the replay seams: annotations on
+    the parameters / returns of ``_to_replay_form``-style functions,
+    plus ``Request`` itself."""
+    names = {"Request"}
+    for mod in modules.values():
+        for fi in mod.functions.values():
+            if fi.name not in _REPLAY_SEAM_FNS:
+                continue
+            node = fi.node
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            anns = [p.annotation for p in
+                    (node.args.posonlyargs + node.args.args
+                     + node.args.kwonlyargs)]
+            anns.append(node.returns)
+            for ann in anns:
+                if ann is None:
+                    continue
+                for sub in ast.walk(ann):
+                    if isinstance(sub, ast.Name) and sub.id[:1].isupper():
+                        names.add(sub.id)
+    return frozenset(names)
+
+
+# -------------------------------------------------------------- the build
+def build_context(modules: Dict[str, ModuleInfo],
+                  graph: CallGraph) -> FaultContext:
+    donors = {mp: ModuleDonors(mod) for mp, mod in modules.items()}
+
+    fn_of: Dict[int, FunctionInfo] = {}
+    routing_trys: Dict[int, List[ast.Try]] = {}
+    covered_seeds: List[FunctionInfo] = []
+    reach_seeds: List[FunctionInfo] = []
+    site_attrs: Dict[str, Set[Tuple[str, str]]] = {}
+    site_locals: Dict[str, Set[Tuple[str, str]]] = {}
+
+    for mp, mod in modules.items():
+        a, l = collect_fault_handles(mod)
+        if a:
+            site_attrs[mp] = a
+        if l:
+            site_locals[mp] = l
+        for fi in mod.functions.values():
+            fn_of[id(fi)] = fi
+            if _RECOVERY_NAME.match(fi.name.lstrip("_")):
+                reach_seeds.append(fi)
+            trys = [t for t in _function_trys(fi)
+                    if _try_routes_recovery(fi, t, graph)]
+            if not trys:
+                continue
+            routing_trys[id(fi)] = trys
+            for t in trys:
+                for call in _calls_in(t.body):
+                    covered_seeds.extend(graph.resolve_call(fi, call))
+                for call in _handler_calls(t):
+                    reach_seeds.extend(graph.resolve_call(fi, call))
+
+    def closure(seed: List[FunctionInfo]) -> Set[int]:
+        out = {id(f) for f in seed}
+        work = list(seed)
+        while work:
+            cur = work.pop()
+            for call in cur.calls:
+                for callee in graph.resolve_call(cur, call):
+                    if id(callee) not in out:
+                        out.add(id(callee))
+                        work.append(callee)
+        return out
+
+    reg_sites = collect_registrations(modules, graph)
+    return FaultContext(
+        graph=graph, donors=donors,
+        covered=closure(covered_seeds), routing_trys=routing_trys,
+        recovery_reach=closure(reach_seeds),
+        site_attrs=site_attrs, site_locals=site_locals,
+        reg_sites=reg_sites,
+        reg_conflicts=find_registration_conflicts(reg_sites),
+        replay_classes=replay_class_vocabulary(modules),
+        fn_of=fn_of,
+        n_registrations=sum(len(v) for v in reg_sites.values()))

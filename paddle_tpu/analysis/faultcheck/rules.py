@@ -1,0 +1,522 @@
+"""The FLT rule checkers.
+
+Each rule is ``(FunctionInfo, FaultContext) -> List[Finding]`` over ONE
+function body (nested defs are their own FunctionInfo).  The rules
+encode the contract the r10–r14 fault-tolerance arc rests on: every
+failure is either absorbed by replay-from-host-state or surfaces
+loudly — so detached-state dispatches need seams, fault checks fire
+before the mutation they guard, replay state stays host-pure, retries
+carry budgets, and one metric family means one schema.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..tracecheck import rules as R
+from ..tracecheck.callgraph import FunctionInfo, _dotted, callee_name
+from ..tracecheck.findings import Finding
+from .fault_model import FaultContext, _walk_stmts, is_fault_check
+
+FAULT_RULES: Dict[str, str] = {
+    "FLT001": "donated dispatch of handoff-detached state outside a "
+              "recovery seam — the argument came from a take_*/detach_* "
+              "handoff, so a failed dispatch leaves the owner's state "
+              "dead; the dispatch must run under a try whose handler "
+              "routes through take_*/install_*/_to_replay_form-style "
+              "recovery (directly or via a covering caller)",
+    "FLT002": "fault-site check() ordered after a state mutation it "
+              "guards — an injected fire must propagate into replay "
+              "recovery from a consistent state; move the check before "
+              "the first store (the r14 kv_spill rule), or pragma a "
+              "deliberately mid-mutation schedule point with a reason",
+    "FLT003": "replay-structure field assigned from a jnp/device-"
+              "producing expression — exported request/replay state "
+              "must be host values (prompt, emitted tokens, cursors); "
+              "a device buffer stored here dies with the pool the "
+              "failure killed and the replay reads garbage",
+    "FLT004": "retry/backoff loop without a FLAGS_*max_retries-style "
+              "budget, deadline, or progress mark — an unbounded "
+              "sleep-retry loop spins forever on a wedged backend; "
+              "bound it by a flag-derived budget and fail loudly when "
+              "the budget is spent",
+    "FLT005": "metric-family label discipline: a family registered "
+              "from per-replica code must bind the 'replica' label "
+              "(two engines in one process otherwise collide on one "
+              "series), and one family name must keep ONE kind/label-"
+              "set/bucket-layout across every registration site",
+    "FLT006": "broad except in recovery-reachable code that neither "
+              "re-raises, counts a counter, nor sets a terminal "
+              "status — a swallowed failure inside the recovery "
+              "machinery is an invisible wedge (requests hang, drills "
+              "pass vacuously)",
+}
+
+_SLEEP_TAILS = {"sleep"}
+
+# identifiers whose presence in a retry loop's test/body marks a bound:
+# flag-derived budgets, deadlines, or explicit progress marks
+_BOUND_IDENT = re.compile(
+    r"(retr|budget|attempt|restart|max_loss|deadline|timeout|max_wall|"
+    r"progress|patience)", re.IGNORECASE)
+_CLOCK_TAILS = {"time", "perf_counter", "monotonic"}
+
+_BROAD_EXC = {"Exception", "BaseException"}
+
+# value wrappers that yield HOST values even over device inputs: their
+# result is safe to store in replay state.  Matching is ROOT-qualified
+# — `np.concatenate` concretizes, `jnp.concatenate` most certainly
+# does not — so builtins, numpy-rooted calls, host-pulling methods and
+# jax.device_get each get their own list.
+_BUILTIN_CONCRETIZERS = {"int", "float", "bool", "str", "len", "list",
+                         "tuple", "_val"}
+_NP_CONCRETIZERS = {"asarray", "array", "concatenate", "copy", "stack"}
+_HOST_METHODS = {"item", "tolist"}
+
+
+def _is_concretizer_call(fi: FunctionInfo, node: ast.Call) -> bool:
+    name = callee_name(node)
+    if name is None:
+        return isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _HOST_METHODS
+    parts = name.split(".")
+    tail = parts[-1]
+    if tail == "device_get":
+        return True                     # jax.device_get pulls to host
+    if len(parts) == 1:
+        return tail in _BUILTIN_CONCRETIZERS
+    if R._is_numpy_alias(fi, parts[0]):
+        return tail in _NP_CONCRETIZERS
+    return tail in _HOST_METHODS        # x.item() / x.tolist()
+
+
+def _finding(fi: FunctionInfo, node: ast.AST, rule: str,
+             msg: str) -> Finding:
+    line = getattr(node, "lineno", fi.lineno)
+    return Finding(rule=rule, path=fi.module.relpath, line=line,
+                   func=fi.qualname, message=msg,
+                   source=fi.module.line(line))
+
+
+# ------------------------------------------------------------------ FLT001
+def _handoff_locals(fi: FunctionInfo) -> Set[str]:
+    """Local names assigned from a ``take_*``-style handoff call
+    anywhere in this function."""
+    out: Set[str] = set()
+    for stmt in R._body_walk(fi):
+        if not isinstance(stmt, ast.Assign):
+            continue
+        if not (isinstance(stmt.value, ast.Call)
+                and R._is_handoff_call(stmt.value)):
+            continue
+        for t in stmt.targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+def _in_routing_try(fi: FunctionInfo, ctx: FaultContext,
+                    call: ast.Call) -> bool:
+    for t in ctx.routing_trys.get(id(fi), ()):
+        for node in ast.walk(t):
+            if node is call:
+                return True
+    return False
+
+
+def flt001_dispatch_outside_seam(fi: FunctionInfo, ctx: FaultContext
+                                 ) -> List[Finding]:
+    if isinstance(fi.node, (ast.Module, ast.Lambda)):
+        return []
+    mp = ctx.graph.modpath_of(fi.module)
+    donors = ctx.donors.get(mp)
+    if donors is None:
+        return []
+    handoffs = None                      # computed lazily
+    out: List[Finding] = []
+    for call in fi.calls:
+        pos = donors.donated_positions(fi, call)
+        if not pos:
+            continue
+        detached = False
+        for p in pos:
+            if p >= len(call.args):
+                continue
+            arg = call.args[p]
+            if R._is_handoff_call(arg):
+                detached = True
+                break
+            chain = _dotted(arg)
+            if chain is not None and "." not in chain:
+                if handoffs is None:
+                    handoffs = _handoff_locals(fi)
+                if chain in handoffs:
+                    detached = True
+                    break
+        if not detached:
+            continue
+        if id(fi) in ctx.covered or _in_routing_try(fi, ctx, call):
+            continue
+        out.append(_finding(
+            fi, call, "FLT001",
+            f"donated dispatch {callee_name(call) or '<call>'}(...) of "
+            "handoff-detached state outside a recovery seam — no "
+            "enclosing or covering try routes the failure through "
+            "take_*/install_*/_to_replay_form recovery, so a failed "
+            "dispatch strands the detached state with nobody to "
+            "rebuild it; wrap the drive path in a recovery seam (the "
+            "serving step()/_recover_dispatch shape)"))
+    return out
+
+
+# ------------------------------------------------------------------ FLT002
+def _store_targets(stmt: ast.stmt) -> List[Tuple[str, ast.AST]]:
+    """(base chain, node) for every store target this statement writes:
+    attribute chains and subscript bases (``self.x = ``,
+    ``self._slots[i] = ``, ``node["host"] = ``)."""
+    targets: List[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    out: List[Tuple[str, ast.AST]] = []
+    for t in targets:
+        for el in ([t] if not isinstance(t, (ast.Tuple, ast.List))
+                   else list(t.elts)):
+            if isinstance(el, ast.Name):
+                continue        # rebinding a local is a read, not a
+                                # mutation (aliases rebind freely)
+            base = el
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            chain = _dotted(base)
+            if chain is not None:
+                out.append((chain, el))
+    return out
+
+
+def flt002_check_after_mutation(fi: FunctionInfo, ctx: FaultContext
+                                ) -> List[Finding]:
+    """Scan with statement-dominance: a store taints the path; a
+    handoff call (``take_*`` — the start of a fresh fail-safe region)
+    clears it; a fault-site ``check()`` on a tainted path is a finding.
+    Stores inside an exclusive-exit sub-block (one ending in
+    return/raise/continue/break) never taint the continuation."""
+    if isinstance(fi.node, (ast.Module, ast.Lambda)):
+        return []
+    has_check = any(is_fault_check(fi, c, ctx) for c in fi.calls)
+    if not has_check:
+        return []
+    out: List[Finding] = []
+    aliases: Set[str] = set()
+
+    def is_state_chain(chain: str) -> bool:
+        root = chain.split(".")[0]
+        return root in ("self", "cls") or root in aliases
+
+    def note_aliases(stmt: ast.stmt) -> None:
+        # node = self._nodes[key]: stores through `node` mutate state
+        if not isinstance(stmt, ast.Assign):
+            return
+        value = stmt.value
+        base = value
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        chain = _dotted(base)
+        if chain is None or not is_state_chain(chain):
+            return
+        for t in stmt.targets:
+            if isinstance(t, ast.Name):
+                aliases.add(t.id)
+
+    def exits(block: List[ast.stmt]) -> bool:
+        return bool(block) and isinstance(
+            block[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+    def scan(stmts: List[ast.stmt],
+             dirty: Optional[ast.stmt]) -> Optional[ast.stmt]:
+        for stmt in stmts:
+            header = R._header_calls(stmt)
+            if any(R._is_handoff_call(c) for c in header):
+                dirty = None            # fresh fail-safe region
+            for call in header:
+                if is_fault_check(fi, call, ctx) and dirty is not None:
+                    out.append(_finding(
+                        fi, call, "FLT002",
+                        "fault-site check() fires AFTER a state "
+                        f"mutation (line {dirty.lineno}: "
+                        f"`{fi.module.line(dirty.lineno)}`) — an "
+                        "injected fault here propagates into recovery "
+                        "from a half-applied state; fire the check "
+                        "before the first store, or pragma a "
+                        "deliberately mid-mutation schedule point "
+                        "with a reason"))
+            note_aliases(stmt)
+            stored = [n for c, n in _store_targets(stmt)
+                      if is_state_chain(c)]
+            if stored and dirty is None:
+                dirty = stmt
+            for sub in R._sub_blocks(stmt):
+                sub_dirty = scan(sub, dirty)
+                if sub_dirty is not None and not exits(sub):
+                    dirty = dirty or sub_dirty
+        return dirty
+
+    scan(list(fi.node.body), None)
+    return out
+
+
+# ------------------------------------------------------------------ FLT003
+def _device_producing(fi: FunctionInfo, expr: ast.expr) -> Optional[str]:
+    """The jnp/lax/jax-rooted call this expression's value flows from,
+    unless a concretizer (int()/np.asarray()/.item()/...) intervenes."""
+    parent: dict = {}
+    order: List[ast.AST] = []
+    stack: List[ast.AST] = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        order.append(node)
+        for child in ast.iter_child_nodes(node):
+            parent[id(child)] = node
+            stack.append(child)
+    skipped: set = set()
+    for node in order:
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_concretizer_call(fi, node):
+            skipped.add(id(node))
+            continue
+        name = callee_name(node)
+        if name is None:
+            continue
+        if R._under_skipped(node, parent, skipped):
+            continue
+        root = name.split(".")[0]
+        target = fi.module.module_aliases.get(root, "")
+        if target in ("jax.numpy", "jax.lax", "jax") or \
+                target.startswith(("jax.numpy.", "jax.lax.")) or \
+                name.startswith(("jnp.", "lax.", "jax.numpy.",
+                                 "jax.lax.", "jax.")):
+            return name
+    return None
+
+
+def _replay_instances(fi: FunctionInfo, ctx: FaultContext) -> Set[str]:
+    """Local names holding replay-structure instances in this function:
+    parameters annotated with a replay class, locals constructed from
+    one, and — in modules that define/import a replay class — the
+    conventional ``req``/``request`` names."""
+    out: Set[str] = set()
+    node = fi.node
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        for p in (node.args.posonlyargs + node.args.args
+                  + node.args.kwonlyargs):
+            ann = p.annotation
+            if ann is not None and any(
+                    isinstance(s, ast.Name) and s.id in ctx.replay_classes
+                    for s in ast.walk(ann)):
+                out.add(p.arg)
+        for stmt in R._body_walk(fi):
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call):
+                vn = callee_name(stmt.value)
+                if vn and vn.rsplit(".", 1)[-1] in ctx.replay_classes:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            out.add(t.id)
+    # conventional names count in modules that import or define a
+    # replay class (serving/fleet pass Request objects through untyped
+    # loops: `for req in victims:`)
+    mod = fi.module
+    mod_has_replay = any(
+        imp[1] in ctx.replay_classes
+        for imp in mod.imported_names.values())
+    if not mod_has_replay:
+        for sub in mod.tree.body:
+            if isinstance(sub, ast.ClassDef) and \
+                    sub.name in ctx.replay_classes:
+                mod_has_replay = True
+                break
+    if mod_has_replay:
+        out.update(("req", "request"))
+    return out
+
+
+def flt003_replay_state_purity(fi: FunctionInfo, ctx: FaultContext
+                               ) -> List[Finding]:
+    if isinstance(fi.node, (ast.Module, ast.Lambda)):
+        return []
+    insts = _replay_instances(fi, ctx)
+    if not insts:
+        return []
+    out: List[Finding] = []
+    for node in R._body_walk(fi):
+        value: Optional[ast.expr] = None
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                chain = _dotted(t)
+                if chain and "." in chain and \
+                        chain.split(".")[0] in insts:
+                    value = node.value
+                    break
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("append", "extend", "insert") and \
+                node.args:
+            chain = _dotted(node.func.value)
+            if chain and chain.split(".")[0] in insts:
+                value = node.args[-1]
+        if value is None:
+            continue
+        culprit = _device_producing(fi, value)
+        if culprit is not None:
+            out.append(_finding(
+                fi, node, "FLT003",
+                f"replay-structure field assigned from {culprit}(...) "
+                "— exported request/replay state must be pure host "
+                "values (prompt, emitted tokens, cursors); a device "
+                "value stored here dies with the pool a failure kills "
+                "and the replayed continuation reads garbage; "
+                "concretize first (int()/np.asarray())"))
+    return out
+
+
+# ------------------------------------------------------------------ FLT004
+def _mentions_bound(nodes: List[ast.AST]) -> bool:
+    for sub in _walk_stmts(nodes):
+        if isinstance(sub, ast.Name) and _BOUND_IDENT.search(sub.id):
+            return True
+        if isinstance(sub, ast.Attribute) and \
+                _BOUND_IDENT.search(sub.attr):
+            return True
+        if isinstance(sub, ast.Call):
+            n = callee_name(sub)
+            if n and n.rsplit(".", 1)[-1] in _CLOCK_TAILS:
+                return True
+    return False
+
+
+def flt004_unbounded_retry(fi: FunctionInfo, ctx: FaultContext
+                           ) -> List[Finding]:
+    if isinstance(fi.node, (ast.Module, ast.Lambda)):
+        return []
+    out: List[Finding] = []
+    for stmt in R._body_walk(fi):
+        if not isinstance(stmt, ast.While):
+            continue
+        sleeps = [c for s in stmt.body for c in _walk_calls(s)
+                  if (callee_name(c) or "").rsplit(".", 1)[-1]
+                  in _SLEEP_TAILS]
+        if not sleeps:
+            continue
+        if _mentions_bound([stmt.test] + list(stmt.body)):
+            continue
+        out.append(_finding(
+            fi, sleeps[0], "FLT004",
+            "retry/backoff loop with no visible bound — nothing in the "
+            "loop references a FLAGS_*max_retries-style budget, a "
+            "deadline/timeout, or a progress mark, so a wedged backend "
+            "spins here forever; bound the loop by a flag-derived "
+            "budget (and raise loudly when it is spent) or by a "
+            "deadline"))
+    return out
+
+
+def _walk_calls(node: ast.AST) -> Iterable[ast.Call]:
+    for sub in _walk_stmts([node]):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+# ------------------------------------------------------------------ FLT005
+def flt005_metric_label_discipline(fi: FunctionInfo, ctx: FaultContext
+                                   ) -> List[Finding]:
+    out: List[Finding] = []
+    for site in ctx.reg_sites.get(id(fi), ()):
+        conflict = ctx.reg_conflicts.get(id(site.call))
+        if conflict is not None:
+            out.append(_finding(fi, site.call, "FLT005", conflict))
+        if site.replica_scoped and site.labels is not None and \
+                "replica" not in site.labels:
+            out.append(_finding(
+                fi, site.call, "FLT005",
+                f"metric family '{site.name}' registered from "
+                "per-replica code without a 'replica' label — two "
+                "engines in one process (the fleet case) collide on "
+                "one series: one replica's writes pollute another's; "
+                "bind .labels(replica=...) once per engine (the "
+                "_EngineTelemetry idiom)"))
+    return out
+
+
+# ------------------------------------------------------------------ FLT006
+def _is_broad_handler(h: ast.ExceptHandler) -> bool:
+    if h.type is None:
+        return True
+    types = (h.type.elts if isinstance(h.type, (ast.Tuple, ast.List))
+             else [h.type])
+    for t in types:
+        name = _dotted(t)
+        if name and name.rsplit(".", 1)[-1] in _BROAD_EXC:
+            return True
+    return False
+
+
+def _handler_absorbs_loudly(h: ast.ExceptHandler) -> bool:
+    """Re-raises, counts a counter, sets a terminal status, or captures
+    the exception for later handling."""
+    for node in _walk_stmts(h.body):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = callee_name(node)
+            if name is None:
+                continue
+            tail = name.rsplit(".", 1)[-1]
+            if tail in ("inc", "warn", "warning", "error",
+                        "exception") or \
+                    tail.startswith(("_observe_", "_finalize", "_fail",
+                                     "_expire", "_recover", "_lose_")):
+                return True
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                chain = _dotted(t)
+                if chain and chain.rsplit(".", 1)[-1] in ("status",
+                                                          "error"):
+                    return True
+            # err = e: captured for later re-raise/report
+            if h.name and isinstance(node.value, ast.Name) and \
+                    node.value.id == h.name:
+                return True
+    return False
+
+
+def flt006_swallowed_in_recovery(fi: FunctionInfo, ctx: FaultContext
+                                 ) -> List[Finding]:
+    if id(fi) not in ctx.recovery_reach or \
+            isinstance(fi.node, (ast.Module, ast.Lambda)):
+        return []
+    out: List[Finding] = []
+    for node in R._body_walk(fi):
+        if not isinstance(node, ast.Try):
+            continue
+        for h in node.handlers:
+            if not _is_broad_handler(h):
+                continue
+            if _handler_absorbs_loudly(h):
+                continue
+            out.append(_finding(
+                fi, h, "FLT006",
+                "broad except in recovery-reachable code swallows the "
+                "failure — it neither re-raises, counts a counter, "
+                "sets a terminal status, nor captures the exception "
+                "for later handling; a silent wedge here makes fault "
+                "drills pass vacuously while requests hang"))
+    return out

@@ -186,10 +186,16 @@ class ModuleDonors:
                         pos = self._jit_donate_positions(stmt.value, fi)
                         if pos is None:
                             # `return self._prefill_fn` where the attr
-                            # was assigned a donor in this class
+                            # was assigned a donor in this class, or
+                            # `return fn` of a local bound to a donor
+                            # earlier in the function (the per-rung
+                            # program-dict idiom: fn = cache.get(...);
+                            # self._fns[bucket] = fn; return fn)
                             chain = _dotted(stmt.value)
                             if chain and fi.cls:
                                 pos = self.attrs.get((fi.cls, chain))
+                            if not pos and chain and "." not in chain:
+                                pos = self.named.get((fi.qualname, chain))
                         if pos and fi.returns_donor != pos:
                             fi.returns_donor = pos
                             changed = True

@@ -1691,9 +1691,15 @@ class ServingEngine:
                 self._slots[dst] = req
                 self._slots[s] = None
                 req.slot = dst
+                # deliberately MID-mutation: every=N drills must land
+                # between row moves, and recovery replays the whole
+                # batch from host state so no half-compacted table
+                # survives  # faultcheck: disable=FLT002
                 self._f_migrate.check(phase="move", rid=req.rid)
         self.bucket = target
         self.bucket_migrations += 1
+        # post-commit schedule point, same full-replay argument
+        # faultcheck: disable=FLT002
         self._f_migrate.check(phase="commit")
         self._observe_bucket(migrated=True)
 

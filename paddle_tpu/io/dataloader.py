@@ -198,6 +198,17 @@ def _process_worker_loop(dataset, collate_fn, index_q, result_q, wid,
             try:
                 _fault.check(batch=bidx)
             except _faults.InjectedFault:
+                # flush the result queue's feeder thread before dying:
+                # os._exit mid-flush can kill the feeder while it holds
+                # the queue's shared write lock, wedging every SURVIVOR's
+                # put() forever (seen once under a loaded box in r14).
+                # The death shape the parent sees is unchanged — nothing
+                # is reported, no sentinel, just a vanished process.
+                try:
+                    result_q.close()
+                    result_q.join_thread()
+                except Exception:
+                    pass
                 os._exit(3)     # simulated worker death, not an error
             segs = []
             try:
@@ -409,7 +420,15 @@ class DataLoader:
                                     f"FLAGS_dataloader_max_worker_restarts")
                             time.sleep(min(0.05 * (2 ** restarts), 1.0))
                             for i in dead:
-                                workers[i].join(timeout=0.5)
+                                # wide join margin: under a loaded box
+                                # the OS can take well over the old
+                                # 0.5 s to reap a dead child, and a
+                                # replacement spawned beside an
+                                # unreaped zombie slot flaked once in
+                                # r14 — the join is on an already-dead
+                                # process, so the margin costs nothing
+                                # in the common case
+                                workers[i].join(timeout=2.0)
                                 workers[i] = spawn(i)
                             restarts += len(dead)
                             m_restarts.inc(len(dead))

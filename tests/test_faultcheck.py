@@ -1,0 +1,1146 @@
+"""faultcheck: the recovery-discipline static analyzer (tier-1).
+
+Three layers, mirroring test_tracecheck/test_meshcheck:
+  1. per-rule fixture tests — a flagged snippet, a clean twin, and a
+     pragma-suppressed copy for each FLT rule;
+  2. machinery tests — the THREE-suite pragma-isolation matrix,
+     baseline round-trip, shared-parse order independence across all
+     three analyzers, single-suite + unified CLI exit codes (incl. the
+     r11 ``--rules``/``--update-baseline`` hardening, ``--changed-only``
+     and the SARIF/github CI formats);
+  3. the package gate — ``paddle_tpu`` analyzed end to end must show
+     ZERO findings beyond tools/faultcheck_baseline.json, inside the
+     acceptance time budget (one shared parse with the other suites).
+
+Pure AST: no jax import required by the analyzer itself.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from paddle_tpu.analysis.faultcheck import (AnalyzerConfig, analyze_package,
+                                            load_baseline, subtract_baseline,
+                                            write_baseline, FAULT_RULES)
+from paddle_tpu.analysis import meshcheck as mc
+from paddle_tpu.analysis import tracecheck as tc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "paddle_tpu")
+BASELINE = os.path.join(REPO, "tools", "faultcheck_baseline.json")
+
+pytestmark = pytest.mark.faultcheck
+
+
+# --------------------------------------------------------------- harness
+def run_snippet(tmp_path, source, config=None, name="mod.py", extra=None):
+    """Analyze one module as a tiny package; returns the result."""
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / name).write_text(textwrap.dedent(source))
+    for fname, src in (extra or {}).items():
+        (pkg / fname).write_text(textwrap.dedent(src))
+    result = analyze_package(str(pkg), config)
+    assert not result.errors, result.errors
+    return result
+
+
+def codes(result):
+    return [f.rule for f in result.findings]
+
+
+FAULTS_MODULE = """
+    def site(name):
+        return name
+
+    def check(name, **ctx):
+        return None
+"""
+
+OBS_MODULE = """
+    def registry():
+        return None
+"""
+
+
+# ---------------------------------------------------------------- FLT001
+FLT001_FLAGGED = """
+    import jax
+
+    class Engine:
+        def __init__(self):
+            self._step = jax.jit(lambda p: p, donate_argnums=(0,))
+
+        def take_pools(self):
+            return []
+
+        def drive(self):
+            pools = self.take_pools()
+            return self._step(pools)
+"""
+
+
+def test_flt001_detached_dispatch_without_seam(tmp_path):
+    res = run_snippet(tmp_path, FLT001_FLAGGED)
+    assert codes(res) == ["FLT001"]
+    assert "recovery seam" in res.findings[0].message
+
+
+def test_flt001_local_seam_clean(tmp_path):
+    # the dispatch runs inside a try whose handler routes recovery
+    res = run_snippet(tmp_path, """
+        import jax
+
+        class Engine:
+            def __init__(self):
+                self._step = jax.jit(lambda p: p, donate_argnums=(0,))
+
+            def take_pools(self):
+                return []
+
+            def install_pools(self, states):
+                return None
+
+            def drive(self):
+                pools = self.take_pools()
+                try:
+                    return self._step(pools)
+                except Exception:
+                    self.install_pools([])
+                    raise
+    """)
+    assert codes(res) == []
+
+
+def test_flt001_covering_caller_seam_clean(tmp_path):
+    # the serving step()/_recover_dispatch shape: the seam lives one
+    # call level up and covers the dispatch through the call graph
+    res = run_snippet(tmp_path, """
+        import jax
+
+        class Engine:
+            def __init__(self):
+                self._step = jax.jit(lambda p: p, donate_argnums=(0,))
+
+            def take_pools(self):
+                return []
+
+            def _dispatch(self):
+                pools = self.take_pools()
+                return self._step(pools)
+
+            def drive(self):
+                try:
+                    return self._dispatch()
+                except Exception as exc:
+                    self._recover_dispatch(exc)
+
+            def _recover_dispatch(self, exc):
+                self._pool = []
+    """)
+    assert codes(res) == []
+
+
+def test_flt001_non_detached_dispatch_clean(tmp_path):
+    # the train-step shape — donated args are plain rebound state, not
+    # a take_* handoff product: a dispatch-time failure leaves the
+    # originals intact, so no seam is demanded here
+    res = run_snippet(tmp_path, """
+        import jax
+
+        class Step:
+            def __init__(self):
+                self._jit = jax.jit(lambda p, s: (p, s),
+                                    donate_argnums=(0, 1))
+
+            def __call__(self):
+                self.params, self.state = self._jit(self.params,
+                                                    self.state)
+    """)
+    assert codes(res) == []
+
+
+def test_flt001_per_rung_program_dict_builder(tmp_path):
+    # the r12 idiom that once escaped the donor pass: the builder result
+    # memoized into a dict through a local and returned — FLT001 must
+    # still see the dispatch as donated
+    res = run_snippet(tmp_path, """
+        import functools
+        import jax
+
+        def _build(note):
+            def run(params, pools):
+                note()
+                return pools
+            return jax.jit(run, donate_argnums=(1,))
+
+        class Engine:
+            def take_pools(self):
+                return []
+
+            def program(self, cache, b):
+                fn = self._fns.get(b)
+                if fn is None:
+                    fn = cache.get("key", functools.partial(_build))
+                    self._fns[b] = fn
+                return fn
+
+            def step(self, cache, params, b):
+                fn = self.program(cache, b)
+                pools = self.take_pools()
+                return fn(params, pools)
+    """)
+    assert codes(res) == ["FLT001"]
+
+
+def test_flt001_pragma(tmp_path):
+    res = run_snippet(tmp_path, FLT001_FLAGGED.replace(
+        "return self._step(pools)",
+        "return self._step(pools)  # faultcheck: disable=FLT001"))
+    assert codes(res) == []
+    assert len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------- FLT002
+FLT002_FLAGGED = """
+    from . import faults
+
+    class Pool:
+        def __init__(self):
+            self._f_spill = faults.site("kv_spill")
+
+        def spill(self, pid):
+            node = self._nodes[pid]
+            node["host"] = self._copy(pid)
+            self._f_spill.check(op="spill")
+            return node
+"""
+
+
+def test_flt002_check_after_mutation(tmp_path):
+    res = run_snippet(tmp_path, FLT002_FLAGGED,
+                      extra={"faults.py": FAULTS_MODULE})
+    assert codes(res) == ["FLT002"]
+    assert "AFTER a state mutation" in res.findings[0].message
+
+
+def test_flt002_check_before_mutation_clean(tmp_path):
+    res = run_snippet(tmp_path, """
+        from . import faults
+
+        class Pool:
+            def __init__(self):
+                self._f_spill = faults.site("kv_spill")
+
+            def spill(self, pid):
+                node = self._nodes[pid]
+                self._f_spill.check(op="spill")
+                node["host"] = self._copy(pid)
+                return node
+    """, extra={"faults.py": FAULTS_MODULE})
+    assert codes(res) == []
+
+
+def test_flt002_handoff_starts_fresh_region_clean(tmp_path):
+    # the post-detach check idiom: scheduler bookkeeping mutated state
+    # earlier, but take_pools() begins a new fail-safe region
+    res = run_snippet(tmp_path, """
+        from . import faults
+
+        class Engine:
+            def __init__(self):
+                self._f_decode = faults.site("decode_dispatch")
+
+            def step(self, fn):
+                self._turn = not self._turn
+                pools = self.take_pools()
+                self._f_decode.check()
+                return fn(pools)
+
+            def take_pools(self):
+                return []
+    """, extra={"faults.py": FAULTS_MODULE})
+    assert codes(res) == []
+
+
+def test_flt002_exclusive_exit_branch_clean(tmp_path):
+    # the program-cache shape: the store lives in an early-return hit
+    # path that is exclusive with the check
+    res = run_snippet(tmp_path, """
+        from . import faults
+
+        class Cache:
+            def __init__(self):
+                self._f_build = faults.site("program_build")
+
+            def get(self, key, builder):
+                fn = self._programs.get(key)
+                if fn is not None:
+                    self.hits += 1
+                    return fn
+                self._f_build.check()
+                return builder()
+    """, extra={"faults.py": FAULTS_MODULE})
+    assert codes(res) == []
+
+
+def test_flt002_module_level_faults_check(tmp_path):
+    # faults.check("site") convenience (the checkpoint_save idiom) is a
+    # check site too
+    res = run_snippet(tmp_path, """
+        from . import faults
+
+        def save(state, path):
+            state["saved"] = True
+            faults.check("checkpoint_save", path=path)
+            return path
+    """, extra={"faults.py": FAULTS_MODULE})
+    assert codes(res) == []      # state is a local dict, not self state
+
+    res = run_snippet(tmp_path, """
+        from . import faults
+
+        class Saver:
+            def save(self, path):
+                self._last_path = path
+                faults.check("checkpoint_save", path=path)
+                return path
+    """, extra={"faults.py": FAULTS_MODULE})
+    assert codes(res) == ["FLT002"]
+
+
+def test_flt002_module_level_handle(tmp_path):
+    # a handle bound at MODULE scope resolves through the '' scope
+    # fallback — check-after-mutation protection must not silently
+    # lapse for module-level sites
+    res = run_snippet(tmp_path, """
+        from . import faults
+
+        _F = faults.site("checkpoint_save")
+
+        class Saver:
+            def save(self, path):
+                self._last_path = path
+                _F.check(path=path)
+                return path
+    """, extra={"faults.py": FAULTS_MODULE})
+    assert codes(res) == ["FLT002"]
+
+
+def test_flt002_pragma(tmp_path):
+    res = run_snippet(tmp_path, FLT002_FLAGGED.replace(
+        'self._f_spill.check(op="spill")',
+        'self._f_spill.check(op="spill")  # faultcheck: disable=FLT002'),
+        extra={"faults.py": FAULTS_MODULE})
+    assert codes(res) == []
+    assert len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------- FLT003
+FLT003_FLAGGED = """
+    import jax.numpy as jnp
+
+    class Request:
+        pass
+
+    def emit(req: Request, logits):
+        req.last_tok = jnp.argmax(logits)
+"""
+
+
+def test_flt003_device_value_in_replay_state(tmp_path):
+    res = run_snippet(tmp_path, FLT003_FLAGGED)
+    assert codes(res) == ["FLT003"]
+    assert "jnp.argmax" in res.findings[0].message
+
+
+def test_flt003_concretized_clean(tmp_path):
+    res = run_snippet(tmp_path, """
+        import numpy as np
+        import jax.numpy as jnp
+
+        class Request:
+            pass
+
+        def emit(req: Request, logits, tok):
+            req.last_tok = int(jnp.argmax(logits))
+            req.tokens.append(tok)
+            req.feed = np.concatenate([req.prompt, np.asarray(req.tokens)])
+    """)
+    assert codes(res) == []
+
+
+def test_flt003_seam_annotation_extends_vocabulary(tmp_path):
+    # a class named in a replay-seam signature joins the vocabulary
+    res = run_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        class HostJob:
+            pass
+
+        def export_requests(job: HostJob):
+            return [job]
+
+        def bad(job: HostJob, x):
+            job.result = jnp.sum(x)
+    """)
+    assert codes(res) == ["FLT003"]
+
+
+def test_flt003_unrelated_object_clean(tmp_path):
+    # stores into non-replay objects are none of this rule's business
+    res = run_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def accumulate(state, x):
+            state.total = jnp.sum(x)
+            return state
+    """)
+    assert codes(res) == []
+
+
+def test_flt003_jnp_spelling_of_concretizers_flagged(tmp_path):
+    # the concretizer exemption is ROOT-qualified: np.concatenate
+    # concretizes, jnp.concatenate most certainly does not — the exact
+    # token-append shape the rule exists to catch
+    res = run_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        class Request:
+            pass
+
+        def bad(req: Request, tok):
+            req.tokens = jnp.concatenate([req.tokens, tok])
+            req.feed = jnp.asarray(req.prompt)
+    """)
+    assert codes(res) == ["FLT003", "FLT003"]
+
+
+def test_flt003_pragma(tmp_path):
+    res = run_snippet(tmp_path, FLT003_FLAGGED.replace(
+        "req.last_tok = jnp.argmax(logits)",
+        "req.last_tok = jnp.argmax(logits)  # faultcheck: disable=FLT003"))
+    assert codes(res) == []
+
+
+# ---------------------------------------------------------------- FLT004
+FLT004_FLAGGED = """
+    import time
+
+    def forever(dispatch):
+        while True:
+            try:
+                return dispatch()
+            except RuntimeError:
+                time.sleep(0.1)
+"""
+
+
+def test_flt004_unbounded_retry_loop(tmp_path):
+    res = run_snippet(tmp_path, FLT004_FLAGGED)
+    assert codes(res) == ["FLT004"]
+
+
+def test_flt004_flag_budget_clean(tmp_path):
+    res = run_snippet(tmp_path, """
+        import time
+
+        def bounded(dispatch, max_retries):
+            failures = 0
+            while failures < max_retries:
+                try:
+                    return dispatch()
+                except RuntimeError:
+                    failures += 1
+                    time.sleep(0.1)
+            raise RuntimeError("retry budget exhausted")
+    """)
+    assert codes(res) == []
+
+
+def test_flt004_deadline_clean(tmp_path):
+    # a wall-clock bound is a bound (the elastic barrier shape)
+    res = run_snippet(tmp_path, """
+        import time
+
+        def barrier(ready, timeout):
+            t0 = time.time()
+            while time.time() - t0 < timeout:
+                if ready():
+                    return True
+                time.sleep(0.1)
+            return False
+    """)
+    assert codes(res) == []
+
+
+def test_flt004_for_range_clean(tmp_path):
+    # for-range retry loops are bounded by construction
+    res = run_snippet(tmp_path, """
+        import time
+
+        def save(write):
+            for attempt in range(3):
+                try:
+                    return write()
+                except OSError:
+                    time.sleep(0.02 * (2 ** attempt))
+    """)
+    assert codes(res) == []
+
+
+def test_nested_def_attribution_is_pruned(tmp_path):
+    """A nested def's constructs belong to the nested FunctionInfo
+    alone: one nested retry loop is ONE finding (not one per enclosing
+    scope), and a nested closure's recovery-routing try must not mint a
+    phantom seam that covers the ENCLOSING function's unprotected
+    dispatch."""
+    res = run_snippet(tmp_path, """
+        import time
+
+        def outer(dispatch):
+            def helper():
+                while True:
+                    try:
+                        return dispatch()
+                    except RuntimeError:
+                        time.sleep(0.1)
+            return helper()
+    """)
+    assert codes(res) == ["FLT004"]
+
+    # the nested closure catches-and-recovers for ITSELF; the outer
+    # detached dispatch still has no seam and must flag
+    res = run_snippet(tmp_path, """
+        import jax
+
+        class Engine:
+            def __init__(self):
+                self._step = jax.jit(lambda p: p, donate_argnums=(0,))
+
+            def take_pools(self):
+                return []
+
+            def drive(self):
+                def probe():
+                    try:
+                        return self._ping()
+                    except Exception:
+                        self._recover()
+                probe()
+                pools = self.take_pools()
+                return self._step(pools)
+    """)
+    assert codes(res) == ["FLT001"]
+
+
+def test_flt004_pragma(tmp_path):
+    res = run_snippet(tmp_path, FLT004_FLAGGED.replace(
+        "time.sleep(0.1)",
+        "time.sleep(0.1)  # faultcheck: disable=FLT004"))
+    assert codes(res) == []
+
+
+# ---------------------------------------------------------------- FLT005
+FLT005_REPLICA_FLAGGED = """
+    from . import observability as obs
+
+    class EngineTelemetry:
+        def __init__(self, replica="0"):
+            r = obs.registry()
+            self.steps = r.counter("engine_steps", "decode steps")
+"""
+
+
+def test_flt005_replica_scope_missing_label(tmp_path):
+    res = run_snippet(tmp_path, FLT005_REPLICA_FLAGGED,
+                      extra={"observability.py": OBS_MODULE})
+    assert codes(res) == ["FLT005"]
+    assert "'replica' label" in res.findings[0].message
+
+
+def test_flt005_replica_label_clean(tmp_path):
+    res = run_snippet(tmp_path, """
+        from . import observability as obs
+
+        class EngineTelemetry:
+            def __init__(self, replica="0"):
+                r = obs.registry()
+                self.steps = r.counter("engine_steps", "decode steps",
+                                       labels=("replica",))
+    """, extra={"observability.py": OBS_MODULE})
+    assert codes(res) == []
+
+
+def test_flt005_helper_idiom_resolved(tmp_path):
+    # the pre-bound-helper idiom: labels travel one call level, so a
+    # helper binding the wrong label set flags at the caller's literal
+    res = run_snippet(tmp_path, """
+        from . import observability as obs
+
+        class EngineTelemetry:
+            def __init__(self, replica="0"):
+                r = obs.registry()
+                rl = ("site",)
+
+                def c(name, help):
+                    return r.counter(name, help, labels=rl)
+
+                self.steps = c("engine_steps", "decode steps")
+    """, extra={"observability.py": OBS_MODULE})
+    assert codes(res) == ["FLT005"]
+
+    res = run_snippet(tmp_path, """
+        from . import observability as obs
+
+        class EngineTelemetry:
+            def __init__(self, replica="0"):
+                r = obs.registry()
+                rl = ("replica",)
+
+                def c(name, help):
+                    return r.counter(name, help, labels=rl)
+
+                self.steps = c("engine_steps", "decode steps")
+    """, extra={"observability.py": OBS_MODULE})
+    assert codes(res) == []
+
+
+FLT005_CONFLICT = """
+    from . import observability as obs
+
+    def bind_router():
+        return obs.registry().counter("reqs_total", "routed",
+                                      labels=("replica",))
+
+    def bind_worker():
+        return obs.registry().counter("reqs_total", "handled",
+                                      labels=("site",))
+"""
+
+
+def test_flt005_schema_conflict(tmp_path):
+    res = run_snippet(tmp_path, FLT005_CONFLICT,
+                      extra={"observability.py": OBS_MODULE})
+    assert codes(res) == ["FLT005", "FLT005"]
+    assert "different schema" in res.findings[0].message
+
+
+def test_flt005_same_schema_re_registration_clean(tmp_path):
+    # idempotent re-registration (the registry contract) never flags
+    res = run_snippet(tmp_path, FLT005_CONFLICT.replace(
+        '("site",)', '("replica",)'),
+        extra={"observability.py": OBS_MODULE})
+    assert codes(res) == []
+
+
+def test_flt005_histogram_bucket_mismatch(tmp_path):
+    res = run_snippet(tmp_path, """
+        from . import observability as obs
+
+        def bind_a():
+            return obs.registry().histogram("lat_seconds", "h",
+                                            buckets=(0.1, 1.0))
+
+        def bind_b():
+            return obs.registry().histogram("lat_seconds", "h")
+    """, extra={"observability.py": OBS_MODULE})
+    assert codes(res) == ["FLT005", "FLT005"]
+
+
+def test_flt005_pragma(tmp_path):
+    res = run_snippet(tmp_path, FLT005_REPLICA_FLAGGED.replace(
+        'self.steps = r.counter("engine_steps", "decode steps")',
+        'self.steps = r.counter("engine_steps", "decode steps")'
+        '  # faultcheck: disable=FLT005'),
+        extra={"observability.py": OBS_MODULE})
+    assert codes(res) == []
+
+
+# ---------------------------------------------------------------- FLT006
+FLT006_FLAGGED = """
+    class Engine:
+        def step(self):
+            try:
+                self._go()
+            except Exception:
+                self._recover()
+
+        def _recover(self):
+            self._cleanup()
+
+        def _cleanup(self):
+            try:
+                self._close()
+            except Exception:
+                pass
+"""
+
+
+def test_flt006_swallowed_in_recovery_path(tmp_path):
+    res = run_snippet(tmp_path, FLT006_FLAGGED)
+    assert codes(res) == ["FLT006"]
+
+
+def test_flt006_loud_handlers_clean(tmp_path):
+    # re-raise, counter, terminal status, and capture-for-later all
+    # count as loud
+    res = run_snippet(tmp_path, """
+        class Engine:
+            def step(self):
+                try:
+                    self._go()
+                except Exception:
+                    self._recover()
+
+            def _recover(self):
+                try:
+                    self._close()
+                except Exception:
+                    raise
+                try:
+                    self._flush()
+                except Exception:
+                    self._m.errors.inc()
+                try:
+                    self._drop(self.req)
+                except Exception:
+                    self.req.status = "FAILED"
+                try:
+                    self._sync()
+                except Exception as e:
+                    err = e
+    """)
+    assert codes(res) == []
+
+
+def test_flt006_narrow_exception_clean(tmp_path):
+    res = run_snippet(tmp_path, FLT006_FLAGGED.replace(
+        "except Exception:\n                pass",
+        "except FileNotFoundError:\n                pass"))
+    assert codes(res) == []
+
+
+def test_flt006_outside_recovery_clean(tmp_path):
+    # the same swallow outside any recovery-reachable code is not this
+    # rule's business (general style is out of scope for a tier-1 gate)
+    res = run_snippet(tmp_path, """
+        class Loader:
+            def close(self):
+                try:
+                    self._fh.close()
+                except Exception:
+                    pass
+    """)
+    assert codes(res) == []
+
+
+def test_flt006_pragma(tmp_path):
+    res = run_snippet(tmp_path, FLT006_FLAGGED.replace(
+        "except Exception:\n                pass",
+        "except Exception:  # faultcheck: disable=FLT006\n"
+        "                pass"))
+    assert codes(res) == []
+
+
+# ---------------------------------------------------- machinery / parse
+def test_rule_catalogue_complete():
+    assert set(FAULT_RULES) == {"FLT001", "FLT002", "FLT003", "FLT004",
+                                "FLT005", "FLT006"}
+    assert set(AnalyzerConfig().rules) == set(FAULT_RULES)
+
+
+# one module that trips all three suites at once: TRC001 (flag read
+# under trace), MSH001 (unbound collective axis), FLT004 (unbounded
+# retry loop)
+TRIPLE_SOURCE = """
+    import time
+    import jax
+    from jax import lax
+    from .flags import get_flag
+
+    def kernel(x):
+        return x * get_flag("use_pallas")
+
+    step = jax.jit(kernel)
+
+    def bad_axis(x):
+        return lax.psum(x, "tp")
+
+    def forever(dispatch):
+        while True:
+            try:
+                return dispatch()
+            except RuntimeError:
+                time.sleep(0.1)
+"""
+
+_TRIPLE_LINES = {
+    "tracecheck": ('return x * get_flag("use_pallas")', "TRC001"),
+    "meshcheck": ('return lax.psum(x, "tp")', "MSH001"),
+    "faultcheck": ("time.sleep(0.1)", "FLT004"),
+}
+
+
+def _triple_results(tmp_path, source):
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(source))
+    return {
+        "tracecheck": tc.analyze_package(str(pkg)),
+        "meshcheck": mc.analyze_package(str(pkg)),
+        "faultcheck": analyze_package(str(pkg)),
+    }
+
+
+def test_three_suite_pragma_isolation_matrix(tmp_path):
+    """Every suite's pragma silences ONLY its own rule: a 3x3 matrix
+    over one module that trips TRC001 + MSH001 + FLT004 at once."""
+    base = {s: [f.rule for f in r.findings]
+            for s, r in _triple_results(tmp_path, TRIPLE_SOURCE).items()}
+    assert base == {"tracecheck": ["TRC001"], "meshcheck": ["MSH001"],
+                    "faultcheck": ["FLT004"]}
+
+    for pragma_tool in ("tracecheck", "meshcheck", "faultcheck"):
+        src = TRIPLE_SOURCE
+        for target_suite, (line, rule) in _TRIPLE_LINES.items():
+            src = src.replace(
+                line, f"{line}  # {pragma_tool}: disable={rule}")
+        results = _triple_results(tmp_path, src)
+        for suite, (_, rule) in _TRIPLE_LINES.items():
+            found = [f.rule for f in results[suite].findings]
+            if suite == pragma_tool:
+                assert found == [], (pragma_tool, suite, found)
+                assert len(results[suite].suppressed) == 1
+            else:
+                # the foreign pragma (even naming this suite's rule
+                # code) must not silence this suite
+                assert found == [rule], (pragma_tool, suite, found)
+
+
+def test_foreign_pragma_with_own_code_does_not_silence(tmp_path):
+    # a meshcheck pragma spelling an FLT code still never crosses suites
+    res = run_snippet(tmp_path, FLT004_FLAGGED.replace(
+        "time.sleep(0.1)",
+        "time.sleep(0.1)  # meshcheck: disable=FLT004"))
+    assert codes(res) == ["FLT004"]
+
+
+def test_baseline_round_trip_stable(tmp_path):
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(FLT004_FLAGGED))
+    res = analyze_package(str(pkg))
+    assert res.findings
+
+    b1 = tmp_path / "baseline.json"
+    entries1 = write_baseline(str(b1), res.findings)
+    assert entries1 == sorted(entries1)
+    new, leftovers = subtract_baseline(
+        analyze_package(str(pkg)).findings, load_baseline(str(b1)))
+    assert new == [] and not leftovers
+
+    # line-number stability: shift every finding down — fingerprints hold
+    (pkg / "mod.py").write_text(
+        "X = 1\nY = 2\n\n" + textwrap.dedent(FLT004_FLAGGED))
+    new, leftovers = subtract_baseline(
+        analyze_package(str(pkg)).findings, load_baseline(str(b1)))
+    assert new == [] and not leftovers
+
+
+def test_baseline_multiset_semantics(tmp_path):
+    src = """
+        import time
+
+        def bad(dispatch):
+            while True:
+                time.sleep(0.1)
+            while True:
+                time.sleep(0.1)
+    """
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(src))
+    findings = analyze_package(str(pkg)).findings
+    assert len(findings) == 2
+    b = tmp_path / "baseline.json"
+    write_baseline(str(b), findings[:1])
+    new, _ = subtract_baseline(findings, load_baseline(str(b)))
+    assert len(new) == 1
+
+
+def test_shared_parse_order_independence():
+    """All three suites over ONE parse must report exactly what they
+    report standalone, in any order — faultcheck's context build (and
+    its donor-pass re-derivation) is idempotent over the shared
+    ModuleInfos."""
+    fc_alone = analyze_package(PKG)
+    tc_alone = tc.analyze_package(PKG)
+    mc_alone = mc.analyze_package(PKG)
+
+    parsed = tc.parse_package(PKG)
+    tc_first = tc.analyze_package(PKG, parsed=parsed)
+    mc_mid = mc.analyze_package(PKG, parsed=parsed)
+    fc_last = analyze_package(PKG, parsed=parsed)
+
+    parsed2 = tc.parse_package(PKG)
+    fc_first = analyze_package(PKG, parsed=parsed2)
+    mc_mid2 = mc.analyze_package(PKG, parsed=parsed2)
+    tc_last = tc.analyze_package(PKG, parsed=parsed2)
+
+    def sig(res):
+        return [f.format() for f in res.findings]
+
+    assert sig(fc_last) == sig(fc_alone) == sig(fc_first)
+    assert sig(tc_first) == sig(tc_alone) == sig(tc_last)
+    assert sig(mc_mid) == sig(mc_alone) == sig(mc_mid2)
+    # coverage counters must be order-independent too
+    assert fc_last.n_recovery == fc_alone.n_recovery == fc_first.n_recovery
+    assert fc_last.n_registrations == fc_alone.n_registrations
+    assert tc_first.n_traced == tc_alone.n_traced == tc_last.n_traced
+
+
+def test_exclude_patterns_apply_to_shared_parse(tmp_path):
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(FLT004_FLAGGED))
+    parsed = tc.parse_package(str(pkg))
+    cfg = AnalyzerConfig(exclude_patterns=("mod.py",))
+    assert analyze_package(str(pkg), cfg, parsed=parsed).findings == []
+    assert analyze_package(str(pkg), cfg).findings == []
+
+
+# ------------------------------------------------------------------- CLI
+def test_single_suite_cli_exit_codes(tmp_path, capsys):
+    from paddle_tpu.analysis.faultcheck import cli
+
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(FLT004_FLAGGED))
+
+    # r11 hardening parity: a rule-filtered run must never write the
+    # baseline (it would clobber the other rules' entries)
+    rc = cli.main([str(pkg), "--rules", "FLT004", "--update-baseline"])
+    assert rc == 2
+    assert "clobber" in capsys.readouterr().err
+
+    rc = cli.main([str(pkg), "--no-baseline"])
+    assert rc == 1
+    assert "FLT004" in capsys.readouterr().out
+
+    rc = cli.main([str(pkg), "--rules", "FLT001", "--no-baseline"])
+    assert rc == 0          # FLT004 not selected
+    capsys.readouterr()
+
+    bl = tmp_path / "bl.json"
+    rc = cli.main([str(pkg), "--update-baseline", "--baseline", str(bl)])
+    assert rc == 0 and bl.exists()
+    capsys.readouterr()
+    rc = cli.main([str(pkg), "--baseline", str(bl)])
+    assert rc == 0
+    capsys.readouterr()
+
+    rc = cli.main(["--list-rules"])
+    assert rc == 0
+    assert "FLT006" in capsys.readouterr().out
+
+    rc = cli.main([str(tmp_path / "nope")])
+    assert rc == 2
+    capsys.readouterr()
+
+
+def _write_triple_pkg(tmp_path):
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(TRIPLE_SOURCE))
+    (tmp_path / "tools").mkdir()
+    return pkg
+
+
+def test_unified_cli_three_suites_and_formats(tmp_path):
+    pkg = _write_triple_pkg(tmp_path)
+    env = dict(os.environ, PYTHONPATH=REPO)
+    cli = [sys.executable, os.path.join(REPO, "tools", "analyze.py")]
+
+    r = subprocess.run(cli + [str(pkg), "--no-baseline", "--json"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 1, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert [f["rule"] for f in payload["tracecheck"]["findings"]] == \
+        ["TRC001"]
+    assert [f["rule"] for f in payload["meshcheck"]["findings"]] == \
+        ["MSH001"]
+    assert [f["rule"] for f in payload["faultcheck"]["findings"]] == \
+        ["FLT004"]
+
+    # --suite faultcheck runs ONLY the FLT rules
+    r = subprocess.run(cli + [str(pkg), "--suite", "faultcheck",
+                              "--no-baseline"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 1
+    assert "FLT004" in r.stdout
+    assert "TRC001" not in r.stdout and "MSH001" not in r.stdout
+
+    # SARIF: valid JSON, one run, all three suites' results present
+    r = subprocess.run(cli + [str(pkg), "--no-baseline", "--format",
+                              "sarif"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 1
+    sarif = json.loads(r.stdout)
+    assert sarif["version"] == "2.1.0"
+    results = sarif["runs"][0]["results"]
+    assert {res["ruleId"] for res in results} == \
+        {"TRC001", "MSH001", "FLT004"}
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("mod.py")
+    assert loc["region"]["startLine"] > 0
+    rule_ids = {rule["id"] for rule in
+                sarif["runs"][0]["tool"]["driver"]["rules"]}
+    assert {"TRC001", "MSH001", "FLT004"} <= rule_ids
+
+    # github annotations: one ::error line per finding
+    r = subprocess.run(cli + [str(pkg), "--no-baseline", "--format",
+                              "github"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 1
+    lines = [l for l in r.stdout.splitlines() if l.startswith("::error")]
+    assert len(lines) == 3
+    assert any("title=FLT004" in l and "file=" in l and "line=" in l
+               for l in lines)
+
+    # --update-baseline writes all three, then the gate is clean
+    r = subprocess.run(cli + [str(pkg), "--update-baseline"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    for suite in ("tracecheck", "meshcheck", "faultcheck"):
+        assert (tmp_path / "tools" / f"{suite}_baseline.json").exists()
+    r = subprocess.run(cli + [str(pkg)], capture_output=True, text=True,
+                       env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_unified_cli_changed_only(tmp_path):
+    pkg = _write_triple_pkg(tmp_path)
+    (pkg / "other.py").write_text(textwrap.dedent("""
+        import time
+
+        def spin():
+            while True:
+                time.sleep(1.0)
+    """))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    cli = [sys.executable, os.path.join(REPO, "tools", "analyze.py")]
+    git = ["git", "-C", str(tmp_path), "-c", "user.email=t@t",
+           "-c", "user.name=t"]
+    subprocess.run(git[:3] + ["init", "-q"], check=True,
+                   capture_output=True)
+    subprocess.run(git + ["add", "-A"], check=True, capture_output=True)
+    subprocess.run(git + ["commit", "-qm", "seed"], check=True,
+                   capture_output=True)
+
+    # nothing changed: the diff-scoped report is empty and exits 0
+    r = subprocess.run(cli + [str(pkg), "--no-baseline",
+                              "--changed-only", "--json"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert all(payload[s]["findings"] == []
+               for s in ("tracecheck", "meshcheck", "faultcheck"))
+
+    # touch ONE file: only its findings report (other.py's FLT004 from
+    # the unchanged file stays filtered), and untracked files count
+    (pkg / "mod.py").write_text(
+        textwrap.dedent(TRIPLE_SOURCE) + "\nX = 1\n")
+    r = subprocess.run(cli + [str(pkg), "--no-baseline",
+                              "--changed-only", "--json"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 1
+    payload = json.loads(r.stdout)
+    assert [f["rule"] for f in payload["faultcheck"]["findings"]] == \
+        ["FLT004"]
+    assert all(f["path"].endswith("mod.py")
+               for s in ("tracecheck", "meshcheck", "faultcheck")
+               for f in payload[s]["findings"])
+
+    # baselined-but-filtered entries must not report as stale
+    r = subprocess.run(cli + [str(pkg), "--update-baseline"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0
+    r = subprocess.run(cli + [str(pkg), "--changed-only", "--json"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert all(payload[s]["stale_baseline_entries"] == []
+               for s in ("tracecheck", "meshcheck", "faultcheck"))
+
+    # --changed-only + --update-baseline: rejected (subset clobber)
+    r = subprocess.run(cli + [str(pkg), "--changed-only",
+                              "--update-baseline"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 2
+    assert "clobber" in r.stderr
+
+    # single-FILE target: findings' paths are relative to the file's
+    # grandparent while git names are root-relative — the filter must
+    # rebase instead of silently reporting a false clean on the very
+    # file being edited
+    r = subprocess.run(cli + [str(pkg / "mod.py"), "--no-baseline",
+                              "--changed-only", "--json"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 1, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert [f["rule"] for f in payload["faultcheck"]["findings"]] == \
+        ["FLT004"]
+
+
+# ------------------------------------------------------- the tier-1 gate
+def test_package_gate_zero_new_findings():
+    """THE gate: the whole package against the checked-in baseline —
+    any new finding fails tier-1 (fix it, pragma it with a reason, or
+    consciously re-baseline)."""
+    t0 = time.time()
+    result = analyze_package(PKG)
+    elapsed = time.time() - t0
+    assert not result.errors, result.errors
+
+    new, leftovers = subtract_baseline(result.findings,
+                                       load_baseline(BASELINE))
+    assert new == [], (
+        "faultcheck found NEW recovery-discipline findings:\n"
+        + "\n".join(f.format() for f in new)
+        + "\n\nfix them, add a '# faultcheck: disable=FLT00x' pragma "
+          "with a reason, or (legacy only) re-run "
+          "'python tools/analyze.py --suite faultcheck "
+          "--update-baseline'")
+    assert not leftovers, (
+        "stale baseline entries — run 'python tools/analyze.py "
+        "--suite faultcheck --update-baseline':\n"
+        + "\n".join(sorted(leftovers)))
+    assert elapsed < 15.0, f"faultcheck took {elapsed:.1f}s"
+
+
+def test_package_gate_scale_sanity():
+    """Coverage floor: if seam/registration/donor detection silently
+    breaks the gate would pass vacuously.  Lower bounds, not exact
+    counts."""
+    result = analyze_package(PKG)
+    assert result.n_files > 150
+    assert result.n_functions > 2000
+    assert result.n_recovery > 20       # recovery-reachable functions
+    assert result.n_covered > 30        # recovery-covered functions
+    assert result.n_registrations > 40  # metric-family registrations
+    # the known deliberate mid-mutation schedule points stay pragma'd,
+    # which proves the FLT002 scan walks the real serving code
+    assert len(result.suppressed) >= 2

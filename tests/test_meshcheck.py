@@ -704,14 +704,16 @@ def test_package_gate_zero_new_findings():
 
 
 def test_combined_gate_single_parse_budget():
-    """tracecheck + meshcheck over ONE parse stay inside the r08 ~15 s
-    tier-1 budget."""
+    """tracecheck + meshcheck + faultcheck over ONE parse stay inside
+    the r08 ~15 s tier-1 budget."""
+    from paddle_tpu.analysis import faultcheck as fc
     t0 = time.time()
     parsed = tc.parse_package(PKG)
     tc_res = tc.analyze_package(PKG, parsed=parsed)
     mc_res = analyze_package(PKG, parsed=parsed)
+    fc_res = fc.analyze_package(PKG, parsed=parsed)
     elapsed = time.time() - t0
-    assert not tc_res.errors and not mc_res.errors
+    assert not tc_res.errors and not mc_res.errors and not fc_res.errors
     assert elapsed < 15.0, f"combined analysis took {elapsed:.1f}s"
 
 

@@ -313,6 +313,40 @@ def test_trc003_program_cache_admission_resolved(tmp_path):
     assert codes(res) == ["TRC003"]
 
 
+def test_trc003_per_rung_program_dict_resolved(tmp_path):
+    """The r12 bucket-ladder idiom: the builder result lands in a local
+    that is memoized into a dict and returned (``fn = cache.get(...);
+    self._fns[b] = fn; return fn``).  The donor pass must resolve the
+    ``return fn`` through the local binding — this exact shape silently
+    dropped the serving DECODE dispatch from donor analysis after the
+    r12 per-rung refactor (caught while building faultcheck's FLT001,
+    which reuses the donor graph)."""
+    res = run_snippet(tmp_path, """
+        import functools
+        import jax
+
+        def _build(note):
+            def run(params, pools):
+                note()
+                return pools
+            return jax.jit(run, donate_argnums=(1,))
+
+        class Engine:
+            def program(self, cache, b):
+                fn = self._fns.get(b)
+                if fn is None:
+                    fn = cache.get("key", functools.partial(_build))
+                    self._fns[b] = fn
+                return fn
+
+            def step(self, cache, params, pools, b):
+                fn = self.program(cache, b)
+                out = fn(params, pools)
+                return out, pools[0]      # pools was donated
+    """)
+    assert codes(res) == ["TRC003"]
+
+
 # ---------------------------------------------------------------- TRC004
 TRC004_FLAGGED = """
     import jax

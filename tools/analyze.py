@@ -1,21 +1,32 @@
 #!/usr/bin/env python
-"""Unified static-analysis gate: tracecheck + meshcheck in ONE parse.
+"""Unified static-analysis gate: tracecheck + meshcheck + faultcheck in
+ONE parse.
 
 Usage:
-    python tools/analyze.py                      # both suites, gate
-    python tools/analyze.py --suite meshcheck    # one suite
-    python tools/analyze.py --json
-    python tools/analyze.py --update-baseline    # rewrites BOTH baselines
+    python tools/analyze.py                      # all three suites, gate
+    python tools/analyze.py --suite faultcheck   # one suite
+    python tools/analyze.py --format json        # (--json still works)
+    python tools/analyze.py --format sarif       # CI code-scanning upload
+    python tools/analyze.py --format github      # ::error annotations
+    python tools/analyze.py --changed-only       # git-diff-scoped report
+    python tools/analyze.py --update-baseline    # rewrites ALL baselines
     python tools/analyze.py --list-rules
 
 The package is parsed ONCE (ast.parse dominates analyzer wall clock);
-both suites consume the same ParsedPackage, so the combined tier-1 gate
+all suites consume the same ParsedPackage, so the combined tier-1 gate
 stays inside the r08 ~15 s budget.  Pure AST — the analysis package is
 loaded standalone (never through ``paddle_tpu/__init__``), so no jax
 import, no device; safe as a pre-commit hook or bare CI step.
 
-Baselines: tools/tracecheck_baseline.json, tools/meshcheck_baseline.json.
-Exit codes: 0 clean, 1 new findings (either suite), 2 usage/parse errors.
+``--changed-only`` still parses and analyzes the WHOLE package (the
+call graph, donor propagation and SPMD/recovery contexts need every
+module) but reports only findings in files the git working tree changed
+vs HEAD (staged, unstaged, or untracked) — the fast pre-push loop.
+Stale-baseline reporting is suppressed in that mode: an entry for an
+unchanged file is filtered, not stale.
+
+Baselines: tools/{tracecheck,meshcheck,faultcheck}_baseline.json.
+Exit codes: 0 clean, 1 new findings (any suite), 2 usage/parse errors.
 """
 
 from __future__ import annotations
@@ -24,13 +35,19 @@ import argparse
 import importlib.util
 import json
 import os
+import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ANALYSIS_DIR = os.path.join(REPO, "paddle_tpu", "analysis")
 
-SUITES = ("tracecheck", "meshcheck")
+SUITES = ("tracecheck", "meshcheck", "faultcheck")
+FORMATS = ("human", "json", "sarif", "github")
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
 
 
 def _load_analysis():
@@ -45,42 +62,138 @@ def _load_analysis():
     sys.modules["ptanalysis"] = mod
     spec.loader.exec_module(mod)
     import importlib as _il
-    return (_il.import_module("ptanalysis.tracecheck"),
-            _il.import_module("ptanalysis.meshcheck"))
+    return {s: _il.import_module(f"ptanalysis.{s}") for s in SUITES}
+
+
+def _rule_catalogue(pkg):
+    for attr in ("RULES", "MESH_RULES", "FAULT_RULES"):
+        cat = getattr(pkg, attr, None)
+        if cat:
+            return cat
+    return {}
 
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="analyze",
-        description="Run the tracecheck (TRC) + meshcheck (MSH) static "
-                    "analyzers over one AST parse.")
+        description="Run the tracecheck (TRC) + meshcheck (MSH) + "
+                    "faultcheck (FLT) static analyzers over one AST "
+                    "parse.")
     p.add_argument("path", nargs="?",
                    default=os.path.join(REPO, "paddle_tpu"),
                    help="package directory (or single file) to analyze")
     p.add_argument("--suite", choices=("all",) + SUITES, default="all")
-    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--format", choices=FORMATS, default=None,
+                   dest="fmt",
+                   help="output format: human (default), json, sarif "
+                        "(2.1.0 — CI code-scanning upload), github "
+                        "(::error workflow annotations)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="alias for --format json")
+    p.add_argument("--changed-only", action="store_true",
+                   help="report only findings in files changed vs git "
+                        "HEAD (staged/unstaged/untracked); the whole "
+                        "package is still parsed for context")
     p.add_argument("--no-baseline", action="store_true",
                    help="ignore baselines: report every finding")
     p.add_argument("--update-baseline", action="store_true",
                    help="rewrite the selected suites' baselines from "
                         "current findings")
     p.add_argument("--rules", default=None,
-                   help="comma-separated subset of rules (TRC00x/MSH00x; "
-                        "each suite picks out its own)")
+                   help="comma-separated subset of rules (TRC00x/MSH00x/"
+                        "FLT00x; each suite picks out its own)")
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("--stats", action="store_true")
     return p
 
 
+def _changed_files(repo_hint: str, findings_base: str):
+    """Paths the working tree changed vs HEAD (plus untracked files),
+    rebased onto ``findings_base`` — the directory findings' paths are
+    relative to — so the filter matches regardless of where the git
+    root sits relative to the analyzed package (or single file).
+    Raises CalledProcessError on a non-repo."""
+    def git(cwd, *args):
+        out = subprocess.run(["git", "-C", cwd] + list(args),
+                             capture_output=True, text=True, check=True)
+        return [l.strip() for l in out.stdout.splitlines() if l.strip()]
+
+    # resolve the toplevel first: `diff --name-only` is root-relative
+    # from any cwd, while `ls-files --others` is cwd-relative — running
+    # both AT the toplevel makes every name root-relative
+    top = git(repo_hint, "rev-parse", "--show-toplevel")[0]
+    names = git(top, "diff", "--name-only", "HEAD")
+    names += git(top, "ls-files", "--others", "--exclude-standard")
+    changed = set()
+    for n in names:
+        rel = os.path.relpath(os.path.join(top, n), findings_base)
+        if not rel.startswith(".."):
+            changed.add(rel.replace(os.sep, "/"))
+    return changed
+
+
+def _to_sarif(per_suite, catalogues) -> dict:
+    rules, results = [], []
+    seen_rules = set()
+    for suite, payload in per_suite.items():
+        cat = catalogues.get(suite, {})
+        for f in payload["findings"]:
+            rid = f["rule"]
+            if rid not in seen_rules:
+                seen_rules.add(rid)
+                rules.append({
+                    "id": rid,
+                    "shortDescription": {
+                        "text": cat.get(rid, rid)[:200]},
+                })
+            results.append({
+                "ruleId": rid,
+                "level": "error",
+                "message": {"text": f["message"]},
+                "partialFingerprints": {
+                    "fingerprint/v1": f["fingerprint"]},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f["path"],
+                            "uriBaseId": "SRCROOT"},
+                        "region": {"startLine": f["line"]},
+                    }}],
+            })
+    return {
+        "version": SARIF_VERSION,
+        "$schema": SARIF_SCHEMA,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "analyze",
+                "informationUri":
+                    "tools/analyze.py (tracecheck+meshcheck+faultcheck)",
+                "rules": sorted(rules, key=lambda r: r["id"]),
+            }},
+            "results": results,
+        }],
+    }
+
+
+def _emit_github(per_suite) -> None:
+    for suite in sorted(per_suite):
+        for f in per_suite[suite]["findings"]:
+            msg = f["message"].replace("%", "%25").replace(
+                "\r", "").replace("\n", "%0A")
+            print(f"::error file={f['path']},line={f['line']},"
+                  f"title={f['rule']}::{msg}")
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    tc, mc = _load_analysis()
+    fmt = args.fmt or ("json" if args.as_json else "human")
+    pkgs = _load_analysis()
 
     if args.list_rules:
-        for code in sorted(tc.RULES):
-            print(f"{code}: {tc.RULES[code]}")
-        for code in sorted(mc.MESH_RULES):
-            print(f"{code}: {mc.MESH_RULES[code]}")
+        for suite in SUITES:
+            cat = _rule_catalogue(pkgs[suite])
+            for code in sorted(cat):
+                print(f"{code}: {cat[code]}")
         return 0
     if not os.path.exists(args.path):
         print(f"analyze: no such path: {args.path}", file=sys.stderr)
@@ -99,7 +212,33 @@ def main(argv=None) -> int:
         wanted = {r.strip().upper() for r in args.rules.split(",")
                   if r.strip()}
 
+    changed = None
+    if args.changed_only:
+        if args.update_baseline:
+            # same clobber argument one level up: a diff-scoped run
+            # sees a subset of files, and writing its findings out
+            # would erase every unchanged file's baseline entries
+            print("analyze: --changed-only cannot be combined with "
+                  "--update-baseline (it would clobber unchanged "
+                  "files' baseline entries)", file=sys.stderr)
+            return 2
+        p = os.path.abspath(args.path.rstrip(os.sep))
+        # findings' paths are relative to the package's PARENT — for a
+        # single-file target that is the file's grandparent (the file's
+        # own directory is the package), mirroring parse_package
+        findings_base = (os.path.dirname(os.path.dirname(p))
+                         if os.path.isfile(p) else os.path.dirname(p))
+        try:
+            changed = _changed_files(
+                p if os.path.isdir(p) else os.path.dirname(p),
+                findings_base)
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            print(f"analyze: --changed-only needs a git checkout "
+                  f"({e})", file=sys.stderr)
+            return 2
+
     t0 = time.time()
+    tc = pkgs["tracecheck"]
     parsed = tc.parse_package(args.path)
     for err in parsed.errors:
         print(f"analyze: parse error: {err}", file=sys.stderr)
@@ -110,16 +249,15 @@ def main(argv=None) -> int:
 
     parent = os.path.dirname(os.path.abspath(args.path.rstrip(os.sep)))
     baseline_paths = {
-        "tracecheck": os.path.join(parent, "tools",
-                                   "tracecheck_baseline.json"),
-        "meshcheck": os.path.join(parent, "tools",
-                                  "meshcheck_baseline.json"),
-    }
+        s: os.path.join(parent, "tools", f"{s}_baseline.json")
+        for s in SUITES}
 
     payload = {}
+    catalogues = {}
     any_new = False
     for suite in suites:
-        pkg = tc if suite == "tracecheck" else mc
+        pkg = pkgs[suite]
+        catalogues[suite] = _rule_catalogue(pkg)
         config = pkg.AnalyzerConfig()
         if wanted is not None:
             sub = tuple(r for r in config.rules if r in wanted)
@@ -128,20 +266,25 @@ def main(argv=None) -> int:
             config = pkg.AnalyzerConfig(rules=sub)
         result = pkg.analyze_package(args.path, config, parsed=parsed)
 
+        findings = result.findings
+        if changed is not None:
+            findings = [f for f in findings if f.path in changed]
+
         bl_path = baseline_paths[suite]
         if args.update_baseline:
-            entries = pkg.write_baseline(bl_path, result.findings)
+            entries = pkg.write_baseline(bl_path, findings)
             print(f"{suite}: baselined {len(entries)} finding(s) -> "
                   f"{bl_path}")
             continue
         baseline = (pkg.load_baseline(bl_path)
                     if not args.no_baseline else None)
         if baseline:
-            new, leftovers = pkg.subtract_baseline(result.findings,
-                                                   baseline)
-            n_baselined = len(result.findings) - len(new)
+            new, leftovers = pkg.subtract_baseline(findings, baseline)
+            n_baselined = len(findings) - len(new)
+            if changed is not None:
+                leftovers = {}      # filtered != stale
         else:
-            new, leftovers, n_baselined = result.findings, {}, 0
+            new, leftovers, n_baselined = findings, {}, 0
         any_new = any_new or bool(new)
 
         payload[suite] = {
@@ -150,12 +293,14 @@ def main(argv=None) -> int:
             "suppressed": len(result.suppressed),
             "stale_baseline_entries": sorted(leftovers),
         }
-        if not args.as_json:
+        if fmt == "human":
             for f in new:
                 print(f.format())
             summary = (f"{suite}: {len(new)} new finding(s), "
                        f"{n_baselined} baselined, "
                        f"{len(result.suppressed)} pragma-suppressed")
+            if changed is not None:
+                summary += f" (changed-only: {len(changed)} file(s))"
             if leftovers:
                 summary += (f"; {sum(leftovers.values())} stale "
                             "baseline entr(ies) — run --update-baseline")
@@ -164,10 +309,15 @@ def main(argv=None) -> int:
     elapsed = time.time() - t0
     if args.update_baseline:
         return 0
-    if args.as_json:
+    if fmt == "json":
         payload["files"] = parsed.n_files
         payload["elapsed_s"] = round(elapsed, 3)
         print(json.dumps(payload, indent=1, sort_keys=True))
+    elif fmt == "sarif":
+        print(json.dumps(_to_sarif(payload, catalogues), indent=1,
+                         sort_keys=True))
+    elif fmt == "github":
+        _emit_github(payload)
     elif args.stats:
         print(f"-- {parsed.n_files} files, one parse, "
               f"{len(suites)} suite(s) in {elapsed:.2f}s")

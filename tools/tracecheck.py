@@ -14,8 +14,8 @@ baseline lives at tools/tracecheck_baseline.json; the tier-1 test
 (tests/test_tracecheck.py) fails on any finding beyond it.
 
 ``python tools/analyze.py`` runs this suite AND meshcheck (MSH001-006,
-SPMD collective discipline) over one shared parse — prefer it for the
-full gate.
+SPMD collective discipline) AND faultcheck (FLT001-006, recovery
+discipline) over one shared parse — prefer it for the full gate.
 """
 
 import importlib.util
