@@ -287,7 +287,8 @@ define_flag("fault_inject", "",
             "faults): ';'-separated '<site>:every=N' / '<site>:p=F"
             "[:seed=N][:times=N][:after=N]' entries arming named "
             "injection sites (prefill, decode_dispatch, preempt, "
-            "kv_spill, router_dispatch, program_build, train_dispatch, "
+            "kv_spill, router_dispatch, spec_draft, spec_verify, "
+            "program_build, train_dispatch, "
             "train_sync, dataloader_worker, "
             "checkpoint_save). Empty (default) = disabled: components "
             "bind no-op stubs at construction, zero hot-path cost. "
@@ -379,6 +380,49 @@ define_flag("serving_kv_host_tier_pages", 0,
             "re-adopted page. Beyond the host budget the coldest "
             "spilled pages drop entirely (classic eviction). Eager-"
             "only: pure pool bookkeeping, never traced.")
+define_flag("serving_spec_gamma", 4,
+            "Initial speculative-decoding draft length γ for a "
+            "ServingEngine built with draft_model= — how many draft "
+            "tokens one target verify checks. Snapped down to the "
+            "nearest FLAGS_serving_spec_rungs rung; per-request "
+            "adaptation (FLAGS_serving_spec_adaptive) takes over from "
+            "there. Eager-only: γ reaches compiled programs through "
+            "the program-cache key (DecodeKey.extra), never through a "
+            "traced flag read.")
+define_flag("serving_spec_rungs", "2,4,8",
+            "','-separated γ rung set for speculative serving. Each "
+            "rung compiles one draft-propose and one verify program "
+            "(cached, like bucket-ladder rungs), and adaptive γ moves "
+            "between rungs instead of retracing per value — steady "
+            "state is zero-retrace by construction. Eager-only; part "
+            "of program identity via DecodeKey.extra.")
+define_flag("serving_spec_adaptive", True,
+            "Per-request adaptive γ: an accept-rate EMA (the "
+            "serving_spec_accept_rate signal) moves each request up a "
+            "γ rung when the draft keeps agreeing and down when it "
+            "keeps missing, so a hard request stops wasting draft "
+            "forwards. Off = every round uses the "
+            "FLAGS_serving_spec_gamma rung. Eager-only scheduling "
+            "policy.")
+define_flag("serving_spec_max_slots", 0,
+            "Decode-slot budget speculation may bill: a speculating "
+            "request prices as γ+1 decode slots (its verify covers γ+1 "
+            "positions), and a step's rows only take speculation "
+            "rounds when n_rows * (γ+1) fits the budget — as "
+            "occupancy rises γ is capped down and finally priced out "
+            "entirely (plain batched decode is the better schedule "
+            "there). 0 (default) = max(max_batch, smallest rung + 1), "
+            "so a lone decode row always affords the smallest rung. "
+            "Eager-only.")
+define_flag("serving_spec_sync_chunk", 64,
+            "Chunk width (tokens) of the draft-KV catch-up sync: when "
+            "a request enters speculation with its draft cache behind "
+            "the target's accepted length (admission prefilled the "
+            "target only, or plain decode ran while speculation was "
+            "priced out), the gap teacher-forces through the draft's "
+            "chunked-prefill program in fixed (1, C) chunks — one "
+            "cached program, any gap length. Eager-only; the width "
+            "reaches the program via the cache key.")
 define_flag("train_max_retries", 2,
             "Model.fit step-recovery budget: retries of a failed "
             "dispatch (sync to last-good state, emergency checkpoint, "

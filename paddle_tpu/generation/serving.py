@@ -85,6 +85,22 @@ class Request:
     # tighter-deadline arrival (bounded by FLAGS_serving_preempt_budget;
     # never counts against the replay-recovery retry budget)
     preempts: int = 0
+    # ---- speculative decoding (r16) ---------------------------------
+    # sampling law (temperature 0 = greedy); temperature > 0 requires a
+    # draft-model engine — the spec verify program is the only sampler
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: Optional[int] = None
+    # per-request adaptive draft length: current γ rung (0 = none yet)
+    # and the accept-rate EMA that moves it. Both SURVIVE replay — the
+    # draft's observed agreement is a property of the request's text,
+    # not of the admission that learned it
+    gamma: int = 0
+    spec_ema: float = 0.5
+    # transient: the draft pool holds this slot's allocation (the draft
+    # KV cursor itself is the draft pool's seq_lens row)
+    spec_ready: bool = False
 
 
 _POOL_STATES = ("used", "free", "shared", "pinned", "spilled")
@@ -209,6 +225,29 @@ class _EngineTelemetry:
             "decode tokens preemption victims will regenerate on "
             "replay — the compute a preemption trades for deadline "
             "slack")
+        # ---- speculative decoding (r16)
+        self.spec_rounds_c = c(
+            "serving_spec_rounds",
+            "speculation rounds retired (one draft-propose scan + one "
+            "target-verify chunk per round)")
+        self.spec_accept = h(
+            "serving_spec_accept_rate",
+            "per-round fraction of draft proposals the target verify "
+            "accepted — the signal per-request adaptive γ follows")
+        self.spec_accepted = c(
+            "serving_spec_tokens_accepted",
+            "draft-proposed tokens the target verify accepted")
+        self.spec_rejected = c(
+            "serving_spec_tokens_rejected",
+            "draft-proposed tokens the target verify rejected — their "
+            "KV positions rolled back to the accepted length and the "
+            "next dispatch overwrites them")
+        self.spec_gamma = g(
+            "serving_spec_gamma",
+            "γ (draft tokens per round) of the most recent speculation "
+            "round: per-request adaptive within the "
+            "FLAGS_serving_spec_rungs set, capped down as batch "
+            "occupancy prices speculation out")
         # ---- memwatch pool ledger (r13): step-end gauges over the
         # PagedKVCache ledger, pre-resolved per state label; "spilled"
         # (r14) is the host-RAM tier
@@ -260,6 +299,9 @@ class _NullEngineTelemetry:
         self.prefill_chunk_s = self.decode_stall_s = obs.NULL
         self.bucket = self.migrations = obs.NULL
         self.preemptions = self.preempted_tokens = obs.NULL
+        self.spec_rounds_c = self.spec_accept = obs.NULL
+        self.spec_accepted = self.spec_rejected = obs.NULL
+        self.spec_gamma = obs.NULL
         self.pool_pages = {s: obs.NULL for s in _POOL_STATES}
         self.pool_bytes = {s: obs.NULL for s in _POOL_STATES}
         self.pool_frag = self.host_tier_peak = obs.NULL
@@ -668,7 +710,20 @@ class ServingEngine:
     rungs as occupancy changes, and decodes one token for every active
     slot. ``run`` steps until drained and returns {rid: tokens}; the
     non-blocking surface is ``run_step``/``poll`` plus per-token
-    ``submit(on_token=...)`` streaming callbacks."""
+    ``submit(on_token=...)`` streaming callbacks.
+
+    With ``draft_model=`` the engine decodes SPECULATIVELY (r16): the
+    draft proposes γ tokens in one scanned dispatch, the target checks
+    all of them (plus the bonus position) in one (1, γ+1) chunk through
+    the r12 chunked-prefill machinery, and the KV cursors of both pools
+    roll to exactly the accepted length. Greedy output is bit-identical
+    to the non-speculative engine by construction; ``submit`` requests
+    with ``temperature > 0`` sample losslessly through the rejection
+    test. γ adapts per request from the observed accept rate, and a
+    speculating request bills γ+1 decode slots against the
+    FLAGS_serving_spec_max_slots budget, so rising batch occupancy caps
+    γ down and finally prices speculation out in favor of the plain
+    batched decode step."""
 
     def __init__(self, model, max_batch: int = 4, page_size: int = 64,
                  num_pages: Optional[int] = None, max_seq_len: int = 1024,
@@ -676,7 +731,8 @@ class ServingEngine:
                  bucket_ladder: Optional[Tuple[int, ...]] = None,
                  prefill_chunk: Optional[int] = None,
                  replica: str = "0",
-                 host_tier_pages: Optional[int] = None):
+                 host_tier_pages: Optional[int] = None,
+                 draft_model=None):
         from .. import flags as _flags
         from ..jit import ensure_live
 
@@ -762,6 +818,71 @@ class ServingEngine:
             _flags.get_flag("serving_preempt_horizon"))
         self.preemptions = 0        # host probe (telemetry-independent)
         self._host_tier_peak = 0
+        # ---- speculative decoding (r16): a draft model turns decode
+        # into propose-γ/verify-once rounds. The draft keeps its OWN
+        # paged pool in slot lockstep with the target's; the draft
+        # pool's seq_lens row IS the draft-KV cursor, so falling behind
+        # (admission prefilled the target only, or plain decode ran
+        # while speculation was priced out) is detected by comparing
+        # the two cursors — no separate bookkeeping to drift
+        self.draft_model = draft_model
+        self._draft_pool: Optional[PagedKVCache] = None
+        if draft_model is not None:
+            dspec = draft_model.cache_spec()
+            dparams, dbuffers = draft_model.raw_state()
+            ensure_live(dparams, "call step.sync_to_model() first.")
+            self._draft_params, self._draft_buffers = dparams, dbuffers
+            dmax = getattr(getattr(draft_model, "config", None),
+                           "max_position_embeddings", None)
+            if dmax is not None and max_seq_len > dmax:
+                raise ValueError(
+                    f"engine max_seq_len ({max_seq_len}) exceeds the "
+                    f"draft model's max_position_embeddings ({dmax})")
+            # ALWAYS worst-case pages (the serving_page_budget cap does
+            # not apply): the target pool admits against its budget —
+            # possibly on adopted shared-prefix pages — and the draft
+            # sync must then never fail an allocate of the same span.
+            # Draft KV is a fraction of target KV, so the safety margin
+            # is cheap where it matters
+            self._draft_geom = dict(
+                num_layers=len(dspec),
+                num_pages=1 + max_batch * (-(-max_seq_len // page_size)),
+                page_size=page_size,
+                num_kv_heads=dspec[0][0], head_dim=dspec[0][1],
+                max_batch=max_batch, max_seq_len=max_seq_len,
+                dtype=jnp.result_type(next(iter(dparams.values()))),
+                reserve_null_page=True)
+            self._draft_pool = PagedKVCache(**self._draft_geom)
+            raw = str(_flags.get_flag("serving_spec_rungs"))
+            srungs = sorted({int(r) for r in raw.replace(";", ",").split(",")
+                             if r.strip()})
+            if not srungs or srungs[0] < 1:
+                raise ValueError(
+                    f"serving_spec_rungs must name rungs >= 1: {raw!r}")
+            self.spec_rungs: Tuple[int, ...] = tuple(srungs)
+            g0 = int(_flags.get_flag("serving_spec_gamma"))
+            self.spec_gamma_default = max(
+                r for r in self.spec_rungs if r <= max(g0, srungs[0]))
+            self.spec_adaptive = bool(
+                _flags.get_flag("serving_spec_adaptive"))
+            # slot budget for γ+1 pricing; the floor keeps a lone
+            # decode row affordable at the smallest rung even on tiny
+            # engines (batch-1 speculation is the headline win)
+            self.spec_slots = (int(_flags.get_flag("serving_spec_max_slots"))
+                               or max(max_batch, srungs[0] + 1))
+            self.spec_sync_chunk = max(
+                1, int(_flags.get_flag("serving_spec_sync_chunk")))
+            self._f_spec_draft = faults.site("spec_draft")
+            self._f_spec_verify = faults.site("spec_verify")
+            self._spec_fns: Dict[tuple, object] = {}
+            self._spec_keys: Dict[tuple, object] = {}
+            self.spec_draft_key = None      # test probes: last-used keys
+            self.spec_verify_key = None
+            # host probes (bench/test surface, telemetry-independent)
+            self.spec_rounds = 0
+            self.spec_tokens_accepted = 0
+            self.spec_tokens_rejected = 0
+            self.spec_last_gamma = 0
         self._slots: List[Optional[Request]] = [None] * max_batch
         self._queue: List[Request] = []
         self._results: Dict[int, List[int]] = {}
@@ -812,6 +933,8 @@ class ServingEngine:
         from .program_cache import model_signature
         self._flags = _flags.snapshot(_flags.PROGRAM_FLAGS)
         self._model_sig = model_signature(model)
+        self._draft_sig = (model_signature(draft_model)
+                           if draft_model is not None else None)
         # telemetry binding is per-engine and resolved once here (the
         # no-op stubs cost one method call per write when disabled);
         # the replica id labels every series so fleet engines coexist
@@ -827,7 +950,9 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None,
                deadline: Optional[float] = None,
-               on_token: Optional[Callable] = None) -> int:
+               on_token: Optional[Callable] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, seed: Optional[int] = None) -> int:
         """Enqueue one request. ``deadline`` (seconds from now) bounds
         its total latency: a request past its deadline — queued or in
         flight — is terminated ``TIMEOUT`` at the next step boundary
@@ -839,7 +964,23 @@ class ServingEngine:
         request reaches a terminal status — callbacks fire on the
         caller's thread at step boundaries, after dispatch/recovery, so
         a raising callback surfaces to the caller instead of tripping
-        replay recovery."""
+        replay recovery.
+
+        ``temperature``/``top_k``/``top_p`` select the sampling law
+        (0 = greedy, the default). Sampling requires a speculative
+        engine (``draft_model=``): the verify program's rejection
+        sampler is the only sampler — it draws the exact
+        temperature/top-k/top-p-filtered target distribution. ``seed``
+        keys the request's sampling stream (default: its rid), and the
+        stream is position-keyed, so replay recovery and preemption
+        reproduce sampled continuations bit-identically."""
+        if temperature is not None and float(temperature) < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if float(temperature or 0.0) > 0.0 and self.draft_model is None:
+            raise ValueError(
+                "temperature > 0 requires a speculative engine "
+                "(ServingEngine(..., draft_model=...)): the spec verify "
+                "program is the engine's sampler")
         prompt = np.asarray(
             prompt._value if hasattr(prompt, "_value") else prompt,
             np.int32).reshape(-1)
@@ -859,6 +1000,10 @@ class ServingEngine:
         self._next_rid += 1
         req = Request(rid, prompt, int(max_new_tokens), eos_token_id)
         req.on_token = on_token
+        req.temperature = float(temperature or 0.0)
+        req.top_k = int(top_k)
+        req.top_p = float(top_p)
+        req.seed = int(seed) if seed is not None else rid
         req.t_submit = time.perf_counter()
         if deadline is not None:
             req.deadline = req.t_submit + float(deadline)
@@ -1004,21 +1149,26 @@ class ServingEngine:
             dtype=str(self.pool.k_pages[0].dtype),
             flags=self._flags.as_tuple(), extra=extra)
 
-    def _fused_spec(self):
+    def _fused_spec(self, draft: bool = False):
         """The model's fused-block layout when the fused path applies:
         FLAGS_fused_block_decode on, the model publishes
         ``block_decode_spec()``, and every named weight is live in the
         param/buffer dicts (a weight-quantized model restructures its
-        Linears into int8 buffers and falls back to the generic step)."""
+        Linears into int8 buffers and falls back to the generic step).
+        ``draft=True`` probes the speculative DRAFT model instead — the
+        draft-propose scan fuses per-layer exactly like the batched
+        decode step when its model qualifies."""
         if not self._flags.fused_block_decode:
             return None
-        get_spec = getattr(self.model, "block_decode_spec", None)
+        model = self.draft_model if draft else self.model
+        get_spec = getattr(model, "block_decode_spec", None)
         if get_spec is None:
             return None
         spec = get_spec()
         if spec is None:
             return None
-        allp = {**self._buffers, **self._params}
+        allp = ({**self._draft_buffers, **self._draft_params} if draft
+                else {**self._buffers, **self._params})
         names = [spec["embed"], spec["final_norm"]]
         if spec["lm_head"]:
             names.append(spec["lm_head"])
@@ -1218,6 +1368,21 @@ class ServingEngine:
             tok = int(tok)              # the span owns the token pull
         # once per admitted request  # tracecheck: disable=TRC007
         self._m.prefills.inc()
+        if req.temperature > 0.0:
+            # a sampled request never takes the prefill's greedy argmax:
+            # park the cursor ONE position short with the last fed token
+            # as the pending feed — exactly the spec-round entry
+            # invariant, so the verify program samples the position the
+            # prefill would have decided (and a replayed admission
+            # resumes at the SAME position key, redrawing identically)
+            self.pool.seq_lens[slot] = p - 1
+            self._last_tok[slot] = int(feed[-1])
+            req.slot = slot
+            self._slots[slot] = req
+            if self._prefix is not None and not replay:
+                self._prefix.register(req.prompt,
+                                      self.pool.block_tables[slot])
+            return
         self.pool.seq_lens[slot] = p
         self._last_tok[slot] = tok
         tnow = time.perf_counter()
@@ -1277,6 +1442,18 @@ class ServingEngine:
         tnow = time.perf_counter()
         self._observe_chunk(tnow - t0, final=True)
         replay = bool(req.tokens)
+        if req.temperature > 0.0:
+            # sampled request: discard the final chunk's greedy argmax
+            # and park the cursor one short (see _prefill) — the spec
+            # verify program is the only sampler
+            self.pool.seq_lens[slot] = len(feed) - 1
+            self._last_tok[slot] = int(feed[-1])
+            req.prefill_pos = None
+            req.feed = None
+            if self._prefix is not None and not replay:
+                self._prefix.register(req.prompt,
+                                      self.pool.block_tables[slot])
+            return
         if replay:
             # a replayed prefill's token continues the sequence: its
             # latency is inter-token, not a second TTFT
@@ -1324,6 +1501,16 @@ class ServingEngine:
         AND prefix cache; the fresh cache never saw those pages)."""
         if unpin and req.pinned and self._prefix is not None:
             self._prefix.unpin(req.pinned)
+        if req.spec_ready:
+            # release the draft pool's mirror allocation when that pool
+            # is still alive (recovery rebuilds it fresh, so a freshly
+            # rebuilt or detached pool has nothing of ours to free);
+            # gamma/spec_ema deliberately survive — the draft's observed
+            # agreement is the request's property, not the admission's
+            if (req.slot is not None and self._draft_pool is not None
+                    and self._draft_pool.k_pages[0] is not None):
+                self._draft_pool.free_sequence(req.slot)
+            req.spec_ready = False
         req.pinned = []
         req.pending = []
         req.prefill_pos = None
@@ -1445,7 +1632,9 @@ class ServingEngine:
                 # a real scheduler bookkeeping bug surfaces loudly
                 # after max_retries consecutive failures instead of
                 # spinning forever.
-                if self.pool.k_pages and self.pool.k_pages[0] is None:
+                if (self.pool.k_pages and self.pool.k_pages[0] is None) \
+                        or (self._draft_pool is not None
+                            and self._draft_pool.k_pages[0] is None):
                     self._rebuild_pool()    # a detached pool stays dead
                 self._consec_failures += 1
                 self._observe_recovery(0, 0, time.perf_counter() - t0)
@@ -1509,6 +1698,12 @@ class ServingEngine:
         the replays without a retrace. The prefix cache indexed pages of
         the dead pool and restarts empty."""
         self.pool = PagedKVCache(**self._pool_geom)
+        if self._draft_pool is not None:
+            # the draft pool dies with the target's (a spec fault leaves
+            # one detached, and a rebuilt target invalidates the draft's
+            # cursor lockstep either way); replay re-syncs from host
+            # state through the draft chunk program
+            self._draft_pool = PagedKVCache(**self._draft_geom)
         self._prefix = (PrefixCache(self.pool, replica=self.replica,
                                     host_tier_pages=self.host_tier_pages)
                         if self._prefix_enabled else None)
@@ -1687,6 +1882,9 @@ class ServingEngine:
                 while self._slots[dst] is not None:
                     dst += 1        # always < target: target covers active
                 self.pool.move_sequence(s, dst)
+                if req.spec_ready:
+                    # the draft pool mirrors the target's slot layout
+                    self._draft_pool.move_sequence(s, dst)
                 self._last_tok[dst] = self._last_tok[s]
                 self._slots[dst] = req
                 self._slots[s] = None
@@ -1780,6 +1978,330 @@ class ServingEngine:
         self._queue.append(req)
         self._observe_preemption(req)
 
+    # ------------------------------------------- speculative decoding
+    # One round = one draft-propose dispatch (γ+1 draft forwards inside
+    # a lax.scan) + one target-verify dispatch (a (1, γ+1) chunk of the
+    # r12 chunked-prefill machinery). Losslessness rests ONLY on the
+    # verify: draft writes past the accepted length — even past the
+    # allocated span, where unallocated block-table entries route to
+    # the reserved null scribble page — are garbage a later dispatch
+    # overwrites before any real row attends to it, so γ needs no
+    # tail-fitting constraint (new tokens just truncate to the budget).
+
+    def _store_draft(self, states) -> None:
+        self._draft_pool.install_pools(
+            [(_val(st.k_pages), _val(st.v_pages)) for st in states])
+
+    def _spec_occupancy_cap(self, n_rows: int) -> int:
+        """Largest γ rung the decode-slot budget affords with
+        ``n_rows`` speculating rows, each billed γ+1 slots (its verify
+        covers γ+1 positions — the bucket-ladder admission price of a
+        speculating request). 0 = priced out: at this occupancy the
+        plain batched decode step is the cheaper schedule."""
+        for g in reversed(self.spec_rungs):
+            if n_rows * (g + 1) <= self.spec_slots:
+                return g
+        return 0
+
+    def _spec_gamma(self, req: Request, cap: int) -> int:
+        """This round's γ for one request: its adaptive rung, capped by
+        occupancy and snapped DOWN to a compiled rung (never retrace),
+        then trimmed toward the tail of the token budget so the last
+        round doesn't draft far past ``max_new_tokens`` (truncation
+        keeps correctness either way; this keeps the draft cheap)."""
+        g = req.gamma or self.spec_gamma_default
+        if cap:
+            g = min(g, cap)
+        remaining = req.max_new_tokens - len(req.tokens)
+        fit = [r for r in self.spec_rungs
+               if r <= min(g, max(1, remaining - 1))]
+        return fit[-1] if fit else self.spec_rungs[0]
+
+    def _spec_step(self, rows: List[Request]) -> bool:
+        """Serve this step's decode-ready rows through speculation
+        rounds, or decline (return False) and let the plain batched
+        decode run. All-or-nothing per step: a row still teacher-
+        forcing a prompt suffix (``pending``) keeps the whole step on
+        the plain path (the suffix feed IS the plain step), and a step
+        whose occupancy prices speculation out declines too — UNLESS a
+        sampled request is present: sampling only exists through the
+        verify program's rejection sampler, so sampled rows force
+        speculation (at the smallest rung when over the budget)."""
+        if any(r.pending for r in rows):
+            return False
+        sampled = any(r.temperature > 0.0 for r in rows)
+        cap = self._spec_occupancy_cap(len(rows))
+        if cap == 0 and not sampled:
+            return False
+        for req in list(rows):
+            self._spec_round(req, self._spec_gamma(req, cap))
+        return True
+
+    def _spec_sync(self, req: Request) -> None:
+        """Bring the draft pool's KV for this slot up to the target's
+        accepted length L. First entry allocates the slot's full span
+        (the worst-case draft pool makes that infallible); any cursor
+        gap — admission prefilled the target only, or plain decode
+        advanced it while speculation was priced out — teacher-forces
+        through the draft's chunked-prefill program in fixed (1, C)
+        chunks whose argmax is never pulled, so sync never retraces
+        and never blocks on a device value."""
+        slot = req.slot
+        L = int(self.pool.seq_lens[slot])
+        if not req.spec_ready:
+            self._draft_pool.allocate(
+                slot, L + 1 + req.max_new_tokens - len(req.tokens))
+            req.spec_ready = True
+        cur = int(self._draft_pool.seq_lens[slot])
+        if cur >= L:
+            return
+        feed = np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)])
+        width = self.spec_sync_chunk
+        fn = self._spec_sync_program()
+        while cur < L:
+            end = min(cur + width, L)
+            ids = np.zeros((width,), np.int32)
+            ids[:end - cur] = feed[cur:end]
+            bt = jnp.asarray(
+                self._draft_pool.block_tables[slot:slot + 1])
+            sl = jnp.asarray(np.full((1,), cur, np.int32))
+            dpools = self._draft_pool.take_pools()
+            self._f_spec_draft.check(rid=req.rid, op="sync")
+            _tok, states = fn(self._draft_params, self._draft_buffers,
+                              jnp.asarray(ids[None]), dpools, bt, sl,
+                              jnp.int32(end - cur - 1))
+            self._store_draft(states)
+            cur = end
+        self._draft_pool.seq_lens[slot] = L
+
+    def _spec_round(self, req: Request, gamma: int) -> None:
+        """One propose/verify round for one decode-ready request.
+        Round invariant (both pools, entering and leaving): the KV
+        holds ids[:L] and ``_last_tok`` is ids[L], the newest not-yet-
+        written token. The draft scan runs γ+1 forwards — the extra
+        one writes the last proposal's KV — so a fully-accepted round
+        leaves the draft cache gap-free and the next round needs no
+        catch-up dispatch. Both fault sites fire BEFORE the accepted-
+        length cursor roll (FLT002): an injected fault replays the
+        round from host state bit-identically."""
+        slot = req.slot
+        sample = req.temperature > 0.0
+        self._spec_sync(req)
+        L = int(self.pool.seq_lens[slot])
+        t0 = time.perf_counter() if self._m.enabled else 0.0
+        # --- draft: γ proposals in ONE dispatch
+        dfn = self._spec_draft_program(gamma, sample, req.top_k)
+        dbt = jnp.asarray(self._draft_pool.block_tables[slot:slot + 1])
+        dsl = jnp.asarray(self._draft_pool.seq_lens[slot:slot + 1])
+        tok = jnp.asarray(self._last_tok[slot:slot + 1][:, None])
+        dpools = self._draft_pool.take_pools()
+        self._f_spec_draft.check(rid=req.rid, op="draft")
+        if sample:
+            key = jax.random.PRNGKey(
+                (req.seed * 1000003 + L) & 0x7FFFFFFF)
+            props, qrows, dstates = dfn(
+                self._draft_params, self._draft_buffers, tok, dpools,
+                dbt, dsl, key, jnp.float32(req.temperature),
+                jnp.float32(req.top_p))
+        else:
+            qrows = None
+            props, dstates = dfn(self._draft_params,
+                                 self._draft_buffers, tok, dpools,
+                                 dbt, dsl)
+        self._store_draft(dstates)
+        # the verify chunk's ids need the concrete proposals — the
+        # round's one designed draft->host sync point
+        props_np = np.asarray(props).astype(np.int32).reshape(-1)
+        # --- verify: ONE (1, γ+1) chunk through the TARGET
+        ids = np.empty((gamma + 1,), np.int32)
+        ids[0] = self._last_tok[slot]
+        ids[1:] = props_np[:gamma]
+        vfn = self._spec_verify_program(gamma, sample, req.top_k)
+        bt = jnp.asarray(self.pool.block_tables[slot:slot + 1])
+        sl = jnp.asarray(self.pool.seq_lens[slot:slot + 1])
+        pools = self.pool.take_pools()
+        self._f_spec_verify.check(rid=req.rid)
+        if sample:
+            greedy, prows, states = vfn(
+                self._params, self._buffers, jnp.asarray(ids[None]),
+                pools, bt, sl, jnp.float32(req.temperature),
+                jnp.float32(req.top_p))
+        else:
+            prows = None
+            greedy, states = vfn(self._params, self._buffers,
+                                 jnp.asarray(ids[None]), pools, bt, sl)
+        self._store(states)
+        # --- acceptance (host): longest agreeing prefix + correction
+        if sample:
+            new_toks, accepted = self._spec_accept_sample(
+                req, L, gamma, props_np, np.asarray(qrows),
+                np.asarray(prows))
+        else:
+            greedy_np = np.asarray(greedy).reshape(-1)
+            accepted = 0
+            while accepted < gamma and \
+                    int(props_np[accepted]) == int(greedy_np[accepted]):
+                accepted += 1
+            new_toks = [int(t) for t in props_np[:accepted]]
+            new_toks.append(int(greedy_np[accepted]))
+        # clip to the token budget, and to the first EOS — the plain
+        # engine would have stopped there, so later positions of this
+        # round must never exist
+        new_toks = new_toks[:req.max_new_tokens - len(req.tokens)]
+        if req.eos_token_id is not None and req.eos_token_id in new_toks:
+            new_toks = new_toks[:new_toks.index(req.eos_token_id) + 1]
+        # --- cursor roll (the rollback contract): both pools advance
+        # to EXACTLY the accepted length; the rejected tail's KV
+        # positions hold stale writes the next dispatch overwrites
+        # before anything attends to them
+        self.pool.seq_lens[slot] = L + len(new_toks)
+        self._draft_pool.seq_lens[slot] = L + len(new_toks)
+        now = time.perf_counter() if self._m.enabled else 0.0
+        first = not req.tokens
+        if self._prefix is not None and first:
+            # first generated token of a shared admission: the verify
+            # chunk just wrote the last prompt position — register the
+            # full pages so repeats of this prompt deepen the cache
+            self._prefix.register(req.prompt,
+                                  self.pool.block_tables[slot])
+        for t in new_toks:
+            req.tokens.append(int(t))
+            self._emit(req, int(t))
+        if self._m.enabled:
+            if first:
+                # TTFT closes on the round's first token
+                # tracecheck: disable=TRC007
+                self._m.ttft.observe(now - req.t_submit)
+            else:
+                # ONE inter-token sample per round: a round delivers
+                # its tokens as a burst, so the host-visible gap is the
+                # round gap  # tracecheck: disable=TRC007
+                self._m.itl.observe(now - req.t_last)
+        req.t_last = now
+        self._last_tok[slot] = int(new_toks[-1])
+        # --- adaptive γ: accept-rate EMA moves the rung
+        rate = accepted / gamma
+        req.spec_ema = 0.7 * req.spec_ema + 0.3 * rate
+        if self.spec_adaptive:
+            idx = max(i for i, r in enumerate(self.spec_rungs)
+                      if r <= max(gamma, self.spec_rungs[0]))
+            if accepted == gamma and req.spec_ema >= self._SPEC_GROW:
+                idx = min(idx + 1, len(self.spec_rungs) - 1)
+            elif req.spec_ema < self._SPEC_SHRINK:
+                idx = max(idx - 1, 0)
+            req.gamma = self.spec_rungs[idx]
+        else:
+            req.gamma = gamma
+        self.spec_rounds += 1
+        self.spec_tokens_accepted += accepted
+        self.spec_tokens_rejected += gamma - accepted
+        self.spec_last_gamma = gamma
+        self._observe_spec(gamma, accepted, rate, t0, now)
+        self._finish_if_done(req)
+
+    # accept-rate EMA thresholds of the adaptive-γ rung walk: grow only
+    # on a sustained-high EMA *and* a clean round, shrink on sustained
+    # low — the gap is the hysteresis band that stops rung flapping
+    _SPEC_GROW = 0.75
+    _SPEC_SHRINK = 0.35
+
+    def _spec_accept_sample(self, req: Request, L: int, gamma: int,
+                            props: np.ndarray, qrows: np.ndarray,
+                            prows: np.ndarray):
+        """Rejection sampling (the speculative-sampling identity):
+        accept draft token d_i with probability min(1, p_i(d_i) /
+        q_i(d_i)); on the first rejection draw the correction from the
+        residual normalize(max(p_i - q_i, 0)); after a full accept
+        draw the bonus token from the target's last row. p and q are
+        the FILTERED (temperature/top-k/top-p) distributions the
+        programs return, so the emitted law is exactly the target's
+        sampling law. Uniforms come from default_rng((seed, L)) —
+        position-keyed, so a replayed round at the same accepted
+        length redraws identically and sampled recovery/preemption
+        stays bit-identical. Returns (new_tokens, accepted_count)."""
+        rng = np.random.default_rng((req.seed, L))
+        out: List[int] = []
+        for i in range(gamma):
+            d = int(props[i])
+            q = float(qrows[i, d])
+            p = float(prows[i, d])
+            if q <= 0.0 or rng.random() * q <= p:
+                out.append(d)
+                continue
+            resid = np.maximum(
+                prows[i].astype(np.float64) - qrows[i], 0.0)
+            s = float(resid.sum())
+            if s <= 0.0:        # q >= p everywhere (numerically): the
+                resid = prows[i].astype(np.float64)     # target row
+                s = float(resid.sum())                  # itself
+            out.append(int(rng.choice(resid.shape[0], p=resid / s)))
+            return out, i
+        last = prows[gamma].astype(np.float64)
+        out.append(int(rng.choice(last.shape[0], p=last / last.sum())))
+        return out, gamma
+
+    # ---- speculative program getters: one compiled program per
+    # (kind, γ rung, sampling mode, top_k) via DecodeKey.extra — the
+    # rung set is small and each entry compiles once, so steady state
+    # swaps between compiled programs with ZERO retraces (the bench's
+    # retrace ledger asserts it)
+
+    def _spec_program(self, kind: str, extra: Tuple, builder,
+                      draft: bool):
+        from .program_cache import DecodeKey, decode_program_cache
+        memo = (kind,) + tuple(extra)
+        fn = self._spec_fns.get(memo)
+        if fn is None:
+            pool = self._draft_pool if draft else self.pool
+            key = DecodeKey(
+                kind=kind,
+                model_sig=self._draft_sig if draft else self._model_sig,
+                batch_bucket=1,
+                page_budget=(pool.num_pages, pool.page_size,
+                             pool.max_pages_per_seq),
+                dtype=str(pool.k_pages[0].dtype),
+                flags=self._flags.as_tuple(), extra=tuple(extra))
+            fn = decode_program_cache().get(key, builder)
+            self._spec_fns[memo] = fn
+            self._spec_keys[memo] = key
+        if kind == "spec_draft":
+            self.spec_draft_key = self._spec_keys[memo]
+        elif kind == "spec_verify":
+            self.spec_verify_key = self._spec_keys[memo]
+        return fn
+
+    def _spec_sync_program(self):
+        """The DRAFT model's chunked-prefill program — the same r12
+        builder the target's chunk path uses, keyed on the draft's
+        signature and the sync chunk width."""
+        return self._spec_program(
+            "prefill_chunk", (self.spec_sync_chunk,),
+            functools.partial(_build_chunk_prefill,
+                              model=self.draft_model), draft=True)
+
+    def _spec_draft_program(self, gamma: int, sample: bool,
+                            top_k: int):
+        fspec = self._fused_spec(draft=True)
+        mode = ("sample", int(top_k)) if sample else ("greedy",)
+        path = ("fused",) if fspec else ("generic",)
+        return self._spec_program(
+            "spec_draft", (gamma,) + path + mode,
+            functools.partial(_build_spec_draft, model=self.draft_model,
+                              gamma=gamma, sample=sample,
+                              top_k=int(top_k), fspec=fspec,
+                              snap=self._flags if fspec else None),
+            draft=True)
+
+    def _spec_verify_program(self, gamma: int, sample: bool,
+                             top_k: int):
+        mode = ("sample", int(top_k)) if sample else ("greedy",)
+        return self._spec_program(
+            "spec_verify", (gamma + 1,) + mode,
+            functools.partial(_build_spec_verify, model=self.model,
+                              sample=sample, top_k=int(top_k)),
+            draft=False)
+
     def _step_inner(self) -> None:  # tracecheck: hotpath
         self._sweep_deadlines()
         self._probe_memo.clear()    # prefix probes are per-step
@@ -1864,6 +2386,13 @@ class ServingEngine:
         if not decode_rows:
             return
 
+        if self._draft_pool is not None and self._spec_step(decode_rows):
+            # the rows were served by speculation rounds (draft scan +
+            # verify chunk per row); the batched decode must not run
+            # again this step
+            self._observe_step_end()
+            return
+
         b = self.bucket
         fn = self._decode_program(b)
         bt = jnp.asarray(self.pool.block_tables[:b])
@@ -1893,6 +2422,14 @@ class ServingEngine:
                 # mid-chunk-prefill slot: its decode row computed (and
                 # wrote) garbage at the cursor position — the next chunk
                 # overwrites that position and the cursor never advanced
+                continue
+            if req.temperature > 0.0 and not req.pending:
+                # a sampled request never takes a token from the greedy
+                # batch step — the spec verify program is its sampler.
+                # The row's KV write at the cursor was a correct (and
+                # repeatable) prefix write, but the cursor must NOT
+                # advance: the next speculation round re-feeds this
+                # position through its verify chunk
                 continue
             self.pool.seq_lens[slot] += 1
             if req.pending:
@@ -2040,6 +2577,24 @@ class ServingEngine:
         if req.tokens:
             m.preempted_tokens.inc(len(req.tokens))
 
+    def _observe_spec(self, gamma: int, accepted: int, rate: float,
+                      t0: float, t1: float) -> None:
+        """One speculation round retired: the accept-rate histogram
+        (the adaptive-γ signal), accepted/rejected token counters, the
+        γ gauge and a timeline event."""
+        m = self._m
+        if not m.enabled:
+            return
+        m.spec_rounds_c.inc()
+        m.spec_accept.observe(rate)
+        if accepted:
+            m.spec_accepted.inc(accepted)
+        if gamma - accepted:
+            m.spec_rejected.inc(gamma - accepted)
+        m.spec_gamma.set(gamma)
+        m.event("engine.spec_round", t0, t1, gamma=gamma,
+                accepted=accepted)
+
     def _observe_chunk(self, dt: float, final: bool = False) -> None:
         """One chunked-prefill dispatch retired: bank its wall clock —
         the unit a long-prompt arrival can stall decode by. The final
@@ -2132,6 +2687,136 @@ def _build_generic_decode(note_trace, model):
             model, params, toks, states, None,
             buffers=buffers, method="forward_with_cache")
         return (jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1),
+                states)
+
+    return jax.jit(run, donate_argnums=(3,))
+
+
+def _spec_filtered_probs(rows, temperature, top_k, top_p):
+    """The sampling law as a distribution: temperature scale, static
+    top-k, traced top-p nucleus, softmax — the same filter chain
+    generation's offline sampler applies, so the engine's rejection
+    sampler and ``model.generate(do_sample=True)`` share one law.
+    ``rows`` is (..., V) f32 logits; ``top_k`` is static (part of the
+    program key), temperature/top_p are traced scalars."""
+    from . import _top_k_filter, _top_p_filter
+    lg = rows / jnp.maximum(temperature, jnp.float32(1e-6))
+    if top_k and top_k > 0:
+        lg = _top_k_filter(lg, top_k)
+    lg = _top_p_filter(lg, top_p)
+    return jax.nn.softmax(lg, axis=-1)
+
+
+def _build_spec_draft(note_trace, model, gamma, sample, top_k,
+                      fspec=None, snap=None):
+    """The draft-propose program: γ draft forwards in ONE dispatch — a
+    ``lax.scan`` over the draft's paged decode step with the scanned
+    seq_lens advancing per iteration, so a speculation round costs two
+    dispatches total (this + the verify chunk) instead of γ+1. The
+    scan deliberately runs γ+1 iterations: the extra forward writes
+    the last proposal's KV, so a fully-accepted round leaves the draft
+    cache gap-free and the next round needs no catch-up sync (its
+    output is discarded — only the first γ proposals return). In
+    sample mode each iteration draws from the FILTERED draft
+    distribution and the program also returns the γ q-rows the
+    rejection test divides by. With ``fspec`` (the draft qualifies for
+    the fused path) each scanned forward runs the per-layer fused
+    block-decode kernel instead of the generic functional_call — the
+    same fusion the batched decode step uses."""
+    from ..jit import functional_call
+    if fspec is not None:
+        from ..kernels.fused_block_decode import (BlockDecodeWeights,
+                                                  _rms,
+                                                  fused_block_decode)
+        nh, nkv = fspec["num_heads"], fspec["num_kv_heads"]
+        theta, eps = fspec["rope_theta"], fspec["epsilon"]
+
+    def run(params, buffers, tok, pools, bt, sl, *rest):
+        note_trace()
+        if sample:
+            key, temperature, top_p = rest
+        else:
+            key = jnp.zeros((2,), jnp.uint32)
+
+        def one(carry, _x):
+            t, cpools, csl, k = carry
+            if fspec is not None:
+                allp = {**buffers, **params}
+                x = jnp.take(allp[fspec["embed"]], t[:, 0], axis=0)
+                nxt_pools = []
+                for i, lw in enumerate(fspec["layers"]):
+                    w = BlockDecodeWeights(
+                        **{f: allp[n] for f, n in lw.items()})
+                    kp, vp = cpools[i]
+                    x, kp, vp = fused_block_decode(
+                        x, w, kp, vp, bt, csl, num_heads=nh,
+                        num_kv_heads=nkv, rope_theta=theta,
+                        epsilon=eps, snap=snap)
+                    nxt_pools.append((kp, vp))
+                x = _rms(x, allp[fspec["final_norm"]], eps)
+                if fspec["lm_head"]:
+                    logits = x @ allp[fspec["lm_head"]]
+                else:                           # tied embeddings
+                    logits = x @ allp[fspec["embed"]].T
+                row = logits[0].astype(jnp.float32)
+            else:
+                states = [PagedDecodeState(kp, vp, bt, csl)
+                          for kp, vp in cpools]
+                logits, states = functional_call(
+                    model, params, t, states, None,
+                    buffers=buffers, method="forward_with_cache")
+                row = _val(logits)[0, -1].astype(jnp.float32)
+                nxt_pools = [(_val(st.k_pages), _val(st.v_pages))
+                             for st in states]
+            if sample:
+                k, sub = jax.random.split(k)
+                q = _spec_filtered_probs(row, temperature, top_k, top_p)
+                nxt = jax.random.categorical(
+                    sub, jnp.log(q + 1e-30)).astype(jnp.int32)
+                out = (nxt, q)
+            else:
+                nxt = jnp.argmax(row).astype(jnp.int32)
+                out = nxt
+            return (nxt[None, None], nxt_pools, csl + 1, k), out
+
+        init = (tok, [(k, v) for k, v in pools], sl, key)
+        (_, out_pools, _, _), outs = jax.lax.scan(
+            one, init, None, length=gamma + 1)
+        states = [PagedDecodeState(k, v, bt, sl)
+                  for k, v in out_pools]
+        if sample:
+            props, qrows = outs
+            return props[:gamma], qrows[:gamma], states
+        return outs[:gamma], states
+
+    return jax.jit(run, donate_argnums=(3,))
+
+
+def _build_spec_verify(note_trace, model, sample, top_k):
+    """The verify program IS a (1, γ+1) chunk of the r12 chunked-
+    prefill machinery: ``PagedChunkState`` statically routes the S>1
+    paged attention through the cache-reading path, the chunk writes
+    the proposal positions' KV at ``sl .. sl+γ`` (so accepted tokens
+    are already cached when the cursor rolls forward), and the
+    returned per-position argmax (greedy) or filtered distributions
+    (sample) drive host-side acceptance. No bespoke kernel — see
+    KERNEL_DECISIONS round 16."""
+    from ..jit import functional_call
+    from ..kernels.paged_attention import PagedChunkState
+
+    def run(params, buffers, ids, pools, bt, sl, *rest):
+        note_trace()
+        states = [PagedChunkState(k, v, bt, sl) for k, v in pools]
+        logits, states = functional_call(
+            model, params, ids, states, sl[0],
+            buffers=buffers, method="forward_with_cache")
+        rows = _val(logits)[0].astype(jnp.float32)      # (γ+1, V)
+        greedy = jnp.argmax(rows, axis=-1).astype(jnp.int32)
+        if not sample:
+            return greedy, states
+        temperature, top_p = rest
+        return (greedy,
+                _spec_filtered_probs(rows, temperature, top_k, top_p),
                 states)
 
     return jax.jit(run, donate_argnums=(3,))

@@ -459,7 +459,11 @@ def estimate_engine_memory(dims: ModelDims, *,
                            weight_dtype: str = "bfloat16",
                            kv_dtype: str = "bfloat16",
                            host_tier_pages: int = 0,
-                           param_count: Optional[int] = None
+                           param_count: Optional[int] = None,
+                           draft_dims: Optional[ModelDims] = None,
+                           spec_gamma: int = 0,
+                           draft_param_count: Optional[int] = None,
+                           draft_weight_dtype: Optional[str] = None
                            ) -> Dict[str, Any]:
     """The what-if planner: predicted steady-state serving HBM for a
     configuration that may be too big to compile locally. Returns the
@@ -469,7 +473,15 @@ def estimate_engine_memory(dims: ModelDims, *,
     top); None = the worst-case formula. ``host_tier_pages`` (r14)
     prices the host-RAM KV tier alongside: its bytes land under
     ``host_tier`` — host RAM, NOT HBM — so device and host are planned
-    jointly but never summed into one number."""
+    jointly but never summed into one number.
+
+    ``draft_dims`` (r16) prices speculative decoding alongside: the
+    draft model's weights, its ALWAYS-worst-case KV pool (the engine
+    sizes it ``1 + max_batch * pages_per_seq`` regardless of
+    ``page_budget`` — draft sync must never fail allocate), and the
+    (1, gamma+1) verify chunk's workspace through the TARGET (the
+    verify is a chunk program, so it prices exactly like a prefill of
+    ``spec_gamma + 1`` positions)."""
     n_params = param_count or dims.param_count
     if n_params is None:
         raise ValueError("need param_count (config.num_params() or "
@@ -496,11 +508,32 @@ def estimate_engine_memory(dims: ModelDims, *,
     decode_tmp = _decode_temp(dims, geom, max_batch)
     chunk_tmp = _prefill_temp(dims, geom, chunk) if chunk else 0
     tables = geom.tables_bytes(max_batch)
+    # ---- speculative decoding (r16): draft weights + worst-case draft
+    # pool are resident; the verify chunk and the draft's own programs
+    # only add workspace (dispatches never overlap, so max not sum)
+    draft_weights = draft_pool = verify_tmp = draft_tmp = 0
+    if draft_dims is not None:
+        gamma = max(1, int(spec_gamma))
+        dn = draft_param_count or draft_dims.param_count
+        if dn is None:
+            raise ValueError("need draft_param_count "
+                             "(config.num_params() or explicit)")
+        draft_weights = weight_bytes(
+            dn, draft_weight_dtype or weight_dtype)
+        dgeom = PoolGeometry(
+            draft_dims.layers, 1 + max_batch * pages_per_seq, page_size,
+            draft_dims.kv_heads, draft_dims.head_dim, pages_per_seq,
+            geom.dtype)
+        draft_pool = dgeom.pool_bytes()
+        verify_tmp = _prefill_temp(dims, geom, gamma + 1)
+        draft_tmp = max(_decode_temp(draft_dims, dgeom, 1),
+                        _prefill_temp(draft_dims, dgeom, gamma + 1))
     # XLA program text + runtime allocations scale with model size; a
     # visible margin line, not silent slack
-    margin = max(64 << 20, int(0.05 * weights))
-    workspace = max(decode_tmp, chunk_tmp)
-    total = weights + pool + workspace + tables + margin
+    margin = max(64 << 20, int(0.05 * (weights + draft_weights)))
+    workspace = max(decode_tmp, chunk_tmp, verify_tmp, draft_tmp)
+    total = (weights + draft_weights + pool + draft_pool + workspace
+             + tables + margin)
     # host-RAM tier: same per-page geometry as the device pool (spill
     # copies pages verbatim, scales included), priced against HOST
     # memory — derived from the pool term so the two can never drift
@@ -515,9 +548,16 @@ def estimate_engine_memory(dims: ModelDims, *,
                    "max_batch": max_batch, "max_seq_len": max_seq_len,
                    "chunk": chunk, "weight_dtype": str(weight_dtype),
                    "kv_dtype": str(kv_dtype),
-                   "host_tier_pages": int(host_tier_pages)},
+                   "host_tier_pages": int(host_tier_pages),
+                   "spec_gamma": (max(1, int(spec_gamma))
+                                  if draft_dims is not None else 0)},
         "breakdown": {
             "weights": weights, "kv_pool": pool,
+            **({"draft_weights": draft_weights,
+                "draft_kv_pool": draft_pool,
+                "spec_verify_workspace": verify_tmp,
+                "draft_workspace": draft_tmp}
+               if draft_dims is not None else {}),
             "decode_workspace": decode_tmp,
             "chunk_prefill_workspace": chunk_tmp,
             "block_tables": tables,

@@ -45,6 +45,14 @@ Sites (KNOWN_SITES; an unknown site in the spec is a construction-time
                         whole-replica loss: the router harvests the
                         replica's host-side request state and re-routes
                         it across the surviving fleet
+    spec_draft          ServingEngine speculative draft dispatch
+                        (post-detach of the DRAFT pool; ctx carries
+                        op="sync" for draft-KV catch-up chunks and
+                        op="draft" for the γ-proposal scan)
+    spec_verify         ServingEngine speculative verify dispatch
+                        (post-detach of the target pool, BEFORE the
+                        accepted-length cursor roll — a fire replays
+                        the round from host state bit-identically)
     program_build       decode program cache build (compile path)
     train_dispatch      TrainStep.__call__ before the jitted dispatch
     train_sync          TrainStep.pull_metrics / sync host pulls
@@ -86,7 +94,7 @@ __all__ = [
 
 KNOWN_SITES = frozenset({
     "prefill", "chunk_prefill", "decode_dispatch", "bucket_migrate",
-    "preempt", "kv_spill", "router_dispatch",
+    "preempt", "kv_spill", "router_dispatch", "spec_draft", "spec_verify",
     "program_build", "train_dispatch", "train_sync", "dataloader_worker",
     "checkpoint_save",
 })

@@ -21,6 +21,16 @@ Arms (each runs a fault-free baseline first, then the chaos pass):
               unbounded ``every=N`` below the chunks-per-prompt count
               is a genuinely wedged backend, which the no-progress
               budget rightly terminates FAILED.
+  serving_spec
+              The r16 speculative decode mode under fire: a GPT target
+              with a divergent draft model (real rejections), with
+              ``spec_draft`` dying at the draft dispatch and
+              ``spec_verify`` dying BEFORE the accepted-length cursor
+              roll. Both sites fire post-detach, so recovery rebuilds
+              BOTH pools and replays from host state. The bar is
+              double: chaos output bit-identical to the fault-free
+              speculative run AND to a plain non-speculative engine
+              (the losslessness contract survives injected faults).
   fleet       The r14 multi-replica router under fire: a 2-replica
               ``FleetRouter`` (prefix cache + host-RAM KV tier armed)
               with ``router_dispatch`` killing whole replicas
@@ -66,6 +76,8 @@ LOADER_SPEC = "dataloader_worker:every=3:times=1"
 FLEET_SPEC = ("router_dispatch:every=6:times=2;"
               "kv_spill:every=3:times=2;"
               "preempt:every=1:times=1")
+SPEC_DECODE_SPEC = ("spec_verify:every=3:times=2;"
+                    "spec_draft:every=5:times=2")
 
 
 def emit(d):
@@ -195,6 +207,72 @@ def drill_serving_chunked(n_requests, max_new):
            "bit_identical": chaos == baseline,
            "statuses": status, "chunk_dispatches": eng.chunk_dispatches,
            "bucket_migrations": eng.bucket_migrations,
+           "counters": ctr}
+    emit(row)
+    return row
+
+
+def drill_serving_spec(n_requests, max_new):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.generation.serving import ServingEngine
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.testing import faults
+
+    paddle.seed(61)
+    model = GPTForCausalLM(GPTConfig.tiny())
+    # a draft with DIFFERENT weights: rounds see real rejections, so
+    # the drilled rollback exercises partial-accept cursor rolls
+    paddle.seed(62)
+    draft = GPTForCausalLM(GPTConfig.tiny())
+    rng = np.random.default_rng(29)
+    prompts = [rng.integers(0, model.config.vocab_size,
+                            (int(rng.integers(4, 13)),)).astype(np.int32)
+               for _ in range(n_requests)]
+
+    def run_engine(with_draft=True):
+        eng = ServingEngine(model, max_batch=2, page_size=8,
+                            max_seq_len=64,
+                            draft_model=draft if with_draft else None)
+        rids = [eng.submit(p, max_new) for p in prompts]
+        out = eng.run(max_wall=300.0)
+        return eng, [out[r] for r in rids], [eng.status(r) for r in rids]
+
+    from paddle_tpu import flags as _flags
+    prev = {"serving_spec_max_slots": _flags.get_flag(
+        "serving_spec_max_slots")}
+    # wide slot budget: both decode rows speculate every step, so the
+    # every=N fault schedules reach real fires within the drill length
+    _flags.set_flags({"serving_spec_max_slots": 6})
+    try:
+        _, plain, _ = run_engine(with_draft=False)
+        beng, baseline, base_status = run_engine()
+        before = counters(*SERVING_COUNTERS)
+        with faults.armed(SPEC_DECODE_SPEC, serving_retry_backoff=0.001,
+                          serving_max_retries=8):
+            eng, chaos, status = run_engine()
+        ctr = delta(counters(*SERVING_COUNTERS), before)
+    finally:
+        _flags.set_flags(prev)
+    draft_fires = ctr.get("faults_injected{site=spec_draft}", 0)
+    verify_fires = ctr.get("faults_injected{site=spec_verify}", 0)
+    ok = (chaos == baseline
+          and chaos == plain       # losslessness survives the chaos
+          and all(s == "OK" for s in status)
+          and all(s == "OK" for s in base_status)
+          and not eng.has_work()
+          and all(k is not None for k in eng.pool.k_pages)
+          and all(k is not None for k in eng._draft_pool.k_pages)
+          and verify_fires >= 1 and draft_fires >= 1
+          and eng.spec_rounds >= 1
+          and beng.spec_tokens_rejected >= 1)
+    row = {"arm": "serving_spec", "ok": ok, "spec": SPEC_DECODE_SPEC,
+           "requests": n_requests, "max_new_tokens": max_new,
+           "bit_identical": chaos == baseline,
+           "lossless_vs_plain": chaos == plain,
+           "statuses": status, "spec_rounds": eng.spec_rounds,
+           "tokens_accepted": eng.spec_tokens_accepted,
+           "tokens_rejected": eng.spec_tokens_rejected,
            "counters": ctr}
     emit(row)
     return row
@@ -381,8 +459,8 @@ def main():
     ap.add_argument("--max-new", type=int, default=6)
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--arms",
-                    default="serving,serving_chunked,fleet,training,"
-                            "dataloader")
+                    default="serving,serving_chunked,serving_spec,"
+                            "fleet,training,dataloader")
     args = ap.parse_args()
 
     import jax
@@ -393,6 +471,9 @@ def main():
         arms["serving"] = drill_serving(args.requests, args.max_new)
     if "serving_chunked" in want:
         arms["serving_chunked"] = drill_serving_chunked(
+            args.requests, args.max_new)
+    if "serving_spec" in want:
+        arms["serving_spec"] = drill_serving_spec(
             args.requests, args.max_new)
     if "fleet" in want:
         arms["fleet"] = drill_fleet(args.max_new)

@@ -74,6 +74,13 @@ def cmd_plan(args) -> int:
               max_seq_len=args.max_seq, chunk=args.chunk,
               weight_dtype=args.weight_dtype, kv_dtype=args.kv_dtype,
               host_tier_pages=args.host_tier_pages)
+    if args.draft:
+        # r16 speculative serving: the draft's weights + worst-case KV
+        # pool are resident, the (1, gamma+1) verify chunk is workspace
+        kw.update(draft_dims=_dims(args.draft),
+                  spec_gamma=args.spec_gamma,
+                  draft_weight_dtype=args.draft_weight_dtype
+                  or args.weight_dtype)
     plan = memwatch.estimate_engine_memory(
         dims, page_budget=args.page_budget, **kw)
     hbm = int(args.hbm_gb * GB)
@@ -82,10 +89,13 @@ def cmd_plan(args) -> int:
     def fmt(b):
         return f"{b / GB:8.3f} GB" if b >= 1 << 20 else f"{b:8d} B "
 
+    spec_note = (f" draft={args.draft} gamma={args.spec_gamma}"
+                 if args.draft else "")
     print(f"# memwatch plan: {args.model} weights={args.weight_dtype} "
           f"kv={args.kv_dtype} rung={args.rung} chunk={args.chunk} "
           f"pages={plan['config']['usable_pages']}x{args.page_size} "
-          f"max_seq={args.max_seq} host_tier={args.host_tier_pages}")
+          f"max_seq={args.max_seq} host_tier={args.host_tier_pages}"
+          f"{spec_note}")
     for k, v in plan["breakdown"].items():
         print(f"  {k:32s} {fmt(v)}")
     print(f"  {'TOTAL (device HBM)':32s} {fmt(plan['total'])}")
@@ -332,6 +342,17 @@ def main() -> int:
     p.add_argument("--host-ram-gb", type=float, default=0.0,
                    help="report host-tier headroom against this much "
                         "host RAM (0 = just report tier bytes)")
+    p.add_argument("--draft", default=None, choices=_MODELS,
+                   help="price speculative serving: this draft model's "
+                        "weights + worst-case KV pool ride along, and "
+                        "the (1, gamma+1) verify chunk joins the "
+                        "workspace max")
+    p.add_argument("--spec-gamma", type=int, default=4,
+                   help="largest speculation rung to price the verify "
+                        "chunk at (FLAGS_serving_spec_gamma)")
+    p.add_argument("--draft-weight-dtype", default=None,
+                   choices=("float32", "bfloat16", "int8", "int4"),
+                   help="draft storage dtype (default: --weight-dtype)")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_plan)
 

@@ -51,6 +51,29 @@ sections over ``paddle_tpu/generation/fleet.py``:
 
 ``--out FLEET_LOAD_r14.json`` banks that ledger; the quick slice is
 driven by tests/test_fleet.py (marker ``fleet``).
+
+``--spec`` (r16) runs the SPECULATIVE-DECODING acceptance bench — two
+sections over the ServingEngine's draft/verify mode:
+
+  throughput  the batch-1 A/B the feature exists for: one request,
+              plain decode vs speculative rounds, REPEATS measured
+              passes per arm after a warmup pass that compiles every
+              γ-rung program. Bars: ≥1.8x tokens/s (min over passes,
+              both arms), greedy outputs bit-identical, ZERO
+              steady-state retraces across all measured passes.
+  occupancy   the γ+1 slot bill made visible: 1/2/4/8 concurrent
+              requests against the same engine geometry, recording the
+              largest γ any round ran at while ALL rows were live —
+              the ladder must fall monotonically (8, 4, 2, then 0 =
+              speculation priced out entirely at a full batch), with
+              every row's outputs bit-identical to the plain engine.
+
+The draft-agreement rig mirrors the production shape (a truncated /
+distilled draft of the serving target): the 4-layer target's upper
+layers are damped to near-identity residuals and the 1-layer draft
+SHARES the target's embedding, layer-0, final-norm and head weights —
+high agreement with real rejections, at a quarter of the layer cost.
+``--out SPEC_DECODE_r16.json`` banks the ledger.
 """
 
 import argparse
@@ -711,6 +734,215 @@ def bench_fleet(seed, quick=False):
     }
 
 
+# ====================================================== spec bench (r16)
+SPEC_SCHEMA = 1
+
+
+def _spec_pair(seed, max_pos):
+    """The draft-agreement rig: a 4-layer tiny Llama target whose
+    layers >= 1 have o_proj/down_proj scaled by 3e-2 — near-identity
+    residual contributions, so the residual stream leaving layer 3 is
+    close to the stream leaving layer 0 — plus a 1-layer draft SHARING
+    the target's embedding, layer-0, final-norm and head weights. The
+    draft is a structural truncation of its target (the production
+    speculative-serving shape), so rounds mostly accept but real
+    rejections still occur, at a quarter of the target's layer cost."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    dims = dict(vocab_size=256, hidden_size=64, num_attention_heads=4,
+                num_key_value_heads=2, intermediate_size=128,
+                max_position_embeddings=max_pos)
+    paddle.seed(seed)
+    target = LlamaForCausalLM(LlamaConfig(num_hidden_layers=4, **dims))
+    sd = dict(target.state_dict())
+    for li in range(1, 4):
+        for nm in (f"llama.layers.{li}.self_attn.o_proj.weight",
+                   f"llama.layers.{li}.mlp.down_proj.weight"):
+            sd[nm] = paddle.to_tensor(sd[nm].numpy() * 3e-2)
+    target.set_state_dict(sd)
+    paddle.seed(seed + 1)
+    draft = LlamaForCausalLM(LlamaConfig(num_hidden_layers=1, **dims))
+    dsd = dict(draft.state_dict())
+    tsd = target.state_dict()
+    for k in dsd:
+        if k in tsd:                # embed, layer 0, final norm, head
+            dsd[k] = tsd[k]
+    draft.set_state_dict(dsd)
+    return target, draft
+
+
+def _spec_engine(target, draft, cfg):
+    from paddle_tpu.generation.serving import ServingEngine
+
+    return ServingEngine(target, max_batch=cfg["max_batch"],
+                         page_size=cfg["page_size"],
+                         max_seq_len=cfg["max_seq_len"],
+                         draft_model=draft)
+
+
+def spec_throughput_section(target, draft, cfg, seed):
+    """Batch-1 plain vs speculative A/B: REPEATS measured passes per
+    arm after a warmup pass (the warmup's γ ladder climbs through
+    every rung, so every draft/verify/sync program the measured passes
+    touch is already compiled). Tokens/s is min over passes for BOTH
+    arms — the structural rate recurs every pass while a one-off OS
+    spike only slows one — and the retrace ledger spans all measured
+    passes of both arms."""
+    import numpy as np
+
+    import paddle_tpu.observability as obs
+
+    rng = np.random.default_rng((seed, 0))
+    prompt = rng.integers(0, cfg["vocab"],
+                          (cfg["prompt_len"],)).astype(np.int32)
+
+    def one_pass(use_draft):
+        eng = _spec_engine(target, draft if use_draft else None, cfg)
+        rid = eng.submit(prompt, cfg["max_new"])
+        t0 = time.perf_counter()
+        out = eng.run(max_wall=300.0)
+        return eng, out[rid], time.perf_counter() - t0, eng.status(rid)
+
+    def run_arm(use_draft):
+        one_pass(use_draft)                             # warmup
+        before = obs.snapshot()
+        walls, statuses = [], []
+        for _ in range(REPEATS):
+            eng, out, wall, status = one_pass(use_draft)
+            walls.append(wall)
+            statuses.append(status)
+        after = obs.snapshot()
+        tps = [round(len(out) / w, 2) for w in walls]
+        metrics = {
+            "tokens": len(out),
+            "passes": REPEATS,
+            "wall_s_per_pass": [round(w, 4) for w in walls],
+            "tokens_per_s_per_pass": tps,
+            "tokens_per_s": min(tps),
+            "steady_retraces": trace_total(after) - trace_total(before),
+            "all_ok": all(s == "OK" for s in statuses),
+        }
+        if use_draft:
+            acc, rej = eng.spec_tokens_accepted, eng.spec_tokens_rejected
+            metrics.update(
+                spec_rounds=eng.spec_rounds,
+                spec_tokens_accepted=acc, spec_tokens_rejected=rej,
+                spec_accept_rate=round(acc / max(1, acc + rej), 4))
+        return metrics, out
+
+    plain, plain_out = run_arm(False)
+    spec, spec_out = run_arm(True)
+    parity = spec_out == plain_out
+    speedup = (round(spec["tokens_per_s"] / plain["tokens_per_s"], 4)
+               if plain["tokens_per_s"] else None)
+    ok = (parity and speedup is not None
+          and speedup >= cfg["speedup_bar"]
+          and plain["steady_retraces"] == 0
+          and spec["steady_retraces"] == 0
+          and plain["all_ok"] and spec["all_ok"])
+    return {"arms": {"plain": plain, "spec": spec},
+            "parity_bit_identical": parity,
+            "tokens_per_s_speedup": speedup,
+            "speedup_bar": cfg["speedup_bar"],
+            "ok": bool(ok)}
+
+
+def spec_occupancy_section(target, draft, cfg, seed):
+    """The γ+1 slot bill: n concurrent rows each cost γ+1 decode slots
+    per round, so the largest affordable rung falls as occupancy
+    rises. For each row count the sweep records the largest γ any
+    round ran at while ALL submitted rows were still live (tail rounds
+    after early finishes run at lower occupancy and would pollute the
+    reading), and checks the speculative outputs against a plain
+    engine on the same prompts — pricing changes the SCHEDULE, never
+    the tokens."""
+    import numpy as np
+
+    rng = np.random.default_rng((seed, 1))
+    prompts = [rng.integers(0, cfg["vocab"],
+                            (cfg["prompt_len"],)).astype(np.int32)
+               for _ in range(max(cfg["occ_rows"]))]
+
+    rows = []
+    for n in cfg["occ_rows"]:
+        plain_eng = _spec_engine(target, None, cfg)
+        prids = [plain_eng.submit(p, cfg["occ_max_new"])
+                 for p in prompts[:n]]
+        pout = plain_eng.run(max_wall=300.0)
+
+        eng = _spec_engine(target, draft, cfg)
+        rids = [eng.submit(p, cfg["occ_max_new"]) for p in prompts[:n]]
+        gamma_full, rounds_full = 0, 0
+        while eng.has_work():
+            occ = sum(1 for s in eng._slots if s is not None)
+            before = eng.spec_rounds
+            eng.step()
+            if occ == n and eng.spec_rounds > before:
+                gamma_full = max(gamma_full, eng.spec_last_gamma)
+                rounds_full += 1
+        out = eng.results()
+        rows.append({
+            "rows": n,
+            "gamma_at_full_occupancy": gamma_full,
+            "rounds_at_full_occupancy": rounds_full,
+            "rounds_total": eng.spec_rounds,
+            "tokens_accepted": eng.spec_tokens_accepted,
+            "tokens_rejected": eng.spec_tokens_rejected,
+            "parity_bit_identical":
+                [out.get(r, []) for r in rids] ==
+                [pout.get(r, []) for r in prids],
+        })
+    gammas = [r["gamma_at_full_occupancy"] for r in rows]
+    top_rung = cfg["rungs"][-1]
+    ok = (all(r["parity_bit_identical"] for r in rows)
+          and all(a >= b for a, b in zip(gammas, gammas[1:]))
+          and gammas[0] == top_rung      # a lone row affords the top
+          and gammas[-1] == 0)           # a full batch prices it out
+    return {"rows": rows, "gamma_ladder": gammas,
+            "top_rung": top_rung, "ok": bool(ok)}
+
+
+def bench_spec(seed, quick=False):
+    import jax
+
+    import paddle_tpu.observability as obs
+    from paddle_tpu import flags
+
+    cfg = dict(vocab=256, max_batch=8, page_size=8, max_seq_len=192,
+               prompt_len=16, max_new=(48 if quick else 96),
+               occ_rows=(1, 2, 4, 8), occ_max_new=(48 if quick else 64),
+               spec_slots=16, speedup_bar=1.8)
+    target, draft = _spec_pair(31, max_pos=256)
+    prev = flags.get_flags(("serving_spec_max_slots",))
+    # 16 decode slots make the whole rung ladder reachable: one row
+    # affords γ=8 (9 slots), a full batch of 8 affords none
+    flags.set_flags({"serving_spec_max_slots": cfg["spec_slots"]})
+    try:
+        cfg["rungs"] = sorted(
+            int(x) for x in
+            str(flags.get_flag("serving_spec_rungs")).split(","))
+        sections = {
+            "throughput": spec_throughput_section(target, draft, cfg,
+                                                  seed),
+            "occupancy": spec_occupancy_section(target, draft, cfg,
+                                                seed),
+        }
+    finally:
+        flags.set_flags(prev)
+    ok = all(s["ok"] for s in sections.values())
+    return {
+        "schema": SPEC_SCHEMA, "bench": "spec_decode",
+        "backend": jax.default_backend(), "seed": seed,
+        "config": {k: (list(v) if isinstance(v, tuple) else v)
+                   for k, v in cfg.items()},
+        "sections": sections,
+        "ok": bool(ok),
+        "telemetry": obs.snapshot(),
+        "memory": obs.memory.section() if obs.enabled() else None,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=None,
@@ -725,9 +957,15 @@ def main():
                     help="run the r14 fleet acceptance bench (routing "
                          "A/B + preemption + tiering) instead of the "
                          "single-engine chunked/monolithic A/B")
+    ap.add_argument("--spec", action="store_true",
+                    help="run the r16 speculative-decoding acceptance "
+                         "bench (batch-1 plain-vs-spec throughput A/B "
+                         "+ the γ-vs-occupancy ladder) instead of the "
+                         "single-engine chunked/monolithic A/B")
     args = ap.parse_args()
 
     doc = (bench_fleet(args.seed, quick=args.quick) if args.fleet
+           else bench_spec(args.seed, quick=args.quick) if args.spec
            else bench(args.per_tenant, args.seed, quick=args.quick))
     brief = {k: v for k, v in doc.items() if k != "telemetry"}
     print(json.dumps(brief, indent=2, sort_keys=True))
