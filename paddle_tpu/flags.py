@@ -231,6 +231,19 @@ define_flag("fused_block_decode", True,
             "block_decode_spec() (the Llama family); others keep the "
             "generic compiled step. Env-overridable "
             "(FLAGS_fused_block_decode=0) like the flash block flags.")
+define_flag("fused_block_layers", 1,
+            "How many transformer blocks one fused decode kernel runs "
+            "(kernels/fused_block_decode.py multi-layer mode): N > 1 "
+            "groups the model's layers into ceil(L/N) stacked-weight "
+            "groups, each dispatched as ONE pallas_call whose activations "
+            "stay VMEM-resident across the group's layers and whose "
+            "q/k/v and gate/up projections run as merged wider matmuls. "
+            "1 (default) keeps the r06 one-kernel-per-layer step. Price "
+            "an N before flipping it: "
+            "`python tools/memwatch.py plan --fused-layers N` refuses an "
+            "N whose VMEM working set cannot fit. Requires the model's "
+            "block_decode_spec() to publish layer_groups; models that "
+            "fall back to the generic step ignore this flag.")
 define_flag("flash_dispatch_table", "0:flash;2048:dense;4096:512x512",
             "Per-shape flash-attention dispatch table: ';'-separated "
             "'<min_seqlen>:<entry>' buckets, entry one of 'flash' (kernel "
@@ -443,7 +456,8 @@ define_flag("dataloader_max_worker_restarts", 2,
 # changing an eager-only flag (log_level, benchmark, allocator parity
 # shims) never invalidates a compiled serving program.
 PROGRAM_FLAGS = (
-    "fused_block_decode", "use_pallas", "flash_attn_min_seqlen",
+    "fused_block_decode", "fused_block_layers", "use_pallas",
+    "flash_attn_min_seqlen",
     "flash_block_q", "flash_block_k", "flash_compact_stats",
     "flash_dispatch_table",
     "tpu_matmul_precision", "embedding_matmul_grad", "deterministic",

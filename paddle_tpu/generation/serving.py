@@ -894,6 +894,14 @@ class ServingEngine:
         self._decode_fns: Dict[int, object] = {}    # bucket rung -> fn
         self._decode_keys: Dict[int, object] = {}
         self.decode_key = None      # key of the current rung (test probe)
+        # FLAGS_fused_block_layers > 1: per-group MultiBlockDecodeWeights
+        # (q|k|v and gate|up merged into stacked wider matmuls), built
+        # ONCE on first N-layer program build and passed to every decode
+        # step as traced args. One extra HBM copy of the layer weights —
+        # the originals still serve prefill/chunk/spec programs. None
+        # whenever the N-layer path doesn't apply (N=1, generic model,
+        # int8 fallback), which is also the dispatch-site discriminant.
+        self._stacked: Optional[tuple] = None
         # streaming: (callback, rid, token|None, done) events buffered
         # during a step and drained AFTER dispatch/recovery, so a user
         # callback that raises never masquerades as a dispatch failure
@@ -1157,14 +1165,25 @@ class ServingEngine:
         Linears into int8 buffers and falls back to the generic step).
         ``draft=True`` probes the speculative DRAFT model instead — the
         draft-propose scan fuses per-layer exactly like the batched
-        decode step when its model qualifies."""
+        decode step when its model qualifies (the draft always stays
+        per-layer: its scan carries one layer's pools at a time, and
+        γ-token proposal latency is not where N-layer fusion pays)."""
         if not self._flags.fused_block_decode:
             return None
         model = self.draft_model if draft else self.model
         get_spec = getattr(model, "block_decode_spec", None)
         if get_spec is None:
             return None
-        spec = get_spec()
+        n = int(self._flags.fused_block_layers)
+        if n > 1 and not draft:
+            try:
+                spec = get_spec(fused_layers=n)
+            except TypeError:
+                # model predates the stacked layout (no fused_layers
+                # kwarg): serve it per-layer rather than refuse
+                spec = get_spec()
+        else:
+            spec = get_spec()
         if spec is None:
             return None
         allp = ({**self._draft_buffers, **self._draft_params} if draft
@@ -1199,20 +1218,50 @@ class ServingEngine:
                 functools.partial(_build_chunk_prefill, model=self.model))
         return self._chunk_fn
 
+    def _stacked_weights(self, spec) -> tuple:
+        """Build (once) the per-group MultiBlockDecodeWeights the N-layer
+        decode programs take as traced args: each group's
+        BlockDecodeWeights stacked along a leading layer axis, q|k|v and
+        gate|up concatenated into single wider matmul operands."""
+        if self._stacked is None:
+            from ..kernels.fused_block_decode import (BlockDecodeWeights,
+                                                      stack_block_weights)
+            allp = {**self._buffers, **self._params}
+            self._stacked = tuple(
+                stack_block_weights([
+                    BlockDecodeWeights(
+                        **{f: allp[n]
+                           for f, n in spec["layers"][i].items()})
+                    for i in group])
+                for group in spec["layer_groups"])
+        return self._stacked
+
     def _decode_program(self, bucket: int):
         """The decode step for one bucket rung, compiled once per rung
         and cached — bucket migration swaps between already-compiled
-        programs instead of retracing."""
+        programs instead of retracing. With FLAGS_fused_block_layers=N
+        and a model that publishes ``layer_groups``, the rung's program
+        is the N-layer kernel step (DecodeKey.extra carries the
+        layer-group shape so same-model engines under a different N
+        never share a program)."""
         fn = self._decode_fns.get(bucket)
         if fn is None:
             from .program_cache import decode_program_cache
             spec = self._fused_spec()
-            key = self._key("decode_fused" if spec else "decode_generic",
-                            bucket=bucket)
-            if spec:
+            groups = spec.get("layer_groups") if spec else None
+            if groups:
+                self._stacked_weights(spec)
+                key = self._key(
+                    "decode_fused_nlayer", bucket=bucket,
+                    extra=("nlayer", tuple(len(g) for g in groups)))
+                builder = functools.partial(_build_fused_nlayer_decode,
+                                            spec=spec, snap=self._flags)
+            elif spec:
+                key = self._key("decode_fused", bucket=bucket)
                 builder = functools.partial(_build_fused_decode, spec=spec,
                                             snap=self._flags)
             else:
+                key = self._key("decode_generic", bucket=bucket)
                 builder = functools.partial(_build_generic_decode,
                                             model=self.model)
             fn = decode_program_cache().get(key, builder)
@@ -2400,10 +2449,18 @@ class ServingEngine:
         t0 = time.perf_counter() if self._m.enabled else 0.0
         pools = self.pool.take_pools()
         self._f_decode.check()
-        toks, states = fn(
-            self._params, self._buffers,
-            jnp.asarray(self._last_tok[:b, None]),
-            pools, bt, sl)
+        if self._stacked is not None:
+            # N-layer program signature: the stacked per-group weight
+            # structs ride as traced args (never baked constants)
+            toks, states = fn(
+                self._params, self._buffers,
+                jnp.asarray(self._last_tok[:b, None]),
+                pools, bt, sl, self._stacked)
+        else:
+            toks, states = fn(
+                self._params, self._buffers,
+                jnp.asarray(self._last_tok[:b, None]),
+                pools, bt, sl)
         self._store(states)
         # the scheduler's designed sync point: admission/eviction need
         # the concrete token ids  # tracecheck: disable=TRC002
@@ -2846,6 +2903,47 @@ def _build_fused_decode(note_trace, spec, snap):
                 x, w, kp, vp, bt, sl, num_heads=nh, num_kv_heads=nkv,
                 rope_theta=theta, epsilon=eps, snap=snap)
             states.append(PagedDecodeState(kp, vp, bt, sl))
+        x = _rms(x, allp[spec["final_norm"]], eps)
+        if spec["lm_head"]:
+            logits = x @ allp[spec["lm_head"]]
+        else:                                   # tied embeddings
+            logits = x @ allp[spec["embed"]].T
+        return jnp.argmax(logits.astype(jnp.float32), axis=-1), states
+
+    return jax.jit(run, donate_argnums=(3,))
+
+
+def _build_fused_nlayer_decode(note_trace, spec, snap):
+    """The N-layer fused decode step (FLAGS_fused_block_layers > 1):
+    embedding lookup, then ONE multi-layer fused kernel per LAYER GROUP
+    — activations stay VMEM-resident across all N blocks of a group and
+    the per-layer weights stream through VMEM double-buffers inside a
+    single pallas_call. ``stacked`` is the engine-built tuple of
+    per-group MultiBlockDecodeWeights (one per spec["layer_groups"]
+    entry, traced args so any same-config model shares the program —
+    riding LAST so ``pools`` keeps the decode-step convention of
+    position 3, the one donated slot every builder shares)."""
+    from ..kernels.fused_block_decode import (_rms,
+                                              fused_multi_block_decode)
+
+    nh, nkv = spec["num_heads"], spec["num_kv_heads"]
+    theta, eps = spec["rope_theta"], spec["epsilon"]
+    groups = spec["layer_groups"]
+
+    def run(params, buffers, toks, pools, bt, sl, stacked):
+        note_trace()
+        allp = {**buffers, **params}
+        x = jnp.take(allp[spec["embed"]], toks[:, 0], axis=0)   # (B, H)
+        states = []
+        for gi, group in enumerate(groups):
+            kps = [pools[i][0] for i in group]
+            vps = [pools[i][1] for i in group]
+            x, kps, vps = fused_multi_block_decode(
+                x, stacked[gi], kps, vps, bt, sl, num_heads=nh,
+                num_kv_heads=nkv, rope_theta=theta, epsilon=eps,
+                snap=snap)
+            states.extend(PagedDecodeState(kp, vp, bt, sl)
+                          for kp, vp in zip(kps, vps))
         x = _rms(x, allp[spec["final_norm"]], eps)
         if spec["lm_head"]:
             logits = x @ allp[spec["lm_head"]]

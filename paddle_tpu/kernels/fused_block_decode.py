@@ -71,8 +71,11 @@ from .paged_attention import paged_attention_xla, write_paged_kv
 _NEG_INF = -1e30
 _LANES = 128
 
-__all__ = ["BlockDecodeWeights", "fused_block_decode",
-           "fused_block_decode_pallas", "fused_block_decode_ref"]
+__all__ = ["BlockDecodeWeights", "MultiBlockDecodeWeights",
+           "fused_block_decode", "fused_block_decode_pallas",
+           "fused_block_decode_ref", "fused_multi_block_decode",
+           "fused_multi_block_decode_pallas", "fused_multi_block_decode_ref",
+           "stack_block_weights"]
 
 
 class BlockDecodeWeights(NamedTuple):
@@ -536,6 +539,513 @@ def fused_block_decode_pallas(x, weights: BlockDecodeWeights, k_pages,
         k_pages, v_pages, k_new[:b].reshape(b, nkv, d),
         v_new[:b].reshape(b, nkv, d), bt, sl)
     return out[:b], k_pages, v_pages
+
+
+# ===================================================== multi-layer fusion
+# r17: N transformer blocks per pallas_call (ClusterFusion++ / FlashFuser
+# direction). The grid becomes ``n_layers x per_layer_phases``; the
+# stacked weight arrays stream through VMEM with a LAYER-aware index map
+# (Pallas double-buffers the next block automatically), the activation
+# carries across layers in a VMEM scratch that never touches HBM, and
+# the q/k/v (resp. gate/up) projections of each layer are ONE merged
+# wider matmul over a concatenated weight (FFN-Fusion's observation:
+# sequential same-input matmuls are width-parallel).
+
+
+class MultiBlockDecodeWeights(NamedTuple):
+    """A GROUP of ``n`` decoder layers' weights, stacked on a leading
+    layer axis with the width-parallel projections pre-merged:
+
+      ln1   (n, H)
+      wqkv  (n, H, (nh + 2*nkv) * d)    q|k|v concatenated on columns
+      wo    (n, nh*d, H)
+      ln2   (n, H)
+      wgu   (n, H, 2*I)                 gate|up concatenated on columns
+      wd    (n, I, H)
+
+    Built ONCE per engine by :func:`stack_block_weights` (a host-side
+    copy of the layer weights — the per-layer originals keep serving
+    prefill/chunk programs) and threaded through jit as a traced
+    argument, so the compiled step never bakes weights as constants."""
+    ln1: Any
+    wqkv: Any
+    wo: Any
+    ln2: Any
+    wgu: Any
+    wd: Any
+
+    @property
+    def n_layers(self) -> int:
+        return int(self.ln1.shape[0])
+
+
+def stack_block_weights(layers) -> MultiBlockDecodeWeights:
+    """Stack per-layer :class:`BlockDecodeWeights` into one
+    :class:`MultiBlockDecodeWeights` group (merging q|k|v and gate|up on
+    the output axis). One-time cost: a device copy of the group's layer
+    weights."""
+    ws = list(layers)
+    return MultiBlockDecodeWeights(
+        ln1=jnp.stack([w.ln1 for w in ws]),
+        wqkv=jnp.stack([jnp.concatenate([w.wq, w.wk, w.wv], axis=1)
+                        for w in ws]),
+        wo=jnp.stack([w.wo for w in ws]),
+        ln2=jnp.stack([w.ln2 for w in ws]),
+        wgu=jnp.stack([jnp.concatenate([w.wg, w.wu], axis=1)
+                       for w in ws]),
+        wd=jnp.stack([w.wd for w in ws]))
+
+
+def fused_multi_block_decode_ref(x, weights: MultiBlockDecodeWeights,
+                                 k_pages, v_pages, block_tables, seq_lens,
+                                 *, num_heads: int, num_kv_heads: int,
+                                 rope_theta: float = 10000.0,
+                                 epsilon: float = 1e-6,
+                                 sm_scale: Optional[float] = None):
+    """Pure-jnp N-layer fused step over a stacked weight group.
+    ``k_pages``/``v_pages`` are SEQUENCES of the group's per-layer pools.
+    The layer loop is the per-layer chain of :func:`fused_block_decode_ref`
+    except the q/k/v and gate/up projections run as the merged matmuls
+    (same contraction per output column, so the split results match the
+    separate matmuls bitwise on every backend we test). CPU-CI path and
+    the parity oracle for the N-layer kernel."""
+    n = int(weights.ln1.shape[0])
+    if len(k_pages) != n or len(v_pages) != n:
+        raise ValueError(f"expected {n} per-layer pools, got "
+                         f"{len(k_pages)}/{len(v_pages)}")
+    b, hidden = x.shape
+    d = weights.wqkv.shape[2] // (num_heads + 2 * num_kv_heads)
+    qw = num_heads * d
+    kvw = num_kv_heads * d
+    inter = weights.wd.shape[1]
+    bt = jnp.asarray(block_tables, jnp.int32)
+    sl = jnp.asarray(seq_lens, jnp.int32)
+    sin, cos = _rope_tables(sl, d, rope_theta)
+
+    kps, vps = list(k_pages), list(v_pages)
+    for i in range(n):
+        h = _rms(x, weights.ln1[i], epsilon)
+        qkv = h @ weights.wqkv[i]
+        q = _rope_heads(qkv[:, :qw].reshape(b, num_heads, d), sin, cos)
+        k = _rope_heads(qkv[:, qw:qw + kvw].reshape(b, num_kv_heads, d),
+                        sin, cos)
+        v = qkv[:, qw + kvw:].reshape(b, num_kv_heads, d)
+        kps[i], vps[i] = write_paged_kv(kps[i], vps[i], k, v, bt, sl)
+        attn = paged_attention_xla(q, kps[i], vps[i], bt, sl + 1, sm_scale)
+        x2 = x + attn.reshape(b, qw) @ weights.wo[i]
+        h2 = _rms(x2, weights.ln2[i], epsilon)
+        gu = h2 @ weights.wgu[i]
+        f = jax.nn.silu(gu[:, :inter]) * gu[:, inter:]
+        x = x2 + f @ weights.wd[i]
+    return x, kps, vps
+
+
+def _fused_multi_block_kernel(bt_ref, sl_ref,                 # scalar prefetch
+                              x_ref, ln1_ref, ln2_ref, wqkv_ref,
+                              sin_ref, cos_ref, wo_ref, wg_ref, wu_ref,
+                              wd_ref, *rest, dims: dict):
+    D = dims
+    n_layers = D["n_layers"]
+    pool_refs = rest[:2 * n_layers]
+    out_ref, knew_ref, vnew_ref = rest[2 * n_layers:2 * n_layers + 3]
+    (xc_ref, h_ref, qkv_ref, ao_ref, x2_ref, fs_ref,
+     acc_a, acc_b, am_ref, mm_ref, ll_ref) = rest[2 * n_layers + 3:]
+
+    nh, nkv, d, rep = D["nh"], D["nkv"], D["d"], D["rep"]
+    page, mp = D["page"], D["mp"]
+    eps, scale = D["eps"], D["scale"]
+    qw = nh * d
+    kvw = nkv * d
+    per = D["per_layer"]
+    t = pl.program_id(0)
+    layer = t // per
+    lt = t % per
+
+    # -------------------------------- layer start: pre-attn norm of the
+    # VMEM-resident activation (layer 0 seeds it from the kernel input)
+    @pl.when(lt == 0)
+    def _layer_init():
+        @pl.when(layer == 0)
+        def _seed():
+            xc_ref[:] = x_ref[:].astype(jnp.float32)
+
+        xv = xc_ref[:]
+        var = jnp.mean(xv * xv, axis=-1, keepdims=True)
+        h_ref[:] = (xv * jax.lax.rsqrt(var + eps)
+                    * ln1_ref[:].astype(jnp.float32))
+        ao_ref[:] = jnp.zeros_like(ao_ref)
+
+    # ------------------------------------------------ shared matmul phase
+    def _mm(local, n_r, tr, tc, src_ref, w_ref, emit):
+        c = local // n_r
+        r = local % n_r
+
+        @pl.when(r == 0)
+        def _zero():
+            acc_a[:, :tc] = jnp.zeros_like(acc_a[:, :tc])
+
+        src = src_ref[:, pl.ds(r * tr, tr)]
+        acc_a[:, :tc] += _f32_dot(src, w_ref[0])
+
+        @pl.when(r == n_r - 1)
+        def _emit():
+            emit(c, acc_a[:, :tc])
+
+    # ------------------------ QKV: ONE merged matmul into the qkv scratch
+    @pl.when((lt >= D["off_qkv"]) & (lt < D["off_r"]))
+    def _qkv():
+        _mm(lt - D["off_qkv"], D["nr_h"], D["tr_h"], D["tc_qkv"], h_ref,
+            wqkv_ref,
+            lambda c, acc: qkv_ref.__setitem__(
+                (slice(None), pl.ds(c * D["tc_qkv"], D["tc_qkv"])), acc))
+
+    # ------------------------------------- R: in-VMEM rope + k/v emission
+    @pl.when(lt == D["off_r"])
+    def _rope():
+        sin = sin_ref[:]
+        cos = cos_ref[:]
+        half = d // 2
+
+        def rot(u):
+            return jnp.concatenate([-u[:, half:], u[:, :half]], axis=1)
+
+        for head in range(nh):
+            c0 = head * d
+            u = qkv_ref[:, c0:c0 + d]
+            qkv_ref[:, c0:c0 + d] = u * cos + rot(u) * sin
+        for head in range(nkv):
+            c0 = qw + head * d
+            u = qkv_ref[:, c0:c0 + d]
+            qkv_ref[:, c0:c0 + d] = u * cos + rot(u) * sin
+        knew_ref[0] = qkv_ref[:, qw:qw + kvw].astype(knew_ref.dtype)
+        vnew_ref[0] = qkv_ref[:, qw + kvw:qw + 2 * kvw].astype(
+            vnew_ref.dtype)
+
+    # --------------------------------------- A: paged attention, by page
+    local_a = jnp.clip(lt - D["off_a"], 0, D["steps_a"] - 1)
+    j = local_a % mp
+    bh = local_a // mp
+    h_i = bh % nkv
+    b_i = bh // nkv
+    in_a = (lt >= D["off_a"]) & (lt < D["off_o"])
+
+    def _online(s, vblk):
+        m_prev = mm_ref[0:rep, 0:1]
+        l_prev = ll_ref[0:rep, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        m_new = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        ll_ref[0:rep, :] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=1, keepdims=True),
+            (rep, ll_ref.shape[1]))
+        mm_ref[0:rep, :] = jnp.broadcast_to(m_new, (rep, mm_ref.shape[1]))
+        am_ref[0:rep, :] = alpha * am_ref[0:rep, :] + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(in_a & (j == 0))
+    def _attn_init():
+        am_ref[...] = jnp.zeros_like(am_ref)
+        mm_ref[...] = jnp.full_like(mm_ref, _NEG_INF)
+        ll_ref[...] = jnp.zeros_like(ll_ref)
+
+    seq = sl_ref[b_i]
+    n_pages = jnp.maximum((seq + page - 1) // page, 1)
+
+    def _attn_page(kp_ref, vp_ref):
+        q = qkv_ref[pl.ds(b_i, 1), pl.ds(h_i * rep * d, rep * d)]
+        q = q.reshape(rep, d)
+        k = kp_ref[0, 0].astype(jnp.float32)           # (page, d)
+        v = vp_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        pos = j * page + jax.lax.broadcasted_iota(jnp.int32, (rep, page), 1)
+        _online(jnp.where(pos < seq, s, _NEG_INF), v)
+
+        # this step's own token attends too: fold its k/v from VMEM at
+        # the row's last valid page (the pool append happens post-kernel)
+        @pl.when(j == n_pages - 1)
+        def _attn_new_token():
+            kn = qkv_ref[pl.ds(b_i, 1), pl.ds(qw + h_i * d, d)]
+            vn = qkv_ref[pl.ds(b_i, 1), pl.ds(qw + kvw + h_i * d, d)]
+            s_new = jax.lax.dot_general(
+                q, kn, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # (rep, 1)
+            _online(s_new, vn)
+
+    # each layer reads ITS pool operand pair: the layer gate is unrolled
+    # over the static group size so the body indexes a python list, and
+    # the operands' index maps freeze inactive layers at page 0 (no
+    # spurious refetch mid-phase)
+    for m in range(n_layers):
+        @pl.when(in_a & (layer == m) & (j < n_pages))
+        def _attn_m(m=m):
+            _attn_page(pool_refs[2 * m], pool_refs[2 * m + 1])
+
+    @pl.when(in_a & (j == mp - 1))
+    def _attn_emit():
+        l = ll_ref[0:rep, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o = am_ref[0:rep, :] / l_safe
+        ao_ref[pl.ds(b_i, 1), pl.ds(h_i * rep * d, rep * d)] = \
+            o.reshape(1, rep * d)
+
+    # ------------------------------- O: out-projection + first residual
+    @pl.when((lt >= D["off_o"]) & (lt < D["off_f"]))
+    def _o():
+        def emit(c, acc):
+            cols = pl.ds(c * D["tc_o"], D["tc_o"])
+            x2_ref[:, cols] = xc_ref[:, cols] + acc
+
+        _mm(lt - D["off_o"], D["nr_o"], D["tr_o"], D["tc_o"], ao_ref,
+            wo_ref, emit)
+
+    # --------------------- F: ffn norm + merged gate|up (two col-offset
+    # views of the SAME stacked wgu operand feed the paired accumulators)
+    in_f = (lt >= D["off_f"]) & (lt < D["off_d"])
+    local_f = jnp.clip(lt - D["off_f"], 0, D["steps_f"] - 1)
+
+    @pl.when(in_f & (local_f == 0))
+    def _ffn_norm():
+        xv = x2_ref[:]
+        var = jnp.mean(xv * xv, axis=-1, keepdims=True)
+        h_ref[:] = (xv * jax.lax.rsqrt(var + eps)
+                    * ln2_ref[:].astype(jnp.float32))
+
+    @pl.when(in_f)
+    def _f():
+        tc = D["tc_f"]
+        c = local_f // D["nr_h"]
+        r = local_f % D["nr_h"]
+
+        @pl.when(r == 0)
+        def _zero():
+            acc_a[:, :tc] = jnp.zeros_like(acc_a[:, :tc])
+            acc_b[:, :tc] = jnp.zeros_like(acc_b[:, :tc])
+
+        src = h_ref[:, pl.ds(r * D["tr_h"], D["tr_h"])]
+        acc_a[:, :tc] += _f32_dot(src, wg_ref[0])
+        acc_b[:, :tc] += _f32_dot(src, wu_ref[0])
+
+        @pl.when(r == D["nr_h"] - 1)
+        def _emit():
+            g = acc_a[:, :tc]
+            fs_ref[:, pl.ds(c * tc, tc)] = jax.nn.silu(g) * acc_b[:, :tc]
+
+    # --------- D: down-projection + second residual. The next layer's
+    # activation rounds through the activation dtype (matching the
+    # unfused chain's inter-layer cast) back into the VMEM carry; the
+    # same tile lands in the kernel output, so the LAST layer's write is
+    # the result
+    @pl.when(lt >= D["off_d"])
+    def _d():
+        def emit(c, acc):
+            cols = pl.ds(c * D["tc_d"], D["tc_d"])
+            nxt = (x2_ref[:, cols] + acc).astype(out_ref.dtype)
+            out_ref[:, cols] = nxt
+            xc_ref[:, cols] = nxt.astype(jnp.float32)
+
+        _mm(lt - D["off_d"], D["nr_i"], D["tr_i"], D["tc_d"], fs_ref,
+            wd_ref, emit)
+
+
+def fused_multi_block_decode_pallas(x, weights: MultiBlockDecodeWeights,
+                                    k_pages, v_pages, block_tables,
+                                    seq_lens, *, num_heads: int,
+                                    num_kv_heads: int,
+                                    rope_theta: float = 10000.0,
+                                    epsilon: float = 1e-6,
+                                    sm_scale: Optional[float] = None,
+                                    interpret: Optional[bool] = None):
+    """N layers in ONE ``pallas_call`` (see the multi-layer section of
+    the module docstring). ``k_pages``/``v_pages`` are sequences of the
+    group's per-layer pools; each is its own kernel operand whose index
+    map streams pages only while its layer is active. Returns
+    ``(out, k_pages_list, v_pages_list)``."""
+    if interpret is None:
+        from ..flags import is_tpu_backend
+        interpret = not is_tpu_backend()
+    n_layers = int(weights.ln1.shape[0])
+    b, hidden = x.shape
+    nh, nkv = num_heads, num_kv_heads
+    if nh % nkv:
+        raise ValueError(f"query heads {nh} not divisible by kv heads {nkv}")
+    d = weights.wqkv.shape[2] // (nh + 2 * nkv)
+    rep = nh // nkv
+    qw = nh * d
+    kvw = nkv * d
+    wq_cols = qw + 2 * kvw
+    page = k_pages[0].shape[2]
+    mp = block_tables.shape[1]
+    inter = weights.wd.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+
+    bt = jnp.asarray(block_tables, jnp.int32)
+    sl = jnp.asarray(seq_lens, jnp.int32)
+    b_pad = -(-b // 8) * 8
+    rep_pad = -(-rep // 8) * 8
+
+    sin, cos = _rope_tables(sl, d, rope_theta)
+    if b_pad != b:
+        pad = [(0, b_pad - b), (0, 0)]
+        x_p = jnp.pad(x, pad)
+        sin, cos = jnp.pad(sin, pad), jnp.pad(cos, pad)
+        bt_p = jnp.pad(bt, pad)
+        sl_p = jnp.pad(sl, (0, b_pad - b))
+    else:
+        x_p, bt_p, sl_p = x, bt, sl
+
+    tr_h = _tile(hidden, 512)
+    tr_o = _tile(qw, 512)
+    tr_i = _tile(inter, 512)
+    tc_qkv = _tile(wq_cols, 256)
+    tc_o = _tile(hidden, 256)
+    tc_f = _tile(inter, 256)
+    tc_d = _tile(hidden, 256)
+    tc_max = max(tc_qkv, tc_o, tc_f, tc_d)
+
+    nr_h = hidden // tr_h
+    nr_o = qw // tr_o
+    nr_i = inter // tr_i
+    n_cf = inter // tc_f
+    steps_qkv = nr_h * (wq_cols // tc_qkv)
+    steps_a = b_pad * nkv * mp
+    steps_o = nr_o * (hidden // tc_o)
+    steps_f = nr_h * n_cf
+    steps_d = nr_i * (hidden // tc_d)
+
+    off_qkv = 0
+    off_r = off_qkv + steps_qkv
+    off_a = off_r + 1
+    off_o = off_a + steps_a
+    off_f = off_o + steps_o
+    off_d = off_f + steps_f
+    per = off_d + steps_d
+
+    dims = dict(n_layers=n_layers, per_layer=per, nh=nh, nkv=nkv, d=d,
+                rep=rep, page=page, mp=mp, eps=float(epsilon),
+                scale=float(sm_scale), tr_h=tr_h, tr_o=tr_o, tr_i=tr_i,
+                tc_qkv=tc_qkv, tc_o=tc_o, tc_f=tc_f, tc_d=tc_d,
+                nr_h=nr_h, nr_o=nr_o, nr_i=nr_i, steps_a=steps_a,
+                steps_f=steps_f, off_qkv=off_qkv, off_r=off_r,
+                off_a=off_a, off_o=off_o, off_f=off_f, off_d=off_d)
+
+    def _const(*_args):
+        return (0, 0)
+
+    def _ln_map(t, bt_ref, sl_ref):
+        return (t // per, 0)
+
+    def _phase_map(off, steps, n_r):
+        def index(t, bt_ref, sl_ref):
+            local = jnp.clip(t % per - off, 0, steps - 1)
+            return (t // per, local % n_r, local // n_r)
+        return index
+
+    def _up_map(t, bt_ref, sl_ref):
+        local = jnp.clip(t % per - off_f, 0, steps_f - 1)
+        return (t // per, local % nr_h, n_cf + local // nr_h)
+
+    def _kp_map(m):
+        def index(t, bt_ref, sl_ref):
+            active = (t // per) == m
+            local = jnp.clip(t % per - off_a, 0, steps_a - 1)
+            jj = local % mp
+            bh = local // mp
+            return (jnp.where(active, bh % nkv, 0),
+                    jnp.where(active, bt_ref[bh // nkv, jj], 0), 0, 0)
+        return index
+
+    def _kv_out_map(t, bt_ref, sl_ref):
+        return (t // per, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_layers * per,),
+        in_specs=[
+            pl.BlockSpec((b_pad, hidden), _const),                  # x
+            pl.BlockSpec((1, hidden), _ln_map),                     # ln1
+            pl.BlockSpec((1, hidden), _ln_map),                     # ln2
+            pl.BlockSpec((1, tr_h, tc_qkv),
+                         _phase_map(off_qkv, steps_qkv, nr_h)),     # wqkv
+            pl.BlockSpec((b_pad, d), _const),                       # sin
+            pl.BlockSpec((b_pad, d), _const),                       # cos
+            pl.BlockSpec((1, tr_o, tc_o),
+                         _phase_map(off_o, steps_o, nr_o)),         # wo
+            pl.BlockSpec((1, tr_h, tc_f),
+                         _phase_map(off_f, steps_f, nr_h)),         # wgu:gate
+            pl.BlockSpec((1, tr_h, tc_f), _up_map),                 # wgu:up
+            pl.BlockSpec((1, tr_i, tc_d),
+                         _phase_map(off_d, steps_d, nr_i)),         # wd
+        ] + [
+            pl.BlockSpec((1, 1, page, d), _kp_map(m // 2))
+            for m in range(2 * n_layers)                            # pools
+        ],
+        out_specs=[
+            pl.BlockSpec((b_pad, hidden), _const),                  # out
+            pl.BlockSpec((1, b_pad, kvw), _kv_out_map),             # k_new
+            pl.BlockSpec((1, b_pad, kvw), _kv_out_map),             # v_new
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b_pad, hidden), jnp.float32),     # x carry
+            pltpu.VMEM((b_pad, hidden), jnp.float32),     # h (normed)
+            pltpu.VMEM((b_pad, wq_cols), jnp.float32),    # merged qkv
+            pltpu.VMEM((b_pad, qw), jnp.float32),         # attn out
+            pltpu.VMEM((b_pad, hidden), jnp.float32),     # x2 (residual)
+            pltpu.VMEM((b_pad, inter), jnp.float32),      # silu(g)*u
+            pltpu.VMEM((b_pad, tc_max), jnp.float32),     # acc a
+            pltpu.VMEM((b_pad, tc_max), jnp.float32),     # acc b
+            pltpu.VMEM((rep_pad, d), jnp.float32),        # attn acc
+            pltpu.VMEM((rep_pad, _LANES), jnp.float32),   # attn m
+            pltpu.VMEM((rep_pad, _LANES), jnp.float32),   # attn l
+        ],
+    )
+
+    operands = [bt_p, sl_p, x_p, weights.ln1, weights.ln2, weights.wqkv,
+                sin, cos, weights.wo, weights.wgu, weights.wgu, weights.wd]
+    for kp, vp in zip(k_pages, v_pages):
+        operands += [kp, vp]
+
+    out, k_new, v_new = pl.pallas_call(
+        functools.partial(_fused_multi_block_kernel, dims=dims),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b_pad, hidden), x.dtype),
+            jax.ShapeDtypeStruct((n_layers, b_pad, kvw), x.dtype),
+            jax.ShapeDtypeStruct((n_layers, b_pad, kvw), x.dtype),
+        ],
+        interpret=interpret,
+    )(*operands)
+
+    kps, vps = list(k_pages), list(v_pages)
+    for i in range(n_layers):
+        kps[i], vps[i] = write_paged_kv(
+            kps[i], vps[i], k_new[i, :b].reshape(b, nkv, d),
+            v_new[i, :b].reshape(b, nkv, d), bt, sl)
+    return out[:b], kps, vps
+
+
+def fused_multi_block_decode(x, weights: MultiBlockDecodeWeights, k_pages,
+                             v_pages, block_tables, seq_lens, *,
+                             num_heads: int, num_kv_heads: int,
+                             rope_theta: float = 10000.0,
+                             epsilon: float = 1e-6,
+                             sm_scale: Optional[float] = None, snap=None):
+    """Dispatch one N-layer fused decode step: the multi-layer Pallas
+    kernel on a real TPU backend, the merged-matmul jnp composition
+    elsewhere. ``snap`` as in :func:`fused_block_decode`."""
+    from ..flags import is_tpu_backend, snapshot
+    if snap is None:
+        snap = snapshot(("use_pallas",))
+    kwargs = dict(num_heads=num_heads, num_kv_heads=num_kv_heads,
+                  rope_theta=rope_theta, epsilon=epsilon, sm_scale=sm_scale)
+    if snap.use_pallas and is_tpu_backend():
+        return fused_multi_block_decode_pallas(
+            x, weights, k_pages, v_pages, block_tables, seq_lens, **kwargs)
+    return fused_multi_block_decode_ref(
+        x, weights, k_pages, v_pages, block_tables, seq_lens, **kwargs)
 
 
 def fused_block_decode(x, weights: BlockDecodeWeights, k_pages, v_pages,

@@ -235,6 +235,208 @@ def paged_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
     return out.reshape(b, h, d).astype(q.dtype)
 
 
+# -------------------------------------- chunk-native prefill attention
+def _paged_chunk_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, out_ref,
+                        acc_ref, m_ref, l_ref, *, sm_scale: float,
+                        page_size: int, s_chunk: int, rows: int,
+                        max_pages: int):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    start = sl_ref[b]
+    # tokens live after the chunk's own write; a PADDED final chunk can
+    # point past the block table, so clamp to the grid width (the
+    # dropped pad writes never landed in the pool anyway)
+    n_pages = jnp.clip((start + s_chunk + page_size - 1) // page_size,
+                       1, max_pages)
+
+    @pl.when(j < n_pages)
+    def _accumulate():
+        rows_pad = acc_ref.shape[0]
+        q = q_ref[0, 0].astype(jnp.float32)            # (rows_pad, d)
+        k = k_ref[0, 0].astype(jnp.float32)            # (page, d)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        # row r holds (rep head r // s_chunk, chunk token r % s_chunk);
+        # its query sits at absolute position start + r % s_chunk and
+        # sees every pool position up to and including itself
+        r_iota = jax.lax.broadcasted_iota(
+            jnp.int32, (rows_pad, page_size), 0)
+        q_pos = start + jax.lax.rem(r_iota, s_chunk)
+        kv_pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (rows_pad, page_size), 1)
+        s = jnp.where(kv_pos <= q_pos, s, _NEG_INF)
+
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        m_new = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=1, keepdims=True),
+            l_ref.shape)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        acc_ref[...] = alpha * acc_ref[...] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_pages - 1)
+    def _emit():
+        l = l_ref[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out_ref[0, 0] = (acc_ref[...] / l_safe).astype(out_ref.dtype)
+
+
+def paged_chunk_attention(q: jax.Array, k_pages: jax.Array,
+                          v_pages: jax.Array, block_tables: jax.Array,
+                          start: jax.Array,
+                          sm_scale: Optional[float] = None) -> jax.Array:
+    """Chunked-prefill attention read straight through the block table —
+    the copy-free replacement for ``gather_paged_view`` +
+    ``cached_attention`` on the chunk hot path (the r12 leftover).
+
+    The S-token query chunk sits at absolute positions ``start ..
+    start+S-1`` and attends causally to the pool's already-written
+    prefix PLUS its own tokens, which the caller must have written
+    (``write_paged_prompt_at``) before calling — write-then-attend, the
+    same ordering the gather path used. Each grid step streams ONE pool
+    page through VMEM (grid ``(B, Hkv, max_pages)``, block-table page
+    index scalar-prefetched), online softmax across pages; nothing ever
+    materializes the ``(B, T, Hkv, D)`` per-sequence view.
+
+    q:     (B, S, H, D) — the chunk's queries
+    start: (B,) int32   — written length BEFORE this chunk (the cursor)
+    Returns (B, S, H, D) in q's dtype. Rows past the real prompt tail
+    (final-chunk padding) emit garbage the caller discards.
+    """
+    b, s, h, d = q.shape
+    hkv, _, page_size, _ = k_pages.shape
+    if h % hkv:
+        raise ValueError(f"query heads {h} not divisible by kv heads {hkv}")
+    rep = h // hkv
+    max_pages = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+
+    rows = rep * s
+    rows_pad = -(-rows // 8) * 8
+    # (B, S, H, D) -> (B, Hkv, rep*S, D): row = rep_head * S + token
+    qg = q.transpose(0, 2, 1, 3).reshape(b, hkv, rows, d)
+    if rows_pad != rows:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows_pad - rows), (0, 0)))
+    bt = jnp.asarray(block_tables, jnp.int32)
+    st = jnp.asarray(start, jnp.int32)
+
+    def q_index(b_, h_, j, bt_ref, sl_ref):
+        return (b_, h_, 0, 0)
+
+    def kv_index(b_, h_, j, bt_ref, sl_ref):
+        return (h_, bt_ref[b_, j], 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(_paged_chunk_kernel, sm_scale=float(sm_scale),
+                          page_size=page_size, s_chunk=s, rows=rows,
+                          max_pages=max_pages),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, hkv, max_pages),
+            in_specs=[
+                pl.BlockSpec((1, 1, rows_pad, d), q_index),
+                pl.BlockSpec((1, 1, page_size, d), kv_index),
+                pl.BlockSpec((1, 1, page_size, d), kv_index),
+            ],
+            out_specs=pl.BlockSpec((1, 1, rows_pad, d), q_index),
+            scratch_shapes=[
+                pltpu.VMEM((rows_pad, d), jnp.float32),       # acc
+                pltpu.VMEM((rows_pad, _LANES), jnp.float32),  # m
+                pltpu.VMEM((rows_pad, _LANES), jnp.float32),  # l
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rows_pad, d), q.dtype),
+        interpret=_interpret(),
+    )(bt, st, qg, k_pages, v_pages)
+    out = out[:, :, :rows].reshape(b, hkv, rep, s, d)
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, s, h, d)
+
+
+# XLA-twin page grouping: pages per fori_loop step are batched so each
+# iteration runs one ~GROUP_KEYS-wide matmul instead of max_pages tiny
+# page-wide ones (64 sequential dispatches of 8-key dots halve CPU
+# prefill throughput). The live workspace stays a FIXED-size page-group
+# block — O(GROUP_KEYS), independent of sequence length — so the
+# copy-free contract (never the (B, T, Hkv, D) gathered view) holds.
+_CHUNK_GROUP_KEYS = 128
+
+
+def paged_chunk_attention_xla(q, k_pages, v_pages, block_tables, start,
+                              sm_scale=None):
+    """Copy-free XLA twin of :func:`paged_chunk_attention` (CPU tests,
+    and the fallback wherever pallas is off): ``lax.fori_loop`` over
+    page GROUPS with online softmax, so the live workspace is one
+    ``(B, Hkv, ~_CHUNK_GROUP_KEYS, D)`` page-group block — fixed-size,
+    O(1) in sequence length — instead of the gathered ``(B, T, Hkv, D)``
+    view the old chunk path materialized. Pages past a sequence's
+    written count are read (their block-table entries are 0 by contract)
+    but fully masked by position."""
+    b, s, h, d = q.shape
+    hkv, _, page_size, _ = k_pages.shape
+    if h % hkv:
+        raise ValueError(f"query heads {h} not divisible by kv heads {hkv}")
+    rep = h // hkv
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    bt = jnp.asarray(block_tables, jnp.int32)
+    st = jnp.asarray(start, jnp.int32)
+    max_pages = bt.shape[1]
+    grp = min(max_pages, max(1, _CHUNK_GROUP_KEYS // page_size))
+    n_groups = -(-max_pages // grp)
+    if n_groups * grp != max_pages:
+        # pad with page 0: its kv_pos >= max_pages*page_size > any q_pos,
+        # so the position mask kills every padded lane
+        bt = jnp.pad(bt, ((0, 0), (0, n_groups * grp - max_pages)))
+    qg = (q.astype(jnp.float32) * sm_scale).transpose(0, 2, 1, 3)
+    qg = qg.reshape(b, hkv, rep, s, d)
+    q_pos = st[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # (B, S)
+
+    def body(j, carry):
+        acc, m, l = carry
+        pages = jax.lax.dynamic_slice_in_dim(bt, j * grp, grp, 1)  # (B, G)
+        kb = jnp.moveaxis(k_pages[:, pages], 1, 0).astype(jnp.float32)
+        vb = jnp.moveaxis(v_pages[:, pages], 1, 0).astype(jnp.float32)
+        kb = kb.reshape(b, hkv, grp * page_size, d)
+        vb = vb.reshape(b, hkv, grp * page_size, d)
+        sc = jnp.einsum("bhrsd,bhpd->bhrsp", qg, kb)
+        kv_pos = (j * grp * page_size
+                  + jnp.arange(grp * page_size, dtype=jnp.int32))
+        vis = kv_pos[None, None, :] <= q_pos[:, :, None]           # (B,S,Gp)
+        sc = jnp.where(vis[:, None, None], sc, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(sc, axis=-1))
+        m_new = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(sc - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum("bhrsp,bhpd->bhrsd",
+                                                  p, vb)
+        return acc, m_new, l
+
+    acc = jnp.zeros((b, hkv, rep, s, d), jnp.float32)
+    m = jnp.full((b, hkv, rep, s), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, hkv, rep, s), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, n_groups, body, (acc, m, l))
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l[..., None]).transpose(0, 3, 1, 2, 4)
+    return out.reshape(b, s, h, d).astype(q.dtype)
+
+
 # ------------------------------------------------------- pool management
 def write_paged_kv(k_pages, v_pages, k_new, v_new, block_tables, positions):
     """Write one token per sequence into the pool at absolute sequence
@@ -293,11 +495,12 @@ def write_paged_prompt_at(k_pages, v_pages, k_new, v_new, block_tables,
 def gather_paged_view(k_pages, v_pages, block_tables):
     """Materialize each sequence's contiguous ``(B, T, Hkv, D)`` cache
     view from its pages (T = max_pages * page_size) — the gather the
-    decode kernel avoids. Chunked prefill amortizes this copy over its
-    whole query chunk and feeds the view to ``cached_attention`` (flash
-    prefill on chip, dense einsum elsewhere); a chunk-native Pallas
-    kernel that skips the gather is a ROADMAP item for the next on-chip
-    window."""
+    decode kernel avoids. Chunked prefill used to amortize this copy
+    over its whole query chunk; it now reads the pool through the block
+    table directly (``paged_chunk_attention`` /
+    ``paged_chunk_attention_xla``), so this helper survives only as the
+    parity oracle those paths are tested against and for offline cache
+    inspection."""
     bt = jnp.asarray(block_tables, jnp.int32)
     hkv, _, page_size, d = k_pages.shape
     b, max_pages = bt.shape

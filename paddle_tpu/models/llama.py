@@ -229,13 +229,22 @@ class LlamaForCausalLM(GenerationMixin, Layer):
                                         offset=offset)
         return self.logits(hidden), new_caches
 
-    def block_decode_spec(self):
+    def block_decode_spec(self, fused_layers: int = 1):
         """Per-layer weight layout for the fused block-decode serving
         path (kernels/fused_block_decode.py): which named parameters form
         each layer's BlockDecodeWeights, plus the embedding / final-norm
         / lm-head names and the attention geometry. The serving engine
         builds its ONE compiled decode step from this — the model's
-        python forward never runs on the decode hot path."""
+        python forward never runs on the decode hot path.
+
+        ``fused_layers=N`` (FLAGS_fused_block_layers) additionally
+        publishes ``layer_groups`` — consecutive layer indices batched N
+        per group (final group ragged) — for the multi-layer kernel: the
+        engine stacks each group's BlockDecodeWeights into one
+        MultiBlockDecodeWeights (q|k|v and gate|up merged into single
+        wider matmuls) and runs the whole group in ONE pallas_call. The
+        per-layer ``layers`` list is unchanged either way, so existing
+        consumers (chunk prefill, spec-decode draft) never re-derive."""
         c = self.config
         layers = []
         for i in range(c.num_hidden_layers):
@@ -250,7 +259,7 @@ class LlamaForCausalLM(GenerationMixin, Layer):
                 wg=p + "mlp.gate_proj.weight",
                 wu=p + "mlp.up_proj.weight",
                 wd=p + "mlp.down_proj.weight"))
-        return dict(
+        spec = dict(
             arch="llama", layers=layers,
             embed="llama.embed_tokens.weight",
             final_norm="llama.norm.weight",
@@ -259,6 +268,12 @@ class LlamaForCausalLM(GenerationMixin, Layer):
             num_kv_heads=c.num_key_value_heads,
             rope_theta=c.rope_theta,
             epsilon=c.rms_norm_eps)
+        if fused_layers > 1:
+            n = c.num_hidden_layers
+            spec["layer_groups"] = [
+                list(range(i, min(i + int(fused_layers), n)))
+                for i in range(0, n, int(fused_layers))]
+        return spec
 
 
 # ===================================================== pipeline-parallel pipe

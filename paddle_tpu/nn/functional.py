@@ -719,9 +719,10 @@ def paged_scaled_dot_product_attention(query, key, value, state):
     the pool pages.
     Chunked prefill (S > 1, PagedChunkState, B = 1): the chunk writes at
     positions ``seq_lens .. seq_lens+S-1`` and attends to the
-    already-written prefix PLUS itself causally over the gathered pool
-    view (``cached_attention``: flash prefill on chip, dense einsum
-    elsewhere). Pad positions past the block table are dropped — but
+    already-written prefix PLUS itself causally, reading the pool
+    through the block table page by page (``paged_chunk_attention`` on
+    chip, its copy-free XLA twin elsewhere) — no gathered per-sequence
+    view is materialized. Pad positions past the block table are dropped — but
     the returned state's ``seq_lens`` still advance by the full static
     S, so a PADDED final chunk overcounts by its pad tail: the driver
     owns the true lengths (see the PagedChunkState length contract).
@@ -732,7 +733,8 @@ def paged_scaled_dot_product_attention(query, key, value, state):
     from ..kernels.decode_attention import cached_attention
     from ..kernels.paged_attention import (PagedChunkState, paged_attention,
                                            paged_attention_xla,
-                                           gather_paged_view,
+                                           paged_chunk_attention,
+                                           paged_chunk_attention_xla,
                                            write_paged_kv,
                                            write_paged_prompt,
                                            write_paged_prompt_at)
@@ -749,12 +751,15 @@ def paged_scaled_dot_product_attention(query, key, value, state):
                     "chunked paged prefill is per-request (B = 1); got "
                     f"batch {qv.shape[0]}")
             kp2, vp2 = write_paged_prompt_at(kp, vp, kv, vv, bt, sl)
-            kg, vg = gather_paged_view(kp2, vp2, bt)
             # query rows sit at absolute positions sl .. sl+s-1; rows
             # past the real prompt tail (final-chunk padding) emit
             # garbage the caller discards, and their K is masked off
-            # every earlier row by causality
-            out = cached_attention(qv, kg, vg, sl[0] + s)
+            # every earlier row by causality. The pool is read through
+            # the block table page by page — no gathered (B, T, Hkv, D)
+            # view is ever materialized.
+            attend = (paged_chunk_attention if use_pallas
+                      else paged_chunk_attention_xla)
+            out = attend(qv, kp2, vp2, bt, sl)
             sl2 = sl + s
         elif s > 1:
             # whole-prompt prefill contract: the sequences must be

@@ -386,48 +386,100 @@ def _decode_temp(dims: ModelDims, geom: PoolGeometry, batch: int) -> int:
     return int(_DECODE_TEMP_K * elems * 4)
 
 
-def _prefill_temp(dims: ModelDims, geom: PoolGeometry, s: int) -> int:
+def _prefill_temp(dims: ModelDims, geom: PoolGeometry, s: int,
+                  chunked: bool = False) -> int:
     """Prefill/chunk temp model (b=1, S query tokens): XLA's buffer
     reuse keeps roughly the two largest stage buffers live at the
-    worst program point — scores, the gathered KV view, the FFN
-    intermediate, the logits block, or the QKV block."""
-    stages = [
-        dims.heads * s * geom.max_seq,      # attention scores
-        2 * geom.max_seq * dims.kv_dim,     # gathered k+v view
-        2 * s * dims.intermediate,          # gate/up FFN halves
-        s * dims.vocab,                     # logits
-        s * 4 * dims.hidden,                # q/k/v/x block
-    ]
+    worst program point — scores, the FFN intermediate, the logits
+    block, or the QKV block.
+
+    ``chunked`` selects the r17 copy-free chunk path: attention reads
+    K/V pages through the block table (a fixed-size page-GROUP block in
+    flight — ~128 keys on the XLA twin, one page on the pallas kernel —
+    online softmax), so the gathered full-context K/V view and the full
+    S x max_seq score matrix never materialize — their stages are
+    replaced by the page-group score/K/V blocks and the softmax carry."""
+    if chunked:
+        # mirrors _CHUNK_GROUP_KEYS in kernels/paged_attention.py: the
+        # XLA twin batches pages into ~128-key groups per loop step
+        pages = -(-geom.max_seq // geom.page_size)
+        gk = min(pages, max(1, 128 // geom.page_size)) * geom.page_size
+        stages = [
+            dims.heads * s * gk,                 # page-group score block
+            2 * gk * dims.kv_dim,                # gathered K+V group block
+            2 * dims.heads * s * dims.head_dim,  # online-softmax acc carry
+            2 * s * dims.intermediate,           # gate/up FFN halves
+            s * dims.vocab,                      # logits
+            s * 4 * dims.hidden,                 # q/k/v/x block
+        ]
+    else:
+        stages = [
+            dims.heads * s * geom.max_seq,      # attention scores
+            2 * geom.max_seq * dims.kv_dim,     # gathered k+v view
+            2 * s * dims.intermediate,          # gate/up FFN halves
+            s * dims.vocab,                     # logits
+            s * 4 * dims.hidden,                # q/k/v/x block
+        ]
     top2 = sum(sorted(stages)[-2:])
     return int(_PREFILL_TEMP_K * top2 * 4)
 
 
+def _nlayer_slice_temp(dims: ModelDims, batch: int) -> int:
+    """Temp floor of the N-layer fused decode program on the CPU ref
+    path (r17). The grouped program receives STACKED per-group weights
+    and slices one layer per iteration; CPU XLA materializes the sliced
+    merged weight feeding each dot instead of fusing the slice, so one
+    largest-merged-slice buffer (reused across layers — hence no N
+    term) plus the merged activations stays live. Measured fit across
+    hidden/intermediate/N sweeps: within 0.7% of compiled temp. The
+    Pallas path streams weight tiles through VMEM and never sees this
+    buffer; see :func:`plan_fused_layers` for its VMEM pricing."""
+    slice_elems = dims.hidden * max(2 * dims.intermediate,
+                                    dims.heads * dims.head_dim
+                                    + 2 * dims.kv_dim)
+    act_elems = batch * (2 * dims.intermediate + 2 * dims.hidden)
+    return 4 * (slice_elems + act_elems)
+
+
 def estimate_decode_program(dims: ModelDims, geom: PoolGeometry,
-                            batch: int, param_bytes: int) -> Dict[str, int]:
-    """Predicted sections of one decode-step program (fused or generic —
-    the calibrated model covers both): params + pools + tables in,
-    donated pools + token ids out."""
+                            batch: int, param_bytes: int,
+                            fused_layers: int = 1) -> Dict[str, int]:
+    """Predicted sections of one decode-step program (fused, generic, or
+    the r17 N-layer grouped program — the calibrated model covers all
+    three): params + pools + tables in, donated pools + token ids out.
+
+    ``fused_layers`` > 1 prices the ``decode_fused_nlayer`` program.
+    Its ARGUMENT section is unchanged: the stacked per-group weight
+    copies add exactly the element count of the per-layer block params
+    XLA elides as unused, so ``param_bytes`` (all params + buffers)
+    still lands on the compiled number. Its temp floor is the stacked
+    slice working set (:func:`_nlayer_slice_temp`)."""
     pool = geom.pool_bytes()
     tables = geom.tables_bytes(batch)
     arg = param_bytes + pool + tables + batch * 4         # toks (B,1)
     out = pool + tables + batch * 4                       # argmax ids
+    temp = _decode_temp(dims, geom, batch)
+    if int(fused_layers) > 1:
+        temp = max(temp, _nlayer_slice_temp(dims, batch))
     return {
         "argument": arg, "output": out,
-        "temp": _decode_temp(dims, geom, batch),
+        "temp": temp,
         "alias": pool, "generated_code": 0,
-        "peak": arg + out - pool + _decode_temp(dims, geom, batch),
+        "peak": arg + out - pool + temp,
     }
 
 
 def estimate_prefill_program(dims: ModelDims, geom: PoolGeometry,
-                             s: int, param_bytes: int) -> Dict[str, int]:
+                             s: int, param_bytes: int,
+                             chunked: bool = False) -> Dict[str, int]:
     """Predicted sections of a b=1 prefill (monolithic length ``s``) or
-    chunked-prefill (``s`` = chunk) program."""
+    chunked-prefill (``s`` = chunk, ``chunked=True`` — the r17
+    copy-free block-table path) program."""
     pool = geom.pool_bytes()
     tables = geom.tables_bytes(1)
     arg = param_bytes + pool + tables + s * 4             # ids (1, S)
     out = pool + tables + 4                               # argmax id
-    temp = _prefill_temp(dims, geom, s)
+    temp = _prefill_temp(dims, geom, s, chunked=chunked)
     return {"argument": arg, "output": out, "temp": temp,
             "alias": pool, "generated_code": 0,
             "peak": arg + out - pool + temp}
@@ -506,7 +558,10 @@ def estimate_engine_memory(dims: ModelDims, *,
         pool += dims.layers * 2 * dims.kv_heads * (usable + 1) * 4
     weights = weight_bytes(n_params, weight_dtype)
     decode_tmp = _decode_temp(dims, geom, max_batch)
-    chunk_tmp = _prefill_temp(dims, geom, chunk) if chunk else 0
+    # chunked prefill is the copy-free block-table path (r17): no
+    # gathered full-context K/V view, no full S x max_seq score matrix
+    chunk_tmp = (_prefill_temp(dims, geom, chunk, chunked=True)
+                 if chunk else 0)
     tables = geom.tables_bytes(max_batch)
     # ---- speculative decoding (r16): draft weights + worst-case draft
     # pool are resident; the verify chunk and the draft's own programs
@@ -525,7 +580,8 @@ def estimate_engine_memory(dims: ModelDims, *,
             draft_dims.kv_heads, draft_dims.head_dim, pages_per_seq,
             geom.dtype)
         draft_pool = dgeom.pool_bytes()
-        verify_tmp = _prefill_temp(dims, geom, gamma + 1)
+        # the verify IS a chunk program — priced on the copy-free path
+        verify_tmp = _prefill_temp(dims, geom, gamma + 1, chunked=True)
         draft_tmp = max(_decode_temp(draft_dims, dgeom, 1),
                         _prefill_temp(draft_dims, dgeom, gamma + 1))
     # XLA program text + runtime allocations scale with model size; a
@@ -567,6 +623,75 @@ def estimate_engine_memory(dims: ModelDims, *,
         "host_tier": {"pages": int(host_tier_pages),
                       "bytes": int(host_tier),
                       "bytes_per_page": int(bytes_per_page)},
+    }
+
+
+def plan_fused_layers(dims: ModelDims, *, fused_layers: int,
+                      batch: int = 8, page_size: int = 64,
+                      io_dtype_bytes: int = 2,
+                      vmem_limit: int = 16 << 20) -> Dict[str, Any]:
+    """Price the N-layer fused decode kernel's VMEM working set (r17)
+    and say whether ``fused_layers`` fits the per-core VMEM budget.
+
+    Walks the exact tile/scratch shapes ``fused_multi_block_decode_pallas``
+    allocates: every block operand is double-buffered by Mosaic (weight
+    tiles, the per-layer page blocks — 2 per grouped layer, so the pool
+    term is the only one that grows with N), activations/carries are
+    persistent f32 VMEM scratch. ``io_dtype_bytes`` is the streamed
+    weight/activation storage width (2 = bf16 serving, 4 = f32).
+    Returns the transparent breakdown + a ``fits`` verdict against
+    ``vmem_limit`` — the ``tools/memwatch.py plan --fused-layers``
+    refusal reads it."""
+    from ..kernels.fused_block_decode import _LANES, _tile
+
+    n = int(fused_layers)
+    if n < 1:
+        raise ValueError(f"fused_layers must be >= 1, got {n}")
+    b_pad = -(-int(batch) // 8) * 8
+    d = dims.head_dim
+    rep = dims.heads // dims.kv_heads
+    rep_pad = -(-rep // 8) * 8
+    qw = dims.heads * d
+    kvw = dims.kv_dim
+    wq_cols = qw + 2 * kvw
+    hidden, inter = dims.hidden, dims.intermediate
+    tr_h, tr_o, tr_i = _tile(hidden, 512), _tile(qw, 512), _tile(inter, 512)
+    tc_qkv, tc_o = _tile(wq_cols, 256), _tile(hidden, 256)
+    tc_f, tc_d = _tile(inter, 256), _tile(hidden, 256)
+    tc_max = max(tc_qkv, tc_o, tc_f, tc_d)
+    io = int(io_dtype_bytes)
+
+    # double-buffered streamed blocks (weights + the small ln vectors)
+    weight_stream = 2 * io * (2 * hidden                  # ln1 + ln2
+                              + tr_h * tc_qkv             # wqkv tile
+                              + tr_o * tc_o               # wo tile
+                              + 2 * tr_h * tc_f           # wgu gate + up
+                              + tr_i * tc_d)              # wd tile
+    # const-mapped activations in/out (still double-buffered by Mosaic)
+    activation_io = 2 * io * (2 * b_pad * hidden          # x in, out
+                              + 2 * b_pad * d             # sin, cos
+                              + 2 * b_pad * kvw)          # k_new, v_new
+    # per-layer K/V page blocks: 2 operands per grouped layer — the
+    # ONLY term that scales with N
+    pool_blocks = 2 * io * (2 * n * page_size * d)
+    # persistent f32 scratch (activation carry + matmul/attn accs)
+    scratch = 4 * (3 * b_pad * hidden + b_pad * wq_cols + b_pad * qw
+                   + b_pad * inter + 2 * b_pad * tc_max
+                   + rep_pad * d + 2 * rep_pad * _LANES)
+    total = weight_stream + activation_io + pool_blocks + scratch
+    return {
+        "fused_layers": n, "batch": int(batch), "b_pad": b_pad,
+        "page_size": int(page_size), "io_dtype_bytes": io,
+        "breakdown": {
+            "weight_stream_buffers": weight_stream,
+            "activation_io_buffers": activation_io,
+            "kv_page_buffers": pool_blocks,
+            "scratch": scratch,
+        },
+        "total": int(total),
+        "vmem_limit": int(vmem_limit),
+        "fits": total <= int(vmem_limit),
+        "headroom_bytes": int(vmem_limit) - int(total),
     }
 
 

@@ -74,8 +74,53 @@ _SLOW_MODULES = {
     "test_unet", "test_dy2static",
 }
 
+# Individual heavy tests whose COVERAGE is redundant with a cheaper
+# sibling that stays in tier-1 (the r17 870s-budget fix: the fast lane
+# keeps one representative per family; these twins only run with the
+# full suite). Keyed (module, test name) so same-named tests in other
+# modules are untouched. Tier-1 representatives kept per family:
+#   zbh1 pipeline parity ... TestZBH1Parity::test_matches_serial_training
+#                            + TestZBH1Tied::test_tied_grads_route_cross_phase
+#   parse order-independence lint gates keep their zero-new-findings
+#                            + scale-sanity siblings
+#   vision forward ......... resnet18 (+ resnet_trains)
+#   bucket migration ....... test_migration_replay_parity_under_faults
+#   adaptive gamma ......... test_gamma_prices_out_as_occupancy_rises
+#   spec-decode greedy ..... fused_llama_path + lossless_under_real_rejections
+#   bert ................... TestBertModel::test_shapes_and_pooler
+#   beam search ............ test_beam_matches_brute_force
+#   memwatch capture ....... train_step/serving_programs_captured
+#   fault replay ........... DonationDiscipline injected-fault replays
+#   sharded train step ..... test_dp_matches_single_device
+#   prefix-aware scheduling  test_prefix_aware_bypass_of_page_blocked_head
+_SLOW_TWINS = {
+    ("test_zbh1", "test_dp2_mp2_pp2_matches_serial"),
+    ("test_zbh1", "test_pp2_mp2_matches_serial"),
+    ("test_zbh1", "test_tied_pp2_matches_serial"),
+    ("test_zbh1", "test_tied_pp2_dp2_matches_serial"),
+    ("test_zbh1", "test_tied_tp_pp2_mp2_matches_serial"),
+    ("test_zbh1", "test_vocab_embedding_and_pce_head"),
+    ("test_zbh1", "test_pp_dp_matches_serial"),
+    ("test_faultcheck", "test_shared_parse_order_independence"),
+    ("test_meshcheck", "test_shared_parse_order_independence"),
+    ("test_meshcheck", "test_combined_gate_single_parse_budget"),
+    ("test_vision", "test_mobilenetv2_forward"),
+    ("test_serving_scheduler", "test_migration_parity_vs_fixed_bucket"),
+    ("test_serving_scheduler", "test_cached_prefix_head_not_page_blocked"),
+    ("test_spec_decode", "test_rung_falls_on_disagreeing_draft"),
+    ("test_spec_decode", "test_eos_inside_burst_truncates"),
+    ("test_bert", "test_pretraining_overfits_tiny_batch"),
+    ("test_generation", "test_beam_beats_or_ties_greedy_logprob"),
+    ("test_generation", "test_beam_with_eos_matches_brute_force"),
+    ("test_memwatch", "test_two_models_do_not_collide"),
+    ("test_faults", "test_serving_drill_bit_identical_under_chaos"),
+    ("test_train_step", "test_dp_sharded_step"),
+}
+
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
         if item.module.__name__ in _SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+        elif (item.module.__name__, item.name) in _SLOW_TWINS:
             item.add_marker(pytest.mark.slow)

@@ -226,9 +226,12 @@ class TestEstimator:
         self._check(memwatch.estimate_prefill_program(dims, geom, 16, pb),
                     self._compiled("prefill"))
         # chunking ON over a long prompt: the fixed (1, 8) chunk program
+        # — priced on the r17 copy-free block-table path (no gathered
+        # K/V view term)
         eng2, _ = _llama_engine(prompt_lens=(20,), prefill_chunk=8)
         eng2.run()
-        self._check(memwatch.estimate_prefill_program(dims, geom, 8, pb),
+        self._check(memwatch.estimate_prefill_program(dims, geom, 8, pb,
+                                                      chunked=True),
                     self._compiled("prefill_chunk"))
 
     def test_planner_7b_arithmetic(self):
@@ -466,15 +469,16 @@ class TestRegressionGate:
         assert {f["verdict"] for f in findings} == {"new"}
 
     def test_banked_artifact_is_valid(self):
-        """The checked-in MEMWATCH_r13.json must stay loadable and
-        carry the capture suite's program rows."""
+        """The checked-in MEMWATCH_r17.json must stay loadable and
+        carry the capture suite's program rows (now incl. the r17
+        N-layer grouped decode program)."""
         path = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "MEMWATCH_r13.json")
+            os.path.abspath(__file__))), "MEMWATCH_r17.json")
         doc = json.load(open(path))
         assert doc["schema"] == 1 and doc["bench"] == "memwatch"
         kinds = {r["kind"] for r in doc["rows"]}
-        assert {"decode_fused", "decode_generic", "prefill",
-                "prefill_chunk", "train_step"} <= kinds
+        assert {"decode_fused", "decode_fused_nlayer", "decode_generic",
+                "prefill", "prefill_chunk", "train_step"} <= kinds
         for r in doc["rows"]:
             assert r["peak"] >= r["temp"] >= 0
         # banked estimator evidence stays inside the acceptance bar

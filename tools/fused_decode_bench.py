@@ -21,8 +21,17 @@ then wall-clock the drain loop (each step() host-syncs by pulling the
 argmax tokens). Test mode (CHIP_SPRINT_TEST=1): LlamaConfig.tiny() on
 CPU validates plumbing + schema.
 
+r17 adds the cross-layer N-sweep: the same A/B repeated at
+FLAGS_fused_block_layers=N for each N in FUSED_BENCH_NLAYERS (default
+"1,2,4" — N=1 is the per-layer fused kernel, N>1 the grouped
+one-pallas_call-per-N-layers program; on CPU both run their pure-jnp
+refs, so the sweep is an apples-to-apples program-structure A/B on any
+backend). The FINAL row carries ``nlayer_sweep`` ({N: step_ms}) and
+``nlayer_ok`` (best grouped step <= per-layer step AND zero retraces at
+every rung) — banked as FUSED_DECODE_BENCH_r17.json.
+
 Env knobs: FUSED_BENCH_MODEL (llama_tiny|llama2_7b), BENCH_DECODE_TOKENS,
-BENCH_DECODE_BATCH, BENCH_PROMPT_LEN.
+BENCH_DECODE_BATCH, BENCH_PROMPT_LEN, FUSED_BENCH_NLAYERS.
 """
 import json
 import os
@@ -59,11 +68,22 @@ def main() -> int:
                  or not is_tpu_backend())
     name = os.environ.get("FUSED_BENCH_MODEL",
                           "llama_tiny" if test_mode else "llama2_7b")
-    cfg = (LlamaConfig.tiny() if name == "llama_tiny"
-           else LlamaConfig.llama2_7b())
+    if name == "llama_tiny":
+        cfg = LlamaConfig.tiny()
+    elif name == "llama_small":
+        # CPU A/B workhorse for the N-sweep: ~30x the matmul work of
+        # tiny per step, so the grouped-vs-per-layer program delta rises
+        # above the engine's fixed host overhead; 4 layers lets N=4 form
+        # a single full group
+        cfg = LlamaConfig(vocab_size=1024, hidden_size=256,
+                          num_hidden_layers=4, num_attention_heads=8,
+                          num_key_value_heads=4, intermediate_size=512,
+                          max_position_embeddings=256)
+    else:
+        cfg = LlamaConfig.llama2_7b()
     batch = int(os.environ.get("BENCH_DECODE_BATCH", "4"))
     steps = int(os.environ.get("BENCH_DECODE_TOKENS",
-                               "16" if name == "llama_tiny" else "64"))
+                               "16" if name.startswith("llama_t") else "64"))
     prompt_len = int(os.environ.get("BENCH_PROMPT_LEN",
                                     "24" if name == "llama_tiny" else "128"))
     page = 8 if name == "llama_tiny" else 64
@@ -85,8 +105,9 @@ def main() -> int:
     prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,))
                .astype(np.int32) for _ in range(batch)]
 
-    def run(fused: bool) -> dict:
-        flags.set_flags({"fused_block_decode": fused})
+    def run(fused: bool, nlayers: int = 1) -> dict:
+        flags.set_flags({"fused_block_decode": fused,
+                         "fused_block_layers": nlayers})
         eng = ServingEngine(model, max_batch=batch, page_size=page,
                             max_seq_len=max_seq)
         for p in prompts:
@@ -110,17 +131,47 @@ def main() -> int:
                 "traces": traces,
                 "retraces_during_run": traces - traces_before}
 
-    prior = flags.get_flag("fused_block_decode")
+    sweep_ns = [int(s) for s in os.environ.get(
+        "FUSED_BENCH_NLAYERS", "1,2,4").split(",") if s.strip()]
+    prior = {"fused_block_decode": flags.get_flag("fused_block_decode"),
+             "fused_block_layers": flags.get_flag("fused_block_layers")}
+    sweep = {}
     try:
         fused = run(True)
         unfused = run(False)
+        # r17 cross-layer sweep: N=1 is the per-layer fused program
+        # (== `fused` modulo timing noise but re-measured so every rung
+        # shares one warm process), N>1 the grouped program. Each rung
+        # keeps its best-of-k step time, and the k repeats are
+        # round-robin-interleaved across rungs — host noise is temporally
+        # correlated, so sequential per-rung blocks bias whole rungs
+        repeats = int(os.environ.get("FUSED_BENCH_REPEATS", "3"))
+        runs_by_n = {n: [] for n in sweep_ns}
+        for _ in range(max(repeats, 1)):
+            for n in sweep_ns:
+                runs_by_n[n].append(run(True, nlayers=n))
+        for n in sweep_ns:
+            runs = runs_by_n[n]
+            best = min(runs, key=lambda r: r["step_ms"])
+            best["retraces_during_run"] = max(
+                r["retraces_during_run"] for r in runs)
+            sweep[n] = best
+            emit({"phase": f"nlayer_{n}", "repeats": len(runs), **best})
     finally:
-        flags.set_flags({"fused_block_decode": prior})
+        flags.set_flags(prior)
     emit({"phase": "fused", **fused})
     emit({"phase": "unfused", **unfused})
 
     speedup = (round(unfused["step_ms"] / fused["step_ms"], 3)
                if fused["step_ms"] else None)
+    per_layer_ms = sweep.get(1, fused)["step_ms"]
+    grouped = {n: r for n, r in sweep.items() if n > 1}
+    best_n = (min(grouped, key=lambda n: grouped[n]["step_ms"])
+              if grouped else None)
+    nlayer_ok = bool(
+        grouped
+        and grouped[best_n]["step_ms"] <= per_layer_ms
+        and all(r["retraces_during_run"] == 0 for r in sweep.values()))
     # the banked row carries its own retrace/cache/latency evidence
     # (tools/telemetry_dump.py renders it back)
     from paddle_tpu import observability as obs
@@ -140,6 +191,14 @@ def main() -> int:
         "decode_tokens": steps,
         "model": name,
         "fused_kind": fused["kind"],
+        "nlayer_sweep": {str(n): r["step_ms"] for n, r in sweep.items()},
+        "nlayer_kinds": {str(n): r["kind"] for n, r in sweep.items()},
+        "nlayer_best": best_n,
+        "nlayer_vs_per_layer": (round(per_layer_ms
+                                      / grouped[best_n]["step_ms"], 3)
+                                if grouped and grouped[best_n]["step_ms"]
+                                else None),
+        "nlayer_ok": nlayer_ok,
         "zero_retrace": fused["retraces_during_run"] == 0
         and unfused["retraces_during_run"] == 0,
         "bench_schema": BENCH_SCHEMA,

@@ -20,13 +20,13 @@ accounting code path with the live capture, the benches, and
   this backend and bank every program's CompiledMemoryStats rows plus
   the estimator's predictions::
 
-      python tools/memwatch.py bank --out MEMWATCH_r13.json
+      python tools/memwatch.py bank --out MEMWATCH_r17.json
 
   **check** — re-run the same capture suite and flag any program whose
   temp/peak grew beyond tolerance vs the banked artifact (the memory
   analogue of the zero-retrace gate; exit code 1 on growth)::
 
-      python tools/memwatch.py check --artifact MEMWATCH_r13.json
+      python tools/memwatch.py check --artifact MEMWATCH_r17.json
 
   **view** — render a banked artifact (or any bench row with a
   ``"memory"`` section) as a table.
@@ -130,9 +130,38 @@ def cmd_plan(args) -> int:
     print(f"  max usable page budget at this HBM: {lo} pages "
           f"({toks} KV tokens, ~{toks // max(args.max_seq, 1)} full-length "
           f"sequences)")
+    # ---- r17: N-layer fused decode kernel VMEM pricing. HBM fit says
+    # nothing about whether the grouped kernel's working set (weight
+    # double-buffers, per-layer page blocks, activation scratch) fits
+    # per-core VMEM — an unfittable N is REFUSED here, before anyone
+    # ships FLAGS_fused_block_layers=N to a chip.
+    vplan = None
+    if args.fused_layers > 1:
+        io = 4 if args.weight_dtype == "float32" else 2
+        vplan = memwatch.plan_fused_layers(
+            dims, fused_layers=args.fused_layers, batch=args.rung,
+            page_size=args.page_size, io_dtype_bytes=io,
+            vmem_limit=int(args.vmem_mb * (1 << 20)))
+        print(f"# fused decode VMEM: N={args.fused_layers} "
+              f"rung={args.rung} io={io}B")
+        for k, v in vplan["breakdown"].items():
+            print(f"  {k:32s} {v:10d} B")
+        print(f"  {'TOTAL (per-core VMEM)':32s} {vplan['total']:10d} B")
+        print(f"  {'VMEM limit':32s} {vplan['vmem_limit']:10d} B")
+        if not vplan["fits"]:
+            print(f"  -> REFUSED: --fused-layers {args.fused_layers} "
+                  f"does not fit {args.vmem_mb:g} MiB VMEM "
+                  f"(over by {-vplan['headroom_bytes']} B); "
+                  f"lower N or the decode rung")
+        else:
+            print(f"  -> VMEM FITS (headroom "
+                  f"{vplan['headroom_bytes']} B)")
     if args.json:
         print(json.dumps({"plan": plan, "verdict": verdict,
-                          "max_page_budget": lo}))
+                          "max_page_budget": lo,
+                          **({"fused_vmem": vplan} if vplan else {})}))
+    if vplan is not None and not vplan["fits"]:
+        return 1
     return 0 if verdict["fits"] else 1
 
 
@@ -174,6 +203,22 @@ def capture_suite() -> dict:
                        .astype(np.int32), 4)
         eng.run()
         estimates += _engine_estimates(eng, lcfg, chunk=8)
+        # --- tiny Llama again, N-layer grouped decode (r17): banks the
+        # decode_fused_nlayer rows so the gate watches the grouped
+        # program's sections too
+        paddle.seed(13)
+        nprior = flags.snapshot(("fused_block_layers",)).as_tuple()
+        flags.set_flags({"fused_block_layers": 2})
+        try:
+            nmodel = LlamaForCausalLM(lcfg)
+            eng = ServingEngine(nmodel, max_batch=2, page_size=8,
+                                max_seq_len=48)
+            eng.submit(rng.integers(0, lcfg.vocab_size, (6,))
+                       .astype(np.int32), 4)
+            eng.run()
+            estimates += _engine_estimates(eng, lcfg, fused_layers=2)
+        finally:
+            flags.set_flags(dict(nprior))
         # --- tiny GPT: generic decode path
         paddle.seed(13)
         gcfg = GPTConfig.tiny()
@@ -196,7 +241,7 @@ def capture_suite() -> dict:
             "watermarks": memwatch.sample_device_memory(publish=False)}
 
 
-def _engine_estimates(eng, cfg, chunk=None):
+def _engine_estimates(eng, cfg, chunk=None, fused_layers=1):
     """Estimator predictions for the engine's captured programs, with
     the compiled row alongside — the banked evidence that the analytic
     model tracks XLA's accounting."""
@@ -214,10 +259,14 @@ def _engine_estimates(eng, cfg, chunk=None):
     rows = {(r["kind"], r["bucket"], r["extra"]): r
             for r in memwatch.program_table() if r["model"] == sig}
     for (kind, bucket, extra), row in sorted(rows.items()):
-        if kind.startswith("decode"):
+        if kind == "decode_fused_nlayer":
+            est = memwatch.estimate_decode_program(
+                dims, geom, bucket, pb, fused_layers=fused_layers)
+        elif kind.startswith("decode"):
             est = memwatch.estimate_decode_program(dims, geom, bucket, pb)
         elif kind == "prefill_chunk" and chunk:
-            est = memwatch.estimate_prefill_program(dims, geom, chunk, pb)
+            est = memwatch.estimate_prefill_program(dims, geom, chunk, pb,
+                                                    chunked=True)
         elif kind == "prefill":
             # the captured prefill row is the LAST prompt length traced;
             # skip rows we cannot reconstruct the length for
@@ -353,6 +402,13 @@ def main() -> int:
     p.add_argument("--draft-weight-dtype", default=None,
                    choices=("float32", "bfloat16", "int8", "int4"),
                    help="draft storage dtype (default: --weight-dtype)")
+    p.add_argument("--fused-layers", type=int, default=1,
+                   help="price the N-layer fused decode kernel's VMEM "
+                        "working set (FLAGS_fused_block_layers=N); an "
+                        "N that does not fit --vmem-mb is refused "
+                        "(exit 1)")
+    p.add_argument("--vmem-mb", type=float, default=16.0,
+                   help="per-core VMEM budget for --fused-layers")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_plan)
 
@@ -361,7 +417,7 @@ def main() -> int:
     p.set_defaults(fn=cmd_bank)
 
     p = sub.add_parser("check", help="regression gate vs banked artifact")
-    p.add_argument("--artifact", default="MEMWATCH_r13.json")
+    p.add_argument("--artifact", default="MEMWATCH_r17.json")
     p.add_argument("--tol", type=float, default=0.10)
     p.set_defaults(fn=cmd_check)
 
