@@ -449,7 +449,9 @@ def apply_op(name: str, fn: Callable, *args, **kwargs) -> Any:
     outs = list(out) if multi else [out]
     wrapped = []
     for i, o in enumerate(outs):
-        if o is None or not hasattr(o, "dtype"):
+        # tuples pass through un-wrapped even when they expose a .dtype
+        # (QuantizedPages rides ops as an array-of-arrays NamedTuple)
+        if o is None or isinstance(o, tuple) or not hasattr(o, "dtype"):
             wrapped.append(o)
             continue
         t = Tensor(o, stop_gradient=(node is None), name=f"{name}_out")

@@ -436,6 +436,24 @@ define_flag("serving_spec_sync_chunk", 64,
             "chunked-prefill program in fixed (1, C) chunks — one "
             "cached program, any gap length. Eager-only; the width "
             "reaches the program via the cache key.")
+define_flag("serving_kv_dtype", "native",
+            "KV pool storage dtype for ServingEngine pools: 'native' "
+            "stores K/V at the compute dtype, 'int8' stores per-page "
+            "int8 payload with per-token f32 amax scales alongside "
+            "(≈2x the page budget at fixed memory). Dequantization is "
+            "fused into every consuming kernel — the bf16 pool view "
+            "is never materialized in HBM. Eager-only: the dtype "
+            "reaches compiled programs through the program-cache key "
+            "(DecodeKey.extra), never through a traced flag read.")
+define_flag("fused_weight_dtype", "native",
+            "Stacked-weight storage dtype for the fused N-layer "
+            "decode kernel: 'native' keeps the r17 layout, 'int4' "
+            "packs the merged q|k|v / gate|up / o / down matmuls two "
+            "nibbles per byte with per-tile f32 scales, unpacked "
+            "MXU-friendly inside the kernel's VMEM stream (2x weight "
+            "memory headroom on top of int8 streaming). LayerNorm "
+            "params stay native. Eager-only; part of program "
+            "identity via DecodeKey.extra.")
 define_flag("train_max_retries", 2,
             "Model.fit step-recovery budget: retries of a failed "
             "dispatch (sync to last-good state, emergency checkpoint, "

@@ -732,7 +732,9 @@ class ServingEngine:
                  prefill_chunk: Optional[int] = None,
                  replica: str = "0",
                  host_tier_pages: Optional[int] = None,
-                 draft_model=None):
+                 draft_model=None,
+                 kv_dtype: Optional[str] = None,
+                 weight_dtype: Optional[str] = None):
         from .. import flags as _flags
         from ..jit import ensure_live
 
@@ -788,13 +790,26 @@ class ServingEngine:
         ensure_live(params, "call step.sync_to_model() first.")
         self._params, self._buffers = params, buffers
         dtype = jnp.result_type(next(iter(params.values())))
+        # ---- quantized serving (r18): KV pool storage dtype and the
+        # fused N-layer stacked-weight dtype are engine identity — both
+        # reach compiled programs only through DecodeKey.extra
+        self.kv_dtype = str(_flags.get_flag("serving_kv_dtype")
+                            if kv_dtype is None else kv_dtype)
+        if self.kv_dtype not in ("native", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'native' or 'int8', got {self.kv_dtype!r}")
+        self.weight_dtype = str(_flags.get_flag("fused_weight_dtype")
+                                if weight_dtype is None else weight_dtype)
+        if self.weight_dtype not in ("native", "int4"):
+            raise ValueError(f"weight_dtype must be 'native' or 'int4', "
+                             f"got {self.weight_dtype!r}")
         # pool geometry is kept so replay recovery can allocate FRESH
         # pools with the identical shape (same compiled programs apply)
         self._pool_geom = dict(
             num_layers=len(spec), num_pages=num_pages, page_size=page_size,
             num_kv_heads=spec[0][0], head_dim=spec[0][1],
             max_batch=max_batch, max_seq_len=max_seq_len, dtype=dtype,
-            reserve_null_page=True)
+            reserve_null_page=True, kv_dtype=self.kv_dtype)
         self.pool = PagedKVCache(**self._pool_geom)
         maxpos = getattr(getattr(model, "config", None),
                          "max_position_embeddings", None)
@@ -851,7 +866,7 @@ class ServingEngine:
                 num_kv_heads=dspec[0][0], head_dim=dspec[0][1],
                 max_batch=max_batch, max_seq_len=max_seq_len,
                 dtype=jnp.result_type(next(iter(dparams.values()))),
-                reserve_null_page=True)
+                reserve_null_page=True, kv_dtype=self.kv_dtype)
             self._draft_pool = PagedKVCache(**self._draft_geom)
             raw = str(_flags.get_flag("serving_spec_rungs"))
             srungs = sorted({int(r) for r in raw.replace(";", ",").split(",")
@@ -1149,6 +1164,13 @@ class ServingEngine:
     def _key(self, kind: str, bucket: Optional[int] = None,
              extra: Tuple = ()):
         from .program_cache import DecodeKey
+        # the kv/weight storage dtypes are program identity (r18): a
+        # dtype flip must never re-serve a stale cached program, so the
+        # discriminant rides every key's extra (the pool dtype string
+        # below also flips to "int8" for quantized pools, but the extra
+        # covers the weight dtype and keys built before pools exist)
+        extra = tuple(extra) + (("kv", self.kv_dtype),
+                                ("wt", self.weight_dtype))
         return DecodeKey(
             kind=kind, model_sig=self._model_sig,
             batch_bucket=self.max_batch if bucket is None else bucket,
@@ -1232,7 +1254,7 @@ class ServingEngine:
                     BlockDecodeWeights(
                         **{f: allp[n]
                            for f, n in spec["layers"][i].items()})
-                    for i in group])
+                    for i in group], weight_dtype=self.weight_dtype)
                 for group in spec["layer_groups"])
         return self._stacked
 
@@ -2310,7 +2332,9 @@ class ServingEngine:
                 page_budget=(pool.num_pages, pool.page_size,
                              pool.max_pages_per_seq),
                 dtype=str(pool.k_pages[0].dtype),
-                flags=self._flags.as_tuple(), extra=tuple(extra))
+                flags=self._flags.as_tuple(),
+                extra=tuple(extra) + (("kv", self.kv_dtype),
+                                      ("wt", self.weight_dtype)))
             fn = decode_program_cache().get(key, builder)
             self._spec_fns[memo] = fn
             self._spec_keys[memo] = key

@@ -66,16 +66,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .paged_attention import paged_attention_xla, write_paged_kv
+from .paged_attention import (QuantizedPages, paged_attention_xla,
+                              write_paged_kv)
 
 _NEG_INF = -1e30
 _LANES = 128
 
-__all__ = ["BlockDecodeWeights", "MultiBlockDecodeWeights",
+__all__ = ["BlockDecodeWeights", "Int4Tiles", "MultiBlockDecodeWeights",
            "fused_block_decode", "fused_block_decode_pallas",
            "fused_block_decode_ref", "fused_multi_block_decode",
            "fused_multi_block_decode_pallas", "fused_multi_block_decode_ref",
-           "stack_block_weights"]
+           "pack_int4_tiles", "stack_block_weights", "unpack_int4_tiles"]
 
 
 class BlockDecodeWeights(NamedTuple):
@@ -176,15 +177,115 @@ def _f32_dot(a, b):
                                preferred_element_type=jnp.float32)
 
 
+def _fake_quant_rows(u):
+    """In-VMEM int8 fake-quantize of the new token's k/v fold (per-row
+    amax, the same math as ``quantize_kv_rows``): the ref path WRITES
+    the quantized token then attends, so the kernel's fold must attend
+    to exactly the value a pool re-read would dequantize to."""
+    amax = jnp.max(jnp.abs(u), axis=1, keepdims=True)
+    sc = amax / 127.0
+    safe = jnp.where(sc > 0, sc, 1.0)
+    return jnp.clip(jnp.round(u / safe), -127.0, 127.0) * sc
+
+
+# ------------------------------------------------------ int4 weight tiles
+class Int4Tiles(NamedTuple):
+    """A stacked weight matrix packed two int4 values per byte with
+    per-tile f32 amax scales. Packing is ROW-paired within each
+    (tr, tc) tile: payload row ``r*tr/2 + i`` of row-band ``r`` holds
+    tile rows ``i`` (low nibble) and ``i + tr/2`` (high nibble), so a
+    kernel block of ``(1, tr/2, tc)`` packed rows unpacks to exactly one
+    ``(tr, tc)`` weight tile by a sublane concat — MXU-friendly, no
+    cross-block shuffles. A NamedTuple (= pytree) so it rides jit as one
+    argument like the bf16 stacks; tiling is DERIVED from the q/scale
+    shapes (never stored — stored ints would become traced pytree
+    leaves). ``shape`` reports the logical unpacked (n, R, C)."""
+    q: Any      # uint8 (n, R/2, C)
+    scale: Any  # f32   (n, R/tr, C/tc)
+
+    @property
+    def shape(self):
+        return (self.q.shape[0], 2 * self.q.shape[1], self.q.shape[2])
+
+
+def pack_int4_tiles(w, tr: int, tc: int) -> Int4Tiles:
+    """Quantize ``w`` (n, R, C) to symmetric int4 ([-7, 7]) with one
+    amax scale per (tr, tc) tile, nibble-packing each tile's row halves
+    (see :class:`Int4Tiles` for the layout)."""
+    n, rows, cols = w.shape
+    if tr % 2 or rows % tr or cols % tc:
+        raise ValueError(f"int4 tile ({tr}, {tc}) must be even-rowed and "
+                         f"divide ({rows}, {cols})")
+    nr, nc = rows // tr, cols // tc
+    t = w.astype(jnp.float32).reshape(n, nr, tr, nc, tc)
+    amax = jnp.max(jnp.abs(t), axis=(2, 4), keepdims=True)
+    scale = amax / 7.0
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(t / safe), -7, 7).astype(jnp.int8)
+    lo, hi = q[:, :, :tr // 2], q[:, :, tr // 2:]
+    packed = ((lo & 0xF).astype(jnp.uint8)
+              | ((hi & 0xF).astype(jnp.uint8) << 4))
+    return Int4Tiles(packed.reshape(n, rows // 2, cols),
+                     scale.reshape(n, nr, nc))
+
+
+def unpack_int4_tiles(t: Int4Tiles):
+    """Dequantize back to f32 (n, R, C) — the pure-jnp reference the
+    in-kernel unpack is exactness-tested against, and the up-front
+    dequant the N-layer REF path runs (elementwise identical to the
+    kernel's tile-wise dequant, so ref/kernel parity is unaffected)."""
+    q, scale = t.q, t.scale
+    n, half_rows, cols = q.shape
+    nr, nc = scale.shape[1], scale.shape[2]
+    tr2, tc = half_rows // nr, cols // nc
+    p = q.reshape(n, nr, tr2, nc, tc).astype(jnp.int32)
+    lo = p & 0xF
+    hi = (p >> 4) & 0xF
+    lo = jnp.where(lo < 8, lo, lo - 16)
+    hi = jnp.where(hi < 8, hi, hi - 16)
+    full = jnp.concatenate([lo, hi], axis=2).astype(jnp.float32)
+    full = full * scale[:, :, None, :, None]
+    return full.reshape(n, 2 * half_rows, cols)
+
+
+def _int4_plan(hidden: int, qw: int, kvw: int, inter: int) -> dict:
+    """The (tr, tc) tile per stacked matrix — the SAME ``_tile`` calls
+    :func:`fused_multi_block_decode_pallas` makes, shared so pack time
+    and kernel time can never disagree on tiling."""
+    plan = {
+        "wqkv": (_tile(hidden, 512), _tile(qw + 2 * kvw, 256)),
+        "wo": (_tile(qw, 512), _tile(hidden, 256)),
+        # wgu packs as ONE (n, H, 2I) matrix tiled tc_f: tc_f divides I,
+        # so no tile straddles the gate|up column boundary and the
+        # kernel's two col-offset views stay tile-aligned
+        "wgu": (_tile(hidden, 512), _tile(inter, 256)),
+        "wd": (_tile(inter, 512), _tile(hidden, 256)),
+    }
+    for name, (tr, _tc) in plan.items():
+        if tr % 2:
+            raise ValueError(f"int4 weights need an even contraction "
+                             f"tile; {name} got tr={tr}")
+    return plan
+
+
 def _fused_block_kernel(
         bt_ref, sl_ref,                                   # scalar prefetch
         x_ref, ln1_ref, ln2_ref, wq_ref, wk_ref, wv_ref, sin_ref, cos_ref,
-        wo_ref, wg_ref, wu_ref, wd_ref, kp_ref, vp_ref,   # inputs
-        out_ref, knew_ref, vnew_ref,                      # outputs
-        h_ref, qs_ref, ks_ref, vs_ref, ao_ref, x2_ref, fs_ref,
-        acc_a, acc_b, am_ref, mm_ref, ll_ref,             # scratch
-        *, dims: dict):
+        wo_ref, wg_ref, wu_ref, wd_ref, *rest,            # pools/outs/scratch
+        dims: dict):
     D = dims
+    # quantized pools ride as (payload, payload, scale, scale) operands;
+    # everything after is (out, knew, vnew) then the 12 scratch refs
+    if D["kv_quant"]:
+        kp_ref, vp_ref, kps_ref, vps_ref = rest[:4]
+        rest = rest[4:]
+    else:
+        kp_ref, vp_ref = rest[:2]
+        kps_ref = vps_ref = None
+        rest = rest[2:]
+    out_ref, knew_ref, vnew_ref = rest[:3]
+    (h_ref, qs_ref, ks_ref, vs_ref, ao_ref, x2_ref, fs_ref,
+     acc_a, acc_b, am_ref, mm_ref, ll_ref) = rest[3:]
     nh, nkv, d, rep = D["nh"], D["nkv"], D["d"], D["rep"]
     page, mp = D["page"], D["mp"]
     eps, scale = D["eps"], D["scale"]
@@ -293,6 +394,9 @@ def _fused_block_kernel(
         q = q.reshape(rep, d)
         k = kp_ref[0, 0].astype(jnp.float32)           # (page, d)
         v = vp_ref[0, 0].astype(jnp.float32)
+        if D["kv_quant"]:
+            k = k * kps_ref[0, 0]                      # (page, d)*(page, 1)
+            v = v * vps_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         pos = j * page + jax.lax.broadcasted_iota(jnp.int32, (rep, page), 1)
@@ -305,6 +409,14 @@ def _fused_block_kernel(
         def _attn_new_token():
             kn = ks_ref[pl.ds(b_i, 1), pl.ds(h_i * d, d)]   # (1, d)
             vn = vs_ref[pl.ds(b_i, 1), pl.ds(h_i * d, d)]
+            if D["kv_quant"]:
+                # match the post-kernel quantized pool write: round-trip
+                # through the emit dtype (what write_paged_kv will see),
+                # then fake-quantize to the value a re-read dequantizes to
+                kn = _fake_quant_rows(
+                    kn.astype(knew_ref.dtype).astype(jnp.float32))
+                vn = _fake_quant_rows(
+                    vn.astype(vnew_ref.dtype).astype(jnp.float32))
             s_new = jax.lax.dot_general(
                 q, kn, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale  # (rep, 1)
@@ -447,13 +559,15 @@ def fused_block_decode_pallas(x, weights: BlockDecodeWeights, k_pages,
     off_d = off_f + steps_f
     total = off_d + steps_d
 
+    kv_quant = isinstance(k_pages, QuantizedPages)
     dims = dict(nh=nh, nkv=nkv, d=d, rep=rep, page=page, mp=mp,
                 eps=float(epsilon), scale=float(sm_scale),
                 tr_h=tr_h, tr_o=tr_o, tr_i=tr_i, tc_q=tc_q, tc_kv=tc_kv,
                 tc_o=tc_o, tc_f=tc_f, tc_d=tc_d, nr_h=nr_h, nr_o=nr_o,
                 nr_i=nr_i, steps_a=steps_a, steps_f=steps_f,
                 off_q=off_q, off_k=off_k, off_v=off_v, off_r=off_r,
-                off_a=off_a, off_o=off_o, off_f=off_f, off_d=off_d)
+                off_a=off_a, off_o=off_o, off_f=off_f, off_d=off_d,
+                kv_quant=kv_quant)
 
     def _const(*_args):
         return (0, 0)
@@ -497,9 +611,13 @@ def fused_block_decode_pallas(x, weights: BlockDecodeWeights, k_pages,
                          _phase_map(off_f, steps_f, nr_h)),         # wu
             pl.BlockSpec((tr_i, tc_d),
                          _phase_map(off_d, steps_d, nr_i)),         # wd
+        ] + [
             pl.BlockSpec((1, 1, page, d), _kp_map),                 # k_pages
             pl.BlockSpec((1, 1, page, d), _kp_map),                 # v_pages
-        ],
+        ] + ([
+            pl.BlockSpec((1, 1, page, 1), _kp_map),                 # k scale
+            pl.BlockSpec((1, 1, page, 1), _kp_map),                 # v scale
+        ] if kv_quant else []),
         out_specs=[
             pl.BlockSpec((b_pad, tc_d), _out_map),                  # out
             pl.BlockSpec((b_pad, nkv * d), _const),                 # k_new
@@ -521,6 +639,8 @@ def fused_block_decode_pallas(x, weights: BlockDecodeWeights, k_pages,
         ],
     )
 
+    pool_ops = ([k_pages.q, v_pages.q, k_pages.scale, v_pages.scale]
+                if kv_quant else [k_pages, v_pages])
     out, k_new, v_new = pl.pallas_call(
         functools.partial(_fused_block_kernel, dims=dims),
         grid_spec=grid_spec,
@@ -533,7 +653,7 @@ def fused_block_decode_pallas(x, weights: BlockDecodeWeights, k_pages,
     )(bt_p, sl_p, x_p, weights.ln1.reshape(1, hidden),
       weights.ln2.reshape(1, hidden), weights.wq, weights.wk, weights.wv,
       sin, cos, weights.wo, weights.wg, weights.wu, weights.wd,
-      k_pages, v_pages)
+      *pool_ops)
 
     k_pages, v_pages = write_paged_kv(
         k_pages, v_pages, k_new[:b].reshape(b, nkv, d),
@@ -579,13 +699,18 @@ class MultiBlockDecodeWeights(NamedTuple):
         return int(self.ln1.shape[0])
 
 
-def stack_block_weights(layers) -> MultiBlockDecodeWeights:
+def stack_block_weights(layers,
+                        weight_dtype: str = "native"
+                        ) -> MultiBlockDecodeWeights:
     """Stack per-layer :class:`BlockDecodeWeights` into one
     :class:`MultiBlockDecodeWeights` group (merging q|k|v and gate|up on
     the output axis). One-time cost: a device copy of the group's layer
-    weights."""
+    weights. ``weight_dtype="int4"`` packs the four stacked matmul
+    weights as :class:`Int4Tiles` (per-tile amax scales on the kernel's
+    own ``_int4_plan`` tiling — halving the group's weight-stream
+    traffic); the rms-norm vectors stay native."""
     ws = list(layers)
-    return MultiBlockDecodeWeights(
+    out = MultiBlockDecodeWeights(
         ln1=jnp.stack([w.ln1 for w in ws]),
         wqkv=jnp.stack([jnp.concatenate([w.wq, w.wk, w.wv], axis=1)
                         for w in ws]),
@@ -594,6 +719,23 @@ def stack_block_weights(layers) -> MultiBlockDecodeWeights:
         wgu=jnp.stack([jnp.concatenate([w.wg, w.wu], axis=1)
                        for w in ws]),
         wd=jnp.stack([w.wd for w in ws]))
+    if weight_dtype == "native":
+        return out
+    if weight_dtype != "int4":
+        raise ValueError(f"weight_dtype must be 'native' or 'int4', "
+                         f"got {weight_dtype!r}")
+    hidden = out.ln1.shape[1]
+    qw = out.wo.shape[1]
+    kvw = (out.wqkv.shape[2] - qw) // 2
+    inter = out.wd.shape[1]
+    plan = _int4_plan(hidden, qw, kvw, inter)
+    return MultiBlockDecodeWeights(
+        ln1=out.ln1,
+        wqkv=pack_int4_tiles(out.wqkv, *plan["wqkv"]),
+        wo=pack_int4_tiles(out.wo, *plan["wo"]),
+        ln2=out.ln2,
+        wgu=pack_int4_tiles(out.wgu, *plan["wgu"]),
+        wd=pack_int4_tiles(out.wd, *plan["wd"]))
 
 
 def fused_multi_block_decode_ref(x, weights: MultiBlockDecodeWeights,
@@ -622,34 +764,76 @@ def fused_multi_block_decode_ref(x, weights: MultiBlockDecodeWeights,
     sl = jnp.asarray(seq_lens, jnp.int32)
     sin, cos = _rope_tables(sl, d, rope_theta)
 
+    # int4 groups dequantize up front: unpack is elementwise, so the
+    # whole-matrix dequant here equals the kernel's tile-wise dequant
+    # value-for-value (the parity contract)
+    w_qkv, w_o, w_gu, w_d = (
+        unpack_int4_tiles(m) if isinstance(m, Int4Tiles) else m
+        for m in (weights.wqkv, weights.wo, weights.wgu, weights.wd))
+
     kps, vps = list(k_pages), list(v_pages)
     for i in range(n):
         h = _rms(x, weights.ln1[i], epsilon)
-        qkv = h @ weights.wqkv[i]
+        qkv = h @ w_qkv[i]
         q = _rope_heads(qkv[:, :qw].reshape(b, num_heads, d), sin, cos)
         k = _rope_heads(qkv[:, qw:qw + kvw].reshape(b, num_kv_heads, d),
                         sin, cos)
         v = qkv[:, qw + kvw:].reshape(b, num_kv_heads, d)
         kps[i], vps[i] = write_paged_kv(kps[i], vps[i], k, v, bt, sl)
         attn = paged_attention_xla(q, kps[i], vps[i], bt, sl + 1, sm_scale)
-        x2 = x + attn.reshape(b, qw) @ weights.wo[i]
+        x2 = x + attn.reshape(b, qw) @ w_o[i]
         h2 = _rms(x2, weights.ln2[i], epsilon)
-        gu = h2 @ weights.wgu[i]
+        gu = h2 @ w_gu[i]
         f = jax.nn.silu(gu[:, :inter]) * gu[:, inter:]
-        x = x2 + f @ weights.wd[i]
+        x = x2 + f @ w_d[i]
     return x, kps, vps
 
 
 def _fused_multi_block_kernel(bt_ref, sl_ref,                 # scalar prefetch
-                              x_ref, ln1_ref, ln2_ref, wqkv_ref,
-                              sin_ref, cos_ref, wo_ref, wg_ref, wu_ref,
-                              wd_ref, *rest, dims: dict):
+                              *ops, dims: dict):
     D = dims
     n_layers = D["n_layers"]
-    pool_refs = rest[:2 * n_layers]
-    out_ref, knew_ref, vnew_ref = rest[2 * n_layers:2 * n_layers + 3]
+    wt = D["wt_quant"]
+    # operand order (int4 weights interleave a per-tile scale ref right
+    # after their packed payload; quantized pools ride 4 refs per layer
+    # instead of 2): x, ln1, ln2, wqkv[, sc], sin, cos, wo[, sc],
+    # wg[, sc], wu[, sc], wd[, sc], pools..., outs..., scratch...
+    it = iter(ops)
+    x_ref, ln1_ref, ln2_ref = next(it), next(it), next(it)
+    wqkv_ref = next(it)
+    wqkv_sc = next(it) if wt else None
+    sin_ref, cos_ref = next(it), next(it)
+    wo_ref = next(it)
+    wo_sc = next(it) if wt else None
+    wg_ref = next(it)
+    wg_sc = next(it) if wt else None
+    wu_ref = next(it)
+    wu_sc = next(it) if wt else None
+    wd_ref = next(it)
+    wd_sc = next(it) if wt else None
+    rest = list(it)
+    stride = 4 if D["kv_quant"] else 2
+    pool_refs = rest[:stride * n_layers]
+    out_ref, knew_ref, vnew_ref = \
+        rest[stride * n_layers:stride * n_layers + 3]
     (xc_ref, h_ref, qkv_ref, ao_ref, x2_ref, fs_ref,
-     acc_a, acc_b, am_ref, mm_ref, ll_ref) = rest[2 * n_layers + 3:]
+     acc_a, acc_b, am_ref, mm_ref, ll_ref) = rest[stride * n_layers + 3:]
+
+    def _load(w_ref, w_sc):
+        # packed int4 blocks carry HALF the weight tile's rows; the
+        # sublane concat of the two nibble planes rebuilds the (tr, tc)
+        # tile in VMEM, scaled by its one per-tile f32 scale — the MXU
+        # sees a plain f32 operand, HBM only ever saw 4 bits/weight
+        w = w_ref[0]
+        if w_sc is None:
+            return w
+        p = w.astype(jnp.int32)
+        lo = p & 0xF
+        hi = (p >> 4) & 0xF
+        lo = jnp.where(lo < 8, lo, lo - 16)
+        hi = jnp.where(hi < 8, hi, hi - 16)
+        full = jnp.concatenate([lo, hi], axis=0).astype(jnp.float32)
+        return full * w_sc[0, 0, 0]
 
     nh, nkv, d, rep = D["nh"], D["nkv"], D["d"], D["rep"]
     page, mp = D["page"], D["mp"]
@@ -676,7 +860,7 @@ def _fused_multi_block_kernel(bt_ref, sl_ref,                 # scalar prefetch
         ao_ref[:] = jnp.zeros_like(ao_ref)
 
     # ------------------------------------------------ shared matmul phase
-    def _mm(local, n_r, tr, tc, src_ref, w_ref, emit):
+    def _mm(local, n_r, tr, tc, src_ref, w_ref, emit, w_sc=None):
         c = local // n_r
         r = local % n_r
 
@@ -685,7 +869,7 @@ def _fused_multi_block_kernel(bt_ref, sl_ref,                 # scalar prefetch
             acc_a[:, :tc] = jnp.zeros_like(acc_a[:, :tc])
 
         src = src_ref[:, pl.ds(r * tr, tr)]
-        acc_a[:, :tc] += _f32_dot(src, w_ref[0])
+        acc_a[:, :tc] += _f32_dot(src, _load(w_ref, w_sc))
 
         @pl.when(r == n_r - 1)
         def _emit():
@@ -697,7 +881,8 @@ def _fused_multi_block_kernel(bt_ref, sl_ref,                 # scalar prefetch
         _mm(lt - D["off_qkv"], D["nr_h"], D["tr_h"], D["tc_qkv"], h_ref,
             wqkv_ref,
             lambda c, acc: qkv_ref.__setitem__(
-                (slice(None), pl.ds(c * D["tc_qkv"], D["tc_qkv"])), acc))
+                (slice(None), pl.ds(c * D["tc_qkv"], D["tc_qkv"])), acc),
+            w_sc=wqkv_sc)
 
     # ------------------------------------- R: in-VMEM rope + k/v emission
     @pl.when(lt == D["off_r"])
@@ -753,11 +938,14 @@ def _fused_multi_block_kernel(bt_ref, sl_ref,                 # scalar prefetch
     seq = sl_ref[b_i]
     n_pages = jnp.maximum((seq + page - 1) // page, 1)
 
-    def _attn_page(kp_ref, vp_ref):
+    def _attn_page(kp_ref, vp_ref, kps_ref=None, vps_ref=None):
         q = qkv_ref[pl.ds(b_i, 1), pl.ds(h_i * rep * d, rep * d)]
         q = q.reshape(rep, d)
         k = kp_ref[0, 0].astype(jnp.float32)           # (page, d)
         v = vp_ref[0, 0].astype(jnp.float32)
+        if kps_ref is not None:
+            k = k * kps_ref[0, 0]                      # (page, d)*(page, 1)
+            v = v * vps_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         pos = j * page + jax.lax.broadcasted_iota(jnp.int32, (rep, page), 1)
@@ -769,19 +957,26 @@ def _fused_multi_block_kernel(bt_ref, sl_ref,                 # scalar prefetch
         def _attn_new_token():
             kn = qkv_ref[pl.ds(b_i, 1), pl.ds(qw + h_i * d, d)]
             vn = qkv_ref[pl.ds(b_i, 1), pl.ds(qw + kvw + h_i * d, d)]
+            if D["kv_quant"]:
+                # match the post-kernel quantized pool write (see the
+                # single-layer kernel's fold for the contract)
+                kn = _fake_quant_rows(
+                    kn.astype(knew_ref.dtype).astype(jnp.float32))
+                vn = _fake_quant_rows(
+                    vn.astype(vnew_ref.dtype).astype(jnp.float32))
             s_new = jax.lax.dot_general(
                 q, kn, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale  # (rep, 1)
             _online(s_new, vn)
 
-    # each layer reads ITS pool operand pair: the layer gate is unrolled
+    # each layer reads ITS pool operand group: the layer gate is unrolled
     # over the static group size so the body indexes a python list, and
     # the operands' index maps freeze inactive layers at page 0 (no
     # spurious refetch mid-phase)
     for m in range(n_layers):
         @pl.when(in_a & (layer == m) & (j < n_pages))
         def _attn_m(m=m):
-            _attn_page(pool_refs[2 * m], pool_refs[2 * m + 1])
+            _attn_page(*pool_refs[stride * m:stride * (m + 1)])
 
     @pl.when(in_a & (j == mp - 1))
     def _attn_emit():
@@ -799,7 +994,7 @@ def _fused_multi_block_kernel(bt_ref, sl_ref,                 # scalar prefetch
             x2_ref[:, cols] = xc_ref[:, cols] + acc
 
         _mm(lt - D["off_o"], D["nr_o"], D["tr_o"], D["tc_o"], ao_ref,
-            wo_ref, emit)
+            wo_ref, emit, w_sc=wo_sc)
 
     # --------------------- F: ffn norm + merged gate|up (two col-offset
     # views of the SAME stacked wgu operand feed the paired accumulators)
@@ -825,8 +1020,8 @@ def _fused_multi_block_kernel(bt_ref, sl_ref,                 # scalar prefetch
             acc_b[:, :tc] = jnp.zeros_like(acc_b[:, :tc])
 
         src = h_ref[:, pl.ds(r * D["tr_h"], D["tr_h"])]
-        acc_a[:, :tc] += _f32_dot(src, wg_ref[0])
-        acc_b[:, :tc] += _f32_dot(src, wu_ref[0])
+        acc_a[:, :tc] += _f32_dot(src, _load(wg_ref, wg_sc))
+        acc_b[:, :tc] += _f32_dot(src, _load(wu_ref, wu_sc))
 
         @pl.when(r == D["nr_h"] - 1)
         def _emit():
@@ -847,7 +1042,7 @@ def _fused_multi_block_kernel(bt_ref, sl_ref,                 # scalar prefetch
             xc_ref[:, cols] = nxt.astype(jnp.float32)
 
         _mm(lt - D["off_d"], D["nr_i"], D["tr_i"], D["tc_d"], fs_ref,
-            wd_ref, emit)
+            wd_ref, emit, w_sc=wd_sc)
 
 
 def fused_multi_block_decode_pallas(x, weights: MultiBlockDecodeWeights,
@@ -924,13 +1119,16 @@ def fused_multi_block_decode_pallas(x, weights: MultiBlockDecodeWeights,
     off_d = off_f + steps_f
     per = off_d + steps_d
 
+    kv_quant = isinstance(k_pages[0], QuantizedPages)
+    wt_quant = isinstance(weights.wqkv, Int4Tiles)
     dims = dict(n_layers=n_layers, per_layer=per, nh=nh, nkv=nkv, d=d,
                 rep=rep, page=page, mp=mp, eps=float(epsilon),
                 scale=float(sm_scale), tr_h=tr_h, tr_o=tr_o, tr_i=tr_i,
                 tc_qkv=tc_qkv, tc_o=tc_o, tc_f=tc_f, tc_d=tc_d,
                 nr_h=nr_h, nr_o=nr_o, nr_i=nr_i, steps_a=steps_a,
                 steps_f=steps_f, off_qkv=off_qkv, off_r=off_r,
-                off_a=off_a, off_o=off_o, off_f=off_f, off_d=off_d)
+                off_a=off_a, off_o=off_o, off_f=off_f, off_d=off_d,
+                kv_quant=kv_quant, wt_quant=wt_quant)
 
     def _const(*_args):
         return (0, 0)
@@ -961,28 +1159,63 @@ def fused_multi_block_decode_pallas(x, weights: MultiBlockDecodeWeights,
     def _kv_out_map(t, bt_ref, sl_ref):
         return (t // per, 0, 0)
 
+    # int4 weights stream HALF-row packed payload blocks, each chased by
+    # its (1, 1, 1) per-tile scale under the SAME index map (block index
+    # == scale element index); the map itself never changes, so the
+    # phase schedule is identical to the native-dtype program's
+    def _wrows(tr):
+        return tr // 2 if wt_quant else tr
+
+    in_specs = [
+        pl.BlockSpec((b_pad, hidden), _const),                      # x
+        pl.BlockSpec((1, hidden), _ln_map),                         # ln1
+        pl.BlockSpec((1, hidden), _ln_map),                         # ln2
+    ]
+    operands = [bt_p, sl_p, x_p, weights.ln1, weights.ln2]
+
+    def _weight(w, spec, imap):
+        in_specs.append(spec)
+        if wt_quant:
+            operands.append(w.q)
+            in_specs.append(pl.BlockSpec((1, 1, 1), imap))
+            operands.append(w.scale)
+        else:
+            operands.append(w)
+
+    qkv_map = _phase_map(off_qkv, steps_qkv, nr_h)
+    _weight(weights.wqkv,
+            pl.BlockSpec((1, _wrows(tr_h), tc_qkv), qkv_map), qkv_map)
+    in_specs += [
+        pl.BlockSpec((b_pad, d), _const),                           # sin
+        pl.BlockSpec((b_pad, d), _const),                           # cos
+    ]
+    operands += [sin, cos]
+    o_map = _phase_map(off_o, steps_o, nr_o)
+    _weight(weights.wo, pl.BlockSpec((1, _wrows(tr_o), tc_o), o_map),
+            o_map)
+    g_map = _phase_map(off_f, steps_f, nr_h)
+    _weight(weights.wgu, pl.BlockSpec((1, _wrows(tr_h), tc_f), g_map),
+            g_map)                                                  # gate
+    _weight(weights.wgu, pl.BlockSpec((1, _wrows(tr_h), tc_f), _up_map),
+            _up_map)                                                # up
+    d_map = _phase_map(off_d, steps_d, nr_i)
+    _weight(weights.wd, pl.BlockSpec((1, _wrows(tr_i), tc_d), d_map),
+            d_map)
+
+    for kp, vp in zip(k_pages, v_pages):
+        if kv_quant:
+            operands += [kp.q, vp.q, kp.scale, vp.scale]
+        else:
+            operands += [kp, vp]
+    for m in range(n_layers):
+        in_specs += [pl.BlockSpec((1, 1, page, d), _kp_map(m))] * 2
+        if kv_quant:
+            in_specs += [pl.BlockSpec((1, 1, page, 1), _kp_map(m))] * 2
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(n_layers * per,),
-        in_specs=[
-            pl.BlockSpec((b_pad, hidden), _const),                  # x
-            pl.BlockSpec((1, hidden), _ln_map),                     # ln1
-            pl.BlockSpec((1, hidden), _ln_map),                     # ln2
-            pl.BlockSpec((1, tr_h, tc_qkv),
-                         _phase_map(off_qkv, steps_qkv, nr_h)),     # wqkv
-            pl.BlockSpec((b_pad, d), _const),                       # sin
-            pl.BlockSpec((b_pad, d), _const),                       # cos
-            pl.BlockSpec((1, tr_o, tc_o),
-                         _phase_map(off_o, steps_o, nr_o)),         # wo
-            pl.BlockSpec((1, tr_h, tc_f),
-                         _phase_map(off_f, steps_f, nr_h)),         # wgu:gate
-            pl.BlockSpec((1, tr_h, tc_f), _up_map),                 # wgu:up
-            pl.BlockSpec((1, tr_i, tc_d),
-                         _phase_map(off_d, steps_d, nr_i)),         # wd
-        ] + [
-            pl.BlockSpec((1, 1, page, d), _kp_map(m // 2))
-            for m in range(2 * n_layers)                            # pools
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((b_pad, hidden), _const),                  # out
             pl.BlockSpec((1, b_pad, kvw), _kv_out_map),             # k_new
@@ -1002,11 +1235,6 @@ def fused_multi_block_decode_pallas(x, weights: MultiBlockDecodeWeights,
             pltpu.VMEM((rep_pad, _LANES), jnp.float32),   # attn l
         ],
     )
-
-    operands = [bt_p, sl_p, x_p, weights.ln1, weights.ln2, weights.wqkv,
-                sin, cos, weights.wo, weights.wgu, weights.wgu, weights.wd]
-    for kp, vp in zip(k_pages, v_pages):
-        operands += [kp, vp]
 
     out, k_new, v_new = pl.pallas_call(
         functools.partial(_fused_multi_block_kernel, dims=dims),

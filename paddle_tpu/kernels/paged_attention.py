@@ -101,10 +101,67 @@ def _interpret() -> bool:
     return not is_tpu_backend()
 
 
+# ------------------------------------------------------- quantized pools
+class QuantizedPages(NamedTuple):
+    """One pool half stored int8 with per-token f32 amax scales riding
+    alongside: ``q`` is the payload, ``scale[h, p, t, 0]`` dequantizes
+    token ``t`` of page ``p`` for kv head ``h`` (``q.astype(f32) *
+    scale``). Scales are per TOKEN ROW, not per page: quantization is
+    then a pure function of each token's own k/v vector, so the pool's
+    bits never depend on WRITE ORDER (chunked prefill vs token-at-a-time
+    replay) — the property greedy fault-replay's bit-identical contract
+    rests on. A NamedTuple (= pytree) so it rides jit/scan/donation like
+    a plain pool array; ``shape``/``dtype`` delegate to the payload so
+    geometry probes (``k_pages.shape[2]``, ``str(dtype)``) keep working.
+    """
+    q: Any       # int8 (Hkv, num_pages, page_size, D)
+    scale: Any   # f32  (Hkv, num_pages, page_size, 1)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+
+def quantize_kv_rows(x):
+    """Symmetric per-row int8 quantization over the trailing (head_dim)
+    axis: returns ``(q, scale)`` with ``q*scale`` the dequantized value.
+    Deterministic and order-free — the write-time half of the int8 KV
+    contract (readers dequantize in-kernel)."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    scale = amax / 127.0
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(x32 / safe), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _gathered_pool(pages, idx):
+    """Gather pool pages by an int32 index array and hand back the f32
+    (or storage-dtype) view with batch leading: the XLA twins' common
+    gather, dequantizing on the spot for quantized pools so no reader
+    ever branches on storage dtype again."""
+    if isinstance(pages, QuantizedPages):
+        g = jnp.moveaxis(pages.q[:, idx], 1, 0).astype(jnp.float32)
+        return g * jnp.moveaxis(pages.scale[:, idx], 1, 0)
+    return jnp.moveaxis(pages[:, idx], 1, 0)
+
+
 # ------------------------------------------------------------ the kernel
-def _paged_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, out_ref,
-                  acc_ref, m_ref, l_ref, *, sm_scale: float,
-                  page_size: int, rep: int):
+def _paged_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, *rest,
+                  sm_scale: float, page_size: int, rep: int,
+                  quant: bool = False):
+    # quantized pools append per-page scale operands after the payloads:
+    # (..., k_ref, v_ref, ks_ref, vs_ref, out_ref, scratch...) — dequant
+    # happens on the VMEM-resident page block, never in HBM
+    if quant:
+        ks_ref, vs_ref = rest[0], rest[1]
+        rest = rest[2:]
+    out_ref, acc_ref, m_ref, l_ref = rest
+
     b = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -122,6 +179,9 @@ def _paged_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, out_ref,
         q = q_ref[0, 0].astype(jnp.float32)            # (rep, d)
         k = k_ref[0, 0].astype(jnp.float32)            # (page, d)
         v = v_ref[0, 0].astype(jnp.float32)
+        if quant:
+            k = k * ks_ref[0, 0]                       # (page, d)*(page, 1)
+            v = v * vs_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale   # (rep, page)
@@ -185,17 +245,26 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
 
     rep_pad = -(-rep // 8) * 8
     grid = (b, hkv, max_pages)
+    quant = isinstance(k_pages, QuantizedPages)
+    in_specs = [
+        pl.BlockSpec((1, 1, rep, d), q_index),
+        pl.BlockSpec((1, 1, page_size, d), kv_index),
+        pl.BlockSpec((1, 1, page_size, d), kv_index),
+    ]
+    operands = [qg, k_pages, v_pages]
+    if quant:
+        # per-token scale rows ride as their own operands, indexed by
+        # the SAME block-table map as the payload pages
+        in_specs += [pl.BlockSpec((1, 1, page_size, 1), kv_index)] * 2
+        operands = [qg, k_pages.q, v_pages.q,
+                    k_pages.scale, v_pages.scale]
     out = pl.pallas_call(
         functools.partial(_paged_kernel, sm_scale=float(sm_scale),
-                          page_size=page_size, rep=rep),
+                          page_size=page_size, rep=rep, quant=quant),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, 1, rep, d), q_index),
-                pl.BlockSpec((1, 1, page_size, d), kv_index),
-                pl.BlockSpec((1, 1, page_size, d), kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, rep, d), q_index),
             scratch_shapes=[
                 pltpu.VMEM((rep_pad, d), jnp.float32),       # acc
@@ -205,7 +274,7 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
         ),
         out_shape=jax.ShapeDtypeStruct((b, hkv, rep, d), q.dtype),
         interpret=_interpret(),
-    )(bt, sl, qg, k_pages, v_pages)
+    )(bt, sl, *operands)
     return out.reshape(b, h, d)
 
 
@@ -220,9 +289,10 @@ def paged_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
         sm_scale = 1.0 / math.sqrt(d)
     bt = jnp.asarray(block_tables, jnp.int32)
     sl = jnp.asarray(seq_lens, jnp.int32)
-    # (Hkv, B, max_pages, page, D) -> (B, T, Hkv, D)
-    k = jnp.moveaxis(k_pages[:, bt], 1, 0)
-    v = jnp.moveaxis(v_pages[:, bt], 1, 0)
+    # (Hkv, B, max_pages, page, D) -> (B, Hkv, T, D), dequantized on the
+    # gathered (not pool-sized) view for quantized pools
+    k = _gathered_pool(k_pages, bt)
+    v = _gathered_pool(v_pages, bt)
     t = k.shape[2] * page_size
     k = k.reshape(b, hkv, t, d)
     v = v.reshape(b, hkv, t, d)
@@ -236,10 +306,14 @@ def paged_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
 
 
 # -------------------------------------- chunk-native prefill attention
-def _paged_chunk_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, out_ref,
-                        acc_ref, m_ref, l_ref, *, sm_scale: float,
-                        page_size: int, s_chunk: int, rows: int,
-                        max_pages: int):
+def _paged_chunk_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, *rest,
+                        sm_scale: float, page_size: int, s_chunk: int,
+                        rows: int, max_pages: int, quant: bool = False):
+    if quant:
+        ks_ref, vs_ref = rest[0], rest[1]
+        rest = rest[2:]
+    out_ref, acc_ref, m_ref, l_ref = rest
+
     b = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -262,6 +336,9 @@ def _paged_chunk_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, out_ref,
         q = q_ref[0, 0].astype(jnp.float32)            # (rows_pad, d)
         k = k_ref[0, 0].astype(jnp.float32)            # (page, d)
         v = v_ref[0, 0].astype(jnp.float32)
+        if quant:
+            k = k * ks_ref[0, 0]
+            v = v * vs_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -342,18 +419,25 @@ def paged_chunk_attention(q: jax.Array, k_pages: jax.Array,
     def kv_index(b_, h_, j, bt_ref, sl_ref):
         return (h_, bt_ref[b_, j], 0, 0)
 
+    quant = isinstance(k_pages, QuantizedPages)
+    in_specs = [
+        pl.BlockSpec((1, 1, rows_pad, d), q_index),
+        pl.BlockSpec((1, 1, page_size, d), kv_index),
+        pl.BlockSpec((1, 1, page_size, d), kv_index),
+    ]
+    operands = [qg, k_pages, v_pages]
+    if quant:
+        in_specs += [pl.BlockSpec((1, 1, page_size, 1), kv_index)] * 2
+        operands = [qg, k_pages.q, v_pages.q,
+                    k_pages.scale, v_pages.scale]
     out = pl.pallas_call(
         functools.partial(_paged_chunk_kernel, sm_scale=float(sm_scale),
                           page_size=page_size, s_chunk=s, rows=rows,
-                          max_pages=max_pages),
+                          max_pages=max_pages, quant=quant),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, hkv, max_pages),
-            in_specs=[
-                pl.BlockSpec((1, 1, rows_pad, d), q_index),
-                pl.BlockSpec((1, 1, page_size, d), kv_index),
-                pl.BlockSpec((1, 1, page_size, d), kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, rows_pad, d), q_index),
             scratch_shapes=[
                 pltpu.VMEM((rows_pad, d), jnp.float32),       # acc
@@ -363,7 +447,7 @@ def paged_chunk_attention(q: jax.Array, k_pages: jax.Array,
         ),
         out_shape=jax.ShapeDtypeStruct((b, hkv, rows_pad, d), q.dtype),
         interpret=_interpret(),
-    )(bt, st, qg, k_pages, v_pages)
+    )(bt, st, *operands)
     out = out[:, :, :rows].reshape(b, hkv, rep, s, d)
     return out.transpose(0, 3, 1, 2, 4).reshape(b, s, h, d)
 
@@ -410,8 +494,8 @@ def paged_chunk_attention_xla(q, k_pages, v_pages, block_tables, start,
     def body(j, carry):
         acc, m, l = carry
         pages = jax.lax.dynamic_slice_in_dim(bt, j * grp, grp, 1)  # (B, G)
-        kb = jnp.moveaxis(k_pages[:, pages], 1, 0).astype(jnp.float32)
-        vb = jnp.moveaxis(v_pages[:, pages], 1, 0).astype(jnp.float32)
+        kb = _gathered_pool(k_pages, pages).astype(jnp.float32)
+        vb = _gathered_pool(v_pages, pages).astype(jnp.float32)
         kb = kb.reshape(b, hkv, grp * page_size, d)
         vb = vb.reshape(b, hkv, grp * page_size, d)
         sc = jnp.einsum("bhrsd,bhpd->bhrsp", qg, kb)
@@ -449,6 +533,18 @@ def write_paged_kv(k_pages, v_pages, k_new, v_new, block_tables, positions):
     page_of = jnp.take_along_axis(bt, (pos // page_size)[:, None],
                                   axis=1)[:, 0]            # (B,)
     off = pos % page_size
+    if isinstance(k_pages, QuantizedPages):
+        # amax-quantize at write time: each token's (payload, scale) row
+        # pair is a pure function of its own k/v vector
+        kq, ks = quantize_kv_rows(k_new)
+        vq, vs = quantize_kv_rows(v_new)
+        k_pages = QuantizedPages(
+            k_pages.q.at[:, page_of, off].set(jnp.moveaxis(kq, 0, 1)),
+            k_pages.scale.at[:, page_of, off].set(jnp.moveaxis(ks, 0, 1)))
+        v_pages = QuantizedPages(
+            v_pages.q.at[:, page_of, off].set(jnp.moveaxis(vq, 0, 1)),
+            v_pages.scale.at[:, page_of, off].set(jnp.moveaxis(vs, 0, 1)))
+        return k_pages, v_pages
     kt = jnp.moveaxis(k_new.astype(k_pages.dtype), 0, 1)   # (Hkv, B, D)
     vt = jnp.moveaxis(v_new.astype(v_pages.dtype), 0, 1)
     k_pages = k_pages.at[:, page_of, off].set(kt)
@@ -485,6 +581,20 @@ def write_paged_prompt_at(k_pages, v_pages, k_new, v_new, block_tables,
     # mode="drop" scatter discards them
     pages = jnp.where(in_range, pages, k_pages.shape[1])
     off = pos % page_size
+    if isinstance(k_pages, QuantizedPages):
+        kq, ks = quantize_kv_rows(k_new)               # (B, S, Hkv, *)
+        vq, vs = quantize_kv_rows(v_new)
+        k_pages = QuantizedPages(
+            k_pages.q.at[:, pages, off].set(
+                jnp.moveaxis(kq, 2, 0), mode="drop"),
+            k_pages.scale.at[:, pages, off].set(
+                jnp.moveaxis(ks, 2, 0), mode="drop"))
+        v_pages = QuantizedPages(
+            v_pages.q.at[:, pages, off].set(
+                jnp.moveaxis(vq, 2, 0), mode="drop"),
+            v_pages.scale.at[:, pages, off].set(
+                jnp.moveaxis(vs, 2, 0), mode="drop"))
+        return k_pages, v_pages
     kt = jnp.moveaxis(k_new.astype(k_pages.dtype), 2, 0)   # (Hkv, B, S, D)
     vt = jnp.moveaxis(v_new.astype(v_pages.dtype), 2, 0)
     k_pages = k_pages.at[:, pages, off].set(kt, mode="drop")
@@ -505,8 +615,9 @@ def gather_paged_view(k_pages, v_pages, block_tables):
     hkv, _, page_size, d = k_pages.shape
     b, max_pages = bt.shape
     t = max_pages * page_size
-    k = jnp.moveaxis(k_pages[:, bt], 1, 0).reshape(b, hkv, t, d)
-    v = jnp.moveaxis(v_pages[:, bt], 1, 0).reshape(b, hkv, t, d)
+    # quantized pools dequantize here: the oracle view is f32
+    k = _gathered_pool(k_pages, bt).reshape(b, hkv, t, d)
+    v = _gathered_pool(v_pages, bt).reshape(b, hkv, t, d)
     return jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2)     # (B, T, Hkv, D)
 
 
@@ -534,15 +645,26 @@ class PagedKVCache:
     def __init__(self, num_layers: int, num_pages: int, page_size: int,
                  num_kv_heads: int, head_dim: int, max_batch: int,
                  max_seq_len: int, dtype=jnp.bfloat16,
-                 reserve_null_page: bool = False):
+                 reserve_null_page: bool = False,
+                 kv_dtype: str = "native"):
         """``reserve_null_page``: keep page 0 out of the free list so it
         only ever holds writes from INACTIVE batch slots (whose block
         tables are all-zero) — a continuous-batching engine decodes full
         fixed-shape batches, and idle rows must scribble somewhere that
-        no live sequence owns."""
+        no live sequence owns.
+
+        ``kv_dtype``: the pool STORAGE dtype — ``"native"`` keeps plain
+        ``dtype`` arrays; ``"int8"`` stores :class:`QuantizedPages`
+        (int8 payload + per-token f32 scale rows, amax-quantized at
+        write time, dequantized in-kernel by every reader).
+        ``bytes_per_page`` bills the actual quantized footprint."""
         if page_size % 8:
             raise ValueError("page_size must be a multiple of 8 (TPU "
                              "sublane tile)")
+        if kv_dtype not in ("native", "int8"):
+            raise ValueError(f"kv_dtype must be 'native' or 'int8', "
+                             f"got {kv_dtype!r}")
+        self.kv_dtype = kv_dtype
         self.page_size = page_size
         self.num_pages = num_pages
         self.max_pages_per_seq = -(-max_seq_len // page_size)
@@ -558,14 +680,28 @@ class PagedKVCache:
         # "spilled" state. The HostPage objects themselves live with
         # the tiering orchestrator (the serving PrefixCache).
         self._spilled_pages = 0
-        self.bytes_per_page = (num_layers * 2 * num_kv_heads * page_size
-                               * head_dim * jnp.dtype(dtype).itemsize)
-        self.k_pages: List[jax.Array] = [
-            jnp.zeros((num_kv_heads, num_pages, page_size, head_dim), dtype)
-            for _ in range(num_layers)]
-        self.v_pages: List[jax.Array] = [
-            jnp.zeros((num_kv_heads, num_pages, page_size, head_dim), dtype)
-            for _ in range(num_layers)]
+        if kv_dtype == "int8":
+            # int8 payload + one f32 scale per token row per head: the
+            # ACTUAL quantized footprint (ledger honesty contract)
+            self.bytes_per_page = (num_layers * 2 * num_kv_heads
+                                   * page_size * (head_dim + 4))
+
+            def _pool():
+                return QuantizedPages(
+                    jnp.zeros((num_kv_heads, num_pages, page_size,
+                               head_dim), jnp.int8),
+                    jnp.zeros((num_kv_heads, num_pages, page_size, 1),
+                              jnp.float32))
+        else:
+            self.bytes_per_page = (num_layers * 2 * num_kv_heads
+                                   * page_size * head_dim
+                                   * jnp.dtype(dtype).itemsize)
+
+            def _pool():
+                return jnp.zeros(
+                    (num_kv_heads, num_pages, page_size, head_dim), dtype)
+        self.k_pages: List[Any] = [_pool() for _ in range(num_layers)]
+        self.v_pages: List[Any] = [_pool() for _ in range(num_layers)]
         self.block_tables = np.zeros((max_batch, self.max_pages_per_seq),
                                      np.int32)
         self.seq_lens = np.zeros((max_batch,), np.int32)
@@ -673,12 +809,22 @@ class PagedKVCache:
         # it only ever runs at scheduler time between dispatched steps.
         # np.array (not asarray): numpy-backed pools would hand back a
         # VIEW of a buffer whose page id gets recycled
-        # tracecheck: disable=TRC002
-        ks = [np.array(self.k_pages[i][:, pid])
-              for i in range(len(self.k_pages))]
-        # tracecheck: disable=TRC002
-        vs = [np.array(self.v_pages[i][:, pid])
-              for i in range(len(self.v_pages))]
+        if isinstance(self.k_pages[0], QuantizedPages):
+            # quantized payload + scales move VERBATIM — the host tier
+            # holds the pool bits, never a dequantized copy
+            # tracecheck: disable=TRC002
+            ks = [(np.array(p.q[:, pid]), np.array(p.scale[:, pid]))
+                  for p in self.k_pages]
+            # tracecheck: disable=TRC002
+            vs = [(np.array(p.q[:, pid]), np.array(p.scale[:, pid]))
+                  for p in self.v_pages]
+        else:
+            # tracecheck: disable=TRC002
+            ks = [np.array(self.k_pages[i][:, pid])
+                  for i in range(len(self.k_pages))]
+            # tracecheck: disable=TRC002
+            vs = [np.array(self.v_pages[i][:, pid])
+                  for i in range(len(self.v_pages))]
         self._spilled_pages += 1
         return HostPage(ks, vs, self.bytes_per_page)
 
@@ -693,10 +839,21 @@ class PagedKVCache:
         (still far cheaper than re-running the chunk's prefill)."""
         pid = int(page_id)
         for i in range(len(self.k_pages)):
-            k = jnp.asarray(self.k_pages[i])
-            v = jnp.asarray(self.v_pages[i])
-            self.k_pages[i] = k.at[:, pid].set(host.k[i])
-            self.v_pages[i] = v.at[:, pid].set(host.v[i])
+            kp, vp = self.k_pages[i], self.v_pages[i]
+            if isinstance(kp, QuantizedPages):
+                # asarray each FIELD — never the NamedTuple itself
+                # (that would try to stack payload and scale)
+                self.k_pages[i] = QuantizedPages(
+                    jnp.asarray(kp.q).at[:, pid].set(host.k[i][0]),
+                    jnp.asarray(kp.scale).at[:, pid].set(host.k[i][1]))
+                self.v_pages[i] = QuantizedPages(
+                    jnp.asarray(vp.q).at[:, pid].set(host.v[i][0]),
+                    jnp.asarray(vp.scale).at[:, pid].set(host.v[i][1]))
+            else:
+                k = jnp.asarray(kp)
+                v = jnp.asarray(vp)
+                self.k_pages[i] = k.at[:, pid].set(host.k[i])
+                self.v_pages[i] = v.at[:, pid].set(host.v[i])
         self._spilled_pages -= 1
 
     def forget_spilled(self, host: HostPage) -> None:

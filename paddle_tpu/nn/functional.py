@@ -731,7 +731,8 @@ def paged_scaled_dot_product_attention(query, key, value, state):
     gather fallback when pallas is off). Returns ``(out, new_state)``."""
     from .. import flags
     from ..kernels.decode_attention import cached_attention
-    from ..kernels.paged_attention import (PagedChunkState, paged_attention,
+    from ..kernels.paged_attention import (PagedChunkState, QuantizedPages,
+                                           paged_attention,
                                            paged_attention_xla,
                                            paged_chunk_attention,
                                            paged_chunk_attention_xla,
@@ -742,6 +743,16 @@ def paged_scaled_dot_product_attention(query, key, value, state):
     use_pallas = (flags.snapshot(("use_pallas",)).use_pallas
                   and flags.is_tpu_backend())
     chunked = isinstance(state, PagedChunkState)
+
+    # a quantized pool reaches here as a NamedTuple whose FIELDS were
+    # Tensor-wrapped by functional_call's tree walk (the tuple itself is
+    # a pytree node, not a leaf) — unwrap to raw arrays for the kernels
+    def _raw_pages(p):
+        if isinstance(p, QuantizedPages):
+            return QuantizedPages(
+                p.q._value if hasattr(p.q, "_value") else p.q,
+                p.scale._value if hasattr(p.scale, "_value") else p.scale)
+        return p
 
     def fn(qv, kv, vv, kp, vp, bt, sl):
         s = qv.shape[1]
@@ -786,7 +797,8 @@ def paged_scaled_dot_product_attention(query, key, value, state):
 
     out, kp2, vp2, sl2 = apply_op(
         "paged_sdpa", fn, query, key, value,
-        state.k_pages, state.v_pages, state.block_tables, state.seq_lens)
+        _raw_pages(state.k_pages), _raw_pages(state.v_pages),
+        state.block_tables, state.seq_lens)
     return out, type(state)(kp2, vp2, state.block_tables, sl2)
 
 

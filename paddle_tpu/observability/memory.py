@@ -297,11 +297,11 @@ class PoolGeometry:
     :class:`PagedKVCache`'s constructor args."""
 
     __slots__ = ("num_layers", "num_pages", "page_size", "num_kv_heads",
-                 "head_dim", "max_pages_per_seq", "dtype")
+                 "head_dim", "max_pages_per_seq", "dtype", "kv_quant")
 
     def __init__(self, num_layers: int, num_pages: int, page_size: int,
                  num_kv_heads: int, head_dim: int, max_pages_per_seq: int,
-                 dtype: Any = "float32"):
+                 dtype: Any = "float32", kv_quant: bool = False):
         self.num_layers = int(num_layers)
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
@@ -310,20 +310,29 @@ class PoolGeometry:
         self.max_pages_per_seq = int(max_pages_per_seq)
         self.dtype = np.dtype(dtype) if not hasattr(dtype, "itemsize") \
             else dtype
+        # int8-quantized pool: int8 payload + one f32 amax scale per
+        # (head, page, token) row alongside (r18)
+        self.kv_quant = bool(kv_quant)
 
     @classmethod
     def of_pool(cls, pool) -> "PoolGeometry":
         """Geometry of a live :class:`PagedKVCache`."""
+        from ..kernels.paged_attention import QuantizedPages
         k0 = pool.k_pages[0]
+        quant = isinstance(k0, QuantizedPages)
         hkv, num_pages, page, d = k0.shape
         return cls(len(pool.k_pages), num_pages, page, hkv, d,
-                   pool.max_pages_per_seq, k0.dtype)
+                   pool.max_pages_per_seq,
+                   k0.q.dtype if quant else k0.dtype, kv_quant=quant)
 
     def pool_bytes(self) -> int:
-        """Both pools, all layers — the donated/aliased block."""
+        """Both pools, all layers — the donated/aliased block. A
+        quantized pool bills the int8 payload plus the f32 per-token
+        scale rows (head_dim + 4 bytes per token-head)."""
+        per_elem = (self.head_dim * np.dtype(self.dtype).itemsize
+                    + (4 if self.kv_quant else 0))
         return (self.num_layers * 2 * self.num_kv_heads * self.num_pages
-                * self.page_size * self.head_dim
-                * np.dtype(self.dtype).itemsize)
+                * self.page_size * per_elem)
 
     def tables_bytes(self, batch: int) -> int:
         """block table + seq_lens for one dispatch (int32)."""
@@ -441,9 +450,37 @@ def _nlayer_slice_temp(dims: ModelDims, batch: int) -> int:
     return 4 * (slice_elems + act_elems)
 
 
+def _kv_dequant_temp(dims: ModelDims, geom: PoolGeometry,
+                     batch: int) -> int:
+    """int8-KV decode adder (r18): the XLA pool readers gather the
+    page payload and materialize ONE f32 dequantized K view of the
+    gathered context (the V dequant fuses into the PV dot, and the
+    buffer is reused across layers, so there is no per-layer term).
+    Fit against CompiledMemoryStats on the tier-1 quantized rows:
+    +5.0% on decode_fused int8 at the capture geometry."""
+    pages = -(-geom.max_seq // geom.page_size)
+    return 4 * dims.kv_heads * batch * pages * geom.page_size \
+        * dims.head_dim
+
+
+def _int4_unpack_temp(dims: ModelDims, group_layers: int) -> int:
+    """int4 stacked-weight adder (r18): the CPU/XLA ref path of the
+    N-layer program dequantizes the group's packed matrices up front,
+    so the group's merged f32 weights land in the temp section — all
+    but ``wd``, whose unpack XLA fuses into its consuming dot (the fit
+    that lands the banked fully-quantized row at -5.8%). The Pallas
+    path unpacks tile-wise in VMEM and never sees these buffers."""
+    merged = (dims.hidden * (dims.heads * dims.head_dim
+                             + 2 * dims.kv_dim)       # wqkv
+              + dims.heads * dims.head_dim * dims.hidden   # wo
+              + dims.hidden * 2 * dims.intermediate)       # gate|up
+    return 4 * group_layers * merged
+
+
 def estimate_decode_program(dims: ModelDims, geom: PoolGeometry,
                             batch: int, param_bytes: int,
-                            fused_layers: int = 1) -> Dict[str, int]:
+                            fused_layers: int = 1,
+                            int4_weights: bool = False) -> Dict[str, int]:
     """Predicted sections of one decode-step program (fused, generic, or
     the r17 N-layer grouped program — the calibrated model covers all
     three): params + pools + tables in, donated pools + token ids out.
@@ -461,6 +498,10 @@ def estimate_decode_program(dims: ModelDims, geom: PoolGeometry,
     temp = _decode_temp(dims, geom, batch)
     if int(fused_layers) > 1:
         temp = max(temp, _nlayer_slice_temp(dims, batch))
+    if geom.kv_quant:
+        temp += _kv_dequant_temp(dims, geom, batch)
+    if int4_weights:
+        temp += _int4_unpack_temp(dims, int(fused_layers))
     return {
         "argument": arg, "output": out,
         "temp": temp,
@@ -544,7 +585,8 @@ def estimate_engine_memory(dims: ModelDims, *,
     geom = PoolGeometry(dims.layers, usable + 1, page_size, dims.kv_heads,
                         dims.head_dim, pages_per_seq, np.dtype(
                             "int8" if str(kv_dtype) == "int8"
-                            else "float16"))  # 2B stand-in for bf16
+                            else "float16"),  # 2B stand-in for bf16
+                        kv_quant=str(kv_dtype) == "int8")
     if str(kv_dtype) in ("bfloat16", "bf16", "float16"):
         kv_item = 2
     elif str(kv_dtype) == "int8":
@@ -554,8 +596,11 @@ def estimate_engine_memory(dims: ModelDims, *,
     pool = (dims.layers * 2 * dims.kv_heads * (usable + 1) * page_size
             * dims.head_dim * kv_item)
     if str(kv_dtype) == "int8":
-        # per-page f32 scales stored alongside the pool (k and v)
-        pool += dims.layers * 2 * dims.kv_heads * (usable + 1) * 4
+        # per-TOKEN f32 amax scales stored alongside the pool (k and v:
+        # one scale per head-token row — write-order-independent, so
+        # fault replay stays bit-identical)
+        pool += (dims.layers * 2 * dims.kv_heads * (usable + 1)
+                 * page_size * 4)
     weights = weight_bytes(n_params, weight_dtype)
     decode_tmp = _decode_temp(dims, geom, max_batch)
     # chunked prefill is the copy-free block-table path (r17): no
@@ -578,7 +623,7 @@ def estimate_engine_memory(dims: ModelDims, *,
         dgeom = PoolGeometry(
             draft_dims.layers, 1 + max_batch * pages_per_seq, page_size,
             draft_dims.kv_heads, draft_dims.head_dim, pages_per_seq,
-            geom.dtype)
+            geom.dtype, kv_quant=geom.kv_quant)
         draft_pool = dgeom.pool_bytes()
         # the verify IS a chunk program — priced on the copy-free path
         verify_tmp = _prefill_temp(dims, geom, gamma + 1, chunked=True)

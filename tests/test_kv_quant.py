@@ -1,0 +1,524 @@
+"""Quantized KV cache + int4 weight tiles (r18).
+
+The contract under test, layer by layer:
+
+  - every pool reader (Pallas decode kernel, its XLA twin, the chunked
+    twins, the fused single-/N-layer kernels) dequantizes the int8
+    payload in-register and lands within a small tolerance of the same
+    computation over the un-quantized pool — and the Pallas and XLA
+    readers agree TIGHTLY with each other (they share one set of pool
+    bits, so their difference is pure kernel arithmetic);
+  - pool bits are a pure function of each token's own k/v row
+    (per-token amax scales): chunked prefill and token-at-a-time replay
+    write IDENTICAL bits, the property greedy fault-replay's
+    bit-identical contract rests on;
+  - int4 weight tiles: ``unpack(pack(w))`` is exact on the quantization
+    grid, error-bounded off it, and the in-kernel tile-wise unpack
+    matches the pure-jnp ``unpack_int4_tiles`` reference through the
+    N-layer kernel;
+  - the engine under ``kv_dtype="int8"`` (and ``weight_dtype="int4"``
+    for the N-layer path) serves the same greedy tokens as the native
+    pool on the tiny models, keys programs on the storage dtypes
+    (DecodeKey.extra discriminant), never retraces at a fixed bucket,
+    replays injected faults bit-identically, and stays self-consistent
+    under speculative decoding;
+  - the ledger bills ACTUAL quantized bytes (int8 payload + f32 scale
+    rows), spill/restore round-trips payload AND scales bit-exactly,
+    and the memwatch planner's kv-pool term agrees with the ledger
+    within the 10% acceptance bar.
+"""
+
+import contextlib
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import flags
+from paddle_tpu.generation.program_cache import (clear_decode_program_cache,
+                                                 decode_program_cache)
+from paddle_tpu.generation.serving import ServingEngine
+from paddle_tpu.kernels.fused_block_decode import (
+    BlockDecodeWeights, Int4Tiles, fused_block_decode_pallas,
+    fused_block_decode_ref, fused_multi_block_decode_pallas,
+    fused_multi_block_decode_ref, pack_int4_tiles, stack_block_weights,
+    unpack_int4_tiles)
+from paddle_tpu.kernels.paged_attention import (PagedKVCache,
+                                                QuantizedPages,
+                                                paged_attention,
+                                                paged_attention_xla,
+                                                paged_chunk_attention,
+                                                paged_chunk_attention_xla,
+                                                quantize_kv_rows,
+                                                write_paged_kv,
+                                                write_paged_prompt_at)
+from paddle_tpu.models import (GPTConfig, GPTForCausalLM, LlamaConfig,
+                               LlamaForCausalLM)
+from paddle_tpu.observability import memory as memwatch
+from paddle_tpu.testing import faults
+
+pytestmark = pytest.mark.kv_quant
+
+# int8-vs-native tolerance: per-row amax quantization carries a worst-
+# case relative step of 1/254 per element; through a softmax-weighted
+# sum over ~tens of tokens the observed error stays well under 3e-2 on
+# the unit-scale test tensors (the documented tolerance contract).
+QTOL = dict(rtol=3e-2, atol=3e-2)
+# Pallas-vs-XLA over the SAME quantized pool: pure kernel arithmetic.
+KTOL = dict(rtol=2e-5, atol=2e-5)
+
+
+@contextlib.contextmanager
+def set_flags(**kw):
+    prev = flags.snapshot(tuple(kw)).as_tuple()
+    flags.set_flags(kw)
+    try:
+        yield
+    finally:
+        flags.set_flags(dict(prev))
+
+
+def quantize_pool(kp):
+    """Per-token-row quantization of a dense (Hkv, P, page, D) pool —
+    exactly what the write path produces row by row."""
+    q, s = quantize_kv_rows(kp)
+    return QuantizedPages(q, s)
+
+
+def make_pool(rng, hkv=2, num_pages=16, page=8, d=32):
+    k = jnp.asarray(rng.standard_normal((hkv, num_pages, page, d)) * 0.5,
+                    jnp.float32)
+    v = jnp.asarray(rng.standard_normal((hkv, num_pages, page, d)) * 0.5,
+                    jnp.float32)
+    return k, v
+
+
+# ------------------------------------------------------- pool readers
+class TestQuantizedPoolReaders:
+    @pytest.mark.pallas_interpret
+    def test_decode_readers_parity(self):
+        """Pallas + XLA decode over one int8 pool: tight against each
+        other, tolerance-bounded against the native-pool compute."""
+        rng = np.random.default_rng(0)
+        b, h, hkv, d, page, num_pages = 3, 8, 2, 32, 8, 16
+        kp, vp = make_pool(rng, hkv, num_pages, page, d)
+        qkp, qvp = quantize_pool(kp), quantize_pool(vp)
+        q = jnp.asarray(rng.standard_normal((b, h, d)) * 0.5, jnp.float32)
+        bt = np.zeros((b, 4), np.int32)
+        perm = rng.permutation(num_pages)
+        bt[0, :2] = perm[:2]
+        bt[1, :4] = perm[2:6]
+        bt[2, :1] = perm[6:7]
+        sl = np.array([13, 29, 5], np.int32)
+
+        out_native = paged_attention_xla(q, kp, vp, bt, sl)
+        out_k = paged_attention(q, qkp, qvp, bt, sl)
+        out_x = paged_attention_xla(q, qkp, qvp, bt, sl)
+        np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_x),
+                                   **KTOL)
+        np.testing.assert_allclose(np.asarray(out_x),
+                                   np.asarray(out_native), **QTOL)
+
+    @pytest.mark.pallas_interpret
+    def test_chunk_readers_parity(self):
+        """Chunked-prefill attention over an int8 pool, chunk written
+        through ``write_paged_prompt_at`` first (write-then-attend)."""
+        rng = np.random.default_rng(1)
+        b, s, h, hkv, d, page, num_pages = 2, 8, 4, 2, 16, 8, 13
+        kp, vp = make_pool(rng, hkv, num_pages, page, d)
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)) * 0.5,
+                        jnp.float32)
+        ck = jnp.asarray(rng.standard_normal((b, s, hkv, d)) * 0.5,
+                         jnp.float32)
+        cv = jnp.asarray(rng.standard_normal((b, s, hkv, d)) * 0.5,
+                         jnp.float32)
+        bt = jnp.asarray(rng.permutation(num_pages - 1)[:b * 6]
+                         .reshape(b, 6) + 1, jnp.int32)
+        start = jnp.asarray([5, 11], jnp.int32)
+
+        knat, vnat = write_paged_prompt_at(kp, vp, ck, cv, bt, start)
+        ref = paged_chunk_attention_xla(q, knat, vnat, bt, start)
+        kq, vq = write_paged_prompt_at(quantize_pool(kp),
+                                       quantize_pool(vp),
+                                       ck, cv, bt, start)
+        out_k = paged_chunk_attention(q, kq, vq, bt, start)
+        out_x = paged_chunk_attention_xla(q, kq, vq, bt, start)
+        np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_x),
+                                   **KTOL)
+        np.testing.assert_allclose(np.asarray(out_x), np.asarray(ref),
+                                   **QTOL)
+
+    def test_write_order_independent_bits(self):
+        """One prompt written as a chunk vs token-at-a-time: per-token
+        scales make the pool bits IDENTICAL — the foundation of the
+        bit-identical replay contract on quantized pools."""
+        rng = np.random.default_rng(2)
+        b, s, hkv, d, page, num_pages = 2, 11, 2, 16, 8, 8
+        zero = QuantizedPages(
+            jnp.zeros((hkv, num_pages, page, d), jnp.int8),
+            jnp.zeros((hkv, num_pages, page, 1), jnp.float32))
+        ck = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+        cv = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+        bt = jnp.asarray([[1, 2, 0], [3, 4, 0]], jnp.int32)
+
+        k1, v1 = write_paged_prompt_at(zero, zero, ck, cv, bt,
+                                       jnp.zeros((b,), jnp.int32))
+        k2, v2 = zero, zero
+        for t in range(s):
+            k2, v2 = write_paged_kv(k2, v2, ck[:, t], cv[:, t], bt,
+                                    jnp.full((b,), t, jnp.int32))
+        for got, want in ((k2, k1), (v2, v1)):
+            np.testing.assert_array_equal(np.asarray(got.q),
+                                          np.asarray(want.q))
+            np.testing.assert_array_equal(np.asarray(got.scale),
+                                          np.asarray(want.scale))
+
+
+# ------------------------------------------------------ fused kernels
+def _mk_layers(rng, n_layers, b=3, hidden=64, nh=4, nkv=2, inter=128,
+               page=8, num_pages=16, mp=4, seq_lens=(5, 8, 11)):
+    d = hidden // nh
+    mk = lambda *sh: jnp.asarray(
+        (rng.standard_normal(sh) * 0.1).astype(np.float32), jnp.float32)
+    ws = []
+    for _ in range(n_layers):
+        ws.append(BlockDecodeWeights(
+            ln1=jnp.asarray(1.0 + 0.1 * rng.standard_normal(hidden)
+                            .astype(np.float32)),
+            wq=mk(hidden, nh * d), wk=mk(hidden, nkv * d),
+            wv=mk(hidden, nkv * d), wo=mk(nh * d, hidden),
+            ln2=jnp.asarray(1.0 + 0.1 * rng.standard_normal(hidden)
+                            .astype(np.float32)),
+            wg=mk(hidden, inter), wu=mk(hidden, inter),
+            wd=mk(inter, hidden)))
+    x = mk(b, hidden)
+    kps = [mk(nkv, num_pages, page, d) for _ in range(n_layers)]
+    vps = [mk(nkv, num_pages, page, d) for _ in range(n_layers)]
+    perm = rng.permutation(num_pages - 1)[:b * mp].reshape(b, mp) + 1
+    bt = jnp.asarray(perm, jnp.int32)
+    sl = jnp.asarray(seq_lens, jnp.int32)
+    return x, ws, kps, vps, bt, sl, dict(num_heads=nh, num_kv_heads=nkv,
+                                         rope_theta=10000.0, epsilon=1e-5)
+
+
+class TestFusedKernelsQuantized:
+    @pytest.mark.pallas_interpret
+    def test_single_layer_int8_pool(self):
+        rng = np.random.default_rng(3)
+        x, ws, kps, vps, bt, sl, kw = _mk_layers(rng, 1)
+        kq, vq = quantize_pool(kps[0]), quantize_pool(vps[0])
+        o_ref, kr, vr = fused_block_decode_ref(x, ws[0], kq, vq, bt, sl,
+                                               **kw)
+        o_ker, kk, vk = fused_block_decode_pallas(x, ws[0], kq, vq, bt,
+                                                  sl, interpret=True,
+                                                  **kw)
+        np.testing.assert_allclose(np.asarray(o_ker), np.asarray(o_ref),
+                                   **KTOL)
+        # the appended token's pool bits must agree EXACTLY: both paths
+        # quantize the same folded k/v rows
+        np.testing.assert_array_equal(np.asarray(kk.q), np.asarray(kr.q))
+        np.testing.assert_array_equal(np.asarray(vk.scale),
+                                      np.asarray(vr.scale))
+        # and the step itself is tolerance-close to the native pool
+        o_nat, _, _ = fused_block_decode_ref(x, ws[0], kps[0], vps[0],
+                                             bt, sl, **kw)
+        np.testing.assert_allclose(np.asarray(o_ref), np.asarray(o_nat),
+                                   **QTOL)
+
+    @pytest.mark.pallas_interpret
+    @pytest.mark.parametrize("kv_q,wt4", [(False, True), (True, False),
+                                          (True, True)])
+    def test_nlayer_combos(self, kv_q, wt4):
+        """The N-layer kernel across the quantization matrix: kernel
+        matches the pure-jnp ref (which unpacks int4 via
+        ``unpack_int4_tiles`` up front — so parity here IS the
+        in-kernel-unpack exactness check)."""
+        rng = np.random.default_rng(40 + 2 * kv_q + wt4)
+        x, ws, kps, vps, bt, sl, kw = _mk_layers(rng, 2)
+        mw = stack_block_weights(ws,
+                                 weight_dtype="int4" if wt4 else "native")
+        if wt4:
+            assert isinstance(mw.wqkv, Int4Tiles)
+        if kv_q:
+            kps = [quantize_pool(p) for p in kps]
+            vps = [quantize_pool(p) for p in vps]
+        o_ref, kr, vr = fused_multi_block_decode_ref(x, mw, kps, vps,
+                                                     bt, sl, **kw)
+        o_ker, kk, vk = fused_multi_block_decode_pallas(
+            x, mw, kps, vps, bt, sl, interpret=True, **kw)
+        np.testing.assert_allclose(np.asarray(o_ker), np.asarray(o_ref),
+                                   **KTOL)
+        for i in range(2):
+            if kv_q:
+                np.testing.assert_array_equal(np.asarray(kk[i].q),
+                                              np.asarray(kr[i].q))
+                np.testing.assert_array_equal(np.asarray(vk[i].q),
+                                              np.asarray(vr[i].q))
+            else:
+                np.testing.assert_allclose(np.asarray(kk[i]),
+                                           np.asarray(kr[i]), rtol=2e-6,
+                                           atol=2e-6)
+
+
+# --------------------------------------------------------- int4 tiles
+class TestInt4Tiles:
+    def test_roundtrip_exact_on_grid(self):
+        """Weights already on the quantization grid (int levels × a
+        power-of-two tile scale) survive pack→unpack BIT-exactly:
+        amax = 7·2^e reconstructs the scale without rounding."""
+        rng = np.random.default_rng(4)
+        n, rows, cols, tr, tc = 2, 32, 24, 8, 12
+        levels = rng.integers(-7, 8, (n, rows, cols)).astype(np.float32)
+        # force each (tr, tc) tile to actually contain a ±7 so amax
+        # reconstructs the intended scale
+        levels[:, ::tr, ::tc] = 7.0
+        tile_scale = np.exp2(
+            rng.integers(-1, 2, (n, rows // tr, cols // tc))
+        ).astype(np.float32)
+        w = levels * np.repeat(np.repeat(tile_scale, tr, 1), tc, 2)
+        t = pack_int4_tiles(jnp.asarray(w), tr, tc)
+        assert t.q.dtype == jnp.uint8 and t.q.shape == (n, rows // 2, cols)
+        np.testing.assert_array_equal(np.asarray(unpack_int4_tiles(t)), w)
+
+    def test_error_bounded_off_grid(self):
+        rng = np.random.default_rng(5)
+        w = rng.standard_normal((1, 16, 16)).astype(np.float32)
+        t = pack_int4_tiles(jnp.asarray(w), 8, 8)
+        back = np.asarray(unpack_int4_tiles(t))
+        # per-tile bound: half a quantization step = amax/14
+        for r in range(2):
+            for c in range(2):
+                tile = w[0, r * 8:(r + 1) * 8, c * 8:(c + 1) * 8]
+                err = np.abs(back[0, r * 8:(r + 1) * 8,
+                                  c * 8:(c + 1) * 8] - tile)
+                assert err.max() <= np.abs(tile).max() / 14 + 1e-6
+
+    def test_odd_tiling_rejected(self):
+        with pytest.raises(ValueError):
+            pack_int4_tiles(jnp.zeros((1, 9, 8)), 3, 8)
+
+
+# ------------------------------------------------------- pool + ledger
+class TestQuantizedPool:
+    def _pool(self, **kw):
+        kw.setdefault("kv_dtype", "int8")
+        return PagedKVCache(num_layers=2, num_pages=8, page_size=8,
+                            num_kv_heads=2, head_dim=16, max_batch=2,
+                            max_seq_len=64, **kw)
+
+    def test_ledger_bills_quantized_bytes(self):
+        pool = self._pool()
+        led = pool.ledger()
+        # int8 payload + one f32 scale per token row, per K and V
+        assert led["bytes_per_page"] == 2 * 2 * 2 * 8 * (16 + 4)
+        assert led["bytes_per_page"] == pool.bytes_per_page
+        pool.allocate(0, 20)
+        led = pool.ledger()
+        assert led["bytes_in_use"] == 3 * led["bytes_per_page"]
+        # denser than the same geometry un-quantized: 2d/(d+4) vs the
+        # bf16 default (1.6x at this test's d=16; ~1.94x at d=128) and
+        # 4d/(d+4) vs f32
+        bf16 = PagedKVCache(num_layers=2, num_pages=8, page_size=8,
+                            num_kv_heads=2, head_dim=16, max_batch=2,
+                            max_seq_len=64)
+        assert bf16.bytes_per_page / pool.bytes_per_page == pytest.approx(
+            2 * 16 / (16 + 4))
+        f32 = PagedKVCache(num_layers=2, num_pages=8, page_size=8,
+                           num_kv_heads=2, head_dim=16, max_batch=2,
+                           max_seq_len=64, dtype=jnp.float32)
+        assert f32.bytes_per_page / pool.bytes_per_page == pytest.approx(
+            4 * 16 / (16 + 4))
+
+    def test_spill_restore_bit_exact(self):
+        """Host-tier round trip moves payload AND scales verbatim."""
+        pool = self._pool()
+        rng = np.random.default_rng(6)
+        pid = pool.take_free_page()
+        want = []
+        for i in range(2):
+            kq, ks = quantize_kv_rows(
+                jnp.asarray(rng.standard_normal((2, 8, 16)), jnp.float32))
+            vq, vs = quantize_kv_rows(
+                jnp.asarray(rng.standard_normal((2, 8, 16)), jnp.float32))
+            pool.k_pages[i] = QuantizedPages(
+                pool.k_pages[i].q.at[:, pid].set(kq),
+                pool.k_pages[i].scale.at[:, pid].set(ks))
+            pool.v_pages[i] = QuantizedPages(
+                pool.v_pages[i].q.at[:, pid].set(vq),
+                pool.v_pages[i].scale.at[:, pid].set(vs))
+            want.append((kq, ks, vq, vs))
+        host = pool.spill_page(pid)
+        assert host.nbytes == pool.bytes_per_page
+        assert pool.ledger()["pages_spilled"] == 1
+        pool.unref_page(pid)
+        new = pool.take_free_page()
+        pool.restore_page(host, new)
+        assert pool.ledger()["pages_spilled"] == 0
+        for i, (kq, ks, vq, vs) in enumerate(want):
+            np.testing.assert_array_equal(
+                np.asarray(pool.k_pages[i].q[:, new]), np.asarray(kq))
+            np.testing.assert_array_equal(
+                np.asarray(pool.k_pages[i].scale[:, new]), np.asarray(ks))
+            np.testing.assert_array_equal(
+                np.asarray(pool.v_pages[i].q[:, new]), np.asarray(vq))
+            np.testing.assert_array_equal(
+                np.asarray(pool.v_pages[i].scale[:, new]), np.asarray(vs))
+
+    def test_planner_agrees_with_ledger(self):
+        """memwatch's kv-pool term vs the live int8 pool's ledger: the
+        10% plan-vs-ledger acceptance bar (they agree exactly)."""
+        cfg = LlamaConfig.tiny()
+        dims = memwatch.ModelDims.of_config(cfg)
+        pool = PagedKVCache(num_layers=cfg.num_hidden_layers, num_pages=9,
+                            page_size=8,
+                            num_kv_heads=cfg.num_key_value_heads,
+                            head_dim=cfg.hidden_size
+                            // cfg.num_attention_heads,
+                            max_batch=2, max_seq_len=48,
+                            reserve_null_page=True, kv_dtype="int8")
+        led = pool.ledger()
+        plan = memwatch.estimate_engine_memory(
+            dims, page_size=8, page_budget=led["usable_pages"],
+            max_batch=2, max_seq_len=48, chunk=0, kv_dtype="int8",
+            param_count=dims.param_count)
+        want = led["bytes_per_page"] * (led["usable_pages"] + 1)
+        got = plan["breakdown"]["kv_pool"]
+        assert abs(got - want) / want <= 0.10
+        # geometry probe prices the quantized pool from the pool itself
+        geom = memwatch.PoolGeometry.of_pool(pool)
+        assert geom.kv_quant and geom.pool_bytes() == want
+
+
+# ------------------------------------------------------------- engine
+def _gpt(seed=7):
+    paddle.seed(seed)
+    cfg = GPTConfig.tiny()
+    return cfg, GPTForCausalLM(cfg)
+
+
+def _run(model, prompts, max_new, **kw):
+    eng = ServingEngine(model, max_batch=kw.pop("max_batch", 2),
+                        page_size=8,
+                        max_seq_len=kw.pop("max_seq_len", 64), **kw)
+    rids = [eng.submit(p, max_new) for p in prompts]
+    out = eng.run(max_wall=300.0)
+    return eng, [out[r] for r in rids]
+
+
+class TestEngineQuantized:
+    def test_generic_int8_parity_keys_and_zero_retrace(self):
+        cfg, model = _gpt()
+        rng = np.random.default_rng(8)
+        prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+                   for n in (6, 9)]
+        _, native = _run(model, prompts, 6)
+        clear_decode_program_cache()
+        cache = decode_program_cache()
+        eng = ServingEngine(model, max_batch=2, page_size=8,
+                            max_seq_len=64, kv_dtype="int8")
+        assert isinstance(eng.pool.k_pages[0], QuantizedPages)
+        rids = [eng.submit(p, 6) for p in prompts]
+        eng.step()
+        key = eng.decode_key
+        assert key.dtype == "int8"
+        assert "('kv', 'int8')" in str(key.extra)
+        assert "('wt', 'native')" in str(key.extra)
+        traced = cache.trace_count(key)
+        while eng.has_work():
+            eng.step()
+        assert cache.trace_count(key) == traced, \
+            "int8-KV decode retraced at a fixed batch bucket"
+        out = [eng.results()[r] for r in rids]
+        # tiny-GPT greedy argmaxes are insensitive to the quantization
+        # noise: tokens are outright identical to the native pool here
+        # (the logit-level tolerance contract is the kernel tests')
+        assert out == native
+
+    def test_nlayer_int8_int4_keys_and_consistency(self):
+        """int4 weights DO perturb logits beyond a random tiny model's
+        greedy margin, so token equality with the native arm is not the
+        contract (the kernel tests own the tolerance bar). What the
+        engine owes: the quantized program keyed apart from the native
+        one, zero steady-state retraces, deterministic output, and
+        first-token agreement (the first token comes off the native-
+        precision prefill logits)."""
+        paddle.seed(91)
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLM(cfg)
+        rng = np.random.default_rng(9)
+        prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+                   for n in (5, 9)]
+        cache = decode_program_cache()
+        with set_flags(fused_block_layers=2):
+            _, native = _run(model, prompts, 6, max_seq_len=48)
+            eng = ServingEngine(model, max_batch=2, page_size=8,
+                                max_seq_len=48, kv_dtype="int8",
+                                weight_dtype="int4")
+            rids = [eng.submit(p, 6) for p in prompts]
+            eng.step()
+            key = eng.decode_key
+            traced = cache.trace_count(key)
+            while eng.has_work():
+                eng.step()
+            quant = [eng.results()[r] for r in rids]
+            assert cache.trace_count(key) == traced, \
+                "quantized N-layer decode retraced at a fixed bucket"
+            # a second engine over the same signature + dtypes reuses
+            # the compiled program and reproduces the tokens bit-for-bit
+            eng2, quant2 = _run(model, prompts, 6, max_seq_len=48,
+                                kv_dtype="int8", weight_dtype="int4")
+            assert eng2.decode_key == key
+            assert cache.trace_count(key) == traced
+        assert key.kind == "decode_fused_nlayer"
+        assert "('kv', 'int8')" in str(key.extra)
+        assert "('wt', 'int4')" in str(key.extra)
+        assert isinstance(eng._stacked[0].wqkv, Int4Tiles)
+        assert quant2 == quant
+        assert all(len(t) == 6 for t in quant)
+        assert [t[0] for t in quant] == [t[0] for t in native]
+
+    @pytest.mark.faults
+    def test_fault_replay_bit_identical_on_int8_pool(self):
+        """The acceptance criterion: greedy fault-replay on the int8
+        pool reproduces the unfaulted run BIT-identically (write-order-
+        independent per-token scales make replayed pool bits equal)."""
+        cfg, model = _gpt(51)
+        rng = np.random.default_rng(17)
+        prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+                   for n in (5, 9, 6, 11)]
+
+        def fault_spec(spec, **extra):
+            extra.setdefault("serving_retry_backoff", 0.001)
+            return faults.armed(spec, **extra)
+
+        def injected_total():
+            import paddle_tpu.observability as obs
+            fam = obs.snapshot()["metrics"].get("faults_injected")
+            return sum(s["value"] for s in fam["series"]) if fam else 0.0
+
+        _, baseline = _run(model, prompts, 6, kv_dtype="int8")
+        with fault_spec("decode_dispatch:every=4;prefill:p=0.2:seed=7",
+                        serving_max_retries=8):
+            eng, chaos = _run(model, prompts, 6, kv_dtype="int8")
+        assert injected_total() >= 1, "the drill must inject"
+        assert chaos == baseline
+        assert not eng.has_work()
+
+    @pytest.mark.spec
+    def test_spec_decode_int8_self_consistent(self):
+        """Speculative decoding over quantized target AND draft pools:
+        the schedule changes, the tokens don't."""
+        cfg, target = _gpt()
+        paddle.seed(99)
+        draft = GPTForCausalLM(cfg)
+        rng = np.random.default_rng(10)
+        prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+                   for n in (6, 4)]
+        _, plain = _run(target, prompts, 8, kv_dtype="int8")
+        eng, spec = _run(target, prompts, 8, kv_dtype="int8",
+                         draft_model=draft)
+        assert spec == plain
+        assert isinstance(eng._draft_pool.k_pages[0], QuantizedPages)
+        assert "('kv', 'int8')" in str(eng.spec_verify_key.extra)

@@ -92,7 +92,9 @@ class TestProgramCapture:
         eng.run()
         rows = {r["kind"]: r for r in memwatch.program_table()}
         assert "prefill_chunk" in rows
-        assert rows["prefill_chunk"]["extra"] == "8"
+        # extra = chunk width + the r18 kv/weight dtype discriminant
+        assert rows["prefill_chunk"]["extra"].startswith("8,")
+        assert "('kv', 'native')" in rows["prefill_chunk"]["extra"]
         assert rows["prefill_chunk"]["bucket"] == 1
 
     def test_two_models_do_not_collide(self):
@@ -201,6 +203,19 @@ class TestEstimator:
             dims, geom, eng.bucket, self._param_bytes(eng))
         self._check(est, self._compiled("decode_fused"))
 
+    def test_decode_estimate_fused_llama_int8_kv(self):
+        """The quantized program rides the same 10% bar: the estimator
+        prices the int8 pool (payload + scale rows) and the dequant
+        view temp (r18)."""
+        eng, cfg = _llama_engine(prompt_lens=(6,), kv_dtype="int8")
+        eng.run()
+        dims = memwatch.ModelDims.of_config(cfg)
+        geom = memwatch.PoolGeometry.of_pool(eng.pool)
+        assert geom.kv_quant
+        est = memwatch.estimate_decode_program(
+            dims, geom, eng.bucket, self._param_bytes(eng))
+        self._check(est, self._compiled("decode_fused"))
+
     def test_decode_estimate_generic_gpt(self):
         paddle.seed(94)
         cfg = GPTConfig.tiny()
@@ -245,9 +260,10 @@ class TestEstimator:
         # int8 weights: 1 byte/param + bounded scale overhead
         assert n <= b["weights"] <= int(n * 1.1)
         # kv pool arithmetic is exact: L * 2 * Hkv * (P+1) * page * D
-        # at 1 byte + per-page scales
+        # at 1 byte + per-TOKEN f32 amax scales (r18: one scale per
+        # cached token row, so replay is write-order independent)
         pool_raw = 32 * 2 * 32 * 513 * 64 * 128
-        assert b["kv_pool"] == pool_raw + 32 * 2 * 32 * 513 * 4
+        assert b["kv_pool"] == pool_raw + 32 * 2 * 32 * 513 * 64 * 4
         # verdicts are monotone in the page budget
         small = memwatch.estimate_engine_memory(
             dims, page_size=64, page_budget=64, max_batch=32,
@@ -468,12 +484,15 @@ class TestRegressionGate:
             rows, rows + [phantom], tolerance=TOL)
         assert {f["verdict"] for f in findings} == {"new"}
 
-    def test_banked_artifact_is_valid(self):
-        """The checked-in MEMWATCH_r17.json must stay loadable and
-        carry the capture suite's program rows (now incl. the r17
-        N-layer grouped decode program)."""
+    @pytest.mark.parametrize("artifact", ["MEMWATCH_r17.json",
+                                          "MEMWATCH_r18.json"])
+    def test_banked_artifact_is_valid(self, artifact):
+        """The checked-in artifacts must stay loadable and carry the
+        capture suite's program rows (r17 adds the N-layer grouped
+        decode program; r18 adds the int8-KV and int8+int4 quantized
+        rows, whose estimates ride the same 10% bar)."""
         path = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "MEMWATCH_r17.json")
+            os.path.abspath(__file__))), artifact)
         doc = json.load(open(path))
         assert doc["schema"] == 1 and doc["bench"] == "memwatch"
         kinds = {r["kind"] for r in doc["rows"]}
@@ -484,3 +503,10 @@ class TestRegressionGate:
         # banked estimator evidence stays inside the acceptance bar
         for e in doc["estimates"]:
             assert abs(e["rel_err"]) <= TOL
+        if artifact == "MEMWATCH_r18.json":
+            extras = {r["extra"] for r in doc["rows"]}
+            assert any("('kv', 'int8')" in x for x in extras)
+            assert any("('wt', 'int4')" in x for x in extras)
+            # the quantized rows' estimates are banked, not just rows
+            assert any("('kv', 'int8')" in e["extra"]
+                       for e in doc["estimates"])

@@ -73,3 +73,47 @@ def test_full_sweep():
     doc = serving_load.bench(per_tenant=16, seed=712, quick=False)
     _assert_acceptance(doc)
     assert doc["arms"]["chunked"]["bucket_migrations"] > 0
+
+
+# ------------------------------------------------------ kv-quant (r18)
+def _assert_kv_quant_acceptance(doc):
+    assert doc["ok"], json.dumps(
+        {k: v for k, v in doc.items() if k != "telemetry"}, indent=1)
+    # ~2x the page budget at fixed pool memory (vs a bf16 pool; this
+    # CPU artifact's native pool is f32, so the measured ratio is
+    # higher still) -- usable pages measured from the LEDGER
+    assert doc["pages"]["usable_page_ratio"] >= 1.8
+    assert (doc["pages"]["int8"]["usable_pages"]
+            > doc["pages"]["native"]["usable_pages"])
+    assert doc["plan_vs_ledger"]["within_10pct"], doc["plan_vs_ledger"]
+    # page-pressure queueing recedes with the denser pool
+    assert doc["page_pressure"]["receded"], doc["page_pressure"]
+    for arm, m in doc["arms"].items():
+        assert m["all_ok"], (arm, m["statuses"])
+        assert m["steady_retraces"] == 0, (arm, m["steady_retraces"])
+        assert m["rerun_bit_identical"], arm
+    assert "metrics" in doc["telemetry"]
+
+
+@pytest.mark.serving_load
+def test_kv_quant_quick_slice_meets_acceptance():
+    """The deterministic --kv-dtype int8 quick slice: fixed-memory page
+    accounting, plan-vs-ledger, pressure A/B, zero retraces."""
+    doc = serving_load.bench_kv_quant(seed=712, quick=True)
+    _assert_kv_quant_acceptance(doc)
+
+
+@pytest.mark.serving_load
+def test_kv_quant_banked_artifact_matches_schema():
+    """The checked-in KV_QUANT_r18.json was produced by this tool at
+    the acceptance bars (regenerate with ``python tools/serving_load.py
+    --kv-dtype int8 --out KV_QUANT_r18.json``)."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "KV_QUANT_r18.json")
+    if not os.path.exists(path):
+        pytest.skip("artifact not banked in this checkout")
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["schema"] == serving_load.KV_QUANT_SCHEMA
+    assert doc["bench"] == "kv_quant"
+    _assert_kv_quant_acceptance(doc)

@@ -20,13 +20,13 @@ accounting code path with the live capture, the benches, and
   this backend and bank every program's CompiledMemoryStats rows plus
   the estimator's predictions::
 
-      python tools/memwatch.py bank --out MEMWATCH_r17.json
+      python tools/memwatch.py bank --out MEMWATCH_r18.json
 
   **check** — re-run the same capture suite and flag any program whose
   temp/peak grew beyond tolerance vs the banked artifact (the memory
   analogue of the zero-retrace gate; exit code 1 on growth)::
 
-      python tools/memwatch.py check --artifact MEMWATCH_r17.json
+      python tools/memwatch.py check --artifact MEMWATCH_r18.json
 
   **view** — render a banked artifact (or any bench row with a
   ``"memory"`` section) as a table.
@@ -203,6 +203,17 @@ def capture_suite() -> dict:
                        .astype(np.int32), 4)
         eng.run()
         estimates += _engine_estimates(eng, lcfg, chunk=8)
+        # --- tiny Llama again, int8-quantized KV pool (r18): the fused
+        # decode + prefill rows against a QuantizedPages pool — the gate
+        # watches the quantized programs' sections (scale rows included)
+        paddle.seed(13)
+        qmodel = LlamaForCausalLM(lcfg)
+        eng = ServingEngine(qmodel, max_batch=2, page_size=8,
+                            max_seq_len=48, kv_dtype="int8")
+        eng.submit(rng.integers(0, lcfg.vocab_size, (6,))
+                   .astype(np.int32), 4)
+        eng.run()
+        estimates += _engine_estimates(eng, lcfg)
         # --- tiny Llama again, N-layer grouped decode (r17): banks the
         # decode_fused_nlayer rows so the gate watches the grouped
         # program's sections too
@@ -213,6 +224,17 @@ def capture_suite() -> dict:
             nmodel = LlamaForCausalLM(lcfg)
             eng = ServingEngine(nmodel, max_batch=2, page_size=8,
                                 max_seq_len=48)
+            eng.submit(rng.integers(0, lcfg.vocab_size, (6,))
+                       .astype(np.int32), 4)
+            eng.run()
+            estimates += _engine_estimates(eng, lcfg, fused_layers=2)
+            # --- N-layer again with int4 weight tiles + int8 KV (r18):
+            # the fully-quantized grouped program's rows
+            paddle.seed(13)
+            n4model = LlamaForCausalLM(lcfg)
+            eng = ServingEngine(n4model, max_batch=2, page_size=8,
+                                max_seq_len=48, kv_dtype="int8",
+                                weight_dtype="int4")
             eng.submit(rng.integers(0, lcfg.vocab_size, (6,))
                        .astype(np.int32), 4)
             eng.run()
@@ -256,12 +278,20 @@ def _engine_estimates(eng, cfg, chunk=None, fused_layers=1):
               if v is not None)
     out = []
     sig = eng._model_sig[:8]            # only THIS engine's programs
+    # every DecodeKey.extra now carries the kv/weight dtype discriminant
+    # (r18) — match it too, or a same-model engine pair (native + int8
+    # pool) would cross-attribute each other's rows
+    tag_kv = str(("kv", eng.kv_dtype))
+    tag_wt = str(("wt", eng.weight_dtype))
     rows = {(r["kind"], r["bucket"], r["extra"]): r
-            for r in memwatch.program_table() if r["model"] == sig}
+            for r in memwatch.program_table()
+            if r["model"] == sig and tag_kv in r["extra"]
+            and tag_wt in r["extra"]}
     for (kind, bucket, extra), row in sorted(rows.items()):
         if kind == "decode_fused_nlayer":
             est = memwatch.estimate_decode_program(
-                dims, geom, bucket, pb, fused_layers=fused_layers)
+                dims, geom, bucket, pb, fused_layers=fused_layers,
+                int4_weights=eng.weight_dtype == "int4")
         elif kind.startswith("decode"):
             est = memwatch.estimate_decode_program(dims, geom, bucket, pb)
         elif kind == "prefill_chunk" and chunk:
@@ -417,7 +447,7 @@ def main() -> int:
     p.set_defaults(fn=cmd_bank)
 
     p = sub.add_parser("check", help="regression gate vs banked artifact")
-    p.add_argument("--artifact", default="MEMWATCH_r17.json")
+    p.add_argument("--artifact", default="MEMWATCH_r18.json")
     p.add_argument("--tol", type=float, default=0.10)
     p.set_defaults(fn=cmd_check)
 

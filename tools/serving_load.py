@@ -74,6 +74,16 @@ layers are damped to near-identity residuals and the 1-layer draft
 SHARES the target's embedding, layer-0, final-norm and head weights —
 high agreement with real rejections, at a quarter of the layer cost.
 ``--out SPEC_DECODE_r16.json`` banks the ledger.
+
+``--kv-dtype int8`` (r18) runs the QUANTIZED-KV acceptance bench — a
+native-vs-int8 pool A/B at FIXED pool memory: the native arm's pool
+bytes re-spent on int8 pages (payload + per-token f32 scales) must buy
+~2x the usable page budget, measured from the pool LEDGER rather than
+the planner, the page-pressure queueing regime must recede (smaller
+queue-depth integral over the drain), int8 re-runs are bit-identical
+(deterministic amax quantization), the analytic ``memwatch plan`` pool
+term agrees with the ledger within 10%, and the retrace ledger stays
+at zero. ``--out KV_QUANT_r18.json`` banks the ledger.
 """
 
 import argparse
@@ -943,6 +953,185 @@ def bench_spec(seed, quick=False):
     }
 
 
+# ================================================= kv-quant bench (r18)
+KV_QUANT_SCHEMA = 1
+
+
+def _kv_quant_model(cfg):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(1234)
+    mcfg = GPTConfig.tiny()
+    mcfg.max_position_embeddings = cfg["max_seq_len"]
+    return mcfg, GPTForCausalLM(mcfg)
+
+
+def _kv_quant_engine(model, cfg, kv_dtype, usable_pages):
+    from paddle_tpu.generation.serving import ServingEngine
+
+    return ServingEngine(model, max_batch=cfg["max_batch"],
+                         page_size=cfg["page_size"],
+                         max_seq_len=cfg["max_seq_len"],
+                         num_pages=usable_pages + 1,
+                         kv_dtype=kv_dtype)
+
+
+def _kv_quant_drain(eng, prompts, max_new):
+    """Submit everything up front and step to drain: how many scheduler
+    steps the backlog takes, and the queue-depth integral over them —
+    the page-pressure queueing regime made visible as one number."""
+    rids = [eng.submit(p, max_new) for p in prompts]
+    steps = 0
+    queue_steps = 0
+    while eng.has_work():
+        queue_steps += len(eng._queue)
+        eng.step()
+        steps += 1
+    out = eng.results()
+    return {"rids": rids,
+            "outputs": [out.get(r, []) for r in rids],
+            "statuses": [eng.status(r) for r in rids],
+            "steps_to_drain": steps,
+            "queue_depth_integral": queue_steps}
+
+
+def bench_kv_quant(seed, quick=False):
+    """The r18 quantized-KV A/B at FIXED pool memory: the bf16/native
+    arm's byte budget, re-spent on int8 pages, must buy ~2x (on an f32
+    CPU pool: more) the usable page budget — measured from the pool
+    LEDGER, never the planner — and the page-pressure queueing regime
+    must recede (smaller queue-depth integral, no more drain steps).
+    The int8 arm re-runs bit-identically (amax quantization is
+    deterministic and write-order independent), the analytic
+    ``memwatch plan`` pool term agrees with the ledger within the 10%
+    bar, and the retrace ledger stays at zero across the measured
+    passes of both arms."""
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu.observability as obs
+    from paddle_tpu.observability import memory as memwatch
+
+    cfg = (dict(vocab=256, max_batch=8, page_size=8, max_seq_len=128,
+                native_pages=9, prompt_len=24, max_new=8, requests=6)
+           if quick else
+           dict(vocab=256, max_batch=8, page_size=8, max_seq_len=128,
+                native_pages=9, prompt_len=24, max_new=8, requests=10))
+    mcfg, model = _kv_quant_model(cfg)
+    rng = np.random.default_rng((seed, 7))
+    prompts = [rng.integers(0, cfg["vocab"],
+                            (cfg["prompt_len"],)).astype(np.int32)
+               for _ in range(cfg["requests"])]
+
+    # ---- fixed-memory page accounting, ledger-measured: the native
+    # arm's pool bytes are the budget; the int8 arm spends the same
+    # bytes on quantized pages (int8 payload + f32 per-token scales)
+    native_eng = _kv_quant_engine(model, cfg, "native",
+                                  cfg["native_pages"])
+    nled = native_eng.pool.ledger()
+    budget = nled["bytes_per_page"] * nled["usable_pages"]
+    int8_probe = _kv_quant_engine(model, cfg, "int8", 1)
+    int8_bpp = int8_probe.pool.ledger()["bytes_per_page"]
+    int8_pages = budget // int8_bpp
+    int8_eng = _kv_quant_engine(model, cfg, "int8", int8_pages)
+    iled = int8_eng.pool.ledger()
+    ratio = iled["usable_pages"] / nled["usable_pages"]
+    pages = {
+        "byte_budget": int(budget),
+        "native": {"usable_pages": nled["usable_pages"],
+                   "bytes_per_page": nled["bytes_per_page"]},
+        "int8": {"usable_pages": iled["usable_pages"],
+                 "bytes_per_page": iled["bytes_per_page"]},
+        "usable_page_ratio": round(ratio, 4),
+        # the bf16-pool reference ratio (2-byte payload): what the same
+        # A/B yields on chip, where pools store bf16 rather than f32
+        "bf16_reference_ratio": round(
+            2 * (nled["bytes_per_page"] // 4) / int8_bpp, 4),
+    }
+
+    # ---- memwatch plan's analytic pool term vs the measured ledger
+    dims = memwatch.ModelDims.of_config(mcfg)
+    plan = memwatch.estimate_engine_memory(
+        dims, page_size=cfg["page_size"],
+        page_budget=iled["usable_pages"], max_batch=cfg["max_batch"],
+        max_seq_len=cfg["max_seq_len"], kv_dtype="int8",
+        param_count=dims.param_count or sum(
+            int(np.prod(v.shape)) for v in model.raw_state()[0].values()))
+    ledger_pool_bytes = iled["bytes_per_page"] * (iled["usable_pages"] + 1)
+    plan_pool_bytes = plan["breakdown"]["kv_pool"]
+    plan_rel_err = plan_pool_bytes / ledger_pool_bytes - 1.0
+    planfit = {"plan_kv_pool_bytes": int(plan_pool_bytes),
+               "ledger_kv_pool_bytes": int(ledger_pool_bytes),
+               "rel_err": round(plan_rel_err, 4),
+               "within_10pct": bool(abs(plan_rel_err) <= 0.10)}
+
+    # ---- the queueing A/B: pass 1 warms every program (admission,
+    # chunkless prefill, each rung the backlog visits), pass 2 is
+    # measured under the zero-retrace bar
+    arms = {}
+    outputs = {}
+    for arm, pages_arm in (("native", nled["usable_pages"]),
+                           ("int8", iled["usable_pages"])):
+        runs = []
+        before = after = None
+        for p in range(2):
+            eng = _kv_quant_engine(model, cfg, arm, pages_arm)
+            if p == 1:
+                before = obs.snapshot()
+            runs.append(_kv_quant_drain(eng, prompts, cfg["max_new"]))
+            if p == 1:
+                after = obs.snapshot()
+        meas = runs[1]
+        arms[arm] = {
+            "requests": cfg["requests"],
+            "steps_to_drain": meas["steps_to_drain"],
+            "queue_depth_integral": meas["queue_depth_integral"],
+            "statuses": {s: meas["statuses"].count(s)
+                         for s in set(meas["statuses"])},
+            "all_ok": all(s == "OK" for s in meas["statuses"]),
+            "steady_retraces": trace_total(after) - trace_total(before),
+            "rerun_bit_identical": runs[0]["outputs"] == meas["outputs"],
+        }
+        outputs[arm] = meas["outputs"]
+
+    # cross-arm token agreement is informational: int8 attention is
+    # tolerance-bounded, not bit-equal, so greedy argmax may flip —
+    # the tolerance contract lives in the kernel parity tests
+    agree = [sum(1 for a, b in zip(x, y) if a == b) / max(len(x), 1)
+             for x, y in zip(outputs["native"], outputs["int8"])]
+    receding = {
+        "native_queue_depth_integral":
+            arms["native"]["queue_depth_integral"],
+        "int8_queue_depth_integral": arms["int8"]["queue_depth_integral"],
+        "receded": bool(arms["int8"]["queue_depth_integral"]
+                        < arms["native"]["queue_depth_integral"]
+                        and arms["int8"]["steps_to_drain"]
+                        <= arms["native"]["steps_to_drain"]),
+    }
+    ok = (ratio >= 1.8
+          and planfit["within_10pct"]
+          and receding["receded"]
+          and all(a["all_ok"] for a in arms.values())
+          and all(a["steady_retraces"] == 0 for a in arms.values())
+          and arms["int8"]["rerun_bit_identical"]
+          and arms["native"]["rerun_bit_identical"])
+    return {
+        "schema": KV_QUANT_SCHEMA, "bench": "kv_quant",
+        "backend": jax.default_backend(), "seed": seed,
+        "config": {**cfg, "quick": bool(quick)},
+        "pages": pages,
+        "plan_vs_ledger": planfit,
+        "arms": arms,
+        "page_pressure": receding,
+        "token_agreement_per_request": [round(a, 4) for a in agree],
+        "ok": bool(ok),
+        "telemetry": obs.snapshot(),
+        "memory": obs.memory.section() if obs.enabled() else None,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=None,
@@ -962,10 +1151,18 @@ def main():
                          "bench (batch-1 plain-vs-spec throughput A/B "
                          "+ the γ-vs-occupancy ladder) instead of the "
                          "single-engine chunked/monolithic A/B")
+    ap.add_argument("--kv-dtype", default=None, choices=("int8",),
+                    help="run the r18 quantized-KV acceptance bench: "
+                         "native-vs-int8 pool A/B at FIXED pool memory "
+                         "(~2x the usable page budget, measured from "
+                         "the ledger; page-pressure queueing recedes; "
+                         "bit-identical re-runs; zero retraces)")
     args = ap.parse_args()
 
     doc = (bench_fleet(args.seed, quick=args.quick) if args.fleet
            else bench_spec(args.seed, quick=args.quick) if args.spec
+           else bench_kv_quant(args.seed, quick=args.quick)
+           if args.kv_dtype
            else bench(args.per_tenant, args.seed, quick=args.quick))
     brief = {k: v for k, v in doc.items() if k != "telemetry"}
     print(json.dumps(brief, indent=2, sort_keys=True))
