@@ -454,6 +454,17 @@ define_flag("fused_weight_dtype", "native",
             "memory headroom on top of int8 streaming). LayerNorm "
             "params stay native. Eager-only; part of program "
             "identity via DecodeKey.extra.")
+define_flag("serving_tp_degree", 1,
+            "Tensor-parallel degree of ServingEngine decode: > 1 "
+            "shards the fused stacked weights column/row-wise (the "
+            "shard_block_weights Megatron layout) and the paged KV "
+            "pool over kv-heads across the mp axis, running the block "
+            "chain under shard_map with two psums per layer. The mp "
+            "process group (fleet.init) names the axis and devices "
+            "when its world size matches; otherwise the first N local "
+            "devices under 'mp'. Eager-only: the degree reaches "
+            "compiled programs through the program-cache key "
+            "(DecodeKey.extra), never a traced flag read.")
 define_flag("train_max_retries", 2,
             "Model.fit step-recovery budget: retries of a failed "
             "dispatch (sync to last-good state, emergency checkpoint, "

@@ -103,8 +103,13 @@ class FleetRouter:
     replica one scheduler round; ``poll``/``results``/``take_results``/
     ``status`` pass through with rid translation; ``run`` steps until
     drained. Engine keyword arguments (page budget, ladder, chunk,
-    ``host_tier_pages``, ...) apply to every replica; ``prefix_cache``
-    defaults ON here — affinity is pointless without it."""
+    ``host_tier_pages``, ``tp_degree``, ...) apply to every replica;
+    ``prefix_cache`` defaults ON here — affinity is pointless without
+    it. ``tp_degree > 1`` makes every replica a tensor-parallel decode
+    group over the SAME mp device set (r19) — the fleet axis stays a
+    routing construct, so replica loss/rebuild and re-route replay are
+    untouched by tp; the per-engine ``tp`` metric label keeps a mixed
+    fleet's series apart."""
 
     POLICIES = ("prefix_affinity", "round_robin")
 

@@ -88,6 +88,17 @@ def model_signature(model) -> str:
     return hashlib.md5("|".join(parts).encode()).hexdigest()
 
 
+def _key_tp(key: DecodeKey) -> str:
+    """Tensor-parallel degree a key was built under, as a label value.
+    The degree rides ``extra`` as a ``("tp", n)`` pair ONLY when the
+    engine is armed (tp > 1), so every tp=1 key — and every pre-tp key —
+    resolves to the default "1" without a schema change."""
+    for item in key.extra:
+        if isinstance(item, tuple) and len(item) == 2 and item[0] == "tp":
+            return str(item[1])
+    return "1"
+
+
 class DecodeProgramCache:
     """Thread-safe keyed cache of compiled decode steps with per-key
     trace counting."""
@@ -128,12 +139,14 @@ class DecodeProgramCache:
                 "jax (re)traces of cached programs (steady state: one "
                 "per key); model = signature prefix, so two models' "
                 "programs — or a fleet serving several — never share "
-                "a series", labels=("kind", "model"))
+                "a series; tp = tensor-parallel degree from the key "
+                "(\"1\" unless the engine sharded the program)",
+                labels=("kind", "model", "tp"))
             self._m_compile = r.histogram(
                 "program_cache_compile_seconds",
                 "wall clock of dispatches that (re)traced — trace + "
-                "compile cost per program kind and model",
-                labels=("kind", "model"))
+                "compile cost per program kind, model and tp degree",
+                labels=("kind", "model", "tp"))
         else:
             self._m_hits = self._m_misses = obs.NULL
             self._m_traces = self._m_compile = obs.NULL
@@ -176,7 +189,8 @@ class DecodeProgramCache:
             with self._lock:
                 self._trace_counts[key] = self._trace_counts.get(key, 0) + 1
             self._m_traces.labels(kind=key.kind,
-                                  model=key.model_sig[:8]).inc()
+                                  model=key.model_sig[:8],
+                                  tp=_key_tp(key)).inc()
         return note_trace
 
     def _timed_dispatch(self, key: DecodeKey, fn):
@@ -193,7 +207,8 @@ class DecodeProgramCache:
         with self._lock:
             cell = self._trace_cells.setdefault(key, [0])
         hist = self._m_compile.labels(kind=key.kind,
-                                      model=key.model_sig[:8])
+                                      model=key.model_sig[:8],
+                                      tp=_key_tp(key))
 
         def dispatch(*args, **kwargs):
             before = cell[0]

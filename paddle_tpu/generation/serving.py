@@ -115,24 +115,31 @@ class _EngineTelemetry:
     process — the fleet case — used to collide on one series, so one
     replica's TTFT polluted another's and the KV gauges flapped between
     pools. The label is threaded from the engine's ``replica`` id and
-    each engine binds its own child instruments here, once."""
+    each engine binds its own child instruments here, once.
+
+    Every family also carries a ``tp`` label (r19, the tensor-parallel
+    degree, "1" for a solo engine): one FLT005-clean schema per family
+    everywhere it is registered, so a tp=2 engine's series never merge
+    with a solo replica's in a mixed fleet."""
 
     enabled = True
 
-    def __init__(self, replica: str = "0"):
+    def __init__(self, replica: str = "0", tp: str = "1"):
         r = obs.registry()
         t = obs.tracer()
-        rl = ("replica",)
+        rl = ("replica", "tp")
 
         def c(name, help):
-            return r.counter(name, help, labels=rl).labels(replica=replica)
+            return r.counter(name, help,
+                             labels=rl).labels(replica=replica, tp=tp)
 
         def g(name, help):
-            return r.gauge(name, help, labels=rl).labels(replica=replica)
+            return r.gauge(name, help,
+                           labels=rl).labels(replica=replica, tp=tp)
 
         def h(name, help):
             return r.histogram(name, help,
-                               labels=rl).labels(replica=replica)
+                               labels=rl).labels(replica=replica, tp=tp)
 
         self.span = t.span
         self.event = t.event
@@ -248,6 +255,12 @@ class _EngineTelemetry:
             "round: per-request adaptive within the "
             "FLAGS_serving_spec_rungs set, capped down as batch "
             "occupancy prices speculation out")
+        # ---- tensor-parallel decode (r19)
+        self.collective_s = h(
+            "serving_collective_seconds",
+            "wall clock of one tensor-parallel sharded decode dispatch "
+            "(per-layer psum pair + compute), observed host-side at the "
+            "dispatch boundary — only tp > 1 engines write it")
         # ---- memwatch pool ledger (r13): step-end gauges over the
         # PagedKVCache ledger, pre-resolved per state label; "spilled"
         # (r14) is the host-RAM tier
@@ -257,14 +270,15 @@ class _EngineTelemetry:
             "the prefix cache), free, shared (refcount > 1), pinned "
             "(prefix pages an in-flight request's block table holds), "
             "spilled (prefix pages resident only in the host-RAM tier)",
-            labels=("replica", "state"))
+            labels=("replica", "tp", "state"))
         pbytes = r.gauge(
             "kv_pool_bytes",
             "KV page-pool ledger in bytes (all layers, k+v)",
-            labels=("replica", "state"))
-        self.pool_pages = {s: pages.labels(replica=replica, state=s)
+            labels=("replica", "tp", "state"))
+        self.pool_pages = {s: pages.labels(replica=replica, tp=tp, state=s)
                            for s in _POOL_STATES}
-        self.pool_bytes = {s: pbytes.labels(replica=replica, state=s)
+        self.pool_bytes = {s: pbytes.labels(replica=replica, tp=tp,
+                                            state=s)
                            for s in _POOL_STATES}
         self.pool_frag = g(
             "kv_pool_fragmentation",
@@ -284,7 +298,7 @@ class _NullEngineTelemetry:
 
     enabled = False
 
-    def __init__(self, replica: str = "0"):
+    def __init__(self, replica: str = "0", tp: str = "1"):
         self.span = obs.null_span
         self.event = obs.null_event
         self.submitted = self.finished = self.prefills = obs.NULL
@@ -301,7 +315,7 @@ class _NullEngineTelemetry:
         self.preemptions = self.preempted_tokens = obs.NULL
         self.spec_rounds_c = self.spec_accept = obs.NULL
         self.spec_accepted = self.spec_rejected = obs.NULL
-        self.spec_gamma = obs.NULL
+        self.spec_gamma = self.collective_s = obs.NULL
         self.pool_pages = {s: obs.NULL for s in _POOL_STATES}
         self.pool_bytes = {s: obs.NULL for s in _POOL_STATES}
         self.pool_frag = self.host_tier_peak = obs.NULL
@@ -734,7 +748,8 @@ class ServingEngine:
                  host_tier_pages: Optional[int] = None,
                  draft_model=None,
                  kv_dtype: Optional[str] = None,
-                 weight_dtype: Optional[str] = None):
+                 weight_dtype: Optional[str] = None,
+                 tp_degree: Optional[int] = None):
         from .. import flags as _flags
         from ..jit import ensure_live
 
@@ -803,6 +818,61 @@ class ServingEngine:
         if self.weight_dtype not in ("native", "int4"):
             raise ValueError(f"weight_dtype must be 'native' or 'int4', "
                              f"got {self.weight_dtype!r}")
+        # ---- tensor-parallel decode (r19): shard the stacked fused
+        # weights column/row-wise and the paged KV pool over kv-heads
+        # across the mp axis. Engine identity like the dtypes above —
+        # it reaches compiled programs only through DecodeKey.extra
+        self.tp_degree = int(_flags.get_flag("serving_tp_degree")
+                             if tp_degree is None else tp_degree)
+        if self.tp_degree < 1:
+            raise ValueError(
+                f"tp_degree must be >= 1, got {self.tp_degree}")
+        self._tp_mesh = None
+        self._tp_axis = "mp"
+        self._pool_sharding = None
+        if self.tp_degree > 1:
+            if self.weight_dtype == "int4":
+                raise ValueError(
+                    "tp_degree > 1 with weight_dtype='int4' is not "
+                    "supported: Int4Tiles nibble packing does not commute "
+                    "with the head-shard permutation (pack after sharding "
+                    "is a chip-window follow-up)")
+            if spec[0][0] % self.tp_degree:
+                raise ValueError(
+                    f"tp_degree={self.tp_degree} must divide the model's "
+                    f"kv-head count ({spec[0][0]}) so the paged pool "
+                    "partitions evenly over kv-heads")
+            from jax.sharding import Mesh as _Mesh
+            from jax.sharding import NamedSharding as _NS
+            from jax.sharding import PartitionSpec as _P
+            from ..distributed.communication.group import resolve_group_axis
+            from ..distributed.fleet.base_topology import (
+                try_get_hybrid_communicate_group,
+            )
+            # the mp process group (when fleet.init built one) names the
+            # axis and the member devices; a bare runtime falls back to
+            # the first tp devices under the canonical "mp" axis name
+            hcg = try_get_hybrid_communicate_group()
+            group = None
+            if (hcg is not None and
+                    hcg.get_model_parallel_world_size() == self.tp_degree):
+                group = hcg.get_model_parallel_group()
+            self._tp_axis = resolve_group_axis(group, "mp")
+            devs = jax.devices()
+            if group is not None:
+                members = [devs[r % len(devs)] for r in group.ranks]
+            elif len(devs) >= self.tp_degree:
+                members = devs[:self.tp_degree]
+            else:
+                raise ValueError(
+                    f"tp_degree={self.tp_degree} needs that many devices; "
+                    f"the runtime has {len(devs)}")
+            self._tp_mesh = _Mesh(np.array(members), (self._tp_axis,))
+            # canonical partition of every per-layer pool leaf: kv-heads
+            # lead on the payload AND the int8 scale band, so one spec
+            # shards both together
+            self._pool_sharding = _NS(self._tp_mesh,
+                                      _P(self._tp_axis, None, None, None))
         # pool geometry is kept so replay recovery can allocate FRESH
         # pools with the identical shape (same compiled programs apply)
         self._pool_geom = dict(
@@ -811,6 +881,7 @@ class ServingEngine:
             max_batch=max_batch, max_seq_len=max_seq_len, dtype=dtype,
             reserve_null_page=True, kv_dtype=self.kv_dtype)
         self.pool = PagedKVCache(**self._pool_geom)
+        self._shard_pool(self.pool)
         maxpos = getattr(getattr(model, "config", None),
                          "max_position_embeddings", None)
         if maxpos is not None and max_seq_len > maxpos:
@@ -868,6 +939,7 @@ class ServingEngine:
                 dtype=jnp.result_type(next(iter(dparams.values()))),
                 reserve_null_page=True, kv_dtype=self.kv_dtype)
             self._draft_pool = PagedKVCache(**self._draft_geom)
+            self._shard_pool(self._draft_pool)
             raw = str(_flags.get_flag("serving_spec_rungs"))
             srungs = sorted({int(r) for r in raw.replace(";", ",").split(",")
                              if r.strip()})
@@ -961,8 +1033,10 @@ class ServingEngine:
         # telemetry binding is per-engine and resolved once here (the
         # no-op stubs cost one method call per write when disabled);
         # the replica id labels every series so fleet engines coexist
-        self._m = (_EngineTelemetry(self.replica) if obs.enabled()
-                   else _NullEngineTelemetry(self.replica))
+        self._m = (_EngineTelemetry(self.replica, str(self.tp_degree))
+                   if obs.enabled()
+                   else _NullEngineTelemetry(self.replica,
+                                             str(self.tp_degree)))
         # pool-ledger fragmentation memo: recompute only when the pool's
         # free-list epoch moved (steady-state decode never moves it)
         self._pool_frag_epoch = -1
@@ -1160,6 +1234,104 @@ class ServingEngine:
         # fleet-wide sum over serving_requests_submitted{replica}
         return req.rid
 
+    # ----------------------------------- disaggregated handoff (r19)
+    def harvest_request(self, rid: int) -> dict:
+        """Detach ONE live greedy request WITH its written KV pages —
+        the prefill-replica half of prefill→decode disaggregation. The
+        pages spill verbatim (int8 payload + scale band included) and
+        leave with the request, so the decode replica resumes WITHOUT
+        re-running prefill and the greedy continuation stays
+        bit-identical: the pool bits move, nothing is recomputed.
+        Returns the bundle :meth:`adopt_request` seats; transfer it
+        however the deployment likes (the dryrun harness rides the
+        deterministic p2p mailbox)."""
+        req = next((r for r in self._slots
+                    if r is not None and r.rid == rid), None)
+        if req is None or req.slot is None:
+            raise ValueError(
+                f"harvest_request: rid {rid} is not seated in a slot "
+                "(queued/completed requests re-route through "
+                "export_requests/inject_request instead)")
+        if req.prefill_pos is not None or req.pending:
+            raise ValueError(
+                "harvest_request: request is mid-prefill (chunk cursor "
+                "or teacher-forced suffix pending) — hand off after its "
+                "first generated token")
+        if req.temperature > 0.0:
+            raise ValueError(
+                "harvest_request: sampled requests park their KV cursor "
+                "in the spec verify program; only greedy requests hand "
+                "off with pages")
+        if not self.pool.k_pages or self.pool.k_pages[0] is None:
+            raise RuntimeError("harvest_request: pool is detached")
+        slot = req.slot
+        seq_len = int(self.pool.seq_lens[slot])
+        last_tok = int(self._last_tok[slot])
+        n_pages = int(self.pool._pages_used[slot])
+        pages = []
+        for i in range(n_pages):
+            hp = self.pool.spill_page(int(self.pool.block_tables[slot, i]))
+            # the copy leaves with the request — it was never this
+            # pool's host-tier resident, so retire it from the census
+            self.pool.forget_spilled(hp)
+            pages.append(hp)
+        self.pool.free_sequence(slot)
+        self._to_replay_form(req)
+        self._slots[slot] = None
+        self._last_tok[slot] = 0
+        return {"request": req, "pages": pages, "seq_len": seq_len,
+                "last_token": last_tok}
+
+    def adopt_request(self, bundle: dict) -> int:
+        """Seat a harvested request mid-stream — the decode-replica
+        half of :meth:`harvest_request`: allocate the span, write the
+        transferred pages into the fresh block table
+        (:meth:`PagedKVCache.adopt_page`), restore the KV cursor and
+        the last emitted token, and resume decoding under a fresh local
+        rid. Pool geometry must match byte-for-byte (same page layout =
+        same compiled programs serve the adopted row)."""
+        req: Request = bundle["request"]
+        pages = bundle["pages"]
+        if not self.pool.k_pages or self.pool.k_pages[0] is None:
+            raise RuntimeError("adopt_request: pool is detached")
+        if pages and pages[0].nbytes != self.pool.bytes_per_page:
+            raise ValueError(
+                f"adopt_request: page layout mismatch — bundle pages "
+                f"are {pages[0].nbytes} bytes, this pool's are "
+                f"{self.pool.bytes_per_page} (layers/kv-heads/page_size/"
+                "kv_dtype must agree across the disaggregated pair)")
+        try:
+            slot = self._slots.index(None)
+        except ValueError:
+            raise RuntimeError(
+                "adopt_request: no free slot (drain or grow max_batch)")
+        try:
+            self.pool.allocate(slot,
+                               len(req.prompt) + int(req.max_new_tokens))
+        except RuntimeError:
+            # partial allocation is recorded in _pages_used — return it
+            self.pool.free_sequence(slot)
+            raise
+        if int(self.pool._pages_used[slot]) < len(pages):
+            self.pool.free_sequence(slot)
+            raise ValueError(
+                f"adopt_request: bundle carries {len(pages)} pages but "
+                f"the span only needs {int(self.pool._pages_used[slot])}")
+        for i, hp in enumerate(pages):
+            self.pool.adopt_page(hp, int(self.pool.block_tables[slot, i]))
+        self.pool.seq_lens[slot] = int(bundle["seq_len"])
+        req.rid = self._next_rid
+        self._next_rid += 1
+        req.slot = slot
+        req.status = "PENDING"
+        req.error = None
+        now = time.perf_counter()
+        req.t_submit = req.t_submit or now
+        req.t_last = now
+        self._slots[slot] = req
+        self._last_tok[slot] = int(bundle["last_token"])
+        return req.rid
+
     # ------------------------------------------------- compiled programs
     def _key(self, kind: str, bucket: Optional[int] = None,
              extra: Tuple = ()):
@@ -1171,6 +1343,10 @@ class ServingEngine:
         # covers the weight dtype and keys built before pools exist)
         extra = tuple(extra) + (("kv", self.kv_dtype),
                                 ("wt", self.weight_dtype))
+        # tp rides the extra ONLY when armed, so every tp=1 key (and the
+        # banked artifacts keyed on it) stays byte-identical to r18
+        if self.tp_degree > 1:
+            extra = extra + (("tp", self.tp_degree),)
         return DecodeKey(
             kind=kind, model_sig=self._model_sig,
             batch_bucket=self.max_batch if bucket is None else bucket,
@@ -1244,7 +1420,15 @@ class ServingEngine:
         """Build (once) the per-group MultiBlockDecodeWeights the N-layer
         decode programs take as traced args: each group's
         BlockDecodeWeights stacked along a leading layer axis, q|k|v and
-        gate|up concatenated into single wider matmul operands."""
+        gate|up concatenated into single wider matmul operands.
+
+        Under tp > 1 the stacks are additionally permuted into the
+        shard-major Megatron layout (``shard_block_weights``) and
+        committed to the tp mesh with the canonical per-field shardings
+        — column-parallel wqkv/wgu split their LAST axis, row-parallel
+        wo/wd their middle (contraction) axis, norms replicate — so
+        every decode dispatch reuses one stable placement and never
+        retraces on a sharding flip."""
         if self._stacked is None:
             from ..kernels.fused_block_decode import (BlockDecodeWeights,
                                                       stack_block_weights)
@@ -1256,6 +1440,27 @@ class ServingEngine:
                            for f, n in spec["layers"][i].items()})
                     for i in group], weight_dtype=self.weight_dtype)
                 for group in spec["layer_groups"])
+            if self.tp_degree > 1:
+                from jax.sharding import NamedSharding as _NS
+                from jax.sharding import PartitionSpec as _P
+                from ..kernels.fused_block_decode import (
+                    MultiBlockDecodeWeights, shard_block_weights)
+                ax = self._tp_axis
+                shardings = MultiBlockDecodeWeights(
+                    ln1=_NS(self._tp_mesh, _P()),
+                    wqkv=_NS(self._tp_mesh, _P(None, None, ax)),
+                    wo=_NS(self._tp_mesh, _P(None, ax, None)),
+                    ln2=_NS(self._tp_mesh, _P()),
+                    wgu=_NS(self._tp_mesh, _P(None, None, ax)),
+                    wd=_NS(self._tp_mesh, _P(None, ax, None)))
+                self._stacked = tuple(
+                    jax.device_put(
+                        shard_block_weights(
+                            g, self.tp_degree,
+                            num_heads=spec["num_heads"],
+                            num_kv_heads=spec["num_kv_heads"]),
+                        shardings)
+                    for g in self._stacked)
         return self._stacked
 
     def _decode_program(self, bucket: int):
@@ -1271,7 +1476,26 @@ class ServingEngine:
             from .program_cache import decode_program_cache
             spec = self._fused_spec()
             groups = spec.get("layer_groups") if spec else None
-            if groups:
+            if spec and self.tp_degree > 1:
+                # tensor-parallel rung: every fused arm (N=1 included)
+                # consumes stacked weights through ONE shard_map body —
+                # a per-layer group chain IS the N=1 stacked layout
+                if not groups:
+                    spec = dict(spec)
+                    groups = [[i] for i in range(len(spec["layers"]))]
+                    spec["layer_groups"] = groups
+                self._stacked_weights(spec)
+                kind = ("decode_fused_nlayer"
+                        if any(len(g) > 1 for g in groups)
+                        else "decode_fused")
+                key = self._key(
+                    kind, bucket=bucket,
+                    extra=("nlayer", tuple(len(g) for g in groups)))
+                builder = functools.partial(
+                    _build_fused_nlayer_decode_tp, spec=spec,
+                    snap=self._flags, mesh=self._tp_mesh,
+                    axis=self._tp_axis, tp=self.tp_degree)
+            elif groups:
                 self._stacked_weights(spec)
                 key = self._key(
                     "decode_fused_nlayer", bucket=bucket,
@@ -1301,9 +1525,42 @@ class ServingEngine:
     # the pool explicitly empty (take_pools refuses a second detach)
     # rather than silently aliasing deleted device buffers.
 
+    def _shard_pool(self, pool) -> None:
+        """Commit every per-layer pool leaf onto the canonical kv-head
+        NamedSharding (the int8 payload and its per-token-row scale band
+        both lead with the kv-head axis, so one spec shards both). A
+        pool whose kv-head count does not divide tp stays replicated (a
+        narrow draft model); no-op at tp=1 or on a detached pool. All
+        host bookkeeping — ledger, spill/restore, replay recovery — is
+        kv-head-count-invariant, so it needs no per-shard twin."""
+        if (self._pool_sharding is None or pool is None
+                or not pool.k_pages or pool.k_pages[0] is None
+                or pool.num_kv_heads % self.tp_degree):
+            return
+        for i in range(len(pool.k_pages)):
+            pool.k_pages[i] = jax.device_put(pool.k_pages[i],
+                                             self._pool_sharding)
+            pool.v_pages[i] = jax.device_put(pool.v_pages[i],
+                                             self._pool_sharding)
+
+    def _canon_pairs(self, pairs, pool):
+        """Re-pin returned pools to the canonical sharding before they
+        re-enter the cache: the sharded decode step already returns them
+        committed there (free), while prefill/chunk/spec outputs carry
+        whatever placement GSPMD inferred and reshard once here — so the
+        next decode dispatch always sees one stable input sharding and
+        never retraces."""
+        if (self._pool_sharding is None
+                or pool.num_kv_heads % self.tp_degree):
+            return pairs
+        return [(jax.device_put(k, self._pool_sharding),
+                 jax.device_put(v, self._pool_sharding))
+                for k, v in pairs]
+
     def _store(self, states) -> None:
-        self.pool.install_pools(
-            [(_val(st.k_pages), _val(st.v_pages)) for st in states])
+        self.pool.install_pools(self._canon_pairs(
+            [(_val(st.k_pages), _val(st.v_pages)) for st in states],
+            self.pool))
 
     def _admit_shared(self, req: Request, slot: int, pages: List[int],
                       n_cached: int) -> None:
@@ -1769,12 +2026,14 @@ class ServingEngine:
         the replays without a retrace. The prefix cache indexed pages of
         the dead pool and restarts empty."""
         self.pool = PagedKVCache(**self._pool_geom)
+        self._shard_pool(self.pool)
         if self._draft_pool is not None:
             # the draft pool dies with the target's (a spec fault leaves
             # one detached, and a rebuilt target invalidates the draft's
             # cursor lockstep either way); replay re-syncs from host
             # state through the draft chunk program
             self._draft_pool = PagedKVCache(**self._draft_geom)
+            self._shard_pool(self._draft_pool)
         self._prefix = (PrefixCache(self.pool, replica=self.replica,
                                     host_tier_pages=self.host_tier_pages)
                         if self._prefix_enabled else None)
@@ -2060,8 +2319,9 @@ class ServingEngine:
     # tail-fitting constraint (new tokens just truncate to the budget).
 
     def _store_draft(self, states) -> None:
-        self._draft_pool.install_pools(
-            [(_val(st.k_pages), _val(st.v_pages)) for st in states])
+        self._draft_pool.install_pools(self._canon_pairs(
+            [(_val(st.k_pages), _val(st.v_pages)) for st in states],
+            self._draft_pool))
 
     def _spec_occupancy_cap(self, n_rows: int) -> int:
         """Largest γ rung the decode-slot budget affords with
@@ -2496,6 +2756,11 @@ class ServingEngine:
         # shows up natively)  # tracecheck: disable=TRC007
         self._m.event("engine.decode_step", t0, now,
                       active=len(decode_rows))
+        if self.tp_degree > 1:
+            # sharded dispatch envelope: compute + the per-layer psum
+            # pair, observed host-side OUTSIDE the shard_map body
+            # (meshcheck MSH006 keeps telemetry off the traced path)
+            self._observe_collective(now - t0)
         for slot, req in enumerate(self._slots):
             if req is None:
                 continue            # idle row wrote the null page; ignore
@@ -2684,6 +2949,14 @@ class ServingEngine:
             self._m.prefill_chunk_s.observe(dt)
             if final:
                 self._m.prefills.inc()
+
+    def _observe_collective(self, dt: float) -> None:
+        """One tensor-parallel decode dispatch retired: bank the wall
+        clock of the sharded envelope (per-layer psum pair + compute).
+        Host-side only — the shard_map body itself never writes
+        telemetry (MSH006); a tp=1 engine never reaches here."""
+        if self._m.enabled:
+            self._m.collective_s.observe(dt)
 
     def _observe_stall(self, dt: float) -> None:
         """Scheduler + prefill work ran this step while decode-ready
@@ -2968,6 +3241,75 @@ def _build_fused_nlayer_decode(note_trace, spec, snap):
                 snap=snap)
             states.extend(PagedDecodeState(kp, vp, bt, sl)
                           for kp, vp in zip(kps, vps))
+        x = _rms(x, allp[spec["final_norm"]], eps)
+        if spec["lm_head"]:
+            logits = x @ allp[spec["lm_head"]]
+        else:                                   # tied embeddings
+            logits = x @ allp[spec["embed"]].T
+        return jnp.argmax(logits.astype(jnp.float32), axis=-1), states
+
+    return jax.jit(run, donate_argnums=(3,))
+
+
+def _build_fused_nlayer_decode_tp(note_trace, spec, snap, mesh, axis, tp):
+    """Tensor-parallel fused decode step (r19): the layer-group chain
+    runs under ``shard_map`` over the mp axis — stacked weights
+    column/row-sharded in the ``shard_block_weights`` layout, pools
+    kv-head-sharded — while embedding lookup, the final norm and the lm
+    head stay on the replicated residual outside the manual region.
+    Exactly two collectives per layer (the row-parallel exits of wo and
+    wd) through ``mp_ops._mp_allreduce``; the body holds NO telemetry
+    and no host work (meshcheck MSH006/MSH001-clean). Same call
+    signature and donation slot as the tp=1 N-layer builder, so the
+    dispatch site does not fork."""
+    from jax.sharding import PartitionSpec
+    from ..kernels.fused_block_decode import (MultiBlockDecodeWeights,
+                                              _rms,
+                                              fused_multi_block_decode_tp)
+
+    nh, nkv = spec["num_heads"], spec["num_kv_heads"]
+    theta, eps = spec["rope_theta"], spec["epsilon"]
+    groups = spec["layer_groups"]
+    nh_s, nkv_s = nh // tp, nkv // tp
+    rep = PartitionSpec()
+    pool_spec = PartitionSpec(axis, None, None, None)
+    w_spec = MultiBlockDecodeWeights(
+        ln1=rep,
+        wqkv=PartitionSpec(None, None, axis),
+        wo=PartitionSpec(None, axis, None),
+        ln2=rep,
+        wgu=PartitionSpec(None, None, axis),
+        wd=PartitionSpec(None, axis, None))
+
+    def tp_block_chain(x, pools, bt, sl, stacked):
+        # per-shard body: local head counts, local weight shards, local
+        # kv-head pool partition; the residual x stays replicated
+        out_pools = list(pools)
+        for gi, group in enumerate(groups):
+            kps = [pools[i][0] for i in group]
+            vps = [pools[i][1] for i in group]
+            x, kps, vps = fused_multi_block_decode_tp(
+                x, stacked[gi], kps, vps, bt, sl, num_heads=nh_s,
+                num_kv_heads=nkv_s, rope_theta=theta, epsilon=eps,
+                axis_name=axis)
+            for j, i in enumerate(group):
+                out_pools[i] = (kps[j], vps[j])
+        return x, out_pools
+
+    sharded = jax.shard_map(
+        tp_block_chain, mesh=mesh,
+        in_specs=(rep, pool_spec, rep, rep,
+                  tuple(w_spec for _ in groups)),
+        out_specs=(rep, pool_spec),
+        check_vma=False)
+
+    def run(params, buffers, toks, pools, bt, sl, stacked):
+        note_trace()
+        allp = {**buffers, **params}
+        x = jnp.take(allp[spec["embed"]], toks[:, 0], axis=0)   # (B, H)
+        x, out_pools = sharded(x, list(pools), bt, sl, stacked)
+        states = [PagedDecodeState(kp, vp, bt, sl)
+                  for kp, vp in out_pools]
         x = _rms(x, allp[spec["final_norm"]], eps)
         if spec["lm_head"]:
             logits = x @ allp[spec["lm_head"]]

@@ -63,6 +63,7 @@ from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -76,7 +77,9 @@ __all__ = ["BlockDecodeWeights", "Int4Tiles", "MultiBlockDecodeWeights",
            "fused_block_decode", "fused_block_decode_pallas",
            "fused_block_decode_ref", "fused_multi_block_decode",
            "fused_multi_block_decode_pallas", "fused_multi_block_decode_ref",
-           "pack_int4_tiles", "stack_block_weights", "unpack_int4_tiles"]
+           "fused_multi_block_decode_tp", "pack_int4_tiles",
+           "shard_block_weights", "stack_block_weights",
+           "unpack_int4_tiles"]
 
 
 class BlockDecodeWeights(NamedTuple):
@@ -786,6 +789,119 @@ def fused_multi_block_decode_ref(x, weights: MultiBlockDecodeWeights,
         gu = h2 @ w_gu[i]
         f = jax.nn.silu(gu[:, :inter]) * gu[:, inter:]
         x = x2 + f @ w_d[i]
+    return x, kps, vps
+
+
+def shard_block_weights(weights: MultiBlockDecodeWeights, tp: int, *,
+                        num_heads: int, num_kv_heads: int
+                        ) -> MultiBlockDecodeWeights:
+    """Permute a stacked group into the tensor-parallel (Megatron) shard
+    layout: each of the ``tp`` shards owns a contiguous slice of heads
+    and of the FFN intermediate, so a plain even split of the LAST axis
+    of wqkv/wgu (and of the MIDDLE axis of wo/wd) hands every shard its
+    own locally-merged q|k|v and gate|up blocks.
+
+    The merged matmuls concatenate q|k|v (and gate|up) on columns, so
+    shard s's columns are NOT contiguous in the stacked layout — this
+    host-side one-time permutation reorders columns shard-major:
+
+      wqkv  [q | k | v]        ->  [q_0|k_0|v_0 | q_1|k_1|v_1 | ...]
+      wgu   [gate | up]        ->  [g_0|u_0 | g_1|u_1 | ...]
+
+    wo (rows = nh*d, head-major) and wd (rows = I) are already
+    shard-contiguous on their contraction axis, and the rms-norm vectors
+    replicate. Int4-packed stacks are refused: the nibble row-pairing and
+    per-tile scales of :class:`Int4Tiles` do not commute with the column
+    permutation (the planner still prices int4-per-shard analytically)."""
+    if tp <= 1:
+        return weights
+    for name in ("wqkv", "wo", "wgu", "wd"):
+        if isinstance(getattr(weights, name), Int4Tiles):
+            raise ValueError(
+                "shard_block_weights: int4-packed stacks cannot be "
+                "resharded (pack after sharding instead); got Int4Tiles "
+                f"for {name}")
+    d = weights.wqkv.shape[2] // (num_heads + 2 * num_kv_heads)
+    inter = weights.wd.shape[1]
+    if num_heads % tp or num_kv_heads % tp or inter % tp:
+        raise ValueError(
+            f"shard_block_weights: heads/kv-heads/intermediate "
+            f"({num_heads}/{num_kv_heads}/{inter}) must all divide "
+            f"tp={tp}")
+    qw = num_heads * d
+    kvw = num_kv_heads * d
+    cols = np.arange(qw + 2 * kvw)
+    q_cols = cols[:qw].reshape(tp, -1)
+    k_cols = cols[qw:qw + kvw].reshape(tp, -1)
+    v_cols = cols[qw + kvw:].reshape(tp, -1)
+    qkv_perm = np.concatenate(
+        [np.concatenate([q_cols[s], k_cols[s], v_cols[s]])
+         for s in range(tp)])
+    gu_cols = np.arange(2 * inter)
+    g_cols = gu_cols[:inter].reshape(tp, -1)
+    u_cols = gu_cols[inter:].reshape(tp, -1)
+    gu_perm = np.concatenate(
+        [np.concatenate([g_cols[s], u_cols[s]]) for s in range(tp)])
+    return MultiBlockDecodeWeights(
+        ln1=weights.ln1,
+        wqkv=weights.wqkv[:, :, qkv_perm],
+        wo=weights.wo,
+        ln2=weights.ln2,
+        wgu=weights.wgu[:, :, gu_perm],
+        wd=weights.wd)
+
+
+def fused_multi_block_decode_tp(x, weights: MultiBlockDecodeWeights,
+                                k_pages, v_pages, block_tables, seq_lens,
+                                *, num_heads: int, num_kv_heads: int,
+                                rope_theta: float = 10000.0,
+                                epsilon: float = 1e-6,
+                                axis_name: str = "mp",
+                                sm_scale: Optional[float] = None):
+    """Per-SHARD N-layer fused step for the ``shard_map`` decode body.
+
+    ``num_heads``/``num_kv_heads`` are the LOCAL (per-shard) head
+    counts; ``weights`` is the local column/row shard produced by
+    :func:`shard_block_weights` + an even split, and the pools are the
+    local kv-head partition. The chain is exactly
+    :func:`fused_multi_block_decode_ref` per shard except the two
+    row-parallel exits (wo and wd) each finish with ONE ``psum`` over
+    ``axis_name`` — the Megatron minimum of two collectives per layer.
+    The residual stream ``x`` stays replicated across shards, so rms
+    moments and rope tables are computed identically everywhere."""
+    # lazy import: mp_ops pulls the distributed package; the kernel
+    # module must stay importable on a bare single-chip runtime
+    from ..distributed.fleet.layers.mpu.mp_ops import _mp_allreduce
+
+    n = int(weights.ln1.shape[0])
+    if len(k_pages) != n or len(v_pages) != n:
+        raise ValueError(f"expected {n} per-layer pools, got "
+                         f"{len(k_pages)}/{len(v_pages)}")
+    b, hidden = x.shape
+    d = weights.wqkv.shape[2] // (num_heads + 2 * num_kv_heads)
+    qw = num_heads * d
+    kvw = num_kv_heads * d
+    inter = weights.wd.shape[1]
+    bt = jnp.asarray(block_tables, jnp.int32)
+    sl = jnp.asarray(seq_lens, jnp.int32)
+    sin, cos = _rope_tables(sl, d, rope_theta)
+
+    kps, vps = list(k_pages), list(v_pages)
+    for i in range(n):
+        h = _rms(x, weights.ln1[i], epsilon)
+        qkv = h @ weights.wqkv[i]
+        q = _rope_heads(qkv[:, :qw].reshape(b, num_heads, d), sin, cos)
+        k = _rope_heads(qkv[:, qw:qw + kvw].reshape(b, num_kv_heads, d),
+                        sin, cos)
+        v = qkv[:, qw + kvw:].reshape(b, num_kv_heads, d)
+        kps[i], vps[i] = write_paged_kv(kps[i], vps[i], k, v, bt, sl)
+        attn = paged_attention_xla(q, kps[i], vps[i], bt, sl + 1, sm_scale)
+        x2 = x + _mp_allreduce(attn.reshape(b, qw) @ weights.wo[i],
+                               axis_name)
+        h2 = _rms(x2, weights.ln2[i], epsilon)
+        gu = h2 @ weights.wgu[i]
+        f = jax.nn.silu(gu[:, :inter]) * gu[:, inter:]
+        x = x2 + _mp_allreduce(f @ weights.wd[i], axis_name)
     return x, kps, vps
 
 

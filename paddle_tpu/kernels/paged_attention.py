@@ -667,6 +667,10 @@ class PagedKVCache:
         self.kv_dtype = kv_dtype
         self.page_size = page_size
         self.num_pages = num_pages
+        # pool geometry the tp=2 sharder (r19) and memwatch both need:
+        # kv-head partitioning is legal only when this divides evenly
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
         self.max_pages_per_seq = -(-max_seq_len // page_size)
         self.reserved_null_page = bool(reserve_null_page)
         # memwatch ledger bookkeeping, all O(1)-maintained (the r09
@@ -837,6 +841,18 @@ class PagedKVCache:
         both flavors route through a functional ``jnp .at[].set`` —
         one pool-copy-sized write per layer, the price of a restore
         (still far cheaper than re-running the chunk's prefill)."""
+        self.adopt_page(host, page_id)
+        self._spilled_pages -= 1
+
+    def adopt_page(self, host: HostPage, page_id: int) -> None:
+        """Write a :class:`HostPage` spilled from ANOTHER pool into
+        device page ``page_id`` — the prefill→decode disaggregation
+        transfer (r19): the page was never in THIS pool's spilled
+        census, so unlike :meth:`restore_page` nothing is retired from
+        it. Functional per-layer ``.at[].set`` writes, so a committed
+        (tensor-parallel) pool sharding is preserved — under tp the
+        caller moves the full-head HostPage and each shard keeps its
+        kv-head slice."""
         pid = int(page_id)
         for i in range(len(self.k_pages)):
             kp, vp = self.k_pages[i], self.v_pages[i]
@@ -854,7 +870,6 @@ class PagedKVCache:
                 v = jnp.asarray(vp)
                 self.k_pages[i] = k.at[:, pid].set(host.k[i])
                 self.v_pages[i] = v.at[:, pid].set(host.v[i])
-        self._spilled_pages -= 1
 
     def forget_spilled(self, host: HostPage) -> None:
         """A spilled page is being dropped entirely (host-tier budget

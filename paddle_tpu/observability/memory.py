@@ -543,6 +543,25 @@ def weight_bytes(param_count: int, dtype: str,
     return int(total)
 
 
+class _ShardedDims(ModelDims):
+    """Per-shard view of a tensor-parallel serving engine (r19): heads,
+    kv-heads and the MLP width divide by ``tp`` while ``head_dim`` stays
+    the FULL model's ``hidden // heads`` — the residual stream (and so
+    ``hidden``) is replicated, only the head and channel axes shard."""
+
+    __slots__ = ("_head_dim",)
+
+    def __init__(self, dims: ModelDims, tp: int):
+        super().__init__(dims.hidden, dims.layers, dims.heads // tp,
+                         dims.kv_heads // tp, dims.intermediate // tp,
+                         dims.vocab, dims.param_count)
+        self._head_dim = dims.head_dim
+
+    @property
+    def head_dim(self) -> int:
+        return self._head_dim
+
+
 def estimate_engine_memory(dims: ModelDims, *,
                            page_size: int = 64,
                            page_budget: Optional[int] = None,
@@ -556,7 +575,8 @@ def estimate_engine_memory(dims: ModelDims, *,
                            draft_dims: Optional[ModelDims] = None,
                            spec_gamma: int = 0,
                            draft_param_count: Optional[int] = None,
-                           draft_weight_dtype: Optional[str] = None
+                           draft_weight_dtype: Optional[str] = None,
+                           tp: int = 1
                            ) -> Dict[str, Any]:
     """The what-if planner: predicted steady-state serving HBM for a
     configuration that may be too big to compile locally. Returns the
@@ -574,16 +594,43 @@ def estimate_engine_memory(dims: ModelDims, *,
     ``page_budget`` — draft sync must never fail allocate), and the
     (1, gamma+1) verify chunk's workspace through the TARGET (the
     verify is a chunk program, so it prices exactly like a prefill of
-    ``spec_gamma + 1`` positions)."""
+    ``spec_gamma + 1`` positions).
+
+    ``tp`` (r19) prices ONE SHARD of a tensor-parallel engine: the
+    stacked block weights split head-/column-/row-wise (embedding and
+    lm_head stay replicated, exactly as the sharder leaves them), the
+    KV pool partitions over kv-heads — the int8 per-token scale band
+    divides with its payload — and the workspaces are re-derived on the
+    per-shard dims. Refuses (ValueError) any degree that does not
+    divide heads, kv-heads and the MLP width: the engine refuses the
+    same configs, and a planner that silently rounded would under-bill.
+    Draft-model terms stay replicated — the r16 draft chain runs
+    un-sharded on every rank, its pool partitioning is future work."""
     n_params = param_count or dims.param_count
     if n_params is None:
         raise ValueError("need param_count (config.num_params() or "
                          "explicit)")
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    if tp > 1 and (dims.heads % tp or dims.kv_heads % tp
+                   or dims.intermediate % tp):
+        raise ValueError(
+            f"tp={tp} must divide heads ({dims.heads}), kv_heads "
+            f"({dims.kv_heads}) and intermediate ({dims.intermediate}) "
+            f"— the engine refuses this config too")
+    if tp > 1 and str(weight_dtype) == "int4":
+        raise ValueError(
+            "int4 weight tiles cannot be sharded: two-nibble row-pairing "
+            "does not commute with the head-shard permutation — the "
+            "engine refuses this config too (serve int8 or bf16 under tp)")
+    sdims = _ShardedDims(dims, tp) if tp > 1 else dims
     pages_per_seq = -(-max_seq_len // page_size)
     usable = (int(page_budget) if page_budget
               else max_batch * pages_per_seq)
-    geom = PoolGeometry(dims.layers, usable + 1, page_size, dims.kv_heads,
-                        dims.head_dim, pages_per_seq, np.dtype(
+    geom = PoolGeometry(sdims.layers, usable + 1, page_size,
+                        sdims.kv_heads,
+                        sdims.head_dim, pages_per_seq, np.dtype(
                             "int8" if str(kv_dtype) == "int8"
                             else "float16"),  # 2B stand-in for bf16
                         kv_quant=str(kv_dtype) == "int8")
@@ -593,19 +640,28 @@ def estimate_engine_memory(dims: ModelDims, *,
         kv_item = 1
     else:
         kv_item = np.dtype(kv_dtype).itemsize
-    pool = (dims.layers * 2 * dims.kv_heads * (usable + 1) * page_size
-            * dims.head_dim * kv_item)
+    pool = (sdims.layers * 2 * sdims.kv_heads * (usable + 1) * page_size
+            * sdims.head_dim * kv_item)
     if str(kv_dtype) == "int8":
         # per-TOKEN f32 amax scales stored alongside the pool (k and v:
         # one scale per head-token row — write-order-independent, so
         # fault replay stays bit-identical)
-        pool += (dims.layers * 2 * dims.kv_heads * (usable + 1)
+        pool += (sdims.layers * 2 * sdims.kv_heads * (usable + 1)
                  * page_size * 4)
-    weights = weight_bytes(n_params, weight_dtype)
-    decode_tmp = _decode_temp(dims, geom, max_batch)
+    if tp > 1:
+        # embedding + lm_head replicate on every shard (the sharder
+        # never touches them); every block weight splits exactly /tp.
+        # int4/int8 per-group scale tiles ride weight_bytes' per-group
+        # scale term, so they divide with their payload.
+        replicated = min(int(n_params), 2 * dims.vocab * dims.hidden)
+        shard_params = replicated + (int(n_params) - replicated) // tp
+        weights = weight_bytes(shard_params, weight_dtype)
+    else:
+        weights = weight_bytes(n_params, weight_dtype)
+    decode_tmp = _decode_temp(sdims, geom, max_batch)
     # chunked prefill is the copy-free block-table path (r17): no
     # gathered full-context K/V view, no full S x max_seq score matrix
-    chunk_tmp = (_prefill_temp(dims, geom, chunk, chunked=True)
+    chunk_tmp = (_prefill_temp(sdims, geom, chunk, chunked=True)
                  if chunk else 0)
     tables = geom.tables_bytes(max_batch)
     # ---- speculative decoding (r16): draft weights + worst-case draft
@@ -625,8 +681,9 @@ def estimate_engine_memory(dims: ModelDims, *,
             draft_dims.kv_heads, draft_dims.head_dim, pages_per_seq,
             geom.dtype, kv_quant=geom.kv_quant)
         draft_pool = dgeom.pool_bytes()
-        # the verify IS a chunk program — priced on the copy-free path
-        verify_tmp = _prefill_temp(dims, geom, gamma + 1, chunked=True)
+        # the verify IS a chunk program — priced on the copy-free path,
+        # through the (possibly sharded) TARGET dims
+        verify_tmp = _prefill_temp(sdims, geom, gamma + 1, chunked=True)
         draft_tmp = max(_decode_temp(draft_dims, dgeom, 1),
                         _prefill_temp(draft_dims, dgeom, gamma + 1))
     # XLA program text + runtime allocations scale with model size; a
@@ -651,7 +708,8 @@ def estimate_engine_memory(dims: ModelDims, *,
                    "kv_dtype": str(kv_dtype),
                    "host_tier_pages": int(host_tier_pages),
                    "spec_gamma": (max(1, int(spec_gamma))
-                                  if draft_dims is not None else 0)},
+                                  if draft_dims is not None else 0),
+                   "tp": tp},
         "breakdown": {
             "weights": weights, "kv_pool": pool,
             **({"draft_weights": draft_weights,

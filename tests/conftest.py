@@ -139,6 +139,36 @@ _SLOW_TWINS = {
     ("test_generation", "test_self_draft_accepts_everything"),
     ("test_paged_attention", "test_gpt_matches_ring_generate"),
     ("test_flash_attention", "test_fwd_bwd_matches_replicated[False]"),
+    # r19 additions: the tp=2 arms keep one representative per family
+    # in tier-1 (fused parity + zero-retrace + tp keying, fault-replay
+    # parity, the three refusals, collective telemetry, unknown-rid
+    # handoff refusal); the N-layer / int8-KV / spec-verify / generic
+    # GSPMD / handoff parity twins ride the full suite — each of those
+    # arms is additionally pinned green by the banked dryrun_multichip
+    # rows (MULTICHIP_r19.json), so tier-1 loses no unique coverage
+    ("test_tp_decode", "test_spec_verify_parity"),
+    ("test_tp_decode", "test_generic_gspmd_parity"),
+    ("test_tp_decode", "test_harvest_adopt_int8_tp2"),
+    ("test_tp_decode", "test_tp1_engine_never_observes_collectives"),
+    ("test_tp_decode", "test_nlayer_parity"),
+    ("test_tp_decode", "test_int8_kv_parity"),
+    ("test_tp_decode", "test_harvest_adopt_bit_identical"),
+    # r19 second ring (the box class running tier-1 oscillates ±15%
+    # between runs, and the budget boundary sits inside that band —
+    # measured via --durations=80, each move keeps a cheaper tier-1
+    # sibling or a banked-JSON gate as the family representative):
+    #   serving-load quick slice .. kv-quant quick slice (4.9s) walks the
+    #                               same loader/acceptance path; banked
+    #                               SERVING_LOAD schema gates stay tier-1
+    #   fleet quick slice ......... fleet unit reps (affinity, preemption,
+    #                               tiering round-trip) stay tier-1
+    #   memwatch train capture .... serving + chunk capture twins stay
+    #   generic-decode replay ..... fused replay twin stays; generic replay
+    #                               also rides chunk/spec/migration replays
+    ("test_serving_load", "test_quick_slice_meets_acceptance"),
+    ("test_fleet", "test_quick_slice_meets_acceptance"),
+    ("test_memwatch", "test_train_step_captured"),
+    ("test_serving_engine", "test_injected_decode_faults_replay_parity_generic"),
 }
 
 
@@ -148,3 +178,29 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
         elif (item.module.__name__, item.name) in _SLOW_TWINS:
             item.add_marker(pytest.mark.slow)
+
+
+# Interpreter shutdown after a full tier-1 run costs 30-60s on the slow
+# box class (the XLA CPU client and hundreds of live executables tear
+# down through atexit/GC) — pure wall clock against the 870s budget with
+# zero coverage, and enough to push an in-budget suite past the timeout
+# DURING teardown. Register a hard exit at session finish: atexit runs
+# LIFO, so a handler registered this late fires before jax's own import-
+# time handlers and skips the teardown entirely. The handler runs only
+# after pytest's terminal summary has printed and `python -m pytest` has
+# returned, and it preserves the real exit status. Persistent state is
+# not at risk: the compilation cache is disabled above (see NOTE) and
+# nothing else flushes at exit. Opt out with PYTEST_FULL_TEARDOWN=1
+# (e.g. when profiling shutdown itself).
+def _hard_exit(code):
+    import sys
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(code)
+
+
+@pytest.hookimpl(trylast=True)
+def pytest_sessionfinish(session, exitstatus):
+    if os.environ.get("PYTEST_FULL_TEARDOWN", "0") != "1":
+        import atexit
+        atexit.register(_hard_exit, int(exitstatus))

@@ -278,6 +278,53 @@ class TestEstimator:
             kv_dtype="int8")
         assert not memwatch.fits(big, hbm)["fits"]
 
+    def test_planner_tp_split(self):
+        # r19: --tp N prices ONE SHARD — weights split minus the
+        # replicated embed/lm_head, the KV pool (incl. the int8 scale
+        # band) divides exactly over kv-heads, draft terms replicate
+        dims = memwatch.ModelDims.of_config(LlamaConfig.llama2_7b())
+        kw = dict(page_size=64, page_budget=512, max_batch=32,
+                  max_seq_len=2048, chunk=256, weight_dtype="bfloat16",
+                  kv_dtype="bfloat16")
+        full = memwatch.estimate_engine_memory(dims, **kw)
+        half = memwatch.estimate_engine_memory(dims, tp=2, **kw)
+        assert half["config"]["tp"] == 2
+        # the acceptance criterion: per-shard weight+KV within 10% of
+        # half the tp=1 bill (embed + lm_head replicate, hence > 0.5x)
+        got = half["breakdown"]["weights"] + half["breakdown"]["kv_pool"]
+        want = (full["breakdown"]["weights"]
+                + full["breakdown"]["kv_pool"]) / 2
+        assert want <= got <= 1.1 * want
+        # pool arithmetic is linear in kv-heads: exactly /2
+        assert half["breakdown"]["kv_pool"] * 2 == \
+            full["breakdown"]["kv_pool"]
+        assert half["total"] < full["total"]
+        # int8 scale band divides with its payload
+        q = dict(kw, kv_dtype="int8")
+        fq = memwatch.estimate_engine_memory(dims, **q)
+        hq = memwatch.estimate_engine_memory(dims, tp=2, **q)
+        assert hq["breakdown"]["kv_pool"] * 2 == fq["breakdown"]["kv_pool"]
+        # draft terms stay replicated (the r16 chain runs un-sharded)
+        tiny = memwatch.ModelDims.of_config(LlamaConfig.tiny())
+        d = dict(kw, draft_dims=tiny, spec_gamma=4,
+                 draft_param_count=tiny.param_count or 1 << 20)
+        fd = memwatch.estimate_engine_memory(dims, **d)
+        hd = memwatch.estimate_engine_memory(dims, tp=2, **d)
+        assert hd["breakdown"]["draft_weights"] == \
+            fd["breakdown"]["draft_weights"]
+        assert hd["breakdown"]["draft_kv_pool"] == \
+            fd["breakdown"]["draft_kv_pool"]
+        # indivisible degrees are REFUSED, never rounded
+        with pytest.raises(ValueError, match="must divide"):
+            memwatch.estimate_engine_memory(dims, tp=3, **kw)
+        with pytest.raises(ValueError):
+            memwatch.estimate_engine_memory(dims, tp=0, **kw)
+        # int4 tiles cannot shard (nibble row-pairing vs the head
+        # permutation) — the planner refuses exactly like the engine
+        with pytest.raises(ValueError, match="int4"):
+            memwatch.estimate_engine_memory(
+                dims, tp=2, **dict(kw, weight_dtype="int4"))
+
     def test_sharded_param_bytes_ceil_division(self):
         from jax.sharding import PartitionSpec as P
         # 10 rows over a 4-way axis pad to 3 rows/device -> 12 f32 bytes

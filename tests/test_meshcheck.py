@@ -151,6 +151,39 @@ def test_msh001_group_axis_clean_twins(tmp_path):
     assert codes(res) == []
 
 
+def test_msh001_tp_decode_collective_site_flagged(tmp_path):
+    # r19 sharded decode, the WRONG shape: a collective call site that
+    # trusts a process group's ``.axis_name`` directly — a group built
+    # from an orthogonal topology has no ``global_axis`` binding, so
+    # the psum axis may not exist in the engine's decode mesh
+    res = run_snippet(tmp_path, """
+        from jax import lax
+
+        def tp_allreduce(x, group):
+            return lax.psum(x, group.axis_name)
+    """)
+    assert codes(res) == ["MSH001"]
+    assert "global_axis" in res.findings[0].message
+
+
+def test_msh001_tp_decode_collective_site_clean_twin(tmp_path):
+    # the shipped idiom: the engine resolves the axis ONCE through the
+    # resolve_group_axis order (global_axis first), then threads it as
+    # a parameter — the collective never reads group attributes
+    res = run_snippet(tmp_path, """
+        from jax import lax
+
+        def resolve_group_axis(group, default):
+            if group is None:
+                return default
+            return group.global_axis or group.axis_name or default
+
+        def tp_allreduce(x, axis_name):
+            return lax.psum(x, axis_name)
+    """)
+    assert codes(res) == []
+
+
 def test_msh001_pragma(tmp_path):
     res = run_snippet(tmp_path, MSH001_FLAGGED.replace(
         'return lax.psum(x, "tp")',
@@ -517,6 +550,57 @@ def test_msh006_jit_level_callback_clean(tmp_path):
 
         step = jax.jit(body)
     """)
+    assert "MSH006" not in codes(res)
+
+
+def test_msh006_tp_decode_body_telemetry_flagged(tmp_path):
+    # r19 sharded decode, the WRONG shape: observing the collective
+    # histogram INSIDE the shard_map block chain — a host write under
+    # per-shard tracing fires once per shard per trace, not per step
+    res = run_snippet(tmp_path, """
+        import jax
+        from jax import lax
+        from . import observability as obs
+
+        def tp_block_chain(x):
+            out = lax.psum(x, "mp")
+            obs.histogram("serving_collective_seconds").observe(0.0)
+            return out
+
+        def build(mesh, specs):
+            return jax.shard_map(tp_block_chain, mesh=mesh,
+                                 in_specs=specs, out_specs=specs)
+    """, extra={"observability.py":
+                "def histogram(name):\n    return None\n"})
+    assert "MSH006" in codes(res)
+
+
+def test_msh006_tp_decode_body_clean_twin(tmp_path):
+    # the shipped idiom: the body is collective + compute only; the
+    # wall clock is observed host-side at the DISPATCH boundary (the
+    # serving engine's _observe_collective), outside the traced body
+    res = run_snippet(tmp_path, """
+        import time
+
+        import jax
+        from jax import lax
+        from . import observability as obs
+
+        def tp_block_chain(x):
+            return lax.psum(x, "mp")
+
+        def build(mesh, specs):
+            return jax.shard_map(tp_block_chain, mesh=mesh,
+                                 in_specs=specs, out_specs=specs)
+
+        def dispatch(step, x):
+            t0 = time.perf_counter()
+            out = step(x)
+            obs.histogram("serving_collective_seconds").observe(
+                time.perf_counter() - t0)
+            return out
+    """, extra={"observability.py":
+                "def histogram(name):\n    return None\n"})
     assert "MSH006" not in codes(res)
 
 

@@ -73,7 +73,8 @@ def cmd_plan(args) -> int:
     kw = dict(page_size=args.page_size, max_batch=args.rung,
               max_seq_len=args.max_seq, chunk=args.chunk,
               weight_dtype=args.weight_dtype, kv_dtype=args.kv_dtype,
-              host_tier_pages=args.host_tier_pages)
+              host_tier_pages=args.host_tier_pages,
+              tp=getattr(args, "tp", 1))
     if args.draft:
         # r16 speculative serving: the draft's weights + worst-case KV
         # pool are resident, the (1, gamma+1) verify chunk is workspace
@@ -81,8 +82,16 @@ def cmd_plan(args) -> int:
                   spec_gamma=args.spec_gamma,
                   draft_weight_dtype=args.draft_weight_dtype
                   or args.weight_dtype)
-    plan = memwatch.estimate_engine_memory(
-        dims, page_budget=args.page_budget, **kw)
+    try:
+        plan = memwatch.estimate_engine_memory(
+            dims, page_budget=args.page_budget, **kw)
+    except ValueError as e:
+        # the r19 tensor-parallel refusal: a degree the engine itself
+        # would reject (kv-head/head/MLP indivisibility) never gets an
+        # HBM number — silently rounding would under-bill every shard
+        print(f"# memwatch plan: {args.model} tp={kw['tp']}")
+        print(f"  -> REFUSED: {e}")
+        return 1
     hbm = int(args.hbm_gb * GB)
     verdict = memwatch.fits(plan, hbm)
 
@@ -91,11 +100,14 @@ def cmd_plan(args) -> int:
 
     spec_note = (f" draft={args.draft} gamma={args.spec_gamma}"
                  if args.draft else "")
+    tp_note = (f" tp={kw['tp']} [PER-SHARD bill: sharded weights + "
+               f"kv-head-partitioned pool + per-shard workspaces]"
+               if kw["tp"] > 1 else "")
     print(f"# memwatch plan: {args.model} weights={args.weight_dtype} "
           f"kv={args.kv_dtype} rung={args.rung} chunk={args.chunk} "
           f"pages={plan['config']['usable_pages']}x{args.page_size} "
           f"max_seq={args.max_seq} host_tier={args.host_tier_pages}"
-          f"{spec_note}")
+          f"{spec_note}{tp_note}")
     for k, v in plan["breakdown"].items():
         print(f"  {k:32s} {fmt(v)}")
     print(f"  {'TOTAL (device HBM)':32s} {fmt(plan['total'])}")
@@ -432,6 +444,12 @@ def main() -> int:
     p.add_argument("--draft-weight-dtype", default=None,
                    choices=("float32", "bfloat16", "int8", "int4"),
                    help="draft storage dtype (default: --weight-dtype)")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel degree: price ONE SHARD of "
+                        "the r19 sharded decode engine (sharded "
+                        "stacked weights, kv-head-partitioned pool "
+                        "incl. the int8 scale band, per-shard "
+                        "workspaces); refuses indivisible degrees")
     p.add_argument("--fused-layers", type=int, default=1,
                    help="price the N-layer fused decode kernel's VMEM "
                         "working set (FLAGS_fused_block_layers=N); an "
