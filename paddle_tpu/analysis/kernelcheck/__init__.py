@@ -1,0 +1,64 @@
+"""kernelcheck — a Pallas/TPU kernel-discipline static analyzer.
+
+tracecheck (r08) gates *trace* discipline, meshcheck (r11) gates
+*collective* discipline, faultcheck (r15) gates *recovery* discipline;
+kernelcheck gates the TPU kernel invariants the r05–r17 Pallas arc
+relies on but can only exercise in CPU interpret mode: tile alignment,
+the 16 MB VMEM bound, grid/index-map hygiene, Mosaic-compilable kernel
+bodies, f32 accumulation, and the ref-twin parity convention.
+Interpret mode cannot manifest any of these failure classes — the lint
+checks them statically on every run, off the same shared parse.
+
+Rules (all pure AST over the shared tracecheck parse):
+
+- **KRN001** tile alignment: every statically-provable BlockSpec block
+  shape and VMEM scratch shape must have a minor-most dim that is a
+  multiple of the 128-lane tile and a second-minor dim aligned to the
+  dtype's sublane packing (8/f32, 16/bf16, 32/int8).  Unresolvable
+  dims are never findings; SMEM (scalar memory) is exempt.
+- **KRN002** static VMEM budget: a site's double-buffered block
+  operands plus persistent scratch must fit the 16 MB per-core bound;
+  and the fused-decode kernels' extracted ``scratch_shapes`` must
+  match the shared templates in ``paddle_tpu.analysis.tile_geometry``
+  — the SAME module memwatch's ``plan_fused_layers`` prices from, so
+  the planner and the lint cannot disagree.
+- **KRN003** grid/index-map discipline: index_map arity must equal
+  grid rank + num_scalar_prefetch, grid extents derived by plain floor
+  division (no ceil-div, no divisibility guard) drop ragged tails, and
+  index maps must return block indices, not element offsets.
+- **KRN004** kernel-body purity: no host/numpy/FLAGS/callback/clock
+  calls, no Python ``while``/data-dependent iteration, no jnp ops with
+  no Mosaic lowering (sort/unique/nonzero/quantile family) anywhere in
+  the kernel's same-module call closure.
+- **KRN005** accumulation discipline: no bf16/f16 scratch carries,
+  every dot in a kernel body pins ``preferred_element_type``, and any
+  scratch ref carried across grid steps is initialized under a
+  ``@pl.when(step == 0)`` guard.
+- **KRN006** ref-twin census: every public pallas entry point has a
+  pure-jnp ``<stem>_ref``/``_xla``/``_dense`` twin so CPU CI can diff
+  kernel output against a reference.
+
+Findings support inline ``# kernelcheck: disable=KRN00x`` pragmas
+(suite-scoped: a tracecheck/meshcheck/faultcheck pragma never silences
+KRN rules) and a checked-in baseline (tools/kernelcheck_baseline.json,
+kept empty — the r08/r11/r15 precedent is fix, don't baseline); the
+tier-1 test gates NEW findings only.
+
+Run it locally::
+
+    python tools/analyze.py                      # all four suites
+    python tools/analyze.py --suite kernelcheck
+    python tools/analyze.py --changed-only       # git-diff-scoped
+    python tools/analyze.py --format sarif       # CI annotation
+"""
+
+from ..tracecheck.findings import (Finding, fingerprint, load_baseline,
+                                   subtract_baseline, write_baseline)
+from .analyzer import AnalyzerConfig, AnalysisResult, analyze_package
+from .rules import KERNEL_RULES
+
+__all__ = [
+    "AnalyzerConfig", "AnalysisResult", "Finding", "KERNEL_RULES",
+    "analyze_package", "fingerprint", "load_baseline",
+    "subtract_baseline", "write_baseline",
+]

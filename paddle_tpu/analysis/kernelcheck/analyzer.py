@@ -1,0 +1,92 @@
+"""Orchestration: parse (or reuse a parse), extract pallas geometry,
+run the KRN rules.
+
+``analyze_package`` mirrors the tracecheck/meshcheck/faultcheck entry
+points and accepts the same :class:`ParsedPackage`, so the unified CLI
+(tools/analyze.py) runs all FOUR suites over ONE ast.parse pass.  The
+geometry build is strictly read-only over the shared ``ModuleInfo``
+objects — kernelcheck never calls ``propagate_traced`` or mutates
+traced/root flags — so running it before or after any other suite
+changes nothing about what the others report.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..tracecheck.analyzer import ParsedPackage, parse_package
+from ..tracecheck.callgraph import CallGraph
+from ..tracecheck.findings import (Finding, dedupe_findings,
+                                   parse_pragmas, suppressed)
+from .geometry import build_context
+from . import rules as KR
+
+
+@dataclass
+class AnalyzerConfig:
+    exclude_patterns: tuple = ()
+    rules: tuple = ("KRN001", "KRN002", "KRN003", "KRN004", "KRN005",
+                    "KRN006")
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding]              # post-pragma, pre-baseline
+    suppressed: List[Finding]            # pragma-silenced
+    n_files: int = 0
+    n_functions: int = 0
+    n_sites: int = 0                     # pallas_call sites found
+    n_specs: int = 0                     # BlockSpec constructors seen
+    n_scratch: int = 0                   # VMEM/SMEM allocations seen
+    n_kernels: int = 0                   # sites with a resolved kernel
+    errors: List[str] = field(default_factory=list)
+
+
+_RULE_FNS = {
+    "KRN001": KR.krn001_tile_alignment,
+    "KRN002": KR.krn002_vmem_budget,
+    "KRN003": KR.krn003_grid_discipline,
+    "KRN004": KR.krn004_kernel_purity,
+    "KRN005": KR.krn005_accumulation,
+    "KRN006": KR.krn006_ref_twin,
+}
+
+
+def analyze_package(package_path: str,
+                    config: Optional[AnalyzerConfig] = None,
+                    parsed: Optional[ParsedPackage] = None
+                    ) -> AnalysisResult:
+    config = config or AnalyzerConfig()
+    if parsed is None:
+        parsed = parse_package(package_path, config.exclude_patterns)
+    else:
+        parsed = parsed.filtered(config.exclude_patterns)
+
+    result = AnalysisResult(findings=[], suppressed=[])
+    result.errors = list(parsed.errors)
+    result.n_files = parsed.n_files
+
+    graph = CallGraph(parsed.modules, parsed.package)
+    ctx = build_context(parsed.modules, graph)
+    result.n_sites = ctx.n_sites
+    result.n_specs = ctx.n_specs
+    result.n_scratch = ctx.n_scratch
+    result.n_kernels = ctx.n_kernels
+
+    findings: List[Finding] = []
+    for mod in parsed.modules.values():
+        pragmas = parse_pragmas(mod.source_lines, tool="kernelcheck")
+        for fi in mod.functions.values():
+            result.n_functions += 1
+            batch: List[Finding] = []
+            for code in config.rules:
+                fn = _RULE_FNS.get(code)
+                if fn is not None:
+                    batch += fn(fi, ctx)
+            for f in batch:
+                (result.suppressed if suppressed(f, pragmas)
+                 else findings).append(f)
+
+    result.findings = dedupe_findings(findings)
+    return result

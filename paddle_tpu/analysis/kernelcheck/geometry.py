@@ -1,0 +1,739 @@
+"""Pallas-site geometry extraction (pure AST, read-only).
+
+Walks every function of the shared tracecheck parse and recovers, for
+each ``pl.pallas_call`` site, the static geometry the KRN rules check:
+the grid, the BlockSpec block shapes and index maps (chased through
+local list variables, ``+=``/``.append()`` building, ``[spec] * 2``
+replication, conditional branches, and append-helper nested defs), the
+``pltpu.VMEM``/``SMEM`` scratch shapes and dtypes, the scalar-prefetch
+count, and the kernel body (resolved through ``functools.partial`` and
+local-name indirection).
+
+Everything here is a *read* of the shared ``ModuleInfo`` objects — no
+traced/root flags are touched, so running kernelcheck before or after
+the other suites changes nothing (the order-independence contract of
+tools/analyze.py).
+
+Shapes stay **AST expressions**: a dimension like ``tr_h`` or
+``nh * d`` is only resolved to an integer when a constant environment
+(module constants, literal local assigns, ``tile()`` calls) can prove
+its value — rules make no claim about dimensions they cannot prove.
+"""
+
+from __future__ import annotations
+
+import ast
+import copy
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..tracecheck.callgraph import (CallGraph, FunctionInfo, ModuleInfo,
+                                    _dotted, callee_name)
+from ..tile_geometry import tile
+
+__all__ = [
+    "KernelContext", "PallasSite", "ScratchInfo", "SpecInfo",
+    "build_context", "eval_dim", "kernel_closure", "map_arity",
+]
+
+
+@dataclass
+class SpecInfo:
+    """One ``pl.BlockSpec`` (an in/out block operand)."""
+    role: str                              # "in" | "out" | "unknown"
+    shape: Optional[Tuple[ast.expr, ...]]  # None = non-literal shape
+    index_map: Optional[ast.expr]          # second arg / index_map kwarg
+    lineno: int = 0
+
+
+@dataclass
+class ScratchInfo:
+    """One ``pltpu.VMEM``/``pltpu.SMEM`` scratch allocation."""
+    space: str                             # "VMEM" | "SMEM"
+    shape: Optional[Tuple[ast.expr, ...]]
+    dtype: str                             # dtype tail name ('' unknown)
+    lineno: int = 0
+
+
+@dataclass
+class PallasSite:
+    """One ``pl.pallas_call`` with whatever geometry resolved."""
+    fi: FunctionInfo
+    call: ast.Call
+    lineno: int
+    kernel: Optional[FunctionInfo] = None
+    grid: Optional[Tuple[ast.expr, ...]] = None
+    num_scalar_prefetch: int = 0
+    in_specs: Optional[List[SpecInfo]] = None
+    out_specs: Optional[List[SpecInfo]] = None
+    scratch: Optional[List[ScratchInfo]] = None
+    specs_complete: bool = False           # every spec list fully chased
+
+
+@dataclass
+class KernelContext:
+    graph: CallGraph
+    modules: Dict[str, ModuleInfo]
+    sites: Dict[str, List[PallasSite]] = field(default_factory=dict)
+    # fi.qualname (per module) -> constructor census
+    census_specs: Dict[Tuple[str, str], List[SpecInfo]] = \
+        field(default_factory=dict)
+    census_scratch: Dict[Tuple[str, str], List[ScratchInfo]] = \
+        field(default_factory=dict)
+    # module relpath -> uncovered public pallas entry FunctionInfos
+    uncovered_entries: Dict[str, List[FunctionInfo]] = \
+        field(default_factory=dict)
+    # per-module int-constant env cache (filled lazily by the rules)
+    mod_consts: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    n_sites: int = 0
+    n_specs: int = 0
+    n_scratch: int = 0
+    n_kernels: int = 0
+
+
+# ------------------------------------------------------------ utilities
+def _tail(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _own_statements(node: ast.AST):
+    """Iterate the statements of a function body WITHOUT descending into
+    nested function/lambda scopes (their assignments are not ours)."""
+    stack = list(getattr(node, "body", []))
+    while stack:
+        stmt = stack.pop(0)
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        for fld in ("body", "orelse", "finalbody"):
+            stack.extend(getattr(stmt, fld, []) or [])
+        for h in getattr(stmt, "handlers", []) or []:
+            stack.extend(h.body)
+
+
+def _scalar_assigns(fi: FunctionInfo) -> Dict[str, List[ast.expr]]:
+    """name -> every ``name = <expr>`` value assigned in fi's own body
+    (both branches of conditionals contribute)."""
+    out: Dict[str, List[ast.expr]] = {}
+    for stmt in _own_statements(fi.node):
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            out.setdefault(stmt.targets[0].id, []).append(stmt.value)
+    return out
+
+
+def _module_consts(mod: ModuleInfo) -> Dict[str, int]:
+    consts: Dict[str, int] = {}
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                isinstance(stmt.value, ast.Constant) and \
+                isinstance(stmt.value.value, int):
+            consts[stmt.targets[0].id] = stmt.value.value
+    # `from ...tile_geometry import LANES [as X]` — the one cross-module
+    # constant worth knowing (the lane tile itself)
+    for local, (modpath, orig) in mod.imported_names.items():
+        if orig == "LANES" and modpath.endswith("tile_geometry"):
+            consts[local] = 128
+    return consts
+
+
+def eval_dim(expr: ast.expr, consts: Dict[str, int],
+             assigns: Optional[Dict[str, List[ast.expr]]] = None,
+             _depth: int = 0) -> Optional[int]:
+    """Best-effort integer evaluation of a shape dimension.  Returns
+    None for anything not statically provable (runtime shapes, function
+    parameters, tuple unpacks)."""
+    if _depth > 8:
+        return None
+    if isinstance(expr, ast.Constant):
+        return expr.value if isinstance(expr.value, int) and \
+            not isinstance(expr.value, bool) else None
+    if isinstance(expr, ast.Name):
+        if expr.id in consts:
+            return consts[expr.id]
+        vals = (assigns or {}).get(expr.id, [])
+        if len(vals) == 1:
+            return eval_dim(vals[0], consts, assigns, _depth + 1)
+        return None
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.USub):
+        v = eval_dim(expr.operand, consts, assigns, _depth + 1)
+        return -v if v is not None else None
+    if isinstance(expr, ast.BinOp):
+        a = eval_dim(expr.left, consts, assigns, _depth + 1)
+        b = eval_dim(expr.right, consts, assigns, _depth + 1)
+        if a is None or b is None:
+            return None
+        try:
+            if isinstance(expr.op, ast.Add):
+                return a + b
+            if isinstance(expr.op, ast.Sub):
+                return a - b
+            if isinstance(expr.op, ast.Mult):
+                return a * b
+            if isinstance(expr.op, ast.FloorDiv):
+                return a // b
+            if isinstance(expr.op, ast.Mod):
+                return a % b
+            if isinstance(expr.op, ast.Pow):
+                return a ** b
+        except (ZeroDivisionError, OverflowError, ValueError):
+            return None
+        return None
+    if isinstance(expr, ast.Call):
+        tail = _tail(callee_name(expr))
+        args = [eval_dim(a, consts, assigns, _depth + 1)
+                for a in expr.args]
+        if any(a is None for a in args):
+            return None
+        if tail in ("tile", "_tile") and len(args) == 2:
+            return tile(args[0], args[1])
+        if tail == "max" and args:
+            return max(args)
+        if tail == "min" and args:
+            return min(args)
+    return None
+
+
+# ------------------------------------------------------- list building
+class _ParamSub(ast.NodeTransformer):
+    def __init__(self, mapping: Dict[str, ast.expr]):
+        self.mapping = mapping
+
+    def visit_Name(self, node: ast.Name):
+        if node.id in self.mapping:
+            return copy.deepcopy(self.mapping[node.id])
+        return node
+
+
+def _resolve_list_expr(expr: ast.expr,
+                       lists: Dict[str, List[ast.expr]]
+                       ) -> Optional[List[ast.expr]]:
+    if isinstance(expr, (ast.List, ast.Tuple)):
+        return list(expr.elts)
+    if isinstance(expr, ast.Name):
+        got = lists.get(expr.id)
+        return list(got) if got is not None else None
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        a = _resolve_list_expr(expr.left, lists)
+        b = _resolve_list_expr(expr.right, lists)
+        return a + b if a is not None and b is not None else None
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Mult):
+        base, n = expr.left, expr.right
+        if isinstance(base, ast.Constant):
+            base, n = expr.right, expr.left
+        elems = _resolve_list_expr(base, lists)
+        if elems is not None and isinstance(n, ast.Constant) and \
+                isinstance(n.value, int):
+            return elems * max(n.value, 1)
+        return None
+    if isinstance(expr, ast.IfExp):
+        a = _resolve_list_expr(expr.body, lists)
+        b = _resolve_list_expr(expr.orelse, lists)
+        if a is None and b is None:
+            return None
+        return (a or []) + (b or [])
+    return None
+
+
+def _collect_lists(fi: FunctionInfo, mod: ModuleInfo
+                   ) -> Tuple[Dict[str, List[ast.expr]], set]:
+    """Statement-ordered chase of list variables in fi's own body.
+    Returns (name -> element exprs, names whose chase was inexact —
+    rebound to something unresolvable, or extended in a loop we only
+    walked once)."""
+    lists: Dict[str, List[ast.expr]] = {}
+    inexact: set = set()
+
+    def helper_appends(call: ast.Call) -> bool:
+        """``_weight(w, spec, imap)``-style append helpers: a nested def
+        of fi whose body appends (substituted) exprs to our lists."""
+        name = callee_name(call)
+        if name is None or "." in name:
+            return False
+        helper = mod.functions.get(fi.qualname + "." + name)
+        if helper is None or not isinstance(helper.node, ast.FunctionDef):
+            return False
+        params = [a.arg for a in helper.node.args.args]
+        if len(call.args) > len(params) or call.keywords:
+            return False
+        mapping = dict(zip(params, call.args))
+        sub = _ParamSub(mapping)
+        did = False
+        for stmt in _own_statements(helper.node):
+            if isinstance(stmt, ast.Expr) and \
+                    isinstance(stmt.value, ast.Call) and \
+                    isinstance(stmt.value.func, ast.Attribute) and \
+                    stmt.value.func.attr == "append" and \
+                    isinstance(stmt.value.func.value, ast.Name) and \
+                    stmt.value.func.value.id in lists and \
+                    len(stmt.value.args) == 1:
+                lists[stmt.value.func.value.id].append(
+                    sub.visit(copy.deepcopy(stmt.value.args[0])))
+                did = True
+        return did
+
+    def walk(body, in_loop: bool):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                n = stmt.targets[0].id
+                r = _resolve_list_expr(stmt.value, lists)
+                if r is not None:
+                    lists[n] = r
+                    if in_loop:
+                        inexact.add(n)
+                elif n in lists:
+                    del lists[n]
+                    inexact.add(n)
+            elif isinstance(stmt, ast.AugAssign) and \
+                    isinstance(stmt.target, ast.Name) and \
+                    isinstance(stmt.op, ast.Add) and \
+                    stmt.target.id in lists:
+                r = _resolve_list_expr(stmt.value, lists)
+                if r is not None:
+                    lists[stmt.target.id].extend(r)
+                else:
+                    inexact.add(stmt.target.id)
+                if in_loop:
+                    inexact.add(stmt.target.id)
+            elif isinstance(stmt, ast.Expr) and \
+                    isinstance(stmt.value, ast.Call):
+                call = stmt.value
+                if isinstance(call.func, ast.Attribute) and \
+                        call.func.attr == "append" and \
+                        isinstance(call.func.value, ast.Name) and \
+                        call.func.value.id in lists and \
+                        len(call.args) == 1:
+                    lists[call.func.value.id].append(call.args[0])
+                    if in_loop:
+                        inexact.add(call.func.value.id)
+                else:
+                    helper_appends(call)
+            elif isinstance(stmt, (ast.For, ast.While)):
+                walk(stmt.body, True)
+                walk(stmt.orelse, True)
+            elif isinstance(stmt, ast.If):
+                walk(stmt.body, in_loop)
+                walk(stmt.orelse, in_loop)
+            elif isinstance(stmt, (ast.With, ast.Try)):
+                walk(getattr(stmt, "body", []), in_loop)
+                for h in getattr(stmt, "handlers", []) or []:
+                    walk(h.body, in_loop)
+                walk(getattr(stmt, "orelse", []) or [], in_loop)
+                walk(getattr(stmt, "finalbody", []) or [], in_loop)
+
+    walk(fi.node.body if hasattr(fi.node, "body") else [], False)
+    return lists, inexact
+
+
+# ----------------------------------------------------- spec construction
+def _as_specs(elems: List[ast.expr], role: str,
+              assigns: Dict[str, List[ast.expr]]) -> List[SpecInfo]:
+    """BlockSpec constructor exprs (or Names resolving to them) ->
+    SpecInfo list; unrecognized elements contribute a shapeless spec so
+    counts stay honest."""
+    out: List[SpecInfo] = []
+    for e in elems:
+        cands = [e]
+        if isinstance(e, ast.Name):
+            cands = assigns.get(e.id, [])
+        made = False
+        for c in cands:
+            s = _spec_from_call(c, role)
+            if s is not None:
+                out.append(s)
+                made = True
+        if not made:
+            out.append(SpecInfo(role=role, shape=None, index_map=None,
+                                lineno=getattr(e, "lineno", 0)))
+    return out
+
+
+def _spec_from_call(expr: ast.expr, role: str) -> Optional[SpecInfo]:
+    if not isinstance(expr, ast.Call) or \
+            _tail(callee_name(expr)) != "BlockSpec":
+        return None
+    shape_arg = expr.args[0] if expr.args else None
+    index_map = expr.args[1] if len(expr.args) > 1 else None
+    for kw in expr.keywords:
+        if kw.arg == "block_shape":
+            shape_arg = kw.value
+        elif kw.arg == "index_map":
+            index_map = kw.value
+    shape = tuple(shape_arg.elts) \
+        if isinstance(shape_arg, (ast.Tuple, ast.List)) else None
+    return SpecInfo(role=role, shape=shape, index_map=index_map,
+                    lineno=expr.lineno)
+
+
+def _scratch_from_call(expr: ast.expr) -> Optional[ScratchInfo]:
+    if not isinstance(expr, ast.Call):
+        return None
+    tail = _tail(callee_name(expr))
+    if tail not in ("VMEM", "SMEM"):
+        return None
+    shape_arg = expr.args[0] if expr.args else None
+    shape = tuple(shape_arg.elts) \
+        if isinstance(shape_arg, (ast.Tuple, ast.List)) else None
+    dtype = ""
+    if len(expr.args) > 1:
+        dtype = _tail(_dotted(expr.args[1]) or "")
+    return ScratchInfo(space=tail, shape=shape, dtype=dtype,
+                       lineno=expr.lineno)
+
+
+def _as_scratch(elems: List[ast.expr],
+                assigns: Dict[str, List[ast.expr]]) -> List[ScratchInfo]:
+    out: List[ScratchInfo] = []
+    for e in elems:
+        cands = [e]
+        if isinstance(e, ast.Name):
+            cands = assigns.get(e.id, [])
+        made = False
+        for c in cands:
+            s = _scratch_from_call(c)
+            if s is not None:
+                out.append(s)
+                made = True
+        if not made:
+            out.append(ScratchInfo(space="VMEM", shape=None, dtype="",
+                                   lineno=getattr(e, "lineno", 0)))
+    return out
+
+
+# ----------------------------------------------------- kernel resolution
+def _local_named(mod: ModuleInfo, fi: FunctionInfo, name: str
+                 ) -> Optional[FunctionInfo]:
+    scope: Optional[FunctionInfo] = fi
+    while scope is not None:
+        hit = mod.functions.get(scope.qualname + "." + name)
+        if hit is not None:
+            return hit
+        scope = scope.parent
+    return mod.functions.get(name)
+
+
+def _resolve_kernel(fi: FunctionInfo, expr: ast.expr,
+                    assigns: Dict[str, List[ast.expr]],
+                    _depth: int = 0) -> Optional[FunctionInfo]:
+    if _depth > 4 or expr is None:
+        return None
+    mod = fi.module
+    if isinstance(expr, ast.Lambda):
+        for f in mod.functions.values():
+            if isinstance(f.node, ast.Lambda) and \
+                    f.node.lineno == expr.lineno and \
+                    f.node.col_offset == expr.col_offset:
+                return f
+        return None
+    if isinstance(expr, ast.Name):
+        hit = _local_named(mod, fi, expr.id)
+        if hit is not None and not isinstance(hit.node, ast.Lambda):
+            return hit
+        for v in assigns.get(expr.id, []):
+            got = _resolve_kernel(fi, v, assigns, _depth + 1)
+            if got is not None:
+                return got
+        return hit
+    if isinstance(expr, ast.Call):
+        name = callee_name(expr)
+        if name and _tail(name) == "partial" and expr.args:
+            return _resolve_kernel(fi, expr.args[0], assigns, _depth + 1)
+        if name is not None and "." not in name:
+            return _local_named(mod, fi, name)
+    return None
+
+
+def kernel_closure(graph: CallGraph, kernel: FunctionInfo
+                   ) -> List[FunctionInfo]:
+    """The kernel body plus its same-module helpers: lexically nested
+    defs and statically resolvable same-module callees (transitively).
+    This is what KRN004/KRN005 walk."""
+    mod = kernel.module
+    seen: Dict[str, FunctionInfo] = {}
+    work = [kernel]
+    while work:
+        fi = work.pop()
+        if fi.qualname in seen:
+            continue
+        seen[fi.qualname] = fi
+        prefix = fi.qualname + "."
+        for qn, nested in mod.functions.items():
+            if qn.startswith(prefix) and qn not in seen:
+                work.append(nested)
+        for call in fi.calls:
+            for callee in graph.resolve_call(fi, call):
+                if callee.module is mod and callee.qualname not in seen:
+                    work.append(callee)
+    return list(seen.values())
+
+
+def map_arity(site_fi: FunctionInfo, expr: Optional[ast.expr],
+              assigns: Dict[str, List[ast.expr]],
+              _depth: int = 0) -> Optional[int]:
+    """Positional arity of an index map: lambda, local/module def,
+    or a factory call returning a nested def.  None = cannot prove
+    (varargs, unresolvable)."""
+    if expr is None or _depth > 4:
+        return None
+    mod = site_fi.module
+
+    def _args_of(node) -> Optional[int]:
+        a = node.args
+        if a.vararg is not None:
+            return None
+        return len(a.posonlyargs) + len(a.args)
+
+    if isinstance(expr, ast.Lambda):
+        return _args_of(expr)
+    if isinstance(expr, ast.Name):
+        fn = _local_named(mod, site_fi, expr.id)
+        if fn is not None and isinstance(
+                fn.node, (ast.FunctionDef, ast.Lambda)):
+            return _args_of(fn.node)
+        for v in assigns.get(expr.id, []):
+            got = map_arity(site_fi, v, assigns, _depth + 1)
+            if got is not None:
+                return got
+        return None
+    if isinstance(expr, ast.Call):
+        # factory: _phase_map(off, steps, nr) returning a nested def
+        name = callee_name(expr)
+        if name is None or "." in name:
+            return None
+        factory = _local_named(mod, site_fi, name)
+        if factory is None or not isinstance(factory.node,
+                                             ast.FunctionDef):
+            return None
+        for stmt in _own_statements(factory.node):
+            if isinstance(stmt, ast.Return):
+                if isinstance(stmt.value, ast.Lambda):
+                    return _args_of(stmt.value)
+                if isinstance(stmt.value, ast.Name):
+                    inner = mod.functions.get(
+                        factory.qualname + "." + stmt.value.id)
+                    if inner is not None and isinstance(
+                            inner.node, ast.FunctionDef):
+                        return _args_of(inner.node)
+        return None
+    return None
+
+
+def resolve_index_map_def(site_fi: FunctionInfo,
+                          expr: Optional[ast.expr],
+                          assigns: Dict[str, List[ast.expr]]
+                          ) -> Optional[ast.AST]:
+    """The def/lambda node behind an index-map expr (for return-value
+    inspection), following the same paths as :func:`map_arity`."""
+    if expr is None:
+        return None
+    mod = site_fi.module
+    if isinstance(expr, ast.Lambda):
+        return expr
+    if isinstance(expr, ast.Name):
+        fn = _local_named(mod, site_fi, expr.id)
+        if fn is not None and isinstance(
+                fn.node, (ast.FunctionDef, ast.Lambda)):
+            return fn.node
+        for v in assigns.get(expr.id, []):
+            got = resolve_index_map_def(site_fi, v, assigns)
+            if got is not None:
+                return got
+        return None
+    if isinstance(expr, ast.Call):
+        name = callee_name(expr)
+        if name is None or "." in name:
+            return None
+        factory = _local_named(mod, site_fi, name)
+        if factory is None or not isinstance(factory.node,
+                                             ast.FunctionDef):
+            return None
+        for stmt in _own_statements(factory.node):
+            if isinstance(stmt, ast.Return):
+                if isinstance(stmt.value, ast.Lambda):
+                    return stmt.value
+                if isinstance(stmt.value, ast.Name):
+                    inner = mod.functions.get(
+                        factory.qualname + "." + stmt.value.id)
+                    if inner is not None:
+                        return inner.node
+        return None
+    return None
+
+
+# --------------------------------------------------------- site parsing
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _resolve_tuple(expr: Optional[ast.expr],
+                   assigns: Dict[str, List[ast.expr]]
+                   ) -> Optional[Tuple[ast.expr, ...]]:
+    if expr is None:
+        return None
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return tuple(expr.elts)
+    if isinstance(expr, ast.Name):
+        vals = assigns.get(expr.id, [])
+        if len(vals) == 1:
+            return _resolve_tuple(vals[0], assigns)
+    return None
+
+
+def _extract_site(fi: FunctionInfo, call: ast.Call, graph: CallGraph
+                  ) -> PallasSite:
+    mod = fi.module
+    assigns = _scalar_assigns(fi)
+    lists, inexact = _collect_lists(fi, mod)
+    site = PallasSite(fi=fi, call=call, lineno=call.lineno)
+    site.kernel = _resolve_kernel(
+        fi, call.args[0] if call.args else None, assigns)
+
+    # locate the grid-spec call: grid_spec= kwarg (inline or via a local
+    # name), else the pallas_call itself carries grid/in_specs/...
+    spec_call: Optional[ast.Call] = None
+    gs = _kwarg(call, "grid_spec")
+    if isinstance(gs, ast.Name):
+        for v in assigns.get(gs.id, []):
+            if isinstance(v, ast.Call):
+                gs = v
+                break
+    if isinstance(gs, ast.Call) and _tail(callee_name(gs)) in (
+            "PrefetchScalarGridSpec", "GridSpec"):
+        spec_call = gs
+    carrier = spec_call if spec_call is not None else call
+
+    site.grid = _resolve_tuple(_kwarg(carrier, "grid"), assigns)
+    nsp = _kwarg(carrier, "num_scalar_prefetch")
+    if isinstance(nsp, ast.Constant) and isinstance(nsp.value, int):
+        site.num_scalar_prefetch = nsp.value
+
+    complete = True
+    for role, kw in (("in", "in_specs"), ("out", "out_specs")):
+        raw = _kwarg(carrier, kw)
+        if raw is None:
+            continue
+        elems = _resolve_list_expr(raw, lists)
+        if elems is None:
+            # a single BlockSpec (out_specs commonly) or a lone Name
+            single = _spec_from_call(raw, role)
+            if single is None and isinstance(raw, ast.Name):
+                if raw.id in inexact:
+                    complete = False
+                for v in assigns.get(raw.id, []):
+                    single = single or _spec_from_call(v, role)
+            if single is not None:
+                elems = [raw]
+            else:
+                complete = False
+        if isinstance(raw, ast.Name) and raw.id in inexact:
+            complete = False
+        if elems is not None:
+            specs = _as_specs(elems, role, assigns)
+            if role == "in":
+                site.in_specs = specs
+            else:
+                site.out_specs = specs
+        else:
+            complete = False
+    raw = _kwarg(carrier, "scratch_shapes")
+    if raw is not None:
+        elems = _resolve_list_expr(raw, lists)
+        if isinstance(raw, ast.Name) and raw.id in inexact:
+            complete = False
+        if elems is not None:
+            site.scratch = _as_scratch(elems, assigns)
+        else:
+            complete = False
+    site.specs_complete = complete and site.grid is not None
+    return site
+
+
+# ----------------------------------------------------------- module census
+_REF_SUFFIXES = ("_ref", "_xla", "_dense")
+
+
+def _entry_stem(name: str) -> str:
+    return name[:-len("_pallas")] if name.endswith("_pallas") else name
+
+
+def _uncovered_entries(mod: ModuleInfo, graph: CallGraph,
+                       has_site: set) -> List[FunctionInfo]:
+    """Public top-level functions that (transitively, within the module)
+    reach a pallas_call but have no ``<stem>_ref/_xla/_dense`` twin."""
+    # transitive reach, within-module resolution only
+    reaches = set(has_site)
+    changed = True
+    while changed:
+        changed = False
+        for qn, fi in mod.functions.items():
+            if qn in reaches or not qn:
+                continue
+            for call in fi.calls:
+                if any(c.module is mod and c.qualname in reaches
+                       for c in graph.resolve_call(fi, call)):
+                    reaches.add(qn)
+                    changed = True
+                    break
+    ref_stems = [n[:-len(s)] for n in mod.functions
+                 for s in _REF_SUFFIXES
+                 if "." not in n and n.endswith(s)]
+    out: List[FunctionInfo] = []
+    for qn in sorted(reaches):
+        fi = mod.functions.get(qn)
+        if fi is None or "." in qn or qn.startswith("_") or \
+                fi.cls is not None:
+            continue
+        stem = _entry_stem(qn)
+        if not any(stem.startswith(rs) or rs.startswith(stem)
+                   for rs in ref_stems):
+            out.append(fi)
+    return out
+
+
+# --------------------------------------------------------------- context
+def build_context(modules: Dict[str, ModuleInfo],
+                  graph: CallGraph) -> KernelContext:
+    ctx = KernelContext(graph=graph, modules=modules)
+    for mod in modules.values():
+        mp = mod.relpath          # rules look functions up by relpath
+        has_site: set = set()
+        for qn, fi in mod.functions.items():
+            specs: List[SpecInfo] = []
+            scratch: List[ScratchInfo] = []
+            for call in fi.calls:
+                tail = _tail(callee_name(call))
+                if tail == "BlockSpec":
+                    s = _spec_from_call(call, "unknown")
+                    if s is not None:
+                        specs.append(s)
+                elif tail in ("VMEM", "SMEM"):
+                    s = _scratch_from_call(call)
+                    if s is not None:
+                        scratch.append(s)
+                elif tail == "pallas_call":
+                    site = _extract_site(fi, call, graph)
+                    ctx.sites.setdefault(mp, []).append(site)
+                    has_site.add(qn)
+                    ctx.n_sites += 1
+                    if site.kernel is not None:
+                        ctx.n_kernels += 1
+            if specs:
+                ctx.census_specs[(mp, qn)] = specs
+                ctx.n_specs += len(specs)
+            if scratch:
+                ctx.census_scratch[(mp, qn)] = scratch
+                ctx.n_scratch += len(scratch)
+        if has_site:
+            unc = _uncovered_entries(mod, graph, has_site)
+            if unc:
+                ctx.uncovered_entries[mod.relpath] = unc
+    return ctx

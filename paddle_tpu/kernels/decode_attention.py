@@ -202,6 +202,16 @@ def _prefill_kernel(off_ref, q_ref, k_ref, v_ref, out_ref,
         out_ref[0] = (acc_ref[...] / l_safe).astype(out_ref.dtype)
 
 
+def flash_prefill_ref(q: jax.Array, k_cache: jax.Array,
+                      v_cache: jax.Array, cur_len,
+                      sm_scale: Optional[float] = None) -> jax.Array:
+    """Pure-jnp twin of :func:`flash_prefill` — the dense cached-
+    attention path IS the oracle (it materializes the (S, T) scores the
+    kernel streams)."""
+    return cached_attention_dense(q, k_cache, v_cache, cur_len,
+                                  sm_scale=sm_scale)
+
+
 def flash_prefill(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                   cur_len, sm_scale: Optional[float] = None,
                   block_q: int = 128, block_k: int = 128) -> jax.Array:

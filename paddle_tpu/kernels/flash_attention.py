@@ -602,7 +602,7 @@ def _bwd_impl(causal, sm_scale, block_q, block_k, h, hkv, compact, res,
             causal=causal, sm_scale=sm_scale, compact=compact)
 
     dq = pl.pallas_call(
-        dq_kernel, grid=(bh, sq // bq, skv // bk),
+        dq_kernel, grid=(bh, pl.cdiv(sq, bq), pl.cdiv(skv, bk)),
         in_specs=in_specs_dq,
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=_sds((bh, sq, d), jnp.float32, q),
@@ -646,7 +646,7 @@ def _bwd_impl(causal, sm_scale, block_q, block_k, h, hkv, compact, res,
 
     bh_kv = k.shape[0]
     dk, dv = pl.pallas_call(
-        dkv_kernel, grid=(bh_kv, skv // bk, rep, sq // bq),
+        dkv_kernel, grid=(bh_kv, pl.cdiv(skv, bk), rep, pl.cdiv(sq, bq)),
         in_specs=in_specs_dkv,
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, i, r, j: (b, i, 0)),
@@ -697,6 +697,46 @@ def _flash_lse_fwd_rule(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q,
 
 
 _flash_attention_lse.defvjp(_flash_lse_fwd_rule, _bwd_with_lse)
+
+
+def flash_attention_ref(q, k, v, segment_ids=None, kv_segment_ids=None,
+                        causal: bool = True,
+                        sm_scale: Optional[float] = None,
+                        n_heads: int = 1,
+                        n_kv_heads: Optional[int] = None):
+    """Pure-jnp dense twin of :func:`flash_attention` — the parity
+    oracle. Same (BH, S, D) layout and GQA convention (query heads of
+    one group are consecutive rows per kv head); matches the kernels'
+    fully-masked-row semantics (such rows emit zeros, not NaN)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    h = n_heads
+    hkv = h if n_kv_heads is None else n_kv_heads
+    rep = h // hkv
+    bh, sq, d = q.shape
+    b = bh // h
+    skv = k.shape[1]
+    qf = q.reshape(b, hkv, rep, sq, d).astype(jnp.float32) * sm_scale
+    kf = k.reshape(b, hkv, skv, d).astype(jnp.float32)
+    vf = v.reshape(b, hkv, skv, d).astype(jnp.float32)
+    s = jnp.einsum("bgrqd,bgkd->bgrqk", qf, kf)
+    if causal:
+        q_pos = jnp.arange(sq)[:, None]
+        kv_pos = jnp.arange(skv)[None, :]
+        s = jnp.where(kv_pos <= q_pos, s, _NEG_INF)
+    if segment_ids is not None:
+        kv_ids = (segment_ids if kv_segment_ids is None
+                  else kv_segment_ids)
+        same = (segment_ids.reshape(b, hkv, rep, sq)[..., :, None]
+                == kv_ids.reshape(b, hkv, skv)[:, :, None, None, :])
+        s = jnp.where(same, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.where(m <= _NEG_INF / 2, 0.0, m)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = jnp.einsum("bgrqk,bgkd->bgrqd", p / l_safe, vf)
+    return out.reshape(bh, sq, d).astype(q.dtype)
 
 
 def flash_attention_with_lse(q, k, v, causal: bool = True,

@@ -67,11 +67,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..analysis.tile_geometry import LANES as _LANES
+from ..analysis.tile_geometry import tile as _tile
 from .paged_attention import (QuantizedPages, paged_attention_xla,
                               write_paged_kv)
 
 _NEG_INF = -1e30
-_LANES = 128
 
 __all__ = ["BlockDecodeWeights", "Int4Tiles", "MultiBlockDecodeWeights",
            "fused_block_decode", "fused_block_decode_pallas",
@@ -159,19 +160,9 @@ def fused_block_decode_ref(x, weights: BlockDecodeWeights, k_pages, v_pages,
 
 
 # --------------------------------------------------------------- tiling
-def _tile(n: int, target: int) -> int:
-    """Largest divisor of ``n`` that is <= target, preferring multiples
-    of 128 (lane tiles); falls back to any divisor so odd dims stay
-    correct (just less efficient)."""
-    if n <= target:
-        return n
-    for cand in range(target - target % 128, 0, -128):
-        if n % cand == 0:
-            return cand
-    for cand in range(min(target, n), 0, -1):
-        if n % cand == 0:
-            return cand
-    return n
+# Block tiling (``_tile``) and the lane constant come from the shared
+# geometry module (analysis/tile_geometry.py) — the memwatch planner
+# and the kernelcheck lint derive VMEM pricing from the same source.
 
 
 def _f32_dot(a, b):
@@ -618,7 +609,11 @@ def fused_block_decode_pallas(x, weights: BlockDecodeWeights, k_pages,
             pl.BlockSpec((1, 1, page, d), _kp_map),                 # k_pages
             pl.BlockSpec((1, 1, page, d), _kp_map),                 # v_pages
         ] + ([
+            # int8 KV scale: ONE value per token row is the quant
+            # contract; a 128-wide block would DMA 127 dead lanes
+            # kernelcheck: disable=KRN001
             pl.BlockSpec((1, 1, page, 1), _kp_map),                 # k scale
+            # kernelcheck: disable=KRN001
             pl.BlockSpec((1, 1, page, 1), _kp_map),                 # v scale
         ] if kv_quant else []),
         out_specs=[
@@ -1293,6 +1288,8 @@ def fused_multi_block_decode_pallas(x, weights: MultiBlockDecodeWeights,
         in_specs.append(spec)
         if wt_quant:
             operands.append(w.q)
+            # int4 tile scale: one scalar per (row, col) weight tile
+            # by design  # kernelcheck: disable=KRN001
             in_specs.append(pl.BlockSpec((1, 1, 1), imap))
             operands.append(w.scale)
         else:
@@ -1326,6 +1323,8 @@ def fused_multi_block_decode_pallas(x, weights: MultiBlockDecodeWeights,
     for m in range(n_layers):
         in_specs += [pl.BlockSpec((1, 1, page, d), _kp_map(m))] * 2
         if kv_quant:
+            # int8 KV scale rows: one value per token row by contract
+            # kernelcheck: disable=KRN001
             in_specs += [pl.BlockSpec((1, 1, page, 1), _kp_map(m))] * 2
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

@@ -254,7 +254,9 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     operands = [qg, k_pages, v_pages]
     if quant:
         # per-token scale rows ride as their own operands, indexed by
-        # the SAME block-table map as the payload pages
+        # the SAME block-table map as the payload pages; the 1-wide
+        # lane is the int8-scale contract (one value per token row)
+        # kernelcheck: disable=KRN001
         in_specs += [pl.BlockSpec((1, 1, page_size, 1), kv_index)] * 2
         operands = [qg, k_pages.q, v_pages.q,
                     k_pages.scale, v_pages.scale]
@@ -427,6 +429,8 @@ def paged_chunk_attention(q: jax.Array, k_pages: jax.Array,
     ]
     operands = [qg, k_pages, v_pages]
     if quant:
+        # per-token int8 scale rows: 1-wide lane by contract
+        # kernelcheck: disable=KRN001
         in_specs += [pl.BlockSpec((1, 1, page_size, 1), kv_index)] * 2
         operands = [qg, k_pages.q, v_pages.q,
                     k_pages.scale, v_pages.scale]

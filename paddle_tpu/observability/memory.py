@@ -744,57 +744,38 @@ def plan_fused_layers(dims: ModelDims, *, fused_layers: int,
     weight/activation storage width (2 = bf16 serving, 4 = f32).
     Returns the transparent breakdown + a ``fits`` verdict against
     ``vmem_limit`` — the ``tools/memwatch.py plan --fused-layers``
-    refusal reads it."""
-    from ..kernels.fused_block_decode import _LANES, _tile
+    refusal reads it.
+
+    The tile/scratch geometry itself lives in ONE place —
+    ``paddle_tpu.analysis.tile_geometry`` — which the kernel imports
+    its tiling from and the kernelcheck lint (KRN002) checks the
+    kernel source against, so this plan can never silently disagree
+    with what the kernel actually allocates (r18)."""
+    from ..analysis.tile_geometry import fused_decode_env, price_fused_decode
 
     n = int(fused_layers)
     if n < 1:
         raise ValueError(f"fused_layers must be >= 1, got {n}")
-    b_pad = -(-int(batch) // 8) * 8
-    d = dims.head_dim
-    rep = dims.heads // dims.kv_heads
-    rep_pad = -(-rep // 8) * 8
-    qw = dims.heads * d
-    kvw = dims.kv_dim
-    wq_cols = qw + 2 * kvw
-    hidden, inter = dims.hidden, dims.intermediate
-    tr_h, tr_o, tr_i = _tile(hidden, 512), _tile(qw, 512), _tile(inter, 512)
-    tc_qkv, tc_o = _tile(wq_cols, 256), _tile(hidden, 256)
-    tc_f, tc_d = _tile(inter, 256), _tile(hidden, 256)
-    tc_max = max(tc_qkv, tc_o, tc_f, tc_d)
-    io = int(io_dtype_bytes)
-
-    # double-buffered streamed blocks (weights + the small ln vectors)
-    weight_stream = 2 * io * (2 * hidden                  # ln1 + ln2
-                              + tr_h * tc_qkv             # wqkv tile
-                              + tr_o * tc_o               # wo tile
-                              + 2 * tr_h * tc_f           # wgu gate + up
-                              + tr_i * tc_d)              # wd tile
-    # const-mapped activations in/out (still double-buffered by Mosaic)
-    activation_io = 2 * io * (2 * b_pad * hidden          # x in, out
-                              + 2 * b_pad * d             # sin, cos
-                              + 2 * b_pad * kvw)          # k_new, v_new
-    # per-layer K/V page blocks: 2 operands per grouped layer — the
-    # ONLY term that scales with N
-    pool_blocks = 2 * io * (2 * n * page_size * d)
-    # persistent f32 scratch (activation carry + matmul/attn accs)
-    scratch = 4 * (3 * b_pad * hidden + b_pad * wq_cols + b_pad * qw
-                   + b_pad * inter + 2 * b_pad * tc_max
-                   + rep_pad * d + 2 * rep_pad * _LANES)
-    total = weight_stream + activation_io + pool_blocks + scratch
+    env = fused_decode_env(
+        hidden=dims.hidden, intermediate=dims.intermediate,
+        heads=dims.heads, kv_heads=dims.kv_heads, head_dim=dims.head_dim,
+        batch=batch, page_size=page_size)
+    priced = price_fused_decode(env, fused_layers=n,
+                                io_dtype_bytes=io_dtype_bytes,
+                                vmem_limit=vmem_limit)
     return {
-        "fused_layers": n, "batch": int(batch), "b_pad": b_pad,
-        "page_size": int(page_size), "io_dtype_bytes": io,
+        "fused_layers": n, "batch": int(batch), "b_pad": env["b_pad"],
+        "page_size": int(page_size), "io_dtype_bytes": int(io_dtype_bytes),
         "breakdown": {
-            "weight_stream_buffers": weight_stream,
-            "activation_io_buffers": activation_io,
-            "kv_page_buffers": pool_blocks,
-            "scratch": scratch,
+            "weight_stream_buffers": priced["weight_stream_buffers"],
+            "activation_io_buffers": priced["activation_io_buffers"],
+            "kv_page_buffers": priced["kv_page_buffers"],
+            "scratch": priced["scratch"],
         },
-        "total": int(total),
-        "vmem_limit": int(vmem_limit),
-        "fits": total <= int(vmem_limit),
-        "headroom_bytes": int(vmem_limit) - int(total),
+        "total": priced["total"],
+        "vmem_limit": priced["vmem_limit"],
+        "fits": priced["fits"],
+        "headroom_bytes": priced["headroom_bytes"],
     }
 
 

@@ -565,3 +565,23 @@ class TestSpeculativeDecode:
         prompt = paddle.to_tensor(np.zeros((2, 4), np.int32))
         with pytest.raises(ValueError, match="batch=1"):
             target.generate_speculative(prompt, target, max_new_tokens=2)
+
+
+def test_flash_prefill_ref_twin_parity():
+    """flash_prefill_ref (the dense cached-attention oracle named by the
+    kernelcheck ref-twin census) agrees with the Pallas prefill path."""
+    from paddle_tpu.kernels.decode_attention import (flash_prefill,
+                                                     flash_prefill_ref,
+                                                     update_kv_cache)
+    rng = np.random.default_rng(7)
+    b, s, h, d, t = 2, 24, 4, 16, 128
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    kc = jnp.zeros((b, t, h, d), jnp.float32)
+    vc = jnp.zeros((b, t, h, d), jnp.float32)
+    kc, vc = update_kv_cache(kc, vc, k, v, 0)
+    out = flash_prefill(q, kc, vc, s, block_k=64)
+    ref = flash_prefill_ref(q, kc, vc, s)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

@@ -61,3 +61,19 @@ def test_incubate_dispatch_matches():
         np.asarray(out.numpy()),
         np.asarray(ref(jnp.asarray(x.numpy()), jnp.asarray(w.numpy()))),
         atol=1e-5, rtol=1e-5)
+
+
+def test_ref_twin_matches_kernel():
+    """rms_norm_ref is the in-tree parity oracle (kernelcheck KRN006)
+    and the XLA fallback for rows too wide for VMEM — both roles need
+    it equal to the kernel path."""
+    from paddle_tpu.kernels.rms_norm import rms_norm_ref
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((64, 384)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal(384) * 0.1 + 1.0, jnp.float32)
+    np.testing.assert_allclose(np.asarray(rms_norm_pallas(x, w)),
+                               np.asarray(rms_norm_ref(x, w)),
+                               atol=1e-5, rtol=1e-5)
+    # and it matches this file's local reference exactly (same formula)
+    np.testing.assert_allclose(np.asarray(rms_norm_ref(x, w)),
+                               np.asarray(ref(x, w)), atol=0, rtol=0)

@@ -1,10 +1,10 @@
 #!/usr/bin/env python
-"""Unified static-analysis gate: tracecheck + meshcheck + faultcheck in
-ONE parse.
+"""Unified static-analysis gate: tracecheck + meshcheck + faultcheck +
+kernelcheck in ONE parse.
 
 Usage:
-    python tools/analyze.py                      # all three suites, gate
-    python tools/analyze.py --suite faultcheck   # one suite
+    python tools/analyze.py                      # all four suites, gate
+    python tools/analyze.py --suite kernelcheck  # one suite
     python tools/analyze.py --format json        # (--json still works)
     python tools/analyze.py --format sarif       # CI code-scanning upload
     python tools/analyze.py --format github      # ::error annotations
@@ -25,7 +25,8 @@ vs HEAD (staged, unstaged, or untracked) — the fast pre-push loop.
 Stale-baseline reporting is suppressed in that mode: an entry for an
 unchanged file is filtered, not stale.
 
-Baselines: tools/{tracecheck,meshcheck,faultcheck}_baseline.json.
+Baselines: tools/{tracecheck,meshcheck,faultcheck,kernelcheck}_baseline
+.json.
 Exit codes: 0 clean, 1 new findings (any suite), 2 usage/parse errors.
 """
 
@@ -42,7 +43,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ANALYSIS_DIR = os.path.join(REPO, "paddle_tpu", "analysis")
 
-SUITES = ("tracecheck", "meshcheck", "faultcheck")
+SUITES = ("tracecheck", "meshcheck", "faultcheck", "kernelcheck")
 FORMATS = ("human", "json", "sarif", "github")
 
 SARIF_VERSION = "2.1.0"
@@ -66,7 +67,7 @@ def _load_analysis():
 
 
 def _rule_catalogue(pkg):
-    for attr in ("RULES", "MESH_RULES", "FAULT_RULES"):
+    for attr in ("RULES", "MESH_RULES", "FAULT_RULES", "KERNEL_RULES"):
         cat = getattr(pkg, attr, None)
         if cat:
             return cat
@@ -77,8 +78,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="analyze",
         description="Run the tracecheck (TRC) + meshcheck (MSH) + "
-                    "faultcheck (FLT) static analyzers over one AST "
-                    "parse.")
+                    "faultcheck (FLT) + kernelcheck (KRN) static "
+                    "analyzers over one AST parse.")
     p.add_argument("path", nargs="?",
                    default=os.path.join(REPO, "paddle_tpu"),
                    help="package directory (or single file) to analyze")
@@ -101,7 +102,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "current findings")
     p.add_argument("--rules", default=None,
                    help="comma-separated subset of rules (TRC00x/MSH00x/"
-                        "FLT00x; each suite picks out its own)")
+                        "FLT00x/KRN00x; each suite picks out its own)")
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("--stats", action="store_true")
     return p
@@ -166,8 +167,8 @@ def _to_sarif(per_suite, catalogues) -> dict:
         "runs": [{
             "tool": {"driver": {
                 "name": "analyze",
-                "informationUri":
-                    "tools/analyze.py (tracecheck+meshcheck+faultcheck)",
+                "informationUri": "tools/analyze.py (tracecheck+"
+                    "meshcheck+faultcheck+kernelcheck)",
                 "rules": sorted(rules, key=lambda r: r["id"]),
             }},
             "results": results,

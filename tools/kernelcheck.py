@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Pallas/TPU kernel-discipline lint gate (see
+paddle_tpu/analysis/kernelcheck/).
+
+Usage:
+    python tools/kernelcheck.py paddle_tpu           # gate (exit 1 on new)
+    python tools/kernelcheck.py paddle_tpu --json
+    python tools/kernelcheck.py paddle_tpu --update-baseline
+    python tools/kernelcheck.py --list-rules
+
+Pure AST — the analysis package is loaded standalone (never through
+``paddle_tpu/__init__``), so this runs in seconds with no jax import
+and no device; safe as a pre-commit hook or bare CI step.  Unlike
+tracecheck, the kernelcheck suite leans on its siblings (the shared
+tracecheck parse + the jax-free ``tile_geometry`` module), so the
+PARENT analysis package is what gets loaded, as ``ptanalysis``.
+
+The checked-in baseline lives at tools/kernelcheck_baseline.json (kept
+EMPTY — fix, don't baseline); the tier-1 test
+(tests/test_kernelcheck.py) fails on any finding beyond it.
+
+``python tools/analyze.py`` runs this suite AND tracecheck AND
+meshcheck AND faultcheck over one shared parse — prefer it for the
+full gate.
+"""
+
+import importlib
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ANALYSIS_DIR = os.path.join(REPO, "paddle_tpu", "analysis")
+
+
+def _load_standalone():
+    """Import paddle_tpu.analysis WITHOUT triggering the framework's
+    top-level __init__ (which pulls in jax), then hand back the
+    kernelcheck CLI."""
+    spec = importlib.util.spec_from_file_location(
+        "ptanalysis", os.path.join(ANALYSIS_DIR, "__init__.py"),
+        submodule_search_locations=[ANALYSIS_DIR])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["ptanalysis"] = mod
+    spec.loader.exec_module(mod)
+    return importlib.import_module("ptanalysis.kernelcheck.cli")
+
+
+if __name__ == "__main__":
+    sys.exit(_load_standalone().main())
