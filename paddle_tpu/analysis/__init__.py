@@ -3,11 +3,13 @@
 The reference stack pairs its kernels with correctness tooling
 (FLAGS_check_nan_inf sanitizer layers, op-level debugging hooks); this
 package holds the *static* half: analyzers that catch trace-discipline,
-SPMD collective-discipline, recovery-discipline, and TPU
-kernel-discipline bugs at lint time instead of on-chip (or at drill
-time).  See :mod:`.tracecheck` (TRC rules), :mod:`.meshcheck` (MSH
-rules), :mod:`.faultcheck` (FLT rules), and :mod:`.kernelcheck` (KRN
-rules); ``tools/analyze.py`` runs all four over one shared parse.
+SPMD collective-discipline, recovery-discipline, TPU
+kernel-discipline, and host-state handoff-discipline bugs at lint time
+instead of on-chip (or at drill time, or on the far side of a process
+boundary).  See :mod:`.tracecheck` (TRC rules), :mod:`.meshcheck` (MSH
+rules), :mod:`.faultcheck` (FLT rules), :mod:`.kernelcheck` (KRN
+rules), and :mod:`.statecheck` (STC rules); ``tools/analyze.py`` runs
+all five over one shared parse.
 
 :mod:`.tile_geometry` is the jax-free TPU tile/VMEM geometry module
 shared by the fused-decode kernel, the memwatch planner, and

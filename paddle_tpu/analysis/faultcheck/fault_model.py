@@ -471,32 +471,13 @@ def find_registration_conflicts(reg_sites: Dict[int, List[RegSite]]
 
 
 # ------------------------------------------------------- replay vocabulary
-_REPLAY_SEAM_FNS = ("_to_replay_form", "export_requests", "inject_request")
-
-
-def replay_class_vocabulary(modules: Dict[str, ModuleInfo]) -> frozenset:
-    """Class names that flow through the replay seams: annotations on
-    the parameters / returns of ``_to_replay_form``-style functions,
-    plus ``Request`` itself."""
-    names = {"Request"}
-    for mod in modules.values():
-        for fi in mod.functions.values():
-            if fi.name not in _REPLAY_SEAM_FNS:
-                continue
-            node = fi.node
-            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            anns = [p.annotation for p in
-                    (node.args.posonlyargs + node.args.args
-                     + node.args.kwonlyargs)]
-            anns.append(node.returns)
-            for ann in anns:
-                if ann is None:
-                    continue
-                for sub in ast.walk(ann):
-                    if isinstance(sub, ast.Name) and sub.id[:1].isupper():
-                        names.add(sub.id)
-    return frozenset(names)
+# ONE vocabulary, no drift: the replay-class scan is owned by
+# statecheck's bundle-vocabulary module (statecheck generalizes it to
+# the full handoff-bundle vocabulary) and re-exported here — FLT003 and
+# the STC rules read the same definition, asserted by a no-drift test.
+from ..statecheck.bundle_vocab import (REPLAY_SEAM_FNS as
+                                       _REPLAY_SEAM_FNS,
+                                       replay_class_vocabulary)
 
 
 # -------------------------------------------------------------- the build

@@ -66,30 +66,17 @@ _CLOCK_TAILS = {"time", "perf_counter", "monotonic"}
 _BROAD_EXC = {"Exception", "BaseException"}
 
 # value wrappers that yield HOST values even over device inputs: their
-# result is safe to store in replay state.  Matching is ROOT-qualified
-# — `np.concatenate` concretizes, `jnp.concatenate` most certainly
-# does not — so builtins, numpy-rooted calls, host-pulling methods and
-# jax.device_get each get their own list.
-_BUILTIN_CONCRETIZERS = {"int", "float", "bool", "str", "len", "list",
-                         "tuple", "_val"}
-_NP_CONCRETIZERS = {"asarray", "array", "concatenate", "copy", "stack"}
-_HOST_METHODS = {"item", "tolist"}
-
-
-def _is_concretizer_call(fi: FunctionInfo, node: ast.Call) -> bool:
-    name = callee_name(node)
-    if name is None:
-        return isinstance(node.func, ast.Attribute) and \
-            node.func.attr in _HOST_METHODS
-    parts = name.split(".")
-    tail = parts[-1]
-    if tail == "device_get":
-        return True                     # jax.device_get pulls to host
-    if len(parts) == 1:
-        return tail in _BUILTIN_CONCRETIZERS
-    if R._is_numpy_alias(fi, parts[0]):
-        return tail in _NP_CONCRETIZERS
-    return tail in _HOST_METHODS        # x.item() / x.tolist()
+# result is safe to store in replay state.  The concretizer vocabulary
+# and the device-value detector are OWNED by statecheck's
+# bundle-vocabulary module (STC001 generalizes FLT003 to the full
+# bundle vocabulary) and aliased here so the two suites cannot drift.
+from ..statecheck.bundle_vocab import (BUILTIN_CONCRETIZERS as
+                                       _BUILTIN_CONCRETIZERS,
+                                       NP_CONCRETIZERS as
+                                       _NP_CONCRETIZERS,
+                                       HOST_METHODS as _HOST_METHODS,
+                                       is_concretizer_call as
+                                       _is_concretizer_call)
 
 
 def _finding(fi: FunctionInfo, node: ast.AST, rule: str,
@@ -269,41 +256,9 @@ def flt002_check_after_mutation(fi: FunctionInfo, ctx: FaultContext
 
 
 # ------------------------------------------------------------------ FLT003
-def _device_producing(fi: FunctionInfo, expr: ast.expr) -> Optional[str]:
-    """The jnp/lax/jax-rooted call this expression's value flows from,
-    unless a concretizer (int()/np.asarray()/.item()/...) intervenes."""
-    parent: dict = {}
-    order: List[ast.AST] = []
-    stack: List[ast.AST] = [expr]
-    while stack:
-        node = stack.pop()
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda)):
-            continue
-        order.append(node)
-        for child in ast.iter_child_nodes(node):
-            parent[id(child)] = node
-            stack.append(child)
-    skipped: set = set()
-    for node in order:
-        if not isinstance(node, ast.Call):
-            continue
-        if _is_concretizer_call(fi, node):
-            skipped.add(id(node))
-            continue
-        name = callee_name(node)
-        if name is None:
-            continue
-        if R._under_skipped(node, parent, skipped):
-            continue
-        root = name.split(".")[0]
-        target = fi.module.module_aliases.get(root, "")
-        if target in ("jax.numpy", "jax.lax", "jax") or \
-                target.startswith(("jax.numpy.", "jax.lax.")) or \
-                name.startswith(("jnp.", "lax.", "jax.numpy.",
-                                 "jax.lax.", "jax.")):
-            return name
-    return None
+# the jnp/lax/jax-rooted device-value detector is shared with STC001;
+# statecheck owns it (see the concretizer import note above)
+from ..statecheck.bundle_vocab import device_producing as _device_producing
 
 
 def _replay_instances(fi: FunctionInfo, ctx: FaultContext) -> Set[str]:

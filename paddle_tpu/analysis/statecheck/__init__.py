@@ -1,0 +1,67 @@
+"""statecheck — a host-state handoff & cross-process serialization
+discipline analyzer.
+
+tracecheck (r08) gates *trace* discipline, meshcheck (r11) *collective*
+discipline, faultcheck (r15) *recovery* discipline, and kernelcheck
+(r20) *kernel* discipline; statecheck gates the bug class the
+cross-process fleet arc (RPC/queue transport, prefill/decode
+disaggregation, elastic rescale) will otherwise discover in
+production: in-process handoffs pass by *reference*, so a device
+array, a live mutable alias, or a bound streaming callback inside a
+bundle works perfectly single-process and fails only the day the
+transport serializes it.  Transportability is a static property —
+check it before the transport exists.
+
+Rules (all pure AST over the shared tracecheck parse):
+
+- **STC001** device-backed (``jnp``/``lax``/jax-rooted) expression
+  assigned into a bundle field outside a concretizer (generalizes
+  faultcheck FLT003 from replay classes to the full bundle
+  vocabulary, dict bundles included).
+- **STC002** untransportable member reachable in a bundle type —
+  locks, threads, generators, lambdas/bound methods/closures, jax
+  objects, device pools.
+- **STC003** exporter/adopter field symmetry + schema-version
+  discipline: the fields the exporter writes and the adopter reads
+  must match exactly, every dict bundle carries a version tag the
+  adopter checks, one bundle name = one field set package-wide.
+- **STC004** post-export aliasing — mutating a self-rooted mutable
+  object after it was placed in an exported bundle
+  (statement-dominance scan; copy/``detach``/``take_*`` resets).
+- **STC005** nondeterministic cross-process identity — ids minted
+  from ``id()``/``hash()``/clocks/uuid1/getpid (the r11
+  ``CommGroup.id`` bug class made static).
+- **STC006** callback discipline — callables are stripped at export
+  and re-bound via an engine-local registry on adopt (the
+  ``take_callbacks()``/``inject_request(on_token=)`` seam).
+
+The bundle vocabulary (:mod:`.bundle_vocab`) is shared with faultcheck
+— FLT003's replay vocabulary imports from here, so the two suites can
+never drift.
+
+Findings support inline ``# statecheck: disable=STC00x`` pragmas
+(suite-scoped: another suite's pragma never silences STC rules) and a
+checked-in baseline (tools/statecheck_baseline.json, kept empty — the
+precedent is fix, don't baseline); the tier-1 test gates NEW findings
+only.
+
+Run it locally::
+
+    python tools/analyze.py                     # all five suites
+    python tools/analyze.py --suite statecheck
+    python tools/statecheck.py --json           # census included
+"""
+
+from ..tracecheck.findings import (Finding, fingerprint, load_baseline,
+                                   subtract_baseline, write_baseline)
+from .analyzer import AnalyzerConfig, AnalysisResult, analyze_package
+from .bundle_vocab import (bundle_class_vocabulary,
+                           replay_class_vocabulary)
+from .rules import STATE_RULES
+
+__all__ = [
+    "AnalyzerConfig", "AnalysisResult", "Finding", "STATE_RULES",
+    "analyze_package", "bundle_class_vocabulary", "fingerprint",
+    "load_baseline", "replay_class_vocabulary", "subtract_baseline",
+    "write_baseline",
+]

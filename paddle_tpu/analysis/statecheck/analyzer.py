@@ -1,0 +1,116 @@
+"""Orchestration: parse (or reuse a parse), build the handoff model,
+run the STC rules.
+
+``analyze_package`` mirrors the other suites' entry points and accepts
+the same :class:`ParsedPackage`, so the unified CLI (tools/analyze.py)
+runs all FIVE suites over ONE ast.parse pass.  The context build is
+read-only over the shared ``ModuleInfo`` objects, so running statecheck
+never changes what the other suites report on the same parse, in
+either order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..tracecheck.analyzer import ParsedPackage, parse_package
+from ..tracecheck.callgraph import CallGraph, FunctionInfo
+from ..tracecheck.findings import (Finding, dedupe_findings,
+                                   parse_pragmas, suppressed)
+from .state_model import build_context
+from . import rules as SR
+
+
+@dataclass
+class AnalyzerConfig:
+    exclude_patterns: tuple = ()
+    rules: tuple = ("STC001", "STC002", "STC003", "STC004", "STC005",
+                    "STC006")
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding]              # post-pragma, pre-baseline
+    suppressed: List[Finding]            # pragma-silenced
+    n_files: int = 0
+    n_functions: int = 0
+    n_bundle_classes: int = 0            # vocabulary classes defined here
+    n_exporters: int = 0                 # exporter seam functions
+    n_adopters: int = 0                  # adopter seam functions
+    n_seam_pairs: int = 0                # paired exporter/adopter groups
+    n_dict_bundles: int = 0              # dict-returning exporters
+    census: Dict[str, object] = field(default_factory=dict)
+    errors: List[str] = field(default_factory=list)
+
+
+_RULE_FNS = {
+    "STC001": SR.stc001_device_in_bundle,
+    "STC002": SR.stc002_untransportable_member,
+    "STC003": SR.stc003_schema_discipline,
+    "STC004": SR.stc004_post_export_alias,
+    "STC005": SR.stc005_nondeterministic_identity,
+    "STC006": SR.stc006_callback_in_bundle,
+}
+
+
+def analyze_package(package_path: str,
+                    config: Optional[AnalyzerConfig] = None,
+                    parsed: Optional[ParsedPackage] = None
+                    ) -> AnalysisResult:
+    config = config or AnalyzerConfig()
+    if parsed is None:
+        parsed = parse_package(package_path, config.exclude_patterns)
+    else:
+        parsed = parsed.filtered(config.exclude_patterns)
+
+    result = AnalysisResult(findings=[], suppressed=[])
+    result.errors = list(parsed.errors)
+    result.n_files = parsed.n_files
+
+    graph = CallGraph(parsed.modules, parsed.package)
+    ctx = build_context(parsed.modules, graph)
+    pairs = ctx.seam_pairs
+    result.n_bundle_classes = len(ctx.class_defs)
+    result.n_exporters = len(ctx.exporters)
+    result.n_adopters = len(ctx.adopters)
+    result.n_seam_pairs = len(pairs)
+    result.n_dict_bundles = len(ctx.dict_bundles)
+    result.census = {
+        "bundle_classes": sorted(ctx.class_defs),
+        "vocabulary": sorted(ctx.bundle_classes),
+        "exporters": sorted(fi.qualname for fi in
+                            ctx.exporters.values()),
+        "adopters": sorted(fi.qualname for fi in
+                           ctx.adopters.values()),
+        "seam_pairs": [list(p) for p in pairs],
+        "dict_bundles": sorted(
+            ({"exporter": db.fi.qualname, "keys": sorted(db.keys),
+              "version_key": db.version_key}
+             for db in ctx.dict_bundles.values()),
+            key=lambda d: d["exporter"]),
+    }
+
+    findings: List[Finding] = []
+    for mod in parsed.modules.values():
+        pragmas = parse_pragmas(mod.source_lines, tool="statecheck")
+        fis = list(mod.functions.values())
+        if "" not in mod.functions:
+            # the indexer creates the module-body FunctionInfo lazily
+            # (only when a top-level call exists); STC002's class-level
+            # field scan anchors there, so synthesize a transient one —
+            # NEVER stored back into the shared parse
+            fis.append(FunctionInfo("", mod.tree, mod, None, None))
+        for fi in fis:
+            result.n_functions += 1
+            batch: List[Finding] = []
+            for code in config.rules:
+                fn = _RULE_FNS.get(code)
+                if fn is not None:
+                    batch += fn(fi, ctx)
+            for f in batch:
+                (result.suppressed if suppressed(f, pragmas)
+                 else findings).append(f)
+
+    result.findings = dedupe_findings(findings)
+    return result

@@ -1,0 +1,191 @@
+"""The ONE handoff-bundle vocabulary, shared by faultcheck and
+statecheck.
+
+faultcheck's FLT003 (r15) polices device values stored into *replay*
+structures; statecheck (this round) generalizes the same vocabulary to
+every host-state bundle that crosses — or will cross — a process
+boundary: ``Request``, ``HostPage``, the ``harvest_request`` dict
+bundle, emergency-checkpoint payloads, and any class annotated on an
+exporter/adopter seam signature.  Both suites import the vocabulary
+from HERE (the r20 ``tile_geometry`` unification pattern): one
+definition, no drift — asserted by a no-drift test.
+
+Also owned here: the *concretizer* vocabulary (host-value wrappers) and
+the device-producing-expression detector both suites share.  Matching
+is ROOT-qualified — ``np.concatenate`` concretizes, ``jnp.concatenate``
+most certainly does not.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..tracecheck import rules as R
+from ..tracecheck.callgraph import FunctionInfo, ModuleInfo, callee_name
+
+# typing-constructor names that appear inside seam annotations but are
+# never transportable payload classes (``List[Request]`` contributes
+# ``Request``, not ``List``)
+TYPING_NAMES = frozenset({
+    "List", "Dict", "Tuple", "Set", "FrozenSet", "Optional", "Union",
+    "Any", "Callable", "Iterable", "Iterator", "Sequence", "Mapping",
+    "MutableMapping", "MutableSequence", "Type", "NamedTuple",
+    "TypedDict", "Deque", "DefaultDict", "OrderedDict", "Counter",
+})
+
+# the r15 replay seams — faultcheck's FLT003 vocabulary, owned here
+REPLAY_SEAM_FNS = ("_to_replay_form", "export_requests",
+                   "inject_request")
+SEED_REPLAY_CLASSES = frozenset({"Request"})
+
+# exporter / adopter seam-name vocabulary: a function named with an
+# EXPORT prefix detaches host state for transfer; an ADOPT prefix seats
+# transferred host state.  ``_to_replay_form`` is the shared
+# normalization seam both sides funnel through.
+EXPORT_PREFIXES = ("export_", "harvest_", "spill_")
+ADOPT_PREFIXES = ("inject_", "adopt_", "restore_")
+
+SEED_BUNDLE_CLASSES = frozenset({"Request", "HostPage"})
+
+
+def is_exporter_name(name: str) -> bool:
+    return name.lstrip("_").startswith(EXPORT_PREFIXES)
+
+
+def is_adopter_name(name: str) -> bool:
+    return name.lstrip("_").startswith(ADOPT_PREFIXES)
+
+
+def is_seam_name(name: str) -> bool:
+    return (is_exporter_name(name) or is_adopter_name(name)
+            or name in REPLAY_SEAM_FNS)
+
+
+def seam_stem(name: str) -> str:
+    """The pairing stem of a seam name: prefix stripped, singularized —
+    ``export_requests``/``inject_request``/``harvest_request`` all stem
+    to ``request``, so exporters and adopters of one bundle pair up."""
+    tail = name.lstrip("_")
+    for p in EXPORT_PREFIXES + ADOPT_PREFIXES:
+        if tail.startswith(p):
+            tail = tail[len(p):]
+            break
+    return tail.rstrip("s")
+
+
+def _annotation_classes(node: ast.AST) -> Set[str]:
+    """Uppercase-initial names inside one annotation expression, minus
+    the typing constructors."""
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id[:1].isupper() and \
+                sub.id not in TYPING_NAMES:
+            out.add(sub.id)
+    return out
+
+
+def _signature_classes(fi: FunctionInfo) -> Set[str]:
+    node = fi.node
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return set()
+    out: Set[str] = set()
+    anns = [p.annotation for p in
+            (node.args.posonlyargs + node.args.args
+             + node.args.kwonlyargs)]
+    anns.append(node.returns)
+    for ann in anns:
+        if ann is not None:
+            out |= _annotation_classes(ann)
+    return out
+
+
+def replay_class_vocabulary(modules: Dict[str, ModuleInfo]) -> frozenset:
+    """Class names that flow through the replay seams: annotations on
+    the parameters / returns of ``_to_replay_form``-style functions,
+    plus ``Request`` itself.  This IS faultcheck FLT003's vocabulary —
+    ``fault_model`` re-exports it from here."""
+    names = set(SEED_REPLAY_CLASSES)
+    for mod in modules.values():
+        for fi in mod.functions.values():
+            if fi.name in REPLAY_SEAM_FNS:
+                names |= _signature_classes(fi)
+    return frozenset(names)
+
+
+def bundle_class_vocabulary(modules: Dict[str, ModuleInfo]) -> frozenset:
+    """The full handoff vocabulary statecheck polices: the replay
+    vocabulary plus ``HostPage`` and every class annotated on an
+    exporter/adopter seam signature (``harvest_*``/``adopt_*``/
+    ``spill_*``/``restore_*``/...)."""
+    names = set(SEED_BUNDLE_CLASSES) | set(SEED_REPLAY_CLASSES)
+    for mod in modules.values():
+        for fi in mod.functions.values():
+            if is_seam_name(fi.name):
+                names |= _signature_classes(fi)
+    return frozenset(names)
+
+
+# ------------------------------------------------- host-purity vocabulary
+# value wrappers that yield HOST values even over device inputs: their
+# result is safe to store in a handoff bundle.  Builtins, numpy-rooted
+# calls, host-pulling methods and jax.device_get each get their own
+# list (root-qualified matching).
+BUILTIN_CONCRETIZERS = frozenset({"int", "float", "bool", "str", "len",
+                                  "list", "tuple", "_val"})
+NP_CONCRETIZERS = frozenset({"asarray", "array", "concatenate", "copy",
+                             "stack"})
+HOST_METHODS = frozenset({"item", "tolist"})
+
+
+def is_concretizer_call(fi: FunctionInfo, node: ast.Call) -> bool:
+    name = callee_name(node)
+    if name is None:
+        return isinstance(node.func, ast.Attribute) and \
+            node.func.attr in HOST_METHODS
+    parts = name.split(".")
+    tail = parts[-1]
+    if tail == "device_get":
+        return True                     # jax.device_get pulls to host
+    if len(parts) == 1:
+        return tail in BUILTIN_CONCRETIZERS
+    if R._is_numpy_alias(fi, parts[0]):
+        return tail in NP_CONCRETIZERS
+    return tail in HOST_METHODS         # x.item() / x.tolist()
+
+
+def device_producing(fi: FunctionInfo, expr: ast.expr) -> Optional[str]:
+    """The jnp/lax/jax-rooted call this expression's value flows from,
+    unless a concretizer (int()/np.asarray()/.item()/...) intervenes."""
+    parent: dict = {}
+    order: List[ast.AST] = []
+    stack: List[ast.AST] = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        order.append(node)
+        for child in ast.iter_child_nodes(node):
+            parent[id(child)] = node
+            stack.append(child)
+    skipped: set = set()
+    for node in order:
+        if not isinstance(node, ast.Call):
+            continue
+        if is_concretizer_call(fi, node):
+            skipped.add(id(node))
+            continue
+        name = callee_name(node)
+        if name is None:
+            continue
+        if R._under_skipped(node, parent, skipped):
+            continue
+        root = name.split(".")[0]
+        target = fi.module.module_aliases.get(root, "")
+        if target in ("jax.numpy", "jax.lax", "jax") or \
+                target.startswith(("jax.numpy.", "jax.lax.")) or \
+                name.startswith(("jnp.", "lax.", "jax.numpy.",
+                                 "jax.lax.", "jax.")):
+            return name
+    return None

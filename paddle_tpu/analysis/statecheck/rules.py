@@ -1,0 +1,650 @@
+"""The STC rule checkers.
+
+Each rule is ``(FunctionInfo, StateContext) -> List[Finding]`` over ONE
+function body (nested defs are their own FunctionInfo).  The rules
+encode the contract the cross-process fleet arc rests on: a handoff
+bundle must survive serialization and mean the same thing on the other
+side — host values only, no untransportable members, one schema per
+bundle name with a version tag, no live aliases after export, no
+per-process identities, and callbacks stripped at export / re-bound via
+registry on adopt.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..tracecheck import rules as R
+from ..tracecheck.callgraph import FunctionInfo, _dotted, callee_name
+from ..tracecheck.findings import Finding
+from .bundle_vocab import device_producing, is_concretizer_call
+from .state_model import StateContext, VERSION_KEYS, _walk_stmts
+
+STATE_RULES: Dict[str, str] = {
+    "STC001": "device-backed expression assigned into a handoff-bundle "
+              "field outside a concretizer — a jnp/lax/jax-rooted "
+              "value stored in a bundle dies with its process's device "
+              "state and cannot serialize; concretize first "
+              "(int()/np.asarray()/.item()/jax.device_get)",
+    "STC002": "untransportable member reachable in a bundle type — a "
+              "lock/thread/generator/callable/jax-object/device-pool "
+              "member makes every instance unpicklable (or silently "
+              "wrong) the day the transport serializes it; keep such "
+              "state engine-local and re-derive it on adopt",
+    "STC003": "exporter/adopter field symmetry + schema-version "
+              "discipline — the fields an exporter writes and its "
+              "paired adopter reads must match exactly, every dict "
+              "bundle carries a version tag the adopter checks, and "
+              "one bundle name keeps ONE field set package-wide",
+    "STC004": "post-export aliasing — a self-rooted mutable object "
+              "mutated after it was placed in an exported bundle: "
+              "in-process the receiver sees the mutation, across a "
+              "process boundary the serialized snapshot silently "
+              "diverges; copy at placement or hand ownership off "
+              "(take_*/detach_*)",
+    "STC005": "nondeterministic cross-process identity — an id minted "
+              "from id()/hash()/clocks/uuid1/getpid is only unique (or "
+              "only stable) within one process; two processes mint "
+              "colliding or irreproducible keys, so derive identities "
+              "from a process-stable key instead",
+    "STC006": "callback discipline — a callable placed in a handoff "
+              "bundle (lambda, bound method, closure, Callable "
+              "parameter) cannot cross a process boundary; strip it at "
+              "export and re-bind via an engine-local registry on "
+              "adopt (the take_callbacks()/inject_request(on_token=) "
+              "seam)",
+}
+
+
+def _finding(fi: FunctionInfo, node: ast.AST, rule: str,
+             msg: str) -> Finding:
+    line = getattr(node, "lineno", fi.lineno)
+    return Finding(rule=rule, path=fi.module.relpath, line=line,
+                   func=fi.qualname, message=msg,
+                   source=fi.module.line(line))
+
+
+# ---------------------------------------------------------- bundle instances
+def _bundle_instances(fi: FunctionInfo, ctx: StateContext) -> Set[str]:
+    """Local names holding bundle instances in this function:
+    parameters annotated with a bundle class, locals constructed from
+    one, and — in modules that define/import a bundle class — the
+    conventional ``req``/``request`` names (the FLT003 convention)."""
+    out: Set[str] = set()
+    node = fi.node
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        for p in (node.args.posonlyargs + node.args.args
+                  + node.args.kwonlyargs):
+            ann = p.annotation
+            if ann is not None and any(
+                    isinstance(s, ast.Name)
+                    and s.id in ctx.bundle_classes
+                    for s in ast.walk(ann)):
+                out.add(p.arg)
+        for stmt in R._body_walk(fi):
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call):
+                vn = callee_name(stmt.value)
+                if vn and vn.rsplit(".", 1)[-1] in ctx.bundle_classes:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            out.add(t.id)
+    mod = fi.module
+    mod_has_bundle = any(
+        imp[1] in ctx.bundle_classes
+        for imp in mod.imported_names.values())
+    if not mod_has_bundle:
+        for sub in mod.tree.body:
+            if isinstance(sub, ast.ClassDef) and \
+                    sub.name in ctx.bundle_classes:
+                mod_has_bundle = True
+                break
+    if mod_has_bundle:
+        out.update(("req", "request"))
+    return out
+
+
+def _field_stores(fi: FunctionInfo, insts: Set[str]):
+    """Yield ``(anchor_node, field_chain, value_expr)`` for every store
+    into a bundle instance: attribute/subscript assigns and
+    append/extend/insert mutations."""
+    for node in R._body_walk(fi):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                chain = _dotted(t)
+                if chain and "." in chain and \
+                        chain.split(".")[0] in insts:
+                    yield node, chain, node.value
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("append", "extend", "insert") and \
+                node.args:
+            chain = _dotted(node.func.value)
+            if chain and chain.split(".")[0] in insts:
+                yield node, chain, node.args[-1]
+
+
+# ------------------------------------------------------------------ STC001
+def stc001_device_in_bundle(fi: FunctionInfo, ctx: StateContext
+                            ) -> List[Finding]:
+    """FLT003 generalized: device-producing expressions stored into ANY
+    bundle-vocabulary instance, plus the values of exporter dict
+    bundles."""
+    if isinstance(fi.node, (ast.Module, ast.Lambda)):
+        return []
+    out: List[Finding] = []
+    insts = _bundle_instances(fi, ctx)
+    if insts:
+        for node, chain, value in _field_stores(fi, insts):
+            culprit = device_producing(fi, value)
+            if culprit is not None:
+                out.append(_finding(
+                    fi, node, "STC001",
+                    f"bundle field {chain} assigned from {culprit}(...)"
+                    " — handoff bundles must be pure host values; a "
+                    "device value here dies with this process's pool "
+                    "and cannot serialize across the transport; "
+                    "concretize first (int()/np.asarray()/"
+                    "jax.device_get)"))
+    db = ctx.dict_bundles.get(id(fi))
+    if db is not None:
+        for key, value in sorted(db.values.items()):
+            culprit = device_producing(fi, value)
+            if culprit is not None:
+                out.append(_finding(
+                    fi, value, "STC001",
+                    f"dict-bundle field '{key}' assigned from "
+                    f"{culprit}(...) — the exported bundle must be "
+                    "pure host values; concretize before placing it "
+                    "(int()/np.asarray()/jax.device_get)"))
+    return out
+
+
+# ------------------------------------------------------------------ STC002
+_UNTRANSPORTABLE_ANN = frozenset({
+    "Callable", "Lock", "RLock", "Thread", "Event", "Condition",
+    "Semaphore", "BoundedSemaphore", "Barrier", "Queue", "LifoQueue",
+    "Generator", "Iterator", "AsyncIterator", "Coroutine",
+    "ThreadPoolExecutor", "ProcessPoolExecutor", "Array", "Tracer",
+    "ArrayImpl", "DeviceArray",
+})
+_UNTRANSPORTABLE_SUFFIX = re.compile(r"(Pool|KVCache|Executor|Socket|"
+                                     r"Client|Server)$")
+_UNTRANSPORTABLE_CTOR_TAILS = frozenset({
+    "Lock", "RLock", "Event", "Condition", "Semaphore",
+    "BoundedSemaphore", "Barrier", "Thread", "Queue", "LifoQueue",
+    "ThreadPoolExecutor", "ProcessPoolExecutor",
+})
+
+
+def _ann_untransportable(ann: ast.AST) -> Optional[str]:
+    for sub in ast.walk(ann):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name is None:
+            continue
+        if name in _UNTRANSPORTABLE_ANN or \
+                _UNTRANSPORTABLE_SUFFIX.search(name):
+            return name
+    return None
+
+
+def _method_names(cls: ast.ClassDef) -> Set[str]:
+    return {s.name for s in cls.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _value_untransportable(fi: FunctionInfo, value: ast.expr,
+                           methods: Set[str]) -> Optional[str]:
+    if isinstance(value, ast.Lambda):
+        return "a lambda"
+    if isinstance(value, (ast.GeneratorExp,)):
+        return "a generator expression"
+    if isinstance(value, ast.Call):
+        name = callee_name(value)
+        if name is not None:
+            tail = name.rsplit(".", 1)[-1]
+            if tail in _UNTRANSPORTABLE_CTOR_TAILS:
+                return f"{tail}()"
+        culprit = device_producing(fi, value)
+        if culprit is not None:
+            return f"{culprit}(...) (a device value)"
+        return None
+    if isinstance(value, ast.Attribute):
+        chain = _dotted(value)
+        if chain and chain.startswith(("self.", "cls.")) and \
+                chain.split(".")[-1] in methods:
+            return f"the bound method {chain}"
+    return None
+
+
+def stc002_untransportable_member(fi: FunctionInfo, ctx: StateContext
+                                  ) -> List[Finding]:
+    """Scan bundle-class bodies: annotated fields (class level and
+    ``__init__`` parameters stored onto self) and ``self.x = ...``
+    member builds must stay transportable.  Findings attach to the
+    class's functions (``__init__``/methods) or — for class-level
+    annotations — to the module body's FunctionInfo."""
+    out: List[Finding] = []
+    # class-level annotated fields: report once, from the module-body
+    # FunctionInfo (qualname ""), anchored at the AnnAssign line
+    if isinstance(fi.node, ast.Module):
+        for cname, (mod, cls) in sorted(ctx.class_defs.items()):
+            if mod is not fi.module:
+                continue
+            for stmt in cls.body:
+                if isinstance(stmt, ast.AnnAssign) and \
+                        stmt.annotation is not None:
+                    bad = _ann_untransportable(stmt.annotation)
+                    if bad is not None:
+                        tname = (_dotted(stmt.target)
+                                 or "<field>")
+                        out.append(_finding(
+                            fi, stmt, "STC002",
+                            f"bundle class {cname} declares field "
+                            f"{tname} as {bad} — an untransportable "
+                            "member makes every exported instance "
+                            "unpicklable (or dead on arrival) across "
+                            "a process boundary; keep it engine-local "
+                            "(registry/pool) and re-bind on adopt"))
+        return out
+    if not fi.cls or fi.cls not in ctx.class_defs:
+        return []
+    mod, cls = ctx.class_defs[fi.cls]
+    if mod is not fi.module:
+        return []
+    methods = _method_names(cls)
+    node = fi.node
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        # parameters stored onto self with untransportable annotations
+        ann_of = {p.arg: p.annotation
+                  for p in (node.args.posonlyargs + node.args.args
+                            + node.args.kwonlyargs)
+                  if p.annotation is not None}
+        for stmt in R._body_walk(fi):
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            self_targets = [
+                t for t in targets
+                if (_dotted(t) or "").startswith(("self.", "cls."))]
+            if not self_targets:
+                continue
+            value = stmt.value
+            if value is None:
+                continue
+            bad: Optional[str] = None
+            if isinstance(value, ast.Name) and value.id in ann_of:
+                got = _ann_untransportable(ann_of[value.id])
+                if got is not None:
+                    bad = f"the {got}-annotated parameter {value.id}"
+            if bad is None:
+                bad = _value_untransportable(fi, value, methods)
+            if bad is not None:
+                chain = _dotted(self_targets[0]) or "self.<member>"
+                out.append(_finding(
+                    fi, stmt, "STC002",
+                    f"bundle class {fi.cls} binds member {chain} to "
+                    f"{bad} — an untransportable member makes every "
+                    "exported instance unpicklable (or dead on "
+                    "arrival) across a process boundary; keep it "
+                    "engine-local (registry/pool) and re-bind on "
+                    "adopt"))
+    return out
+
+
+# ------------------------------------------------------------------ STC003
+def stc003_schema_discipline(fi: FunctionInfo, ctx: StateContext
+                             ) -> List[Finding]:
+    out: List[Finding] = []
+    db = ctx.dict_bundles.get(id(fi))
+    if db is not None and not db.dynamic:
+        stem = db.group[1]
+        if db.version_key is None:
+            out.append(_finding(
+                fi, db.node, "STC003",
+                f"dict bundle '{stem}' carries no schema-version tag "
+                f"(one of {sorted(VERSION_KEYS)}) — a cross-process "
+                "pair built from different revisions would mis-read "
+                "the bundle instead of refusing loudly; write a "
+                "version key and validate it at adopt"))
+        # field symmetry vs every paired adopter that does keyed reads
+        ex, ad = ctx.pair_groups.get(db.group, ([], []))
+        for adopter in ad:
+            reads = ctx.adopter_reads.get(id(adopter))
+            if reads is None:
+                continue
+            missing = sorted(reads.keys - db.keys)
+            unread = sorted(db.keys - reads.keys)
+            if missing or unread:
+                detail = []
+                if unread:
+                    detail.append("written but never read: "
+                                  + ", ".join(unread))
+                if missing:
+                    detail.append("read but never written: "
+                                  + ", ".join(missing))
+                out.append(_finding(
+                    fi, db.node, "STC003",
+                    f"dict bundle '{stem}' field asymmetry vs adopter "
+                    f"{adopter.qualname} ({'; '.join(detail)}) — the "
+                    "exporter's field set and the adopter's reads "
+                    "must match exactly, or a schema drift ships "
+                    "silently"))
+            if db.version_key is not None and not reads.version_read:
+                out.append(_finding(
+                    fi, db.node, "STC003",
+                    f"dict bundle '{stem}' writes version key "
+                    f"'{db.version_key}' but adopter "
+                    f"{adopter.qualname} never reads it — an "
+                    "unchecked version tag is no version discipline; "
+                    "validate it before seating the bundle"))
+        # one bundle name = one field set package-wide
+        for other in ctx.dict_bundles.values():
+            if other.fi is db.fi or other.dynamic:
+                continue
+            if other.group[1] == stem and other.keys != db.keys and \
+                    (other.fi.module.relpath, other.fi.qualname) < \
+                    (fi.module.relpath, fi.qualname):
+                out.append(_finding(
+                    fi, db.node, "STC003",
+                    f"dict bundle '{stem}' written here with fields "
+                    f"{sorted(db.keys)} but at "
+                    f"{other.fi.module.relpath}:{other.node.lineno} "
+                    f"with {sorted(other.keys)} — one bundle name "
+                    "keeps ONE field set package-wide (the FLT005 "
+                    "metric-schema idiom applied to bundles)"))
+    return out
+
+
+# ------------------------------------------------------------------ STC004
+_TRANSPORT_TAILS = frozenset({"dumps", "dump", "send", "send_bytes",
+                              "put", "put_nowait", "publish"})
+_COPY_TAILS = frozenset({"list", "dict", "tuple", "copy", "deepcopy",
+                         "array", "asarray", "frombuffer"})
+_MUTATOR_TAILS = frozenset({"append", "extend", "insert", "pop",
+                            "clear", "update", "remove", "setdefault",
+                            "sort", "reverse"})
+
+
+def _placed_value_chain(value: ast.expr) -> Optional[str]:
+    """The self-rooted chain a bundle member aliases, or None when the
+    placement copies (list()/np.array()/copy.deepcopy) or detaches
+    (take_*/detach_*) the value."""
+    if isinstance(value, ast.Call):
+        name = callee_name(value)
+        if name is not None:
+            tail = name.rsplit(".", 1)[-1]
+            if tail in _COPY_TAILS or R._is_handoff_call(value):
+                return None
+        return None                      # call results are fresh values
+    base = value
+    while isinstance(base, ast.Subscript):
+        base = base.value
+    chain = _dotted(base)
+    if chain is not None and chain.split(".")[0] in ("self", "cls"):
+        return chain
+    return None
+
+
+def stc004_post_export_alias(fi: FunctionInfo, ctx: StateContext
+                             ) -> List[Finding]:
+    """Statement-dominance scan (the FLT002 shape): placing a
+    self-rooted object into a local bundle records the alias; a
+    transport call (pickle.dumps/send/put/publish) on that bundle marks
+    the export point; mutating a placed alias afterwards is a finding.
+    Rebinding the bundle local clears its region."""
+    if isinstance(fi.node, (ast.Module, ast.Lambda)):
+        return []
+    out: List[Finding] = []
+    placed: Dict[str, Dict[str, ast.AST]] = {}   # bundle -> chain -> node
+    exported: Dict[str, ast.stmt] = {}           # bundle -> export stmt
+
+    def record_placement(bundle: str, value: ast.expr) -> None:
+        chain = _placed_value_chain(value)
+        if chain is not None:
+            placed.setdefault(bundle, {})[chain] = value
+
+    def stmt_mutates(stmt: ast.stmt) -> Optional[Tuple[str, str]]:
+        """(bundle, chain) when this statement mutates a placed alias
+        of an already-exported bundle."""
+        chains: List[str] = []
+        for node in _walk_stmts([stmt]):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    base = t
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    c = _dotted(base)
+                    if c is not None and "." in c:
+                        chains.append(c)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATOR_TAILS:
+                c = _dotted(node.func.value)
+                if c is not None:
+                    chains.append(c)
+        for bundle in exported:
+            for chain in chains:
+                for pchain in placed.get(bundle, {}):
+                    if chain == pchain or \
+                            chain.startswith(pchain + "."):
+                        return bundle, pchain
+        return None
+
+    def scan(stmts: List[ast.stmt]) -> None:
+        for stmt in stmts:
+            # placements: b = {...} / b["k"] = self.x / b.append(self.x)
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        # rebinding the local starts a fresh bundle
+                        placed.pop(t.id, None)
+                        exported.pop(t.id, None)
+                        if isinstance(stmt.value, ast.Dict):
+                            for v in stmt.value.values:
+                                if v is not None:
+                                    record_placement(t.id, v)
+                        elif isinstance(stmt.value, (ast.List,
+                                                     ast.Tuple)):
+                            for v in stmt.value.elts:
+                                record_placement(t.id, v)
+                    elif isinstance(t, ast.Subscript) and \
+                            isinstance(t.value, ast.Name):
+                        record_placement(t.value.id, stmt.value)
+            hit = stmt_mutates(stmt)
+            if hit is not None:
+                bundle, chain = hit
+                out.append(_finding(
+                    fi, stmt, "STC004",
+                    f"{chain} mutated after being placed in bundle "
+                    f"'{bundle}', which was exported at line "
+                    f"{exported[bundle].lineno} — in-process the "
+                    "receiver sees the mutation, across a process "
+                    "boundary the serialized snapshot silently "
+                    "diverges; copy at placement (np.array/list()) or "
+                    "hand ownership off (take_*/detach_*) before "
+                    "mutating"))
+            for sub in _walk_stmts([stmt]):
+                if isinstance(sub, ast.Call):
+                    name = callee_name(sub)
+                    if name is None:
+                        continue
+                    if name.rsplit(".", 1)[-1] in _TRANSPORT_TAILS:
+                        for arg in sub.args:
+                            if isinstance(arg, ast.Name) and \
+                                    arg.id in placed:
+                                exported.setdefault(arg.id, stmt)
+                    # b.append(self.x) placement
+                    if isinstance(sub.func, ast.Attribute) and \
+                            sub.func.attr in ("append", "insert",
+                                              "extend") and \
+                            isinstance(sub.func.value, ast.Name) and \
+                            sub.args:
+                        record_placement(sub.func.value.id,
+                                         sub.args[-1])
+
+    scan(list(fi.node.body))
+    return out
+
+
+# ------------------------------------------------------------------ STC005
+_ID_FIELD = re.compile(r"(^|_)(id|uid|gid|rid|lid|uuid|key)$")
+_NONDET_TAILS = frozenset({"id", "hash", "uuid1", "uuid4", "getpid",
+                           "time", "time_ns", "monotonic",
+                           "monotonic_ns", "perf_counter",
+                           "perf_counter_ns", "random", "randint",
+                           "randrange", "getrandbits", "token_hex",
+                           "token_bytes", "urandom"})
+
+
+def _nondet_call(expr: ast.expr) -> Optional[str]:
+    for sub in _walk_stmts([expr]):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = callee_name(sub)
+        if name is None:
+            continue
+        parts = name.split(".")
+        tail = parts[-1]
+        if tail not in _NONDET_TAILS:
+            continue
+        if tail in ("id", "hash") and len(parts) > 1:
+            continue                    # obj.id()/x.hash() is a method,
+                                        # not the process-local builtin
+        return name
+    return None
+
+
+def stc005_nondeterministic_identity(fi: FunctionInfo, ctx: StateContext
+                                     ) -> List[Finding]:
+    """Identity fields of bundle instances (``*.rid``/``*.key``/...)
+    and id-ish dict-bundle values must not be minted from process-local
+    sources (id()/hash()/clocks/uuid1/getpid/random)."""
+    if isinstance(fi.node, (ast.Module, ast.Lambda)):
+        return []
+    out: List[Finding] = []
+    insts = _bundle_instances(fi, ctx)
+    if insts:
+        for node, chain, value in _field_stores(fi, insts):
+            fld = chain.rsplit(".", 1)[-1]
+            if not _ID_FIELD.search(fld):
+                continue
+            culprit = _nondet_call(value)
+            if culprit is not None:
+                out.append(_finding(
+                    fi, node, "STC005",
+                    f"bundle identity field {chain} minted from "
+                    f"{culprit}(...) — id()/hash()/clocks/uuid1/getpid "
+                    "are process-local: ids collide or change across "
+                    "the process boundary (the CommGroup.id bug class)"
+                    "; derive identities from a process-stable key"))
+    db = ctx.dict_bundles.get(id(fi))
+    if db is not None:
+        for key, value in sorted(db.values.items()):
+            if not _ID_FIELD.search(key):
+                continue
+            culprit = _nondet_call(value)
+            if culprit is not None:
+                out.append(_finding(
+                    fi, value, "STC005",
+                    f"dict-bundle identity field '{key}' minted from "
+                    f"{culprit}(...) — process-local identity sources "
+                    "collide or change across the process boundary; "
+                    "derive identities from a process-stable key"))
+    return out
+
+
+# ------------------------------------------------------------------ STC006
+def _local_defs(fi: FunctionInfo) -> Set[str]:
+    names: Set[str] = set()
+    node = fi.node
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        for stmt in ast.walk(node):
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) and \
+                    stmt is not node:
+                names.add(stmt.name)
+    return names
+
+
+def _callable_params(fi: FunctionInfo) -> Set[str]:
+    node = fi.node
+    out: Set[str] = set()
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        for p in (node.args.posonlyargs + node.args.args
+                  + node.args.kwonlyargs):
+            ann = p.annotation
+            if ann is not None and any(
+                    isinstance(s, ast.Name) and s.id == "Callable"
+                    for s in ast.walk(ann)):
+                out.add(p.arg)
+    return out
+
+
+def _callable_value(fi: FunctionInfo, value: ast.expr,
+                    local_defs: Set[str],
+                    callable_params: Set[str]) -> Optional[str]:
+    if isinstance(value, ast.Lambda):
+        return "a lambda"
+    if isinstance(value, ast.Name):
+        if value.id in local_defs:
+            return f"the nested function {value.id} (a closure)"
+        if value.id in callable_params:
+            return f"the Callable parameter {value.id}"
+    if isinstance(value, ast.Call):
+        name = callee_name(value)
+        if name and name.rsplit(".", 1)[-1] == "partial":
+            return f"{name}(...) (a bound partial)"
+    return None
+
+
+def stc006_callback_in_bundle(fi: FunctionInfo, ctx: StateContext
+                              ) -> List[Finding]:
+    """A callable flowing into a bundle-instance field or an exporter
+    dict bundle.  The blessed pattern is an engine-local registry:
+    strip at export (``take_callbacks()``), re-bind on adopt
+    (``inject_request(..., on_token=)``)."""
+    if isinstance(fi.node, (ast.Module, ast.Lambda)):
+        return []
+    out: List[Finding] = []
+    local_defs = _local_defs(fi)
+    callable_params = _callable_params(fi)
+    insts = _bundle_instances(fi, ctx)
+    if insts:
+        for node, chain, value in _field_stores(fi, insts):
+            bad = _callable_value(fi, value, local_defs,
+                                  callable_params)
+            if bad is not None:
+                out.append(_finding(
+                    fi, node, "STC006",
+                    f"bundle field {chain} bound to {bad} — a "
+                    "callable inside a handoff bundle cannot cross "
+                    "the process boundary (closures/bound methods "
+                    "drag live state with them); strip it at export "
+                    "and re-bind via an engine-local registry on "
+                    "adopt (take_callbacks()/inject_request("
+                    "on_token=))"))
+    db = ctx.dict_bundles.get(id(fi))
+    if db is not None:
+        for key, value in sorted(db.values.items()):
+            bad = _callable_value(fi, value, local_defs,
+                                  callable_params)
+            if bad is not None:
+                out.append(_finding(
+                    fi, value, "STC006",
+                    f"dict-bundle field '{key}' bound to {bad} — a "
+                    "callable inside an exported bundle cannot cross "
+                    "the process boundary; strip it at export and "
+                    "re-bind via an engine-local registry on adopt"))
+    return out

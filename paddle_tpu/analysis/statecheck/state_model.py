@@ -1,0 +1,268 @@
+"""The handoff model statecheck reasons over (pure AST, shared parse).
+
+Four questions drive the STC rules:
+
+1. **What is a bundle?**  The bundle-class vocabulary from
+   :mod:`.bundle_vocab` (``Request``, ``HostPage``, seeds plus classes
+   annotated on exporter/adopter seam signatures), restricted to
+   classes actually DEFINED in the analyzed package for the class-body
+   rules (STC002), plus the dict bundles exporters return.
+
+2. **Where are the seams?**  Every function named with an exporter
+   prefix (``export_``/``harvest_``/``spill_``) or an adopter prefix
+   (``inject_``/``adopt_``/``restore_``).  Exporters and adopters pair
+   by (owner class, seam stem) — ``harvest_request`` pairs with
+   ``adopt_request`` on ``ServingEngine``, ``spill_page`` with
+   ``adopt_page``/``restore_page`` on ``PagedKVCache``.  The pair
+   census feeds STC003 and the scale-sanity gate.
+
+3. **What does each dict bundle carry?**  For a dict-returning
+   exporter: the string keys of the returned dict literal (plus
+   ``b["k"] = ...`` writes into the returned local).  For an adopter:
+   the keys it subscripts/``.get``\\ s off its bundle parameter.  STC003
+   compares the two and demands a schema-version key.
+
+4. **Which calls matter?**  The call graph resolves exporter/adopter
+   call sites (fleet ``_lose_replica`` -> ``export_requests``) so the
+   rules can scope alias and callback checks to code that actually
+   feeds a seam.
+
+Everything here is READ-ONLY over the shared :class:`ModuleInfo`
+objects, so running statecheck never changes what the other suites
+report on the same parse, in either order.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..tracecheck.callgraph import (CallGraph, FunctionInfo, ModuleInfo,
+                                    _dotted, callee_name)
+from ..tracecheck.rules import _body_walk
+from .bundle_vocab import (bundle_class_vocabulary, is_adopter_name,
+                           is_exporter_name, seam_stem)
+
+# keys an exporter may use as the bundle's schema-version tag
+VERSION_KEYS = frozenset({"v", "version", "schema", "schema_version"})
+
+
+def _walk_stmts(stmts):
+    """Pre-order walk of a statement list that PRUNES nested function
+    bodies (a closure's statements belong to its own FunctionInfo)."""
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@dataclass
+class DictBundle:
+    """One exporter-written dict bundle (the ``harvest_request``
+    shape): the keys it returns, keyed by the seam's pairing group."""
+    fi: FunctionInfo
+    group: Tuple[str, str]               # (owner, stem)
+    keys: frozenset                      # statically-known string keys
+    values: Dict[str, ast.expr]          # key -> value expression
+    node: ast.AST                        # the dict literal (anchor)
+    version_key: Optional[str]           # which VERSION_KEYS member, if any
+    dynamic: bool                        # non-constant key seen
+
+
+@dataclass
+class AdopterReads:
+    """The dict-bundle keys one adopter reads off its parameter."""
+    fi: FunctionInfo
+    group: Tuple[str, str]
+    keys: frozenset                      # subscript/.get string keys
+    version_read: bool
+
+
+@dataclass
+class StateContext:
+    graph: CallGraph
+    bundle_classes: frozenset            # full vocabulary (names)
+    class_defs: Dict[str, Tuple[ModuleInfo, ast.ClassDef]]
+    exporters: Dict[int, FunctionInfo]   # id(fi) -> fi
+    adopters: Dict[int, FunctionInfo]
+    pair_groups: Dict[Tuple[str, str], Tuple[List[FunctionInfo],
+                                             List[FunctionInfo]]]
+    dict_bundles: Dict[int, DictBundle]  # id(fi) -> bundle
+    adopter_reads: Dict[int, AdopterReads]
+    fn_of: Dict[int, FunctionInfo] = field(default_factory=dict)
+
+    @property
+    def seam_pairs(self) -> List[Tuple[str, str]]:
+        """Pairing groups with at least one exporter AND one adopter."""
+        return sorted(g for g, (ex, ad) in self.pair_groups.items()
+                      if ex and ad)
+
+
+def _owner_of(fi: FunctionInfo) -> str:
+    return fi.cls if fi.cls else fi.module.relpath
+
+
+# --------------------------------------------------- dict-bundle extraction
+def _dict_literal_keys(node: ast.Dict) -> Tuple[Set[str],
+                                                Dict[str, ast.expr], bool]:
+    keys: Set[str] = set()
+    values: Dict[str, ast.expr] = {}
+    dynamic = False
+    for k, v in zip(node.keys, node.values):
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            keys.add(k.value)
+            values[k.value] = v
+        else:
+            dynamic = True               # **spread or computed key
+    return keys, values, dynamic
+
+
+def extract_dict_bundle(fi: FunctionInfo) -> Optional[DictBundle]:
+    """The dict bundle an exporter returns: ``return {literal}``, or
+    ``return name`` where ``name`` was assigned a dict literal in this
+    body (``b["k"] = ...`` writes between the two extend the keys)."""
+    node = fi.node
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    returns = [s for s in _walk_stmts(node.body)
+               if isinstance(s, ast.Return) and s.value is not None]
+    lit: Optional[ast.Dict] = None
+    local: Optional[str] = None
+    for r in returns:
+        if isinstance(r.value, ast.Dict):
+            lit = r.value
+            break
+        if isinstance(r.value, ast.Name):
+            local = r.value.id
+    keys: Set[str] = set()
+    values: Dict[str, ast.expr] = {}
+    dynamic = False
+    anchor: Optional[ast.AST] = lit
+    if lit is not None:
+        keys, values, dynamic = _dict_literal_keys(lit)
+    elif local is not None:
+        found = False
+        for stmt in _walk_stmts(node.body):
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Dict) and any(
+                        isinstance(t, ast.Name) and t.id == local
+                        for t in stmt.targets):
+                k, v, d = _dict_literal_keys(stmt.value)
+                keys |= k
+                values.update(v)
+                dynamic = dynamic or d
+                anchor = anchor or stmt.value
+                found = True
+            elif isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Subscript) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == local:
+                        sl = t.slice
+                        if isinstance(sl, ast.Constant) and \
+                                isinstance(sl.value, str):
+                            keys.add(sl.value)
+                            values[sl.value] = stmt.value
+                        else:
+                            dynamic = True
+        if not found:
+            return None                  # returns something else
+    else:
+        return None
+    version = next((k for k in sorted(keys) if k in VERSION_KEYS), None)
+    return DictBundle(fi=fi, group=(_owner_of(fi), seam_stem(fi.name)),
+                      keys=frozenset(keys), values=values,
+                      node=anchor or node, version_key=version,
+                      dynamic=dynamic)
+
+
+def extract_adopter_reads(fi: FunctionInfo) -> Optional[AdopterReads]:
+    """Keys this adopter reads off a dict-bundle parameter: subscripts
+    and ``.get(...)`` calls with string-constant keys on any
+    parameter.  None when the adopter never does a keyed read (it
+    adopts a typed object, not a dict bundle)."""
+    node = fi.node
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    params = {p.arg for p in (node.args.posonlyargs + node.args.args
+                              + node.args.kwonlyargs)} - {"self", "cls"}
+    if not params:
+        return None
+    keys: Set[str] = set()
+    version_read = False
+    for sub in _walk_stmts(node.body):
+        key: Optional[str] = None
+        if isinstance(sub, ast.Subscript) and \
+                isinstance(sub.value, ast.Name) and \
+                sub.value.id in params and \
+                isinstance(sub.slice, ast.Constant) and \
+                isinstance(sub.slice.value, str):
+            key = sub.slice.value
+        elif isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr == "get" and \
+                isinstance(sub.func.value, ast.Name) and \
+                sub.func.value.id in params and sub.args and \
+                isinstance(sub.args[0], ast.Constant) and \
+                isinstance(sub.args[0].value, str):
+            key = sub.args[0].value
+        if key is None:
+            continue
+        keys.add(key)
+        if key in VERSION_KEYS:
+            version_read = True
+    if not keys:
+        return None
+    return AdopterReads(fi=fi, group=(_owner_of(fi), seam_stem(fi.name)),
+                        keys=frozenset(keys), version_read=version_read)
+
+
+# -------------------------------------------------------------- the build
+def build_context(modules: Dict[str, ModuleInfo],
+                  graph: CallGraph) -> StateContext:
+    vocab = bundle_class_vocabulary(modules)
+
+    class_defs: Dict[str, Tuple[ModuleInfo, ast.ClassDef]] = {}
+    for mod in modules.values():
+        for stmt in ast.walk(mod.tree):
+            if isinstance(stmt, ast.ClassDef) and stmt.name in vocab:
+                class_defs.setdefault(stmt.name, (mod, stmt))
+
+    fn_of: Dict[int, FunctionInfo] = {}
+    exporters: Dict[int, FunctionInfo] = {}
+    adopters: Dict[int, FunctionInfo] = {}
+    pair_groups: Dict[Tuple[str, str],
+                      Tuple[List[FunctionInfo], List[FunctionInfo]]] = {}
+    dict_bundles: Dict[int, DictBundle] = {}
+    adopter_reads: Dict[int, AdopterReads] = {}
+
+    for mod in modules.values():
+        for fi in mod.functions.values():
+            fn_of[id(fi)] = fi
+            if isinstance(fi.node, (ast.Module, ast.Lambda)):
+                continue
+            if is_exporter_name(fi.name):
+                exporters[id(fi)] = fi
+                group = (_owner_of(fi), seam_stem(fi.name))
+                pair_groups.setdefault(group, ([], []))[0].append(fi)
+                db = extract_dict_bundle(fi)
+                if db is not None:
+                    dict_bundles[id(fi)] = db
+            elif is_adopter_name(fi.name):
+                adopters[id(fi)] = fi
+                group = (_owner_of(fi), seam_stem(fi.name))
+                pair_groups.setdefault(group, ([], []))[1].append(fi)
+                ar = extract_adopter_reads(fi)
+                if ar is not None:
+                    adopter_reads[id(fi)] = ar
+
+    return StateContext(
+        graph=graph, bundle_classes=vocab, class_defs=class_defs,
+        exporters=exporters, adopters=adopters,
+        pair_groups=pair_groups, dict_bundles=dict_bundles,
+        adopter_reads=adopter_reads, fn_of=fn_of)

@@ -352,6 +352,10 @@ class FleetRouter:
                 self._where.pop(rid, None)
                 self._results[rid] = toks
                 self._status[rid] = st.get(lrid, OK)
+        # strip-at-export / re-bind-on-adopt: streaming callbacks are
+        # engine-local, never part of the exported host bundles — pull
+        # the registry off the dying engine, re-bind per request below
+        callbacks = eng.take_callbacks()
         harvested = eng.export_requests()
         lost_map = self._local2g[ri]
         self._local2g[ri] = {}
@@ -361,19 +365,22 @@ class FleetRouter:
         self._completed_at_loss = self._fleet_completed()
         self._observe_loss(ri)
         for req in harvested:
+            cb = callbacks.get(req.rid)
             rid = lost_map.pop(req.rid, None)
             if rid is None:
                 continue
-            self._route_existing(rid, req)
+            self._route_existing(rid, req, cb)
             self.rerouted += 1
         self._observe_reroutes(len(harvested))
 
-    def _route_existing(self, rid: int, req: Request) -> None:
+    def _route_existing(self, rid: int, req: Request,
+                        on_token: Optional[Callable] = None) -> None:
         """Re-route one harvested request through normal placement.
-        ``inject_request`` keeps its tokens/deadline/callback, so the
+        ``inject_request`` keeps its tokens/deadline (and re-binds the
+        stripped streaming callback under the fresh local rid), so the
         receiving replica replays the continuation bit-identically."""
         ri, why = self._place(req.prompt, req.deadline)
-        lrid = self.engines[ri].inject_request(req)
+        lrid = self.engines[ri].inject_request(req, on_token=on_token)
         self._where[rid] = (ri, lrid)
         self._local2g[ri][lrid] = rid
         self.placements.append((rid, ri, why))

@@ -75,9 +75,11 @@ class Request:
     # token stream (prompt, plus emitted tokens on replay)
     prefill_pos: Optional[int] = None
     feed: Optional[np.ndarray] = None
-    # streaming: host callback fired per generated token and once at
-    # terminal status — on_token(rid, token_id_or_None, done)
-    on_token: Optional[Callable[[int, Optional[int], bool], None]] = None
+    # streaming callbacks deliberately do NOT live on the request: they
+    # are engine-local state (``ServingEngine._callbacks``, rid ->
+    # on_token), stripped at every export seam and re-bound on
+    # inject/adopt — a bound callable inside a handoff bundle cannot
+    # cross the process boundary the fleet transport serializes over
     # prefix-aware admission bookkeeping: how many cached-prefix
     # requests bypassed THIS request while it was the page-blocked head
     bypassed: int = 0
@@ -104,6 +106,12 @@ class Request:
 
 
 _POOL_STATES = ("used", "free", "shared", "pinned", "spilled")
+
+# schema version of the harvest_request/adopt_request handoff bundle:
+# bumped whenever the bundle's field set changes, and validated at
+# adopt — a disaggregated pair built from different revisions must
+# refuse loudly instead of mis-seating pages
+HANDOFF_SCHEMA_VERSION = 1
 
 
 class _EngineTelemetry:
@@ -993,6 +1001,12 @@ class ServingEngine:
         # during a step and drained AFTER dispatch/recovery, so a user
         # callback that raises never masquerades as a dispatch failure
         self._events: List[tuple] = []
+        # streaming-callback registry: rid -> on_token. Engine-LOCAL by
+        # design — callbacks never ride the Request objects the export/
+        # harvest seams detach (a bound callable cannot serialize across
+        # a process boundary); take_callbacks() strips the registry at
+        # export and inject_request/adopt_request re-bind on the far side
+        self._callbacks: Dict[int, Callable] = {}
         self._prefix_enabled = bool(prefix_cache)
         self._prefix = (PrefixCache(self.pool, replica=self.replica,
                                     host_tier_pages=self.host_tier_pages)
@@ -1096,7 +1110,8 @@ class ServingEngine:
         rid = self._next_rid
         self._next_rid += 1
         req = Request(rid, prompt, int(max_new_tokens), eos_token_id)
-        req.on_token = on_token
+        if on_token is not None:
+            self._callbacks[rid] = on_token
         req.temperature = float(temperature or 0.0)
         req.top_k = int(top_k)
         req.top_p = float(top_p)
@@ -1202,7 +1217,10 @@ class ServingEngine:
         the bit-identical greedy continuation. The engine is left with
         no pending work; completed results stay until drained. Pages
         release when the pool is still alive (a lost replica's pool may
-        be detached — its device state is gone either way)."""
+        be detached — its device state is gone either way). Streaming
+        callbacks do NOT ride the exported requests (host bundles stay
+        transportable): grab them with :meth:`take_callbacks` and
+        re-bind each via ``inject_request(req, on_token=...)``."""
         live = [r for r in self._slots if r is not None]
         pool_alive = self.pool.k_pages and self.pool.k_pages[0] is not None
         out = sorted(live + self._queue, key=lambda r: r.rid)
@@ -1216,17 +1234,33 @@ class ServingEngine:
         self._last_tok[:] = 0
         return out
 
-    def inject_request(self, req: Request) -> int:
+    def take_callbacks(self) -> Dict[int, Callable]:
+        """Detach the rid -> streaming-callback registry — the
+        strip-at-export half of the callback discipline. Callbacks are
+        engine-local and never ride the ``Request`` bundles the export/
+        harvest seams detach (a bound callable cannot serialize across
+        a process boundary); the caller re-binds each one on the far
+        side via ``inject_request(..., on_token=)`` /
+        ``adopt_request(..., on_token=)``."""
+        out, self._callbacks = self._callbacks, {}
+        return out
+
+    def inject_request(self, req: Request,
+                       on_token: Optional[Callable] = None) -> int:
         """Enqueue an EXISTING request object under a fresh local rid —
         the fleet router's re-route half of :meth:`export_requests`.
-        Prompt, emitted tokens, deadline, budgets and the streaming
-        callback all ride along, so admission treats a token-bearing
-        injection exactly like a replay (prefill from prompt + tokens,
-        bit-identical greedy continuation)."""
+        Prompt, emitted tokens, deadline and budgets ride along, so
+        admission treats a token-bearing injection exactly like a
+        replay (prefill from prompt + tokens, bit-identical greedy
+        continuation). ``on_token`` re-binds the request's streaming
+        callback under its fresh rid (the re-bind-on-adopt half of
+        :meth:`take_callbacks`)."""
         req.rid = self._next_rid
         self._next_rid += 1
         req.status = "PENDING"
         req.error = None
+        if on_token is not None:
+            self._callbacks[req.rid] = on_token
         self._queue.append(req)
         # NOT counted as a submission: the request was submitted once,
         # on its original replica — fleet_rerouted_requests is the
@@ -1242,9 +1276,12 @@ class ServingEngine:
         leave with the request, so the decode replica resumes WITHOUT
         re-running prefill and the greedy continuation stays
         bit-identical: the pool bits move, nothing is recomputed.
-        Returns the bundle :meth:`adopt_request` seats; transfer it
-        however the deployment likes (the dryrun harness rides the
-        deterministic p2p mailbox)."""
+        Returns the bundle :meth:`adopt_request` seats — pure host
+        state (``HANDOFF_SCHEMA_VERSION``-tagged, pickle-transportable;
+        the streaming callback is stripped, re-bind it via
+        ``adopt_request(..., on_token=)``); transfer it however the
+        deployment likes (the dryrun harness rides the deterministic
+        p2p mailbox)."""
         req = next((r for r in self._slots
                     if r is not None and r.rid == rid), None)
         if req is None or req.slot is None:
@@ -1279,17 +1316,32 @@ class ServingEngine:
         self._to_replay_form(req)
         self._slots[slot] = None
         self._last_tok[slot] = 0
-        return {"request": req, "pages": pages, "seq_len": seq_len,
+        # strip-at-export: the callback is engine-local state, never
+        # part of the transportable bundle (the adopter re-binds one)
+        self._callbacks.pop(rid, None)
+        return {"v": HANDOFF_SCHEMA_VERSION, "request": req,
+                "pages": pages, "seq_len": seq_len,
                 "last_token": last_tok}
 
-    def adopt_request(self, bundle: dict) -> int:
+    def adopt_request(self, bundle: dict,
+                      on_token: Optional[Callable] = None) -> int:
         """Seat a harvested request mid-stream — the decode-replica
         half of :meth:`harvest_request`: allocate the span, write the
         transferred pages into the fresh block table
         (:meth:`PagedKVCache.adopt_page`), restore the KV cursor and
         the last emitted token, and resume decoding under a fresh local
         rid. Pool geometry must match byte-for-byte (same page layout =
-        same compiled programs serve the adopted row)."""
+        same compiled programs serve the adopted row). ``on_token``
+        re-binds a streaming callback under the fresh rid (callbacks
+        never ride the bundle — the re-bind-on-adopt half of the
+        callback discipline)."""
+        v = bundle.get("v")
+        if v != HANDOFF_SCHEMA_VERSION:
+            raise ValueError(
+                f"adopt_request: bundle schema version {v!r} != this "
+                f"engine's {HANDOFF_SCHEMA_VERSION} — the disaggregated "
+                "pair must run the same handoff revision (re-harvest on "
+                "a matching build instead of mis-seating pages)")
         req: Request = bundle["request"]
         pages = bundle["pages"]
         if not self.pool.k_pages or self.pool.k_pages[0] is None:
@@ -1328,6 +1380,8 @@ class ServingEngine:
         now = time.perf_counter()
         req.t_submit = req.t_submit or now
         req.t_last = now
+        if on_token is not None:
+            self._callbacks[req.rid] = on_token
         self._slots[slot] = req
         self._last_tok[slot] = int(bundle["last_token"])
         return req.rid
@@ -1850,8 +1904,9 @@ class ServingEngine:
               done: bool = False) -> None:
         """Buffer one streaming event; :meth:`step` drains the buffer
         to the callbacks after dispatch/recovery completes."""
-        if req.on_token is not None:
-            self._events.append((req.on_token, req.rid, tok, done))
+        cb = self._callbacks.get(req.rid)
+        if cb is not None:
+            self._events.append((cb, req.rid, tok, done))
 
     def _drain_events(self) -> None:
         while self._events:
@@ -1873,6 +1928,9 @@ class ServingEngine:
         self._results[req.rid] = req.tokens
         self._status[req.rid] = status
         self._emit(req, None, done=True)
+        # the terminal event is buffered above with the callback object
+        # in hand; the registry entry is dead weight from here on
+        self._callbacks.pop(req.rid, None)
 
     def _finish_if_done(self, req: Request) -> None:
         done = len(req.tokens) >= req.max_new_tokens or (

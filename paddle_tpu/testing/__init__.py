@@ -4,10 +4,17 @@
 registry (``FLAGS_fault_inject``) that the serving/training recovery
 machinery is exercised against — see MIGRATION.md "Fault tolerance" and
 ``tools/fault_drill.py`` for the chaos-drill harness.
+
+:mod:`paddle_tpu.testing.transport` is the cross-process handoff
+harness: ``assert_bundle_transportable`` round-trips a bundle through
+pickle into a *spawned* child with numpy byte-equality, and
+``adopt_and_decode_in_child`` resumes a harvested decode on the far
+side of a real process boundary — the dynamic counterpart of the
+statecheck (STC) static gate.  See MIGRATION.md "Handoff discipline".
 """
 
 from __future__ import annotations
 
-from . import faults
+from . import faults, transport
 
-__all__ = ["faults"]
+__all__ = ["faults", "transport"]

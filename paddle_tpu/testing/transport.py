@@ -1,0 +1,252 @@
+"""Cross-process transport assertions for handoff bundles.
+
+statecheck (STC001-006) proves transportability *statically*; this
+module proves it *dynamically*: a bundle that claims to cross a
+process boundary must actually survive ``pickle`` → spawn → unpickle
+with every numpy payload byte-identical.  In-process handoff tests
+pass by reference and cannot catch a device array, a live alias, or a
+bound callback riding in the bundle — only a real process boundary
+does, and ``multiprocessing``'s *spawn* context is the strictest one
+available (fresh interpreter, no inherited memory, the same contract
+an RPC/queue transport will hold the fleet to).
+
+Two seams:
+
+- :func:`export_payload_digests` walks a bundle on the exporting side
+  and digests every numpy leaf (sha256 over the raw bytes, plus shape/
+  dtype/nbytes) into host-pure :class:`PayloadDigest` records;
+- :func:`_adopt_and_report` runs on the adopting side of the boundary:
+  unpickle the wire blob, digest again, wrap in a
+  :class:`TransportReport`.
+
+:func:`assert_bundle_transportable` drives both and fails loudly on
+any drift; :func:`adopt_and_decode_in_child` goes further and resumes
+the decode inside the spawned child (the prefill→decode disaggregation
+smoke path — the continuation must be bit-identical to a solo run).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import multiprocessing as mp
+
+import numpy as np
+
+TRANSPORT_SCHEMA_VERSION = 1
+
+# spawn-child budget: covers a cold jax import on a loaded CI host
+_CHILD_TIMEOUT_S = 300.0
+
+
+@dataclass
+class PayloadDigest:
+    """Host-pure fingerprint of one numpy payload inside a bundle."""
+    path: str                   # e.g. "pages[0].k" — locates the leaf
+    shape: Tuple[int, ...]
+    dtype: str
+    nbytes: int
+    sha256: str
+
+
+@dataclass
+class TransportReport:
+    """What the adopting side of a process boundary actually received."""
+    v: int
+    n_arrays: int
+    total_bytes: int
+    digests: List[PayloadDigest] = field(default_factory=list)
+
+
+def _digest_array(path: str, arr: np.ndarray) -> PayloadDigest:
+    raw = np.ascontiguousarray(arr).tobytes()
+    return PayloadDigest(path=path, shape=tuple(arr.shape),
+                         dtype=str(arr.dtype), nbytes=len(raw),
+                         sha256=hashlib.sha256(raw).hexdigest())
+
+
+def _walk(obj: Any, path: str, out: List[PayloadDigest],
+          seen: set) -> None:
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes,
+                                       np.generic)):
+        return
+    marker = id(obj)
+    if marker in seen:
+        return
+    seen.add(marker)
+    if isinstance(obj, np.ndarray):
+        out.append(_digest_array(path, obj))
+        return
+    tmod = type(obj).__module__ or ""
+    if tmod == "jax" or tmod.startswith(("jax.", "jaxlib")):
+        raise AssertionError(
+            f"bundle leaf {path} is device-backed ({type(obj).__name__})"
+            " — concretize (np.asarray/.item()) before export")
+    if callable(obj) and not isinstance(obj, type):
+        raise AssertionError(
+            f"bundle leaf {path} is a callable "
+            f"({type(obj).__name__}) — strip callbacks at export and "
+            "re-bind via the engine registry on adopt")
+    if isinstance(obj, dict):
+        for k in sorted(obj, key=repr):
+            _walk(obj[k], f"{path}[{k!r}]", out, seen)
+        return
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        items = obj if isinstance(obj, (list, tuple)) else sorted(
+            obj, key=repr)
+        for i, item in enumerate(items):
+            _walk(item, f"{path}[{i}]", out, seen)
+        return
+    slots = getattr(type(obj), "__slots__", None)
+    if slots is not None:
+        for name in slots:
+            _walk(getattr(obj, name), f"{path}.{name}", out, seen)
+        return
+    attrs = getattr(obj, "__dict__", None)
+    if attrs is not None:
+        for name in sorted(attrs):
+            _walk(attrs[name], f"{path}.{name}", out, seen)
+    # any other leaf (enum, range, ...) is pickle's problem — the
+    # round-trip in assert_bundle_transportable still covers it
+
+
+def export_payload_digests(bundle: Any) -> List[PayloadDigest]:
+    """Exporter-side census: every numpy leaf in ``bundle``, digested.
+    Rejects device-backed and callable leaves outright."""
+    out: List[PayloadDigest] = []
+    _walk(bundle, "bundle", out, set())
+    return out
+
+
+def _adopt_and_report(blob: bytes) -> TransportReport:
+    """Adopter-side seam: unpickle the wire blob and report what
+    arrived.  Runs inside the spawned child."""
+    bundle = pickle.loads(blob)
+    digests = export_payload_digests(bundle)
+    return TransportReport(v=TRANSPORT_SCHEMA_VERSION,
+                           n_arrays=len(digests),
+                           total_bytes=sum(d.nbytes for d in digests),
+                           digests=digests)
+
+
+# ----------------------------------------------------- spawn-child workers
+# module-level so the spawn context can import them by qualified name;
+# results travel back over a Pipe as ("ok", payload) / ("error", repr)
+def _report_child(blob: bytes, conn) -> None:
+    try:
+        conn.send(("ok", _adopt_and_report(blob)))
+    except Exception as exc:  # noqa: BLE001 — relayed, parent re-raises
+        conn.send(("error", repr(exc)))
+    finally:
+        conn.close()
+
+
+def _decode_child(blob: bytes, model_kind: str, model_seed: int,
+                  engine_kw: Dict[str, Any], conn) -> None:
+    try:
+        import paddle_tpu as paddle
+        from paddle_tpu.generation.serving import ServingEngine
+        from paddle_tpu import models as M
+
+        paddle.seed(model_seed)
+        if model_kind == "llama":
+            model = M.LlamaForCausalLM(M.LlamaConfig.tiny())
+        elif model_kind == "gpt":
+            model = M.GPTForCausalLM(M.GPTConfig.tiny())
+        else:
+            raise ValueError(f"unknown model_kind: {model_kind!r}")
+        eng = ServingEngine(model, **engine_kw)
+        rid = eng.adopt_request(pickle.loads(blob))
+        res = eng.run()
+        conn.send(("ok", res[rid]))
+    except Exception as exc:  # noqa: BLE001 — relayed, parent re-raises
+        conn.send(("error", repr(exc)))
+    finally:
+        conn.close()
+
+
+def _run_child(target, args, timeout: float) -> Any:
+    ctx = mp.get_context("spawn")
+    parent, child = ctx.Pipe(duplex=False)
+    proc = ctx.Process(target=target, args=args + (child,))
+    proc.start()
+    child.close()
+    try:
+        if not parent.poll(timeout):
+            raise AssertionError(
+                f"spawned child {target.__name__} produced nothing "
+                f"within {timeout:.0f}s")
+        status, payload = parent.recv()
+    finally:
+        proc.join(timeout=30)
+        if proc.is_alive():
+            proc.terminate()
+            proc.join()
+        parent.close()
+    if status != "ok":
+        raise AssertionError(f"{target.__name__} failed in the spawned "
+                             f"child: {payload}")
+    return payload
+
+
+# ------------------------------------------------------------ public API
+def assert_bundle_transportable(bundle: Any,
+                                timeout: float = _CHILD_TIMEOUT_S
+                                ) -> TransportReport:
+    """Round-trip ``bundle`` through pickle into a *spawned* child and
+    back; every numpy payload must arrive byte-identical.
+
+    Raises AssertionError on: a device-backed or callable leaf, an
+    unpicklable member, a child-side failure, or any digest drift
+    (count, path, shape, dtype, or sha256).  Returns the child's
+    :class:`TransportReport` on success.
+    """
+    local = export_payload_digests(bundle)
+    try:
+        blob = pickle.dumps(bundle, protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception as exc:
+        raise AssertionError(
+            f"bundle is not picklable: {exc!r} — statecheck STC002 "
+            "names the member classes that cannot cross a process "
+            "boundary") from exc
+    report = _run_child(_report_child, (blob,), timeout)
+    if report.v != TRANSPORT_SCHEMA_VERSION:
+        raise AssertionError(
+            f"transport report version {report.v} != "
+            f"{TRANSPORT_SCHEMA_VERSION}")
+    mismatches: List[str] = []
+    remote = {d.path: d for d in report.digests}
+    for d in local:
+        got: Optional[PayloadDigest] = remote.pop(d.path, None)
+        if got is None:
+            mismatches.append(f"{d.path}: lost in transit")
+        elif (got.shape, got.dtype, got.sha256) != (d.shape, d.dtype,
+                                                    d.sha256):
+            mismatches.append(
+                f"{d.path}: sent {d.dtype}{list(d.shape)} "
+                f"{d.sha256[:12]}, received {got.dtype}"
+                f"{list(got.shape)} {got.sha256[:12]}")
+    mismatches += [f"{p}: materialized only on arrival" for p in remote]
+    if mismatches:
+        raise AssertionError(
+            "bundle payloads drifted across the process boundary: "
+            + "; ".join(sorted(mismatches)))
+    return report
+
+
+def adopt_and_decode_in_child(bundle: Any, model_kind: str = "llama",
+                              model_seed: int = 91,
+                              engine_kw: Optional[Dict[str, Any]] = None,
+                              timeout: float = _CHILD_TIMEOUT_S
+                              ) -> List[int]:
+    """Ship ``bundle`` to a spawned child that rebuilds the model from
+    ``model_seed``, adopts the request, and runs the decode to
+    completion.  Returns the child's token stream — the caller asserts
+    bit-identity against a solo reference."""
+    blob = pickle.dumps(bundle, protocol=pickle.HIGHEST_PROTOCOL)
+    return _run_child(_decode_child,
+                      (blob, model_kind, model_seed,
+                       dict(engine_kw or {})), timeout)

@@ -467,6 +467,57 @@ class TestReplicaLoss:
         assert all(fleet.status(r) == "OK" for r in rids)
 
 
+class TestHandoffTransport:
+    """Fleet harvest bundles must survive a REAL process boundary
+    (pickle -> spawned child -> byte-identical payloads): the replica
+    router hands work off in-process today, but the bundle contract it
+    rides on is the cross-process one (see MIGRATION.md "Handoff
+    discipline" and the statecheck STC gate)."""
+
+    @staticmethod
+    def _midstream_bundle(eng, rid):
+        for _ in range(64):
+            eng.step()
+            req = next((r for r in eng._slots
+                        if r is not None and r.rid == rid), None)
+            if (req is not None and req.tokens
+                    and req.prefill_pos is None and not req.pending):
+                break
+        else:
+            raise AssertionError("request never reached mid-stream "
+                                 "state")
+        return eng.harvest_request(rid)
+
+    def test_harvest_bundle_crosses_process_boundary(self):
+        from paddle_tpu.testing import transport
+        model = gpt_model()
+        eng = ServingEngine(model, max_batch=1, page_size=8,
+                            max_seq_len=64, replica="xproc")
+        rng = np.random.default_rng(61)
+        rid = eng.submit(rng.integers(0, 256, (12,)).astype(np.int32),
+                         6)
+        bundle = self._midstream_bundle(eng, rid)
+        report = transport.assert_bundle_transportable(bundle)
+        assert report.n_arrays >= 2     # >= 1 page -> k and v payloads
+
+    def test_streaming_callback_never_rides_the_bundle(self):
+        # the on_token callback is engine-local registry state: it is
+        # stripped at every export seam and re-bound on inject/adopt,
+        # so a streaming request's harvest bundle stays picklable
+        from paddle_tpu.testing import transport
+        model = gpt_model()
+        eng = ServingEngine(model, max_batch=1, page_size=8,
+                            max_seq_len=64, replica="xprocb")
+        rng = np.random.default_rng(62)
+        seen = []
+        rid = eng.submit(rng.integers(0, 256, (12,)).astype(np.int32),
+                         6, on_token=lambda r, t, d: seen.append(t))
+        bundle = self._midstream_bundle(eng, rid)
+        transport.assert_bundle_transportable(bundle)
+        # ...and the registry entry was dropped with the harvest
+        assert rid not in eng._callbacks
+
+
 class TestReplicaLabels:
     """The r14 satellite fix: two engines in one process must land on
     DISTINCT per-replica metric series (they used to collide)."""

@@ -34,7 +34,7 @@ from paddle_tpu.generation.program_cache import (clear_decode_program_cache,
 from paddle_tpu.generation.serving import ServingEngine
 from paddle_tpu.models import (GPTConfig, GPTForCausalLM, LlamaConfig,
                                LlamaForCausalLM)
-from paddle_tpu.testing import faults
+from paddle_tpu.testing import faults, transport
 
 pytestmark = pytest.mark.tp_decode
 
@@ -188,9 +188,9 @@ class TestCollectiveTelemetry:
 
 
 # ----------------------------------------- prefill→decode disaggregation
-def _handoff(tokens=8, **kw):
-    """Solo reference vs. a mid-stream harvest/adopt pair; returns
-    (solo_tokens, adopted_tokens)."""
+def _harvest_midstream(tokens=8, **kw):
+    """Run a request past prefill on a fresh engine and harvest it;
+    returns (solo_reference_tokens, bundle, engine_kw)."""
     prompt = PROMPTS[0]
     _, ref = _run(_llama(), prompts=[prompt], tokens=tokens, **kw)
 
@@ -211,11 +211,17 @@ def _handoff(tokens=8, **kw):
         raise AssertionError("request never reached mid-stream state")
     bundle = a.harvest_request(rid)
     assert all(r is None or r.rid != rid for r in a._slots)
+    return ref[0], bundle, kw
 
+
+def _handoff(tokens=8, **kw):
+    """Solo reference vs. a mid-stream harvest/adopt pair; returns
+    (solo_tokens, adopted_tokens)."""
+    solo, bundle, kw = _harvest_midstream(tokens=tokens, **kw)
     b = ServingEngine(_llama(), **kw)
     new_rid = b.adopt_request(bundle)
     res = b.run()
-    return ref[0], res[new_rid]
+    return solo, res[new_rid]
 
 
 class TestHandoff:
@@ -233,3 +239,28 @@ class TestHandoff:
         eng = ServingEngine(_llama(), max_batch=4, max_seq_len=128)
         with pytest.raises(ValueError, match="not seated"):
             eng.harvest_request(12345)
+
+
+class TestCrossProcessHandoff:
+    """The same harvest/adopt pair across a REAL process boundary
+    (multiprocessing spawn): the bundle must survive pickle with every
+    KV page byte-identical, and the child's continuation must equal the
+    solo stream — in-process handoff tests pass by reference and cannot
+    catch a device array or a bound callback riding in the bundle."""
+
+    def test_spawn_roundtrip_bit_identical(self):
+        solo, bundle, kw = _harvest_midstream()
+        report = transport.assert_bundle_transportable(bundle)
+        assert report.n_arrays >= 2       # >=1 page -> k and v payloads
+        adopted = transport.adopt_and_decode_in_child(bundle,
+                                                      engine_kw=kw)
+        assert adopted == solo
+
+    def test_spawn_roundtrip_int8_kv(self):
+        # quantized pages (payload + scale band) must cross the
+        # boundary verbatim — a re-quantization on adopt would drift
+        solo, bundle, kw = _harvest_midstream(kv_dtype="int8")
+        transport.assert_bundle_transportable(bundle)
+        adopted = transport.adopt_and_decode_in_child(bundle,
+                                                      engine_kw=kw)
+        assert adopted == solo

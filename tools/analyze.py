@@ -1,9 +1,9 @@
 #!/usr/bin/env python
 """Unified static-analysis gate: tracecheck + meshcheck + faultcheck +
-kernelcheck in ONE parse.
+kernelcheck + statecheck in ONE parse.
 
 Usage:
-    python tools/analyze.py                      # all four suites, gate
+    python tools/analyze.py                      # all five suites, gate
     python tools/analyze.py --suite kernelcheck  # one suite
     python tools/analyze.py --format json        # (--json still works)
     python tools/analyze.py --format sarif       # CI code-scanning upload
@@ -25,8 +25,8 @@ vs HEAD (staged, unstaged, or untracked) — the fast pre-push loop.
 Stale-baseline reporting is suppressed in that mode: an entry for an
 unchanged file is filtered, not stale.
 
-Baselines: tools/{tracecheck,meshcheck,faultcheck,kernelcheck}_baseline
-.json.
+Baselines: tools/{tracecheck,meshcheck,faultcheck,kernelcheck,
+statecheck}_baseline.json.
 Exit codes: 0 clean, 1 new findings (any suite), 2 usage/parse errors.
 """
 
@@ -43,7 +43,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ANALYSIS_DIR = os.path.join(REPO, "paddle_tpu", "analysis")
 
-SUITES = ("tracecheck", "meshcheck", "faultcheck", "kernelcheck")
+SUITES = ("tracecheck", "meshcheck", "faultcheck", "kernelcheck",
+          "statecheck")
 FORMATS = ("human", "json", "sarif", "github")
 
 SARIF_VERSION = "2.1.0"
@@ -67,7 +68,8 @@ def _load_analysis():
 
 
 def _rule_catalogue(pkg):
-    for attr in ("RULES", "MESH_RULES", "FAULT_RULES", "KERNEL_RULES"):
+    for attr in ("RULES", "MESH_RULES", "FAULT_RULES", "KERNEL_RULES",
+                 "STATE_RULES"):
         cat = getattr(pkg, attr, None)
         if cat:
             return cat
@@ -78,8 +80,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="analyze",
         description="Run the tracecheck (TRC) + meshcheck (MSH) + "
-                    "faultcheck (FLT) + kernelcheck (KRN) static "
-                    "analyzers over one AST parse.")
+                    "faultcheck (FLT) + kernelcheck (KRN) + "
+                    "statecheck (STC) static analyzers over one AST "
+                    "parse.")
     p.add_argument("path", nargs="?",
                    default=os.path.join(REPO, "paddle_tpu"),
                    help="package directory (or single file) to analyze")
@@ -102,7 +105,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "current findings")
     p.add_argument("--rules", default=None,
                    help="comma-separated subset of rules (TRC00x/MSH00x/"
-                        "FLT00x/KRN00x; each suite picks out its own)")
+                        "FLT00x/KRN00x/STC00x; each suite picks out "
+                        "its own)")
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("--stats", action="store_true")
     return p
@@ -168,7 +172,7 @@ def _to_sarif(per_suite, catalogues) -> dict:
             "tool": {"driver": {
                 "name": "analyze",
                 "informationUri": "tools/analyze.py (tracecheck+"
-                    "meshcheck+faultcheck+kernelcheck)",
+                    "meshcheck+faultcheck+kernelcheck+statecheck)",
                 "rules": sorted(rules, key=lambda r: r["id"]),
             }},
             "results": results,

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Host-state handoff & serialization-discipline lint gate (see
+paddle_tpu/analysis/statecheck/).
+
+Usage:
+    python tools/statecheck.py paddle_tpu           # gate (exit 1 on new)
+    python tools/statecheck.py paddle_tpu --json    # census included
+    python tools/statecheck.py paddle_tpu --update-baseline
+    python tools/statecheck.py --list-rules
+
+Pure AST — the analysis package is loaded standalone (never through
+``paddle_tpu/__init__``), so this runs in seconds with no jax import
+and no device; safe as a pre-commit hook or bare CI step.  The suite
+leans on its siblings (the shared tracecheck parse + the bundle
+vocabulary faultcheck also imports), so the PARENT analysis package is
+what gets loaded, as ``ptanalysis``.
+
+The checked-in baseline lives at tools/statecheck_baseline.json (kept
+EMPTY — fix, don't baseline); the tier-1 test
+(tests/test_statecheck.py) fails on any finding beyond it.
+
+``python tools/analyze.py`` runs this suite AND tracecheck AND
+meshcheck AND faultcheck AND kernelcheck over one shared parse —
+prefer it for the full gate.
+"""
+
+import importlib
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ANALYSIS_DIR = os.path.join(REPO, "paddle_tpu", "analysis")
+
+
+def _load_standalone():
+    """Import paddle_tpu.analysis WITHOUT triggering the framework's
+    top-level __init__ (which pulls in jax), then hand back the
+    statecheck CLI."""
+    spec = importlib.util.spec_from_file_location(
+        "ptanalysis", os.path.join(ANALYSIS_DIR, "__init__.py"),
+        submodule_search_locations=[ANALYSIS_DIR])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["ptanalysis"] = mod
+    spec.loader.exec_module(mod)
+    return importlib.import_module("ptanalysis.statecheck.cli")
+
+
+if __name__ == "__main__":
+    sys.exit(_load_standalone().main())
