@@ -70,6 +70,11 @@ def enable_tensor_checker(checker_config=None):
     abort = mode == DebugMode.CHECK_NAN_INF_AND_ABORT
     flags.set_flags({"check_nan_inf": True,
                      "check_nan_inf_level": 0 if abort else 1})
+    # check_nan_inf rides compiled serving programs (PROGRAM_FLAGS):
+    # re-arm the program cache so already-cached steps don't keep
+    # serving without the checker
+    from ..generation.program_cache import clear_decode_program_cache
+    clear_decode_program_cache()
     if checker_config is not None:
         out_dir = getattr(checker_config, "output_dir", None)
         if out_dir:
@@ -81,6 +86,8 @@ def enable_tensor_checker(checker_config=None):
 
 def disable_tensor_checker():
     flags.set_flags({"check_nan_inf": False, "check_nan_inf_level": 0})
+    from ..generation.program_cache import clear_decode_program_cache
+    clear_decode_program_cache()
     jax.config.update("jax_debug_nans", False)
     f = getattr(_dump, "file", None)
     if f is not None:

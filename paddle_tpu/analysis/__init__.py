@@ -4,15 +4,19 @@ The reference stack pairs its kernels with correctness tooling
 (FLAGS_check_nan_inf sanitizer layers, op-level debugging hooks); this
 package holds the *static* half: analyzers that catch trace-discipline,
 SPMD collective-discipline, recovery-discipline, TPU
-kernel-discipline, and host-state handoff-discipline bugs at lint time
-instead of on-chip (or at drill time, or on the far side of a process
-boundary).  See :mod:`.tracecheck` (TRC rules), :mod:`.meshcheck` (MSH
-rules), :mod:`.faultcheck` (FLT rules), :mod:`.kernelcheck` (KRN
-rules), and :mod:`.statecheck` (STC rules); ``tools/analyze.py`` runs
-all five over one shared parse.
+kernel-discipline, host-state handoff-discipline, and compiled-program
+identity bugs at lint time instead of on-chip (or at drill time, on
+the far side of a process boundary, or as a stale cached program in
+production).  See :mod:`.tracecheck` (TRC rules), :mod:`.meshcheck`
+(MSH rules), :mod:`.faultcheck` (FLT rules), :mod:`.kernelcheck` (KRN
+rules), :mod:`.statecheck` (STC rules), and :mod:`.keycheck` (KEY
+rules); ``tools/analyze.py`` runs all six over one shared parse.
 
 :mod:`.tile_geometry` is the jax-free TPU tile/VMEM geometry module
 shared by the fused-decode kernel, the memwatch planner, and
 kernelcheck's KRN002 budget — one source for block shapes so the
-planner and the lint can never disagree.
+planner and the lint can never disagree.  :mod:`.key_vocab` plays the
+same role for program identity: the ``DecodeKey.extra`` tag grammar
+that ``generation/serving.py`` mints keys with and keycheck's KEY006
+lints against — identical-by-object, no drift possible.
 """

@@ -1,0 +1,73 @@
+"""keycheck — a compiled-program identity & cache-key soundness
+analyzer.
+
+tracecheck (r08) gates *trace* discipline, meshcheck (r11)
+*collective* discipline, faultcheck (r15) *recovery* discipline,
+kernelcheck (r20) *kernel* discipline, and statecheck (r21) *handoff*
+discipline; keycheck gates the contract all of serving rides on:
+``DecodeKey`` IS a compiled program's identity.  The two silent
+failure classes — a key-relevant input left OUT of the key (a stale
+program serves wrong math forever) and a per-dispatch value left IN
+(unbounded retrace churn on the most expensive compiles in the repo)
+— are invisible to the dynamic zero-retrace probes, which only see
+config combinations a test actually exercised.  Key soundness is a
+static property — check it before the collision ships.
+
+Rules (all pure AST over the shared tracecheck parse):
+
+- **KEY001** flag read reachable from a cached builder's traced body
+  where the flag is neither in ``PROGRAM_FLAGS`` (read from
+  ``flags.py`` by AST at analysis time) nor a key discriminant —
+  ``serving_kv_dtype`` is the annotated exemplar: eager-only BY
+  DESIGN because the dtype rides ``DecodeKey.extra``.
+- **KEY002** builder closure over mutable engine state not derivable
+  from key components (the documented generic/prefill model-object
+  closure is the pragma'd exemplar) — a second engine sharing the
+  key must get identical math.
+- **KEY003** key-component hygiene: unhashable/identity-hashed
+  objects, device values, raw floats, dicts in key fields or
+  ``extra``.
+- **KEY004** per-dispatch-varying values keyed — step counters, live
+  queue lengths, clocks/rng: retrace churn made static.
+- **KEY005** cache-invalidation discipline: a ``PROGRAM_FLAGS``
+  member mutated on a path that neither routes through
+  ``clear_decode_program_cache()`` nor mints a new key.
+- **KEY006** ``extra``-grammar discipline: one kind = one extra
+  schema package-wide, tag vocabulary registered in the jax-free
+  :mod:`..key_vocab` that ``generation/serving.py`` imports back
+  (identical-by-object — the tile_geometry/bundle_vocab coupling
+  pattern), so tree-spec and LoRA keys register tags instead of
+  inventing colliding positional tuples.
+
+The dynamic twin (tests/test_key_matrix.py) instantiates engines
+across the config lattice and proves the other direction at runtime:
+distinct configs mint distinct keys, identical configs share
+programs, eager-only flag toggles change NO key, and every
+``PROGRAM_FLAGS`` toggle changes ALL decode keys.
+
+Findings support inline ``# keycheck: disable=KEY00x`` pragmas
+(suite-scoped: another suite's pragma never silences KEY rules) and a
+checked-in baseline (tools/keycheck_baseline.json, kept empty — the
+precedent is fix, don't baseline); the tier-1 test gates NEW findings
+only.
+
+Run it locally::
+
+    python tools/analyze.py                   # all six suites
+    python tools/analyze.py --suite keycheck
+    python tools/keycheck.py --json           # key census included
+"""
+
+from ..tracecheck.findings import (Finding, fingerprint, load_baseline,
+                                   subtract_baseline, write_baseline)
+from .analyzer import AnalyzerConfig, AnalysisResult, analyze_package
+from .key_model import (declared_flag_names, extra_vocabulary,
+                        program_flags_vocabulary)
+from .rules import KEY_RULES
+
+__all__ = [
+    "AnalyzerConfig", "AnalysisResult", "Finding", "KEY_RULES",
+    "analyze_package", "declared_flag_names", "extra_vocabulary",
+    "fingerprint", "load_baseline", "program_flags_vocabulary",
+    "subtract_baseline", "write_baseline",
+]

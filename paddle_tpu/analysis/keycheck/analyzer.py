@@ -1,0 +1,120 @@
+"""Orchestration: parse (or reuse a parse), build the program-identity
+model, run the KEY rules.
+
+``analyze_package`` mirrors the other suites' entry points and accepts
+the same :class:`ParsedPackage`, so the unified CLI (tools/analyze.py)
+runs all SIX suites over ONE ast.parse pass.  The context build is
+read-only over the shared ``ModuleInfo`` objects, so running keycheck
+never changes what the other suites report on the same parse, in
+either order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..tracecheck.analyzer import ParsedPackage, parse_package
+from ..tracecheck.callgraph import CallGraph
+from ..tracecheck.findings import (Finding, dedupe_findings,
+                                   parse_pragmas, suppressed)
+from .key_model import build_context
+from . import rules as KR
+
+
+@dataclass
+class AnalyzerConfig:
+    exclude_patterns: tuple = ()
+    rules: tuple = ("KEY001", "KEY002", "KEY003", "KEY004", "KEY005",
+                    "KEY006")
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding]              # post-pragma, pre-baseline
+    suppressed: List[Finding]            # pragma-silenced
+    n_files: int = 0
+    n_functions: int = 0
+    n_key_sites: int = 0                 # kind-resolved DecodeKey sites
+    n_kinds: int = 0                     # distinct program kinds
+    n_tags: int = 0                      # extra tags observed in use
+    n_builders: int = 0                  # resolved builder functions
+    n_admissions: int = 0                # cache .get(key, builder) calls
+    n_minters: int = 0                   # DecodeKey-from-params functions
+    census: Dict[str, object] = field(default_factory=dict)
+    errors: List[str] = field(default_factory=list)
+
+
+_RULE_FNS = {
+    "KEY001": KR.key001_untracked_flag_read,
+    "KEY002": KR.key002_builder_closure,
+    "KEY003": KR.key003_component_hygiene,
+    "KEY004": KR.key004_per_dispatch_value,
+    "KEY005": KR.key005_invalidation_discipline,
+    "KEY006": KR.key006_extra_grammar,
+}
+
+
+def analyze_package(package_path: str,
+                    config: Optional[AnalyzerConfig] = None,
+                    parsed: Optional[ParsedPackage] = None
+                    ) -> AnalysisResult:
+    config = config or AnalyzerConfig()
+    if parsed is None:
+        parsed = parse_package(package_path, config.exclude_patterns)
+    else:
+        parsed = parsed.filtered(config.exclude_patterns)
+
+    result = AnalysisResult(findings=[], suppressed=[])
+    result.errors = list(parsed.errors)
+    result.n_files = parsed.n_files
+
+    graph = CallGraph(parsed.modules, parsed.package)
+    ctx = build_context(parsed.modules, graph)
+
+    sites = [s for s in ctx.key_sites if s.kinds]
+    kinds = sorted({k for s in sites for k in s.kinds})
+    builders = sorted({bfi.qualname for adm in ctx.admissions
+                       for bfi in adm.builder_fis})
+    result.n_key_sites = len(sites)
+    result.n_kinds = len(kinds)
+    result.n_tags = len(ctx.observed_tags)
+    result.n_builders = len(builders)
+    result.n_admissions = len(ctx.admissions)
+    result.n_minters = len(ctx.minters)
+    result.census = {
+        "decode_key_sites": sorted(
+            f"{s.fi.module.relpath}:{s.node.lineno} "
+            f"kind={'|'.join(s.kinds)}"
+            + (f" via={s.via}" if s.via else "") for s in sites),
+        "kinds": kinds,
+        "extra_tags": sorted(ctx.observed_tags),
+        "extra_atoms": sorted(ctx.observed_atoms),
+        "builders": builders,
+        "minters": sorted(m.fi.qualname for m in ctx.minters.values()),
+        "snapshot_sites": sorted(
+            f"{fi.module.relpath}:{node.lineno}"
+            for fi, node in ctx.snapshot_sites),
+        "set_sites": sorted(
+            f"{s.fi.module.relpath}:{s.node.lineno} "
+            f"{','.join(s.names)}" for s in ctx.set_sites),
+        "program_flags": sorted(ctx.program_flags),
+        "vocab_source": ctx.vocab.source,
+    }
+
+    findings: List[Finding] = []
+    for mod in parsed.modules.values():
+        pragmas = parse_pragmas(mod.source_lines, tool="keycheck")
+        for fi in mod.functions.values():
+            result.n_functions += 1
+            batch: List[Finding] = []
+            for code in config.rules:
+                fn = _RULE_FNS.get(code)
+                if fn is not None:
+                    batch += fn(fi, ctx)
+            for f in batch:
+                (result.suppressed if suppressed(f, pragmas)
+                 else findings).append(f)
+
+    result.findings = dedupe_findings(findings)
+    return result

@@ -1,0 +1,141 @@
+"""keycheck CLI (single-suite; tools/analyze.py runs all six suites
+over one parse).
+
+Exit codes: 0 clean (or all findings baselined/suppressed), 1 new
+findings, 2 usage/parse errors.  ``--json`` includes the key census
+(decode_key_sites, kinds, extra_tags, builders, snapshot_sites).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from ..tracecheck.findings import (load_baseline, subtract_baseline,
+                                   write_baseline)
+from .analyzer import AnalyzerConfig, analyze_package
+from .rules import KEY_RULES
+
+DEFAULT_BASELINE = os.path.join("tools", "keycheck_baseline.json")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="keycheck",
+        description="Compiled-program identity & cache-key soundness "
+                    "analyzer (KEY001-006).")
+    p.add_argument("path", nargs="?", default="paddle_tpu",
+                   help="package directory (or single file) to analyze")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit findings + key census as JSON on stdout")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: {DEFAULT_BASELINE} "
+                        "next to the analyzed package when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline: report every finding")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from current findings")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated subset of rules to run")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalogue and exit")
+    p.add_argument("--stats", action="store_true",
+                   help="print file/function/key-census counters")
+    return p
+
+
+def _default_baseline_path(pkg_path: str) -> str:
+    parent = os.path.dirname(os.path.abspath(pkg_path.rstrip(os.sep)))
+    return os.path.join(parent, DEFAULT_BASELINE)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for code in sorted(KEY_RULES):
+            print(f"{code}: {KEY_RULES[code]}")
+        return 0
+    if not os.path.exists(args.path):
+        print(f"keycheck: no such path: {args.path}", file=sys.stderr)
+        return 2
+
+    config = AnalyzerConfig()
+    if args.rules:
+        if args.update_baseline:
+            # a rule-filtered run sees a subset of findings — writing
+            # it out would erase every unselected rule's baseline
+            # entries (the r11 hardening parity rule)
+            print("keycheck: --rules cannot be combined with "
+                  "--update-baseline (it would clobber the other "
+                  "rules' baseline entries)", file=sys.stderr)
+            return 2
+        config = AnalyzerConfig(
+            rules=tuple(r.strip().upper() for r in args.rules.split(",")
+                        if r.strip()))
+
+    t0 = time.time()
+    result = analyze_package(args.path, config)
+    elapsed = time.time() - t0
+    for err in result.errors:
+        print(f"keycheck: parse error: {err}", file=sys.stderr)
+    if result.errors:
+        return 2
+
+    baseline_path = args.baseline or _default_baseline_path(args.path)
+    if args.update_baseline:
+        entries = write_baseline(baseline_path, result.findings)
+        print(f"keycheck: baselined {len(entries)} finding(s) -> "
+              f"{baseline_path}")
+        return 0
+
+    baseline = (load_baseline(baseline_path) if not args.no_baseline
+                else None)
+    if baseline:
+        new, leftovers = subtract_baseline(result.findings, baseline)
+        n_baselined = len(result.findings) - len(new)
+    else:
+        new, leftovers, n_baselined = result.findings, {}, 0
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in new],
+            "baselined": n_baselined,
+            "suppressed": len(result.suppressed),
+            "stale_baseline_entries": sorted(leftovers),
+            "files": result.n_files,
+            "functions": result.n_functions,
+            "key_sites": result.n_key_sites,
+            "kinds": result.n_kinds,
+            "extra_tags": result.n_tags,
+            "builders": result.n_builders,
+            "admissions": result.n_admissions,
+            "minters": result.n_minters,
+            "census": result.census,
+            "elapsed_s": round(elapsed, 3),
+        }, indent=1, sort_keys=True))
+    else:
+        for f in new:
+            print(f.format())
+        if args.stats:
+            print(f"-- {result.n_files} files, {result.n_functions} "
+                  f"functions ({result.n_key_sites} key sites / "
+                  f"{result.n_kinds} kinds / {result.n_tags} tags, "
+                  f"{result.n_builders} builders in "
+                  f"{result.n_admissions} admissions, "
+                  f"{result.n_minters} minters) in {elapsed:.2f}s")
+        summary = (f"keycheck: {len(new)} new finding(s), "
+                   f"{n_baselined} baselined, "
+                   f"{len(result.suppressed)} pragma-suppressed")
+        if leftovers:
+            summary += (f"; {sum(leftovers.values())} stale baseline "
+                        "entr(ies) — run --update-baseline")
+        print(summary)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

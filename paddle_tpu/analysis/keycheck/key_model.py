@@ -1,0 +1,737 @@
+"""The program-identity model keycheck reasons over (pure AST, shared
+parse).
+
+Four questions drive the KEY rules:
+
+1. **Which flags ride programs?**  ``PROGRAM_FLAGS`` as the analyzed
+   package declares it — read from ``flags.py`` by AST at analysis time
+   (the meshcheck ``_HYBRID_AXES`` idiom), with
+   :data:`..key_vocab.PROGRAM_FLAGS_FALLBACK` as the fixture-package
+   safety net — plus the discriminant flags whose values ride the key
+   as components (``serving_kv_dtype`` -> ``("kv", dtype)``).
+
+2. **Where are keys minted?**  Every ``DecodeKey(...)`` construction.
+   A construction whose ``kind`` is a parameter makes the enclosing
+   function a *minter* (``ServingEngine._key``); its call sites are
+   then resolved through the call graph and each becomes an effective
+   key site with the caller's kind/extra arguments bound to the
+   minter's parameters.  ``extra``-tuple reassignment chains in the
+   minter body (``extra = tuple(extra) + (("kv", ...),)``) contribute
+   the appended grammar.
+
+3. **What guards admission?**  Every
+   ``decode_program_cache().get(key, builder)`` call, with the builder
+   resolved through names, locals, ``functools.partial`` and lambdas
+   (the r15 donors.py return-of-local lesson).  The transitive closure
+   of functions reachable from builder bodies is the set whose flag
+   reads KEY001 audits.
+
+4. **What may ``extra`` say?**  The tag/atom vocabulary from the
+   analyzed package's ``analysis/key_vocab.py`` (again by AST, so
+   fixture packages can declare their own), falling back to the
+   constants this suite itself imports — identical-by-object with what
+   ``generation/serving.py`` uses at runtime.
+
+Everything here is READ-ONLY over the shared :class:`ModuleInfo`
+objects, so running keycheck never changes what the other suites
+report on the same parse, in either order.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..tracecheck.callgraph import (CallGraph, FunctionInfo, ModuleInfo,
+                                    _dotted, callee_name)
+from ..tracecheck.rules import _body_walk
+from .. import key_vocab
+
+# ------------------------------------------------- vocabulary extraction
+
+def _module_str_symbols(tree: ast.Module) -> Dict[str, str]:
+    """NAME = "literal" assignments at module scope (TAG_KV = "kv")."""
+    syms: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            syms[node.targets[0].id] = node.value.value
+    return syms
+
+
+def _assigned_value(tree: ast.Module, name: str) -> Optional[ast.expr]:
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == name:
+            return node.value
+    return None
+
+
+def _const_str_set(tree: ast.Module, syms: Dict[str, str],
+                   name: str) -> Optional[frozenset]:
+    """Resolve ``NAME = frozenset({...})`` / tuple / list of string
+    constants (or of names bound to string constants)."""
+    val = _assigned_value(tree, name)
+    if val is None:
+        return None
+    if isinstance(val, ast.Call) and val.args:
+        val = val.args[0]
+    if not isinstance(val, (ast.Tuple, ast.List, ast.Set)):
+        return None
+    out: Set[str] = set()
+    for el in val.elts:
+        if isinstance(el, ast.Constant) and isinstance(el.value, str):
+            out.add(el.value)
+        elif isinstance(el, ast.Name) and el.id in syms:
+            out.add(syms[el.id])
+    return frozenset(out)
+
+
+def _const_dict_keys(tree: ast.Module, syms: Dict[str, str],
+                     name: str) -> Optional[frozenset]:
+    val = _assigned_value(tree, name)
+    if not isinstance(val, ast.Dict):
+        return None
+    out: Set[str] = set()
+    for k in val.keys:
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            out.add(k.value)
+        elif isinstance(k, ast.Name) and k.id in syms:
+            out.add(syms[k.id])
+    return frozenset(out)
+
+
+def _find_module(modules: Dict[str, ModuleInfo],
+                 *suffixes: str) -> Optional[ModuleInfo]:
+    hits = [m for m in modules.values()
+            if any(m.relpath.endswith(s) for s in suffixes)]
+    if not hits:
+        return None
+    # prefer the shallowest path (the package's own top-level flags.py
+    # over some vendored copy)
+    return min(hits, key=lambda m: (m.relpath.count("/"), m.relpath))
+
+
+def program_flags_vocabulary(modules: Dict[str, ModuleInfo]) -> frozenset:
+    """``PROGRAM_FLAGS`` as declared by the analyzed package's
+    ``flags.py``, else the key_vocab fallback (fixture packages)."""
+    mod = _find_module(modules, "/flags.py", "flags.py")
+    if mod is not None:
+        vocab = _const_str_set(mod.tree, {}, "PROGRAM_FLAGS")
+        if vocab:
+            return vocab
+    return key_vocab.PROGRAM_FLAGS_FALLBACK
+
+
+def declared_flag_names(modules: Dict[str, ModuleInfo]
+                        ) -> Optional[frozenset]:
+    """Every ``define_flag("name", ...)`` in the analyzed package's
+    flags module; None when the package has no flags.py (fixtures) —
+    callers then treat every candidate name as a flag."""
+    mod = _find_module(modules, "/flags.py", "flags.py")
+    if mod is None:
+        return None
+    names: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            cn = (callee_name(node) or "").rsplit(".", 1)[-1]
+            if cn == "define_flag" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                names.add(node.args[0].value)
+    return frozenset(names) if names else None
+
+
+@dataclass
+class ExtraVocabulary:
+    tags: frozenset
+    atoms: frozenset
+    discriminants: frozenset          # flag names riding key components
+    derived_attrs: frozenset          # KEY002 closure allowlist
+    snapshot_attrs: frozenset
+    symbols: Dict[str, str]           # vocab constant name -> tag string
+    source: str                       # relpath of the vocab module, or ""
+
+
+def extra_vocabulary(modules: Dict[str, ModuleInfo]) -> ExtraVocabulary:
+    """The tag/atom vocabulary from the analyzed package's
+    ``analysis/key_vocab.py`` (AST — fixture packages can declare their
+    own), falling back to the constants this suite imports itself."""
+    mod = _find_module(modules, "analysis/key_vocab.py", "key_vocab.py")
+    if mod is not None:
+        syms = _module_str_symbols(mod.tree)
+        tags = _const_str_set(mod.tree, syms, "EXTRA_TAGS")
+        atoms = _const_str_set(mod.tree, syms, "EXTRA_ATOMS")
+        if tags is not None or atoms is not None:
+            return ExtraVocabulary(
+                tags=tags or frozenset(),
+                atoms=atoms or frozenset(),
+                discriminants=_const_dict_keys(
+                    mod.tree, syms, "DISCRIMINANT_FLAGS") or frozenset(),
+                derived_attrs=_const_str_set(
+                    mod.tree, syms, "KEY_DERIVED_ATTRS") or frozenset(),
+                snapshot_attrs=_const_str_set(
+                    mod.tree, syms, "SNAPSHOT_ATTRS")
+                or frozenset(key_vocab.SNAPSHOT_ATTRS),
+                symbols=syms, source=mod.relpath)
+    syms = {n: v for n, v in vars(key_vocab).items()
+            if n.isupper() and isinstance(v, str)}
+    return ExtraVocabulary(
+        tags=key_vocab.EXTRA_TAGS, atoms=key_vocab.EXTRA_ATOMS,
+        discriminants=frozenset(key_vocab.DISCRIMINANT_FLAGS),
+        derived_attrs=key_vocab.KEY_DERIVED_ATTRS,
+        snapshot_attrs=key_vocab.SNAPSHOT_ATTRS,
+        symbols=syms, source="")
+
+
+# --------------------------------------------------------- model objects
+
+@dataclass
+class KeySite:
+    """One effective DecodeKey minting site: either a direct
+    ``DecodeKey(...)`` construction with a statically-known kind, or a
+    resolved call into a minter with the caller's arguments bound."""
+    fi: FunctionInfo
+    node: ast.Call
+    kinds: Tuple[str, ...]            # () when the kind is opaque
+    via: Optional[str]                # minter qualname for call sites
+    fields: List[Tuple[str, ast.expr]]
+    grammar: Optional[Tuple[str, ...]]  # extra schema; None = opaque
+    unregistered: List[Tuple[ast.AST, str]] = field(default_factory=list)
+
+
+@dataclass
+class Minter:
+    """A function that constructs DecodeKey from its own parameters
+    (``ServingEngine._key`` / ``_spec_program``)."""
+    fi: FunctionInfo
+    key_node: ast.Call
+    params: List[str]                 # declared order, self/cls dropped
+    defaults: Dict[str, ast.expr]
+    kind_param: Optional[str]
+    extra_param: Optional[str]
+    appended: Tuple[str, ...] = ()    # grammar appended in the body
+    appended_unregistered: List[Tuple[ast.AST, str]] = \
+        field(default_factory=list)
+
+
+@dataclass
+class Admission:
+    """One ``decode_program_cache().get(key, builder)`` call."""
+    fi: FunctionInfo
+    node: ast.Call
+    builder_expr: ast.expr
+    builder_fis: List[FunctionInfo]
+    binds: List[Tuple[str, ast.expr]]  # partial-bound (name, value expr)
+
+
+@dataclass
+class SetSite:
+    """One ``flags.set_flags({...})`` / registry ``.set("name", v)``."""
+    fi: FunctionInfo
+    node: ast.Call
+    names: Tuple[str, ...]            # statically-known flag names
+
+
+@dataclass
+class KeyContext:
+    graph: CallGraph
+    program_flags: frozenset
+    flag_names: Optional[frozenset]
+    vocab: ExtraVocabulary
+    key_sites: List[KeySite] = field(default_factory=list)
+    minters: Dict[int, Minter] = field(default_factory=dict)
+    admissions: List[Admission] = field(default_factory=list)
+    builder_reachable: Set[int] = field(default_factory=set)
+    snapshot_sites: List[Tuple[FunctionInfo, ast.Call]] = \
+        field(default_factory=list)
+    set_sites: List[SetSite] = field(default_factory=list)
+    schema_conflicts: List[Tuple[KeySite, str, Tuple, Tuple, KeySite]] = \
+        field(default_factory=list)
+    observed_tags: Set[str] = field(default_factory=set)
+    observed_atoms: Set[str] = field(default_factory=set)
+
+    @property
+    def discriminants(self) -> frozenset:
+        return self.vocab.discriminants
+
+
+def _tail(name: Optional[str]) -> str:
+    return (name or "").rsplit(".", 1)[-1]
+
+
+# ------------------------------------------------------ local resolution
+
+def _local_assigns(fi: FunctionInfo, name: str) -> List[ast.expr]:
+    """Every statically-visible ``name = <expr>`` in this function
+    (pruned walk: a closure's assigns belong to its own FunctionInfo).
+    All arms matter — the decode builder local is assigned once per
+    if/elif dispatch arm."""
+    found: List[ast.expr] = []
+    for node in _body_walk(fi):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == name:
+            found.append(node.value)
+    return found
+
+
+def _local_assign(fi: FunctionInfo, name: str) -> Optional[ast.expr]:
+    found = _local_assigns(fi, name)
+    return found[0] if found else None
+
+
+def _kind_strings(fi: FunctionInfo, expr: Optional[ast.expr],
+                  depth: int = 0) -> Tuple[str, ...]:
+    """Statically-known kind strings an expression can evaluate to
+    (constants, locals, conditional expressions — the fused/nlayer
+    kind pivot is an IfExp of two constants)."""
+    if expr is None or depth > 4:
+        return ()
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return (expr.value,)
+    if isinstance(expr, ast.IfExp):
+        return (_kind_strings(fi, expr.body, depth + 1)
+                + _kind_strings(fi, expr.orelse, depth + 1))
+    if isinstance(expr, ast.Name):
+        return _kind_strings(fi, _local_assign(fi, expr.id), depth + 1)
+    return ()
+
+
+def _resolve_str(expr: ast.expr, symbols: Dict[str, str]
+                 ) -> Optional[str]:
+    """A string the expression statically names: a constant, a vocab
+    constant by Name, or ``key_vocab.TAG_X`` by Attribute."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    if isinstance(expr, ast.Name):
+        return symbols.get(expr.id)
+    if isinstance(expr, ast.Attribute):
+        return symbols.get(expr.attr)
+    return None
+
+
+def _grammar_of(fi: FunctionInfo, expr: Optional[ast.expr],
+                ctx: KeyContext, depth: int = 0
+                ) -> Tuple[Optional[Tuple[str, ...]],
+                           List[Tuple[ast.AST, str]]]:
+    """(schema descriptor, unregistered strings) for an extra
+    expression.  None schema = opaque (a parameter, an unresolvable
+    name) — opaque sites make no KEY006 schema claim but still get
+    their statically-visible strings vocabulary-checked."""
+    unreg: List[Tuple[ast.AST, str]] = []
+    if expr is None:
+        return (), unreg
+    if depth > 6:
+        return None, unreg
+    syms = ctx.vocab.symbols
+
+    if isinstance(expr, ast.Tuple):
+        gram: List[str] = []
+        for el in expr.elts:
+            s = _resolve_str(el, syms)
+            if s is not None:
+                if s in ctx.vocab.tags:
+                    ctx.observed_tags.add(s)
+                    gram.append(f"tag:{s}")
+                elif s in ctx.vocab.atoms:
+                    ctx.observed_atoms.add(s)
+                    gram.append(f"atom:{s}")
+                else:
+                    unreg.append((el, s))
+                    gram.append(f"?:{s}")
+            elif isinstance(el, ast.Tuple) and el.elts:
+                head = _resolve_str(el.elts[0], syms)
+                if head is not None:
+                    if head in ctx.vocab.tags:
+                        ctx.observed_tags.add(head)
+                        gram.append(f"pair:{head}")
+                    else:
+                        unreg.append((el.elts[0], head))
+                        gram.append(f"pair:?{head}")
+                else:
+                    gram.append("pair")
+            elif isinstance(el, ast.Constant) and \
+                    isinstance(el.value, int):
+                gram.append("int")
+            elif isinstance(el, ast.Dict):
+                gram.append("dict")     # KEY003's finding, not KEY006's
+            elif isinstance(el, ast.Call):
+                gram.append("seq" if _tail(callee_name(el)) == "tuple"
+                            else "expr")
+            else:
+                gram.append("var")
+        return tuple(gram), unreg
+
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        lg, lu = _grammar_of(fi, expr.left, ctx, depth + 1)
+        rg, ru = _grammar_of(fi, expr.right, ctx, depth + 1)
+        unreg = lu + ru
+        if lg is None or rg is None:
+            return None, unreg
+        return lg + rg, unreg
+
+    if isinstance(expr, ast.IfExp):
+        # both arms contribute to the vocabulary check; the schema
+        # itself becomes an alternative (opaque for conflict purposes)
+        _, bu = _grammar_of(fi, expr.body, ctx, depth + 1)
+        _, ou = _grammar_of(fi, expr.orelse, ctx, depth + 1)
+        return None, bu + ou
+
+    if isinstance(expr, ast.Name):
+        local = _local_assign(fi, expr.id)
+        if local is not None:
+            return _grammar_of(fi, local, ctx, depth + 1)
+        return None, unreg
+
+    if isinstance(expr, ast.Call) and _tail(callee_name(expr)) == "tuple":
+        return None, unreg
+    return None, unreg
+
+
+# -------------------------------------------------------- site scanning
+
+_KEY_FIELDS = ("kind", "model_sig", "batch_bucket", "page_budget",
+               "dtype", "flags", "extra")
+
+
+def _call_fields(node: ast.Call,
+                 param_names: Tuple[str, ...]) -> List[Tuple[str,
+                                                             ast.expr]]:
+    fields: List[Tuple[str, ast.expr]] = []
+    for i, a in enumerate(node.args):
+        fields.append((param_names[i] if i < len(param_names)
+                       else f"arg{i}", a))
+    for kw in node.keywords:
+        if kw.arg is not None:
+            fields.append((kw.arg, kw.value))
+    return fields
+
+
+def _field_expr(fields: List[Tuple[str, ast.expr]],
+                name: str) -> Optional[ast.expr]:
+    for n, e in fields:
+        if n == name:
+            return e
+    return None
+
+
+def _fn_params(fi: FunctionInfo) -> Tuple[List[str], Dict[str, ast.expr]]:
+    """Declared parameter names (self/cls dropped) and their defaults."""
+    if isinstance(fi.node, ast.Lambda):
+        args = fi.node.args
+    elif isinstance(fi.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        args = fi.node.args
+    else:
+        return [], {}
+    names = [a.arg for a in args.args]
+    if fi.cls and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    defaults: Dict[str, ast.expr] = {}
+    pos = args.args[-len(args.defaults):] if args.defaults else []
+    for a, d in zip(pos, args.defaults):
+        defaults[a.arg] = d
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if d is not None:
+            defaults[a.arg] = d
+    names += [a.arg for a in args.kwonlyargs if a.arg not in names]
+    return names, defaults
+
+
+def _minter_appends(minter: Minter, ctx: KeyContext) -> None:
+    """Grammar appended to the extra parameter inside the minter body:
+    ``extra = tuple(extra) + (("kv", ...),) [+ (("tp", N),)]``."""
+    if minter.extra_param is None:
+        return
+    gram: List[str] = []
+    for node in _body_walk(minter.fi):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == minter.extra_param):
+            continue
+        val = node.value
+        while isinstance(val, ast.BinOp) and isinstance(val.op, ast.Add):
+            g, u = _grammar_of(minter.fi, val.right, ctx)
+            if g is not None:
+                gram = list(g) + gram
+            minter.appended_unregistered.extend(u)
+            val = val.left
+    minter.appended = tuple(gram)
+
+
+def _scan_decode_keys(fi: FunctionInfo, ctx: KeyContext) -> None:
+    for node in _body_walk(fi):
+        if not isinstance(node, ast.Call):
+            continue
+        if _tail(callee_name(node)) != "DecodeKey":
+            continue
+        fields = _call_fields(node, _KEY_FIELDS)
+        kind_expr = _field_expr(fields, "kind")
+        params, defaults = _fn_params(fi)
+        if isinstance(kind_expr, ast.Name) and kind_expr.id in params \
+                and _local_assign(fi, kind_expr.id) is None:
+            # kind comes from a parameter: this function is a minter
+            extra_expr = _field_expr(fields, "extra")
+            extra_param = (extra_expr.id
+                           if isinstance(extra_expr, ast.Name)
+                           and extra_expr.id in params else None)
+            minter = Minter(fi=fi, key_node=node, params=params,
+                            defaults=defaults,
+                            kind_param=kind_expr.id,
+                            extra_param=extra_param)
+            _minter_appends(minter, ctx)
+            ctx.minters[id(fi)] = minter
+            # the construction itself stays a (kind-opaque) site so
+            # KEY003/KEY004 audit its direct field expressions
+            ctx.key_sites.append(KeySite(
+                fi=fi, node=node, kinds=(), via=None, fields=fields,
+                grammar=None))
+            continue
+        kinds = _kind_strings(fi, kind_expr)
+        gram, unreg = _grammar_of(fi, _field_expr(fields, "extra"), ctx)
+        ctx.key_sites.append(KeySite(
+            fi=fi, node=node, kinds=kinds, via=None, fields=fields,
+            grammar=gram, unregistered=unreg))
+
+
+def _scan_minter_calls(fi: FunctionInfo, ctx: KeyContext) -> None:
+    for call in fi.calls:
+        for target in ctx.graph.resolve_call(fi, call):
+            minter = ctx.minters.get(id(target))
+            if minter is None or target is fi:
+                continue
+            fields = _call_fields(call, tuple(minter.params))
+            kinds = _kind_strings(
+                fi, _field_expr(fields, minter.kind_param or "kind"))
+            extra_expr = _field_expr(fields, minter.extra_param
+                                     or "extra")
+            if extra_expr is None and minter.extra_param:
+                extra_expr = minter.defaults.get(minter.extra_param)
+            gram, unreg = _grammar_of(fi, extra_expr, ctx)
+            ctx.key_sites.append(KeySite(
+                fi=fi, node=call, kinds=kinds, via=target.qualname,
+                fields=fields, grammar=gram, unregistered=unreg))
+
+
+# ----------------------------------------------------- admission scanning
+
+def _cache_get_call(fi: FunctionInfo, node: ast.Call) -> bool:
+    """True for ``<decode_program_cache()>.get(key, builder, ...)`` —
+    directly chained or through a local bound to the cache."""
+    if not (isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get" and len(node.args) >= 2):
+        return False
+    base = node.func.value
+    if isinstance(base, ast.Call):
+        return _tail(callee_name(base)) == "decode_program_cache"
+    if isinstance(base, ast.Name):
+        local = _local_assign(fi, base.id)
+        return isinstance(local, ast.Call) and \
+            _tail(callee_name(local)) == "decode_program_cache"
+    return False
+
+
+def _lookup_function(fi: FunctionInfo, name: str
+                     ) -> Optional[FunctionInfo]:
+    """Resolve a bare name to a FunctionInfo: enclosing-scope nested
+    defs first, then module-level defs (the donors.py scope chain)."""
+    mod = fi.module
+    scope: Optional[FunctionInfo] = fi
+    while scope is not None:
+        hit = mod.functions.get(
+            (scope.qualname + "." if scope.qualname else "") + name)
+        if hit is not None:
+            return hit
+        scope = scope.parent
+    return mod.functions.get(name)
+
+
+def _resolve_builder(fi: FunctionInfo, expr: ast.expr, ctx: KeyContext,
+                     depth: int = 0
+                     ) -> Tuple[List[FunctionInfo],
+                                List[Tuple[str, ast.expr]]]:
+    """(builder FunctionInfos, partial-bound (name, value) pairs) for a
+    builder expression — through names, locals assigned earlier,
+    ``functools.partial`` and lambdas (the r15 return-of-local lesson)."""
+    if depth > 4:
+        return [], []
+    if isinstance(expr, ast.Lambda):
+        fis = [f for f in fi.module.functions.values()
+               if f.node is expr]
+        return fis, []
+    if isinstance(expr, ast.Name):
+        hit = _lookup_function(fi, expr.id)
+        if hit is not None:
+            return [hit], []
+        fis: List[FunctionInfo] = []
+        binds: List[Tuple[str, ast.expr]] = []
+        for local in _local_assigns(fi, expr.id):
+            lf, lb = _resolve_builder(fi, local, ctx, depth + 1)
+            fis.extend(f for f in lf if f not in fis)
+            binds.extend(lb)
+        return fis, binds
+    if isinstance(expr, ast.Attribute):
+        chain = _dotted(expr)
+        if chain:
+            parts = chain.split(".")
+            if parts[0] in ("self", "cls") and len(parts) == 2 and fi.cls:
+                hit = fi.module.functions.get(f"{fi.cls}.{parts[1]}")
+                return ([hit], []) if hit else ([], [])
+        return [], []
+    if isinstance(expr, ast.Call):
+        if _tail(callee_name(expr)) == "partial" and expr.args:
+            fis, _ = _resolve_builder(fi, expr.args[0], ctx, depth + 1)
+            binds: List[Tuple[str, ast.expr]] = []
+            pnames: List[str] = []
+            if fis:
+                pnames, _d = _fn_params(fis[0])
+            for i, a in enumerate(expr.args[1:]):
+                binds.append((pnames[i] if i < len(pnames)
+                              else f"arg{i}", a))
+            for kw in expr.keywords:
+                if kw.arg is not None:
+                    binds.append((kw.arg, kw.value))
+            return fis, binds
+        # builder() call result admitted directly — not the contract,
+        # leave opaque
+        return [], []
+    return [], []
+
+
+def _scan_admissions(fi: FunctionInfo, ctx: KeyContext) -> None:
+    for node in _body_walk(fi):
+        if isinstance(node, ast.Call) and _cache_get_call(fi, node):
+            builder_expr = node.args[1]
+            fis, binds = _resolve_builder(fi, builder_expr, ctx)
+            ctx.admissions.append(Admission(
+                fi=fi, node=node, builder_expr=builder_expr,
+                builder_fis=fis, binds=binds))
+
+
+def _forwarded_admissions(ctx: KeyContext,
+                          modules: Dict[str, ModuleInfo]) -> None:
+    """An admission whose builder is a *parameter* of the admitting
+    function (``_spec_program(kind, extra, builder)``) is opaque at
+    the ``.get`` — the partial is built by the caller.  Audit every
+    resolved call site that supplies the parameter, so KEY002 sees the
+    caller's binds and the builder lands in the reachable set."""
+    forwarding: Dict[int, Tuple[FunctionInfo, str]] = {}
+    for adm in ctx.admissions:
+        be = adm.builder_expr
+        params, _ = _fn_params(adm.fi)
+        if isinstance(be, ast.Name) and be.id in params and \
+                not _local_assigns(adm.fi, be.id):
+            forwarding[id(adm.fi)] = (adm.fi, be.id)
+    if not forwarding:
+        return
+    extra: List[Admission] = []
+    for mod in modules.values():
+        for fi in mod.functions.values():
+            for call in fi.calls:
+                for target in ctx.graph.resolve_call(fi, call):
+                    fwd = forwarding.get(id(target))
+                    if fwd is None or target is fi:
+                        continue
+                    tparams, _d = _fn_params(target)
+                    fields = _call_fields(call, tuple(tparams))
+                    bexpr = _field_expr(fields, fwd[1])
+                    if bexpr is None:
+                        continue
+                    fis, binds = _resolve_builder(fi, bexpr, ctx)
+                    extra.append(Admission(
+                        fi=fi, node=call, builder_expr=bexpr,
+                        builder_fis=fis, binds=binds))
+    ctx.admissions.extend(extra)
+
+
+# ------------------------------------------------- flag mutation / reads
+
+def _scan_flag_calls(fi: FunctionInfo, ctx: KeyContext) -> None:
+    for node in _body_walk(fi):
+        if not isinstance(node, ast.Call):
+            continue
+        name = callee_name(node) or ""
+        tail = _tail(name)
+        root = name.split(".")[0]
+        if tail == "snapshot" and ("flags" in root
+                                   or root in ("self", "cls")):
+            ctx.snapshot_sites.append((fi, node))
+        elif tail == "set_flags" and node.args and \
+                isinstance(node.args[0], ast.Dict):
+            names = tuple(k.value for k in node.args[0].keys
+                          if isinstance(k, ast.Constant)
+                          and isinstance(k.value, str))
+            if names:
+                ctx.set_sites.append(SetSite(fi, node, names))
+        elif tail == "set" and node.args and \
+                ("flags" in root or "registry" in root.lstrip("_")) and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            ctx.set_sites.append(
+                SetSite(fi, node, (node.args[0].value,)))
+
+
+# ------------------------------------------------------- reachable build
+
+def _builder_reachable(ctx: KeyContext,
+                       modules: Dict[str, ModuleInfo]) -> None:
+    seeds: List[FunctionInfo] = []
+    for adm in ctx.admissions:
+        seeds.extend(adm.builder_fis)
+    seen: Set[int] = set()
+    frontier = list(seeds)
+    while frontier:
+        fi = frontier.pop()
+        if id(fi) in seen:
+            continue
+        seen.add(id(fi))
+        prefix = fi.qualname + "."
+        for other in fi.module.functions.values():
+            if other.qualname.startswith(prefix) and \
+                    id(other) not in seen:
+                frontier.append(other)
+        for call in fi.calls:
+            for target in ctx.graph.resolve_call(fi, call):
+                if id(target) not in seen:
+                    frontier.append(target)
+    ctx.builder_reachable = seen
+
+
+# ------------------------------------------------------------- assembly
+
+def build_context(modules: Dict[str, ModuleInfo],
+                  graph: CallGraph) -> KeyContext:
+    ctx = KeyContext(graph=graph,
+                     program_flags=program_flags_vocabulary(modules),
+                     flag_names=declared_flag_names(modules),
+                     vocab=extra_vocabulary(modules))
+    fis = [f for m in sorted(modules.values(),
+                             key=lambda m: m.relpath)
+           for f in m.functions.values()]
+    for fi in fis:                       # pass 1: minters + direct sites
+        _scan_decode_keys(fi, ctx)
+    for fi in fis:                       # pass 2: minter call sites
+        _scan_minter_calls(fi, ctx)
+    for fi in fis:
+        _scan_admissions(fi, ctx)
+        _scan_flag_calls(fi, ctx)
+    _forwarded_admissions(ctx, modules)
+    _builder_reachable(ctx, modules)
+
+    # one kind = one extra schema, package-wide (KEY006)
+    schemas: Dict[str, Tuple[Tuple[str, ...], KeySite]] = {}
+    for site in ctx.key_sites:
+        if site.grammar is None or not site.kinds:
+            continue
+        for kind in site.kinds:
+            prior = schemas.get(kind)
+            if prior is None:
+                schemas[kind] = (site.grammar, site)
+            elif prior[0] != site.grammar:
+                ctx.schema_conflicts.append(
+                    (site, kind, site.grammar, prior[0], prior[1]))
+    return ctx

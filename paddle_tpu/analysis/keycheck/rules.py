@@ -1,0 +1,379 @@
+"""The six KEY rules (compiled-program identity & cache-key soundness).
+
+Each rule is ``fn(fi, ctx) -> List[Finding]`` over the program-identity
+model in :mod:`.key_model`; all state is precomputed there, so the
+rules are pure filters and the suite stays READ-ONLY over the shared
+parse.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+from ..tracecheck.callgraph import FunctionInfo, _dotted, callee_name
+from ..tracecheck.findings import Finding
+from ..tracecheck.rules import _body_walk
+from ..statecheck.bundle_vocab import device_producing
+from .key_model import Admission, KeyContext, KeySite
+
+KEY_RULES = {
+    "KEY001": "flag read reachable from a cached builder's traced body "
+              "where the flag is neither in PROGRAM_FLAGS nor a DecodeKey "
+              "discriminant — the compiled program freezes whatever value "
+              "it saw at trace time and serves it forever (stale-program "
+              "class; eager-only flags must stay out of traced bodies, or "
+              "ride the key like serving_kv_dtype does).",
+    "KEY002": "cached-program builder closes over mutable engine state "
+              "that is not derivable from the key's components — a second "
+              "engine admitted under the same key silently gets the FIRST "
+              "engine's math (the documented generic/prefill model-object "
+              "closure is the pragma'd exemplar).",
+    "KEY003": "key-component hygiene: unhashable or identity-hashed "
+              "object, device value, or raw float in a DecodeKey field "
+              "or extra tuple — keys must be pure host tuples with value "
+              "semantics (dict/list/set literals, floats, id()/hash(), "
+              "jnp-produced values).",
+    "KEY004": "per-dispatch-varying value keyed (step counter, live "
+              "queue/batch length, clock or rng) — every dispatch mints "
+              "a fresh key, so the program cache retraces forever "
+              "(retrace churn made static; key the bucket/rung, not the "
+              "live value).",
+    "KEY005": "PROGRAM_FLAGS member mutated on a path that neither "
+              "routes through clear_decode_program_cache() nor mints a "
+              "new key — cached programs keep their old flag tuple's "
+              "fault-site binding and memwatch banking until re-armed "
+              "(program_cache.py's documented re-arm contract).",
+    "KEY006": "extra-grammar discipline: a tag/atom not registered in "
+              "analysis/key_vocab.py, or a second extra schema for a "
+              "kind that already has one — one kind = one extra schema "
+              "package-wide, so new key families (tree-spec, LoRA) "
+              "cannot collide with existing positional tuples.",
+}
+
+
+def _finding(fi: FunctionInfo, node: ast.AST, rule: str,
+             msg: str) -> Finding:
+    line = getattr(node, "lineno", fi.lineno)
+    return Finding(rule=rule, path=fi.module.relpath, line=line,
+                   func=fi.qualname, message=msg,
+                   source=fi.module.line(line))
+
+
+def _tail(name: Optional[str]) -> str:
+    return (name or "").rsplit(".", 1)[-1]
+
+
+def _sites_of(fi: FunctionInfo, ctx: KeyContext) -> Iterator[KeySite]:
+    for site in ctx.key_sites:
+        if site.fi is fi:
+            yield site
+
+
+# ------------------------------------------------------------- KEY001
+
+_SNAP_PARAM_NAMES = frozenset({"snap", "snapshot"})
+
+
+def _snapshot_names(fi: FunctionInfo) -> frozenset:
+    """Names bound to a flag snapshot and visible in this scope:
+    parameters named like one, and locals assigned from a
+    ``*.snapshot(...)`` call — in this function or any lexically
+    enclosing one (a nested traced body reads the builder's snap)."""
+    names = set()
+    cur: Optional[FunctionInfo] = fi
+    while cur is not None:
+        node = cur.node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            for a in list(node.args.args) + list(node.args.kwonlyargs):
+                if a.arg in _SNAP_PARAM_NAMES or a.arg.endswith("_snap"):
+                    names.add(a.arg)
+        for sub in _body_walk(cur):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name) \
+                    and isinstance(sub.value, ast.Call) \
+                    and _tail(callee_name(sub.value)) == "snapshot":
+                names.add(sub.targets[0].id)
+        cur = cur.parent
+    return frozenset(names)
+
+
+def key001_untracked_flag_read(fi: FunctionInfo,
+                               ctx: KeyContext) -> List[Finding]:
+    if id(fi) not in ctx.builder_reachable:
+        return []
+    out: List[Finding] = []
+    tracked = ctx.program_flags | ctx.discriminants
+    snap_names = _snapshot_names(fi)
+
+    def is_flag(name: str) -> bool:
+        return ctx.flag_names is None or name in ctx.flag_names
+
+    for node in _body_walk(fi):
+        if isinstance(node, ast.Call):
+            if _tail(callee_name(node)) == "get_flag" and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                name = node.args[0].value
+                if name not in tracked and is_flag(name):
+                    out.append(_finding(
+                        fi, node, "KEY001",
+                        f"get_flag('{name}') is reachable from a cached "
+                        "builder but the flag is not in PROGRAM_FLAGS "
+                        "(nor a key discriminant) — the compiled program "
+                        "freezes the trace-time value"))
+        elif isinstance(node, ast.Attribute):
+            base = _dotted(node.value)
+            if base is None:
+                continue
+            parts = base.split(".")
+            is_snap = (len(parts) == 1 and parts[0] in snap_names) or \
+                (len(parts) == 2 and parts[0] in ("self", "cls")
+                 and parts[1] in ctx.vocab.snapshot_attrs)
+            if not is_snap:
+                continue
+            attr = node.attr
+            if attr.startswith("_") or attr == "as_tuple":
+                continue
+            if attr in tracked or not is_flag(attr):
+                continue
+            out.append(_finding(
+                fi, node, "KEY001",
+                f"snapshot read {base}.{attr} is reachable from a cached "
+                "builder but the flag is not in PROGRAM_FLAGS (nor a key "
+                "discriminant) — stale-program class"))
+    return out
+
+
+# ------------------------------------------------------------- KEY002
+
+def _closure_offenses(expr: ast.expr,
+                      ctx: KeyContext) -> Iterator[Tuple[ast.expr, str]]:
+    """self/cls-rooted attribute chains in a builder bind that are not
+    snapshot state or key-derived state."""
+    if isinstance(expr, ast.IfExp):
+        yield from _closure_offenses(expr.body, ctx)
+        yield from _closure_offenses(expr.orelse, ctx)
+        return
+    chain = _dotted(expr)
+    if chain is None:
+        return
+    parts = chain.split(".")
+    if parts[0] not in ("self", "cls") or len(parts) < 2:
+        return
+    attr = parts[1]
+    if attr in ctx.vocab.snapshot_attrs or \
+            attr in ctx.vocab.derived_attrs:
+        return
+    yield expr, chain
+
+
+def _is_nested_in(inner: FunctionInfo, outer: FunctionInfo) -> bool:
+    cur = inner.parent
+    while cur is not None:
+        if cur is outer:
+            return True
+        cur = cur.parent
+    return False
+
+
+def key002_builder_closure(fi: FunctionInfo,
+                           ctx: KeyContext) -> List[Finding]:
+    out: List[Finding] = []
+    for adm in ctx.admissions:
+        if adm.fi is not fi:
+            continue
+        for pname, vexpr in adm.binds:
+            for node, chain in _closure_offenses(vexpr, ctx):
+                out.append(_finding(
+                    fi, node, "KEY002",
+                    f"builder binds {pname}={chain} — mutable engine "
+                    "state not derivable from the key; a second engine "
+                    "sharing this key gets this engine's object"))
+        for bfi in adm.builder_fis:
+            if not _is_nested_in(bfi, fi):
+                continue
+            # a local-closure builder: its body may capture self.* from
+            # the admitting method's scope
+            for node in _body_walk(bfi):
+                if isinstance(node, ast.Attribute) and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id in ("self", "cls") and \
+                        node.attr not in ctx.vocab.snapshot_attrs and \
+                        node.attr not in ctx.vocab.derived_attrs:
+                    out.append(_finding(
+                        fi, node, "KEY002",
+                        f"local builder '{bfi.name}' closes over "
+                        f"self.{node.attr} — mutable engine state not "
+                        "derivable from the key"))
+    return out
+
+
+# ------------------------------------------------------------- KEY003
+
+_UNHASHABLE = (ast.Dict, ast.Set, ast.List, ast.ListComp, ast.SetComp,
+               ast.DictComp, ast.GeneratorExp)
+
+
+def _hygiene_offenses(fi: FunctionInfo, expr: ast.expr,
+                      depth: int = 0) -> Iterator[Tuple[ast.AST, str]]:
+    if depth > 4:
+        return
+    if isinstance(expr, ast.Tuple):
+        for el in expr.elts:
+            yield from _hygiene_offenses(fi, el, depth + 1)
+        return
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        yield from _hygiene_offenses(fi, expr.left, depth + 1)
+        yield from _hygiene_offenses(fi, expr.right, depth + 1)
+        return
+    if isinstance(expr, _UNHASHABLE):
+        yield expr, ("unhashable "
+                     f"{type(expr).__name__.lower()} in a key component")
+        return
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, float):
+        yield expr, "raw float constant in a key component"
+        return
+    if isinstance(expr, ast.Call):
+        tail = _tail(callee_name(expr))
+        if tail == "float":
+            yield expr, "raw float in a key component"
+            return
+        if tail in ("id", "hash"):
+            yield expr, (f"{tail}() in a key component — identity "
+                         "hashing breaks cross-engine sharing")
+            return
+    dev = device_producing(fi, expr)
+    if dev is not None:
+        yield expr, (f"device-producing '{dev}' in a key component — "
+                     "keys must be host values (a device array forces "
+                     "a sync and hashes by identity)")
+
+
+def key003_component_hygiene(fi: FunctionInfo,
+                             ctx: KeyContext) -> List[Finding]:
+    out: List[Finding] = []
+    for site in _sites_of(fi, ctx):
+        for fname, vexpr in site.fields:
+            for node, why in _hygiene_offenses(fi, vexpr):
+                out.append(_finding(
+                    fi, node, "KEY003", f"DecodeKey {fname}: {why}"))
+    return out
+
+
+# ------------------------------------------------------------- KEY004
+
+_STEP_NAMES = frozenset({"step", "steps", "counter", "counters", "tick",
+                         "ticks", "iteration", "iterations", "seq_no",
+                         "now", "t_now"})
+_CLOCK_TAILS = frozenset({"perf_counter", "monotonic", "time_ns",
+                          "process_time", "clock"})
+_RNG_TAILS = frozenset({"random", "randint", "uuid1", "uuid4",
+                        "getrandbits", "token_hex", "getpid"})
+
+
+def _dispatch_varying(fi: FunctionInfo,
+                      expr: ast.expr) -> Iterator[Tuple[ast.AST, str]]:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            name = callee_name(node) or ""
+            tail = _tail(name)
+            if tail in _CLOCK_TAILS or \
+                    (tail == "time" and name.split(".")[0] == "time"):
+                yield node, f"clock read {name}()"
+            elif tail in _RNG_TAILS:
+                yield node, f"rng/identity call {name}()"
+            elif tail == "len" and node.args:
+                chain = _dotted(node.args[0]) or ""
+                if chain.split(".")[0] in ("self", "cls"):
+                    yield node, (f"len({chain}) — a live container "
+                                 "length; key the bucket, not the load")
+        elif isinstance(node, ast.Attribute):
+            if node.attr.lstrip("_") in _STEP_NAMES:
+                yield node, f"step-like attribute .{node.attr}"
+        elif isinstance(node, ast.Name):
+            if node.id.lstrip("_") in _STEP_NAMES:
+                yield node, f"step-like name '{node.id}'"
+
+
+def key004_per_dispatch_value(fi: FunctionInfo,
+                              ctx: KeyContext) -> List[Finding]:
+    out: List[Finding] = []
+    for site in _sites_of(fi, ctx):
+        for fname, vexpr in site.fields:
+            for node, why in _dispatch_varying(fi, vexpr):
+                out.append(_finding(
+                    fi, node, "KEY004",
+                    f"DecodeKey {fname}: {why} — per-dispatch-varying "
+                    "values retrace on every call"))
+    return out
+
+
+# ------------------------------------------------------------- KEY005
+
+def _routes_through_invalidation(fi: FunctionInfo,
+                                 ctx: KeyContext) -> bool:
+    candidates = [fi]
+    for call in fi.calls:
+        candidates.extend(ctx.graph.resolve_call(fi, call))
+    site_fis = {id(s.fi) for s in ctx.key_sites}
+    for cand in candidates:
+        if id(cand) in site_fis:
+            return True
+        for call in cand.calls:
+            if _tail(callee_name(call)) == "clear_decode_program_cache":
+                return True
+    return False
+
+
+def key005_invalidation_discipline(fi: FunctionInfo,
+                                   ctx: KeyContext) -> List[Finding]:
+    touched = [s for s in ctx.set_sites
+               if s.fi is fi and set(s.names) & ctx.program_flags]
+    if not touched:
+        return []
+    if _routes_through_invalidation(fi, ctx):
+        return []
+    out: List[Finding] = []
+    for s in touched:
+        names = ", ".join(sorted(set(s.names) & ctx.program_flags))
+        out.append(_finding(
+            fi, s.node, "KEY005",
+            f"sets PROGRAM_FLAGS member(s) {names} without routing "
+            "through clear_decode_program_cache() or minting a new key "
+            "— cached programs keep the old flag tuple's fault/banking "
+            "binding until re-armed"))
+    return out
+
+
+# ------------------------------------------------------------- KEY006
+
+def key006_extra_grammar(fi: FunctionInfo,
+                         ctx: KeyContext) -> List[Finding]:
+    out: List[Finding] = []
+    for site in _sites_of(fi, ctx):
+        for node, s in site.unregistered:
+            out.append(_finding(
+                fi, node, "KEY006",
+                f"extra tag/atom '{s}' is not registered in "
+                "analysis/key_vocab.py — register it in "
+                "EXTRA_TAGS/EXTRA_ATOMS so other key families cannot "
+                "collide with it"))
+    minter = ctx.minters.get(id(fi))
+    if minter is not None:
+        for node, s in minter.appended_unregistered:
+            out.append(_finding(
+                fi, node, "KEY006",
+                f"extra tag/atom '{s}' appended by minter "
+                f"'{fi.qualname}' is not registered in "
+                "analysis/key_vocab.py"))
+    for site, kind, gram, prior_gram, prior in ctx.schema_conflicts:
+        if site.fi is not fi:
+            continue
+        out.append(_finding(
+            fi, site.node, "KEY006",
+            f"kind '{kind}' keys extra schema {list(gram)} here but "
+            f"{list(prior_gram)} at {prior.fi.module.relpath}:"
+            f"{prior.node.lineno} — one kind = one extra schema"))
+    return out

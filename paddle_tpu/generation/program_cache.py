@@ -51,6 +51,7 @@ program carries a memory signature next to its compile-time counter
 from __future__ import annotations
 
 import hashlib
+import re
 import threading
 import time
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
@@ -73,12 +74,23 @@ class DecodeKey(NamedTuple):
     extra: Tuple = ()         # kind-specific, e.g. (chunk_len,)
 
 
+# default object.__repr__ embeds a memory address: "<X object at 0x7f..>"
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+
+
 def model_signature(model) -> str:
     """Structural identity of a model: class + config + the full
     name/shape/dtype tree of params and buffers, digested. Captures
     everything that changes the traced program; weight VALUES are traced
-    arguments and deliberately excluded."""
-    parts = [type(model).__name__, repr(getattr(model, "config", None)),
+    arguments and deliberately excluded.
+
+    The config repr is canonicalized: a config member with a default
+    ``object.__repr__`` embeds its memory address, which would mint a
+    DISTINCT signature per instance — silently defeating cross-engine
+    program sharing and splitting telemetry ``model`` labels. Addresses
+    carry no structural identity, so they are zeroed out of the repr."""
+    cfg_repr = _ADDR_RE.sub("0x0", repr(getattr(model, "config", None)))
+    parts = [type(model).__name__, cfg_repr,
              f"training={getattr(model, 'training', False)}"]
     for name, t in sorted(model.named_parameters()):
         parts.append(f"{name}:{tuple(t.shape)}:{t.dtype}")
@@ -237,6 +249,12 @@ class DecodeProgramCache:
         (0.0 with telemetry off — the timing wrapper is not installed)."""
         with self._lock:
             return self._compile_seconds.get(key, 0.0)
+
+    def keys(self) -> List[DecodeKey]:
+        """Every key with a cached program (admission order) — the live
+        census ``tools/telemetry_dump.py --programs`` renders."""
+        with self._lock:
+            return list(self._programs)
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:

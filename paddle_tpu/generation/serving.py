@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import observability as obs
+from ..analysis import key_vocab
 from ..kernels.paged_attention import PagedDecodeState, PagedKVCache
 from ..testing import faults
 
@@ -1395,12 +1396,12 @@ class ServingEngine:
         # discriminant rides every key's extra (the pool dtype string
         # below also flips to "int8" for quantized pools, but the extra
         # covers the weight dtype and keys built before pools exist)
-        extra = tuple(extra) + (("kv", self.kv_dtype),
-                                ("wt", self.weight_dtype))
+        extra = tuple(extra) + ((key_vocab.TAG_KV, self.kv_dtype),
+                                (key_vocab.TAG_WT, self.weight_dtype))
         # tp rides the extra ONLY when armed, so every tp=1 key (and the
         # banked artifacts keyed on it) stays byte-identical to r18
         if self.tp_degree > 1:
-            extra = extra + (("tp", self.tp_degree),)
+            extra = extra + ((key_vocab.TAG_TP, self.tp_degree),)
         return DecodeKey(
             kind=kind, model_sig=self._model_sig,
             batch_bucket=self.max_batch if bucket is None else bucket,
@@ -1454,7 +1455,7 @@ class ServingEngine:
             from .program_cache import decode_program_cache
             self._prefill_fn = decode_program_cache().get(
                 self._key("prefill"),
-                functools.partial(_build_prefill, model=self.model))
+                functools.partial(_build_prefill, model=self.model))  # keycheck: disable=KEY002 — the documented model-object closure (model_sig rides the key)
         return self._prefill_fn
 
     def _chunk_program(self):
@@ -1467,7 +1468,7 @@ class ServingEngine:
             self._chunk_fn = decode_program_cache().get(
                 self._key("prefill_chunk", bucket=1,
                           extra=(self.chunk,)),
-                functools.partial(_build_chunk_prefill, model=self.model))
+                functools.partial(_build_chunk_prefill, model=self.model))  # keycheck: disable=KEY002 — the documented model-object closure (model_sig rides the key)
         return self._chunk_fn
 
     def _stacked_weights(self, spec) -> tuple:
@@ -1539,12 +1540,19 @@ class ServingEngine:
                     groups = [[i] for i in range(len(spec["layers"]))]
                     spec["layer_groups"] = groups
                 self._stacked_weights(spec)
-                kind = ("decode_fused_nlayer"
-                        if any(len(g) > 1 for g in groups)
-                        else "decode_fused")
-                key = self._key(
-                    kind, bucket=bucket,
-                    extra=("nlayer", tuple(len(g) for g in groups)))
+                if any(len(g) > 1 for g in groups):
+                    key = self._key(
+                        "decode_fused_nlayer", bucket=bucket,
+                        extra=(key_vocab.TAG_NLAYER,
+                               tuple(len(g) for g in groups)))
+                else:
+                    # all-singleton groups ARE the N=1 stacked layout:
+                    # model_sig pins the layer count, so a (1,)*L shape
+                    # tag adds nothing — key it as plain decode_fused
+                    # (the ("tp", N) pair still separates it from the
+                    # single-device program) so the kind keeps ONE
+                    # extra schema package-wide (KEY006)
+                    key = self._key("decode_fused", bucket=bucket)
                 builder = functools.partial(
                     _build_fused_nlayer_decode_tp, spec=spec,
                     snap=self._flags, mesh=self._tp_mesh,
@@ -1553,7 +1561,8 @@ class ServingEngine:
                 self._stacked_weights(spec)
                 key = self._key(
                     "decode_fused_nlayer", bucket=bucket,
-                    extra=("nlayer", tuple(len(g) for g in groups)))
+                    extra=(key_vocab.TAG_NLAYER,
+                           tuple(len(g) for g in groups)))
                 builder = functools.partial(_build_fused_nlayer_decode,
                                             spec=spec, snap=self._flags)
             elif spec:
@@ -1563,7 +1572,7 @@ class ServingEngine:
             else:
                 key = self._key("decode_generic", bucket=bucket)
                 builder = functools.partial(_build_generic_decode,
-                                            model=self.model)
+                                            model=self.model)  # keycheck: disable=KEY002 — the documented model-object closure (model_sig rides the key)
             fn = decode_program_cache().get(key, builder)
             self._decode_fns[bucket] = fn
             self._decode_keys[bucket] = key
@@ -2651,8 +2660,9 @@ class ServingEngine:
                              pool.max_pages_per_seq),
                 dtype=str(pool.k_pages[0].dtype),
                 flags=self._flags.as_tuple(),
-                extra=tuple(extra) + (("kv", self.kv_dtype),
-                                      ("wt", self.weight_dtype)))
+                extra=tuple(extra) + ((key_vocab.TAG_KV, self.kv_dtype),
+                                      (key_vocab.TAG_WT,
+                                       self.weight_dtype)))
             fn = decode_program_cache().get(key, builder)
             self._spec_fns[memo] = fn
             self._spec_keys[memo] = key
@@ -2669,16 +2679,18 @@ class ServingEngine:
         return self._spec_program(
             "prefill_chunk", (self.spec_sync_chunk,),
             functools.partial(_build_chunk_prefill,
-                              model=self.draft_model), draft=True)
+                              model=self.draft_model), draft=True)  # keycheck: disable=KEY002 — the documented model-object closure (draft model_sig rides the key)
 
     def _spec_draft_program(self, gamma: int, sample: bool,
                             top_k: int):
         fspec = self._fused_spec(draft=True)
-        mode = ("sample", int(top_k)) if sample else ("greedy",)
-        path = ("fused",) if fspec else ("generic",)
+        mode = ((key_vocab.ATOM_SAMPLE, int(top_k)) if sample
+                else (key_vocab.ATOM_GREEDY,))
+        path = ((key_vocab.ATOM_FUSED,) if fspec
+                else (key_vocab.ATOM_GENERIC,))
         return self._spec_program(
             "spec_draft", (gamma,) + path + mode,
-            functools.partial(_build_spec_draft, model=self.draft_model,
+            functools.partial(_build_spec_draft, model=self.draft_model,  # keycheck: disable=KEY002 — the documented model-object closure (draft model_sig rides the key)
                               gamma=gamma, sample=sample,
                               top_k=int(top_k), fspec=fspec,
                               snap=self._flags if fspec else None),
@@ -2686,10 +2698,11 @@ class ServingEngine:
 
     def _spec_verify_program(self, gamma: int, sample: bool,
                              top_k: int):
-        mode = ("sample", int(top_k)) if sample else ("greedy",)
+        mode = ((key_vocab.ATOM_SAMPLE, int(top_k)) if sample
+                else (key_vocab.ATOM_GREEDY,))
         return self._spec_program(
             "spec_verify", (gamma + 1,) + mode,
-            functools.partial(_build_spec_verify, model=self.model,
+            functools.partial(_build_spec_verify, model=self.model,  # keycheck: disable=KEY002 — the documented model-object closure (model_sig rides the key)
                               sample=sample, top_k=int(top_k)),
             draft=False)
 

@@ -1,10 +1,10 @@
 #!/usr/bin/env python
 """Unified static-analysis gate: tracecheck + meshcheck + faultcheck +
-kernelcheck + statecheck in ONE parse.
+kernelcheck + statecheck + keycheck in ONE parse.
 
 Usage:
-    python tools/analyze.py                      # all five suites, gate
-    python tools/analyze.py --suite kernelcheck  # one suite
+    python tools/analyze.py                      # all six suites, gate
+    python tools/analyze.py --suite keycheck     # one suite
     python tools/analyze.py --format json        # (--json still works)
     python tools/analyze.py --format sarif       # CI code-scanning upload
     python tools/analyze.py --format github      # ::error annotations
@@ -26,7 +26,7 @@ Stale-baseline reporting is suppressed in that mode: an entry for an
 unchanged file is filtered, not stale.
 
 Baselines: tools/{tracecheck,meshcheck,faultcheck,kernelcheck,
-statecheck}_baseline.json.
+statecheck,keycheck}_baseline.json.
 Exit codes: 0 clean, 1 new findings (any suite), 2 usage/parse errors.
 """
 
@@ -44,7 +44,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ANALYSIS_DIR = os.path.join(REPO, "paddle_tpu", "analysis")
 
 SUITES = ("tracecheck", "meshcheck", "faultcheck", "kernelcheck",
-          "statecheck")
+          "statecheck", "keycheck")
 FORMATS = ("human", "json", "sarif", "github")
 
 SARIF_VERSION = "2.1.0"
@@ -69,7 +69,7 @@ def _load_analysis():
 
 def _rule_catalogue(pkg):
     for attr in ("RULES", "MESH_RULES", "FAULT_RULES", "KERNEL_RULES",
-                 "STATE_RULES"):
+                 "STATE_RULES", "KEY_RULES"):
         cat = getattr(pkg, attr, None)
         if cat:
             return cat
@@ -81,8 +81,8 @@ def build_parser() -> argparse.ArgumentParser:
         prog="analyze",
         description="Run the tracecheck (TRC) + meshcheck (MSH) + "
                     "faultcheck (FLT) + kernelcheck (KRN) + "
-                    "statecheck (STC) static analyzers over one AST "
-                    "parse.")
+                    "statecheck (STC) + keycheck (KEY) static "
+                    "analyzers over one AST parse.")
     p.add_argument("path", nargs="?",
                    default=os.path.join(REPO, "paddle_tpu"),
                    help="package directory (or single file) to analyze")
@@ -105,8 +105,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "current findings")
     p.add_argument("--rules", default=None,
                    help="comma-separated subset of rules (TRC00x/MSH00x/"
-                        "FLT00x/KRN00x/STC00x; each suite picks out "
-                        "its own)")
+                        "FLT00x/KRN00x/STC00x/KEY00x; each suite picks "
+                        "out its own)")
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("--stats", action="store_true")
     return p
@@ -172,7 +172,8 @@ def _to_sarif(per_suite, catalogues) -> dict:
             "tool": {"driver": {
                 "name": "analyze",
                 "informationUri": "tools/analyze.py (tracecheck+"
-                    "meshcheck+faultcheck+kernelcheck+statecheck)",
+                    "meshcheck+faultcheck+kernelcheck+statecheck+"
+                    "keycheck)",
                 "rules": sorted(rules, key=lambda r: r["id"]),
             }},
             "results": results,

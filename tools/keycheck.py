@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Compiled-program identity & cache-key soundness lint gate (see
+paddle_tpu/analysis/keycheck/).
+
+Usage:
+    python tools/keycheck.py paddle_tpu           # gate (exit 1 on new)
+    python tools/keycheck.py paddle_tpu --json    # key census included
+    python tools/keycheck.py paddle_tpu --update-baseline
+    python tools/keycheck.py --list-rules
+
+Pure AST — the analysis package is loaded standalone (never through
+``paddle_tpu/__init__``), so this runs in seconds with no jax import
+and no device; safe as a pre-commit hook or bare CI step.  The suite
+leans on its siblings (the shared tracecheck parse, statecheck's
+device-expression vocabulary, and the jax-free key_vocab the serving
+engine imports back), so the PARENT analysis package is what gets
+loaded, as ``ptanalysis``.
+
+The checked-in baseline lives at tools/keycheck_baseline.json (kept
+EMPTY — fix, don't baseline); the tier-1 test (tests/test_keycheck.py)
+fails on any finding beyond it.
+
+``python tools/analyze.py`` runs this suite AND tracecheck AND
+meshcheck AND faultcheck AND kernelcheck AND statecheck over one
+shared parse — prefer it for the full gate.
+"""
+
+import importlib
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ANALYSIS_DIR = os.path.join(REPO, "paddle_tpu", "analysis")
+
+
+def _load_standalone():
+    """Import paddle_tpu.analysis WITHOUT triggering the framework's
+    top-level __init__ (which pulls in jax), then hand back the
+    keycheck CLI."""
+    spec = importlib.util.spec_from_file_location(
+        "ptanalysis", os.path.join(ANALYSIS_DIR, "__init__.py"),
+        submodule_search_locations=[ANALYSIS_DIR])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["ptanalysis"] = mod
+    spec.loader.exec_module(mod)
+    return importlib.import_module("ptanalysis.keycheck.cli")
+
+
+if __name__ == "__main__":
+    sys.exit(_load_standalone().main())

@@ -137,6 +137,44 @@ def render_memory(snap: dict, doc: dict = None) -> str:
     return "\n".join(lines)
 
 
+def render_programs() -> str:
+    """The --programs view: a LIVE census of the in-process decode
+    program cache — one row per cached key (kind, model-signature
+    prefix, batch bucket, page budget, dtype, the extra tuple, trace
+    count, banked compile seconds) plus the memwatch peak bytes when
+    the program's memory row was captured. The cache is process state,
+    not a snapshot artifact, so this only shows anything under --demo
+    (or when imported by an in-process serving harness)."""
+    from paddle_tpu.generation.program_cache import decode_program_cache
+    from paddle_tpu.observability.memory import _extra_str, program_table
+
+    cache = decode_program_cache()
+    stats = cache.stats()
+    keys = cache.keys()                  # admission order
+    for k in stats["traces"]:            # traced keys survive a clear of
+        if k not in keys:                # _programs only via stats; show
+            keys.append(k)               # them too rather than lose them
+    mem_peak = {(r["kind"], str(r["bucket"]), str(r["extra"])): r["peak"]
+                for r in program_table() if "peak" in r}
+    cols = ("kind", "model", "bucket", "pages", "dtype", "extra",
+            "traces", "compile_s", "peak_bytes")
+    lines = [f"# decode program cache: {stats['programs']} program(s), "
+             f"{stats['hits']} hit(s), {stats['misses']} miss(es)"]
+    lines.append("  ".join(f"{h:>18s}" for h in cols))
+    for k in keys:
+        row = (k.kind, k.model_sig[:8], str(k.batch_bucket),
+               _extra_str(k.page_budget), k.dtype,
+               _extra_str(k.extra) or "-",
+               str(stats["traces"].get(k, 0)),
+               f"{stats['compile_seconds'].get(k, 0.0):.3f}",
+               str(mem_peak.get((k.kind, str(k.batch_bucket),
+                                 _extra_str(k.extra)), "-")))
+        lines.append("  ".join(f"{v:>18s}" for v in row))
+    if not keys:
+        lines.append("  (no cached programs in this process)")
+    return "\n".join(lines)
+
+
 def render_table(snap: dict) -> str:
     from paddle_tpu.observability import series_quantile
 
@@ -160,7 +198,8 @@ def render_table(snap: dict) -> str:
     return "\n".join(lines)
 
 
-def run_demo(n_requests: int, tokens: int, trace_path, overhead: bool):
+def run_demo(n_requests: int, tokens: int, trace_path, overhead: bool,
+             programs: bool = False):
     import numpy as np
 
     import paddle_tpu as paddle
@@ -254,10 +293,13 @@ def run_demo(n_requests: int, tokens: int, trace_path, overhead: bool):
                 overhead_pct=(round((on - off) / off * 100, 2)
                               if off else None))
         print(json.dumps(result), file=sys.stderr)
+        # the census reads LIVE cache state, so render it before the
+        # finally clears the cache (the snapshot survives, keys don't)
+        prog_text = render_programs() if programs else None
     finally:
         flags.set_flags(dict(prior))
         clear_decode_program_cache()
-    return snap
+    return snap, prog_text
 
 
 def main() -> int:
@@ -271,6 +313,11 @@ def main() -> int:
     ap.add_argument("--memory", action="store_true",
                     help="memwatch view: per-program compiled-memory "
                     "table + KV pool ledger + watermarks")
+    ap.add_argument("--programs", action="store_true",
+                    help="live decode-program-cache census: one row per "
+                    "cached DecodeKey (kind/model/bucket/pages/dtype/"
+                    "extra) with trace counts, compile seconds, and "
+                    "memwatch peak bytes; pairs with --demo")
     ap.add_argument("--demo", action="store_true",
                     help="run a tiny in-process ServingEngine load and "
                     "dump ITS telemetry")
@@ -283,10 +330,17 @@ def main() -> int:
     args = ap.parse_args()
 
     doc = None
+    prog_text = None
     if args.demo:
-        snap = run_demo(args.requests, args.tokens, args.trace,
-                        args.overhead)
+        snap, prog_text = run_demo(args.requests, args.tokens, args.trace,
+                                   args.overhead, programs=args.programs)
     else:
+        if args.programs:
+            # live cache of THIS process — no demo means nothing was
+            # admitted, but the empty census (with its explanatory
+            # trailer line) is still the honest answer
+            print(render_programs())
+            return 0
         if args.path:
             with open(args.path) as fh:
                 doc = json.load(fh)
@@ -302,6 +356,8 @@ def main() -> int:
         sys.stdout.write("\n")
     elif args.memory:
         print(render_memory(snap, doc))
+    elif args.programs:
+        print(prog_text)
     else:
         print(render_table(snap))
     return 0
